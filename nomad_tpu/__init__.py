@@ -1,0 +1,23 @@
+"""nomad_tpu — a TPU-native cluster workload orchestrator.
+
+A brand-new implementation of the capabilities of HashiCorp Nomad 0.10
+(reference: /root/reference), redesigned TPU-first: the server-side
+scheduling core is a batched JAX/XLA constraint-satisfaction kernel
+("tpu-batch" scheduler) that scores all pending allocations against all
+feasible nodes in one pjit'd shot, while a scalar Python implementation of
+the reference's exact iterator semantics is kept as the correctness oracle.
+
+Layout (mirrors SURVEY.md §2's component inventory):
+  structs/    shared data model + resource math (ref: nomad/structs/)
+  state/      MVCC state store + watch sets     (ref: nomad/state/)
+  scheduler/  scalar oracle scheduler           (ref: scheduler/)
+  tpu/        columnar mirror + batched kernel  (new, TPU-native)
+  core/       broker, plan queue/applier, worker, leader duties (ref: nomad/)
+  client/     node agent, alloc/task runners    (ref: client/)
+  plugins/    driver/device plugin framework    (ref: plugins/)
+  api/        HTTP API + client                 (ref: api/, command/agent)
+  cli/        command-line interface            (ref: command/)
+  jobspec/    job specification parser          (ref: jobspec/)
+"""
+
+__version__ = "0.1.0"

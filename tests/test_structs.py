@@ -1,0 +1,308 @@
+"""Data model + resource math tests (semantics ref: nomad/structs/*_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    Bitmap,
+    NetworkIndex,
+    allocs_fit,
+    compute_class,
+    escaped_constraints,
+    parse_attribute,
+    parse_port_ranges,
+    score_fit,
+)
+from nomad_tpu.structs.model import (
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    ComparableResources,
+    Constraint,
+    Job,
+    NetworkResource,
+    Port,
+    filter_terminal_allocs,
+    remove_allocs,
+)
+
+
+def _alloc_res(cpu, mem, disk=0) -> AllocatedResources:
+    return AllocatedResources(
+        tasks={
+            "web": AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=cpu),
+                memory=AllocatedMemoryResources(memory_mb=mem),
+            )
+        },
+        shared=AllocatedSharedResources(disk_mb=disk),
+    )
+
+
+class TestAllocsFit:
+    def test_fits_empty(self):
+        n = mock.node()
+        fit, dim, used = allocs_fit(n, [])
+        assert fit, dim
+        # only the node reserved resources are counted
+        assert used.flattened.cpu.cpu_shares == 100
+        assert used.flattened.memory.memory_mb == 256
+
+    def test_fit_and_overcommit(self):
+        # ref funcs_test.go TestAllocsFit
+        n = mock.node()
+        a = Allocation(id="a1", allocated_resources=_alloc_res(2000, 2048, 1024))
+        fit, dim, used = allocs_fit(n, [a])
+        assert fit
+        assert used.flattened.cpu.cpu_shares == 2100
+        # Double the alloc → still fits in 4000/8192 (4100/4352) but triple won't
+        fit, dim, _ = allocs_fit(n, [a, a.copy(), a.copy()])
+        assert not fit
+        assert dim == "cpu"
+
+    def test_terminal_allocs_ignored(self):
+        n = mock.node()
+        a = Allocation(id="a1", allocated_resources=_alloc_res(100_000, 1))
+        a.desired_status = "stop"
+        fit, _, _ = allocs_fit(n, [a])
+        assert fit
+
+    def test_port_collision(self):
+        n = mock.node()
+        net = NetworkResource(
+            device="eth0",
+            ip="192.168.0.100",
+            reserved_ports=[Port(label="main", value=8000)],
+        )
+        res = _alloc_res(100, 100)
+        res.tasks["web"].networks = [net]
+        a1 = Allocation(id="a1", allocated_resources=res)
+        a2 = Allocation(id="a2", allocated_resources=res.copy())
+        fit, dim, _ = allocs_fit(n, [a1, a2])
+        assert not fit
+        assert dim == "reserved port collision"
+
+    def test_device_oversubscription(self):
+        n = mock.tpu_node()
+        dev_id = n.node_resources.devices[0].instances[0].id
+        res = _alloc_res(100, 100)
+        from nomad_tpu.structs.model import AllocatedDeviceResource
+
+        res.tasks["web"].devices = [
+            AllocatedDeviceResource(
+                vendor="google", type="tpu", name="v5e", device_ids=[dev_id]
+            )
+        ]
+        a1 = Allocation(id="a1", allocated_resources=res)
+        a2 = Allocation(id="a2", allocated_resources=res.copy())
+        fit, dim, _ = allocs_fit(n, [a1, a2], check_devices=True)
+        assert not fit
+        assert dim == "device oversubscribed"
+        fit, _, _ = allocs_fit(n, [a1], check_devices=True)
+        assert fit
+
+
+class TestScoreFit:
+    # ref funcs_test.go TestScoreFit
+    def _node(self):
+        n = mock.node()
+        n.node_resources.cpu.cpu_shares = 4096
+        n.node_resources.memory.memory_mb = 8192
+        n.reserved_resources = None
+        return n
+
+    def test_perfect_fit(self):
+        n = self._node()
+        util = ComparableResources(
+            flattened=AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=4096),
+                memory=AllocatedMemoryResources(memory_mb=8192),
+            )
+        )
+        assert score_fit(n, util) == 18.0
+
+    def test_zero_util(self):
+        n = self._node()
+        util = ComparableResources()
+        assert score_fit(n, util) == 0.0
+
+    def test_mid_util(self):
+        n = self._node()
+        util = ComparableResources(
+            flattened=AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=2048),
+                memory=AllocatedMemoryResources(memory_mb=4096),
+            )
+        )
+        score = score_fit(n, util)
+        assert abs(score - 13.675445) < 1e-4
+
+
+class TestNetworkIndex:
+    def test_set_node_reserved_ports(self):
+        n = mock.node()
+        idx = NetworkIndex()
+        collide = idx.set_node(n)
+        assert not collide
+        assert idx.used_ports["192.168.0.100"].check(22)
+
+    def test_assign_network_dynamic(self):
+        n = mock.node()
+        idx = NetworkIndex()
+        idx.set_node(n)
+        ask = NetworkResource(
+            mbits=50, dynamic_ports=[Port(label="http"), Port(label="admin")]
+        )
+        offer, err = idx.assign_network(ask)
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.100"
+        ports = {p.value for p in offer.dynamic_ports}
+        assert len(ports) == 2
+        for p in ports:
+            assert 20000 <= p < 32000
+
+    def test_assign_network_reserved_collision(self):
+        n = mock.node()
+        idx = NetworkIndex()
+        idx.set_node(n)
+        ask = NetworkResource(mbits=1, reserved_ports=[Port(label="ssh", value=22)])
+        offer, err = idx.assign_network(ask)
+        assert offer is None
+        assert err == "reserved port collision"
+
+    def test_bandwidth_exceeded(self):
+        n = mock.node()
+        idx = NetworkIndex()
+        idx.set_node(n)
+        ask = NetworkResource(mbits=2000)
+        offer, err = idx.assign_network(ask)
+        assert offer is None
+        assert err == "bandwidth exceeded"
+
+    def test_overcommitted(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        idx.add_reserved(
+            NetworkResource(device="eth0", ip="192.168.0.100", mbits=2000)
+        )
+        assert idx.overcommitted()
+
+    def test_parse_port_ranges(self):
+        assert parse_port_ranges("80,100-103,205") == [80, 100, 101, 102, 103, 205]
+        with pytest.raises(ValueError):
+            parse_port_ranges("200-100")
+
+
+class TestBitmap:
+    def test_basics(self):
+        b = Bitmap(128)
+        b.set(5)
+        assert b.check(5)
+        assert not b.check(6)
+        assert b.indexes_in_range(True, 0, 127) == [5]
+        assert 5 not in b.indexes_in_range(False, 0, 127)
+        c = b.copy()
+        c.unset(5)
+        assert b.check(5) and not c.check(5)
+
+
+class TestComputedClass:
+    def test_identical_nodes_same_class(self):
+        n1, n2 = mock.node(), mock.node()
+        assert n1.computed_class == n2.computed_class
+
+    def test_unique_attrs_excluded(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.attributes["unique.hostname"] = "xyz"
+        compute_class(n2)
+        assert n1.computed_class == n2.computed_class
+
+    def test_class_changes_with_attrs(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.attributes["kernel.name"] = "darwin"
+        compute_class(n2)
+        assert n1.computed_class != n2.computed_class
+
+    def test_devices_affect_class(self):
+        assert mock.node().computed_class != mock.tpu_node().computed_class
+
+    def test_escaped_constraints(self):
+        cs = [
+            Constraint(l_target="${node.unique.id}", r_target="x", operand="="),
+            Constraint(l_target="${attr.kernel.name}", r_target="linux", operand="="),
+        ]
+        esc = escaped_constraints(cs)
+        assert len(esc) == 1
+        assert esc[0].l_target == "${node.unique.id}"
+
+
+class TestAttribute:
+    def test_parse(self):
+        a = parse_attribute("11GiB")
+        assert a.int_val == 11 and a.unit == "GiB"
+        assert parse_attribute("3.14").float_val == 3.14
+        assert parse_attribute("true").bool_val is True
+        assert parse_attribute("hello").string_val == "hello"
+
+    def test_unit_compare(self):
+        a = parse_attribute("1GiB")
+        b = parse_attribute("1024MiB")
+        cmp, ok = a.compare(b)
+        assert ok and cmp == 0
+        c = parse_attribute("2000MB")
+        cmp, ok = a.compare(c)
+        assert ok and cmp == -1
+
+    def test_incomparable(self):
+        a = parse_attribute("1GiB")
+        b = parse_attribute("100MHz")
+        _, ok = a.compare(b)
+        assert not ok
+
+
+class TestModelHelpers:
+    def test_serialization_roundtrip(self):
+        j = mock.job()
+        j2 = Job.from_dict(j.to_dict())
+        assert j2.to_dict() == j.to_dict()
+        assert j2.task_groups[0].tasks[0].resources.cpu == 500
+
+    def test_remove_and_filter_allocs(self):
+        a1, a2 = mock.alloc(), mock.alloc()
+        assert [x.id for x in remove_allocs([a1, a2], [a2])] == [a1.id]
+        a2.client_status = "failed"
+        a2.name = a1.name
+        live, term = filter_terminal_allocs([a1, a2])
+        assert [x.id for x in live] == [a1.id]
+        assert term[a1.name].id == a2.id
+
+    def test_copy_preserves_typed_device_attributes(self):
+        n = mock.tpu_node().copy()
+        attr = n.node_resources.devices[0].attributes["memory"]
+        cmp, ok = attr.compare(parse_attribute("16GiB"))
+        assert ok and cmp == 0
+
+    def test_next_reschedule_time_guards(self):
+        a = mock.alloc()
+        a.modify_time = 12345
+        a.client_status = "running"
+        assert a.next_reschedule_time() == (0, False)
+        a.client_status = "failed"
+        t, eligible = a.next_reschedule_time()
+        assert eligible and t == 12345 + 5 * 1_000_000_000
+
+    def test_score_fit_zero_capacity_node(self):
+        n = mock.node()
+        n.node_resources.cpu.cpu_shares = 100  # equals reserved cpu
+        assert score_fit(n, ComparableResources()) == 0.0
+
+    def test_spec_changed(self):
+        j = mock.job()
+        j2 = j.copy()
+        j2.modify_index += 10
+        assert not j.specchanged(j2)
+        j2.priority += 1
+        assert j.specchanged(j2)
