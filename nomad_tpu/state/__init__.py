@@ -1,0 +1,3 @@
+"""MVCC state store (ref nomad/state/)."""
+
+from .store import Generation, StateReader, StateSnapshot, StateStore

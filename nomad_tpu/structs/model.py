@@ -1212,6 +1212,30 @@ class Evaluation(Base):
 
 
 @dataclass
+class TaskGroupSummary(Base):
+    queued: int = 0
+    complete: int = 0
+    failed: int = 0
+    running: int = 0
+    starting: int = 0
+    lost: int = 0
+
+
+@dataclass
+class JobSummary(Base):
+    """Per-job rollup of alloc states by task group (ref structs.go JobSummary)."""
+
+    job_id: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    summary: dict[str, TaskGroupSummary] = field(default_factory=dict)
+    children_pending: int = 0
+    children_running: int = 0
+    children_dead: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
 class DesiredUpdates(Base):
     ignore: int = 0
     place: int = 0

@@ -1,0 +1,313 @@
+"""State store tests (semantics ref: nomad/state/state_store_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs.model import (
+    Allocation,
+    DeploymentStatusUpdate,
+    Plan,
+    PlanResult,
+)
+
+
+class TestNodes:
+    def test_upsert_and_get(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1000, n)
+        got = s.node_by_id(n.id)
+        assert got.create_index == 1000 and got.modify_index == 1000
+        assert s.latest_index() == 1000
+        assert s.table_index("nodes") == 1000
+
+    def test_update_retains_server_fields(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1000, n)
+        s.update_node_drain(1001, n.id, True)
+        # re-register (client restart) must not clear drain
+        s.upsert_node(1002, n)
+        got = s.node_by_id(n.id)
+        assert got.drain is True
+        assert got.scheduling_eligibility == "ineligible"
+        assert got.create_index == 1000
+
+    def test_status_update(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        s.update_node_status(2, n.id, "down")
+        assert s.node_by_id(n.id).status == "down"
+        assert not s.node_by_id(n.id).ready()
+
+    def test_ready_nodes_in_dcs(self):
+        s = StateStore()
+        n1, n2, n3 = mock.node(), mock.node(), mock.node()
+        n2.datacenter = "dc2"
+        n3.status = "down"
+        for i, n in enumerate([n1, n2, n3]):
+            s.upsert_node(i + 1, n)
+        nodes, by_dc = s.ready_nodes_in_dcs(["dc1"])
+        assert [n.id for n in nodes] == [n1.id]
+        assert by_dc == {"dc1": 1}
+
+
+class TestJobs:
+    def test_upsert_versioning(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1000, j)
+        got = s.job_by_id(j.namespace, j.id)
+        assert got.version == 0 and got.create_index == 1000
+        j2 = j.copy()
+        j2.priority = 60
+        s.upsert_job(1001, j2)
+        got = s.job_by_id(j.namespace, j.id)
+        assert got.version == 1 and got.create_index == 1000
+        assert got.job_modify_index == 1001
+        versions = s.job_versions(j.namespace, j.id)
+        assert [v.version for v in versions] == [1, 0]
+
+    def test_summary_created(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        summary = s.job_summary_by_id(j.namespace, j.id)
+        assert "web" in summary.summary
+
+    def test_delete(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        s.delete_job(2, j.namespace, j.id)
+        assert s.job_by_id(j.namespace, j.id) is None
+        assert s.job_versions(j.namespace, j.id) == []
+
+
+class TestEvalsAllocs:
+    def test_eval_upsert(self):
+        s = StateStore()
+        e = mock.evaluation()
+        s.upsert_evals(10, [e])
+        assert s.eval_by_id(e.id).create_index == 10
+
+    def test_alloc_upsert_requires_job(self):
+        s = StateStore()
+        with pytest.raises(ValueError):
+            s.upsert_allocs(1, [Allocation(id="x")])
+
+    def test_alloc_upsert_and_client_update(self):
+        s = StateStore()
+        a = mock.alloc()
+        n = mock.node()
+        a.node_id = n.id
+        s.upsert_job(1, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)  # scheduler attaches snapshot job
+        s.upsert_allocs(2, [a])
+        got = s.alloc_by_id(a.id)
+        assert got.create_index == 2
+
+        # job should be marked running (non-terminal alloc)
+        assert s.job_by_id(a.namespace, a.job_id).status == "running"
+
+        update = a.copy()
+        update.client_status = "running"
+        s.update_allocs_from_client(3, [update])
+        assert s.alloc_by_id(a.id).client_status == "running"
+        summary = s.job_summary_by_id(a.namespace, a.job_id)
+        assert summary.summary["web"].running == 1
+
+    def test_scheduler_cannot_override_client_status(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        s.upsert_allocs(2, [a])
+        up = a.copy()
+        up.client_status = "running"
+        s.update_allocs_from_client(3, [up])
+        # scheduler rewrite with stale pending status must not clobber
+        stale = a.copy()
+        stale.client_status = "pending"
+        s.upsert_allocs(4, [stale])
+        assert s.alloc_by_id(a.id).client_status == "running"
+        # but marking lost is allowed
+        lost = a.copy()
+        lost.client_status = "lost"
+        s.upsert_allocs(5, [lost])
+        assert s.alloc_by_id(a.id).client_status == "lost"
+
+    def test_allocs_by_queries(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        s.upsert_allocs(2, [a])
+        assert len(s.allocs_by_node(a.node_id)) == 1
+        assert len(s.allocs_by_node_terminal(a.node_id, False)) == 1
+        assert len(s.allocs_by_node_terminal(a.node_id, True)) == 0
+        assert len(s.allocs_by_job(a.namespace, a.job_id)) == 1
+        assert len(s.allocs_by_eval(a.eval_id)) == 1
+
+
+class TestJobStatusTransitions:
+    def test_job_dead_when_last_alloc_terminal(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        s.upsert_allocs(2, [a])
+        assert s.job_by_id(a.namespace, a.job_id).status == "running"
+        done = a.copy()
+        done.client_status = "complete"
+        s.update_allocs_from_client(3, [done])
+        assert s.job_by_id(a.namespace, a.job_id).status == "dead"
+
+
+class TestDeploymentHealthMerge:
+    def test_client_can_only_set_health_once(self):
+        from nomad_tpu.structs.model import DeploymentStatus, DeploymentTaskGroupState
+
+        s = StateStore()
+        d = mock.deployment()
+        d.task_groups["web"] = DeploymentTaskGroupState(desired_total=1)
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        a.deployment_id = d.id
+        s.upsert_deployment(2, d)
+        s.upsert_allocs(3, [a])
+        u = a.copy()
+        u.deployment_status = DeploymentStatus(healthy=True, timestamp=1)
+        s.update_allocs_from_client(4, [u])
+        # a later update with no deployment status must not wipe stored health
+        u2 = a.copy()
+        u2.deployment_status = None
+        s.update_allocs_from_client(5, [u2])
+        # and a re-report must not double count
+        u3 = a.copy()
+        u3.deployment_status = DeploymentStatus(healthy=True, timestamp=2)
+        s.update_allocs_from_client(6, [u3])
+        assert s.deployment_by_id(d.id).task_groups["web"].healthy_allocs == 1
+        assert s.alloc_by_id(a.id).deployment_status.healthy is True
+
+
+class TestSnapshots:
+    def test_snapshot_isolation(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        snap = s.snapshot()
+        s.update_node_status(2, n.id, "down")
+        assert snap.node_by_id(n.id).status == "ready"
+        assert s.node_by_id(n.id).status == "down"
+
+    def test_snapshot_min_index(self):
+        s = StateStore()
+        n = mock.node()
+
+        def writer():
+            time.sleep(0.05)
+            s.upsert_node(5, n)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        snap = s.snapshot_min_index(5, timeout=2.0)
+        t.join()
+        assert snap.latest_index() >= 5
+
+    def test_snapshot_min_index_timeout(self):
+        s = StateStore()
+        with pytest.raises(TimeoutError):
+            s.snapshot_min_index(99, timeout=0.05)
+
+    def test_blocking_query_wakes_on_write(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        results = []
+
+        def query():
+            res, idx = s.blocking_query(
+                lambda snap: len(list(snap.nodes())), min_index=1, timeout=2.0
+            )
+            results.append((res, idx))
+
+        t = threading.Thread(target=query)
+        t.start()
+        time.sleep(0.05)
+        s.upsert_node(2, mock.node())
+        t.join()
+        assert results == [(2, 2)]
+
+
+class TestPlanResults:
+    def test_apply_plan(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        n = mock.node()
+        s.upsert_node(2, n)
+
+        a = mock.alloc()
+        a.job = None  # normalized out of the payload
+        a.job_id = j.id
+        a.namespace = j.namespace
+        a.node_id = n.id
+        plan = Plan(eval_id="e1", job=j)
+        result = PlanResult(node_allocation={n.id: [a]})
+        s.upsert_plan_results(10, plan, result)
+
+        got = s.alloc_by_id(a.id)
+        assert got is not None
+        assert got.job is not None and got.job.id == j.id
+        assert got.create_index == 10
+
+    def test_apply_plan_with_stops_and_preemptions(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        a = mock.alloc()
+        a.job_id = j.id
+        s.upsert_allocs(2, [a])
+
+        stop = a.copy()
+        stop.desired_status = "stop"
+        stop.job = None
+        plan = Plan(eval_id="e1", job=j)
+        result = PlanResult(node_update={a.node_id: [stop]})
+        s.upsert_plan_results(3, plan, result)
+        assert s.alloc_by_id(a.id).desired_status == "stop"
+
+    def test_deployment_update_via_plan(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        d = mock.deployment()
+        s.upsert_deployment(2, d)
+        plan = Plan(eval_id="e1", job=j)
+        result = PlanResult(
+            deployment_updates=[
+                DeploymentStatusUpdate(
+                    deployment_id=d.id, status="failed", status_description="x"
+                )
+            ]
+        )
+        s.upsert_plan_results(3, plan, result)
+        assert s.deployment_by_id(d.id).status == "failed"
+
+
+class TestDeployments:
+    def test_latest_by_job(self):
+        s = StateStore()
+        j = mock.job()
+        from nomad_tpu.structs.model import Deployment
+
+        d1 = Deployment.new_for_job(j)
+        d2 = Deployment.new_for_job(j)
+        s.upsert_deployment(1, d1)
+        s.upsert_deployment(2, d2)
+        assert s.latest_deployment_by_job_id(j.namespace, j.id).id == d2.id
