@@ -1,0 +1,979 @@
+"""Alloc reconciler: desired-vs-actual diff for service/batch jobs, including
+rolling updates, canaries, rescheduling, and deployment state
+(ref scheduler/reconcile.go, reconcile_util.go)."""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..structs.bitmap import Bitmap
+from ..structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_LOST,
+    ALLOC_DESIRED_STATUS_EVICT,
+    ALLOC_DESIRED_STATUS_STOP,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+    Allocation,
+    Deployment,
+    DeploymentStatusUpdate,
+    DeploymentTaskGroupState,
+    DesiredUpdates,
+    Evaluation,
+    Job,
+    Node,
+    TaskGroup,
+    alloc_name,
+    alloc_name_index,
+    generate_uuid,
+)
+from .util import (
+    ALLOC_LOST,
+    ALLOC_MIGRATING,
+    ALLOC_NOT_NEEDED,
+    ALLOC_RESCHEDULED,
+    ALLOC_UPDATING,
+    RESCHEDULING_FOLLOWUP_EVAL_DESC,
+)
+
+# ref reconcile.go:16-25
+BATCHED_FAILED_ALLOC_WINDOW_NS = 5 * 1_000_000_000
+RESCHEDULE_WINDOW_NS = 1 * 1_000_000_000
+
+DEPLOYMENT_DESC_STOPPED_JOB = "Cancelled because job is stopped"
+DEPLOYMENT_DESC_NEWER_JOB = "Cancelled due to newer version of job"
+DEPLOYMENT_DESC_SUCCESSFUL = "Deployment completed successfully"
+DEPLOYMENT_DESC_RUNNING_NEEDS_PROMOTION = "Deployment is running but requires promotion"
+DEPLOYMENT_DESC_RUNNING_AUTO_PROMOTION = (
+    "Deployment is running pending automatic promotion"
+)
+
+
+# ---------------------------------------------------------------------------
+# Result containers (ref reconcile_util.go:39-80)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AllocStopResult:
+    alloc: Allocation
+    client_status: str = ""
+    status_description: str = ""
+
+
+@dataclass
+class AllocPlaceResult:
+    name: str = ""
+    canary: bool = False
+    task_group: Optional[TaskGroup] = None
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+
+    def stop_previous_alloc(self) -> tuple[bool, str]:
+        return False, ""
+
+
+@dataclass
+class AllocDestructiveResult:
+    place_name: str = ""
+    place_task_group: Optional[TaskGroup] = None
+    stop_alloc: Optional[Allocation] = None
+    stop_status_description: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.place_name
+
+    @property
+    def task_group(self) -> Optional[TaskGroup]:
+        return self.place_task_group
+
+    @property
+    def previous_alloc(self) -> Optional[Allocation]:
+        return self.stop_alloc
+
+    canary = False
+    reschedule = False
+
+    def stop_previous_alloc(self) -> tuple[bool, str]:
+        return True, self.stop_status_description
+
+
+@dataclass
+class ReconcileResults:
+    """ref reconcile.go:90-122"""
+
+    deployment: Optional[Deployment] = None
+    deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
+    place: list[AllocPlaceResult] = field(default_factory=list)
+    destructive_update: list[AllocDestructiveResult] = field(default_factory=list)
+    inplace_update: list[Allocation] = field(default_factory=list)
+    stop: list[AllocStopResult] = field(default_factory=list)
+    attribute_updates: dict[str, Allocation] = field(default_factory=dict)
+    desired_tg_updates: dict[str, DesiredUpdates] = field(default_factory=dict)
+    desired_followup_evals: dict[str, list[Evaluation]] = field(default_factory=dict)
+
+    def changes(self) -> int:
+        return len(self.place) + len(self.inplace_update) + len(self.stop)
+
+
+@dataclass
+class DelayedRescheduleInfo:
+    alloc_id: str
+    alloc: Allocation
+    reschedule_time: int  # unix ns
+
+
+# ---------------------------------------------------------------------------
+# allocSet helpers (ref reconcile_util.go:108-371)
+# ---------------------------------------------------------------------------
+
+AllocSet = dict[str, Allocation]
+
+
+def new_alloc_matrix(job: Optional[Job], allocs: list[Allocation]) -> dict[str, AllocSet]:
+    m: dict[str, AllocSet] = {}
+    for a in allocs:
+        m.setdefault(a.task_group, {})[a.id] = a
+    if job is not None:
+        for tg in job.task_groups:
+            m.setdefault(tg.name, {})
+    return m
+
+
+def name_set(a: AllocSet) -> set[str]:
+    return {alloc.name for alloc in a.values()}
+
+
+def name_order(a: AllocSet) -> list[Allocation]:
+    return sorted(a.values(), key=lambda alloc: alloc_name_index(alloc.name))
+
+
+def difference(a: AllocSet, *others: AllocSet) -> AllocSet:
+    return {
+        k: v for k, v in a.items() if not any(k in other for other in others)
+    }
+
+
+def union(a: AllocSet, *others: AllocSet) -> AllocSet:
+    out = dict(a)
+    for other in others:
+        out.update(other)
+    return out
+
+
+def from_keys(a: AllocSet, keys: list[str]) -> AllocSet:
+    return {k: a[k] for k in keys if k in a}
+
+
+def filter_by_tainted(
+    a: AllocSet, nodes: dict[str, Optional[Node]]
+) -> tuple[AllocSet, AllocSet, AllocSet]:
+    """(untainted, migrate, lost) (ref reconcile_util.go:197-231)."""
+    untainted: AllocSet = {}
+    migrate: AllocSet = {}
+    lost: AllocSet = {}
+    for alloc in a.values():
+        if alloc.terminal_status():
+            untainted[alloc.id] = alloc
+            continue
+        if alloc.desired_transition.should_migrate():
+            migrate[alloc.id] = alloc
+            continue
+        if alloc.node_id not in nodes:
+            untainted[alloc.id] = alloc
+            continue
+        n = nodes[alloc.node_id]
+        if n is None or n.terminal_status():
+            lost[alloc.id] = alloc
+            continue
+        untainted[alloc.id] = alloc
+    return untainted, migrate, lost
+
+
+def should_filter(alloc: Allocation, is_batch: bool) -> tuple[bool, bool]:
+    """(untainted, ignore) (ref reconcile_util.go:283-319)."""
+    if is_batch:
+        if alloc.desired_status in (
+            ALLOC_DESIRED_STATUS_STOP,
+            ALLOC_DESIRED_STATUS_EVICT,
+        ):
+            if alloc.ran_successfully():
+                return True, False
+            return False, True
+        if alloc.client_status != ALLOC_CLIENT_STATUS_FAILED:
+            return True, False
+        return False, False
+
+    if alloc.desired_status in (ALLOC_DESIRED_STATUS_STOP, ALLOC_DESIRED_STATUS_EVICT):
+        return False, True
+    if alloc.client_status in (ALLOC_CLIENT_STATUS_COMPLETE, ALLOC_CLIENT_STATUS_LOST):
+        return False, True
+    return False, False
+
+
+def update_by_reschedulable(
+    alloc: Allocation, now_ns_: int, eval_id: str, d: Optional[Deployment]
+) -> tuple[bool, bool, int]:
+    """(reschedule_now, reschedule_later, reschedule_time)
+    (ref reconcile_util.go:323-345)."""
+    if (
+        d is not None
+        and alloc.deployment_id == d.id
+        and d.active()
+        and not bool(alloc.desired_transition.reschedule)
+    ):
+        return False, False, 0
+
+    reschedule_now = False
+    if alloc.desired_transition.should_force_reschedule():
+        reschedule_now = True
+
+    reschedule_time, eligible = alloc.next_reschedule_time()
+    if eligible and (
+        alloc.follow_up_eval_id == eval_id
+        or reschedule_time - now_ns_ <= RESCHEDULE_WINDOW_NS
+    ):
+        return True, False, reschedule_time
+    if reschedule_now:
+        return True, False, reschedule_time
+    if eligible and alloc.follow_up_eval_id == "":
+        return False, True, reschedule_time
+    return False, False, reschedule_time
+
+
+def filter_by_rescheduleable(
+    a: AllocSet, is_batch: bool, now_ns_: int, eval_id: str, deployment
+) -> tuple[AllocSet, AllocSet, list[DelayedRescheduleInfo]]:
+    """(untainted, reschedule_now, reschedule_later)
+    (ref reconcile_util.go:237-271)."""
+    untainted: AllocSet = {}
+    reschedule_now: AllocSet = {}
+    reschedule_later: list[DelayedRescheduleInfo] = []
+
+    for alloc in a.values():
+        if alloc.next_allocation != "":
+            continue
+        is_untainted, ignore = should_filter(alloc, is_batch)
+        if is_untainted:
+            untainted[alloc.id] = alloc
+        if is_untainted or ignore:
+            continue
+        eligible_now, eligible_later, reschedule_time = update_by_reschedulable(
+            alloc, now_ns_, eval_id, deployment
+        )
+        if not eligible_now:
+            untainted[alloc.id] = alloc
+            if eligible_later:
+                reschedule_later.append(
+                    DelayedRescheduleInfo(alloc.id, alloc, reschedule_time)
+                )
+        else:
+            reschedule_now[alloc.id] = alloc
+    return untainted, reschedule_now, reschedule_later
+
+
+def filter_by_terminal(a: AllocSet) -> AllocSet:
+    return {k: v for k, v in a.items() if not v.terminal_status()}
+
+
+def filter_by_deployment(a: AllocSet, deployment_id: str) -> tuple[AllocSet, AllocSet]:
+    match = {k: v for k, v in a.items() if v.deployment_id == deployment_id}
+    nonmatch = {k: v for k, v in a.items() if v.deployment_id != deployment_id}
+    return match, nonmatch
+
+
+# ---------------------------------------------------------------------------
+# Name index (ref reconcile_util.go:375-554)
+# ---------------------------------------------------------------------------
+
+def _bitmap_from(input_set: AllocSet, min_size: int) -> Bitmap:
+    max_idx = 0
+    for a in input_set.values():
+        num = alloc_name_index(a.name)
+        if num > max_idx:
+            max_idx = num
+    if min_size < len(input_set):
+        min_size = len(input_set)
+    if max_idx < min_size:
+        max_idx = min_size
+    elif max_idx % 8 == 0:
+        max_idx += 1
+    if max_idx == 0:
+        max_idx = 8
+    if max_idx % 8 != 0:
+        max_idx += 8 - (max_idx % 8)
+    bitmap = Bitmap(max_idx)
+    for a in input_set.values():
+        bitmap.set(alloc_name_index(a.name))
+    return bitmap
+
+
+class AllocNameIndex:
+    def __init__(self, job: str, task_group: str, count: int, in_set: AllocSet):
+        self.job = job
+        self.task_group = task_group
+        self.count = count
+        self.b = _bitmap_from(in_set, count)
+
+    def highest(self, n: int) -> set[str]:
+        h: set[str] = set()
+        for idx in range(self.b.size - 1, -1, -1):
+            if len(h) >= n:
+                break
+            if self.b.check(idx):
+                self.b.unset(idx)
+                h.add(alloc_name(self.job, self.task_group, idx))
+        return h
+
+    def unset_index(self, idx: int):
+        self.b.unset(idx)
+
+    def next_canaries(
+        self, n: int, existing: AllocSet, destructive: AllocSet
+    ) -> list[str]:
+        """ref reconcile_util.go:475-526"""
+        next_names: list[str] = []
+        existing_names = name_set(existing)
+        dmap = _bitmap_from(destructive, self.count)
+        remainder = n
+        for idx in dmap.indexes_in_range(True, 0, self.count - 1):
+            name = alloc_name(self.job, self.task_group, idx)
+            if name not in existing_names:
+                next_names.append(name)
+                self.b.set(idx)
+                remainder = n - len(next_names)
+                if remainder == 0:
+                    return next_names
+        for idx in self.b.indexes_in_range(False, 0, self.count - 1):
+            name = alloc_name(self.job, self.task_group, idx)
+            if name not in existing_names:
+                next_names.append(name)
+                self.b.set(idx)
+                remainder = n - len(next_names)
+                if remainder == 0:
+                    return next_names
+        for i in range(self.count, self.count + remainder):
+            next_names.append(alloc_name(self.job, self.task_group, i))
+        return next_names
+
+    def next(self, n: int) -> list[str]:
+        next_names: list[str] = []
+        for idx in self.b.indexes_in_range(False, 0, self.count - 1):
+            next_names.append(alloc_name(self.job, self.task_group, idx))
+            self.b.set(idx)
+            if len(next_names) == n:
+                return next_names
+        remainder = n - len(next_names)
+        for i in range(remainder):
+            next_names.append(alloc_name(self.job, self.task_group, i))
+            self.b.set(i)
+        return next_names
+
+
+# ---------------------------------------------------------------------------
+# Reconciler
+# ---------------------------------------------------------------------------
+
+def _update_is_empty(update) -> bool:
+    return update is None or update.max_parallel == 0
+
+
+class AllocReconciler:
+    """ref reconcile.go:39-539"""
+
+    def __init__(
+        self,
+        alloc_update_fn: Callable,
+        batch: bool,
+        job_id: str,
+        job: Optional[Job],
+        deployment: Optional[Deployment],
+        existing_allocs: list[Allocation],
+        tainted_nodes: dict[str, Optional[Node]],
+        eval_id: str,
+        now_ns_: Optional[int] = None,
+    ):
+        self.alloc_update_fn = alloc_update_fn
+        self.batch = batch
+        self.job_id = job_id
+        self.job = job
+        self.old_deployment: Optional[Deployment] = None
+        self.deployment = deployment.copy() if deployment is not None else None
+        self.deployment_paused = False
+        self.deployment_failed = False
+        self.tainted_nodes = tainted_nodes
+        self.existing_allocs = existing_allocs
+        self.eval_id = eval_id
+        self.now = now_ns_ if now_ns_ is not None else _time.time_ns()
+        self.result = ReconcileResults()
+
+    def compute(self) -> ReconcileResults:
+        m = new_alloc_matrix(self.job, self.existing_allocs)
+        self._cancel_deployments()
+
+        if self.job is None or self.job.stopped():
+            self._handle_stop(m)
+            return self.result
+
+        if self.deployment is not None:
+            self.deployment_paused = (
+                self.deployment.status == DEPLOYMENT_STATUS_PAUSED
+            )
+            self.deployment_failed = (
+                self.deployment.status == DEPLOYMENT_STATUS_FAILED
+            )
+
+        complete = True
+        for group, allocs in m.items():
+            group_complete = self._compute_group(group, allocs)
+            complete = complete and group_complete
+
+        if self.deployment is not None and complete:
+            self.result.deployment_updates.append(
+                DeploymentStatusUpdate(
+                    deployment_id=self.deployment.id,
+                    status=DEPLOYMENT_STATUS_SUCCESSFUL,
+                    status_description=DEPLOYMENT_DESC_SUCCESSFUL,
+                )
+            )
+
+        d = self.result.deployment
+        if d is not None and d.requires_promotion():
+            if d.has_auto_promote():
+                d.status_description = DEPLOYMENT_DESC_RUNNING_AUTO_PROMOTION
+            else:
+                d.status_description = DEPLOYMENT_DESC_RUNNING_NEEDS_PROMOTION
+
+        return self.result
+
+    def _cancel_deployments(self):
+        """ref reconcile.go:235-276"""
+        if self.job is None or self.job.stopped():
+            if self.deployment is not None and self.deployment.active():
+                self.result.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=self.deployment.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description=DEPLOYMENT_DESC_STOPPED_JOB,
+                    )
+                )
+            self.old_deployment = self.deployment
+            self.deployment = None
+            return
+
+        d = self.deployment
+        if d is None:
+            return
+
+        if (
+            d.job_create_index != self.job.create_index
+            or d.job_version != self.job.version
+        ):
+            if d.active():
+                self.result.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=d.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description=DEPLOYMENT_DESC_NEWER_JOB,
+                    )
+                )
+            self.old_deployment = d
+            self.deployment = None
+
+        if d.status == DEPLOYMENT_STATUS_SUCCESSFUL:
+            self.old_deployment = d
+            self.deployment = None
+
+    def _handle_stop(self, m: dict[str, AllocSet]):
+        for group, allocs in m.items():
+            allocs = filter_by_terminal(allocs)
+            untainted, migrate, lost = filter_by_tainted(allocs, self.tainted_nodes)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_STATUS_LOST, ALLOC_LOST)
+            desired = DesiredUpdates()
+            desired.stop = len(allocs)
+            self.result.desired_tg_updates[group] = desired
+
+    def _mark_stop(self, allocs: AllocSet, client_status: str, status_description: str):
+        for alloc in allocs.values():
+            self.result.stop.append(
+                AllocStopResult(
+                    alloc=alloc,
+                    client_status=client_status,
+                    status_description=status_description,
+                )
+            )
+
+    def _compute_group(self, group: str, all_set: AllocSet) -> bool:
+        """ref reconcile.go:306-539"""
+        desired_changes = DesiredUpdates()
+        self.result.desired_tg_updates[group] = desired_changes
+
+        tg = self.job.lookup_task_group(group)
+        if tg is None:
+            untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_STATUS_LOST, ALLOC_LOST)
+            desired_changes.stop = len(untainted) + len(migrate) + len(lost)
+            return True
+
+        dstate: Optional[DeploymentTaskGroupState] = None
+        existing_deployment = False
+        if self.deployment is not None:
+            dstate = self.deployment.task_groups.get(group)
+            existing_deployment = dstate is not None
+        if not existing_deployment:
+            dstate = DeploymentTaskGroupState()
+            if not _update_is_empty(tg.update):
+                dstate.auto_revert = tg.update.auto_revert
+                dstate.auto_promote = tg.update.auto_promote
+                dstate.progress_deadline = tg.update.progress_deadline
+
+        all_set, ignore = self._filter_old_terminal_allocs(all_set)
+        desired_changes.ignore += len(ignore)
+
+        canaries, all_set = self._handle_group_canaries(all_set, desired_changes)
+
+        untainted, migrate, lost = filter_by_tainted(all_set, self.tainted_nodes)
+
+        untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
+            untainted, self.batch, self.now, self.eval_id, self.deployment
+        )
+
+        self._handle_delayed_reschedules(reschedule_later, all_set, tg.name)
+
+        name_index = AllocNameIndex(
+            self.job_id, group, tg.count, union(untainted, migrate, reschedule_now)
+        )
+
+        canary_state = (
+            dstate is not None and dstate.desired_canaries != 0 and not dstate.promoted
+        )
+        stop = self._compute_stop(
+            tg, name_index, untainted, migrate, lost, canaries, canary_state
+        )
+        desired_changes.stop += len(stop)
+        untainted = difference(untainted, stop)
+
+        ignore2, inplace, destructive = self._compute_updates(tg, untainted)
+        desired_changes.ignore += len(ignore2)
+        desired_changes.in_place_update += len(inplace)
+        if not existing_deployment:
+            dstate.desired_total += len(destructive) + len(inplace)
+
+        if canary_state:
+            untainted = difference(untainted, canaries)
+
+        num_destructive = len(destructive)
+        strategy = tg.update
+        canaries_promoted = dstate is not None and dstate.promoted
+        require_canary = (
+            num_destructive != 0
+            and strategy is not None
+            and len(canaries) < strategy.canary
+            and not canaries_promoted
+        )
+        if require_canary and not self.deployment_paused and not self.deployment_failed:
+            number = strategy.canary - len(canaries)
+            desired_changes.canary += number
+            if not existing_deployment:
+                dstate.desired_canaries = strategy.canary
+            for name in name_index.next_canaries(number, canaries, destructive):
+                self.result.place.append(
+                    AllocPlaceResult(name=name, canary=True, task_group=tg)
+                )
+
+        canary_state = (
+            dstate is not None and dstate.desired_canaries != 0 and not dstate.promoted
+        )
+        limit = self._compute_limit(tg, untainted, destructive, migrate, canary_state)
+
+        place = self._compute_placements(
+            tg, name_index, untainted, migrate, reschedule_now
+        )
+        if not existing_deployment:
+            dstate.desired_total += len(place)
+
+        deployment_place_ready = (
+            not self.deployment_paused
+            and not self.deployment_failed
+            and not canary_state
+        )
+
+        if deployment_place_ready:
+            desired_changes.place += len(place)
+            self.result.place.extend(place)
+            self._mark_stop(reschedule_now, "", ALLOC_RESCHEDULED)
+            desired_changes.stop += len(reschedule_now)
+            limit -= min(len(place), limit)
+        else:
+            if lost:
+                allowed = min(len(lost), len(place))
+                desired_changes.place += allowed
+                self.result.place.extend(place[:allowed])
+            if reschedule_now:
+                for p in place:
+                    prev = p.previous_alloc
+                    if p.reschedule and not (
+                        self.deployment_failed
+                        and prev is not None
+                        and self.deployment is not None
+                        and self.deployment.id == prev.deployment_id
+                    ):
+                        self.result.place.append(p)
+                        desired_changes.place += 1
+                        self.result.stop.append(
+                            AllocStopResult(
+                                alloc=prev, status_description=ALLOC_RESCHEDULED
+                            )
+                        )
+                        desired_changes.stop += 1
+
+        if deployment_place_ready:
+            dmin = min(len(destructive), limit)
+            desired_changes.destructive_update += dmin
+            desired_changes.ignore += len(destructive) - dmin
+            for alloc in name_order(destructive)[:dmin]:
+                self.result.destructive_update.append(
+                    AllocDestructiveResult(
+                        place_name=alloc.name,
+                        place_task_group=tg,
+                        stop_alloc=alloc,
+                        stop_status_description=ALLOC_UPDATING,
+                    )
+                )
+        else:
+            desired_changes.ignore += len(destructive)
+
+        desired_changes.migrate += len(migrate)
+        for alloc in name_order(migrate):
+            self.result.stop.append(
+                AllocStopResult(alloc=alloc, status_description=ALLOC_MIGRATING)
+            )
+            self.result.place.append(
+                AllocPlaceResult(
+                    name=alloc.name,
+                    canary=False,
+                    task_group=tg,
+                    previous_alloc=alloc,
+                )
+            )
+
+        updating_spec = len(destructive) != 0 or len(self.result.inplace_update) != 0
+        had_running = any(
+            alloc.job is not None
+            and alloc.job.version == self.job.version
+            and alloc.job.create_index == self.job.create_index
+            for alloc in all_set.values()
+        )
+
+        if (
+            not existing_deployment
+            and not _update_is_empty(strategy)
+            and dstate.desired_total != 0
+            and (not had_running or updating_spec)
+        ):
+            if self.deployment is None:
+                self.deployment = Deployment.new_for_job(self.job)
+                self.result.deployment = self.deployment
+            self.deployment.task_groups[group] = dstate
+
+        deployment_complete = (
+            len(destructive)
+            + len(inplace)
+            + len(place)
+            + len(migrate)
+            + len(reschedule_now)
+            + len(reschedule_later)
+            == 0
+            and not require_canary
+        )
+
+        if deployment_complete and self.deployment is not None:
+            group_state = self.deployment.task_groups.get(group)
+            if group_state is not None:
+                if group_state.healthy_allocs < max(
+                    group_state.desired_total, group_state.desired_canaries
+                ) or (group_state.desired_canaries > 0 and not group_state.promoted):
+                    deployment_complete = False
+
+        return deployment_complete
+
+    def _filter_old_terminal_allocs(
+        self, all_set: AllocSet
+    ) -> tuple[AllocSet, AllocSet]:
+        """ref reconcile.go:543-561"""
+        if not self.batch:
+            return all_set, {}
+        filtered = dict(all_set)
+        ignored: AllocSet = {}
+        for alloc_id, alloc in list(filtered.items()):
+            older = (
+                alloc.job.version < self.job.version
+                or alloc.job.create_index < self.job.create_index
+            )
+            if older and alloc.terminal_status():
+                del filtered[alloc_id]
+                ignored[alloc_id] = alloc
+        return filtered, ignored
+
+    def _handle_group_canaries(
+        self, all_set: AllocSet, desired_changes: DesiredUpdates
+    ) -> tuple[AllocSet, AllocSet]:
+        """ref reconcile.go:566-613"""
+        stop: list[str] = []
+        if self.old_deployment is not None:
+            for s in self.old_deployment.task_groups.values():
+                if not s.promoted:
+                    stop.extend(s.placed_canaries)
+        if (
+            self.deployment is not None
+            and self.deployment.status == DEPLOYMENT_STATUS_FAILED
+        ):
+            for s in self.deployment.task_groups.values():
+                if not s.promoted:
+                    stop.extend(s.placed_canaries)
+
+        stop_set = from_keys(all_set, stop)
+        self._mark_stop(stop_set, "", ALLOC_NOT_NEEDED)
+        desired_changes.stop += len(stop_set)
+        all_set = difference(all_set, stop_set)
+
+        canaries: AllocSet = {}
+        if self.deployment is not None:
+            canary_ids: list[str] = []
+            for s in self.deployment.task_groups.values():
+                canary_ids.extend(s.placed_canaries)
+            canaries = from_keys(all_set, canary_ids)
+            untainted, migrate, lost = filter_by_tainted(canaries, self.tainted_nodes)
+            self._mark_stop(migrate, "", ALLOC_MIGRATING)
+            self._mark_stop(lost, ALLOC_CLIENT_STATUS_LOST, ALLOC_LOST)
+            canaries = untainted
+            all_set = difference(all_set, migrate, lost)
+
+        return canaries, all_set
+
+    def _compute_limit(
+        self,
+        group: TaskGroup,
+        untainted: AllocSet,
+        destructive: AllocSet,
+        migrate: AllocSet,
+        canary_state: bool,
+    ) -> int:
+        """ref reconcile.go:618-658"""
+        if _update_is_empty(group.update) or len(destructive) + len(migrate) == 0:
+            return group.count
+        if self.deployment_paused or self.deployment_failed:
+            return 0
+        if canary_state:
+            return 0
+
+        limit = group.update.max_parallel
+        if self.deployment is not None:
+            part_of, _ = filter_by_deployment(untainted, self.deployment.id)
+            for alloc in part_of.values():
+                if (
+                    alloc.deployment_status is not None
+                    and alloc.deployment_status.is_unhealthy()
+                ):
+                    return 0
+                if (
+                    alloc.deployment_status is None
+                    or not alloc.deployment_status.is_healthy()
+                ):
+                    limit -= 1
+        return max(limit, 0)
+
+    def _compute_placements(
+        self,
+        group: TaskGroup,
+        name_index: AllocNameIndex,
+        untainted: AllocSet,
+        migrate: AllocSet,
+        reschedule: AllocSet,
+    ) -> list[AllocPlaceResult]:
+        """ref reconcile.go:662-694"""
+        place: list[AllocPlaceResult] = []
+        for alloc in reschedule.values():
+            place.append(
+                AllocPlaceResult(
+                    name=alloc.name,
+                    task_group=group,
+                    previous_alloc=alloc,
+                    reschedule=True,
+                    canary=(
+                        alloc.deployment_status is not None
+                        and alloc.deployment_status.canary
+                    ),
+                )
+            )
+        existing = len(untainted) + len(migrate) + len(reschedule)
+        if existing < group.count:
+            for name in name_index.next(group.count - existing):
+                place.append(AllocPlaceResult(name=name, task_group=group))
+        return place
+
+    def _compute_stop(
+        self,
+        group: TaskGroup,
+        name_index: AllocNameIndex,
+        untainted: AllocSet,
+        migrate: AllocSet,
+        lost: AllocSet,
+        canaries: AllocSet,
+        canary_state: bool,
+    ) -> AllocSet:
+        """ref reconcile.go:699-802"""
+        stop: AllocSet = dict(lost)
+        self._mark_stop(lost, ALLOC_CLIENT_STATUS_LOST, ALLOC_LOST)
+
+        if canary_state:
+            untainted = difference(untainted, canaries)
+
+        remove = len(untainted) + len(migrate) - group.count
+        if remove <= 0:
+            return stop
+
+        untainted = filter_by_terminal(untainted)
+
+        if not canary_state and canaries:
+            canary_names = name_set(canaries)
+            for alloc_id, alloc in list(difference(untainted, canaries).items()):
+                if alloc.name in canary_names:
+                    stop[alloc_id] = alloc
+                    self.result.stop.append(
+                        AllocStopResult(
+                            alloc=alloc, status_description=ALLOC_NOT_NEEDED
+                        )
+                    )
+                    del untainted[alloc_id]
+                    remove -= 1
+                    if remove == 0:
+                        return stop
+
+        if migrate:
+            m_names = AllocNameIndex(self.job_id, group.name, group.count, migrate)
+            remove_names = m_names.highest(remove)
+            for alloc_id, alloc in list(migrate.items()):
+                if alloc.name not in remove_names:
+                    continue
+                self.result.stop.append(
+                    AllocStopResult(alloc=alloc, status_description=ALLOC_NOT_NEEDED)
+                )
+                del migrate[alloc_id]
+                stop[alloc_id] = alloc
+                name_index.unset_index(alloc_name_index(alloc.name))
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        remove_names = name_index.highest(remove)
+        for alloc_id, alloc in list(untainted.items()):
+            if alloc.name in remove_names:
+                stop[alloc_id] = alloc
+                self.result.stop.append(
+                    AllocStopResult(alloc=alloc, status_description=ALLOC_NOT_NEEDED)
+                )
+                del untainted[alloc_id]
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        for alloc_id, alloc in list(untainted.items()):
+            stop[alloc_id] = alloc
+            self.result.stop.append(
+                AllocStopResult(alloc=alloc, status_description=ALLOC_NOT_NEEDED)
+            )
+            del untainted[alloc_id]
+            remove -= 1
+            if remove == 0:
+                return stop
+
+        return stop
+
+    def _compute_updates(
+        self, group: TaskGroup, untainted: AllocSet
+    ) -> tuple[AllocSet, AllocSet, AllocSet]:
+        """ref reconcile.go:810-829"""
+        ignore: AllocSet = {}
+        inplace: AllocSet = {}
+        destructive: AllocSet = {}
+        for alloc in untainted.values():
+            ignore_change, destructive_change, inplace_alloc = self.alloc_update_fn(
+                alloc, self.job, group
+            )
+            if ignore_change:
+                ignore[alloc.id] = alloc
+            elif destructive_change:
+                destructive[alloc.id] = alloc
+            else:
+                inplace[alloc.id] = alloc
+                self.result.inplace_update.append(inplace_alloc)
+        return ignore, inplace, destructive
+
+    def _handle_delayed_reschedules(
+        self,
+        reschedule_later: list[DelayedRescheduleInfo],
+        all_set: AllocSet,
+        tg_name: str,
+    ):
+        """ref reconcile.go:833-900"""
+        if not reschedule_later:
+            return
+
+        reschedule_later.sort(key=lambda info: info.reschedule_time)
+
+        evals: list[Evaluation] = []
+        next_resched_time = reschedule_later[0].reschedule_time
+        alloc_to_eval: dict[str, str] = {}
+
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=self.job.namespace,
+            priority=self.job.priority,
+            type=self.job.type,
+            triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+            job_id=self.job.id,
+            job_modify_index=self.job.modify_index,
+            status=EVAL_STATUS_PENDING,
+            status_description=RESCHEDULING_FOLLOWUP_EVAL_DESC,
+            wait_until=next_resched_time,
+        )
+        evals.append(ev)
+
+        for info in reschedule_later:
+            if info.reschedule_time - next_resched_time < BATCHED_FAILED_ALLOC_WINDOW_NS:
+                alloc_to_eval[info.alloc_id] = ev.id
+            else:
+                next_resched_time = info.reschedule_time
+                ev = Evaluation(
+                    id=generate_uuid(),
+                    namespace=self.job.namespace,
+                    priority=self.job.priority,
+                    type=self.job.type,
+                    triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=self.job.id,
+                    job_modify_index=self.job.modify_index,
+                    status=EVAL_STATUS_PENDING,
+                    wait_until=next_resched_time,
+                )
+                evals.append(ev)
+                alloc_to_eval[info.alloc_id] = ev.id
+
+        self.result.desired_followup_evals[tg_name] = evals
+
+        for alloc_id, eval_id in alloc_to_eval.items():
+            existing_alloc = all_set[alloc_id]
+            updated_alloc = existing_alloc.copy()
+            updated_alloc.follow_up_eval_id = eval_id
+            self.result.attribute_updates[updated_alloc.id] = updated_alloc

@@ -1,0 +1,68 @@
+"""Scheduler factory + Planner protocol (ref scheduler/scheduler.go).
+
+The factory map is where backends register. Alongside the reference's
+service/batch/system schedulers, this framework registers ``tpu-batch`` —
+the batched JAX backend that drains many evals at once and scores
+allocations × nodes as dense tensors (nomad_tpu/tpu/).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional, Protocol
+
+from ..structs.model import Evaluation, Plan, PlanResult
+from .generic import GenericScheduler
+from .system import SystemScheduler
+
+
+class Planner(Protocol):
+    """ref scheduler.go:97-130"""
+
+    def submit_plan(self, plan: Plan) -> tuple[PlanResult, Optional[object]]:
+        """Submit a plan; returns (result, refreshed-state-or-None)."""
+        ...
+
+    def update_eval(self, eval: Evaluation) -> None: ...
+
+    def create_eval(self, eval: Evaluation) -> None: ...
+
+    def reblock_eval(self, eval: Evaluation) -> None: ...
+
+
+def _service_factory(state, planner, rng=None):
+    return GenericScheduler(state, planner, batch=False, rng=rng)
+
+
+def _batch_factory(state, planner, rng=None):
+    return GenericScheduler(state, planner, batch=True, rng=rng)
+
+
+def _system_factory(state, planner, rng=None):
+    return SystemScheduler(state, planner, rng=rng)
+
+
+def _tpu_batch_factory(state, planner, rng=None):
+    try:
+        from ..tpu.batch_sched import TPUBatchScheduler
+    except ImportError as e:
+        raise ValueError(f"scheduler 'tpu-batch' backend unavailable: {e}") from e
+
+    return TPUBatchScheduler(state, planner, rng=rng)
+
+
+# ref scheduler.go:23-29 BuiltinSchedulers + the new TPU backend
+BUILTIN_SCHEDULERS: dict[str, Callable] = {
+    "service": _service_factory,
+    "batch": _batch_factory,
+    "system": _system_factory,
+    "tpu-batch": _tpu_batch_factory,
+}
+
+
+def new_scheduler(name: str, state, planner, rng: Optional[random.Random] = None):
+    """ref scheduler.go:34-44"""
+    factory = BUILTIN_SCHEDULERS.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factory(state, planner, rng=rng)

@@ -1,0 +1,447 @@
+"""Scheduler oracle tests (semantics ref: scheduler/*_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs.model import (
+    Affinity,
+    Constraint,
+    Evaluation,
+    Spread,
+    SpreadTarget,
+    UpdateStrategy,
+    generate_uuid,
+)
+
+
+def make_eval(job, triggered_by="job-register", **kw):
+    return Evaluation(
+        id=generate_uuid(),
+        namespace=job.namespace,
+        priority=job.priority,
+        type=job.type,
+        triggered_by=triggered_by,
+        job_id=job.id,
+        status="pending",
+        **kw,
+    )
+
+
+def setup_harness(num_nodes=10, seed=42, node_fn=mock.node):
+    h = Harness(seed=seed)
+    nodes = []
+    for _ in range(num_nodes):
+        n = node_fn()
+        nodes.append(n)
+        h.state.upsert_node(h.next_index(), n)
+    return h, nodes
+
+
+def run_eval(h, job, sched_type=None, triggered_by="job-register"):
+    ev = make_eval(job, triggered_by=triggered_by)
+    h.state.upsert_evals(h.next_index(), [ev])
+    sched = h.process(sched_type or job.type, ev)
+    return sched, ev
+
+
+class TestServiceSched:
+    def test_job_register(self):
+        # ref generic_sched_test.go TestServiceSched_JobRegister
+        h, _ = setup_harness(10)
+        job = mock.job()
+        h.state.upsert_job(h.next_index(), job)
+        sched, ev = run_eval(h, job)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert sum(len(v) for v in plan.node_allocation.values()) == 10
+        assert not sched.failed_tg_allocs
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+        # all different names
+        assert len({a.name for a in out}) == 10
+        assert h.evals[-1].status == "complete"
+
+    def test_job_register_distinct_hosts(self):
+        h, _ = setup_harness(10)
+        job = mock.job()
+        job.constraints.append(Constraint(operand="distinct_hosts"))
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+        # each alloc on a unique node
+        assert len({a.node_id for a in out}) == 10
+
+    def test_job_register_distinct_property(self):
+        h, nodes = setup_harness(6)
+        # 3 racks, 2 nodes each
+        for i, n in enumerate(nodes):
+            n2 = n.copy()
+            n2.meta["rack"] = f"rack{i % 3}"
+            h.state.upsert_node(h.next_index(), n2)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.constraints.append(
+            Constraint(
+                operand="distinct_property", l_target="${meta.rack}", r_target="1"
+            )
+        )
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 3
+        racks = {h.state.node_by_id(a.node_id).meta["rack"] for a in out}
+        assert len(racks) == 3
+
+    def test_no_feasible_nodes_creates_blocked_eval(self):
+        h, nodes = setup_harness(3)
+        job = mock.job()
+        job.constraints = [
+            Constraint(l_target="${attr.kernel.name}", r_target="darwin", operand="=")
+        ]
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        assert "web" in sched.failed_tg_allocs
+        assert sched.failed_tg_allocs["web"].nodes_filtered == 3
+        # blocked eval created
+        assert len(h.create_evals) == 1
+        assert h.create_evals[0].status == "blocked"
+        assert h.create_evals[0].triggered_by == "queued-allocs"
+        # class eligibility recorded
+        assert h.create_evals[0].class_eligibility
+
+    def test_resource_exhaustion(self):
+        h, _ = setup_harness(1)
+        job = mock.job()
+        job.task_groups[0].count = 20  # 20 * 500 cpu > 3900 available
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        placed = len(h.state.allocs_by_job(job.namespace, job.id))
+        assert placed < 20
+        assert sched.failed_tg_allocs["web"].coalesced_failures == 20 - placed - 1
+        assert "cpu" in sched.failed_tg_allocs["web"].dimension_exhausted
+
+    def test_scale_down(self):
+        h, _ = setup_harness(10)
+        job = mock.job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 10
+
+        job2 = h.state.job_by_id(job.namespace, job.id).copy()
+        job2.task_groups[0].count = 3
+        h.state.upsert_job(h.next_index(), job2)
+        sched, _ = run_eval(h, job2)
+        live = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == "run"
+        ]
+        assert len(live) == 3
+        # highest-indexed names were removed
+        kept = sorted(int(a.name.split("[")[1].rstrip("]")) for a in live)
+        assert kept == [0, 1, 2]
+
+    def test_destructive_update(self):
+        h, _ = setup_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        job2 = h.state.job_by_id(job.namespace, job.id).copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        h.state.upsert_job(h.next_index(), job2)
+        sched, _ = run_eval(h, job2)
+        plan = h.plans[-1]
+        stops = sum(len(v) for v in plan.node_update.values())
+        places = sum(len(v) for v in plan.node_allocation.values())
+        assert stops == 4 and places == 4
+
+    def test_inplace_update(self):
+        h, _ = setup_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        before_ids = {a.id for a in h.state.allocs_by_job(job.namespace, job.id)}
+
+        # priority-only change → in-place
+        job2 = h.state.job_by_id(job.namespace, job.id).copy()
+        job2.priority = 60
+        h.state.upsert_job(h.next_index(), job2)
+        sched, _ = run_eval(h, job2)
+        plan = h.plans[-1]
+        assert sum(len(v) for v in plan.node_update.values()) == 0
+        after_ids = {a.id for a in h.state.allocs_by_job(job.namespace, job.id)}
+        assert before_ids == after_ids
+
+    def test_node_down_replaces_allocs(self):
+        h, nodes = setup_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        # mark one node down; its allocs become lost and get replaced
+        victim = h.state.allocs_by_job(job.namespace, job.id)[0].node_id
+        h.state.update_node_status(h.next_index(), victim, "down")
+        sched, _ = run_eval(h, job, triggered_by="node-update")
+        allocs = h.state.allocs_by_job(job.namespace, job.id)
+        lost = [a for a in allocs if a.client_status == "lost"]
+        live = [a for a in allocs if a.desired_status == "run" and a.client_status != "lost"]
+        assert len(lost) >= 1
+        assert len(live) == 4
+        assert all(a.node_id != victim for a in live)
+
+    def test_drain_migrates(self):
+        h, nodes = setup_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        victim = h.state.allocs_by_job(job.namespace, job.id)[0]
+        # mark desired transition migrate (drainer behavior)
+        updated = victim.copy()
+        updated.desired_transition.migrate = True
+        updated.job = h.state.job_by_id(job.namespace, job.id)
+        h.state.upsert_allocs(h.next_index(), [updated])
+        h.state.update_node_drain(h.next_index(), victim.node_id, True)
+
+        sched, _ = run_eval(h, job, triggered_by="node-drain")
+        allocs = h.state.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if a.desired_status == "run"]
+        assert len(live) == 4
+        assert all(a.node_id != victim.node_id for a in live)
+
+    def test_affinity_prefers_matching_nodes(self):
+        h, nodes = setup_harness(6)
+        # tag half the nodes
+        tagged = set()
+        for i, n in enumerate(nodes[:3]):
+            n2 = n.copy()
+            n2.meta["ssd"] = "true"
+            tagged.add(n2.id)
+            h.state.upsert_node(h.next_index(), n2)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.affinities = [
+            Affinity(l_target="${meta.ssd}", r_target="true", operand="=", weight=100)
+        ]
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 3
+        assert all(a.node_id in tagged for a in out)
+
+    def test_spread_across_datacenters(self):
+        h = Harness(seed=7)
+        for i in range(6):
+            n = mock.node()
+            n.datacenter = f"dc{i % 2 + 1}"
+            h.state.upsert_node(h.next_index(), n)
+        job = mock.job()
+        job.datacenters = ["dc1", "dc2"]
+        job.task_groups[0].count = 4
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=100,
+                spread_target=[
+                    SpreadTarget(value="dc1", percent=50),
+                    SpreadTarget(value="dc2", percent=50),
+                ],
+            )
+        ]
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 4
+        by_dc = {}
+        for a in out:
+            dc = h.state.node_by_id(a.node_id).datacenter
+            by_dc[dc] = by_dc.get(dc, 0) + 1
+        assert by_dc == {"dc1": 2, "dc2": 2}
+
+    def test_annotate_plan(self):
+        h, _ = setup_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        h.state.upsert_job(h.next_index(), job)
+        ev = make_eval(job)
+        ev.annotate_plan = True
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("service", ev)
+        plan = h.plans[0]
+        assert plan.annotations is not None
+        assert plan.annotations.desired_tg_updates["web"].place == 2
+
+    def test_reschedule_failed_alloc_penalizes_old_node(self):
+        h, nodes = setup_harness(3)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].reschedule_policy.delay = 0
+        job.task_groups[0].reschedule_policy.delay_function = "constant"
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        victim = h.state.allocs_by_job(job.namespace, job.id)[0]
+
+        import time
+
+        failed = victim.copy()
+        failed.client_status = "failed"
+        failed.modify_time = time.time_ns()
+        h.state.update_allocs_from_client(h.next_index(), [failed])
+
+        sched, _ = run_eval(h, job, triggered_by="alloc-failure")
+        allocs = h.state.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if a.desired_status == "run" and a.client_status == "pending"]
+        assert len(live) == 1
+        replacement = live[0]
+        assert replacement.previous_allocation == victim.id
+        assert replacement.node_id != victim.node_id
+        assert replacement.reschedule_tracker is not None
+        assert len(replacement.reschedule_tracker.events) == 1
+
+
+class TestBatchSched:
+    def test_register(self):
+        h, _ = setup_harness(5)
+        job = mock.batch_job()
+        job.task_groups[0].count = 5
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job, sched_type="batch")
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 5
+
+    def test_complete_batch_not_replaced_on_node_down(self):
+        # ref generic_sched_test.go: successful batch allocs on tainted nodes stay
+        h, nodes = setup_harness(2)
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job, sched_type="batch")
+        a = h.state.allocs_by_job(job.namespace, job.id)[0]
+
+        from nomad_tpu.structs.model import TaskState
+
+        done = a.copy()
+        done.client_status = "complete"
+        done.task_states = {"web": TaskState(state="dead", failed=False)}
+        h.state.update_allocs_from_client(h.next_index(), [done])
+        h.state.update_node_status(h.next_index(), a.node_id, "down")
+
+        sched, _ = run_eval(h, job, sched_type="batch", triggered_by="node-update")
+        allocs = h.state.allocs_by_job(job.namespace, job.id)
+        # no replacement should have been created
+        assert len(allocs) == 1
+
+
+class TestSystemSched:
+    def test_register_places_on_all_nodes(self):
+        h, _ = setup_harness(6)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 6
+        assert len({a.node_id for a in out}) == 6
+
+    def test_constraint_filters_nodes(self):
+        h, nodes = setup_harness(4)
+        # one node not linux
+        odd = nodes[0].copy()
+        odd.attributes["kernel.name"] = "windows"
+        from nomad_tpu.structs import compute_class
+
+        compute_class(odd)
+        h.state.upsert_node(h.next_index(), odd)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 3
+        assert all(a.node_id != odd.id for a in out)
+
+    def test_new_node_gets_system_alloc(self):
+        h, _ = setup_harness(2)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 2
+        h.state.upsert_node(h.next_index(), mock.node())
+        run_eval(h, job, triggered_by="node-update")
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 3
+
+    def test_preemption_for_high_priority_system_job(self):
+        h = Harness(seed=3)
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+
+        # low-priority service filling the node
+        low = mock.job()
+        low.priority = 30
+        low.task_groups[0].count = 1
+        low.task_groups[0].tasks[0].resources.cpu = 3600
+        low.task_groups[0].tasks[0].resources.memory_mb = 7000
+        low.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), low)
+        run_eval(h, low)
+        assert len(h.state.allocs_by_job(low.namespace, low.id)) == 1
+
+        # high-priority system job needing most of the node
+        sysjob = mock.system_job()
+        sysjob.priority = 100
+        sysjob.task_groups[0].tasks[0].resources.cpu = 3000
+        sysjob.task_groups[0].tasks[0].resources.memory_mb = 6000
+        sysjob.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), sysjob)
+        sched, _ = run_eval(h, sysjob)
+        plan = h.plans[-1]
+        preempted = sum(len(v) for v in plan.node_preemptions.values())
+        placed = sum(len(v) for v in plan.node_allocation.values())
+        assert placed == 1
+        assert preempted == 1
+
+
+class TestDeployments:
+    def test_deployment_created_on_update(self):
+        h, _ = setup_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        job.update = UpdateStrategy(max_parallel=2, stagger=30 * 1_000_000_000)
+        job.task_groups[0].update = UpdateStrategy(
+            max_parallel=2, healthy_deadline=300 * 1_000_000_000
+        )
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        # initial registration creates a deployment (no running allocs before)
+        deployments = list(h.state.deployments())
+        assert len(deployments) == 1
+        d = deployments[0]
+        assert d.task_groups["web"].desired_total == 4
+
+    def test_rolling_update_limited_by_max_parallel(self):
+        h, _ = setup_harness(6)
+        job = mock.job()
+        job.task_groups[0].count = 6
+        job.task_groups[0].update = UpdateStrategy(max_parallel=2)
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 6
+
+        job2 = h.state.job_by_id(job.namespace, job.id).copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        h.state.upsert_job(h.next_index(), job2)
+        sched, _ = run_eval(h, job2)
+        plan = h.plans[-1]
+        stops = sum(
+            1
+            for v in plan.node_update.values()
+            for a in v
+            if a.desired_description == "alloc is being updated due to job update"
+        )
+        assert stops == 2  # limited by max_parallel
