@@ -54,7 +54,10 @@ class DeviceAllocator(DeviceAccounter):
                         continue
                     choice_score += float(a.weight)
                     sum_matched += float(a.weight)
-                choice_score /= total_weight
+                # Go float semantics: /0 yields NaN and scheduling continues
+                choice_score = (
+                    choice_score / total_weight if total_weight else float("nan")
+                )
 
             if offer is not None and choice_score < offer_score:
                 continue

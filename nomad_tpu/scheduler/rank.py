@@ -425,7 +425,8 @@ class NodeAffinityIterator:
         for affinity in self.affinities:
             if matches_affinity(self.ctx, affinity, option.node):
                 total += float(affinity.weight)
-        norm_score = total / sum_weight
+        # Go float semantics: /0 yields NaN and scheduling continues
+        norm_score = total / sum_weight if sum_weight else float("nan")
         if total != 0.0:
             option.scores.append(norm_score)
             self.ctx.metrics.score_node(option.node, "node-affinity", norm_score)

@@ -99,8 +99,11 @@ class SpreadIterator:
                         if desired_count is None:
                             total_spread_score -= 1.0
                             continue
+                    # Go float semantics: /0 yields NaN, scheduling continues
                     spread_weight = (
                         float(spread_details.weight) / self.sum_spread_weights
+                        if self.sum_spread_weights
+                        else float("nan")
                     )
                     boost = (
                         (desired_count - float(used_count)) / desired_count

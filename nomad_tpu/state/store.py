@@ -313,24 +313,27 @@ class StateStore(StateReader):
     # ------------------------------------------------------------------
     @_write_txn
     def upsert_node(self, index: int, node: Node):
+        self.upsert_nodes(index, [node])
+
+    @_write_txn
+    def upsert_nodes(self, index: int, nodes: list[Node]):
+        """Bulk node insert: one generation swap for the whole batch (used by
+        simulation/benchmark cluster bootstrap; avoids O(N²) COW copies)."""
         gen = self._gen
-        nodes = dict(gen.nodes)
-        existing = nodes.get(node.id)
-        node = node.copy()
-        if existing is not None:
-            node.create_index = existing.create_index
+        table = dict(gen.nodes)
+        for node in nodes:
+            node = node.copy()
+            existing = table.get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+                node.drain = existing.drain
+                node.scheduling_eligibility = existing.scheduling_eligibility
+            else:
+                node.create_index = index
             node.modify_index = index
-            # Retain server-managed drain/eligibility metadata
-            node.drain = existing.drain
-            node.scheduling_eligibility = existing.scheduling_eligibility
-        else:
-            node.create_index = index
-            node.modify_index = index
-        nodes[node.id] = node
+            table[node.id] = node
         self._publish(
-            index=index,
-            nodes=nodes,
-            table_indexes=self._bump(gen, index, "nodes"),
+            index=index, nodes=table, table_indexes=self._bump(gen, index, "nodes")
         )
 
     @_write_txn

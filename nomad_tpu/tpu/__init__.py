@@ -1,0 +1,14 @@
+"""TPU-native batched scheduling backend.
+
+The reference scores one allocation against one node at a time inside a Go
+iterator chain (scheduler/rank.go:176). Here the same semantics are expressed
+as dense array programs: a columnar mirror of cluster state (columnar.py)
+feeds a jitted lax.scan kernel (kernel.py) that plans every pending
+allocation against every feasible node in one XLA program, and the
+``tpu-batch`` scheduler (batch_sched.py) wires it into the factory map with
+the scalar oracle as fallback for paths the kernel does not cover.
+"""
+
+from .batch_sched import TPUBatchScheduler
+from .columnar import ColumnarCluster
+from .kernel import plan_batch

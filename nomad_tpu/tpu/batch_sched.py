@@ -1,0 +1,340 @@
+"""The ``tpu-batch`` scheduler: a drop-in GenericScheduler whose placement
+loop runs as one batched XLA program.
+
+Registered in the factory map alongside service/batch/system
+(scheduler/scheduler.py). The reconciler, plan bookkeeping, blocked evals and
+retries are shared with the oracle; only computePlacements
+(generic_sched.go:426-566) is replaced — the per-alloc Select walk becomes a
+single lax.scan over all pending placements. Anything the kernel does not
+model (ports, devices, distinct_* constraints, reschedules with penalty
+nodes, sticky disk, destructive updates) transparently falls back to the
+scalar oracle path, so behavior is complete while the hot path is dense.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..scheduler.feasible import shuffle_nodes
+from ..scheduler.generic import GenericScheduler
+from ..structs.model import (
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_DESIRED_STATUS_RUN,
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    AllocMetric,
+    generate_uuid,
+)
+from .columnar import (
+    ColumnarCluster,
+    build_group_planes,
+    compute_limit,
+    kernel_supported,
+)
+
+
+def _pad_to(x: np.ndarray, size: int, fill=0):
+    if x.shape[0] == size:
+        return x
+    pad_shape = (size - x.shape[0],) + x.shape[1:]
+    return np.concatenate([x, np.full(pad_shape, fill, dtype=x.dtype)])
+
+
+def _bucket(n: int) -> int:
+    """Round up to limit distinct compiled shapes: powers of two up to 1024,
+    then multiples of 1024 (keeps padding waste <~10% at cluster scale)."""
+    size = 8
+    while size < n and size < 1024:
+        size *= 2
+    if n <= size:
+        return size
+    return ((n + 1023) // 1024) * 1024
+
+
+#: timing of the most recent kernel invocation, for the benchmark harness
+LAST_KERNEL_STATS: dict = {}
+
+
+class TPUBatchScheduler(GenericScheduler):
+    """GenericScheduler with the batched placement kernel."""
+
+    def __init__(self, state, planner, rng=None, batch: bool = False):
+        super().__init__(state, planner, batch=batch, rng=rng)
+
+    # ------------------------------------------------------------------
+    def _compute_placements(self, destructive: list, place: list):
+        if destructive or not place:
+            return super()._compute_placements(destructive, place)
+
+        # The kernel covers fresh placements only
+        if any(p.previous_alloc is not None or p.canary for p in place):
+            return super()._compute_placements(destructive, place)
+        groups = {p.task_group.name: p.task_group for p in place}
+        if not all(kernel_supported(self.job, tg) for tg in groups.values()):
+            return super()._compute_placements(destructive, place)
+
+        nodes, by_dc = self.state.ready_nodes_in_dcs(self.job.datacenters)
+        if not nodes:
+            return super()._compute_placements(destructive, place)
+
+        self._kernel_placements(place, nodes, by_dc)
+
+    # ------------------------------------------------------------------
+    def _kernel_placements(self, place: list, nodes: list, by_dc: dict):
+        import time
+
+        import jax.numpy as jnp
+
+        from .kernel import BatchArgs, BatchState, plan_batch
+
+        t_start = time.monotonic()
+        ctx = self.ctx
+        n_real = len(nodes)
+
+        # Same seeded shuffle the oracle's stack.set_nodes performs
+        shuffled = list(nodes)
+        shuffle_nodes(ctx, shuffled)
+
+        cluster = ColumnarCluster(nodes)
+        perm_real = np.array([cluster.index[n.id] for n in shuffled], dtype=np.int32)
+
+        # group planes
+        group_names = []
+        planes_list = []
+        for name, tg in {p.task_group.name: p.task_group for p in place}.items():
+            group_names.append(name)
+            planes_list.append(
+                build_group_planes(ctx, cluster, self.state, self.job, tg)
+            )
+        g_index = {n: i for i, n in enumerate(group_names)}
+        G = len(group_names)
+
+        # demands per group
+        tg_by_name = {p.task_group.name: p.task_group for p in place}
+        demand_by_group = {}
+        for name, tg in tg_by_name.items():
+            cpu = sum(t.resources.cpu for t in tg.tasks)
+            mem = sum(t.resources.memory_mb for t in tg.tasks)
+            disk = tg.ephemeral_disk.size_mb
+            demand_by_group[name] = (cpu, mem, disk)
+
+        # pad node axis
+        N = _bucket(n_real)
+        capacity = _pad_to(cluster.capacity, N).astype(np.int32)
+        usable = _pad_to(cluster.usable, N, fill=1.0).astype(np.float32)
+        used0 = _pad_to(cluster.initial_used(self.state, self.plan), N, fill=2**30).astype(np.int32)
+        perm = np.concatenate(
+            [perm_real, np.arange(n_real, N, dtype=np.int32)]
+        )
+
+        V = max(
+            max((len(p.values) for p in planes_list), default=1), 1
+        )
+        feasible = np.zeros((G, N), dtype=bool)
+        affinity = np.zeros((G, N), dtype=np.float32)
+        affinity_present = np.zeros((G, N), dtype=bool)
+        group_count = np.zeros(G, dtype=np.int32)
+        node_value = np.full((G, N), -1, dtype=np.int32)
+        spread_desired = np.full((G, V), -1.0, dtype=np.float32)
+        spread_implicit = np.full(G, -1.0, dtype=np.float32)
+        spread_weight_frac = np.zeros(G, dtype=np.float32)
+        spread_even = np.zeros(G, dtype=bool)
+        spread_active = np.zeros(G, dtype=bool)
+        counts0 = np.zeros((G, V), dtype=np.int32)
+        present0 = np.zeros((G, V), dtype=bool)
+        collisions0 = np.zeros((G, N), dtype=np.int32)
+
+        has_aff_or_spread = False
+        for gi, planes in enumerate(planes_list):
+            feasible[gi, :n_real] = planes.feasible
+            affinity[gi, :n_real] = planes.affinity
+            affinity_present[gi, :n_real] = planes.affinity_present
+            group_count[gi] = planes.count
+            collisions0[gi, :n_real] = cluster.collision_counts(
+                self.state, self.job.id, planes.name
+            )
+            if planes.node_value is not None:
+                node_value[gi, :n_real] = planes.node_value
+                nv = len(planes.counts0)
+                counts0[gi, :nv] = planes.counts0
+                present0[gi, :nv] = planes.present0
+                spread_desired[gi, : len(planes.desired)] = planes.desired
+                spread_implicit[gi] = planes.implicit
+                spread_weight_frac[gi] = planes.weight_frac
+                spread_even[gi] = planes.even
+                spread_active[gi] = True
+            if planes.affinity_present.any() or planes.node_value is not None:
+                has_aff_or_spread = True
+
+        # per-alloc arrays
+        a_real = len(place)
+        A = _bucket(a_real)
+        demands = np.zeros((A, 3), dtype=np.int32)
+        group_ids = np.zeros(A, dtype=np.int32)
+        limits = np.zeros(A, dtype=np.int32)
+        valid = np.zeros(A, dtype=bool)
+        for i, p in enumerate(place):
+            gi = g_index[p.task_group.name]
+            demands[i] = demand_by_group[p.task_group.name]
+            group_ids[i] = gi
+            planes = planes_list[gi]
+            limits[i] = min(
+                compute_limit(
+                    n_real,
+                    self.batch,
+                    bool(planes.affinity_present.any())
+                    or planes.node_value is not None,
+                ),
+                n_real,
+            )
+            valid[i] = True
+
+        # Rotation-parallel fast path: one group, bounded candidate window,
+        # no dynamic score planes → mega-step the whole batch
+        use_windowed = (
+            G == 1
+            and not has_aff_or_spread
+            and a_real > 0
+            and limits[0] < n_real
+        )
+        if use_windowed:
+            from .kernel import WindowArgs, plan_batch_windowed
+
+            t_columnar = time.monotonic()
+            wargs = WindowArgs(
+                capacity=jnp.asarray(capacity),
+                usable=jnp.asarray(usable),
+                feasible=jnp.asarray(feasible[0]),
+                perm=jnp.asarray(perm),
+                demand=jnp.asarray(demands[0]),
+                group_count=jnp.asarray(np.int32(group_count[0])),
+                limit=jnp.asarray(np.int32(limits[0])),
+                n_allocs=jnp.asarray(np.int32(a_real)),
+            )
+            placements = plan_batch_windowed(
+                wargs,
+                jnp.asarray(used0),
+                jnp.asarray(collisions0[0]),
+                n_real,
+                A,
+            )
+            placements = np.asarray(placements)
+            t_kernel = time.monotonic()
+            LAST_KERNEL_STATS.update(
+                columnar_s=t_columnar - t_start,
+                kernel_s=t_kernel - t_columnar,
+                n_nodes=n_real,
+                n_allocs=a_real,
+                n_padded_nodes=N,
+                n_padded_allocs=A,
+                mode="windowed",
+            )
+            self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+            return
+
+        args = BatchArgs(
+            capacity=jnp.asarray(capacity),
+            usable=jnp.asarray(usable),
+            feasible=jnp.asarray(feasible),
+            affinity=jnp.asarray(affinity),
+            affinity_present=jnp.asarray(affinity_present),
+            group_count=jnp.asarray(group_count),
+            node_value=jnp.asarray(node_value),
+            spread_desired=jnp.asarray(spread_desired),
+            spread_implicit=jnp.asarray(spread_implicit),
+            spread_weight_frac=jnp.asarray(spread_weight_frac),
+            spread_even=jnp.asarray(spread_even),
+            spread_active=jnp.asarray(spread_active),
+            perm=jnp.asarray(perm),
+            demands=jnp.asarray(demands),
+            groups=jnp.asarray(group_ids),
+            limits=jnp.asarray(limits),
+            valid=jnp.asarray(valid),
+        )
+        init = BatchState(
+            used=jnp.asarray(used0),
+            collisions=jnp.asarray(collisions0),
+            spread_counts=jnp.asarray(counts0),
+            spread_present=jnp.asarray(present0),
+            offset=jnp.asarray(0, dtype=np.int32),
+        )
+
+        t_columnar = time.monotonic()
+        _, placements = plan_batch(args, init, n_real)
+        placements = np.asarray(placements)  # blocks on device completion
+        t_kernel = time.monotonic()
+        LAST_KERNEL_STATS.update(
+            columnar_s=t_columnar - t_start,
+            kernel_s=t_kernel - t_columnar,
+            n_nodes=n_real,
+            n_allocs=len(place),
+            n_padded_nodes=N,
+            n_padded_allocs=A,
+            mode="exact-scan",
+        )
+        self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+
+    # ------------------------------------------------------------------
+    def _materialize(self, place, placements, nodes, by_dc, planes_list, g_index):
+        n_real = len(nodes)
+        deployment_id = ""
+        if self.deployment is not None and self.deployment.active():
+            deployment_id = self.deployment.id
+
+        for i, p in enumerate(place):
+            tg = p.task_group
+            node_idx = int(placements[i])
+            if node_idx < 0 or node_idx >= n_real:
+                if tg.name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    continue
+                metrics = AllocMetric()
+                gi = g_index[tg.name]
+                metrics.nodes_evaluated = n_real
+                metrics.nodes_filtered = int((~planes_list[gi].feasible).sum())
+                metrics.nodes_available = by_dc
+                metrics.nodes_exhausted = (
+                    n_real - metrics.nodes_filtered
+                )
+                if metrics.nodes_exhausted:
+                    metrics.dimension_exhausted["cpu"] = metrics.nodes_exhausted
+                self.failed_tg_allocs[tg.name] = metrics
+                continue
+
+            node = nodes[node_idx]
+            tasks = {
+                t.name: AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=t.resources.cpu),
+                    memory=AllocatedMemoryResources(memory_mb=t.resources.memory_mb),
+                )
+                for t in tg.tasks
+            }
+            resources = AllocatedResources(
+                tasks=tasks,
+                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+            )
+            metrics = AllocMetric()
+            metrics.nodes_evaluated = n_real
+            metrics.nodes_available = by_dc
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=self.job.namespace,
+                eval_id=self.eval.id,
+                name=p.name,
+                job_id=self.job.id,
+                task_group=tg.name,
+                metrics=metrics,
+                node_id=node.id,
+                node_name=node.name,
+                deployment_id=deployment_id,
+                allocated_resources=resources,
+                desired_status=ALLOC_DESIRED_STATUS_RUN,
+                client_status=ALLOC_CLIENT_STATUS_PENDING,
+            )
+            self.plan.append_alloc(alloc)

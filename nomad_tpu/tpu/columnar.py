@@ -1,0 +1,271 @@
+"""Columnar mirror of cluster state for the batched kernel.
+
+Extracts device-friendly arrays from a state snapshot: int32 capacity/usage
+matrices, per-task-group boolean feasibility rows (evaluated once per
+computed node class — the same memoization the reference uses in
+feasible.go:787), static affinity score planes, and spread value tables.
+String-world constraint evaluation happens here, host-side, exactly once per
+(task group, node class); the device only ever sees dense numerics.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..scheduler.context import EvalContext
+from ..scheduler.feasible import (
+    ConstraintChecker,
+    DeviceChecker,
+    DriverChecker,
+    HostVolumeChecker,
+)
+from ..scheduler.rank import matches_affinity
+from ..scheduler.propertyset import get_property
+from ..scheduler.stack import task_group_constraints
+from ..structs.model import Job, Node, TaskGroup
+from ..structs.node_class import escaped_constraints
+
+# spread sentinel indices
+NO_VALUE = -1
+
+
+@dataclass
+class GroupPlanes:
+    """Per-task-group static planes."""
+
+    name: str
+    feasible: np.ndarray  # bool[N]
+    affinity: np.ndarray  # f32[N]
+    affinity_present: np.ndarray  # bool[N]
+    count: int = 1
+    # spread (at most one attribute in the fast path; more → fallback)
+    node_value: Optional[np.ndarray] = None  # i32[N] value ids, NO_VALUE if missing
+    desired: Optional[np.ndarray] = None  # f32[V]; -1 = absent
+    implicit: float = -1.0
+    weight_frac: float = 0.0
+    even: bool = False
+    values: list[str] = field(default_factory=list)
+    counts0: Optional[np.ndarray] = None  # i32[V]
+    present0: Optional[np.ndarray] = None  # bool[V]
+
+
+class ColumnarCluster:
+    """Dense arrays for a set of candidate nodes."""
+
+    def __init__(self, nodes: list[Node]):
+        self.nodes = nodes
+        self.index = {n.id: i for i, n in enumerate(nodes)}
+        n = len(nodes)
+        self.capacity = np.zeros((n, 3), dtype=np.int64)
+        self.reserved = np.zeros((n, 3), dtype=np.int64)
+        for i, node in enumerate(nodes):
+            res = node.node_resources
+            self.capacity[i] = (
+                res.cpu.cpu_shares,
+                res.memory.memory_mb,
+                res.disk.disk_mb,
+            )
+            if node.reserved_resources is not None:
+                rr = node.reserved_resources
+                self.reserved[i] = (
+                    rr.cpu.cpu_shares,
+                    rr.memory.memory_mb,
+                    rr.disk.disk_mb,
+                )
+        # Scoring denominators (ScoreFit: total - reserved; funcs.go:160-165)
+        self.usable = (self.capacity[:, :2] - self.reserved[:, :2]).astype(np.float32)
+
+    def initial_used(self, state, plan=None) -> np.ndarray:
+        """used = reserved + Σ non-terminal alloc resources per node (the
+        accumulation AllocsFit performs per check, funcs.go:104-117),
+        including any plan overlays."""
+        used = self.reserved.copy()
+        for i, node in enumerate(self.nodes):
+            allocs = state.allocs_by_node_terminal(node.id, False)
+            if plan is not None:
+                from ..structs.model import remove_allocs
+
+                update = plan.node_update.get(node.id, [])
+                if update:
+                    allocs = remove_allocs(allocs, update)
+            for a in allocs:
+                if a.allocated_resources is None:
+                    continue
+                c = a.comparable_resources()
+                used[i, 0] += c.flattened.cpu.cpu_shares
+                used[i, 1] += c.flattened.memory.memory_mb
+                used[i, 2] += c.shared.disk_mb
+        return used
+
+    def collision_counts(self, state, job_id: str, tg_name: str) -> np.ndarray:
+        """Existing same-job/same-group alloc counts per node (the
+        JobAntiAffinityIterator's collision input, rank.go:498-505)."""
+        counts = np.zeros(len(self.nodes), dtype=np.int32)
+        for i, node in enumerate(self.nodes):
+            for a in state.allocs_by_node_terminal(node.id, False):
+                if a.job_id == job_id and a.task_group == tg_name:
+                    counts[i] += 1
+        return counts
+
+
+def kernel_supported(job: Job, tg: TaskGroup) -> bool:
+    """Whether the fast kernel covers this group; anything else falls back to
+    the scalar oracle (ports, devices, distinct_*, sticky disk, multi-spread)."""
+    if tg.networks:
+        return False
+    for task in tg.tasks:
+        if task.resources.networks or task.resources.devices:
+            return False
+    if tg.ephemeral_disk.sticky:
+        return False
+    constraints = list(job.constraints) + list(tg.constraints)
+    for task in tg.tasks:
+        constraints.extend(task.constraints)
+    for c in constraints:
+        if c.operand in ("distinct_hosts", "distinct_property"):
+            return False
+    spreads = list(job.spreads) + list(tg.spreads)
+    if len(spreads) > 1:
+        return False
+    return True
+
+
+def build_group_planes(
+    ctx: EvalContext,
+    cluster: ColumnarCluster,
+    state,
+    job: Job,
+    tg: TaskGroup,
+) -> GroupPlanes:
+    """Evaluate the string-world checks into dense planes, memoizing
+    feasibility by computed node class."""
+    nodes = cluster.nodes
+    n = len(nodes)
+
+    job_checker = ConstraintChecker(ctx, job.constraints)
+    constraints, drivers = task_group_constraints(tg)
+    tg_checkers = [
+        DriverChecker(ctx, drivers),
+        ConstraintChecker(ctx, constraints),
+        HostVolumeChecker(ctx),
+        DeviceChecker(ctx),
+    ]
+    tg_checkers[2].set_volumes(tg.volumes)
+    tg_checkers[3].set_task_group(tg)
+
+    # class-level memoization; escaped constraints force per-node checks
+    escaped = bool(
+        escaped_constraints(list(job.constraints) + constraints)
+    )
+    cache: dict[str, bool] = {}
+    elig = ctx.get_eligibility()
+    feasible = np.zeros(n, dtype=bool)
+    for i, node in enumerate(nodes):
+        key = node.computed_class
+        if not escaped and key in cache:
+            feasible[i] = cache[key]
+            continue
+        ok = job_checker.feasible(node) and all(
+            c.feasible(node) for c in tg_checkers
+        )
+        feasible[i] = ok
+        if not escaped:
+            cache[key] = ok
+            elig.set_job_eligibility(job_checker.feasible(node), key)
+            elig.set_task_group_eligibility(ok, tg.name, key)
+
+    # static affinity plane (rank.go:619-646)
+    affinities = list(job.affinities) + list(tg.affinities)
+    for task in tg.tasks:
+        affinities.extend(task.affinities)
+    affinity = np.zeros(n, dtype=np.float32)
+    affinity_present = np.zeros(n, dtype=bool)
+    if affinities:
+        sum_weight = sum(abs(float(a.weight)) for a in affinities)
+        for i, node in enumerate(nodes):
+            total = 0.0
+            for a in affinities:
+                if matches_affinity(ctx, a, node):
+                    total += float(a.weight)
+            if total != 0.0:
+                affinity[i] = total / sum_weight
+                affinity_present[i] = True
+
+    planes = GroupPlanes(
+        name=tg.name,
+        feasible=feasible,
+        affinity=affinity,
+        affinity_present=affinity_present,
+        count=tg.count,
+    )
+
+    # spread planes (spread.go:110-257); single attribute in the fast path
+    spreads = list(tg.spreads) + list(job.spreads)
+    if spreads:
+        spread = spreads[0]
+        sum_weights = sum(s.weight for s in spreads)
+        planes.weight_frac = float(spread.weight) / float(sum_weights)
+        values: dict[str, int] = {}
+        node_value = np.full(n, NO_VALUE, dtype=np.int32)
+        for i, node in enumerate(nodes):
+            val, ok = get_property(node, spread.attribute)
+            if not ok:
+                continue
+            if val not in values:
+                values[val] = len(values)
+            node_value[i] = values[val]
+
+        total_count = tg.count
+        if spread.spread_target:
+            desired_map = {}
+            sum_desired = 0.0
+            for st in spread.spread_target:
+                desired_count = (float(st.percent) / 100.0) * float(total_count)
+                desired_map[st.value] = desired_count
+                sum_desired += desired_count
+                if st.value not in values:
+                    values[st.value] = len(values)
+            if 0 < sum_desired < float(total_count):
+                planes.implicit = float(total_count) - sum_desired
+            desired = np.full(len(values), -1.0, dtype=np.float32)
+            for val, dc in desired_map.items():
+                desired[values[val]] = dc
+            planes.desired = desired
+        else:
+            planes.even = True
+            planes.desired = np.full(max(len(values), 1), -1.0, dtype=np.float32)
+
+        # existing counts per value for this TG's job (propertyset semantics)
+        counts0 = np.zeros(max(len(values), 1), dtype=np.int32)
+        present0 = np.zeros(max(len(values), 1), dtype=bool)
+        for a in state.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status() or a.task_group != tg.name:
+                continue
+            node = state.node_by_id(a.node_id)
+            val, ok = get_property(node, spread.attribute)
+            if ok and val in values:
+                counts0[values[val]] += 1
+                present0[values[val]] = True
+
+        # re-size node_value table if targets introduced new values
+        planes.node_value = node_value
+        planes.values = list(values)
+        planes.counts0 = counts0
+        planes.present0 = present0
+    return planes
+
+
+def compute_limit(num_nodes: int, batch: bool, has_affinity_or_spread: bool) -> int:
+    """Candidate-scan bound (ref stack.go:74-87, :148-150)."""
+    if has_affinity_or_spread:
+        return 2**31 - 1
+    limit = 2
+    if not batch and num_nodes > 0:
+        log_limit = int(math.ceil(math.log2(num_nodes)))
+        if log_limit > limit:
+            limit = log_limit
+    return limit

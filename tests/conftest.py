@@ -8,7 +8,6 @@ same code paths drive real TPU meshes in production.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +15,9 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This image pins JAX_PLATFORMS=axon (real TPU); the env var is overridden by
+# the platform plugin, so force the CPU backend through the config API.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,193 @@
+"""Oracle-parity tests for the tpu-batch kernel.
+
+Identical seeded state driven through the scalar oracle and the batched
+kernel must produce matching (alloc name → node) placements. This mirrors the
+north-star parity requirement (BASELINE.md: ≥99% placement match).
+"""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs.model import (
+    Affinity,
+    Constraint,
+    Evaluation,
+    Spread,
+    SpreadTarget,
+)
+
+
+def build_cluster(n_nodes, cap_seed=99, dcs=("dc1",)):
+    rng = random.Random(cap_seed)
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.node_resources.cpu.cpu_shares = rng.choice([2000, 4000, 8000])
+        n.node_resources.memory.memory_mb = rng.choice([4096, 8192, 16384])
+        n.datacenter = dcs[i % len(dcs)]
+        nodes.append(n)
+    return nodes
+
+
+def make_job(count, mutate=None):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.networks = []
+    if mutate:
+        mutate(job)
+    return job
+
+
+def run(nodes, job, sched_type, seed=5):
+    h = Harness(seed=seed)
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        id="eval-1",
+        namespace=job.namespace,
+        priority=job.priority,
+        type="service",
+        triggered_by="job-register",
+        job_id=job.id,
+        status="pending",
+    )
+    h.state.upsert_evals(h.next_index(), [ev])
+    sched = h.process(sched_type, ev)
+    placements = {
+        a.name: a.node_id for a in h.state.allocs_by_job(job.namespace, job.id)
+    }
+    return placements, sched, h
+
+
+def assert_parity(nodes, job, min_match=1.0):
+    p_oracle, s_oracle, _ = run(nodes, job, "service")
+    p_batch, s_batch, _ = run(nodes, job, "tpu-batch")
+    assert set(p_oracle) == set(p_batch), (
+        f"placed sets differ: oracle={len(p_oracle)} batch={len(p_batch)}"
+    )
+    total = len(p_oracle)
+    if total == 0:
+        return 1.0
+    matches = sum(1 for k in p_oracle if p_oracle[k] == p_batch[k])
+    frac = matches / total
+    assert frac >= min_match, f"parity {frac:.3f} < {min_match} ({matches}/{total})"
+    return frac
+
+
+class TestKernelParity:
+    def test_basic_binpack(self):
+        nodes = build_cluster(20)
+        assert_parity(nodes, make_job(15))
+
+    def test_small_cluster(self):
+        nodes = build_cluster(3)
+        assert_parity(nodes, make_job(5))
+
+    def test_single_node(self):
+        nodes = build_cluster(1)
+        assert_parity(nodes, make_job(3))
+
+    def test_with_constraints(self):
+        nodes = build_cluster(20)
+        # make half the nodes fail a constraint
+        for i, n in enumerate(nodes):
+            n.attributes["rack_class"] = "a" if i % 2 == 0 else "b"
+            from nomad_tpu.structs import compute_class
+
+            compute_class(n)
+
+        def mutate(job):
+            job.constraints.append(
+                Constraint(
+                    l_target="${attr.rack_class}", r_target="a", operand="="
+                )
+            )
+
+        nodes2 = [n.copy() for n in nodes]
+        p_batch, _, h = run(nodes2, make_job(8, mutate), "tpu-batch")
+        assert len(p_batch) == 8
+        a_nodes = {h.state.node_by_id(nid).attributes["rack_class"] for nid in p_batch.values()}
+        assert a_nodes == {"a"}
+        assert_parity(nodes, make_job(8, mutate))
+
+    def test_with_affinity(self):
+        nodes = build_cluster(16)
+        for i, n in enumerate(nodes):
+            n.meta["ssd"] = "true" if i < 4 else "false"
+
+        def mutate(job):
+            job.affinities = [
+                Affinity(
+                    l_target="${meta.ssd}", r_target="true", operand="=", weight=50
+                )
+            ]
+
+        assert_parity(nodes, make_job(10, mutate))
+
+    def test_with_spread_targets(self):
+        nodes = build_cluster(12, dcs=("dc1", "dc2"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2"]
+            job.spreads = [
+                Spread(
+                    attribute="${node.datacenter}",
+                    weight=100,
+                    spread_target=[
+                        SpreadTarget(value="dc1", percent=50),
+                        SpreadTarget(value="dc2", percent=50),
+                    ],
+                )
+            ]
+
+        assert_parity(nodes, make_job(8, mutate))
+
+    def test_with_even_spread(self):
+        nodes = build_cluster(12, dcs=("dc1", "dc2", "dc3"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+
+        assert_parity(nodes, make_job(9, mutate))
+
+    def test_resource_exhaustion_matches(self):
+        nodes = build_cluster(2)
+        p_oracle, s_oracle, _ = run(nodes, make_job(40), "service")
+        job = make_job(40)
+        p_batch, s_batch, _ = run(nodes, job, "tpu-batch")
+        # same number placed, both report failures
+        assert len(p_oracle) == len(p_batch)
+        assert bool(s_oracle.failed_tg_allocs) == bool(s_batch.failed_tg_allocs)
+        assert (
+            s_oracle.failed_tg_allocs["web"].coalesced_failures
+            == s_batch.failed_tg_allocs["web"].coalesced_failures
+        )
+
+    def test_larger_parity_ratio(self):
+        # 100 nodes x 80 allocs: allow tiny divergence from float rounding
+        nodes = build_cluster(100)
+        frac = assert_parity(nodes, make_job(80), min_match=0.99)
+        assert frac >= 0.99
+
+    def test_fallback_on_networks(self):
+        # job with dynamic ports must fall back to the oracle path and still place
+        nodes = build_cluster(5)
+        job = mock.job()  # has networks
+        job.task_groups[0].count = 5
+        p_batch, sched, _ = run(nodes, job, "tpu-batch")
+        assert len(p_batch) == 5
+
+    def test_fallback_on_distinct_hosts(self):
+        nodes = build_cluster(8)
+
+        def mutate(job):
+            job.constraints.append(Constraint(operand="distinct_hosts"))
+
+        p_batch, _, _ = run(nodes, make_job(6, mutate), "tpu-batch")
+        assert len(p_batch) == 6
+        assert len(set(p_batch.values())) == 6
