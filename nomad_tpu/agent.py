@@ -1,0 +1,49 @@
+"""Dev agent: server + client(s) in one process (ref command/agent/ -dev
+mode, which embeds both halves the same way)."""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Optional
+
+from .client import Client
+from .core import Server
+
+
+class DevAgent:
+    """Single-process cluster for development, tests, and the CLI dev mode."""
+
+    def __init__(
+        self,
+        num_clients: int = 1,
+        server_config: Optional[dict] = None,
+        num_workers: int = 2,
+    ):
+        config = {"heartbeat_ttl": 3.0}
+        config.update(server_config or {})
+        self.server = Server(config)
+        self.num_workers = num_workers
+        self.clients: list[Client] = []
+        self._tmpdir = tempfile.mkdtemp(prefix="nomad_tpu_dev_")
+        for i in range(num_clients):
+            self.clients.append(
+                Client(self.server, data_dir=f"{self._tmpdir}/client{i}")
+            )
+
+    def start(self):
+        self.server.start(num_workers=self.num_workers)
+        for c in self.clients:
+            c.start()
+
+    def stop(self):
+        for c in self.clients:
+            c.stop()
+        self.server.stop()
+
+    # convenience passthroughs
+    @property
+    def state(self):
+        return self.server.state
+
+    def run_job(self, job) -> str:
+        return self.server.job_register(job)

@@ -1,0 +1,370 @@
+"""Client agent: fingerprint, register, heartbeat, watch allocations, and run
+them (ref client/client.go; alloc/task runner hook pipelines simplified to
+the execution core — the full hook chains land with the client hardening
+phase).
+
+The client talks to the server through a transport interface; in-process
+(dev agent) that is the Server object directly, matching how the reference's
+dev mode embeds both.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import platform
+import threading
+import time
+from typing import Optional
+
+from ..structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    Allocation,
+    DriverInfo,
+    Node,
+    NodeCpuResources,
+    NodeDiskResources,
+    NodeMemoryResources,
+    NodeResources,
+    TaskState,
+    generate_uuid,
+    now_ns,
+)
+from ..structs.node_class import compute_class
+from .driver import BUILTIN_DRIVERS, Driver, TaskHandle
+
+logger = logging.getLogger("nomad_tpu.client")
+
+
+class TaskRunner:
+    """Per-task lifecycle with restart policy
+    (ref client/allocrunner/taskrunner/task_runner.go:423-533)."""
+
+    def __init__(self, alloc_runner, task, driver: Driver):
+        self.alloc_runner = alloc_runner
+        self.task = task
+        self.driver = driver
+        self.state = TaskState(state="pending")
+        self.handle: Optional[TaskHandle] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._restarts_in_interval: list[float] = []
+
+    def start(self):
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def run(self):
+        restart_policy = None
+        tg = None
+        if self.alloc_runner.alloc.job is not None:
+            tg = self.alloc_runner.alloc.job.lookup_task_group(
+                self.alloc_runner.alloc.task_group
+            )
+        if tg is not None:
+            restart_policy = tg.restart_policy
+
+        while not self._stop.is_set():
+            try:
+                self.handle = self.driver.start_task(
+                    self.task, self.alloc_runner.task_dir(self.task.name)
+                )
+            except Exception as e:
+                self.state = TaskState(
+                    state="dead", failed=True, finished_at=now_ns()
+                )
+                self.state.events.append({"type": "Driver Failure", "message": str(e)})
+                self.alloc_runner.task_state_updated()
+                return
+
+            self.state = TaskState(state="running", started_at=self.handle.started_at)
+            self.alloc_runner.task_state_updated()
+
+            self.handle.wait()
+            exit_code = self.handle.exit_code or 0
+            failed = exit_code != 0
+
+            if self._stop.is_set():
+                self.state = TaskState(
+                    state="dead",
+                    failed=False,
+                    started_at=self.state.started_at,
+                    finished_at=now_ns(),
+                )
+                self.alloc_runner.task_state_updated()
+                return
+
+            if not failed:
+                self.state = TaskState(
+                    state="dead",
+                    failed=False,
+                    started_at=self.state.started_at,
+                    finished_at=self.handle.finished_at,
+                )
+                self.alloc_runner.task_state_updated()
+                return
+
+            # Restart policy (ref client/allocrunner/taskrunner/restarts/)
+            if restart_policy is not None and self._should_restart(restart_policy):
+                self.state = TaskState(
+                    state="pending", restarts=self.state.restarts + 1
+                )
+                self.alloc_runner.task_state_updated()
+                delay = (restart_policy.delay or 0) / 1e9
+                cap = self.alloc_runner.client.max_restart_delay
+                if cap is not None:
+                    delay = min(delay, cap)
+                if self._stop.wait(delay):
+                    return
+                continue
+
+            self.state = TaskState(
+                state="dead",
+                failed=True,
+                started_at=self.state.started_at,
+                finished_at=self.handle.finished_at,
+            )
+            self.alloc_runner.task_state_updated()
+            return
+
+    def _should_restart(self, policy) -> bool:
+        if policy.mode not in ("delay", "fail"):
+            return False
+        now = time.monotonic()
+        interval_s = (policy.interval or 0) / 1e9
+        if interval_s > 0:
+            # prune attempts outside the rolling interval; interval 0 means
+            # the budget never resets (attempts are a lifetime limit)
+            self._restarts_in_interval = [
+                t for t in self._restarts_in_interval if now - t < interval_s
+            ]
+        if len(self._restarts_in_interval) >= policy.attempts:
+            return policy.mode == "delay"
+        self._restarts_in_interval.append(now)
+        return True
+
+    def stop(self):
+        self._stop.set()
+        if self.handle is not None:
+            self.driver.stop_task(self.handle)
+
+
+class AllocRunner:
+    """Per-allocation supervisor (ref client/allocrunner/alloc_runner.go)."""
+
+    def __init__(self, client, alloc: Allocation):
+        self.client = client
+        self.alloc = alloc
+        self.task_runners: dict[str, TaskRunner] = {}
+        self._destroyed = False
+        self._lock = threading.Lock()
+
+    def task_dir(self, task_name: str) -> str:
+        d = os.path.join(
+            self.client.data_dir, "allocs", self.alloc.id, task_name
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run(self):
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None:
+            return
+        for task in tg.tasks:
+            driver = self.client.drivers.get(task.driver)
+            if driver is None:
+                tr = TaskRunner(self, task, None)
+                tr.state = TaskState(state="dead", failed=True, finished_at=now_ns())
+                tr.state.events.append(
+                    {"type": "Driver Failure", "message": f"unknown driver {task.driver}"}
+                )
+                self.task_runners[task.name] = tr
+                self.task_state_updated()
+                continue
+            tr = TaskRunner(self, task, driver)
+            self.task_runners[task.name] = tr
+            tr.start()
+
+    def client_status(self) -> str:
+        """Aggregate task states into the alloc's client status
+        (ref alloc_runner.go clientAlloc)."""
+        states = [tr.state for tr in self.task_runners.values()]
+        if not states:
+            return ALLOC_CLIENT_STATUS_PENDING
+        if any(s.state == "running" for s in states):
+            return ALLOC_CLIENT_STATUS_RUNNING
+        if all(s.state == "dead" for s in states):
+            if any(s.failed for s in states):
+                return ALLOC_CLIENT_STATUS_FAILED
+            return ALLOC_CLIENT_STATUS_COMPLETE
+        return ALLOC_CLIENT_STATUS_PENDING
+
+    def task_state_updated(self):
+        self.client.alloc_state_updated(self)
+
+    def update(self, alloc: Allocation):
+        with self._lock:
+            self.alloc.desired_status = alloc.desired_status
+            self.alloc.desired_description = alloc.desired_description
+            if alloc.server_terminal_status():
+                self.destroy()
+
+    def destroy(self):
+        if self._destroyed:
+            return
+        self._destroyed = True
+        for tr in self.task_runners.values():
+            tr.stop()
+
+
+class Client:
+    """ref client/client.go"""
+
+    def __init__(
+        self,
+        server,
+        data_dir: str = "/tmp/nomad_tpu_client",
+        node: Optional[Node] = None,
+        drivers: Optional[dict[str, Driver]] = None,
+    ):
+        self.server = server
+        self.data_dir = data_dir
+        # Optional cap on restart backoff (dev/test speedup); None = honor
+        # the task group's configured delay in full
+        self.max_restart_delay: Optional[float] = None
+        self.drivers = drivers or {
+            name: cls() for name, cls in BUILTIN_DRIVERS.items()
+        }
+        self.node = node or self.fingerprint()
+        self.alloc_runners: dict[str, AllocRunner] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._update_lock = threading.Lock()
+        self._pending_updates: dict[str, Allocation] = {}
+        self._heartbeat_ttl = 30.0
+
+    # ------------------------------------------------------------------
+    def fingerprint(self) -> Node:
+        """Host fingerprinting (ref client/fingerprint/): arch, cpu, memory,
+        drivers. TPU devices are fingerprinted by the device manager phase."""
+        try:
+            cpu_count = os.cpu_count() or 1
+        except Exception:
+            cpu_count = 1
+        node = Node(
+            id=generate_uuid(),
+            name=platform.node() or "client",
+            datacenter="dc1",
+            attributes={
+                "kernel.name": platform.system().lower(),
+                "arch": platform.machine(),
+                "nomad.version": "0.1.0",
+                "cpu.numcores": str(cpu_count),
+            },
+            node_resources=NodeResources(
+                cpu=NodeCpuResources(cpu_shares=cpu_count * 1000),
+                memory=NodeMemoryResources(memory_mb=8192),
+                disk=NodeDiskResources(disk_mb=20 * 1024),
+            ),
+            status="initializing",
+        )
+        for name, driver in self.drivers.items():
+            fp = driver.fingerprint()
+            node.drivers[name] = DriverInfo(
+                detected=fp["detected"], healthy=fp["healthy"]
+            )
+            node.attributes[f"driver.{name}"] = "1"
+        compute_class(node)
+        return node
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._stop.clear()
+        resp = self.server.node_register(self.node)
+        self._heartbeat_ttl = resp.get("heartbeat_ttl", 30.0)
+        self.server.node_update_status(self.node.id, "ready")
+        for target in (self._heartbeat_loop, self._watch_allocations, self._update_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for ar in self.alloc_runners.values():
+            ar.destroy()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads = []
+
+    # ------------------------------------------------------------------
+    def _heartbeat_loop(self):
+        """ref client.go:1421 registerAndHeartbeat"""
+        while not self._stop.is_set():
+            interval = max(self._heartbeat_ttl / 2, 0.05)
+            if self._stop.wait(interval):
+                return
+            try:
+                self.server.node_heartbeat(self.node.id)
+            except Exception:
+                logger.exception("heartbeat failed")
+
+    def _watch_allocations(self):
+        """Long-poll the server for alloc changes (ref client.go:1861)."""
+        index = 0
+        while not self._stop.is_set():
+            try:
+                allocs, new_index = self.server.get_client_allocs(
+                    self.node.id, min_index=index, timeout=0.5
+                )
+            except Exception:
+                logger.exception("alloc watch failed")
+                time.sleep(0.5)
+                continue
+            if new_index == index:
+                continue
+            index = new_index
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, allocs: list[Allocation]):
+        """Diff desired allocs against runners (ref client.go:2079 runAllocs)."""
+        desired = {a.id: a for a in allocs}
+        for alloc_id, alloc in desired.items():
+            runner = self.alloc_runners.get(alloc_id)
+            if runner is None:
+                if alloc.server_terminal_status() or alloc.client_terminal_status():
+                    continue
+                runner = AllocRunner(self, alloc)
+                self.alloc_runners[alloc_id] = runner
+                runner.run()
+            else:
+                runner.update(alloc)
+
+    # ------------------------------------------------------------------
+    def alloc_state_updated(self, runner: AllocRunner):
+        """Batch alloc status updates back to the server
+        (ref client.go AllocStateUpdated + allocSync)."""
+        update = runner.alloc.copy()
+        update.client_status = runner.client_status()
+        update.task_states = {
+            name: tr.state for name, tr in runner.task_runners.items()
+        }
+        update.modify_time = now_ns()
+        with self._update_lock:
+            self._pending_updates[update.id] = update
+
+    def _update_loop(self):
+        while not self._stop.is_set():
+            if self._stop.wait(0.1):
+                return
+            with self._update_lock:
+                updates = list(self._pending_updates.values())
+                self._pending_updates.clear()
+            if updates:
+                try:
+                    self.server.update_allocs(updates)
+                except Exception:
+                    logger.exception("alloc update failed")

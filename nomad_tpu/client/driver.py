@@ -1,0 +1,160 @@
+"""Task drivers (ref plugins/drivers/ + drivers/{mock,rawexec}).
+
+The driver interface mirrors the reference's gRPC Driver service surface
+(plugins/drivers/proto/driver.proto:13-84) in-process: fingerprint,
+start/wait/stop/destroy/inspect/signal. The mock driver reproduces the
+reference's scriptable test driver (drivers/mock): configurable run duration,
+exit codes, and start errors. RawExecDriver runs real subprocesses with no
+isolation (drivers/rawexec); the isolated exec driver arrives with the C++
+executor.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..structs.model import Task
+
+
+@dataclass
+class TaskHandle:
+    task_name: str = ""
+    driver: str = ""
+    proc: Optional[object] = None
+    exit_code: Optional[int] = None
+    error: str = ""
+    started_at: int = 0
+    finished_at: int = 0
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def finish(self, exit_code: int, error: str = ""):
+        self.exit_code = exit_code
+        self.error = error
+        self.finished_at = time.time_ns()
+        self._done.set()
+
+
+class Driver:
+    """Driver plugin interface (ref plugins/drivers/driver.go)."""
+
+    name = "driver"
+
+    def fingerprint(self) -> dict:
+        """Returns {detected, healthy, attributes}."""
+        return {"detected": True, "healthy": True, "attributes": {}}
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        raise NotImplementedError
+
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+        raise NotImplementedError
+
+    def destroy_task(self, handle: TaskHandle):
+        pass
+
+    def inspect_task(self, handle: TaskHandle) -> dict:
+        return {
+            "exit_code": handle.exit_code,
+            "error": handle.error,
+            "running": not handle._done.is_set(),
+        }
+
+
+class MockDriver(Driver):
+    """Scriptable driver for tests (ref drivers/mock/driver.go).
+
+    Task config keys:
+      run_for          seconds to run before exiting (default 0: exit now)
+      exit_code        exit code to report (default 0)
+      start_error      error string raised at start
+      start_block_for  seconds to block in start
+    """
+
+    name = "mock_driver"
+
+    def __init__(self):
+        self._timers: dict[int, threading.Timer] = {}
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        if cfg.get("start_error"):
+            raise RuntimeError(str(cfg["start_error"]))
+        if cfg.get("start_block_for"):
+            time.sleep(float(cfg["start_block_for"]))
+
+        handle = TaskHandle(
+            task_name=task.name, driver=self.name, started_at=time.time_ns()
+        )
+        run_for = float(cfg.get("run_for", 0))
+        exit_code = int(cfg.get("exit_code", 0))
+        if run_for <= 0:
+            handle.finish(exit_code)
+        else:
+            t = threading.Timer(run_for, handle.finish, args=(exit_code,))
+            t.daemon = True
+            self._timers[id(handle)] = t
+            t.start()
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+        t = self._timers.pop(id(handle), None)
+        if t is not None:
+            t.cancel()
+        if not handle._done.is_set():
+            handle.finish(130, "killed")
+
+
+class RawExecDriver(Driver):
+    """Run a real subprocess with no isolation (ref drivers/rawexec)."""
+
+    name = "raw_exec"
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise RuntimeError("raw_exec requires a command")
+        args = [command] + list(cfg.get("args", []))
+        proc = subprocess.Popen(
+            args,
+            cwd=task_dir or None,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin", **task.env},
+        )
+        handle = TaskHandle(
+            task_name=task.name,
+            driver=self.name,
+            proc=proc,
+            started_at=time.time_ns(),
+        )
+
+        def waiter():
+            code = proc.wait()
+            handle.finish(code)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+        proc = handle.proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+BUILTIN_DRIVERS = {
+    MockDriver.name: MockDriver,
+    RawExecDriver.name: RawExecDriver,
+}

@@ -1,0 +1,7 @@
+"""Server core: broker, plan queue/applier, workers, endpoints (ref nomad/)."""
+
+from .blocked_evals import BlockedEvals
+from .broker import FAILED_QUEUE, BrokerError, EvalBroker
+from .plan_apply import PlanQueue, Planner, evaluate_plan
+from .server import Server
+from .worker import Worker

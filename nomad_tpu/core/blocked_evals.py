@@ -1,0 +1,165 @@
+"""BlockedEvals: tracks failed-placement evaluations and unblocks them when
+capacity becomes available (ref nomad/blocked_evals.go:33-761).
+
+Evals are indexed by the computed node classes they found ineligible; when a
+node of a new/updated class appears, matching evals re-enter the broker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..structs.model import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
+
+
+class BlockedEvals:
+    def __init__(self, broker):
+        self.broker = broker
+        self.enabled = False
+        self._lock = threading.Lock()
+        # job key -> blocked eval (one per job; ref blocked_evals.go dedup)
+        self._jobs: dict[tuple[str, str], Evaluation] = {}
+        # eval id -> eval
+        self._captured: dict[str, Evaluation] = {}
+        # last state index at which capacity changed, globally and per class
+        # (closes the race where capacity arrives while a scheduler is still
+        # deciding to block; ref blocked_evals.go unblockIndexes)
+        self._unblock_index = 0
+        self._unblock_indexes: dict[str, int] = {}
+        # evals that escaped computed classes unblock on any change
+        self._escaped: set[str] = set()
+
+    def set_enabled(self, enabled: bool):
+        with self._lock:
+            prev = self.enabled
+            self.enabled = enabled
+        if prev and not enabled:
+            self.flush()
+
+    # ------------------------------------------------------------------
+    def block(self, ev: Evaluation):
+        """Track a blocked eval (ref blocked_evals.go Block)."""
+        requeue = False
+        with self._lock:
+            if not self.enabled:
+                return
+            # Capacity changed after the scheduler's snapshot: the eval may
+            # already fit, so re-enqueue instead of blocking
+            # (ref blocked_evals.go missedUnblock)
+            if ev.snapshot_index and self._missed_unblock(ev):
+                requeue = True
+            key = (ev.namespace, ev.job_id)
+            # Dedup: one blocked eval per job; keep the newer
+            existing = self._jobs.get(key)
+            if existing is not None:
+                self._captured.pop(existing.id, None)
+                self._escaped.discard(existing.id)
+            if not requeue:
+                self._jobs[key] = ev
+                self._captured[ev.id] = ev
+                if ev.escaped_computed_class:
+                    self._escaped.add(ev.id)
+        if requeue:
+            requeued = ev.copy()
+            requeued.status = EVAL_STATUS_PENDING
+            self.broker.enqueue(requeued)
+
+    def _missed_unblock(self, ev: Evaluation) -> bool:
+        """Did a relevant capacity change land after the eval's snapshot?"""
+        if ev.escaped_computed_class:
+            return self._unblock_index > ev.snapshot_index
+        elig = ev.class_eligibility or {}
+        for cls, index in self._unblock_indexes.items():
+            if index <= ev.snapshot_index:
+                continue
+            if elig.get(cls, True):  # eligible or never-evaluated class
+                return True
+        return False
+
+    def untrack(self, namespace: str, job_id: str):
+        """Stop tracking a job's blocked eval (e.g. job deregistered)."""
+        with self._lock:
+            ev = self._jobs.pop((namespace, job_id), None)
+            if ev is not None:
+                self._captured.pop(ev.id, None)
+                self._escaped.discard(ev.id)
+
+    # ------------------------------------------------------------------
+    def unblock(self, computed_class: str, index: int):
+        """Capacity for a node class changed: re-enqueue matching evals
+        (ref blocked_evals.go Unblock)."""
+        to_unblock = []
+        with self._lock:
+            if not self.enabled:
+                return
+            self._unblock_index = max(self._unblock_index, index)
+            self._unblock_indexes[computed_class] = max(
+                self._unblock_indexes.get(computed_class, 0), index
+            )
+            for eval_id, ev in list(self._captured.items()):
+                if self._should_unblock(ev, computed_class):
+                    to_unblock.append(ev)
+                    self._captured.pop(eval_id, None)
+                    self._escaped.discard(eval_id)
+                    self._jobs.pop((ev.namespace, ev.job_id), None)
+        for ev in to_unblock:
+            requeued = ev.copy()
+            requeued.status = EVAL_STATUS_PENDING
+            self.broker.enqueue(requeued)
+
+    def unblock_all(self, index: int = 0):
+        """Unblock everything (e.g. new node registered with unknown class)."""
+        with self._lock:
+            evals = list(self._captured.values())
+            self._captured.clear()
+            self._escaped.clear()
+            self._jobs.clear()
+        for ev in evals:
+            requeued = ev.copy()
+            requeued.status = EVAL_STATUS_PENDING
+            self.broker.enqueue(requeued)
+
+    @staticmethod
+    def _should_unblock(ev: Evaluation, computed_class: str) -> bool:
+        """ref blocked_evals.go:missedUnblock semantics (inverted): an eval
+        unblocks unless it explicitly marked this class ineligible."""
+        if ev.escaped_computed_class:
+            return True
+        elig = ev.class_eligibility or {}
+        if computed_class in elig:
+            return elig[computed_class]
+        # Unknown class: the eval never evaluated it, so it may now fit
+        return True
+
+    def unblock_failed(self):
+        """Re-enqueue evals blocked due to max plan attempts after a cooldown
+        (ref blocked_evals.go UnblockFailed)."""
+        with self._lock:
+            failed = [
+                ev
+                for ev in self._captured.values()
+                if ev.triggered_by == EVAL_TRIGGER_MAX_PLANS
+            ]
+            for ev in failed:
+                self._captured.pop(ev.id, None)
+                self._escaped.discard(ev.id)
+                self._jobs.pop((ev.namespace, ev.job_id), None)
+        for ev in failed:
+            requeued = ev.copy()
+            requeued.status = EVAL_STATUS_PENDING
+            self.broker.enqueue(requeued)
+
+    def flush(self):
+        with self._lock:
+            self._jobs.clear()
+            self._captured.clear()
+            self._escaped.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_blocked": len(self._captured),
+                "total_escaped": len(self._escaped),
+            }

@@ -1,0 +1,318 @@
+"""EvalBroker: leader-side priority queue of evaluations with at-least-once
+delivery (ref nomad/eval_broker.go).
+
+Semantics preserved: per-scheduler-type ready heaps ordered by priority,
+per-job serialization (one eval in flight per job; the rest block behind
+it), token'd unack with Nack timers, delivery limit → ``_failed`` queue,
+nack re-enqueue delay ramp, wait/wait_until delayed evals, and requeue-on-ack
+for reblocked evals. This is also where the TPU batch bridge drains N evals
+at a time (``dequeue_batch``).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..structs.model import Evaluation, generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+DEFAULT_INITIAL_NACK_DELAY = 1.0
+DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
+
+
+class BrokerError(Exception):
+    pass
+
+
+class _PendingHeap:
+    """Priority heap: highest priority first, FIFO within a priority."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._counter = itertools.count()
+
+    def push(self, ev: Evaluation):
+        heapq.heappush(self._heap, (-ev.priority, next(self._counter), ev))
+
+    def pop(self) -> Evaluation:
+        return heapq.heappop(self._heap)[2]
+
+    def peek(self) -> Optional[Evaluation]:
+        return self._heap[0][2] if self._heap else None
+
+    def __len__(self):
+        return len(self._heap)
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+        delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+        initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
+        subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
+    ):
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
+
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # evals: eval id -> dequeue attempt count (dedup + delivery limit)
+        self._evals: dict[str, int] = {}
+        # per-job serialization: (ns, job) -> in-flight eval id
+        self._job_evals: dict[tuple[str, str], str] = {}
+        # (ns, job) -> heap of evals blocked behind the in-flight one
+        self._blocked: dict[tuple[str, str], _PendingHeap] = {}
+        # scheduler type -> ready heap
+        self._ready: dict[str, _PendingHeap] = {}
+        # eval id -> (eval, token, nack timer)
+        self._unack: dict[str, tuple[Evaluation, str, threading.Timer]] = {}
+        # token -> eval to requeue on ack
+        self._requeue: dict[str, Evaluation] = {}
+        # eval id -> wait timer
+        self._time_wait: dict[str, threading.Timer] = {}
+
+    # ------------------------------------------------------------------
+    def set_enabled(self, enabled: bool):
+        with self._lock:
+            prev = self.enabled
+            self.enabled = enabled
+        if prev and not enabled:
+            self.flush()
+
+    # ------------------------------------------------------------------
+    def enqueue(self, ev: Evaluation):
+        with self._lock:
+            self._process_enqueue(ev, "")
+
+    def enqueue_all(self, evals: dict | list):
+        """Enqueue many evals; accepts {eval: token} or a list."""
+        with self._lock:
+            if isinstance(evals, dict):
+                for ev, token in evals.items():
+                    self._process_enqueue(ev, token)
+            else:
+                for ev in evals:
+                    self._process_enqueue(ev, "")
+
+    def _process_enqueue(self, ev: Evaluation, token: str):
+        """ref eval_broker.go:212-254"""
+        if not self.enabled:
+            return
+        if ev.id in self._evals:
+            if token == "":
+                return
+            unack = self._unack.get(ev.id)
+            if unack is not None and unack[1] == token:
+                self._requeue[token] = ev
+            return
+        self._evals[ev.id] = 0
+
+        if ev.wait_until:
+            now = time.time_ns()
+            delay = max((ev.wait_until - now) / 1e9, 0.0)
+            if delay > 0:
+                timer = threading.Timer(delay, self._enqueue_waiting, args=(ev,))
+                timer.daemon = True
+                self._time_wait[ev.id] = timer
+                timer.start()
+                return
+
+        self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_waiting(self, ev: Evaluation):
+        with self._lock:
+            self._time_wait.pop(ev.id, None)
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str):
+        """ref eval_broker.go:277-327"""
+        if not self.enabled:
+            return
+        key = (ev.namespace, ev.job_id)
+        pending_eval = self._job_evals.get(key, "")
+        if pending_eval == "":
+            self._job_evals[key] = ev.id
+        elif pending_eval != ev.id:
+            self._blocked.setdefault(key, _PendingHeap()).push(ev)
+            return
+
+        self._ready.setdefault(queue, _PendingHeap()).push(ev)
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    def dequeue(
+        self, schedulers: list[str], timeout: Optional[float] = None
+    ) -> tuple[Optional[Evaluation], str]:
+        """Blocking dequeue for the given scheduler types; returns
+        (eval, token) or (None, "") on timeout (ref eval_broker.go:329-460)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                ev, token = self._scan(schedulers)
+                if ev is not None:
+                    return ev, token
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None, ""
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def dequeue_batch(
+        self, schedulers: list[str], max_evals: int, timeout: Optional[float] = None
+    ) -> list[tuple[Evaluation, str]]:
+        """Drain up to max_evals ready evaluations in one call — the TPU batch
+        bridge (SURVEY §2.3: "where the TPU bridge drains N evals at a time").
+        Blocks for the first eval only."""
+        out = []
+        ev, token = self.dequeue(schedulers, timeout)
+        if ev is None:
+            return out
+        out.append((ev, token))
+        with self._cond:
+            while len(out) < max_evals:
+                ev, token = self._scan(schedulers)
+                if ev is None:
+                    break
+                out.append((ev, token))
+        return out
+
+    def _scan(self, schedulers: list[str]) -> tuple[Optional[Evaluation], str]:
+        """Pick the highest-priority eval across eligible queues; must hold
+        the lock."""
+        best: Optional[Evaluation] = None
+        best_queue = ""
+        for sched in schedulers:
+            heap_ = self._ready.get(sched)
+            if not heap_ or not len(heap_):
+                continue
+            candidate = heap_.peek()
+            if best is None or candidate.priority > best.priority:
+                best = candidate
+                best_queue = sched
+        if best is None:
+            return None, ""
+        ev = self._ready[best_queue].pop()
+        token = generate_uuid()
+        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+
+        timer = threading.Timer(self.nack_timeout, self._nack_timeout, args=(ev.id, token))
+        timer.daemon = True
+        self._unack[ev.id] = (ev, token, timer)
+        timer.start()
+        return ev, token
+
+    def _nack_timeout(self, eval_id: str, token: str):
+        try:
+            self.nack(eval_id, token)
+        except BrokerError:
+            pass
+
+    # ------------------------------------------------------------------
+    def outstanding(self, eval_id: str) -> tuple[str, bool]:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                return "", False
+            return unack[1], True
+
+    def ack(self, eval_id: str, token: str):
+        """ref eval_broker.go:531-592"""
+        with self._lock:
+            requeued = self._requeue.pop(token, None)
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("Evaluation ID not found")
+            ev, utoken, timer = unack
+            if utoken != token:
+                raise BrokerError("Token does not match for Evaluation ID")
+            timer.cancel()
+            del self._unack[eval_id]
+            self._evals.pop(eval_id, None)
+
+            key = (ev.namespace, ev.job_id)
+            self._job_evals.pop(key, None)
+
+            blocked = self._blocked.get(key)
+            if blocked is not None and len(blocked):
+                nxt = blocked.pop()
+                if not len(blocked):
+                    del self._blocked[key]
+                self._enqueue_locked(nxt, nxt.type)
+
+            if requeued is not None:
+                self._process_enqueue(requeued, "")
+            self._cond.notify_all()
+
+    def nack(self, eval_id: str, token: str):
+        """ref eval_broker.go:595-642"""
+        with self._lock:
+            self._requeue.pop(token, None)
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("Evaluation ID not found")
+            ev, utoken, timer = unack
+            if utoken != token:
+                raise BrokerError("Token does not match for Evaluation ID")
+            timer.cancel()
+            del self._unack[eval_id]
+
+            dequeues = self._evals.get(eval_id, 0)
+            if dequeues >= self.delivery_limit:
+                self._enqueue_locked(ev, FAILED_QUEUE)
+            else:
+                delay = self._nack_reenqueue_delay(dequeues)
+                if delay > 0:
+                    t = threading.Timer(delay, self._enqueue_waiting, args=(ev,))
+                    t.daemon = True
+                    self._time_wait[ev.id] = t
+                    t.start()
+                else:
+                    self._enqueue_locked(ev, ev.type)
+            self._cond.notify_all()
+
+    def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
+        """ref eval_broker.go:644-655"""
+        if prev_dequeues <= 0:
+            return 0.0
+        if prev_dequeues == 1:
+            return self.initial_nack_delay
+        return (prev_dequeues - 1) * self.subsequent_nack_delay
+
+    # ------------------------------------------------------------------
+    def flush(self):
+        """Cancel timers and drop all state (ref eval_broker.go:692-749)."""
+        with self._lock:
+            for _, _, timer in self._unack.values():
+                timer.cancel()
+            for timer in self._time_wait.values():
+                timer.cancel()
+            self._evals.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._ready.clear()
+            self._unack.clear()
+            self._requeue.clear()
+            self._time_wait.clear()
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_ready": sum(len(h) for h in self._ready.values()),
+                "total_unacked": len(self._unack),
+                "total_blocked": sum(len(h) for h in self._blocked.values()),
+                "total_waiting": len(self._time_wait),
+                "by_scheduler": {k: len(h) for k, h in self._ready.items()},
+            }

@@ -1,0 +1,225 @@
+"""Plan queue + plan applier: the optimistic-concurrency arbiter
+(ref nomad/plan_queue.go:40-260, plan_apply.go:49-689).
+
+Many schedulers plan in parallel against snapshots; this single serialized
+applier re-checks every touched node's allocations against the latest state
+(AllocsFit with devices), commits fully or partially, and hands back a
+RefreshIndex so the scheduler can retry against fresher state. The per-node
+verification is a dense check over the plan's touched nodes — the same masked
+fit-matrix the TPU kernel computes, evaluated host-side at commit time.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..state.store import StateSnapshot, StateStore
+from ..structs.funcs import allocs_fit
+from ..structs.model import (
+    NODE_SCHED_INELIGIBLE,
+    NODE_STATUS_READY,
+    Evaluation,
+    Plan,
+    PlanResult,
+    remove_allocs,
+)
+
+
+class PendingPlan:
+    """A queued plan + its completion future (ref plan_queue.go pendingPlan)."""
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.result: Optional[PlanResult] = None
+        self.error: Optional[Exception] = None
+        self._done = threading.Event()
+
+    def respond(self, result: Optional[PlanResult], error: Optional[Exception]):
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> tuple[Optional[PlanResult], Optional[Exception]]:
+        self._done.wait(timeout)
+        return self.result, self.error
+
+
+class PlanQueue:
+    """Priority queue of pending plans (ref plan_queue.go:40-260)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._heap: list = []
+        self._counter = itertools.count()
+
+    def set_enabled(self, enabled: bool):
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                self._heap = []
+            self._cond.notify_all()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        pending = PendingPlan(plan)
+        with self._lock:
+            if not self.enabled:
+                pending.respond(None, RuntimeError("plan queue is disabled"))
+                return pending
+            heapq.heappush(
+                self._heap, (-plan.priority, next(self._counter), pending)
+            )
+            self._cond.notify_all()
+        return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._heap:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining if remaining is not None else 1.0)
+            return heapq.heappop(self._heap)[2]
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+def evaluate_node_plan(
+    snap: StateSnapshot, plan: Plan, node_id: str
+) -> tuple[bool, str]:
+    """Re-check one node's proposed allocs against latest state
+    (ref plan_apply.go:628-681)."""
+    if not plan.node_allocation.get(node_id):
+        return True, ""
+
+    node = snap.node_by_id(node_id)
+    if node is None:
+        return False, "node does not exist"
+    if node.status != NODE_STATUS_READY:
+        return False, "node is not ready for placements"
+    if node.scheduling_eligibility == NODE_SCHED_INELIGIBLE:
+        return False, "node is not eligible for draining"
+
+    existing = snap.allocs_by_node_terminal(node_id, False)
+    remove = []
+    remove.extend(plan.node_update.get(node_id, []))
+    remove.extend(plan.node_preemptions.get(node_id, []))
+    remove.extend(plan.node_allocation.get(node_id, []))
+    proposed = remove_allocs(existing, remove)
+    proposed = proposed + plan.node_allocation.get(node_id, [])
+
+    fit, reason, _ = allocs_fit(node, proposed, None, True)
+    return fit, reason
+
+
+def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
+    """Determine the committable subset of a plan
+    (ref plan_apply.go:399-560)."""
+    result = PlanResult(
+        deployment=plan.deployment.copy() if plan.deployment else None,
+        deployment_updates=plan.deployment_updates,
+    )
+
+    node_ids = list(dict.fromkeys(
+        list(plan.node_update.keys()) + list(plan.node_allocation.keys())
+    ))
+
+    partial_commit = False
+    for node_id in node_ids:
+        fit, reason = evaluate_node_plan(snap, plan, node_id)
+        if not fit:
+            partial_commit = True
+            if plan.all_at_once:
+                return PlanResult(refresh_index=snap.latest_index())
+            continue
+        if plan.node_update.get(node_id):
+            result.node_update[node_id] = plan.node_update[node_id]
+        if plan.node_allocation.get(node_id):
+            result.node_allocation[node_id] = plan.node_allocation[node_id]
+        if plan.node_preemptions.get(node_id):
+            result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+
+    # evict/preempt-only nodes always commit
+    for node_id, preempted in plan.node_preemptions.items():
+        if node_id not in node_ids and preempted:
+            result.node_preemptions[node_id] = preempted
+
+    if partial_commit:
+        result.refresh_index = snap.latest_index()
+        _correct_deployment_canaries(result)
+    return result
+
+
+def _correct_deployment_canaries(result: PlanResult):
+    """Drop canaries that were not actually placed after a partial commit
+    (ref plan_apply.go:592-625)."""
+    if result.deployment is None:
+        return
+    placed = {
+        a.id for allocs in result.node_allocation.values() for a in allocs
+    }
+    for group in result.deployment.task_groups.values():
+        group.placed_canaries = [c for c in group.placed_canaries if c in placed]
+
+
+class Planner:
+    """The leader's single plan-apply loop (ref plan_apply.go:71-180)."""
+
+    def __init__(self, state: StateStore):
+        self.state = state
+        self.queue = PlanQueue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.preemption_evals_fn = None  # hook: build follow-up evals for preempted allocs
+        self.on_preemption_evals = None  # hook: enqueue them after commit
+
+    def start(self):
+        self.queue.set_enabled(True)
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._apply_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self.queue.set_enabled(False)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _apply_loop(self):
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply(pending.plan)
+                pending.respond(result, None)
+            except Exception as e:  # surface to the submitting worker
+                pending.respond(None, e)
+
+    def apply(self, plan: Plan) -> PlanResult:
+        """Verify against the latest snapshot and commit the verified subset."""
+        snap = self.state.snapshot()
+        result = evaluate_plan(snap, plan)
+        if result.is_no_op() and result.refresh_index:
+            return result
+
+        preemption_evals: list[Evaluation] = []
+        if self.preemption_evals_fn is not None and result.node_preemptions:
+            preemption_evals = self.preemption_evals_fn(result)
+        index = self.state.upsert_plan_results(
+            None, plan, result, preemption_evals=preemption_evals
+        )
+        result.alloc_index = index
+        if preemption_evals and self.on_preemption_evals is not None:
+            self.on_preemption_evals(
+                [self.state.eval_by_id(e.id) for e in preemption_evals]
+            )
+        return result

@@ -1,0 +1,416 @@
+"""Server core: wires state, broker, plan applier, workers, heartbeats, and
+the RPC endpoint surface (ref nomad/server.go, nomad/*_endpoint.go).
+
+This is the single-region control plane. Endpoints are plain methods (the
+HTTP/API layer calls them; in-process clients call them directly, the same
+way the reference's agent embeds both server and client). Raft replication is
+replaced by the serialized state-store write path; multi-server consensus
+attaches underneath in a later phase without changing this surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..state.store import StateStore
+from ..structs.model import (
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+    JOB_TYPE_CORE,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSTEM,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    generate_uuid,
+    now_ns,
+)
+from ..structs.node_class import compute_class
+from .blocked_evals import BlockedEvals
+from .broker import EvalBroker
+from .plan_apply import Planner
+from .worker import Worker
+
+logger = logging.getLogger("nomad_tpu.server")
+
+DEFAULT_HEARTBEAT_TTL = 30.0
+
+
+class Server:
+    """ref nomad/server.go:91"""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {}
+        self.state = StateStore()
+        self.eval_broker = EvalBroker(
+            nack_timeout=self.config.get("nack_timeout", 60.0),
+            delivery_limit=self.config.get("delivery_limit", 3),
+            initial_nack_delay=self.config.get("initial_nack_delay", 1.0),
+            subsequent_nack_delay=self.config.get("subsequent_nack_delay", 20.0),
+        )
+        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.planner = Planner(self.state)
+        self.planner.preemption_evals_fn = self._make_preemption_evals
+        self.planner.on_preemption_evals = lambda evals: [
+            self.eval_broker.enqueue(e) for e in evals if e is not None
+        ]
+        self.workers: list[Worker] = []
+        self.heartbeat_ttl = self.config.get("heartbeat_ttl", DEFAULT_HEARTBEAT_TTL)
+        self._heartbeat_timers: dict[str, threading.Timer] = {}
+        self._lock = threading.Lock()
+        self._running = False
+
+    # ------------------------------------------------------------------
+    # lifecycle (ref leader.go:180 establishLeadership)
+    # ------------------------------------------------------------------
+    def start(self, num_workers: int = 2):
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.planner.start()
+        for i in range(num_workers):
+            w = Worker(self, seed=self.config.get("seed"))
+            self.workers.append(w)
+            w.start()
+        self._running = True
+        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
+        self._reaper.start()
+
+    def stop(self):
+        self._running = False
+        for w in self.workers:
+            w.stop()
+        self.workers = []
+        self.planner.stop()
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        with self._lock:
+            for t in self._heartbeat_timers.values():
+                t.cancel()
+            self._heartbeat_timers.clear()
+
+    def _next_index(self):
+        """Index sentinel: writes allocate their index inside the store's
+        write transaction (passing None)."""
+        return None
+
+    def _reap_failed_evals(self):
+        """Drain the _failed queue: mark evals failed and schedule a delayed
+        follow-up retry (ref leader.go:505 reapFailedEvaluations)."""
+        from .broker import FAILED_QUEUE
+
+        follow_up_wait = self.config.get("failed_eval_followup_wait", 60.0)
+        while self._running:
+            ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.5)
+            if ev is None:
+                continue
+            try:
+                failed = ev.copy()
+                failed.status = "failed"
+                failed.status_description = (
+                    "evaluation reached delivery limit"
+                )
+                follow_up = failed.create_failed_follow_up_eval(
+                    int(follow_up_wait * 1e9)
+                )
+                self.state.upsert_evals(None, [failed, follow_up])
+                self.eval_broker.enqueue(self.state.eval_by_id(follow_up.id))
+                self.eval_broker.ack(ev.id, token)
+            except Exception:
+                logger.exception("failed-eval reaping error for %s", ev.id)
+
+    # ------------------------------------------------------------------
+    # Job endpoints (ref nomad/job_endpoint.go:80 Register)
+    # ------------------------------------------------------------------
+    def job_register(self, job: Job) -> str:
+        """Returns the eval id created (empty for periodic/parameterized)."""
+        self._validate_job(job)
+        self.state.upsert_job(None, job)
+        stored = self.state.job_by_id(job.namespace, job.id)
+
+        if stored.is_periodic() or stored.is_parameterized():
+            return ""
+
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=stored.priority,
+            type=stored.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=stored.id,
+            job_modify_index=stored.modify_index,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.state.upsert_evals(None, [ev])
+        stored_eval = self.state.eval_by_id(ev.id)
+        self.eval_broker.enqueue(stored_eval)
+        return ev.id
+
+    def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
+        """ref job_endpoint.go Deregister"""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        if purge:
+            self.state.delete_job(None, namespace, job_id)
+        else:
+            stopped = job.copy()
+            stopped.stop = True
+            self.state.upsert_job(None, stopped)
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.state.upsert_evals(None, [ev])
+        self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+        return ev.id
+
+    @staticmethod
+    def _validate_job(job: Job):
+        """Minimal admission checks (ref job_endpoint.go validateJob)."""
+        if not job.id:
+            raise ValueError("missing job ID")
+        if not job.task_groups and not job.stop:
+            raise ValueError("job requires at least one task group")
+        if job.type == JOB_TYPE_CORE:
+            raise ValueError("job type cannot be core")
+        for tg in job.task_groups:
+            if tg.count < 0:
+                raise ValueError(f"task group {tg.name} count must be >= 0")
+            if not tg.tasks:
+                raise ValueError(f"task group {tg.name} requires at least one task")
+
+    # ------------------------------------------------------------------
+    # Node endpoints (ref nomad/node_endpoint.go:79 Register, :362
+    # UpdateStatus, :894 GetClientAllocs)
+    # ------------------------------------------------------------------
+    def node_register(self, node: Node) -> dict:
+        if not node.computed_class:
+            compute_class(node)
+        existed = self.state.node_by_id(node.id) is not None
+        if not node.status:
+            node.status = NODE_STATUS_READY
+        self.state.upsert_node(None, node)
+        self._reset_heartbeat(node.id)
+
+        # new capacity: unblock matching blocked evals + system-job evals
+        if not existed or node.status == NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class, self.state.latest_index())
+            self._create_node_evals(node.id)
+        return {"heartbeat_ttl": self.heartbeat_ttl}
+
+    def node_update_status(self, node_id: str, status: str) -> dict:
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        if node.status != status:
+            self.state.update_node_status(
+                None, node_id, status, updated_at_ns=now_ns()
+            )
+            self._create_node_evals(node_id)
+            if status == NODE_STATUS_READY:
+                node = self.state.node_by_id(node_id)
+                self.blocked_evals.unblock(
+                    node.computed_class, self.state.latest_index()
+                )
+        if status != NODE_STATUS_DOWN:
+            self._reset_heartbeat(node_id)
+        return {"heartbeat_ttl": self.heartbeat_ttl}
+
+    def node_heartbeat(self, node_id: str) -> dict:
+        """ref node_endpoint.go UpdateStatus heartbeat path + heartbeat.go"""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        if node.status == NODE_STATUS_DOWN:
+            # heartbeat revives a down node
+            return self.node_update_status(node_id, NODE_STATUS_READY)
+        self._reset_heartbeat(node_id)
+        return {"heartbeat_ttl": self.heartbeat_ttl}
+
+    def node_drain(self, node_id: str, drain: bool):
+        """ref node_endpoint.go UpdateDrain"""
+        self.state.update_node_drain(None, node_id, drain)
+        if drain:
+            # mark this node's allocs for migration
+            updates = []
+            for a in self.state.allocs_by_node_terminal(node_id, False):
+                ac = a.copy()
+                ac.desired_transition.migrate = True
+                updates.append(ac)
+            if updates:
+                self.state.upsert_allocs(None, updates)
+        self._create_node_evals(node_id)
+
+    def node_update_eligibility(self, node_id: str, eligibility: str):
+        self.state.update_node_eligibility(None, node_id, eligibility)
+
+    def _reset_heartbeat(self, node_id: str):
+        """ref heartbeat.go:33-212 resetHeartbeatTimer"""
+        if not self._running:
+            return
+        with self._lock:
+            old = self._heartbeat_timers.pop(node_id, None)
+            if old is not None:
+                old.cancel()
+            t = threading.Timer(
+                self.heartbeat_ttl, self._invalidate_heartbeat, args=(node_id,)
+            )
+            t.daemon = True
+            self._heartbeat_timers[node_id] = t
+            t.start()
+
+    def _invalidate_heartbeat(self, node_id: str):
+        """Heartbeat missed → node down → node evals (ref heartbeat.go:150)."""
+        with self._lock:
+            self._heartbeat_timers.pop(node_id, None)
+        try:
+            node = self.state.node_by_id(node_id)
+            if node is not None and node.status != NODE_STATUS_DOWN:
+                logger.warning("node %s missed heartbeat; marking down", node_id[:8])
+                self.node_update_status(node_id, NODE_STATUS_DOWN)
+        except Exception:
+            logger.exception("heartbeat invalidation failed for %s", node_id)
+
+    def _create_node_evals(self, node_id: str):
+        """Create evals for all jobs with allocs on the node + system jobs
+        (ref node_endpoint.go:1056 createNodeEvals)."""
+        node = self.state.node_by_id(node_id)
+        jobs: dict[tuple[str, str], Job] = {}
+        for alloc in self.state.allocs_by_node(node_id):
+            if alloc.job is not None and not alloc.terminal_status():
+                jobs[(alloc.namespace, alloc.job_id)] = alloc.job
+        for job in self.state.jobs_by_scheduler(JOB_TYPE_SYSTEM):
+            if node is not None and node.datacenter in job.datacenters:
+                jobs[(job.namespace, job.id)] = job
+
+        evals = []
+        for (ns, job_id), job in jobs.items():
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    namespace=ns,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+                    job_id=job_id,
+                    node_id=node_id,
+                    status=EVAL_STATUS_PENDING,
+                    create_time=now_ns(),
+                    modify_time=now_ns(),
+                )
+            )
+        if evals:
+            self.state.upsert_evals(None, evals)
+            for ev in evals:
+                self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+
+    # ------------------------------------------------------------------
+    # Client alloc sync (ref node_endpoint.go:894 GetClientAllocs, :362
+    # UpdateAlloc)
+    # ------------------------------------------------------------------
+    def get_client_allocs(
+        self, node_id: str, min_index: int = 0, timeout: float = 30.0
+    ) -> tuple[list[Allocation], int]:
+        """Blocking query the client long-polls for its allocs."""
+        def query(snap):
+            return snap.allocs_by_node(node_id)
+
+        return self.state.blocking_query(query, min_index=min_index, timeout=timeout)
+
+    def update_allocs(self, allocs: list[Allocation]):
+        """Client-reported alloc status; failed allocs trigger new evals
+        (ref node_endpoint.go UpdateAlloc:1006-1053)."""
+        self.state.update_allocs_from_client(None, allocs)
+        evals = []
+        for update in allocs:
+            stored = self.state.alloc_by_id(update.id)
+            if stored is None or stored.job is None:
+                continue
+            if (
+                stored.client_terminal_status()
+                and not stored.server_terminal_status()
+            ):
+                evals.append(
+                    Evaluation(
+                        id=generate_uuid(),
+                        namespace=stored.namespace,
+                        priority=stored.job.priority,
+                        type=stored.job.type,
+                        triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                        job_id=stored.job_id,
+                        status=EVAL_STATUS_PENDING,
+                        create_time=now_ns(),
+                        modify_time=now_ns(),
+                    )
+                )
+        if evals:
+            # dedup per job
+            seen = set()
+            unique = []
+            for ev in evals:
+                key = (ev.namespace, ev.job_id)
+                if key not in seen:
+                    seen.add(key)
+                    unique.append(ev)
+            self.state.upsert_evals(None, unique)
+            for ev in unique:
+                self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+
+    # ------------------------------------------------------------------
+    # Eval endpoints (ref nomad/eval_endpoint.go)
+    # ------------------------------------------------------------------
+    def eval_dequeue(self, schedulers: list[str], timeout: float = 1.0):
+        return self.eval_broker.dequeue(schedulers, timeout)
+
+    def eval_ack(self, eval_id: str, token: str):
+        self.eval_broker.ack(eval_id, token)
+
+    def eval_nack(self, eval_id: str, token: str):
+        self.eval_broker.nack(eval_id, token)
+
+    # ------------------------------------------------------------------
+    def _make_preemption_evals(self, result) -> list[Evaluation]:
+        """Follow-up evals for jobs whose allocs were preempted
+        (ref plan_apply.go preemption eval creation)."""
+        jobs = {}
+        for allocs in result.node_preemptions.values():
+            for alloc in allocs:
+                stored = self.state.alloc_by_id(alloc.id)
+                job = stored.job if stored is not None else None
+                if job is not None:
+                    jobs[(alloc.namespace, alloc.job_id)] = job
+        evals = []
+        for (ns, job_id), job in jobs.items():
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    namespace=ns,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by="preemption",
+                    job_id=job_id,
+                    status=EVAL_STATUS_PENDING,
+                    create_time=now_ns(),
+                    modify_time=now_ns(),
+                )
+            )
+        return evals

@@ -1,0 +1,150 @@
+"""Scheduler worker: dequeues evals, snapshots state, runs the scheduler, and
+submits plans (ref nomad/worker.go:74-523).
+
+The worker implements the scheduler's Planner protocol: SubmitPlan routes
+through the leader's plan queue (optimistic concurrency), and a RefreshIndex
+response hands the scheduler a newer snapshot to retry against.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Optional
+
+from ..scheduler.scheduler import new_scheduler
+from ..structs.model import (
+    EVAL_STATUS_FAILED,
+    Evaluation,
+    Plan,
+    PlanResult,
+)
+from .broker import FAILED_QUEUE, BrokerError
+
+logger = logging.getLogger("nomad_tpu.worker")
+
+DEQUEUE_TIMEOUT = 0.5
+RAFT_SYNC_LIMIT = 5.0
+
+
+class Worker:
+    """One scheduling worker (the reference runs NumCPU of these)."""
+
+    def __init__(self, server, schedulers: Optional[list[str]] = None, seed=None):
+        self.server = server
+        # _failed is drained by the leader's reaper (Server._reap_failed_evals),
+        # not by scheduling workers (ref leader.go:505 reapFailedEvaluations)
+        self.schedulers = schedulers or ["service", "batch", "system"]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.seed = seed
+        # set per-invocation; lets SubmitPlan attach the eval token and
+        # blocked evals record the snapshot they were evaluated against
+        self._eval_token = ""
+        self._eval: Optional[Evaluation] = None
+        self._snapshot_index = 0
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def run(self):
+        """ref worker.go:105-140"""
+        while not self._stop.is_set():
+            ev, token = self.server.eval_broker.dequeue(
+                self.schedulers, timeout=DEQUEUE_TIMEOUT
+            )
+            if ev is None:
+                continue
+            self.process_eval(ev, token)
+
+    # ------------------------------------------------------------------
+    def process_eval(self, ev: Evaluation, token: str):
+        """Dequeue → snapshot ≥ wait index → invoke scheduler → ack/nack
+        (ref worker.go:142-276)."""
+        try:
+            snapshot = self.server.state.snapshot_min_index(
+                ev.modify_index, timeout=RAFT_SYNC_LIMIT
+            )
+            self._eval_token = token
+            self._eval = ev
+            self._snapshot_index = snapshot.latest_index()
+            self.invoke_scheduler(snapshot, ev)
+        except Exception:
+            logger.exception("eval processing failed; nacking %s", ev.id)
+            try:
+                self.server.eval_broker.nack(ev.id, token)
+            except BrokerError:
+                pass
+            return
+        finally:
+            self._eval_token = ""
+            self._eval = None
+        try:
+            self.server.eval_broker.ack(ev.id, token)
+        except BrokerError:
+            pass
+
+    def invoke_scheduler(self, snapshot, ev: Evaluation):
+        """ref worker.go:244-276"""
+        rng = random.Random(self.seed) if self.seed is not None else None
+        sched_name = ev.type
+        if self.server.config.get("default_scheduler"):
+            # e.g. route service/batch evals through the tpu-batch backend
+            if ev.type in ("service", "batch"):
+                sched_name = self.server.config["default_scheduler"]
+        sched = new_scheduler(sched_name, snapshot, self, rng=rng)
+        sched.process(ev)
+
+    # ------------------------------------------------------------------
+    # Planner protocol (ref worker.go:347-523)
+    # ------------------------------------------------------------------
+    def submit_plan(self, plan: Plan):
+        """Attach the eval token, route through the plan queue, and hand back
+        a fresh snapshot when the applier asks for a refresh."""
+        plan.eval_token = self._eval_token
+        plan.snapshot_index = self.server.state.latest_index()
+        pending = self.server.planner.queue.enqueue(plan)
+        result, error = pending.wait(timeout=30.0)
+        if error is not None:
+            raise error
+        if result is None:
+            raise RuntimeError("plan submission timed out")
+
+        new_state = None
+        if result.refresh_index:
+            new_state = self.server.state.snapshot_min_index(
+                result.refresh_index, timeout=RAFT_SYNC_LIMIT
+            )
+        return result, new_state
+
+    def update_eval(self, ev: Evaluation):
+        """ref worker.go:426-445"""
+        self.server.state.upsert_evals(None, [ev])
+        if ev.status == EVAL_STATUS_FAILED:
+            logger.warning("eval failed: %s (%s)", ev.id, ev.status_description)
+
+    def create_eval(self, ev: Evaluation):
+        """ref worker.go:447-466"""
+        if ev.should_block() and not ev.snapshot_index:
+            ev.snapshot_index = self._snapshot_index
+        self.server.state.upsert_evals(None, [ev])
+        if ev.should_enqueue():
+            self.server.eval_broker.enqueue(ev)
+        elif ev.should_block():
+            self.server.blocked_evals.block(ev)
+
+    def reblock_eval(self, ev: Evaluation):
+        """ref worker.go:468-523"""
+        if not ev.snapshot_index:
+            ev.snapshot_index = self._snapshot_index
+        self.server.state.upsert_evals(None, [ev])
+        self.server.blocked_evals.block(ev)

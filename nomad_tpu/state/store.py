@@ -244,12 +244,19 @@ class StateSnapshot(StateReader):
 def _write_txn(method):
     """Serialize a whole read-copy-publish write transaction. In the
     reference, writes are serialized by the raft FSM apply loop; here the
-    store enforces it so any caller layering is safe."""
+    store enforces it so any caller layering is safe.
+
+    Every write method takes ``index`` as its first argument; passing None
+    allocates the next index *inside* the mutex (callers computing
+    latest_index()+1 outside the lock would race and publish two writes
+    under one index, starving blocking queries)."""
 
     @functools.wraps(method)
-    def wrapper(self, *args, **kwargs):
+    def wrapper(self, index=None, *args, **kwargs):
         with self._write_mutex:
-            return method(self, *args, **kwargs)
+            if index is None:
+                index = self._gen.index + 1
+            return method(self, index, *args, **kwargs)
 
     return wrapper
 
@@ -937,3 +944,4 @@ class StateStore(StateReader):
                 gen, index, "allocs", "jobs", "evals", "job_summary", "deployment"
             ),
         )
+        return index

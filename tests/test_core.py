@@ -1,0 +1,381 @@
+"""Server core tests: broker, plan applier, workers, end-to-end dev agent
+(semantics ref: nomad/eval_broker_test.go, plan_apply_test.go, worker_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import BrokerError, EvalBroker, Server, evaluate_plan
+from nomad_tpu.core.plan_apply import PlanQueue
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs.model import Evaluation, Plan, generate_uuid
+
+
+def make_eval(priority=50, type_="service", job_id=None, **kw):
+    return Evaluation(
+        id=generate_uuid(),
+        priority=priority,
+        type=type_,
+        job_id=job_id or generate_uuid(),
+        triggered_by="job-register",
+        status="pending",
+        **kw,
+    )
+
+
+class TestEvalBroker:
+    def _broker(self, **kw):
+        b = EvalBroker(nack_timeout=5.0, **kw)
+        b.set_enabled(True)
+        return b
+
+    def test_enqueue_dequeue_ack(self):
+        b = self._broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=0.5)
+        assert out.id == ev.id
+        assert token
+        b.ack(ev.id, token)
+        assert b.stats()["total_ready"] == 0
+
+    def test_priority_order(self):
+        b = self._broker()
+        low, high = make_eval(priority=10), make_eval(priority=90)
+        b.enqueue(low)
+        b.enqueue(high)
+        out, token = b.dequeue(["service"], timeout=0.5)
+        assert out.id == high.id
+        b.ack(out.id, token)
+
+    def test_scheduler_type_routing(self):
+        b = self._broker()
+        svc, batch = make_eval(type_="service"), make_eval(type_="batch")
+        b.enqueue(svc)
+        b.enqueue(batch)
+        out, token = b.dequeue(["batch"], timeout=0.5)
+        assert out.id == batch.id
+        b.ack(out.id, token)
+        out, _ = b.dequeue(["service"], timeout=0.5)
+        assert out.id == svc.id
+
+    def test_dedup(self):
+        b = self._broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        b.enqueue(ev)
+        assert b.stats()["total_ready"] == 1
+
+    def test_per_job_serialization(self):
+        b = self._broker()
+        job_id = generate_uuid()
+        ev1, ev2 = make_eval(job_id=job_id), make_eval(job_id=job_id)
+        b.enqueue(ev1)
+        b.enqueue(ev2)
+        out1, token1 = b.dequeue(["service"], timeout=0.5)
+        # second eval for the same job is blocked until ack
+        out2, _ = b.dequeue(["service"], timeout=0.1)
+        assert out2 is None
+        assert b.stats()["total_blocked"] == 1
+        b.ack(out1.id, token1)
+        out2, token2 = b.dequeue(["service"], timeout=0.5)
+        assert out2.id == ev2.id
+        b.ack(out2.id, token2)
+
+    def test_nack_requeues(self):
+        b = self._broker(initial_nack_delay=0.0, subsequent_nack_delay=0.0)
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=0.5)
+        b.nack(out.id, token)
+        out2, token2 = b.dequeue(["service"], timeout=0.5)
+        assert out2.id == ev.id
+        b.ack(out2.id, token2)
+
+    def test_delivery_limit_failed_queue(self):
+        b = self._broker(delivery_limit=2, initial_nack_delay=0.0, subsequent_nack_delay=0.0)
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=0.5)
+        b.nack(out.id, token)
+        # second delivery hits the limit; next nack routes to _failed
+        out, token = b.dequeue(["service"], timeout=0.5)
+        b.nack(out.id, token)
+        out, token = b.dequeue(["_failed"], timeout=0.5)
+        assert out.id == ev.id
+
+    def test_wait_until_delays(self):
+        b = self._broker()
+        ev = make_eval()
+        ev.wait_until = time.time_ns() + int(0.2 * 1e9)
+        b.enqueue(ev)
+        out, _ = b.dequeue(["service"], timeout=0.05)
+        assert out is None
+        out, token = b.dequeue(["service"], timeout=1.0)
+        assert out is not None and out.id == ev.id
+
+    def test_token_mismatch(self):
+        b = self._broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=0.5)
+        with pytest.raises(BrokerError):
+            b.ack(out.id, "bogus")
+
+    def test_dequeue_batch(self):
+        b = self._broker()
+        evs = [make_eval() for _ in range(5)]
+        for ev in evs:
+            b.enqueue(ev)
+        batch = b.dequeue_batch(["service"], max_evals=3, timeout=0.5)
+        assert len(batch) == 3
+        for ev, token in batch:
+            b.ack(ev.id, token)
+
+
+class TestPlanApply:
+    def test_evaluate_plan_commits_fitting(self):
+        state = StateStore()
+        n = mock.node()
+        state.upsert_node(1, n)
+        a = mock.alloc()
+        a.node_id = n.id
+        plan = Plan(eval_id="e", job=a.job, node_allocation={n.id: [a]})
+        result = evaluate_plan(state.snapshot(), plan)
+        assert result.node_allocation == {n.id: [a]}
+        assert result.refresh_index == 0
+
+    def test_evaluate_plan_rejects_overcommit(self):
+        state = StateStore()
+        n = mock.node()
+        state.upsert_node(1, n)
+        a = mock.alloc()
+        a.node_id = n.id
+        a.allocated_resources.tasks["web"].cpu.cpu_shares = 100000
+        plan = Plan(eval_id="e", job=a.job, node_allocation={n.id: [a]})
+        result = evaluate_plan(state.snapshot(), plan)
+        assert not result.node_allocation
+        assert result.refresh_index > 0
+
+    def test_partial_commit(self):
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        state.upsert_node(1, n1)
+        state.upsert_node(2, n2)
+        good = mock.alloc()
+        good.node_id = n1.id
+        bad = mock.alloc()
+        bad.node_id = n2.id
+        bad.allocated_resources.tasks["web"].cpu.cpu_shares = 100000
+        plan = Plan(
+            eval_id="e",
+            job=good.job,
+            node_allocation={n1.id: [good], n2.id: [bad]},
+        )
+        result = evaluate_plan(state.snapshot(), plan)
+        assert n1.id in result.node_allocation
+        assert n2.id not in result.node_allocation
+        assert result.refresh_index > 0
+
+    def test_all_at_once_rejects_whole_plan(self):
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        state.upsert_node(1, n1)
+        state.upsert_node(2, n2)
+        good = mock.alloc()
+        good.node_id = n1.id
+        bad = mock.alloc()
+        bad.node_id = n2.id
+        bad.allocated_resources.tasks["web"].cpu.cpu_shares = 100000
+        plan = Plan(
+            eval_id="e",
+            job=good.job,
+            all_at_once=True,
+            node_allocation={n1.id: [good], n2.id: [bad]},
+        )
+        result = evaluate_plan(state.snapshot(), plan)
+        assert not result.node_allocation
+        assert result.refresh_index > 0
+
+    def test_down_node_rejected(self):
+        state = StateStore()
+        n = mock.node()
+        n.status = "down"
+        state.upsert_node(1, n)
+        a = mock.alloc()
+        a.node_id = n.id
+        plan = Plan(eval_id="e", job=a.job, node_allocation={n.id: [a]})
+        result = evaluate_plan(state.snapshot(), plan)
+        assert not result.node_allocation
+
+    def test_plan_queue_priority(self):
+        q = PlanQueue()
+        q.set_enabled(True)
+        p_low = q.enqueue(Plan(priority=10))
+        p_high = q.enqueue(Plan(priority=90))
+        first = q.dequeue(timeout=0.5)
+        assert first.plan.priority == 90
+
+
+class TestServerEndToEnd:
+    def test_job_register_places_allocs(self):
+        server = Server({"seed": 42, "heartbeat_ttl": 60.0})
+        server.start(num_workers=2)
+        try:
+            for _ in range(4):
+                server.state.upsert_node(server._next_index(), mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 4
+            eval_id = server.job_register(job)
+            assert eval_id
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                ev = server.state.eval_by_id(eval_id)
+                if ev is not None and ev.status == "complete":
+                    break
+                time.sleep(0.05)
+            ev = server.state.eval_by_id(eval_id)
+            assert ev.status == "complete", ev.status_description
+            allocs = server.state.allocs_by_job(job.namespace, job.id)
+            assert len(allocs) == 4
+        finally:
+            server.stop()
+
+    def test_blocked_eval_unblocks_on_new_node(self):
+        server = Server({"seed": 42, "heartbeat_ttl": 60.0})
+        server.start(num_workers=1)
+        try:
+            # no nodes: eval blocks
+            job = mock.job()
+            job.task_groups[0].count = 2
+            eval_id = server.job_register(job)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if server.blocked_evals.stats()["total_blocked"] >= 1:
+                    break
+                time.sleep(0.05)
+            assert server.blocked_evals.stats()["total_blocked"] >= 1
+
+            # register a node: blocked eval unblocks, allocs place
+            server.state.upsert_node(server._next_index(), mock.node())
+            server.node_register(mock.node())
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = server.state.allocs_by_job(job.namespace, job.id)
+                if len(allocs) == 2:
+                    break
+                time.sleep(0.05)
+            assert len(server.state.allocs_by_job(job.namespace, job.id)) == 2
+        finally:
+            server.stop()
+
+
+class TestDevAgentE2E:
+    def test_mock_job_runs_to_complete(self):
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=2, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = mock.batch_job()
+            job.task_groups[0].count = 3
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            job.task_groups[0].tasks[0].config = {"run_for": 0.2, "exit_code": 0}
+            agent.run_job(job)
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                allocs = agent.state.allocs_by_job(job.namespace, job.id)
+                if len(allocs) == 3 and all(
+                    a.client_status == "complete" for a in allocs
+                ):
+                    break
+                time.sleep(0.1)
+            allocs = agent.state.allocs_by_job(job.namespace, job.id)
+            assert len(allocs) == 3
+            assert all(a.client_status == "complete" for a in allocs), [
+                (a.client_status, a.task_states) for a in allocs
+            ]
+            # job transitions to dead after batch completion
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if agent.state.job_by_id(job.namespace, job.id).status == "dead":
+                    break
+                time.sleep(0.1)
+            assert agent.state.job_by_id(job.namespace, job.id).status == "dead"
+        finally:
+            agent.stop()
+
+    def test_service_job_runs(self):
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=1, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 2
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            job.task_groups[0].tasks[0].config = {"run_for": 60}
+            job.task_groups[0].tasks[0].resources.networks = []
+            agent.run_job(job)
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                allocs = agent.state.allocs_by_job(job.namespace, job.id)
+                if len(allocs) == 2 and all(
+                    a.client_status == "running" for a in allocs
+                ):
+                    break
+                time.sleep(0.1)
+            allocs = agent.state.allocs_by_job(job.namespace, job.id)
+            assert len(allocs) == 2
+            assert all(a.client_status == "running" for a in allocs)
+            assert agent.state.job_by_id(job.namespace, job.id).status == "running"
+
+            # stop the job: allocs are stopped on the client
+            agent.server.job_deregister(job.namespace, job.id)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                allocs = agent.state.allocs_by_job(job.namespace, job.id)
+                if all(a.desired_status == "stop" for a in allocs):
+                    break
+                time.sleep(0.1)
+            assert all(a.desired_status == "stop" for a in allocs)
+        finally:
+            agent.stop()
+
+    def test_failed_alloc_rescheduled(self):
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=2, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            tg = job.task_groups[0]
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": 0.1, "exit_code": 1}
+            tg.tasks[0].resources.networks = []
+            tg.restart_policy.attempts = 0
+            tg.restart_policy.mode = "fail"
+            tg.reschedule_policy.attempts = 1
+            tg.reschedule_policy.interval = 60 * 60 * 1_000_000_000
+            tg.reschedule_policy.delay = 0
+            tg.reschedule_policy.delay_function = "constant"
+            agent.run_job(job)
+
+            deadline = time.time() + 20
+            replacement = None
+            while time.time() < deadline:
+                allocs = agent.state.allocs_by_job(job.namespace, job.id)
+                replacements = [a for a in allocs if a.previous_allocation]
+                if replacements:
+                    replacement = replacements[0]
+                    break
+                time.sleep(0.1)
+            assert replacement is not None, "no rescheduled alloc appeared"
+            assert replacement.reschedule_tracker is not None
+        finally:
+            agent.stop()
