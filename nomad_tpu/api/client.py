@@ -1,0 +1,114 @@
+"""HTTP API client (ref api/ — the Go SDK's typed client surface)."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class ApiClient:
+    """ref api/api.go Client"""
+
+    def __init__(self, address: Optional[str] = None, namespace: str = "default"):
+        self.address = (
+            address
+            or os.environ.get("NOMAD_TPU_ADDR")
+            or "http://127.0.0.1:4646"
+        ).rstrip("/")
+        self.namespace = namespace
+
+    def _request(self, method: str, path: str, params=None, body=None):
+        url = self.address + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=330) as resp:
+                payload = json.loads(resp.read() or b"null")
+                index = resp.headers.get("X-Nomad-Index")
+                return payload, int(index) if index else None
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise APIError(e.code, message) from e
+
+    def get(self, path: str, **params):
+        return self._request("GET", path, params=params or None)
+
+    def put(self, path: str, body=None, **params):
+        return self._request("PUT", path, params=params or None, body=body)
+
+    def delete(self, path: str, **params):
+        return self._request("DELETE", path, params=params or None)
+
+    # -- typed helpers ---------------------------------------------------
+    def jobs(self, prefix: str = ""):
+        return self.get("/v1/jobs", **({"prefix": prefix} if prefix else {}))[0]
+
+    def register_job(self, job_dict: dict) -> dict:
+        return self.put("/v1/jobs", body={"Job": job_dict})[0]
+
+    def job(self, job_id: str) -> dict:
+        return self.get(f"/v1/job/{job_id}")[0]
+
+    def deregister_job(self, job_id: str, purge: bool = False) -> dict:
+        params = {"purge": "true"} if purge else {}
+        return self.delete(f"/v1/job/{job_id}", **params)[0]
+
+    def job_allocations(self, job_id: str):
+        return self.get(f"/v1/job/{job_id}/allocations")[0]
+
+    def job_evaluations(self, job_id: str):
+        return self.get(f"/v1/job/{job_id}/evaluations")[0]
+
+    def job_summary(self, job_id: str):
+        return self.get(f"/v1/job/{job_id}/summary")[0]
+
+    def nodes(self):
+        return self.get("/v1/nodes")[0]
+
+    def node(self, node_id: str):
+        return self.get(f"/v1/node/{node_id}")[0]
+
+    def node_allocations(self, node_id: str):
+        return self.get(f"/v1/node/{node_id}/allocations")[0]
+
+    def drain_node(self, node_id: str, enable: bool = True):
+        return self.put(
+            f"/v1/node/{node_id}/drain",
+            body={"DrainSpec": {} if enable else None},
+        )[0]
+
+    def allocations(self, prefix: str = ""):
+        return self.get(
+            "/v1/allocations", **({"prefix": prefix} if prefix else {})
+        )[0]
+
+    def allocation(self, alloc_id: str):
+        return self.get(f"/v1/allocation/{alloc_id}")[0]
+
+    def evaluations(self):
+        return self.get("/v1/evaluations")[0]
+
+    def evaluation(self, eval_id: str):
+        return self.get(f"/v1/evaluation/{eval_id}")[0]
+
+    def agent_self(self):
+        return self.get("/v1/agent/self")[0]
+
+    def metrics(self):
+        return self.get("/v1/metrics")[0]

@@ -1,0 +1,400 @@
+"""HTTP API server: the /v1/* surface (ref command/agent/http.go:150-222).
+
+Blocking queries are supported via ?index=N&wait=DUR on list endpoints, the
+same long-poll contract the reference exposes. JSON in/out; the model's
+canonical dict encoding is the wire format.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..jobspec.hcl import parse_duration
+from ..structs.model import Allocation, Job
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = []
+
+
+def route(method: str, pattern: str):
+    compiled = re.compile("^" + pattern + "$")
+
+    def deco(fn):
+        _ROUTES.append((method, compiled, fn.__name__))
+        return fn
+
+    return deco
+
+
+class HTTPServer:
+    """Wraps a Server (and optionally clients) with the HTTP surface."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 4646, agent=None):
+        self.server = server
+        self.agent = agent
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self, method):
+                parsed = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw = self.rfile.read(length)
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        body = raw.decode()
+                for m, pattern, name in _ROUTES:
+                    if m != method:
+                        continue
+                    match = pattern.match(parsed.path)
+                    if match:
+                        try:
+                            result, index = getattr(api, name)(
+                                match, query, body
+                            )
+                            self._respond(200, result, index)
+                        except KeyError as e:
+                            self._respond(404, {"error": str(e)}, None)
+                        except ValueError as e:
+                            self._respond(400, {"error": str(e)}, None)
+                        except Exception as e:
+                            self._respond(500, {"error": str(e)}, None)
+                        return
+                self._respond(404, {"error": f"no handler for {parsed.path}"}, None)
+
+            def _respond(self, code, payload, index):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_POST(self):
+                self._dispatch("PUT")  # POST == PUT (ref http.go)
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------
+    def _blocking(self, query, run):
+        """Shared blocking-query plumbing (?index=N&wait=D)."""
+        min_index = int(query.get("index", 0))
+        if min_index:
+            wait = parse_duration(query.get("wait", "5m")) / 1e9
+            result, index = self.server.state.blocking_query(
+                run, min_index=min_index, timeout=wait
+            )
+            return result, index
+        snap = self.server.state.snapshot()
+        return run(snap), snap.latest_index()
+
+    # -- jobs ----------------------------------------------------------
+    @route("GET", r"/v1/jobs")
+    def list_jobs(self, m, query, body):
+        prefix = query.get("prefix", "")
+
+        def run(snap):
+            return [
+                {
+                    "ID": j.id,
+                    "Name": j.name,
+                    "Type": j.type,
+                    "Priority": j.priority,
+                    "Status": j.status,
+                    "JobModifyIndex": j.job_modify_index,
+                }
+                for j in snap.jobs()
+                if j.id.startswith(prefix)
+            ]
+
+        return self._blocking(query, run)
+
+    @route("PUT", r"/v1/jobs")
+    def register_job(self, m, query, body):
+        if not isinstance(body, dict) or "Job" not in body:
+            raise ValueError("request must contain a Job")
+        job = Job.from_dict(body["Job"])
+        eval_id = self.server.job_register(job)
+        return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)")
+    def get_job(self, m, query, body):
+        def run(snap):
+            job = snap.job_by_id(query.get("namespace", "default"), m["job_id"])
+            if job is None:
+                raise KeyError(f"job not found: {m['job_id']}")
+            return job.to_dict()
+
+        return self._blocking(query, run)
+
+    @route("DELETE", r"/v1/job/(?P<job_id>[^/]+)")
+    def deregister_job(self, m, query, body):
+        purge = query.get("purge", "false") == "true"
+        eval_id = self.server.job_deregister(
+            query.get("namespace", "default"), m["job_id"], purge=purge
+        )
+        return {"EvalID": eval_id}, None
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/allocations")
+    def job_allocations(self, m, query, body):
+        def run(snap):
+            return [
+                _alloc_stub(a)
+                for a in snap.allocs_by_job(
+                    query.get("namespace", "default"), m["job_id"]
+                )
+            ]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/evaluations")
+    def job_evaluations(self, m, query, body):
+        def run(snap):
+            return [
+                e.to_dict()
+                for e in snap.evals_by_job(
+                    query.get("namespace", "default"), m["job_id"]
+                )
+            ]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/summary")
+    def job_summary(self, m, query, body):
+        def run(snap):
+            s = snap.job_summary_by_id(query.get("namespace", "default"), m["job_id"])
+            if s is None:
+                raise KeyError(f"job summary not found: {m['job_id']}")
+            return s.to_dict()
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/deployments")
+    def job_deployments(self, m, query, body):
+        def run(snap):
+            return [
+                d.to_dict()
+                for d in snap.deployments_by_job(
+                    query.get("namespace", "default"), m["job_id"]
+                )
+            ]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/versions")
+    def job_versions(self, m, query, body):
+        def run(snap):
+            return [
+                j.to_dict()
+                for j in snap.job_versions(
+                    query.get("namespace", "default"), m["job_id"]
+                )
+            ]
+
+        return self._blocking(query, run)
+
+    # -- nodes ----------------------------------------------------------
+    @route("GET", r"/v1/nodes")
+    def list_nodes(self, m, query, body):
+        def run(snap):
+            return [
+                {
+                    "ID": n.id,
+                    "Name": n.name,
+                    "Datacenter": n.datacenter,
+                    "NodeClass": n.node_class,
+                    "Status": n.status,
+                    "Drain": n.drain,
+                    "SchedulingEligibility": n.scheduling_eligibility,
+                }
+                for n in snap.nodes()
+            ]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/node/(?P<node_id>[^/]+)")
+    def get_node(self, m, query, body):
+        def run(snap):
+            node = snap.node_by_id(m["node_id"]) or next(
+                iter(snap.node_by_prefix(m["node_id"])), None
+            )
+            if node is None:
+                raise KeyError(f"node not found: {m['node_id']}")
+            return node.to_dict()
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/node/(?P<node_id>[^/]+)/allocations")
+    def node_allocations(self, m, query, body):
+        def run(snap):
+            return [_alloc_stub(a) for a in snap.allocs_by_node(m["node_id"])]
+
+        return self._blocking(query, run)
+
+    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/drain")
+    def node_drain(self, m, query, body):
+        enable = bool((body or {}).get("DrainSpec"))
+        self.server.node_drain(m["node_id"], enable)
+        return {"NodeModifyIndex": self.server.state.latest_index()}, None
+
+    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/eligibility")
+    def node_eligibility(self, m, query, body):
+        elig = (body or {}).get("Eligibility", "eligible")
+        self.server.node_update_eligibility(m["node_id"], elig)
+        return {"NodeModifyIndex": self.server.state.latest_index()}, None
+
+    # -- allocations -----------------------------------------------------
+    @route("GET", r"/v1/allocations")
+    def list_allocations(self, m, query, body):
+        prefix = query.get("prefix", "")
+
+        def run(snap):
+            return [
+                _alloc_stub(a) for a in snap.allocs() if a.id.startswith(prefix)
+            ]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/allocation/(?P<alloc_id>[^/]+)")
+    def get_allocation(self, m, query, body):
+        def run(snap):
+            alloc = snap.alloc_by_id(m["alloc_id"])
+            if alloc is None:
+                matches = [
+                    a for a in snap.allocs() if a.id.startswith(m["alloc_id"])
+                ]
+                alloc = matches[0] if len(matches) == 1 else None
+            if alloc is None:
+                raise KeyError(f"alloc not found: {m['alloc_id']}")
+            return alloc.to_dict()
+
+        return self._blocking(query, run)
+
+    # -- evaluations -----------------------------------------------------
+    @route("GET", r"/v1/evaluations")
+    def list_evaluations(self, m, query, body):
+        def run(snap):
+            return [e.to_dict() for e in snap.evals()]
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/evaluation/(?P<eval_id>[^/]+)")
+    def get_evaluation(self, m, query, body):
+        def run(snap):
+            ev = snap.eval_by_id(m["eval_id"])
+            if ev is None:
+                matches = [
+                    e for e in snap.evals() if e.id.startswith(m["eval_id"])
+                ]
+                ev = matches[0] if len(matches) == 1 else None
+            if ev is None:
+                raise KeyError(f"eval not found: {m['eval_id']}")
+            return ev.to_dict()
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/deployments")
+    def list_deployments(self, m, query, body):
+        def run(snap):
+            return [d.to_dict() for d in snap.deployments()]
+
+        return self._blocking(query, run)
+
+    # -- agent / status --------------------------------------------------
+    @route("GET", r"/v1/agent/self")
+    def agent_self(self, m, query, body):
+        clients = []
+        if self.agent is not None:
+            clients = [c.node.id for c in getattr(self.agent, "clients", [])]
+        return (
+            {
+                "config": {k: v for k, v in self.server.config.items()},
+                "stats": {
+                    "broker": self.server.eval_broker.stats(),
+                    "blocked_evals": self.server.blocked_evals.stats(),
+                },
+                "member": {"Name": "server-1", "Status": "alive"},
+                "clients": clients,
+            },
+            None,
+        )
+
+    @route("GET", r"/v1/status/leader")
+    def status_leader(self, m, query, body):
+        return f"{self.host}:{self.port}", None
+
+    @route("GET", r"/v1/metrics")
+    def metrics(self, m, query, body):
+        return (
+            {
+                "broker": self.server.eval_broker.stats(),
+                "blocked_evals": self.server.blocked_evals.stats(),
+                "plan_queue_depth": self.server.planner.queue.depth(),
+                "state_index": self.server.state.latest_index(),
+            },
+            None,
+        )
+
+    @route("GET", r"/v1/operator/scheduler/configuration")
+    def get_scheduler_config(self, m, query, body):
+        return self.server.state.scheduler_config() or {}, None
+
+    @route("PUT", r"/v1/operator/scheduler/configuration")
+    def set_scheduler_config(self, m, query, body):
+        self.server.state.set_scheduler_config(None, body or {})
+        return {"Updated": True}, None
+
+
+def _alloc_stub(a: Allocation) -> dict:
+    return {
+        "ID": a.id,
+        "Name": a.name,
+        "NodeID": a.node_id,
+        "JobID": a.job_id,
+        "TaskGroup": a.task_group,
+        "DesiredStatus": a.desired_status,
+        "ClientStatus": a.client_status,
+        "CreateIndex": a.create_index,
+        "ModifyIndex": a.modify_index,
+    }

@@ -1,0 +1,3 @@
+"""Command-line interface (ref command/)."""
+
+from .main import main

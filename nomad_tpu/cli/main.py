@@ -1,0 +1,338 @@
+"""Command-line interface (ref command/commands.go — the ~90-command mitchellh
+CLI tree; the operationally-core subset is implemented here, one subcommand
+family per reference command file)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+from ..api.client import APIError, ApiClient
+
+EXAMPLE_JOB = """\
+job "example" {
+  datacenters = ["dc1"]
+  type = "service"
+
+  group "cache" {
+    count = 1
+
+    restart {
+      attempts = 2
+      interval = "30m"
+      delay    = "15s"
+      mode     = "fail"
+    }
+
+    task "redis" {
+      driver = "mock_driver"
+
+      config {
+        run_for = "3600"
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}
+"""
+
+
+def _client(args) -> ApiClient:
+    return ApiClient(address=args.address)
+
+
+def cmd_agent(args):
+    """ref command/agent/command.go"""
+    from ..agent import DevAgent
+    from ..api.http import HTTPServer
+
+    if not args.dev:
+        print("only -dev mode is supported in this build", file=sys.stderr)
+        return 1
+    agent = DevAgent(num_clients=args.clients)
+    agent.start()
+    http = HTTPServer(
+        agent.server, host=args.bind, port=args.port, agent=agent
+    )
+    http.start()
+    print(f"==> nomad-tpu dev agent started: {http.address}")
+    print(f"    clients: {[c.node.id[:8] for c in agent.clients]}")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        print("==> shutting down")
+        http.stop()
+        agent.stop()
+    return 0
+
+
+def cmd_job_init(args):
+    path = args.filename or "example.nomad"
+    with open(path, "w") as f:
+        f.write(EXAMPLE_JOB)
+    print(f"Example job file written to {path}")
+    return 0
+
+
+def cmd_job_run(args):
+    from ..jobspec import parse_job
+
+    with open(args.jobfile) as f:
+        job = parse_job(f.read())
+    client = _client(args)
+    resp = client.register_job(job.to_dict())
+    eval_id = resp.get("EvalID", "")
+    print(f"==> Evaluation {eval_id[:8]} created")
+    if args.detach or not eval_id:
+        return 0
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ev = client.evaluation(eval_id)
+        if ev["status"] in ("complete", "failed", "canceled"):
+            print(f"==> Evaluation status: {ev['status']}")
+            if ev.get("failed_tg_allocs"):
+                for tg, metrics in ev["failed_tg_allocs"].items():
+                    print(f"    group {tg}: failed to place "
+                          f"({metrics.get('nodes_filtered', 0)} filtered, "
+                          f"{metrics.get('nodes_exhausted', 0)} exhausted)")
+                return 2
+            return 0
+        time.sleep(0.2)
+    print("==> Timed out waiting for evaluation")
+    return 1
+
+
+def cmd_job_status(args):
+    client = _client(args)
+    if not args.job_id:
+        jobs = client.jobs()
+        if not jobs:
+            print("No running jobs")
+            return 0
+        print(f"{'ID':<30} {'Type':<10} {'Priority':<9} Status")
+        for j in jobs:
+            print(f"{j['ID']:<30} {j['Type']:<10} {j['Priority']:<9} {j['Status']}")
+        return 0
+    job = client.job(args.job_id)
+    print(f"ID            = {job['id']}")
+    print(f"Name          = {job['name']}")
+    print(f"Type          = {job['type']}")
+    print(f"Priority      = {job['priority']}")
+    print(f"Datacenters   = {','.join(job['datacenters'])}")
+    print(f"Status        = {job['status']}")
+    try:
+        summary = client.job_summary(args.job_id)
+        print("\nSummary")
+        print(f"{'Task Group':<15} {'Queued':<7} {'Starting':<9} {'Running':<8} "
+              f"{'Failed':<7} {'Complete':<9} Lost")
+        for tg, s in summary["summary"].items():
+            print(f"{tg:<15} {s['queued']:<7} {s['starting']:<9} {s['running']:<8} "
+                  f"{s['failed']:<7} {s['complete']:<9} {s['lost']}")
+    except APIError:
+        pass
+    allocs = client.job_allocations(args.job_id)
+    if allocs:
+        print("\nAllocations")
+        print(f"{'ID':<10} {'Node ID':<10} {'Task Group':<12} {'Desired':<8} Status")
+        for a in allocs:
+            print(f"{a['ID'][:8]:<10} {a['NodeID'][:8]:<10} "
+                  f"{a['TaskGroup']:<12} {a['DesiredStatus']:<8} {a['ClientStatus']}")
+    return 0
+
+
+def cmd_job_stop(args):
+    client = _client(args)
+    resp = client.deregister_job(args.job_id, purge=args.purge)
+    print(f"==> Evaluation {resp.get('EvalID', '')[:8]} created")
+    return 0
+
+
+def cmd_node_status(args):
+    client = _client(args)
+    if not args.node_id:
+        nodes = client.nodes()
+        print(f"{'ID':<10} {'DC':<6} {'Name':<16} {'Class':<18} "
+              f"{'Drain':<6} {'Eligibility':<12} Status")
+        for n in nodes:
+            print(f"{n['ID'][:8]:<10} {n['Datacenter']:<6} {n['Name'][:15]:<16} "
+                  f"{(n['NodeClass'] or '<none>'):<18} {str(n['Drain']).lower():<6} "
+                  f"{n['SchedulingEligibility']:<12} {n['Status']}")
+        return 0
+    node = client.node(args.node_id)
+    print(f"ID          = {node['id']}")
+    print(f"Name        = {node['name']}")
+    print(f"Datacenter  = {node['datacenter']}")
+    print(f"Class       = {node['node_class'] or '<none>'}")
+    print(f"Status      = {node['status']}")
+    print(f"Drain       = {node['drain']}")
+    res = node.get("node_resources") or {}
+    if res:
+        print(f"Resources   = cpu {res['cpu']['cpu_shares']} MHz, "
+              f"mem {res['memory']['memory_mb']} MB, "
+              f"disk {res['disk']['disk_mb']} MB")
+    allocs = client.node_allocations(node["id"])
+    if allocs:
+        print("\nAllocations")
+        for a in allocs:
+            print(f"  {a['ID'][:8]} {a['JobID'][:24]:<26} "
+                  f"{a['DesiredStatus']:<8} {a['ClientStatus']}")
+    return 0
+
+
+def cmd_node_drain(args):
+    client = _client(args)
+    enable = not args.disable
+    client.drain_node(args.node_id, enable)
+    print(f"Node {args.node_id[:8]} drain {'enabled' if enable else 'disabled'}")
+    return 0
+
+
+def cmd_alloc_status(args):
+    client = _client(args)
+    alloc = client.allocation(args.alloc_id)
+    print(f"ID            = {alloc['id']}")
+    print(f"Name          = {alloc['name']}")
+    print(f"Node ID       = {alloc['node_id'][:8]}")
+    print(f"Job ID        = {alloc['job_id']}")
+    print(f"Desired       = {alloc['desired_status']}")
+    print(f"Client Status = {alloc['client_status']}")
+    states = alloc.get("task_states") or {}
+    for task, st in states.items():
+        print(f"\nTask \"{task}\": {st['state']}"
+              + (" (failed)" if st.get("failed") else ""))
+        print(f"  Restarts = {st.get('restarts', 0)}")
+    return 0
+
+
+def cmd_eval_status(args):
+    client = _client(args)
+    ev = client.evaluation(args.eval_id)
+    print(f"ID            = {ev['id']}")
+    print(f"Type          = {ev['type']}")
+    print(f"TriggeredBy   = {ev['triggered_by']}")
+    print(f"Job ID        = {ev['job_id']}")
+    print(f"Status        = {ev['status']}")
+    if ev.get("status_description"):
+        print(f"Description   = {ev['status_description']}")
+    return 0
+
+
+def cmd_server_members(args):
+    client = _client(args)
+    info = client.agent_self()
+    member = info["member"]
+    print(f"{'Name':<12} Status")
+    print(f"{member['Name']:<12} {member['Status']}")
+    return 0
+
+
+def cmd_agent_info(args):
+    client = _client(args)
+    print(json.dumps(client.agent_self(), indent=2))
+    return 0
+
+
+def cmd_version(args):
+    from .. import __version__
+
+    print(f"nomad-tpu v{__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    p.add_argument("-address", default=None, help="agent HTTP address")
+    sub = p.add_subparsers(dest="command")
+
+    agent = sub.add_parser("agent", help="run the agent")
+    agent.add_argument("-dev", action="store_true")
+    agent.add_argument("-bind", default="127.0.0.1")
+    agent.add_argument("-port", type=int, default=4646)
+    agent.add_argument("-clients", type=int, default=1)
+    agent.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands")
+    jsub = job.add_subparsers(dest="subcommand")
+    jr = jsub.add_parser("run")
+    jr.add_argument("jobfile")
+    jr.add_argument("-detach", action="store_true")
+    jr.set_defaults(fn=cmd_job_run)
+    js = jsub.add_parser("status")
+    js.add_argument("job_id", nargs="?")
+    js.set_defaults(fn=cmd_job_status)
+    jst = jsub.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-purge", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    ji = jsub.add_parser("init")
+    ji.add_argument("filename", nargs="?")
+    ji.set_defaults(fn=cmd_job_init)
+
+    node = sub.add_parser("node", help="node commands")
+    nsub = node.add_subparsers(dest="subcommand")
+    ns = nsub.add_parser("status")
+    ns.add_argument("node_id", nargs="?")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = nsub.add_parser("drain")
+    nd.add_argument("node_id")
+    nd.add_argument("-disable", action="store_true")
+    nd.set_defaults(fn=cmd_node_drain)
+
+    alloc = sub.add_parser("alloc", help="allocation commands")
+    asub = alloc.add_subparsers(dest="subcommand")
+    ast = asub.add_parser("status")
+    ast.add_argument("alloc_id")
+    ast.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval", help="evaluation commands")
+    esub = ev.add_subparsers(dest="subcommand")
+    est = esub.add_parser("status")
+    est.add_argument("eval_id")
+    est.set_defaults(fn=cmd_eval_status)
+
+    server = sub.add_parser("server", help="server commands")
+    ssub = server.add_subparsers(dest="subcommand")
+    sm = ssub.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
+
+    ai = sub.add_parser("agent-info")
+    ai.set_defaults(fn=cmd_agent_info)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    fn = getattr(args, "fn", None)
+    if fn is None:
+        parser.print_help()
+        return 1
+    try:
+        return fn(args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # jobspec parse errors, connection refused, ...
+        print(f"Error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

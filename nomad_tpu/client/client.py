@@ -24,6 +24,7 @@ from ..structs.model import (
     ALLOC_CLIENT_STATUS_RUNNING,
     Allocation,
     DriverInfo,
+    NetworkResource,
     Node,
     NodeCpuResources,
     NodeDiskResources,
@@ -73,6 +74,12 @@ class TaskRunner:
                     self.task, self.alloc_runner.task_dir(self.task.name)
                 )
             except Exception as e:
+                # Start failures route through the restart policy like any
+                # other failure (ref taskrunner restart tracker)
+                if restart_policy is not None and self._restart_or_wait(
+                    restart_policy
+                ):
+                    continue
                 self.state = TaskState(
                     state="dead", failed=True, finished_at=now_ns()
                 )
@@ -108,17 +115,11 @@ class TaskRunner:
                 return
 
             # Restart policy (ref client/allocrunner/taskrunner/restarts/)
-            if restart_policy is not None and self._should_restart(restart_policy):
+            if restart_policy is not None and self._restart_or_wait(restart_policy):
                 self.state = TaskState(
                     state="pending", restarts=self.state.restarts + 1
                 )
                 self.alloc_runner.task_state_updated()
-                delay = (restart_policy.delay or 0) / 1e9
-                cap = self.alloc_runner.client.max_restart_delay
-                if cap is not None:
-                    delay = min(delay, cap)
-                if self._stop.wait(delay):
-                    return
                 continue
 
             self.state = TaskState(
@@ -130,7 +131,11 @@ class TaskRunner:
             self.alloc_runner.task_state_updated()
             return
 
-    def _should_restart(self, policy) -> bool:
+    def _restart_or_wait(self, policy) -> bool:
+        """Decide whether to restart and sleep out the backoff. In 'delay'
+        mode with the budget exhausted, wait until the oldest attempt ages out
+        of the interval before restarting (ref restarts/restarts.go);
+        returns False when the task should fail permanently."""
         if policy.mode not in ("delay", "fail"):
             return False
         now = time.monotonic()
@@ -141,10 +146,18 @@ class TaskRunner:
             self._restarts_in_interval = [
                 t for t in self._restarts_in_interval if now - t < interval_s
             ]
+        wait = (policy.delay or 0) / 1e9
         if len(self._restarts_in_interval) >= policy.attempts:
-            return policy.mode == "delay"
+            if policy.mode != "delay":
+                return False
+            # throttle: restart only once the interval budget frees up
+            oldest = min(self._restarts_in_interval, default=now)
+            wait = max(wait, (oldest + interval_s) - now)
         self._restarts_in_interval.append(now)
-        return True
+        cap = self.alloc_runner.client.max_restart_delay
+        if cap is not None:
+            wait = min(wait, cap)
+        return not self._stop.wait(max(wait, 0))
 
     def stop(self):
         self._stop.set()
@@ -174,20 +187,24 @@ class AllocRunner:
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         if tg is None:
             return
+        # Fully populate the runner map before starting any task thread:
+        # task threads iterate it from task_state_updated()
+        missing_driver = []
         for task in tg.tasks:
             driver = self.client.drivers.get(task.driver)
+            tr = TaskRunner(self, task, driver)
             if driver is None:
-                tr = TaskRunner(self, task, None)
                 tr.state = TaskState(state="dead", failed=True, finished_at=now_ns())
                 tr.state.events.append(
                     {"type": "Driver Failure", "message": f"unknown driver {task.driver}"}
                 )
-                self.task_runners[task.name] = tr
-                self.task_state_updated()
-                continue
-            tr = TaskRunner(self, task, driver)
+                missing_driver.append(tr)
             self.task_runners[task.name] = tr
-            tr.start()
+        for tr in self.task_runners.values():
+            if tr.driver is not None:
+                tr.start()
+        if missing_driver:
+            self.task_state_updated()
 
     def client_status(self) -> str:
         """Aggregate task states into the alloc's client status
@@ -269,6 +286,16 @@ class Client:
                 cpu=NodeCpuResources(cpu_shares=cpu_count * 1000),
                 memory=NodeMemoryResources(memory_mb=8192),
                 disk=NodeDiskResources(disk_mb=20 * 1024),
+                # network fingerprint (ref client/fingerprint/network.go):
+                # loopback with a nominal gbit link for port allocation
+                networks=[
+                    NetworkResource(
+                        device="lo",
+                        cidr="127.0.0.1/32",
+                        ip="127.0.0.1",
+                        mbits=1000,
+                    )
+                ],
             ),
             status="initializing",
         )
@@ -342,6 +369,18 @@ class Client:
                 runner.run()
             else:
                 runner.update(alloc)
+        # GC: destroy runners for allocs removed server-side (job purge /
+        # alloc GC) and drop terminal runners (ref client.go removeAlloc)
+        for alloc_id in list(self.alloc_runners):
+            runner = self.alloc_runners[alloc_id]
+            if alloc_id not in desired:
+                runner.destroy()
+                del self.alloc_runners[alloc_id]
+            elif runner._destroyed and runner.client_status() in (
+                "complete",
+                "failed",
+            ):
+                del self.alloc_runners[alloc_id]
 
     # ------------------------------------------------------------------
     def alloc_state_updated(self, runner: AllocRunner):

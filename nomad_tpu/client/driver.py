@@ -97,9 +97,15 @@ class MockDriver(Driver):
         if run_for <= 0:
             handle.finish(exit_code)
         else:
-            t = threading.Timer(run_for, handle.finish, args=(exit_code,))
+            key = id(handle)
+
+            def _finish():
+                self._timers.pop(key, None)
+                handle.finish(exit_code)
+
+            t = threading.Timer(run_for, _finish)
             t.daemon = True
-            self._timers[id(handle)] = t
+            self._timers[key] = t
             t.start()
         return handle
 

@@ -62,6 +62,9 @@ class PlanQueue:
         with self._lock:
             self.enabled = enabled
             if not enabled:
+                # fail queued plans so submitting workers unblock immediately
+                for _, _, pending in self._heap:
+                    pending.respond(None, RuntimeError("plan queue is disabled"))
                 self._heap = []
             self._cond.notify_all()
 

@@ -108,7 +108,14 @@ class Server:
         from .broker import FAILED_QUEUE
 
         follow_up_wait = self.config.get("failed_eval_followup_wait", 60.0)
+        unblock_interval = self.config.get("failed_eval_unblock_interval", 60.0)
+        last_unblock = time.monotonic()
         while self._running:
+            # periodically retry max-plan-attempt blocked evals
+            # (ref leader.go:588 periodicUnblockFailedEvals)
+            if time.monotonic() - last_unblock >= unblock_interval:
+                last_unblock = time.monotonic()
+                self.blocked_evals.unblock_failed()
             ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.5)
             if ev is None:
                 continue

@@ -1,0 +1,261 @@
+"""Jobspec, HTTP API, and CLI tests (ref jobspec/parse_test.go,
+command/agent/*_endpoint_test.go)."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu.jobspec import parse_hcl, parse_job, parse_duration
+from nomad_tpu.jobspec.hcl import HCLError
+
+
+class TestHCL:
+    def test_basic_types(self):
+        out = parse_hcl(
+            """
+            str = "hello"
+            num = 42
+            fl = 1.5
+            yes = true
+            no = false
+            list = ["a", "b"]
+            obj { k = "v" }
+            """
+        )
+        assert out == {
+            "str": "hello",
+            "num": 42,
+            "fl": 1.5,
+            "yes": True,
+            "no": False,
+            "list": ["a", "b"],
+            "obj": {"k": "v"},
+        }
+
+    def test_labeled_blocks_nest(self):
+        out = parse_hcl('job "a" { group "g" { count = 2 } }')
+        assert out == {"job": {"a": {"group": {"g": {"count": 2}}}}}
+
+    def test_repeated_blocks_become_lists(self):
+        out = parse_hcl(
+            """
+            constraint { attribute = "x" }
+            constraint { attribute = "y" }
+            """
+        )
+        assert [c["attribute"] for c in out["constraint"]] == ["x", "y"]
+
+    def test_comments_and_escapes(self):
+        out = parse_hcl(
+            """
+            # comment
+            // also comment
+            /* block
+               comment */
+            v = "a\\"b\\nc"
+            """
+        )
+        assert out["v"] == 'a"b\nc'
+
+    def test_error_on_garbage(self):
+        with pytest.raises(HCLError):
+            parse_hcl("key = = =")
+
+    def test_durations(self):
+        assert parse_duration("30s") == 30 * 10**9
+        assert parse_duration("10m") == 600 * 10**9
+        assert parse_duration("1h30m") == 5400 * 10**9
+        assert parse_duration("250ms") == 250 * 10**6
+        with pytest.raises(HCLError):
+            parse_duration("abc")
+
+
+class TestJobspec:
+    SPEC = """
+    job "web" {
+      datacenters = ["dc1", "dc2"]
+      type = "service"
+      priority = 70
+
+      constraint {
+        attribute = "${attr.kernel.name}"
+        value = "linux"
+      }
+
+      group "frontend" {
+        count = 3
+        spread {
+          attribute = "${node.datacenter}"
+          weight = 100
+          target "dc1" { percent = 60 }
+          target "dc2" { percent = 40 }
+        }
+        task "nginx" {
+          driver = "mock_driver"
+          config { run_for = "10" }
+          resources {
+            cpu = 200
+            memory = 128
+            network {
+              mbits = 5
+              port "http" {}
+            }
+          }
+        }
+      }
+    }
+    """
+
+    def test_parse(self):
+        job = parse_job(self.SPEC)
+        assert job.id == "web" and job.priority == 70
+        assert job.datacenters == ["dc1", "dc2"]
+        assert job.constraints[0].r_target == "linux"
+        tg = job.task_groups[0]
+        assert tg.count == 3
+        assert tg.spreads[0].spread_target[1].percent == 40
+        assert tg.tasks[0].resources.networks[0].dynamic_ports[0].label == "http"
+
+    def test_parse_and_schedule(self):
+        # parsed jobs flow through the scheduler unmodified
+        from nomad_tpu import mock
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.structs.model import Evaluation, generate_uuid
+
+        job = parse_job(self.SPEC)
+        # strip ports so the fast path handles it; constraint/spread kept
+        job.task_groups[0].tasks[0].resources.networks = []
+        h = Harness(seed=1)
+        for i in range(4):
+            n = mock.node()
+            n.datacenter = "dc1" if i % 2 == 0 else "dc2"
+            h.state.upsert_node(h.next_index(), n)
+        h.state.upsert_job(h.next_index(), job)
+        ev = Evaluation(
+            id=generate_uuid(), namespace=job.namespace, priority=job.priority,
+            type="service", triggered_by="job-register", job_id=job.id,
+            status="pending",
+        )
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("service", ev)
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 3
+
+    def test_multiple_jobs_rejected(self):
+        with pytest.raises(HCLError):
+            parse_job('job "a" {}\njob "b" {}')
+
+
+@pytest.fixture(scope="module")
+def http_cluster():
+    from nomad_tpu.agent import DevAgent
+    from nomad_tpu.api import ApiClient, HTTPServer
+
+    agent = DevAgent(num_clients=1, server_config={"seed": 3})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    client = ApiClient(address=http.address)
+    yield agent, http, client
+    http.stop()
+    agent.stop()
+
+
+class TestHTTPAPI:
+    def test_register_and_query_job(self, http_cluster):
+        agent, http, client = http_cluster
+        job = parse_job(TestJobspec.SPEC)
+        job.datacenters = ["dc1"]
+        resp = client.register_job(job.to_dict())
+        assert resp["EvalID"]
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ev = client.evaluation(resp["EvalID"])
+            if ev["status"] == "complete":
+                break
+            time.sleep(0.1)
+        assert ev["status"] == "complete"
+
+        jobs = client.jobs()
+        assert any(j["ID"] == "web" for j in jobs)
+        got = client.job("web")
+        assert got["priority"] == 70
+        allocs = client.job_allocations("web")
+        assert len(allocs) == 3
+        summary = client.job_summary("web")
+        assert "frontend" in summary["summary"]
+
+    def test_nodes_and_allocs(self, http_cluster):
+        agent, http, client = http_cluster
+        nodes = client.nodes()
+        assert len(nodes) == 1
+        node = client.node(nodes[0]["ID"][:8])  # prefix lookup
+        assert node["status"] == "ready"
+        allocs = client.allocations()
+        if allocs:
+            alloc = client.allocation(allocs[0]["ID"])
+            assert alloc["id"] == allocs[0]["ID"]
+
+    def test_404(self, http_cluster):
+        from nomad_tpu.api import APIError
+
+        _, _, client = http_cluster
+        with pytest.raises(APIError) as e:
+            client.job("nonexistent")
+        assert e.value.status == 404
+
+    def test_metrics_and_agent_self(self, http_cluster):
+        _, _, client = http_cluster
+        m = client.metrics()
+        assert "broker" in m and "state_index" in m
+        info = client.agent_self()
+        assert info["member"]["Status"] == "alive"
+
+    def test_blocking_query_wakes(self, http_cluster):
+        import threading
+
+        agent, http, client = http_cluster
+        idx = client.get("/v1/jobs")[1]
+        results = []
+
+        def blocked():
+            jobs, new_idx = client.get("/v1/jobs", index=idx, wait="10s")
+            results.append(new_idx)
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.2)
+        job = parse_job(TestJobspec.SPEC)
+        job.id = job.name = "wakeup-job"
+        job.task_groups[0].count = 0
+        client.register_job(job.to_dict())
+        t.join(timeout=12)
+        assert results and results[0] > idx
+
+
+class TestCLI:
+    def test_cli_against_http(self, http_cluster, capsys, tmp_path):
+        from nomad_tpu.cli.main import main
+
+        agent, http, client = http_cluster
+        addr = ["-address", http.address]
+
+        assert main(addr + ["job", "status"]) == 0
+        out = capsys.readouterr().out
+        assert "web" in out
+
+        assert main(addr + ["node", "status"]) == 0
+        out = capsys.readouterr().out
+        assert "ready" in out
+
+        spec = tmp_path / "test.nomad"
+        assert main(["job", "init", str(spec)]) == 0
+        capsys.readouterr()
+        assert main(addr + ["job", "run", "-detach", str(spec)]) == 0
+        out = capsys.readouterr().out
+        assert "Evaluation" in out
+
+        assert main(addr + ["job", "stop", "example"]) == 0
+        capsys.readouterr()
+        assert main(addr + ["version"]) == 0
