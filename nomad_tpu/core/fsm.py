@@ -1,0 +1,404 @@
+"""Replicated finite-state machine: applies typed log entries into the
+state store (ref nomad/fsm.go:173-1073).
+
+The reference's raft FSM dispatches 31 log message types into the
+StateStore and — on the leader, where the eval broker / blocked-evals /
+periodic dispatcher are enabled — re-enqueues applied evaluations into the
+in-memory brokers (fsm.go:190-252 switch, :1059 Snapshot, :1073 Restore).
+This FSM keeps the same shape: every server (leader or follower) applies
+the identical log; broker side effects are no-ops on followers because the
+brokers are disabled there (eval_broker.go enqueue guards).
+
+All writes in the framework flow through here: the server endpoints build
+plain-dict payloads, consensus orders them, and `FSM.apply` mutates state
+at the entry's log index, so the state-store index equals the raft index —
+the invariant blocking queries and SnapshotMinIndex rely on.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional
+
+from ..state.store import StateStore
+from ..structs.model import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    Allocation,
+    Deployment,
+    DeploymentStatusUpdate,
+    Evaluation,
+    Job,
+    JobSummary,
+    Node,
+    Plan,
+    PlanResult,
+)
+
+logger = logging.getLogger("nomad_tpu.fsm")
+
+# Log message types (ref fsm.go:190-252 / structs.go MessageType consts)
+NODE_REGISTER = "node_register"
+NODE_DEREGISTER = "node_deregister"
+NODE_STATUS_UPDATE = "node_status_update"
+NODE_DRAIN_UPDATE = "node_drain_update"
+NODE_ELIGIBILITY_UPDATE = "node_eligibility_update"
+JOB_REGISTER = "job_register"
+JOB_DEREGISTER = "job_deregister"
+JOB_BATCH_DEREGISTER = "job_batch_deregister"
+JOB_STABILITY = "job_stability"
+EVAL_UPDATE = "eval_update"
+EVAL_DELETE = "eval_delete"
+ALLOC_UPDATE = "alloc_update"
+ALLOC_CLIENT_UPDATE = "alloc_client_update"
+ALLOC_DESIRED_TRANSITION = "alloc_desired_transition"
+APPLY_PLAN_RESULTS = "apply_plan_results"
+DEPLOYMENT_STATUS_UPDATE = "deployment_status_update"
+DEPLOYMENT_PROMOTE = "deployment_promote"
+DEPLOYMENT_ALLOC_HEALTH = "deployment_alloc_health"
+DEPLOYMENT_DELETE = "deployment_delete"
+PERIODIC_LAUNCH = "periodic_launch"
+SCHEDULER_CONFIG = "scheduler_config"
+ACL_POLICY_UPSERT = "acl_policy_upsert"
+ACL_POLICY_DELETE = "acl_policy_delete"
+ACL_TOKEN_UPSERT = "acl_token_upsert"
+ACL_TOKEN_DELETE = "acl_token_delete"
+NOOP = "noop"
+
+
+class FSM:
+    """Applies ordered log entries into a StateStore, with leader-side
+    broker re-enqueue hooks (ref fsm.go nomadFSM)."""
+
+    def __init__(
+        self,
+        state: Optional[StateStore] = None,
+        eval_broker=None,
+        blocked_evals=None,
+        periodic_dispatcher=None,
+    ):
+        self.state = state if state is not None else StateStore()
+        self.eval_broker = eval_broker
+        self.blocked_evals = blocked_evals
+        self.periodic_dispatcher = periodic_dispatcher
+        self._appliers: dict[str, Callable[[int, dict], Any]] = {
+            NODE_REGISTER: self._apply_node_register,
+            NODE_DEREGISTER: self._apply_node_deregister,
+            NODE_STATUS_UPDATE: self._apply_node_status_update,
+            NODE_DRAIN_UPDATE: self._apply_node_drain_update,
+            NODE_ELIGIBILITY_UPDATE: self._apply_node_eligibility_update,
+            JOB_REGISTER: self._apply_job_register,
+            JOB_DEREGISTER: self._apply_job_deregister,
+            JOB_BATCH_DEREGISTER: self._apply_job_batch_deregister,
+            JOB_STABILITY: self._apply_job_stability,
+            EVAL_UPDATE: self._apply_eval_update,
+            EVAL_DELETE: self._apply_eval_delete,
+            ALLOC_UPDATE: self._apply_alloc_update,
+            ALLOC_CLIENT_UPDATE: self._apply_alloc_client_update,
+            ALLOC_DESIRED_TRANSITION: self._apply_alloc_desired_transition,
+            APPLY_PLAN_RESULTS: self._apply_plan_results,
+            DEPLOYMENT_STATUS_UPDATE: self._apply_deployment_status_update,
+            DEPLOYMENT_PROMOTE: self._apply_deployment_promote,
+            DEPLOYMENT_ALLOC_HEALTH: self._apply_deployment_alloc_health,
+            DEPLOYMENT_DELETE: self._apply_deployment_delete,
+            PERIODIC_LAUNCH: self._apply_periodic_launch,
+            SCHEDULER_CONFIG: self._apply_scheduler_config,
+            ACL_POLICY_UPSERT: self._apply_acl_policy_upsert,
+            ACL_POLICY_DELETE: self._apply_acl_policy_delete,
+            ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
+            ACL_TOKEN_DELETE: self._apply_acl_token_delete,
+            NOOP: lambda index, payload: None,
+        }
+
+    # ------------------------------------------------------------------
+    def apply(self, index: int, msg_type: str, payload: dict) -> Any:
+        """Apply one committed log entry. Returns the applier's response
+        (surfaced to the caller that proposed the entry)."""
+        applier = self._appliers.get(msg_type)
+        if applier is None:
+            # Unknown types must not crash replication (fsm.go ignores
+            # ignoreUnknownTypeFlag entries); log and skip.
+            logger.error("fsm: unknown message type %r at index %d", msg_type, index)
+            return None
+        return applier(index, payload)
+
+    # ------------------------------------------------------------------
+    # snapshot / restore (ref fsm.go:1059,1073)
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return self.state.persist()
+
+    def restore(self, data: dict):
+        self.state.restore(data)
+
+    # ------------------------------------------------------------------
+    # node appliers (ref fsm.go applyUpsertNode / applyDeregisterNode /
+    # applyStatusUpdate / applyDrainUpdate / applyEligibilityUpdate)
+    # ------------------------------------------------------------------
+    def _apply_node_register(self, index: int, payload: dict):
+        node = Node.from_dict(payload["node"])
+        self.state.upsert_node(index, node)
+        # new capacity unblocks class-matching blocked evals
+        if self.blocked_evals is not None and node.computed_class:
+            self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    def _apply_node_deregister(self, index: int, payload: dict):
+        self.state.delete_node(index, payload["node_id"])
+        return index
+
+    def _apply_node_status_update(self, index: int, payload: dict):
+        self.state.update_node_status(
+            index,
+            payload["node_id"],
+            payload["status"],
+            updated_at_ns=payload.get("updated_at", 0),
+        )
+        if self.blocked_evals is not None and payload["status"] == "ready":
+            node = self.state.node_by_id(payload["node_id"])
+            if node is not None and node.computed_class:
+                self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    def _apply_node_drain_update(self, index: int, payload: dict):
+        self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+        return index
+
+    def _apply_node_eligibility_update(self, index: int, payload: dict):
+        self.state.update_node_eligibility(
+            index, payload["node_id"], payload["eligibility"]
+        )
+        return index
+
+    # ------------------------------------------------------------------
+    # job appliers (ref fsm.go applyUpsertJob / applyDeregisterJob)
+    # ------------------------------------------------------------------
+    def _apply_job_register(self, index: int, payload: dict):
+        job = Job.from_dict(payload["job"])
+        self.state.upsert_job(index, job)
+        stored = self.state.job_by_id(job.namespace, job.id)
+        if self.periodic_dispatcher is not None:
+            # leader tracks periodic jobs as they are applied (fsm.go:330)
+            if stored.is_periodic() and not stored.stopped():
+                self.periodic_dispatcher.add(stored)
+            else:
+                self.periodic_dispatcher.remove(stored.namespace, stored.id)
+        return index
+
+    def _apply_job_deregister(self, index: int, payload: dict):
+        ns, job_id = payload["namespace"], payload["job_id"]
+        if payload.get("purge"):
+            self.state.delete_job(index, ns, job_id)
+        else:
+            job = self.state.job_by_id(ns, job_id)
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.state.upsert_job(index, stopped)
+        if self.periodic_dispatcher is not None:
+            self.periodic_dispatcher.remove(ns, job_id)
+        if self.blocked_evals is not None:
+            self.blocked_evals.untrack(ns, job_id)
+        return index
+
+    def _apply_job_batch_deregister(self, index: int, payload: dict):
+        for item in payload["jobs"]:
+            self._apply_job_deregister(
+                index,
+                {
+                    "namespace": item["namespace"],
+                    "job_id": item["job_id"],
+                    "purge": item.get("purge", False),
+                },
+            )
+        self._apply_eval_update(index, {"evals": payload.get("evals", [])})
+        return index
+
+    def _apply_job_stability(self, index: int, payload: dict):
+        self.state.update_job_stability(
+            index,
+            payload["namespace"],
+            payload["job_id"],
+            payload["version"],
+            payload["stable"],
+        )
+        return index
+
+    # ------------------------------------------------------------------
+    # eval appliers (ref fsm.go applyUpdateEval:560-620)
+    # ------------------------------------------------------------------
+    def _apply_eval_update(self, index: int, payload: dict):
+        evals = [Evaluation.from_dict(d) for d in payload["evals"]]
+        if not evals:
+            return index
+        self.state.upsert_evals(index, evals)
+        self._handle_upserted_evals(evals)
+        return index
+
+    def _handle_upserted_evals(self, evals: list[Evaluation]):
+        """Leader-side broker routing of applied evals (fsm.go:585-618):
+        pending → broker, blocked → blocked-tracker, others untracked."""
+        for ev in evals:
+            stored = self.state.eval_by_id(ev.id)
+            if stored is None:
+                continue
+            if stored.should_enqueue():
+                if self.eval_broker is not None:
+                    self.eval_broker.enqueue(stored)
+            elif stored.should_block():
+                if self.blocked_evals is not None:
+                    self.blocked_evals.block(stored)
+            elif (
+                self.blocked_evals is not None
+                and stored.status == "complete"
+                and not stored.failed_tg_allocs
+            ):
+                # fully-satisfied eval: drop any tracked blocked eval for
+                # the job (fsm.go:612-617)
+                self.blocked_evals.untrack(stored.namespace, stored.job_id)
+
+    def _apply_eval_delete(self, index: int, payload: dict):
+        self.state.delete_evals(
+            index, payload.get("eval_ids", []), payload.get("alloc_ids", [])
+        )
+        return index
+
+    # ------------------------------------------------------------------
+    # alloc appliers (ref fsm.go applyAllocUpdate / applyAllocClientUpdate /
+    # applyAllocUpdateDesiredTransition)
+    # ------------------------------------------------------------------
+    def _apply_alloc_update(self, index: int, payload: dict):
+        allocs = [Allocation.from_dict(d) for d in payload["allocs"]]
+        self.state.upsert_allocs(index, allocs)
+        return index
+
+    def _apply_alloc_client_update(self, index: int, payload: dict):
+        allocs = [Allocation.from_dict(d) for d in payload["allocs"]]
+        self.state.update_allocs_from_client(index, allocs)
+        # evals created by the endpoint ride the same log entry
+        # (ref node_endpoint.go UpdateAlloc → AllocUpdateRequest.Evals)
+        self._apply_eval_update(index, {"evals": payload.get("evals", [])})
+        return index
+
+    def _apply_alloc_desired_transition(self, index: int, payload: dict):
+        updates = []
+        for alloc_id, transition in payload["allocs"].items():
+            stored = self.state.alloc_by_id(alloc_id)
+            if stored is None:
+                continue
+            ac = stored.copy()
+            if transition.get("migrate") is not None:
+                ac.desired_transition.migrate = transition["migrate"]
+            if transition.get("reschedule") is not None:
+                ac.desired_transition.reschedule = transition["reschedule"]
+            if transition.get("force_reschedule") is not None:
+                ac.desired_transition.force_reschedule = transition["force_reschedule"]
+            updates.append(ac)
+        if updates:
+            self.state.upsert_allocs(index, updates)
+        self._apply_eval_update(index, {"evals": payload.get("evals", [])})
+        return index
+
+    # ------------------------------------------------------------------
+    # plan apply (ref fsm.go applyPlanResults → UpsertPlanResults)
+    # ------------------------------------------------------------------
+    def _apply_plan_results(self, index: int, payload: dict):
+        plan = Plan.from_dict(payload["plan"])
+        result = PlanResult.from_dict(payload["result"])
+        preemption_evals = [
+            Evaluation.from_dict(d) for d in payload.get("preemption_evals", [])
+        ]
+        self.state.upsert_plan_results(
+            index, plan, result, preemption_evals=preemption_evals
+        )
+        self._handle_upserted_evals(preemption_evals)
+        return index
+
+    # ------------------------------------------------------------------
+    # deployment appliers (ref fsm.go applyDeployment*)
+    # ------------------------------------------------------------------
+    def _apply_deployment_status_update(self, index: int, payload: dict):
+        update = DeploymentStatusUpdate.from_dict(payload["update"])
+        self.state.update_deployment_status(index, update)
+        if payload.get("job") is not None:
+            self.state.upsert_job(index, Job.from_dict(payload["job"]))
+        self._apply_eval_update(
+            index,
+            {"evals": [payload["eval"]] if payload.get("eval") else []},
+        )
+        return index
+
+    def _apply_deployment_promote(self, index: int, payload: dict):
+        self.state.update_deployment_promotion(
+            index,
+            payload["deployment_id"],
+            payload.get("groups", []),
+            payload.get("all", False),
+        )
+        self._apply_eval_update(
+            index,
+            {"evals": [payload["eval"]] if payload.get("eval") else []},
+        )
+        return index
+
+    def _apply_deployment_alloc_health(self, index: int, payload: dict):
+        self.state.update_deployment_alloc_health(
+            index,
+            payload["deployment_id"],
+            payload.get("healthy_ids", []),
+            payload.get("unhealthy_ids", []),
+            timestamp_ns=payload.get("timestamp", 0),
+        )
+        if payload.get("deployment_status_update") is not None:
+            self.state.update_deployment_status(
+                index,
+                DeploymentStatusUpdate.from_dict(
+                    payload["deployment_status_update"]
+                ),
+            )
+        if payload.get("job") is not None:
+            self.state.upsert_job(index, Job.from_dict(payload["job"]))
+        self._apply_eval_update(
+            index,
+            {"evals": [payload["eval"]] if payload.get("eval") else []},
+        )
+        return index
+
+    def _apply_deployment_delete(self, index: int, payload: dict):
+        self.state.delete_deployment(index, payload["deployment_ids"])
+        return index
+
+    # ------------------------------------------------------------------
+    def _apply_periodic_launch(self, index: int, payload: dict):
+        self.state.upsert_periodic_launch(
+            index, payload["namespace"], payload["job_id"], payload["launch"]
+        )
+        return index
+
+    def _apply_scheduler_config(self, index: int, payload: dict):
+        self.state.set_scheduler_config(index, payload["config"])
+        return index
+
+    # ------------------------------------------------------------------
+    # ACL appliers (ref fsm.go applyACL*; store methods land with the ACL
+    # subsystem — gated so replication of ACL entries never crashes)
+    # ------------------------------------------------------------------
+    def _apply_acl_policy_upsert(self, index: int, payload: dict):
+        if hasattr(self.state, "upsert_acl_policies"):
+            self.state.upsert_acl_policies(index, payload["policies"])
+        return index
+
+    def _apply_acl_policy_delete(self, index: int, payload: dict):
+        if hasattr(self.state, "delete_acl_policies"):
+            self.state.delete_acl_policies(index, payload["names"])
+        return index
+
+    def _apply_acl_token_upsert(self, index: int, payload: dict):
+        if hasattr(self.state, "upsert_acl_tokens"):
+            self.state.upsert_acl_tokens(index, payload["tokens"])
+        return index
+
+    def _apply_acl_token_delete(self, index: int, payload: dict):
+        if hasattr(self.state, "delete_acl_tokens"):
+            self.state.delete_acl_tokens(index, payload["accessors"])
+        return index

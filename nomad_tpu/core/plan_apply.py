@@ -183,6 +183,11 @@ class Planner:
         self._thread: Optional[threading.Thread] = None
         self.preemption_evals_fn = None  # hook: build follow-up evals for preempted allocs
         self.on_preemption_evals = None  # hook: enqueue them after commit
+        # consensus commit hook: (plan, result, preemption_evals) -> index.
+        # When set (server wiring), the verified result is replicated via
+        # raft ApplyPlanResults instead of written directly (plan_apply.go
+        # applyPlan → raftApplyFuture).
+        self.commit_fn = None
 
     def start(self):
         self.queue.set_enabled(True)
@@ -217,12 +222,15 @@ class Planner:
         preemption_evals: list[Evaluation] = []
         if self.preemption_evals_fn is not None and result.node_preemptions:
             preemption_evals = self.preemption_evals_fn(result)
-        index = self.state.upsert_plan_results(
-            None, plan, result, preemption_evals=preemption_evals
-        )
-        result.alloc_index = index
-        if preemption_evals and self.on_preemption_evals is not None:
-            self.on_preemption_evals(
-                [self.state.eval_by_id(e.id) for e in preemption_evals]
+        if self.commit_fn is not None:
+            index = self.commit_fn(plan, result, preemption_evals)
+        else:
+            index = self.state.upsert_plan_results(
+                None, plan, result, preemption_evals=preemption_evals
             )
+            if preemption_evals and self.on_preemption_evals is not None:
+                self.on_preemption_evals(
+                    [self.state.eval_by_id(e.id) for e in preemption_evals]
+                )
+        result.alloc_index = index
         return result

@@ -1,11 +1,15 @@
-"""Server core: wires state, broker, plan applier, workers, heartbeats, and
-the RPC endpoint surface (ref nomad/server.go, nomad/*_endpoint.go).
+"""Server core: raft-replicated control plane wiring state, FSM, broker,
+plan applier, workers, heartbeats, and the RPC endpoint surface
+(ref nomad/server.go, nomad/leader.go, nomad/*_endpoint.go).
 
-This is the single-region control plane. Endpoints are plain methods (the
-HTTP/API layer calls them; in-process clients call them directly, the same
-way the reference's agent embeds both server and client). Raft replication is
-replaced by the serialized state-store write path; multi-server consensus
-attaches underneath in a later phase without changing this surface.
+Every state mutation flows through ``_apply`` → raft log → FSM → state
+store, exactly as the reference routes writes through raftApply
+(nomad/rpc.go). Leader-only subsystems (eval broker, blocked-evals
+tracker, plan queue, heartbeat timers, failed-eval reaper) are enabled in
+``_establish_leadership`` and disabled in ``_revoke_leadership``
+(ref leader.go:180 establishLeadership / revokeLeadership). A single-node
+server bootstraps itself as leader in milliseconds (the reference's
+-dev mode with in-memory raft, server.go:105).
 """
 
 from __future__ import annotations
@@ -15,6 +19,8 @@ import threading
 import time
 from typing import Optional
 
+from ..raft import InmemTransport, NotLeaderError, Raft, RaftConfig
+from ..raft.log import InmemLogStore, SnapshotStore, StableStore
 from ..state.store import StateStore
 from ..structs.model import (
     EVAL_STATUS_PENDING,
@@ -35,8 +41,10 @@ from ..structs.model import (
     now_ns,
 )
 from ..structs.node_class import compute_class
+from . import fsm as fsm_mod
 from .blocked_evals import BlockedEvals
 from .broker import EvalBroker
+from .fsm import FSM
 from .plan_apply import Planner
 from .worker import Worker
 
@@ -58,49 +66,177 @@ class Server:
             subsequent_nack_delay=self.config.get("subsequent_nack_delay", 20.0),
         )
         self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.periodic = None  # PeriodicDispatch attaches in agent wiring
+        self.deployment_watcher = None
+        self.drainer = None
+        self.fsm = FSM(
+            state=self.state,
+            eval_broker=self.eval_broker,
+            blocked_evals=self.blocked_evals,
+        )
         self.planner = Planner(self.state)
+        self.planner.commit_fn = self._commit_plan
         self.planner.preemption_evals_fn = self._make_preemption_evals
-        self.planner.on_preemption_evals = lambda evals: [
-            self.eval_broker.enqueue(e) for e in evals if e is not None
-        ]
         self.workers: list[Worker] = []
         self.heartbeat_ttl = self.config.get("heartbeat_ttl", DEFAULT_HEARTBEAT_TTL)
         self._heartbeat_timers: dict[str, threading.Timer] = {}
         self._lock = threading.Lock()
         self._running = False
+        self._leader = False
+        self._leader_cond = threading.Condition()
+        self._reaper: Optional[threading.Thread] = None
+
+        self.raft = self._setup_raft()
 
     # ------------------------------------------------------------------
-    # lifecycle (ref leader.go:180 establishLeadership)
+    # raft wiring (ref server.go:1075 setupRaft)
     # ------------------------------------------------------------------
-    def start(self, num_workers: int = 2):
-        self.eval_broker.set_enabled(True)
-        self.blocked_evals.set_enabled(True)
-        self.planner.start()
+    def _setup_raft(self) -> Raft:
+        rc = self.config.get("raft", {})
+        node_id = rc.get("node_id", self.config.get("name", "server-1"))
+        address = rc.get("address", node_id)
+        voters = rc.get("voters", {node_id: address})
+        single = len(voters) == 1
+        raft_config = rc.get("config") or RaftConfig(
+            # single-voter dev servers elect in ~10ms (raftInmem dev mode)
+            heartbeat_interval=0.02 if single else 0.05,
+            election_timeout_min=0.01 if single else 0.15,
+            election_timeout_max=0.03 if single else 0.30,
+            snapshot_threshold=rc.get("snapshot_threshold", 8192),
+        )
+        return Raft(
+            node_id=node_id,
+            address=address,
+            voters=voters,
+            fsm=self.fsm,
+            transport=rc.get("transport") or InmemTransport(),
+            log_store=rc.get("log_store") or InmemLogStore(),
+            stable=rc.get("stable") or StableStore(),
+            snapshots=rc.get("snapshots") or SnapshotStore(),
+            config=raft_config,
+            on_leadership=self._leadership_changed,
+        )
+
+    def _apply(self, msg_type: str, payload: dict):
+        """Propose a write through consensus (ref nomad/rpc.go raftApply).
+        Raises NotLeaderError with a leader hint; the RPC layer forwards."""
+        return self.raft.apply(msg_type, payload)
+
+    def attach_periodic(self, dispatcher):
+        """Attach the leader's periodic dispatcher; the FSM tracks periodic
+        jobs as registrations apply (ref fsm.go periodicDispatcher field)."""
+        self.periodic = dispatcher
+        self.fsm.periodic_dispatcher = dispatcher
+        if self._leader:
+            dispatcher.set_enabled(True)
+            dispatcher.restore(self.state)
+
+    def _commit_plan(self, plan, result, preemption_evals):
+        return self._apply(
+            fsm_mod.APPLY_PLAN_RESULTS,
+            {
+                "plan": plan.to_dict(),
+                "result": result.to_dict(),
+                "preemption_evals": [e.to_dict() for e in preemption_evals],
+            },
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self, num_workers: int = 2, wait_for_leader: Optional[float] = None):
+        self._running = True
+        self.raft.start()
         for i in range(num_workers):
             w = Worker(self, seed=self.config.get("seed"))
             self.workers.append(w)
             w.start()
-        self._running = True
-        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
-        self._reaper.start()
+        if wait_for_leader is None:
+            # single-voter servers are their own leader; block briefly so
+            # callers can write immediately (dev-mode ergonomics)
+            wait_for_leader = 5.0 if len(self.raft.voters) == 1 else 0.0
+        if wait_for_leader:
+            self.wait_for_leader(wait_for_leader)
 
     def stop(self):
         self._running = False
         for w in self.workers:
             w.stop()
         self.workers = []
+        self._revoke_leadership()
+        self.raft.shutdown()
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def leader_address(self) -> Optional[str]:
+        return self.raft.leader_address()
+
+    def wait_for_leader(self, timeout: float = 5.0) -> bool:
+        """Wait until this server becomes the leader."""
+        with self._leader_cond:
+            return self._leader_cond.wait_for(lambda: self._leader, timeout)
+
+    def _leadership_changed(self, leader: bool):
+        if leader:
+            self._establish_leadership()
+        else:
+            self._revoke_leadership()
+
+    def _establish_leadership(self):
+        """ref leader.go:180 establishLeadership"""
+        if not self._running:
+            return
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.planner.start()
+        self._restore_evals()
+        self._initialize_heartbeat_timers()
+        if self.periodic is not None:
+            self.periodic.set_enabled(True)
+            self.periodic.restore(self.state)
+        if self.deployment_watcher is not None:
+            self.deployment_watcher.set_enabled(True)
+        if self.drainer is not None:
+            self.drainer.set_enabled(True)
+        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
+        self._reaper.start()
+        with self._leader_cond:
+            self._leader = True
+            self._leader_cond.notify_all()
+        logger.info("server %s: leadership established", self.raft.node_id)
+
+    def _revoke_leadership(self):
+        with self._leader_cond:
+            self._leader = False
         self.planner.stop()
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
+        if self.periodic is not None:
+            self.periodic.set_enabled(False)
+        if self.deployment_watcher is not None:
+            self.deployment_watcher.set_enabled(False)
+        if self.drainer is not None:
+            self.drainer.set_enabled(False)
         with self._lock:
             for t in self._heartbeat_timers.values():
                 t.cancel()
             self._heartbeat_timers.clear()
 
-    def _next_index(self):
-        """Index sentinel: writes allocate their index inside the store's
-        write transaction (passing None)."""
-        return None
+    def _restore_evals(self):
+        """Re-populate the broker from replicated state on leadership
+        (ref leader.go:295 restoreEvals)."""
+        for ev in list(self.state.evals()):
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _initialize_heartbeat_timers(self):
+        """ref heartbeat.go:21 initializeHeartbeatTimers"""
+        for node in list(self.state.nodes()):
+            if node.status != NODE_STATUS_DOWN:
+                self._reset_heartbeat(node.id)
 
     def _reap_failed_evals(self):
         """Drain the _failed queue: mark evals failed and schedule a delayed
@@ -110,9 +246,7 @@ class Server:
         follow_up_wait = self.config.get("failed_eval_followup_wait", 60.0)
         unblock_interval = self.config.get("failed_eval_unblock_interval", 60.0)
         last_unblock = time.monotonic()
-        while self._running:
-            # periodically retry max-plan-attempt blocked evals
-            # (ref leader.go:588 periodicUnblockFailedEvals)
+        while self._running and self._leader:
             if time.monotonic() - last_unblock >= unblock_interval:
                 last_unblock = time.monotonic()
                 self.blocked_evals.unblock_failed()
@@ -122,15 +256,17 @@ class Server:
             try:
                 failed = ev.copy()
                 failed.status = "failed"
-                failed.status_description = (
-                    "evaluation reached delivery limit"
-                )
+                failed.status_description = "evaluation reached delivery limit"
                 follow_up = failed.create_failed_follow_up_eval(
                     int(follow_up_wait * 1e9)
                 )
-                self.state.upsert_evals(None, [failed, follow_up])
-                self.eval_broker.enqueue(self.state.eval_by_id(follow_up.id))
+                self._apply(
+                    fsm_mod.EVAL_UPDATE,
+                    {"evals": [failed.to_dict(), follow_up.to_dict()]},
+                )
                 self.eval_broker.ack(ev.id, token)
+            except NotLeaderError:
+                return
             except Exception:
                 logger.exception("failed-eval reaping error for %s", ev.id)
 
@@ -140,7 +276,7 @@ class Server:
     def job_register(self, job: Job) -> str:
         """Returns the eval id created (empty for periodic/parameterized)."""
         self._validate_job(job)
-        self.state.upsert_job(None, job)
+        self._apply(fsm_mod.JOB_REGISTER, {"job": job.to_dict()})
         stored = self.state.job_by_id(job.namespace, job.id)
 
         if stored.is_periodic() or stored.is_parameterized():
@@ -158,9 +294,7 @@ class Server:
             create_time=now_ns(),
             modify_time=now_ns(),
         )
-        self.state.upsert_evals(None, [ev])
-        stored_eval = self.state.eval_by_id(ev.id)
-        self.eval_broker.enqueue(stored_eval)
+        self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return ev.id
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
@@ -168,13 +302,10 @@ class Server:
         job = self.state.job_by_id(namespace, job_id)
         if job is None:
             raise KeyError(f"job not found: {job_id}")
-        if purge:
-            self.state.delete_job(None, namespace, job_id)
-        else:
-            stopped = job.copy()
-            stopped.stop = True
-            self.state.upsert_job(None, stopped)
-        self.blocked_evals.untrack(namespace, job_id)
+        self._apply(
+            fsm_mod.JOB_DEREGISTER,
+            {"namespace": namespace, "job_id": job_id, "purge": purge},
+        )
         ev = Evaluation(
             id=generate_uuid(),
             namespace=namespace,
@@ -186,8 +317,7 @@ class Server:
             create_time=now_ns(),
             modify_time=now_ns(),
         )
-        self.state.upsert_evals(None, [ev])
-        self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+        self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return ev.id
 
     @staticmethod
@@ -215,29 +345,30 @@ class Server:
         existed = self.state.node_by_id(node.id) is not None
         if not node.status:
             node.status = NODE_STATUS_READY
-        self.state.upsert_node(None, node)
+        self._apply(fsm_mod.NODE_REGISTER, {"node": node.to_dict()})
         self._reset_heartbeat(node.id)
 
-        # new capacity: unblock matching blocked evals + system-job evals
         if not existed or node.status == NODE_STATUS_READY:
-            self.blocked_evals.unblock(node.computed_class, self.state.latest_index())
             self._create_node_evals(node.id)
         return {"heartbeat_ttl": self.heartbeat_ttl}
+
+    def node_deregister(self, node_id: str):
+        self._apply(fsm_mod.NODE_DEREGISTER, {"node_id": node_id})
+        with self._lock:
+            t = self._heartbeat_timers.pop(node_id, None)
+            if t is not None:
+                t.cancel()
 
     def node_update_status(self, node_id: str, status: str) -> dict:
         node = self.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
         if node.status != status:
-            self.state.update_node_status(
-                None, node_id, status, updated_at_ns=now_ns()
+            self._apply(
+                fsm_mod.NODE_STATUS_UPDATE,
+                {"node_id": node_id, "status": status, "updated_at": now_ns()},
             )
             self._create_node_evals(node_id)
-            if status == NODE_STATUS_READY:
-                node = self.state.node_by_id(node_id)
-                self.blocked_evals.unblock(
-                    node.computed_class, self.state.latest_index()
-                )
         if status != NODE_STATUS_DOWN:
             self._reset_heartbeat(node_id)
         return {"heartbeat_ttl": self.heartbeat_ttl}
@@ -255,24 +386,33 @@ class Server:
 
     def node_drain(self, node_id: str, drain: bool):
         """ref node_endpoint.go UpdateDrain"""
-        self.state.update_node_drain(None, node_id, drain)
+        self._apply(fsm_mod.NODE_DRAIN_UPDATE, {"node_id": node_id, "drain": drain})
         if drain:
-            # mark this node's allocs for migration
-            updates = []
-            for a in self.state.allocs_by_node_terminal(node_id, False):
-                ac = a.copy()
-                ac.desired_transition.migrate = True
-                updates.append(ac)
-            if updates:
-                self.state.upsert_allocs(None, updates)
+            if self.drainer is not None:
+                self.drainer.notify()
+            else:
+                # without the drainer subsystem: immediately mark this
+                # node's allocs for migration
+                transitions = {
+                    a.id: {"migrate": True}
+                    for a in self.state.allocs_by_node_terminal(node_id, False)
+                }
+                if transitions:
+                    self._apply(
+                        fsm_mod.ALLOC_DESIRED_TRANSITION,
+                        {"allocs": transitions, "evals": []},
+                    )
         self._create_node_evals(node_id)
 
     def node_update_eligibility(self, node_id: str, eligibility: str):
-        self.state.update_node_eligibility(None, node_id, eligibility)
+        self._apply(
+            fsm_mod.NODE_ELIGIBILITY_UPDATE,
+            {"node_id": node_id, "eligibility": eligibility},
+        )
 
     def _reset_heartbeat(self, node_id: str):
-        """ref heartbeat.go:33-212 resetHeartbeatTimer"""
-        if not self._running:
+        """ref heartbeat.go:33-212 resetHeartbeatTimer (leader-only)"""
+        if not self._running or not self._leader:
             return
         with self._lock:
             old = self._heartbeat_timers.pop(node_id, None)
@@ -294,6 +434,8 @@ class Server:
             if node is not None and node.status != NODE_STATUS_DOWN:
                 logger.warning("node %s missed heartbeat; marking down", node_id[:8])
                 self.node_update_status(node_id, NODE_STATUS_DOWN)
+        except NotLeaderError:
+            pass
         except Exception:
             logger.exception("heartbeat invalidation failed for %s", node_id)
 
@@ -326,9 +468,9 @@ class Server:
                 )
             )
         if evals:
-            self.state.upsert_evals(None, evals)
-            for ev in evals:
-                self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+            self._apply(
+                fsm_mod.EVAL_UPDATE, {"evals": [e.to_dict() for e in evals]}
+            )
 
     # ------------------------------------------------------------------
     # Client alloc sync (ref node_endpoint.go:894 GetClientAllocs, :362
@@ -344,24 +486,26 @@ class Server:
         return self.state.blocking_query(query, min_index=min_index, timeout=timeout)
 
     def update_allocs(self, allocs: list[Allocation]):
-        """Client-reported alloc status; failed allocs trigger new evals
-        (ref node_endpoint.go UpdateAlloc:1006-1053)."""
-        self.state.update_allocs_from_client(None, allocs)
+        """Client-reported alloc status; failed allocs trigger new evals in
+        the same log entry (ref node_endpoint.go UpdateAlloc:1006-1053)."""
         evals = []
+        seen = set()
         for update in allocs:
             stored = self.state.alloc_by_id(update.id)
-            if stored is None or stored.job is None:
+            job = stored.job if stored is not None else None
+            if job is None:
                 continue
-            if (
-                stored.client_terminal_status()
-                and not stored.server_terminal_status()
-            ):
+            if update.client_terminal_status() and not stored.server_terminal_status():
+                key = (stored.namespace, stored.job_id)
+                if key in seen:
+                    continue
+                seen.add(key)
                 evals.append(
                     Evaluation(
                         id=generate_uuid(),
                         namespace=stored.namespace,
-                        priority=stored.job.priority,
-                        type=stored.job.type,
+                        priority=job.priority,
+                        type=job.type,
                         triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
                         job_id=stored.job_id,
                         status=EVAL_STATUS_PENDING,
@@ -369,18 +513,13 @@ class Server:
                         modify_time=now_ns(),
                     )
                 )
-        if evals:
-            # dedup per job
-            seen = set()
-            unique = []
-            for ev in evals:
-                key = (ev.namespace, ev.job_id)
-                if key not in seen:
-                    seen.add(key)
-                    unique.append(ev)
-            self.state.upsert_evals(None, unique)
-            for ev in unique:
-                self.eval_broker.enqueue(self.state.eval_by_id(ev.id))
+        self._apply(
+            fsm_mod.ALLOC_CLIENT_UPDATE,
+            {
+                "allocs": [a.to_dict() for a in allocs],
+                "evals": [e.to_dict() for e in evals],
+            },
+        )
 
     # ------------------------------------------------------------------
     # Eval endpoints (ref nomad/eval_endpoint.go)
@@ -393,6 +532,12 @@ class Server:
 
     def eval_nack(self, eval_id: str, token: str):
         self.eval_broker.nack(eval_id, token)
+
+    def update_evals(self, evals: list[Evaluation]):
+        """Worker-side eval status writes (ref eval_endpoint.go Update)."""
+        self._apply(
+            fsm_mod.EVAL_UPDATE, {"evals": [e.to_dict() for e in evals]}
+        )
 
     # ------------------------------------------------------------------
     def _make_preemption_evals(self, result) -> list[Evaluation]:

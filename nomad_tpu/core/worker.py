@@ -127,8 +127,9 @@ class Worker:
         return result, new_state
 
     def update_eval(self, ev: Evaluation):
-        """ref worker.go:426-445"""
-        self.server.state.upsert_evals(None, [ev])
+        """ref worker.go:426-445 (raft Eval.Update; broker routing happens
+        in the FSM apply)"""
+        self.server.update_evals([ev])
         if ev.status == EVAL_STATUS_FAILED:
             logger.warning("eval failed: %s (%s)", ev.id, ev.status_description)
 
@@ -136,15 +137,10 @@ class Worker:
         """ref worker.go:447-466"""
         if ev.should_block() and not ev.snapshot_index:
             ev.snapshot_index = self._snapshot_index
-        self.server.state.upsert_evals(None, [ev])
-        if ev.should_enqueue():
-            self.server.eval_broker.enqueue(ev)
-        elif ev.should_block():
-            self.server.blocked_evals.block(ev)
+        self.server.update_evals([ev])
 
     def reblock_eval(self, ev: Evaluation):
         """ref worker.go:468-523"""
         if not ev.snapshot_index:
             ev.snapshot_index = self._snapshot_index
-        self.server.state.upsert_evals(None, [ev])
-        self.server.blocked_evals.block(ev)
+        self.server.update_evals([ev])

@@ -33,8 +33,10 @@ from ..structs.model import (
     NODE_SCHED_ELIGIBLE,
     NODE_SCHED_INELIGIBLE,
     NODE_STATUS_DOWN,
+    DEPLOYMENT_STATUS_DESC_RUNNING,
     Allocation,
     Deployment,
+    DeploymentStatus,
     DeploymentStatusUpdate,
     Evaluation,
     Job,
@@ -847,6 +849,153 @@ class StateStore(StateReader):
         deployments[d.id] = d
 
     @_write_txn
+    def update_deployment_promotion(
+        self, index: int, deployment_id: str, groups: list[str], all_groups: bool
+    ):
+        """Promote canaries for the requested groups (ref state_store.go
+        UpdateDeploymentPromotion): each promoted group needs at least one
+        healthy canary; when no group still requires promotion the
+        deployment returns to plain running."""
+        gen = self._gen
+        deployments = dict(gen.deployments)
+        d = deployments.get(deployment_id)
+        if d is None:
+            raise KeyError(f"deployment not found: {deployment_id}")
+        if not d.active():
+            raise ValueError(f"deployment {deployment_id} is terminal")
+        d = d.copy()
+
+        healthy_canaries: dict[str, int] = {}
+        for alloc in gen.allocs.values():
+            if alloc.deployment_id != deployment_id:
+                continue
+            ds = alloc.deployment_status
+            if ds is not None and ds.canary and ds.is_healthy():
+                healthy_canaries[alloc.task_group] = (
+                    healthy_canaries.get(alloc.task_group, 0) + 1
+                )
+
+        unhealthy_err = []
+        for group_name, state in d.task_groups.items():
+            if not all_groups and group_name not in groups:
+                continue
+            if state.desired_canaries == 0 or state.promoted:
+                continue
+            healthy = healthy_canaries.get(group_name, 0)
+            if healthy < state.desired_canaries:
+                unhealthy_err.append(
+                    f'Task group "{group_name}" has {healthy}/'
+                    f"{state.desired_canaries} healthy canaries"
+                )
+                continue
+            state.promoted = True
+        if unhealthy_err:
+            raise ValueError("; ".join(unhealthy_err))
+
+        if not d.requires_promotion():
+            d.status_description = DEPLOYMENT_STATUS_DESC_RUNNING
+        d.modify_index = index
+        deployments[d.id] = d
+        self._publish(
+            index=index,
+            deployments=deployments,
+            table_indexes=self._bump(gen, index, "deployment"),
+        )
+
+    @_write_txn
+    def update_deployment_alloc_health(
+        self,
+        index: int,
+        deployment_id: str,
+        healthy_ids: list[str],
+        unhealthy_ids: list[str],
+        timestamp_ns: int = 0,
+    ):
+        """Record alloc deployment health + bump the deployment's per-group
+        healthy/unhealthy counters (ref state_store.go
+        UpdateDeploymentAllocHealth)."""
+        gen = self._gen
+        deployments = dict(gen.deployments)
+        d = deployments.get(deployment_id)
+        if d is None:
+            raise KeyError(f"deployment not found: {deployment_id}")
+        if not d.active():
+            raise ValueError(f"deployment {deployment_id} is terminal")
+        d = d.copy()
+        allocs_table = dict(gen.allocs)
+
+        def mark(alloc_id: str, healthy: bool):
+            alloc = allocs_table.get(alloc_id)
+            if alloc is None or alloc.deployment_id != deployment_id:
+                return
+            alloc = alloc.copy()
+            prev = (
+                alloc.deployment_status.healthy
+                if alloc.deployment_status is not None
+                else None
+            )
+            if alloc.deployment_status is None:
+                alloc.deployment_status = DeploymentStatus()
+            alloc.deployment_status.healthy = healthy
+            alloc.deployment_status.timestamp = timestamp_ns
+            alloc.deployment_status.modify_index = index
+            alloc.modify_index = index
+            allocs_table[alloc_id] = alloc
+            state = d.task_groups.get(alloc.task_group)
+            if state is not None and prev != healthy:
+                if healthy:
+                    state.healthy_allocs += 1
+                    if prev is False:
+                        state.unhealthy_allocs -= 1
+                else:
+                    state.unhealthy_allocs += 1
+                    if prev is True:
+                        state.healthy_allocs -= 1
+
+        for aid in healthy_ids:
+            mark(aid, True)
+        for aid in unhealthy_ids:
+            mark(aid, False)
+
+        d.modify_index = index
+        deployments[d.id] = d
+        self._publish(
+            index=index,
+            allocs=allocs_table,
+            deployments=deployments,
+            table_indexes=self._bump(gen, index, "allocs", "deployment"),
+        )
+
+    @_write_txn
+    def update_job_stability(
+        self, index: int, namespace: str, job_id: str, version: int, stable: bool
+    ):
+        """Flip the stable flag on a job version (ref state_store.go
+        UpdateJobStability) — used by deployment auto-revert and
+        `job revert`."""
+        gen = self._gen
+        versions = dict(gen.job_versions)
+        jobs = dict(gen.jobs)
+        vj = versions.get((namespace, job_id, version))
+        if vj is not None:
+            vj = vj.copy()
+            vj.stable = stable
+            vj.modify_index = index
+            versions[(namespace, job_id, version)] = vj
+        cur = jobs.get((namespace, job_id))
+        if cur is not None and cur.version == version:
+            cur = cur.copy()
+            cur.stable = stable
+            cur.modify_index = index
+            jobs[(namespace, job_id)] = cur
+        self._publish(
+            index=index,
+            jobs=jobs,
+            job_versions=versions,
+            table_indexes=self._bump(gen, index, "jobs", "job_version"),
+        )
+
+    @_write_txn
     def delete_deployment(self, index: int, deployment_ids: list[str]):
         gen = self._gen
         deployments = dict(gen.deployments)
@@ -945,3 +1094,82 @@ class StateStore(StateReader):
             ),
         )
         return index
+
+    # ------------------------------------------------------------------
+    # whole-store persistence (ref nomad/fsm.go:1059 Snapshot / :1073
+    # Restore — the FSM serializes every table into the raft snapshot)
+    # ------------------------------------------------------------------
+    def persist(self) -> dict:
+        """Serialize the current generation into a plain (msgpack-able)
+        dict. Tuple-keyed tables are emitted as object lists; keys are
+        rebuilt on restore."""
+        gen = self._gen
+        return {
+            "index": gen.index,
+            "nodes": [n.to_dict() for n in gen.nodes.values()],
+            "jobs": [j.to_dict() for j in gen.jobs.values()],
+            "job_versions": [j.to_dict() for j in gen.job_versions.values()],
+            "job_summaries": [s.to_dict() for s in gen.job_summaries.values()],
+            "evals": [e.to_dict() for e in gen.evals.values()],
+            "allocs": [a.to_dict() for a in gen.allocs.values()],
+            "deployments": [d.to_dict() for d in gen.deployments.values()],
+            "periodic_launch": list(gen.periodic_launch.values()),
+            "scheduler_config": gen.scheduler_config,
+            "table_indexes": dict(gen.table_indexes),
+        }
+
+    def restore(self, data: dict):
+        """Replace all tables with the persisted snapshot (ref fsm.go:1073
+        Restore: blows away current state, installs the snapshot)."""
+        with self._write_mutex:
+            gen = Generation(
+                index=data.get("index", 0),
+                nodes={
+                    n.id: n
+                    for n in (Node.from_dict(d) for d in data.get("nodes", []))
+                },
+                jobs={
+                    (j.namespace, j.id): j
+                    for j in (Job.from_dict(d) for d in data.get("jobs", []))
+                },
+                job_versions={
+                    (j.namespace, j.id, j.version): j
+                    for j in (Job.from_dict(d) for d in data.get("job_versions", []))
+                },
+                job_summaries={
+                    (s.namespace, s.job_id): s
+                    for s in (
+                        JobSummary.from_dict(d)
+                        for d in data.get("job_summaries", [])
+                    )
+                },
+                evals={
+                    e.id: e
+                    for e in (
+                        Evaluation.from_dict(d) for d in data.get("evals", [])
+                    )
+                },
+                allocs={
+                    a.id: a
+                    for a in (
+                        Allocation.from_dict(d) for d in data.get("allocs", [])
+                    )
+                },
+                deployments={
+                    d.id: d
+                    for d in (
+                        Deployment.from_dict(x) for x in data.get("deployments", [])
+                    )
+                },
+                periodic_launch={
+                    (pl["namespace"], pl["job_id"]): pl
+                    for pl in data.get("periodic_launch", [])
+                },
+                scheduler_config=data.get("scheduler_config"),
+                table_indexes=dict(data.get("table_indexes", {})),
+            )
+            self._publish(**{f: getattr(gen, f) for f in (
+                "index", "nodes", "jobs", "job_versions", "job_summaries",
+                "evals", "allocs", "deployments", "periodic_launch",
+                "scheduler_config", "table_indexes",
+            )})

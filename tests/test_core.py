@@ -224,7 +224,7 @@ class TestServerEndToEnd:
         server.start(num_workers=2)
         try:
             for _ in range(4):
-                server.state.upsert_node(server._next_index(), mock.node())
+                server.node_register(mock.node())
             job = mock.job()
             job.task_groups[0].count = 4
             eval_id = server.job_register(job)
@@ -259,7 +259,6 @@ class TestServerEndToEnd:
             assert server.blocked_evals.stats()["total_blocked"] >= 1
 
             # register a node: blocked eval unblocks, allocs place
-            server.state.upsert_node(server._next_index(), mock.node())
             server.node_register(mock.node())
             deadline = time.time() + 10
             while time.time() < deadline:
