@@ -1,8 +1,15 @@
-"""Dev agent: server + client(s) in one process (ref command/agent/ -dev
-mode, which embeds both halves the same way)."""
+"""Agents: the processes that run servers and node clients
+(ref command/agent/agent.go — an Agent embeds a Server and/or Client).
+
+``DevAgent`` is the -dev mode: server + in-process clients, no network.
+``ServerAgent`` runs a server with a real RPC listener (raft + endpoint
+protocols muxed on one port, ref nomad/rpc.go); ``ClientAgent`` runs a
+node agent that talks to servers over RPC via ServerProxy.
+"""
 
 from __future__ import annotations
 
+import os
 import tempfile
 from typing import Optional
 
@@ -47,3 +54,108 @@ class DevAgent:
 
     def run_job(self, job) -> str:
         return self.server.job_register(job)
+
+
+class ServerAgent:
+    """A server with a network RPC listener (ref command/agent/agent.go
+    server mode + nomad/rpc.go listener).
+
+    Two-phase start so multi-server clusters can exchange addresses:
+    constructing binds the listener (``.address`` is then known); ``start``
+    takes the full voter map and boots raft + endpoints.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        bind: str = "127.0.0.1",
+        port: int = 0,
+        data_dir: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        from .rpc import RpcServer, TcpRaftTransport
+        from .rpc.endpoints import register_endpoints
+
+        self.name = name
+        self.data_dir = data_dir
+        self.config = dict(config or {})
+        self.rpc = RpcServer(bind, port)
+        self.address = self.rpc.address
+        self._transport = TcpRaftTransport(self.rpc)
+        self._register_endpoints = register_endpoints
+        self.server: Optional[Server] = None
+
+    def start(
+        self,
+        voters: Optional[dict[str, str]] = None,
+        num_workers: int = 2,
+        wait_for_leader: Optional[float] = None,
+    ):
+        from .raft.log import FileLogStore, SnapshotStore, StableStore
+
+        voters = voters or {self.name: self.address}
+        raft_cfg: dict = {
+            "node_id": self.name,
+            "address": self.address,
+            "voters": voters,
+            "transport": self._transport,
+        }
+        if self.data_dir:
+            os.makedirs(self.data_dir, exist_ok=True)
+            raft_cfg["log_store"] = FileLogStore(
+                os.path.join(self.data_dir, "raft.log")
+            )
+            raft_cfg["stable"] = StableStore(
+                os.path.join(self.data_dir, "stable.db")
+            )
+            raft_cfg["snapshots"] = SnapshotStore(
+                os.path.join(self.data_dir, "snapshots")
+            )
+        cfg = dict(self.config)
+        cfg["name"] = self.name
+        cfg["raft"] = raft_cfg
+        self.server = Server(cfg)
+        # raft rides the RPC listener, so raft addr == rpc addr
+        self.rpc.server_rpc_addrs = dict(voters)
+        self._register_endpoints(self.server, self.rpc)
+        self.rpc.start()
+        self.server.start(num_workers=num_workers, wait_for_leader=wait_for_leader)
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()
+        self._transport.close()
+        self.rpc.stop()
+
+
+class ClientAgent:
+    """A node agent connected to servers over RPC (ref command/agent client
+    mode; server list managed like client/servers/manager.go)."""
+
+    def __init__(
+        self,
+        servers: list[str],
+        data_dir: Optional[str] = None,
+        node=None,
+        drivers: Optional[dict] = None,
+    ):
+        from .rpc import ServerProxy
+
+        self.proxy = ServerProxy(servers)
+        self.client = Client(
+            self.proxy,
+            data_dir=data_dir or tempfile.mkdtemp(prefix="nomad_tpu_client_"),
+            node=node,
+            drivers=drivers,
+        )
+
+    @property
+    def node(self):
+        return self.client.node
+
+    def start(self):
+        self.client.start()
+
+    def stop(self):
+        self.client.stop()
+        self.proxy.pool.close()

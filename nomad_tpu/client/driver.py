@@ -20,6 +20,18 @@ from typing import Optional
 from ..structs.model import Task
 
 
+def parse_duration(v) -> float:
+    """Seconds from a number or a Go-style duration string ("250ms",
+    "1m30s" — the format the reference's mock driver configs use,
+    drivers/mock/driver.go run_for). Delegates to the jobspec parser so
+    compound durations behave identically everywhere."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    from ..jobspec.hcl import parse_duration as _hcl_duration
+
+    return _hcl_duration(str(v)) / 1e9
+
+
 @dataclass
 class TaskHandle:
     task_name: str = ""
@@ -87,12 +99,12 @@ class MockDriver(Driver):
         if cfg.get("start_error"):
             raise RuntimeError(str(cfg["start_error"]))
         if cfg.get("start_block_for"):
-            time.sleep(float(cfg["start_block_for"]))
+            time.sleep(parse_duration(cfg["start_block_for"]))
 
         handle = TaskHandle(
             task_name=task.name, driver=self.name, started_at=time.time_ns()
         )
-        run_for = float(cfg.get("run_for", 0))
+        run_for = parse_duration(cfg.get("run_for", 0))
         exit_code = int(cfg.get("exit_code", 0))
         if run_for <= 0:
             handle.finish(exit_code)

@@ -122,6 +122,16 @@ class Server:
         Raises NotLeaderError with a leader hint; the RPC layer forwards."""
         return self.raft.apply(msg_type, payload)
 
+    def _check_leader(self):
+        """Forward-first semantics: leader-only endpoints reject on
+        followers BEFORE reading local (possibly stale) state, so the RPC
+        layer retries at the leader (ref nomad/rpc.go forward(), called at
+        the top of every endpoint)."""
+        if not self.raft.is_leader():
+            raise NotLeaderError(
+                self.raft.leader_address(), self.raft.leader_id
+            )
+
     def attach_periodic(self, dispatcher):
         """Attach the leader's periodic dispatcher; the FSM tracks periodic
         jobs as registrations apply (ref fsm.go periodicDispatcher field)."""
@@ -275,6 +285,7 @@ class Server:
     # ------------------------------------------------------------------
     def job_register(self, job: Job) -> str:
         """Returns the eval id created (empty for periodic/parameterized)."""
+        self._check_leader()
         self._validate_job(job)
         self._apply(fsm_mod.JOB_REGISTER, {"job": job.to_dict()})
         stored = self.state.job_by_id(job.namespace, job.id)
@@ -299,6 +310,7 @@ class Server:
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
         """ref job_endpoint.go Deregister"""
+        self._check_leader()
         job = self.state.job_by_id(namespace, job_id)
         if job is None:
             raise KeyError(f"job not found: {job_id}")
@@ -340,6 +352,7 @@ class Server:
     # UpdateStatus, :894 GetClientAllocs)
     # ------------------------------------------------------------------
     def node_register(self, node: Node) -> dict:
+        self._check_leader()
         if not node.computed_class:
             compute_class(node)
         existed = self.state.node_by_id(node.id) is not None
@@ -353,6 +366,7 @@ class Server:
         return {"heartbeat_ttl": self.heartbeat_ttl}
 
     def node_deregister(self, node_id: str):
+        self._check_leader()
         self._apply(fsm_mod.NODE_DEREGISTER, {"node_id": node_id})
         with self._lock:
             t = self._heartbeat_timers.pop(node_id, None)
@@ -360,6 +374,7 @@ class Server:
                 t.cancel()
 
     def node_update_status(self, node_id: str, status: str) -> dict:
+        self._check_leader()
         node = self.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
@@ -375,6 +390,7 @@ class Server:
 
     def node_heartbeat(self, node_id: str) -> dict:
         """ref node_endpoint.go UpdateStatus heartbeat path + heartbeat.go"""
+        self._check_leader()
         node = self.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
@@ -386,6 +402,7 @@ class Server:
 
     def node_drain(self, node_id: str, drain: bool):
         """ref node_endpoint.go UpdateDrain"""
+        self._check_leader()
         self._apply(fsm_mod.NODE_DRAIN_UPDATE, {"node_id": node_id, "drain": drain})
         if drain:
             if self.drainer is not None:
@@ -405,6 +422,7 @@ class Server:
         self._create_node_evals(node_id)
 
     def node_update_eligibility(self, node_id: str, eligibility: str):
+        self._check_leader()
         self._apply(
             fsm_mod.NODE_ELIGIBILITY_UPDATE,
             {"node_id": node_id, "eligibility": eligibility},
@@ -488,6 +506,7 @@ class Server:
     def update_allocs(self, allocs: list[Allocation]):
         """Client-reported alloc status; failed allocs trigger new evals in
         the same log entry (ref node_endpoint.go UpdateAlloc:1006-1053)."""
+        self._check_leader()
         evals = []
         seen = set()
         for update in allocs:
@@ -525,12 +544,15 @@ class Server:
     # Eval endpoints (ref nomad/eval_endpoint.go)
     # ------------------------------------------------------------------
     def eval_dequeue(self, schedulers: list[str], timeout: float = 1.0):
+        self._check_leader()
         return self.eval_broker.dequeue(schedulers, timeout)
 
     def eval_ack(self, eval_id: str, token: str):
+        self._check_leader()
         self.eval_broker.ack(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str):
+        self._check_leader()
         self.eval_broker.nack(eval_id, token)
 
     def update_evals(self, evals: list[Evaluation]):

@@ -1,0 +1,202 @@
+"""Pooled RPC client + the typed server proxy (ref helper/pool/pool.go
+conn pooling and api/ typed client).
+
+``ConnPool.call`` retries once on a not_leader error by re-dialing the
+leader address the error carries — the follower→leader forwarding model
+(the reference forwards server-side, rpc.go:433; doing it client-side
+keeps the wire format trivial and the hop count identical).
+
+``ServerProxy`` exposes the same method surface as ``core.Server`` so the
+node agent (client/client.py) works identically in-process or over TCP.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Optional
+
+from .codec import RPC_NOMAD, ConnectionClosed, read_frame, write_frame
+
+
+class RpcError(Exception):
+    def __init__(self, code: str, message: str, leader_rpc_addr: Optional[str] = None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.leader_rpc_addr = leader_rpc_addr
+
+
+class _Conn:
+    def __init__(self, addr: str, timeout: float):
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.sendall(bytes([RPC_NOMAD]))
+        self.lock = threading.Lock()
+        self.seq = itertools.count(1)
+
+    def call(self, method: str, payload, timeout: Optional[float] = None):
+        with self.lock:
+            if timeout is not None:
+                self.sock.settimeout(timeout)
+            seq = next(self.seq)
+            write_frame(self.sock, [seq, method, payload])
+            rseq, error, result = read_frame(self.sock)
+            if rseq != seq:
+                raise ConnectionClosed("rpc sequence mismatch")
+            if error is not None:
+                raise RpcError(
+                    error.get("code", "error"),
+                    error.get("message", ""),
+                    error.get("leader_rpc_addr"),
+                )
+            return result
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ConnPool:
+    """Persistent connections per server address (ref helper/pool)."""
+
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+        self._conns: dict[str, list[_Conn]] = {}
+        self._lock = threading.Lock()
+
+    def _acquire(self, addr: str) -> _Conn:
+        with self._lock:
+            conns = self._conns.setdefault(addr, [])
+            if conns:
+                return conns.pop()
+        return _Conn(addr, self.timeout)
+
+    def _release(self, addr: str, conn: _Conn):
+        with self._lock:
+            self._conns.setdefault(addr, []).append(conn)
+
+    def call(
+        self,
+        addr: str,
+        method: str,
+        payload,
+        timeout: Optional[float] = None,
+        retry_leader: bool = True,
+    ):
+        """One RPC. On a not_leader error with a leader hint, retries once
+        against the leader (follower→leader forwarding)."""
+        try:
+            conn = self._acquire(addr)
+        except OSError as e:
+            raise RpcError("connect", f"{addr}: {e}")
+        try:
+            result = conn.call(method, payload, timeout=timeout or self.timeout)
+            self._release(addr, conn)
+            return result
+        except RpcError as e:
+            self._release(addr, conn)
+            if e.code == "not_leader" and retry_leader and e.leader_rpc_addr:
+                return self.call(
+                    e.leader_rpc_addr, method, payload,
+                    timeout=timeout, retry_leader=False,
+                )
+            raise
+        except (ConnectionClosed, OSError) as e:
+            conn.close()
+            raise RpcError("connection", f"{addr}: {e}")
+
+    def close(self):
+        with self._lock:
+            for conns in self._conns.values():
+                for c in conns:
+                    c.close()
+            self._conns.clear()
+
+
+class ServerProxy:
+    """RPC-backed stand-in for core.Server: the node agent's view of the
+    cluster (ref client/rpc.go + client/servers/ server manager).
+
+    Maintains a server list; each call tries the current server and
+    rotates on connection failure (ref client/servers/manager.go)."""
+
+    def __init__(self, servers: list[str], pool: Optional[ConnPool] = None,
+                 max_retries: int = 3):
+        if not servers:
+            raise ValueError("at least one server address required")
+        self.servers = list(servers)
+        self.pool = pool or ConnPool()
+        self.max_retries = max_retries
+        self._lock = threading.Lock()
+        self._current = 0
+
+    def set_servers(self, servers: list[str]):
+        with self._lock:
+            self.servers = list(servers)
+            self._current = 0
+
+    def _call(self, method: str, payload, timeout: Optional[float] = None):
+        last_err = None
+        for attempt in range(self.max_retries):
+            with self._lock:
+                addr = self.servers[self._current % len(self.servers)]
+            try:
+                return self.pool.call(addr, method, payload, timeout=timeout)
+            except RpcError as e:
+                if e.code in ("connect", "connection", "not_leader"):
+                    # rotate to the next server (manager.go NotifyFailedServer)
+                    with self._lock:
+                        self._current += 1
+                    last_err = e
+                    time.sleep(0.05 * attempt)
+                    continue
+                raise
+        raise last_err
+
+    # ------------------------------------------------------------------
+    # the node-agent surface (mirrors core.Server methods)
+    # ------------------------------------------------------------------
+    def node_register(self, node) -> dict:
+        return self._call("Node.Register", {"node": node.to_dict()})
+
+    def node_heartbeat(self, node_id: str) -> dict:
+        return self._call("Node.UpdateStatus", {"node_id": node_id, "heartbeat": True})
+
+    def node_update_status(self, node_id: str, status: str) -> dict:
+        return self._call(
+            "Node.UpdateStatus", {"node_id": node_id, "status": status}
+        )
+
+    def get_client_allocs(self, node_id: str, min_index: int = 0, timeout: float = 30.0):
+        resp = self._call(
+            "Node.GetClientAllocs",
+            {"node_id": node_id, "min_index": min_index, "timeout": timeout},
+            timeout=timeout + 10.0,
+        )
+        from ..structs.model import Allocation
+
+        return (
+            [Allocation.from_dict(d) for d in resp["allocs"]],
+            resp["index"],
+        )
+
+    def update_allocs(self, allocs) -> None:
+        self._call(
+            "Node.UpdateAlloc", {"allocs": [a.to_dict() for a in allocs]}
+        )
+
+    # job/eval/etc. surface used by the HTTP API & CLI when remote
+    def job_register(self, job) -> str:
+        return self._call("Job.Register", {"job": job.to_dict()})
+
+    def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
+        return self._call(
+            "Job.Deregister",
+            {"namespace": namespace, "job_id": job_id, "purge": purge},
+        )

@@ -1,0 +1,146 @@
+"""RPC listener: one TCP port, first-byte protocol select, endpoint
+registry, forwarding (ref nomad/rpc.go:170-366).
+
+Protocol RPC_NOMAD serves request/response endpoint calls; RPC_RAFT
+serves raft consensus messages on the same port (the reference does the
+same single-listener mux). Endpoint handlers are registered as
+``"Service.Method" -> callable(payload) -> result``. Handlers raising
+``NotLeaderError`` are answered with a structured error carrying the
+leader's RPC address so clients can retry there (the reference's
+forward-to-leader, rpc.go:433-490, is done client-side by ConnPool or
+server-side via ``forward``)."""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Callable, Optional
+
+from ..raft import NotLeaderError
+from .codec import (
+    RPC_NOMAD,
+    RPC_RAFT,
+    ConnectionClosed,
+    read_frame,
+    write_frame,
+)
+
+logger = logging.getLogger("nomad_tpu.rpc")
+
+
+class RpcServer:
+    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0):
+        self.handlers: dict[str, Callable] = {}
+        self.raft_handlers: dict[str, Callable] = {}
+        # maps raft node_id -> rpc "host:port" (fed by config/gossip) so
+        # NotLeaderError responses can carry a dialable leader address
+        self.server_rpc_addrs: dict[str, str] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((bind_addr, port))
+        self._sock.listen(128)
+        self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
+        self._running = False
+        self._threads: list[threading.Thread] = []
+
+    def register(self, method: str, handler: Callable):
+        self.handlers[method] = handler
+
+    def register_raft(self, handlers: dict[str, Callable]):
+        self.raft_handlers = dict(handlers)
+
+    def start(self):
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True, name="rpc-accept")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            proto = conn.recv(1)
+            if not proto:
+                return
+            if proto[0] == RPC_NOMAD:
+                self._serve_rpc(conn, self._dispatch)
+            elif proto[0] == RPC_RAFT:
+                self._serve_rpc(conn, self._dispatch_raft)
+            else:
+                logger.warning("unknown rpc protocol byte %r", proto)
+        except (ConnectionClosed, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_rpc(self, conn: socket.socket, dispatch):
+        while self._running:
+            try:
+                seq, method, payload = read_frame(conn)
+            except (ConnectionClosed, OSError):
+                return
+            try:
+                result = dispatch(method, payload)
+                write_frame(conn, [seq, None, result])
+            except NotLeaderError as e:
+                leader_rpc = None
+                if e.leader_id and e.leader_id in self.server_rpc_addrs:
+                    leader_rpc = self.server_rpc_addrs[e.leader_id]
+                write_frame(
+                    conn,
+                    [
+                        seq,
+                        {
+                            "code": "not_leader",
+                            "message": str(e),
+                            "leader_rpc_addr": leader_rpc,
+                        },
+                        None,
+                    ],
+                )
+            except KeyError as e:
+                write_frame(
+                    conn, [seq, {"code": "not_found", "message": str(e)}, None]
+                )
+            except ValueError as e:
+                write_frame(
+                    conn, [seq, {"code": "invalid", "message": str(e)}, None]
+                )
+            except Exception as e:
+                logger.exception("rpc handler error for %s", method)
+                write_frame(
+                    conn, [seq, {"code": "internal", "message": str(e)}, None]
+                )
+
+    def _dispatch(self, method: str, payload):
+        handler = self.handlers.get(method)
+        if handler is None:
+            raise KeyError(f"unknown rpc method: {method}")
+        return handler(payload)
+
+    def _dispatch_raft(self, method: str, payload):
+        handler = self.raft_handlers.get(method)
+        if handler is None:
+            raise KeyError(f"unknown raft rpc: {method}")
+        return handler(payload)
