@@ -107,6 +107,38 @@ class ApiClient:
     def evaluation(self, eval_id: str):
         return self.get(f"/v1/evaluation/{eval_id}")[0]
 
+    def deployments(self):
+        return self.get("/v1/deployments")[0]
+
+    def deployment(self, deployment_id: str):
+        return self.get(f"/v1/deployment/{deployment_id}")[0]
+
+    def deployment_allocations(self, deployment_id: str):
+        return self.get(f"/v1/deployment/allocations/{deployment_id}")[0]
+
+    def deployment_promote(self, deployment_id: str, groups=None):
+        body = {"Groups": groups} if groups else {"All": True}
+        return self.put(f"/v1/deployment/promote/{deployment_id}", body=body)[0]
+
+    def deployment_fail(self, deployment_id: str):
+        return self.put(f"/v1/deployment/fail/{deployment_id}")[0]
+
+    def deployment_pause(self, deployment_id: str, pause: bool = True):
+        return self.put(
+            f"/v1/deployment/pause/{deployment_id}", body={"Pause": pause}
+        )[0]
+
+    def job_deployments(self, job_id: str):
+        return self.get(f"/v1/job/{job_id}/deployments")[0]
+
+    def job_revert(self, job_id: str, version: int):
+        return self.put(
+            f"/v1/job/{job_id}/revert", body={"JobVersion": version}
+        )[0]
+
+    def job_versions(self, job_id: str):
+        return self.get(f"/v1/job/{job_id}/versions")[0]
+
     def agent_self(self):
         return self.get("/v1/agent/self")[0]
 

@@ -341,6 +341,75 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
+    @route("GET", r"/v1/deployment/(?P<deploy_id>[^/]+)")
+    def get_deployment(self, m, query, body):
+        def run(snap):
+            d = snap.deployment_by_id(m["deploy_id"])
+            if d is None:
+                # prefix match, like the reference's prefix-tolerant lookups
+                matches = [
+                    x for x in snap.deployments()
+                    if x.id.startswith(m["deploy_id"])
+                ]
+                if len(matches) == 1:
+                    d = matches[0]
+            if d is None:
+                raise KeyError(f"deployment not found: {m['deploy_id']}")
+            return d.to_dict()
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/deployment/allocations/(?P<deploy_id>[^/]+)")
+    def deployment_allocations(self, m, query, body):
+        def run(snap):
+            return [
+                _alloc_stub(a) for a in snap.allocs_by_deployment(m["deploy_id"])
+            ]
+
+        return self._blocking(query, run)
+
+    @route("PUT", r"/v1/deployment/promote/(?P<deploy_id>[^/]+)")
+    def deployment_promote(self, m, query, body):
+        body = body or {}
+        self.server.deployment_promote(
+            m["deploy_id"],
+            groups=body.get("Groups"),
+            all_groups=body.get("All", not body.get("Groups")),
+        )
+        return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
+
+    @route("PUT", r"/v1/deployment/fail/(?P<deploy_id>[^/]+)")
+    def deployment_fail(self, m, query, body):
+        self.server.deployment_fail(m["deploy_id"])
+        return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
+
+    @route("PUT", r"/v1/deployment/pause/(?P<deploy_id>[^/]+)")
+    def deployment_pause(self, m, query, body):
+        pause = bool((body or {}).get("Pause", True))
+        self.server.deployment_pause(m["deploy_id"], pause)
+        return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
+
+    @route("PUT", r"/v1/deployment/allocation-health/(?P<deploy_id>[^/]+)")
+    def deployment_alloc_health(self, m, query, body):
+        body = body or {}
+        self.server.deployment_set_alloc_health(
+            m["deploy_id"],
+            healthy_ids=body.get("HealthyAllocationIDs", []),
+            unhealthy_ids=body.get("UnhealthyAllocationIDs", []),
+        )
+        return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
+
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/revert")
+    def job_revert(self, m, query, body):
+        body = body or {}
+        eval_id = self.server.job_revert(
+            query.get("namespace", "default"),
+            m["job_id"],
+            int(body.get("JobVersion", 0)),
+            enforce_prior_version=body.get("EnforcePriorVersion"),
+        )
+        return {"EvalID": eval_id}, None
+
     # -- agent / status --------------------------------------------------
     @route("GET", r"/v1/agent/self")
     def agent_self(self, m, query, body):

@@ -228,6 +228,87 @@ def cmd_eval_status(args):
     return 0
 
 
+def cmd_deployment_list(args):
+    client = _client(args)
+    rows = client.deployments()
+    print(f"{'ID':<10} {'Job ID':<24} {'Status':<12} Description")
+    for d in rows:
+        print(
+            f"{d['id'][:8]:<10} {d['job_id'][:22]:<24} "
+            f"{d['status']:<12} {d['status_description']}"
+        )
+    return 0
+
+
+def cmd_deployment_status(args):
+    client = _client(args)
+    d = client.deployment(args.deployment_id)
+    print(f"ID          = {d['id']}")
+    print(f"Job ID      = {d['job_id']}")
+    print(f"Job Version = {d['job_version']}")
+    print(f"Status      = {d['status']}")
+    print(f"Description = {d['status_description']}")
+    print()
+    print("Deployed")
+    print(f"{'Task Group':<12} {'Desired':>8} {'Placed':>8} {'Healthy':>8} {'Unhealthy':>10}")
+    for name, s in d.get("task_groups", {}).items():
+        print(
+            f"{name:<12} {s['desired_total']:>8} {s['placed_allocs']:>8} "
+            f"{s['healthy_allocs']:>8} {s['unhealthy_allocs']:>10}"
+        )
+    return 0
+
+
+def cmd_deployment_promote(args):
+    _client(args).deployment_promote(args.deployment_id, groups=args.group or None)
+    print(f"Deployment {args.deployment_id[:8]} promoted")
+    return 0
+
+
+def cmd_deployment_fail(args):
+    _client(args).deployment_fail(args.deployment_id)
+    print(f"Deployment {args.deployment_id[:8]} marked as failed")
+    return 0
+
+
+def cmd_deployment_pause(args):
+    _client(args).deployment_pause(args.deployment_id, not args.resume)
+    verb = "resumed" if args.resume else "paused"
+    print(f"Deployment {args.deployment_id[:8]} {verb}")
+    return 0
+
+
+def cmd_job_revert(args):
+    out = _client(args).job_revert(args.job_id, args.version)
+    print(f"Job {args.job_id} reverted to version {args.version}")
+    if out.get("EvalID"):
+        print(f"Evaluation ID: {out['EvalID']}")
+    return 0
+
+
+def cmd_job_history(args):
+    client = _client(args)
+    versions = client.job_versions(args.job_id)
+    for v in versions:
+        print(f"Version     = {v['version']}")
+        print(f"Stable      = {v['stable']}")
+        print(f"Submit Date = {v.get('submit_time', 0)}")
+        print()
+    return 0
+
+
+def cmd_job_deployments(args):
+    client = _client(args)
+    rows = client.job_deployments(args.job_id)
+    print(f"{'ID':<10} {'Job Version':>12} {'Status':<12} Description")
+    for d in rows:
+        print(
+            f"{d['id'][:8]:<10} {d['job_version']:>12} {d['status']:<12} "
+            f"{d['status_description']}"
+        )
+    return 0
+
+
 def cmd_server_members(args):
     client = _client(args)
     info = client.agent_self()
@@ -278,6 +359,16 @@ def build_parser() -> argparse.ArgumentParser:
     ji = jsub.add_parser("init")
     ji.add_argument("filename", nargs="?")
     ji.set_defaults(fn=cmd_job_init)
+    jrv = jsub.add_parser("revert")
+    jrv.add_argument("job_id")
+    jrv.add_argument("version", type=int)
+    jrv.set_defaults(fn=cmd_job_revert)
+    jh = jsub.add_parser("history")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
+    jd = jsub.add_parser("deployments")
+    jd.add_argument("job_id")
+    jd.set_defaults(fn=cmd_job_deployments)
 
     node = sub.add_parser("node", help="node commands")
     nsub = node.add_subparsers(dest="subcommand")
@@ -300,6 +391,25 @@ def build_parser() -> argparse.ArgumentParser:
     est = esub.add_parser("status")
     est.add_argument("eval_id")
     est.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment", help="deployment commands")
+    dsub = dep.add_subparsers(dest="subcommand")
+    dl = dsub.add_parser("list")
+    dl.set_defaults(fn=cmd_deployment_list)
+    dst = dsub.add_parser("status")
+    dst.add_argument("deployment_id")
+    dst.set_defaults(fn=cmd_deployment_status)
+    dp = dsub.add_parser("promote")
+    dp.add_argument("deployment_id")
+    dp.add_argument("-group", action="append")
+    dp.set_defaults(fn=cmd_deployment_promote)
+    df = dsub.add_parser("fail")
+    df.add_argument("deployment_id")
+    df.set_defaults(fn=cmd_deployment_fail)
+    dpa = dsub.add_parser("pause")
+    dpa.add_argument("deployment_id")
+    dpa.add_argument("-resume", action="store_true")
+    dpa.set_defaults(fn=cmd_deployment_pause)
 
     server = sub.add_parser("server", help="server commands")
     ssub = server.add_subparsers(dest="subcommand")

@@ -203,8 +203,54 @@ class AllocRunner:
         for tr in self.task_runners.values():
             if tr.driver is not None:
                 tr.start()
+        if self.alloc.deployment_id:
+            # Health watcher hook (ref allocrunner/health_hook.go +
+            # allochealth/tracker.go): report deployment health once all
+            # tasks have been running for min_healthy_time, or unhealthy
+            # on failure / healthy_deadline expiry. Started only after the
+            # runner map is fully populated (it iterates task_runners).
+            t = threading.Thread(target=self._watch_health, daemon=True)
+            t.start()
         if missing_driver:
             self.task_state_updated()
+
+    def _watch_health(self):
+        """ref allochealth/tracker.go: watch task states until the alloc
+        is provably healthy or unhealthy, then report once."""
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        strategy = tg.update if tg is not None else None
+        min_healthy = (strategy.min_healthy_time if strategy else 0) / 1e9
+        deadline_ns = strategy.healthy_deadline if strategy else 0
+        deadline = time.monotonic() + (deadline_ns / 1e9 if deadline_ns else 300.0)
+        healthy_since = None
+        while not self._destroyed:
+            states = [tr.state for tr in self.task_runners.values()]
+            if any(s.failed for s in states):
+                self._set_health(False)
+                return
+            if states and all(s.state == "running" for s in states):
+                if healthy_since is None:
+                    healthy_since = time.monotonic()
+                if time.monotonic() - healthy_since >= min_healthy:
+                    self._set_health(True)
+                    return
+            else:
+                healthy_since = None
+            if time.monotonic() > deadline:
+                self._set_health(False)
+                return
+            time.sleep(0.05)
+
+    def _set_health(self, healthy: bool):
+        from ..structs.model import DeploymentStatus
+
+        with self._lock:
+            ds = self.alloc.deployment_status or DeploymentStatus()
+            ds.healthy = healthy
+            ds.timestamp = now_ns()
+            self.alloc.deployment_status = ds
+        self.task_state_updated()
 
     def client_status(self) -> str:
         """Aggregate task states into the alloc's client status
@@ -364,7 +410,10 @@ class Client:
             if runner is None:
                 if alloc.server_terminal_status() or alloc.client_terminal_status():
                     continue
-                runner = AllocRunner(self, alloc)
+                # Copy: in-process transport hands us the state store's own
+                # objects; the reference's msgpack RPC boundary implies a
+                # copy, and runner hooks mutate alloc fields (health).
+                runner = AllocRunner(self, alloc.copy())
                 self.alloc_runners[alloc_id] = runner
                 runner.run()
             else:

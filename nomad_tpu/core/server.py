@@ -44,6 +44,7 @@ from ..structs.node_class import compute_class
 from . import fsm as fsm_mod
 from .blocked_evals import BlockedEvals
 from .broker import EvalBroker
+from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
 from .fsm import FSM
 from .plan_apply import Planner
 from .worker import Worker
@@ -67,7 +68,7 @@ class Server:
         )
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.periodic = None  # PeriodicDispatch attaches in agent wiring
-        self.deployment_watcher = None
+        self.deployment_watcher = None  # set by DeploymentsWatcher below
         self.drainer = None
         self.fsm = FSM(
             state=self.state,
@@ -86,6 +87,7 @@ class Server:
         self._leader_cond = threading.Condition()
         self._reaper: Optional[threading.Thread] = None
 
+        DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
         self.raft = self._setup_raft()
 
     # ------------------------------------------------------------------
@@ -588,3 +590,8 @@ class Server:
                 )
             )
         return evals
+
+
+# Deployment RPC surface (ref nomad/deployment_endpoint.go) lives in
+# deployment_watcher.py; attach its methods to Server here.
+install_deployment_endpoints(Server)

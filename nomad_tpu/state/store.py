@@ -34,6 +34,7 @@ from ..structs.model import (
     NODE_SCHED_INELIGIBLE,
     NODE_STATUS_DOWN,
     DEPLOYMENT_STATUS_DESC_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
     Allocation,
     Deployment,
     DeploymentStatus,
@@ -830,23 +831,64 @@ class StateStore(StateReader):
     def update_deployment_status(self, index: int, update: DeploymentStatusUpdate):
         gen = self._gen
         deployments = dict(gen.deployments)
-        self._apply_deployment_update(deployments, index, update)
-        self._publish(
-            index=index,
-            deployments=deployments,
-            table_indexes=self._bump(gen, index, "deployment"),
+        jobs = dict(gen.jobs)
+        versions = dict(gen.job_versions)
+        stabilized = self._apply_deployment_update(
+            deployments, jobs, versions, index, update
         )
+        if stabilized:
+            self._publish(
+                index=index,
+                deployments=deployments,
+                jobs=jobs,
+                job_versions=versions,
+                table_indexes=self._bump(
+                    gen, index, "deployment", "jobs", "job_version"
+                ),
+            )
+        else:
+            self._publish(
+                index=index,
+                deployments=deployments,
+                table_indexes=self._bump(gen, index, "deployment"),
+            )
 
-    @staticmethod
-    def _apply_deployment_update(deployments, index, update):
+    @classmethod
+    def _apply_deployment_update(cls, deployments, jobs, versions, index, update):
+        """Returns True when the jobs/job_versions tables were touched."""
         d = deployments.get(update.deployment_id)
         if d is None:
-            return
+            return False
         d = d.copy()
         d.status = update.status
         d.status_description = update.status_description
         d.modify_index = index
         deployments[d.id] = d
+        # A successful deployment marks its job version stable
+        # (ref state_store.go updateDeploymentStatusImpl → UpdateJobStability)
+        if update.status == DEPLOYMENT_STATUS_SUCCESSFUL:
+            cls._stabilize_job_impl(
+                jobs, versions, index, d.namespace, d.job_id, d.job_version, True
+            )
+            return True
+        return False
+
+    @staticmethod
+    def _stabilize_job_impl(jobs, versions, index, namespace, job_id, version, stable):
+        """Flip the stable flag on a job version in-transaction (shared by
+        deployment success and explicit UpdateJobStability)."""
+        vj = versions.get((namespace, job_id, version))
+        if vj is not None:
+            vj = vj.copy()
+            vj.stable = stable
+            vj.modify_index = index
+            versions[(namespace, job_id, version)] = vj
+        cur = jobs.get((namespace, job_id))
+        if cur is not None and cur.version == version:
+            cur = cur.copy()
+            cur.stable = stable
+            cur.modify_index = index
+            jobs[(namespace, job_id)] = cur
 
     @_write_txn
     def update_deployment_promotion(
@@ -976,18 +1018,9 @@ class StateStore(StateReader):
         gen = self._gen
         versions = dict(gen.job_versions)
         jobs = dict(gen.jobs)
-        vj = versions.get((namespace, job_id, version))
-        if vj is not None:
-            vj = vj.copy()
-            vj.stable = stable
-            vj.modify_index = index
-            versions[(namespace, job_id, version)] = vj
-        cur = jobs.get((namespace, job_id))
-        if cur is not None and cur.version == version:
-            cur = cur.copy()
-            cur.stable = stable
-            cur.modify_index = index
-            jobs[(namespace, job_id)] = cur
+        self._stabilize_job_impl(
+            jobs, versions, index, namespace, job_id, version, stable
+        )
         self._publish(
             index=index,
             jobs=jobs,
@@ -1047,12 +1080,16 @@ class StateStore(StateReader):
         summaries = dict(gen.job_summaries)
         deployments = dict(gen.deployments)
         evals_table = dict(gen.evals)
+        jobs_table = dict(gen.jobs)
+        versions_table = dict(gen.job_versions)
         jobs_touched: dict[tuple[str, str], str] = {}
 
         if result.deployment is not None:
             self._upsert_deployment_impl(deployments, index, result.deployment.copy())
         for update in result.deployment_updates:
-            self._apply_deployment_update(deployments, index, update)
+            self._apply_deployment_update(
+                deployments, jobs_table, versions_table, index, update
+            )
 
         if plan.eval_id and plan.eval_id in evals_table:
             ev = evals_table[plan.eval_id].copy()
@@ -1080,17 +1117,19 @@ class StateStore(StateReader):
             self._nested_upsert_eval(gen, evals_table, index, ev.copy(), jobs_touched)
 
         jobs = self._set_job_statuses(
-            dict(gen.jobs), allocs_table, evals_table, index, jobs_touched
+            jobs_table, allocs_table, evals_table, index, jobs_touched
         )
         self._publish(
             index=index,
             allocs=allocs_table,
             jobs=jobs,
+            job_versions=versions_table,
             evals=evals_table,
             job_summaries=summaries,
             deployments=deployments,
             table_indexes=self._bump(
-                gen, index, "allocs", "jobs", "evals", "job_summary", "deployment"
+                gen, index, "allocs", "jobs", "job_version", "evals",
+                "job_summary", "deployment"
             ),
         )
         return index

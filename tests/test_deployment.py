@@ -1,0 +1,262 @@
+"""Deployment watcher end-to-end tests (semantics ref:
+nomad/deploymentwatcher/deployments_watcher_test.go).
+
+All scenarios run on the in-process dev agent with the mock driver; health
+is reported by the client's alloc health watcher, and the leader's
+deployment watcher drives promotion / failure / revert.
+"""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.structs.model import (
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    UpdateStrategy,
+)
+
+SECOND_NS = 1_000_000_000
+
+
+def _deploy_job(count=2, canary=0, auto_promote=False, auto_revert=False,
+                run_for=60, exit_code=0):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": run_for, "exit_code": exit_code}
+    tg.tasks[0].resources.networks = []
+    tg.restart_policy.attempts = 0
+    tg.restart_policy.mode = "fail"
+    tg.reschedule_policy.attempts = 0
+    tg.reschedule_policy.unlimited = False
+    tg.update = UpdateStrategy(
+        max_parallel=count,
+        health_check="task_states",
+        # tasks must stay up 300ms to count healthy, so crash-looping
+        # tasks (run_for 0.1) report unhealthy instead of racing to healthy
+        min_healthy_time=int(0.3 * SECOND_NS),
+        healthy_deadline=10 * SECOND_NS,
+        progress_deadline=30 * SECOND_NS,
+        canary=canary,
+        auto_promote=auto_promote,
+        auto_revert=auto_revert,
+    )
+    return job
+
+
+def _wait(fn, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+class TestDeploymentE2E:
+    def _agent(self):
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=2, server_config={"seed": 7})
+        agent.start()
+        return agent
+
+    def test_initial_deployment_succeeds_and_stabilizes(self):
+        agent = self._agent()
+        try:
+            job = _deploy_job(count=2)
+            agent.run_job(job)
+
+            d = _wait(
+                lambda: agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )
+            )
+            assert d is not None, "no deployment created"
+
+            ok = _wait(
+                lambda: (
+                    agent.state.deployment_by_id(d.id).status
+                    == DEPLOYMENT_STATUS_SUCCESSFUL
+                )
+            )
+            final = agent.state.deployment_by_id(d.id)
+            assert ok, (final.status, final.status_description, final.task_groups)
+            # successful deployment marks the job version stable
+            assert agent.state.job_by_id(job.namespace, job.id).stable
+        finally:
+            agent.stop()
+
+    def test_canary_auto_promote(self):
+        agent = self._agent()
+        try:
+            job = _deploy_job(count=2)
+            agent.run_job(job)
+            _wait(
+                lambda: (d := agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )) is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL
+            )
+
+            # v1 with a canary + auto-promote
+            v1 = job.copy()
+            v1.task_groups[0].tasks[0].config = {"run_for": 61, "exit_code": 0}
+            v1.task_groups[0].update.canary = 1
+            v1.task_groups[0].update.auto_promote = True
+            agent.run_job(v1)
+
+            def canary_deployment():
+                d = agent.state.latest_deployment_by_job_id(job.namespace, job.id)
+                if d is not None and any(
+                    s.desired_canaries > 0 for s in d.task_groups.values()
+                ):
+                    return d
+                return None
+
+            d = _wait(canary_deployment)
+            assert d is not None, "no canary deployment created"
+
+            ok = _wait(
+                lambda: (
+                    agent.state.deployment_by_id(d.id).status
+                    == DEPLOYMENT_STATUS_SUCCESSFUL
+                ),
+                timeout=30,
+            )
+            final = agent.state.deployment_by_id(d.id)
+            assert ok, (final.status, final.status_description, final.task_groups)
+            assert all(s.promoted for s in final.task_groups.values())
+        finally:
+            agent.stop()
+
+    def test_unhealthy_alloc_fails_deployment_and_reverts(self):
+        agent = self._agent()
+        try:
+            job = _deploy_job(count=1, auto_revert=True)
+            agent.run_job(job)
+            _wait(
+                lambda: (d := agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )) is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL
+            )
+            assert agent.state.job_by_id(job.namespace, job.id).stable
+            v0 = agent.state.job_by_id(job.namespace, job.id).version
+
+            # v1 crashes immediately → unhealthy → deployment fails →
+            # auto-revert re-registers the stable v0 spec as a new version
+            v1 = job.copy()
+            v1.task_groups[0].tasks[0].config = {"run_for": 0.1, "exit_code": 1}
+            agent.run_job(v1)
+
+            def failed_deployment():
+                for d in agent.state.deployments():
+                    if (
+                        d.job_id == job.id
+                        and d.status == DEPLOYMENT_STATUS_FAILED
+                    ):
+                        return d
+                return None
+
+            d = _wait(failed_deployment, timeout=30)
+            assert d is not None, [
+                (x.status, x.status_description)
+                for x in agent.state.deployments()
+            ]
+            assert "rolling back" in d.status_description
+
+            # job rolled back: newest version runs the healthy config
+            reverted = _wait(
+                lambda: (
+                    agent.state.job_by_id(job.namespace, job.id).version
+                    > v0 + 1
+                )
+            )
+            assert reverted
+            cur = agent.state.job_by_id(job.namespace, job.id)
+            assert cur.task_groups[0].tasks[0].config["exit_code"] == 0
+        finally:
+            agent.stop()
+
+    def test_manual_pause_and_fail(self):
+        agent = self._agent()
+        try:
+            job = _deploy_job(count=1)
+            # long min_healthy_time keeps the deployment running long
+            # enough to pause it deterministically
+            job.task_groups[0].update.min_healthy_time = 60 * SECOND_NS
+            agent.run_job(job)
+            d = _wait(
+                lambda: agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )
+            )
+            assert d is not None
+
+            agent.server.deployment_pause(d.id, True)
+            assert (
+                agent.state.deployment_by_id(d.id).status
+                == DEPLOYMENT_STATUS_PAUSED
+            )
+            agent.server.deployment_pause(d.id, False)
+            assert (
+                agent.state.deployment_by_id(d.id).status
+                == DEPLOYMENT_STATUS_RUNNING
+            )
+
+            agent.server.deployment_fail(d.id)
+            final = agent.state.deployment_by_id(d.id)
+            assert final.status == DEPLOYMENT_STATUS_FAILED
+        finally:
+            agent.stop()
+
+
+class TestDeploymentHTTP:
+    def test_deployment_http_surface(self):
+        from nomad_tpu.agent import DevAgent
+        from nomad_tpu.api import ApiClient, HTTPServer
+
+        agent = DevAgent(num_clients=1, server_config={"seed": 7})
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        client = ApiClient(address=http.address)
+        try:
+            job = _deploy_job(count=1)
+            agent.run_job(job)
+            d = _wait(
+                lambda: agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )
+            )
+            assert d is not None
+
+            got = client.deployment(d.id)
+            assert got["job_id"] == job.id
+            assert client.job_deployments(job.id)
+            allocs = _wait(lambda: client.deployment_allocations(d.id))
+            assert allocs and allocs[0]["JobID"] == job.id
+
+            _wait(
+                lambda: client.deployment(d.id)["status"]
+                == DEPLOYMENT_STATUS_SUCCESSFUL
+            )
+
+            # revert via HTTP: v1 then back to v0
+            v1 = job.copy()
+            v1.task_groups[0].tasks[0].config = {"run_for": 61}
+            agent.run_job(v1)
+            _wait(
+                lambda: agent.state.job_by_id(job.namespace, job.id).version >= 1
+            )
+            out = client.job_revert(job.id, 0)
+            assert out["EvalID"]
+            versions = client.job_versions(job.id)
+            assert len(versions) >= 3
+        finally:
+            http.stop()
+            agent.stop()
