@@ -87,11 +87,20 @@ class ApiClient:
     def node_allocations(self, node_id: str):
         return self.get(f"/v1/node/{node_id}/allocations")[0]
 
-    def drain_node(self, node_id: str, enable: bool = True):
-        return self.put(
-            f"/v1/node/{node_id}/drain",
-            body={"DrainSpec": {} if enable else None},
-        )[0]
+    def drain_node(
+        self,
+        node_id: str,
+        enable: bool = True,
+        deadline_ns: int = 0,
+        ignore_system_jobs: bool = False,
+    ):
+        body = {"DrainSpec": None}
+        if enable:
+            body["DrainSpec"] = {
+                "Deadline": deadline_ns,
+                "IgnoreSystemJobs": ignore_system_jobs,
+            }
+        return self.put(f"/v1/node/{node_id}/drain", body=body)[0]
 
     def allocations(self, prefix: str = ""):
         return self.get(

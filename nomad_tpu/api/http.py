@@ -274,8 +274,21 @@ class HTTPServer:
 
     @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/drain")
     def node_drain(self, m, query, body):
-        enable = bool((body or {}).get("DrainSpec"))
-        self.server.node_drain(m["node_id"], enable)
+        body = body or {}
+        spec = body.get("DrainSpec")
+        # a present-but-empty spec means enable-with-defaults (the
+        # reference distinguishes nil vs non-nil DrainSpec)
+        if spec is not None:
+            self.server.node_drain(
+                m["node_id"],
+                True,
+                deadline_ns=int(spec.get("Deadline", 0)),
+                ignore_system_jobs=bool(spec.get("IgnoreSystemJobs", False)),
+            )
+        else:
+            self.server.node_drain(
+                m["node_id"], False, mark_eligible=body.get("MarkEligible")
+            )
         return {"NodeModifyIndex": self.server.state.latest_index()}, None
 
     @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/eligibility")

@@ -193,8 +193,26 @@ def cmd_node_status(args):
 def cmd_node_drain(args):
     client = _client(args)
     enable = not args.disable
-    client.drain_node(args.node_id, enable)
+    deadline_ns = 0
+    if enable and args.deadline:
+        from ..jobspec.hcl import parse_duration as hcl_duration
+
+        deadline_ns = hcl_duration(args.deadline)
+    client.drain_node(
+        args.node_id,
+        enable,
+        deadline_ns=deadline_ns,
+        ignore_system_jobs=args.ignore_system,
+    )
     print(f"Node {args.node_id[:8]} drain {'enabled' if enable else 'disabled'}")
+    return 0
+
+
+def cmd_node_eligibility(args):
+    client = _client(args)
+    elig = "ineligible" if args.elig_disable else "eligible"
+    client.put(f"/v1/node/{args.node_id}/eligibility", body={"Eligibility": elig})
+    print(f"Node {args.node_id[:8]} marked {elig}")
     return 0
 
 
@@ -378,7 +396,15 @@ def build_parser() -> argparse.ArgumentParser:
     nd = nsub.add_parser("drain")
     nd.add_argument("node_id")
     nd.add_argument("-disable", action="store_true")
+    nd.add_argument("-deadline", default="", help='force deadline, e.g. "5m"')
+    nd.add_argument("-ignore-system", dest="ignore_system", action="store_true")
     nd.set_defaults(fn=cmd_node_drain)
+    ne = nsub.add_parser("eligibility")
+    ne.add_argument("node_id")
+    ne_group = ne.add_mutually_exclusive_group(required=True)
+    ne_group.add_argument("-enable", dest="elig_enable", action="store_true")
+    ne_group.add_argument("-disable", dest="elig_disable", action="store_true")
+    ne.set_defaults(fn=cmd_node_eligibility)
 
     alloc = sub.add_parser("alloc", help="allocation commands")
     asub = alloc.add_subparsers(dest="subcommand")

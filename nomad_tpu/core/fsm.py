@@ -161,7 +161,16 @@ class FSM:
         return index
 
     def _apply_node_drain_update(self, index: int, payload: dict):
-        self.state.update_node_drain(index, payload["node_id"], payload["drain"])
+        from ..structs.model import DrainStrategy
+
+        strategy = payload.get("drain_strategy")
+        self.state.update_node_drain(
+            index,
+            payload["node_id"],
+            payload["drain"],
+            strategy=DrainStrategy.from_dict(strategy) if strategy else None,
+            mark_eligible=payload.get("mark_eligible", False),
+        )
         return index
 
     def _apply_node_eligibility_update(self, index: int, payload: dict):

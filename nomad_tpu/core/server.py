@@ -45,6 +45,7 @@ from . import fsm as fsm_mod
 from .blocked_evals import BlockedEvals
 from .broker import EvalBroker
 from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
+from .drainer import NodeDrainer
 from .fsm import FSM
 from .plan_apply import Planner
 from .worker import Worker
@@ -88,6 +89,7 @@ class Server:
         self._reaper: Optional[threading.Thread] = None
 
         DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
+        NodeDrainer(self)  # installs itself as self.drainer
         self.raft = self._setup_raft()
 
     # ------------------------------------------------------------------
@@ -402,33 +404,55 @@ class Server:
         self._reset_heartbeat(node_id)
         return {"heartbeat_ttl": self.heartbeat_ttl}
 
-    def node_drain(self, node_id: str, drain: bool):
-        """ref node_endpoint.go UpdateDrain"""
+    def node_drain(
+        self,
+        node_id: str,
+        drain: bool,
+        deadline_ns: int = 0,
+        ignore_system_jobs: bool = False,
+        mark_eligible: Optional[bool] = None,
+    ):
+        """ref node_endpoint.go UpdateDrain: the drainer subsystem paces the
+        actual migrations; a deadline forces whatever remains."""
         self._check_leader()
-        self._apply(fsm_mod.NODE_DRAIN_UPDATE, {"node_id": node_id, "drain": drain})
+        node_id = self._node_id_by_prefix(node_id)
+        payload = {"node_id": node_id, "drain": drain}
         if drain:
-            if self.drainer is not None:
-                self.drainer.notify()
-            else:
-                # without the drainer subsystem: immediately mark this
-                # node's allocs for migration
-                transitions = {
-                    a.id: {"migrate": True}
-                    for a in self.state.allocs_by_node_terminal(node_id, False)
-                }
-                if transitions:
-                    self._apply(
-                        fsm_mod.ALLOC_DESIRED_TRANSITION,
-                        {"allocs": transitions, "evals": []},
-                    )
+            payload["drain_strategy"] = {
+                "deadline": deadline_ns,
+                "force_deadline": (now_ns() + deadline_ns) if deadline_ns > 0 else 0,
+                "ignore_system_jobs": ignore_system_jobs,
+            }
+        else:
+            # cancelling a drain re-marks eligible unless told otherwise
+            payload["mark_eligible"] = (
+                True if mark_eligible is None else mark_eligible
+            )
+        self._apply(fsm_mod.NODE_DRAIN_UPDATE, payload)
+        if drain and self.drainer is not None:
+            self.drainer.notify()
         self._create_node_evals(node_id)
 
     def node_update_eligibility(self, node_id: str, eligibility: str):
         self._check_leader()
         self._apply(
             fsm_mod.NODE_ELIGIBILITY_UPDATE,
-            {"node_id": node_id, "eligibility": eligibility},
+            {"node_id": self._node_id_by_prefix(node_id), "eligibility": eligibility},
         )
+
+    def _node_id_by_prefix(self, node_id: str) -> str:
+        """Resolve a short node ID to the full ID (the CLI prints 8-char
+        prefixes, matching the reference's prefix-tolerant lookups)."""
+        if self.state.node_by_id(node_id) is not None:
+            return node_id
+        matches = self.state.node_by_prefix(node_id)
+        if len(matches) > 1:
+            raise ValueError(
+                f"ambiguous node prefix {node_id!r} ({len(matches)} matches)"
+            )
+        if not matches:
+            raise KeyError(f"node not found: {node_id}")
+        return matches[0].id
 
     def _reset_heartbeat(self, node_id: str):
         """ref heartbeat.go:33-212 resetHeartbeatTimer (leader-only)"""

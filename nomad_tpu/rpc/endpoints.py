@@ -36,7 +36,13 @@ def register_endpoints(server, rpc) -> None:
         return server.node_update_status(p["node_id"], p["status"])
 
     def node_drain(p):
-        server.node_drain(p["node_id"], p["drain"])
+        server.node_drain(
+            p["node_id"],
+            p["drain"],
+            deadline_ns=p.get("deadline_ns", 0),
+            ignore_system_jobs=p.get("ignore_system_jobs", False),
+            mark_eligible=p.get("mark_eligible"),
+        )
         return {}
 
     def node_eligibility(p):

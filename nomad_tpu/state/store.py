@@ -369,9 +369,35 @@ class StateStore(StateReader):
         )
 
     @_write_txn
-    def update_node_drain(self, index: int, node_id: str, drain: bool):
-        elig = NODE_SCHED_INELIGIBLE if drain else NODE_SCHED_ELIGIBLE
-        self._update_node(index, node_id, drain=drain, scheduling_eligibility=elig)
+    def update_node_drain(
+        self,
+        index: int,
+        node_id: str,
+        drain: bool,
+        strategy=None,
+        mark_eligible: bool = False,
+    ):
+        """ref state_store.go UpdateNodeDrain: entering drain makes the node
+        ineligible; completing a drain keeps it ineligible unless the caller
+        explicitly re-marks it eligible."""
+        if drain:
+            elig = NODE_SCHED_INELIGIBLE
+        elif mark_eligible:
+            elig = NODE_SCHED_ELIGIBLE
+        else:
+            existing = self._gen.nodes.get(node_id)
+            elig = (
+                existing.scheduling_eligibility
+                if existing is not None
+                else NODE_SCHED_INELIGIBLE
+            )
+        self._update_node(
+            index,
+            node_id,
+            drain=drain,
+            drain_strategy=strategy if drain else None,
+            scheduling_eligibility=elig,
+        )
 
     @_write_txn
     def update_node_eligibility(self, index: int, node_id: str, eligibility: str):

@@ -503,6 +503,19 @@ class ClientHostVolumeConfig(Base):
 
 
 @dataclass
+class DrainStrategy(Base):
+    """ref structs.go DrainStrategy/DrainSpec: how long a drain may take
+    before remaining allocs are force-migrated."""
+
+    deadline: int = 0  # ns duration requested by the operator
+    force_deadline: int = 0  # absolute ns wall-clock when the drain forces
+    ignore_system_jobs: bool = False
+
+    def deadline_passed(self) -> bool:
+        return 0 < self.force_deadline < now_ns()
+
+
+@dataclass
 class Node(Base):
     id: str = ""
     name: str = ""
@@ -519,6 +532,7 @@ class Node(Base):
     status_description: str = ""
     scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
     drain: bool = False
+    drain_strategy: Optional[DrainStrategy] = None
     computed_class: str = ""
     http_addr: str = ""
     secret_id: str = ""

@@ -1,0 +1,191 @@
+"""Node drainer tests (semantics ref: nomad/drainer/drainer_int_test.go,
+watch_jobs_test.go): migration pacing, force deadlines, system-jobs-last,
+and end-to-end drain with replacement placement."""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server
+from nomad_tpu.structs.model import MigrateStrategy
+
+from tests.test_deployment import _wait
+
+SECOND_NS = 1_000_000_000
+
+
+def _place_allocs(server, job, node, count):
+    """Insert running allocs for job on node directly into state."""
+    allocs = []
+    for i in range(count):
+        a = mock.alloc()
+        a.namespace, a.job_id, a.job = job.namespace, job.id, job
+        a.node_id = node.id
+        a.task_group = job.task_groups[0].name
+        a.name = f"{job.id}.{a.task_group}[{i}]"
+        a.client_status = "running"
+        a.desired_status = "run"
+        allocs.append(a)
+    server.state.upsert_allocs(None, allocs)
+    return allocs
+
+
+class TestDrainerPacing:
+    def _server(self):
+        s = Server({"seed": 7})
+        s.start(num_workers=0)
+        assert s.wait_for_leader(5)
+        return s
+
+    def test_max_parallel_paces_migrations(self):
+        s = self._server()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 3
+            job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+            s.state.upsert_job(None, job)
+            node = mock.node()
+            s.state.upsert_node(None, node)
+            _place_allocs(s, job, node, 3)
+
+            s.node_drain(node.id, True)
+
+            # with no clients, replacements never start: exactly one alloc
+            # may ever be in-flight under max_parallel=1
+            _wait(
+                lambda: any(
+                    a.desired_transition.should_migrate()
+                    for a in s.state.allocs_by_node(node.id)
+                )
+            )
+            time.sleep(1.0)  # give the drainer time to (wrongly) mark more
+            migrating = [
+                a
+                for a in s.state.allocs_by_node(node.id)
+                if a.desired_transition.should_migrate()
+            ]
+            assert len(migrating) == 1, [a.id[:8] for a in migrating]
+        finally:
+            s.stop()
+
+    def test_force_deadline_migrates_everything(self):
+        s = self._server()
+        try:
+            job = mock.job()
+            job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+            s.state.upsert_job(None, job)
+            node = mock.node()
+            s.state.upsert_node(None, node)
+            _place_allocs(s, job, node, 3)
+
+            s.node_drain(node.id, True, deadline_ns=int(0.5 * SECOND_NS))
+            ok = _wait(
+                lambda: all(
+                    a.desired_transition.should_migrate()
+                    for a in s.state.allocs_by_node(node.id)
+                ),
+                timeout=10,
+            )
+            assert ok, [
+                (a.id[:8], a.desired_transition)
+                for a in s.state.allocs_by_node(node.id)
+            ]
+        finally:
+            s.stop()
+
+    def test_system_allocs_drain_last(self):
+        s = self._server()
+        try:
+            svc = mock.job()
+            svc.task_groups[0].migrate = MigrateStrategy(max_parallel=10)
+            s.state.upsert_job(None, svc)
+            sysjob = mock.system_job()
+            s.state.upsert_job(None, sysjob)
+            node = mock.node()
+            s.state.upsert_node(None, node)
+            svc_allocs = _place_allocs(s, svc, node, 1)
+            sys_allocs = _place_allocs(s, sysjob, node, 1)
+
+            s.node_drain(node.id, True)
+            _wait(
+                lambda: s.state.alloc_by_id(svc_allocs[0].id)
+                .desired_transition.should_migrate()
+            )
+            # system alloc holds while service work is still on the node
+            assert not (
+                s.state.alloc_by_id(sys_allocs[0].id)
+                .desired_transition.should_migrate()
+            )
+
+            # service alloc leaves → system alloc drains
+            done = svc_allocs[0].copy()
+            done.client_status = "complete"
+            s.state.update_allocs_from_client(None, [done])
+            ok = _wait(
+                lambda: s.state.alloc_by_id(sys_allocs[0].id)
+                .desired_transition.should_migrate(),
+                timeout=10,
+            )
+            assert ok
+        finally:
+            s.stop()
+
+
+class TestDrainE2E:
+    def test_drain_migrates_and_completes(self):
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=2, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.migrate = MigrateStrategy(max_parallel=1)
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": 60}
+            tg.tasks[0].resources.networks = []
+            agent.run_job(job)
+
+            alloc = _wait(
+                lambda: next(
+                    (
+                        a
+                        for a in agent.state.allocs_by_job(job.namespace, job.id)
+                        if a.client_status == "running"
+                    ),
+                    None,
+                )
+            )
+            assert alloc is not None
+            src_node = alloc.node_id
+
+            agent.server.node_drain(src_node, True)
+
+            # replacement lands on the other node and runs
+            repl = _wait(
+                lambda: next(
+                    (
+                        a
+                        for a in agent.state.allocs_by_job(job.namespace, job.id)
+                        if a.node_id != src_node and a.client_status == "running"
+                    ),
+                    None,
+                ),
+                timeout=30,
+            )
+            assert repl is not None, [
+                (a.node_id[:8], a.client_status, a.desired_status)
+                for a in agent.state.allocs_by_job(job.namespace, job.id)
+            ]
+
+            # drain completes: flag cleared, node stays ineligible
+            ok = _wait(
+                lambda: not agent.state.node_by_id(src_node).drain, timeout=30
+            )
+            assert ok
+            assert (
+                agent.state.node_by_id(src_node).scheduling_eligibility
+                == "ineligible"
+            )
+        finally:
+            agent.stop()
