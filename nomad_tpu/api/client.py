@@ -148,6 +148,18 @@ class ApiClient:
     def job_versions(self, job_id: str):
         return self.get(f"/v1/job/{job_id}/versions")[0]
 
+    def job_dispatch(self, job_id: str, payload: str = "", meta=None):
+        import base64 as _b64
+
+        body = {
+            "Payload": _b64.b64encode(payload.encode()).decode() if payload else "",
+            "Meta": meta or {},
+        }
+        return self.put(f"/v1/job/{job_id}/dispatch", body=body)[0]
+
+    def job_periodic_force(self, job_id: str):
+        return self.put(f"/v1/job/{job_id}/periodic/force")[0]
+
     def agent_self(self):
         return self.get("/v1/agent/self")[0]
 

@@ -412,6 +412,32 @@ class HTTPServer:
         )
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/dispatch")
+    def job_dispatch(self, m, query, body):
+        body = body or {}
+        import base64 as _b64
+
+        payload = body.get("Payload", "")
+        if payload:
+            try:
+                payload = _b64.b64decode(payload).decode()
+            except Exception:
+                pass  # accept raw strings too
+        out = self.server.job_dispatch(
+            query.get("namespace", "default"),
+            m["job_id"],
+            payload=payload,
+            meta=body.get("Meta") or {},
+        )
+        return out, None
+
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/periodic/force")
+    def job_periodic_force(self, m, query, body):
+        child_id = self.server.periodic_force(
+            query.get("namespace", "default"), m["job_id"]
+        )
+        return {"DispatchedJobID": child_id}, None
+
     @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/revert")
     def job_revert(self, m, query, body):
         body = body or {}

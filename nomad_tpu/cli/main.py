@@ -93,8 +93,13 @@ def cmd_job_run(args):
     client = _client(args)
     resp = client.register_job(job.to_dict())
     eval_id = resp.get("EvalID", "")
+    if not eval_id:
+        # periodic/parameterized jobs register without a direct evaluation
+        kind = "periodic" if job.is_periodic() else "parameterized"
+        print(f"==> Registered {kind} job {job.id!r} (no evaluation created)")
+        return 0
     print(f"==> Evaluation {eval_id[:8]} created")
-    if args.detach or not eval_id:
+    if args.detach:
         return 0
     deadline = time.time() + 30
     while time.time() < deadline:
@@ -304,6 +309,31 @@ def cmd_job_revert(args):
     return 0
 
 
+def cmd_job_dispatch(args):
+    client = _client(args)
+    payload = ""
+    if args.payload_file:
+        with open(args.payload_file) as f:
+            payload = f.read()
+    meta = {}
+    for kv in args.meta or []:
+        if "=" not in kv:
+            print(f"Error: -meta expects KEY=VALUE, got {kv!r}", file=sys.stderr)
+            return 1
+        k, v = kv.split("=", 1)
+        meta[k] = v
+    out = client.job_dispatch(args.job_id, payload=payload, meta=meta)
+    print(f"Dispatched Job ID = {out['DispatchedJobID']}")
+    print(f"Evaluation ID     = {out['EvalID']}")
+    return 0
+
+
+def cmd_job_periodic_force(args):
+    out = _client(args).job_periodic_force(args.job_id)
+    print(f"Forced periodic launch: {out['DispatchedJobID']}")
+    return 0
+
+
 def cmd_job_history(args):
     client = _client(args)
     versions = client.job_versions(args.job_id)
@@ -377,6 +407,16 @@ def build_parser() -> argparse.ArgumentParser:
     ji = jsub.add_parser("init")
     ji.add_argument("filename", nargs="?")
     ji.set_defaults(fn=cmd_job_init)
+    jdp = jsub.add_parser("dispatch")
+    jdp.add_argument("job_id")
+    jdp.add_argument("payload_file", nargs="?")
+    jdp.add_argument("-meta", action="append", metavar="KEY=VALUE")
+    jdp.set_defaults(fn=cmd_job_dispatch)
+    jpf = jsub.add_parser("periodic")
+    jpf_sub = jpf.add_subparsers(dest="periodic_cmd")
+    jpff = jpf_sub.add_parser("force")
+    jpff.add_argument("job_id")
+    jpff.set_defaults(fn=cmd_job_periodic_force)
     jrv = jsub.add_parser("revert")
     jrv.add_argument("job_id")
     jrv.add_argument("version", type=int)

@@ -69,7 +69,10 @@ class NodeDrainer:
     def _run(self):
         state = self.server.state
         min_index = 0
-        while self._enabled:
+        me = threading.current_thread()
+        # the thread-identity check prevents two loops after a leadership
+        # flap inside the poll window (old thread exits when superseded)
+        while self._enabled and self._thread is me:
             try:
                 deadline_wait = self._tick()
             except Exception:
@@ -143,14 +146,16 @@ class NodeDrainer:
             if not movable and (ignore_system or not system):
                 self._finish_drain(node)
                 continue
-            if not movable and system:
-                # service/batch work is gone; system allocs drain now
-                # (ref drainer.go: system jobs drained after all others)
+            if system and not ignore_system and (not movable or force):
+                # system allocs drain once all other work has left the
+                # node — or immediately when the force deadline passes
+                # (ref drainer.go handleDeadlinedNodes drains everything)
                 for a in system:
                     if not a.desired_transition.should_migrate():
                         transitions[a.id] = {"migrate": True}
                         jobs_to_eval[(a.namespace, a.job_id)] = a.job
-                continue
+                if not movable:
+                    continue
 
             for a in movable:
                 if a.desired_transition.should_migrate():

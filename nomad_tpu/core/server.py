@@ -46,6 +46,7 @@ from .blocked_evals import BlockedEvals
 from .broker import EvalBroker
 from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
 from .drainer import NodeDrainer
+from .periodic import PeriodicDispatch, derive_dispatch_job
 from .fsm import FSM
 from .plan_apply import Planner
 from .worker import Worker
@@ -90,6 +91,7 @@ class Server:
 
         DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
         NodeDrainer(self)  # installs itself as self.drainer
+        PeriodicDispatch(self)  # attaches as self.periodic + FSM hook
         self.raft = self._setup_raft()
 
     # ------------------------------------------------------------------
@@ -336,6 +338,66 @@ class Server:
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return ev.id
 
+    def job_dispatch(
+        self,
+        namespace: str,
+        job_id: str,
+        payload: str = "",
+        meta: Optional[dict] = None,
+    ) -> dict:
+        """Instantiate a parameterized job (ref job_endpoint.go:1523
+        Dispatch): validates payload/meta against the job's parameterized
+        config, registers a derived child, and evaluates it."""
+        self._check_leader()
+        parent = self.state.job_by_id(namespace, job_id)
+        if parent is None:
+            raise KeyError(f"job not found: {job_id}")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id} is not parameterized")
+        if parent.stopped():
+            raise ValueError(f"job {job_id} is stopped")
+
+        cfg = parent.parameterized_job
+        meta = dict(meta or {})
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required by the job")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden by the job")
+        if len(payload) > 16 * 1024:
+            raise ValueError("payload exceeds maximum size (16KiB)")
+        missing = [k for k in cfg.meta_required if k not in meta]
+        if missing:
+            raise ValueError(f"missing required dispatch meta: {missing}")
+        allowed = set(cfg.meta_required) | set(cfg.meta_optional)
+        unknown = [k for k in meta if k not in allowed]
+        if unknown:
+            raise ValueError(f"dispatch meta not allowed by job: {unknown}")
+
+        child = derive_dispatch_job(parent, payload, meta)
+        self._apply(fsm_mod.JOB_REGISTER, {"job": child.to_dict()})
+        stored = self.state.job_by_id(namespace, child.id)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=stored.priority,
+            type=stored.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=stored.id,
+            job_modify_index=stored.modify_index,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
+        return {"DispatchedJobID": child.id, "EvalID": ev.id}
+
+    def periodic_force(self, namespace: str, job_id: str) -> str:
+        """ref periodic_endpoint.go Force"""
+        self._check_leader()
+        if self.periodic is None:
+            raise ValueError("periodic dispatcher not available")
+        return self.periodic.force_launch(namespace, job_id)
+
     @staticmethod
     def _validate_job(job: Job):
         """Minimal admission checks (ref job_endpoint.go validateJob)."""
@@ -345,6 +407,17 @@ class Server:
             raise ValueError("job requires at least one task group")
         if job.type == JOB_TYPE_CORE:
             raise ValueError("job type cannot be core")
+        if job.is_periodic():
+            # reject bad cron specs at admission: the dispatcher would
+            # otherwise silently never launch (ref structs.go
+            # PeriodicConfig.Validate)
+            from .periodic import CronSpec
+
+            if job.periodic.spec_type != "cron":
+                raise ValueError(
+                    f"unknown periodic spec type {job.periodic.spec_type!r}"
+                )
+            CronSpec(job.periodic.spec)
         for tg in job.task_groups:
             if tg.count < 0:
                 raise ValueError(f"task group {tg.name} count must be >= 0")

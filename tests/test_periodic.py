@@ -1,0 +1,220 @@
+"""Periodic dispatch + parameterized job tests (semantics ref:
+nomad/periodic_test.go, structs PeriodicConfig.Next via gorhill/cronexpr,
+job_endpoint Dispatch)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server
+from nomad_tpu.core.periodic import (
+    CronSpec,
+    derive_dispatch_job,
+    derived_job_id,
+    next_launch,
+)
+from nomad_tpu.structs.model import ParameterizedJobConfig, PeriodicConfig
+
+from tests.test_deployment import _wait
+
+
+def dt(*args):
+    return datetime(*args, tzinfo=timezone.utc)
+
+
+class TestCronSpec:
+    def test_every_minute(self):
+        assert CronSpec("* * * * *").next(dt(2026, 7, 29, 12, 0)) == dt(
+            2026, 7, 29, 12, 1
+        )
+
+    def test_step_minutes(self):
+        c = CronSpec("*/15 * * * *")
+        assert c.next(dt(2026, 7, 29, 12, 0)) == dt(2026, 7, 29, 12, 15)
+        assert c.next(dt(2026, 7, 29, 12, 50)) == dt(2026, 7, 29, 13, 0)
+
+    def test_fixed_daily(self):
+        c = CronSpec("30 4 * * *")
+        assert c.next(dt(2026, 7, 29, 5, 0)) == dt(2026, 7, 30, 4, 30)
+        assert c.next(dt(2026, 7, 29, 3, 0)) == dt(2026, 7, 29, 4, 30)
+
+    def test_dow(self):
+        # 2026-07-29 is a Wednesday; next Sunday is 08-02
+        c = CronSpec("0 0 * * 0")
+        assert c.next(dt(2026, 7, 29, 12, 0)) == dt(2026, 8, 2, 0, 0)
+
+    def test_dow_names_and_ranges(self):
+        c = CronSpec("0 9 * * mon-fri")
+        assert c.next(dt(2026, 7, 31, 10, 0)) == dt(2026, 8, 3, 9, 0)  # Fri→Mon
+
+    def test_dom_dow_union(self):
+        # both restricted: standard cron fires on either match
+        c = CronSpec("0 0 1 * 0")  # 1st of month OR Sunday
+        assert c.next(dt(2026, 7, 29, 1, 0)) == dt(2026, 8, 1, 0, 0)
+
+    def test_month_names(self):
+        c = CronSpec("0 0 1 jan *")
+        assert c.next(dt(2026, 7, 29, 0, 0)) == dt(2027, 1, 1, 0, 0)
+
+    def test_aliases(self):
+        assert CronSpec("@hourly").next(dt(2026, 7, 29, 12, 30)) == dt(
+            2026, 7, 29, 13, 0
+        )
+        assert CronSpec("@daily").next(dt(2026, 7, 29, 12, 30)) == dt(
+            2026, 7, 30, 0, 0
+        )
+
+    def test_invalid_specs(self):
+        for bad in ("* * * *", "61 * * * *", "* * * * * *", "a * * * *"):
+            with pytest.raises(ValueError):
+                CronSpec(bad)
+
+    def test_next_launch_ns(self):
+        job = mock.periodic_job()
+        job.periodic.spec = "*/30 * * * *"
+        after = int(dt(2026, 7, 29, 12, 0).timestamp() * 1e9)
+        nxt = next_launch(job, after)
+        assert nxt == int(dt(2026, 7, 29, 12, 30).timestamp() * 1e9)
+
+
+class TestPeriodicDispatch:
+    def _server(self):
+        s = Server({"seed": 7})
+        s.start(num_workers=0)
+        assert s.wait_for_leader(5)
+        return s
+
+    def test_periodic_job_tracked_not_scheduled(self):
+        s = self._server()
+        try:
+            job = mock.periodic_job()
+            eval_id = s.job_register(job)
+            assert eval_id == ""  # periodic jobs create no eval directly
+            assert s.periodic.tracked()
+            assert not s.state.evals_by_job(job.namespace, job.id)
+        finally:
+            s.stop()
+
+    def test_force_launch_creates_child(self):
+        s = self._server()
+        try:
+            job = mock.periodic_job()
+            s.job_register(job)
+            child_id = s.periodic_force(job.namespace, job.id)
+            assert child_id.startswith(f"{job.id}/periodic-")
+            child = s.state.job_by_id(job.namespace, child_id)
+            assert child is not None
+            assert child.parent_id == job.id
+            assert child.periodic is None
+            assert s.state.evals_by_job(job.namespace, child_id)
+            # launch checkpointed
+            launch = s.state.periodic_launch_by_id(job.namespace, job.id)
+            assert launch is not None
+        finally:
+            s.stop()
+
+    def test_prohibit_overlap_skips(self):
+        s = self._server()
+        try:
+            job = mock.periodic_job()
+            job.periodic.prohibit_overlap = True
+            s.job_register(job)
+            first = s.periodic_force(job.namespace, job.id)
+            # child is pending (no workers); second force must skip and
+            # report it (no phantom job id)
+            before = len(s.state.jobs_by_namespace(job.namespace))
+            with pytest.raises(ValueError, match="prohibit_overlap"):
+                s.periodic_force(job.namespace, job.id)
+            after = len(s.state.jobs_by_namespace(job.namespace))
+            assert before == after
+            assert s.state.job_by_id(job.namespace, first) is not None
+        finally:
+            s.stop()
+
+    def test_timer_fires(self):
+        s = self._server()
+        try:
+            job = mock.periodic_job()
+            job.periodic.spec = "* * * * *"  # every minute
+            s.job_register(job)
+            # fake the heap entry to fire immediately instead of waiting 60s
+            with s.periodic._cv:
+                assert s.periodic._heap
+                _, key, gen = s.periodic._heap[0]
+                from nomad_tpu.structs.model import now_ns
+
+                s.periodic._heap[0] = (now_ns() - 1, key, gen)
+                s.periodic._cv.notify_all()
+            child = _wait(
+                lambda: next(
+                    (
+                        j
+                        for j in s.state.jobs_by_namespace(job.namespace)
+                        if j.parent_id == job.id
+                    ),
+                    None,
+                ),
+                timeout=10,
+            )
+            assert child is not None
+        finally:
+            s.stop()
+
+
+class TestParameterizedDispatch:
+    def _server(self):
+        s = Server({"seed": 7})
+        s.start(num_workers=0)
+        assert s.wait_for_leader(5)
+        return s
+
+    def _param_job(self):
+        job = mock.batch_job()
+        job.parameterized_job = ParameterizedJobConfig(
+            payload="optional",
+            meta_required=["input"],
+            meta_optional=["verbose"],
+        )
+        return job
+
+    def test_dispatch_creates_child(self):
+        s = self._server()
+        try:
+            job = self._param_job()
+            assert s.job_register(job) == ""  # no direct eval
+            out = s.job_dispatch(
+                job.namespace, job.id, payload="hello", meta={"input": "x"}
+            )
+            child = s.state.job_by_id(job.namespace, out["DispatchedJobID"])
+            assert child.dispatched
+            assert child.payload == "hello"
+            assert child.meta["input"] == "x"
+            assert child.parent_id == job.id
+            assert not child.is_parameterized()  # children schedule normally
+            assert s.state.eval_by_id(out["EvalID"]) is not None
+        finally:
+            s.stop()
+
+    def test_dispatch_validation(self):
+        s = self._server()
+        try:
+            job = self._param_job()
+            s.job_register(job)
+            with pytest.raises(ValueError):  # missing required meta
+                s.job_dispatch(job.namespace, job.id)
+            with pytest.raises(ValueError):  # unknown meta key
+                s.job_dispatch(
+                    job.namespace, job.id, meta={"input": "x", "bogus": "y"}
+                )
+            job2 = self._param_job()
+            job2.id = "param2"
+            job2.parameterized_job.payload = "required"
+            job2.parameterized_job.meta_required = []
+            s.job_register(job2)
+            with pytest.raises(ValueError):  # payload required
+                s.job_dispatch(job2.namespace, job2.id)
+            with pytest.raises(KeyError):  # unknown job
+                s.job_dispatch("default", "nope")
+        finally:
+            s.stop()
