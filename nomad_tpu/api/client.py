@@ -10,6 +10,12 @@ import urllib.request
 from typing import Any, Optional
 
 
+def _q(segment: str) -> str:
+    """Percent-encode one path segment: derived child job IDs embed '/'
+    (``<id>/periodic-<ts>``) and must travel as a single segment."""
+    return urllib.parse.quote(segment, safe="")
+
+
 class APIError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(f"{status}: {message}")
@@ -63,20 +69,20 @@ class ApiClient:
         return self.put("/v1/jobs", body={"Job": job_dict})[0]
 
     def job(self, job_id: str) -> dict:
-        return self.get(f"/v1/job/{job_id}")[0]
+        return self.get(f"/v1/job/{_q(job_id)}")[0]
 
     def deregister_job(self, job_id: str, purge: bool = False) -> dict:
         params = {"purge": "true"} if purge else {}
-        return self.delete(f"/v1/job/{job_id}", **params)[0]
+        return self.delete(f"/v1/job/{_q(job_id)}", **params)[0]
 
     def job_allocations(self, job_id: str):
-        return self.get(f"/v1/job/{job_id}/allocations")[0]
+        return self.get(f"/v1/job/{_q(job_id)}/allocations")[0]
 
     def job_evaluations(self, job_id: str):
-        return self.get(f"/v1/job/{job_id}/evaluations")[0]
+        return self.get(f"/v1/job/{_q(job_id)}/evaluations")[0]
 
     def job_summary(self, job_id: str):
-        return self.get(f"/v1/job/{job_id}/summary")[0]
+        return self.get(f"/v1/job/{_q(job_id)}/summary")[0]
 
     def nodes(self):
         return self.get("/v1/nodes")[0]
@@ -138,15 +144,15 @@ class ApiClient:
         )[0]
 
     def job_deployments(self, job_id: str):
-        return self.get(f"/v1/job/{job_id}/deployments")[0]
+        return self.get(f"/v1/job/{_q(job_id)}/deployments")[0]
 
     def job_revert(self, job_id: str, version: int):
         return self.put(
-            f"/v1/job/{job_id}/revert", body={"JobVersion": version}
+            f"/v1/job/{_q(job_id)}/revert", body={"JobVersion": version}
         )[0]
 
     def job_versions(self, job_id: str):
-        return self.get(f"/v1/job/{job_id}/versions")[0]
+        return self.get(f"/v1/job/{_q(job_id)}/versions")[0]
 
     def job_dispatch(self, job_id: str, payload: str = "", meta=None):
         import base64 as _b64
@@ -155,10 +161,10 @@ class ApiClient:
             "Payload": _b64.b64encode(payload.encode()).decode() if payload else "",
             "Meta": meta or {},
         }
-        return self.put(f"/v1/job/{job_id}/dispatch", body=body)[0]
+        return self.put(f"/v1/job/{_q(job_id)}/dispatch", body=body)[0]
 
     def job_periodic_force(self, job_id: str):
-        return self.put(f"/v1/job/{job_id}/periodic/force")[0]
+        return self.put(f"/v1/job/{_q(job_id)}/periodic/force")[0]
 
     def agent_self(self):
         return self.get("/v1/agent/self")[0]

@@ -12,12 +12,31 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from ..jobspec.hcl import parse_duration
 from ..structs.model import Allocation, Job
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = []
+
+
+class _DecodedMatch:
+    """Percent-decodes captured path segments so derived child job IDs
+    (``<id>/periodic-<ts>``, ``<id>/dispatch-<ts>-<uuid>``) resolve when
+    clients encode the embedded '/' (ref http.go uses mux vars similarly)."""
+
+    def __init__(self, match: re.Match):
+        self._match = match
+
+    def group(self, *args):
+        g = self._match.group(*args)
+        if isinstance(g, tuple):
+            return tuple(unquote(x) if x else x for x in g)
+        return unquote(g) if g else g
+
+    def __getitem__(self, key):
+        g = self._match[key]
+        return unquote(g) if g else g
 
 
 def route(method: str, pattern: str):
@@ -68,7 +87,7 @@ class HTTPServer:
                     if match:
                         try:
                             result, index = getattr(api, name)(
-                                match, query, body
+                                _DecodedMatch(match), query, body
                             )
                             self._respond(200, result, index)
                         except KeyError as e:
@@ -490,7 +509,13 @@ class HTTPServer:
 
     @route("PUT", r"/v1/operator/scheduler/configuration")
     def set_scheduler_config(self, m, query, body):
-        self.server.state.set_scheduler_config(None, body or {})
+        # Must replicate via raft like every other write (ref
+        # operator_endpoint.go SchedulerSetConfiguration → raftApply):
+        # a direct state write would exist only on the serving server
+        # and vanish on failover.
+        from ..core import fsm as fsm_mod
+
+        self.server._apply(fsm_mod.SCHEDULER_CONFIG, {"config": body or {}})
         return {"Updated": True}, None
 
 

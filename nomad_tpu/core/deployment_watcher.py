@@ -117,7 +117,7 @@ class DeploymentWatcher:
             _, min_index = state.blocking_query(
                 query, min_index=min_index, timeout=timeout
             )
-        self.parent._watcher_done(self.deployment_id)
+        self.parent._watcher_done(self.deployment_id, self)
 
     def _arm_deadlines(self):
         d = self.server.state.deployment_by_id(self.deployment_id)
@@ -292,9 +292,12 @@ class DeploymentsWatcher:
     def _run(self):
         state = self.server.state
         min_index = 0
+        me = threading.current_thread()
         while True:
             with self._lock:
-                if not self._enabled:
+                # exit if disabled OR superseded by a newer manager thread
+                # (leadership flap inside the 2s blocking-query window)
+                if not self._enabled or self._thread is not me:
                     return
                 active = {
                     d.id
@@ -315,9 +318,12 @@ class DeploymentsWatcher:
                 query, min_index=min_index, timeout=2.0
             )
 
-    def _watcher_done(self, deployment_id: str):
+    def _watcher_done(self, deployment_id: str, watcher: "DeploymentWatcher"):
         with self._lock:
-            self._watchers.pop(deployment_id, None)
+            # only remove the exact instance: an old watcher exiting must not
+            # pop a freshly created watcher for the same deployment
+            if self._watchers.get(deployment_id) is watcher:
+                self._watchers.pop(deployment_id)
 
     # ------------------------------------------------------------------
     def latest_stable_job(

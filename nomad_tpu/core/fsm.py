@@ -186,6 +186,16 @@ class FSM:
         job = Job.from_dict(payload["job"])
         self.state.upsert_job(index, job)
         stored = self.state.job_by_id(job.namespace, job.id)
+        if stored.is_periodic() and not stored.stopped():
+            # Seed the launch checkpoint at registration (ref fsm.go
+            # applyUpsertJob → UpsertPeriodicLaunch when none exists) so a
+            # leader restored after downtime knows the job existed before
+            # the outage and can catch up its missed first run. Stamped
+            # with submit_time, which is deterministic across replicas.
+            if self.state.periodic_launch_by_id(stored.namespace, stored.id) is None:
+                self.state.upsert_periodic_launch(
+                    index, stored.namespace, stored.id, stored.submit_time
+                )
         if self.periodic_dispatcher is not None:
             # leader tracks periodic jobs as they are applied (fsm.go:330)
             if stored.is_periodic() and not stored.stopped():

@@ -200,11 +200,32 @@ class PeriodicDispatch:
                 self._cv.notify_all()
 
     def restore(self, state):
-        """Track all live periodic jobs on leadership, resuming from the
-        replicated last-launch times (ref leader.go restorePeriodicDispatcher)."""
+        """Track all live periodic jobs on leadership (ref leader.go
+        restorePeriodicDispatcher). Future launches are scheduled from *now*
+        (see add); for launches missed while there was no leader, force at
+        most ONE catch-up dispatch per job — never one per missed interval."""
+        now = now_ns()
+        catch_up: list[Job] = []
         for job in state.jobs_by_periodic():
-            if not job.stopped():
-                self.add(job)
+            if job.stopped():
+                continue
+            self.add(job)
+            launch = state.periodic_launch_by_id(*job.namespaced_id())
+            if launch is None:
+                continue
+            try:
+                nxt = next_launch(job, launch["launch"])
+            except ValueError:
+                continue
+            if nxt is not None and nxt <= now:
+                catch_up.append(job)
+        for job in catch_up:
+            try:
+                # launch stamped at *now* (ref periodic.go ForceRun), so the
+                # checkpoint advances and a second restore doesn't re-fire
+                self.dispatch(job, now_ns())
+            except Exception:
+                logger.exception("periodic catch-up launch of %s failed", job.id)
 
     # ------------------------------------------------------------------
     def add(self, job: Job):
@@ -213,10 +234,12 @@ class PeriodicDispatch:
             if not self._enabled:
                 return
             key = job.namespaced_id()
-            launch = self.server.state.periodic_launch_by_id(*key)
-            after = launch["launch"] if launch else now_ns()
+            # Schedule from *now*, not from the replicated last-launch
+            # (ref periodic.go Add → j.Periodic.Next(time.Now())): scheduling
+            # from a stale last-launch would enqueue every missed interval
+            # and storm the cluster with derived jobs after leader downtime.
             try:
-                nxt = next_launch(job, after)
+                nxt = next_launch(job, now_ns())
             except ValueError as e:
                 logger.error("periodic job %s: bad spec: %s", job.id, e)
                 return

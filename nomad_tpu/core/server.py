@@ -293,6 +293,11 @@ class Server:
         """Returns the eval id created (empty for periodic/parameterized)."""
         self._check_leader()
         self._validate_job(job)
+        # stamp submission time before replication (ref job_endpoint.go
+        # Register → job.SubmitTime = time.Now()); the FSM seeds the
+        # periodic-launch checkpoint from it, so 0 would mean epoch-0 and
+        # fire a spurious catch-up on the next leadership establishment
+        job.submit_time = now_ns()
         self._apply(fsm_mod.JOB_REGISTER, {"job": job.to_dict()})
         stored = self.state.job_by_id(job.namespace, job.id)
 

@@ -337,6 +337,10 @@ class StateStore(StateReader):
             if existing is not None:
                 node.create_index = existing.create_index
                 node.drain = existing.drain
+                # strategy must survive re-registration too, or a draining
+                # client restart loses its force deadline and the drain can
+                # never force-complete (ref state_store.go upsertNodeTxn)
+                node.drain_strategy = existing.drain_strategy
                 node.scheduling_eligibility = existing.scheduling_eligibility
             else:
                 node.create_index = index

@@ -132,6 +132,51 @@ class TestPeriodicDispatch:
         finally:
             s.stop()
 
+    def test_restore_catch_up_is_single(self):
+        """A new leader whose last-launch checkpoint is N intervals in the
+        past must force ONE catch-up dispatch, not N (ref leader.go
+        restorePeriodicDispatcher / periodic.go ForceRun)."""
+        from nomad_tpu.core import fsm as fsm_mod
+        from nomad_tpu.structs.model import now_ns
+
+        s = self._server()
+        try:
+            job = mock.periodic_job()
+            job.periodic.spec = "* * * * *"  # every minute
+            s.job_register(job)
+            # simulate a weekend of leader downtime: checkpoint far in past
+            past = now_ns() - 3 * 24 * 3600 * 1_000_000_000
+            s._apply(
+                fsm_mod.PERIODIC_LAUNCH,
+                {"namespace": job.namespace, "job_id": job.id, "launch": past},
+            )
+            s.periodic.restore(s.state)
+            children = [
+                j
+                for j in s.state.jobs_by_namespace(job.namespace)
+                if j.parent_id == job.id
+            ]
+            assert len(children) == 1  # not thousands
+            # launch checkpoint advanced to ~now so a second restore with no
+            # newly missed interval does not re-fire
+            s.periodic.restore(s.state)
+            children = [
+                j
+                for j in s.state.jobs_by_namespace(job.namespace)
+                if j.parent_id == job.id
+            ]
+            assert len(children) == 1
+            # future fires are scheduled from now, not from the stale launch
+            with s.periodic._cv:
+                live = [
+                    t
+                    for (t, k, g) in s.periodic._heap
+                    if g == s.periodic._gen.get(k)
+                ]
+            assert live and all(t > now_ns() for t in live)
+        finally:
+            s.stop()
+
     def test_timer_fires(self):
         s = self._server()
         try:

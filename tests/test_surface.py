@@ -212,6 +212,26 @@ class TestHTTPAPI:
         info = client.agent_self()
         assert info["member"]["Status"] == "alive"
 
+    def test_encoded_child_job_id_resolves(self, http_cluster):
+        """Derived child job IDs contain '/'; percent-encoded they must
+        resolve through every /v1/job/:id route (ADVICE r1)."""
+        _, _, client = http_cluster
+        job = parse_job(TestJobspec.SPEC)
+        job.id = job.name = "cron-parent"
+        job.datacenters = ["dc1"]
+        job.task_groups[0].count = 0
+        from nomad_tpu.structs.model import PeriodicConfig
+
+        job.periodic = PeriodicConfig(enabled=True, spec="0 0 1 1 *")
+        client.register_job(job.to_dict())
+        out = client.job_periodic_force("cron-parent")
+        child_id = out["DispatchedJobID"]
+        assert "/" in child_id
+        got = client.job(child_id)  # client percent-encodes the segment
+        assert got["id"] == child_id
+        assert client.job_summary(child_id) is not None
+        assert client.job_allocations(child_id) == []
+
     def test_blocking_query_wakes(self, http_cluster):
         import threading
 
