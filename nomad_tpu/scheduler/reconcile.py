@@ -364,12 +364,14 @@ class AllocNameIndex:
         return next_names
 
     def next(self, n: int) -> list[str]:
-        next_names: list[str] = []
-        for idx in self.b.indexes_in_range(False, 0, self.count - 1):
-            next_names.append(alloc_name(self.job, self.task_group, idx))
-            self.b.set(idx)
-            if len(next_names) == n:
-                return next_names
+        import numpy as np
+
+        # vectorized over the bitmap (the per-bit walk was measurable at
+        # 50K-placement scale); semantics identical to the scalar loop
+        free = np.nonzero(~self.b.bits[: self.count])[0][:n]
+        self.b.bits[free] = True
+        prefix = f"{self.job}.{self.task_group}"
+        next_names = [f"{prefix}[{i}]" for i in free]
         remainder = n - len(next_names)
         for i in range(remainder):
             next_names.append(alloc_name(self.job, self.task_group, i))

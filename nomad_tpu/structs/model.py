@@ -13,6 +13,7 @@ canonical encoding.
 from __future__ import annotations
 
 import functools
+import os
 import time
 import typing
 import uuid
@@ -112,6 +113,21 @@ MAX_VALID_PORT = 65536
 
 def generate_uuid() -> str:
     return str(uuid.uuid4())
+
+
+def generate_uuids(n: int) -> list[str]:
+    """Batched uuid4 generation: one urandom call + hex slicing instead of
+    n ``uuid.UUID`` object round-trips (~10x faster at 50K-alloc plan scale,
+    where per-alloc id minting is pure overhead on the hot path)."""
+    raw = os.urandom(16 * n).hex()
+    out = []
+    for off in range(0, 32 * n, 32):
+        s = raw[off : off + 32]
+        # force the uuid4 version/variant nibbles like uuid.uuid4 does
+        out.append(
+            f"{s[:8]}-{s[8:12]}-4{s[13:16]}-{'89ab'[int(s[16], 16) & 3]}{s[17:20]}-{s[20:]}"
+        )
+    return out
 
 
 def now_ns() -> int:

@@ -29,7 +29,7 @@ from ..structs.model import (
     AllocatedTaskResources,
     Allocation,
     AllocMetric,
-    generate_uuid,
+    generate_uuids,
 )
 from .columnar import (
     ColumnarCluster,
@@ -172,19 +172,16 @@ class TPUBatchScheduler(GenericScheduler):
             if planes.affinity_present.any() or planes.node_value is not None:
                 has_aff_or_spread = True
 
-        # per-alloc arrays
+        # per-alloc arrays, built per-group then gathered (the per-alloc
+        # Python loop was ~0.3s of pure overhead at 50K allocs)
         a_real = len(place)
         A = _bucket(a_real)
-        demands = np.zeros((A, 3), dtype=np.int32)
-        group_ids = np.zeros(A, dtype=np.int32)
-        limits = np.zeros(A, dtype=np.int32)
-        valid = np.zeros(A, dtype=bool)
-        for i, p in enumerate(place):
-            gi = g_index[p.task_group.name]
-            demands[i] = demand_by_group[p.task_group.name]
-            group_ids[i] = gi
+        g_demand = np.zeros((G, 3), dtype=np.int32)
+        g_limit = np.zeros(G, dtype=np.int32)
+        for name, gi in g_index.items():
+            g_demand[gi] = demand_by_group[name]
             planes = planes_list[gi]
-            limits[i] = min(
+            g_limit[gi] = min(
                 compute_limit(
                     n_real,
                     self.batch,
@@ -193,7 +190,17 @@ class TPUBatchScheduler(GenericScheduler):
                 ),
                 n_real,
             )
-            valid[i] = True
+        gid_real = np.fromiter(
+            (g_index[p.task_group.name] for p in place), dtype=np.int32, count=a_real
+        )
+        group_ids = np.zeros(A, dtype=np.int32)
+        group_ids[:a_real] = gid_real
+        demands = np.zeros((A, 3), dtype=np.int32)
+        demands[:a_real] = g_demand[gid_real]
+        limits = np.zeros(A, dtype=np.int32)
+        limits[:a_real] = g_limit[gid_real]
+        valid = np.zeros(A, dtype=bool)
+        valid[:a_real] = True
 
         # Rotation-parallel fast path: one group, bounded candidate window,
         # no dynamic score planes → mega-step the whole batch
@@ -287,9 +294,51 @@ class TPUBatchScheduler(GenericScheduler):
         if self.deployment is not None and self.deployment.active():
             deployment_id = self.deployment.id
 
+        # Per-group template allocation: every placement of a group carries
+        # identical AllocatedResources and (successful) AllocMetric content,
+        # so one nested instance per group is shared by reference across the
+        # plan's allocations — they are immutable after scheduling (MVCC
+        # copies on any later write path), and constructing 50K deep object
+        # trees was the single largest end-to-end cost. New allocations are
+        # minted by __dict__-cloning the template (3x cheaper than the
+        # dataclass __init__ at this scale).
+        template_by_group: dict[str, dict] = {}
+        for name, gi in g_index.items():
+            tg = next(p.task_group for p in place if p.task_group.name == name)
+            tasks = {
+                t.name: AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=t.resources.cpu),
+                    memory=AllocatedMemoryResources(memory_mb=t.resources.memory_mb),
+                )
+                for t in tg.tasks
+            }
+            resources = AllocatedResources(
+                tasks=tasks,
+                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+            )
+            metrics = AllocMetric()
+            metrics.nodes_evaluated = n_real
+            metrics.nodes_available = by_dc
+            template_by_group[name] = Allocation(
+                namespace=self.job.namespace,
+                eval_id=self.eval.id,
+                job_id=self.job.id,
+                task_group=name,
+                metrics=metrics,
+                deployment_id=deployment_id,
+                allocated_resources=resources,
+                desired_status=ALLOC_DESIRED_STATUS_RUN,
+                client_status=ALLOC_CLIENT_STATUS_PENDING,
+            ).__dict__
+
+        ids = generate_uuids(len(place))
+        node_alloc = self.plan.node_allocation
+        placed_list = placements[: len(place)].tolist()
+        alloc_new = Allocation.__new__
+
         for i, p in enumerate(place):
             tg = p.task_group
-            node_idx = int(placements[i])
+            node_idx = placed_list[i]
             if node_idx < 0 or node_idx >= n_real:
                 if tg.name in self.failed_tg_allocs:
                     self.failed_tg_allocs[tg.name].coalesced_failures += 1
@@ -308,33 +357,13 @@ class TPUBatchScheduler(GenericScheduler):
                 continue
 
             node = nodes[node_idx]
-            tasks = {
-                t.name: AllocatedTaskResources(
-                    cpu=AllocatedCpuResources(cpu_shares=t.resources.cpu),
-                    memory=AllocatedMemoryResources(memory_mb=t.resources.memory_mb),
-                )
-                for t in tg.tasks
-            }
-            resources = AllocatedResources(
-                tasks=tasks,
-                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
-            )
-            metrics = AllocMetric()
-            metrics.nodes_evaluated = n_real
-            metrics.nodes_available = by_dc
-            alloc = Allocation(
-                id=generate_uuid(),
-                namespace=self.job.namespace,
-                eval_id=self.eval.id,
-                name=p.name,
-                job_id=self.job.id,
-                task_group=tg.name,
-                metrics=metrics,
-                node_id=node.id,
-                node_name=node.name,
-                deployment_id=deployment_id,
-                allocated_resources=resources,
-                desired_status=ALLOC_DESIRED_STATUS_RUN,
-                client_status=ALLOC_CLIENT_STATUS_PENDING,
-            )
-            self.plan.append_alloc(alloc)
+            alloc = alloc_new(Allocation)
+            alloc.__dict__.update(template_by_group[tg.name])
+            alloc.id = ids[i]
+            alloc.name = p.name
+            alloc.node_id = node.id
+            alloc.node_name = node.name
+            bucket = node_alloc.get(node.id)
+            if bucket is None:
+                bucket = node_alloc[node.id] = []
+            bucket.append(alloc)
