@@ -370,8 +370,7 @@ class AllocNameIndex:
         # 50K-placement scale); semantics identical to the scalar loop
         free = np.nonzero(~self.b.bits[: self.count])[0][:n]
         self.b.bits[free] = True
-        prefix = f"{self.job}.{self.task_group}"
-        next_names = [f"{prefix}[{i}]" for i in free]
+        next_names = [alloc_name(self.job, self.task_group, i) for i in free]
         remainder = n - len(next_names)
         for i in range(remainder):
             next_names.append(alloc_name(self.job, self.task_group, i))

@@ -29,6 +29,7 @@ from ..structs.model import (
     AllocatedTaskResources,
     Allocation,
     AllocMetric,
+    DesiredTransition,
     generate_uuids,
 )
 from .columnar import (
@@ -202,6 +203,61 @@ class TPUBatchScheduler(GenericScheduler):
         valid = np.zeros(A, dtype=bool)
         valid[:a_real] = True
 
+        # Run-based fast path: one group with affinity/spread (limit=∞,
+        # full-ring selection) → resolve fill runs and sweep tie-runs one
+        # step each instead of one step per placement
+        use_runs = (
+            G == 1
+            and has_aff_or_spread
+            and a_real > 64
+            and limits[0] >= n_real
+        )
+        if use_runs:
+            from .kernel import RunArgs, plan_batch_runs
+
+            t_columnar = time.monotonic()
+            rargs = RunArgs(
+                capacity=jnp.asarray(capacity[perm]),
+                usable=jnp.asarray(usable[perm]),
+                feasible=jnp.asarray(feasible[0][perm]),
+                affinity=jnp.asarray(affinity[0][perm]),
+                affinity_present=jnp.asarray(affinity_present[0][perm]),
+                group_count=jnp.asarray(np.int32(group_count[0])),
+                node_value=jnp.asarray(node_value[0][perm]),
+                spread_desired=jnp.asarray(spread_desired[0]),
+                spread_implicit=jnp.asarray(np.float32(spread_implicit[0])),
+                spread_weight_frac=jnp.asarray(np.float32(spread_weight_frac[0])),
+                spread_even=jnp.asarray(bool(spread_even[0])),
+                spread_active=jnp.asarray(bool(spread_active[0])),
+                perm=jnp.asarray(perm),
+                demand=jnp.asarray(demands[0]),
+                n_allocs=jnp.asarray(np.int32(a_real)),
+            )
+            placements = plan_batch_runs(
+                rargs,
+                (
+                    jnp.asarray(used0[perm]),
+                    jnp.asarray(collisions0[0][perm]),
+                    jnp.asarray(counts0[0]),
+                    jnp.asarray(present0[0]),
+                ),
+                A,
+                bool(spread_even[0]),
+            )
+            placements = np.asarray(placements)
+            t_kernel = time.monotonic()
+            LAST_KERNEL_STATS.update(
+                columnar_s=t_columnar - t_start,
+                kernel_s=t_kernel - t_columnar,
+                n_nodes=n_real,
+                n_allocs=a_real,
+                n_padded_nodes=N,
+                n_padded_allocs=A,
+                mode="runs",
+            )
+            self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+            return
+
         # Rotation-parallel fast path: one group, bounded candidate window,
         # no dynamic score planes → mega-step the whole batch
         use_windowed = (
@@ -294,6 +350,25 @@ class TPUBatchScheduler(GenericScheduler):
         if self.deployment is not None and self.deployment.active():
             deployment_id = self.deployment.id
 
+        any_placed = bool((placements[: len(place)] >= 0).any())
+        if not any_placed:
+            # fully failed plan: no ids or templates needed
+            for p in place:
+                tg = p.task_group
+                if tg.name in self.failed_tg_allocs:
+                    self.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    continue
+                metrics = AllocMetric()
+                gi = g_index[tg.name]
+                metrics.nodes_evaluated = n_real
+                metrics.nodes_filtered = int((~planes_list[gi].feasible).sum())
+                metrics.nodes_available = by_dc
+                metrics.nodes_exhausted = n_real - metrics.nodes_filtered
+                if metrics.nodes_exhausted:
+                    metrics.dimension_exhausted["cpu"] = metrics.nodes_exhausted
+                self.failed_tg_allocs[tg.name] = metrics
+            return
+
         # Per-group template allocation: every placement of a group carries
         # identical AllocatedResources and (successful) AllocMetric content,
         # so one nested instance per group is shared by reference across the
@@ -301,10 +376,14 @@ class TPUBatchScheduler(GenericScheduler):
         # copies on any later write path), and constructing 50K deep object
         # trees was the single largest end-to-end cost. New allocations are
         # minted by __dict__-cloning the template (3x cheaper than the
-        # dataclass __init__ at this scale).
+        # dataclass __init__ at this scale); per-alloc mutable containers
+        # (task_states, desired_transition, preempted_allocations) are
+        # re-bound fresh on every clone below so no plan alloc aliases
+        # another's mutable state.
+        tg_by_name = {p.task_group.name: p.task_group for p in place}
         template_by_group: dict[str, dict] = {}
         for name, gi in g_index.items():
-            tg = next(p.task_group for p in place if p.task_group.name == name)
+            tg = tg_by_name[name]
             tasks = {
                 t.name: AllocatedTaskResources(
                     cpu=AllocatedCpuResources(cpu_shares=t.resources.cpu),
@@ -363,6 +442,9 @@ class TPUBatchScheduler(GenericScheduler):
             alloc.name = p.name
             alloc.node_id = node.id
             alloc.node_name = node.name
+            alloc.task_states = {}
+            alloc.desired_transition = DesiredTransition()
+            alloc.preempted_allocations = []
             bucket = node_alloc.get(node.id)
             if bucket is None:
                 bucket = node_alloc[node.id] = []

@@ -277,6 +277,329 @@ def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
 # (not 100%) parity budget is for.
 
 
+# ---------------------------------------------------------------------------
+# Run-based full-ring planner (spread/affinity fast path, limit=∞)
+# ---------------------------------------------------------------------------
+#
+# With affinities or spreads the reference sets the candidate limit to ∞
+# (stack.go:148-150): every Select is a global argmax over the full ring, and
+# a naive scan needs one sequential step per placement. But the score
+# dynamics collapse the sequence into *runs* that one step can resolve:
+#
+# - FILL runs: ScoreFit rewards utilization (funcs.go:154-188 — a fuller
+#   node scores higher), so once a node wins and keeps rising it wins again
+#   and again until it no longer fits. Its whole score trajectory under j
+#   further self-placements is a closed-form function of j (binpack walks
+#   the utilization curve, anti-affinity adds −1/count per hit, its class's
+#   target-spread boost drops wf/desired per hit), while every OTHER node's
+#   score is frozen (same-class nodes only fall). So: compute the
+#   trajectory, compare against the frozen runner-up (an upper bound on the
+#   competition — conservative, so a run can only end early, never late),
+#   and place the whole run in one step.
+#
+# - SWEEP tie-runs: real clusters have tiers of identical nodes; fresh
+#   identical nodes tie exactly and the sequential process consumes them in
+#   rotation order, with each placement dropping that node far below the tie
+#   (the plane-count denominator flips at the first collision). A placement
+#   in class v also lowers every *tied* class-v key by wf/desired_v, so the
+#   exact merged order of the whole tied set is given by keys
+#   k_i = score − t_i·δ_v/num_i (t_i = rotation rank among same-class ties).
+#   All accepted ties are placed in one step, in exactly that order; a
+#   guard (post-placement score must stay ≤ the smallest accepted key)
+#   rejects lanes that would be re-picked mid-sweep and defers them to the
+#   next step's fill run.
+#
+# Both mechanisms are conservative: each step places a prefix of the true
+# sequential order, and the next step re-scores the full ring, so splitting
+# a run never changes the result — only even-mode spread (whose boost
+# couples classes through min/max counts) disables runs and pays one step
+# per placement. Divergence from the oracle is confined to the fired-flip
+# corner (spread score crossing exactly 0 changes the denominator) and the
+# candidate-local deferral tie-break (select.go:35-67), both covered by the
+# ≥99% parity budget.
+
+
+class RunArgs(NamedTuple):
+    """Node-axis arrays are in ROTATION (shuffled) order; ``perm`` maps a
+    position back to the node id the caller knows."""
+
+    capacity: jax.Array  # i32[N,3]
+    usable: jax.Array  # f32[N,2]
+    feasible: jax.Array  # bool[N]
+    affinity: jax.Array  # f32[N]
+    affinity_present: jax.Array  # bool[N]
+    group_count: jax.Array  # i32 scalar
+    node_value: jax.Array  # i32[N] (-1 = missing)
+    spread_desired: jax.Array  # f32[V] (-1 = absent)
+    spread_implicit: jax.Array  # f32 scalar (-1 = none)
+    spread_weight_frac: jax.Array  # f32 scalar
+    spread_even: jax.Array  # bool scalar
+    spread_active: jax.Array  # bool scalar
+    perm: jax.Array  # i32[N]
+    demand: jax.Array  # i32[3]
+    n_allocs: jax.Array  # i32 scalar
+
+
+def _run_class_boosts(args: RunArgs, counts, present, V):
+    """Spread boost per value class plus the missing-value pseudo-class at
+    index V (the per-class factor of spread.go:110-227)."""
+    used_count = counts.astype(jnp.float32) + 1.0
+    desired = jnp.where(
+        args.spread_desired >= 0.0, args.spread_desired, args.spread_implicit
+    )
+    target = jnp.where(
+        desired >= 0.0,
+        (desired - used_count) / jnp.maximum(desired, 1e-9) * args.spread_weight_frac,
+        -1.0,
+    )
+
+    counts_f = counts.astype(jnp.float32)
+    big = jnp.float32(2**30)
+    any_present = jnp.any(present)
+    min_count = jnp.where(any_present, jnp.min(jnp.where(present, counts_f, big)), 0.0)
+    max_count = jnp.where(any_present, jnp.max(jnp.where(present, counts_f, -big)), 0.0)
+    delta_boost = jnp.where(
+        min_count == 0.0, -1.0, (min_count - counts_f) / jnp.maximum(min_count, 1e-9)
+    )
+    even = jnp.where(
+        counts_f != min_count,
+        delta_boost,
+        jnp.where(
+            min_count == max_count,
+            -1.0,
+            jnp.where(
+                min_count == 0.0,
+                1.0,
+                (max_count - min_count) / jnp.maximum(min_count, 1e-9),
+            ),
+        ),
+    )
+    even = jnp.where(any_present, even, 0.0)
+
+    per_class = jnp.where(args.spread_even, even, target)
+    boosts = jnp.concatenate([per_class, jnp.array([-1.0], dtype=jnp.float32)])
+    return jnp.where(args.spread_active, boosts, jnp.zeros_like(boosts))
+
+
+RUNCAP = 512  # max placements resolved by a single fill run
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def plan_batch_runs(
+    args: RunArgs,
+    init,
+    a_pad: int,
+    even_mode: bool = False,
+):
+    """Place ``n_allocs`` identical asks under full-ring (limit=∞) selection;
+    returns node index per alloc slot (length ``a_pad``, -1 = unplaced)."""
+    n_pad = args.capacity.shape[0]
+    used0, coll0, counts0, present0 = init
+    V = counts0.shape[0]
+    count_f = args.group_count.astype(jnp.float32)
+    pos = jnp.arange(n_pad)
+    cls = jnp.where(args.node_value >= 0, args.node_value, V)
+    onehot_cls = jax.nn.one_hot(cls, V + 1, dtype=jnp.float32)  # [N, V+1]
+    aff_term = jnp.where(args.affinity_present, args.affinity, 0.0)
+    aff_f = args.affinity_present.astype(jnp.float32)
+    # per-placement key decay of a node's class under target spread
+    desired_eff = jnp.where(
+        args.spread_desired >= 0.0, args.spread_desired, args.spread_implicit
+    )
+    delta_v = jnp.where(
+        desired_eff >= 0.0,
+        args.spread_weight_frac / jnp.maximum(desired_eff, 1e-9),
+        0.0,
+    )
+    delta_v = jnp.where(args.spread_active & ~args.spread_even, delta_v, 0.0)
+    delta_node = jnp.concatenate([delta_v, jnp.zeros(1, dtype=jnp.float32)])[cls]
+    demand_f2 = args.demand[:2].astype(jnp.float32)
+
+    def _score_at(used, coll, boosts, extra_d, extra_c, extra_k):
+        """Score vector with ``extra_d`` demands / ``extra_c`` collisions on
+        every node and ``extra_k`` additional own-class placements."""
+        util = (used + (1 + extra_d) * args.demand[None, :])[:, :2].astype(jnp.float32)
+        free = 1.0 - util / args.usable
+        binpack = (
+            jnp.clip(
+                20.0 - jnp.power(10.0, free[:, 0]) - jnp.power(10.0, free[:, 1]),
+                0.0,
+                18.0,
+            )
+            / 18.0
+        )
+        coll_e = coll + extra_c
+        ap = coll_e > 0
+        an = jnp.where(ap, -(coll_e.astype(jnp.float32) + 1.0) / count_f, 0.0)
+        sp = (onehot_cls @ boosts) - extra_k * delta_node
+        fired = args.spread_active & (sp != 0.0)
+        num = 1.0 + ap.astype(jnp.float32) + aff_f + fired.astype(jnp.float32)
+        score = (binpack + an + aff_term + jnp.where(fired, sp, 0.0)) / num
+        return score, num
+
+    def body(state):
+        used, coll, counts, present, placed, placements, _ = state
+
+        fit = args.feasible & jnp.all(
+            used + args.demand[None, :] <= args.capacity, axis=1
+        )
+        boosts = _run_class_boosts(args, counts, present, V)
+        score, num = _score_at(used, coll, boosts, 0, 0, 0)
+        avail = fit
+        any_avail = jnp.any(avail)
+        max_score = jnp.max(jnp.where(avail, score, NEG_INF))
+
+        # deferral of the first 3 nonpositive options in rotation order
+        # (select.go:35-67); only affects tie-breaks when everything is ≤ 0
+        posf = pos.astype(jnp.float32)
+        nonpos = avail & (score <= 0.0)
+        m1 = jnp.min(jnp.where(nonpos, posf, jnp.inf))
+        m2 = jnp.min(jnp.where(nonpos & (posf > m1), posf, jnp.inf))
+        m3 = jnp.min(jnp.where(nonpos & (posf > m2), posf, jnp.inf))
+        deferred = nonpos & (posf <= m3)
+        visit = pos + jnp.where(deferred, n_pad, 0)
+
+        tied = avail & (score == max_score)
+        best = jnp.argmin(jnp.where(tied, visit, 2**30))
+        score_not_best = jnp.where(pos == best, NEG_INF, score)
+        runner_other = jnp.max(jnp.where(avail, score_not_best, NEG_INF))
+        runner_nontied = jnp.max(jnp.where(avail & ~tied, score, NEG_INF))
+        remaining = args.n_allocs - placed
+
+        if not even_mode:
+            # ---- sweep tie-run: keys of the tied set in merged order ----
+            t_mat = jnp.cumsum(onehot_cls * tied[:, None].astype(jnp.float32), axis=0)
+            t_own = jnp.sum(t_mat * onehot_cls, axis=1) - 1.0  # rank among class ties
+            key = score - t_own * delta_node / num
+            accept0 = tied & (key > runner_nontied)
+            key_min0 = jnp.min(jnp.where(accept0, key, jnp.inf))
+            score2, _ = _score_at(used, coll, boosts, 1, 1, 1)
+            guard = score2 <= key_min0
+            bad_key = jnp.max(jnp.where(accept0 & ~guard, key, NEG_INF))
+            accept = accept0 & (key > bad_key)
+            n_acc = jnp.sum(accept.astype(jnp.int32))
+            sweep_ok = n_acc > 1
+        else:
+            accept = jnp.zeros(n_pad, dtype=bool)
+            key = score
+            sweep_ok = jnp.bool_(False)
+
+        def sweep_branch(used, coll, counts, present, placed, placements):
+            sort_key = jnp.where(accept, key, NEG_INF)
+            order = jnp.lexsort((visit, -sort_key))
+            rank = jnp.zeros(n_pad, dtype=jnp.int32).at[order].set(
+                jnp.arange(n_pad, dtype=jnp.int32)
+            )
+            take = jnp.minimum(remaining, jnp.sum(accept.astype(jnp.int32)))
+            acc = accept & (rank < take)
+            slots = jnp.where(acc, placed + rank, a_pad)
+            placements = placements.at[slots].set(jnp.where(acc, args.perm, -1))
+            used = used + jnp.where(acc[:, None], args.demand[None, :], 0)
+            coll = coll + acc.astype(jnp.int32)
+            m_v = jnp.sum(onehot_cls * acc[:, None].astype(jnp.float32), axis=0)
+            m_v = m_v[:V].astype(jnp.int32)
+            hit = jnp.where(args.spread_active, m_v, 0)
+            counts = counts + hit
+            present = present | (hit > 0)
+            placed = placed + take
+            return used, coll, counts, present, placed, placements
+
+        def fill_branch(used, coll, counts, present, placed, placements):
+            # trajectory of the winning node under j self-placements
+            used_b = used[best]
+            coll_b = coll[best].astype(jnp.float32)
+            cls_b = cls[best]
+            boost_b = boosts[cls_b]
+            delta_b = delta_node[best]
+            aff_b = aff_term[best]
+            aff_fb = aff_f[best]
+            cap_b = args.capacity[best]
+            usable_b = args.usable[best]
+            jj = jnp.arange(RUNCAP)
+            jf = jj.astype(jnp.float32)
+            util_j = (
+                used_b[:2].astype(jnp.float32)[None, :]
+                + (jf[:, None] + 1.0) * demand_f2[None, :]
+            )
+            free_j = 1.0 - util_j / usable_b[None, :]
+            bp_j = (
+                jnp.clip(
+                    20.0
+                    - jnp.power(10.0, free_j[:, 0])
+                    - jnp.power(10.0, free_j[:, 1]),
+                    0.0,
+                    18.0,
+                )
+                / 18.0
+            )
+            coll_j = coll_b + jf
+            ap_j = coll_j > 0.0
+            an_j = jnp.where(ap_j, -(coll_j + 1.0) / count_f, 0.0)
+            sp_j = boost_b - jf * delta_b
+            fired_j = args.spread_active & (sp_j != 0.0)
+            num_j = 1.0 + ap_j.astype(jnp.float32) + aff_fb + fired_j.astype(jnp.float32)
+            traj = (bp_j + an_j + aff_b + jnp.where(fired_j, sp_j, 0.0)) / num_j
+            fit_j = jnp.all(
+                used_b[None, :] + (jj[:, None] + 1) * args.demand[None, :]
+                <= cap_b[None, :],
+                axis=1,
+            )
+            if even_mode:
+                ok = jnp.zeros(RUNCAP, dtype=bool)
+            else:
+                ok = fit_j & (traj > runner_other) & (jj.astype(jnp.int32) < remaining)
+            # ok[j] ⇒ the (j+1)-th consecutive placement happens; the first
+            # is granted (best already won this step)
+            ok = ok & (jj > 0)
+            run = 1 + jnp.sum(jnp.cumprod(ok[1:].astype(jnp.int32)))
+            run = jnp.minimum(run, remaining)
+
+            idx = placed + jj
+            mask = jj < run
+            placements = placements.at[jnp.where(mask, idx, a_pad)].set(
+                jnp.where(mask, args.perm[best], -1)
+            )
+            used = used.at[best].add(run * args.demand)
+            coll = coll.at[best].add(run)
+            do_spread = args.spread_active & (cls_b < V)
+            safe_b = jnp.minimum(cls_b, V - 1)
+            hit = jnp.where(do_spread, run, 0)
+            counts = counts.at[safe_b].add(hit)
+            present = present.at[safe_b].set(present[safe_b] | (hit > 0))
+            placed = placed + run
+            return used, coll, counts, present, placed, placements
+
+        used, coll, counts, present, placed, placements = jax.lax.cond(
+            sweep_ok & any_avail,
+            sweep_branch,
+            lambda *a: jax.lax.cond(any_avail, fill_branch, lambda *b: b, *a),
+            used,
+            coll,
+            counts,
+            present,
+            placed,
+            placements,
+        )
+        return used, coll, counts, present, placed, placements, any_avail
+
+    def cond(state):
+        _, _, _, _, placed, _, progress = state
+        return (placed < args.n_allocs) & progress
+
+    placements0 = jnp.full(a_pad + 1, -1, dtype=jnp.int32)
+    init_state = (
+        used0,
+        coll0,
+        counts0,
+        present0,
+        jnp.int32(0),
+        placements0,
+        jnp.bool_(True),
+    )
+    *_, placements, _ = jax.lax.while_loop(cond, body, init_state)
+    return placements[:a_pad]
+
+
 class WindowArgs(NamedTuple):
     capacity: jax.Array  # i32[N,3]
     usable: jax.Array  # f32[N,2]

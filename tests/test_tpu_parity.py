@@ -174,6 +174,76 @@ class TestKernelParity:
         frac = assert_parity(nodes, make_job(80), min_match=0.99)
         assert frac >= 0.99
 
+    def test_chunked_spread_targets_parity(self):
+        # count > 64 with spread → chunked global-argmax path
+        nodes = build_cluster(40, dcs=("dc1", "dc2", "dc3", "dc4"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+            job.spreads = [
+                Spread(
+                    attribute="${node.datacenter}",
+                    weight=100,
+                    spread_target=[
+                        SpreadTarget(value=f"dc{i}", percent=25) for i in (1, 2, 3, 4)
+                    ],
+                )
+            ]
+
+        from nomad_tpu.tpu import batch_sched
+
+        frac = assert_parity(nodes, make_job(120, mutate), min_match=0.98)
+        assert batch_sched.LAST_KERNEL_STATS.get("mode") == "runs"
+        assert frac >= 0.98
+
+    def test_chunked_even_spread_parity(self):
+        nodes = build_cluster(30, dcs=("dc1", "dc2", "dc3"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+
+        from nomad_tpu.tpu import batch_sched
+
+        assert_parity(nodes, make_job(90, mutate), min_match=0.98)
+        assert batch_sched.LAST_KERNEL_STATS.get("mode") == "runs"
+
+    def test_chunked_affinity_parity(self):
+        nodes = build_cluster(50)
+        for i, n in enumerate(nodes):
+            n.meta["ssd"] = "true" if i < 10 else "false"
+
+        def mutate(job):
+            job.affinities = [
+                Affinity(
+                    l_target="${meta.ssd}", r_target="true", operand="=", weight=50
+                )
+            ]
+
+        from nomad_tpu.tpu import batch_sched
+
+        assert_parity(nodes, make_job(100, mutate), min_match=0.98)
+        assert batch_sched.LAST_KERNEL_STATS.get("mode") == "runs"
+
+    def test_chunked_spread_and_affinity(self):
+        nodes = build_cluster(40, dcs=("dc1", "dc2"))
+        for i, n in enumerate(nodes):
+            n.meta["ssd"] = "true" if i % 3 == 0 else "false"
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2"]
+            job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+            job.affinities = [
+                Affinity(
+                    l_target="${meta.ssd}", r_target="true", operand="=", weight=50
+                )
+            ]
+
+        from nomad_tpu.tpu import batch_sched
+
+        assert_parity(nodes, make_job(80, mutate), min_match=0.97)
+        assert batch_sched.LAST_KERNEL_STATS.get("mode") == "runs"
+
     def test_fallback_on_networks(self):
         # job with dynamic ports must fall back to the oracle path and still place
         nodes = build_cluster(5)
