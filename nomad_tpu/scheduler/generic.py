@@ -282,14 +282,12 @@ class GenericScheduler:
                     self.queued_allocs[tg.name] = 0
             return
 
-        for p in results.place:
-            self.queued_allocs[p.task_group.name] = (
-                self.queued_allocs.get(p.task_group.name, 0) + 1
-            )
-        for d in results.destructive_update:
-            self.queued_allocs[d.place_task_group.name] = (
-                self.queued_allocs.get(d.place_task_group.name, 0) + 1
-            )
+        from collections import Counter
+
+        counts = Counter(p.task_group.name for p in results.place)
+        counts.update(d.place_task_group.name for d in results.destructive_update)
+        for name, c in counts.items():
+            self.queued_allocs[name] = self.queued_allocs.get(name, 0) + c
 
         self._compute_placements(results.destructive_update, results.place)
 

@@ -820,8 +820,15 @@ class AllocReconciler:
             )
         existing = len(untainted) + len(migrate) + len(reschedule)
         if existing < group.count:
+            # __dict__-template clone: the dataclass __init__ was measurable
+            # at 50K fresh placements per eval; cloning a real instance's
+            # dict stays in sync with the field list automatically
+            template = AllocPlaceResult(task_group=group).__dict__
+            new = AllocPlaceResult.__new__
             for name in name_index.next(group.count - existing):
-                place.append(AllocPlaceResult(name=name, task_group=group))
+                p = new(AllocPlaceResult)
+                p.__dict__ = dict(template, name=name)
+                place.append(p)
         return place
 
     def _compute_stop(

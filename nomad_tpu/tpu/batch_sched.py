@@ -61,6 +61,12 @@ def _bucket(n: int) -> int:
 #: timing of the most recent kernel invocation, for the benchmark harness
 LAST_KERNEL_STATS: dict = {}
 
+#: when True, skip the runs/windowed fast paths and use the exact
+#: sequential-scan kernel for every placement. The benchmark flips this to
+#: measure fast-path parity at full scale (the exact scan is the
+#: one-step-per-placement program validated against the scalar oracle).
+EXACT_ONLY = False
+
 
 class TPUBatchScheduler(GenericScheduler):
     """GenericScheduler with the batched placement kernel."""
@@ -211,6 +217,7 @@ class TPUBatchScheduler(GenericScheduler):
             and has_aff_or_spread
             and a_real > 64
             and limits[0] >= n_real
+            and not EXACT_ONLY
         )
         if use_runs:
             from .kernel import RunArgs, plan_batch_runs
@@ -244,18 +251,20 @@ class TPUBatchScheduler(GenericScheduler):
                 A,
                 bool(spread_even[0]),
             )
-            placements = np.asarray(placements)
-            t_kernel = time.monotonic()
             LAST_KERNEL_STATS.update(
                 columnar_s=t_columnar - t_start,
-                kernel_s=t_kernel - t_columnar,
                 n_nodes=n_real,
                 n_allocs=a_real,
                 n_padded_nodes=N,
                 n_padded_allocs=A,
                 mode="runs",
             )
-            self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+            # dispatch is async: _materialize builds templates/ids while the
+            # device runs, then blocks on the placements
+            self._materialize(
+                place, placements, nodes, by_dc, planes_list, g_index,
+                gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+            )
             return
 
         # Rotation-parallel fast path: one group, bounded candidate window,
@@ -265,6 +274,7 @@ class TPUBatchScheduler(GenericScheduler):
             and not has_aff_or_spread
             and a_real > 0
             and limits[0] < n_real
+            and not EXACT_ONLY
         )
         if use_windowed:
             from .kernel import WindowArgs, plan_batch_windowed
@@ -287,18 +297,18 @@ class TPUBatchScheduler(GenericScheduler):
                 n_real,
                 A,
             )
-            placements = np.asarray(placements)
-            t_kernel = time.monotonic()
             LAST_KERNEL_STATS.update(
                 columnar_s=t_columnar - t_start,
-                kernel_s=t_kernel - t_columnar,
                 n_nodes=n_real,
                 n_allocs=a_real,
                 n_padded_nodes=N,
                 n_padded_allocs=A,
                 mode="windowed",
             )
-            self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+            self._materialize(
+                place, placements, nodes, by_dc, planes_list, g_index,
+                gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+            )
             return
 
         args = BatchArgs(
@@ -330,45 +340,121 @@ class TPUBatchScheduler(GenericScheduler):
 
         t_columnar = time.monotonic()
         _, placements = plan_batch(args, init, n_real)
-        placements = np.asarray(placements)  # blocks on device completion
-        t_kernel = time.monotonic()
         LAST_KERNEL_STATS.update(
             columnar_s=t_columnar - t_start,
-            kernel_s=t_kernel - t_columnar,
             n_nodes=n_real,
             n_allocs=len(place),
             n_padded_nodes=N,
             n_padded_allocs=A,
             mode="exact-scan",
         )
-        self._materialize(place, placements, nodes, by_dc, planes_list, g_index)
+        self._materialize(
+            place, placements, nodes, by_dc, planes_list, g_index,
+            gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+        )
 
     # ------------------------------------------------------------------
-    def _materialize(self, place, placements, nodes, by_dc, planes_list, g_index):
+    def _failed_group_metric(
+        self, gi, planes_list, by_dc, used_final, capacity, demand, n_real
+    ) -> AllocMetric:
+        """Measured failure accounting for one task group: a feasible node is
+        exhausted if one more alloc of this group's demand overflows some
+        dimension of the node's capacity at the usage the scan had reached
+        when this group first failed; the recorded dimension is the first
+        failing of cpu/memory/disk (the superset-check order,
+        structs.go:3199-3210). Measured from the kernel's actual state
+        rather than guessed."""
+        metrics = AllocMetric()
+        metrics.nodes_evaluated = n_real
+        feasible = planes_list[gi].feasible
+        metrics.nodes_filtered = int((~feasible).sum())
+        metrics.nodes_available = by_dc
+        over = used_final + demand[None, :] > capacity[:n_real]
+        exhausted = feasible & over.any(axis=1)
+        metrics.nodes_exhausted = int(exhausted.sum())
+        first_dim = np.where(over[:, 0], 0, np.where(over[:, 1], 1, 2))
+        for d, name in enumerate(("cpu", "memory", "disk")):
+            c = int((exhausted & (first_dim == d)).sum())
+            if c:
+                metrics.dimension_exhausted[name] = c
+        return metrics
+
+    # ------------------------------------------------------------------
+    def _materialize(
+        self, place, placements, nodes, by_dc, planes_list, g_index,
+        gid_real, used0, capacity, g_demand, t_dispatch=None,
+    ):
+        import time
+
         n_real = len(nodes)
         deployment_id = ""
         if self.deployment is not None and self.deployment.active():
             deployment_id = self.deployment.id
 
-        any_placed = bool((placements[: len(place)] >= 0).any())
-        if not any_placed:
-            # fully failed plan: no ids or templates needed
-            for p in place:
-                tg = p.task_group
+        # Templates and ids don't depend on the placements, so when the
+        # kernel dispatch was asynchronous (t_dispatch set) this prep work
+        # overlaps device execution; np.asarray below is the sync point.
+        template_by_group = self._build_templates(place, g_index, by_dc, n_real, deployment_id)
+        ids = generate_uuids(len(place))
+
+        placements = np.asarray(placements)
+        if t_dispatch is not None:
+            LAST_KERNEL_STATS["kernel_s"] = time.monotonic() - t_dispatch
+
+        placed_idx = placements[: len(place)]
+        valid_mask = (placed_idx >= 0) & (placed_idx < n_real)
+
+        def used_at(fail_idx: int) -> np.ndarray:
+            """Per-node usage as of placement ``fail_idx`` (placements are in
+            scan order, so the prefix of granted demands reconstructs the
+            usage the oracle would have seen at that failure moment — later
+            placements of other groups don't leak in)."""
+            used = used0[:n_real].astype(np.int64).copy()
+            prior = valid_mask.copy()
+            prior[fail_idx:] = False
+            for gj in range(len(planes_list)):
+                m = prior & (gid_real == gj)
+                if m.any():
+                    counts = np.bincount(placed_idx[m], minlength=n_real)
+                    used += counts[:, None] * g_demand[gj][None, :].astype(np.int64)
+            return used
+
+        node_alloc = self.plan.node_allocation
+        placed_list = placed_idx.tolist()
+        alloc_new = Allocation.__new__
+
+        for i, p in enumerate(place):
+            tg = p.task_group
+            node_idx = placed_list[i]
+            if node_idx < 0 or node_idx >= n_real:
                 if tg.name in self.failed_tg_allocs:
                     self.failed_tg_allocs[tg.name].coalesced_failures += 1
                     continue
-                metrics = AllocMetric()
                 gi = g_index[tg.name]
-                metrics.nodes_evaluated = n_real
-                metrics.nodes_filtered = int((~planes_list[gi].feasible).sum())
-                metrics.nodes_available = by_dc
-                metrics.nodes_exhausted = n_real - metrics.nodes_filtered
-                if metrics.nodes_exhausted:
-                    metrics.dimension_exhausted["cpu"] = metrics.nodes_exhausted
-                self.failed_tg_allocs[tg.name] = metrics
-            return
+                self.failed_tg_allocs[tg.name] = self._failed_group_metric(
+                    gi, planes_list, by_dc, used_at(i), capacity, g_demand[gi], n_real
+                )
+                continue
 
+            node = nodes[node_idx]
+            alloc = alloc_new(Allocation)
+            alloc.__dict__ = dict(
+                template_by_group[tg.name],
+                id=ids[i],
+                name=p.name,
+                node_id=node.id,
+                node_name=node.name,
+                task_states={},
+                desired_transition=DesiredTransition(),
+                preempted_allocations=[],
+            )
+            bucket = node_alloc.get(node.id)
+            if bucket is None:
+                bucket = node_alloc[node.id] = []
+            bucket.append(alloc)
+
+    # ------------------------------------------------------------------
+    def _build_templates(self, place, g_index, by_dc, n_real, deployment_id):
         # Per-group template allocation: every placement of a group carries
         # identical AllocatedResources and (successful) AllocMetric content,
         # so one nested instance per group is shared by reference across the
@@ -409,43 +495,4 @@ class TPUBatchScheduler(GenericScheduler):
                 desired_status=ALLOC_DESIRED_STATUS_RUN,
                 client_status=ALLOC_CLIENT_STATUS_PENDING,
             ).__dict__
-
-        ids = generate_uuids(len(place))
-        node_alloc = self.plan.node_allocation
-        placed_list = placements[: len(place)].tolist()
-        alloc_new = Allocation.__new__
-
-        for i, p in enumerate(place):
-            tg = p.task_group
-            node_idx = placed_list[i]
-            if node_idx < 0 or node_idx >= n_real:
-                if tg.name in self.failed_tg_allocs:
-                    self.failed_tg_allocs[tg.name].coalesced_failures += 1
-                    continue
-                metrics = AllocMetric()
-                gi = g_index[tg.name]
-                metrics.nodes_evaluated = n_real
-                metrics.nodes_filtered = int((~planes_list[gi].feasible).sum())
-                metrics.nodes_available = by_dc
-                metrics.nodes_exhausted = (
-                    n_real - metrics.nodes_filtered
-                )
-                if metrics.nodes_exhausted:
-                    metrics.dimension_exhausted["cpu"] = metrics.nodes_exhausted
-                self.failed_tg_allocs[tg.name] = metrics
-                continue
-
-            node = nodes[node_idx]
-            alloc = alloc_new(Allocation)
-            alloc.__dict__.update(template_by_group[tg.name])
-            alloc.id = ids[i]
-            alloc.name = p.name
-            alloc.node_id = node.id
-            alloc.node_name = node.name
-            alloc.task_states = {}
-            alloc.desired_transition = DesiredTransition()
-            alloc.preempted_allocations = []
-            bucket = node_alloc.get(node.id)
-            if bucket is None:
-                bucket = node_alloc[node.id] = []
-            bucket.append(alloc)
+        return template_by_group

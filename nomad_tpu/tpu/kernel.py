@@ -66,6 +66,56 @@ class BatchState(NamedTuple):
     offset: jax.Array  # i32 scalar
 
 
+def _binpack(free_cpu, free_mem):
+    """Normalized ScoreFit: clip(20 − 10^fcpu − 10^fmem, [0,18]) / 18
+    (ref funcs.go:154-191, rank.go:13). Single definition — the run/sweep
+    planners' closed-form trajectories must match the step formula exactly."""
+    total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
+    return jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
+
+
+def _class_boosts(counts, present, desired, implicit, weight_frac, even_flag, active_flag):
+    """Spread boost per value class, plus the missing-value pseudo-class at
+    index V (spread.go:110-227: target mode boosts (desired−used)/desired
+    weighted; even mode boosts below-min classes). Single definition — the
+    per-placement scorer indexes it per node and the run planner consumes it
+    per class, and both must agree exactly."""
+    used_count = counts.astype(jnp.float32) + 1.0
+    desired_eff = jnp.where(desired >= 0.0, desired, implicit)
+    target = jnp.where(
+        desired_eff >= 0.0,
+        (desired_eff - used_count) / jnp.maximum(desired_eff, 1e-9) * weight_frac,
+        -1.0,
+    )
+
+    counts_f = counts.astype(jnp.float32)
+    big = jnp.float32(2**30)
+    any_present = jnp.any(present)
+    min_count = jnp.where(any_present, jnp.min(jnp.where(present, counts_f, big)), 0.0)
+    max_count = jnp.where(any_present, jnp.max(jnp.where(present, counts_f, -big)), 0.0)
+    delta_boost = jnp.where(
+        min_count == 0.0, -1.0, (min_count - counts_f) / jnp.maximum(min_count, 1e-9)
+    )
+    even = jnp.where(
+        counts_f != min_count,
+        delta_boost,
+        jnp.where(
+            min_count == max_count,
+            -1.0,
+            jnp.where(
+                min_count == 0.0,
+                1.0,
+                (max_count - min_count) / jnp.maximum(min_count, 1e-9),
+            ),
+        ),
+    )
+    even = jnp.where(any_present, even, 0.0)
+
+    per_class = jnp.where(even_flag, even, target)
+    boosts = jnp.concatenate([per_class, jnp.array([-1.0], dtype=jnp.float32)])
+    return jnp.where(active_flag, boosts, jnp.zeros_like(boosts))
+
+
 def _scores(args: BatchArgs, state: BatchState, g, demand):
     """Final score per node for one placement (mean over fired planes)."""
     used = state.used
@@ -73,8 +123,7 @@ def _scores(args: BatchArgs, state: BatchState, g, demand):
 
     free_cpu = 1.0 - util[:, 0].astype(jnp.float32) / args.usable[:, 0]
     free_mem = 1.0 - util[:, 1].astype(jnp.float32) / args.usable[:, 1]
-    total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
-    binpack = jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
+    binpack = _binpack(free_cpu, free_mem)
 
     coll = state.collisions[g]
     anti_present = coll > 0
@@ -87,50 +136,20 @@ def _scores(args: BatchArgs, state: BatchState, g, demand):
     aff = args.affinity[g]
     aff_present = args.affinity_present[g]
 
-    # spread plane (spread.go:110-227)
+    # spread plane (spread.go:110-227): per-class boosts indexed per node
     v = args.node_value[g]
-    safe_v = jnp.maximum(v, 0)
-    cnt = state.spread_counts[g][safe_v]
-    used_count = cnt.astype(jnp.float32) + 1.0
-    desired_direct = args.spread_desired[g][safe_v]
-    desired = jnp.where(desired_direct >= 0.0, desired_direct, args.spread_implicit[g])
-    target_boost = jnp.where(
-        desired >= 0.0,
-        (desired - used_count) / jnp.maximum(desired, 1e-9) * args.spread_weight_frac[g],
-        -1.0,
+    boosts = _class_boosts(
+        state.spread_counts[g],
+        state.spread_present[g],
+        args.spread_desired[g],
+        args.spread_implicit[g],
+        args.spread_weight_frac[g],
+        args.spread_even[g],
+        args.spread_active[g],
     )
-
-    # even spread (spread.go:178-228)
-    present = state.spread_present[g]
-    counts_f = state.spread_counts[g].astype(jnp.float32)
-    big = jnp.float32(2**30)
-    min_count = jnp.min(jnp.where(present, counts_f, big))
-    max_count = jnp.max(jnp.where(present, counts_f, -big))
-    any_present = jnp.any(present)
-    min_count = jnp.where(any_present, min_count, 0.0)
-    max_count = jnp.where(any_present, max_count, 0.0)
-    cur = cnt.astype(jnp.float32)
-    delta_boost = jnp.where(
-        min_count == 0.0, -1.0, (min_count - cur) / jnp.maximum(min_count, 1e-9)
-    )
-    even_boost = jnp.where(
-        cur != min_count,
-        delta_boost,
-        jnp.where(
-            min_count == max_count,
-            -1.0,
-            jnp.where(
-                min_count == 0.0,
-                1.0,
-                (max_count - min_count) / jnp.maximum(min_count, 1e-9),
-            ),
-        ),
-    )
-    even_boost = jnp.where(any_present, even_boost, 0.0)
-    even_boost = jnp.where(v >= 0, even_boost, -1.0)
-
-    spread_score = jnp.where(args.spread_even[g], even_boost, target_boost)
-    spread_score = jnp.where(v >= 0, spread_score, -1.0)
+    V = args.spread_desired.shape[1]
+    cls = jnp.where(v >= 0, v, V)
+    spread_score = boosts[cls]
     spread_fired = args.spread_active[g] & (spread_score != 0.0)
     spread_score = jnp.where(spread_fired, spread_score, 0.0)
 
@@ -341,44 +360,16 @@ class RunArgs(NamedTuple):
 
 
 def _run_class_boosts(args: RunArgs, counts, present, V):
-    """Spread boost per value class plus the missing-value pseudo-class at
-    index V (the per-class factor of spread.go:110-227)."""
-    used_count = counts.astype(jnp.float32) + 1.0
-    desired = jnp.where(
-        args.spread_desired >= 0.0, args.spread_desired, args.spread_implicit
+    """Run-planner view of the shared spread-boost formula."""
+    return _class_boosts(
+        counts,
+        present,
+        args.spread_desired,
+        args.spread_implicit,
+        args.spread_weight_frac,
+        args.spread_even,
+        args.spread_active,
     )
-    target = jnp.where(
-        desired >= 0.0,
-        (desired - used_count) / jnp.maximum(desired, 1e-9) * args.spread_weight_frac,
-        -1.0,
-    )
-
-    counts_f = counts.astype(jnp.float32)
-    big = jnp.float32(2**30)
-    any_present = jnp.any(present)
-    min_count = jnp.where(any_present, jnp.min(jnp.where(present, counts_f, big)), 0.0)
-    max_count = jnp.where(any_present, jnp.max(jnp.where(present, counts_f, -big)), 0.0)
-    delta_boost = jnp.where(
-        min_count == 0.0, -1.0, (min_count - counts_f) / jnp.maximum(min_count, 1e-9)
-    )
-    even = jnp.where(
-        counts_f != min_count,
-        delta_boost,
-        jnp.where(
-            min_count == max_count,
-            -1.0,
-            jnp.where(
-                min_count == 0.0,
-                1.0,
-                (max_count - min_count) / jnp.maximum(min_count, 1e-9),
-            ),
-        ),
-    )
-    even = jnp.where(any_present, even, 0.0)
-
-    per_class = jnp.where(args.spread_even, even, target)
-    boosts = jnp.concatenate([per_class, jnp.array([-1.0], dtype=jnp.float32)])
-    return jnp.where(args.spread_active, boosts, jnp.zeros_like(boosts))
 
 
 RUNCAP = 512  # max placements resolved by a single fill run
@@ -420,14 +411,7 @@ def plan_batch_runs(
         every node and ``extra_k`` additional own-class placements."""
         util = (used + (1 + extra_d) * args.demand[None, :])[:, :2].astype(jnp.float32)
         free = 1.0 - util / args.usable
-        binpack = (
-            jnp.clip(
-                20.0 - jnp.power(10.0, free[:, 0]) - jnp.power(10.0, free[:, 1]),
-                0.0,
-                18.0,
-            )
-            / 18.0
-        )
+        binpack = _binpack(free[:, 0], free[:, 1])
         coll_e = coll + extra_c
         ap = coll_e > 0
         an = jnp.where(ap, -(coll_e.astype(jnp.float32) + 1.0) / count_f, 0.0)
@@ -522,16 +506,7 @@ def plan_batch_runs(
                 + (jf[:, None] + 1.0) * demand_f2[None, :]
             )
             free_j = 1.0 - util_j / usable_b[None, :]
-            bp_j = (
-                jnp.clip(
-                    20.0
-                    - jnp.power(10.0, free_j[:, 0])
-                    - jnp.power(10.0, free_j[:, 1]),
-                    0.0,
-                    18.0,
-                )
-                / 18.0
-            )
+            bp_j = _binpack(free_j[:, 0], free_j[:, 1])
             coll_j = coll_b + jf
             ap_j = coll_j > 0.0
             an_j = jnp.where(ap_j, -(coll_j + 1.0) / count_f, 0.0)
@@ -638,10 +613,7 @@ def plan_batch_windowed(
         util = used + args.demand[None, :]
         free_cpu = 1.0 - util[:, 0].astype(jnp.float32) / args.usable[:, 0]
         free_mem = 1.0 - util[:, 1].astype(jnp.float32) / args.usable[:, 1]
-        binpack = (
-            jnp.clip(20.0 - jnp.power(10.0, free_cpu) - jnp.power(10.0, free_mem), 0.0, 18.0)
-            / 18.0
-        )
+        binpack = _binpack(free_cpu, free_mem)
         anti_present = collisions > 0
         anti = jnp.where(
             anti_present,

@@ -163,10 +163,14 @@ class TestKernelParity:
         # same number placed, both report failures
         assert len(p_oracle) == len(p_batch)
         assert bool(s_oracle.failed_tg_allocs) == bool(s_batch.failed_tg_allocs)
-        assert (
-            s_oracle.failed_tg_allocs["web"].coalesced_failures
-            == s_batch.failed_tg_allocs["web"].coalesced_failures
-        )
+        m_oracle = s_oracle.failed_tg_allocs["web"]
+        m_batch = s_batch.failed_tg_allocs["web"]
+        assert m_oracle.coalesced_failures == m_batch.coalesced_failures
+        # failure accounting is measured, not guessed: exhausted node count
+        # and the per-dimension attribution must match the oracle's
+        assert m_oracle.nodes_exhausted == m_batch.nodes_exhausted
+        assert dict(m_oracle.dimension_exhausted) == dict(m_batch.dimension_exhausted)
+        assert m_oracle.nodes_filtered == m_batch.nodes_filtered
 
     def test_larger_parity_ratio(self):
         # 100 nodes x 80 allocs: allow tiny divergence from float rounding
