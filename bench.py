@@ -383,6 +383,79 @@ def bench_config3(n_allocs=10000, n_nodes=1000):
     }
 
 
+def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
+    """Evals/sec through the REAL server path: jobs registered against a
+    running server with default_scheduler=tpu-batch and batch_drain workers,
+    evals fused into multi-eval kernel batches by the broker drain
+    (worker.go:105-276 / SURVEY §2.3 north-star bridge)."""
+    from nomad_tpu import mock
+    from nomad_tpu.core.server import Server
+    from nomad_tpu.raft import InmemTransport, RaftConfig
+    from nomad_tpu.tpu import drain as drain_mod
+
+    drain_mod.DRAIN_COUNTERS.update(batches=0, evals=0)
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "default_scheduler": "tpu-batch",
+        "batch_drain": drain,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.05,
+                election_timeout_min=0.1,
+                election_timeout_max=0.2,
+            ),
+        },
+    }
+    server = Server(cfg)
+    server.start(num_workers=2, wait_for_leader=5.0)
+    try:
+        for node in build_nodes(n_nodes):
+            server.node_register(node)
+        rng = random.Random(11)
+        jobs = []
+        for _ in range(n_jobs):
+            job = mock.job()
+            job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+            tg = job.task_groups[0]
+            tg.count = rng.randint(1, 4)
+            tg.tasks[0].resources.cpu = rng.choice([100, 250])
+            tg.tasks[0].resources.memory_mb = rng.choice([64, 128])
+            tg.tasks[0].resources.networks = []
+            jobs.append(job)
+
+        t0 = time.monotonic()
+        eval_ids = [server.job_register(j) for j in jobs]
+        pending = set(eval_ids)
+        deadline = time.monotonic() + 600
+        while pending and time.monotonic() < deadline:
+            for eid in list(pending):
+                ev = server.state.eval_by_id(eid)
+                if ev is not None and ev.status in ("complete", "failed"):
+                    pending.discard(eid)
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        placed = sum(
+            len(server.state.allocs_by_job(j.namespace, j.id)) for j in jobs
+        )
+        return {
+            "jobs": n_jobs,
+            "nodes": n_nodes,
+            "unfinished": len(pending),
+            "placed": placed,
+            "wall_s": round(elapsed, 3),
+            "evals_per_s": round(n_jobs / elapsed, 1),
+            "drain_batches": drain_mod.DRAIN_COUNTERS["batches"],
+            "drain_evals": drain_mod.DRAIN_COUNTERS["evals"],
+        }
+    finally:
+        server.stop()
+
+
 def bench_config5(n_nodes=10000):
     """Mixed service+system jobs with device{} asks + NetworkIndex port
     collisions at 10K nodes. Devices and ports are exact-semantics host
@@ -464,6 +537,7 @@ def main():
         detail["config2"] = bench_config2()
         detail["config3"] = bench_config3()
         detail["config5"] = bench_config5()
+        detail["drain"] = bench_drain()
     e2e = headline["end_to_end_s"]
     parities = [headline["parity_exact_full"], headline["parity_oracle_prefix"]]
     detail["parity"] = round(min(parities), 5)

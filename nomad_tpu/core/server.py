@@ -163,8 +163,18 @@ class Server:
     def start(self, num_workers: int = 2, wait_for_leader: Optional[float] = None):
         self._running = True
         self.raft.start()
+        drain_n = int(self.config.get("batch_drain", 0))
         for i in range(num_workers):
-            w = Worker(self, seed=self.config.get("seed"))
+            if drain_n > 1:
+                # north-star bridge: drain N evals per cycle into one fused
+                # kernel batch (worker.go:105 + SURVEY §2.3 broker drain)
+                from .worker import BatchDrainWorker
+
+                w = BatchDrainWorker(
+                    self, seed=self.config.get("seed"), batch_size=drain_n
+                )
+            else:
+                w = Worker(self, seed=self.config.get("seed"))
             self.workers.append(w)
             w.start()
         if wait_for_leader is None:

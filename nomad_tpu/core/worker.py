@@ -67,17 +67,19 @@ class Worker:
             self.process_eval(ev, token)
 
     # ------------------------------------------------------------------
-    def process_eval(self, ev: Evaluation, token: str):
+    def process_eval(self, ev: Evaluation, token: str, snapshot=None, collector=None):
         """Dequeue → snapshot ≥ wait index → invoke scheduler → ack/nack
-        (ref worker.go:142-276)."""
+        (ref worker.go:142-276). ``snapshot``/``collector`` are supplied by
+        the batch-drain path (one shared snapshot, fused kernel)."""
         try:
-            snapshot = self.server.state.snapshot_min_index(
-                ev.modify_index, timeout=RAFT_SYNC_LIMIT
-            )
+            if snapshot is None:
+                snapshot = self.server.state.snapshot_min_index(
+                    ev.modify_index, timeout=RAFT_SYNC_LIMIT
+                )
             self._eval_token = token
             self._eval = ev
             self._snapshot_index = snapshot.latest_index()
-            self.invoke_scheduler(snapshot, ev)
+            self.invoke_scheduler(snapshot, ev, collector=collector)
         except Exception:
             logger.exception("eval processing failed; nacking %s", ev.id)
             try:
@@ -88,12 +90,15 @@ class Worker:
         finally:
             self._eval_token = ""
             self._eval = None
+            if collector is not None:
+                # no-op if the eval submitted or already left (fallback)
+                collector.leave(ev.id)
         try:
             self.server.eval_broker.ack(ev.id, token)
         except BrokerError:
             pass
 
-    def invoke_scheduler(self, snapshot, ev: Evaluation):
+    def invoke_scheduler(self, snapshot, ev: Evaluation, collector=None):
         """ref worker.go:244-276"""
         rng = random.Random(self.seed) if self.seed is not None else None
         sched_name = ev.type
@@ -102,6 +107,10 @@ class Worker:
             if ev.type in ("service", "batch"):
                 sched_name = self.server.config["default_scheduler"]
         sched = new_scheduler(sched_name, snapshot, self, rng=rng)
+        if collector is not None and hasattr(sched, "drain_collector"):
+            # non-tpu schedulers simply never consume the collector; the
+            # caller's finally-leave covers them
+            sched.drain_collector = collector
         sched.process(ev)
 
     # ------------------------------------------------------------------
@@ -144,3 +153,67 @@ class Worker:
         if not ev.snapshot_index:
             ev.snapshot_index = self._snapshot_index
         self.server.update_evals([ev])
+
+
+class BatchDrainWorker(Worker):
+    """Worker that drains up to ``batch_size`` ready evals per cycle and
+    fuses their placement scans into one kernel invocation (the north-star
+    bridge: EvalBroker.dequeue_batch → one multi-eval program → individual
+    plan submission and ack/nack; SURVEY §2.3, worker.go:105-276).
+
+    Each drained eval runs its full scheduler bookkeeping on its own thread
+    against one shared snapshot; their kernels rendezvous at a
+    KernelBatchCollector. At-least-once semantics are untouched: every eval
+    is acked/nacked individually by its own thread.
+    """
+
+    def __init__(self, server, schedulers=None, seed=None, batch_size: int = 16):
+        super().__init__(server, schedulers, seed)
+        self.batch_size = batch_size
+
+    def run(self):
+        while not self._stop.is_set():
+            batch = self.server.eval_broker.dequeue_batch(
+                self.schedulers, self.batch_size, timeout=DEQUEUE_TIMEOUT
+            )
+            if not batch:
+                continue
+            self.process_batch(batch)
+
+    def process_batch(self, batch: list):
+        if len(batch) == 1:
+            self.process_eval(*batch[0])
+            return
+
+        from ..tpu.drain import KernelBatchCollector, SharedCluster
+
+        try:
+            snapshot = self.server.state.snapshot_min_index(
+                max(ev.modify_index for ev, _ in batch), timeout=RAFT_SYNC_LIMIT
+            )
+        except Exception:
+            logger.exception("drain snapshot failed; nacking batch")
+            for ev, token in batch:
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except BrokerError:
+                    pass
+            return
+
+        shared = SharedCluster(snapshot)
+        collector = KernelBatchCollector(shared, expected=len(batch))
+        threads = []
+        for ev, token in batch:
+            # one planner per eval: SubmitPlan attaches per-eval tokens and
+            # refresh snapshots, so workers can't be shared across threads
+            w = Worker(self.server, self.schedulers, seed=self.seed)
+            t = threading.Thread(
+                target=w.process_eval,
+                args=(ev, token),
+                kwargs={"snapshot": snapshot, "collector": collector},
+                daemon=True,
+            )
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)

@@ -73,9 +73,53 @@ class TPUBatchScheduler(GenericScheduler):
 
     def __init__(self, state, planner, rng=None, batch: bool = False):
         super().__init__(state, planner, batch=batch, rng=rng)
+        # when set, the first placement pass routes through the multi-eval
+        # drain collector (tpu/drain.py); refresh retries run solo
+        self.drain_collector = None
+
+    # ------------------------------------------------------------------
+    def _batchable(self, destructive: list, place: list) -> bool:
+        """Whether this eval's placements can join a fused kernel batch:
+        fresh placements only, kernel-supported groups, and no plan overlays
+        (stopped/lost allocs would make the shared usage plane wrong)."""
+        if destructive or not place:
+            return False
+        if any(p.previous_alloc is not None or p.canary for p in place):
+            return False
+        groups = {p.task_group.name: p.task_group for p in place}
+        if not all(kernel_supported(self.job, tg) for tg in groups.values()):
+            return False
+        if self.plan.node_update:
+            return False
+        return True
 
     # ------------------------------------------------------------------
     def _compute_placements(self, destructive: list, place: list):
+        collector = self.drain_collector
+        if collector is not None:
+            self.drain_collector = None
+            if self._batchable(destructive, place):
+                prep = self._prepare_drain(place, collector.shared)
+                if prep is not None:
+                    placements, used0 = collector.submit(prep)
+                    eligible = np.zeros(len(collector.shared.nodes), dtype=bool)
+                    eligible[prep.perm_eligible] = True
+                    self._materialize(
+                        place,
+                        placements,
+                        collector.shared.nodes,
+                        prep.by_dc,
+                        prep.planes_list,
+                        prep.g_index,
+                        prep.gid_real,
+                        used0,
+                        collector.shared.capacity,
+                        prep.g_demand,
+                        eligible=eligible,
+                    )
+                    return
+            collector.leave(self.eval.id)
+
         if destructive or not place:
             return super()._compute_placements(destructive, place)
 
@@ -91,6 +135,94 @@ class TPUBatchScheduler(GenericScheduler):
             return super()._compute_placements(destructive, place)
 
         self._kernel_placements(place, nodes, by_dc)
+
+    # ------------------------------------------------------------------
+    def _assemble_groups(self, cluster, place: list, n_limit_nodes: int):
+        """Group planes, demands, candidate limits, collision counts and the
+        per-alloc group-id vector for this eval's placements, evaluated
+        against ``cluster`` — the eval's own candidate set on the solo path,
+        or the batch's shared cluster on the drain path. One definition so
+        the two paths can't drift."""
+        ctx = self.ctx
+        tg_by_name = {p.task_group.name: p.task_group for p in place}
+        group_names = list(tg_by_name)
+        planes_list = [
+            build_group_planes(ctx, cluster, self.state, self.job, tg_by_name[n])
+            for n in group_names
+        ]
+        g_index = {n: i for i, n in enumerate(group_names)}
+        G = len(group_names)
+        n_nodes = len(cluster.nodes)
+
+        g_demand = np.zeros((G, 3), dtype=np.int32)
+        g_limit = np.zeros(G, dtype=np.int32)
+        collisions0 = np.zeros((G, n_nodes), dtype=np.int32)
+        for name, gi in g_index.items():
+            tg = tg_by_name[name]
+            g_demand[gi] = (
+                sum(t.resources.cpu for t in tg.tasks),
+                sum(t.resources.memory_mb for t in tg.tasks),
+                tg.ephemeral_disk.size_mb,
+            )
+            planes = planes_list[gi]
+            g_limit[gi] = min(
+                compute_limit(
+                    n_limit_nodes,
+                    self.batch,
+                    bool(planes.affinity_present.any())
+                    or planes.node_value is not None,
+                ),
+                n_limit_nodes,
+            )
+            collisions0[gi] = cluster.collision_counts(
+                self.state, self.job.id, planes.name
+            )
+        gid_real = np.fromiter(
+            (g_index[p.task_group.name] for p in place),
+            dtype=np.int32,
+            count=len(place),
+        )
+        return planes_list, g_index, g_demand, g_limit, gid_real, collisions0
+
+    # ------------------------------------------------------------------
+    def _prepare_drain(self, place: list, shared):
+        """Build this eval's contribution to a fused drain batch: group
+        planes over the shared cluster, demands/limits, and the shuffled
+        ring of datacenter-eligible node indices."""
+        from .drain import DrainPrep
+
+        ctx = self.ctx
+        nodes_elig, by_dc = self.state.ready_nodes_in_dcs(self.job.datacenters)
+        if not nodes_elig:
+            return None
+
+        shuffled = list(nodes_elig)
+        shuffle_nodes(ctx, shuffled)
+        index = shared.cluster.index
+        try:
+            perm_eligible = np.fromiter(
+                (index[n.id] for n in shuffled), dtype=np.int32, count=len(shuffled)
+            )
+        except KeyError:
+            # eligible node missing from the shared cluster (snapshot skew)
+            return None
+
+        planes_list, g_index, g_demand, g_limit, gid_real, collisions0 = (
+            self._assemble_groups(shared.cluster, place, len(nodes_elig))
+        )
+        return DrainPrep(
+            eval_id=self.eval.id,
+            priority=self.eval.priority,
+            create_index=self.eval.create_index,
+            planes_list=planes_list,
+            g_index=g_index,
+            g_demand=g_demand,
+            g_limit=g_limit,
+            gid_real=gid_real,
+            perm_eligible=perm_eligible,
+            collisions0=collisions0,
+            by_dc=by_dc,
+        )
 
     # ------------------------------------------------------------------
     def _kernel_placements(self, place: list, nodes: list, by_dc: dict):
@@ -111,25 +243,10 @@ class TPUBatchScheduler(GenericScheduler):
         cluster = ColumnarCluster(nodes)
         perm_real = np.array([cluster.index[n.id] for n in shuffled], dtype=np.int32)
 
-        # group planes
-        group_names = []
-        planes_list = []
-        for name, tg in {p.task_group.name: p.task_group for p in place}.items():
-            group_names.append(name)
-            planes_list.append(
-                build_group_planes(ctx, cluster, self.state, self.job, tg)
-            )
-        g_index = {n: i for i, n in enumerate(group_names)}
-        G = len(group_names)
-
-        # demands per group
-        tg_by_name = {p.task_group.name: p.task_group for p in place}
-        demand_by_group = {}
-        for name, tg in tg_by_name.items():
-            cpu = sum(t.resources.cpu for t in tg.tasks)
-            mem = sum(t.resources.memory_mb for t in tg.tasks)
-            disk = tg.ephemeral_disk.size_mb
-            demand_by_group[name] = (cpu, mem, disk)
+        planes_list, g_index, g_demand, g_limit, gid_real, collisions0_real = (
+            self._assemble_groups(cluster, place, n_real)
+        )
+        G = len(planes_list)
 
         # pad node axis
         N = _bucket(n_real)
@@ -156,6 +273,7 @@ class TPUBatchScheduler(GenericScheduler):
         counts0 = np.zeros((G, V), dtype=np.int32)
         present0 = np.zeros((G, V), dtype=bool)
         collisions0 = np.zeros((G, N), dtype=np.int32)
+        collisions0[:, :n_real] = collisions0_real
 
         has_aff_or_spread = False
         for gi, planes in enumerate(planes_list):
@@ -163,9 +281,6 @@ class TPUBatchScheduler(GenericScheduler):
             affinity[gi, :n_real] = planes.affinity
             affinity_present[gi, :n_real] = planes.affinity_present
             group_count[gi] = planes.count
-            collisions0[gi, :n_real] = cluster.collision_counts(
-                self.state, self.job.id, planes.name
-            )
             if planes.node_value is not None:
                 node_value[gi, :n_real] = planes.node_value
                 nv = len(planes.counts0)
@@ -183,23 +298,6 @@ class TPUBatchScheduler(GenericScheduler):
         # Python loop was ~0.3s of pure overhead at 50K allocs)
         a_real = len(place)
         A = _bucket(a_real)
-        g_demand = np.zeros((G, 3), dtype=np.int32)
-        g_limit = np.zeros(G, dtype=np.int32)
-        for name, gi in g_index.items():
-            g_demand[gi] = demand_by_group[name]
-            planes = planes_list[gi]
-            g_limit[gi] = min(
-                compute_limit(
-                    n_real,
-                    self.batch,
-                    bool(planes.affinity_present.any())
-                    or planes.node_value is not None,
-                ),
-                n_real,
-            )
-        gid_real = np.fromiter(
-            (g_index[p.task_group.name] for p in place), dtype=np.int32, count=a_real
-        )
         group_ids = np.zeros(A, dtype=np.int32)
         group_ids[:a_real] = gid_real
         demands = np.zeros((A, 3), dtype=np.int32)
@@ -318,13 +416,15 @@ class TPUBatchScheduler(GenericScheduler):
             affinity=jnp.asarray(affinity),
             affinity_present=jnp.asarray(affinity_present),
             group_count=jnp.asarray(group_count),
+            group_eval=jnp.zeros(G, dtype=np.int32),
             node_value=jnp.asarray(node_value),
             spread_desired=jnp.asarray(spread_desired),
             spread_implicit=jnp.asarray(spread_implicit),
             spread_weight_frac=jnp.asarray(spread_weight_frac),
             spread_even=jnp.asarray(spread_even),
             spread_active=jnp.asarray(spread_active),
-            perm=jnp.asarray(perm),
+            perm=jnp.asarray(perm[None, :]),
+            ring=jnp.asarray(np.array([n_real], dtype=np.int32)),
             demands=jnp.asarray(demands),
             groups=jnp.asarray(group_ids),
             limits=jnp.asarray(limits),
@@ -335,7 +435,7 @@ class TPUBatchScheduler(GenericScheduler):
             collisions=jnp.asarray(collisions0),
             spread_counts=jnp.asarray(counts0),
             spread_present=jnp.asarray(present0),
-            offset=jnp.asarray(0, dtype=np.int32),
+            offset=jnp.zeros(1, dtype=np.int32),
         )
 
         t_columnar = time.monotonic()
@@ -355,7 +455,8 @@ class TPUBatchScheduler(GenericScheduler):
 
     # ------------------------------------------------------------------
     def _failed_group_metric(
-        self, gi, planes_list, by_dc, used_final, capacity, demand, n_real
+        self, gi, planes_list, by_dc, used_final, capacity, demand, n_real,
+        eligible=None,
     ) -> AllocMetric:
         """Measured failure accounting for one task group: a feasible node is
         exhausted if one more alloc of this group's demand overflows some
@@ -363,11 +464,18 @@ class TPUBatchScheduler(GenericScheduler):
         when this group first failed; the recorded dimension is the first
         failing of cpu/memory/disk (the superset-check order,
         structs.go:3199-3210). Measured from the kernel's actual state
-        rather than guessed."""
+        rather than guessed. ``eligible`` restricts the node universe to the
+        eval's datacenter-eligible ring on the drain path, so metrics match
+        what the same eval would report solo."""
         metrics = AllocMetric()
-        metrics.nodes_evaluated = n_real
         feasible = planes_list[gi].feasible
-        metrics.nodes_filtered = int((~feasible).sum())
+        if eligible is not None:
+            metrics.nodes_evaluated = int(eligible.sum())
+            feasible = feasible & eligible
+            metrics.nodes_filtered = int((eligible & ~feasible).sum())
+        else:
+            metrics.nodes_evaluated = n_real
+            metrics.nodes_filtered = int((~feasible).sum())
         metrics.nodes_available = by_dc
         over = used_final + demand[None, :] > capacity[:n_real]
         exhausted = feasible & over.any(axis=1)
@@ -382,11 +490,12 @@ class TPUBatchScheduler(GenericScheduler):
     # ------------------------------------------------------------------
     def _materialize(
         self, place, placements, nodes, by_dc, planes_list, g_index,
-        gid_real, used0, capacity, g_demand, t_dispatch=None,
+        gid_real, used0, capacity, g_demand, t_dispatch=None, eligible=None,
     ):
         import time
 
         n_real = len(nodes)
+        n_evaluated = int(eligible.sum()) if eligible is not None else n_real
         deployment_id = ""
         if self.deployment is not None and self.deployment.active():
             deployment_id = self.deployment.id
@@ -394,7 +503,9 @@ class TPUBatchScheduler(GenericScheduler):
         # Templates and ids don't depend on the placements, so when the
         # kernel dispatch was asynchronous (t_dispatch set) this prep work
         # overlaps device execution; np.asarray below is the sync point.
-        template_by_group = self._build_templates(place, g_index, by_dc, n_real, deployment_id)
+        template_by_group = self._build_templates(
+            place, g_index, by_dc, n_evaluated, deployment_id
+        )
         ids = generate_uuids(len(place))
 
         placements = np.asarray(placements)
@@ -432,7 +543,8 @@ class TPUBatchScheduler(GenericScheduler):
                     continue
                 gi = g_index[tg.name]
                 self.failed_tg_allocs[tg.name] = self._failed_group_metric(
-                    gi, planes_list, by_dc, used_at(i), capacity, g_demand[gi], n_real
+                    gi, planes_list, by_dc, used_at(i), capacity, g_demand[gi],
+                    n_real, eligible=eligible,
                 )
                 continue
 
@@ -454,7 +566,7 @@ class TPUBatchScheduler(GenericScheduler):
             bucket.append(alloc)
 
     # ------------------------------------------------------------------
-    def _build_templates(self, place, g_index, by_dc, n_real, deployment_id):
+    def _build_templates(self, place, g_index, by_dc, n_evaluated, deployment_id):
         # Per-group template allocation: every placement of a group carries
         # identical AllocatedResources and (successful) AllocMetric content,
         # so one nested instance per group is shared by reference across the
@@ -482,7 +594,7 @@ class TPUBatchScheduler(GenericScheduler):
                 shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
             )
             metrics = AllocMetric()
-            metrics.nodes_evaluated = n_real
+            metrics.nodes_evaluated = n_evaluated
             metrics.nodes_available = by_dc
             template_by_group[name] = Allocation(
                 namespace=self.job.namespace,

@@ -1,0 +1,311 @@
+"""Multi-eval kernel batching for the eval-broker drain.
+
+The reference schedules with one worker goroutine per core, each planning a
+single evaluation against its own snapshot (worker.go:105-276). The TPU
+bridge instead drains N evaluations at once (SURVEY §2.3: "this is where the
+TPU bridge drains N evals at a time"): each eval still runs its full
+scheduler bookkeeping — reconciler, plan construction, blocked evals,
+individual plan submission and ack/nack — on its own thread, but the
+placement scans all park at a :class:`KernelBatchCollector`, which fuses
+them into ONE multi-eval ``plan_batch`` program (kernel.py: per-eval ring
+permutations/cursors over a shared capacity plane) and hands each eval its
+slice of the placements.
+
+Because the fused scan threads capacity sequentially across evals (in
+dequeue/priority order), the combined plans never oversubscribe each other
+— the batch behaves like the serialized plan applier would, instead of N
+optimistic plans racing and partially rejecting.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .columnar import ColumnarCluster, GroupPlanes
+
+logger = logging.getLogger("nomad_tpu.tpu.drain")
+
+#: stats of the most recent drain invocation (benchmark/observability)
+LAST_DRAIN_STATS: dict = {}
+
+#: cumulative drain accounting (observability / tests)
+DRAIN_COUNTERS = {"batches": 0, "evals": 0}
+
+
+class SharedCluster:
+    """The node-axis arrays every eval in a drain batch shares: all ready
+    nodes (any datacenter — per-eval DC eligibility lives in each eval's
+    ring permutation), their capacity planes, and the snapshot usage."""
+
+    def __init__(self, snapshot):
+        nodes = [n for n in snapshot.nodes() if n.ready()]
+        self.nodes = nodes
+        self.cluster = ColumnarCluster(nodes)
+        self.used0 = self.cluster.initial_used(snapshot).astype(np.int64)
+        self.capacity = self.cluster.capacity
+        self.usable = self.cluster.usable
+
+
+@dataclass
+class DrainPrep:
+    """One eval's contribution to the fused kernel batch (all arrays are in
+    the shared cluster's node-index space)."""
+
+    eval_id: str
+    priority: int
+    create_index: int
+    planes_list: list[GroupPlanes]
+    g_index: dict[str, int]
+    g_demand: np.ndarray  # i32[Gi,3]
+    g_limit: np.ndarray  # i32[Gi]
+    gid_real: np.ndarray  # i32[Ai]
+    perm_eligible: np.ndarray  # i32[n_elig] shuffled eligible node indices
+    collisions0: np.ndarray  # i32[Gi, n_real] same-job alloc counts
+    by_dc: dict[str, int]
+
+
+class _Parked:
+    def __init__(self, prep: DrainPrep):
+        self.prep = prep
+        self.event = threading.Event()
+        self.placements: Optional[np.ndarray] = None
+        self.used0: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+from .batch_sched import _bucket  # one padding-bucket policy for all kernels
+
+
+class KernelBatchCollector:
+    """Rendezvous for the evals of one drain batch.
+
+    Each eval's scheduler thread either ``submit()``s its prepared inputs
+    (blocking until the fused kernel returns its placement slice) or
+    ``leave()``s (fallback path / no placements / error). The last thread to
+    arrive runs the combined kernel for everyone.
+    """
+
+    def __init__(self, shared: SharedCluster, expected: int, timeout: float = 60.0):
+        self.shared = shared
+        self.timeout = timeout
+        self._expected = expected
+        self._lock = threading.Lock()
+        self._parked: list[_Parked] = []
+        self._consumed: set[str] = set()
+        self.invocations = 0
+
+    # ------------------------------------------------------------------
+    def consumed(self, eval_id: str) -> bool:
+        with self._lock:
+            return eval_id in self._consumed
+
+    def leave(self, eval_id: str):
+        """An eval is not participating (fallback, no-op plan, or error).
+        Idempotent per eval — the scheduler's fallback path and the worker's
+        finally-guard may both call it."""
+        with self._lock:
+            if eval_id in self._consumed:
+                return
+            self._consumed.add(eval_id)
+            self._expected -= 1
+            self._maybe_run_locked()
+
+    def submit(self, prep: DrainPrep) -> tuple[np.ndarray, np.ndarray]:
+        """Park this eval's inputs; returns (placements slice, usage base
+        including all earlier evals' grants)."""
+        park = _Parked(prep)
+        with self._lock:
+            self._consumed.add(prep.eval_id)
+            self._parked.append(park)
+            self._maybe_run_locked()
+        if not park.event.wait(self.timeout):
+            raise RuntimeError("drain kernel batch timed out")
+        if park.error is not None:
+            raise park.error
+        return park.placements, park.used0
+
+    # ------------------------------------------------------------------
+    def _maybe_run_locked(self):
+        if len(self._parked) < self._expected or not self._parked:
+            return
+        parked, self._parked = self._parked, []
+        self._expected = 0
+        # deterministic sequencing regardless of thread arrival order:
+        # highest priority first, then submission order (the broker's
+        # dequeue ordering), so capacity threads through the fused scan the
+        # way the serialized applier would commit
+        parked.sort(
+            key=lambda p: (-p.prep.priority, p.prep.create_index, p.prep.eval_id)
+        )
+        try:
+            self._run(parked)
+        except BaseException as e:  # propagate to every parked thread
+            logger.exception("drain kernel batch failed")
+            for p in parked:
+                p.error = e
+        finally:
+            for p in parked:
+                p.event.set()
+
+    # ------------------------------------------------------------------
+    def _run(self, parked: list[_Parked]):
+        import jax.numpy as jnp
+
+        from .kernel import BatchArgs, BatchState, plan_batch
+
+        t0 = time.monotonic()
+        shared = self.shared
+        n_real = len(shared.nodes)
+        N = _bucket(n_real)
+        E = _bucket(len(parked))
+        G = _bucket(sum(len(p.prep.planes_list) for p in parked))
+        A_real = sum(len(p.prep.gid_real) for p in parked)
+        A = _bucket(A_real)
+        V = _bucket(
+            max(
+                max(
+                    (len(pl.counts0) for p in parked for pl in p.prep.planes_list
+                     if pl.counts0 is not None),
+                    default=1,
+                ),
+                1,
+            )
+        )
+
+        capacity = np.zeros((N, 3), dtype=np.int32)
+        capacity[:n_real] = shared.capacity
+        usable = np.ones((N, 2), dtype=np.float32)
+        usable[:n_real] = shared.usable
+        used0 = np.full((N, 3), 2**30, dtype=np.int32)
+        used0[:n_real] = shared.used0
+
+        feasible = np.zeros((G, N), dtype=bool)
+        affinity = np.zeros((G, N), dtype=np.float32)
+        affinity_present = np.zeros((G, N), dtype=bool)
+        group_count = np.ones(G, dtype=np.int32)
+        group_eval = np.full(G, E - 1, dtype=np.int32)
+        node_value = np.full((G, N), -1, dtype=np.int32)
+        spread_desired = np.full((G, V), -1.0, dtype=np.float32)
+        spread_implicit = np.full(G, -1.0, dtype=np.float32)
+        spread_weight_frac = np.zeros(G, dtype=np.float32)
+        spread_even = np.zeros(G, dtype=bool)
+        spread_active = np.zeros(G, dtype=bool)
+        counts0 = np.zeros((G, V), dtype=np.int32)
+        present0 = np.zeros((G, V), dtype=bool)
+        collisions0 = np.zeros((G, N), dtype=np.int32)
+        perm = np.tile(np.arange(N, dtype=np.int32), (E, 1))
+        ring = np.zeros(E, dtype=np.int32)
+
+        demands = np.zeros((A, 3), dtype=np.int32)
+        groups = np.zeros(A, dtype=np.int32)
+        limits = np.zeros(A, dtype=np.int32)
+        valid = np.zeros(A, dtype=bool)
+
+        g_off = 0
+        a_off = 0
+        slices = []  # (park, a_start, a_len)
+        for e, park in enumerate(parked):
+            prep = park.prep
+            n_elig = len(prep.perm_eligible)
+            rest = np.setdiff1d(
+                np.arange(N, dtype=np.int32), prep.perm_eligible, assume_unique=False
+            )
+            perm[e] = np.concatenate([prep.perm_eligible, rest])
+            ring[e] = n_elig
+            for gi, planes in enumerate(prep.planes_list):
+                g = g_off + gi
+                feasible[g, :n_real] = planes.feasible
+                affinity[g, :n_real] = planes.affinity
+                affinity_present[g, :n_real] = planes.affinity_present
+                group_count[g] = planes.count
+                group_eval[g] = e
+                collisions0[g, :n_real] = prep.collisions0[gi]
+                if planes.node_value is not None:
+                    node_value[g, :n_real] = planes.node_value
+                    nv = len(planes.counts0)
+                    counts0[g, :nv] = planes.counts0
+                    present0[g, :nv] = planes.present0
+                    spread_desired[g, : len(planes.desired)] = planes.desired
+                    spread_implicit[g] = planes.implicit
+                    spread_weight_frac[g] = planes.weight_frac
+                    spread_even[g] = planes.even
+                    spread_active[g] = True
+            a_len = len(prep.gid_real)
+            demands[a_off : a_off + a_len] = prep.g_demand[prep.gid_real]
+            groups[a_off : a_off + a_len] = prep.gid_real + g_off
+            limits[a_off : a_off + a_len] = prep.g_limit[prep.gid_real]
+            valid[a_off : a_off + a_len] = True
+            slices.append((park, a_off, a_len))
+            g_off += len(prep.planes_list)
+            a_off += a_len
+
+        args = BatchArgs(
+            capacity=jnp.asarray(capacity),
+            usable=jnp.asarray(usable),
+            feasible=jnp.asarray(feasible),
+            affinity=jnp.asarray(affinity),
+            affinity_present=jnp.asarray(affinity_present),
+            group_count=jnp.asarray(group_count),
+            group_eval=jnp.asarray(group_eval),
+            node_value=jnp.asarray(node_value),
+            spread_desired=jnp.asarray(spread_desired),
+            spread_implicit=jnp.asarray(spread_implicit),
+            spread_weight_frac=jnp.asarray(spread_weight_frac),
+            spread_even=jnp.asarray(spread_even),
+            spread_active=jnp.asarray(spread_active),
+            perm=jnp.asarray(perm),
+            ring=jnp.asarray(ring),
+            demands=jnp.asarray(demands),
+            groups=jnp.asarray(groups),
+            limits=jnp.asarray(limits),
+            valid=jnp.asarray(valid),
+        )
+        init = BatchState(
+            used=jnp.asarray(used0),
+            collisions=jnp.asarray(collisions0),
+            spread_counts=jnp.asarray(counts0),
+            spread_present=jnp.asarray(present0),
+            offset=np.zeros(E, dtype=np.int32),
+        )
+        t_build = time.monotonic()
+        _, placements = plan_batch(args, init, n_real)
+        placements = np.asarray(placements)
+        t_kernel = time.monotonic()
+
+        # split slices and hand each eval a usage base that includes all
+        # earlier evals' grants (exact sequential semantics for its own
+        # failure accounting)
+        running = shared.used0.copy()
+        for park, a_start, a_len in slices:
+            park.placements = placements[a_start : a_start + a_len]
+            park.used0 = running
+            placed = park.placements
+            ok = (placed >= 0) & (placed < n_real)
+            if ok.any():
+                running = running.copy()
+                prep = park.prep
+                for gj in range(len(prep.planes_list)):
+                    m = ok & (prep.gid_real == gj)
+                    if m.any():
+                        counts = np.bincount(placed[m], minlength=n_real)
+                        running[:n_real] += (
+                            counts[:, None] * prep.g_demand[gj][None, :]
+                        ).astype(np.int64)
+
+        self.invocations += 1
+        DRAIN_COUNTERS["batches"] += 1
+        DRAIN_COUNTERS["evals"] += len(parked)
+        LAST_DRAIN_STATS.update(
+            n_evals=len(parked),
+            n_allocs=A_real,
+            n_nodes=n_real,
+            build_s=t_build - t0,
+            kernel_s=t_kernel - t_build,
+            padded=(E, G, A, N, V),
+        )

@@ -35,7 +35,15 @@ NEG_INF = -1e30
 
 
 class BatchArgs(NamedTuple):
-    """Static per-batch planes (see columnar.py for construction)."""
+    """Static per-batch planes (see columnar.py for construction).
+
+    The batch may span several evaluations (the eval-broker drain,
+    worker.go:105-276 / SURVEY §2.3): each group belongs to one eval via
+    ``group_eval``, and every eval has its own shuffled node ring (``perm``
+    row), ring size (``ring`` — the count of its datacenter-eligible nodes,
+    which occupy the front of its perm row) and rotating cursor
+    (``BatchState.offset`` entry). Capacity/usage are shared, so placements
+    sequence across evals exactly like the serialized plan applier would."""
 
     capacity: jax.Array  # i32[N,3]
     usable: jax.Array  # f32[N,2]
@@ -43,6 +51,7 @@ class BatchArgs(NamedTuple):
     affinity: jax.Array  # f32[G,N]
     affinity_present: jax.Array  # bool[G,N]
     group_count: jax.Array  # i32[G]
+    group_eval: jax.Array  # i32[G] owning eval per group
     # spread planes
     node_value: jax.Array  # i32[G,N] (-1 = missing)
     spread_desired: jax.Array  # f32[G,V] (-1 = absent)
@@ -50,7 +59,8 @@ class BatchArgs(NamedTuple):
     spread_weight_frac: jax.Array  # f32[G] (0 = no spread)
     spread_even: jax.Array  # bool[G]
     spread_active: jax.Array  # bool[G]
-    perm: jax.Array  # i32[N] node id at shuffled position p
+    perm: jax.Array  # i32[E,N] node id at shuffled ring position p, per eval
+    ring: jax.Array  # i32[E] ring size (eligible-node count) per eval
     # per-alloc
     demands: jax.Array  # i32[A,3]
     groups: jax.Array  # i32[A]
@@ -63,7 +73,7 @@ class BatchState(NamedTuple):
     collisions: jax.Array  # i32[G,N]
     spread_counts: jax.Array  # i32[G,V]
     spread_present: jax.Array  # bool[G,V]
-    offset: jax.Array  # i32 scalar
+    offset: jax.Array  # i32[E] ring cursor per eval
 
 
 def _binpack(free_cpu, free_mem):
@@ -182,17 +192,20 @@ def _step(n_real: int, args: BatchArgs, state: BatchState, alloc):
     demand, g, limit, valid = alloc
     n_pad = args.capacity.shape[0]
     positions = jnp.arange(n_pad)
-    in_ring = positions < n_real
+    e = args.group_eval[g]
+    ring_size = args.ring[e]
+    perm = args.perm[e]
+    in_ring = positions < ring_size
 
     fit_nodes = args.feasible[g] & jnp.all(
         state.used + demand[None, :] <= args.capacity, axis=1
     )
     final = _scores(args, state, g, demand)
 
-    # permuted (shuffled) coordinates; ring positions are [0, n_real)
-    fit_p = fit_nodes[args.perm] & in_ring
-    score_p = final[args.perm]
-    offset = state.offset
+    # permuted (shuffled) coordinates; ring positions are [0, ring_size)
+    fit_p = fit_nodes[perm] & in_ring
+    score_p = final[perm]
+    offset = state.offset[e]
 
     fit_total = jnp.sum(fit_p.astype(jnp.int32))
 
@@ -216,7 +229,7 @@ def _step(n_real: int, args: BatchArgs, state: BatchState, alloc):
     candidates = returned | replay
 
     # rotation rank of every ring position (0 = the iterator's cursor)
-    rot_rank = jnp.where(positions >= offset, positions - offset, n_real - offset + positions)
+    rot_rank = jnp.where(positions >= offset, positions - offset, ring_size - offset + positions)
 
     found = jnp.any(candidates)
     max_score = jnp.max(jnp.where(candidates, score_p, NEG_INF))
@@ -224,14 +237,14 @@ def _step(n_real: int, args: BatchArgs, state: BatchState, alloc):
     # options in rotation order, then any replayed (deferred) options
     # (select.go:59-66 replays skipped nodes only after the source exhausts)
     tie = candidates & (score_p == max_score)
-    visit_order = rot_rank + jnp.where(replay, n_real, 0)
+    visit_order = rot_rank + jnp.where(replay, n_pad, 0)
     best_p = jnp.argmin(jnp.where(tie, visit_order, 2**30))
-    best_node = args.perm[best_p]
+    best_node = perm[best_p]
 
     # source positions consumed (StaticIterator.seen accounting): all ring
     # positions up to and including the limit-th returned option
     last_ret_rank = jnp.max(jnp.where(returned, rot_rank, -1))
-    consumed = jnp.where(n_returned >= limit, last_ret_rank + 1, n_real)
+    consumed = jnp.where(n_returned >= limit, last_ret_rank + 1, ring_size)
 
     place = found & valid
     best_node = jnp.where(place, best_node, -1)
@@ -260,7 +273,11 @@ def _step(n_real: int, args: BatchArgs, state: BatchState, alloc):
         state.spread_present.at[g, safe_v].set(True),
         state.spread_present,
     )
-    new_offset = jnp.where(valid, (state.offset + consumed) % n_real, state.offset)
+    new_offset = jnp.where(
+        valid,
+        state.offset.at[e].set((offset + consumed) % jnp.maximum(ring_size, 1)),
+        state.offset,
+    )
 
     new_state = BatchState(used, collisions, spread_counts, spread_present, new_offset)
     return new_state, best_node
@@ -359,7 +376,7 @@ class RunArgs(NamedTuple):
     n_allocs: jax.Array  # i32 scalar
 
 
-def _run_class_boosts(args: RunArgs, counts, present, V):
+def _run_class_boosts(args: RunArgs, counts, present):
     """Run-planner view of the shared spread-boost formula."""
     return _class_boosts(
         counts,
@@ -427,7 +444,7 @@ def plan_batch_runs(
         fit = args.feasible & jnp.all(
             used + args.demand[None, :] <= args.capacity, axis=1
         )
-        boosts = _run_class_boosts(args, counts, present, V)
+        boosts = _run_class_boosts(args, counts, present)
         score, num = _score_at(used, coll, boosts, 0, 0, 0)
         avail = fit
         any_avail = jnp.any(avail)
