@@ -503,6 +503,13 @@ class HTTPServer:
             None,
         )
 
+    @route("PUT", r"/v1/system/gc")
+    def system_gc(self, m, query, body):
+        """Force-GC all eligible terminal objects
+        (ref system_endpoint.go GarbageCollect)."""
+        self.server.system_gc()
+        return {}, None
+
     @route("GET", r"/v1/operator/scheduler/configuration")
     def get_scheduler_config(self, m, query, body):
         return self.server.state.scheduler_config() or {}, None

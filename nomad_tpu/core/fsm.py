@@ -76,11 +76,13 @@ class FSM:
         eval_broker=None,
         blocked_evals=None,
         periodic_dispatcher=None,
+        time_table=None,
     ):
         self.state = state if state is not None else StateStore()
         self.eval_broker = eval_broker
         self.blocked_evals = blocked_evals
         self.periodic_dispatcher = periodic_dispatcher
+        self.time_table = time_table
         self._appliers: dict[str, Callable[[int, dict], Any]] = {
             NODE_REGISTER: self._apply_node_register,
             NODE_DEREGISTER: self._apply_node_deregister,
@@ -120,6 +122,9 @@ class FSM:
             # ignoreUnknownTypeFlag entries); log and skip.
             logger.error("fsm: unknown message type %r at index %d", msg_type, index)
             return None
+        if self.time_table is not None:
+            # witness index→time for GC age thresholds (fsm.go:258)
+            self.time_table.witness(index)
         return applier(index, payload)
 
     # ------------------------------------------------------------------

@@ -72,10 +72,19 @@ class Server:
         self.periodic = None  # PeriodicDispatch attaches in agent wiring
         self.deployment_watcher = None  # set by DeploymentsWatcher below
         self.drainer = None
+        # coarse time→index witness map feeding GC thresholds
+        # (ref fsm.go TimeTable; not snapshot-persisted — after a restart
+        # the table refills and GC conservatively pauses for one threshold)
+        from .core_sched import TimeTable
+
+        self.time_table = TimeTable(
+            granularity=float(self.config.get("time_table_granularity", 60.0))
+        )
         self.fsm = FSM(
             state=self.state,
             eval_broker=self.eval_broker,
             blocked_evals=self.blocked_evals,
+            time_table=self.time_table,
         )
         self.planner = Planner(self.state)
         self.planner.commit_fn = self._commit_plan
@@ -88,6 +97,7 @@ class Server:
         self._leader = False
         self._leader_cond = threading.Condition()
         self._reaper: Optional[threading.Thread] = None
+        self._gc_scheduler: Optional[threading.Thread] = None
 
         DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
         NodeDrainer(self)  # installs itself as self.drainer
@@ -225,11 +235,15 @@ class Server:
             self.deployment_watcher.set_enabled(True)
         if self.drainer is not None:
             self.drainer.set_enabled(True)
-        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
-        self._reaper.start()
+        # the flag must be up before the leader loops launch — they check it
+        # as their run condition and would otherwise race a one-iteration exit
         with self._leader_cond:
             self._leader = True
             self._leader_cond.notify_all()
+        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
+        self._reaper.start()
+        self._gc_scheduler = threading.Thread(target=self._schedule_core_gc, daemon=True)
+        self._gc_scheduler.start()
         logger.info("server %s: leadership established", self.raft.node_id)
 
     def _revoke_leadership(self):
@@ -295,6 +309,53 @@ class Server:
                 return
             except Exception:
                 logger.exception("failed-eval reaping error for %s", ev.id)
+
+    def _schedule_core_gc(self):
+        """Leader cron enqueuing GC core-job evals on their intervals
+        (ref leader.go:440-486 schedulePeriodic). Core evals live only in
+        the leader's broker — they are never raft-persisted."""
+        from .core_sched import (
+            CORE_JOB_DEPLOYMENT_GC,
+            CORE_JOB_EVAL_GC,
+            CORE_JOB_JOB_GC,
+            CORE_JOB_NODE_GC,
+            core_job_eval,
+        )
+
+        this_thread = threading.current_thread()
+        intervals = {
+            CORE_JOB_EVAL_GC: float(self.config.get("eval_gc_interval", 300.0)),
+            CORE_JOB_NODE_GC: float(self.config.get("node_gc_interval", 300.0)),
+            CORE_JOB_JOB_GC: float(self.config.get("job_gc_interval", 300.0)),
+            CORE_JOB_DEPLOYMENT_GC: float(
+                self.config.get("deployment_gc_interval", 300.0)
+            ),
+        }
+        next_fire = {job: time.monotonic() + iv for job, iv in intervals.items()}
+        while (
+            self._running and self._leader and self._gc_scheduler is this_thread
+        ):
+            # keep witnessing the head index as wall time passes; apply-time
+            # witnesses alone never age the newest writes on an idle cluster
+            self.time_table.witness(self.state.latest_index())
+            now = time.monotonic()
+            for job, fire_at in next_fire.items():
+                if now >= fire_at:
+                    next_fire[job] = now + intervals[job]
+                    self.eval_broker.enqueue(
+                        core_job_eval(job, self.state.latest_index())
+                    )
+            time.sleep(min(1.0, min(iv for iv in intervals.values())))
+
+    def system_gc(self):
+        """Force-GC everything eligible (ref system_endpoint.go GarbageCollect
+        → CoreJobForceGC). Leader-only."""
+        from .core_sched import CORE_JOB_FORCE_GC, core_job_eval
+
+        self._check_leader()
+        self.eval_broker.enqueue(
+            core_job_eval(CORE_JOB_FORCE_GC, self.state.latest_index())
+        )
 
     # ------------------------------------------------------------------
     # Job endpoints (ref nomad/job_endpoint.go:80 Register)

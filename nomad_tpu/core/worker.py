@@ -35,7 +35,7 @@ class Worker:
         self.server = server
         # _failed is drained by the leader's reaper (Server._reap_failed_evals),
         # not by scheduling workers (ref leader.go:505 reapFailedEvaluations)
-        self.schedulers = schedulers or ["service", "batch", "system"]
+        self.schedulers = schedulers or ["service", "batch", "system", "_core"]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.seed = seed
@@ -100,6 +100,12 @@ class Worker:
 
     def invoke_scheduler(self, snapshot, ev: Evaluation, collector=None):
         """ref worker.go:244-276"""
+        if ev.type == "_core":
+            # GC runs in-worker against the snapshot (core_sched.go:26)
+            from .core_sched import CoreScheduler
+
+            CoreScheduler(self.server, snapshot).process(ev)
+            return
         rng = random.Random(self.seed) if self.seed is not None else None
         sched_name = ev.type
         if self.server.config.get("default_scheduler"):

@@ -1,0 +1,198 @@
+"""CoreScheduler garbage collection (ref nomad/core_sched.go:43-630,
+leader.go:440 schedulePeriodic, system_endpoint.go GarbageCollect)."""
+
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.core.core_sched import TimeTable
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def make_server(config=None):
+    cfg = dict(config or {})
+    cfg.setdefault("seed", 42)
+    cfg.setdefault("heartbeat_ttl", 600.0)
+    cfg["raft"] = {
+        "node_id": "s0",
+        "address": "raft0",
+        "voters": {"s0": "raft0"},
+        "transport": InmemTransport(),
+        "config": RaftConfig(
+            heartbeat_interval=0.02,
+            election_timeout_min=0.05,
+            election_timeout_max=0.10,
+        ),
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def run_job(server, count=2):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.networks = []
+    eval_id = server.job_register(job)
+    wait_until(
+        lambda: (server.state.eval_by_id(eval_id) or mock.evaluation()).status
+        == "complete",
+        msg="eval complete",
+    )
+    return job
+
+
+class TestTimeTable:
+    def test_witness_and_nearest(self):
+        tt = TimeTable(granularity=0.0)
+        tt.witness(10, when=100.0)
+        tt.witness(20, when=200.0)
+        tt.witness(30, when=300.0)
+        assert tt.nearest_index(50.0) == 0
+        assert tt.nearest_index(150.0) == 10
+        assert tt.nearest_index(250.0) == 20
+        assert tt.nearest_index(999.0) == 30
+
+    def test_granularity_suppresses(self):
+        tt = TimeTable(granularity=10.0)
+        tt.witness(1, when=100.0)
+        tt.witness(2, when=105.0)  # inside granularity window: dropped
+        tt.witness(3, when=120.0)
+        assert tt.nearest_index(110.0) == 1
+        assert tt.nearest_index(130.0) == 3
+
+
+class TestForceGC:
+    def test_force_gc_reaps_stopped_job(self):
+        """Stopped dead job: force GC purges job, evals and allocs
+        (core_sched.go jobGC + evalReap)."""
+        server = make_server()
+        try:
+            for _ in range(3):
+                server.node_register(mock.node())
+            job = run_job(server)
+            assert len(server.state.allocs_by_job(job.namespace, job.id)) == 2
+
+            # stop (deregister, no purge): allocs go terminal, job dead
+            server.job_deregister(job.namespace, job.id)
+            wait_until(
+                lambda: all(
+                    a.terminal_status()
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="allocs terminal",
+            )
+            wait_until(
+                lambda: (server.state.job_by_id(job.namespace, job.id)) is None
+                or server.state.job_by_id(job.namespace, job.id).status == "dead",
+                msg="job dead",
+            )
+
+            server.system_gc()
+            wait_until(
+                lambda: server.state.job_by_id(job.namespace, job.id) is None,
+                msg="job purged",
+            )
+            assert server.state.allocs_by_job(job.namespace, job.id) == []
+            assert server.state.evals_by_job(job.namespace, job.id) == []
+        finally:
+            server.stop()
+
+    def test_force_gc_reaps_down_node(self):
+        """Down node with no allocs is deregistered (core_sched.go nodeGC)."""
+        server = make_server()
+        try:
+            node = mock.node()
+            server.node_register(node)
+            server.node_update_status(node.id, "down")
+            server.system_gc()
+            wait_until(
+                lambda: server.state.node_by_id(node.id) is None,
+                msg="node reaped",
+            )
+        finally:
+            server.stop()
+
+    def test_force_gc_spares_live_job(self):
+        """A running service job's evals/allocs survive force GC."""
+        server = make_server()
+        try:
+            for _ in range(2):
+                server.node_register(mock.node())
+            job = run_job(server)
+            server.system_gc()
+            time.sleep(1.0)
+            assert server.state.job_by_id(job.namespace, job.id) is not None
+            assert len(server.state.allocs_by_job(job.namespace, job.id)) == 2
+        finally:
+            server.stop()
+
+    def test_http_system_gc_route(self):
+        from nomad_tpu.api.http import HTTPServer
+
+        server = make_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            import json
+            import urllib.request
+
+            node = mock.node()
+            server.node_register(node)
+            server.node_update_status(node.id, "down")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http.port}/v1/system/gc",
+                data=b"{}",
+                method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                json.loads(resp.read() or b"{}")
+            wait_until(
+                lambda: server.state.node_by_id(node.id) is None,
+                msg="node reaped via HTTP force gc",
+            )
+        finally:
+            http.stop()
+            server.stop()
+
+
+class TestPeriodicGC:
+    def test_leader_cron_reaps_on_interval(self):
+        """Terminal objects are reaped automatically by the leader's GC cron
+        with tiny thresholds (leader.go:440) — the long-running-cluster
+        state-size-bounded property."""
+        server = make_server(
+            {
+                "eval_gc_interval": 0.3,
+                "job_gc_interval": 0.3,
+                "node_gc_interval": 0.3,
+                "deployment_gc_interval": 0.3,
+                "eval_gc_threshold": 0.0,
+                "job_gc_threshold": 0.0,
+                "node_gc_threshold": 0.0,
+                "time_table_granularity": 0.3,
+            }
+        )
+        try:
+            for _ in range(2):
+                server.node_register(mock.node())
+            job = run_job(server)
+            server.job_deregister(job.namespace, job.id)
+            wait_until(
+                lambda: server.state.job_by_id(job.namespace, job.id) is None,
+                timeout=30.0,
+                msg="job auto-GC'd by leader cron",
+            )
+            assert server.state.allocs_by_job(job.namespace, job.id) == []
+        finally:
+            server.stop()
