@@ -44,12 +44,15 @@ class TaskRunner:
     """Per-task lifecycle with restart policy
     (ref client/allocrunner/taskrunner/task_runner.go:423-533)."""
 
-    def __init__(self, alloc_runner, task, driver: Driver):
+    def __init__(self, alloc_runner, task, driver: Driver, recovered_handle=None):
         self.alloc_runner = alloc_runner
         self.task = task
         self.driver = driver
         self.state = TaskState(state="pending")
         self.handle: Optional[TaskHandle] = None
+        # handle reattached by the driver's RecoverTask after a client
+        # restart; consumed by the first run-loop iteration
+        self._recovered_handle = recovered_handle
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restarts_in_interval: list[float] = []
@@ -69,23 +72,30 @@ class TaskRunner:
             restart_policy = tg.restart_policy
 
         while not self._stop.is_set():
-            try:
-                self.handle = self.driver.start_task(
-                    self.task, self.alloc_runner.task_dir(self.task.name)
-                )
-            except Exception as e:
-                # Start failures route through the restart policy like any
-                # other failure (ref taskrunner restart tracker)
-                if restart_policy is not None and self._restart_or_wait(
-                    restart_policy
-                ):
-                    continue
-                self.state = TaskState(
-                    state="dead", failed=True, finished_at=now_ns()
-                )
-                self.state.events.append({"type": "Driver Failure", "message": str(e)})
-                self.alloc_runner.task_state_updated()
-                return
+            if self._recovered_handle is not None:
+                # reattached by RecoverTask after a client restart: skip
+                # driver start, resume supervision of the live handle
+                self.handle = self._recovered_handle
+                self._recovered_handle = None
+            else:
+                try:
+                    self.handle = self.driver.start_task(
+                        self.task, self.alloc_runner.task_dir(self.task.name)
+                    )
+                except Exception as e:
+                    # Start failures route through the restart policy like any
+                    # other failure (ref taskrunner restart tracker)
+                    if restart_policy is not None and self._restart_or_wait(
+                        restart_policy
+                    ):
+                        continue
+                    self.state = TaskState(
+                        state="dead", failed=True, finished_at=now_ns()
+                    )
+                    self.state.events.append({"type": "Driver Failure", "message": str(e)})
+                    self.alloc_runner.task_state_updated()
+                    return
+            self.alloc_runner.driver_handle_updated(self)
 
             self.state = TaskState(state="running", started_at=self.handle.started_at)
             self.alloc_runner.task_state_updated()
@@ -182,7 +192,10 @@ class AllocRunner:
         os.makedirs(d, exist_ok=True)
         return d
 
-    def run(self):
+    def run(self, recovered_handles: Optional[dict] = None):
+        """Start (or, with ``recovered_handles``, resume) the alloc's tasks.
+        ``recovered_handles`` maps task name → live TaskHandle reattached by
+        the driver's RecoverTask (client.go:979 restoreState)."""
         job = self.alloc.job
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         if tg is None:
@@ -192,7 +205,8 @@ class AllocRunner:
         missing_driver = []
         for task in tg.tasks:
             driver = self.client.drivers.get(task.driver)
-            tr = TaskRunner(self, task, driver)
+            recovered = (recovered_handles or {}).get(task.name)
+            tr = TaskRunner(self, task, driver, recovered_handle=recovered)
             if driver is None:
                 tr.state = TaskState(state="dead", failed=True, finished_at=now_ns())
                 tr.state.events.append(
@@ -269,6 +283,19 @@ class AllocRunner:
     def task_state_updated(self):
         self.client.alloc_state_updated(self)
 
+    def driver_handle_updated(self, tr: "TaskRunner"):
+        """Persist the driver's reattach info so a restarted client can
+        RecoverTask (state_database.go PutTaskRunnerState analog)."""
+        db = self.client.state_db
+        if db is None or tr.driver is None or tr.handle is None:
+            return
+        try:
+            db.put_driver_handle(
+                self.alloc.id, tr.task.name, tr.driver.handle_data(tr.handle)
+            )
+        except Exception:
+            logger.exception("persisting driver handle failed")
+
     def update(self, alloc: Allocation):
         with self._lock:
             self.alloc.desired_status = alloc.desired_status
@@ -293,6 +320,7 @@ class Client:
         data_dir: str = "/tmp/nomad_tpu_client",
         node: Optional[Node] = None,
         drivers: Optional[dict[str, Driver]] = None,
+        persist: bool = True,
     ):
         self.server = server
         self.data_dir = data_dir
@@ -302,7 +330,22 @@ class Client:
         self.drivers = drivers or {
             name: cls() for name, cls in BUILTIN_DRIVERS.items()
         }
+        # durable local state: alloc docs, task states, driver handles and
+        # the node identity (ref client/state/state_database.go:107)
+        self.state_db = None
+        if persist:
+            from .state import ClientStateDB
+
+            self.state_db = ClientStateDB(data_dir)
         self.node = node or self.fingerprint()
+        if self.state_db is not None:
+            # a restarted client must be the SAME node or its allocs orphan
+            persisted = self.state_db.get_meta("node_id")
+            if node is None and persisted:
+                self.node.id = persisted
+                compute_class(self.node)
+            else:
+                self.state_db.put_meta("node_id", self.node.id)
         self.alloc_runners: dict[str, AllocRunner] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -357,6 +400,7 @@ class Client:
     # ------------------------------------------------------------------
     def start(self):
         self._stop.clear()
+        self._restore_state()
         resp = self.server.node_register(self.node)
         self._heartbeat_ttl = resp.get("heartbeat_ttl", 30.0)
         self.server.node_update_status(self.node.id, "ready")
@@ -365,15 +409,92 @@ class Client:
             t.start()
             self._threads.append(t)
 
-    def stop(self):
+    def stop(self, destroy_allocs: bool = True):
+        """``destroy_allocs=False`` leaves tasks running (the crash/restart
+        path: a real client death can't stop its raw_exec children either —
+        the next client recovers them from the state DB)."""
         self._stop.set()
-        for ar in self.alloc_runners.values():
-            ar.destroy()
+        if destroy_allocs:
+            for ar in self.alloc_runners.values():
+                ar.destroy()
         for t in self._threads:
             t.join(timeout=1.0)
         self._threads = []
+        if self.state_db is not None:
+            self.state_db.close()
 
     # ------------------------------------------------------------------
+    def _restore_state(self):
+        """Restore alloc runners from the durable DB and reattach to tasks
+        still running from the previous client process via the drivers'
+        RecoverTask (ref client.go:979 restoreState)."""
+        if self.state_db is None:
+            return
+        for alloc_dict in self.state_db.get_allocs():
+            try:
+                alloc = Allocation.from_dict(alloc_dict)
+            except Exception:
+                logger.exception("restore: undecodable alloc doc; dropping")
+                continue
+            if alloc.server_terminal_status() or alloc.client_terminal_status():
+                # the alloc was stopping/stopped when we died: recover any
+                # persisted handles purely to make sure the task is dead
+                # (a crash between the stop decision and the actual kill
+                # would otherwise orphan a live process forever)
+                self._kill_orphans(alloc)
+                self.state_db.delete_alloc(alloc.id)
+                continue
+            job = alloc.job
+            tg = job.lookup_task_group(alloc.task_group) if job else None
+            recovered = {}
+            if tg is not None:
+                for task in tg.tasks:
+                    data = self.state_db.get_driver_handle(alloc.id, task.name)
+                    driver = self.drivers.get(task.driver)
+                    if data is None or driver is None:
+                        continue
+                    try:
+                        handle = driver.recover_task(task, data)
+                    except Exception:
+                        logger.exception("RecoverTask failed")
+                        handle = None
+                    if handle is not None:
+                        recovered[task.name] = handle
+                    else:
+                        self.state_db.delete_driver_handle(alloc.id, task.name)
+            runner = AllocRunner(self, alloc)
+            self.alloc_runners[alloc.id] = runner
+            runner.run(recovered_handles=recovered)
+            logger.info(
+                "restored alloc %s (%d/%d tasks recovered)",
+                alloc.id[:8], len(recovered),
+                len(tg.tasks) if tg is not None else 0,
+            )
+
+    # ------------------------------------------------------------------
+    def _kill_orphans(self, alloc: Allocation):
+        """Best-effort stop of any still-running tasks of an alloc that is
+        not being restored (terminal before the crash)."""
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None:
+            return
+        for task in tg.tasks:
+            data = self.state_db.get_driver_handle(alloc.id, task.name)
+            driver = self.drivers.get(task.driver)
+            if data is None or driver is None:
+                continue
+            try:
+                handle = driver.recover_task(task, data)
+                if handle is not None and not handle._done.is_set():
+                    logger.info(
+                        "killing orphaned task %s of terminal alloc %s",
+                        task.name, alloc.id[:8],
+                    )
+                    driver.stop_task(handle)
+            except Exception:
+                logger.exception("orphan kill failed")
+
     def _heartbeat_loop(self):
         """ref client.go:1421 registerAndHeartbeat"""
         while not self._stop.is_set():
@@ -415,9 +536,11 @@ class Client:
                 # copy, and runner hooks mutate alloc fields (health).
                 runner = AllocRunner(self, alloc.copy())
                 self.alloc_runners[alloc_id] = runner
+                self._persist_alloc(runner)
                 runner.run()
             else:
                 runner.update(alloc)
+                self._persist_alloc(runner)
         # GC: destroy runners for allocs removed server-side (job purge /
         # alloc GC) and drop terminal runners (ref client.go removeAlloc)
         for alloc_id in list(self.alloc_runners):
@@ -425,11 +548,30 @@ class Client:
             if alloc_id not in desired:
                 runner.destroy()
                 del self.alloc_runners[alloc_id]
+                self._forget_alloc(alloc_id)
             elif runner._destroyed and runner.client_status() in (
                 "complete",
                 "failed",
             ):
                 del self.alloc_runners[alloc_id]
+                self._forget_alloc(alloc_id)
+
+    def _persist_alloc(self, runner: AllocRunner):
+        """State-DB writes must never kill the alloc-watch thread."""
+        if self.state_db is None:
+            return
+        try:
+            self.state_db.put_alloc(runner.alloc.to_dict())
+        except Exception:
+            logger.exception("persisting alloc failed")
+
+    def _forget_alloc(self, alloc_id: str):
+        if self.state_db is None:
+            return
+        try:
+            self.state_db.delete_alloc(alloc_id)
+        except Exception:
+            logger.exception("deleting alloc state failed")
 
     # ------------------------------------------------------------------
     def alloc_state_updated(self, runner: AllocRunner):
@@ -441,6 +583,20 @@ class Client:
             name: tr.state for name, tr in runner.task_runners.items()
         }
         update.modify_time = now_ns()
+        # keep the runner's own copy in sync so later persistence points
+        # (runner.update → put_alloc) don't resurrect a stale status
+        runner.alloc.client_status = update.client_status
+        if self.state_db is not None:
+            try:
+                # the doc carries the aggregated client_status so a restore
+                # after a crash prunes already-terminal allocs
+                self.state_db.put_alloc(update.to_dict())
+                for name, tr in runner.task_runners.items():
+                    self.state_db.put_task_state(
+                        runner.alloc.id, name, tr.state.to_dict()
+                    )
+            except Exception:
+                logger.exception("persisting task state failed")
         with self._update_lock:
             self._pending_updates[update.id] = update
 

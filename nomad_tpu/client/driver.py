@@ -37,10 +37,12 @@ class TaskHandle:
     task_name: str = ""
     driver: str = ""
     proc: Optional[object] = None
+    pid: int = 0
     exit_code: Optional[int] = None
     error: str = ""
     started_at: int = 0
     finished_at: int = 0
+    recovered: bool = False
     _done: threading.Event = field(default_factory=threading.Event)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -78,6 +80,16 @@ class Driver:
             "running": not handle._done.is_set(),
         }
 
+    # -- recovery (ref plugins/drivers/proto/driver.proto:35 RecoverTask) --
+    def handle_data(self, handle: TaskHandle) -> dict:
+        """Serializable reattach info persisted in the client state DB."""
+        return {"driver": self.name, "task_name": handle.task_name}
+
+    def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
+        """Reattach to a task started by a previous client process; returns
+        None when the task can't be recovered (the runner restarts it)."""
+        return None
+
 
 class MockDriver(Driver):
     """Scriptable driver for tests (ref drivers/mock/driver.go).
@@ -106,6 +118,8 @@ class MockDriver(Driver):
         )
         run_for = parse_duration(cfg.get("run_for", 0))
         exit_code = int(cfg.get("exit_code", 0))
+        handle._run_for = run_for
+        handle._exit_code = exit_code
         if run_for <= 0:
             handle.finish(exit_code)
         else:
@@ -127,6 +141,53 @@ class MockDriver(Driver):
             t.cancel()
         if not handle._done.is_set():
             handle.finish(130, "killed")
+
+    def handle_data(self, handle: TaskHandle) -> dict:
+        return {
+            "driver": self.name,
+            "task_name": handle.task_name,
+            "started_at": handle.started_at,
+            "run_for": getattr(handle, "_run_for", 0.0),
+            "exit_code": getattr(handle, "_exit_code", 0),
+        }
+
+    def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
+        """Scriptable recovery (the reference's mock driver RecoverTask):
+        config fail_recover forces the unrecoverable path; otherwise the
+        handle resumes with whatever run time remains."""
+        cfg = task.config or {}
+        if cfg.get("fail_recover"):
+            return None
+        handle = TaskHandle(
+            task_name=task.name,
+            driver=self.name,
+            started_at=int(data.get("started_at", 0)),
+            recovered=True,
+        )
+        exit_code = int(data.get("exit_code", 0))
+        # carry the reattach info so handle_data round-trips through a
+        # SECOND crash/recovery without zeroing run_for/exit_code
+        handle._run_for = float(data.get("run_for", 0.0))
+        handle._exit_code = exit_code
+        remaining = (
+            data.get("started_at", 0) / 1e9
+            + float(data.get("run_for", 0.0))
+            - time.time()
+        )
+        if remaining <= 0:
+            handle.finish(exit_code)
+            return handle
+        key = id(handle)
+
+        def _finish():
+            self._timers.pop(key, None)
+            handle.finish(exit_code)
+
+        t = threading.Timer(remaining, _finish)
+        t.daemon = True
+        self._timers[key] = t
+        t.start()
+        return handle
 
 
 class RawExecDriver(Driver):
@@ -151,6 +212,7 @@ class RawExecDriver(Driver):
             task_name=task.name,
             driver=self.name,
             proc=proc,
+            pid=proc.pid,
             started_at=time.time_ns(),
         )
 
@@ -163,13 +225,83 @@ class RawExecDriver(Driver):
 
     def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
         proc = handle.proc
-        if proc is None or proc.poll() is not None:
+        if proc is not None:
+            if proc.poll() is not None:
+                return
+            proc.terminate()
+            try:
+                proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
             return
-        proc.terminate()
-        try:
-            proc.wait(timeout)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        # recovered handle: not our child; signal by pid with the same
+        # term → wait → kill escalation the child path gets
+        if handle.pid and not handle._done.is_set():
+            import os
+            import signal
+
+            try:
+                os.kill(handle.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                return
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if not _pid_alive(handle.pid):
+                    return
+                time.sleep(0.05)
+            try:
+                os.kill(handle.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def handle_data(self, handle: TaskHandle) -> dict:
+        return {
+            "driver": self.name,
+            "task_name": handle.task_name,
+            "pid": handle.pid,
+            "started_at": handle.started_at,
+        }
+
+    def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
+        """Reattach to a still-running process from a previous client
+        process. The pid is no longer our child (reparented at client
+        death), so liveness is polled and the exit code of a process that
+        finishes after recovery is unknowable — it reports 0, the price of
+        raw (executor-less) exec; the exec driver's shepherd process keeps
+        real exit codes across client restarts."""
+        import os
+
+        pid = int(data.get("pid", 0))
+        if pid <= 0 or not _pid_alive(pid):
+            return None
+        handle = TaskHandle(
+            task_name=task.name,
+            driver=self.name,
+            pid=pid,
+            started_at=int(data.get("started_at", 0)),
+            recovered=True,
+        )
+
+        def poller():
+            while _pid_alive(pid):
+                time.sleep(0.2)
+            if not handle._done.is_set():
+                handle.finish(0)
+
+        threading.Thread(target=poller, daemon=True).start()
+        return handle
+
+
+def _pid_alive(pid: int) -> bool:
+    import os
+
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
 
 
 BUILTIN_DRIVERS = {

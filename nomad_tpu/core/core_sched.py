@@ -174,7 +174,10 @@ class CoreScheduler:
         gc_alloc: list[str] = []
         for ev in list(self.snap.evals()):
             if ev.type == "_core":
-                # core evals carry no allocs; reap terminal old ones directly
+                # core evals normally live only in the leader's broker, but
+                # one that exhausts its delivery limit is persisted as
+                # failed by the failed-eval reaper (server._reap_failed_evals
+                # applies EVAL_UPDATE) — reap those here
                 if ev.terminal_status() and ev.modify_index <= threshold:
                     gc_eval.append(ev.id)
                 continue
@@ -209,6 +212,11 @@ class CoreScheduler:
             elif allow_batch:
                 collect = True
             if not collect:
+                # terminal allocs from an older job incarnation (purge +
+                # re-register under the same id gives a fresh create_index;
+                # in-place updates preserve it, so this matches exactly the
+                # reference's alloc.Job.CreateIndex < job.CreateIndex test,
+                # core_sched.go:345-355 — no age threshold there either)
                 old = [
                     a.id
                     for a in allocs

@@ -1,0 +1,243 @@
+"""Client durable state + task recovery
+(ref client/state/state_database.go:107, client.go:979 restoreState,
+plugins/drivers/proto/driver.proto:35 RecoverTask).
+
+A client that dies mid-task must come back as the SAME node, restore its
+alloc runners from the local DB, and reattach to still-running tasks via
+the driver's RecoverTask — no orphaned work, no duplicate allocs."""
+
+import tempfile
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.client.client import Client
+from nomad_tpu.client.state import ClientStateDB
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def make_server():
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def mock_job(run_for="10s", count=1, extra_config=None):
+    # batch type: completed allocs stay complete (a service job would
+    # replace them to hold count, so restart tests would never converge)
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = count
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": run_for}
+    task.config.update(extra_config or {})
+    task.resources.networks = []
+    return job
+
+
+class TestClientStateDB:
+    def test_roundtrip(self):
+        with tempfile.TemporaryDirectory() as d:
+            db = ClientStateDB(d)
+            db.put_meta("node_id", "n-1")
+            db.put_alloc({"id": "a1", "job_id": "j1"})
+            db.put_task_state("a1", "web", {"state": "running"})
+            db.put_driver_handle("a1", "web", {"pid": 42})
+            db.close()
+
+            db2 = ClientStateDB(d)
+            assert db2.get_meta("node_id") == "n-1"
+            assert db2.get_allocs() == [{"id": "a1", "job_id": "j1"}]
+            assert db2.get_task_states("a1") == {"web": {"state": "running"}}
+            assert db2.get_driver_handle("a1", "web") == {"pid": 42}
+            db2.delete_alloc("a1")
+            assert db2.get_allocs() == []
+            assert db2.get_driver_handle("a1", "web") is None
+            db2.close()
+
+
+class TestClientRestart:
+    def _start_client(self, server, data_dir):
+        c = Client(server, data_dir=data_dir)
+        c.start()
+        return c
+
+    def test_mock_task_survives_client_restart(self):
+        """Crash the client mid-task: the restarted client is the same node,
+        recovers the runner, the task keeps running and completes — and the
+        server never sees a duplicate alloc."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="client_restart_")
+        try:
+            c1 = self._start_client(server, data_dir)
+            node_id = c1.node.id
+            job = mock_job(run_for="4s")
+            server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="alloc running",
+            )
+
+            # crash: no destroy — tasks keep their (timer-simulated) life
+            c1.stop(destroy_allocs=False)
+
+            c2 = self._start_client(server, data_dir)
+            assert c2.node.id == node_id, "restarted client must keep its node id"
+            assert len(c2.alloc_runners) == 1, "runner restored from state db"
+            (runner,) = c2.alloc_runners.values()
+            (tr,) = runner.task_runners.values()
+            wait_until(lambda: tr.handle is not None, msg="handle attached")
+            assert tr.handle.recovered, "task reattached, not restarted"
+
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                timeout=20.0,
+                msg="task completes after recovery",
+            )
+            allocs = server.state.allocs_by_job(job.namespace, job.id)
+            assert len(allocs) == 1, "no duplicate alloc after restart"
+            c2.stop()
+        finally:
+            server.stop()
+
+    def test_raw_exec_pid_reattach(self):
+        """raw_exec: the real process keeps running through the client crash
+        and the restarted client reattaches to the same pid."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="client_rawexec_")
+        try:
+            c1 = self._start_client(server, data_dir)
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sleep", "args": ["4"]}
+            task.resources.networks = []
+            server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="alloc running",
+            )
+            (runner,) = c1.alloc_runners.values()
+            (tr,) = runner.task_runners.values()
+            pid = tr.handle.pid
+            assert pid > 0
+
+            c1.stop(destroy_allocs=False)
+
+            import os
+
+            os.kill(pid, 0)  # still alive through the crash
+
+            c2 = self._start_client(server, data_dir)
+            (runner2,) = c2.alloc_runners.values()
+            (tr2,) = runner2.task_runners.values()
+            wait_until(lambda: tr2.handle is not None, msg="handle attached")
+            assert tr2.handle.recovered and tr2.handle.pid == pid
+
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                timeout=20.0,
+                msg="sleep completes after recovery",
+            )
+            c2.stop()
+        finally:
+            server.stop()
+
+    def test_unrecoverable_task_restarts(self):
+        """fail_recover: RecoverTask declines, so the restarted client
+        restarts the task under the restart policy instead of orphaning."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="client_norecover_")
+        try:
+            c1 = self._start_client(server, data_dir)
+            job = mock_job(run_for="2s", extra_config={"fail_recover": True})
+            # fast restarts for the test
+            job.task_groups[0].restart_policy.delay = int(0.1 * 1e9)
+            server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="alloc running",
+            )
+            c1.stop(destroy_allocs=False)
+
+            c2 = self._start_client(server, data_dir)
+            (runner2,) = c2.alloc_runners.values()
+            (tr2,) = runner2.task_runners.values()
+            wait_until(lambda: tr2.handle is not None, msg="task started again")
+            assert not tr2.handle.recovered, "unrecoverable task restarted fresh"
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                timeout=20.0,
+                msg="restarted task completes",
+            )
+            c2.stop()
+        finally:
+            server.stop()
+
+    def test_terminal_allocs_pruned_on_restore(self):
+        """Allocs that finished before the crash don't resurrect runners."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="client_prune_")
+        try:
+            c1 = self._start_client(server, data_dir)
+            job = mock_job(run_for="0s")
+            server.job_register(job)
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                )
+                and len(server.state.allocs_by_job(job.namespace, job.id)) == 1,
+                msg="task complete",
+            )
+            c1.stop(destroy_allocs=False)
+            c2 = self._start_client(server, data_dir)
+            assert c2.alloc_runners == {}, "terminal alloc must not restore"
+            c2.stop()
+        finally:
+            server.stop()
