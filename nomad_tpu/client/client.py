@@ -44,7 +44,14 @@ class TaskRunner:
     """Per-task lifecycle with restart policy
     (ref client/allocrunner/taskrunner/task_runner.go:423-533)."""
 
-    def __init__(self, alloc_runner, task, driver: Driver, recovered_handle=None):
+    def __init__(
+        self,
+        alloc_runner,
+        task,
+        driver: Driver,
+        recovered_handle=None,
+        restored_state: Optional[dict] = None,
+    ):
         self.alloc_runner = alloc_runner
         self.task = task
         self.driver = driver
@@ -55,7 +62,15 @@ class TaskRunner:
         self._recovered_handle = recovered_handle
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # wall-clock restart attempt times: persisted with the task state so
+        # a client restart does NOT hand a crash-looping task a fresh
+        # restart-policy budget (ref restarts/restarts.go)
         self._restarts_in_interval: list[float] = []
+        if restored_state:
+            self.state.restarts = int(restored_state.get("restarts", 0))
+            self._restarts_in_interval = [
+                float(t) for t in restored_state.get("restart_times", [])
+            ]
 
     def start(self):
         self._thread = threading.Thread(target=self.run, daemon=True)
@@ -79,8 +94,15 @@ class TaskRunner:
                 self._recovered_handle = None
             else:
                 try:
+                    task = self.task
+                    device_env = self.alloc_runner.device_env(task.name)
+                    if device_env:
+                        # reserved devices ride into the task environment
+                        # (devices/gpu/nvidia: CUDA_VISIBLE_DEVICES analog)
+                        task = task.copy()
+                        task.env = {**task.env, **device_env}
                     self.handle = self.driver.start_task(
-                        self.task, self.alloc_runner.task_dir(self.task.name)
+                        task, self.alloc_runner.task_dir(self.task.name)
                     )
                 except Exception as e:
                     # Start failures route through the restart policy like any
@@ -97,7 +119,11 @@ class TaskRunner:
                     return
             self.alloc_runner.driver_handle_updated(self)
 
-            self.state = TaskState(state="running", started_at=self.handle.started_at)
+            self.state = TaskState(
+                state="running",
+                started_at=self.handle.started_at,
+                restarts=self.state.restarts,
+            )
             self.alloc_runner.task_state_updated()
 
             self.handle.wait()
@@ -110,6 +136,7 @@ class TaskRunner:
                     failed=False,
                     started_at=self.state.started_at,
                     finished_at=now_ns(),
+                    restarts=self.state.restarts,
                 )
                 self.alloc_runner.task_state_updated()
                 return
@@ -120,6 +147,7 @@ class TaskRunner:
                     failed=False,
                     started_at=self.state.started_at,
                     finished_at=self.handle.finished_at,
+                    restarts=self.state.restarts,
                 )
                 self.alloc_runner.task_state_updated()
                 return
@@ -137,6 +165,7 @@ class TaskRunner:
                 failed=True,
                 started_at=self.state.started_at,
                 finished_at=self.handle.finished_at,
+                restarts=self.state.restarts,
             )
             self.alloc_runner.task_state_updated()
             return
@@ -148,7 +177,7 @@ class TaskRunner:
         returns False when the task should fail permanently."""
         if policy.mode not in ("delay", "fail"):
             return False
-        now = time.monotonic()
+        now = time.time()
         interval_s = (policy.interval or 0) / 1e9
         if interval_s > 0:
             # prune attempts outside the rolling interval; interval 0 means
@@ -192,10 +221,21 @@ class AllocRunner:
         os.makedirs(d, exist_ok=True)
         return d
 
-    def run(self, recovered_handles: Optional[dict] = None):
+    def device_env(self, task_name: str) -> dict:
+        """Env vars for the task's reserved device instances."""
+        resources = self.alloc.allocated_resources
+        if resources is None:
+            return {}
+        task_resources = resources.tasks.get(task_name)
+        if task_resources is None or not task_resources.devices:
+            return {}
+        return self.client.device_manager.reserve_env(task_resources.devices)
+
+    def run(self, recovered_handles: Optional[dict] = None, restored_states=None):
         """Start (or, with ``recovered_handles``, resume) the alloc's tasks.
         ``recovered_handles`` maps task name → live TaskHandle reattached by
-        the driver's RecoverTask (client.go:979 restoreState)."""
+        the driver's RecoverTask; ``restored_states`` maps task name → the
+        persisted task-state doc (client.go:979 restoreState)."""
         job = self.alloc.job
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         if tg is None:
@@ -206,7 +246,13 @@ class AllocRunner:
         for task in tg.tasks:
             driver = self.client.drivers.get(task.driver)
             recovered = (recovered_handles or {}).get(task.name)
-            tr = TaskRunner(self, task, driver, recovered_handle=recovered)
+            tr = TaskRunner(
+                self,
+                task,
+                driver,
+                recovered_handle=recovered,
+                restored_state=(restored_states or {}).get(task.name),
+            )
             if driver is None:
                 tr.state = TaskState(state="dead", failed=True, finished_at=now_ns())
                 tr.state.events.append(
@@ -317,12 +363,20 @@ class Client:
     def __init__(
         self,
         server,
-        data_dir: str = "/tmp/nomad_tpu_client",
+        data_dir: Optional[str] = None,
         node: Optional[Node] = None,
         drivers: Optional[dict[str, Driver]] = None,
         persist: bool = True,
+        device_plugins: Optional[list] = None,
     ):
         self.server = server
+        if data_dir is None:
+            # unique by default: the state DB carries node IDENTITY, so two
+            # clients sharing a dir would register as the same node and
+            # resurrect each other's allocs
+            import tempfile
+
+            data_dir = tempfile.mkdtemp(prefix="nomad_tpu_client_")
         self.data_dir = data_dir
         # Optional cap on restart backoff (dev/test speedup); None = honor
         # the task group's configured delay in full
@@ -330,6 +384,9 @@ class Client:
         self.drivers = drivers or {
             name: cls() for name, cls in BUILTIN_DRIVERS.items()
         }
+        from .devices import DeviceManager
+
+        self.device_manager = DeviceManager(device_plugins)
         # durable local state: alloc docs, task states, driver handles and
         # the node identity (ref client/state/state_database.go:107)
         self.state_db = None
@@ -394,12 +451,20 @@ class Client:
                 detected=fp["detected"], healthy=fp["healthy"]
             )
             node.attributes[f"driver.{name}"] = "1"
+        # device plugins: TPU chips → node device groups (SURVEY §2.6)
+        self.device_manager.fingerprint_node(node)
         compute_class(node)
         return node
 
     # ------------------------------------------------------------------
     def start(self):
         self._stop.clear()
+        if self.state_db is not None and self.state_db.closed:
+            # a stopped Client can be started again (tests and the agent's
+            # restart path do); stop() closed the handle
+            from .state import ClientStateDB
+
+            self.state_db = ClientStateDB(self.data_dir)
         self._restore_state()
         resp = self.server.node_register(self.node)
         self._heartbeat_ttl = resp.get("heartbeat_ttl", 30.0)
@@ -464,7 +529,10 @@ class Client:
                         self.state_db.delete_driver_handle(alloc.id, task.name)
             runner = AllocRunner(self, alloc)
             self.alloc_runners[alloc.id] = runner
-            runner.run(recovered_handles=recovered)
+            runner.run(
+                recovered_handles=recovered,
+                restored_states=self.state_db.get_task_states(alloc.id),
+            )
             logger.info(
                 "restored alloc %s (%d/%d tasks recovered)",
                 alloc.id[:8], len(recovered),
@@ -588,13 +656,15 @@ class Client:
         runner.alloc.client_status = update.client_status
         if self.state_db is not None:
             try:
-                # the doc carries the aggregated client_status so a restore
-                # after a crash prunes already-terminal allocs
-                self.state_db.put_alloc(update.to_dict())
+                # one transaction: the alloc doc (carrying the aggregated
+                # client_status so a restore prunes terminal allocs) plus
+                # each task's state with its restart-budget timestamps
+                task_docs = {}
                 for name, tr in runner.task_runners.items():
-                    self.state_db.put_task_state(
-                        runner.alloc.id, name, tr.state.to_dict()
-                    )
+                    doc = tr.state.to_dict()
+                    doc["restart_times"] = list(tr._restarts_in_interval)
+                    task_docs[name] = doc
+                self.state_db.put_alloc_update(update.to_dict(), task_docs)
             except Exception:
                 logger.exception("persisting task state failed")
         with self._update_lock:

@@ -1,0 +1,155 @@
+"""Device plugin tier + TPU fingerprinting
+(ref plugins/device/proto/device.proto: Fingerprint/Reserve/Stats;
+devices/gpu/nvidia/device.go: the NVML-backed GPU plugin this framework's
+TPU plugin mirrors — fingerprint chips into node device groups, reserve →
+environment variables, stats).
+
+The client's DeviceManager runs the configured plugins, merges their
+fingerprints into the node's device groups before registration, and at
+task start asks the owning plugin to reserve the allocated instance ids —
+producing the env the driver injects (TPU_VISIBLE_DEVICES here, the
+CUDA_VISIBLE_DEVICES analog)."""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import re
+from typing import Optional
+
+from ..structs.model import Attribute, NodeDevice, NodeDeviceResource
+
+logger = logging.getLogger("nomad_tpu.client.devices")
+
+
+class DevicePlugin:
+    """Device plugin interface (ref plugins/device/device.go)."""
+
+    name = "device"
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        """Detected device groups (empty when absent)."""
+        return []
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        """Reservation for the given instance ids → {"env": {...}}."""
+        return {"env": {}}
+
+    def stats(self) -> dict:
+        return {}
+
+
+class TPUDevicePlugin(DevicePlugin):
+    """Fingerprints the host's TPU chips (ref devices/gpu/nvidia, with
+    libtpu/accel chardevs standing in for NVML).
+
+    Detection: accelerator character devices (``/dev/accel*`` — the PCIe
+    TPU driver surface — or ``/dev/vfio/*`` for VFIO-bound chips), plus
+    libtpu presence for the version attribute. NOMAD_TPU_DEV_GLOB overrides
+    the device glob (tests point it at a fake dev tree). Reserve maps
+    instance ids to TPU_VISIBLE_DEVICES, libtpu's device-selection env."""
+
+    name = "tpu"
+
+    def __init__(self, dev_glob: Optional[str] = None):
+        self.dev_glob = dev_glob or os.environ.get(
+            "NOMAD_TPU_DEV_GLOB", "/dev/accel*"
+        )
+
+    def _chips(self) -> list[str]:
+        chips = sorted(glob.glob(self.dev_glob))
+        # vfio fallback: chips bound to vfio show up as numbered group files
+        if not chips and self.dev_glob == "/dev/accel*":
+            chips = sorted(
+                p for p in glob.glob("/dev/vfio/*") if re.search(r"\d+$", p)
+            )
+        return chips
+
+    @staticmethod
+    def _libtpu_version() -> str:
+        try:
+            import importlib.metadata as md
+
+            for dist in ("libtpu", "libtpu-nightly"):
+                try:
+                    return md.version(dist)
+                except md.PackageNotFoundError:
+                    continue
+        except Exception:
+            pass
+        return ""
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        chips = self._chips()
+        if not chips:
+            return []
+        attributes = {
+            "driver_version": Attribute.of_string(self._libtpu_version() or "unknown"),
+        }
+        instances = []
+        for path in chips:
+            m = re.search(r"(\d+)$", os.path.basename(path))
+            chip_id = m.group(1) if m else os.path.basename(path)
+            instances.append(NodeDevice(id=chip_id, healthy=True))
+        return [
+            NodeDeviceResource(
+                vendor="google",
+                type="tpu",
+                name="tpu",
+                instances=instances,
+                attributes=attributes,
+            )
+        ]
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        return {"env": {"TPU_VISIBLE_DEVICES": ",".join(device_ids)}}
+
+
+class DeviceManager:
+    """Client-side plugin lifecycle + reservation routing
+    (ref client/devicemanager/manager.go)."""
+
+    def __init__(self, plugins: Optional[list[DevicePlugin]] = None):
+        self.plugins = plugins if plugins is not None else [TPUDevicePlugin()]
+        # (vendor, type, name) → owning plugin, filled by fingerprint_node
+        self._owners: dict[tuple, DevicePlugin] = {}
+
+    def fingerprint_node(self, node) -> int:
+        """Merge all plugins' device groups into the node; returns the
+        number of device groups found."""
+        groups = []
+        for plugin in self.plugins:
+            try:
+                found = plugin.fingerprint()
+            except Exception:
+                logger.exception("device plugin %s fingerprint failed", plugin.name)
+                continue
+            for group in found:
+                key = (group.vendor, group.type, group.name)
+                self._owners[key] = plugin
+                groups.append(group)
+                node.attributes[f"device.{group.vendor}.{group.type}.count"] = str(
+                    len(group.instances)
+                )
+        if groups:
+            node.node_resources.devices = groups
+        return len(groups)
+
+    def reserve_env(self, allocated_devices) -> dict:
+        """Env for a task's AllocatedDeviceResource list."""
+        env: dict[str, str] = {}
+        for ad in allocated_devices or []:
+            plugin = self._owners.get((ad.vendor, ad.type, ad.name))
+            if plugin is None:
+                logger.warning(
+                    "no device plugin owns %s/%s/%s", ad.vendor, ad.type, ad.name
+                )
+                continue
+            try:
+                res = plugin.reserve(list(ad.device_ids))
+            except Exception:
+                logger.exception("device reserve failed")
+                continue
+            env.update(res.get("env", {}))
+        return env

@@ -195,15 +195,11 @@ class RawExecDriver(Driver):
 
     name = "raw_exec"
 
-    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
-        cfg = task.config or {}
-        command = cfg.get("command")
-        if not command:
-            raise RuntimeError("raw_exec requires a command")
-        args = [command] + list(cfg.get("args", []))
+    def _spawn(self, task: Task, argv: list, cwd) -> TaskHandle:
+        """Shared Popen → TaskHandle → waiter tail for the exec family."""
         proc = subprocess.Popen(
-            args,
-            cwd=task_dir or None,
+            argv,
+            cwd=cwd,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
             env={"PATH": "/usr/bin:/bin:/usr/local/bin", **task.env},
@@ -215,13 +211,21 @@ class RawExecDriver(Driver):
             pid=proc.pid,
             started_at=time.time_ns(),
         )
+        handle._proc_start = _proc_start_time(proc.pid)
 
         def waiter():
-            code = proc.wait()
-            handle.finish(code)
+            handle.finish(proc.wait())
 
         threading.Thread(target=waiter, daemon=True).start()
         return handle
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise RuntimeError("raw_exec requires a command")
+        args = [command] + list(cfg.get("args", []))
+        return self._spawn(task, args, task_dir or None)
 
     def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
         proc = handle.proc
@@ -260,6 +264,7 @@ class RawExecDriver(Driver):
             "task_name": handle.task_name,
             "pid": handle.pid,
             "started_at": handle.started_at,
+            "proc_start": getattr(handle, "_proc_start", 0),
         }
 
     def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
@@ -268,12 +273,15 @@ class RawExecDriver(Driver):
         death), so liveness is polled and the exit code of a process that
         finishes after recovery is unknowable — it reports 0, the price of
         raw (executor-less) exec; the exec driver's shepherd process keeps
-        real exit codes across client restarts."""
-        import os
-
+        real exit codes across client restarts. The persisted /proc start
+        time guards against pid reuse: a recycled pid would make us adopt
+        (and later kill) an unrelated process."""
         pid = int(data.get("pid", 0))
         if pid <= 0 or not _pid_alive(pid):
             return None
+        persisted_start = int(data.get("proc_start", 0))
+        if persisted_start and _proc_start_time(pid) != persisted_start:
+            return None  # pid recycled by another process
         handle = TaskHandle(
             task_name=task.name,
             driver=self.name,
@@ -281,6 +289,7 @@ class RawExecDriver(Driver):
             started_at=int(data.get("started_at", 0)),
             recovered=True,
         )
+        handle._proc_start = persisted_start
 
         def poller():
             while _pid_alive(pid):
@@ -304,7 +313,69 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
+def _proc_start_time(pid: int) -> int:
+    """Kernel start time of a pid (clock ticks since boot, field 22 of
+    /proc/<pid>/stat) — the stable identity that survives everything but
+    pid reuse. 0 when unreadable."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm may contain spaces/parens; field 22 counts after the last ')'
+        rest = stat.rsplit(")", 1)[1].split()
+        return int(rest[19])  # state is rest[0] → starttime is rest[19]
+    except Exception:
+        return 0
+
+
+class ExecDriver(RawExecDriver):
+    """Isolated exec via the nsexec shepherd (ref drivers/exec +
+    drivers/shared/executor/executor_linux.go:29: libcontainer-backed
+    isolation; here a small C++ namespace shepherd, SURVEY §2.9). Tasks run
+    in fresh PID/mount/IPC/UTS namespaces with a namespace-local /proc; the
+    persisted pid is the shepherd's, which forwards signals and propagates
+    the task's exit status — so recovery-by-pid works exactly like
+    raw_exec's but kills the whole namespace tree."""
+
+    name = "exec"
+
+    def __init__(self):
+        self._nsexec = None
+        self._healthy = False
+        try:
+            from ..native import isolation_available, nsexec_path
+
+            if isolation_available():
+                self._nsexec = nsexec_path()
+                self._healthy = True
+        except Exception:
+            self._healthy = False
+
+    def fingerprint(self) -> dict:
+        return {
+            "detected": self._nsexec is not None,
+            "healthy": self._healthy,
+            "attributes": {"driver.exec.isolation": "namespaces"},
+        }
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        if not self._healthy:
+            raise RuntimeError("exec driver requires namespace isolation")
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise RuntimeError("exec requires a command")
+        args = [
+            self._nsexec,
+            "--workdir",
+            task_dir or "/",
+            "--",
+            command,
+        ] + list(cfg.get("args", []))
+        return self._spawn(task, args, None)
+
+
 BUILTIN_DRIVERS = {
     MockDriver.name: MockDriver,
     RawExecDriver.name: RawExecDriver,
+    ExecDriver.name: ExecDriver,
 }

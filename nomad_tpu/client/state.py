@@ -48,6 +48,7 @@ class ClientStateDB:
         os.makedirs(data_dir, exist_ok=True)
         self.path = os.path.join(data_dir, "client.db")
         self._lock = threading.Lock()
+        self.closed = False
         self._db = sqlite3.connect(self.path, check_same_thread=False)
         with self._lock:
             self._db.executescript(_SCHEMA)
@@ -56,6 +57,7 @@ class ClientStateDB:
 
     def close(self):
         with self._lock:
+            self.closed = True
             self._db.close()
 
     # -- meta (node identity) -------------------------------------------
@@ -97,6 +99,25 @@ class ClientStateDB:
             )
             self._db.execute(
                 "DELETE FROM driver_handles WHERE alloc_id = ?", (alloc_id,)
+            )
+            self._db.commit()
+
+    def put_alloc_update(self, alloc_dict: dict, task_docs: dict[str, dict]):
+        """Alloc doc + all its task-state rows in ONE transaction — the
+        hot path on every task state transition."""
+        with self._lock:
+            alloc_id = alloc_dict["id"]
+            self._db.execute(
+                "INSERT OR REPLACE INTO allocs (alloc_id, doc) VALUES (?, ?)",
+                (alloc_id, json.dumps(alloc_dict)),
+            )
+            self._db.executemany(
+                "INSERT OR REPLACE INTO task_states (alloc_id, task, doc)"
+                " VALUES (?, ?, ?)",
+                [
+                    (alloc_id, task, json.dumps(doc))
+                    for task, doc in task_docs.items()
+                ],
             )
             self._db.commit()
 

@@ -1,0 +1,72 @@
+"""Native helpers (the framework's C++ tier).
+
+The reference's only first-party native surface is the libcontainer/nsenter
+isolation layer under drivers/shared/executor (SURVEY §2.9); here that is
+``nsexec.cc``, compiled on demand with the system toolchain and cached
+next to the source (or in NOMAD_TPU_NATIVE_DIR when the package directory
+is read-only)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build_dir() -> str:
+    d = os.environ.get("NOMAD_TPU_NATIVE_DIR")
+    if d:
+        os.makedirs(d, exist_ok=True)
+        return d
+    return _HERE
+
+
+def nsexec_path(rebuild: bool = False) -> str:
+    """Path to the compiled nsexec binary, building it if stale or absent."""
+    src = os.path.join(_HERE, "nsexec.cc")
+    out = os.path.join(_build_dir(), "nsexec")
+    with _BUILD_LOCK:
+        if (
+            not rebuild
+            and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            return out
+        cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+        if cxx is None:
+            raise NativeBuildError("no C++ compiler on PATH")
+        tmp = out + ".tmp"
+        proc = subprocess.run(
+            [cxx, "-O2", "-static", "-o", tmp, src],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            # retry without -static (glibc-only toolchains)
+            proc = subprocess.run(
+                [cxx, "-O2", "-o", tmp, src], capture_output=True, text=True
+            )
+        if proc.returncode != 0:
+            raise NativeBuildError(f"nsexec build failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+        return out
+
+
+def isolation_available() -> bool:
+    """Whether namespace isolation works here (nsexec --check)."""
+    try:
+        binary = nsexec_path()
+    except NativeBuildError:
+        return False
+    try:
+        return subprocess.run([binary, "--check"], timeout=10).returncode == 0
+    except Exception:
+        return False

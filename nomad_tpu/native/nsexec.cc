@@ -1,0 +1,147 @@
+// nsexec — minimal namespace-isolation shepherd for the exec driver.
+//
+// The reference isolates exec/java tasks with libcontainer plus an embedded
+// nsenter C shim re-exec'd as a subprocess (drivers/shared/executor/
+// executor_linux.go:29, libcontainer_nsenter_linux.go). This is the same
+// role as a single small C++ binary: it creates fresh PID / mount / IPC /
+// UTS namespaces, makes the mount tree private, mounts a namespace-local
+// /proc, then supervises the task as the namespace's init — forwarding
+// SIGTERM/SIGINT and propagating the task's exit status to the driver.
+//
+// usage:
+//   nsexec --check                     exit 0 iff isolation is available
+//   nsexec [--workdir D] [--hostname H] -- cmd [args...]
+//
+// exit codes: task's own status, or 125 for shepherd-level failures.
+
+#include <errno.h>
+#include <sched.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mount.h>
+#include <sys/prctl.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static const int SHEPHERD_ERR = 125;
+static pid_t task_pid = -1;
+
+static void forward_signal(int sig) {
+  if (task_pid > 0) kill(task_pid, sig);
+}
+
+static int ns_flags() {
+  return CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWIPC | CLONE_NEWUTS;
+}
+
+static int check_isolation() {
+  // fork first: unshare(CLONE_NEWPID) changes what fork() creates, and we
+  // don't want to disturb the caller's process
+  pid_t pid = fork();
+  if (pid < 0) return 1;
+  if (pid == 0) {
+    _exit(unshare(ns_flags()) == 0 ? 0 : 1);
+  }
+  int status = 0;
+  if (waitpid(pid, &status, 0) < 0) return 1;
+  return WIFEXITED(status) ? WEXITSTATUS(status) : 1;
+}
+
+int main(int argc, char **argv) {
+  const char *workdir = NULL;
+  const char *hostname = "nomad-task";
+  int i = 1;
+  for (; i < argc; i++) {
+    if (strcmp(argv[i], "--check") == 0) {
+      return check_isolation();
+    } else if (strcmp(argv[i], "--workdir") == 0 && i + 1 < argc) {
+      workdir = argv[++i];
+    } else if (strcmp(argv[i], "--hostname") == 0 && i + 1 < argc) {
+      hostname = argv[++i];
+    } else if (strcmp(argv[i], "--") == 0) {
+      i++;
+      break;
+    } else {
+      fprintf(stderr, "nsexec: unknown argument %s\n", argv[i]);
+      return SHEPHERD_ERR;
+    }
+  }
+  if (i >= argc) {
+    fprintf(stderr, "nsexec: no command\n");
+    return SHEPHERD_ERR;
+  }
+  char **cmd = &argv[i];
+
+  if (unshare(ns_flags()) != 0) {
+    fprintf(stderr, "nsexec: unshare: %s\n", strerror(errno));
+    return SHEPHERD_ERR;
+  }
+
+  // first fork after unshare(CLONE_NEWPID) becomes pid 1 of the new ns
+  pid_t init_pid = fork();
+  if (init_pid < 0) return SHEPHERD_ERR;
+
+  if (init_pid > 0) {
+    // outer shepherd: forward signals to the namespace init, propagate exit
+    task_pid = init_pid;
+    signal(SIGTERM, forward_signal);
+    signal(SIGINT, forward_signal);
+    int status = 0;
+    while (waitpid(init_pid, &status, 0) < 0 && errno == EINTR) {
+    }
+    if (WIFEXITED(status)) return WEXITSTATUS(status);
+    if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
+    return SHEPHERD_ERR;
+  }
+
+  // namespace init (pid 1 inside): private mounts, own /proc, supervise task
+  if (mount(NULL, "/", NULL, MS_REC | MS_PRIVATE, NULL) != 0) {
+    fprintf(stderr, "nsexec: private mounts: %s\n", strerror(errno));
+    _exit(SHEPHERD_ERR);
+  }
+  if (mount("proc", "/proc", "proc", MS_NOSUID | MS_NODEV | MS_NOEXEC, NULL) != 0) {
+    // non-fatal: /proc may be read-only in constrained sandboxes
+    fprintf(stderr, "nsexec: warning: mount /proc: %s\n", strerror(errno));
+  }
+  if (sethostname(hostname, strlen(hostname)) != 0) {
+    fprintf(stderr, "nsexec: warning: sethostname: %s\n", strerror(errno));
+  }
+
+  pid_t child = fork();
+  if (child < 0) _exit(SHEPHERD_ERR);
+  if (child == 0) {
+    if (workdir && chdir(workdir) != 0) {
+      fprintf(stderr, "nsexec: chdir %s: %s\n", workdir, strerror(errno));
+      _exit(SHEPHERD_ERR);
+    }
+    prctl(PR_SET_PDEATHSIG, SIGKILL);
+    execvp(cmd[0], cmd);
+    fprintf(stderr, "nsexec: exec %s: %s\n", cmd[0], strerror(errno));
+    _exit(SHEPHERD_ERR);
+  }
+
+  // pid 1 must install handlers explicitly — default dispositions are
+  // ignored for a namespace's init
+  task_pid = child;
+  signal(SIGTERM, forward_signal);
+  signal(SIGINT, forward_signal);
+
+  int code = SHEPHERD_ERR;
+  for (;;) {
+    int status = 0;
+    pid_t done = waitpid(-1, &status, 0);
+    if (done < 0) {
+      if (errno == EINTR) continue;
+      break;  // ECHILD: everything reaped
+    }
+    if (done == child) {
+      if (WIFEXITED(status)) code = WEXITSTATUS(status);
+      else if (WIFSIGNALED(status)) code = 128 + WTERMSIG(status);
+      // keep reaping until all namespace descendants are gone
+    }
+  }
+  _exit(code);
+}

@@ -1,0 +1,256 @@
+"""Client-side driver plugin proxy (ref helper/pluginutils/loader +
+plugins/drivers/client.go: the go-plugin managed subprocess and its gRPC
+client shim).
+
+ExternalDriver spawns ``python -m nomad_tpu.plugins.serve`` with a driver
+spec, connects over the unix socket, and implements the ordinary Driver
+interface by RPC. Wait semantics are preserved by a per-task poller thread
+long-polling Driver.WaitTask and completing a local TaskHandle, so runner
+code is identical for in-process and subprocess drivers. If the plugin
+process dies, in-flight handles fail; RecoverTask after a client restart
+spawns a fresh plugin process and reattaches by the persisted handle data
+(driver.proto:35)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ..client.driver import Driver, TaskHandle
+from ..rpc.codec import ConnectionClosed, read_frame, write_frame
+from ..structs.model import Task
+
+logger = logging.getLogger("nomad_tpu.plugins.external")
+
+LAUNCH_TIMEOUT = 10.0
+
+
+class PluginError(RuntimeError):
+    pass
+
+
+class _Conn:
+    """Seq-matched request/response client over the framed socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._closed = False
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self):
+        while True:
+            try:
+                seq, error, payload = read_frame(self._sock)
+            except (ConnectionClosed, OSError):
+                break
+            with self._lock:
+                waiter = self._pending.pop(seq, None)
+            if waiter is not None:
+                waiter[1].extend([error, payload])
+                waiter[0].set()
+        with self._lock:
+            self._closed = True
+            pending, self._pending = self._pending, {}
+        for event, box in pending.values():
+            box.extend(["plugin connection closed", None])
+            event.set()
+
+    def call(self, method: str, payload: dict, timeout: float = 30.0):
+        event = threading.Event()
+        box: list = []
+        with self._lock:
+            if self._closed:
+                raise PluginError("plugin connection closed")
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = (event, box)
+            try:
+                write_frame(self._sock, [seq, method, payload])
+            except OSError as e:
+                self._pending.pop(seq, None)
+                raise PluginError(f"plugin write failed: {e}")
+        if not event.wait(timeout):
+            with self._lock:
+                self._pending.pop(seq, None)
+            raise PluginError(f"plugin call {method} timed out")
+        error, result = box
+        if error is not None:
+            raise PluginError(str(error))
+        return result
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ExternalDriver(Driver):
+    """A Driver whose implementation runs in a plugin subprocess."""
+
+    def __init__(self, driver_spec: str, name: Optional[str] = None):
+        """``driver_spec`` is 'pkg.module:factory' resolved inside the
+        plugin process (e.g. 'nomad_tpu.client.driver:MockDriver')."""
+        self.spec = driver_spec
+        self.name = name or driver_spec.rsplit(":", 1)[-1].lower()
+        self._proc: Optional[subprocess.Popen] = None
+        self._conn: Optional[_Conn] = None
+        self._lock = threading.Lock()
+
+    # -- process management --------------------------------------------
+    def _ensure(self) -> _Conn:
+        with self._lock:
+            if self._conn is not None and self._proc is not None and self._proc.poll() is None:
+                return self._conn
+            return self._launch_locked()
+
+    def _launch_locked(self) -> _Conn:
+        sock_path = os.path.join(
+            tempfile.mkdtemp(prefix="nomad_plugin_"), "plugin.sock"
+        )
+        self._proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "nomad_tpu.plugins.serve",
+                "--driver",
+                self.spec,
+                "--socket",
+                sock_path,
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + LAUNCH_TIMEOUT
+        last_err = None
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise PluginError(
+                    f"plugin process exited at launch (rc={self._proc.returncode})"
+                )
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(sock_path)
+                self._conn = _Conn(s)
+                info = self._conn.call("Plugin.Info", {})
+                self.name = info.get("name", self.name)
+                return self._conn
+            except (FileNotFoundError, ConnectionRefusedError, OSError) as e:
+                last_err = e
+                time.sleep(0.05)
+        raise PluginError(f"plugin socket never came up: {last_err}")
+
+    def shutdown(self):
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+            if self._proc is not None:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+                self._proc = None
+
+    # -- handle plumbing ------------------------------------------------
+    def _local_handle(self, desc: dict, task: Task) -> TaskHandle:
+        handle = TaskHandle(
+            task_name=task.name,
+            driver=self.name,
+            pid=int(desc.get("pid", 0)),
+            started_at=int(desc.get("started_at", 0)),
+            recovered=bool(desc.get("recovered", False)),
+        )
+        handle._plugin_id = desc["handle_id"]
+        conn = self._conn
+
+        def poller():
+            while not handle._done.is_set():
+                try:
+                    r = conn.call(
+                        "Driver.WaitTask",
+                        {"handle_id": handle._plugin_id, "timeout": 1.0},
+                        timeout=30.0,
+                    )
+                except PluginError as e:
+                    handle.finish(128, f"plugin died: {e}")
+                    return
+                if r.get("done"):
+                    handle.exit_code = r.get("exit_code")
+                    handle.error = r.get("error", "")
+                    handle.finished_at = r.get("finished_at") or time.time_ns()
+                    handle._done.set()
+                    return
+
+        threading.Thread(target=poller, daemon=True).start()
+        return handle
+
+    # -- Driver interface -----------------------------------------------
+    def fingerprint(self) -> dict:
+        try:
+            return self._ensure().call("Driver.Fingerprint", {})
+        except PluginError as e:
+            logger.warning("plugin fingerprint failed: %s", e)
+            return {"detected": False, "healthy": False, "attributes": {}}
+
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        desc = self._ensure().call(
+            "Driver.StartTask",
+            {"task": task.to_dict(), "task_dir": task_dir},
+        )
+        return self._local_handle(desc, task)
+
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+        conn = self._conn
+        if conn is None or not hasattr(handle, "_plugin_id"):
+            return
+        try:
+            conn.call(
+                "Driver.StopTask",
+                {"handle_id": handle._plugin_id, "timeout": timeout},
+                timeout=timeout + 10.0,
+            )
+        except PluginError as e:
+            logger.warning("plugin stop failed: %s", e)
+
+    def inspect_task(self, handle: TaskHandle) -> dict:
+        conn = self._conn
+        if conn is None or not hasattr(handle, "_plugin_id"):
+            return super().inspect_task(handle)
+        return conn.call("Driver.InspectTask", {"handle_id": handle._plugin_id})
+
+    def handle_data(self, handle: TaskHandle) -> dict:
+        conn = self._conn
+        if conn is not None and hasattr(handle, "_plugin_id"):
+            try:
+                data = conn.call(
+                    "Driver.HandleData", {"handle_id": handle._plugin_id}
+                )
+                data["plugin_spec"] = self.spec
+                return data
+            except PluginError:
+                pass
+        return {"driver": self.name, "task_name": handle.task_name}
+
+    def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
+        try:
+            desc = self._ensure().call(
+                "Driver.RecoverTask", {"task": task.to_dict(), "data": data}
+            )
+        except PluginError as e:
+            logger.warning("plugin recover failed: %s", e)
+            return None
+        if not desc.get("recovered"):
+            return None
+        return self._local_handle(desc, task)

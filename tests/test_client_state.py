@@ -219,6 +219,45 @@ class TestClientRestart:
         finally:
             server.stop()
 
+    def test_restart_budget_survives_restart(self):
+        """Persisted restart timestamps seed the restored runner, so a
+        crash-looping task doesn't get a fresh restart-policy budget from a
+        client restart (ref restarts/restarts.go)."""
+        import time as _time
+
+        from nomad_tpu.client.client import AllocRunner, TaskRunner
+        from nomad_tpu.client.driver import MockDriver
+
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="client_budget_")
+        try:
+            c1 = self._start_client(server, data_dir)
+            job = mock_job(run_for="30s")
+            server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="alloc running",
+            )
+            (runner,) = c1.alloc_runners.values()
+            (tr,) = runner.task_runners.values()
+            # simulate two consumed restart attempts, then a crash
+            tr._restarts_in_interval = [_time.time() - 1.0, _time.time()]
+            tr.state.restarts = 2
+            c1.alloc_state_updated(runner)
+            c1.stop(destroy_allocs=False)
+
+            c2 = self._start_client(server, data_dir)
+            (runner2,) = c2.alloc_runners.values()
+            (tr2,) = runner2.task_runners.values()
+            assert tr2.state.restarts == 2
+            assert len(tr2._restarts_in_interval) == 2
+            c2.stop()
+        finally:
+            server.stop()
+
     def test_terminal_allocs_pruned_on_restore(self):
         """Allocs that finished before the crash don't resurrect runners."""
         server = make_server()

@@ -1,0 +1,267 @@
+"""Driver plugin protocol, isolated exec driver, and the TPU device plugin
+(ref plugins/drivers/proto/driver.proto:13-84, drivers/shared/executor/
+executor_linux.go:29, devices/gpu/nvidia/device.go)."""
+
+import os
+import subprocess
+import tempfile
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.client.client import Client
+from nomad_tpu.client.devices import DeviceManager, TPUDevicePlugin
+from nomad_tpu.client.driver import ExecDriver
+from nomad_tpu.core.server import Server
+from nomad_tpu.plugins import ExternalDriver
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.model import RequestedDevice, Task
+
+
+def make_server():
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+isolation_ok = False
+try:
+    from nomad_tpu.native import isolation_available
+
+    isolation_ok = isolation_available()
+except Exception:
+    pass
+
+
+class TestExternalDriverProtocol:
+    def test_subprocess_driver_lifecycle(self):
+        """fingerprint/start/wait/stop across the subprocess boundary."""
+        driver = ExternalDriver(
+            "nomad_tpu.client.driver:MockDriver", name="mock_driver"
+        )
+        try:
+            fp = driver.fingerprint()
+            assert fp["detected"] and fp["healthy"]
+
+            task = Task(name="t1", driver="mock_driver", config={"run_for": "0.3s"})
+            handle = driver.start_task(task, "")
+            assert not handle.wait(timeout=0.05)
+            assert handle.wait(timeout=5.0)
+            assert handle.exit_code == 0
+
+            # stop a long task mid-run
+            task2 = Task(name="t2", driver="mock_driver", config={"run_for": "30s"})
+            h2 = driver.start_task(task2, "")
+            driver.stop_task(h2)
+            assert h2.wait(timeout=5.0)
+            assert h2.exit_code == 130
+        finally:
+            driver.shutdown()
+
+    def test_plugin_process_death_fails_task(self):
+        driver = ExternalDriver(
+            "nomad_tpu.client.driver:MockDriver", name="mock_driver"
+        )
+        try:
+            task = Task(name="t", driver="mock_driver", config={"run_for": "30s"})
+            handle = driver.start_task(task, "")
+            driver._proc.kill()
+            assert handle.wait(timeout=10.0)
+            assert handle.exit_code == 128
+            assert "plugin died" in handle.error
+        finally:
+            driver.shutdown()
+
+    def test_client_runs_job_through_subprocess_driver(self):
+        """A real batch job executes inside a plugin subprocess driver —
+        the agent can't tell it from a builtin."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="plugin_client_")
+        external = ExternalDriver(
+            "nomad_tpu.client.driver:MockDriver", name="mock_driver"
+        )
+        try:
+            client = Client(
+                server, data_dir=data_dir, drivers={"mock_driver": external}
+            )
+            client.start()
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": "0.2s"}
+            tg.tasks[0].resources.networks = []
+            server.job_register(job)
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                )
+                and len(server.state.allocs_by_job(job.namespace, job.id)) == 1,
+                msg="job completes through the plugin driver",
+            )
+            client.stop()
+        finally:
+            external.shutdown()
+            server.stop()
+
+
+@pytest.mark.skipif(not isolation_ok, reason="namespace isolation unavailable")
+class TestExecDriver:
+    def test_isolated_hostname_and_exit(self):
+        driver = ExecDriver()
+        fp = driver.fingerprint()
+        assert fp["detected"] and fp["healthy"]
+        with tempfile.TemporaryDirectory() as d:
+            task = Task(
+                name="t",
+                driver="exec",
+                config={
+                    "command": "/bin/sh",
+                    "args": ["-c", "hostname > out; exit 3"],
+                },
+            )
+            handle = driver.start_task(task, d)
+            assert handle.wait(timeout=10.0)
+            assert handle.exit_code == 3
+            with open(os.path.join(d, "out")) as f:
+                assert f.read().strip() == "nomad-task"
+
+    def test_pid_namespace(self):
+        """The task sees only namespace-local processes."""
+        driver = ExecDriver()
+        with tempfile.TemporaryDirectory() as d:
+            task = Task(
+                name="t",
+                driver="exec",
+                config={
+                    "command": "/bin/sh",
+                    "args": ["-c", "ls /proc | grep -c '^[0-9]' > out"],
+                },
+            )
+            handle = driver.start_task(task, d)
+            assert handle.wait(timeout=10.0)
+            with open(os.path.join(d, "out")) as f:
+                visible = int(f.read().strip())
+            host_visible = int(
+                subprocess.run(
+                    ["/bin/sh", "-c", "ls /proc | grep -c '^[0-9]'"],
+                    capture_output=True,
+                    text=True,
+                ).stdout.strip()
+            )
+            assert visible < host_visible and visible <= 4
+
+    def test_stop_kills_tree(self):
+        driver = ExecDriver()
+        with tempfile.TemporaryDirectory() as d:
+            task = Task(
+                name="t",
+                driver="exec",
+                config={"command": "/bin/sleep", "args": ["60"]},
+            )
+            handle = driver.start_task(task, d)
+            time.sleep(0.3)
+            driver.stop_task(handle)
+            assert handle.wait(timeout=10.0)
+            assert handle.exit_code != 0
+
+
+class TestTPUDevicePlugin:
+    def _fake_dev(self, tmp, n=4):
+        for i in range(n):
+            open(os.path.join(tmp, f"accel{i}"), "w").close()
+        return os.path.join(tmp, "accel*")
+
+    def test_fingerprint_and_reserve(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = TPUDevicePlugin(dev_glob=self._fake_dev(tmp))
+            groups = plugin.fingerprint()
+            assert len(groups) == 1
+            g = groups[0]
+            assert (g.vendor, g.type, g.name) == ("google", "tpu", "tpu")
+            assert [i.id for i in g.instances] == ["0", "1", "2", "3"]
+            res = plugin.reserve(["1", "3"])
+            assert res["env"] == {"TPU_VISIBLE_DEVICES": "1,3"}
+
+    def test_no_devices_no_groups(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = TPUDevicePlugin(dev_glob=os.path.join(tmp, "accel*"))
+            assert plugin.fingerprint() == []
+
+    def test_device_job_schedules_and_gets_env(self):
+        """End-to-end: a node fingerprinting TPUs via the device plugin,
+        a job asking for device 'tpu', scheduled through DeviceChecker /
+        deviceAllocator, and the task env carrying TPU_VISIBLE_DEVICES."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="device_client_")
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = TPUDevicePlugin(dev_glob=self._fake_dev(tmp, n=2))
+            client = Client(
+                server,
+                data_dir=data_dir,
+                device_plugins=[plugin],
+            )
+            try:
+                assert client.node.node_resources.devices, "TPUs fingerprinted"
+                client.start()
+
+                job = mock.batch_job()
+                tg = job.task_groups[0]
+                tg.count = 1
+                task = tg.tasks[0]
+                task.driver = "raw_exec"
+                task.config = {
+                    "command": "/bin/sh",
+                    "args": ["-c", "echo -n $TPU_VISIBLE_DEVICES > tpu_env"],
+                }
+                task.resources.networks = []
+                task.resources.devices = [RequestedDevice(name="tpu", count=1)]
+                server.job_register(job)
+
+                wait_until(
+                    lambda: all(
+                        a.client_status == "complete"
+                        for a in server.state.allocs_by_job(job.namespace, job.id)
+                    )
+                    and len(server.state.allocs_by_job(job.namespace, job.id)) == 1,
+                    msg="device job completes",
+                )
+                (alloc,) = server.state.allocs_by_job(job.namespace, job.id)
+                devices = alloc.allocated_resources.tasks["web"].devices
+                assert devices and devices[0].type == "tpu"
+                assert len(devices[0].device_ids) == 1
+
+                out = os.path.join(
+                    data_dir, "allocs", alloc.id, "web", "tpu_env"
+                )
+                with open(out) as f:
+                    assert f.read() == devices[0].device_ids[0]
+                client.stop()
+            finally:
+                server.stop()
