@@ -77,6 +77,9 @@ class EvalBroker:
         self._ready: dict[str, _PendingHeap] = {}
         # eval id -> (eval, token, nack timer)
         self._unack: dict[str, tuple[Evaluation, str, threading.Timer]] = {}
+        # evals whose nack timer is paused (plan in flight); checked by the
+        # timer path under the lock since cancel() can't stop a fired timer
+        self._paused: set[str] = set()
         # token -> eval to requeue on ack
         self._requeue: dict[str, Evaluation] = {}
         # eval id -> wait timer
@@ -215,7 +218,7 @@ class EvalBroker:
 
     def _nack_timeout(self, eval_id: str, token: str):
         try:
-            self.nack(eval_id, token)
+            self.nack(eval_id, token, from_timer=True)
         except BrokerError:
             pass
 
@@ -226,6 +229,40 @@ class EvalBroker:
             if unack is None:
                 return "", False
             return unack[1], True
+
+    def pause_nack_timeout(self, eval_id: str, token: str):
+        """Pause the nack timer while the eval's plan waits in the plan
+        queue — progress is being made; also the token guard: a stale
+        worker (its eval nacked and re-dequeued elsewhere) fails here and
+        its plan never reaches the queue (ref eval_broker.go:656-672,
+        plan_endpoint.go:30-35)."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("evaluation is not outstanding")
+            _, utoken, timer = unack
+            if utoken != token:
+                raise BrokerError("evaluation token does not match")
+            self._paused.add(eval_id)
+            timer.cancel()
+
+    def resume_nack_timeout(self, eval_id: str, token: str):
+        """Re-arm the nack timer after the plan result returns
+        (ref eval_broker.go:674-690)."""
+        with self._lock:
+            self._paused.discard(eval_id)
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("evaluation is not outstanding")
+            ev, utoken, _ = unack
+            if utoken != token:
+                raise BrokerError("evaluation token does not match")
+            timer = threading.Timer(
+                self.nack_timeout, self._nack_timeout, args=(eval_id, token)
+            )
+            timer.daemon = True
+            self._unack[eval_id] = (ev, token, timer)
+            timer.start()
 
     def ack(self, eval_id: str, token: str):
         """ref eval_broker.go:531-592"""
@@ -240,6 +277,7 @@ class EvalBroker:
             timer.cancel()
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
+            self._paused.discard(eval_id)
 
             key = (ev.namespace, ev.job_id)
             self._job_evals.pop(key, None)
@@ -255,9 +293,14 @@ class EvalBroker:
                 self._process_enqueue(requeued, "")
             self._cond.notify_all()
 
-    def nack(self, eval_id: str, token: str):
-        """ref eval_broker.go:595-642"""
+    def nack(self, eval_id: str, token: str, from_timer: bool = False):
+        """ref eval_broker.go:595-642. ``from_timer`` marks the nack-timeout
+        path, which must yield to a concurrent pause: Timer.cancel() can't
+        stop a callback already blocked on this lock, so the paused-set
+        check (atomic under the same lock as pause) is the real guard."""
         with self._lock:
+            if from_timer and eval_id in self._paused:
+                return
             self._requeue.pop(token, None)
             unack = self._unack.get(eval_id)
             if unack is None:
@@ -304,6 +347,7 @@ class EvalBroker:
             self._ready.clear()
             self._unack.clear()
             self._requeue.clear()
+            self._paused.clear()
             self._time_wait.clear()
             self._cond.notify_all()
 

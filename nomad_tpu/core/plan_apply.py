@@ -123,6 +123,122 @@ def evaluate_node_plan(
     return fit, reason
 
 
+#: plans with at least this many placements verify through the dense path
+DENSE_VERIFY_THRESHOLD = 256
+
+
+def _alloc_triple(alloc) -> tuple[int, int, int]:
+    """(cpu, memory_mb, disk_mb) of an allocation without materializing
+    ComparableResources objects (the allocs_fit summation, funcs.go:104-117,
+    done as plain ints for the dense verify path)."""
+    resources = alloc.allocated_resources
+    cpu = 0
+    mem = 0
+    for tr in resources.tasks.values():
+        cpu += tr.cpu.cpu_shares
+        mem += tr.memory.memory_mb
+    return cpu, mem, resources.shared.disk_mb
+
+
+def _alloc_exotic(alloc) -> bool:
+    """Whether the alloc carries ports/bandwidth or devices — dimensions the
+    dense verify doesn't model, forcing the exact per-node check."""
+    resources = alloc.allocated_resources
+    if resources.shared.networks:
+        return True
+    for tr in resources.tasks.values():
+        if tr.networks or tr.devices:
+            return True
+    return False
+
+
+def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dict[str, tuple[bool, str]]:
+    """Vectorized fit verdicts for the plan's touched nodes: per-node
+    proposed usage is summed as int triples and compared against capacity
+    (the masked fit-matrix reduction of SURVEY §2.8#2); nodes whose allocs
+    carry ports or devices, and nodes that fail the dense check (which need
+    the exact failing reason), fall back to evaluate_node_plan."""
+    import numpy as np
+
+    n = len(node_ids)
+    capacity = np.zeros((n, 3), dtype=np.int64)
+    used = np.zeros((n, 3), dtype=np.int64)
+    exact = np.zeros(n, dtype=bool)  # exotic dimensions → exact check
+
+    # one pass over the alloc table instead of one scan per touched node
+    # (allocs_by_node_terminal is O(total allocs) per call)
+    touched = set(node_ids)
+    existing_by_node: dict[str, list] = {nid: [] for nid in node_ids}
+    for a in snap.allocs():
+        if a.node_id in touched and not a.terminal_status():
+            existing_by_node[a.node_id].append(a)
+
+    verdicts: dict[str, tuple[bool, str]] = {}
+    for i, node_id in enumerate(node_ids):
+        if not plan.node_allocation.get(node_id):
+            verdicts[node_id] = (True, "")
+            continue
+        node = snap.node_by_id(node_id)
+        if node is None:
+            verdicts[node_id] = (False, "node does not exist")
+            continue
+        if node.status != NODE_STATUS_READY:
+            verdicts[node_id] = (False, "node is not ready for placements")
+            continue
+        if node.scheduling_eligibility == NODE_SCHED_INELIGIBLE:
+            verdicts[node_id] = (False, "node is not eligible for draining")
+            continue
+
+        res = node.node_resources
+        capacity[i] = (res.cpu.cpu_shares, res.memory.memory_mb, res.disk.disk_mb)
+        if node.reserved_resources is not None:
+            rr = node.reserved_resources
+            used[i] = (rr.cpu.cpu_shares, rr.memory.memory_mb, rr.disk.disk_mb)
+
+        removed = {
+            a.id
+            for a in (
+                plan.node_update.get(node_id, [])
+                + plan.node_preemptions.get(node_id, [])
+                + plan.node_allocation.get(node_id, [])
+            )
+        }
+        for a in existing_by_node[node_id]:
+            if a.id in removed or a.allocated_resources is None:
+                continue
+            if _alloc_exotic(a):
+                exact[i] = True
+                break
+            c, m, d = _alloc_triple(a)
+            used[i, 0] += c
+            used[i, 1] += m
+            used[i, 2] += d
+        if exact[i]:
+            continue
+        for a in plan.node_allocation.get(node_id, []):
+            if a.allocated_resources is None:
+                continue
+            if _alloc_exotic(a):
+                exact[i] = True
+                break
+            c, m, d = _alloc_triple(a)
+            used[i, 0] += c
+            used[i, 1] += m
+            used[i, 2] += d
+
+    fits = (used <= capacity).all(axis=1)
+    for i, node_id in enumerate(node_ids):
+        if node_id in verdicts:
+            continue
+        if exact[i] or not fits[i]:
+            # exact path: exotic dimensions, or dense failure needing the
+            # precise failing reason (and a double-check)
+            verdicts[node_id] = evaluate_node_plan(snap, plan, node_id)
+        else:
+            verdicts[node_id] = (True, "")
+    return verdicts
+
+
 def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
     """Determine the committable subset of a plan
     (ref plan_apply.go:399-560)."""
@@ -135,9 +251,17 @@ def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
         list(plan.node_update.keys()) + list(plan.node_allocation.keys())
     ))
 
+    total_placements = sum(len(v) for v in plan.node_allocation.values())
+    dense = None
+    if total_placements >= DENSE_VERIFY_THRESHOLD:
+        dense = _dense_node_fit(snap, plan, node_ids)
+
     partial_commit = False
     for node_id in node_ids:
-        fit, reason = evaluate_node_plan(snap, plan, node_id)
+        if dense is not None:
+            fit, reason = dense[node_id]
+        else:
+            fit, reason = evaluate_node_plan(snap, plan, node_id)
         if not fit:
             partial_commit = True
             if plan.all_at_once:
@@ -202,35 +326,146 @@ class Planner:
             self._thread.join(timeout=2.0)
 
     def _apply_loop(self):
+        """Overlap verify(N+1) with raft-apply(N) (ref plan_apply.go:49-180):
+        after dispatching plan N's commit asynchronously, plan N+1 is
+        verified against an OPTIMISTIC snapshot that already contains N's
+        result — so back-to-back plans can't double-book capacity while the
+        consensus round-trip is in flight. The submitting worker is answered
+        only after its commit really lands (unhappy-path safety)."""
+        outstanding: Optional[tuple[threading.Thread, dict]] = None
+        prev_index = 0
+        snap: Optional[StateSnapshot] = None
+
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
+
+            # harvest a commit that finished while we were idle
+            if outstanding is not None and not outstanding[0].is_alive():
+                prev_index = max(prev_index, outstanding[1].get("index", 0))
+                outstanding = None
+                snap = None
+
+            min_index = max(prev_index, pending.plan.snapshot_index)
+            if snap is not None and snap.latest_index() < min_index:
+                snap = None
+            if snap is None:
+                # a replacement snapshot must contain the in-flight plan's
+                # placements — unrelated writes advancing the store index
+                # would otherwise satisfy min_index with a snapshot that
+                # misses them and double-books their capacity
+                if outstanding is not None:
+                    outstanding[0].join()
+                    prev_index = max(prev_index, outstanding[1].get("index", 0))
+                    outstanding = None
+                    min_index = max(prev_index, pending.plan.snapshot_index)
+                try:
+                    snap = self.state.snapshot_min_index(min_index, timeout=5.0)
+                except Exception as e:
+                    pending.respond(None, e)
+                    continue
+
             try:
-                result = self.apply(pending.plan)
-                pending.respond(result, None)
-            except Exception as e:  # surface to the submitting worker
+                result = evaluate_plan(snap, pending.plan)
+            except Exception as e:
                 pending.respond(None, e)
+                continue
+            if result.is_no_op() and result.refresh_index:
+                pending.respond(result, None)
+                continue
+
+            # one commit in flight at a time: wait out the previous one and
+            # refresh to a snapshot containing it before dispatching
+            if outstanding is not None:
+                outstanding[0].join()
+                committed = outstanding[1].get("index", 0)
+                prev_index = max(prev_index, committed)
+                outstanding = None
+                try:
+                    snap = self.state.snapshot_min_index(
+                        max(prev_index, pending.plan.snapshot_index), timeout=5.0
+                    )
+                except Exception as e:
+                    pending.respond(None, e)
+                    continue
+                if not committed:
+                    # the previous commit FAILED: this plan was verified
+                    # against an optimistic world that never materialized —
+                    # re-verify against reality before committing
+                    try:
+                        result = evaluate_plan(snap, pending.plan)
+                    except Exception as e:
+                        pending.respond(None, e)
+                        continue
+                    if result.is_no_op() and result.refresh_index:
+                        pending.respond(result, None)
+                        continue
+
+            # next iteration verifies against this plan's optimistic world
+            try:
+                snap = self._optimistic_snapshot(snap, pending.plan, result)
+            except Exception:
+                snap = None  # fall back to a fresh snapshot next round
+
+            box: dict = {}
+            t = threading.Thread(
+                target=self._async_commit,
+                args=(pending, result, box),
+                daemon=True,
+            )
+            t.start()
+            outstanding = (t, box)
+
+        if outstanding is not None:
+            outstanding[0].join(timeout=2.0)
+
+    def _optimistic_snapshot(
+        self, snap: StateSnapshot, plan: Plan, result: PlanResult
+    ) -> StateSnapshot:
+        """A snapshot with ``result`` applied on top of ``snap`` without
+        publishing anything: a scratch store adopts the immutable generation
+        and copy-on-writes a private one (the reference's optimistic
+        snapshot, plan_apply.go:72-76)."""
+        scratch = StateStore()
+        scratch._gen = snap._gen
+        scratch.upsert_plan_results(None, plan, result)
+        return scratch.snapshot()
+
+    def _async_commit(self, pending: PendingPlan, result: PlanResult, box: dict):
+        """Commit the verified result via consensus and answer the worker
+        (ref plan_apply.go:367 asyncPlanWait)."""
+        try:
+            plan = pending.plan
+            preemption_evals: list[Evaluation] = []
+            if self.preemption_evals_fn is not None and result.node_preemptions:
+                preemption_evals = self.preemption_evals_fn(result)
+            if self.commit_fn is not None:
+                index = self.commit_fn(plan, result, preemption_evals)
+            else:
+                index = self.state.upsert_plan_results(
+                    None, plan, result, preemption_evals=preemption_evals
+                )
+                if preemption_evals and self.on_preemption_evals is not None:
+                    self.on_preemption_evals(
+                        [self.state.eval_by_id(e.id) for e in preemption_evals]
+                    )
+            result.alloc_index = index
+            box["index"] = index
+            pending.respond(result, None)
+        except Exception as e:
+            pending.respond(None, e)
 
     def apply(self, plan: Plan) -> PlanResult:
-        """Verify against the latest snapshot and commit the verified subset."""
+        """Synchronous verify + commit against the latest snapshot (the
+        non-overlapped path kept for direct callers/tests)."""
         snap = self.state.snapshot()
         result = evaluate_plan(snap, plan)
         if result.is_no_op() and result.refresh_index:
             return result
-
-        preemption_evals: list[Evaluation] = []
-        if self.preemption_evals_fn is not None and result.node_preemptions:
-            preemption_evals = self.preemption_evals_fn(result)
-        if self.commit_fn is not None:
-            index = self.commit_fn(plan, result, preemption_evals)
-        else:
-            index = self.state.upsert_plan_results(
-                None, plan, result, preemption_evals=preemption_evals
-            )
-            if preemption_evals and self.on_preemption_evals is not None:
-                self.on_preemption_evals(
-                    [self.state.eval_by_id(e.id) for e in preemption_evals]
-                )
-        result.alloc_index = index
-        return result
+        pending = PendingPlan(plan)
+        self._async_commit(pending, result, {})
+        res, err = pending.wait(timeout=30.0)
+        if err is not None:
+            raise err
+        return res

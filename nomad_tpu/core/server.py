@@ -347,6 +347,27 @@ class Server:
                     )
             time.sleep(min(1.0, min(iv for iv in intervals.values())))
 
+    def plan_submit(self, plan):
+        """Plan submission with the EvalToken split-brain guard
+        (ref plan_endpoint.go:19-52): the broker must still hold this eval
+        outstanding under this token, else the worker is stale (its eval was
+        nacked and re-dequeued elsewhere) and the plan is rejected before it
+        can clobber the newer worker's. The nack timer pauses while the plan
+        queues — it is making progress — and resumes when the result lands."""
+        from .broker import BrokerError
+
+        eval_id = plan.eval_id
+        token = plan.eval_token
+        self.eval_broker.pause_nack_timeout(eval_id, token)
+        try:
+            pending = self.planner.queue.enqueue(plan)
+            return pending.wait(timeout=30.0)
+        finally:
+            try:
+                self.eval_broker.resume_nack_timeout(eval_id, token)
+            except BrokerError:
+                pass  # acked/nacked while the plan was in flight
+
     def system_gc(self):
         """Force-GC everything eligible (ref system_endpoint.go GarbageCollect
         → CoreJobForceGC). Leader-only."""

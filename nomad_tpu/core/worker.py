@@ -127,8 +127,7 @@ class Worker:
         a fresh snapshot when the applier asks for a refresh."""
         plan.eval_token = self._eval_token
         plan.snapshot_index = self.server.state.latest_index()
-        pending = self.server.planner.queue.enqueue(plan)
-        result, error = pending.wait(timeout=30.0)
+        result, error = self.server.plan_submit(plan)
         if error is not None:
             raise error
         if result is None:
