@@ -68,6 +68,12 @@ class ApiClient:
     def register_job(self, job_dict: dict) -> dict:
         return self.put("/v1/jobs", body={"Job": job_dict})[0]
 
+    def plan_job(self, job_dict: dict, diff: bool = True) -> dict:
+        return self.put(
+            f"/v1/job/{_q(job_dict.get('id', ''))}/plan",
+            body={"Job": job_dict, "Diff": diff},
+        )[0]
+
     def job(self, job_id: str) -> dict:
         return self.get(f"/v1/job/{_q(job_id)}")[0]
 

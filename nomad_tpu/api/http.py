@@ -195,6 +195,21 @@ class HTTPServer:
         )
         return {"EvalID": eval_id}, None
 
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/plan")
+    def plan_job(self, m, query, body):
+        """Dry-run: annotated placement plan + structural diff, no state
+        mutation (ref job_endpoint.go Plan, command/job_plan.go)."""
+        if not isinstance(body, dict) or "Job" not in body:
+            raise ValueError("request must contain a Job")
+        job = Job.from_dict(body["Job"])
+        result = self.server.job_plan(job, diff=bool(body.get("Diff", True)))
+        return {
+            "Annotations": result["annotations"],
+            "FailedTGAllocs": result["failed_tg_allocs"],
+            "Diff": result["diff"],
+            "JobModifyIndex": result["job_modify_index"],
+        }, None
+
     @route("GET", r"/v1/job/(?P<job_id>[^/]+)/allocations")
     def job_allocations(self, m, query, body):
         def run(snap):

@@ -118,6 +118,85 @@ def cmd_job_run(args):
     return 1
 
 
+def _render_field_diff(d, indent):
+    mark = {"Added": "+", "Deleted": "-", "Edited": "~"}.get(d["Type"], " ")
+    if d["Type"] == "Edited":
+        print(f"{indent}{mark} {d['Name']}: {d['Old']!r} => {d['New']!r}")
+    elif d["Type"] == "Added":
+        print(f"{indent}{mark} {d['Name']}: {d['New']!r}")
+    else:
+        print(f"{indent}{mark} {d['Name']}: {d['Old']!r}")
+
+
+def _render_object_diff(d, indent="  "):
+    mark = {"Added": "+", "Deleted": "-", "Edited": "~"}.get(d["Type"], " ")
+    print(f"{indent}{mark} {d['Name']}")
+    for fd in d.get("Fields", []):
+        _render_field_diff(fd, indent + "  ")
+    for od in d.get("Objects", []):
+        _render_object_diff(od, indent + "  ")
+
+
+def cmd_job_plan(args):
+    """Dry-run a job: structural diff + annotated placement decisions,
+    nothing committed (ref command/job_plan.go)."""
+    from ..jobspec import parse_job
+
+    with open(args.jobfile) as f:
+        job = parse_job(f.read())
+    client = _client(args)
+    resp = client.plan_job(job.to_dict(), diff=not args.no_diff)
+
+    diff = resp.get("Diff")
+    if diff:
+        print(f"==> Job: {job.id!r} ({diff['Type']})")
+        for fd in diff.get("Fields", []):
+            _render_field_diff(fd, "  ")
+        for od in diff.get("Objects", []):
+            _render_object_diff(od)
+        for tg in diff.get("TaskGroups", []):
+            mark = {"Added": "+", "Deleted": "-", "Edited": "~"}.get(tg["Type"], " ")
+            print(f"{mark} Task Group: {tg['Name']!r}")
+            for fd in tg.get("Fields", []):
+                _render_field_diff(fd, "    ")
+            for od in tg.get("Objects", []):
+                _render_object_diff(od, "    ")
+            for td in tg.get("Tasks", []):
+                tmark = {"Added": "+", "Deleted": "-", "Edited": "~"}.get(td["Type"], " ")
+                print(f"    {tmark} Task: {td['Name']!r}")
+                for fd in td.get("Fields", []):
+                    _render_field_diff(fd, "      ")
+                for od in td.get("Objects", []):
+                    _render_object_diff(od, "      ")
+
+    annotations = resp.get("Annotations") or {}
+    updates = annotations.get("desired_tg_updates") or {}
+    if updates:
+        print("==> Scheduler dry-run:")
+        for tg, u in updates.items():
+            parts = []
+            for key, label in (
+                ("place", "place"),
+                ("stop", "stop"),
+                ("in_place_update", "in-place update"),
+                ("destructive_update", "destructive update"),
+                ("migrate", "migrate"),
+                ("canary", "canary"),
+                ("ignore", "ignore"),
+            ):
+                if u.get(key):
+                    parts.append(f"{u[key]} {label}")
+            detail = ", ".join(parts) if parts else "no changes"
+            print(f"    group {tg!r}: {detail}")
+    failed = resp.get("FailedTGAllocs") or {}
+    for tg, metrics in failed.items():
+        print(f"    group {tg!r}: WOULD FAIL to place "
+              f"({metrics.get('nodes_filtered', 0)} filtered, "
+              f"{metrics.get('nodes_exhausted', 0)} exhausted)")
+    print(f"==> Job Modify Index: {resp.get('JobModifyIndex', 0)}")
+    return 2 if failed else 0
+
+
 def cmd_job_status(args):
     client = _client(args)
     if not args.job_id:
@@ -393,6 +472,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     job = sub.add_parser("job", help="job commands")
     jsub = job.add_subparsers(dest="subcommand")
+    jp = jsub.add_parser("plan", help="dry-run a job: diff + placements")
+    jp.add_argument("jobfile")
+    jp.add_argument("--no-diff", action="store_true")
+    jp.set_defaults(fn=cmd_job_plan)
     jr = jsub.add_parser("run")
     jr.add_argument("jobfile")
     jr.add_argument("-detach", action="store_true")

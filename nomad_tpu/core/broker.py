@@ -248,15 +248,18 @@ class EvalBroker:
 
     def resume_nack_timeout(self, eval_id: str, token: str):
         """Re-arm the nack timer after the plan result returns
-        (ref eval_broker.go:674-690)."""
+        (ref eval_broker.go:674-690). Token validation precedes the paused-
+        set removal: a stale holder's resume must not strip the CURRENT
+        holder's pause (a lock-blocked timer callback would then slip past
+        the paused guard and nack a live plan)."""
         with self._lock:
-            self._paused.discard(eval_id)
             unack = self._unack.get(eval_id)
             if unack is None:
                 raise BrokerError("evaluation is not outstanding")
             ev, utoken, _ = unack
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
+            self._paused.discard(eval_id)
             timer = threading.Timer(
                 self.nack_timeout, self._nack_timeout, args=(eval_id, token)
             )

@@ -153,18 +153,12 @@ def _alloc_exotic(alloc) -> bool:
 
 
 def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dict[str, tuple[bool, str]]:
-    """Vectorized fit verdicts for the plan's touched nodes: per-node
-    proposed usage is summed as int triples and compared against capacity
-    (the masked fit-matrix reduction of SURVEY §2.8#2); nodes whose allocs
-    carry ports or devices, and nodes that fail the dense check (which need
-    the exact failing reason), fall back to evaluate_node_plan."""
-    import numpy as np
-
-    n = len(node_ids)
-    capacity = np.zeros((n, 3), dtype=np.int64)
-    used = np.zeros((n, 3), dtype=np.int64)
-    exact = np.zeros(n, dtype=bool)  # exotic dimensions → exact check
-
+    """Batched fit verdicts for the plan's touched nodes. Two wins over the
+    per-node exact path: the alloc table is scanned ONCE (not once per
+    node), and usage sums are plain int triples instead of
+    ComparableResources object math. Nodes whose allocs carry ports or
+    devices, and nodes that fail this check (which need the exact failing
+    reason), fall back to evaluate_node_plan."""
     # one pass over the alloc table instead of one scan per touched node
     # (allocs_by_node_terminal is O(total allocs) per call)
     touched = set(node_ids)
@@ -174,7 +168,7 @@ def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dic
             existing_by_node[a.node_id].append(a)
 
     verdicts: dict[str, tuple[bool, str]] = {}
-    for i, node_id in enumerate(node_ids):
+    for node_id in node_ids:
         if not plan.node_allocation.get(node_id):
             verdicts[node_id] = (True, "")
             continue
@@ -190,10 +184,13 @@ def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dic
             continue
 
         res = node.node_resources
-        capacity[i] = (res.cpu.cpu_shares, res.memory.memory_mb, res.disk.disk_mb)
+        cap = (res.cpu.cpu_shares, res.memory.memory_mb, res.disk.disk_mb)
+        cpu = mem = disk = 0
         if node.reserved_resources is not None:
             rr = node.reserved_resources
-            used[i] = (rr.cpu.cpu_shares, rr.memory.memory_mb, rr.disk.disk_mb)
+            cpu, mem, disk = (
+                rr.cpu.cpu_shares, rr.memory.memory_mb, rr.disk.disk_mb
+            )
 
         removed = {
             a.id
@@ -203,36 +200,32 @@ def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dic
                 + plan.node_allocation.get(node_id, [])
             )
         }
+        exotic = False
         for a in existing_by_node[node_id]:
             if a.id in removed or a.allocated_resources is None:
                 continue
             if _alloc_exotic(a):
-                exact[i] = True
+                exotic = True
                 break
             c, m, d = _alloc_triple(a)
-            used[i, 0] += c
-            used[i, 1] += m
-            used[i, 2] += d
-        if exact[i]:
-            continue
-        for a in plan.node_allocation.get(node_id, []):
-            if a.allocated_resources is None:
-                continue
-            if _alloc_exotic(a):
-                exact[i] = True
-                break
-            c, m, d = _alloc_triple(a)
-            used[i, 0] += c
-            used[i, 1] += m
-            used[i, 2] += d
+            cpu += c
+            mem += m
+            disk += d
+        if not exotic:
+            for a in plan.node_allocation.get(node_id, []):
+                if a.allocated_resources is None:
+                    continue
+                if _alloc_exotic(a):
+                    exotic = True
+                    break
+                c, m, d = _alloc_triple(a)
+                cpu += c
+                mem += m
+                disk += d
 
-    fits = (used <= capacity).all(axis=1)
-    for i, node_id in enumerate(node_ids):
-        if node_id in verdicts:
-            continue
-        if exact[i] or not fits[i]:
-            # exact path: exotic dimensions, or dense failure needing the
-            # precise failing reason (and a double-check)
+        if exotic or cpu > cap[0] or mem > cap[1] or disk > cap[2]:
+            # exact path: exotic dimensions, or failure needing the precise
+            # failing reason (and a double-check)
             verdicts[node_id] = evaluate_node_plan(snap, plan, node_id)
         else:
             verdicts[node_id] = (True, "")
@@ -307,6 +300,11 @@ class Planner:
         self._thread: Optional[threading.Thread] = None
         self.preemption_evals_fn = None  # hook: build follow-up evals for preempted allocs
         self.on_preemption_evals = None  # hook: enqueue them after commit
+        # hook: (plan) -> bool; re-validates the plan's eval token at
+        # dequeue time — a worker that timed out waiting leaves its plan
+        # orphaned in the queue, and committing it after the eval moved on
+        # would double-place (the enqueue-time guard alone can't catch it)
+        self.token_check_fn = None
         # consensus commit hook: (plan, result, preemption_evals) -> index.
         # When set (server wiring), the verified result is replicated via
         # raft ApplyPlanResults instead of written directly (plan_apply.go
@@ -335,10 +333,25 @@ class Planner:
         outstanding: Optional[tuple[threading.Thread, dict]] = None
         prev_index = 0
         snap: Optional[StateSnapshot] = None
+        # the REAL store index the current snap is based on: an optimistic
+        # overlay bumps the snapshot's own index synthetically, which must
+        # not satisfy staleness checks against genuine raft writes (a node
+        # going down at the same numeric index would be missed)
+        snap_base_index = 0
 
         while not self._stop.is_set():
             pending = self.queue.dequeue(timeout=0.2)
             if pending is None:
+                continue
+
+            if self.token_check_fn is not None and not self.token_check_fn(
+                pending.plan
+            ):
+                # the submitting worker gave up (timeout) and its eval moved
+                # on — committing this orphan would double-place the eval
+                pending.respond(
+                    None, RuntimeError("plan rejected: eval token no longer live")
+                )
                 continue
 
             # harvest a commit that finished while we were idle
@@ -348,7 +361,7 @@ class Planner:
                 snap = None
 
             min_index = max(prev_index, pending.plan.snapshot_index)
-            if snap is not None and snap.latest_index() < min_index:
+            if snap is not None and snap_base_index < min_index:
                 snap = None
             if snap is None:
                 # a replacement snapshot must contain the in-flight plan's
@@ -362,6 +375,7 @@ class Planner:
                     min_index = max(prev_index, pending.plan.snapshot_index)
                 try:
                     snap = self.state.snapshot_min_index(min_index, timeout=5.0)
+                    snap_base_index = snap.latest_index()
                 except Exception as e:
                     pending.respond(None, e)
                     continue
@@ -386,6 +400,7 @@ class Planner:
                     snap = self.state.snapshot_min_index(
                         max(prev_index, pending.plan.snapshot_index), timeout=5.0
                     )
+                    snap_base_index = snap.latest_index()
                 except Exception as e:
                     pending.respond(None, e)
                     continue

@@ -89,6 +89,7 @@ class Server:
         self.planner = Planner(self.state)
         self.planner.commit_fn = self._commit_plan
         self.planner.preemption_evals_fn = self._make_preemption_evals
+        self.planner.token_check_fn = self._plan_token_live
         self.workers: list[Worker] = []
         self.heartbeat_ttl = self.config.get("heartbeat_ttl", DEFAULT_HEARTBEAT_TTL)
         self._heartbeat_timers: dict[str, threading.Timer] = {}
@@ -347,6 +348,14 @@ class Server:
                     )
             time.sleep(min(1.0, min(iv for iv in intervals.values())))
 
+    def _plan_token_live(self, plan) -> bool:
+        """Dequeue-time re-validation of a plan's eval token (plans without
+        tokens — direct planner users — pass)."""
+        if not plan.eval_token:
+            return True
+        token, ok = self.eval_broker.outstanding(plan.eval_id)
+        return ok and token == plan.eval_token
+
     def plan_submit(self, plan):
         """Plan submission with the EvalToken split-brain guard
         (ref plan_endpoint.go:19-52): the broker must still hold this eval
@@ -410,6 +419,53 @@ class Server:
         )
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return ev.id
+
+    def job_plan(self, job: Job, diff: bool = True) -> dict:
+        """Dry-run the job against a scratch copy of current state and
+        return the annotated plan + structural diff without mutating
+        anything (ref job_endpoint.go Plan: snapshot + UpsertJob into the
+        snapshot, scheduler.Harness dry-run with annotate, structs diff)."""
+        from ..scheduler import Harness
+        from ..structs.diff import job_diff
+
+        self._validate_job(job)
+        old_job = self.state.job_by_id(job.namespace, job.id)
+
+        # scratch world adopting the immutable generation; never published
+        scratch = StateStore()
+        scratch._gen = self.state.snapshot()._gen
+        planned = job.copy()
+        planned.submit_time = now_ns()
+        scratch.upsert_job(None, planned)
+
+        harness = Harness(state=scratch, seed=self.config.get("seed"))
+        harness._next_index = scratch.latest_index() + 1
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            status=EVAL_STATUS_PENDING,
+            annotate_plan=True,
+        )
+        sched = harness.process(job.type, ev)
+
+        plan = harness.plans[-1] if harness.plans else None
+        annotations = None
+        if plan is not None and plan.annotations is not None:
+            annotations = plan.annotations.to_dict()
+        failed = {
+            name: metric.to_dict()
+            for name, metric in (getattr(sched, "failed_tg_allocs", None) or {}).items()
+        }
+        return {
+            "annotations": annotations,
+            "failed_tg_allocs": failed,
+            "diff": job_diff(old_job, job) if diff else None,
+            "job_modify_index": old_job.modify_index if old_job is not None else 0,
+        }
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
         """ref job_endpoint.go Deregister"""

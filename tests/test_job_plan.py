@@ -1,0 +1,191 @@
+"""`job plan` end-to-end: structural diff, annotated dry-run, HTTP route,
+CLI rendering (ref nomad/structs/diff.go, scheduler/annotate.go,
+job_endpoint.go Plan, command/job_plan.go)."""
+
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.diff import job_diff
+from nomad_tpu.structs.model import Constraint
+
+
+def make_server():
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def simple_job(count=2):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.networks = []
+    return job
+
+
+class TestJobDiff:
+    def test_new_job_is_added(self):
+        job = simple_job()
+        d = job_diff(None, job)
+        assert d["Type"] == "Added"
+
+    def test_identical_jobs_no_diff(self):
+        job = simple_job()
+        d = job_diff(job, job.copy())
+        assert d["Type"] == "None"
+        assert d["Fields"] == [] and d["TaskGroups"] == []
+
+    def test_count_change_is_tg_edit(self):
+        old = simple_job(count=2)
+        new = old.copy()
+        new.task_groups[0].count = 5
+        d = job_diff(old, new)
+        assert d["Type"] == "Edited"
+        (tg,) = d["TaskGroups"]
+        counts = [f for f in tg["Fields"] if f["Name"] == "count"]
+        assert counts and counts[0]["Old"] == "2" and counts[0]["New"] == "5"
+
+    def test_task_and_constraint_changes(self):
+        old = simple_job()
+        new = old.copy()
+        new.task_groups[0].tasks[0].resources.cpu = 999
+        new.constraints = list(new.constraints) + [
+            Constraint(l_target="${attr.arch}", r_target="amd64", operand="=")
+        ]
+        d = job_diff(old, new)
+        assert d["Type"] == "Edited"
+        assert any(o["Type"] == "Added" for o in d["Objects"])  # new constraint
+        (tg,) = d["TaskGroups"]
+        (task,) = tg["Tasks"]
+        assert task["Type"] == "Edited"
+        assert any(
+            f["Name"] == "cpu" and f["New"] == "999"
+            for o in task["Objects"]
+            for f in o["Fields"]
+        )
+
+    def test_removed_group_is_deleted(self):
+        old = simple_job()
+        new = old.copy()
+        new.task_groups = []
+        d = job_diff(old, new)
+        (tg,) = d["TaskGroups"]
+        assert tg["Type"] == "Deleted"
+
+
+class TestJobPlanEndpoint:
+    def test_dry_run_annotations_without_mutation(self):
+        server = make_server()
+        try:
+            for _ in range(3):
+                server.node_register(mock.node())
+            job = simple_job(count=2)
+            server.job_register(job)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len(server.state.allocs_by_job(job.namespace, job.id)) == 2:
+                    break
+                time.sleep(0.05)
+
+            before_index = server.state.latest_index()
+            before_allocs = len(server.state.allocs_by_job(job.namespace, job.id))
+            before_evals = len(server.state.evals_by_job(job.namespace, job.id))
+
+            scaled = job.copy()
+            scaled.task_groups[0].count = 5
+            result = server.job_plan(scaled)
+
+            updates = result["annotations"]["desired_tg_updates"]["web"]
+            assert updates["place"] == 3
+            # existing allocs stay but get their job ref refreshed in place
+            assert updates["in_place_update"] == 2
+            assert result["job_modify_index"] > 0
+
+            counts = [
+                f
+                for f in result["diff"]["TaskGroups"][0]["Fields"]
+                if f["Name"] == "count"
+            ]
+            assert counts[0]["New"] == "5"
+
+            # nothing mutated
+            assert server.state.latest_index() == before_index
+            assert len(server.state.allocs_by_job(job.namespace, job.id)) == before_allocs
+            assert len(server.state.evals_by_job(job.namespace, job.id)) == before_evals
+        finally:
+            server.stop()
+
+    def test_plan_reports_would_fail(self):
+        server = make_server()
+        try:
+            # no nodes: every placement would fail
+            job = simple_job(count=2)
+            result = server.job_plan(job)
+            assert result["failed_tg_allocs"], "failure surfaced in dry-run"
+            assert result["diff"]["Type"] == "Added"
+        finally:
+            server.stop()
+
+
+class TestJobPlanHTTP:
+    def test_http_route_and_cli_rendering(self, capsys, tmp_path, monkeypatch):
+        from nomad_tpu.api.http import HTTPServer
+        from nomad_tpu.api.client import ApiClient
+
+        server = make_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            for _ in range(2):
+                server.node_register(mock.node())
+            client = ApiClient(address=f"http://127.0.0.1:{http.port}")
+            job = simple_job(count=3)
+            resp = client.plan_job(job.to_dict())
+            assert resp["Diff"]["Type"] == "Added"
+            assert resp["Annotations"]["desired_tg_updates"]["web"]["place"] == 3
+
+            # CLI rendering over a real HCL jobspec
+            spec = tmp_path / "web.nomad"
+            spec.write_text(
+                """
+job "web-plan" {
+  datacenters = ["dc1"]
+  group "web" {
+    count = 2
+    task "srv" {
+      driver = "mock_driver"
+      config { run_for = "10s" }
+      resources { cpu = 100\n memory = 64 }
+    }
+  }
+}
+"""
+            )
+            from nomad_tpu.cli.main import main as cli_main
+
+            rc = cli_main(
+                ["-address", f"http://127.0.0.1:{http.port}", "job", "plan", str(spec)]
+            )
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "web-plan" in out and "place" in out
+            assert "Job Modify Index" in out
+        finally:
+            http.stop()
+            server.stop()
