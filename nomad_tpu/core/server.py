@@ -104,6 +104,7 @@ class Server:
         NodeDrainer(self)  # installs itself as self.drainer
         PeriodicDispatch(self)  # attaches as self.periodic + FSM hook
         self.raft = self._setup_raft()
+        self.gossip = self._setup_gossip()
 
     # ------------------------------------------------------------------
     # raft wiring (ref server.go:1075 setupRaft)
@@ -112,7 +113,13 @@ class Server:
         rc = self.config.get("raft", {})
         node_id = rc.get("node_id", self.config.get("name", "server-1"))
         address = rc.get("address", node_id)
-        voters = rc.get("voters", {node_id: address})
+        if self.config.get("gossip") and not self.config.get("bootstrap"):
+            # gossip auto-discovery (ref serf.go): non-bootstrap servers
+            # start with no voters and wait for the leader to add them via
+            # a raft CONFIG entry — they never self-elect
+            voters = rc.get("voters", {})
+        else:
+            voters = rc.get("voters", {node_id: address})
         single = len(voters) == 1
         raft_config = rc.get("config") or RaftConfig(
             # single-voter dev servers elect in ~10ms (raftInmem dev mode)
@@ -133,6 +140,80 @@ class Server:
             config=raft_config,
             on_leadership=self._leadership_changed,
         )
+
+    def _setup_gossip(self):
+        """Gossip membership wiring (ref nomad/serf.go setupSerf +
+        serf event handler feeding raft membership)."""
+        gcfg = self.config.get("gossip")
+        if not gcfg:
+            return None
+        import random as random_mod
+
+        from ..gossip import Gossip
+
+        seed = self.config.get("seed")
+        return Gossip(
+            name=self.raft.node_id,
+            bind=tuple(gcfg.get("bind", ("127.0.0.1", 0))),
+            tags={"raft": self.raft.address, "role": "server"},
+            probe_interval=float(gcfg.get("probe_interval", 0.3)),
+            ack_timeout=float(gcfg.get("ack_timeout", 0.3)),
+            suspect_timeout=float(gcfg.get("suspect_timeout", 1.5)),
+            reap_timeout=float(gcfg.get("reap_timeout", 3.0)),
+            on_event=self._gossip_event,
+            rng=random_mod.Random(seed),
+        )
+
+    def _gossip_event(self, event: str, member):
+        """Serf events → raft membership, leader-side only (followers
+        converge through the replicated CONFIG entries); ref serf.go
+        nodeJoin/nodeFailed + autopilot dead-server cleanup."""
+        if not self._leader:
+            return
+        try:
+            if event == "join":
+                raft_addr = member.tags.get("raft")
+                if raft_addr and self.raft.voters.get(member.name) != raft_addr:
+                    # new server, or a known server back with a different
+                    # raft address (restart with dynamic bind): either way
+                    # the CONFIG entry carries the current address
+                    logger.info("gossip: adding server %s to raft", member.name)
+                    self.raft.add_voter(member.name, raft_addr)
+            elif event in ("dead", "leave", "reap"):
+                if member.name in self.raft.voters:
+                    logger.info("gossip: removing server %s from raft", member.name)
+                    self.raft.remove_voter(member.name)
+        except NotLeaderError:
+            pass
+        except Exception:
+            logger.exception("gossip membership change failed")
+
+    def _reconcile_gossip_members(self):
+        """On leadership: fold the current gossip view into raft membership
+        both ways — joins a previous leader never applied AND removals it
+        never committed (a follower drops dead/reap events at the leader
+        guard, and swim reaps the record entirely, so without this sweep a
+        dead server would stay a quorum-counted voter forever)."""
+        if self.gossip is None:
+            return
+        alive = {m.name: m for m in self.gossip.alive_members()}
+        for member in alive.values():
+            if member.name == self.raft.node_id:
+                continue
+            self._gossip_event("join", member)
+        for voter in list(self.raft.voters):
+            if voter == self.raft.node_id or voter in alive:
+                continue
+            with_status = self.gossip.members.get(voter)
+            if with_status is not None and with_status.status == "suspect":
+                continue  # possibly flapping; the dead event will decide
+            try:
+                logger.info(
+                    "gossip reconcile: removing non-member voter %s", voter
+                )
+                self.raft.remove_voter(voter)
+            except Exception:
+                logger.exception("gossip reconcile removal failed")
 
     def _apply(self, msg_type: str, payload: dict):
         """Propose a write through consensus (ref nomad/rpc.go raftApply).
@@ -174,6 +255,28 @@ class Server:
     def start(self, num_workers: int = 2, wait_for_leader: Optional[float] = None):
         self._running = True
         self.raft.start()
+        if self.gossip is not None:
+            self.gossip.start()
+            seeds = self.config.get("gossip", {}).get("join", [])
+            if seeds:
+                # retry-join in the background until a seed answers
+                # (ref agent retry_join): a seed binding late must not
+                # strand a non-bootstrap server (it has no voters and
+                # never self-elects, so a silent give-up is a hang)
+                def _join():
+                    delay = 0.5
+                    while self._running:
+                        for seed in seeds:
+                            if self.gossip.join(tuple(seed)):
+                                return
+                        logger.warning(
+                            "gossip: no seed answered (%s); retrying in %.1fs",
+                            seeds, delay,
+                        )
+                        time.sleep(delay)
+                        delay = min(delay * 2, 10.0)
+
+                threading.Thread(target=_join, daemon=True).start()
         drain_n = int(self.config.get("batch_drain", 0))
         for i in range(num_workers):
             if drain_n > 1:
@@ -197,6 +300,12 @@ class Server:
 
     def stop(self):
         self._running = False
+        if self.gossip is not None:
+            try:
+                self.gossip.leave()
+            except Exception:
+                pass
+            self.gossip.stop()
         for w in self.workers:
             w.stop()
         self.workers = []
@@ -245,6 +354,7 @@ class Server:
         self._reaper.start()
         self._gc_scheduler = threading.Thread(target=self._schedule_core_gc, daemon=True)
         self._gc_scheduler.start()
+        self._reconcile_gossip_members()
         logger.info("server %s: leadership established", self.raft.node_id)
 
     def _revoke_leadership(self):

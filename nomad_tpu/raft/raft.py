@@ -280,6 +280,12 @@ class Raft:
                     return
                 remaining = timeout - (time.monotonic() - self._last_contact)
                 if remaining <= 0:
+                    if self.node_id not in self.voters:
+                        # non-voting joiner (gossip auto-discovery): wait to
+                        # be added by the leader via a CONFIG entry instead
+                        # of standing for election as a one-node cluster
+                        self._last_contact = time.monotonic()
+                        continue
                     # no heartbeat: stand for election
                     self.role = CANDIDATE
                     return
@@ -404,7 +410,9 @@ class Raft:
                     self.role != LEADER
                     or self._leadership_epoch != epoch
                     or self._shutdown
+                    or peer_id not in self.voters  # removed by remove_voter
                 ):
+                    self._replicators.pop(peer_id, None)
                     return
                 term = self.current_term
                 next_idx = self._next_index.get(peer_id, 1)
@@ -655,6 +663,31 @@ class Raft:
             self.voters = voters
             self._futures[index] = fut
         self._kick_replicators_new_peer()
+        self._maybe_advance_commit()
+        fut.wait(timeout)
+
+    def remove_voter(self, node_id: str, timeout: float = 5.0):
+        """Single-server membership removal via a CONFIG entry (the
+        dead-server cleanup autopilot performs in the reference)."""
+        fut = _Future()
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_address(), self.leader_id)
+            if node_id not in self.voters:
+                return
+            voters = dict(self.voters)
+            del voters[node_id]
+            index = self.log.last_index() + 1
+            entry = LogEntry(
+                index=index, term=self.current_term, etype=CONFIG,
+                data={"voters": voters},
+            )
+            self.log.store_entries([entry])
+            self.voters = voters
+            self._futures[index] = fut
+        # wake every replicator: the removed peer's loop observes its
+        # eviction and exits instead of retrying a dead address forever
+        self._kick_replicators()
         self._maybe_advance_commit()
         fut.wait(timeout)
 

@@ -155,12 +155,22 @@ def _diff_lists(name: str, old: list, new: list) -> list[dict]:
                 )
         return out
 
-    old_by = {}
-    for i, v in enumerate(old):
-        old_by[_object_name(v, f"{name}[{i}]")] = v
-    new_by = {}
-    for i, v in enumerate(new):
-        new_by[_object_name(v, f"{name}[{i}]")] = v
+    def keyed(items):
+        # duplicate display names (e.g. two constraints on one l_target)
+        # get positional suffixes so neither is silently dropped; the
+        # suffix order pairs k-th duplicate with k-th duplicate
+        out = {}
+        for i, v in enumerate(items):
+            key = _object_name(v, f"{name}[{i}]")
+            base, n = key, 2
+            while key in out:
+                key = f"{base} #{n}"
+                n += 1
+            out[key] = v
+        return out
+
+    old_by = keyed(old)
+    new_by = keyed(new)
     for key in sorted(set(old_by) | set(new_by), key=str):
         d = diff_objects(f"{name} ({key})" if key else name, old_by.get(key), new_by.get(key))
         if d:

@@ -80,6 +80,24 @@ class TestJobDiff:
             for f in o["Fields"]
         )
 
+    def test_duplicate_named_constraints_not_dropped(self):
+        """Two constraints sharing an l_target must both survive the diff
+        (pairing is positional among duplicates)."""
+        old = simple_job()
+        old.constraints = [
+            Constraint(l_target="${attr.kernel.version}", r_target="3.0", operand=">="),
+            Constraint(l_target="${attr.kernel.version}", r_target="5.0", operand="<"),
+        ]
+        new = old.copy()
+        new.constraints = new.constraints[:1]  # drop the '<' constraint
+        d = job_diff(old, new)
+        deleted = [o for o in d["Objects"] if o["Type"] == "Deleted"]
+        assert len(deleted) == 1
+        assert any(
+            f["Name"] == "r_target" and f["Old"] == "5.0"
+            for f in deleted[0]["Fields"]
+        )
+
     def test_removed_group_is_deleted(self):
         old = simple_job()
         new = old.copy()
