@@ -409,52 +409,76 @@ class Client:
         self._update_lock = threading.Lock()
         self._pending_updates: dict[str, Allocation] = {}
         self._heartbeat_ttl = 30.0
+        #: seconds between driver/storage re-fingerprints
+        self.fingerprint_interval = 30.0
 
     # ------------------------------------------------------------------
     def fingerprint(self) -> Node:
-        """Host fingerprinting (ref client/fingerprint/): arch, cpu, memory,
-        drivers. TPU devices are fingerprinted by the device manager phase."""
-        try:
-            cpu_count = os.cpu_count() or 1
-        except Exception:
-            cpu_count = 1
+        """Host fingerprinting (ref client/fingerprint/ +
+        fingerprint_manager.go): real cpu/memory/storage/network detection,
+        driver health, and device plugins, merged into the node."""
+        from . import fingerprint as fp_mod
+
+        cpu = fp_mod.cpu_fingerprint()
+        memory_mb = fp_mod.memory_fingerprint()
+        disk_total, disk_free = fp_mod.storage_fingerprint(self.data_dir)
+        host = fp_mod.host_fingerprint()
+        networks = fp_mod.network_fingerprint()
+
         node = Node(
             id=generate_uuid(),
-            name=platform.node() or "client",
+            name=host["hostname"],
             datacenter="dc1",
             attributes={
-                "kernel.name": platform.system().lower(),
-                "arch": platform.machine(),
+                "kernel.name": host["kernel.name"],
+                "kernel.version": host["kernel.version"],
+                "os.name": host["os.name"],
+                "arch": host["arch"],
                 "nomad.version": "0.1.0",
-                "cpu.numcores": str(cpu_count),
+                "cpu.numcores": str(cpu["cores"]),
+                "cpu.frequency": str(int(cpu["mhz"])),
+                "cpu.totalcompute": str(cpu["total_compute"]),
+                "memory.totalbytes": str(memory_mb * 1024 * 1024),
+                "unique.storage.volume": self.data_dir,
+                "unique.storage.bytestotal": str(disk_total * 1024 * 1024),
+                "unique.storage.bytesfree": str(disk_free * 1024 * 1024),
             },
             node_resources=NodeResources(
-                cpu=NodeCpuResources(cpu_shares=cpu_count * 1000),
-                memory=NodeMemoryResources(memory_mb=8192),
-                disk=NodeDiskResources(disk_mb=20 * 1024),
-                # network fingerprint (ref client/fingerprint/network.go):
-                # loopback with a nominal gbit link for port allocation
-                networks=[
-                    NetworkResource(
-                        device="lo",
-                        cidr="127.0.0.1/32",
-                        ip="127.0.0.1",
-                        mbits=1000,
-                    )
-                ],
+                cpu=NodeCpuResources(cpu_shares=cpu["total_compute"]),
+                memory=NodeMemoryResources(memory_mb=memory_mb),
+                disk=NodeDiskResources(disk_mb=disk_free),
+                networks=networks,
             ),
             status="initializing",
         )
-        for name, driver in self.drivers.items():
-            fp = driver.fingerprint()
-            node.drivers[name] = DriverInfo(
-                detected=fp["detected"], healthy=fp["healthy"]
-            )
-            node.attributes[f"driver.{name}"] = "1"
+        self._fingerprint_drivers(node)
         # device plugins: TPU chips → node device groups (SURVEY §2.6)
         self.device_manager.fingerprint_node(node)
         compute_class(node)
         return node
+
+    def _fingerprint_drivers(self, node: Node) -> bool:
+        """(Re-)run driver fingerprints into the node; True when any
+        driver's health changed (ref drivermanager health re-checks)."""
+        changed = False
+        for name, driver in self.drivers.items():
+            try:
+                fp = driver.fingerprint()
+            except Exception:
+                logger.exception("driver %s fingerprint failed", name)
+                fp = {"detected": False, "healthy": False}
+            prev = node.drivers.get(name)
+            if (
+                prev is None
+                or prev.detected != fp["detected"]
+                or prev.healthy != fp["healthy"]
+            ):
+                changed = True
+            node.drivers[name] = DriverInfo(
+                detected=fp["detected"], healthy=fp["healthy"]
+            )
+            node.attributes[f"driver.{name}"] = "1"
+        return changed
 
     # ------------------------------------------------------------------
     def start(self):
@@ -469,7 +493,16 @@ class Client:
         resp = self.server.node_register(self.node)
         self._heartbeat_ttl = resp.get("heartbeat_ttl", 30.0)
         self.server.node_update_status(self.node.id, "ready")
-        for target in (self._heartbeat_loop, self._watch_allocations, self._update_loop):
+        # track our own status: re-registrations (periodic re-fingerprint)
+        # send the full node, and upsert preserves drain but NOT status — a
+        # stale 'initializing' would knock the node out of scheduling
+        self.node.status = "ready"
+        for target in (
+            self._heartbeat_loop,
+            self._watch_allocations,
+            self._update_loop,
+            self._fingerprint_loop,
+        ):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -562,6 +595,29 @@ class Client:
                     driver.stop_task(handle)
             except Exception:
                 logger.exception("orphan kill failed")
+
+    def _fingerprint_loop(self):
+        """Periodic re-fingerprint (ref fingerprint_manager.go: drivers and
+        volatile fingerprints re-run on an interval; changes re-register
+        the node so the scheduler sees current health/capacity)."""
+        interval = self.fingerprint_interval
+        while not self._stop.is_set():
+            if self._stop.wait(interval):
+                return
+            try:
+                from . import fingerprint as fp_mod
+
+                changed = self._fingerprint_drivers(self.node)
+                _, disk_free = fp_mod.storage_fingerprint(self.data_dir)
+                free_attr = str(disk_free * 1024 * 1024)
+                if self.node.attributes.get("unique.storage.bytesfree") != free_attr:
+                    self.node.attributes["unique.storage.bytesfree"] = free_attr
+                    changed = True
+                if changed:
+                    compute_class(self.node)
+                    self.server.node_register(self.node)
+            except Exception:
+                logger.exception("re-fingerprint failed")
 
     def _heartbeat_loop(self):
         """ref client.go:1421 registerAndHeartbeat"""

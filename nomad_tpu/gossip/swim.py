@@ -298,6 +298,10 @@ class Gossip:
                 old_status = current.status
                 current.incarnation = incoming.incarnation
                 current.tags = incoming.tags
+                # a member that restarted and rebound carries a new
+                # endpoint; adopt it or probes flap at the dead address
+                current.host = incoming.host
+                current.port = incoming.port
                 if incoming.status != old_status:
                     current.status = incoming.status
                     current.status_time = time.monotonic()

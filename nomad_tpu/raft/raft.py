@@ -413,6 +413,7 @@ class Raft:
                     or peer_id not in self.voters  # removed by remove_voter
                 ):
                     self._replicators.pop(peer_id, None)
+                    self._repl_conds.pop(peer_id, None)
                     return
                 term = self.current_term
                 next_idx = self._next_index.get(peer_id, 1)

@@ -1,0 +1,108 @@
+"""Real host fingerprinting (ref client/fingerprint/fingerprint.go:31-50,
+fingerprint_manager.go periodic re-fingerprint)."""
+
+import os
+import re
+import time
+
+from nomad_tpu.client import fingerprint as fp
+
+
+class TestFingerprinters:
+    def test_cpu_matches_host(self):
+        cpu = fp.cpu_fingerprint()
+        assert cpu["cores"] == os.cpu_count()
+        assert cpu["mhz"] > 0
+        assert cpu["total_compute"] >= cpu["cores"]
+
+    def test_memory_matches_proc_meminfo(self):
+        mb = fp.memory_fingerprint()
+        with open("/proc/meminfo") as f:
+            expected = int(re.search(r"MemTotal:\s*(\d+)", f.read()).group(1)) // 1024
+        assert mb == expected
+        assert mb > 0
+
+    def test_storage_matches_statvfs(self, tmp_path):
+        total, free = fp.storage_fingerprint(str(tmp_path))
+        st = os.statvfs(str(tmp_path))
+        assert total == st.f_blocks * st.f_frsize // (1024 * 1024)
+        assert 0 < free <= total
+
+    def test_host_identity(self):
+        host = fp.host_fingerprint()
+        assert host["kernel.name"] == "linux"
+        assert host["kernel.version"]
+        assert host["arch"]
+
+    def test_network_has_usable_link(self):
+        nets = fp.network_fingerprint()
+        assert nets and nets[0].ip
+        assert nets[0].mbits > 0
+
+
+class TestClientFingerprint:
+    def test_node_reflects_real_host(self, tmp_path):
+        from nomad_tpu.client.client import Client
+
+        class NullServer:
+            pass
+
+        c = Client(NullServer(), data_dir=str(tmp_path))
+        node = c.node
+        mem = fp.memory_fingerprint()
+        assert node.node_resources.memory.memory_mb == mem
+        assert node.node_resources.cpu.cpu_shares == fp.cpu_fingerprint()["total_compute"]
+        assert int(node.attributes["cpu.numcores"]) == os.cpu_count()
+        assert node.attributes["kernel.version"]
+        # disk advertises the real free space of the data dir's volume
+        _, free = fp.storage_fingerprint(str(tmp_path))
+        assert abs(node.node_resources.disk.disk_mb - free) < 1024
+
+    def test_driver_health_change_triggers_reregister(self, tmp_path):
+        from nomad_tpu.client.client import Client
+        from nomad_tpu.client.driver import MockDriver
+
+        registrations = []
+
+        class RecordingServer:
+            def node_register(self, node):
+                registrations.append(node.drivers["mock_driver"].healthy)
+                return {"heartbeat_ttl": 600.0}
+
+            def node_update_status(self, node_id, status):
+                return {}
+
+            def get_client_allocs(self, node_id, min_index=0, timeout=0.5):
+                time.sleep(timeout)
+                return [], min_index
+
+            def node_heartbeat(self, node_id):
+                return {}
+
+            def update_allocs(self, updates):
+                return {}
+
+        flaky = MockDriver()
+        healthy = {"value": True}
+        flaky.fingerprint = lambda: {
+            "detected": True,
+            "healthy": healthy["value"],
+            "attributes": {},
+        }
+        c = Client(
+            RecordingServer(),
+            data_dir=str(tmp_path),
+            drivers={"mock_driver": flaky},
+        )
+        c.fingerprint_interval = 0.2
+        c.start()
+        try:
+            healthy["value"] = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if False in registrations:
+                    break
+                time.sleep(0.05)
+            assert False in registrations, "health change must re-register"
+        finally:
+            c.stop()
