@@ -25,13 +25,20 @@ class APIError(Exception):
 class ApiClient:
     """ref api/api.go Client"""
 
-    def __init__(self, address: Optional[str] = None, namespace: str = "default"):
+    def __init__(
+        self,
+        address: Optional[str] = None,
+        namespace: str = "default",
+        token: Optional[str] = None,
+    ):
         self.address = (
             address
             or os.environ.get("NOMAD_TPU_ADDR")
             or "http://127.0.0.1:4646"
         ).rstrip("/")
         self.namespace = namespace
+        # bearer secret sent as X-Nomad-Token (ref api.Client SecretID)
+        self.token = token or os.environ.get("NOMAD_TPU_TOKEN") or ""
 
     def _request(self, method: str, path: str, params=None, body=None):
         url = self.address + path
@@ -40,6 +47,8 @@ class ApiClient:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
         try:
             with urllib.request.urlopen(req, timeout=330) as resp:
                 payload = json.loads(resp.read() or b"null")

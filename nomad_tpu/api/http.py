@@ -17,7 +17,40 @@ from urllib.parse import parse_qs, unquote, urlparse
 from ..jobspec.hcl import parse_duration
 from ..structs.model import Allocation, Job
 
-_ROUTES: list[tuple[str, re.Pattern, str]] = []
+_ROUTES: list[tuple[str, re.Pattern, str, object]] = []
+
+# route ACL specs (ref nomad/acl.go per-endpoint checks; http routes carry
+# the capability they require): "anonymous" = open, "ns:<capability>" =
+# namespace capability from the request's namespace, "node:read|write",
+# "agent:read|write", "operator:read|write"; None = management-only (the
+# safe default for unannotated routes when ACLs are enabled)
+
+
+def _acl_allows(acl, spec, query) -> bool:
+    if spec == "anonymous":
+        return True
+    if acl is None:
+        return False
+    if acl.management:
+        return True
+    if spec is None:
+        return False
+    if callable(spec):
+        return bool(spec(acl, query))
+    if spec.startswith("ns:"):
+        ns = query.get("namespace", "default")
+        return acl.allow_namespace_operation(ns, spec[3:])
+    domain, _, level = spec.partition(":")
+    checks = {
+        ("node", "read"): lambda: acl.allow_node_read(),
+        ("node", "write"): lambda: acl.allow_node_write(),
+        ("agent", "read"): lambda: acl.allow_agent_read(),
+        ("agent", "write"): lambda: acl.allow_agent_write(),
+        ("operator", "read"): lambda: acl.allow_operator_read(),
+        ("operator", "write"): lambda: acl.allow_operator_write(),
+    }
+    check = checks.get((domain, level))
+    return bool(check and check())
 
 
 class _DecodedMatch:
@@ -39,11 +72,11 @@ class _DecodedMatch:
         return unquote(g) if g else g
 
 
-def route(method: str, pattern: str):
+def route(method: str, pattern: str, acl=None):
     compiled = re.compile("^" + pattern + "$")
 
     def deco(fn):
-        _ROUTES.append((method, compiled, fn.__name__))
+        _ROUTES.append((method, compiled, fn.__name__, acl))
         return fn
 
     return deco
@@ -80,11 +113,28 @@ class HTTPServer:
                         body = json.loads(raw)
                     except json.JSONDecodeError:
                         body = raw.decode()
-                for m, pattern, name in _ROUTES:
+                for m, pattern, name, acl_spec in _ROUTES:
                     if m != method:
                         continue
                     match = pattern.match(parsed.path)
                     if match:
+                        server = api.server
+                        acl_obj = None
+                        if server is not None and server.acl_enabled():
+                            secret = self.headers.get("X-Nomad-Token", "")
+                            try:
+                                acl_obj = server.resolve_token(secret)
+                            except PermissionError as e:
+                                self._respond(403, {"error": str(e)}, None)
+                                return
+                            if not _acl_allows(acl_obj, acl_spec, query):
+                                self._respond(
+                                    403, {"error": "Permission denied"}, None
+                                )
+                                return
+                        # reserved key: handlers needing finer-grained
+                        # checks (search's per-context filtering) read it
+                        query["__acl__"] = acl_obj
                         try:
                             result, index = getattr(api, name)(
                                 _DecodedMatch(match), query, body
@@ -92,6 +142,8 @@ class HTTPServer:
                             self._respond(200, result, index)
                         except KeyError as e:
                             self._respond(404, {"error": str(e)}, None)
+                        except PermissionError as e:
+                            self._respond(403, {"error": str(e)}, None)
                         except ValueError as e:
                             self._respond(400, {"error": str(e)}, None)
                         except Exception as e:
@@ -149,7 +201,7 @@ class HTTPServer:
         return run(snap), snap.latest_index()
 
     # -- jobs ----------------------------------------------------------
-    @route("GET", r"/v1/jobs")
+    @route("GET", r"/v1/jobs", acl="ns:list-jobs")
     def list_jobs(self, m, query, body):
         prefix = query.get("prefix", "")
 
@@ -169,7 +221,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("PUT", r"/v1/jobs")
+    @route("PUT", r"/v1/jobs", acl="ns:submit-job")
     def register_job(self, m, query, body):
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
@@ -177,7 +229,7 @@ class HTTPServer:
         eval_id = self.server.job_register(job)
         return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)", acl="ns:read-job")
     def get_job(self, m, query, body):
         def run(snap):
             job = snap.job_by_id(query.get("namespace", "default"), m["job_id"])
@@ -187,7 +239,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("DELETE", r"/v1/job/(?P<job_id>[^/]+)")
+    @route("DELETE", r"/v1/job/(?P<job_id>[^/]+)", acl="ns:submit-job")
     def deregister_job(self, m, query, body):
         purge = query.get("purge", "false") == "true"
         eval_id = self.server.job_deregister(
@@ -195,7 +247,7 @@ class HTTPServer:
         )
         return {"EvalID": eval_id}, None
 
-    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/plan")
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/plan", acl="ns:submit-job")
     def plan_job(self, m, query, body):
         """Dry-run: annotated placement plan + structural diff, no state
         mutation (ref job_endpoint.go Plan, command/job_plan.go)."""
@@ -210,7 +262,7 @@ class HTTPServer:
             "JobModifyIndex": result["job_modify_index"],
         }, None
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/allocations")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/allocations", acl="ns:read-job")
     def job_allocations(self, m, query, body):
         def run(snap):
             return [
@@ -222,7 +274,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/evaluations")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/evaluations", acl="ns:read-job")
     def job_evaluations(self, m, query, body):
         def run(snap):
             return [
@@ -234,7 +286,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/summary")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/summary", acl="ns:read-job")
     def job_summary(self, m, query, body):
         def run(snap):
             s = snap.job_summary_by_id(query.get("namespace", "default"), m["job_id"])
@@ -244,7 +296,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/deployments")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/deployments", acl="ns:read-job")
     def job_deployments(self, m, query, body):
         def run(snap):
             return [
@@ -256,7 +308,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/versions")
+    @route("GET", r"/v1/job/(?P<job_id>[^/]+)/versions", acl="ns:read-job")
     def job_versions(self, m, query, body):
         def run(snap):
             return [
@@ -269,7 +321,7 @@ class HTTPServer:
         return self._blocking(query, run)
 
     # -- nodes ----------------------------------------------------------
-    @route("GET", r"/v1/nodes")
+    @route("GET", r"/v1/nodes", acl="node:read")
     def list_nodes(self, m, query, body):
         def run(snap):
             return [
@@ -287,7 +339,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/node/(?P<node_id>[^/]+)")
+    @route("GET", r"/v1/node/(?P<node_id>[^/]+)", acl="node:read")
     def get_node(self, m, query, body):
         def run(snap):
             node = snap.node_by_id(m["node_id"]) or next(
@@ -299,14 +351,14 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/node/(?P<node_id>[^/]+)/allocations")
+    @route("GET", r"/v1/node/(?P<node_id>[^/]+)/allocations", acl="node:read")
     def node_allocations(self, m, query, body):
         def run(snap):
             return [_alloc_stub(a) for a in snap.allocs_by_node(m["node_id"])]
 
         return self._blocking(query, run)
 
-    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/drain")
+    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/drain", acl="node:write")
     def node_drain(self, m, query, body):
         body = body or {}
         spec = body.get("DrainSpec")
@@ -325,14 +377,14 @@ class HTTPServer:
             )
         return {"NodeModifyIndex": self.server.state.latest_index()}, None
 
-    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/eligibility")
+    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/eligibility", acl="node:write")
     def node_eligibility(self, m, query, body):
         elig = (body or {}).get("Eligibility", "eligible")
         self.server.node_update_eligibility(m["node_id"], elig)
         return {"NodeModifyIndex": self.server.state.latest_index()}, None
 
     # -- allocations -----------------------------------------------------
-    @route("GET", r"/v1/allocations")
+    @route("GET", r"/v1/allocations", acl="ns:read-job")
     def list_allocations(self, m, query, body):
         prefix = query.get("prefix", "")
 
@@ -343,7 +395,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/allocation/(?P<alloc_id>[^/]+)")
+    @route("GET", r"/v1/allocation/(?P<alloc_id>[^/]+)", acl="ns:read-job")
     def get_allocation(self, m, query, body):
         def run(snap):
             alloc = snap.alloc_by_id(m["alloc_id"])
@@ -359,14 +411,14 @@ class HTTPServer:
         return self._blocking(query, run)
 
     # -- evaluations -----------------------------------------------------
-    @route("GET", r"/v1/evaluations")
+    @route("GET", r"/v1/evaluations", acl="ns:read-job")
     def list_evaluations(self, m, query, body):
         def run(snap):
             return [e.to_dict() for e in snap.evals()]
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/evaluation/(?P<eval_id>[^/]+)")
+    @route("GET", r"/v1/evaluation/(?P<eval_id>[^/]+)", acl="ns:read-job")
     def get_evaluation(self, m, query, body):
         def run(snap):
             ev = snap.eval_by_id(m["eval_id"])
@@ -381,14 +433,14 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/deployments")
+    @route("GET", r"/v1/deployments", acl="ns:read-job")
     def list_deployments(self, m, query, body):
         def run(snap):
             return [d.to_dict() for d in snap.deployments()]
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/deployment/(?P<deploy_id>[^/]+)")
+    @route("GET", r"/v1/deployment/(?P<deploy_id>[^/]+)", acl="ns:read-job")
     def get_deployment(self, m, query, body):
         def run(snap):
             d = snap.deployment_by_id(m["deploy_id"])
@@ -406,7 +458,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("GET", r"/v1/deployment/allocations/(?P<deploy_id>[^/]+)")
+    @route("GET", r"/v1/deployment/allocations/(?P<deploy_id>[^/]+)", acl="ns:read-job")
     def deployment_allocations(self, m, query, body):
         def run(snap):
             return [
@@ -415,7 +467,7 @@ class HTTPServer:
 
         return self._blocking(query, run)
 
-    @route("PUT", r"/v1/deployment/promote/(?P<deploy_id>[^/]+)")
+    @route("PUT", r"/v1/deployment/promote/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_promote(self, m, query, body):
         body = body or {}
         self.server.deployment_promote(
@@ -425,18 +477,18 @@ class HTTPServer:
         )
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
-    @route("PUT", r"/v1/deployment/fail/(?P<deploy_id>[^/]+)")
+    @route("PUT", r"/v1/deployment/fail/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_fail(self, m, query, body):
         self.server.deployment_fail(m["deploy_id"])
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
-    @route("PUT", r"/v1/deployment/pause/(?P<deploy_id>[^/]+)")
+    @route("PUT", r"/v1/deployment/pause/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_pause(self, m, query, body):
         pause = bool((body or {}).get("Pause", True))
         self.server.deployment_pause(m["deploy_id"], pause)
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
-    @route("PUT", r"/v1/deployment/allocation-health/(?P<deploy_id>[^/]+)")
+    @route("PUT", r"/v1/deployment/allocation-health/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_alloc_health(self, m, query, body):
         body = body or {}
         self.server.deployment_set_alloc_health(
@@ -446,7 +498,7 @@ class HTTPServer:
         )
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
-    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/dispatch")
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/dispatch", acl="ns:dispatch-job")
     def job_dispatch(self, m, query, body):
         body = body or {}
         import base64 as _b64
@@ -465,14 +517,14 @@ class HTTPServer:
         )
         return out, None
 
-    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/periodic/force")
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/periodic/force", acl="ns:submit-job")
     def job_periodic_force(self, m, query, body):
         child_id = self.server.periodic_force(
             query.get("namespace", "default"), m["job_id"]
         )
         return {"DispatchedJobID": child_id}, None
 
-    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/revert")
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/revert", acl="ns:submit-job")
     def job_revert(self, m, query, body):
         body = body or {}
         eval_id = self.server.job_revert(
@@ -484,7 +536,7 @@ class HTTPServer:
         return {"EvalID": eval_id}, None
 
     # -- agent / status --------------------------------------------------
-    @route("GET", r"/v1/agent/self")
+    @route("GET", r"/v1/agent/self", acl="agent:read")
     def agent_self(self, m, query, body):
         clients = []
         if self.agent is not None:
@@ -502,11 +554,11 @@ class HTTPServer:
             None,
         )
 
-    @route("GET", r"/v1/status/leader")
+    @route("GET", r"/v1/status/leader", acl="anonymous")
     def status_leader(self, m, query, body):
         return f"{self.host}:{self.port}", None
 
-    @route("GET", r"/v1/metrics")
+    @route("GET", r"/v1/metrics", acl="agent:read")
     def metrics(self, m, query, body):
         from ..tpu import batch_sched
         from ..tpu import drain as drain_mod
@@ -525,18 +577,103 @@ class HTTPServer:
             None,
         )
 
-    @route("PUT", r"/v1/system/gc")
+    @route("PUT", r"/v1/system/gc", acl="operator:write")
     def system_gc(self, m, query, body):
         """Force-GC all eligible terminal objects
         (ref system_endpoint.go GarbageCollect)."""
         self.server.system_gc()
         return {}, None
 
-    @route("GET", r"/v1/operator/scheduler/configuration")
+    # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------
+    @route("PUT", r"/v1/acl/bootstrap", acl="anonymous")
+    def acl_bootstrap(self, m, query, body):
+        token = self.server.acl_bootstrap()
+        return _acl_token_dict(token), None
+
+    @route("GET", r"/v1/acl/policies")
+    def acl_list_policies(self, m, query, body):
+        return [
+            {"Name": p.name, "Description": p.description}
+            for p in self.server.state.acl_policies()
+        ], self.server.state.latest_index()
+
+    @route("GET", r"/v1/acl/policy/(?P<name>[^/]+)")
+    def acl_get_policy(self, m, query, body):
+        p = self.server.state.acl_policy_by_name(m["name"])
+        if p is None:
+            raise KeyError(f"policy not found: {m['name']}")
+        return {
+            "Name": p.name,
+            "Description": p.description,
+            "Rules": p.rules,
+        }, None
+
+    @route("PUT", r"/v1/acl/policy/(?P<name>[^/]+)")
+    def acl_put_policy(self, m, query, body):
+        from ..structs.model import AclPolicy
+
+        body = body or {}
+        policy = AclPolicy(
+            name=m["name"],
+            description=body.get("Description", ""),
+            rules=body.get("Rules", ""),
+        )
+        self.server.acl_upsert_policies([policy])
+        return {}, None
+
+    @route("DELETE", r"/v1/acl/policy/(?P<name>[^/]+)")
+    def acl_delete_policy(self, m, query, body):
+        self.server.acl_delete_policies([m["name"]])
+        return {}, None
+
+    @route("GET", r"/v1/acl/tokens")
+    def acl_list_tokens(self, m, query, body):
+        return [
+            {
+                "AccessorID": t.accessor_id,
+                "Name": t.name,
+                "Type": t.type,
+                "Policies": list(t.policies),
+            }
+            for t in self.server.state.acl_tokens()
+        ], self.server.state.latest_index()
+
+    @route("PUT", r"/v1/acl/token")
+    def acl_create_token(self, m, query, body):
+        from ..structs.model import AclToken
+
+        body = body or {}
+        token = AclToken(
+            name=body.get("Name", ""),
+            type=body.get("Type", "client"),
+            policies=list(body.get("Policies", [])),
+            global_token=bool(body.get("Global", False)),
+        )
+        token = self.server.acl_create_token(token)
+        return _acl_token_dict(token), None
+
+    @route("DELETE", r"/v1/acl/token/(?P<accessor>[^/]+)")
+    def acl_delete_token(self, m, query, body):
+        self.server.acl_delete_tokens([m["accessor"]])
+        return {}, None
+
+    # -- search (ref search_endpoint.go) ---------------------------------
+    @route("PUT", r"/v1/search", acl="ns:read-job")
+    def search(self, m, query, body):
+        body = body or {}
+        acl = query.get("__acl__")
+        return self.server.search(
+            prefix=body.get("Prefix", ""),
+            context=(body.get("Context") or "all"),
+            namespace=query.get("namespace", "default"),
+            include_nodes=acl is None or acl.allow_node_read(),
+        ), self.server.state.latest_index()
+
+    @route("GET", r"/v1/operator/scheduler/configuration", acl="operator:read")
     def get_scheduler_config(self, m, query, body):
         return self.server.state.scheduler_config() or {}, None
 
-    @route("PUT", r"/v1/operator/scheduler/configuration")
+    @route("PUT", r"/v1/operator/scheduler/configuration", acl="operator:write")
     def set_scheduler_config(self, m, query, body):
         # Must replicate via raft like every other write (ref
         # operator_endpoint.go SchedulerSetConfiguration → raftApply):
@@ -546,6 +683,17 @@ class HTTPServer:
 
         self.server._apply(fsm_mod.SCHEDULER_CONFIG, {"config": body or {}})
         return {"Updated": True}, None
+
+
+def _acl_token_dict(t) -> dict:
+    return {
+        "AccessorID": t.accessor_id,
+        "SecretID": t.secret_id,
+        "Name": t.name,
+        "Type": t.type,
+        "Policies": list(t.policies),
+        "Global": t.global_token,
+    }
 
 
 def _alloc_stub(a: Allocation) -> dict:

@@ -609,9 +609,14 @@ class Client:
 
                 changed = self._fingerprint_drivers(self.node)
                 _, disk_free = fp_mod.storage_fingerprint(self.data_dir)
-                free_attr = str(disk_free * 1024 * 1024)
-                if self.node.attributes.get("unique.storage.bytesfree") != free_attr:
-                    self.node.attributes["unique.storage.bytesfree"] = free_attr
+                current = self.node.node_resources.disk.disk_mb
+                # hysteresis: free space jitters constantly; re-advertise
+                # only when it moves enough to matter for bin-packing
+                if abs(disk_free - current) > max(1024, current // 20):
+                    self.node.node_resources.disk.disk_mb = disk_free
+                    self.node.attributes["unique.storage.bytesfree"] = str(
+                        disk_free * 1024 * 1024
+                    )
                     changed = True
                 if changed:
                     compute_class(self.node)

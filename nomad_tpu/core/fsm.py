@@ -418,8 +418,9 @@ class FSM:
         return index
 
     def _apply_acl_token_upsert(self, index: int, payload: dict):
-        if hasattr(self.state, "upsert_acl_tokens"):
-            self.state.upsert_acl_tokens(index, payload["tokens"])
+        self.state.upsert_acl_tokens(
+            index, payload["tokens"], bootstrap=payload.get("bootstrap", False)
+        )
         return index
 
     def _apply_acl_token_delete(self, index: int, payload: dict):

@@ -99,6 +99,8 @@ class Server:
         self._leader_cond = threading.Condition()
         self._reaper: Optional[threading.Thread] = None
         self._gc_scheduler: Optional[threading.Thread] = None
+        # secret → compiled ACL, invalidated by acl table indexes in the key
+        self._acl_cache: dict = {}
 
         DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
         NodeDrainer(self)  # installs itself as self.drainer
@@ -457,6 +459,151 @@ class Server:
                         core_job_eval(job, self.state.latest_index())
                     )
             time.sleep(min(1.0, min(iv for iv in intervals.values())))
+
+    # ------------------------------------------------------------------
+    # ACL endpoints (ref nomad/acl_endpoint.go + nomad/acl.go)
+    # ------------------------------------------------------------------
+    def acl_enabled(self) -> bool:
+        return bool(self.config.get("acl", {}).get("enabled"))
+
+    def resolve_token(self, secret: str):
+        """secret → compiled ACL (ref acl.go ResolveToken, with the
+        reference's resolution cache). With ACLs off, everything is allowed;
+        an empty secret is the anonymous ACL; an unknown secret is rejected.
+        Resolutions cache on (secret, token-table index, policy-table
+        index) so the hot path skips the token scan + policy parse until an
+        ACL write invalidates it."""
+        from ..acl import ACL_ANONYMOUS, ACL_MANAGEMENT, compile_acl, parse_policy
+        from ..structs.model import ACL_TOKEN_TYPE_MANAGEMENT
+
+        if not self.acl_enabled():
+            return ACL_MANAGEMENT
+        if not secret:
+            return ACL_ANONYMOUS
+        key = (
+            secret,
+            self.state.table_index("acl_token"),
+            self.state.table_index("acl_policy"),
+        )
+        cached = self._acl_cache.get(key)
+        if cached is not None:
+            return cached
+        token = self.state.acl_token_by_secret(secret)
+        if token is None:
+            raise PermissionError("ACL token not found")
+        if token.type == ACL_TOKEN_TYPE_MANAGEMENT:
+            acl = ACL_MANAGEMENT
+        else:
+            parsed = []
+            for name in token.policies:
+                policy = self.state.acl_policy_by_name(name)
+                if policy is not None:
+                    parsed.append(parse_policy(policy.rules))
+            acl = compile_acl(parsed)
+        if len(self._acl_cache) > 512:
+            self._acl_cache.clear()
+        self._acl_cache[key] = acl
+        return acl
+
+    def acl_bootstrap(self):
+        """One-shot creation of the initial management token
+        (ref acl_endpoint.go Bootstrap). Done-ness is a persisted index
+        marker, NOT the existence of a management token — deleting all
+        management tokens must not silently re-open anonymous bootstrap."""
+        from ..structs.model import ACL_TOKEN_TYPE_MANAGEMENT, AclToken
+
+        self._check_leader()
+        if self.state.table_index("acl_bootstrap"):
+            raise PermissionError("ACL bootstrap already done")
+        token = AclToken(
+            accessor_id=generate_uuid(),
+            secret_id=generate_uuid(),
+            name="Bootstrap Token",
+            type=ACL_TOKEN_TYPE_MANAGEMENT,
+            global_token=True,
+            create_time=now_ns(),
+        )
+        self._apply(
+            fsm_mod.ACL_TOKEN_UPSERT,
+            {"tokens": [token.to_dict()], "bootstrap": True},
+        )
+        return token
+
+    def acl_upsert_policies(self, policies: list):
+        from ..acl import parse_policy
+
+        self._check_leader()
+        for p in policies:
+            if not p.name:
+                raise ValueError("policy requires a name")
+            parse_policy(p.rules)  # validate before replicating
+        self._apply(
+            fsm_mod.ACL_POLICY_UPSERT,
+            {"policies": [p.to_dict() for p in policies]},
+        )
+
+    def acl_delete_policies(self, names: list[str]):
+        self._check_leader()
+        self._apply(fsm_mod.ACL_POLICY_DELETE, {"names": list(names)})
+
+    def acl_create_token(self, token):
+        from ..structs.model import ACL_TOKEN_TYPE_CLIENT, ACL_TOKEN_TYPE_MANAGEMENT
+
+        self._check_leader()
+        if token.type not in (ACL_TOKEN_TYPE_CLIENT, ACL_TOKEN_TYPE_MANAGEMENT):
+            raise ValueError(f"invalid token type {token.type!r}")
+        if token.type == ACL_TOKEN_TYPE_CLIENT and not token.policies:
+            raise ValueError("client token requires policies")
+        token.accessor_id = token.accessor_id or generate_uuid()
+        token.secret_id = token.secret_id or generate_uuid()
+        token.create_time = token.create_time or now_ns()
+        self._apply(fsm_mod.ACL_TOKEN_UPSERT, {"tokens": [token.to_dict()]})
+        return token
+
+    def acl_delete_tokens(self, accessors: list[str]):
+        self._check_leader()
+        self._apply(fsm_mod.ACL_TOKEN_DELETE, {"accessors": list(accessors)})
+
+    # ------------------------------------------------------------------
+    # Search (ref nomad/search_endpoint.go: prefix matches across tables,
+    # truncated at 20 per context)
+    # ------------------------------------------------------------------
+    def search(
+        self,
+        prefix: str,
+        context: str = "all",
+        namespace: str = "default",
+        include_nodes: bool = True,
+    ) -> dict:
+        """Results are scoped to the request namespace (jobs/evals/allocs/
+        deployments), and nodes only appear for callers holding node:read —
+        matching the per-context ACL filtering of search_endpoint.go."""
+        snap = self.state.snapshot()
+        limit = 20
+        contexts: dict[str, list[str]] = {}
+        truncations: dict[str, bool] = {}
+
+        def collect(name: str, ids):
+            if context not in ("all", name):
+                return
+            matches = sorted(i for i in ids if i.startswith(prefix))
+            truncations[name] = len(matches) > limit
+            contexts[name] = matches[:limit]
+
+        collect("jobs", (j.id for j in snap.jobs() if j.namespace == namespace))
+        collect(
+            "evals", (e.id for e in snap.evals() if e.namespace == namespace)
+        )
+        collect(
+            "allocs", (a.id for a in snap.allocs() if a.namespace == namespace)
+        )
+        if include_nodes:
+            collect("nodes", (n.id for n in snap.nodes()))
+        collect(
+            "deployments",
+            (d.id for d in snap.deployments() if d.namespace == namespace),
+        )
+        return {"matches": contexts, "truncations": truncations}
 
     def _plan_token_live(self, plan) -> bool:
         """Dequeue-time re-validation of a plan's eval token (plans without

@@ -22,6 +22,8 @@ from ..structs.model import (
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_CLIENT_STATUS_LOST,
     ALLOC_CLIENT_STATUS_PENDING,
+    AclPolicy,
+    AclToken,
     ALLOC_CLIENT_STATUS_RUNNING,
     ALLOC_DESIRED_STATUS_EVICT,
     ALLOC_DESIRED_STATUS_STOP,
@@ -66,6 +68,8 @@ class Generation:
     deployments: dict[str, Deployment] = field(default_factory=dict)
     periodic_launch: dict[tuple[str, str], dict] = field(default_factory=dict)
     scheduler_config: Optional[dict] = None
+    acl_policies: dict[str, "AclPolicy"] = field(default_factory=dict)
+    acl_tokens: dict[str, "AclToken"] = field(default_factory=dict)  # by accessor
     table_indexes: dict[str, int] = field(default_factory=dict)
 
 
@@ -219,6 +223,25 @@ class StateReader:
     # -- config -----------------------------------------------------------
     def scheduler_config(self) -> Optional[dict]:
         return self._gen.scheduler_config
+
+    # -- acl --------------------------------------------------------------
+    def acl_policies(self) -> Iterable["AclPolicy"]:
+        return self._gen.acl_policies.values()
+
+    def acl_policy_by_name(self, name: str) -> Optional["AclPolicy"]:
+        return self._gen.acl_policies.get(name)
+
+    def acl_tokens(self) -> Iterable["AclToken"]:
+        return self._gen.acl_tokens.values()
+
+    def acl_token_by_accessor(self, accessor: str) -> Optional["AclToken"]:
+        return self._gen.acl_tokens.get(accessor)
+
+    def acl_token_by_secret(self, secret: str) -> Optional["AclToken"]:
+        for t in self._gen.acl_tokens.values():
+            if t.secret_id == secret:
+                return t
+        return None
 
     # -- ready nodes ------------------------------------------------------
     def ready_nodes_in_dcs(self, datacenters: list[str]) -> tuple[list[Node], dict[str, int]]:
@@ -1090,6 +1113,68 @@ class StateStore(StateReader):
         )
 
     @_write_txn
+    def upsert_acl_policies(self, index: int, policies: list):
+        """ref state_store.go UpsertACLPolicies"""
+        gen = self._gen
+        table = dict(gen.acl_policies)
+        for p in policies:
+            policy = AclPolicy.from_dict(p) if isinstance(p, dict) else p
+            existing = table.get(policy.name)
+            policy.create_index = (
+                existing.create_index if existing is not None else index
+            )
+            policy.modify_index = index
+            table[policy.name] = policy
+        self._publish(
+            index=index,
+            acl_policies=table,
+            table_indexes=self._bump(gen, index, "acl_policy"),
+        )
+
+    @_write_txn
+    def delete_acl_policies(self, index: int, names: list[str]):
+        gen = self._gen
+        table = {k: v for k, v in gen.acl_policies.items() if k not in set(names)}
+        self._publish(
+            index=index,
+            acl_policies=table,
+            table_indexes=self._bump(gen, index, "acl_policy"),
+        )
+
+    @_write_txn
+    def upsert_acl_tokens(self, index: int, tokens: list, bootstrap: bool = False):
+        """ref state_store.go UpsertACLTokens; ``bootstrap`` also stamps the
+        one-shot bootstrap marker (BootstrapACLTokens' index record)."""
+        gen = self._gen
+        table = dict(gen.acl_tokens)
+        for t in tokens:
+            token = AclToken.from_dict(t) if isinstance(t, dict) else t
+            existing = table.get(token.accessor_id)
+            token.create_index = (
+                existing.create_index if existing is not None else index
+            )
+            token.modify_index = index
+            table[token.accessor_id] = token
+        bumped = ("acl_token", "acl_bootstrap") if bootstrap else ("acl_token",)
+        self._publish(
+            index=index,
+            acl_tokens=table,
+            table_indexes=self._bump(gen, index, *bumped),
+        )
+
+    @_write_txn
+    def delete_acl_tokens(self, index: int, accessors: list[str]):
+        gen = self._gen
+        table = {
+            k: v for k, v in gen.acl_tokens.items() if k not in set(accessors)
+        }
+        self._publish(
+            index=index,
+            acl_tokens=table,
+            table_indexes=self._bump(gen, index, "acl_token"),
+        )
+
+    @_write_txn
     def set_scheduler_config(self, index: int, config: dict):
         gen = self._gen
         self._publish(
@@ -1184,6 +1269,8 @@ class StateStore(StateReader):
             "deployments": [d.to_dict() for d in gen.deployments.values()],
             "periodic_launch": list(gen.periodic_launch.values()),
             "scheduler_config": gen.scheduler_config,
+            "acl_policies": [p.to_dict() for p in gen.acl_policies.values()],
+            "acl_tokens": [t.to_dict() for t in gen.acl_tokens.values()],
             "table_indexes": dict(gen.table_indexes),
         }
 
@@ -1235,10 +1322,24 @@ class StateStore(StateReader):
                     for pl in data.get("periodic_launch", [])
                 },
                 scheduler_config=data.get("scheduler_config"),
+                acl_policies={
+                    p.name: p
+                    for p in (
+                        AclPolicy.from_dict(d)
+                        for d in data.get("acl_policies", [])
+                    )
+                },
+                acl_tokens={
+                    t.accessor_id: t
+                    for t in (
+                        AclToken.from_dict(d) for d in data.get("acl_tokens", [])
+                    )
+                },
                 table_indexes=dict(data.get("table_indexes", {})),
             )
             self._publish(**{f: getattr(gen, f) for f in (
                 "index", "nodes", "jobs", "job_versions", "job_summaries",
                 "evals", "allocs", "deployments", "periodic_launch",
-                "scheduler_config", "table_indexes",
+                "scheduler_config", "acl_policies", "acl_tokens",
+                "table_indexes",
             )})

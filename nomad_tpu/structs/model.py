@@ -1251,6 +1251,36 @@ class TaskGroupSummary(Base):
     lost: int = 0
 
 
+# ---------------------------------------------------------------------------
+# ACL (ref structs.go ACLPolicy :8850 / ACLToken :8950, acl/)
+# ---------------------------------------------------------------------------
+
+ACL_TOKEN_TYPE_CLIENT = "client"
+ACL_TOKEN_TYPE_MANAGEMENT = "management"
+
+
+@dataclass
+class AclPolicy(Base):
+    name: str = ""
+    description: str = ""
+    rules: str = ""  # HCL rules document (acl/policy.go format)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class AclToken(Base):
+    accessor_id: str = ""  # public identifier
+    secret_id: str = ""  # the bearer credential
+    name: str = ""
+    type: str = ACL_TOKEN_TYPE_CLIENT  # client | management
+    policies: list[str] = field(default_factory=list)
+    global_token: bool = False
+    create_time: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+
 @dataclass
 class JobSummary(Base):
     """Per-job rollup of alloc states by task group (ref structs.go JobSummary)."""

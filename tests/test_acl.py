@@ -1,0 +1,219 @@
+"""ACL system + Search endpoint (ref acl/policy.go, acl/acl.go,
+nomad/acl.go, acl_endpoint.go Bootstrap, search_endpoint.go)."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.acl import compile_acl, parse_policy
+from nomad_tpu.acl.policy import PolicyError
+from nomad_tpu.api.client import APIError, ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+class TestPolicyParse:
+    def test_coarse_expansion(self):
+        p = parse_policy('namespace "default" { policy = "read" }')
+        (ns,) = p.namespaces
+        assert ns.capabilities == {"list-jobs", "read-job"}
+        p = parse_policy('namespace "default" { policy = "write" }')
+        assert "submit-job" in p.namespaces[0].capabilities
+
+    def test_capabilities_and_domains(self):
+        p = parse_policy(
+            """
+namespace "ops-*" { capabilities = ["read-job", "submit-job"] }
+node { policy = "read" }
+operator { policy = "write" }
+"""
+        )
+        assert p.namespaces[0].name == "ops-*"
+        assert p.node == "read" and p.operator == "write"
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(PolicyError):
+            parse_policy('namespace "x" { policy = "root" }')
+
+
+class TestACLEval:
+    def test_namespace_glob_longest_match(self):
+        acl = compile_acl(
+            [
+                parse_policy('namespace "ops-*" { policy = "read" }'),
+                parse_policy('namespace "ops-prod-*" { policy = "write" }'),
+            ]
+        )
+        assert acl.allow_namespace_operation("ops-dev", "read-job")
+        assert not acl.allow_namespace_operation("ops-dev", "submit-job")
+        assert acl.allow_namespace_operation("ops-prod-1", "submit-job")
+        assert not acl.allow_namespace_operation("other", "read-job")
+
+    def test_deny_dominates(self):
+        acl = compile_acl(
+            [
+                parse_policy('namespace "default" { policy = "write" }'),
+                parse_policy('namespace "default" { policy = "deny" }'),
+            ]
+        )
+        assert not acl.allow_namespace_operation("default", "read-job")
+
+    def test_coarse_domains(self):
+        acl = compile_acl([parse_policy('node { policy = "read" }')])
+        assert acl.allow_node_read()
+        assert not acl.allow_node_write()
+        assert not acl.allow_operator_read()
+
+
+def make_acl_server():
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "acl": {"enabled": True},
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+class TestACLEnforcement:
+    def test_bootstrap_token_enforcement_flow(self):
+        """Bootstrap → anonymous denied → management allowed → scoped client
+        token gets exactly its grants. The full acl_endpoint + http
+        enforcement loop."""
+        server = make_acl_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            anon = ApiClient(address=f"http://127.0.0.1:{http.port}")
+            # anonymous: denied before bootstrap completes the loop
+            with pytest.raises(APIError) as e:
+                anon.jobs()
+            assert e.value.status == 403
+
+            boot = anon.put("/v1/acl/bootstrap")[0]
+            assert boot["SecretID"] and boot["Type"] == "management"
+
+            # second bootstrap is rejected
+            with pytest.raises(APIError):
+                anon.put("/v1/acl/bootstrap")
+
+            mgmt = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token=boot["SecretID"]
+            )
+            assert mgmt.jobs() == []
+
+            # scoped policy + client token
+            mgmt.put(
+                "/v1/acl/policy/readonly",
+                body={
+                    "Rules": 'namespace "default" { policy = "read" }',
+                },
+            )
+            tok = mgmt.put(
+                "/v1/acl/token",
+                body={"Name": "ro", "Type": "client", "Policies": ["readonly"]},
+            )[0]
+            ro = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token=tok["SecretID"]
+            )
+            assert ro.jobs() == []  # list-jobs granted
+            job = mock.job()
+            job.task_groups[0].tasks[0].resources.networks = []
+            with pytest.raises(APIError) as e:
+                ro.register_job(job.to_dict())  # submit-job NOT granted
+            assert e.value.status == 403
+            # node reads denied too (no node policy)
+            with pytest.raises(APIError):
+                ro.get("/v1/nodes")
+            # acl admin is management-only
+            with pytest.raises(APIError):
+                ro.get("/v1/acl/tokens")
+
+            # bogus token outright rejected
+            bad = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token="nope"
+            )
+            with pytest.raises(APIError) as e:
+                bad.jobs()
+            assert e.value.status == 403
+
+            # management can schedule end-to-end with ACLs on
+            server.node_register(mock.node())
+            resp = mgmt.register_job(job.to_dict())
+            assert resp["EvalID"]
+        finally:
+            http.stop()
+            server.stop()
+
+    def test_acl_disabled_allows_all(self):
+        cfg_server = Server(
+            {
+                "seed": 1,
+                "heartbeat_ttl": 600.0,
+                "raft": {
+                    "node_id": "s0",
+                    "address": "r0",
+                    "voters": {"s0": "r0"},
+                    "transport": InmemTransport(),
+                    "config": RaftConfig(
+                        heartbeat_interval=0.02,
+                        election_timeout_min=0.05,
+                        election_timeout_max=0.10,
+                    ),
+                },
+            }
+        )
+        cfg_server.start(num_workers=0, wait_for_leader=5.0)
+        http = HTTPServer(cfg_server, port=0)
+        http.start()
+        try:
+            anon = ApiClient(address=f"http://127.0.0.1:{http.port}")
+            assert anon.jobs() == []
+        finally:
+            http.stop()
+            cfg_server.stop()
+
+
+class TestSearch:
+    def test_prefix_search_contexts(self):
+        server = make_acl_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            boot = server.acl_bootstrap()
+            mgmt = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token=boot.secret_id
+            )
+            node = mock.node()
+            server.node_register(node)
+            job = mock.job()
+            job.id = "web-frontend"
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].resources.networks = []
+            server.job_register(job)
+
+            res = mgmt.put("/v1/search", body={"Prefix": "web-", "Context": "jobs"})[0]
+            assert res["matches"]["jobs"] == ["web-frontend"]
+            assert "nodes" not in res["matches"]
+
+            res = mgmt.put(
+                "/v1/search", body={"Prefix": node.id[:8], "Context": "all"}
+            )[0]
+            assert node.id in res["matches"]["nodes"]
+        finally:
+            http.stop()
+            server.stop()
