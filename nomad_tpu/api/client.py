@@ -61,14 +61,14 @@ class ApiClient:
                 message = str(e)
             raise APIError(e.code, message) from e
 
-    def get(self, path: str, **params):
-        return self._request("GET", path, params=params or None)
+    def get(self, url: str, **params):
+        return self._request("GET", url, params=params or None)
 
-    def put(self, path: str, body=None, **params):
-        return self._request("PUT", path, params=params or None, body=body)
+    def put(self, url: str, body=None, **params):
+        return self._request("PUT", url, params=params or None, body=body)
 
-    def delete(self, path: str, **params):
-        return self._request("DELETE", path, params=params or None)
+    def delete(self, url: str, **params):
+        return self._request("DELETE", url, params=params or None)
 
     # -- typed helpers ---------------------------------------------------
     def jobs(self, prefix: str = ""):

@@ -217,6 +217,7 @@ class HTTPServer:
                 }
                 for j in snap.jobs()
                 if j.id.startswith(prefix)
+                and j.namespace == query.get("namespace", "default")
             ]
 
         return self._blocking(query, run)
@@ -226,6 +227,7 @@ class HTTPServer:
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
         job = Job.from_dict(body["Job"])
+        self._check_ns(query, job.namespace, "submit-job")
         eval_id = self.server.job_register(job)
         return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
 
@@ -254,6 +256,7 @@ class HTTPServer:
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
         job = Job.from_dict(body["Job"])
+        self._check_ns(query, job.namespace, "submit-job")
         result = self.server.job_plan(job, diff=bool(body.get("Diff", True)))
         return {
             "Annotations": result["annotations"],
@@ -389,8 +392,11 @@ class HTTPServer:
         prefix = query.get("prefix", "")
 
         def run(snap):
+            ns = query.get("namespace", "default")
             return [
-                _alloc_stub(a) for a in snap.allocs() if a.id.startswith(prefix)
+                _alloc_stub(a)
+                for a in snap.allocs()
+                if a.id.startswith(prefix) and a.namespace == ns
             ]
 
         return self._blocking(query, run)
@@ -406,6 +412,7 @@ class HTTPServer:
                 alloc = matches[0] if len(matches) == 1 else None
             if alloc is None:
                 raise KeyError(f"alloc not found: {m['alloc_id']}")
+            self._check_ns(query, alloc.namespace, "read-job")
             return alloc.to_dict()
 
         return self._blocking(query, run)
@@ -414,7 +421,8 @@ class HTTPServer:
     @route("GET", r"/v1/evaluations", acl="ns:read-job")
     def list_evaluations(self, m, query, body):
         def run(snap):
-            return [e.to_dict() for e in snap.evals()]
+            ns = query.get("namespace", "default")
+            return [e.to_dict() for e in snap.evals() if e.namespace == ns]
 
         return self._blocking(query, run)
 
@@ -429,6 +437,7 @@ class HTTPServer:
                 ev = matches[0] if len(matches) == 1 else None
             if ev is None:
                 raise KeyError(f"eval not found: {m['eval_id']}")
+            self._check_ns(query, ev.namespace, "read-job")
             return ev.to_dict()
 
         return self._blocking(query, run)
@@ -436,7 +445,10 @@ class HTTPServer:
     @route("GET", r"/v1/deployments", acl="ns:read-job")
     def list_deployments(self, m, query, body):
         def run(snap):
-            return [d.to_dict() for d in snap.deployments()]
+            ns = query.get("namespace", "default")
+            return [
+                d.to_dict() for d in snap.deployments() if d.namespace == ns
+            ]
 
         return self._blocking(query, run)
 
@@ -454,6 +466,7 @@ class HTTPServer:
                     d = matches[0]
             if d is None:
                 raise KeyError(f"deployment not found: {m['deploy_id']}")
+            self._check_ns(query, d.namespace, "read-job")
             return d.to_dict()
 
         return self._blocking(query, run)
@@ -461,6 +474,9 @@ class HTTPServer:
     @route("GET", r"/v1/deployment/allocations/(?P<deploy_id>[^/]+)", acl="ns:read-job")
     def deployment_allocations(self, m, query, body):
         def run(snap):
+            d = snap.deployment_by_id(m["deploy_id"])
+            if d is not None:
+                self._check_ns(query, d.namespace, "read-job")
             return [
                 _alloc_stub(a) for a in snap.allocs_by_deployment(m["deploy_id"])
             ]
@@ -470,6 +486,7 @@ class HTTPServer:
     @route("PUT", r"/v1/deployment/promote/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_promote(self, m, query, body):
         body = body or {}
+        self._check_deployment_ns(query, m["deploy_id"], "submit-job")
         self.server.deployment_promote(
             m["deploy_id"],
             groups=body.get("Groups"),
@@ -479,17 +496,20 @@ class HTTPServer:
 
     @route("PUT", r"/v1/deployment/fail/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_fail(self, m, query, body):
+        self._check_deployment_ns(query, m["deploy_id"], "submit-job")
         self.server.deployment_fail(m["deploy_id"])
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
     @route("PUT", r"/v1/deployment/pause/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_pause(self, m, query, body):
+        self._check_deployment_ns(query, m["deploy_id"], "submit-job")
         pause = bool((body or {}).get("Pause", True))
         self.server.deployment_pause(m["deploy_id"], pause)
         return {"DeploymentModifyIndex": self.server.state.latest_index()}, None
 
     @route("PUT", r"/v1/deployment/allocation-health/(?P<deploy_id>[^/]+)", acl="ns:submit-job")
     def deployment_alloc_health(self, m, query, body):
+        self._check_deployment_ns(query, m["deploy_id"], "submit-job")
         body = body or {}
         self.server.deployment_set_alloc_health(
             m["deploy_id"],
@@ -583,6 +603,146 @@ class HTTPServer:
         (ref system_endpoint.go GarbageCollect)."""
         self.server.system_gc()
         return {}, None
+
+    # -- client fs/logs/exec (ref command/agent/fs_endpoint.go +
+    # client_fs_endpoint.go; served by the agent holding the alloc — the
+    # in-process analog of the server→client streaming-RPC forwarding) ---
+    def _alloc_dir(self, alloc_id: str) -> str:
+        import os
+
+        clients = []
+        if self.agent is not None:
+            clients = getattr(self.agent, "clients", None) or [
+                getattr(self.agent, "client", None)
+            ]
+        for client in clients:
+            if client is None:
+                continue
+            d = os.path.join(client.data_dir, "allocs", alloc_id)
+            if os.path.isdir(d):
+                return d
+        raise KeyError(f"alloc dir not found for {alloc_id}")
+
+    @staticmethod
+    def _safe_join(base: str, rel: str) -> str:
+        import os
+
+        base = os.path.abspath(base)
+        path = os.path.abspath(os.path.join(base, rel.lstrip("/")))
+        # commonpath: a bare prefix test would accept sibling dirs whose
+        # names extend the alloc id (allocs/abc vs allocs/abc-other)
+        if os.path.commonpath([base, path]) != base:
+            raise ValueError("path escapes the allocation directory")
+        return path
+
+    def _check_deployment_ns(self, query, deploy_id: str, capability: str):
+        d = self.server.state.deployment_by_id(deploy_id) if self.server else None
+        if d is not None:
+            self._check_ns(query, d.namespace, capability)
+
+    def _check_ns(self, query, namespace: str, capability: str):
+        """Re-check the capability against the RESOURCE's namespace: the
+        route gate used the caller-chosen ?namespace=, and trusting it
+        would let a token scoped to one namespace act on another's
+        resources (the cross-namespace escalation class)."""
+        acl = query.get("__acl__")
+        if acl is None:
+            return
+        if not acl.allow_namespace_operation(namespace, capability):
+            raise PermissionError("Permission denied")
+
+    def _check_alloc_ns(self, query, alloc_id: str, capability: str):
+        alloc = self.server.state.alloc_by_id(alloc_id) if self.server else None
+        if alloc is not None:
+            self._check_ns(query, alloc.namespace, capability)
+
+    @route("GET", r"/v1/client/fs/ls/(?P<alloc_id>[^/]+)", acl="ns:read-fs")
+    def fs_ls(self, m, query, body):
+        import os
+
+        self._check_alloc_ns(query, m["alloc_id"], "read-fs")
+        base = self._alloc_dir(m["alloc_id"])
+        path = self._safe_join(base, query.get("path", "/"))
+        entries = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            st = os.stat(full)
+            entries.append(
+                {
+                    "Name": name,
+                    "IsDir": os.path.isdir(full),
+                    "Size": st.st_size,
+                    "ModTime": int(st.st_mtime),
+                }
+            )
+        return entries, None
+
+    @route("GET", r"/v1/client/fs/cat/(?P<alloc_id>[^/]+)", acl="ns:read-fs")
+    def fs_cat(self, m, query, body):
+        self._check_alloc_ns(query, m["alloc_id"], "read-fs")
+        base = self._alloc_dir(m["alloc_id"])
+        path = self._safe_join(base, query.get("path", "/"))
+        with open(path, "rb") as f:
+            return {"Data": f.read().decode("utf-8", "replace")}, None
+
+    @route("GET", r"/v1/client/fs/logs/(?P<alloc_id>[^/]+)", acl="ns:read-logs")
+    def fs_logs(self, m, query, body):
+        """Task log window: ?task=&type=stdout|stderr&offset=&origin=
+        (the non-streaming core of fs_endpoint.go Logs; clients follow by
+        polling with the returned offset)."""
+        import os
+
+        task = query.get("task", "")
+        if not task:
+            raise ValueError("task is required")
+        kind = query.get("type", "stdout")
+        if kind not in ("stdout", "stderr"):
+            raise ValueError("type must be stdout or stderr")
+        self._check_alloc_ns(query, m["alloc_id"], "read-logs")
+        base = self._alloc_dir(m["alloc_id"])
+        path = self._safe_join(base, f"{task}/logs/{task}.{kind}.0")
+        if not os.path.exists(path):
+            return {"Data": "", "Offset": 0}, None
+        size = os.path.getsize(path)
+        origin = query.get("origin", "start")
+        offset = int(query.get("offset", 0))
+        start = max(size - offset, 0) if origin == "end" else min(offset, size)
+        limit = int(query.get("limit", 1 << 20))
+        with open(path, "rb") as f:
+            f.seek(start)
+            data = f.read(limit)
+        return {
+            "Data": data.decode("utf-8", "replace"),
+            "Offset": start + len(data),
+            "Size": size,
+        }, None
+
+    @route("PUT", r"/v1/client/exec/(?P<alloc_id>[^/]+)", acl="ns:alloc-exec")
+    def alloc_exec(self, m, query, body):
+        """One-shot command in the task's working directory
+        (ref alloc exec; the reference's interactive streaming session is
+        served here as a run-to-completion exec with captured output)."""
+        import subprocess
+
+        body = body or {}
+        task = body.get("Task", "")
+        cmd = body.get("Cmd") or []
+        if not task or not cmd:
+            raise ValueError("Task and Cmd are required")
+        self._check_alloc_ns(query, m["alloc_id"], "alloc-exec")
+        base = self._alloc_dir(m["alloc_id"])
+        task_dir = self._safe_join(base, task)
+        proc = subprocess.run(
+            cmd,
+            cwd=task_dir,
+            capture_output=True,
+            timeout=float(body.get("Timeout", 30.0)),
+        )
+        return {
+            "ExitCode": proc.returncode,
+            "Stdout": proc.stdout.decode("utf-8", "replace"),
+            "Stderr": proc.stderr.decode("utf-8", "replace"),
+        }, None
 
     # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------
     @route("PUT", r"/v1/acl/bootstrap", acl="anonymous")

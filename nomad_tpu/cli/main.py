@@ -300,6 +300,63 @@ def cmd_node_eligibility(args):
     return 0
 
 
+def cmd_alloc_logs(args):
+    """ref command/alloc_logs.go (poll-follow on the offset cursor)"""
+    client = _client(args)
+    kind = "stderr" if args.stderr else "stdout"
+    params = {"task": args.task, "type": kind}
+    resp = client.get(f"/v1/client/fs/logs/{args.alloc_id}", **params)[0]
+    print(resp.get("Data", ""), end="")
+    if args.follow:
+        offset = resp.get("Offset", 0)
+        try:
+            while True:
+                time.sleep(1.0)
+                resp = client.get(
+                    f"/v1/client/fs/logs/{args.alloc_id}",
+                    **params,
+                    offset=offset,
+                )[0]
+                if resp.get("Data"):
+                    print(resp["Data"], end="", flush=True)
+                    offset = resp.get("Offset", offset)
+        except KeyboardInterrupt:
+            return 0
+    return 0
+
+
+def cmd_alloc_fs(args):
+    """ref command/alloc_fs.go: ls a directory, cat a file"""
+    client = _client(args)
+    path = args.path or "/"
+    try:
+        entries = client.get(f"/v1/client/fs/ls/{args.alloc_id}", path=path)[0]
+        for entry in entries:
+            kind = "d" if entry["IsDir"] else "-"
+            print(f"{kind} {entry['Size']:>10}  {entry['Name']}")
+        return 0
+    except APIError:
+        resp = client.get(f"/v1/client/fs/cat/{args.alloc_id}", path=path)[0]
+        print(resp.get("Data", ""), end="")
+        return 0
+
+
+def cmd_alloc_exec(args):
+    """ref command/alloc_exec.go (one-shot captured exec)"""
+    client = _client(args)
+    resp = client.put(
+        f"/v1/client/exec/{args.alloc_id}",
+        body={"Task": args.task, "Cmd": args.cmd},
+    )[0]
+    if resp.get("Stdout"):
+        print(resp["Stdout"], end="")
+    if resp.get("Stderr"):
+        import sys
+
+        print(resp["Stderr"], end="", file=sys.stderr)
+    return resp.get("ExitCode", 0)
+
+
 def cmd_alloc_status(args):
     client = _client(args)
     alloc = client.allocation(args.alloc_id)
@@ -531,6 +588,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     alloc = sub.add_parser("alloc", help="allocation commands")
     asub = alloc.add_subparsers(dest="subcommand")
+    alog = asub.add_parser("logs", help="task log window (poll-follow)")
+    alog.add_argument("alloc_id")
+    alog.add_argument("task")
+    alog.add_argument("--stderr", action="store_true")
+    alog.add_argument("-f", "--follow", action="store_true")
+    alog.set_defaults(fn=cmd_alloc_logs)
+    afs = asub.add_parser("fs", help="browse the allocation directory")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?")
+    afs.set_defaults(fn=cmd_alloc_fs)
+    aex = asub.add_parser("exec", help="run a command in the task dir")
+    aex.add_argument("alloc_id")
+    aex.add_argument("task")
+    aex.add_argument("cmd", nargs="+")
+    aex.set_defaults(fn=cmd_alloc_exec)
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_status)

@@ -513,7 +513,9 @@ class Client:
         the next client recovers them from the state DB)."""
         self._stop.set()
         if destroy_allocs:
-            for ar in self.alloc_runners.values():
+            # snapshot: the watch thread keeps mutating the runner map
+            # until its join below
+            for ar in list(self.alloc_runners.values()):
                 ar.destroy()
         for t in self._threads:
             t.join(timeout=1.0)

@@ -20,6 +20,13 @@ from typing import Optional
 from ..structs.model import Task
 
 
+def task_log_dir(task_dir: str) -> str:
+    """Log directory inside a task dir (ref allocdir: alloc/logs)."""
+    import os
+
+    return os.path.join(task_dir, "logs")
+
+
 def parse_duration(v) -> float:
     """Seconds from a number or a Go-style duration string ("250ms",
     "1m30s" — the format the reference's mock driver configs use,
@@ -195,15 +202,38 @@ class RawExecDriver(Driver):
 
     name = "raw_exec"
 
-    def _spawn(self, task: Task, argv: list, cwd) -> TaskHandle:
-        """Shared Popen → TaskHandle → waiter tail for the exec family."""
-        proc = subprocess.Popen(
-            argv,
-            cwd=cwd,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            env={"PATH": "/usr/bin:/bin:/usr/local/bin", **task.env},
-        )
+    def _spawn(self, task: Task, argv: list, cwd, log_base=None) -> TaskHandle:
+        """Shared Popen → TaskHandle → waiter tail for the exec family.
+        stdout/stderr are captured to ``<log_base or cwd>/logs/`` (the
+        logmon role, ref client/logmon/: per-task log files the fs/logs
+        API serves)."""
+        stdout = stderr = subprocess.DEVNULL
+        log_base = log_base or cwd
+        log_dir = task_log_dir(log_base) if log_base else None
+        try:
+            if log_dir is not None:
+                import os
+
+                os.makedirs(log_dir, exist_ok=True)
+                stdout = open(
+                    os.path.join(log_dir, f"{task.name}.stdout.0"), "ab"
+                )
+                stderr = open(
+                    os.path.join(log_dir, f"{task.name}.stderr.0"), "ab"
+                )
+            proc = subprocess.Popen(
+                argv,
+                cwd=cwd,
+                stdout=stdout,
+                stderr=stderr,
+                env={"PATH": "/usr/bin:/bin:/usr/local/bin", **task.env},
+            )
+        finally:
+            # the child holds the fds now (or Popen/open raised)
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()
+            if stderr is not subprocess.DEVNULL:
+                stderr.close()
         handle = TaskHandle(
             task_name=task.name,
             driver=self.name,
@@ -371,7 +401,7 @@ class ExecDriver(RawExecDriver):
             "--",
             command,
         ] + list(cfg.get("args", []))
-        return self._spawn(task, args, None)
+        return self._spawn(task, args, None, log_base=task_dir)
 
 
 BUILTIN_DRIVERS = {

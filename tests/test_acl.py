@@ -188,6 +188,41 @@ class TestACLEnforcement:
             cfg_server.stop()
 
 
+class TestCrossNamespace:
+    def test_body_namespace_cannot_escape_checked_namespace(self):
+        """A token scoped to one namespace can't register into another by
+        putting a different namespace in the job body (the gate checks the
+        query namespace; the handler must re-check the resource's)."""
+        server = make_acl_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            boot = server.acl_bootstrap()
+            mgmt = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token=boot.secret_id
+            )
+            mgmt.put(
+                "/v1/acl/policy/dev-only",
+                body={"Rules": 'namespace "dev" { policy = "write" }'},
+            )
+            tok = mgmt.put(
+                "/v1/acl/token",
+                body={"Type": "client", "Policies": ["dev-only"]},
+            )[0]
+            dev = ApiClient(
+                address=f"http://127.0.0.1:{http.port}", token=tok["SecretID"]
+            )
+            job = mock.job()
+            job.namespace = "prod"
+            job.task_groups[0].tasks[0].resources.networks = []
+            with pytest.raises(APIError) as e:
+                dev.put("/v1/jobs?namespace=dev", body={"Job": job.to_dict()})
+            assert e.value.status == 403
+        finally:
+            http.stop()
+            server.stop()
+
+
 class TestSearch:
     def test_prefix_search_contexts(self):
         server = make_acl_server()

@@ -1,0 +1,122 @@
+"""Alloc fs/logs/exec surface (ref command/agent/fs_endpoint.go,
+client/logmon, command/alloc_{logs,fs,exec}.go)."""
+
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestFsLogsExec:
+    def test_logs_fs_exec_roundtrip(self, capsys):
+        agent = DevAgent(num_clients=1, server_config={"seed": 3})
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        client = ApiClient(address=http.address)
+        try:
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo hello-stdout; echo hello-stderr >&2; echo data > artifact.txt"],
+            }
+            task.resources.networks = []
+            agent.server.job_register(job)
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+                )
+                and len(agent.server.state.allocs_by_job(job.namespace, job.id)) == 1,
+                msg="task complete",
+            )
+            (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+
+            # logs: stdout and stderr captured by the driver's logmon role
+            out = client.get(
+                f"/v1/client/fs/logs/{alloc.id}", task="web", type="stdout"
+            )[0]
+            assert "hello-stdout" in out["Data"]
+            err = client.get(
+                f"/v1/client/fs/logs/{alloc.id}", task="web", type="stderr"
+            )[0]
+            assert "hello-stderr" in err["Data"]
+
+            # fs ls + cat
+            entries = client.get(f"/v1/client/fs/ls/{alloc.id}", path="web")[0]
+            names = {e["Name"] for e in entries}
+            assert {"logs", "artifact.txt"} <= names
+            cat = client.get(
+                f"/v1/client/fs/cat/{alloc.id}", path="web/artifact.txt"
+            )[0]
+            assert cat["Data"].strip() == "data"
+
+            # path traversal rejected
+            from nomad_tpu.api.client import APIError
+
+            try:
+                client.get(f"/v1/client/fs/cat/{alloc.id}", path="../../etc/passwd")
+                raise AssertionError("traversal must be rejected")
+            except APIError as e:
+                assert e.status in (400, 404)
+
+            # one-shot exec in the task dir
+            resp = client.put(
+                f"/v1/client/exec/{alloc.id}",
+                body={"Task": "web", "Cmd": ["/bin/cat", "artifact.txt"]},
+            )[0]
+            assert resp["ExitCode"] == 0 and resp["Stdout"].strip() == "data"
+
+            # CLI: alloc logs + fs + exec
+            from nomad_tpu.cli.main import main as cli_main
+
+            rc = cli_main(
+                ["-address", http.address, "alloc", "logs", alloc.id, "web"]
+            )
+            assert rc == 0
+            assert "hello-stdout" in capsys.readouterr().out
+
+            rc = cli_main(
+                ["-address", http.address, "alloc", "fs", alloc.id, "web"]
+            )
+            assert rc == 0
+            assert "artifact.txt" in capsys.readouterr().out
+
+            rc = cli_main(
+                [
+                    "-address", http.address, "alloc", "exec",
+                    alloc.id, "web", "/bin/cat", "artifact.txt",
+                ]
+            )
+            assert rc == 0
+            assert "data" in capsys.readouterr().out
+
+            # logs offset cursor: poll-follow reads only the delta
+            first = client.get(
+                f"/v1/client/fs/logs/{alloc.id}", task="web", type="stdout"
+            )[0]
+            again = client.get(
+                f"/v1/client/fs/logs/{alloc.id}",
+                task="web",
+                type="stdout",
+                offset=first["Offset"],
+            )[0]
+            assert again["Data"] == ""
+        finally:
+            http.stop()
+            agent.stop()
