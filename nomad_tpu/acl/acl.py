@@ -59,6 +59,20 @@ class ACL:
         rule = self._namespace_rule(ns)
         return rule is not None and not rule[1] and bool(rule[0])
 
+    def allow_capability_any_namespace(self, capability: str) -> bool:
+        """Whether ANY namespace rule grants the capability — the gate for
+        wildcard (?namespace=*) list requests, whose results are then
+        filtered per object (ref acl.go AllowNsOpFunc wildcard handling)."""
+        if self.management:
+            return True
+        for caps, deny in self._ns_exact.values():
+            if not deny and capability in caps:
+                return True
+        for _, caps, deny in self._ns_glob:
+            if not deny and capability in caps:
+                return True
+        return False
+
     # -- coarse domains -------------------------------------------------
     def _coarse_allows(self, granted: str, needed: str) -> bool:
         if self.management:

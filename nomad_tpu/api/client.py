@@ -42,6 +42,11 @@ class ApiClient:
 
     def _request(self, method: str, path: str, params=None, body=None):
         url = self.address + path
+        params = dict(params or {})
+        # the client's namespace rides every request unless overridden
+        # (ref api.Client QueryOptions.Namespace)
+        if self.namespace != "default" and "namespace" not in params and "?" not in path:
+            params["namespace"] = self.namespace
         if params:
             url += "?" + urllib.parse.urlencode(params)
         data = json.dumps(body).encode() if body is not None else None

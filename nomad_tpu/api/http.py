@@ -39,6 +39,10 @@ def _acl_allows(acl, spec, query) -> bool:
         return bool(spec(acl, query))
     if spec.startswith("ns:"):
         ns = query.get("namespace", "default")
+        if ns == "*":
+            # wildcard lists: allowed when any namespace grants the
+            # capability; handlers filter the results per object
+            return acl.allow_capability_any_namespace(spec[3:])
         return acl.allow_namespace_operation(ns, spec[3:])
     domain, _, level = spec.partition(":")
     checks = {
@@ -188,6 +192,17 @@ class HTTPServer:
         return f"http://{self.host}:{self.port}"
 
     # ------------------------------------------------------------------
+    def _ns_visible(self, query, obj_ns: str, capability: str) -> bool:
+        """List-endpoint namespace scoping: exact match normally, or — for
+        ?namespace=* wildcard lists — every namespace the token holds the
+        capability in (ref the reference's wildcard namespace handling)."""
+        ns = query.get("namespace", "default")
+        if ns != "*":
+            return obj_ns == ns
+        acl = query.get("__acl__")
+        return acl is None or acl.allow_namespace_operation(obj_ns, capability)
+
+    # ------------------------------------------------------------------
     def _blocking(self, query, run):
         """Shared blocking-query plumbing (?index=N&wait=D)."""
         min_index = int(query.get("index", 0))
@@ -217,7 +232,7 @@ class HTTPServer:
                 }
                 for j in snap.jobs()
                 if j.id.startswith(prefix)
-                and j.namespace == query.get("namespace", "default")
+                and self._ns_visible(query, j.namespace, "list-jobs")
             ]
 
         return self._blocking(query, run)
@@ -392,11 +407,11 @@ class HTTPServer:
         prefix = query.get("prefix", "")
 
         def run(snap):
-            ns = query.get("namespace", "default")
             return [
                 _alloc_stub(a)
                 for a in snap.allocs()
-                if a.id.startswith(prefix) and a.namespace == ns
+                if a.id.startswith(prefix)
+                and self._ns_visible(query, a.namespace, "read-job")
             ]
 
         return self._blocking(query, run)
@@ -421,8 +436,11 @@ class HTTPServer:
     @route("GET", r"/v1/evaluations", acl="ns:read-job")
     def list_evaluations(self, m, query, body):
         def run(snap):
-            ns = query.get("namespace", "default")
-            return [e.to_dict() for e in snap.evals() if e.namespace == ns]
+            return [
+                e.to_dict()
+                for e in snap.evals()
+                if self._ns_visible(query, e.namespace, "read-job")
+            ]
 
         return self._blocking(query, run)
 
@@ -445,9 +463,10 @@ class HTTPServer:
     @route("GET", r"/v1/deployments", acl="ns:read-job")
     def list_deployments(self, m, query, body):
         def run(snap):
-            ns = query.get("namespace", "default")
             return [
-                d.to_dict() for d in snap.deployments() if d.namespace == ns
+                d.to_dict()
+                for d in snap.deployments()
+                if self._ns_visible(query, d.namespace, "read-job")
             ]
 
         return self._blocking(query, run)
@@ -625,15 +644,9 @@ class HTTPServer:
 
     @staticmethod
     def _safe_join(base: str, rel: str) -> str:
-        import os
+        from ..util import contained_path
 
-        base = os.path.abspath(base)
-        path = os.path.abspath(os.path.join(base, rel.lstrip("/")))
-        # commonpath: a bare prefix test would accept sibling dirs whose
-        # names extend the alloc id (allocs/abc vs allocs/abc-other)
-        if os.path.commonpath([base, path]) != base:
-            raise ValueError("path escapes the allocation directory")
-        return path
+        return contained_path(base, rel)
 
     def _check_deployment_ns(self, query, deploy_id: str, capability: str):
         d = self.server.state.deployment_by_id(deploy_id) if self.server else None
@@ -679,11 +692,24 @@ class HTTPServer:
 
     @route("GET", r"/v1/client/fs/cat/(?P<alloc_id>[^/]+)", acl="ns:read-fs")
     def fs_cat(self, m, query, body):
+        import os
+
         self._check_alloc_ns(query, m["alloc_id"], "read-fs")
         base = self._alloc_dir(m["alloc_id"])
         path = self._safe_join(base, query.get("path", "/"))
+        # bounded window like fs_logs: an unbounded read of a multi-GB
+        # task file would balloon the agent and the JSON response
+        offset = int(query.get("offset", 0))
+        limit = int(query.get("limit", 1 << 20))
+        size = os.path.getsize(path)
         with open(path, "rb") as f:
-            return {"Data": f.read().decode("utf-8", "replace")}, None
+            f.seek(offset)
+            data = f.read(limit)
+        return {
+            "Data": data.decode("utf-8", "replace"),
+            "Offset": offset + len(data),
+            "Size": size,
+        }, None
 
     @route("GET", r"/v1/client/fs/logs/(?P<alloc_id>[^/]+)", acl="ns:read-logs")
     def fs_logs(self, m, query, body):
@@ -732,12 +758,23 @@ class HTTPServer:
         self._check_alloc_ns(query, m["alloc_id"], "alloc-exec")
         base = self._alloc_dir(m["alloc_id"])
         task_dir = self._safe_join(base, task)
-        proc = subprocess.run(
-            cmd,
-            cwd=task_dir,
-            capture_output=True,
-            timeout=float(body.get("Timeout", 30.0)),
-        )
+        try:
+            proc = subprocess.run(
+                cmd,
+                cwd=task_dir,
+                capture_output=True,
+                timeout=float(body.get("Timeout", 30.0)),
+            )
+        except subprocess.TimeoutExpired as e:
+            # structured timeout: keep whatever output was captured
+            return {
+                "ExitCode": -1,
+                "TimedOut": True,
+                "Stdout": (e.stdout or b"").decode("utf-8", "replace"),
+                "Stderr": (e.stderr or b"").decode("utf-8", "replace"),
+            }, None
+        except (FileNotFoundError, NotADirectoryError, PermissionError) as e:
+            raise ValueError(f"exec failed: {e}") from e
         return {
             "ExitCode": proc.returncode,
             "Stdout": proc.stdout.decode("utf-8", "replace"),

@@ -45,7 +45,9 @@ job "example" {
 
 
 def _client(args) -> ApiClient:
-    return ApiClient(address=args.address)
+    return ApiClient(
+        address=args.address, namespace=getattr(args, "namespace", "default")
+    )
 
 
 def cmd_agent(args):
@@ -518,6 +520,10 @@ def cmd_version(args):
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", default=None, help="agent HTTP address")
+    p.add_argument(
+        "-namespace", default="default",
+        help="target namespace ('*' lists all authorized namespaces)",
+    )
     sub = p.add_subparsers(dest="command")
 
     agent = sub.add_parser("agent", help="run the agent")

@@ -94,16 +94,21 @@ class TaskRunner:
                 self._recovered_handle = None
             else:
                 try:
-                    task = self.task
-                    device_env = self.alloc_runner.device_env(task.name)
-                    if device_env:
-                        # reserved devices ride into the task environment
-                        # (devices/gpu/nvidia: CUDA_VISIBLE_DEVICES analog)
-                        task = task.copy()
-                        task.env = {**task.env, **device_env}
-                    self.handle = self.driver.start_task(
-                        task, self.alloc_runner.task_dir(self.task.name)
+                    from . import hooks
+
+                    task_dir = self.alloc_runner.task_dir(self.task.name)
+                    # prestart pipeline (task_runner_hooks.go:48-118):
+                    # dirs → dispatch payload → artifacts → templates →
+                    # NOMAD_* env + ${...} interpolation + device env
+                    task, _ = hooks.run_prestart(
+                        self.alloc_runner.alloc,
+                        self.task,
+                        self.alloc_runner.client.node,
+                        task_dir,
+                        self.alloc_runner.alloc_dir(),
+                        extra_env=self.alloc_runner.device_env(self.task.name),
                     )
+                    self.handle = self.driver.start_task(task, task_dir)
                 except Exception as e:
                     # Start failures route through the restart policy like any
                     # other failure (ref taskrunner restart tracker)
@@ -220,6 +225,10 @@ class AllocRunner:
         )
         os.makedirs(d, exist_ok=True)
         return d
+
+    def alloc_dir(self) -> str:
+        """Shared dir all the alloc's tasks see (ref allocdir SharedDir)."""
+        return os.path.join(self.client.data_dir, "allocs", self.alloc.id, "alloc")
 
     def device_env(self, task_name: str) -> dict:
         """Env vars for the task's reserved device instances."""

@@ -1,0 +1,144 @@
+"""Task prestart hooks (ref client/allocrunner/taskrunner/
+task_runner_hooks.go:48-118: validate → taskdir → logmon → dispatch
+payload → artifacts → templates → env; logmon lives in the drivers'
+_spawn log capture here).
+
+Hooks run before every driver start, in order; a hook failure fails the
+start attempt, which routes through the task's restart policy exactly like
+a driver start failure."""
+
+from __future__ import annotations
+
+import base64
+import logging
+import os
+import shutil
+import urllib.request
+from urllib.parse import urlparse
+
+from . import taskenv
+
+logger = logging.getLogger("nomad_tpu.client.hooks")
+
+
+class HookError(RuntimeError):
+    pass
+
+
+def _contained(base: str, rel: str) -> str:
+    from ..util import contained_path
+
+    try:
+        return contained_path(base, rel)
+    except ValueError as e:
+        raise HookError(str(e)) from e
+
+
+def task_dir_hook(task_dir: str, alloc_dir: str):
+    """allocdir layout (ref client/allocdir/): shared alloc dir plus the
+    task's local/secrets/tmp tree."""
+    for d in (
+        alloc_dir,
+        os.path.join(alloc_dir, "data"),
+        os.path.join(alloc_dir, "tmp"),
+        os.path.join(task_dir, "local"),
+        os.path.join(task_dir, "secrets"),
+        os.path.join(task_dir, "tmp"),
+    ):
+        os.makedirs(d, exist_ok=True)
+
+
+def dispatch_payload_hook(alloc, task, task_dir: str):
+    """Write the dispatch payload into local/ (ref dispatch_hook.go)."""
+    if task.dispatch_payload is None or not task.dispatch_payload.file:
+        return
+    job = alloc.job
+    payload = getattr(job, "payload", "") if job is not None else ""
+    if not payload:
+        return
+    try:
+        data = base64.b64decode(payload)
+    except Exception:
+        data = payload.encode()
+    dest = os.path.join(task_dir, "local", task.dispatch_payload.file)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    with open(dest, "wb") as f:
+        f.write(data)
+
+
+def artifacts_hook(task, task_dir: str, env: dict, node=None):
+    """Fetch artifacts into local/ (ref artifact_hook.go + go-getter).
+    Supported getters: file:// and bare paths (copy, dir or file) and
+    http(s):// via urllib; failures raise and route through the restart
+    policy like the reference's artifact failures."""
+    for artifact in task.artifacts:
+        source = taskenv.interpolate(artifact.getter_source, env, node)
+        rel = taskenv.interpolate(artifact.relative_dest, env, node) or "local/"
+        dest_base = _contained(task_dir, rel)
+        os.makedirs(dest_base, exist_ok=True)
+        parsed = urlparse(source)
+        try:
+            if parsed.scheme in ("", "file"):
+                path = parsed.path if parsed.scheme == "file" else source
+                if os.path.isdir(path):
+                    shutil.copytree(
+                        path,
+                        os.path.join(dest_base, os.path.basename(path.rstrip("/"))),
+                        dirs_exist_ok=True,
+                    )
+                else:
+                    shutil.copy(path, dest_base)
+            elif parsed.scheme in ("http", "https"):
+                name = os.path.basename(parsed.path) or "artifact"
+                with urllib.request.urlopen(source, timeout=30) as resp:
+                    with open(os.path.join(dest_base, name), "wb") as f:
+                        shutil.copyfileobj(resp, f)
+            else:
+                raise HookError(f"unsupported artifact getter: {source}")
+        except HookError:
+            raise
+        except Exception as e:
+            raise HookError(f"artifact fetch failed for {source}: {e}") from e
+
+
+def templates_hook(task, task_dir: str, env: dict, node=None):
+    """Render templates (ref template_hook.go; the reference runs
+    consul-template — here embedded templates interpolate the task env and
+    node attributes through the same ${...} syntax)."""
+    for template in task.templates:
+        content = template.embedded_tmpl
+        if not content and template.source_path:
+            source = _contained(task_dir, template.source_path)
+            try:
+                with open(source) as f:
+                    content = f.read()
+            except OSError as e:
+                raise HookError(f"template source unreadable: {e}") from e
+        rendered = taskenv.interpolate(content, env, node)
+        dest = _contained(task_dir, template.dest_path)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "w") as f:
+            f.write(rendered)
+        try:
+            os.chmod(dest, int(template.perms or "0644", 8))
+        except (ValueError, OSError):
+            pass
+
+
+def run_prestart(alloc, task, node, task_dir: str, alloc_dir: str, extra_env=None):
+    """The prestart pipeline; returns the prepared (interpolated) task copy
+    and its full environment."""
+    task_dir_hook(task_dir, alloc_dir)
+    env = taskenv.build_env(alloc, task, node, task_dir, alloc_dir)
+    env.update(extra_env or {})
+    dispatch_payload_hook(alloc, task, task_dir)
+    artifacts_hook(task, task_dir, env, node)
+    templates_hook(task, task_dir, env, node)
+
+    prepared = task.copy()
+    prepared.env = {
+        **{k: taskenv.interpolate(v, env, node) for k, v in task.env.items()},
+        **env,
+    }
+    prepared.config = taskenv.interpolate(task.config, prepared.env, node)
+    return prepared, env

@@ -1,0 +1,18 @@
+"""Small shared helpers (the reference's helper/ grab-bag)."""
+
+from __future__ import annotations
+
+import os
+
+
+def contained_path(base: str, rel: str) -> str:
+    """Join ``rel`` under ``base`` and guarantee the result stays inside.
+
+    realpath on both sides: symlinks planted inside the tree (a task
+    running ``ln -s / esc``) must not escape; a bare prefix test would also
+    accept sibling dirs whose names extend the base. Raises ValueError."""
+    base = os.path.realpath(base)
+    path = os.path.realpath(os.path.join(base, rel.lstrip("/")))
+    if path != base and os.path.commonpath([base, path]) != base:
+        raise ValueError(f"path escapes the base directory: {rel}")
+    return path

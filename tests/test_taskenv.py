@@ -1,0 +1,193 @@
+"""Task environment + prestart hook pipeline
+(ref client/taskenv/env.go, task_runner_hooks.go:48-118,
+artifact_hook.go, template_hook.go, dispatch_hook.go)."""
+
+import base64
+import os
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.client import hooks, taskenv
+from nomad_tpu.client.hooks import HookError
+from nomad_tpu.structs.model import (
+    DispatchPayloadConfig,
+    TaskArtifact,
+    Template,
+)
+
+
+def make_alloc():
+    alloc = mock.alloc()
+    return alloc
+
+
+class TestTaskEnv:
+    def test_nomad_variables(self):
+        alloc = make_alloc()
+        node = mock.node()
+        task = alloc.job.task_groups[0].tasks[0]
+        env = taskenv.build_env(alloc, task, node, "/t/web", "/t/alloc")
+        assert env["NOMAD_ALLOC_ID"] == alloc.id
+        assert env["NOMAD_TASK_NAME"] == task.name
+        assert env["NOMAD_GROUP_NAME"] == alloc.task_group
+        assert env["NOMAD_TASK_DIR"] == "/t/web/local"
+        assert env["NOMAD_ALLOC_DIR"] == "/t/alloc"
+        assert env["NOMAD_CPU_LIMIT"] == str(task.resources.cpu)
+        assert env["NOMAD_ALLOC_INDEX"] == "0"
+
+    def test_meta_and_ports(self):
+        alloc = make_alloc()
+        node = mock.node()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.meta = {"owner": "me"}
+        env = taskenv.build_env(alloc, task, node, "/t/web", "/t/alloc")
+        assert env["NOMAD_META_OWNER"] == "me"
+        # mock alloc carries an allocated port for 'web'
+        port_keys = [k for k in env if k.startswith("NOMAD_ADDR_")]
+        assert port_keys, "allocated ports become NOMAD_ADDR_* vars"
+
+    def test_interpolation(self):
+        node = mock.node()
+        node.attributes["rack"] = "r9"
+        node.meta["zone"] = "z1"
+        env = {"NOMAD_TASK_DIR": "/td/local", "FOO": "bar"}
+        assert (
+            taskenv.interpolate("${NOMAD_TASK_DIR}/x ${env.FOO}", env, node)
+            == "/td/local/x bar"
+        )
+        assert taskenv.interpolate("${attr.rack}", env, node) == "r9"
+        assert taskenv.interpolate("${meta.zone}", env, node) == "z1"
+        assert taskenv.interpolate("${node.datacenter}", env, node) == node.datacenter
+        assert taskenv.interpolate(
+            {"cmd": ["${env.FOO}", 7]}, env, node
+        ) == {"cmd": ["bar", 7]}
+
+
+class TestHooks:
+    def test_artifact_file_copy_and_template(self, tmp_path):
+        src = tmp_path / "payload.bin"
+        src.write_text("artifact-data")
+        task_dir = tmp_path / "task"
+        alloc_dir = tmp_path / "alloc"
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.artifacts = [TaskArtifact(getter_source=f"file://{src}")]
+        task.templates = [
+            Template(
+                embedded_tmpl="job=${NOMAD_JOB_ID} dc=${node.datacenter}",
+                dest_path="local/config.txt",
+            )
+        ]
+        node = mock.node()
+        prepared, env = hooks.run_prestart(
+            alloc, task, node, str(task_dir), str(alloc_dir)
+        )
+        assert (task_dir / "local" / "payload.bin").read_text() == "artifact-data"
+        rendered = (task_dir / "local" / "config.txt").read_text()
+        assert rendered == f"job={alloc.job_id} dc={node.datacenter}"
+        assert (alloc_dir / "data").is_dir()
+        assert prepared.env["NOMAD_ALLOC_ID"] == alloc.id
+
+    def test_artifact_escape_rejected(self, tmp_path):
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.artifacts = [
+            TaskArtifact(getter_source="/etc/hostname", relative_dest="../../out")
+        ]
+        with pytest.raises(HookError):
+            hooks.run_prestart(
+                alloc, task, mock.node(), str(tmp_path / "t"), str(tmp_path / "a")
+            )
+
+    def test_missing_artifact_fails(self, tmp_path):
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.artifacts = [TaskArtifact(getter_source="/does/not/exist")]
+        with pytest.raises(HookError):
+            hooks.run_prestart(
+                alloc, task, mock.node(), str(tmp_path / "t"), str(tmp_path / "a")
+            )
+
+    def test_dispatch_payload_written(self, tmp_path):
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.dispatch_payload = DispatchPayloadConfig(file="input.dat")
+        alloc.job.payload = base64.b64encode(b"dispatched").decode()
+        hooks.run_prestart(
+            alloc, task, mock.node(), str(tmp_path / "t"), str(tmp_path / "a")
+        )
+        assert (tmp_path / "t" / "local" / "input.dat").read_bytes() == b"dispatched"
+
+    def test_config_interpolation(self, tmp_path):
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.config = {"command": "/bin/echo", "args": ["${NOMAD_ALLOC_ID}"]}
+        prepared, _ = hooks.run_prestart(
+            alloc, task, mock.node(), str(tmp_path / "t"), str(tmp_path / "a")
+        )
+        assert prepared.config["args"] == [alloc.id]
+
+
+class TestEndToEnd:
+    def test_task_sees_nomad_env_and_artifact(self, tmp_path):
+        """A raw_exec task reads its NOMAD_* env and a fetched artifact."""
+        from nomad_tpu.client.client import Client
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.raft import InmemTransport, RaftConfig
+
+        artifact = tmp_path / "seed.txt"
+        artifact.write_text("seeded")
+
+        cfg = {
+            "seed": 42,
+            "heartbeat_ttl": 600.0,
+            "raft": {
+                "node_id": "s0",
+                "address": "raft0",
+                "voters": {"s0": "raft0"},
+                "transport": InmemTransport(),
+                "config": RaftConfig(
+                    heartbeat_interval=0.02,
+                    election_timeout_min=0.05,
+                    election_timeout_max=0.10,
+                ),
+            },
+        }
+        server = Server(cfg)
+        server.start(num_workers=1, wait_for_leader=5.0)
+        client = Client(server, data_dir=str(tmp_path / "client"))
+        client.start()
+        try:
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'echo "$NOMAD_ALLOC_ID" > out; cat "$NOMAD_TASK_DIR/seed.txt" >> out',
+                ],
+            }
+            task.artifacts = [TaskArtifact(getter_source=f"file://{artifact}")]
+            task.resources.networks = []
+            server.job_register(job)
+
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                allocs = server.state.allocs_by_job(job.namespace, job.id)
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                time.sleep(0.05)
+            (alloc,) = server.state.allocs_by_job(job.namespace, job.id)
+            assert alloc.client_status == "complete"
+            out = (
+                tmp_path / "client" / "allocs" / alloc.id / "web" / "out"
+            ).read_text()
+            assert alloc.id in out and "seeded" in out
+        finally:
+            client.stop()
+            server.stop()
