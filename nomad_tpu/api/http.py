@@ -108,6 +108,17 @@ class HTTPServer:
 
             def _dispatch(self, method):
                 parsed = urlparse(self.path)
+                # the web UI (ref command/agent/http.go:211 serving /ui/)
+                if method == "GET" and parsed.path in ("/", "/ui", "/ui/"):
+                    from ..ui import INDEX_HTML
+
+                    data = INDEX_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
@@ -117,6 +128,16 @@ class HTTPServer:
                         body = json.loads(raw)
                     except json.JSONDecodeError:
                         body = raw.decode()
+                # region forwarding (ref rpc.go forward() + region tables):
+                # a request naming another region proxies to a server there
+                region = query.get("region")
+                if (
+                    region
+                    and api.server is not None
+                    and region != getattr(api.server, "region", region)
+                ):
+                    self._forward_region(method, region, parsed, query, body)
+                    return
                 for m, pattern, name, acl_spec in _ROUTES:
                     if m != method:
                         continue
@@ -155,6 +176,30 @@ class HTTPServer:
                         return
                 self._respond(404, {"error": f"no handler for {parsed.path}"}, None)
 
+            def _forward_region(self, method, region, parsed, query, body):
+                from .client import APIError, ApiClient
+
+                peers = api.server.region_http_servers(region)
+                if not peers:
+                    self._respond(
+                        500, {"error": f"no path to region {region!r}"}, None
+                    )
+                    return
+                proxy = ApiClient(
+                    address=peers[0],
+                    token=self.headers.get("X-Nomad-Token") or "",
+                )
+                path = parsed.path + ("?" + parsed.query if parsed.query else "")
+                try:
+                    payload, index = proxy._request(method, path, body=body)
+                    self._respond(200, payload, index)
+                except APIError as e:
+                    self._respond(e.status, {"error": str(e)}, None)
+                except Exception as e:
+                    self._respond(
+                        500, {"error": f"region forward failed: {e}"}, None
+                    )
+
             def _respond(self, code, payload, index):
                 data = json.dumps(payload).encode()
                 self.send_response(code)
@@ -181,6 +226,9 @@ class HTTPServer:
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        if self.server is not None and hasattr(self.server, "advertise_http"):
+            # publish our HTTP address for cross-region forwarding
+            self.server.advertise_http(self.address)
 
     def stop(self):
         if self._httpd is not None:
@@ -242,6 +290,7 @@ class HTTPServer:
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
         job = Job.from_dict(body["Job"])
+        self._apply_request_ns(query, job)
         self._check_ns(query, job.namespace, "submit-job")
         eval_id = self.server.job_register(job)
         return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
@@ -271,6 +320,7 @@ class HTTPServer:
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
         job = Job.from_dict(body["Job"])
+        self._apply_request_ns(query, job)
         self._check_ns(query, job.namespace, "submit-job")
         result = self.server.job_plan(job, diff=bool(body.get("Diff", True)))
         return {
@@ -593,6 +643,11 @@ class HTTPServer:
             None,
         )
 
+    @route("GET", r"/v1/regions", acl="anonymous")
+    def list_regions(self, m, query, body):
+        """ref nomad/regions_endpoint.go List"""
+        return self.server.regions(), None
+
     @route("GET", r"/v1/status/leader", acl="anonymous")
     def status_leader(self, m, query, body):
         return f"{self.host}:{self.port}", None
@@ -647,6 +702,15 @@ class HTTPServer:
         from ..util import contained_path
 
         return contained_path(base, rel)
+
+    @staticmethod
+    def _apply_request_ns(query, job):
+        """A job spec that doesn't name a namespace registers into the
+        request's (?namespace= / CLI -namespace); an explicit spec
+        namespace wins and is ACL-re-checked either way."""
+        ns = query.get("namespace", "default")
+        if job.namespace == "default" and ns not in ("default", "*"):
+            job.namespace = ns
 
     def _check_deployment_ns(self, query, deploy_id: str, capability: str):
         d = self.server.state.deployment_by_id(deploy_id) if self.server else None

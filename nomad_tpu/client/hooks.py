@@ -60,7 +60,8 @@ def dispatch_payload_hook(alloc, task, task_dir: str):
         data = base64.b64decode(payload)
     except Exception:
         data = payload.encode()
-    dest = os.path.join(task_dir, "local", task.dispatch_payload.file)
+    # user-controlled filename: must stay inside the task dir
+    dest = _contained(task_dir, os.path.join("local", task.dispatch_payload.file))
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     with open(dest, "wb") as f:
         f.write(data)

@@ -105,6 +105,9 @@ class Server:
         DeploymentsWatcher(self)  # installs itself as self.deployment_watcher
         NodeDrainer(self)  # installs itself as self.drainer
         PeriodicDispatch(self)  # attaches as self.periodic + FSM hook
+        #: this server's region; regions are independent raft domains
+        #: federated over gossip (ref regions_endpoint.go, serf.go WAN)
+        self.region = self.config.get("region", "global")
         self.raft = self._setup_raft()
         self.gossip = self._setup_gossip()
 
@@ -157,7 +160,11 @@ class Server:
         return Gossip(
             name=self.raft.node_id,
             bind=tuple(gcfg.get("bind", ("127.0.0.1", 0))),
-            tags={"raft": self.raft.address, "role": "server"},
+            tags={
+                "raft": self.raft.address,
+                "role": "server",
+                "region": self.region,
+            },
             probe_interval=float(gcfg.get("probe_interval", 0.3)),
             ack_timeout=float(gcfg.get("ack_timeout", 0.3)),
             suspect_timeout=float(gcfg.get("suspect_timeout", 1.5)),
@@ -171,6 +178,11 @@ class Server:
         converge through the replicated CONFIG entries); ref serf.go
         nodeJoin/nodeFailed + autopilot dead-server cleanup."""
         if not self._leader:
+            return
+        # regions are independent raft domains joined only by gossip
+        # (ref serf.go WAN federation): never add a foreign region's
+        # server as a voter
+        if member.tags.get("region", "global") != self.region:
             return
         try:
             if event == "join":
@@ -189,6 +201,37 @@ class Server:
             pass
         except Exception:
             logger.exception("gossip membership change failed")
+
+    # ------------------------------------------------------------------
+    # Regions (ref nomad/regions_endpoint.go + rpc.go region forwarding)
+    # ------------------------------------------------------------------
+    def regions(self) -> list[str]:
+        """All regions known through gossip, self included."""
+        out = {self.region}
+        if self.gossip is not None:
+            for member in self.gossip.alive_members():
+                region = member.tags.get("region")
+                if region:
+                    out.add(region)
+        return sorted(out)
+
+    def region_http_servers(self, region: str) -> list[str]:
+        """HTTP addresses of alive servers in ``region`` (from gossip
+        tags) — the region-forwarding table."""
+        if self.gossip is None:
+            return []
+        out = []
+        for member in self.gossip.alive_members():
+            if member.tags.get("region") == region and member.tags.get("http"):
+                out.append(member.tags["http"])
+        return out
+
+    def advertise_http(self, address: str):
+        """Publish this server's HTTP address into its gossip tags so other
+        regions can forward to it."""
+        if self.gossip is None:
+            return
+        self.gossip.set_tags({"http": address})
 
     def _reconcile_gossip_members(self):
         """On leadership: fold the current gossip view into raft membership

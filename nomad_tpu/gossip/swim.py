@@ -154,6 +154,13 @@ class Gossip:
             if m.status == ALIVE:
                 self._send(m.addr, {"t": "state", "view": view})
 
+    def set_tags(self, tags: dict):
+        """Merge tag updates into our record and bump the incarnation so
+        the new tags dominate peers' stale copies (ref serf SetTags)."""
+        with self._lock:
+            self._me.tags.update(tags)
+            self._me.incarnation += 1
+
     def alive_members(self) -> list[Member]:
         with self._lock:
             return [m for m in self.members.values() if m.status == ALIVE]

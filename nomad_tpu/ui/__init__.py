@@ -1,0 +1,159 @@
+"""Web UI (ref ui/: the reference ships an Ember SPA at /ui/; this is a
+single-file SPA over the same /v1/* API — jobs, nodes, allocations and
+evaluations with drill-down, auto-refresh, and ACL token support)."""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  :root { --bg:#15181f; --panel:#1d212b; --line:#2a2f3d; --text:#e6e9f0;
+          --dim:#8b93a7; --accent:#5b8dee; --ok:#39b37a; --bad:#e35d6a;
+          --warn:#d9a23c; }
+  * { box-sizing:border-box; }
+  body { margin:0; background:var(--bg); color:var(--text);
+         font:14px/1.5 system-ui, sans-serif; }
+  header { display:flex; align-items:center; gap:1.5rem; padding:.8rem 1.2rem;
+           background:var(--panel); border-bottom:1px solid var(--line); }
+  header h1 { font-size:1rem; margin:0; color:var(--accent); }
+  nav a { color:var(--dim); text-decoration:none; margin-right:1rem;
+          padding:.2rem 0; }
+  nav a.active { color:var(--text); border-bottom:2px solid var(--accent); }
+  header input { margin-left:auto; background:var(--bg); color:var(--text);
+                 border:1px solid var(--line); border-radius:4px;
+                 padding:.3rem .5rem; width:16rem; }
+  main { padding:1rem 1.2rem; }
+  table { width:100%; border-collapse:collapse; background:var(--panel);
+          border:1px solid var(--line); border-radius:6px; overflow:hidden; }
+  th, td { text-align:left; padding:.45rem .7rem;
+           border-bottom:1px solid var(--line); }
+  th { color:var(--dim); font-weight:500; font-size:.8rem;
+       text-transform:uppercase; letter-spacing:.04em; }
+  tr:last-child td { border-bottom:none; }
+  tr.row:hover { background:#232838; cursor:pointer; }
+  .status { display:inline-block; padding:0 .5rem; border-radius:99px;
+            font-size:.8rem; }
+  .s-running, .s-ready, .s-complete, .s-successful
+    { background:#173527; color:var(--ok); }
+  .s-pending, .s-initializing { background:#39301b; color:var(--warn); }
+  .s-dead, .s-failed, .s-down, .s-lost { background:#3a2125; color:var(--bad); }
+  pre { background:var(--panel); border:1px solid var(--line);
+        border-radius:6px; padding:1rem; overflow:auto; max-height:70vh; }
+  .err { color:var(--bad); padding:.6rem 0; }
+  .crumb { color:var(--dim); margin-bottom:.8rem; }
+  .crumb a { color:var(--accent); text-decoration:none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>nomad-tpu</h1>
+  <nav>
+    <a href="#/jobs">Jobs</a>
+    <a href="#/nodes">Nodes</a>
+    <a href="#/allocations">Allocations</a>
+    <a href="#/evaluations">Evaluations</a>
+  </nav>
+  <input id="token" placeholder="ACL token (X-Nomad-Token)" />
+</header>
+<main id="view">Loading…</main>
+<script>
+const view = document.getElementById('view');
+const tokenInput = document.getElementById('token');
+tokenInput.value = localStorage.getItem('nomad_token') || '';
+tokenInput.addEventListener('change', () => {
+  localStorage.setItem('nomad_token', tokenInput.value); render();
+});
+
+async function api(path) {
+  const headers = {};
+  if (tokenInput.value) headers['X-Nomad-Token'] = tokenInput.value;
+  const resp = await fetch(path, {headers});
+  if (!resp.ok) throw new Error(resp.status + ' ' + ((await resp.json()).error || ''));
+  return resp.json();
+}
+const badge = s => `<span class="status s-${s}">${s}</span>`;
+const esc = x => String(x ?? '').replace(/[&<>"]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+
+function table(headers, rows, onclickPrefix) {
+  return `<table><tr>${headers.map(h=>`<th>${h}</th>`).join('')}</tr>` +
+    rows.map(r => `<tr class="row" onclick="location.hash='${onclickPrefix}/${r.id}'">` +
+      r.cells.map(c=>`<td>${c}</td>`).join('') + '</tr>').join('') + '</table>';
+}
+
+const routes = {
+  async jobs() {
+    const jobs = await api('/v1/jobs');
+    return table(['ID','Type','Priority','Status'], jobs.map(j => ({
+      id: encodeURIComponent(j.ID),
+      cells: [esc(j.ID), esc(j.Type), j.Priority, badge(esc(j.Status))]
+    })), '#/job');
+  },
+  async job(id) {
+    const j = await api('/v1/job/' + id);
+    let allocs = [];
+    try { allocs = await api('/v1/job/' + id + '/allocations'); } catch {}
+    return `<div class="crumb"><a href="#/jobs">jobs</a> / ${esc(j.id)}</div>` +
+      table(['Alloc','Group','Desired','Client','Node'], allocs.map(a => ({
+        id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.TaskGroup),
+          badge(esc(a.DesiredStatus)), badge(esc(a.ClientStatus)),
+          esc((a.NodeID||'').slice(0,8))]
+      })), '#/allocation') +
+      `<h3>Spec</h3><pre>${esc(JSON.stringify(j, null, 2))}</pre>`;
+  },
+  async nodes() {
+    const nodes = await api('/v1/nodes');
+    return table(['ID','Name','DC','Class','Status'], nodes.map(n => ({
+      id: n.ID, cells: [esc(n.ID.slice(0,8)), esc(n.Name), esc(n.Datacenter),
+        esc(n.NodeClass || '-'), badge(esc(n.Status))]
+    })), '#/node');
+  },
+  async node(id) {
+    const n = await api('/v1/node/' + id);
+    let allocs = [];
+    try { allocs = await api('/v1/node/' + id + '/allocations'); } catch {}
+    return `<div class="crumb"><a href="#/nodes">nodes</a> / ${esc(n.name)}</div>` +
+      table(['Alloc','Job','Group','Client'], allocs.map(a => ({
+        id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.JobID), esc(a.TaskGroup),
+          badge(esc(a.ClientStatus))]
+      })), '#/allocation') +
+      `<h3>Node</h3><pre>${esc(JSON.stringify(n, null, 2))}</pre>`;
+  },
+  async allocations() {
+    const allocs = await api('/v1/allocations');
+    return table(['ID','Job','Group','Desired','Client'], allocs.map(a => ({
+      id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.JobID), esc(a.TaskGroup),
+        badge(esc(a.DesiredStatus)), badge(esc(a.ClientStatus))]
+    })), '#/allocation');
+  },
+  async allocation(id) {
+    const a = await api('/v1/allocation/' + id);
+    return `<div class="crumb"><a href="#/allocations">allocations</a> / ${esc(a.id.slice(0,8))}</div>` +
+      `<pre>${esc(JSON.stringify(a, null, 2))}</pre>`;
+  },
+  async evaluations() {
+    const evals = await api('/v1/evaluations');
+    return table(['ID','Job','Type','Triggered By','Status'], evals.map(e => ({
+      id: e.id, cells: [esc(e.id.slice(0,8)), esc(e.job_id), esc(e.type),
+        esc(e.triggered_by), badge(esc(e.status))]
+    })), '#/evaluations');
+  },
+};
+
+async function render() {
+  const hash = location.hash || '#/jobs';
+  const [, page, id] = hash.split('/');
+  document.querySelectorAll('nav a').forEach(a =>
+    a.classList.toggle('active', a.getAttribute('href') === '#/' + page));
+  const fn = routes[page] || routes.jobs;
+  try { view.innerHTML = await fn(id); }
+  catch (e) { view.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+window.addEventListener('hashchange', render);
+setInterval(() => { if (!(location.hash||'').match(/#\\/(job|node|allocation)\\//)) render(); }, 3000);
+render();
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,104 @@
+"""Multi-region federation (ref nomad/regions_endpoint.go, serf.go WAN
+federation, rpc.go region forwarding): regions are independent raft
+domains joined by gossip; requests naming another region forward to it."""
+
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_region_server(name, region, transport, seeds=None):
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "region": region,
+        "bootstrap": True,  # each region bootstraps its own raft domain
+        "gossip": {"bind": ("127.0.0.1", 0), "join": seeds or []},
+        "raft": {
+            "node_id": name,
+            "address": f"raft-{name}",
+            "transport": transport,
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+class TestRegions:
+    def test_federation_and_forwarding(self):
+        """Two regions federate over gossip without merging raft domains;
+        a request naming the other region forwards transparently."""
+        transport = InmemTransport()
+        east = make_region_server("east-1", "east", transport)
+        west = make_region_server(
+            "west-1", "west", transport, seeds=[list(east.gossip.addr)]
+        )
+        http_east = HTTPServer(east, port=0)
+        http_east.start()
+        http_west = HTTPServer(west, port=0)
+        http_west.start()
+        try:
+            wait_until(
+                lambda: len(east.gossip.alive_members()) == 2
+                and len(west.gossip.alive_members()) == 2,
+                msg="gossip federation",
+            )
+            # raft domains stay separate: each region is its own voter set
+            assert set(east.raft.voters) == {"east-1"}
+            assert set(west.raft.voters) == {"west-1"}
+
+            # both regions visible from either side
+            client = ApiClient(address=http_east.address)
+            wait_until(
+                lambda: client.get("/v1/regions")[0] == ["east", "west"],
+                msg="regions listed",
+            )
+
+            # register a job in west THROUGH east's HTTP endpoint
+            west.node_register(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].resources.networks = []
+            wait_until(
+                lambda: east.region_http_servers("west"),
+                msg="west's http address propagated",
+            )
+            resp = client.put(
+                "/v1/jobs", body={"Job": job.to_dict()}, region="west"
+            )[0]
+            assert resp["EvalID"]
+            # the job lives in west's state, not east's
+            assert west.state.job_by_id(job.namespace, job.id) is not None
+            assert east.state.job_by_id(job.namespace, job.id) is None
+
+            # and reads forward too
+            got = client.get(f"/v1/job/{job.id}", region="west")[0]
+            assert got["id"] == job.id
+            wait_until(
+                lambda: len(west.state.allocs_by_job(job.namespace, job.id)) == 1,
+                msg="west scheduled the forwarded job",
+            )
+        finally:
+            http_east.stop()
+            http_west.stop()
+            west.stop()
+            east.stop()
