@@ -51,24 +51,61 @@ def _client(args) -> ApiClient:
 
 
 def cmd_agent(args):
-    """ref command/agent/command.go"""
+    """ref command/agent/command.go: -dev mode, or HCL config files with
+    merge semantics and SIGHUP log-level reload."""
     from ..agent import DevAgent
     from ..api.http import HTTPServer
-
-    if not args.dev:
-        print("only -dev mode is supported in this build", file=sys.stderr)
-        return 1
-    agent = DevAgent(num_clients=args.clients)
-    agent.start()
-    http = HTTPServer(
-        agent.server, host=args.bind, port=args.port, agent=agent
+    from ..config import (
+        apply_log_level,
+        load_agent_config,
+        server_config_from_agent,
     )
+
+    config_paths = list(args.config or [])
+    if not args.dev and not config_paths:
+        print("provide -dev or -config <file>", file=sys.stderr)
+        return 1
+
+    config = load_agent_config(config_paths)
+    apply_log_level(config)
+    server_cfg = server_config_from_agent(config)
+    server_cfg["name"] = config.get("name", "server-1")
+
+    num_clients = args.clients
+    if (
+        not args.dev
+        and config_paths
+        and not config.get("client", {}).get("enabled", False)
+    ):
+        num_clients = 0
+    agent = DevAgent(
+        num_clients=num_clients,
+        server_config=server_cfg,
+        num_workers=int(config.get("server", {}).get("num_schedulers", 2)),
+    )
+    agent.start()
+    port = args.port if args.port is not None else int(
+        config.get("ports", {}).get("http", 4646)
+    )
+    http = HTTPServer(agent.server, host=args.bind, port=port, agent=agent)
     http.start()
-    print(f"==> nomad-tpu dev agent started: {http.address}")
+    print(f"==> nomad-tpu agent started: {http.address} "
+          f"(region {agent.server.region!r})")
     print(f"    clients: {[c.node.id[:8] for c in agent.clients]}")
+
     stop = []
+
+    def _reload(*_a):
+        # SIGHUP: re-read config files, apply the reloadable subset
+        try:
+            level = apply_log_level(load_agent_config(config_paths))
+            print(f"==> config reloaded (log_level={level})")
+        except Exception as e:
+            print(f"==> config reload failed: {e}", file=sys.stderr)
+
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGHUP, _reload)
     try:
         while not stop:
             time.sleep(0.2)
@@ -529,8 +566,12 @@ def build_parser() -> argparse.ArgumentParser:
     agent = sub.add_parser("agent", help="run the agent")
     agent.add_argument("-dev", action="store_true")
     agent.add_argument("-bind", default="127.0.0.1")
-    agent.add_argument("-port", type=int, default=4646)
+    agent.add_argument("-port", type=int, default=None)
     agent.add_argument("-clients", type=int, default=1)
+    agent.add_argument(
+        "-config", action="append",
+        help="HCL agent config file (repeatable; merged in order)",
+    )
     agent.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands")

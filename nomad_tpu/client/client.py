@@ -66,11 +66,22 @@ class TaskRunner:
         # a client restart does NOT hand a crash-looping task a fresh
         # restart-policy budget (ref restarts/restarts.go)
         self._restarts_in_interval: list[float] = []
+        # bounded event timeline surviving state transitions
+        # (ref structs.TaskEvent + TaskState.Events)
+        self._events: list[dict] = []
         if restored_state:
             self.state.restarts = int(restored_state.get("restarts", 0))
             self._restarts_in_interval = [
                 float(t) for t in restored_state.get("restart_times", [])
             ]
+            self._events = list(restored_state.get("events", []))[-10:]
+        self._event("Received", "Task received by client")
+
+    def _event(self, etype: str, message: str):
+        self._events = (self._events + [
+            {"type": etype, "message": message, "time": now_ns()}
+        ])[-10:]
+        self.state.events = list(self._events)
 
     def start(self):
         self._thread = threading.Thread(target=self.run, daemon=True)
@@ -92,8 +103,10 @@ class TaskRunner:
                 # driver start, resume supervision of the live handle
                 self.handle = self._recovered_handle
                 self._recovered_handle = None
+                self._event("Recovered", "Task reattached after client restart")
             else:
                 try:
+                    self._event("Task Setup", "Building task directory and environment")
                     from . import hooks
 
                     task_dir = self.alloc_runner.task_dir(self.task.name)
@@ -119,7 +132,7 @@ class TaskRunner:
                     self.state = TaskState(
                         state="dead", failed=True, finished_at=now_ns()
                     )
-                    self.state.events.append({"type": "Driver Failure", "message": str(e)})
+                    self._event("Driver Failure", str(e))
                     self.alloc_runner.task_state_updated()
                     return
             self.alloc_runner.driver_handle_updated(self)
@@ -129,6 +142,7 @@ class TaskRunner:
                 started_at=self.handle.started_at,
                 restarts=self.state.restarts,
             )
+            self._event("Started", "Task started by client")
             self.alloc_runner.task_state_updated()
 
             self.handle.wait()
@@ -154,6 +168,7 @@ class TaskRunner:
                     finished_at=self.handle.finished_at,
                     restarts=self.state.restarts,
                 )
+                self._event("Terminated", f"Exit Code: {exit_code}")
                 self.alloc_runner.task_state_updated()
                 return
 
@@ -161,6 +176,9 @@ class TaskRunner:
             if restart_policy is not None and self._restart_or_wait(restart_policy):
                 self.state = TaskState(
                     state="pending", restarts=self.state.restarts + 1
+                )
+                self._event(
+                    "Restarting", f"Task restarting (exit code {exit_code})"
                 )
                 self.alloc_runner.task_state_updated()
                 continue
@@ -172,6 +190,7 @@ class TaskRunner:
                 finished_at=self.handle.finished_at,
                 restarts=self.state.restarts,
             )
+            self._event("Terminated", f"Exit Code: {exit_code}, failed")
             self.alloc_runner.task_state_updated()
             return
 
@@ -206,6 +225,7 @@ class TaskRunner:
     def stop(self):
         self._stop.set()
         if self.handle is not None:
+            self._event("Killing", "Task being killed")
             self.driver.stop_task(self.handle)
 
 
@@ -264,9 +284,7 @@ class AllocRunner:
             )
             if driver is None:
                 tr.state = TaskState(state="dead", failed=True, finished_at=now_ns())
-                tr.state.events.append(
-                    {"type": "Driver Failure", "message": f"unknown driver {task.driver}"}
-                )
+                tr._event("Driver Failure", f"unknown driver {task.driver}")
                 missing_driver.append(tr)
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():
@@ -735,6 +753,7 @@ class Client:
                 for name, tr in runner.task_runners.items():
                     doc = tr.state.to_dict()
                     doc["restart_times"] = list(tr._restarts_in_interval)
+                    doc["events"] = list(tr._events)
                     task_docs[name] = doc
                 self.state_db.put_alloc_update(update.to_dict(), task_docs)
             except Exception:

@@ -1,0 +1,99 @@
+"""Agent configuration (ref command/agent/config.go + config_parse.go:
+HCL config files merged in order, CLI flags overriding, and a SIGHUP
+reload path for the reloadable subset)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+#: defaults (ref config.go DefaultConfig)
+DEFAULT_AGENT_CONFIG: dict[str, Any] = {
+    "region": "global",
+    "datacenter": "dc1",
+    "data_dir": "",
+    "log_level": "INFO",
+    "ports": {"http": 4646},
+    "server": {"enabled": False, "bootstrap_expect": 1, "num_schedulers": 2},
+    "client": {"enabled": False, "servers": []},
+    "acl": {"enabled": False},
+    "gossip": {},
+}
+
+
+def deep_merge(base: dict, override: dict) -> dict:
+    """Later config wins; nested dicts merge recursively (the reference's
+    per-struct Merge methods, config.go Merge)."""
+    out = dict(base)
+    for key, value in override.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def _normalize(value):
+    """HCL1 turns repeated blocks into lists of objects; agent config
+    semantics merge them (config_parse.go's object-list handling)."""
+    if isinstance(value, list) and value and all(
+        isinstance(v, dict) for v in value
+    ):
+        merged: dict = {}
+        for v in value:
+            merged = deep_merge(merged, _normalize(v))
+        return merged
+    if isinstance(value, dict):
+        return {k: _normalize(v) for k, v in value.items()}
+    return value
+
+
+def load_agent_config(paths: list[str]) -> dict:
+    """Parse + merge HCL agent config files in order over the defaults."""
+    from .jobspec import parse_hcl
+
+    merged = dict(DEFAULT_AGENT_CONFIG)
+    for path in paths:
+        with open(path) as f:
+            raw = parse_hcl(f.read())
+        merged = deep_merge(merged, _normalize(raw))
+    return merged
+
+
+def apply_log_level(config: dict):
+    """The SIGHUP-reloadable subset (ref agent.go Reload: log level)."""
+    level = str(config.get("log_level", "INFO")).upper()
+    numeric = getattr(logging, level, None)
+    if not isinstance(numeric, int):
+        raise ValueError(f"invalid log_level {level!r}")
+    logging.getLogger("nomad_tpu").setLevel(numeric)
+    return level
+
+
+def server_config_from_agent(config: dict) -> dict:
+    """The Server(...) config dict derived from an agent config."""
+    server = config.get("server", {})
+    out = {
+        "region": config.get("region", "global"),
+        "acl": dict(config.get("acl", {})),
+    }
+    if config.get("gossip"):
+        out["gossip"] = dict(config["gossip"])
+        out["bootstrap"] = bool(server.get("bootstrap_expect", 1) <= 1)
+    for key in (
+        "heartbeat_ttl",
+        "eval_gc_interval",
+        "job_gc_interval",
+        "node_gc_interval",
+        "deployment_gc_interval",
+        "eval_gc_threshold",
+        "job_gc_threshold",
+        "node_gc_threshold",
+        "deployment_gc_threshold",
+        "default_scheduler",
+        "batch_drain",
+        "seed",
+    ):
+        if key in server:
+            out[key] = server[key]
+    return out

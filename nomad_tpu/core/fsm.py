@@ -175,12 +175,16 @@ class FSM:
             payload["drain"],
             strategy=DrainStrategy.from_dict(strategy) if strategy else None,
             mark_eligible=payload.get("mark_eligible", False),
+            updated_at_ns=payload.get("updated_at", 0),
         )
         return index
 
     def _apply_node_eligibility_update(self, index: int, payload: dict):
         self.state.update_node_eligibility(
-            index, payload["node_id"], payload["eligibility"]
+            index,
+            payload["node_id"],
+            payload["eligibility"],
+            updated_at_ns=payload.get("updated_at", 0),
         )
         return index
 

@@ -888,6 +888,9 @@ class Server:
         existed = self.state.node_by_id(node.id) is not None
         if not node.status:
             node.status = NODE_STATUS_READY
+        # stamp before replication: event timestamps must be identical on
+        # every replica and across log replays (like job.submit_time)
+        node.status_updated_at = now_ns()
         self._apply(fsm_mod.NODE_REGISTER, {"node": node.to_dict()})
         self._reset_heartbeat(node.id)
 
@@ -942,7 +945,7 @@ class Server:
         actual migrations; a deadline forces whatever remains."""
         self._check_leader()
         node_id = self._node_id_by_prefix(node_id)
-        payload = {"node_id": node_id, "drain": drain}
+        payload = {"node_id": node_id, "drain": drain, "updated_at": now_ns()}
         if drain:
             payload["drain_strategy"] = {
                 "deadline": deadline_ns,
@@ -963,7 +966,11 @@ class Server:
         self._check_leader()
         self._apply(
             fsm_mod.NODE_ELIGIBILITY_UPDATE,
-            {"node_id": self._node_id_by_prefix(node_id), "eligibility": eligibility},
+            {
+                "node_id": self._node_id_by_prefix(node_id),
+                "eligibility": eligibility,
+                "updated_at": now_ns(),
+            },
         )
 
     def _node_id_by_prefix(self, node_id: str) -> str:

@@ -348,6 +348,23 @@ class StateStore(StateReader):
     def upsert_node(self, index: int, node: Node):
         self.upsert_nodes(index, [node])
 
+    #: events retained per node (ref structs.go MaxRetainedNodeEvents)
+    MAX_NODE_EVENTS = 10
+
+    @staticmethod
+    def _node_event(node: Node, subsystem: str, message: str, at_ns: int):
+        """Append to the node's bounded event ring (ref state_store.go
+        appendNodeEvents + UpsertNodeEventsType). ``at_ns`` comes from the
+        raft payload, never local wall clock — replicas and log replays
+        must produce identical state."""
+        node.events = (list(node.events) + [
+            {
+                "timestamp": at_ns,
+                "subsystem": subsystem,
+                "message": message,
+            }
+        ])[-StateStore.MAX_NODE_EVENTS :]
+
     @_write_txn
     def upsert_nodes(self, index: int, nodes: list[Node]):
         """Bulk node insert: one generation swap for the whole batch (used by
@@ -365,8 +382,15 @@ class StateStore(StateReader):
                 # never force-complete (ref state_store.go upsertNodeTxn)
                 node.drain_strategy = existing.drain_strategy
                 node.scheduling_eligibility = existing.scheduling_eligibility
+                node.events = list(existing.events)
+                self._node_event(
+                    node, "Cluster", "Node re-registered", node.status_updated_at
+                )
             else:
                 node.create_index = index
+                self._node_event(
+                    node, "Cluster", "Node registered", node.status_updated_at
+                )
             node.modify_index = index
             table[node.id] = node
         self._publish(
@@ -392,7 +416,8 @@ class StateStore(StateReader):
         event: Optional[dict] = None,
     ):
         self._update_node(
-            index, node_id, status=status, status_updated_at=updated_at_ns
+            index, node_id, status=status, status_updated_at=updated_at_ns,
+            _event=("Cluster", f"Node status changed to {status}", updated_at_ns),
         )
 
     @_write_txn
@@ -403,6 +428,7 @@ class StateStore(StateReader):
         drain: bool,
         strategy=None,
         mark_eligible: bool = False,
+        updated_at_ns: int = 0,
     ):
         """ref state_store.go UpdateNodeDrain: entering drain makes the node
         ineligible; completing a drain keeps it ineligible unless the caller
@@ -424,13 +450,23 @@ class StateStore(StateReader):
             drain=drain,
             drain_strategy=strategy if drain else None,
             scheduling_eligibility=elig,
+            _event=(
+                "Drain",
+                "Node drain strategy set" if drain else "Node drain complete",
+                updated_at_ns,
+            ),
         )
 
     @_write_txn
-    def update_node_eligibility(self, index: int, node_id: str, eligibility: str):
-        self._update_node(index, node_id, scheduling_eligibility=eligibility)
+    def update_node_eligibility(
+        self, index: int, node_id: str, eligibility: str, updated_at_ns: int = 0
+    ):
+        self._update_node(
+            index, node_id, scheduling_eligibility=eligibility,
+            _event=("Cluster", f"Node marked as {eligibility}", updated_at_ns),
+        )
 
-    def _update_node(self, index: int, node_id: str, **attrs):
+    def _update_node(self, index: int, node_id: str, _event=None, **attrs):
         gen = self._gen
         existing = gen.nodes.get(node_id)
         if existing is None:
@@ -438,6 +474,8 @@ class StateStore(StateReader):
         node = existing.copy()
         for k, v in attrs.items():
             setattr(node, k, v)
+        if _event is not None:
+            self._node_event(node, *_event)
         node.modify_index = index
         nodes = dict(gen.nodes)
         nodes[node_id] = node

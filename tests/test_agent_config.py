@@ -1,0 +1,146 @@
+"""Agent HCL config merge + SIGHUP-reloadable settings + node/task event
+timelines (ref command/agent/config.go, agent.go Reload,
+state_store.go appendNodeEvents, structs.TaskEvent)."""
+
+import logging
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.config import (
+    apply_log_level,
+    deep_merge,
+    load_agent_config,
+    server_config_from_agent,
+)
+from nomad_tpu.state import StateStore
+
+
+class TestAgentConfig:
+    def test_load_and_merge(self, tmp_path):
+        base = tmp_path / "base.hcl"
+        base.write_text(
+            """
+region = "east"
+datacenter = "dc7"
+log_level = "WARNING"
+server {
+  enabled = true
+  num_schedulers = 4
+}
+acl { enabled = true }
+ports { http = 5646 }
+"""
+        )
+        override = tmp_path / "override.hcl"
+        override.write_text(
+            """
+log_level = "DEBUG"
+server { default_scheduler = "tpu-batch" }
+"""
+        )
+        cfg = load_agent_config([str(base), str(override)])
+        assert cfg["region"] == "east"
+        assert cfg["datacenter"] == "dc7"
+        assert cfg["log_level"] == "DEBUG"  # later file wins
+        # nested merge keeps earlier keys
+        assert cfg["server"]["enabled"] is True
+        assert cfg["server"]["num_schedulers"] == 4
+        assert cfg["server"]["default_scheduler"] == "tpu-batch"
+        assert cfg["acl"]["enabled"] is True
+        assert cfg["ports"]["http"] == 5646
+
+        server_cfg = server_config_from_agent(cfg)
+        assert server_cfg["region"] == "east"
+        assert server_cfg["acl"]["enabled"] is True
+        assert server_cfg["default_scheduler"] == "tpu-batch"
+
+    def test_deep_merge_scalars_and_dicts(self):
+        merged = deep_merge(
+            {"a": 1, "b": {"x": 1, "y": 2}}, {"b": {"y": 3, "z": 4}, "c": 5}
+        )
+        assert merged == {"a": 1, "b": {"x": 1, "y": 3, "z": 4}, "c": 5}
+
+    def test_apply_log_level(self):
+        previous = logging.getLogger("nomad_tpu").level
+        try:
+            assert apply_log_level({"log_level": "debug"}) == "DEBUG"
+            assert logging.getLogger("nomad_tpu").level == logging.DEBUG
+            with pytest.raises(ValueError):
+                apply_log_level({"log_level": "noisy"})
+        finally:
+            logging.getLogger("nomad_tpu").setLevel(previous)
+
+
+class TestNodeEvents:
+    def test_event_ring(self):
+        state = StateStore()
+        node = mock.node()
+        state.upsert_node(1, node)
+        stored = state.node_by_id(node.id)
+        assert any("registered" in e["message"] for e in stored.events)
+
+        state.update_node_status(2, node.id, "ready")
+        state.update_node_status(3, node.id, "down")
+        stored = state.node_by_id(node.id)
+        messages = [e["message"] for e in stored.events]
+        assert "Node status changed to ready" in messages
+        assert "Node status changed to down" in messages
+
+        # bounded ring: never more than the retention cap
+        for i in range(4, 30):
+            state.update_node_status(i, node.id, "ready")
+        stored = state.node_by_id(node.id)
+        assert len(stored.events) == StateStore.MAX_NODE_EVENTS
+
+
+class TestTaskEvents:
+    def test_timeline_through_lifecycle(self, tmp_path):
+        from nomad_tpu.client.client import Client
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.raft import InmemTransport, RaftConfig
+
+        cfg = {
+            "seed": 42,
+            "heartbeat_ttl": 600.0,
+            "raft": {
+                "node_id": "s0",
+                "address": "raft0",
+                "voters": {"s0": "raft0"},
+                "transport": InmemTransport(),
+                "config": RaftConfig(
+                    heartbeat_interval=0.02,
+                    election_timeout_min=0.05,
+                    election_timeout_max=0.10,
+                ),
+            },
+        }
+        server = Server(cfg)
+        server.start(num_workers=1, wait_for_leader=5.0)
+        client = Client(server, data_dir=str(tmp_path))
+        client.start()
+        try:
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": "0.2s"}
+            tg.tasks[0].resources.networks = []
+            server.job_register(job)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                allocs = server.state.allocs_by_job(job.namespace, job.id)
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                time.sleep(0.05)
+            (alloc,) = server.state.allocs_by_job(job.namespace, job.id)
+            events = alloc.task_states["web"].events
+            types = [e["type"] for e in events]
+            assert "Received" in types
+            assert "Task Setup" in types
+            assert "Started" in types
+            assert "Terminated" in types
+        finally:
+            client.stop()
+            server.stop()
