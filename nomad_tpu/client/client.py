@@ -1,7 +1,7 @@
 """Client agent: fingerprint, register, heartbeat, watch allocations, and run
-them (ref client/client.go; alloc/task runner hook pipelines simplified to
-the execution core — the full hook chains land with the client hardening
-phase).
+them (ref client/client.go), with durable local state + task recovery,
+prestart hook pipelines (hooks.py), device plugins, and periodic
+re-fingerprinting.
 
 The client talks to the server through a transport interface; in-process
 (dev agent) that is the Server object directly, matching how the reference's
