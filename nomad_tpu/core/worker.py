@@ -108,10 +108,18 @@ class Worker:
             return
         rng = random.Random(self.seed) if self.seed is not None else None
         sched_name = ev.type
-        if self.server.config.get("default_scheduler"):
-            # e.g. route service/batch evals through the tpu-batch backend
-            if ev.type in ("service", "batch"):
-                sched_name = self.server.config["default_scheduler"]
+        override = self.server.config.get("default_scheduler")
+        if override:
+            # route evals through the TPU backends: service/batch take the
+            # generic-semantics tpu-batch, system takes the plane-batched
+            # tpu-system. A non-generic override must never reach
+            # service/batch evals (system semantics ignore group counts).
+            if ev.type in ("service", "batch") and override in (
+                "tpu-batch", "service", "batch"
+            ):
+                sched_name = override
+            elif ev.type == "system" and override in ("tpu-batch", "tpu-system"):
+                sched_name = "tpu-system"
         sched = new_scheduler(sched_name, snapshot, self, rng=rng)
         if collector is not None and hasattr(sched, "drain_collector"):
             # non-tpu schedulers simply never consume the collector; the

@@ -51,12 +51,22 @@ def _tpu_batch_factory(state, planner, rng=None):
     return TPUBatchScheduler(state, planner, rng=rng)
 
 
-# ref scheduler.go:23-29 BuiltinSchedulers + the new TPU backend
+def _tpu_system_factory(state, planner, rng=None):
+    try:
+        from ..tpu.system_sched import TPUSystemScheduler
+    except ImportError as e:
+        raise ValueError(f"scheduler 'tpu-system' backend unavailable: {e}") from e
+
+    return TPUSystemScheduler(state, planner, rng=rng)
+
+
+# ref scheduler.go:23-29 BuiltinSchedulers + the new TPU backends
 BUILTIN_SCHEDULERS: dict[str, Callable] = {
     "service": _service_factory,
     "batch": _batch_factory,
     "system": _system_factory,
     "tpu-batch": _tpu_batch_factory,
+    "tpu-system": _tpu_system_factory,
 }
 
 

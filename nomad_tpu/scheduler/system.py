@@ -251,73 +251,83 @@ class SystemScheduler:
             node = node_by_id.get(missing.alloc.node_id)
             if node is None:
                 raise KeyError(f"could not find node {missing.alloc.node_id}")
+            self._place_one(missing, node)
 
-            self.stack.set_nodes([node])
-            option = self.stack.select(missing.task_group, None)
+    def _place_one(self, missing: AllocTuple, node: Node):
+        """Run the full single-node stack for one system placement (the
+        loop body of system_sched.go:268-402; also the exact-semantics
+        fallback the batched tpu-system path uses for fit failures)."""
+        self.stack.set_nodes([node])
+        option = self.stack.select(missing.task_group, None)
 
-            if option is None:
-                if self.ctx.metrics.nodes_filtered > 0:
-                    self.queued_allocs[missing.task_group.name] -= 1
-                    if (
-                        self.eval.annotate_plan
-                        and self.plan.annotations is not None
-                        and self.plan.annotations.desired_tg_updates
-                    ):
-                        desired = self.plan.annotations.desired_tg_updates.get(
-                            missing.task_group.name
-                        )
-                        if desired is not None:
-                            desired.place -= 1
-                    continue
-                if missing.task_group.name in self.failed_tg_allocs:
-                    self.failed_tg_allocs[
-                        missing.task_group.name
-                    ].coalesced_failures += 1
-                    continue
-                self.ctx.metrics.nodes_available = self.nodes_by_dc
-                self.ctx.metrics.pop_score_meta()
-                self.failed_tg_allocs[missing.task_group.name] = self.ctx.metrics
-                self._add_blocked(node)
-                continue
-
+        if option is None:
+            if self.ctx.metrics.nodes_filtered > 0:
+                self._count_filtered(missing)
+                return
+            if missing.task_group.name in self.failed_tg_allocs:
+                self.failed_tg_allocs[
+                    missing.task_group.name
+                ].coalesced_failures += 1
+                return
             self.ctx.metrics.nodes_available = self.nodes_by_dc
             self.ctx.metrics.pop_score_meta()
+            self.failed_tg_allocs[missing.task_group.name] = self.ctx.metrics
+            self._add_blocked(node)
+            return
 
-            resources = AllocatedResources(
-                tasks=option.task_resources,
-                shared=AllocatedSharedResources(
-                    disk_mb=missing.task_group.ephemeral_disk.size_mb
-                ),
+        self.ctx.metrics.nodes_available = self.nodes_by_dc
+        self.ctx.metrics.pop_score_meta()
+
+        resources = AllocatedResources(
+            tasks=option.task_resources,
+            shared=AllocatedSharedResources(
+                disk_mb=missing.task_group.ephemeral_disk.size_mb
+            ),
+        )
+        if option.alloc_resources is not None:
+            resources.shared.networks = option.alloc_resources.networks
+
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace=self.job.namespace,
+            eval_id=self.eval.id,
+            name=missing.name,
+            job_id=self.job.id,
+            task_group=missing.task_group.name,
+            metrics=self.ctx.metrics,
+            node_id=option.node.id,
+            node_name=option.node.name,
+            allocated_resources=resources,
+            desired_status=ALLOC_DESIRED_STATUS_RUN,
+            client_status=ALLOC_CLIENT_STATUS_PENDING,
+        )
+
+        if missing.alloc is not None and missing.alloc.id:
+            alloc.previous_allocation = missing.alloc.id
+
+        if option.preempted_allocs:
+            preempted_ids = []
+            for stop in option.preempted_allocs:
+                self.plan.append_preempted_alloc(stop, alloc.id)
+                preempted_ids.append(stop.id)
+            alloc.preempted_allocations = preempted_ids
+
+        self.plan.append_alloc(alloc)
+
+    def _count_filtered(self, missing: AllocTuple):
+        """Node filtered by feasibility: not queued, annotation adjusted
+        (system_sched.go:283-300)."""
+        self.queued_allocs[missing.task_group.name] -= 1
+        if (
+            self.eval.annotate_plan
+            and self.plan.annotations is not None
+            and self.plan.annotations.desired_tg_updates
+        ):
+            desired = self.plan.annotations.desired_tg_updates.get(
+                missing.task_group.name
             )
-            if option.alloc_resources is not None:
-                resources.shared.networks = option.alloc_resources.networks
-
-            alloc = Allocation(
-                id=generate_uuid(),
-                namespace=self.job.namespace,
-                eval_id=self.eval.id,
-                name=missing.name,
-                job_id=self.job.id,
-                task_group=missing.task_group.name,
-                metrics=self.ctx.metrics,
-                node_id=option.node.id,
-                node_name=option.node.name,
-                allocated_resources=resources,
-                desired_status=ALLOC_DESIRED_STATUS_RUN,
-                client_status=ALLOC_CLIENT_STATUS_PENDING,
-            )
-
-            if missing.alloc is not None and missing.alloc.id:
-                alloc.previous_allocation = missing.alloc.id
-
-            if option.preempted_allocs:
-                preempted_ids = []
-                for stop in option.preempted_allocs:
-                    self.plan.append_preempted_alloc(stop, alloc.id)
-                    preempted_ids.append(stop.id)
-                alloc.preempted_allocations = preempted_ids
-
-            self.plan.append_alloc(alloc)
+            if desired is not None:
+                desired.place -= 1
 
     def _add_blocked(self, node: Node):
         """ref system_sched.go:406-421"""

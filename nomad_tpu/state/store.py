@@ -696,6 +696,21 @@ class StateStore(StateReader):
             ),
         )
 
+    @staticmethod
+    def _fast_alloc_clone(a: Allocation) -> Allocation:
+        """Shallow clone for plan-apply inserts: the upsert mutates only
+        top-level bookkeeping fields plus deployment_status.modify_index
+        (so that one nested object is rebound). The deep dict-roundtrip
+        copy() costs ~250µs per alloc — at 10-50K placements per plan it
+        was the dominant cost of committing, dwarfing scheduling itself.
+        Nested objects stay shared; every later mutation path in the store
+        copies before writing (the table's immutability contract)."""
+        c = Allocation.__new__(Allocation)
+        c.__dict__ = dict(a.__dict__)
+        if c.deployment_status is not None:
+            c.deployment_status = replace(c.deployment_status)
+        return c
+
     def _upsert_alloc_impl(
         self, gen, table, summaries, deployments, index, alloc, jobs_touched
     ):
@@ -733,7 +748,7 @@ class StateStore(StateReader):
         if alloc.previous_allocation:
             prev = table.get(alloc.previous_allocation)
             if prev is not None:
-                prev = prev.copy()
+                prev = self._fast_alloc_clone(prev)
                 prev.next_allocation = alloc.id
                 prev.modify_index = index
                 table[prev.id] = prev
@@ -1258,7 +1273,7 @@ class StateStore(StateReader):
             to_upsert.extend(allocs)
 
         for a in to_upsert:
-            a = a.copy()
+            a = self._fast_alloc_clone(a)
             # Re-attach the job pulled out of the plan payload
             if a.job is None:
                 a.job = plan.job

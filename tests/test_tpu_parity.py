@@ -248,6 +248,69 @@ class TestKernelParity:
         assert_parity(nodes, make_job(80, mutate), min_match=0.97)
         assert batch_sched.LAST_KERNEL_STATS.get("mode") == "runs"
 
+    def test_system_planes_parity(self):
+        """tpu-system places the same set as the oracle system scheduler,
+        with infeasible nodes filtered identically (one plane build instead
+        of one stack walk per node)."""
+        from nomad_tpu import mock as mock_mod
+        from nomad_tpu.structs import compute_class
+        from nomad_tpu.structs.model import Constraint
+        from nomad_tpu.tpu import batch_sched
+
+        nodes = build_cluster(60)
+        for i, n in enumerate(nodes):
+            n.attributes["rack_class"] = "a" if i % 3 else "b"
+            compute_class(n)
+
+        def sys_job():
+            j = mock_mod.system_job()
+            j.constraints = [
+                Constraint(l_target="${attr.kernel.name}", r_target="linux", operand="="),
+                Constraint(l_target="${attr.rack_class}", r_target="a", operand="="),
+            ]
+            j.task_groups[0].tasks[0].resources.networks = []
+            return j
+
+        job = sys_job()
+        _, _, h_oracle = run(nodes, job, "system")
+        job2 = job.copy()
+        _, _, h_batch = run([n.copy() for n in nodes], job2, "tpu-system")
+        # system allocs share one name per group — compare by node set
+        oracle_nodes = {
+            a.node_id for a in h_oracle.state.allocs_by_job(job.namespace, job.id)
+        }
+        batch_nodes = {
+            a.node_id for a in h_batch.state.allocs_by_job(job2.namespace, job2.id)
+        }
+        assert len(oracle_nodes) == len(batch_nodes) == 40
+        # same rack-'a' filter applied on both paths (node objects are
+        # copies, so compare by attribute)
+        assert all(
+            h_batch.state.node_by_id(nid).attributes["rack_class"] == "a"
+            for nid in batch_nodes
+        )
+        assert batch_sched.SCHED_COUNTERS["modes"].get("system-planes", 0) >= 1
+
+    def test_system_planes_fit_fallback(self):
+        """A full node routes through the exact single-node walk and fails
+        with real metrics, while the rest place densely."""
+        nodes = build_cluster(40)
+        full = nodes[0]
+        full.node_resources.cpu.cpu_shares = 10  # too small for the task
+
+        from nomad_tpu import mock as mock_mod
+
+        job = mock_mod.system_job()
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].tasks[0].resources.cpu = 100
+        _, sched, h = run(nodes, job, "tpu-system")
+        placed_nodes = {
+            a.node_id for a in h.state.allocs_by_job(job.namespace, job.id)
+        }
+        assert len(placed_nodes) == 39
+        assert full.id not in placed_nodes
+        assert sched.failed_tg_allocs, "full node surfaces failure metrics"
+
     def test_fallback_on_networks(self):
         # job with dynamic ports must fall back to the oracle path and still place
         nodes = build_cluster(5)
