@@ -569,7 +569,7 @@ class Client:
                 # (a crash between the stop decision and the actual kill
                 # would otherwise orphan a live process forever)
                 self._kill_orphans(alloc)
-                self.state_db.delete_alloc(alloc.id)
+                self._forget_alloc(alloc.id)
                 continue
             job = alloc.job
             tg = job.lookup_task_group(alloc.task_group) if job else None
@@ -724,12 +724,21 @@ class Client:
             logger.exception("persisting alloc failed")
 
     def _forget_alloc(self, alloc_id: str):
-        if self.state_db is None:
-            return
-        try:
-            self.state_db.delete_alloc(alloc_id)
-        except Exception:
-            logger.exception("deleting alloc state failed")
+        if self.state_db is not None:
+            try:
+                self.state_db.delete_alloc(alloc_id)
+            except Exception:
+                logger.exception("deleting alloc state failed")
+        # alloc-dir GC (ref client/gc.go AllocGarbageCollector): a forgotten
+        # alloc's directory tree is reclaimed, or the data dir grows forever
+        import shutil
+
+        d = os.path.join(self.data_dir, "allocs", alloc_id)
+        if os.path.isdir(d):
+            try:
+                shutil.rmtree(d)
+            except OSError:
+                logger.exception("alloc dir GC failed for %s", alloc_id)
 
     # ------------------------------------------------------------------
     def alloc_state_updated(self, runner: AllocRunner):

@@ -379,6 +379,26 @@ class ExecDriver(RawExecDriver):
                 self._healthy = True
         except Exception:
             self._healthy = False
+        self._sweep_stale_cgroups()
+
+    @staticmethod
+    def _sweep_stale_cgroups():
+        """A SIGKILL'd shepherd never runs its cgroup cleanup; empty
+        nomad-* groups left behind are reclaimed here at driver startup so
+        a churning node can't accumulate them forever."""
+        import glob
+        import os
+
+        for root in (
+            "/sys/fs/cgroup",
+            "/sys/fs/cgroup/memory",
+            "/sys/fs/cgroup/cpu",
+        ):
+            for d in glob.glob(os.path.join(root, "nomad-*")):
+                try:
+                    os.rmdir(d)  # only succeeds when the group is empty
+                except OSError:
+                    pass
 
     def fingerprint(self) -> dict:
         return {
@@ -394,13 +414,18 @@ class ExecDriver(RawExecDriver):
         command = cfg.get("command")
         if not command:
             raise RuntimeError("exec requires a command")
-        args = [
-            self._nsexec,
-            "--workdir",
-            task_dir or "/",
-            "--",
-            command,
-        ] + list(cfg.get("args", []))
+        args = [self._nsexec, "--workdir", task_dir or "/"]
+        # resource enforcement via the shepherd's cgroup (the executor's
+        # resource-container role): best-effort, keyed uniquely per start
+        if cfg.get("enforce_resources", True):
+            import uuid as _uuid
+
+            args += ["--cgroup", f"{task.name}-{_uuid.uuid4().hex[:8]}"]
+            if task.resources.memory_mb:
+                args += ["--memory-mb", str(task.resources.memory_mb)]
+            if task.resources.cpu:
+                args += ["--cpu-shares", str(task.resources.cpu)]
+        args += ["--", command] + list(cfg.get("args", []))
         return self._spawn(task, args, None, log_base=task_dir)
 
 

@@ -10,11 +10,19 @@
 //
 // usage:
 //   nsexec --check                     exit 0 iff isolation is available
-//   nsexec [--workdir D] [--hostname H] -- cmd [args...]
+//   nsexec [--workdir D] [--hostname H] [--cgroup NAME]
+//          [--memory-mb N] [--cpu-shares N] -- cmd [args...]
+//
+// --cgroup enables best-effort resource limits (the executor's
+// resource-container role, drivers/shared/executor resourceContainer):
+// cgroup v2 unified (memory.max / cpu.weight) when available, else
+// cgroup v1 memory/cpu controllers. The task enters the group before
+// exec; the shepherd removes the group after the namespace empties.
 //
 // exit codes: task's own status, or 125 for shepherd-level failures.
 
 #include <errno.h>
+#include <fcntl.h>
 #include <sched.h>
 #include <signal.h>
 #include <stdio.h>
@@ -22,12 +30,112 @@
 #include <string.h>
 #include <sys/mount.h>
 #include <sys/prctl.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
 static const int SHEPHERD_ERR = 125;
 static pid_t task_pid = -1;
+
+static int write_file(const char *path, const char *value) {
+  int fd = open(path, O_WRONLY);
+  if (fd < 0) return -1;
+  ssize_t n = write(fd, value, strlen(value));
+  close(fd);
+  return n < 0 ? -1 : 0;
+}
+
+// cgroup state shared with the child via these globals (set before fork)
+static char cg_mem_dir[512] = "";
+static char cg_cpu_dir[512] = "";
+static int cg_v2 = 0;
+
+static void setup_cgroups(const char *name, long memory_mb, long cpu_shares) {
+  char buf[64];
+  if (access("/sys/fs/cgroup/cgroup.controllers", R_OK) == 0) {
+    // unified v2 hierarchy
+    cg_v2 = 1;
+    snprintf(cg_mem_dir, sizeof cg_mem_dir, "/sys/fs/cgroup/nomad-%s", name);
+    if (mkdir(cg_mem_dir, 0755) != 0 && errno != EEXIST) {
+      fprintf(stderr, "nsexec: warning: cgroup mkdir: %s\n", strerror(errno));
+      cg_mem_dir[0] = '\0';
+      return;
+    }
+    char path[600];
+    if (memory_mb > 0) {
+      snprintf(path, sizeof path, "%s/memory.max", cg_mem_dir);
+      snprintf(buf, sizeof buf, "%ld", memory_mb * 1024 * 1024);
+      if (write_file(path, buf) != 0)
+        fprintf(stderr, "nsexec: warning: memory.max: %s\n", strerror(errno));
+      // no swap escape hatch: over-limit must kill, not page out
+      snprintf(path, sizeof path, "%s/memory.swap.max", cg_mem_dir);
+      write_file(path, "0");
+    }
+    if (cpu_shares > 0) {
+      // v2 weight 1..10000; map shares (1024 default) proportionally
+      long weight = cpu_shares * 100 / 1024;
+      if (weight < 1) weight = 1;
+      if (weight > 10000) weight = 10000;
+      snprintf(path, sizeof path, "%s/cpu.weight", cg_mem_dir);
+      snprintf(buf, sizeof buf, "%ld", weight);
+      if (write_file(path, buf) != 0)
+        fprintf(stderr, "nsexec: warning: cpu.weight: %s\n", strerror(errno));
+    }
+    return;
+  }
+  // v1 split hierarchies
+  if (memory_mb > 0) {
+    snprintf(cg_mem_dir, sizeof cg_mem_dir,
+             "/sys/fs/cgroup/memory/nomad-%s", name);
+    if (mkdir(cg_mem_dir, 0755) == 0 || errno == EEXIST) {
+      char path[600];
+      snprintf(path, sizeof path, "%s/memory.limit_in_bytes", cg_mem_dir);
+      snprintf(buf, sizeof buf, "%ld", memory_mb * 1024 * 1024);
+      if (write_file(path, buf) != 0)
+        fprintf(stderr, "nsexec: warning: memory limit: %s\n", strerror(errno));
+      // cap memory+swap at the same limit (kill instead of paging out)
+      snprintf(path, sizeof path, "%s/memory.memsw.limit_in_bytes", cg_mem_dir);
+      write_file(path, buf);
+    } else {
+      fprintf(stderr, "nsexec: warning: memory cgroup: %s\n", strerror(errno));
+      cg_mem_dir[0] = '\0';
+    }
+  }
+  if (cpu_shares > 0) {
+    snprintf(cg_cpu_dir, sizeof cg_cpu_dir, "/sys/fs/cgroup/cpu/nomad-%s", name);
+    if (mkdir(cg_cpu_dir, 0755) == 0 || errno == EEXIST) {
+      char path[600];
+      snprintf(path, sizeof path, "%s/cpu.shares", cg_cpu_dir);
+      snprintf(buf, sizeof buf, "%ld", cpu_shares);
+      if (write_file(path, buf) != 0)
+        fprintf(stderr, "nsexec: warning: cpu shares: %s\n", strerror(errno));
+    } else {
+      fprintf(stderr, "nsexec: warning: cpu cgroup: %s\n", strerror(errno));
+      cg_cpu_dir[0] = '\0';
+    }
+  }
+}
+
+static void enter_cgroups(void) {
+  // writing "0" adds the calling process; done by the task child pre-exec
+  char path[600];
+  if (cg_mem_dir[0]) {
+    snprintf(path, sizeof path, "%s/cgroup.procs", cg_mem_dir);
+    if (write_file(path, "0") != 0)
+      fprintf(stderr, "nsexec: warning: cgroup join: %s\n", strerror(errno));
+  }
+  if (!cg_v2 && cg_cpu_dir[0]) {
+    snprintf(path, sizeof path, "%s/cgroup.procs", cg_cpu_dir);
+    if (write_file(path, "0") != 0)
+      fprintf(stderr, "nsexec: warning: cpu cgroup join: %s\n", strerror(errno));
+  }
+}
+
+static void cleanup_cgroups(void) {
+  if (cg_mem_dir[0]) rmdir(cg_mem_dir);
+  if (cg_cpu_dir[0]) rmdir(cg_cpu_dir);
+}
 
 static void forward_signal(int sig) {
   if (task_pid > 0) kill(task_pid, sig);
@@ -53,6 +161,9 @@ static int check_isolation() {
 int main(int argc, char **argv) {
   const char *workdir = NULL;
   const char *hostname = "nomad-task";
+  const char *cgroup = NULL;
+  long memory_mb = 0;
+  long cpu_shares = 0;
   int i = 1;
   for (; i < argc; i++) {
     if (strcmp(argv[i], "--check") == 0) {
@@ -61,6 +172,12 @@ int main(int argc, char **argv) {
       workdir = argv[++i];
     } else if (strcmp(argv[i], "--hostname") == 0 && i + 1 < argc) {
       hostname = argv[++i];
+    } else if (strcmp(argv[i], "--cgroup") == 0 && i + 1 < argc) {
+      cgroup = argv[++i];
+    } else if (strcmp(argv[i], "--memory-mb") == 0 && i + 1 < argc) {
+      memory_mb = atol(argv[++i]);
+    } else if (strcmp(argv[i], "--cpu-shares") == 0 && i + 1 < argc) {
+      cpu_shares = atol(argv[++i]);
     } else if (strcmp(argv[i], "--") == 0) {
       i++;
       break;
@@ -75,8 +192,11 @@ int main(int argc, char **argv) {
   }
   char **cmd = &argv[i];
 
+  if (cgroup != NULL) setup_cgroups(cgroup, memory_mb, cpu_shares);
+
   if (unshare(ns_flags()) != 0) {
     fprintf(stderr, "nsexec: unshare: %s\n", strerror(errno));
+    cleanup_cgroups();
     return SHEPHERD_ERR;
   }
 
@@ -92,6 +212,7 @@ int main(int argc, char **argv) {
     int status = 0;
     while (waitpid(init_pid, &status, 0) < 0 && errno == EINTR) {
     }
+    cleanup_cgroups();  // namespace empty: the group can be removed
     if (WIFEXITED(status)) return WEXITSTATUS(status);
     if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
     return SHEPHERD_ERR;
@@ -113,6 +234,7 @@ int main(int argc, char **argv) {
   pid_t child = fork();
   if (child < 0) _exit(SHEPHERD_ERR);
   if (child == 0) {
+    enter_cgroups();  // join before exec so the limits cover the task
     if (workdir && chdir(workdir) != 0) {
       fprintf(stderr, "nsexec: chdir %s: %s\n", workdir, strerror(errno));
       _exit(SHEPHERD_ERR);

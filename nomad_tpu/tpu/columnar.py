@@ -79,6 +79,22 @@ class ColumnarCluster:
         # Scoring denominators (ScoreFit: total - reserved; funcs.go:160-165)
         self.usable = (self.capacity[:, :2] - self.reserved[:, :2]).astype(np.float32)
 
+    @staticmethod
+    def sum_alloc_usage(allocs, into=None) -> np.ndarray:
+        """Σ (cpu, memory_mb, disk_mb) over non-terminal allocs — THE
+        resource accumulation (AllocsFit's summation, funcs.go:104-117);
+        single definition shared by the plane builders and the fallback
+        recompute paths."""
+        used = into if into is not None else np.zeros(3, dtype=np.int64)
+        for a in allocs:
+            if a.allocated_resources is None:
+                continue
+            c = a.comparable_resources()
+            used[0] += c.flattened.cpu.cpu_shares
+            used[1] += c.flattened.memory.memory_mb
+            used[2] += c.shared.disk_mb
+        return used
+
     def initial_used(self, state, plan=None) -> np.ndarray:
         """used = reserved + Σ non-terminal alloc resources per node (the
         accumulation AllocsFit performs per check, funcs.go:104-117),
@@ -92,13 +108,7 @@ class ColumnarCluster:
                 update = plan.node_update.get(node.id, [])
                 if update:
                     allocs = remove_allocs(allocs, update)
-            for a in allocs:
-                if a.allocated_resources is None:
-                    continue
-                c = a.comparable_resources()
-                used[i, 0] += c.flattened.cpu.cpu_shares
-                used[i, 1] += c.flattened.memory.memory_mb
-                used[i, 2] += c.shared.disk_mb
+            self.sum_alloc_usage(allocs, into=used[i])
         return used
 
     def collision_counts(self, state, job_id: str, tg_name: str) -> np.ndarray:

@@ -168,11 +168,4 @@ class TPUSystemScheduler(SystemScheduler):
         allocs = remove_allocs(allocs, removed)
         allocs = allocs + self.plan.node_allocation.get(node.id, [])
         used = np.array(cluster.reserved[idx], dtype=np.int64)
-        for a in allocs:
-            if a.allocated_resources is None:
-                continue
-            c = a.comparable_resources()
-            used[0] += c.flattened.cpu.cpu_shares
-            used[1] += c.flattened.memory.memory_mb
-            used[2] += c.shared.disk_mb
-        return used
+        return ColumnarCluster.sum_alloc_usage(allocs, into=used)

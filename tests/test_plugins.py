@@ -177,6 +177,50 @@ class TestExecDriver:
             )
             assert visible < host_visible and visible <= 4
 
+    def test_memory_limit_enforced(self):
+        """The shepherd's cgroup kills a task exceeding its memory ask
+        (the executor resource-container role)."""
+        import shutil
+
+        def _cgroup_writable():
+            for base in ("/sys/fs/cgroup/memory", "/sys/fs/cgroup"):
+                probe = os.path.join(base, "nomad-probe-test")
+                try:
+                    os.mkdir(probe)
+                except OSError:
+                    continue
+                os.rmdir(probe)
+                return True
+            return False
+
+        if not _cgroup_writable():
+            pytest.skip("no writable cgroup hierarchy")
+        driver = ExecDriver()
+        with tempfile.TemporaryDirectory() as d:
+            py = shutil.which("python3") or "/usr/bin/python3"
+            task = Task(
+                name="oom",
+                driver="exec",
+                config={
+                    "command": py,
+                    "args": ["-c", "x = bytearray(256*1024*1024)"],
+                },
+            )
+            task.resources.memory_mb = 64
+            handle = driver.start_task(task, d)
+            assert handle.wait(timeout=30.0)
+            assert handle.exit_code != 0, "over-limit task must be killed"
+
+            ok = Task(
+                name="fits",
+                driver="exec",
+                config={"command": py, "args": ["-c", "x = bytearray(16*1024*1024)"]},
+            )
+            ok.resources.memory_mb = 512
+            h2 = driver.start_task(ok, d)
+            assert h2.wait(timeout=30.0)
+            assert h2.exit_code == 0
+
     def test_stop_kills_tree(self):
         driver = ExecDriver()
         with tempfile.TemporaryDirectory() as d:
