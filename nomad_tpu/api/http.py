@@ -57,6 +57,15 @@ def _acl_allows(acl, spec, query) -> bool:
     return bool(check and check())
 
 
+class RawResponse:
+    """A handler result served verbatim instead of as JSON (the metrics
+    endpoint's prometheus exposition, http.go's formatted responses)."""
+
+    def __init__(self, content_type: str, body: bytes):
+        self.content_type = content_type
+        self.body = body
+
+
 class _DecodedMatch:
     """Percent-decodes captured path segments so derived child job IDs
     (``<id>/periodic-<ts>``, ``<id>/dispatch-<ts>-<uuid>``) resolve when
@@ -164,6 +173,14 @@ class HTTPServer:
                             result, index = getattr(api, name)(
                                 _DecodedMatch(match), query, body
                             )
+                            if isinstance(result, RawResponse):
+                                data = result.body
+                                self.send_response(200)
+                                self.send_header("Content-Type", result.content_type)
+                                self.send_header("Content-Length", str(len(data)))
+                                self.end_headers()
+                                self.wfile.write(data)
+                                return
                             self._respond(200, result, index)
                         except KeyError as e:
                             self._respond(404, {"error": str(e)}, None)
@@ -643,6 +660,71 @@ class HTTPServer:
             None,
         )
 
+    # -- services (a nomad-native service catalog: the reference registers
+    # workload services into Consul, command/agent/consul/ — here the same
+    # service/check data is served straight from cluster state) ----------
+    def _service_entries(self, snap, query, name_filter=None):
+        out = []
+        for alloc in snap.allocs():
+            if alloc.terminal_status() or not self._ns_visible(
+                query, alloc.namespace, "read-job"
+            ):
+                continue
+            job = alloc.job
+            tg = job.lookup_task_group(alloc.task_group) if job else None
+            if tg is None:
+                continue
+            for task in tg.tasks:
+                state = alloc.task_states.get(task.name)
+                healthy = state is not None and state.state == "running"
+                for svc in task.services:
+                    if name_filter and svc.name != name_filter:
+                        continue
+                    address, port = "", 0
+                    resources = alloc.allocated_resources
+                    tr = (
+                        resources.tasks.get(task.name)
+                        if resources is not None
+                        else None
+                    )
+                    if tr is not None and svc.port_label:
+                        for net in tr.networks:
+                            for p in list(net.reserved_ports) + list(
+                                net.dynamic_ports
+                            ):
+                                if p.label == svc.port_label:
+                                    address, port = net.ip, p.value
+                    out.append(
+                        {
+                            "ServiceName": svc.name,
+                            "Tags": list(svc.tags),
+                            "AllocID": alloc.id,
+                            "JobID": alloc.job_id,
+                            "NodeID": alloc.node_id,
+                            "Address": address,
+                            "Port": port,
+                            "Status": "passing" if healthy else "critical",
+                        }
+                    )
+        return out
+
+    @route("GET", r"/v1/services", acl="ns:read-job")
+    def list_services(self, m, query, body):
+        def run(snap):
+            return self._service_entries(snap, query)
+
+        return self._blocking(query, run)
+
+    @route("GET", r"/v1/service/(?P<name>[^/]+)", acl="ns:read-job")
+    def get_service(self, m, query, body):
+        def run(snap):
+            entries = self._service_entries(snap, query, name_filter=m["name"])
+            if not entries:
+                raise KeyError(f"service not found: {m['name']}")
+            return entries
+
+        return self._blocking(query, run)
+
     @route("GET", r"/v1/regions", acl="anonymous")
     def list_regions(self, m, query, body):
         """ref nomad/regions_endpoint.go List"""
@@ -657,19 +739,36 @@ class HTTPServer:
         from ..tpu import batch_sched
         from ..tpu import drain as drain_mod
 
-        return (
-            {
-                "broker": self.server.eval_broker.stats(),
-                "blocked_evals": self.server.blocked_evals.stats(),
-                "plan_queue_depth": self.server.planner.queue.depth(),
-                "state_index": self.server.state.latest_index(),
-                # kernel-vs-oracle routing (VERDICT r1 weak #10): how many
-                # evals rode the TPU path, by mode, and why the rest didn't
-                "tpu_scheduler": batch_sched.counters_snapshot(),
-                "drain": dict(drain_mod.DRAIN_COUNTERS),
-            },
-            None,
-        )
+        payload = {
+            "broker": self.server.eval_broker.stats(),
+            "blocked_evals": self.server.blocked_evals.stats(),
+            "plan_queue_depth": self.server.planner.queue.depth(),
+            "state_index": self.server.state.latest_index(),
+            # kernel-vs-oracle routing (VERDICT r1 weak #10): how many
+            # evals rode the TPU path, by mode, and why the rest didn't
+            "tpu_scheduler": batch_sched.counters_snapshot(),
+            "drain": dict(drain_mod.DRAIN_COUNTERS),
+        }
+        if query.get("format") == "prometheus":
+            # text exposition (the reference's prometheus telemetry sink,
+            # config.go:500-577 / /v1/metrics?format=prometheus)
+            lines = []
+
+            def emit(prefix, value):
+                if isinstance(value, dict):
+                    for k, v in value.items():
+                        key = str(k).replace("-", "_").replace(".", "_")
+                        emit(f"{prefix}_{key}", v)
+                elif isinstance(value, (int, float)) and not isinstance(value, bool):
+                    lines.append(f"# TYPE {prefix} gauge")
+                    lines.append(f"{prefix} {value}")
+
+            emit("nomad_tpu", payload)
+            return RawResponse(
+                "text/plain; version=0.0.4",
+                ("\n".join(lines) + "\n").encode(),
+            ), None
+        return payload, None
 
     @route("PUT", r"/v1/system/gc", acl="operator:write")
     def system_gc(self, m, query, body):

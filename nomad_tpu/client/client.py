@@ -69,6 +69,9 @@ class TaskRunner:
         # bounded event timeline surviving state transitions
         # (ref structs.TaskEvent + TaskState.Events)
         self._events: list[dict] = []
+        # one vault token per task lifecycle: restarts reuse it instead of
+        # minting (and leaking) a fresh accessor per attempt
+        self._vault_token: Optional[str] = None
         if restored_state:
             self.state.restarts = int(restored_state.get("restarts", 0))
             self._restarts_in_interval = [
@@ -121,6 +124,7 @@ class TaskRunner:
                         self.alloc_runner.alloc_dir(),
                         extra_env=self.alloc_runner.device_env(self.task.name),
                     )
+                    self._vault_hook(task, task_dir)
                     self.handle = self.driver.start_task(task, task_dir)
                 except Exception as e:
                     # Start failures route through the restart policy like any
@@ -193,6 +197,29 @@ class TaskRunner:
             self._event("Terminated", f"Exit Code: {exit_code}, failed")
             self.alloc_runner.task_state_updated()
             return
+
+    def _vault_hook(self, task, task_dir: str):
+        """Derive the task's vault token and deliver it into secrets/
+        (+ VAULT_TOKEN when the stanza asks; ref vault_hook.go)."""
+        if self.task.vault is None:
+            return
+        if self._vault_token is None:
+            server = self.alloc_runner.client.server
+            derive = getattr(server, "derive_vault_token", None)
+            if derive is None:
+                raise RuntimeError("server transport lacks vault token derivation")
+            self._vault_token = derive(
+                self.alloc_runner.alloc.id, self.task.name
+            )
+        token = self._vault_token
+        secrets = os.path.join(task_dir, "secrets")
+        os.makedirs(secrets, exist_ok=True)
+        token_path = os.path.join(secrets, "vault_token")
+        with open(token_path, "w") as f:
+            f.write(token)
+        os.chmod(token_path, 0o600)
+        if self.task.vault.env:
+            task.env = {**task.env, "VAULT_TOKEN": token}
 
     def _restart_or_wait(self, policy) -> bool:
         """Decide whether to restart and sleep out the backoff. In 'delay'

@@ -389,6 +389,9 @@ class ExecDriver(RawExecDriver):
         import glob
         import os
 
+        import time as _time
+
+        now = _time.time()
         for root in (
             "/sys/fs/cgroup",
             "/sys/fs/cgroup/memory",
@@ -396,6 +399,11 @@ class ExecDriver(RawExecDriver):
         ):
             for d in glob.glob(os.path.join(root, "nomad-*")):
                 try:
+                    # age gate: a freshly created group may belong to a task
+                    # whose child hasn't joined yet (another client's nsexec
+                    # between setup and enter)
+                    if now - os.stat(d).st_mtime < 300:
+                        continue
                     os.rmdir(d)  # only succeeds when the group is empty
                 except OSError:
                     pass

@@ -363,6 +363,9 @@ class CoreScheduler:
         """ref core_sched.go:346-412 evalReap (partitioned raft deletes)"""
         from . import fsm as fsm_mod
 
+        if allocs and self.server.vault.enabled():
+            self.server.vault.revoke_for_allocs(list(allocs))
+
         evals = list(evals)
         allocs = list(allocs)
         while evals or allocs:

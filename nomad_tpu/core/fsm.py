@@ -63,6 +63,8 @@ ACL_POLICY_UPSERT = "acl_policy_upsert"
 ACL_POLICY_DELETE = "acl_policy_delete"
 ACL_TOKEN_UPSERT = "acl_token_upsert"
 ACL_TOKEN_DELETE = "acl_token_delete"
+VAULT_ACCESSOR_UPSERT = "vault_accessor_upsert"
+VAULT_ACCESSOR_DELETE = "vault_accessor_delete"
 NOOP = "noop"
 
 
@@ -109,6 +111,8 @@ class FSM:
             ACL_POLICY_DELETE: self._apply_acl_policy_delete,
             ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
             ACL_TOKEN_DELETE: self._apply_acl_token_delete,
+            VAULT_ACCESSOR_UPSERT: self._apply_vault_accessor_upsert,
+            VAULT_ACCESSOR_DELETE: self._apply_vault_accessor_delete,
             NOOP: lambda index, payload: None,
         }
 
@@ -411,6 +415,14 @@ class FSM:
     # ACL appliers (ref fsm.go applyACL*; store methods land with the ACL
     # subsystem — gated so replication of ACL entries never crashes)
     # ------------------------------------------------------------------
+    def _apply_vault_accessor_upsert(self, index: int, payload: dict):
+        self.state.upsert_vault_accessors(index, payload["accessors"])
+        return index
+
+    def _apply_vault_accessor_delete(self, index: int, payload: dict):
+        self.state.delete_vault_accessors(index, payload["accessors"])
+        return index
+
     def _apply_acl_policy_upsert(self, index: int, payload: dict):
         if hasattr(self.state, "upsert_acl_policies"):
             self.state.upsert_acl_policies(index, payload["policies"])

@@ -110,6 +110,9 @@ class Server:
         self.region = self.config.get("region", "global")
         self.raft = self._setup_raft()
         self.gossip = self._setup_gossip()
+        from .vault import VaultClient
+
+        self.vault = VaultClient(self)
 
     # ------------------------------------------------------------------
     # raft wiring (ref server.go:1075 setupRaft)
@@ -677,6 +680,11 @@ class Server:
             except BrokerError:
                 pass  # acked/nacked while the plan was in flight
 
+    def derive_vault_token(self, alloc_id: str, task_name: str) -> str:
+        """ref node_endpoint.go DeriveVaultToken"""
+        self._check_leader()
+        return self.vault.derive_token(alloc_id, task_name)
+
     def system_gc(self):
         """Force-GC everything eligible (ref system_endpoint.go GarbageCollect
         → CoreJobForceGC). Leader-only."""
@@ -1098,6 +1106,12 @@ class Server:
                 "evals": [e.to_dict() for e in evals],
             },
         )
+        if self.vault.enabled():
+            terminal = [a.id for a in allocs if a.client_terminal_status()]
+            if terminal:
+                # alloc done → its vault tokens die with it (vault.go
+                # RevokeTokens on terminal allocations)
+                self.vault.revoke_for_allocs(terminal)
 
     # ------------------------------------------------------------------
     # Eval endpoints (ref nomad/eval_endpoint.go)

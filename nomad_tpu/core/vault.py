@@ -1,0 +1,128 @@
+"""Vault integration: task token derivation + accessor lifecycle
+(ref nomad/vault.go: DeriveVaultToken, accessor tracking, revocation on
+alloc termination).
+
+The reference talks to a real Vault server through a renewable management
+token. Here the token LIFECYCLE is implemented against a pluggable
+provider: ``InternalProvider`` mints standalone secrets (the zero-
+dependency default, suitable for dev and for the secret-delivery contract
+tests), and a real-Vault provider only needs create/revoke against the
+external API. Accessors replicate through raft so a new leader can keep
+revoking; tokens themselves never enter server state — only the client's
+secrets dir."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Protocol
+
+from ..structs.model import generate_uuid
+
+logger = logging.getLogger("nomad_tpu.vault")
+
+
+class VaultProvider(Protocol):
+    def create_token(self, policies: list[str]) -> tuple[str, str]:
+        """→ (secret token, accessor)"""
+        ...
+
+    def revoke_accessor(self, accessor: str) -> None: ...
+
+
+class InternalProvider:
+    """Standalone token mint (dev mode / tests): uuid secrets, revocation
+    is bookkeeping only."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live: dict[str, str] = {}  # accessor -> token
+
+    def create_token(self, policies: list[str]) -> tuple[str, str]:
+        token = f"s.{generate_uuid()}"
+        accessor = generate_uuid()
+        with self._lock:
+            self._live[accessor] = token
+        return token, accessor
+
+    def revoke_accessor(self, accessor: str) -> None:
+        with self._lock:
+            self._live.pop(accessor, None)
+
+    def is_live(self, accessor: str) -> bool:
+        with self._lock:
+            return accessor in self._live
+
+
+class VaultClient:
+    """Server-side vault workflow (ref vault.go vaultClient)."""
+
+    def __init__(self, server, provider: Optional[VaultProvider] = None):
+        self.server = server
+        self.provider = provider or InternalProvider()
+
+    def enabled(self) -> bool:
+        return bool(self.server.config.get("vault", {}).get("enabled"))
+
+    # ------------------------------------------------------------------
+    def derive_token(self, alloc_id: str, task_name: str) -> str:
+        """Create a token for a task's vault stanza and track its accessor
+        (ref node_endpoint.go DeriveVaultToken → vault.go CreateToken)."""
+        if not self.enabled():
+            raise ValueError("vault integration is disabled")
+        alloc = self.server.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        task = None
+        if tg is not None:
+            task = next((t for t in tg.tasks if t.name == task_name), None)
+        if task is None or task.vault is None:
+            raise ValueError(
+                f"task {task_name!r} does not declare a vault stanza"
+            )
+        token, accessor = self.provider.create_token(list(task.vault.policies))
+        from . import fsm as fsm_mod
+
+        self.server._apply(
+            fsm_mod.VAULT_ACCESSOR_UPSERT,
+            {
+                "accessors": [
+                    {
+                        "accessor": accessor,
+                        "alloc_id": alloc_id,
+                        "task": task_name,
+                        "node_id": alloc.node_id,
+                    }
+                ]
+            },
+        )
+        return token
+
+    # ------------------------------------------------------------------
+    def revoke_for_allocs(self, alloc_ids: list[str]):
+        """Revoke every accessor tied to the given allocs (the reference
+        revokes when allocs terminate/GC, vault.go RevokeTokens)."""
+        ids = set(alloc_ids)
+        targets = [
+            a["accessor"]
+            for a in self.server.state.vault_accessors()
+            if a["alloc_id"] in ids
+        ]
+        if not targets:
+            return
+        for accessor in targets:
+            try:
+                self.provider.revoke_accessor(accessor)
+            except Exception:
+                logger.exception("vault revoke failed for %s", accessor)
+        from . import fsm as fsm_mod
+        from .core_sched import MAX_IDS_PER_REAP
+
+        # bounded raft entries, like every other reap path
+        for start in range(0, len(targets), MAX_IDS_PER_REAP):
+            self.server._apply(
+                fsm_mod.VAULT_ACCESSOR_DELETE,
+                {"accessors": targets[start : start + MAX_IDS_PER_REAP]},
+            )

@@ -70,6 +70,7 @@ class Generation:
     scheduler_config: Optional[dict] = None
     acl_policies: dict[str, "AclPolicy"] = field(default_factory=dict)
     acl_tokens: dict[str, "AclToken"] = field(default_factory=dict)  # by accessor
+    vault_accessors: dict[str, dict] = field(default_factory=dict)  # by accessor
     table_indexes: dict[str, int] = field(default_factory=dict)
 
 
@@ -223,6 +224,10 @@ class StateReader:
     # -- config -----------------------------------------------------------
     def scheduler_config(self) -> Optional[dict]:
         return self._gen.scheduler_config
+
+    # -- vault ------------------------------------------------------------
+    def vault_accessors(self) -> list[dict]:
+        return list(self._gen.vault_accessors.values())
 
     # -- acl --------------------------------------------------------------
     def acl_policies(self) -> Iterable["AclPolicy"]:
@@ -1166,6 +1171,32 @@ class StateStore(StateReader):
         )
 
     @_write_txn
+    def upsert_vault_accessors(self, index: int, accessors: list[dict]):
+        """ref state_store.go UpsertVaultAccessor"""
+        gen = self._gen
+        table = dict(gen.vault_accessors)
+        for a in accessors:
+            table[a["accessor"]] = dict(a, create_index=index)
+        self._publish(
+            index=index,
+            vault_accessors=table,
+            table_indexes=self._bump(gen, index, "vault_accessors"),
+        )
+
+    @_write_txn
+    def delete_vault_accessors(self, index: int, accessors: list[str]):
+        gen = self._gen
+        drop = set(accessors)
+        table = {
+            k: v for k, v in gen.vault_accessors.items() if k not in drop
+        }
+        self._publish(
+            index=index,
+            vault_accessors=table,
+            table_indexes=self._bump(gen, index, "vault_accessors"),
+        )
+
+    @_write_txn
     def upsert_acl_policies(self, index: int, policies: list):
         """ref state_store.go UpsertACLPolicies"""
         gen = self._gen
@@ -1324,6 +1355,7 @@ class StateStore(StateReader):
             "scheduler_config": gen.scheduler_config,
             "acl_policies": [p.to_dict() for p in gen.acl_policies.values()],
             "acl_tokens": [t.to_dict() for t in gen.acl_tokens.values()],
+            "vault_accessors": list(gen.vault_accessors.values()),
             "table_indexes": dict(gen.table_indexes),
         }
 
@@ -1388,11 +1420,14 @@ class StateStore(StateReader):
                         AclToken.from_dict(d) for d in data.get("acl_tokens", [])
                     )
                 },
+                vault_accessors={
+                    a["accessor"]: a for a in data.get("vault_accessors", [])
+                },
                 table_indexes=dict(data.get("table_indexes", {})),
             )
             self._publish(**{f: getattr(gen, f) for f in (
                 "index", "nodes", "jobs", "job_versions", "job_summaries",
                 "evals", "allocs", "deployments", "periodic_launch",
                 "scheduler_config", "acl_policies", "acl_tokens",
-                "table_indexes",
+                "vault_accessors", "table_indexes",
             )})

@@ -1,0 +1,184 @@
+"""Vault token lifecycle, the service catalog, and prometheus metrics
+(ref nomad/vault.go, command/agent/consul/ service sync,
+config.go telemetry sinks)."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.client.client import Client
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.model import Service, Vault
+
+
+def make_server(extra=None):
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    cfg.update(extra or {})
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestVaultLifecycle:
+    def test_token_derived_delivered_and_revoked(self, tmp_path):
+        """A task with a vault stanza gets a token in secrets/vault_token
+        and VAULT_TOKEN; the accessor is tracked in raft state and revoked
+        when the alloc terminates (vault.go DeriveVaultToken/RevokeTokens)."""
+        server = make_server({"vault": {"enabled": True}})
+        client = Client(server, data_dir=str(tmp_path))
+        client.start()
+        try:
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.vault = Vault(policies=["app-secrets"])
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'cp "$NOMAD_SECRETS_DIR/vault_token" tok_file;'
+                    ' echo -n "$VAULT_TOKEN" > tok_env; sleep 1',
+                ],
+            }
+            task.resources.networks = []
+            server.job_register(job)
+
+            wait_until(
+                lambda: server.state.vault_accessors(),
+                msg="accessor tracked while task runs",
+            )
+            (accessor,) = server.state.vault_accessors()
+            assert accessor["task"] == "web"
+            assert server.vault.provider.is_live(accessor["accessor"])
+
+            wait_until(
+                lambda: all(
+                    a.client_status == "complete"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="task completes",
+            )
+            (alloc,) = server.state.allocs_by_job(job.namespace, job.id)
+            base = tmp_path / "allocs" / alloc.id / "web"
+            from_file = (base / "tok_file").read_text().strip()
+            from_env = (base / "tok_env").read_text().strip()
+            assert from_file.startswith("s.") and from_file == from_env
+
+            # revoked with the alloc's terminal update
+            wait_until(
+                lambda: not server.state.vault_accessors(),
+                msg="accessor revoked on termination",
+            )
+            assert not server.vault.provider.is_live(accessor["accessor"])
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_disabled_vault_fails_stanza_tasks(self, tmp_path):
+        server = make_server()  # vault not enabled
+        try:
+            with pytest.raises(ValueError):
+                server.vault.derive_token("nope", "web")
+        finally:
+            server.stop()
+
+
+class TestServiceCatalog:
+    def test_services_from_running_allocs(self, tmp_path):
+        server = make_server()
+        client = Client(server, data_dir=str(tmp_path))
+        client.start()
+        http = HTTPServer(server, port=0)
+        http.start()
+        api = ApiClient(address=f"http://127.0.0.1:{http.port}")
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "mock_driver"
+            task.config = {"run_for": "30s"}
+            task.services = [
+                Service(name="web-api", port_label="http", tags=["prod"])
+            ]
+            server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.state.allocs_by_job(job.namespace, job.id)
+                ),
+                msg="alloc running",
+            )
+            wait_until(
+                lambda: any(
+                    e["Status"] == "passing"
+                    for e in api.get("/v1/services")[0]
+                    if e["ServiceName"] == "web-api"
+                ),
+                msg="service passing in catalog",
+            )
+            (entry,) = api.get("/v1/service/web-api")[0]
+            assert entry["Tags"] == ["prod"]
+            assert entry["Port"] > 0 and entry["Address"], entry
+            client.stop()
+        finally:
+            http.stop()
+            server.stop()
+
+
+class TestPrometheusMetrics:
+    def test_text_exposition(self):
+        server = make_server()
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            import urllib.request
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/v1/metrics?format=prometheus"
+            ).read().decode()
+            assert "# TYPE nomad_tpu_state_index gauge" in body
+            assert "nomad_tpu_plan_queue_depth" in body
+            # still JSON without the format param
+            import json
+
+            payload = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/v1/metrics"
+                ).read()
+            )
+            assert "broker" in payload
+        finally:
+            http.stop()
+            server.stop()

@@ -182,19 +182,28 @@ class TestExecDriver:
         (the executor resource-container role)."""
         import shutil
 
-        def _cgroup_writable():
-            for base in ("/sys/fs/cgroup/memory", "/sys/fs/cgroup"):
+        def _cgroup_enforceable():
+            for base, limit_file in (
+                ("/sys/fs/cgroup/memory", "memory.limit_in_bytes"),
+                ("/sys/fs/cgroup", "memory.max"),
+            ):
                 probe = os.path.join(base, "nomad-probe-test")
                 try:
                     os.mkdir(probe)
                 except OSError:
                     continue
-                os.rmdir(probe)
-                return True
+                try:
+                    with open(os.path.join(probe, limit_file), "w") as f:
+                        f.write(str(64 * 1024 * 1024))
+                    return True
+                except OSError:
+                    continue
+                finally:
+                    os.rmdir(probe)
             return False
 
-        if not _cgroup_writable():
-            pytest.skip("no writable cgroup hierarchy")
+        if not _cgroup_enforceable():
+            pytest.skip("memory limits not enforceable here")
         driver = ExecDriver()
         with tempfile.TemporaryDirectory() as d:
             py = shutil.which("python3") or "/usr/bin/python3"
