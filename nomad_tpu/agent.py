@@ -138,8 +138,11 @@ class ClientAgent:
         data_dir: Optional[str] = None,
         node=None,
         drivers: Optional[dict] = None,
+        bind: str = "127.0.0.1",
+        advertise: Optional[str] = None,
     ):
-        from .rpc import ServerProxy
+        from .client.fs import register_fs_rpc
+        from .rpc import RpcServer, ServerProxy
 
         self.proxy = ServerProxy(servers)
         self.client = Client(
@@ -148,14 +151,29 @@ class ClientAgent:
             node=node,
             drivers=drivers,
         )
+        # the client's own RPC listener: servers/agents forward alloc
+        # fs/logs/exec here (the reverse-streaming path of
+        # client_fs_endpoint.go, served as plain RPC). ``bind`` must be a
+        # reachable interface (and ``advertise`` the reachable address) in
+        # multi-host topologies.
+        self.rpc = RpcServer(bind, 0)
+        register_fs_rpc(self.rpc, self.client)
+        self.client.node.attributes["unique.advertise.client_rpc"] = (
+            advertise or self.rpc.address
+        )
+        from .structs.node_class import compute_class
+
+        compute_class(self.client.node)
 
     @property
     def node(self):
         return self.client.node
 
     def start(self):
+        self.rpc.start()
         self.client.start()
 
     def stop(self):
         self.client.stop()
+        self.rpc.stop()
         self.proxy.pool.close()

@@ -251,6 +251,9 @@ class HTTPServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        pool = getattr(self, "_fs_pool", None)
+        if pool is not None:
+            pool.close()
 
     @property
     def address(self) -> str:
@@ -432,7 +435,11 @@ class HTTPServer:
             )
             if node is None:
                 raise KeyError(f"node not found: {m['node_id']}")
-            return node.to_dict()
+            doc = node.to_dict()
+            # the node secret authenticates its client RPC; never serve it
+            # (the reference redacts SecretID from API responses)
+            doc.pop("secret_id", None)
+            return doc
 
         return self._blocking(query, run)
 
@@ -797,12 +804,6 @@ class HTTPServer:
         raise KeyError(f"alloc dir not found for {alloc_id}")
 
     @staticmethod
-    def _safe_join(base: str, rel: str) -> str:
-        from ..util import contained_path
-
-        return contained_path(base, rel)
-
-    @staticmethod
     def _apply_request_ns(query, job):
         """A job spec that doesn't name a namespace registers into the
         request's (?namespace= / CLI -namespace); an explicit spec
@@ -832,86 +833,113 @@ class HTTPServer:
         if alloc is not None:
             self._check_ns(query, alloc.namespace, capability)
 
+    def _forward_client_fs(self, alloc_id: str, method: str, payload: dict):
+        """The alloc lives on a remote node: forward over the node's
+        advertised client RPC listener (client_fs_endpoint.go's
+        server→client path)."""
+        server = self.server
+        alloc = server.state.alloc_by_id(alloc_id) if server else None
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        node = server.state.node_by_id(alloc.node_id)
+        addr = (
+            node.attributes.get("unique.advertise.client_rpc")
+            if node is not None
+            else None
+        )
+        if not addr:
+            raise KeyError(
+                f"alloc {alloc_id} is on a node without a client RPC address"
+            )
+        from ..rpc import ConnPool, RpcError
+
+        pool = getattr(self, "_fs_pool", None)
+        if pool is None:
+            pool = self._fs_pool = ConnPool()
+        # the node secret authenticates us to the client's RPC listener
+        payload = dict(
+            payload, alloc_id=alloc_id, secret=node.secret_id
+        )
+        # socket timeout must outlast the operation's own timeout
+        timeout = float(payload.get("timeout", 0) or 0) + 15.0
+        try:
+            return pool.call(addr, method, payload, timeout=timeout)
+        except RpcError as e:
+            # preserve status semantics across the forwarding boundary
+            if e.code == "not_found":
+                raise KeyError(e.message) from e
+            if e.code == "invalid":
+                raise ValueError(e.message) from e
+            raise
+
     @route("GET", r"/v1/client/fs/ls/(?P<alloc_id>[^/]+)", acl="ns:read-fs")
     def fs_ls(self, m, query, body):
-        import os
+        from ..client import fs
 
         self._check_alloc_ns(query, m["alloc_id"], "read-fs")
-        base = self._alloc_dir(m["alloc_id"])
-        path = self._safe_join(base, query.get("path", "/"))
-        entries = []
-        for name in sorted(os.listdir(path)):
-            full = os.path.join(path, name)
-            st = os.stat(full)
-            entries.append(
-                {
-                    "Name": name,
-                    "IsDir": os.path.isdir(full),
-                    "Size": st.st_size,
-                    "ModTime": int(st.st_mtime),
-                }
-            )
-        return entries, None
+        path = query.get("path", "/")
+        try:
+            base = self._alloc_dir(m["alloc_id"])
+        except KeyError:
+            return self._forward_client_fs(
+                m["alloc_id"], "ClientFS.List", {"path": path}
+            ), None
+        return fs.list_dir(base, path), None
 
     @route("GET", r"/v1/client/fs/cat/(?P<alloc_id>[^/]+)", acl="ns:read-fs")
     def fs_cat(self, m, query, body):
-        import os
+        from ..client import fs
 
         self._check_alloc_ns(query, m["alloc_id"], "read-fs")
-        base = self._alloc_dir(m["alloc_id"])
-        path = self._safe_join(base, query.get("path", "/"))
-        # bounded window like fs_logs: an unbounded read of a multi-GB
-        # task file would balloon the agent and the JSON response
-        offset = int(query.get("offset", 0))
-        limit = int(query.get("limit", 1 << 20))
-        size = os.path.getsize(path)
-        with open(path, "rb") as f:
-            f.seek(offset)
-            data = f.read(limit)
-        return {
-            "Data": data.decode("utf-8", "replace"),
-            "Offset": offset + len(data),
-            "Size": size,
-        }, None
+        params = {
+            "path": query.get("path", "/"),
+            "offset": int(query.get("offset", 0)),
+            "limit": int(query.get("limit", 1 << 20)),
+        }
+        try:
+            base = self._alloc_dir(m["alloc_id"])
+        except KeyError:
+            return self._forward_client_fs(
+                m["alloc_id"], "ClientFS.Cat", params
+            ), None
+        return fs.cat(base, **params), None
 
     @route("GET", r"/v1/client/fs/logs/(?P<alloc_id>[^/]+)", acl="ns:read-logs")
     def fs_logs(self, m, query, body):
         """Task log window: ?task=&type=stdout|stderr&offset=&origin=
         (the non-streaming core of fs_endpoint.go Logs; clients follow by
         polling with the returned offset)."""
-        import os
+        from ..client import fs
 
         task = query.get("task", "")
         if not task:
             raise ValueError("task is required")
-        kind = query.get("type", "stdout")
-        if kind not in ("stdout", "stderr"):
-            raise ValueError("type must be stdout or stderr")
         self._check_alloc_ns(query, m["alloc_id"], "read-logs")
-        base = self._alloc_dir(m["alloc_id"])
-        path = self._safe_join(base, f"{task}/logs/{task}.{kind}.0")
-        if not os.path.exists(path):
-            return {"Data": "", "Offset": 0}, None
-        size = os.path.getsize(path)
-        origin = query.get("origin", "start")
+        kind = query.get("type", "stdout")
         offset = int(query.get("offset", 0))
-        start = max(size - offset, 0) if origin == "end" else min(offset, size)
+        origin = query.get("origin", "start")
         limit = int(query.get("limit", 1 << 20))
-        with open(path, "rb") as f:
-            f.seek(start)
-            data = f.read(limit)
-        return {
-            "Data": data.decode("utf-8", "replace"),
-            "Offset": start + len(data),
-            "Size": size,
-        }, None
+        try:
+            base = self._alloc_dir(m["alloc_id"])
+        except KeyError:
+            return self._forward_client_fs(
+                m["alloc_id"],
+                "ClientFS.Logs",
+                {
+                    "task": task, "type": kind, "offset": offset,
+                    "origin": origin, "limit": limit,
+                },
+            ), None
+        return fs.logs(
+            base, task, kind, offset=offset, origin=origin, limit=limit
+        ), None
 
     @route("PUT", r"/v1/client/exec/(?P<alloc_id>[^/]+)", acl="ns:alloc-exec")
     def alloc_exec(self, m, query, body):
         """One-shot command in the task's working directory
         (ref alloc exec; the reference's interactive streaming session is
         served here as a run-to-completion exec with captured output)."""
-        import subprocess
+        from ..client import fs
 
         body = body or {}
         task = body.get("Task", "")
@@ -919,30 +947,16 @@ class HTTPServer:
         if not task or not cmd:
             raise ValueError("Task and Cmd are required")
         self._check_alloc_ns(query, m["alloc_id"], "alloc-exec")
-        base = self._alloc_dir(m["alloc_id"])
-        task_dir = self._safe_join(base, task)
+        timeout = float(body.get("Timeout", 30.0))
         try:
-            proc = subprocess.run(
-                cmd,
-                cwd=task_dir,
-                capture_output=True,
-                timeout=float(body.get("Timeout", 30.0)),
-            )
-        except subprocess.TimeoutExpired as e:
-            # structured timeout: keep whatever output was captured
-            return {
-                "ExitCode": -1,
-                "TimedOut": True,
-                "Stdout": (e.stdout or b"").decode("utf-8", "replace"),
-                "Stderr": (e.stderr or b"").decode("utf-8", "replace"),
-            }, None
-        except (FileNotFoundError, NotADirectoryError, PermissionError) as e:
-            raise ValueError(f"exec failed: {e}") from e
-        return {
-            "ExitCode": proc.returncode,
-            "Stdout": proc.stdout.decode("utf-8", "replace"),
-            "Stderr": proc.stderr.decode("utf-8", "replace"),
-        }, None
+            base = self._alloc_dir(m["alloc_id"])
+        except KeyError:
+            return self._forward_client_fs(
+                m["alloc_id"],
+                "ClientFS.Exec",
+                {"task": task, "cmd": cmd, "timeout": timeout},
+            ), None
+        return fs.exec_in(base, task, cmd, timeout=timeout), None
 
     # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------
     @route("PUT", r"/v1/acl/bootstrap", acl="anonymous")

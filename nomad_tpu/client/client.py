@@ -450,13 +450,18 @@ class Client:
             self.state_db = ClientStateDB(data_dir)
         self.node = node or self.fingerprint()
         if self.state_db is not None:
-            # a restarted client must be the SAME node or its allocs orphan
+            # a restarted client must be the SAME node (same id AND secret,
+            # which authenticates its client RPC) or its allocs orphan
             persisted = self.state_db.get_meta("node_id")
+            persisted_secret = self.state_db.get_meta("node_secret")
             if node is None and persisted:
                 self.node.id = persisted
+                if persisted_secret:
+                    self.node.secret_id = persisted_secret
                 compute_class(self.node)
             else:
                 self.state_db.put_meta("node_id", self.node.id)
+                self.state_db.put_meta("node_secret", self.node.secret_id)
         self.alloc_runners: dict[str, AllocRunner] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -481,6 +486,7 @@ class Client:
 
         node = Node(
             id=generate_uuid(),
+            secret_id=generate_uuid(),
             name=host["hostname"],
             datacenter="dc1",
             attributes={

@@ -1,0 +1,141 @@
+"""Allocation filesystem operations (ref client fs/logs/exec surface:
+command/agent/fs_endpoint.go serving, client_fs_endpoint.go forwarding).
+
+Pure functions over an allocation directory, shared by the agent's local
+HTTP handlers and the client's RPC service (the server→client forwarding
+path for allocations living on remote nodes)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from ..util import contained_path
+
+
+def list_dir(alloc_dir: str, path: str) -> list[dict]:
+    full = contained_path(alloc_dir, path)
+    entries = []
+    for name in sorted(os.listdir(full)):
+        p = os.path.join(full, name)
+        st = os.stat(p)
+        entries.append(
+            {
+                "Name": name,
+                "IsDir": os.path.isdir(p),
+                "Size": st.st_size,
+                "ModTime": int(st.st_mtime),
+            }
+        )
+    return entries
+
+
+def cat(alloc_dir: str, path: str, offset: int = 0, limit: int = 1 << 20) -> dict:
+    full = contained_path(alloc_dir, path)
+    size = os.path.getsize(full)
+    with open(full, "rb") as f:
+        f.seek(offset)
+        data = f.read(limit)
+    return {
+        "Data": data.decode("utf-8", "replace"),
+        "Offset": offset + len(data),
+        "Size": size,
+    }
+
+
+def logs(
+    alloc_dir: str,
+    task: str,
+    kind: str,
+    offset: int = 0,
+    origin: str = "start",
+    limit: int = 1 << 20,
+) -> dict:
+    if kind not in ("stdout", "stderr"):
+        raise ValueError("type must be stdout or stderr")
+    path = contained_path(alloc_dir, f"{task}/logs/{task}.{kind}.0")
+    if not os.path.exists(path):
+        return {"Data": "", "Offset": 0}
+    size = os.path.getsize(path)
+    start = max(size - offset, 0) if origin == "end" else min(offset, size)
+    with open(path, "rb") as f:
+        f.seek(start)
+        data = f.read(limit)
+    return {
+        "Data": data.decode("utf-8", "replace"),
+        "Offset": start + len(data),
+        "Size": size,
+    }
+
+
+def exec_in(alloc_dir: str, task: str, cmd: list, timeout: float = 30.0) -> dict:
+    task_dir = contained_path(alloc_dir, task)
+    try:
+        proc = subprocess.run(
+            cmd, cwd=task_dir, capture_output=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired as e:
+        return {
+            "ExitCode": -1,
+            "TimedOut": True,
+            "Stdout": (e.stdout or b"").decode("utf-8", "replace"),
+            "Stderr": (e.stderr or b"").decode("utf-8", "replace"),
+        }
+    except (FileNotFoundError, NotADirectoryError, PermissionError) as e:
+        raise ValueError(f"exec failed: {e}") from e
+    return {
+        "ExitCode": proc.returncode,
+        "Stdout": proc.stdout.decode("utf-8", "replace"),
+        "Stderr": proc.stderr.decode("utf-8", "replace"),
+    }
+
+
+def register_fs_rpc(rpc_server, client):
+    """Expose the client's alloc dirs over its RPC listener
+    (the server→client reverse path, client_fs_endpoint.go's role)."""
+
+    def alloc_dir(payload) -> str:
+        # node-secret auth (the reference authenticates client RPCs with
+        # the node's SecretID): the HTTP layer already enforced namespace
+        # ACLs and proves it by presenting the secret only servers know
+        secret = client.node.secret_id
+        if secret and payload.get("secret") != secret:
+            raise ValueError("client RPC requires the node secret")
+        d = os.path.join(client.data_dir, "allocs", payload["alloc_id"])
+        if not os.path.isdir(d):
+            raise KeyError(f"alloc dir not found for {payload['alloc_id']}")
+        return d
+
+    rpc_server.register(
+        "ClientFS.List",
+        lambda p: list_dir(alloc_dir(p), p.get("path", "/")),
+    )
+    rpc_server.register(
+        "ClientFS.Cat",
+        lambda p: cat(
+            alloc_dir(p),
+            p.get("path", "/"),
+            offset=int(p.get("offset", 0)),
+            limit=int(p.get("limit", 1 << 20)),
+        ),
+    )
+    rpc_server.register(
+        "ClientFS.Logs",
+        lambda p: logs(
+            alloc_dir(p),
+            p["task"],
+            p.get("type", "stdout"),
+            offset=int(p.get("offset", 0)),
+            origin=p.get("origin", "start"),
+            limit=int(p.get("limit", 1 << 20)),
+        ),
+    )
+    rpc_server.register(
+        "ClientFS.Exec",
+        lambda p: exec_in(
+            alloc_dir(p),
+            p["task"],
+            list(p.get("cmd", [])),
+            timeout=float(p.get("timeout", 30.0)),
+        ),
+    )

@@ -165,6 +165,12 @@ class ServerProxy:
     def node_register(self, node) -> dict:
         return self._call("Node.Register", {"node": node.to_dict()})
 
+    def derive_vault_token(self, alloc_id: str, task: str) -> str:
+        """ref node_endpoint.go DeriveVaultToken (client→server RPC)."""
+        return self._call(
+            "Node.DeriveVaultToken", {"alloc_id": alloc_id, "task": task}
+        )
+
     def node_heartbeat(self, node_id: str) -> dict:
         return self._call("Node.UpdateStatus", {"node_id": node_id, "heartbeat": True})
 

@@ -66,6 +66,11 @@ def register_endpoints(server, rpc) -> None:
         return {}
 
     rpc.register("Node.Register", node_register)
+
+    def node_derive_vault_token(payload):
+        return server.derive_vault_token(payload["alloc_id"], payload["task"])
+
+    rpc.register("Node.DeriveVaultToken", node_derive_vault_token)
     rpc.register("Node.UpdateStatus", node_update_status)
     rpc.register("Node.Drain", node_drain)
     rpc.register("Node.Eligibility", node_eligibility)

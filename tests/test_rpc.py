@@ -44,6 +44,63 @@ def wait_leader(agents, timeout=8.0):
     raise AssertionError("no single leader over TCP")
 
 
+class TestRemoteClientFS:
+    def test_remote_alloc_logs_forwarded_over_rpc(self, tmp_path):
+        """A job on a REMOTE (TCP) client: the server's HTTP agent serves
+        its logs/fs/exec by forwarding over the client's RPC listener (the
+        client_fs_endpoint.go server→client path)."""
+        from nomad_tpu.api.client import ApiClient
+        from nomad_tpu.api.http import HTTPServer
+
+        server = ServerAgent("fs-s1", config={"seed": 42, "heartbeat_ttl": 60.0})
+        server.start(num_workers=1, wait_for_leader=5.0)
+        client = ClientAgent([server.address], data_dir=str(tmp_path))
+        http = HTTPServer(server.server, port=0)  # NO agent ref: not local
+        http.start()
+        api = ApiClient(address=f"http://127.0.0.1:{http.port}")
+        try:
+            client.start()
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo remote-hello; echo marker > f.txt"],
+            }
+            task.resources.networks = []
+            pool = ConnPool()
+            pool.call(server.address, "Job.Register", {"job": job.to_dict()})
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                allocs = server.server.state.allocs_by_job(job.namespace, job.id)
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                time.sleep(0.05)
+            (alloc,) = server.server.state.allocs_by_job(job.namespace, job.id)
+            assert alloc.client_status == "complete"
+
+            logs = api.get(
+                f"/v1/client/fs/logs/{alloc.id}", task="web", type="stdout"
+            )[0]
+            assert "remote-hello" in logs["Data"]
+            entries = api.get(f"/v1/client/fs/ls/{alloc.id}", path="web")[0]
+            assert any(e["Name"] == "f.txt" for e in entries)
+            cat = api.get(f"/v1/client/fs/cat/{alloc.id}", path="web/f.txt")[0]
+            assert cat["Data"].strip() == "marker"
+            resp = api.put(
+                f"/v1/client/exec/{alloc.id}",
+                body={"Task": "web", "Cmd": ["/bin/cat", "f.txt"]},
+            )[0]
+            assert resp["ExitCode"] == 0 and resp["Stdout"].strip() == "marker"
+        finally:
+            http.stop()
+            client.stop()
+            server.stop()
+
+
 class TestRpcCluster:
     def test_tcp_cluster_schedules_and_forwards(self):
         agents = make_tcp_cluster(3)
@@ -66,7 +123,9 @@ class TestRpcCluster:
             )
             assert eval_id
 
-            deadline = time.monotonic() + 10
+            # generous deadlines: under full-suite load (TCP + raft
+            # elections + concurrent JAX compiles) 10s flakes
+            deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
                 ev = leader.server.state.eval_by_id(eval_id)
                 if ev is not None and ev.status == "complete":
@@ -75,7 +134,7 @@ class TestRpcCluster:
             assert leader.server.state.eval_by_id(eval_id).status == "complete"
 
             # replicated everywhere
-            deadline = time.monotonic() + 5
+            deadline = time.monotonic() + 15
             while time.monotonic() < deadline:
                 if all(
                     len(a.server.state.allocs_by_job(job.namespace, job.id)) == 2
