@@ -79,9 +79,17 @@ class ServerAgent:
         self.name = name
         self.data_dir = data_dir
         self.config = dict(config or {})
-        self.rpc = RpcServer(bind, port)
+        # mTLS (helper/tlsutil): config["tls"] = {ca, cert, key} wraps the
+        # RPC listener and every outbound raft/endpoint connection
+        from .tlsutil import contexts_from_config
+
+        server_ctx, client_ctx = contexts_from_config(self.config.get("tls"))
+        #: outbound mTLS context; consumed by the HTTP agent's client-fs
+        #: forwarding pool (attached onto the core Server in start())
+        self.tls_client_context = client_ctx
+        self.rpc = RpcServer(bind, port, tls_context=server_ctx)
         self.address = self.rpc.address
-        self._transport = TcpRaftTransport(self.rpc)
+        self._transport = TcpRaftTransport(self.rpc, tls_context=client_ctx)
         self._register_endpoints = register_endpoints
         self.server: Optional[Server] = None
 
@@ -115,6 +123,9 @@ class ServerAgent:
         cfg["name"] = self.name
         cfg["raft"] = raft_cfg
         self.server = Server(cfg)
+        # the HTTP agent's client-fs forwarding pool must dial client RPC
+        # listeners with the same mTLS identity
+        self.server.tls_client_context = self.tls_client_context
         # raft rides the RPC listener, so raft addr == rpc addr
         self.rpc.server_rpc_addrs = dict(voters)
         self._register_endpoints(self.server, self.rpc)
@@ -140,11 +151,15 @@ class ClientAgent:
         drivers: Optional[dict] = None,
         bind: str = "127.0.0.1",
         advertise: Optional[str] = None,
+        tls: Optional[dict] = None,
     ):
         from .client.fs import register_fs_rpc
-        from .rpc import RpcServer, ServerProxy
+        from .rpc import ConnPool, RpcServer, ServerProxy
+        from .tlsutil import contexts_from_config
 
-        self.proxy = ServerProxy(servers)
+        server_ctx, client_ctx = contexts_from_config(tls or {})
+        pool = ConnPool(tls_context=client_ctx) if client_ctx else None
+        self.proxy = ServerProxy(servers, pool=pool)
         self.client = Client(
             self.proxy,
             data_dir=data_dir or tempfile.mkdtemp(prefix="nomad_tpu_client_"),
@@ -156,7 +171,7 @@ class ClientAgent:
         # client_fs_endpoint.go, served as plain RPC). ``bind`` must be a
         # reachable interface (and ``advertise`` the reachable address) in
         # multi-host topologies.
-        self.rpc = RpcServer(bind, 0)
+        self.rpc = RpcServer(bind, 0, tls_context=server_ctx)
         register_fs_rpc(self.rpc, self.client)
         self.client.node.attributes["unique.advertise.client_rpc"] = (
             advertise or self.rpc.address

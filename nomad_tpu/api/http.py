@@ -855,7 +855,10 @@ class HTTPServer:
 
         pool = getattr(self, "_fs_pool", None)
         if pool is None:
-            pool = self._fs_pool = ConnPool()
+            # mTLS rides along when the cluster runs with TLS
+            pool = self._fs_pool = ConnPool(
+                tls_context=getattr(server, "tls_client_context", None)
+            )
         # the node secret authenticates us to the client's RPC listener
         payload = dict(
             payload, alloc_id=alloc_id, secret=node.secret_id

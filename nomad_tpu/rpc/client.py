@@ -30,10 +30,12 @@ class RpcError(Exception):
 
 
 class _Conn:
-    def __init__(self, addr: str, timeout: float):
+    def __init__(self, addr: str, timeout: float, tls_context=None):
         host, port = addr.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls_context is not None:
+            self.sock = tls_context.wrap_socket(self.sock)
         self.sock.sendall(bytes([RPC_NOMAD]))
         self.lock = threading.Lock()
         self.seq = itertools.count(1)
@@ -65,17 +67,21 @@ class _Conn:
 class ConnPool:
     """Persistent connections per server address (ref helper/pool)."""
 
-    def __init__(self, timeout: float = 10.0):
+    def __init__(self, timeout: float = 10.0, tls_context=None):
         self.timeout = timeout
+        self.tls_context = tls_context
         self._conns: dict[str, list[_Conn]] = {}
         self._lock = threading.Lock()
 
-    def _acquire(self, addr: str) -> _Conn:
+    def _acquire(self, addr: str) -> tuple[_Conn, bool]:
+        """→ (conn, pooled): pooled connections may be stale — the server
+        can have closed them between calls — so callers retry once with a
+        fresh connection on a connection-level failure."""
         with self._lock:
             conns = self._conns.setdefault(addr, [])
             if conns:
-                return conns.pop()
-        return _Conn(addr, self.timeout)
+                return conns.pop(), True
+        return _Conn(addr, self.timeout, tls_context=self.tls_context), False
 
     def _release(self, addr: str, conn: _Conn):
         with self._lock:
@@ -90,9 +96,11 @@ class ConnPool:
         retry_leader: bool = True,
     ):
         """One RPC. On a not_leader error with a leader hint, retries once
-        against the leader (follower→leader forwarding)."""
+        against the leader (follower→leader forwarding); a stale POOLED
+        connection (reset/closed by the server between calls) retries once
+        on a fresh connection (helper/pool's reconnect-on-reuse)."""
         try:
-            conn = self._acquire(addr)
+            conn, pooled = self._acquire(addr)
         except OSError as e:
             raise RpcError("connect", f"{addr}: {e}")
         try:
@@ -109,6 +117,16 @@ class ConnPool:
             raise
         except (ConnectionClosed, OSError) as e:
             conn.close()
+            if pooled:
+                # drop every pooled conn to this addr (likely all stale)
+                # and run the call on a fresh connection
+                with self._lock:
+                    for stale in self._conns.pop(addr, []):
+                        stale.close()
+                return self.call(
+                    addr, method, payload,
+                    timeout=timeout, retry_leader=retry_leader,
+                )
             raise RpcError("connection", f"{addr}: {e}")
 
     def close(self):

@@ -14,10 +14,12 @@ from .codec import RPC_RAFT, ConnectionClosed, read_frame, write_frame
 
 
 class _RaftConn:
-    def __init__(self, addr: str, timeout: float):
+    def __init__(self, addr: str, timeout: float, tls_context=None):
         host, port = addr.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls_context is not None:
+            self.sock = tls_context.wrap_socket(self.sock)
         self.sock.sendall(bytes([RPC_RAFT]))
         self.lock = threading.Lock()
         self.seq = itertools.count(1)
@@ -42,9 +44,10 @@ class TcpRaftTransport(Transport):
     """Dials peers' RPC listeners with the raft protocol byte. The local
     node's handlers are registered onto its RpcServer (register())."""
 
-    def __init__(self, rpc_server=None, timeout: float = 5.0):
+    def __init__(self, rpc_server=None, timeout: float = 5.0, tls_context=None):
         self.rpc_server = rpc_server
         self.timeout = timeout
+        self.tls_context = tls_context
         self._conns: dict[str, _RaftConn] = {}
         self._lock = threading.Lock()
 
@@ -57,7 +60,7 @@ class TcpRaftTransport(Transport):
             c = self._conns.get(target)
             if c is not None:
                 return c
-            c = _RaftConn(target, self.timeout)
+            c = _RaftConn(target, self.timeout, tls_context=self.tls_context)
             self._conns[target] = c
             return c
 

@@ -30,7 +30,12 @@ logger = logging.getLogger("nomad_tpu.rpc")
 
 
 class RpcServer:
-    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, bind_addr: str = "127.0.0.1", port: int = 0, tls_context=None
+    ):
+        #: mTLS server context (helper/tlsutil role); when set, every
+        #: accepted connection handshakes and must present a CA-signed cert
+        self.tls_context = tls_context
         self.handlers: dict[str, Callable] = {}
         self.raft_handlers: dict[str, Callable] = {}
         # maps raft node_id -> rpc "host:port" (fed by config/gossip) so
@@ -58,6 +63,20 @@ class RpcServer:
 
     def stop(self):
         self._running = False
+        # wake the blocked accept with a throwaway connection so the thread
+        # observes _running and exits BEFORE the fd closes: closing under a
+        # blocked accept lets the kernel recycle the fd into a NEW listener
+        # (a later test/agent on the reused port), and the stale thread then
+        # steals — and mis-serves — that listener's connections
+        try:
+            wake = socket.create_connection(
+                self._sock.getsockname(), timeout=1.0
+            )
+            wake.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
         try:
             self._sock.close()
         except OSError:
@@ -77,6 +96,13 @@ class RpcServer:
 
     def _serve_conn(self, conn: socket.socket):
         try:
+            if self.tls_context is not None:
+                # handshake per connection in its own thread, bounded so a
+                # plaintext peer can't pin the thread forever; a peer
+                # without a CA-signed client cert is rejected here
+                conn.settimeout(10.0)
+                conn = self.tls_context.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
             proto = conn.recv(1)
             if not proto:
                 return
@@ -86,6 +112,10 @@ class RpcServer:
                 self._serve_rpc(conn, self._dispatch_raft)
             else:
                 logger.warning("unknown rpc protocol byte %r", proto)
+        except __import__("ssl").SSLError as e:
+            # must precede OSError (SSLError subclasses it): rejected
+            # handshakes need log evidence for mTLS debugging
+            logger.warning("tls handshake failed: %s", e)
         except (ConnectionClosed, OSError):
             pass
         finally:

@@ -1,0 +1,76 @@
+"""mTLS on the RPC tier (ref helper/tlsutil: CA-pinned mutual TLS over
+the muxed RPC/raft listener)."""
+
+import socket
+import tempfile
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ServerAgent
+from nomad_tpu.rpc import ConnPool, RpcError
+from nomad_tpu.tlsutil import client_context, contexts_from_config, generate_dev_certs
+
+
+@pytest.fixture(scope="module")
+def certs():
+    d = tempfile.mkdtemp(prefix="nomad_tls_")
+    return {
+        "server": generate_dev_certs(d, "server"),
+        "client": generate_dev_certs(d, "client"),
+        # a SECOND CA: certs from it must be rejected by the cluster CA
+        "foreign": generate_dev_certs(tempfile.mkdtemp(prefix="nomad_tls2_"), "evil"),
+    }
+
+
+class TestMutualTLS:
+    def test_tls_cluster_serves_and_rejects(self, certs):
+        server = ServerAgent(
+            "tls-s1",
+            config={"seed": 42, "heartbeat_ttl": 60.0, "tls": certs["server"]},
+        )
+        server.start(num_workers=1, wait_for_leader=5.0)
+        try:
+            # CA-signed client: full scheduling round-trip over TLS
+            ctx = client_context(**certs["client"])
+            pool = ConnPool(tls_context=ctx)
+            pool.call(
+                server.address, "Node.Register", {"node": mock.node().to_dict()}
+            )
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].resources.networks = []
+            eval_id = pool.call(
+                server.address, "Job.Register", {"job": job.to_dict()}
+            )
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                ev = server.server.state.eval_by_id(eval_id)
+                if ev is not None and ev.status == "complete":
+                    break
+                time.sleep(0.05)
+            assert server.server.state.eval_by_id(eval_id).status == "complete"
+            pool.close()
+
+            # plaintext caller: refused at the handshake
+            plain = ConnPool(timeout=2.0)
+            with pytest.raises((RpcError, OSError, ConnectionError)):
+                plain.call(server.address, "Status.Leader", {})
+            plain.close()
+
+            # cert from a FOREIGN CA: mutual verification rejects it
+            evil_ctx = client_context(**certs["foreign"])
+            evil = ConnPool(timeout=2.0, tls_context=evil_ctx)
+            with pytest.raises((RpcError, OSError, ConnectionError)):
+                evil.call(server.address, "Status.Leader", {})
+            evil.close()
+        finally:
+            server.stop()
+
+    def test_contexts_require_full_config(self):
+        from nomad_tpu.tlsutil import TLSError
+
+        with pytest.raises(TLSError):
+            contexts_from_config({"ca": "/x"})
+        assert contexts_from_config({}) == (None, None)
