@@ -33,6 +33,7 @@ from ..structs.model import (
     Node,
     Plan,
     PlanResult,
+    fast_alloc_clone,
 )
 
 logger = logging.getLogger("nomad_tpu.fsm")
@@ -336,7 +337,10 @@ class FSM:
     # ------------------------------------------------------------------
     def _apply_plan_results(self, index: int, payload: dict):
         plan = Plan.from_dict(payload["plan"])
-        result = PlanResult.from_dict(payload["result"])
+        if payload.get("normalized"):
+            result = self._denormalize_plan_result(payload["result"])
+        else:
+            result = PlanResult.from_dict(payload["result"])
         preemption_evals = [
             Evaluation.from_dict(d) for d in payload.get("preemption_evals", [])
         ]
@@ -345,6 +349,73 @@ class FSM:
         )
         self._handle_upserted_evals(preemption_evals)
         return index
+
+    def _denormalize_plan_result(self, doc: dict) -> PlanResult:
+        """Rehydrate stop/preemption diffs from this replica's own state
+        (ref fsm.go denormalizeAllocationDiffSlice): the full documents are
+        already replicated here, the diff carries only what changed."""
+
+        def rehydrate(diff_map: dict) -> dict:
+            out: dict = {}
+            for node_id, diffs in diff_map.items():
+                allocs = []
+                for d in diffs:
+                    stored = self.state.alloc_by_id(d["id"])
+                    if stored is None:
+                        logger.warning(
+                            "plan diff references unknown alloc %s", d["id"]
+                        )
+                        continue
+                    # shallow clone (bulk stops are the raft hot path) that
+                    # keeps stored.job: nulling the job would make the
+                    # store re-attach plan.job, which for a PREEMPTION
+                    # victim is the preemptor's job, not the victim's
+                    a = fast_alloc_clone(stored)
+                    a.desired_status = d["desired_status"]
+                    a.desired_description = d["desired_description"]
+                    if d.get("client_status"):
+                        a.client_status = d["client_status"]
+                    if d.get("preempted_by_allocation"):
+                        a.preempted_by_allocation = d["preempted_by_allocation"]
+                    allocs.append(a)
+                out[node_id] = allocs
+            return out
+
+        # shared job documents ship once per plan; reattach by ref. The
+        # parsed Job object is deliberately shared across the plan's
+        # placements — the store treats published objects as immutable.
+        jobs = {
+            jkey: Job.from_dict(jd)
+            for jkey, jd in doc.get("jobs", {}).items()
+        }
+
+        def placement(x: dict) -> Allocation:
+            # get, not pop: the payload dict lives in the raft log and may
+            # be re-applied on restore; from_dict ignores unknown keys
+            jkey = x.get("job_ref")
+            a = Allocation.from_dict(x)
+            if jkey is not None:
+                a.job = jobs[jkey]
+            return a
+
+        return PlanResult(
+            node_update=rehydrate(doc.get("node_update", {})),
+            node_preemptions=rehydrate(doc.get("node_preemptions", {})),
+            node_allocation={
+                node_id: [placement(x) for x in allocs]
+                for node_id, allocs in doc.get("node_allocation", {}).items()
+            },
+            deployment=(
+                Deployment.from_dict(doc["deployment"])
+                if doc.get("deployment")
+                else None
+            ),
+            deployment_updates=[
+                DeploymentStatusUpdate.from_dict(u)
+                for u in doc.get("deployment_updates", [])
+            ],
+            refresh_index=doc.get("refresh_index", 0),
+        )
 
     # ------------------------------------------------------------------
     # deployment appliers (ref fsm.go applyDeployment*)

@@ -37,6 +37,7 @@ from ..structs.model import (
     Evaluation,
     Job,
     Node,
+    fast_alloc_clone,
     generate_uuid,
     now_ns,
 )
@@ -288,11 +289,75 @@ class Server:
             dispatcher.restore(self.state)
 
     def _commit_plan(self, plan, result, preemption_evals):
+        """Replicate the verified plan result — NORMALIZED (the reference's
+        plan normalization for raft-log size, structs.go Plan.NormalizeAllocations):
+        the plan ships without its alloc maps (the result carries the
+        verified subset), and stopped/preempted allocs ship as id+field
+        diffs the FSM rehydrates from each replica's own state, since the
+        full documents are already replicated there. Only fresh placements
+        travel whole."""
+        import dataclasses
+
+        slim_plan = dataclasses.replace(
+            plan, node_update={}, node_allocation={}, node_preemptions={},
+            annotations=None,
+        )
+
+        def diffs(alloc_map):
+            return {
+                node_id: [
+                    {
+                        "id": a.id,
+                        "desired_status": a.desired_status,
+                        "desired_description": a.desired_description,
+                        "client_status": a.client_status,
+                        "preempted_by_allocation": a.preempted_by_allocation,
+                    }
+                    for a in allocs
+                ]
+                for node_id, allocs in alloc_map.items()
+            }
+
+        # placements travel whole, but the (shared) Job document ships
+        # exactly once per distinct job version, not once per alloc —
+        # serializing 10K copies of the same job dominated commit time
+        jobs_doc: dict[str, dict] = {}
+
+        def placement_doc(a):
+            job = a.job
+            if job is None:
+                return a.to_dict()
+            jkey = f"{job.namespace}\x00{job.id}\x00{job.version}\x00{job.modify_index}"
+            if jkey not in jobs_doc:
+                jobs_doc[jkey] = job.to_dict()
+            c = fast_alloc_clone(a)
+            c.job = None
+            d = c.to_dict()
+            d["job_ref"] = jkey
+            return d
+
+        result_doc = {
+            "node_update": diffs(result.node_update),
+            "node_preemptions": diffs(result.node_preemptions),
+            "node_allocation": {
+                node_id: [placement_doc(a) for a in allocs]
+                for node_id, allocs in result.node_allocation.items()
+            },
+            "jobs": jobs_doc,
+            "deployment": (
+                result.deployment.to_dict() if result.deployment else None
+            ),
+            "deployment_updates": [
+                u.to_dict() for u in result.deployment_updates
+            ],
+            "refresh_index": result.refresh_index,
+        }
         return self._apply(
             fsm_mod.APPLY_PLAN_RESULTS,
             {
-                "plan": plan.to_dict(),
-                "result": result.to_dict(),
+                "plan": slim_plan.to_dict(),
+                "result": result_doc,
+                "normalized": True,
                 "preemption_evals": [e.to_dict() for e in preemption_evals],
             },
         )

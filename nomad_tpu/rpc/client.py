@@ -13,6 +13,7 @@ node agent (client/client.py) works identically in-process or over TCP.
 from __future__ import annotations
 
 import itertools
+import select
 import socket
 import threading
 import time
@@ -29,6 +30,18 @@ class RpcError(Exception):
         self.leader_rpc_addr = leader_rpc_addr
 
 
+class _SendFailed(Exception):
+    """The request frame failed to SEND: the server cannot have received a
+    complete frame, so it cannot have executed the call — re-sending on a
+    fresh connection is safe even for non-idempotent writes. Failures
+    after the frame was flushed must NOT be retried (the server may have
+    executed the call and died before answering)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class _Conn:
     def __init__(self, addr: str, timeout: float, tls_context=None):
         host, port = addr.rsplit(":", 1)
@@ -40,12 +53,29 @@ class _Conn:
         self.lock = threading.Lock()
         self.seq = itertools.count(1)
 
+    def stale(self) -> bool:
+        """A pooled conn that is readable while idle has either been
+        closed by the server (EOF/RST pending) or is protocol-broken
+        (unsolicited bytes); both mean it must not carry the next call.
+        select-based so it works for TLS sockets too (SSLSocket rejects
+        MSG_PEEK)."""
+        try:
+            readable, _, _ = select.select([self.sock], [], [], 0)
+        except (OSError, ValueError):
+            return True
+        return bool(readable)
+
     def call(self, method: str, payload, timeout: Optional[float] = None):
         with self.lock:
             if timeout is not None:
                 self.sock.settimeout(timeout)
             seq = next(self.seq)
-            write_frame(self.sock, [seq, method, payload])
+            try:
+                write_frame(self.sock, [seq, method, payload])
+            except socket.timeout:
+                raise
+            except (ConnectionClosed, OSError) as e:
+                raise _SendFailed(e) from e
             rseq, error, result = read_frame(self.sock)
             if rseq != seq:
                 raise ConnectionClosed("rpc sequence mismatch")
@@ -77,10 +107,19 @@ class ConnPool:
         """→ (conn, pooled): pooled connections may be stale — the server
         can have closed them between calls — so callers retry once with a
         fresh connection on a connection-level failure."""
-        with self._lock:
-            conns = self._conns.setdefault(addr, [])
-            if conns:
-                return conns.pop(), True
+        while True:
+            with self._lock:
+                conns = self._conns.setdefault(addr, [])
+                conn = conns.pop() if conns else None
+            if conn is None:
+                break
+            # server-closed-idle conns are detected HERE, before the
+            # request is written, so the at-most-once retry rule below
+            # rarely has to reject a genuinely-safe resend
+            if conn.stale():
+                conn.close()
+                continue
+            return conn, True
         return _Conn(addr, self.timeout, tls_context=self.tls_context), False
 
     def _release(self, addr: str, conn: _Conn):
@@ -94,11 +133,18 @@ class ConnPool:
         payload,
         timeout: Optional[float] = None,
         retry_leader: bool = True,
+        retry_stale: bool = True,
     ):
         """One RPC. On a not_leader error with a leader hint, retries once
         against the leader (follower→leader forwarding); a stale POOLED
-        connection (reset/closed by the server between calls) retries once
-        on a fresh connection (helper/pool's reconnect-on-reuse)."""
+        connection (closed by the server between calls) retries once on a
+        fresh connection (helper/pool's reconnect-on-reuse) — but ONLY
+        when the request frame failed to send, so the server cannot have
+        executed it. Failures after the frame was flushed — including a
+        timeout, where the handler may still be running — are never
+        retried: re-sending would duplicate a non-idempotent write. The
+        stale retry fires at most once per call (retry_stale), even if
+        another thread repopulates the pool between attempts."""
         try:
             conn, pooled = self._acquire(addr)
         except OSError as e:
@@ -115,18 +161,26 @@ class ConnPool:
                     timeout=timeout, retry_leader=False,
                 )
             raise
-        except (ConnectionClosed, OSError) as e:
+        except socket.timeout as e:
             conn.close()
-            if pooled:
+            raise RpcError("timeout", f"{addr}: {method}: {e}")
+        except _SendFailed as e:
+            conn.close()
+            if pooled and retry_stale:
                 # drop every pooled conn to this addr (likely all stale)
-                # and run the call on a fresh connection
+                # and run the call on a fresh connection; safe because the
+                # request frame never reached the server whole
                 with self._lock:
                     for stale in self._conns.pop(addr, []):
                         stale.close()
                 return self.call(
                     addr, method, payload,
                     timeout=timeout, retry_leader=retry_leader,
+                    retry_stale=False,
                 )
+            raise RpcError("connection", f"{addr}: {e.cause}")
+        except (ConnectionClosed, OSError) as e:
+            conn.close()
             raise RpcError("connection", f"{addr}: {e}")
 
     def close(self):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import socket
+import ssl
 import threading
 from typing import Callable, Optional
 
@@ -112,10 +113,13 @@ class RpcServer:
                 self._serve_rpc(conn, self._dispatch_raft)
             else:
                 logger.warning("unknown rpc protocol byte %r", proto)
-        except __import__("ssl").SSLError as e:
+        except ssl.SSLError as e:
             # must precede OSError (SSLError subclasses it): rejected
-            # handshakes need log evidence for mTLS debugging
-            logger.warning("tls handshake failed: %s", e)
+            # handshakes need log evidence for mTLS debugging. Suppressed
+            # during shutdown — stop()'s plaintext wake connection would
+            # otherwise log a fake handshake failure on every clean exit
+            if self._running:
+                logger.warning("tls handshake failed: %s", e)
         except (ConnectionClosed, OSError):
             pass
         finally:

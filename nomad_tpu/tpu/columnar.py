@@ -95,13 +95,33 @@ class ColumnarCluster:
             used[2] += c.shared.disk_mb
         return used
 
+    def _live_allocs_by_node(self, state) -> dict[str, list]:
+        """One pass over the alloc table bucketing non-terminal allocs by
+        node (allocs_by_node_terminal is O(total allocs) PER CALL, which
+        made the plane builds quadratic on loaded clusters). Cached per
+        state generation — generations are copy-on-write and immutable
+        after publication, so holding the gen object and comparing by
+        identity is sound (the held reference also pins it against id
+        reuse)."""
+        gen = getattr(state, "_gen", state)
+        cached = getattr(self, "_live_cache", None)
+        if cached is not None and cached[0] is gen:
+            return cached[1]
+        buckets: dict[str, list] = {n.id: [] for n in self.nodes}
+        for a in state.allocs():
+            if a.node_id in buckets and not a.terminal_status():
+                buckets[a.node_id].append(a)
+        self._live_cache = (gen, buckets)
+        return buckets
+
     def initial_used(self, state, plan=None) -> np.ndarray:
         """used = reserved + Σ non-terminal alloc resources per node (the
         accumulation AllocsFit performs per check, funcs.go:104-117),
         including any plan overlays."""
         used = self.reserved.copy()
+        by_node = self._live_allocs_by_node(state)
         for i, node in enumerate(self.nodes):
-            allocs = state.allocs_by_node_terminal(node.id, False)
+            allocs = by_node[node.id]
             if plan is not None:
                 from ..structs.model import remove_allocs
 
@@ -115,8 +135,9 @@ class ColumnarCluster:
         """Existing same-job/same-group alloc counts per node (the
         JobAntiAffinityIterator's collision input, rank.go:498-505)."""
         counts = np.zeros(len(self.nodes), dtype=np.int32)
+        by_node = self._live_allocs_by_node(state)
         for i, node in enumerate(self.nodes):
-            for a in state.allocs_by_node_terminal(node.id, False):
+            for a in by_node[node.id]:
                 if a.job_id == job_id and a.task_group == tg_name:
                     counts[i] += 1
         return counts

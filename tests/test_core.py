@@ -378,3 +378,59 @@ class TestDevAgentE2E:
             assert replacement.reschedule_tracker is not None
         finally:
             agent.stop()
+
+
+class TestNormalizedPlanCommit:
+    def test_preemption_victim_keeps_own_job(self):
+        """A normalized plan ships preemptions as id+field diffs; the FSM
+        must rehydrate the victim with ITS OWN job, not the preemptor's
+        (plan.job) — the two belong to different jobs by definition."""
+        from nomad_tpu.structs.model import PlanResult
+
+        server = Server({"seed": 42, "heartbeat_ttl": 60.0})
+        server.start(num_workers=0)
+        try:
+            node = mock.node()
+            server.node_register(node)
+            victim_job = mock.job()
+            server.state.upsert_job(None, victim_job)
+            victim = mock.alloc()
+            victim.job = server.state.job_by_id(victim_job.namespace, victim_job.id)
+            victim.job_id = victim_job.id
+            victim.namespace = victim_job.namespace
+            victim.node_id = node.id
+            server.state.upsert_allocs(None, [victim])
+
+            preemptor_job = mock.job()
+            server.state.upsert_job(None, preemptor_job)
+            placement = mock.alloc()
+            placement.job = server.state.job_by_id(
+                preemptor_job.namespace, preemptor_job.id
+            )
+            placement.job_id = preemptor_job.id
+            placement.namespace = preemptor_job.namespace
+            placement.node_id = node.id
+
+            pre = victim.copy()
+            pre.desired_status = "evict"
+            pre.desired_description = "preempted"
+            pre.preempted_by_allocation = placement.id
+            plan = Plan(eval_id=generate_uuid(), job=placement.job)
+            result = PlanResult(
+                node_allocation={node.id: [placement]},
+                node_preemptions={node.id: [pre]},
+            )
+            server._commit_plan(plan, result, [])
+
+            stored_victim = server.state.alloc_by_id(victim.id)
+            assert stored_victim.desired_status == "evict"
+            assert stored_victim.preempted_by_allocation == placement.id
+            assert stored_victim.job is not None
+            assert stored_victim.job.id == victim_job.id, (
+                "victim rehydrated with the preemptor's job"
+            )
+            stored_placement = server.state.alloc_by_id(placement.id)
+            assert stored_placement.job is not None
+            assert stored_placement.job.id == preemptor_job.id
+        finally:
+            server.stop()
