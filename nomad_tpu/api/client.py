@@ -191,3 +191,54 @@ class ApiClient:
 
     def metrics(self):
         return self.get("/v1/metrics")[0]
+
+    def validate_job(self, job_dict: dict) -> dict:
+        return self.put("/v1/validate/job", body={"Job": job_dict})[0]
+
+    def agent_members(self) -> dict:
+        return self.get("/v1/agent/members")[0]
+
+    def agent_join(self, address: str) -> dict:
+        return self.put("/v1/agent/join", address=address)[0]
+
+    def agent_force_leave(self, node: str) -> dict:
+        return self.put("/v1/agent/force-leave", node=node)[0]
+
+    def agent_servers(self) -> list:
+        return self.get("/v1/agent/servers")[0]
+
+    def agent_health(self) -> dict:
+        return self.get("/v1/agent/health")[0]
+
+    def status_peers(self) -> list:
+        return self.get("/v1/status/peers")[0]
+
+    def node_purge(self, node_id: str) -> dict:
+        return self.put(f"/v1/node/{_q(node_id)}/purge")[0]
+
+    def eval_allocations(self, eval_id: str) -> list:
+        return self.get(f"/v1/evaluation/{_q(eval_id)}/allocations")[0]
+
+    def raft_configuration(self) -> dict:
+        return self.get("/v1/operator/raft/configuration")[0]
+
+    def raft_remove_peer(self, peer_id: str) -> dict:
+        return self.delete("/v1/operator/raft/peer", id=peer_id)[0]
+
+    def autopilot_configuration(self) -> dict:
+        return self.get("/v1/operator/autopilot/configuration")[0]
+
+    def autopilot_set_configuration(self, config: dict) -> dict:
+        return self.put("/v1/operator/autopilot/configuration", body=config)[0]
+
+    def autopilot_health(self) -> dict:
+        return self.get("/v1/operator/autopilot/health")[0]
+
+    def reconcile_summaries(self) -> dict:
+        return self.put("/v1/system/reconcile/summaries")[0]
+
+    def system_gc(self) -> dict:
+        return self.put("/v1/system/gc")[0]
+
+    def acl_token_self(self) -> dict:
+        return self.get("/v1/acl/token/self")[0]

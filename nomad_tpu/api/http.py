@@ -169,6 +169,9 @@ class HTTPServer:
                         # reserved key: handlers needing finer-grained
                         # checks (search's per-context filtering) read it
                         query["__acl__"] = acl_obj
+                        query["__secret__"] = self.headers.get(
+                            "X-Nomad-Token", ""
+                        )
                         try:
                             result, index = getattr(api, name)(
                                 _DecodedMatch(match), query, body
@@ -741,6 +744,147 @@ class HTTPServer:
     def status_leader(self, m, query, body):
         return f"{self.host}:{self.port}", None
 
+    @route("GET", r"/v1/status/peers", acl="anonymous")
+    def status_peers(self, m, query, body):
+        """ref status_endpoint.go Peers"""
+        return sorted(self.server.raft.voters_snapshot().values()), None
+
+    @route("GET", r"/v1/agent/members", acl="agent:read")
+    def agent_members(self, m, query, body):
+        """ref agent_endpoint.go AgentMembersRequest"""
+        return {
+            "ServerName": self.server.raft.node_id,
+            "ServerRegion": self.server.region,
+            "Members": self.server.members(),
+        }, None
+
+    @route("PUT", r"/v1/agent/join", acl="agent:write")
+    def agent_join(self, m, query, body):
+        """ref agent_endpoint.go AgentJoinRequest"""
+        addresses = []
+        if query.get("address"):
+            addresses.append(query["address"])
+        if isinstance(body, dict) and body.get("Addresses"):
+            addresses.extend(body["Addresses"])
+        if not addresses:
+            raise ValueError("missing address to join")
+        joined = self.server.gossip_join(addresses)
+        return {"num_joined": joined}, None
+
+    @route("PUT", r"/v1/agent/force-leave", acl="agent:write")
+    def agent_force_leave(self, m, query, body):
+        """ref agent_endpoint.go AgentForceLeaveRequest"""
+        node = query.get("node") or (body or {}).get("Node")
+        if not node:
+            raise ValueError("missing node to force leave")
+        if not self.server.gossip_force_leave(node):
+            raise KeyError(f"unknown member: {node}")
+        return {}, None
+
+    @route("GET", r"/v1/agent/servers", acl="agent:read")
+    def agent_servers(self, m, query, body):
+        """ref agent_endpoint.go AgentServersRequest"""
+        return sorted(self.server.raft.voters_snapshot().values()), None
+
+    @route("GET", r"/v1/agent/health", acl="anonymous")
+    def agent_health(self, m, query, body):
+        """ref agent_endpoint.go HealthRequest"""
+        out = {}
+        if self.server is not None:
+            leader = self.server.leader_address() is not None
+            out["server"] = {
+                "ok": True,
+                "message": "leader elected" if leader else "no leader",
+            }
+        clients = getattr(self.agent, "clients", []) if self.agent else []
+        if clients:
+            out["client"] = {"ok": True, "message": f"{len(clients)} client(s)"}
+        return out, None
+
+    @route("PUT", r"/v1/validate/job", acl="ns:submit-job")
+    def validate_job(self, m, query, body):
+        """Dry validation without registering (ref job_endpoint.go
+        Validate / command/agent/job_endpoint.go ValidateJobRequest)."""
+        if not isinstance(body, dict) or "Job" not in body:
+            raise ValueError("request must contain a Job")
+        errors = []
+        warnings = []
+        try:
+            job = Job.from_dict(body["Job"])
+            self._apply_request_ns(query, job)
+            self.server._validate_job(job)
+        except (ValueError, KeyError, TypeError) as e:
+            errors.append(str(e))
+        return {
+            "DriverConfigValidated": True,
+            "ValidationErrors": errors,
+            "Warnings": "; ".join(warnings),
+            "Error": errors[0] if errors else "",
+        }, None
+
+    @route("PUT", r"/v1/system/reconcile/summaries", acl="operator:write")
+    def system_reconcile_summaries(self, m, query, body):
+        """ref system_endpoint.go ReconcileJobSummaries"""
+        self.server.reconcile_summaries()
+        return {}, None
+
+    @route("PUT", r"/v1/node/(?P<node_id>[^/]+)/purge", acl="node:write")
+    def node_purge(self, m, query, body):
+        """ref node_endpoint.go Deregister (purge)"""
+        eval_ids = self.server.node_purge(m["node_id"])
+        return {
+            "EvalIDs": eval_ids,
+            "NodeModifyIndex": self.server.state.latest_index(),
+        }, None
+
+    @route("GET", r"/v1/evaluation/(?P<eval_id>[^/]+)/allocations", acl="ns:read-job")
+    def eval_allocations(self, m, query, body):
+        """ref eval_endpoint.go Allocations"""
+        def run(snap):
+            return [
+                a.to_dict()
+                for a in snap.allocs_by_eval(m["eval_id"])
+                if self._ns_visible(query, a.namespace, "read-job")
+            ]
+
+        return self._blocking(query, run)
+
+    # -- operator raft / autopilot (ref operator_endpoint.go) ------------
+    @route("GET", r"/v1/operator/raft/configuration", acl="operator:read")
+    def operator_raft_configuration(self, m, query, body):
+        return self.server.raft_configuration(), None
+
+    @route("DELETE", r"/v1/operator/raft/peer", acl="operator:write")
+    def operator_raft_remove_peer(self, m, query, body):
+        peer = query.get("id") or query.get("address")
+        if not peer:
+            raise ValueError("missing peer id")
+        # accept either a node id or its raft address
+        voters = self.server.raft.voters_snapshot()
+        if peer not in voters:
+            by_addr = [
+                nid for nid, addr in voters.items() if addr == peer
+            ]
+            if len(by_addr) == 1:
+                peer = by_addr[0]
+        self.server.raft_remove_peer(peer)
+        return {}, None
+
+    @route("GET", r"/v1/operator/autopilot/configuration", acl="operator:read")
+    def operator_autopilot_get(self, m, query, body):
+        return self.server.autopilot_config(), None
+
+    @route("PUT", r"/v1/operator/autopilot/configuration", acl="operator:write")
+    def operator_autopilot_set(self, m, query, body):
+        overrides = dict(self.server.state.autopilot_config() or {})
+        overrides.update(body or {})
+        self.server.set_autopilot_config(overrides)
+        return {"Updated": True}, None
+
+    @route("GET", r"/v1/operator/autopilot/health", acl="operator:read")
+    def operator_autopilot_health(self, m, query, body):
+        return self.server.autopilot_health(), None
+
     @route("GET", r"/v1/metrics", acl="agent:read")
     def metrics(self, m, query, body):
         from ..tpu import batch_sched
@@ -1033,6 +1177,23 @@ class HTTPServer:
     def acl_delete_token(self, m, query, body):
         self.server.acl_delete_tokens([m["accessor"]])
         return {}, None
+
+    @route("GET", r"/v1/acl/token/self", acl="anonymous")
+    def acl_token_self(self, m, query, body):
+        """ref acl_endpoint.go GetToken (self); resolves the request's own
+        secret, so it needs no management capability."""
+        secret = query.get("__secret__", "")
+        token = self.server.state.acl_token_by_secret(secret)
+        if token is None:
+            raise KeyError("token not found for provided secret")
+        return _acl_token_dict(token), None
+
+    @route("GET", r"/v1/acl/token/(?P<accessor>[^/]+)")
+    def acl_get_token(self, m, query, body):
+        token = self.server.state.acl_token_by_accessor(m["accessor"])
+        if token is None:
+            raise KeyError(f"token not found: {m['accessor']}")
+        return _acl_token_dict(token), None
 
     # -- search (ref search_endpoint.go) ---------------------------------
     @route("PUT", r"/v1/search", acl="ns:read-job")

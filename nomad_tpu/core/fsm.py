@@ -60,6 +60,8 @@ DEPLOYMENT_ALLOC_HEALTH = "deployment_alloc_health"
 DEPLOYMENT_DELETE = "deployment_delete"
 PERIODIC_LAUNCH = "periodic_launch"
 SCHEDULER_CONFIG = "scheduler_config"
+AUTOPILOT_CONFIG = "autopilot_config"
+RECONCILE_SUMMARIES = "reconcile_summaries"
 ACL_POLICY_UPSERT = "acl_policy_upsert"
 ACL_POLICY_DELETE = "acl_policy_delete"
 ACL_TOKEN_UPSERT = "acl_token_upsert"
@@ -108,6 +110,8 @@ class FSM:
             DEPLOYMENT_DELETE: self._apply_deployment_delete,
             PERIODIC_LAUNCH: self._apply_periodic_launch,
             SCHEDULER_CONFIG: self._apply_scheduler_config,
+            AUTOPILOT_CONFIG: self._apply_autopilot_config,
+            RECONCILE_SUMMARIES: self._apply_reconcile_summaries,
             ACL_POLICY_UPSERT: self._apply_acl_policy_upsert,
             ACL_POLICY_DELETE: self._apply_acl_policy_delete,
             ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
@@ -480,6 +484,14 @@ class FSM:
 
     def _apply_scheduler_config(self, index: int, payload: dict):
         self.state.set_scheduler_config(index, payload["config"])
+        return index
+
+    def _apply_autopilot_config(self, index: int, payload: dict):
+        self.state.set_autopilot_config(index, payload["config"])
+        return index
+
+    def _apply_reconcile_summaries(self, index: int, payload: dict):
+        self.state.reconcile_job_summaries(index)
         return index
 
     # ------------------------------------------------------------------

@@ -198,6 +198,13 @@ class Server:
                     logger.info("gossip: adding server %s to raft", member.name)
                     self.raft.add_voter(member.name, raft_addr)
             elif event in ("dead", "leave", "reap"):
+                # intentional leaves always deregister; crash-failures are
+                # reaped only when autopilot dead-server cleanup is on
+                # (ref autopilot.go pruneDeadServers)
+                if event == "dead" and not self.autopilot_config().get(
+                    "cleanup_dead_servers", True
+                ):
+                    return
                 if member.name in self.raft.voters:
                     logger.info("gossip: removing server %s from raft", member.name)
                     self.raft.remove_voter(member.name)
@@ -205,6 +212,174 @@ class Server:
             pass
         except Exception:
             logger.exception("gossip membership change failed")
+
+    # ------------------------------------------------------------------
+    # Autopilot + operator membership surface (ref nomad/autopilot.go,
+    # nomad/operator_endpoint.go, command/agent/agent_endpoint.go)
+    # ------------------------------------------------------------------
+    DEFAULT_AUTOPILOT = {
+        "cleanup_dead_servers": True,
+        "last_contact_threshold_s": 0.2,
+        "max_trailing_logs": 250,
+        "server_stabilization_time_s": 10.0,
+    }
+
+    def autopilot_config(self) -> dict:
+        cfg = dict(self.DEFAULT_AUTOPILOT)
+        cfg.update(self.state.autopilot_config() or {})
+        return cfg
+
+    def set_autopilot_config(self, config: dict):
+        """Validate and persist the autopilot overrides. Only known keys
+        with the right types are stored (a stray string duration would
+        otherwise 500 every future health check), and defaults are NOT
+        folded in — future default changes must still apply."""
+        cleaned = {}
+        for key, value in (config or {}).items():
+            if key not in self.DEFAULT_AUTOPILOT:
+                raise ValueError(f"unknown autopilot setting: {key}")
+            default = self.DEFAULT_AUTOPILOT[key]
+            if isinstance(default, bool):
+                if not isinstance(value, bool):
+                    raise ValueError(f"autopilot setting {key} must be a bool")
+            elif isinstance(default, (int, float)):
+                if isinstance(value, bool) or not isinstance(
+                    value, (int, float)
+                ):
+                    raise ValueError(
+                        f"autopilot setting {key} must be a number"
+                    )
+                value = float(value)
+            cleaned[key] = value
+        self._apply(fsm_mod.AUTOPILOT_CONFIG, {"config": cleaned})
+
+    def members(self) -> list[dict]:
+        """Gossip membership view (ref agent_endpoint.go AgentMembersRequest).
+        Without gossip (dev/static clusters) synthesizes records from the
+        raft voter map."""
+        if self.gossip is not None:
+            with self.gossip._lock:
+                rows = [
+                    {
+                        "Name": m.name,
+                        "Addr": m.host,
+                        "Port": m.port,
+                        "Status": m.status,
+                        "Tags": dict(m.tags),
+                    }
+                    for m in self.gossip.members.values()
+                ]
+            return sorted(rows, key=lambda r: r["Name"])
+        return [
+            {
+                "Name": node_id,
+                "Addr": addr,
+                "Port": 0,
+                "Status": "alive",
+                "Tags": {"raft": addr, "role": "server", "region": self.region},
+            }
+            for node_id, addr in sorted(self.raft.voters_snapshot().items())
+        ]
+
+    def gossip_join(self, addresses: list) -> int:
+        """Join one or more gossip seeds; returns how many succeeded
+        (ref agent.go Join)."""
+        if self.gossip is None:
+            raise RuntimeError("gossip is not enabled on this server")
+        joined = 0
+        for addr in addresses:
+            host, _, port = str(addr).rpartition(":")
+            if self.gossip.join((host or "127.0.0.1", int(port)), timeout=3.0):
+                joined += 1
+        return joined
+
+    def gossip_force_leave(self, name: str) -> bool:
+        """Force a failed member out of gossip (and, via the leave event,
+        out of raft); ref agent.go ForceLeave → serf RemoveFailedNode."""
+        if self.gossip is None:
+            raise RuntimeError("gossip is not enabled on this server")
+        return self.gossip.force_leave(name)
+
+    def raft_configuration(self) -> dict:
+        """ref operator_endpoint.go RaftGetConfiguration"""
+        leader_id = getattr(self.raft, "leader_id", None)
+        servers = []
+        for node_id, addr in sorted(self.raft.voters_snapshot().items()):
+            servers.append(
+                {
+                    "ID": node_id,
+                    "Node": node_id,
+                    "Address": addr,
+                    "Leader": self.raft.is_leader()
+                    and node_id == self.raft.node_id
+                    or node_id == leader_id,
+                    "Voter": True,
+                }
+            )
+        return {"Servers": servers, "Index": self.state.latest_index()}
+
+    def raft_remove_peer(self, node_id: str):
+        """ref operator_endpoint.go RaftRemovePeerByID"""
+        self._check_leader()
+        if node_id not in self.raft.voters_snapshot():
+            raise KeyError(f"no raft peer with id {node_id}")
+        self.raft.remove_voter(node_id)
+
+    def autopilot_health(self) -> dict:
+        """Per-server health from leader replication progress + gossip
+        status (ref autopilot ServerHealth/OperatorServerHealth)."""
+        cfg = self.autopilot_config()
+        progress = self.raft.peer_progress() if self.raft.is_leader() else {}
+        gossip_status = {}
+        if self.gossip is not None:
+            with self.gossip._lock:
+                gossip_status = {
+                    m.name: m.status for m in self.gossip.members.values()
+                }
+        leader_last, _ = (
+            self.raft._last_log() if self.raft.is_leader() else (0, 0)
+        )
+        servers = []
+        healthy_all = True
+        for node_id, addr in sorted(self.raft.voters_snapshot().items()):
+            prog = progress.get(node_id, {})
+            contact = prog.get("last_contact_s")
+            trailing = (
+                leader_last - prog.get("match_index", 0)
+                if prog
+                else None
+            )
+            alive = gossip_status.get(node_id, "alive") == "alive"
+            healthy = alive and (
+                node_id == self.raft.node_id
+                or not self.raft.is_leader()
+                or (
+                    contact is not None
+                    and contact <= cfg["last_contact_threshold_s"]
+                    and trailing is not None
+                    and trailing <= cfg["max_trailing_logs"]
+                )
+            )
+            healthy_all = healthy_all and healthy
+            servers.append(
+                {
+                    "ID": node_id,
+                    "Name": node_id,
+                    "Address": addr,
+                    "SerfStatus": gossip_status.get(node_id, "alive"),
+                    "LastContact": contact,
+                    "TrailingLogs": trailing,
+                    "Leader": prog.get("leader", False),
+                    "Healthy": healthy,
+                    "Voter": True,
+                }
+            )
+        failure_tolerance = max(0, (len(servers) - 1) // 2) if servers else 0
+        return {
+            "Healthy": healthy_all,
+            "FailureTolerance": failure_tolerance,
+            "Servers": servers,
+        }
 
     # ------------------------------------------------------------------
     # Regions (ref nomad/regions_endpoint.go + rpc.go region forwarding)
@@ -250,7 +425,7 @@ class Server:
             if member.name == self.raft.node_id:
                 continue
             self._gossip_event("join", member)
-        for voter in list(self.raft.voters):
+        for voter in self.raft.voters_snapshot():
             if voter == self.raft.node_id or voter in alive:
                 continue
             with_status = self.gossip.members.get(voter)
@@ -979,6 +1154,23 @@ class Server:
             if t is not None:
                 t.cancel()
 
+    def node_purge(self, node_id: str) -> list[str]:
+        """Force-remove a node and create evals so its allocations are
+        rescheduled (ref node_endpoint.go Deregister: the raft deregister
+        applies FIRST, then createNodeEvals — evals created before the
+        deregister commits would schedule against a state where the node
+        still looks healthy and no-op, stranding its allocs)."""
+        self._check_leader()
+        node_id = self._node_id_by_prefix(node_id)
+        self.node_deregister(node_id)
+        return self._create_node_evals(node_id) or []
+
+    def reconcile_summaries(self):
+        """Rebuild job summaries from the alloc table through raft
+        (ref system_endpoint.go ReconcileJobSummaries)."""
+        self._check_leader()
+        self._apply(fsm_mod.RECONCILE_SUMMARIES, {})
+
     def node_update_status(self, node_id: str, status: str) -> dict:
         self._check_leader()
         node = self.state.node_by_id(node_id)
@@ -1121,6 +1313,7 @@ class Server:
             self._apply(
                 fsm_mod.EVAL_UPDATE, {"evals": [e.to_dict() for e in evals]}
             )
+        return [e.id for e in evals]
 
     # ------------------------------------------------------------------
     # Client alloc sync (ref node_endpoint.go:894 GetClientAllocs, :362

@@ -131,17 +131,27 @@ class Gossip:
 
     # ------------------------------------------------------------------
     def join(self, seed: tuple[str, int], timeout: float = 5.0) -> bool:
-        """Push our record at a seed and wait until its view merges back
-        (ref serf Join)."""
+        """Push our record at a seed and wait until *that seed's* view
+        merges back (ref serf Join). Success requires a member at the seed
+        address — an earlier successful join must not vouch for a dead
+        seed."""
+        seed = (seed[0], int(seed[1]))
+
+        def seed_merged() -> bool:
+            with self._lock:
+                return any(
+                    m.addr == seed
+                    for m in self.members.values()
+                    if m.name != self.name
+                )
+
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline and not self._stop.is_set():
-            self._send(tuple(seed), {"t": "join", "view": self._view()})
+            self._send(seed, {"t": "join", "view": self._view()})
             time.sleep(0.2)
-            with self._lock:
-                if len(self.members) > 1:
-                    return True
-        with self._lock:
-            return len(self.members) > 1
+            if seed_merged():
+                return True
+        return seed_merged()
 
     def leave(self):
         """Broadcast an intentional departure (ref serf Leave)."""
@@ -153,6 +163,29 @@ class Gossip:
         for m in peers:
             if m.status == ALIVE:
                 self._send(m.addr, {"t": "state", "view": view})
+
+    def force_leave(self, name: str) -> bool:
+        """Mark a (possibly unreachable) member as left and gossip the
+        tombstone at the same incarnation+1 so it dominates the member's
+        own alive record (ref serf RemoveFailedNode). The target can still
+        refute by rejoining with a higher incarnation."""
+        with self._lock:
+            m = self.members.get(name)
+            if m is None or m.name == self.name:
+                return False
+            m.incarnation += 1
+            m.status = LEFT
+            m.status_time = time.monotonic()
+            peers = [
+                p
+                for p in self.members.values()
+                if p.name not in (self.name, name) and p.status == ALIVE
+            ]
+            view = self._view_locked()
+        for p in peers:
+            self._send(p.addr, {"t": "state", "view": view})
+        self._emit("leave", m)
+        return True
 
     def set_tags(self, tags: dict):
         """Merge tag updates into our record and bump the incarnation so

@@ -107,6 +107,7 @@ class Raft:
         self._last_contact = time.monotonic()
         self._futures: dict[int, _Future] = {}
         self._match_index: dict[str, int] = {}
+        self._peer_contact: dict[str, float] = {}  # last successful append ack
         self._next_index: dict[str, int] = {}
         self._replicators: dict[str, threading.Thread] = {}
         self._repl_conds: dict[str, threading.Condition] = {}
@@ -247,6 +248,37 @@ class Raft:
     def leader_address(self) -> Optional[str]:
         lid = self.leader_id
         return self.voters.get(lid) if lid else None
+
+    def voters_snapshot(self) -> dict[str, str]:
+        """Copy of the voter map safe to iterate off-thread (membership
+        changes mutate ``voters`` under the raft lock)."""
+        with self._lock:
+            return dict(self.voters)
+
+    def peer_progress(self) -> dict:
+        """Leader-side replication progress per voter (for autopilot
+        server-health; ref autopilot ServerStats / raft.Stats)."""
+        now = time.monotonic()
+        with self._lock:
+            last, _ = self._last_log()
+            out = {}
+            for pid in self.voters:
+                if pid == self.node_id:
+                    out[pid] = {
+                        "match_index": last,
+                        "last_contact_s": 0.0,
+                        "leader": self.role == LEADER,
+                    }
+                    continue
+                contact = self._peer_contact.get(pid)
+                out[pid] = {
+                    "match_index": self._match_index.get(pid, 0),
+                    "last_contact_s": (
+                        round(now - contact, 3) if contact is not None else None
+                    ),
+                    "leader": False,
+                }
+            return out
 
     def is_leader(self) -> bool:
         return self.role == LEADER
@@ -470,6 +502,7 @@ class Raft:
             if self.role != LEADER:
                 return False
             if resp.get("success"):
+                self._peer_contact[peer_id] = time.monotonic()
                 if entries:
                     self._match_index[peer_id] = entries[-1][0]
                     self._next_index[peer_id] = entries[-1][0] + 1

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Iterable, Optional
 
 from ..structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_CLIENT_STATUS_LOST,
     ALLOC_CLIENT_STATUS_PENDING,
@@ -69,6 +70,7 @@ class Generation:
     deployments: dict[str, Deployment] = field(default_factory=dict)
     periodic_launch: dict[tuple[str, str], dict] = field(default_factory=dict)
     scheduler_config: Optional[dict] = None
+    autopilot_config: Optional[dict] = None
     acl_policies: dict[str, "AclPolicy"] = field(default_factory=dict)
     acl_tokens: dict[str, "AclToken"] = field(default_factory=dict)  # by accessor
     vault_accessors: dict[str, dict] = field(default_factory=dict)  # by accessor
@@ -225,6 +227,9 @@ class StateReader:
     # -- config -----------------------------------------------------------
     def scheduler_config(self) -> Optional[dict]:
         return self._gen.scheduler_config
+
+    def autopilot_config(self) -> Optional[dict]:
+        return self._gen.autopilot_config
 
     # -- vault ------------------------------------------------------------
     def vault_accessors(self) -> list[dict]:
@@ -616,6 +621,49 @@ class StateStore(StateReader):
         summary = summary.copy()
         summary.modify_index = index
         summaries[(summary.namespace, summary.job_id)] = summary
+        self._publish(
+            index=index,
+            job_summaries=summaries,
+            table_indexes=self._bump(gen, index, "job_summary"),
+        )
+
+    @_write_txn
+    def reconcile_job_summaries(self, index: int):
+        """Rebuild every job summary from the allocation table (ref
+        state_store.go ReconcileJobSummaries / fsm.go reconcileSummaries):
+        the repair path behind PUT /v1/system/reconcile/summaries."""
+        gen = self._gen
+        summaries: dict[tuple[str, str], JobSummary] = {}
+        for (ns, jid), job in gen.jobs.items():
+            old = gen.job_summaries.get((ns, jid))
+            s = JobSummary(
+                namespace=ns,
+                job_id=jid,
+                create_index=job.create_index,
+                modify_index=index,
+                children_pending=old.children_pending if old else 0,
+                children_running=old.children_running if old else 0,
+                children_dead=old.children_dead if old else 0,
+            )
+            for tg in job.task_groups:
+                s.summary[tg.name] = TaskGroupSummary()
+            summaries[(ns, jid)] = s
+        for a in gen.allocs.values():
+            s = summaries.get((a.namespace, a.job_id))
+            tg = s.summary.get(a.task_group) if s is not None else None
+            if tg is None:
+                continue
+            cs = a.client_status
+            if cs == ALLOC_CLIENT_STATUS_PENDING:
+                tg.starting += 1
+            elif cs == ALLOC_CLIENT_STATUS_RUNNING:
+                tg.running += 1
+            elif cs == ALLOC_CLIENT_STATUS_COMPLETE:
+                tg.complete += 1
+            elif cs == ALLOC_CLIENT_STATUS_FAILED:
+                tg.failed += 1
+            elif cs == ALLOC_CLIENT_STATUS_LOST:
+                tg.lost += 1
         self._publish(
             index=index,
             job_summaries=summaries,
@@ -1268,6 +1316,15 @@ class StateStore(StateReader):
             table_indexes=self._bump(gen, index, "scheduler_config"),
         )
 
+    @_write_txn
+    def set_autopilot_config(self, index: int, config: dict):
+        gen = self._gen
+        self._publish(
+            index=index,
+            autopilot_config=dict(config),
+            table_indexes=self._bump(gen, index, "autopilot_config"),
+        )
+
     # ------------------------------------------------------------------
     # plan apply (the atomic commit; ref state_store.go:227)
     # ------------------------------------------------------------------
@@ -1354,6 +1411,7 @@ class StateStore(StateReader):
             "deployments": [d.to_dict() for d in gen.deployments.values()],
             "periodic_launch": list(gen.periodic_launch.values()),
             "scheduler_config": gen.scheduler_config,
+            "autopilot_config": gen.autopilot_config,
             "acl_policies": [p.to_dict() for p in gen.acl_policies.values()],
             "acl_tokens": [t.to_dict() for t in gen.acl_tokens.values()],
             "vault_accessors": list(gen.vault_accessors.values()),
@@ -1408,6 +1466,7 @@ class StateStore(StateReader):
                     for pl in data.get("periodic_launch", [])
                 },
                 scheduler_config=data.get("scheduler_config"),
+                autopilot_config=data.get("autopilot_config"),
                 acl_policies={
                     p.name: p
                     for p in (
@@ -1429,6 +1488,7 @@ class StateStore(StateReader):
             self._publish(**{f: getattr(gen, f) for f in (
                 "index", "nodes", "jobs", "job_versions", "job_summaries",
                 "evals", "allocs", "deployments", "periodic_launch",
-                "scheduler_config", "acl_policies", "acl_tokens",
+                "scheduler_config", "autopilot_config",
+                "acl_policies", "acl_tokens",
                 "vault_accessors", "table_indexes",
             )})

@@ -1,0 +1,343 @@
+"""Operator + agent surface tests: raft configuration, autopilot config/
+health, members/join/force-leave, validate/job, node purge, reconcile
+summaries, token self (ref operator_endpoint_test.go, agent_endpoint_test.go,
+system_endpoint_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+
+
+@pytest.fixture(scope="module")
+def http_cluster():
+    from nomad_tpu.agent import DevAgent
+    from nomad_tpu.api import ApiClient, HTTPServer
+
+    agent = DevAgent(num_clients=1, server_config={"seed": 7})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    client = ApiClient(address=http.address)
+    yield agent, http, client
+    http.stop()
+    agent.stop()
+
+
+class TestOperatorRaft:
+    def test_raft_configuration(self, http_cluster):
+        _, _, client = http_cluster
+        cfg = client.raft_configuration()
+        assert len(cfg["Servers"]) == 1
+        srv = cfg["Servers"][0]
+        assert srv["Voter"] is True
+        assert srv["Leader"] is True
+
+    def test_status_peers(self, http_cluster):
+        _, _, client = http_cluster
+        peers = client.status_peers()
+        assert len(peers) == 1
+
+    def test_remove_unknown_peer_404(self, http_cluster):
+        from nomad_tpu.api.client import APIError
+
+        _, _, client = http_cluster
+        with pytest.raises(APIError) as err:
+            client.raft_remove_peer("nope")
+        assert err.value.status == 404
+
+
+class TestAutopilot:
+    def test_default_config(self, http_cluster):
+        _, _, client = http_cluster
+        cfg = client.autopilot_configuration()
+        assert cfg["cleanup_dead_servers"] is True
+
+    def test_set_config_replicates_through_raft(self, http_cluster):
+        agent, _, client = http_cluster
+        client.autopilot_set_configuration({"cleanup_dead_servers": False})
+        # the write must land in the replicated state store, not a local var
+        assert (
+            agent.server.state.autopilot_config()["cleanup_dead_servers"]
+            is False
+        )
+        assert (
+            client.autopilot_configuration()["cleanup_dead_servers"] is False
+        )
+        client.autopilot_set_configuration({"cleanup_dead_servers": True})
+
+    def test_bad_config_rejected(self, http_cluster):
+        from nomad_tpu.api.client import APIError
+
+        _, _, client = http_cluster
+        with pytest.raises(APIError) as err:
+            client.autopilot_set_configuration(
+                {"last_contact_threshold_s": "0.5s"}
+            )
+        assert err.value.status == 400
+        with pytest.raises(APIError):
+            client.autopilot_set_configuration({"bogus_knob": 1})
+        # the health endpoint still works after the rejected writes
+        assert client.autopilot_health()["Healthy"] is True
+
+    def test_health_single_server(self, http_cluster):
+        _, _, client = http_cluster
+        health = client.autopilot_health()
+        assert health["Healthy"] is True
+        assert health["FailureTolerance"] == 0
+        assert len(health["Servers"]) == 1
+        assert health["Servers"][0]["Healthy"] is True
+
+    def test_health_reflects_replication(self):
+        """3-voter in-mem cluster: the leader reports per-peer contact and
+        trailing logs; a partitioned follower turns unhealthy."""
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.raft import InmemTransport, RaftConfig
+
+        transport = InmemTransport()
+        voters = {f"s{i}": f"raft{i}" for i in range(3)}
+        servers = []
+        for i in range(3):
+            cfg = {
+                "seed": i,
+                "heartbeat_ttl": 60.0,
+                "raft": {
+                    "node_id": f"s{i}",
+                    "address": f"raft{i}",
+                    "voters": dict(voters),
+                    "transport": transport,
+                    "config": RaftConfig(
+                        heartbeat_interval=0.03,
+                        election_timeout_min=0.1,
+                        election_timeout_max=0.2,
+                    ),
+                },
+            }
+            s = Server(cfg)
+            s.start(num_workers=0, wait_for_leader=None)
+            servers.append(s)
+        try:
+            deadline = time.monotonic() + 5
+            leader = None
+            while time.monotonic() < deadline and leader is None:
+                leader = next((s for s in servers if s.is_leader()), None)
+                time.sleep(0.02)
+            assert leader is not None
+            # let a couple heartbeat rounds record peer contact
+            time.sleep(0.3)
+            health = leader.autopilot_health()
+            assert health["Healthy"] is True
+            assert health["FailureTolerance"] == 1
+            by_id = {s["ID"]: s for s in health["Servers"]}
+            assert len(by_id) == 3
+            followers = [s for s in servers if not s.is_leader()]
+            victim = followers[0]
+            transport.disconnect(victim.raft.address)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                health = leader.autopilot_health()
+                row = {s["ID"]: s for s in health["Servers"]}[
+                    victim.raft.node_id
+                ]
+                if not row["Healthy"]:
+                    break
+                time.sleep(0.05)
+            assert not row["Healthy"]
+            assert not health["Healthy"]
+        finally:
+            for s in servers:
+                s.stop()
+
+
+class TestAgentSurface:
+    def test_members_static_fallback(self, http_cluster):
+        _, _, client = http_cluster
+        out = client.agent_members()
+        assert out["ServerRegion"] == "global"
+        assert len(out["Members"]) == 1
+        assert out["Members"][0]["Status"] == "alive"
+
+    def test_agent_servers_and_health(self, http_cluster):
+        _, _, client = http_cluster
+        assert len(client.agent_servers()) == 1
+        health = client.agent_health()
+        assert health["server"]["ok"] is True
+
+    def test_join_without_gossip_is_an_error(self, http_cluster):
+        from nomad_tpu.api.client import APIError
+
+        _, _, client = http_cluster
+        with pytest.raises(APIError):
+            client.agent_join("127.0.0.1:1")
+
+
+class TestValidateJob:
+    def test_valid_job(self, http_cluster):
+        _, _, client = http_cluster
+        out = client.validate_job(mock.job().to_dict())
+        assert out["ValidationErrors"] == []
+        assert out["Error"] == ""
+
+    def test_invalid_job(self, http_cluster):
+        _, _, client = http_cluster
+        bad = mock.job()
+        bad.id = ""
+        out = client.validate_job(bad.to_dict())
+        assert out["ValidationErrors"]
+        assert "ID" in out["Error"]
+
+    def test_validate_does_not_register(self, http_cluster):
+        agent, _, client = http_cluster
+        job = mock.job()
+        client.validate_job(job.to_dict())
+        assert agent.server.state.job_by_id(job.namespace, job.id) is None
+
+
+class TestNodePurge:
+    def test_purge_removes_node_and_creates_evals(self, http_cluster):
+        agent, _, client = http_cluster
+        node = mock.node()
+        agent.server.node_register(node)
+        out = client.node_purge(node.id)
+        assert agent.server.state.node_by_id(node.id) is None
+        assert isinstance(out["EvalIDs"], list)
+
+    def test_purge_unknown_node_404(self, http_cluster):
+        from nomad_tpu.api.client import APIError
+
+        _, _, client = http_cluster
+        with pytest.raises(APIError) as err:
+            client.node_purge("00000000-dead-beef-0000-000000000000")
+        assert err.value.status == 404
+
+
+class TestReconcileSummaries:
+    def test_reconcile_rebuilds_from_allocs(self, http_cluster):
+        agent, _, client = http_cluster
+        server = agent.server
+        job = mock.job()
+        job.task_groups[0].count = 1
+        eval_id = server.job_register(job)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ev = server.state.eval_by_id(eval_id)
+            if ev is not None and ev.status == "complete":
+                break
+            time.sleep(0.05)
+        allocs = server.state.allocs_by_job(job.namespace, job.id)
+        assert allocs
+        # corrupt the summary, then ask the cluster to repair it
+        from nomad_tpu.structs.model import JobSummary, TaskGroupSummary
+
+        bogus = JobSummary(
+            namespace=job.namespace,
+            job_id=job.id,
+            create_index=server.state.job_by_id(
+                job.namespace, job.id
+            ).create_index,
+            summary={"web": TaskGroupSummary(running=99, failed=42)},
+        )
+        server.state.upsert_job_summary(
+            server.state.latest_index() + 1, bogus
+        )
+        client.reconcile_summaries()
+        fixed = server.state.job_summary_by_id(job.namespace, job.id)
+        tg = fixed.summary[job.task_groups[0].name]
+        assert tg.failed == 0
+        assert tg.running + tg.starting == len(
+            [a for a in allocs if not a.terminal_status()]
+        )
+
+    def test_eval_allocations_route(self, http_cluster):
+        agent, _, client = http_cluster
+        server = agent.server
+        job = mock.job()
+        job.id = "eval-allocs-job"
+        eval_id = server.job_register(job)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ev = server.state.eval_by_id(eval_id)
+            if ev is not None and ev.status == "complete":
+                break
+            time.sleep(0.05)
+        out = client.eval_allocations(eval_id)
+        assert all(a["eval_id"] == eval_id for a in out)
+
+
+class TestGossipOperator:
+    def test_force_leave_and_dead_server_cleanup_gate(self):
+        """3 gossip servers; autopilot cleanup off keeps a crashed server
+        in the voter map, force-leave (intentional) still removes it."""
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.raft import InmemTransport, RaftConfig
+
+        transport = InmemTransport()
+        servers = []
+        seed_addr = None
+        for i in range(3):
+            cfg = {
+                "seed": 100 + i,
+                "heartbeat_ttl": 60.0,
+                "bootstrap": i == 0,
+                "gossip": {
+                    "bind": ("127.0.0.1", 0),
+                    "probe_interval": 0.1,
+                    "ack_timeout": 0.1,
+                    "suspect_timeout": 0.4,
+                    "reap_timeout": 60.0,
+                },
+                "raft": {
+                    "node_id": f"g{i}",
+                    "address": f"graft{i}",
+                    "voters": {f"g{i}": f"graft{i}"} if i == 0 else {},
+                    "transport": transport,
+                    "config": RaftConfig(
+                        heartbeat_interval=0.03,
+                        election_timeout_min=0.1,
+                        election_timeout_max=0.2,
+                    ),
+                },
+            }
+            s = Server(cfg)
+            s.start(num_workers=0, wait_for_leader=None)
+            if seed_addr is not None:
+                s.gossip.join(seed_addr)
+            else:
+                seed_addr = s.gossip.addr
+            servers.append(s)
+        try:
+            leader = servers[0]
+            assert leader.wait_for_leader(5.0)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if len(leader.raft.voters) == 3:
+                    break
+                time.sleep(0.05)
+            assert len(leader.raft.voters) == 3
+            assert len(leader.members()) == 3
+
+            # autopilot cleanup OFF: a crashed server stays a voter
+            leader.set_autopilot_config({"cleanup_dead_servers": False})
+            victim = servers[2]
+            victim.gossip.stop()
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                m = leader.gossip.members.get("g2")
+                if m is not None and m.status == "dead":
+                    break
+                time.sleep(0.05)
+            time.sleep(0.3)  # would-be removal window
+            assert "g2" in leader.raft.voters
+
+            # force-leave is an intentional departure: always removed
+            assert leader.gossip_force_leave("g2")
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if "g2" not in leader.raft.voters:
+                    break
+                time.sleep(0.05)
+            assert "g2" not in leader.raft.voters
+        finally:
+            for s in servers:
+                s.stop()
