@@ -153,7 +153,7 @@ class ClientAgent:
         advertise: Optional[str] = None,
         tls: Optional[dict] = None,
     ):
-        from .client.fs import register_fs_rpc
+        from .client.fs import register_alloc_rpc, register_fs_rpc
         from .rpc import ConnPool, RpcServer, ServerProxy
         from .tlsutil import contexts_from_config
 
@@ -173,6 +173,7 @@ class ClientAgent:
         # multi-host topologies.
         self.rpc = RpcServer(bind, 0, tls_context=server_ctx)
         register_fs_rpc(self.rpc, self.client)
+        register_alloc_rpc(self.rpc, self.client)
         self.client.node.attributes["unique.advertise.client_rpc"] = (
             advertise or self.rpc.address
         )

@@ -242,3 +242,20 @@ class ApiClient:
 
     def acl_token_self(self) -> dict:
         return self.get("/v1/acl/token/self")[0]
+
+    def alloc_stop(self, alloc_id: str) -> dict:
+        return self.put(f"/v1/allocation/{_q(alloc_id)}/stop")[0]
+
+    def alloc_restart(self, alloc_id: str, task: str = "") -> dict:
+        return self.put(
+            f"/v1/client/allocation/{_q(alloc_id)}/restart",
+            body={"TaskName": task},
+        )[0]
+
+    def alloc_signal(
+        self, alloc_id: str, signal: str = "SIGINT", task: str = ""
+    ) -> dict:
+        return self.put(
+            f"/v1/client/allocation/{_q(alloc_id)}/signal",
+            body={"Signal": signal, "TaskName": task},
+        )[0]

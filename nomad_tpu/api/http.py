@@ -1105,6 +1105,66 @@ class HTTPServer:
             ), None
         return fs.exec_in(base, task, cmd, timeout=timeout), None
 
+    # -- alloc lifecycle (ref alloc_endpoint.go Stop +
+    # client_alloc_endpoint.go Restart/Signal) ---------------------------
+    def _local_client_with_alloc(self, alloc_id: str):
+        clients = []
+        if self.agent is not None:
+            clients = getattr(self.agent, "clients", None) or [
+                getattr(self.agent, "client", None)
+            ]
+        for client in clients:
+            if client is not None and alloc_id in getattr(
+                client, "alloc_runners", {}
+            ):
+                return client
+        return None
+
+    @route("PUT", r"/v1/allocation/(?P<alloc_id>[^/]+)/stop", acl="ns:alloc-lifecycle")
+    def alloc_stop(self, m, query, body):
+        self._check_alloc_ns(query, m["alloc_id"], "alloc-lifecycle")
+        eval_id = self.server.alloc_stop(m["alloc_id"])
+        return {
+            "EvalID": eval_id,
+            "Index": self.server.state.latest_index(),
+        }, None
+
+    @route(
+        "PUT",
+        r"/v1/client/allocation/(?P<alloc_id>[^/]+)/restart",
+        acl="ns:alloc-lifecycle",
+    )
+    def alloc_restart(self, m, query, body):
+        self._check_alloc_ns(query, m["alloc_id"], "alloc-lifecycle")
+        task = (body or {}).get("TaskName", "") or query.get("task", "")
+        client = self._local_client_with_alloc(m["alloc_id"])
+        if client is not None:
+            return {"tasks": client.alloc_restart(m["alloc_id"], task)}, None
+        return self._forward_client_fs(
+            m["alloc_id"], "ClientAllocations.Restart", {"task": task}
+        ), None
+
+    @route(
+        "PUT",
+        r"/v1/client/allocation/(?P<alloc_id>[^/]+)/signal",
+        acl="ns:alloc-lifecycle",
+    )
+    def alloc_signal(self, m, query, body):
+        self._check_alloc_ns(query, m["alloc_id"], "alloc-lifecycle")
+        body = body or {}
+        signal = body.get("Signal", "") or query.get("signal", "SIGINT")
+        task = body.get("TaskName", "") or query.get("task", "")
+        client = self._local_client_with_alloc(m["alloc_id"])
+        if client is not None:
+            return {
+                "tasks": client.alloc_signal(m["alloc_id"], signal, task)
+            }, None
+        return self._forward_client_fs(
+            m["alloc_id"],
+            "ClientAllocations.Signal",
+            {"signal": signal, "task": task},
+        ), None
+
     # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------
     @route("PUT", r"/v1/acl/bootstrap", acl="anonymous")
     def acl_bootstrap(self, m, query, body):

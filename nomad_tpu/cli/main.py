@@ -413,6 +413,29 @@ def cmd_alloc_status(args):
     return 0
 
 
+def cmd_alloc_stop(args):
+    client = _client(args)
+    out = client.alloc_stop(args.alloc_id)
+    print(f"Stop requested; eval {out['EvalID']}")
+    return 0
+
+
+def cmd_alloc_restart(args):
+    client = _client(args)
+    out = client.alloc_restart(args.alloc_id, task=args.task or "")
+    print(f"Restarted tasks: {', '.join(out['tasks'])}")
+    return 0
+
+
+def cmd_alloc_signal(args):
+    client = _client(args)
+    out = client.alloc_signal(
+        args.alloc_id, signal=args.signal, task=args.task or ""
+    )
+    print(f"Signaled tasks: {', '.join(out['tasks'])}")
+    return 0
+
+
 def cmd_eval_status(args):
     client = _client(args)
     ev = client.evaluation(args.eval_id)
@@ -653,6 +676,18 @@ def build_parser() -> argparse.ArgumentParser:
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_status)
+    astop = asub.add_parser("stop", help="stop and reschedule an allocation")
+    astop.add_argument("alloc_id")
+    astop.set_defaults(fn=cmd_alloc_stop)
+    arst = asub.add_parser("restart", help="restart an allocation's tasks")
+    arst.add_argument("alloc_id")
+    arst.add_argument("task", nargs="?")
+    arst.set_defaults(fn=cmd_alloc_restart)
+    asig = asub.add_parser("signal", help="signal an allocation's tasks")
+    asig.add_argument("-s", "--signal", default="SIGINT")
+    asig.add_argument("alloc_id")
+    asig.add_argument("task", nargs="?")
+    asig.set_defaults(fn=cmd_alloc_signal)
 
     ev = sub.add_parser("eval", help="evaluation commands")
     esub = ev.add_subparsers(dest="subcommand")

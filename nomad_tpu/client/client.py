@@ -72,6 +72,9 @@ class TaskRunner:
         # one vault token per task lifecycle: restarts reuse it instead of
         # minting (and leaking) a fresh accessor per attempt
         self._vault_token: Optional[str] = None
+        # user-initiated restart in flight: the run loop re-launches
+        # without consuming the restart-policy budget
+        self._restarting = False
         if restored_state:
             self.state.restarts = int(restored_state.get("restarts", 0))
             self._restarts_in_interval = [
@@ -152,6 +155,17 @@ class TaskRunner:
             self.handle.wait()
             exit_code = self.handle.exit_code or 0
             failed = exit_code != 0
+
+            if self._restarting and not self._stop.is_set():
+                # user-initiated restart (ref taskrunner Restart): loop
+                # without touching the restart-policy budget
+                self._restarting = False
+                self.state = TaskState(
+                    state="pending", restarts=self.state.restarts + 1
+                )
+                self._event("Restarting", "Task restarting by user request")
+                self.alloc_runner.task_state_updated()
+                continue
 
             if self._stop.is_set():
                 self.state = TaskState(
@@ -255,6 +269,31 @@ class TaskRunner:
             self._event("Killing", "Task being killed")
             self.driver.stop_task(self.handle)
 
+    def restart(self):
+        """User-initiated restart (ref client_alloc_endpoint.go Restart →
+        TaskRunner.Restart): kill the running process; the run loop
+        re-launches it outside the restart-policy budget."""
+        if (
+            self.handle is None
+            or self._stop.is_set()
+            or self.state.state != "running"
+        ):
+            raise ValueError(f"task {self.task.name!r} is not running")
+        self._restarting = True
+        self._event("Restart Signaled", "User requested task restart")
+        self.driver.stop_task(self.handle)
+
+    def signal(self, signal_name: str):
+        """Deliver a signal to the running task (ref SignalTask RPC)."""
+        if (
+            self.handle is None
+            or self._stop.is_set()
+            or self.state.state != "running"
+        ):
+            raise ValueError(f"task {self.task.name!r} is not running")
+        self._event("Signaling", f"Task being sent signal {signal_name}")
+        self.driver.signal_task(self.handle, signal_name)
+
 
 class AllocRunner:
     """Per-allocation supervisor (ref client/allocrunner/alloc_runner.go)."""
@@ -327,6 +366,38 @@ class AllocRunner:
             t.start()
         if missing_driver:
             self.task_state_updated()
+
+    def restart_task(self, task_name: str = "") -> list[str]:
+        """Restart one task, or every running task when unnamed
+        (ref client_alloc_endpoint.go Restart). Returns the restarted
+        task names."""
+        runners = self._select_runners(task_name)
+        for tr in runners:
+            tr.restart()
+        return [tr.task.name for tr in runners]
+
+    def signal_task(self, signal_name: str, task_name: str = "") -> list[str]:
+        """Signal one task, or every running task when unnamed
+        (ref client_alloc_endpoint.go Signal)."""
+        runners = self._select_runners(task_name)
+        for tr in runners:
+            tr.signal(signal_name)
+        return [tr.task.name for tr in runners]
+
+    def _select_runners(self, task_name: str) -> list["TaskRunner"]:
+        if task_name:
+            tr = self.task_runners.get(task_name)
+            if tr is None:
+                raise KeyError(f"unknown task: {task_name}")
+            return [tr]
+        running = [
+            tr
+            for tr in self.task_runners.values()
+            if tr.state.state == "running"
+        ]
+        if not running:
+            raise ValueError("allocation has no running tasks")
+        return running
 
     def _watch_health(self):
         """ref allochealth/tracker.go: watch task states until the alloc
@@ -774,6 +845,24 @@ class Client:
                 logger.exception("alloc dir GC failed for %s", alloc_id)
 
     # ------------------------------------------------------------------
+    def alloc_restart(self, alloc_id: str, task_name: str = "") -> list[str]:
+        """Restart a local allocation's task(s); ref client Allocations
+        endpoint Restart."""
+        runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc not found on this client: {alloc_id}")
+        return runner.restart_task(task_name)
+
+    def alloc_signal(
+        self, alloc_id: str, signal_name: str, task_name: str = ""
+    ) -> list[str]:
+        """Signal a local allocation's task(s); ref client Allocations
+        endpoint Signal."""
+        runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc not found on this client: {alloc_id}")
+        return runner.signal_task(signal_name, task_name)
+
     def alloc_state_updated(self, runner: AllocRunner):
         """Batch alloc status updates back to the server
         (ref client.go AllocStateUpdated + allocSync)."""

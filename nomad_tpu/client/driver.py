@@ -87,6 +87,11 @@ class Driver:
             "running": not handle._done.is_set(),
         }
 
+    def signal_task(self, handle: TaskHandle, signal_name: str):
+        """Deliver a signal to the running task (ref driver.proto
+        SignalTask). Drivers without signal support raise."""
+        raise ValueError(f"driver {self.name} does not support signals")
+
     # -- recovery (ref plugins/drivers/proto/driver.proto:35 RecoverTask) --
     def handle_data(self, handle: TaskHandle) -> dict:
         """Serializable reattach info persisted in the client state DB."""
@@ -123,6 +128,7 @@ class MockDriver(Driver):
         handle = TaskHandle(
             task_name=task.name, driver=self.name, started_at=time.time_ns()
         )
+        handle._cfg = dict(cfg)
         run_for = parse_duration(cfg.get("run_for", 0))
         exit_code = int(cfg.get("exit_code", 0))
         handle._run_for = run_for
@@ -148,6 +154,20 @@ class MockDriver(Driver):
             t.cancel()
         if not handle._done.is_set():
             handle.finish(130, "killed")
+
+    def signal_task(self, handle: TaskHandle, signal_name: str):
+        """Records delivered signals for assertions (ref drivers/mock
+        scriptable signals); ``signal_error`` in the task config makes the
+        delivery fail, ``exit_on_signal`` ends the task."""
+        cfg = getattr(handle, "_cfg", {})
+        if cfg.get("signal_error"):
+            raise RuntimeError(str(cfg["signal_error"]))
+        signals = getattr(handle, "signals", None)
+        if signals is None:
+            signals = handle.signals = []
+        signals.append(signal_name)
+        if cfg.get("exit_on_signal") and not handle._done.is_set():
+            self.stop_task(handle)
 
     def handle_data(self, handle: TaskHandle) -> dict:
         return {
@@ -287,6 +307,24 @@ class RawExecDriver(Driver):
                 os.kill(handle.pid, signal.SIGKILL)
             except ProcessLookupError:
                 pass
+
+    def signal_task(self, handle: TaskHandle, signal_name: str):
+        """os-level signal delivery by pid (ref drivers/rawexec SignalTask)."""
+        import os
+        import signal as signal_mod
+
+        if handle._done.is_set() or not handle.pid:
+            raise ValueError("task is not running")
+        name = str(signal_name).upper()
+        if not name.startswith("SIG"):
+            name = "SIG" + name
+        sig = getattr(signal_mod, name, None)
+        if not isinstance(sig, signal_mod.Signals):
+            raise ValueError(f"unknown signal: {signal_name}")
+        try:
+            os.kill(handle.pid, sig)
+        except ProcessLookupError:
+            raise ValueError("task process has already exited")
 
     def handle_data(self, handle: TaskHandle) -> dict:
         return {

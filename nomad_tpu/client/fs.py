@@ -90,6 +90,38 @@ def exec_in(alloc_dir: str, task: str, cmd: list, timeout: float = 30.0) -> dict
     }
 
 
+def register_alloc_rpc(rpc_server, client):
+    """Alloc lifecycle RPCs on the client's listener — the server→client
+    path behind /v1/client/allocation/:id/{restart,signal}
+    (ref client_alloc_endpoint.go → client/rpc Allocations.Restart/Signal)."""
+
+    def check(payload):
+        secret = client.node.secret_id
+        if secret and payload.get("secret") != secret:
+            raise ValueError("client RPC requires the node secret")
+
+    def restart(payload):
+        check(payload)
+        return {
+            "tasks": client.alloc_restart(
+                payload["alloc_id"], payload.get("task", "")
+            )
+        }
+
+    def signal(payload):
+        check(payload)
+        return {
+            "tasks": client.alloc_signal(
+                payload["alloc_id"],
+                payload.get("signal", "SIGINT"),
+                payload.get("task", ""),
+            )
+        }
+
+    rpc_server.register("ClientAllocations.Restart", restart)
+    rpc_server.register("ClientAllocations.Signal", signal)
+
+
 def register_fs_rpc(rpc_server, client):
     """Expose the client's alloc dirs over its RPC listener
     (the server→client reverse path, client_fs_endpoint.go's role)."""

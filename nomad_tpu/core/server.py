@@ -1165,6 +1165,43 @@ class Server:
         self.node_deregister(node_id)
         return self._create_node_evals(node_id) or []
 
+    def alloc_stop(self, alloc_id: str) -> str:
+        """Stop one allocation: desired-transition migrate=true plus an
+        alloc-stop eval in a single raft apply (ref alloc_endpoint.go:211
+        Stop). The scheduler reconciles the stop and replaces the alloc."""
+        from ..structs.model import EVAL_TRIGGER_ALLOC_STOP
+
+        self._check_leader()
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            matches = [
+                a for a in self.state.allocs() if a.id.startswith(alloc_id)
+            ]
+            if len(matches) == 1:
+                alloc = matches[0]
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        job = alloc.job or self.state.job_by_id(alloc.namespace, alloc.job_id)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=alloc.namespace,
+            priority=job.priority if job is not None else 50,
+            type=job.type if job is not None else JOB_TYPE_SERVICE,
+            triggered_by=EVAL_TRIGGER_ALLOC_STOP,
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self._apply(
+            fsm_mod.ALLOC_DESIRED_TRANSITION,
+            {
+                "allocs": {alloc.id: {"migrate": True}},
+                "evals": [ev.to_dict()],
+            },
+        )
+        return ev.id
+
     def reconcile_summaries(self):
         """Rebuild job summaries from the alloc table through raft
         (ref system_endpoint.go ReconcileJobSummaries)."""

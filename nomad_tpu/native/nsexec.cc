@@ -141,6 +141,17 @@ static void forward_signal(int sig) {
   if (task_pid > 0) kill(task_pid, sig);
 }
 
+// the full set the driver's SignalTask can deliver; TERM/INT kill, the
+// rest (HUP/USR1/USR2/QUIT) are app-level signals the task may trap
+static void install_forwarders(void) {
+  signal(SIGTERM, forward_signal);
+  signal(SIGINT, forward_signal);
+  signal(SIGHUP, forward_signal);
+  signal(SIGUSR1, forward_signal);
+  signal(SIGUSR2, forward_signal);
+  signal(SIGQUIT, forward_signal);
+}
+
 static int ns_flags() {
   return CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWIPC | CLONE_NEWUTS;
 }
@@ -207,8 +218,7 @@ int main(int argc, char **argv) {
   if (init_pid > 0) {
     // outer shepherd: forward signals to the namespace init, propagate exit
     task_pid = init_pid;
-    signal(SIGTERM, forward_signal);
-    signal(SIGINT, forward_signal);
+    install_forwarders();
     int status = 0;
     while (waitpid(init_pid, &status, 0) < 0 && errno == EINTR) {
     }
@@ -248,8 +258,7 @@ int main(int argc, char **argv) {
   // pid 1 must install handlers explicitly — default dispositions are
   // ignored for a namespace's init
   task_pid = child;
-  signal(SIGTERM, forward_signal);
-  signal(SIGINT, forward_signal);
+  install_forwarders();
 
   int code = SHEPHERD_ERR;
   for (;;) {

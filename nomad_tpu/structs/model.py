@@ -62,6 +62,7 @@ EVAL_TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"
 EVAL_TRIGGER_QUEUED_ALLOCS = "queued-allocs"
 EVAL_TRIGGER_PREEMPTION = "preemption"
 EVAL_TRIGGER_JOB_SCALING = "job-scaling"
+EVAL_TRIGGER_ALLOC_STOP = "alloc-stop"
 
 ALLOC_DESIRED_STATUS_RUN = "run"
 ALLOC_DESIRED_STATUS_STOP = "stop"

@@ -1,0 +1,237 @@
+"""Alloc lifecycle surface: stop (server-side reschedule), restart and
+signal (client-side, local and forwarded) — ref alloc_endpoint.go Stop,
+client_alloc_endpoint.go Restart/Signal, drivers SignalTask."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, DevAgent, ServerAgent
+from nomad_tpu.api.client import ApiClient, APIError
+from nomad_tpu.api.http import HTTPServer
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def dev():
+    agent = DevAgent(num_clients=1, server_config={"seed": 23})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    client = ApiClient(address=http.address)
+    yield agent, client
+    http.stop()
+    agent.stop()
+
+
+def run_long_job(agent, count=1, run_for="60s"):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": run_for}
+    tg.tasks[0].resources.networks = []
+    agent.server.job_register(job)
+    wait_until(
+        lambda: len(
+            [
+                a
+                for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+                if a.client_status == "running"
+            ]
+        )
+        == count,
+        msg="allocs running",
+    )
+    return job
+
+
+class TestLocalRestartSignal:
+    def test_restart_relaunches_without_budget(self, dev):
+        agent, client = dev
+        job = run_long_job(agent)
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        out = client.alloc_restart(alloc.id)
+        assert out["tasks"] == ["web"]
+        runner = agent.clients[0].alloc_runners[alloc.id]
+        tr = runner.task_runners["web"]
+        wait_until(
+            lambda: tr.state.state == "running" and tr.state.restarts == 1,
+            msg="task running again after restart",
+        )
+        # user restarts bypass the restart-policy budget
+        assert tr._restarts_in_interval == []
+
+    def test_signal_reaches_driver(self, dev):
+        agent, client = dev
+        job = run_long_job(agent)
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        out = client.alloc_signal(alloc.id, signal="SIGHUP")
+        assert out["tasks"] == ["web"]
+        runner = agent.clients[0].alloc_runners[alloc.id]
+        handle = runner.task_runners["web"].handle
+        assert handle.signals == ["SIGHUP"]
+
+    def test_signal_real_process(self, dev):
+        """raw_exec delivers an OS signal the task can trap."""
+        agent, client = dev
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "raw_exec"
+        tg.tasks[0].config = {
+            "command": "/bin/sh",
+            "args": [
+                "-c",
+                'trap "echo got-hup > sig.txt" HUP; '
+                "while true; do sleep 0.1; done",
+            ],
+        }
+        tg.tasks[0].resources.networks = []
+        agent.server.job_register(job)
+        wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in agent.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+            ),
+            msg="raw_exec task running",
+        )
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        client.alloc_signal(alloc.id, signal="HUP")
+        runner = agent.clients[0].alloc_runners[alloc.id]
+        import os
+
+        sig_file = os.path.join(runner.task_dir("web"), "sig.txt")
+        wait_until(
+            lambda: os.path.exists(sig_file), msg="signal trapped by task"
+        )
+
+    def test_unknown_task_404(self, dev):
+        agent, client = dev
+        job = run_long_job(agent)
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        with pytest.raises(APIError) as err:
+            client.alloc_restart(alloc.id, task="nope")
+        assert err.value.status == 404
+
+    def test_signal_completed_task_400(self, dev):
+        agent, client = dev
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "mock_driver"
+        tg.tasks[0].config = {"run_for": "0s"}
+        tg.tasks[0].resources.networks = []
+        agent.server.job_register(job)
+        wait_until(
+            lambda: [
+                a.client_status
+                for a in agent.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+            ]
+            == ["complete"],
+            msg="batch task complete",
+        )
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        with pytest.raises(APIError) as err:
+            client.alloc_signal(alloc.id)
+        assert err.value.status == 400
+
+
+class TestAllocStop:
+    def test_stop_reschedules_elsewhere(self, dev):
+        agent, client = dev
+        job = run_long_job(agent)
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        out = client.alloc_stop(alloc.id)
+        assert out["EvalID"]
+        wait_until(
+            lambda: (
+                agent.server.state.alloc_by_id(alloc.id).desired_status
+                == "stop"
+            ),
+            msg="original alloc stopped",
+        )
+        # the alloc-stop eval places a replacement
+        wait_until(
+            lambda: any(
+                a.id != alloc.id and not a.terminal_status()
+                for a in agent.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+            ),
+            msg="replacement placed",
+        )
+        ev = agent.server.state.eval_by_id(out["EvalID"])
+        assert ev.triggered_by == "alloc-stop"
+
+    def test_stop_unknown_alloc_404(self, dev):
+        _, client = dev
+        with pytest.raises(APIError) as err:
+            client.alloc_stop("00000000-0000-0000-0000-00000000dead")
+        assert err.value.status == 404
+
+
+class TestRemoteForwarding:
+    def test_restart_and_signal_forward_to_remote_client(self):
+        server = ServerAgent("ls0", config={"seed": 31, "heartbeat_ttl": 5.0})
+        server.start(num_workers=2)
+        node_agent = ClientAgent([server.address])
+        http = HTTPServer(server.server, port=0, agent=None)
+        http.start()
+        api = ApiClient(address=http.address)
+        try:
+            node_agent.start()
+            wait_until(
+                lambda: server.server.state.node_by_id(node_agent.node.id)
+                is not None,
+                msg="node registered",
+            )
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": "60s"}
+            tg.tasks[0].resources.networks = []
+            server.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="remote alloc running",
+            )
+            (alloc,) = server.server.state.allocs_by_job(
+                job.namespace, job.id
+            )
+            out = api.alloc_signal(alloc.id, signal="SIGUSR1")
+            assert out["tasks"] == ["web"]
+            runner = node_agent.client.alloc_runners[alloc.id]
+            assert runner.task_runners["web"].handle.signals == ["SIGUSR1"]
+
+            out = api.alloc_restart(alloc.id)
+            assert out["tasks"] == ["web"]
+            tr = runner.task_runners["web"]
+            wait_until(
+                lambda: tr.state.state == "running"
+                and tr.state.restarts == 1,
+                msg="remote task restarted",
+            )
+        finally:
+            http.stop()
+            node_agent.stop()
+            server.stop()
