@@ -259,3 +259,10 @@ class ApiClient:
             f"/v1/client/allocation/{_q(alloc_id)}/signal",
             body={"Signal": signal, "TaskName": task},
         )[0]
+
+    def client_stats(self, node_id: str = "") -> dict:
+        params = {"node_id": node_id} if node_id else {}
+        return self.get("/v1/client/stats", **params)[0]
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        return self.get(f"/v1/client/allocation/{_q(alloc_id)}/stats")[0]

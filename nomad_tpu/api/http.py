@@ -986,6 +986,13 @@ class HTTPServer:
         if alloc is None:
             raise KeyError(f"alloc not found: {alloc_id}")
         node = server.state.node_by_id(alloc.node_id)
+        return self._forward_client_node(
+            node, method, dict(payload, alloc_id=alloc_id)
+        )
+
+    def _forward_client_node(self, node, method: str, payload: dict):
+        """Forward an RPC to a specific node's client listener (the
+        node-addressed variant used by client stats)."""
         addr = (
             node.attributes.get("unique.advertise.client_rpc")
             if node is not None
@@ -993,7 +1000,7 @@ class HTTPServer:
         )
         if not addr:
             raise KeyError(
-                f"alloc {alloc_id} is on a node without a client RPC address"
+                "target node has no advertised client RPC address"
             )
         from ..rpc import ConnPool, RpcError
 
@@ -1001,12 +1008,10 @@ class HTTPServer:
         if pool is None:
             # mTLS rides along when the cluster runs with TLS
             pool = self._fs_pool = ConnPool(
-                tls_context=getattr(server, "tls_client_context", None)
+                tls_context=getattr(self.server, "tls_client_context", None)
             )
         # the node secret authenticates us to the client's RPC listener
-        payload = dict(
-            payload, alloc_id=alloc_id, secret=node.secret_id
-        )
+        payload = dict(payload, secret=node.secret_id)
         # socket timeout must outlast the operation's own timeout
         timeout = float(payload.get("timeout", 0) or 0) + 15.0
         try:
@@ -1163,6 +1168,43 @@ class HTTPServer:
             m["alloc_id"],
             "ClientAllocations.Signal",
             {"signal": signal, "task": task},
+        ), None
+
+    # -- client / alloc stats (ref client_stats_endpoint.go +
+    # client_alloc_endpoint.go Stats) ------------------------------------
+    @route("GET", r"/v1/client/stats", acl="node:read")
+    def client_stats(self, m, query, body):
+        """Host stats of the local client, or of ?node_id= via forwarding."""
+        node_id = query.get("node_id", "")
+        clients = []
+        if self.agent is not None:
+            clients = getattr(self.agent, "clients", None) or [
+                getattr(self.agent, "client", None)
+            ]
+        for client in clients:
+            if client is None:
+                continue
+            if not node_id or client.node.id.startswith(node_id):
+                return client.host_stats(), None
+        if not node_id:
+            raise KeyError("this agent runs no client")
+        nodes = self.server.state.node_by_prefix(node_id)
+        if len(nodes) != 1:
+            raise KeyError(f"node not found: {node_id}")
+        return self._forward_client_node(nodes[0], "ClientStats.Stats", {}), None
+
+    @route(
+        "GET",
+        r"/v1/client/allocation/(?P<alloc_id>[^/]+)/stats",
+        acl="ns:read-job",
+    )
+    def alloc_stats(self, m, query, body):
+        self._check_alloc_ns(query, m["alloc_id"], "read-job")
+        client = self._local_client_with_alloc(m["alloc_id"])
+        if client is not None:
+            return client.alloc_stats(m["alloc_id"]), None
+        return self._forward_client_fs(
+            m["alloc_id"], "ClientAllocations.Stats", {}
         ), None
 
     # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------

@@ -310,6 +310,22 @@ def cmd_node_status(args):
         for a in allocs:
             print(f"  {a['ID'][:8]} {a['JobID'][:24]:<26} "
                   f"{a['DesiredStatus']:<8} {a['ClientStatus']}")
+    if getattr(args, "stats", False):
+        try:
+            stats = client.client_stats(node["id"])
+        except Exception as e:
+            print(f"\nHost Stats  = unavailable ({e})")
+            return 0
+        mem = stats.get("memory", {})
+        disk = stats.get("disk", {})
+        cpu = stats.get("cpu", {})
+        print("\nHost Stats")
+        print(f"  CPU    = {cpu.get('total_percent', 0):.1f}% busy")
+        print(f"  Memory = {mem.get('used', 0) // (1 << 20)} MiB used / "
+              f"{mem.get('total', 0) // (1 << 20)} MiB")
+        print(f"  Disk   = {disk.get('used_percent', 0):.1f}% of "
+              f"{disk.get('size', 0) // (1 << 30)} GiB")
+        print(f"  Uptime = {stats.get('uptime_s', 0):.0f}s")
     return 0
 
 
@@ -410,6 +426,17 @@ def cmd_alloc_status(args):
         print(f"\nTask \"{task}\": {st['state']}"
               + (" (failed)" if st.get("failed") else ""))
         print(f"  Restarts = {st.get('restarts', 0)}")
+    if getattr(args, "stats", False):
+        try:
+            stats = client.alloc_stats(alloc["id"])
+        except Exception as e:
+            print(f"\nResource Usage = unavailable ({e})")
+            return 0
+        print("\nResource Usage")
+        for task, usage in sorted(stats.get("tasks", {}).items()):
+            print(f"  {task}: cpu {usage.get('cpu_time_s', 0)}s, "
+                  f"rss {usage.get('rss_bytes', 0) // (1 << 20)} MiB, "
+                  f"pids {usage.get('pids', 0)}")
     return 0
 
 
@@ -641,6 +668,7 @@ def build_parser() -> argparse.ArgumentParser:
     node = sub.add_parser("node", help="node commands")
     nsub = node.add_subparsers(dest="subcommand")
     ns = nsub.add_parser("status")
+    ns.add_argument("-stats", "--stats", action="store_true", dest="stats")
     ns.add_argument("node_id", nargs="?")
     ns.set_defaults(fn=cmd_node_status)
     nd = nsub.add_parser("drain")
@@ -675,6 +703,7 @@ def build_parser() -> argparse.ArgumentParser:
     aex.set_defaults(fn=cmd_alloc_exec)
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")
+    ast.add_argument("-stats", "--stats", action="store_true", dest="stats")
     ast.set_defaults(fn=cmd_alloc_status)
     astop = asub.add_parser("stop", help="stop and reschedule an allocation")
     astop.add_argument("alloc_id")

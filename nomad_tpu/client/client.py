@@ -845,6 +845,53 @@ class Client:
                 logger.exception("alloc dir GC failed for %s", alloc_id)
 
     # ------------------------------------------------------------------
+    def host_stats(self) -> dict:
+        """Sampled host cpu/mem/disk/uptime stats (ref client/stats/host.go;
+        served as /v1/client/stats)."""
+        from .stats import HostStatsCollector
+
+        if getattr(self, "_stats_collector", None) is None:
+            self._stats_collector = HostStatsCollector(self.data_dir)
+        stats = self._stats_collector.collect()
+        stats["node_id"] = self.node.id
+        stats["allocs_running"] = len(self.alloc_runners)
+        return stats
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        """Per-task resource usage for a local alloc (ref
+        client_alloc_endpoint.go Stats → TaskResourceUsage)."""
+        from .stats import task_resource_usage
+
+        runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc not found on this client: {alloc_id}")
+        tasks = {}
+        total = {"cpu_time_s": 0.0, "rss_bytes": 0, "pids": 0}
+        for name, tr in runner.task_runners.items():
+            usage = (
+                task_resource_usage(tr.handle)
+                if tr.handle is not None
+                else {
+                    "cpu_time_s": 0.0,
+                    "rss_bytes": 0,
+                    "pids": 0,
+                    "timestamp": now_ns(),
+                }
+            )
+            usage["state"] = tr.state.state
+            tasks[name] = usage
+            total["cpu_time_s"] = round(
+                total["cpu_time_s"] + usage["cpu_time_s"], 3
+            )
+            total["rss_bytes"] += usage["rss_bytes"]
+            total["pids"] += usage["pids"]
+        return {
+            "alloc_id": alloc_id,
+            "tasks": tasks,
+            "resource_usage": total,
+            "timestamp": now_ns(),
+        }
+
     def alloc_restart(self, alloc_id: str, task_name: str = "") -> list[str]:
         """Restart a local allocation's task(s); ref client Allocations
         endpoint Restart."""

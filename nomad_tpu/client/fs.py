@@ -118,8 +118,18 @@ def register_alloc_rpc(rpc_server, client):
             )
         }
 
+    def stats(payload):
+        check(payload)
+        return client.alloc_stats(payload["alloc_id"])
+
+    def host_stats(payload):
+        check(payload)
+        return client.host_stats()
+
     rpc_server.register("ClientAllocations.Restart", restart)
     rpc_server.register("ClientAllocations.Signal", signal)
+    rpc_server.register("ClientAllocations.Stats", stats)
+    rpc_server.register("ClientStats.Stats", host_stats)
 
 
 def register_fs_rpc(rpc_server, client):

@@ -1,0 +1,170 @@
+"""Host and alloc stats (ref client/stats/host.go, drivers/shared/executor
+pid stats, client_stats_endpoint.go, client_alloc_endpoint.go Stats)."""
+
+import os
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, DevAgent, ServerAgent
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.client.stats import (
+    HostStatsCollector,
+    disk_stats,
+    pid_stats,
+    task_resource_usage,
+)
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestCollectors:
+    def test_host_stats_shape(self):
+        c = HostStatsCollector("/")
+        first = c.collect()
+        assert first["memory"]["total"] > 0
+        assert first["disk"]["size"] > 0
+        assert first["uptime_s"] > 0
+        # burn a little cpu so the delta sample is nonzero somewhere
+        sum(i * i for i in range(200_000))
+        second = c.collect()
+        cpu = second["cpu"]
+        assert 0.0 <= cpu["total_percent"] <= 100.0
+        assert (
+            abs(
+                cpu["user_percent"]
+                + cpu["system_percent"]
+                + cpu["idle_percent"]
+                - 100.0
+            )
+            < 15.0  # delta rounding + unaccounted states (steal, irq)
+        )
+
+    def test_disk_stats_used_percent(self):
+        d = disk_stats("/tmp")
+        assert d["size"] >= d["used"] >= 0
+        assert 0.0 <= d["used_percent"] <= 100.0
+
+    def test_pid_stats_self(self):
+        st = pid_stats(os.getpid())
+        assert st is not None
+        assert st["rss_bytes"] > 1 << 20  # a python process holds >1MiB
+        assert st["cpu_time_s"] >= 0.0
+
+    def test_pid_stats_gone(self):
+        assert pid_stats(2**22 - 3) is None
+
+    def test_task_resource_usage_subprocess(self):
+        import subprocess
+        import threading
+
+        from nomad_tpu.client.driver import TaskHandle
+
+        proc = subprocess.Popen(["sleep", "5"])
+        handle = TaskHandle(task_name="t", pid=proc.pid)
+        try:
+            # rss can read 0 for an instant mid-exec; settle briefly
+            deadline = time.monotonic() + 5
+            usage = task_resource_usage(handle)
+            while usage["rss_bytes"] == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+                usage = task_resource_usage(handle)
+            assert usage["pids"] >= 1
+            assert usage["rss_bytes"] > 0
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+class TestStatsSurface:
+    @pytest.fixture()
+    def dev(self):
+        agent = DevAgent(num_clients=1, server_config={"seed": 41})
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        client = ApiClient(address=http.address)
+        yield agent, client
+        http.stop()
+        agent.stop()
+
+    def test_client_stats_local(self, dev):
+        agent, client = dev
+        stats = client.client_stats()
+        assert stats["node_id"] == agent.clients[0].node.id
+        assert stats["memory"]["total"] > 0
+
+    def test_alloc_stats_local_real_process(self, dev):
+        agent, client = dev
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "raw_exec"
+        tg.tasks[0].config = {"command": "/bin/sleep", "args": ["60"]}
+        tg.tasks[0].resources.networks = []
+        agent.server.job_register(job)
+        wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+            ),
+            msg="raw_exec running",
+        )
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        stats = client.alloc_stats(alloc.id)
+        assert stats["alloc_id"] == alloc.id
+        web = stats["tasks"]["web"]
+        assert web["state"] == "running"
+        assert web["pids"] >= 1
+        assert web["rss_bytes"] > 0
+
+    def test_remote_stats_forwarding(self):
+        server = ServerAgent("st0", config={"seed": 43, "heartbeat_ttl": 5.0})
+        server.start(num_workers=2)
+        node_agent = ClientAgent([server.address])
+        http = HTTPServer(server.server, port=0, agent=None)
+        http.start()
+        api = ApiClient(address=http.address)
+        try:
+            node_agent.start()
+            wait_until(
+                lambda: server.server.state.node_by_id(node_agent.node.id)
+                is not None,
+                msg="node registered",
+            )
+            stats = api.client_stats(node_agent.node.id)
+            assert stats["node_id"] == node_agent.node.id
+            assert stats["memory"]["total"] > 0
+
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": "60s"}
+            tg.tasks[0].resources.networks = []
+            server.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="remote alloc running",
+            )
+            (alloc,) = server.server.state.allocs_by_job(job.namespace, job.id)
+            stats = api.alloc_stats(alloc.id)
+            assert stats["tasks"]["web"]["state"] == "running"
+        finally:
+            http.stop()
+            node_agent.stop()
+            server.stop()
