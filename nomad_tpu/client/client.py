@@ -35,7 +35,7 @@ from ..structs.model import (
     now_ns,
 )
 from ..structs.node_class import compute_class
-from .driver import BUILTIN_DRIVERS, Driver, TaskHandle
+from .driver import BUILTIN_DRIVERS, Driver, TaskHandle, default_drivers
 
 logger = logging.getLogger("nomad_tpu.client")
 
@@ -506,9 +506,7 @@ class Client:
         # Optional cap on restart backoff (dev/test speedup); None = honor
         # the task group's configured delay in full
         self.max_restart_delay: Optional[float] = None
-        self.drivers = drivers or {
-            name: cls() for name, cls in BUILTIN_DRIVERS.items()
-        }
+        self.drivers = drivers or default_drivers()
         from .devices import DeviceManager
 
         self.device_manager = DeviceManager(device_plugins)
@@ -608,7 +606,15 @@ class Client:
             node.drivers[name] = DriverInfo(
                 detected=fp["detected"], healthy=fp["healthy"]
             )
-            node.attributes[f"driver.{name}"] = "1"
+            # the driver.<name> attribute exists only while detected, and
+            # driver-reported attributes (versions etc) ride along
+            # (ref drivermanager → fingerprint attribute merge)
+            if fp["detected"]:
+                node.attributes[f"driver.{name}"] = "1"
+                for k, v in (fp.get("attributes") or {}).items():
+                    node.attributes[k] = str(v)
+            else:
+                node.attributes.pop(f"driver.{name}", None)
         return changed
 
     # ------------------------------------------------------------------

@@ -480,3 +480,20 @@ BUILTIN_DRIVERS = {
     RawExecDriver.name: RawExecDriver,
     ExecDriver.name: ExecDriver,
 }
+
+
+def default_drivers() -> dict:
+    """Instantiate every driver family a node agent carries by default:
+    the builtin exec family plus the external-runtime tier (java, qemu,
+    docker — ref helper/pluginutils/catalog/register.go's builtin driver
+    registrations). Runtime-gated drivers report detected=False via
+    fingerprint when their binary is absent."""
+    out = {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+    from ..drivers import EXTENDED_DRIVERS
+
+    for name, cls in EXTENDED_DRIVERS.items():
+        try:
+            out[name] = cls()
+        except Exception:  # a broken runtime probe must not kill the agent
+            pass
+    return out

@@ -1,0 +1,244 @@
+"""Docker task driver (ref drivers/docker/driver.go), built on the docker
+CLI rather than the engine API socket: run/wait/stop/kill/rm/inspect cover
+the reference driver's container lifecycle, `docker logs -f` feeds the
+task log files (the docklog companion's role), and recovery re-attaches to
+a still-running container by name (RecoverTask).
+
+Task config:
+  image         required
+  command/args  override the image entrypoint
+  network_mode  --network value
+  volumes       ["host:container", ...]
+  labels        {k: v} container labels
+  port_map      {label: container_port} publish task ports
+  force_pull    pull the image even when present
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+import time
+import uuid
+
+from ..client.driver import Driver, TaskHandle, task_log_dir
+from ..structs.model import Task
+
+
+class DockerDriver(Driver):
+    name = "docker"
+
+    def __init__(self, binary: str = ""):
+        self._docker = binary or shutil.which("docker")
+        self._version = ""
+        self._healthy = False
+        if self._docker:
+            self._version = self._probe_version()
+            self._healthy = bool(self._version)
+
+    def _run(self, *args, timeout: float = 60.0) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            [self._docker, *args],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+
+    def _probe_version(self) -> str:
+        """Engine (server) version; empty when the daemon is unreachable —
+        the CLI alone doesn't make the driver healthy (ref docker
+        fingerprint's dockerd connectivity check)."""
+        try:
+            out = self._run(
+                "version", "--format", "{{.Server.Version}}", timeout=10
+            )
+            if out.returncode == 0:
+                return out.stdout.strip()
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        return ""
+
+    def fingerprint(self) -> dict:
+        attrs = {}
+        if self._healthy:
+            attrs["driver.docker.version"] = self._version
+        return {
+            "detected": bool(self._docker),
+            "healthy": self._healthy,
+            "attributes": attrs,
+        }
+
+    # ------------------------------------------------------------------
+    def start_task(self, task: Task, task_dir: str) -> TaskHandle:
+        if not self._healthy:
+            raise RuntimeError("docker daemon is not available on this node")
+        cfg = task.config or {}
+        image = cfg.get("image")
+        if not image:
+            raise RuntimeError("docker requires an image")
+        container = f"nomad-{task.name}-{uuid.uuid4().hex[:8]}"
+
+        if cfg.get("force_pull"):
+            pulled = self._run("pull", image, timeout=600)
+            if pulled.returncode != 0:
+                raise RuntimeError(f"docker pull failed: {pulled.stderr.strip()}")
+
+        argv = ["run", "-d", "--name", container]
+        if task.resources.memory_mb:
+            argv += ["--memory", f"{task.resources.memory_mb}m"]
+        if task.resources.cpu:
+            argv += ["--cpu-shares", str(task.resources.cpu)]
+        for k, v in (task.env or {}).items():
+            argv += ["-e", f"{k}={v}"]
+        for volume in cfg.get("volumes", []):
+            argv += ["-v", str(volume)]
+        if cfg.get("network_mode"):
+            argv += ["--network", str(cfg["network_mode"])]
+        for k, v in (cfg.get("labels") or {}).items():
+            argv += ["--label", f"{k}={v}"]
+        # port publishing: task port labels → container ports
+        # (ref docker driver's port_map + publishedPorts)
+        port_map = cfg.get("port_map") or {}
+        ports = {}
+        for net in task.resources.networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                ports[p.label] = p.value
+        for label, container_port in port_map.items():
+            host_port = ports.get(label)
+            if host_port:
+                argv += ["-p", f"{host_port}:{container_port}"]
+        argv.append(image)
+        if cfg.get("command"):
+            argv.append(str(cfg["command"]))
+        argv += [str(a) for a in cfg.get("args", [])]
+
+        out = self._run(*argv, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
+
+        handle = TaskHandle(
+            task_name=task.name, driver=self.name, started_at=time.time_ns()
+        )
+        handle._container = container
+        self._supervise(handle, container, task_dir)
+        return handle
+
+    def _supervise(self, handle: TaskHandle, container: str, task_dir: str):
+        """Wait for exit + follow logs into the task log files (the
+        docklog companion process's role, drivers/docker/docklog/)."""
+        if task_dir:
+            log_dir = task_log_dir(task_dir)
+            os.makedirs(log_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(log_dir, f"{handle.task_name}.stdout.0"), "ab"
+            )
+            stderr = open(
+                os.path.join(log_dir, f"{handle.task_name}.stderr.0"), "ab"
+            )
+            try:
+                follower = subprocess.Popen(
+                    [self._docker, "logs", "-f", container],
+                    stdout=stdout,
+                    stderr=stderr,
+                )
+                handle._log_follower = follower
+            except OSError:
+                pass
+            finally:
+                stdout.close()
+                stderr.close()
+
+        def waiter():
+            code = 130
+            try:
+                out = subprocess.run(
+                    [self._docker, "wait", container],
+                    capture_output=True,
+                    text=True,
+                )
+                if out.returncode == 0:
+                    code = int(out.stdout.strip().splitlines()[-1])
+            except (OSError, ValueError, IndexError):
+                pass
+            follower = getattr(handle, "_log_follower", None)
+            if follower is not None and follower.poll() is None:
+                try:
+                    follower.terminate()
+                except OSError:
+                    pass
+            if not handle._done.is_set():
+                handle.finish(code)
+
+        threading.Thread(target=waiter, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+        container = getattr(handle, "_container", None)
+        if container is None or handle._done.is_set():
+            return
+        try:
+            self._run(
+                "stop", "-t", str(int(timeout)), container,
+                timeout=timeout + 30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def destroy_task(self, handle: TaskHandle):
+        container = getattr(handle, "_container", None)
+        if container is None:
+            return
+        try:
+            self._run("rm", "-f", container, timeout=60)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def signal_task(self, handle: TaskHandle, signal_name: str):
+        container = getattr(handle, "_container", None)
+        if container is None or handle._done.is_set():
+            raise ValueError("task is not running")
+        name = str(signal_name).upper()
+        if not name.startswith("SIG"):
+            name = "SIG" + name
+        out = self._run("kill", "--signal", name, container, timeout=30)
+        if out.returncode != 0:
+            raise ValueError(f"docker kill failed: {out.stderr.strip()}")
+
+    def inspect_task(self, handle: TaskHandle) -> dict:
+        base = super().inspect_task(handle)
+        base["container"] = getattr(handle, "_container", None)
+        return base
+
+    # -- recovery (ref docker RecoverTask by reattaching to the container)
+    def handle_data(self, handle: TaskHandle) -> dict:
+        return {
+            "driver": self.name,
+            "task_name": handle.task_name,
+            "container": getattr(handle, "_container", None),
+            "started_at": handle.started_at,
+        }
+
+    def recover_task(self, task: Task, data: dict):
+        container = data.get("container")
+        if not container or not self._healthy:
+            return None
+        try:
+            out = self._run(
+                "inspect", "--format", "{{.State.Running}}", container,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0 or out.stdout.strip() != "true":
+            return None
+        handle = TaskHandle(
+            task_name=task.name,
+            driver=self.name,
+            started_at=int(data.get("started_at", 0)),
+            recovered=True,
+        )
+        handle._container = container
+        self._supervise(handle, container, "")
+        return handle

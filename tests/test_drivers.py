@@ -1,0 +1,274 @@
+"""Extended driver families: java, qemu, docker (ref drivers/{java,qemu,
+docker}). The real runtimes are absent in CI, so fingerprint gating is
+tested against the live host and lifecycle behavior against fake binaries."""
+
+import os
+import stat
+import textwrap
+import time
+
+import pytest
+
+from nomad_tpu.client.driver import default_drivers
+from nomad_tpu.drivers import DockerDriver, JavaDriver, QemuDriver
+from nomad_tpu.structs.model import Task
+
+
+def write_script(path, body):
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n" + textwrap.dedent(body))
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def make_task(name="t1", config=None, cpu=100, memory_mb=256):
+    task = Task(name=name, driver="x", config=config or {})
+    task.resources.cpu = cpu
+    task.resources.memory_mb = memory_mb
+    task.resources.networks = []
+    return task
+
+
+class TestFingerprintGating:
+    def test_absent_runtimes_undetected(self):
+        """This image carries none of the runtimes: every extended driver
+        must degrade to detected=False instead of failing."""
+        for cls in (JavaDriver, QemuDriver, DockerDriver):
+            fp = cls().fingerprint()
+            assert fp["detected"] is False
+            assert fp["healthy"] is False
+
+    def test_default_drivers_contains_all_families(self):
+        drivers = default_drivers()
+        for name in ("mock_driver", "raw_exec", "exec", "java", "qemu", "docker"):
+            assert name in drivers
+
+    def test_undetected_driver_blocks_scheduling(self):
+        """DriverChecker keeps docker jobs off nodes without docker."""
+        import nomad_tpu.mock as mock
+        from nomad_tpu.scheduler import Harness
+
+        h = Harness(seed=3)
+        node = mock.node()
+        h.state.upsert_node(h.next_index(), node)  # mock node: no docker
+        job = mock.job()
+        job.task_groups[0].tasks[0].driver = "docker"
+        job.task_groups[0].tasks[0].config = {"image": "redis:3.2"}
+        h.state.upsert_job(h.next_index(), job)
+        from nomad_tpu.structs.model import Evaluation, generate_uuid
+
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=50,
+            type=job.type,
+            triggered_by="job-register",
+            job_id=job.id,
+            status="pending",
+        )
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("service", ev)
+        assert h.state.allocs_by_job(job.namespace, job.id) == []
+
+
+class TestJavaDriver:
+    def test_version_parse_and_run(self, tmp_path):
+        fake = write_script(
+            tmp_path / "java",
+            """
+            if [ "$1" = "-version" ]; then
+              echo 'openjdk version "11.0.2" 2019-01-15' >&2
+              exit 0
+            fi
+            echo "ran: $@" > "$JAVA_OUT"
+            """,
+        )
+        driver = JavaDriver(binary=fake)
+        fp = driver.fingerprint()
+        assert fp["detected"] and fp["healthy"]
+        assert fp["attributes"]["driver.java.version"] == "11.0.2"
+
+        out_file = tmp_path / "out.txt"
+        task = make_task(
+            config={
+                "jar_path": "/srv/app.jar",
+                "jvm_options": ["-Xmx128m"],
+                "args": ["serve"],
+            }
+        )
+        task.env = {"JAVA_OUT": str(out_file)}
+        handle = driver.start_task(task, str(tmp_path))
+        assert handle.wait(10)
+        assert handle.exit_code == 0
+        assert out_file.read_text().strip() == "ran: -Xmx128m -jar /srv/app.jar serve"
+
+    def test_requires_exactly_one_target(self, tmp_path):
+        fake = write_script(tmp_path / "java", "exit 0\n")
+        driver = JavaDriver(binary=fake)
+        with pytest.raises(RuntimeError):
+            driver.start_task(make_task(config={}), str(tmp_path))
+        with pytest.raises(RuntimeError):
+            driver.start_task(
+                make_task(config={"jar_path": "a.jar", "class": "Main"}),
+                str(tmp_path),
+            )
+
+
+class TestQemuDriver:
+    def test_command_composition(self, tmp_path):
+        fake = write_script(
+            tmp_path / "qemu-system-x86_64",
+            """
+            if [ "$1" = "--version" ]; then
+              echo "QEMU emulator version 6.2.0 (Debian)"
+              exit 0
+            fi
+            echo "$@" > "$QEMU_OUT"
+            """,
+        )
+        driver = QemuDriver(binary=fake)
+        fp = driver.fingerprint()
+        assert fp["attributes"]["driver.qemu.version"] == "6.2.0"
+
+        out_file = tmp_path / "argv.txt"
+        task = make_task(
+            memory_mb=1024,
+            config={"image_path": "/srv/vm.img", "accelerator": "tcg"},
+        )
+        task.env = {"QEMU_OUT": str(out_file)}
+        handle = driver.start_task(task, str(tmp_path))
+        assert handle.wait(10)
+        argv = out_file.read_text()
+        assert "-m 1024M" in argv
+        assert "accel=tcg" in argv
+        assert "file=/srv/vm.img" in argv
+
+    def test_image_required(self, tmp_path):
+        fake = write_script(tmp_path / "q", "exit 0\n")
+        with pytest.raises(RuntimeError):
+            QemuDriver(binary=fake).start_task(make_task(), str(tmp_path))
+
+
+class TestDockerDriver:
+    @pytest.fixture()
+    def fake_docker(self, tmp_path):
+        """A docker CLI stand-in with enough statefulness for the driver's
+        lifecycle: run records args, wait blocks until stop/kill writes an
+        exit file, inspect reports running state."""
+        state = tmp_path / "docker-state"
+        state.mkdir()
+        script = write_script(
+            tmp_path / "docker",
+            f"""
+            STATE="{state}"
+            cmd=$1; shift
+            case "$cmd" in
+              version) echo "24.0.5";;
+              run)
+                name=""
+                prev=""
+                for a in "$@"; do
+                  if [ "$prev" = "--name" ]; then name="$a"; fi
+                  prev="$a"
+                done
+                echo "$@" > "$STATE/$name.run"
+                echo running > "$STATE/$name.state"
+                echo "deadbeef$name"
+                ;;
+              wait)
+                name="$1"
+                while [ ! -f "$STATE/$name.exit" ]; do sleep 0.05; done
+                cat "$STATE/$name.exit"
+                ;;
+              stop)
+                shift; name="$2"  # after -t N
+                [ -z "$name" ] && name="$1"
+                echo stopped > "$STATE/$name.state"
+                echo 0 > "$STATE/$name.exit"
+                ;;
+              kill)
+                sig="$2"; name="$3"
+                echo "$sig" >> "$STATE/$name.signals"
+                ;;
+              logs) echo "hello-docker";;
+              inspect)
+                name="$3"
+                [ "$3" = "--format" ] && name="$4"
+                grep -q running "$STATE/$name.state" 2>/dev/null \\
+                  && echo true || echo false
+                ;;
+              rm) echo removed > "$STATE/$2.state" 2>/dev/null || true;;
+            esac
+            """,
+        )
+        return script, state
+
+    def test_lifecycle(self, fake_docker, tmp_path):
+        script, state = fake_docker
+        driver = DockerDriver(binary=script)
+        fp = driver.fingerprint()
+        assert fp["healthy"]
+        assert fp["attributes"]["driver.docker.version"] == "24.0.5"
+
+        task = make_task(
+            config={
+                "image": "redis:3.2",
+                "args": ["--appendonly", "yes"],
+                "labels": {"team": "infra"},
+            }
+        )
+        task.env = {"FOO": "bar"}
+        task_dir = tmp_path / "taskdir"
+        task_dir.mkdir()
+        handle = driver.start_task(task, str(task_dir))
+        container = handle._container
+        run_args = (state / f"{container}.run").read_text()
+        assert "redis:3.2" in run_args
+        assert "--memory 256m" in run_args
+        assert "-e FOO=bar" in run_args
+        assert "--label team=infra" in run_args
+        assert not handle._done.is_set()
+
+        driver.signal_task(handle, "HUP")
+        assert (state / f"{container}.signals").read_text().strip() == "SIGHUP"
+
+        driver.stop_task(handle, timeout=1.0)
+        assert handle.wait(10)
+        assert handle.exit_code == 0
+
+        # docklog role: container output landed in the task log files
+        logs = (
+            task_dir / "logs" / f"{task.name}.stdout.0"
+        ).read_text()
+        assert "hello-docker" in logs
+
+    def test_recover_running_container(self, fake_docker, tmp_path):
+        script, state = fake_docker
+        driver = DockerDriver(binary=script)
+        task = make_task(config={"image": "redis:3.2"})
+        handle = driver.start_task(task, str(tmp_path))
+        data = driver.handle_data(handle)
+
+        fresh = DockerDriver(binary=script)
+        recovered = fresh.recover_task(task, data)
+        assert recovered is not None
+        assert recovered.recovered is True
+        assert recovered._container == handle._container
+
+        # a stopped container is not recoverable
+        (state / f"{handle._container}.state").write_text("stopped")
+        assert fresh.recover_task(task, data) is None
+
+    def test_run_failure_raises(self, tmp_path):
+        script = write_script(
+            tmp_path / "docker",
+            """
+            case "$1" in
+              version) echo "24.0.5";;
+              run) echo "no such image" >&2; exit 125;;
+            esac
+            """,
+        )
+        driver = DockerDriver(binary=script)
+        with pytest.raises(RuntimeError, match="no such image"):
+            driver.start_task(make_task(config={"image": "nope"}), str(tmp_path))
