@@ -260,6 +260,58 @@ class ApiClient:
             body={"Signal": signal, "TaskName": task},
         )[0]
 
+    def job_evaluate(self, job_id: str, force_reschedule: bool = False) -> dict:
+        return self.put(
+            f"/v1/job/{_q(job_id)}/evaluate",
+            body={"EvalOptions": {"ForceReschedule": force_reschedule}},
+        )[0]
+
+    def agent_monitor(self, index: int = 0, log_level: str = "") -> dict:
+        params = {"index": index}
+        if log_level:
+            params["log_level"] = log_level
+        return self.get("/v1/agent/monitor", **params)[0]
+
+    def acl_bootstrap(self) -> dict:
+        return self.put("/v1/acl/bootstrap")[0]
+
+    def acl_policies(self) -> list:
+        return self.get("/v1/acl/policies")[0]
+
+    def acl_policy(self, name: str) -> dict:
+        return self.get(f"/v1/acl/policy/{_q(name)}")[0]
+
+    def acl_put_policy(self, name: str, rules: str, description: str = "") -> dict:
+        return self.put(
+            f"/v1/acl/policy/{_q(name)}",
+            body={"Rules": rules, "Description": description},
+        )[0]
+
+    def acl_delete_policy(self, name: str) -> dict:
+        return self.delete(f"/v1/acl/policy/{_q(name)}")[0]
+
+    def acl_tokens(self) -> list:
+        return self.get("/v1/acl/tokens")[0]
+
+    def acl_token(self, accessor: str) -> dict:
+        return self.get(f"/v1/acl/token/{_q(accessor)}")[0]
+
+    def acl_create_token(
+        self, name: str = "", type: str = "client", policies=None, global_token=False
+    ) -> dict:
+        return self.put(
+            "/v1/acl/token",
+            body={
+                "Name": name,
+                "Type": type,
+                "Policies": list(policies or []),
+                "Global": global_token,
+            },
+        )[0]
+
+    def acl_delete_token(self, accessor: str) -> dict:
+        return self.delete(f"/v1/acl/token/{_q(accessor)}")[0]
+
     def client_stats(self, node_id: str = "") -> dict:
         params = {"node_id": node_id} if node_id else {}
         return self.get("/v1/client/stats", **params)[0]

@@ -107,6 +107,9 @@ class HTTPServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
+        from ..util import LogBuffer
+
+        LogBuffer.install()  # capture logs from agent start for /monitor
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -800,6 +803,34 @@ class HTTPServer:
         if clients:
             out["client"] = {"ok": True, "message": f"{len(clients)} client(s)"}
         return out, None
+
+    @route("PUT", r"/v1/job/(?P<job_id>[^/]+)/evaluate", acl="ns:read-job")
+    def job_evaluate(self, m, query, body):
+        """ref job_endpoint.go Evaluate / api PUT /v1/job/:id/evaluate"""
+        body = body or {}
+        opts = body.get("EvalOptions") or {}
+        eval_id = self.server.job_evaluate(
+            query.get("namespace", "default"),
+            m["job_id"],
+            force_reschedule=bool(opts.get("ForceReschedule")),
+        )
+        return {"EvalID": eval_id}, None
+
+    @route("GET", r"/v1/agent/monitor", acl="agent:read")
+    def agent_monitor(self, m, query, body):
+        """Recent agent log records after ?index= (poll-follow analog of
+        the reference's streaming monitor endpoint)."""
+        from ..util import LogBuffer
+
+        buf = LogBuffer.install()
+        entries, index = buf.since(int(query.get("index", 0)))
+        level = query.get("log_level", "").upper()
+        if level:
+            order = ["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"]
+            if level in order:
+                keep = set(order[order.index(level):])
+                entries = [e for e in entries if e["level"] in keep]
+        return {"Entries": entries, "Index": index}, None
 
     @route("PUT", r"/v1/validate/job", acl="ns:submit-job")
     def validate_job(self, m, query, body):

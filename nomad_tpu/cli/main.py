@@ -45,8 +45,13 @@ job "example" {
 
 
 def _client(args) -> ApiClient:
+    import os as os_mod
+
     return ApiClient(
-        address=args.address, namespace=getattr(args, "namespace", "default")
+        address=args.address,
+        namespace=getattr(args, "namespace", "default"),
+        token=getattr(args, "token", None)
+        or os_mod.environ.get("NOMAD_TOKEN", ""),
     )
 
 
@@ -582,6 +587,224 @@ def cmd_job_deployments(args):
     return 0
 
 
+def cmd_job_validate(args):
+    from ..jobspec import parse_job
+
+    client = _client(args)
+    with open(args.path) as f:
+        job = parse_job(f.read())
+    out = client.validate_job(job.to_dict())
+    if out.get("ValidationErrors"):
+        print("Job validation errors:")
+        for e in out["ValidationErrors"]:
+            print(f"  * {e}")
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+def cmd_job_inspect(args):
+    import json as json_mod
+
+    client = _client(args)
+    print(json_mod.dumps(client.job(args.job_id), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_job_eval(args):
+    client = _client(args)
+    out = client.job_evaluate(args.job_id, force_reschedule=args.force_reschedule)
+    print(f"Created eval {out['EvalID']}")
+    return 0
+
+
+def cmd_eval_list(args):
+    client = _client(args)
+    evals = client.evaluations()
+    print(f"{'ID':<10} {'Priority':<9} {'Triggered By':<18} {'Job ID':<28} Status")
+    for e in evals:
+        print(f"{e['id'][:8]:<10} {e['priority']:<9} {e['triggered_by']:<18} "
+              f"{e['job_id'][:26]:<28} {e['status']}")
+    return 0
+
+
+def cmd_acl(args):
+    client = _client(args)
+    sub = args.acl_cmd
+    if sub == "bootstrap":
+        t = client.acl_bootstrap()
+        print(f"Accessor ID = {t['AccessorID']}")
+        print(f"Secret ID   = {t['SecretID']}")
+        print(f"Type        = {t['Type']}")
+        return 0
+    if sub == "policy-apply":
+        with open(args.path) as f:
+            rules = f.read()
+        client.acl_put_policy(args.name, rules, description=args.description or "")
+        print(f"Successfully wrote {args.name!r} ACL policy")
+        return 0
+    if sub == "policy-list":
+        for p in client.acl_policies():
+            print(f"{p['Name']:<24} {p.get('Description', '')}")
+        return 0
+    if sub == "policy-info":
+        p = client.acl_policy(args.name)
+        print(f"Name        = {p['Name']}")
+        print(f"Description = {p['Description']}")
+        print("Rules:")
+        print(p["Rules"])
+        return 0
+    if sub == "policy-delete":
+        client.acl_delete_policy(args.name)
+        print(f"Deleted policy {args.name!r}")
+        return 0
+    if sub == "token-create":
+        t = client.acl_create_token(
+            name=args.name or "",
+            type=args.type,
+            policies=args.policy or [],
+            global_token=args.global_token,
+        )
+        print(f"Accessor ID = {t['AccessorID']}")
+        print(f"Secret ID   = {t['SecretID']}")
+        print(f"Type        = {t['Type']}")
+        print(f"Policies    = {t['Policies']}")
+        return 0
+    if sub == "token-list":
+        for t in client.acl_tokens():
+            print(f"{t['AccessorID'][:8]:<10} {t['Type']:<12} "
+                  f"{t['Name'] or '<none>':<24} {','.join(t['Policies'])}")
+        return 0
+    if sub == "token-info":
+        t = client.acl_token(args.accessor)
+        print(f"Accessor ID = {t['AccessorID']}")
+        print(f"Name        = {t['Name']}")
+        print(f"Type        = {t['Type']}")
+        print(f"Policies    = {t['Policies']}")
+        return 0
+    if sub == "token-self":
+        t = client.acl_token_self()
+        print(f"Accessor ID = {t['AccessorID']}")
+        print(f"Type        = {t['Type']}")
+        print(f"Policies    = {t['Policies']}")
+        return 0
+    if sub == "token-delete":
+        client.acl_delete_token(args.accessor)
+        print(f"Deleted token {args.accessor[:8]}")
+        return 0
+    print(f"unknown acl subcommand: {sub}")
+    return 1
+
+
+def cmd_operator_raft_list(args):
+    client = _client(args)
+    cfg = client.raft_configuration()
+    print(f"{'Node':<16} {'ID':<16} {'Address':<24} {'Leader':<7} Voter")
+    for s in cfg["Servers"]:
+        print(f"{s['Node']:<16} {s['ID']:<16} {s['Address']:<24} "
+              f"{str(s['Leader']).lower():<7} {str(s['Voter']).lower()}")
+    return 0
+
+
+def cmd_operator_raft_remove(args):
+    client = _client(args)
+    client.raft_remove_peer(args.peer_id)
+    print(f"Removed peer {args.peer_id}")
+    return 0
+
+
+def cmd_operator_autopilot_get(args):
+    client = _client(args)
+    for k, v in sorted(client.autopilot_configuration().items()):
+        print(f"{k} = {v}")
+    return 0
+
+
+def cmd_operator_autopilot_set(args):
+    client = _client(args)
+    overrides = {}
+    if args.cleanup_dead_servers is not None:
+        overrides["cleanup_dead_servers"] = args.cleanup_dead_servers == "true"
+    if args.last_contact_threshold is not None:
+        overrides["last_contact_threshold_s"] = float(args.last_contact_threshold)
+    if args.max_trailing_logs is not None:
+        overrides["max_trailing_logs"] = int(args.max_trailing_logs)
+    client.autopilot_set_configuration(overrides)
+    print("Configuration updated!")
+    return 0
+
+
+def cmd_system_gc(args):
+    _client(args).system_gc()
+    print("System GC triggered")
+    return 0
+
+
+def cmd_system_reconcile(args):
+    _client(args).reconcile_summaries()
+    print("Job summaries reconciled")
+    return 0
+
+
+def cmd_server_join(args):
+    client = _client(args)
+    out = client.agent_join(args.address)
+    print(f"Joined {out['num_joined']} servers successfully")
+    return 0
+
+
+def cmd_server_force_leave(args):
+    client = _client(args)
+    client.agent_force_leave(args.node)
+    print(f"Force-leave issued for {args.node}")
+    return 0
+
+
+def cmd_monitor(args):
+    import time as time_mod
+
+    client = _client(args)
+    index = 0
+    try:
+        while True:
+            out = client.agent_monitor(index=index, log_level=args.log_level or "")
+            for e in out["Entries"]:
+                print(e["message"])
+            index = out["Index"]
+            if not args.follow:
+                return 0
+            time_mod.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_status(args):
+    """Generic prefix dispatch (ref command/status.go): search all
+    contexts and show the best match."""
+    client = _client(args)
+    if not args.prefix:
+        args.job_id = None
+        return cmd_job_status(args)
+    out = client.put(
+        "/v1/search", body={"Prefix": args.prefix, "Context": "all"}
+    )[0]
+    found = False
+    for context in ("jobs", "allocs", "nodes", "evals", "deployments"):
+        ids = (out.get("matches") or {}).get(context) or []
+        if ids:
+            found = True
+            print(f"{context}: {', '.join(ids[:10])}")
+    if not found:
+        print(f"No matches found for {args.prefix!r}")
+    return 0
+
+
+def cmd_ui(args):
+    addr = args.address or "http://127.0.0.1:4646"
+    print(f"Opening Nomad UI: {addr}/ui/")
+    return 0
+
+
 def cmd_server_members(args):
     client = _client(args)
     info = client.agent_self()
@@ -610,6 +833,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "-namespace", default="default",
         help="target namespace ('*' lists all authorized namespaces)",
+    )
+    p.add_argument(
+        "-token", default=None,
+        help="ACL secret (falls back to $NOMAD_TOKEN)",
     )
     sub = p.add_subparsers(dest="command")
 
@@ -664,6 +891,17 @@ def build_parser() -> argparse.ArgumentParser:
     jd = jsub.add_parser("deployments")
     jd.add_argument("job_id")
     jd.set_defaults(fn=cmd_job_deployments)
+    jv = jsub.add_parser("validate", help="validate a jobspec without running it")
+    jv.add_argument("path")
+    jv.set_defaults(fn=cmd_job_validate)
+    jins = jsub.add_parser("inspect", help="dump the registered job as JSON")
+    jins.add_argument("job_id")
+    jins.set_defaults(fn=cmd_job_inspect)
+    jev = jsub.add_parser("eval", help="force a fresh evaluation of a job")
+    jev.add_argument("-force-reschedule", "--force-reschedule",
+                     action="store_true", dest="force_reschedule")
+    jev.add_argument("job_id")
+    jev.set_defaults(fn=cmd_job_eval)
 
     node = sub.add_parser("node", help="node commands")
     nsub = node.add_subparsers(dest="subcommand")
@@ -747,6 +985,97 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = server.add_subparsers(dest="subcommand")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+    sj = ssub.add_parser("join", help="join this server to a gossip peer")
+    sj.add_argument("address")
+    sj.set_defaults(fn=cmd_server_join)
+    sfl = ssub.add_parser("force-leave", help="force a failed server out")
+    sfl.add_argument("node")
+    sfl.set_defaults(fn=cmd_server_force_leave)
+
+    ev2 = esub.add_parser("list")
+    ev2.set_defaults(fn=cmd_eval_list)
+
+    acl = sub.add_parser("acl", help="ACL policies and tokens")
+    aclsub = acl.add_subparsers(dest="acl_group")
+    ab = aclsub.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl, acl_cmd="bootstrap")
+    apol = aclsub.add_parser("policy")
+    apolsub = apol.add_subparsers(dest="acl_policy_cmd")
+    apa = apolsub.add_parser("apply")
+    apa.add_argument("-description", "--description")
+    apa.add_argument("name")
+    apa.add_argument("path")
+    apa.set_defaults(fn=cmd_acl, acl_cmd="policy-apply")
+    apl = apolsub.add_parser("list")
+    apl.set_defaults(fn=cmd_acl, acl_cmd="policy-list")
+    api_ = apolsub.add_parser("info")
+    api_.add_argument("name")
+    api_.set_defaults(fn=cmd_acl, acl_cmd="policy-info")
+    apd = apolsub.add_parser("delete")
+    apd.add_argument("name")
+    apd.set_defaults(fn=cmd_acl, acl_cmd="policy-delete")
+    atok = aclsub.add_parser("token")
+    atoksub = atok.add_subparsers(dest="acl_token_cmd")
+    atc = atoksub.add_parser("create")
+    atc.add_argument("-name", "--name")
+    atc.add_argument("-type", "--type", default="client")
+    atc.add_argument("-policy", "--policy", action="append")
+    atc.add_argument("-global", "--global", action="store_true",
+                     dest="global_token")
+    atc.set_defaults(fn=cmd_acl, acl_cmd="token-create")
+    atl = atoksub.add_parser("list")
+    atl.set_defaults(fn=cmd_acl, acl_cmd="token-list")
+    ati = atoksub.add_parser("info")
+    ati.add_argument("accessor")
+    ati.set_defaults(fn=cmd_acl, acl_cmd="token-info")
+    ats = atoksub.add_parser("self")
+    ats.set_defaults(fn=cmd_acl, acl_cmd="token-self")
+    atd = atoksub.add_parser("delete")
+    atd.add_argument("accessor")
+    atd.set_defaults(fn=cmd_acl, acl_cmd="token-delete")
+
+    op = sub.add_parser("operator", help="cluster operator commands")
+    opsub = op.add_subparsers(dest="operator_group")
+    opraft = opsub.add_parser("raft")
+    opraftsub = opraft.add_subparsers(dest="raft_cmd")
+    orl = opraftsub.add_parser("list-peers")
+    orl.set_defaults(fn=cmd_operator_raft_list)
+    orr = opraftsub.add_parser("remove-peer")
+    orr.add_argument("peer_id")
+    orr.set_defaults(fn=cmd_operator_raft_remove)
+    opap = opsub.add_parser("autopilot")
+    opapsub = opap.add_subparsers(dest="autopilot_cmd")
+    oag = opapsub.add_parser("get-config")
+    oag.set_defaults(fn=cmd_operator_autopilot_get)
+    oas = opapsub.add_parser("set-config")
+    oas.add_argument("-cleanup-dead-servers", "--cleanup-dead-servers",
+                     dest="cleanup_dead_servers", choices=["true", "false"])
+    oas.add_argument("-last-contact-threshold", "--last-contact-threshold",
+                     dest="last_contact_threshold")
+    oas.add_argument("-max-trailing-logs", "--max-trailing-logs",
+                     dest="max_trailing_logs")
+    oas.set_defaults(fn=cmd_operator_autopilot_set)
+
+    system = sub.add_parser("system", help="system maintenance")
+    syssub = system.add_subparsers(dest="system_cmd")
+    sgc = syssub.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
+    srec = syssub.add_parser("reconcile")
+    srecsub = srec.add_subparsers(dest="reconcile_cmd")
+    srs = srecsub.add_parser("summaries")
+    srs.set_defaults(fn=cmd_system_reconcile)
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", "--log-level", dest="log_level")
+    mon.add_argument("-f", "--follow", action="store_true")
+    mon.set_defaults(fn=cmd_monitor)
+
+    st = sub.add_parser("status", help="status of any prefix (job/alloc/node/eval)")
+    st.add_argument("prefix", nargs="?")
+    st.set_defaults(fn=cmd_status)
+
+    uip = sub.add_parser("ui", help="print the web UI address")
+    uip.set_defaults(fn=cmd_ui)
 
     ai = sub.add_parser("agent-info")
     ai.set_defaults(fn=cmd_agent_info)

@@ -1092,6 +1092,44 @@ class Server:
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return {"DispatchedJobID": child.id, "EvalID": ev.id}
 
+    def job_evaluate(
+        self, namespace: str, job_id: str, force_reschedule: bool = False
+    ) -> str:
+        """Force a fresh evaluation of a job (ref job_endpoint.go Evaluate):
+        used by `job eval` to re-drive placement after manual fixes. With
+        force_reschedule, failed allocs get desired-transition
+        ForceReschedule so the reconciler replaces them immediately."""
+        self._check_leader()
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        if job.is_periodic():
+            raise ValueError("can't evaluate a periodic job directly")
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        if force_reschedule:
+            failed = {
+                a.id: {"force_reschedule": True}
+                for a in self.state.allocs_by_job(namespace, job_id)
+                if a.client_status == "failed" and not a.next_allocation
+            }
+            self._apply(
+                fsm_mod.ALLOC_DESIRED_TRANSITION,
+                {"allocs": failed, "evals": [ev.to_dict()]},
+            )
+        else:
+            self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
+        return ev.id
+
     def periodic_force(self, namespace: str, job_id: str) -> str:
         """ref periodic_endpoint.go Force"""
         self._check_leader()

@@ -1,0 +1,167 @@
+"""CLI breadth: job validate/inspect/eval, eval list, operator raft/
+autopilot, acl, system, monitor, status (ref command/ tree)."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.cli.main import main
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    agent = DevAgent(num_clients=1, server_config={"seed": 61})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    client = ApiClient(address=http.address)
+    yield agent, http, client
+    http.stop()
+    agent.stop()
+
+
+def run(http, capsys, *argv):
+    code = main(["-address", http.address, *argv])
+    return code, capsys.readouterr().out
+
+
+class TestJobCommands:
+    def test_validate_ok_and_bad(self, cluster, capsys, tmp_path):
+        _, http, _ = cluster
+        spec = tmp_path / "ok.nomad"
+        assert main(["job", "init", str(spec)]) == 0
+        capsys.readouterr()
+        code, out = run(http, capsys, "job", "validate", str(spec))
+        assert code == 0 and "successful" in out
+
+        bad = tmp_path / "bad.nomad"
+        bad.write_text('job "" { group "g" { count = 1 } }')
+        code, out = run(http, capsys, "job", "validate", str(bad))
+        assert code == 1
+
+    def test_inspect_and_eval(self, cluster, capsys):
+        agent, http, _ = cluster
+        job = mock.job()
+        job.id = "cli-inspect-job"
+        agent.server.job_register(job)
+        code, out = run(http, capsys, "job", "inspect", "cli-inspect-job")
+        assert code == 0 and '"cli-inspect-job"' in out
+
+        code, out = run(http, capsys, "job", "eval", "cli-inspect-job")
+        assert code == 0 and "Created eval" in out
+
+        code, out = run(http, capsys, "eval", "list")
+        assert code == 0 and "job-register" in out
+
+
+class TestOperatorCommands:
+    def test_raft_and_autopilot(self, cluster, capsys):
+        _, http, _ = cluster
+        code, out = run(http, capsys, "operator", "raft", "list-peers")
+        assert code == 0 and "true" in out
+
+        code, out = run(http, capsys, "operator", "autopilot", "get-config")
+        assert code == 0 and "cleanup_dead_servers" in out
+
+        code, out = run(
+            http, capsys, "operator", "autopilot", "set-config",
+            "-max-trailing-logs", "400",
+        )
+        assert code == 0
+        code, out = run(http, capsys, "operator", "autopilot", "get-config")
+        assert "400" in out
+
+    def test_system_commands(self, cluster, capsys):
+        _, http, _ = cluster
+        code, out = run(http, capsys, "system", "gc")
+        assert code == 0
+        code, out = run(http, capsys, "system", "reconcile", "summaries")
+        assert code == 0 and "reconciled" in out
+
+
+class TestMonitorAndStatus:
+    def test_monitor_returns_recent_logs(self, cluster, capsys):
+        agent, http, _ = cluster
+        # generate a log line after the buffer is installed
+        import logging
+
+        logging.getLogger("nomad_tpu.server").info("monitor-test-marker")
+        code, out = run(http, capsys, "monitor")
+        assert code == 0
+        assert "monitor-test-marker" in out
+
+    def test_status_prefix_dispatch(self, cluster, capsys):
+        agent, http, _ = cluster
+        job = mock.job()
+        job.id = "status-prefix-job"
+        agent.server.job_register(job)
+        code, out = run(http, capsys, "status", "status-prefix")
+        assert code == 0 and "status-prefix-job" in out
+
+        code, out = run(http, capsys, "status", "zzz-no-such")
+        assert code == 0 and "No matches" in out
+
+    def test_ui_command(self, cluster, capsys):
+        _, http, _ = cluster
+        code, out = run(http, capsys, "ui")
+        assert code == 0 and "/ui/" in out
+
+
+class TestAclCommands:
+    def test_acl_lifecycle(self, capsys, tmp_path):
+        """ACL commands against an ACL-enabled agent: bootstrap, policy
+        CRUD, token CRUD, token self."""
+        agent = DevAgent(
+            num_clients=0,
+            server_config={"seed": 67, "acl": {"enabled": True}},
+        )
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        try:
+            code = main(["-address", http.address, "acl", "bootstrap"])
+            out = capsys.readouterr().out
+            assert code == 0
+            secret = next(
+                line.split("=")[1].strip()
+                for line in out.splitlines()
+                if line.startswith("Secret ID")
+            )
+            addr = ["-address", http.address, "-token", secret]
+
+            policy = tmp_path / "readonly.hcl"
+            policy.write_text(
+                'namespace "default" { policy = "read" }\n'
+            )
+            assert main(addr + ["acl", "policy", "apply", "readonly",
+                                str(policy)]) == 0
+            capsys.readouterr()
+            assert main(addr + ["acl", "policy", "list"]) == 0
+            assert "readonly" in capsys.readouterr().out
+            assert main(addr + ["acl", "policy", "info", "readonly"]) == 0
+            assert "read" in capsys.readouterr().out
+
+            assert main(addr + ["acl", "token", "create", "-name", "ro",
+                                "-policy", "readonly"]) == 0
+            out = capsys.readouterr().out
+            accessor = next(
+                line.split("=")[1].strip()
+                for line in out.splitlines()
+                if line.startswith("Accessor ID")
+            )
+            assert main(addr + ["acl", "token", "list"]) == 0
+            assert "ro" in capsys.readouterr().out
+            assert main(addr + ["acl", "token", "info", accessor]) == 0
+            assert "readonly" in capsys.readouterr().out
+            assert main(addr + ["acl", "token", "self"]) == 0
+            assert "management" in capsys.readouterr().out
+            assert main(addr + ["acl", "token", "delete", accessor]) == 0
+            capsys.readouterr()
+            assert main(addr + ["acl", "policy", "delete", "readonly"]) == 0
+        finally:
+            http.stop()
+            agent.stop()
