@@ -335,6 +335,15 @@ class AllocRunner:
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         if tg is None:
             return
+        if recovered_handles is None and self.alloc.previous_allocation:
+            # prerun hook: await the predecessor + inherit its ephemeral
+            # disk (ref alloc_runner_hooks.go:98 await-prev → disk migrate)
+            from . import allocwatcher
+
+            try:
+                allocwatcher.await_previous(self.client, self.alloc, tg)
+            except Exception:
+                logger.exception("previous-alloc migration failed")
         # Fully populate the runner map before starting any task thread:
         # task threads iterate it from task_state_updated()
         missing_driver = []

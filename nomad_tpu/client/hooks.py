@@ -126,10 +126,38 @@ def templates_hook(task, task_dir: str, env: dict, node=None):
             pass
 
 
+def volumes_hook(alloc, task, node, task_dir: str):
+    """Materialize host-volume mounts into the task dir as symlinks
+    (ref taskrunner/volume_hook.go: the group's volume{} requests bound
+    through the node's client host_volume config)."""
+    import os
+
+    job = alloc.job
+    tg = job.lookup_task_group(alloc.task_group) if job else None
+    requests = tg.volumes if tg is not None else {}
+    for mount in task.volume_mounts:
+        req = requests.get(mount.volume)
+        if req is None:
+            raise RuntimeError(f"task mounts unknown volume {mount.volume!r}")
+        host = node.host_volumes.get(req.source)
+        if host is None:
+            raise RuntimeError(
+                f"node is missing host volume {req.source!r}"
+            )
+        target = os.path.join(task_dir, mount.destination.lstrip("/"))
+        os.makedirs(os.path.dirname(target) or task_dir, exist_ok=True)
+        if os.path.islink(target):
+            os.unlink(target)
+        elif os.path.exists(target):
+            continue  # restart of a recovered task: mount already present
+        os.symlink(host.path, target)
+
+
 def run_prestart(alloc, task, node, task_dir: str, alloc_dir: str, extra_env=None):
     """The prestart pipeline; returns the prepared (interpolated) task copy
     and its full environment."""
     task_dir_hook(task_dir, alloc_dir)
+    volumes_hook(alloc, task, node, task_dir)
     env = taskenv.build_env(alloc, task, node, task_dir, alloc_dir)
     env.update(extra_env or {})
     dispatch_payload_hook(alloc, task, task_dir)

@@ -1240,6 +1240,43 @@ class Server:
         )
         return ev.id
 
+    def alloc_get(self, alloc_id: str) -> Optional[dict]:
+        """Alloc document by id (ref alloc_endpoint.go GetAlloc); used by
+        clients awaiting a previous allocation during disk migration."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        return None if alloc is None else alloc.to_dict()
+
+    def forward_client_fs(self, alloc_id: str, method: str, params: dict):
+        """Server-side hop of the client→server→client fs path
+        (ref client_fs_endpoint.go): resolve the alloc's node and forward
+        to its client RPC listener with the node secret. This is how a
+        replacement alloc migrates ephemeral disk off another node without
+        ever holding that node's secret itself."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        node = self.state.node_by_id(alloc.node_id)
+        addr = (
+            node.attributes.get("unique.advertise.client_rpc")
+            if node is not None
+            else None
+        )
+        if not addr:
+            raise KeyError(
+                f"alloc {alloc_id} is on a node without a client RPC address"
+            )
+        from ..rpc import ConnPool
+
+        pool = getattr(self, "_client_fs_pool", None)
+        if pool is None:
+            pool = self._client_fs_pool = ConnPool(
+                tls_context=getattr(self, "tls_client_context", None)
+            )
+        payload = dict(
+            params or {}, alloc_id=alloc_id, secret=node.secret_id
+        )
+        return pool.call(addr, f"ClientFS.{method}", payload, timeout=30.0)
+
     def reconcile_summaries(self):
         """Rebuild job summaries from the alloc table through raft
         (ref system_endpoint.go ReconcileJobSummaries)."""

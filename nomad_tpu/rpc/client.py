@@ -269,6 +269,16 @@ class ServerProxy:
             "Node.UpdateAlloc", {"allocs": [a.to_dict() for a in allocs]}
         )
 
+    def alloc_get(self, alloc_id: str):
+        return self._call("Alloc.GetAlloc", {"alloc_id": alloc_id})["alloc"]
+
+    def forward_client_fs(self, alloc_id: str, method: str, params: dict):
+        return self._call(
+            "ClientFS.Forward",
+            {"alloc_id": alloc_id, "method": method, "params": params},
+            timeout=45.0,
+        )
+
     # job/eval/etc. surface used by the HTTP API & CLI when remote
     def job_register(self, job) -> str:
         return self._call("Job.Register", {"job": job.to_dict()})

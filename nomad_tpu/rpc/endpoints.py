@@ -90,6 +90,16 @@ def register_endpoints(server, rpc) -> None:
     rpc.register("Eval.Nack", lambda p: server.eval_nack(p["eval_id"], p["token"]) or {})
 
     # ---------------------------------------------------------- Status
+    rpc.register(
+        "Alloc.GetAlloc", lambda p: {"alloc": server.alloc_get(p["alloc_id"])}
+    )
+    rpc.register(
+        "ClientFS.Forward",
+        lambda p: server.forward_client_fs(
+            p["alloc_id"], p["method"], p.get("params") or {}
+        ),
+    )
+
     rpc.register("Status.Ping", lambda p: {"ok": True})
     rpc.register(
         "Status.Leader",

@@ -1,0 +1,258 @@
+"""Host volumes and ephemeral-disk migration (ref taskrunner/
+volume_hook.go, client/allocwatcher/ local+remote migrators)."""
+
+import os
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, DevAgent, ServerAgent
+from nomad_tpu.structs.model import VolumeMount, VolumeRequest
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestHostVolumes:
+    def test_mount_reaches_host_path(self, tmp_path):
+        host_dir = tmp_path / "shared-data"
+        host_dir.mkdir()
+        agent = DevAgent(num_clients=1, server_config={"seed": 71})
+        # declare the host volume on the node before registration
+        client = agent.clients[0]
+        from nomad_tpu.structs.model import ClientHostVolumeConfig
+
+        client.node.host_volumes["data"] = ClientHostVolumeConfig(
+            name="data", path=str(host_dir)
+        )
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes["vol0"] = VolumeRequest(
+                name="vol0", type="host", source="data"
+            )
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo from-task > mnt/out.txt"],
+            }
+            task.volume_mounts = [
+                VolumeMount(volume="vol0", destination="mnt")
+            ]
+            task.resources.networks = []
+            agent.server.job_register(job)
+            wait_until(
+                lambda: (host_dir / "out.txt").exists(),
+                msg="task wrote through the volume mount",
+            )
+            assert (host_dir / "out.txt").read_text().strip() == "from-task"
+        finally:
+            agent.stop()
+
+    def test_missing_volume_fails_task(self, tmp_path):
+        agent = DevAgent(num_clients=1, server_config={"seed": 73})
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            # no tg.volumes declared: the mount must fail the task
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/true"}
+            task.volume_mounts = [
+                VolumeMount(volume="ghost", destination="mnt")
+            ]
+            task.resources.networks = []
+            # restart policy off so the failure is terminal quickly
+            tg.restart_policy.attempts = 0
+            tg.restart_policy.mode = "fail"
+            agent.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "failed"
+                    for a in agent.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="task failed on unknown volume",
+            )
+        finally:
+            agent.stop()
+
+
+class TestLocalDiskMigration:
+    def test_alloc_stop_carries_sticky_data(self):
+        """alloc stop → replacement on the same node inherits alloc/ data
+        via the local migrator."""
+        agent = DevAgent(num_clients=1, server_config={"seed": 79})
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.ephemeral_disk.sticky = True
+            tg.ephemeral_disk.migrate = True
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'if [ ! -f "$NOMAD_ALLOC_DIR/marker" ]; then '
+                    'echo generation-one > "$NOMAD_ALLOC_DIR/marker"; fi; '
+                    "sleep 60",
+                ],
+            }
+            task.resources.networks = []
+            agent.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in agent.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="first alloc running",
+            )
+            (first,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+            marker = os.path.join(
+                agent.clients[0].data_dir, "allocs", first.id, "alloc", "marker"
+            )
+            wait_until(lambda: os.path.exists(marker), msg="marker written")
+
+            agent.server.alloc_stop(first.id)
+            wait_until(
+                lambda: any(
+                    a.id != first.id
+                    and a.previous_allocation == first.id
+                    and a.client_status == "running"
+                    for a in agent.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="replacement running",
+            )
+            replacement = next(
+                a
+                for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+                if a.previous_allocation == first.id
+            )
+            inherited = os.path.join(
+                agent.clients[0].data_dir,
+                "allocs",
+                replacement.id,
+                "alloc",
+                "marker",
+            )
+            wait_until(
+                lambda: os.path.exists(inherited), msg="data migrated"
+            )
+            with open(inherited) as f:
+                assert f.read().strip() == "generation-one"
+        finally:
+            agent.stop()
+
+
+class TestRemoteDiskMigration:
+    def test_drain_migrates_disk_across_nodes(self):
+        """Two remote nodes; draining the one running the task moves the
+        alloc AND its ephemeral disk through the server's ClientFS hop."""
+        server = ServerAgent("mig0", config={"seed": 83, "heartbeat_ttl": 5.0})
+        server.start(num_workers=2)
+        agents = []
+        try:
+            for _ in range(2):
+                a = ClientAgent([server.address])
+                a.start()
+                agents.append(a)
+            wait_until(
+                lambda: all(
+                    server.server.state.node_by_id(a.node.id) is not None
+                    for a in agents
+                ),
+                msg="both nodes registered",
+            )
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.ephemeral_disk.migrate = True
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'if [ ! -f "$NOMAD_ALLOC_DIR/marker" ]; then '
+                    'echo first-node > "$NOMAD_ALLOC_DIR/marker"; fi; '
+                    "sleep 120",
+                ],
+            }
+            task.resources.networks = []
+            server.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="first alloc running",
+            )
+            (first,) = server.server.state.allocs_by_job(job.namespace, job.id)
+            origin = next(
+                a for a in agents if first.node_id == a.node.id
+            )
+            dest = next(a for a in agents if a is not origin)
+            marker = os.path.join(
+                origin.client.data_dir, "allocs", first.id, "alloc", "marker"
+            )
+            wait_until(lambda: os.path.exists(marker), msg="marker written")
+
+            server.server.node_drain(first.node_id, drain=True)
+            wait_until(
+                lambda: any(
+                    a.id != first.id and a.client_status == "running"
+                    for a in server.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                timeout=60,
+                msg="replacement running on the other node",
+            )
+            replacement = next(
+                a
+                for a in server.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+                if a.id != first.id and a.client_status == "running"
+            )
+            assert replacement.node_id == dest.node.id
+            inherited = os.path.join(
+                dest.client.data_dir,
+                "allocs",
+                replacement.id,
+                "alloc",
+                "marker",
+            )
+            wait_until(
+                lambda: os.path.exists(inherited),
+                timeout=30,
+                msg="disk migrated across nodes",
+            )
+            with open(inherited) as f:
+                assert f.read().strip() == "first-node"
+        finally:
+            for a in agents:
+                a.stop()
+            server.stop()
