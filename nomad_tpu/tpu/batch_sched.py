@@ -297,7 +297,7 @@ class TPUBatchScheduler(GenericScheduler):
         shuffled = list(nodes)
         shuffle_nodes(ctx, shuffled)
 
-        cluster = ColumnarCluster(nodes)
+        cluster = ColumnarCluster.shared(self.state, nodes)
         perm_real = np.array([cluster.index[n.id] for n in shuffled], dtype=np.int32)
 
         planes_list, g_index, g_demand, g_limit, gid_real, collisions0_real = (
@@ -594,35 +594,48 @@ class TPUBatchScheduler(GenericScheduler):
         placed_list = placed_idx.tolist()
         alloc_new = Allocation.__new__
 
-        for i, p in enumerate(place):
-            tg = p.task_group
-            node_idx = placed_list[i]
-            if node_idx < 0 or node_idx >= n_real:
-                if tg.name in self.failed_tg_allocs:
-                    self.failed_tg_allocs[tg.name].coalesced_failures += 1
-                    continue
-                gi = g_index[tg.name]
-                self.failed_tg_allocs[tg.name] = self._failed_group_metric(
-                    gi, planes_list, by_dc, used_at(i), capacity, g_demand[gi],
-                    n_real, eligible=eligible,
-                )
+        # failures first (rare): each gets the full AllocMetric treatment
+        for i in np.flatnonzero(~valid_mask).tolist():
+            tg = place[i].task_group
+            if tg.name in self.failed_tg_allocs:
+                self.failed_tg_allocs[tg.name].coalesced_failures += 1
                 continue
+            gi = g_index[tg.name]
+            self.failed_tg_allocs[tg.name] = self._failed_group_metric(
+                gi, planes_list, by_dc, used_at(i), capacity, g_demand[gi],
+                n_real, eligible=eligible,
+            )
 
-            node = nodes[node_idx]
+        # successes: tight loop over precomputed flat fields — per-iteration
+        # attribute chains and bound-method lookups priced out at 50K
+        # placements/eval, so everything is hoisted
+        node_ids = [n.id for n in nodes]
+        node_names = [n.name for n in nodes]
+        all_valid = bool(valid_mask.all())
+        success = (
+            range(len(place))
+            if all_valid
+            else np.flatnonzero(valid_mask).tolist()
+        )
+        DT = DesiredTransition
+        for i in success:
+            p = place[i]
+            node_idx = placed_list[i]
+            node_id = node_ids[node_idx]
             alloc = alloc_new(Allocation)
             alloc.__dict__ = dict(
-                template_by_group[tg.name],
+                template_by_group[p.task_group.name],
                 id=ids[i],
                 name=p.name,
-                node_id=node.id,
-                node_name=node.name,
+                node_id=node_id,
+                node_name=node_names[node_idx],
                 task_states={},
-                desired_transition=DesiredTransition(),
+                desired_transition=DT(),
                 preempted_allocations=[],
             )
-            bucket = node_alloc.get(node.id)
+            bucket = node_alloc.get(node_id)
             if bucket is None:
-                bucket = node_alloc[node.id] = []
+                bucket = node_alloc[node_id] = []
             bucket.append(alloc)
 
     # ------------------------------------------------------------------

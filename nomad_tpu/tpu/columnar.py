@@ -11,7 +11,7 @@ String-world constraint evaluation happens here, host-side, exactly once per
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 import numpy as np
@@ -53,6 +53,10 @@ class GroupPlanes:
     present0: Optional[np.ndarray] = None  # bool[V]
 
 
+#: small LRU of (nodes_table_index, node-identity fingerprint, cluster)
+_SHARED_CLUSTERS: list = []
+
+
 class ColumnarCluster:
     """Dense arrays for a set of candidate nodes."""
 
@@ -78,6 +82,31 @@ class ColumnarCluster:
                 )
         # Scoring denominators (ScoreFit: total - reserved; funcs.go:160-165)
         self.usable = (self.capacity[:, :2] - self.reserved[:, :2]).astype(np.float32)
+        # per-(job version, group) feasibility/affinity/spread planes —
+        # valid for this cluster's exact node set (see build_group_planes)
+        self.planes_cache: dict = {}
+
+    @classmethod
+    def shared(cls, state, nodes: list[Node]) -> "ColumnarCluster":
+        """Cross-eval cluster cache — the incremental columnar mirror
+        (SURVEY §7: avoid re-materializing 10K-node matrices per eval).
+
+        Keyed by the nodes-table index plus the identity fingerprint of the
+        node list: COW generations republish unchanged Node objects, so an
+        identical fingerprint under an identical table index proves the
+        candidate set is byte-for-byte the one the cached arrays were built
+        from (the cached cluster pins the node objects, so their ids can't
+        be reused while the entry lives). Any node change bumps the table
+        index and rebuilds."""
+        key = state.table_index("nodes")
+        fingerprint = tuple(map(id, nodes))
+        for entry in _SHARED_CLUSTERS:
+            if entry[0] == key and entry[1] == fingerprint:
+                return entry[2]
+        cluster = cls(nodes)
+        _SHARED_CLUSTERS.insert(0, (key, fingerprint, cluster))
+        del _SHARED_CLUSTERS[4:]
+        return cluster
 
     @staticmethod
     def sum_alloc_usage(allocs, into=None) -> np.ndarray:
@@ -173,7 +202,22 @@ def build_group_planes(
     tg: TaskGroup,
 ) -> GroupPlanes:
     """Evaluate the string-world checks into dense planes, memoizing
-    feasibility by computed node class."""
+    feasibility by computed node class — and memoizing the finished static
+    planes per (job version, group) on the cluster, so repeat evals of an
+    unchanged job skip the O(N) python sweeps entirely. Spread's existing-
+    alloc counts (counts0/present0) are state-dependent and recomputed on
+    every call."""
+    cache_key = (
+        job.namespace,
+        job.id,
+        job.modify_index,
+        job.version,
+        tg.name,
+        tg.count,
+    )
+    cached = cluster.planes_cache.get(cache_key)
+    if cached is not None:
+        return _attach_spread_counts(cached, state, job, tg)
     nodes = cluster.nodes
     n = len(nodes)
 
@@ -270,23 +314,36 @@ def build_group_planes(
             planes.even = True
             planes.desired = np.full(max(len(values), 1), -1.0, dtype=np.float32)
 
-        # existing counts per value for this TG's job (propertyset semantics)
-        counts0 = np.zeros(max(len(values), 1), dtype=np.int32)
-        present0 = np.zeros(max(len(values), 1), dtype=bool)
-        for a in state.allocs_by_job(job.namespace, job.id):
-            if a.terminal_status() or a.task_group != tg.name:
-                continue
-            node = state.node_by_id(a.node_id)
-            val, ok = get_property(node, spread.attribute)
-            if ok and val in values:
-                counts0[values[val]] += 1
-                present0[values[val]] = True
-
         # re-size node_value table if targets introduced new values
         planes.node_value = node_value
         planes.values = list(values)
-        planes.counts0 = counts0
-        planes.present0 = present0
+    if len(cluster.planes_cache) > 256:
+        cluster.planes_cache.clear()
+    cluster.planes_cache[cache_key] = planes
+    return _attach_spread_counts(planes, state, job, tg)
+
+
+def _attach_spread_counts(static: GroupPlanes, state, job, tg) -> GroupPlanes:
+    """Overlay the state-dependent spread inputs onto cached static planes:
+    existing per-value alloc counts for this TG's job (propertyset
+    semantics). Returns a shallow copy so the cached template stays
+    state-free; no-spread groups are fully static and shared as-is."""
+    if static.node_value is None:
+        return static
+    spreads = list(tg.spreads) + list(job.spreads)
+    spread = spreads[0]
+    values = {v: i for i, v in enumerate(static.values)}
+    counts0 = np.zeros(max(len(values), 1), dtype=np.int32)
+    present0 = np.zeros(max(len(values), 1), dtype=bool)
+    for a in state.allocs_by_job(job.namespace, job.id):
+        if a.terminal_status() or a.task_group != tg.name:
+            continue
+        node = state.node_by_id(a.node_id)
+        val, ok = get_property(node, spread.attribute)
+        if ok and val in values:
+            counts0[values[val]] += 1
+            present0[values[val]] = True
+    planes = replace(static, counts0=counts0, present0=present0)
     return planes
 
 

@@ -46,7 +46,7 @@ class SharedCluster:
     def __init__(self, snapshot):
         nodes = [n for n in snapshot.nodes() if n.ready()]
         self.nodes = nodes
-        self.cluster = ColumnarCluster(nodes)
+        self.cluster = ColumnarCluster.shared(snapshot, nodes)
         self.used0 = self.cluster.initial_used(snapshot).astype(np.int64)
         self.capacity = self.cluster.capacity
         self.usable = self.cluster.usable

@@ -65,7 +65,7 @@ class TPUSystemScheduler(SystemScheduler):
                 seen.add(t.alloc.node_id)
                 target_nodes.append(node)
 
-        cluster = ColumnarCluster(target_nodes)
+        cluster = ColumnarCluster.shared(self.state, target_nodes)
         planes = {
             name: build_group_planes(self.ctx, cluster, self.state, self.job, tg)
             for name, tg in groups.items()
