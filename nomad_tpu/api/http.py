@@ -690,6 +690,11 @@ class HTTPServer:
             for task in tg.tasks:
                 state = alloc.task_states.get(task.name)
                 healthy = state is not None and state.state == "running"
+                # check results published by the client's check runner
+                # override the coarse is-it-running signal
+                checks = dict(state.check_status) if state is not None else {}
+                if healthy and any(v != "passing" for v in checks.values()):
+                    healthy = False
                 for svc in task.services:
                     if name_filter and svc.name != name_filter:
                         continue
@@ -717,6 +722,7 @@ class HTTPServer:
                             "Address": address,
                             "Port": port,
                             "Status": "passing" if healthy else "critical",
+                            "Checks": checks,
                         }
                     )
         return out

@@ -119,7 +119,7 @@ class TaskRunner:
                     # prestart pipeline (task_runner_hooks.go:48-118):
                     # dirs → dispatch payload → artifacts → templates →
                     # NOMAD_* env + ${...} interpolation + device env
-                    task, _ = hooks.run_prestart(
+                    task, env = hooks.run_prestart(
                         self.alloc_runner.alloc,
                         self.task,
                         self.alloc_runner.client.node,
@@ -127,6 +127,7 @@ class TaskRunner:
                         self.alloc_runner.alloc_dir(),
                         extra_env=self.alloc_runner.device_env(self.task.name),
                     )
+                    self._env = env  # service checks interpolate against it
                     self._vault_hook(task, task_dir)
                     self.handle = self.driver.start_task(task, task_dir)
                 except Exception as e:
@@ -152,7 +153,16 @@ class TaskRunner:
             self._event("Started", "Task started by client")
             self.alloc_runner.task_state_updated()
 
-            self.handle.wait()
+            # service-check runner rides the running window
+            # (ref task_runner_hooks.go script-checks hook)
+            from .checks import CheckRunner
+
+            check_runner = CheckRunner(self)
+            check_runner.start()
+            try:
+                self.handle.wait()
+            finally:
+                check_runner.stop()
             exit_code = self.handle.exit_code or 0
             failed = exit_code != 0
 
@@ -417,13 +427,23 @@ class AllocRunner:
         min_healthy = (strategy.min_healthy_time if strategy else 0) / 1e9
         deadline_ns = strategy.healthy_deadline if strategy else 0
         deadline = time.monotonic() + (deadline_ns / 1e9 if deadline_ns else 300.0)
+        # with health_check="checks" (the default), service checks must be
+        # passing for the min_healthy window too (ref allochealth/tracker.go
+        # watchConsulEvents)
+        use_checks = strategy is None or strategy.health_check in ("", "checks")
         healthy_since = None
         while not self._destroyed:
             states = [tr.state for tr in self.task_runners.values()]
             if any(s.failed for s in states):
                 self._set_health(False)
                 return
-            if states and all(s.state == "running" for s in states):
+            running = bool(states) and all(s.state == "running" for s in states)
+            checks_ok = not use_checks or all(
+                v == "passing"
+                for s in states
+                for v in s.check_status.values()
+            )
+            if running and checks_ok:
                 if healthy_since is None:
                     healthy_since = time.monotonic()
                 if time.monotonic() - healthy_since >= min_healthy:

@@ -893,6 +893,10 @@ class TaskState(Base):
     started_at: int = 0
     finished_at: int = 0
     events: list[dict] = field(default_factory=list)
+    # service-check name → "passing"/"critical" (the client's check runner
+    # publishes health through alloc updates the way the reference pushes
+    # check state into Consul; the nomad-native catalog reads it from here)
+    check_status: dict[str, str] = field(default_factory=dict)
 
     def successful(self) -> bool:
         return self.state == "dead" and not self.failed

@@ -1,0 +1,248 @@
+"""Service health checks: script/http/tcp execution, catalog integration,
+deployment health gating (ref command/agent/consul script checks,
+allochealth/tracker.go)."""
+
+import http.server
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.client.checks import run_check
+from nomad_tpu.structs.model import Service, ServiceCheck
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class FakeAlloc:
+    allocated_resources = None
+
+
+class TestRunCheck:
+    def test_script_pass_fail(self, tmp_path):
+        ok = run_check(
+            ServiceCheck(name="ok", type="script", command="/bin/true"),
+            FakeAlloc(), "t", str(tmp_path), {},
+        )
+        assert ok[0] == "passing"
+        bad = run_check(
+            ServiceCheck(name="bad", type="script", command="/bin/false"),
+            FakeAlloc(), "t", str(tmp_path), {},
+        )
+        assert bad[0] == "critical"
+
+    def test_script_timeout(self, tmp_path):
+        status, output = run_check(
+            ServiceCheck(
+                name="slow", type="script", command="/bin/sleep",
+                args=["5"], timeout=int(0.2 * 1e9),
+            ),
+            FakeAlloc(), "t", str(tmp_path), {},
+        )
+        assert status == "critical"
+        assert "timed out" in output
+
+    def _alloc_with_port(self, port):
+        from nomad_tpu.structs.model import (
+            AllocatedResources, AllocatedTaskResources, NetworkResource, Port,
+        )
+
+        alloc = FakeAlloc()
+        alloc.allocated_resources = AllocatedResources(
+            tasks={
+                "t": AllocatedTaskResources(
+                    networks=[
+                        NetworkResource(
+                            ip="127.0.0.1",
+                            reserved_ports=[Port(label="web", value=port)],
+                        )
+                    ]
+                )
+            }
+        )
+        return alloc
+
+    def test_tcp_and_http(self):
+        class Quiet(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                code = 500 if self.path == "/broken" else 200
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Quiet)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            alloc = self._alloc_with_port(port)
+            tcp = run_check(
+                ServiceCheck(name="tcp", type="tcp", port_label="web"),
+                alloc, "t", "", {},
+            )
+            assert tcp[0] == "passing"
+            ok = run_check(
+                ServiceCheck(name="http", type="http", port_label="web", path="/health"),
+                alloc, "t", "", {},
+            )
+            assert ok[0] == "passing"
+            bad = run_check(
+                ServiceCheck(name="http", type="http", port_label="web", path="/broken"),
+                alloc, "t", "", {},
+            )
+            assert bad[0] == "critical"
+        finally:
+            httpd.shutdown()
+
+    def test_tcp_refused(self):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            free_port = s.getsockname()[1]
+        alloc = self._alloc_with_port(free_port)
+        status, _ = run_check(
+            ServiceCheck(name="tcp", type="tcp", port_label="web"),
+            alloc, "t", "", {},
+        )
+        assert status == "critical"
+
+
+class TestCheckSurface:
+    def test_check_transitions_reach_catalog(self, tmp_path):
+        flag = tmp_path / "healthy-flag"
+        agent = DevAgent(num_clients=1, server_config={"seed": 89})
+        agent.start()
+        http_srv = HTTPServer(agent.server, port=0, agent=agent)
+        http_srv.start()
+        api = ApiClient(address=http_srv.address)
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sleep", "args": ["60"]}
+            task.resources.networks = []
+            task.services = [
+                Service(
+                    name="checked-svc",
+                    checks=[
+                        ServiceCheck(
+                            name="flag-check",
+                            type="script",
+                            command="/usr/bin/test",
+                            args=["-f", str(flag)],
+                            interval=int(0.1 * 1e9),
+                        )
+                    ],
+                )
+            ]
+            agent.server.job_register(job)
+
+            # critical first: the flag file doesn't exist yet
+            def catalog_status():
+                try:
+                    entries = api.get("/v1/service/checked-svc")[0]
+                except Exception:
+                    return None
+                return entries[0]["Status"]
+
+            # the check result must reach replicated server state (the
+            # client pushes the transition through its update loop)
+            def server_check_status():
+                allocs = agent.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+                if not allocs:
+                    return None
+                state = allocs[0].task_states.get("web")
+                return state.check_status.get("flag-check") if state else None
+
+            wait_until(
+                lambda: server_check_status() == "critical",
+                msg="critical check replicated to server state",
+            )
+            assert catalog_status() == "critical"
+
+            flag.write_text("ok")
+            wait_until(
+                lambda: catalog_status() == "passing",
+                msg="check passing in catalog",
+            )
+        finally:
+            http_srv.stop()
+            agent.stop()
+
+    def test_failing_check_blocks_deployment_health(self):
+        """health_check='checks' (default): a critical check keeps the
+        alloc from reporting healthy, failing the deployment at the
+        healthy_deadline."""
+        agent = DevAgent(num_clients=1, server_config={"seed": 97})
+        agent.start()
+        try:
+            from nomad_tpu.structs.model import UpdateStrategy
+
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.update = UpdateStrategy(
+                max_parallel=1,
+                min_healthy_time=int(0.1 * 1e9),
+                healthy_deadline=int(1.5 * 1e9),
+                progress_deadline=int(3 * 1e9),
+                auto_revert=False,
+            )
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sleep", "args": ["60"]}
+            task.resources.networks = []
+            task.services = [
+                Service(
+                    name="never-healthy",
+                    checks=[
+                        ServiceCheck(
+                            name="always-red",
+                            type="script",
+                            command="/bin/false",
+                            interval=int(0.1 * 1e9),
+                        )
+                    ],
+                )
+            ]
+            agent.server.job_register(job)
+            # v2 so a deployment exists
+            job2 = job.copy()
+            job2.version = 1
+            job2.task_groups[0].tasks[0].config = {
+                "command": "/bin/sleep",
+                "args": ["61"],
+            }
+            agent.server.job_register(job2)
+
+            def deployment_failed():
+                deps = agent.server.state.deployments_by_job(
+                    job.namespace, job.id
+                )
+                return any(d.status == "failed" for d in deps)
+
+            wait_until(
+                lambda: deployment_failed(),
+                timeout=30,
+                msg="deployment failed on critical check",
+            )
+        finally:
+            agent.stop()
