@@ -53,6 +53,9 @@ INDEX_HTML = """<!doctype html>
     <a href="#/nodes">Nodes</a>
     <a href="#/allocations">Allocations</a>
     <a href="#/evaluations">Evaluations</a>
+    <a href="#/deployments">Deployments</a>
+    <a href="#/services">Services</a>
+    <a href="#/servers">Servers</a>
   </nav>
   <input id="token" placeholder="ACL token (X-Nomad-Token)" />
 </header>
@@ -138,6 +141,43 @@ const routes = {
       id: e.id, cells: [esc(e.id.slice(0,8)), esc(e.job_id), esc(e.type),
         esc(e.triggered_by), badge(esc(e.status))]
     })), '#/evaluations');
+  },
+  async deployments() {
+    const deps = await api('/v1/deployments');
+    return table(['ID','Job','Version','Status','Description'], deps.map(d => ({
+      id: d.ID, cells: [esc(d.ID.slice(0,8)), esc(d.JobID), d.JobVersion,
+        badge(esc(d.Status)), esc(d.StatusDescription || '')]
+    })), '#/deployment');
+  },
+  async deployment(id) {
+    const d = await api('/v1/deployment/' + id);
+    return `<div class="crumb"><a href="#/deployments">deployments</a> / ${esc(id.slice(0,8))}</div>` +
+      `<pre>${esc(JSON.stringify(d, null, 2))}</pre>`;
+  },
+  async services() {
+    const svcs = await api('/v1/services');
+    return table(['Service','Job','Alloc','Address','Status','Checks'], svcs.map(s => ({
+      id: s.AllocID, cells: [esc(s.ServiceName), esc(s.JobID),
+        esc(s.AllocID.slice(0,8)),
+        esc(s.Address ? s.Address + ':' + s.Port : '-'),
+        badge(esc(s.Status)),
+        esc(Object.entries(s.Checks || {}).map(([k,v]) => k + '=' + v).join(' ') || '-')]
+    })), '#/allocation');
+  },
+  async servers() {
+    const m = await api('/v1/agent/members');
+    let health = {Servers: []};
+    try { health = await api('/v1/operator/autopilot/health'); } catch {}
+    const byId = Object.fromEntries(health.Servers.map(s => [s.ID, s]));
+    return `<div class="crumb">region ${esc(m.ServerRegion)}</div>` +
+      table(['Name','Address','Gossip','Leader','Healthy','Last Contact'],
+        m.Members.map(s => {
+          const h = byId[s.Name] || {};
+          return {id: '', cells: [esc(s.Name), esc(s.Addr + ':' + s.Port),
+            badge(esc(s.Status)),
+            h.Leader ? 'yes' : '', badge(h.Healthy === false ? 'failed' : 'ready'),
+            esc(h.LastContact == null ? '-' : h.LastContact + 's')]};
+        }), '#/servers');
   },
 };
 
