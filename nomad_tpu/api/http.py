@@ -683,6 +683,24 @@ class HTTPServer:
                 query, alloc.namespace, "read-job"
             ):
                 continue
+            # Connect sidecar listeners published by the owning client
+            for svc_name, ep in (alloc.connect_proxies or {}).items():
+                sidecar_name = f"{svc_name}-sidecar-proxy"
+                if name_filter and sidecar_name != name_filter:
+                    continue
+                out.append(
+                    {
+                        "ServiceName": sidecar_name,
+                        "Tags": ["connect-proxy"],
+                        "AllocID": alloc.id,
+                        "JobID": alloc.job_id,
+                        "NodeID": alloc.node_id,
+                        "Address": ep.get("ip", ""),
+                        "Port": int(ep.get("port", 0)),
+                        "Status": "passing",
+                        "Checks": {},
+                    }
+                )
             job = alloc.job
             tg = job.lookup_task_group(alloc.task_group) if job else None
             if tg is None:

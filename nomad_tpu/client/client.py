@@ -313,6 +313,7 @@ class AllocRunner:
         self.alloc = alloc
         self.task_runners: dict[str, TaskRunner] = {}
         self._destroyed = False
+        self._connect = None  # ConnectHook when the group runs sidecars
         self._lock = threading.Lock()
 
     def task_dir(self, task_name: str) -> str:
@@ -354,6 +355,18 @@ class AllocRunner:
                 allocwatcher.await_previous(self.client, self.alloc, tg)
             except Exception:
                 logger.exception("previous-alloc migration failed")
+        # Connect sidecars bind before any task starts so upstream ports
+        # are live from the task's first instruction (ref
+        # alloc_runner_hooks.go network/group-services ordering)
+        try:
+            from .connect import ConnectHook
+
+            hook = ConnectHook(self.client, self.alloc, tg)
+            if hook.start():
+                self._connect = hook
+                self.alloc.connect_proxies = dict(hook.proxies)
+        except Exception:
+            logger.exception("connect sidecar setup failed")
         # Fully populate the runner map before starting any task thread:
         # task threads iterate it from task_state_updated()
         missing_driver = []
@@ -507,6 +520,8 @@ class AllocRunner:
         if self._destroyed:
             return
         self._destroyed = True
+        if self._connect is not None:
+            self._connect.stop()
         for tr in self.task_runners.values():
             tr.stop()
 

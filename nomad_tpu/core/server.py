@@ -891,6 +891,69 @@ class Server:
         )
         return {"matches": contexts, "truncations": truncations}
 
+    def catalog_service(self, name: str) -> list[dict]:
+        """Service catalog lookup (the Consul-catalog role for Connect
+        upstream resolution): plain service instances by name, plus
+        client-published sidecar listeners under ``<svc>-sidecar-proxy``
+        (ref Consul sidecar service registrations)."""
+        snap = self.state.snapshot()
+        out = []
+        for alloc in snap.allocs():
+            if alloc.terminal_status():
+                continue
+            for svc_name, ep in (alloc.connect_proxies or {}).items():
+                if f"{svc_name}-sidecar-proxy" != name:
+                    continue
+                out.append(
+                    {
+                        "ServiceName": name,
+                        "AllocID": alloc.id,
+                        "NodeID": alloc.node_id,
+                        "Address": ep.get("ip", ""),
+                        "Port": int(ep.get("port", 0)),
+                        "Status": "passing",
+                    }
+                )
+            job = alloc.job
+            tg = job.lookup_task_group(alloc.task_group) if job else None
+            if tg is None:
+                continue
+            for task in tg.tasks:
+                state = alloc.task_states.get(task.name)
+                healthy = state is not None and state.state == "running"
+                if healthy and any(
+                    v != "passing" for v in state.check_status.values()
+                ):
+                    healthy = False
+                for svc in task.services:
+                    if svc.name != name:
+                        continue
+                    address, port = "", 0
+                    resources = alloc.allocated_resources
+                    tr = (
+                        resources.tasks.get(task.name)
+                        if resources is not None
+                        else None
+                    )
+                    if tr is not None and svc.port_label:
+                        for net in tr.networks:
+                            for p in list(net.reserved_ports) + list(
+                                net.dynamic_ports
+                            ):
+                                if p.label == svc.port_label:
+                                    address, port = net.ip, p.value
+                    out.append(
+                        {
+                            "ServiceName": svc.name,
+                            "AllocID": alloc.id,
+                            "NodeID": alloc.node_id,
+                            "Address": address,
+                            "Port": port,
+                            "Status": "passing" if healthy else "critical",
+                        }
+                    )
+        return out
+
     def _plan_token_live(self, plan) -> bool:
         """Dequeue-time re-validation of a plan's eval token (plans without
         tokens — direct planner users — pass)."""

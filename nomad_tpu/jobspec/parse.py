@@ -21,6 +21,10 @@ from ..structs.model import (
     Resources,
     RestartPolicy,
     RequestedDevice,
+    ConsulConnect,
+    ConsulProxy,
+    ConsulSidecarService,
+    ConsulUpstream,
     Service,
     ServiceCheck,
     Spread,
@@ -176,6 +180,26 @@ def parse_service(name_default: str, d: dict) -> Service:
                 timeout=parse_duration(body.get("timeout", 0)),
             )
         )
+    for body in _listify(d.get("connect")):
+        connect = ConsulConnect()
+        for sidecar in _listify(body.get("sidecar_service")):
+            sidecar = sidecar or {}
+            proxy = None
+            for pbody in _listify(sidecar.get("proxy")):
+                pbody = pbody or {}
+                proxy = ConsulProxy(
+                    upstreams=[
+                        ConsulUpstream(
+                            destination_name=u.get("destination_name", ""),
+                            local_bind_port=int(u.get("local_bind_port", 0)),
+                        )
+                        for u in _listify(pbody.get("upstreams"))
+                    ]
+                )
+            connect.sidecar_service = ConsulSidecarService(
+                port=str(sidecar.get("port", "")), proxy=proxy
+            )
+        svc.connect = connect
     return svc
 
 

@@ -272,6 +272,9 @@ class ServerProxy:
     def alloc_get(self, alloc_id: str):
         return self._call("Alloc.GetAlloc", {"alloc_id": alloc_id})["alloc"]
 
+    def catalog_service(self, name: str) -> list[dict]:
+        return self._call("Catalog.Service", {"name": name})["entries"]
+
     def forward_client_fs(self, alloc_id: str, method: str, params: dict):
         return self._call(
             "ClientFS.Forward",

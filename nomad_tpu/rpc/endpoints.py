@@ -94,6 +94,10 @@ def register_endpoints(server, rpc) -> None:
         "Alloc.GetAlloc", lambda p: {"alloc": server.alloc_get(p["alloc_id"])}
     )
     rpc.register(
+        "Catalog.Service",
+        lambda p: {"entries": server.catalog_service(p["name"])},
+    )
+    rpc.register(
         "ClientFS.Forward",
         lambda p: server.forward_client_fs(
             p["alloc_id"], p["method"], p.get("params") or {}

@@ -819,6 +819,9 @@ class StateStore(StateReader):
             alloc.client_status = update.client_status
             alloc.client_description = update.client_description
             alloc.task_states = update.task_states
+            # sidecar listener endpoints are client-owned (the client binds
+            # them); the catalog serves them for Connect upstream resolution
+            alloc.connect_proxies = update.connect_proxies
             # The client may only set deployment health + timestamp
             # (ref state_store.go:1977-1992)
             if alloc.deployment_status is not None and update.deployment_status is not None:

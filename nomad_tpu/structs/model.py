@@ -686,6 +686,35 @@ class ServiceCheck(Base):
 
 
 @dataclass
+class ConsulUpstream(Base):
+    """ref structs.go ConsulUpstream: a dependency reached through the
+    local sidecar at local_bind_port."""
+
+    destination_name: str = ""
+    local_bind_port: int = 0
+
+
+@dataclass
+class ConsulProxy(Base):
+    upstreams: list[ConsulUpstream] = field(default_factory=list)
+
+
+@dataclass
+class ConsulSidecarService(Base):
+    port: str = ""
+    proxy: Optional[ConsulProxy] = None
+
+
+@dataclass
+class ConsulConnect(Base):
+    """ref structs.go ConsulConnect (Nomad 0.10's Connect integration):
+    a service with a sidecar_service gets a mesh proxy in front of it, and
+    its upstreams become local ports proxied to other services' sidecars."""
+
+    sidecar_service: Optional[ConsulSidecarService] = None
+
+
+@dataclass
 class Service(Base):
     name: str = ""
     port_label: str = ""
@@ -693,6 +722,7 @@ class Service(Base):
     tags: list[str] = field(default_factory=list)
     canary_tags: list[str] = field(default_factory=list)
     checks: list[ServiceCheck] = field(default_factory=list)
+    connect: Optional[ConsulConnect] = None
 
 
 @dataclass
@@ -995,6 +1025,11 @@ class Allocation(Base):
     client_status: str = ALLOC_CLIENT_STATUS_PENDING
     client_description: str = ""
     task_states: dict[str, TaskState] = field(default_factory=dict)
+    # service name → {"ip","port"}: the client's Connect sidecar listeners,
+    # published through alloc updates so other allocs' upstream proxies can
+    # discover them from the catalog (the role Consul's sidecar service
+    # registrations play for the reference)
+    connect_proxies: dict[str, dict] = field(default_factory=dict)
     deployment_id: str = ""
     deployment_status: Optional[DeploymentStatus] = None
     reschedule_tracker: Optional[RescheduleTracker] = None

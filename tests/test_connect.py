@@ -1,0 +1,249 @@
+"""Connect service mesh analog (ref Nomad 0.10 Consul Connect:
+job_endpoint_hook_connect.go + Consul sidecar routing). An upstream
+consumer reaches a connect service through two proxy hops: its local
+upstream listener → the destination's sidecar → the service."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, DevAgent, ServerAgent
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.structs.model import (
+    ConsulConnect,
+    ConsulProxy,
+    ConsulSidecarService,
+    ConsulUpstream,
+    NetworkResource,
+    Port,
+    Service,
+)
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestJobspecConnect:
+    def test_parse_connect_stanza(self):
+        job = parse_job(
+            """
+            job "mesh" {
+              group "api" {
+                task "server" {
+                  driver = "raw_exec"
+                  service {
+                    name = "api"
+                    port = "http"
+                    connect {
+                      sidecar_service {
+                        proxy {
+                          upstreams {
+                            destination_name = "db"
+                            local_bind_port  = 5432
+                          }
+                        }
+                      }
+                    }
+                  }
+                }
+              }
+            }
+            """
+        )
+        svc = job.task_groups[0].tasks[0].services[0]
+        assert svc.connect is not None
+        assert svc.connect.sidecar_service is not None
+        ups = svc.connect.sidecar_service.proxy.upstreams
+        assert len(ups) == 1
+        assert ups[0].destination_name == "db"
+        assert ups[0].local_bind_port == 5432
+
+
+def connect_service(name, port_label="", upstreams=None):
+    proxy = (
+        ConsulProxy(
+            upstreams=[
+                ConsulUpstream(destination_name=d, local_bind_port=p)
+                for d, p in (upstreams or [])
+            ]
+        )
+        if upstreams
+        else None
+    )
+    return Service(
+        name=name,
+        port_label=port_label,
+        connect=ConsulConnect(
+            sidecar_service=ConsulSidecarService(proxy=proxy)
+        ),
+    )
+
+
+class TestMeshEndToEnd:
+    def test_upstream_traffic_flows_through_sidecars(self, tmp_path):
+        agent = DevAgent(num_clients=1, server_config={"seed": 101})
+        agent.start()
+        try:
+            # service job: python http.server on its allocated port,
+            # exposed through a connect sidecar
+            api = mock.job()
+            api.id = "api-job"
+            tg = api.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.name = "api"
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'echo mesh-payload > index.html; '
+                    'exec python3 -m http.server "$NOMAD_PORT_api_http" '
+                    "--bind 127.0.0.1",
+                ],
+            }
+            task.resources.networks = [
+                NetworkResource(mbits=1, dynamic_ports=[Port(label="http")])
+            ]
+            task.services = [connect_service("api", port_label="http")]
+            agent.server.job_register(api)
+
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    and a.connect_proxies.get("api")
+                    for a in agent.server.state.allocs_by_job(
+                        api.namespace, api.id
+                    )
+                ),
+                msg="api sidecar published",
+            )
+            entries = agent.server.catalog_service("api-sidecar-proxy")
+            assert entries and entries[0]["Port"] > 0
+
+            # consumer job: reaches "api" only via its local upstream port
+            bind_port = 29876
+            out_file = tmp_path / "fetched.txt"
+            web = mock.job()
+            web.id = "web-job"
+            wtg = web.task_groups[0]
+            wtg.count = 1
+            wtask = wtg.tasks[0]
+            wtask.name = "web"
+            wtask.driver = "raw_exec"
+            wtask.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "for i in $(seq 1 100); do "
+                    f'python3 -c "import urllib.request;'
+                    f"open('{out_file}','w').write("
+                    f'urllib.request.urlopen(\'http://127.0.0.1:{bind_port}/\').read().decode())" '
+                    "2>/dev/null && break; sleep 0.3; done; sleep 60",
+                ],
+            }
+            wtask.resources.networks = []
+            wtask.services = [
+                connect_service("web", upstreams=[("api", bind_port)])
+            ]
+            agent.server.job_register(web)
+
+            wait_until(
+                lambda: out_file.exists()
+                and out_file.read_text().strip() == "mesh-payload",
+                timeout=45,
+                msg="payload fetched through both sidecars",
+            )
+        finally:
+            agent.stop()
+
+    def test_remote_client_resolves_upstream_over_rpc(self, tmp_path):
+        """Two node agents on the RPC tier: the consumer's upstream proxy
+        resolves the destination sidecar via the Catalog.Service RPC."""
+        server = ServerAgent("cn0", config={"seed": 103, "heartbeat_ttl": 5.0})
+        server.start(num_workers=2)
+        agents = [ClientAgent([server.address]) for _ in range(2)]
+        try:
+            for a in agents:
+                a.start()
+            wait_until(
+                lambda: all(
+                    server.server.state.node_by_id(a.node.id) is not None
+                    for a in agents
+                ),
+                msg="nodes registered",
+            )
+            api = mock.job()
+            api.id = "r-api"
+            tg = api.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.name = "api"
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'echo remote-mesh > index.html; '
+                    'exec python3 -m http.server "$NOMAD_PORT_api_http" '
+                    "--bind 127.0.0.1",
+                ],
+            }
+            task.resources.networks = [
+                NetworkResource(mbits=1, dynamic_ports=[Port(label="http")])
+            ]
+            task.services = [connect_service("api", port_label="http")]
+            server.server.job_register(api)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    and a.connect_proxies.get("api")
+                    for a in server.server.state.allocs_by_job(
+                        api.namespace, api.id
+                    )
+                ),
+                msg="remote api sidecar published",
+            )
+
+            bind_port = 29877
+            out_file = tmp_path / "remote.txt"
+            web = mock.job()
+            web.id = "r-web"
+            wtg = web.task_groups[0]
+            wtg.count = 1
+            wtask = wtg.tasks[0]
+            wtask.name = "web"
+            wtask.driver = "raw_exec"
+            wtask.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "for i in $(seq 1 100); do "
+                    f'python3 -c "import urllib.request;'
+                    f"open('{out_file}','w').write("
+                    f'urllib.request.urlopen(\'http://127.0.0.1:{bind_port}/\').read().decode())" '
+                    "2>/dev/null && break; sleep 0.3; done; sleep 60",
+                ],
+            }
+            wtask.resources.networks = []
+            wtask.services = [
+                connect_service("web", upstreams=[("api", bind_port)])
+            ]
+            server.server.job_register(web)
+            wait_until(
+                lambda: out_file.exists()
+                and out_file.read_text().strip() == "remote-mesh",
+                timeout=60,
+                msg="payload fetched across agents through the mesh",
+            )
+        finally:
+            for a in agents:
+                a.stop()
+            server.stop()

@@ -237,10 +237,14 @@ class TestDockerDriver:
         assert handle.exit_code == 0
 
         # docklog role: container output landed in the task log files
-        logs = (
-            task_dir / "logs" / f"{task.name}.stdout.0"
-        ).read_text()
-        assert "hello-docker" in logs
+        # (the follower subprocess flushes asynchronously — poll briefly)
+        log_file = task_dir / "logs" / f"{task.name}.stdout.0"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if log_file.exists() and "hello-docker" in log_file.read_text():
+                break
+            time.sleep(0.05)
+        assert "hello-docker" in log_file.read_text()
 
     def test_recover_running_container(self, fake_docker, tmp_path):
         script, state = fake_docker
