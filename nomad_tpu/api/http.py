@@ -945,11 +945,31 @@ class HTTPServer:
         from ..tpu import batch_sched
         from ..tpu import drain as drain_mod
 
+        from .. import metrics as metrics_mod
+
+        # job-summary gauges (ref leader.go:602 publishJobSummaryMetrics)
+        summaries = {}
+        for s in self.server.state.job_summaries():
+            rollup = {}
+            for tg_name, tg in s.summary.items():
+                rollup[tg_name] = {
+                    "queued": tg.queued,
+                    "running": tg.running,
+                    "starting": tg.starting,
+                    "complete": tg.complete,
+                    "failed": tg.failed,
+                    "lost": tg.lost,
+                }
+            summaries[s.job_id] = rollup
+
         payload = {
             "broker": self.server.eval_broker.stats(),
             "blocked_evals": self.server.blocked_evals.stats(),
             "plan_queue_depth": self.server.planner.queue.depth(),
             "state_index": self.server.state.latest_index(),
+            # per-stage timers + counters (the go-metrics MeasureSince role)
+            "stages": metrics_mod.snapshot(),
+            "job_summary": summaries,
             # kernel-vs-oracle routing (VERDICT r1 weak #10): how many
             # evals rode the TPU path, by mode, and why the rest didn't
             "tpu_scheduler": batch_sched.counters_snapshot(),

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import metrics
 from ..state.store import StateSnapshot, StateStore
 from ..structs.funcs import allocs_fit
 from ..structs.model import (
@@ -381,7 +382,8 @@ class Planner:
                     continue
 
             try:
-                result = evaluate_plan(snap, pending.plan)
+                with metrics.measure("plan.evaluate"):
+                    result = evaluate_plan(snap, pending.plan)
             except Exception as e:
                 pending.respond(None, e)
                 continue
@@ -409,7 +411,8 @@ class Planner:
                     # against an optimistic world that never materialized —
                     # re-verify against reality before committing
                     try:
-                        result = evaluate_plan(snap, pending.plan)
+                        with metrics.measure("plan.evaluate"):
+                            result = evaluate_plan(snap, pending.plan)
                     except Exception as e:
                         pending.respond(None, e)
                         continue
@@ -456,7 +459,8 @@ class Planner:
             if self.preemption_evals_fn is not None and result.node_preemptions:
                 preemption_evals = self.preemption_evals_fn(result)
             if self.commit_fn is not None:
-                index = self.commit_fn(plan, result, preemption_evals)
+                with metrics.measure("plan.apply"):
+                    index = self.commit_fn(plan, result, preemption_evals)
             else:
                 index = self.state.upsert_plan_results(
                     None, plan, result, preemption_evals=preemption_evals

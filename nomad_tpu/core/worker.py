@@ -125,7 +125,11 @@ class Worker:
             # non-tpu schedulers simply never consume the collector; the
             # caller's finally-leave covers them
             sched.drain_collector = collector
-        sched.process(ev)
+        from .. import metrics
+
+        with metrics.measure(f"worker.invoke_scheduler.{sched_name}"):
+            sched.process(ev)
+        metrics.incr(f"worker.evals_processed.{ev.type}")
 
     # ------------------------------------------------------------------
     # Planner protocol (ref worker.go:347-523)
@@ -133,9 +137,12 @@ class Worker:
     def submit_plan(self, plan: Plan):
         """Attach the eval token, route through the plan queue, and hand back
         a fresh snapshot when the applier asks for a refresh."""
+        from .. import metrics
+
         plan.eval_token = self._eval_token
         plan.snapshot_index = self.server.state.latest_index()
-        result, error = self.server.plan_submit(plan)
+        with metrics.measure("plan.submit"):
+            result, error = self.server.plan_submit(plan)
         if error is not None:
             raise error
         if result is None:

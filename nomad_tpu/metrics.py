@@ -1,0 +1,67 @@
+"""In-process metrics registry (the armon/go-metrics role: the reference
+wraps every RPC/scheduler stage in MeasureSince and publishes gauges;
+ref command/agent/config.go:500-577 telemetry). Counters, gauges, and
+windowed timers with count/mean/p99, exported by /v1/metrics in both JSON
+and prometheus exposition."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[str, float] = {}
+_TIMERS: dict[str, list[float]] = {}
+
+TIMER_WINDOW = 512  # samples retained per timer
+
+
+def incr(name: str, value: float = 1.0):
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0.0) + value
+
+
+def sample(name: str, seconds: float):
+    with _LOCK:
+        bucket = _TIMERS.setdefault(name, [])
+        bucket.append(seconds)
+        if len(bucket) > TIMER_WINDOW:
+            del bucket[: len(bucket) - TIMER_WINDOW]
+
+
+@contextmanager
+def measure(name: str):
+    """MeasureSince analog: times the with-block into ``name``."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        sample(name, time.monotonic() - t0)
+
+
+def snapshot() -> dict:
+    """{counters: {...}, timers: {name: {count, mean_ms, p99_ms, max_ms}}}"""
+    with _LOCK:
+        counters = dict(_COUNTERS)
+        timers = {k: list(v) for k, v in _TIMERS.items()}
+    out_timers = {}
+    for name, samples in timers.items():
+        if not samples:
+            continue
+        ordered = sorted(samples)
+        p99 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+        out_timers[name] = {
+            "count": len(ordered),
+            "mean_ms": round(sum(ordered) / len(ordered) * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "max_ms": round(ordered[-1] * 1e3, 3),
+        }
+    return {"counters": counters, "timers": out_timers}
+
+
+def reset():
+    """Test hook."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _TIMERS.clear()

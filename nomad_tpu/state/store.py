@@ -130,6 +130,9 @@ class StateReader:
         versions.sort(key=lambda j: j.version, reverse=True)
         return versions
 
+    def job_summaries(self) -> Iterable[JobSummary]:
+        return self._gen.job_summaries.values()
+
     def job_summary_by_id(self, namespace: str, job_id: str) -> Optional[JobSummary]:
         return self._gen.job_summaries.get((namespace, job_id))
 

@@ -182,3 +182,51 @@ class TestPrometheusMetrics:
         finally:
             http.stop()
             server.stop()
+
+
+class TestStageTimers:
+    def test_scheduler_stages_measured(self):
+        """Per-stage timers (the go-metrics MeasureSince role: worker
+        invoke, plan evaluate/submit/apply) and job-summary gauges appear
+        in /v1/metrics after one scheduling round."""
+        import time as time_mod
+
+        from nomad_tpu import metrics as metrics_mod
+        from nomad_tpu import mock
+        from nomad_tpu.agent import DevAgent
+        from nomad_tpu.api import ApiClient
+
+        metrics_mod.reset()
+        agent = DevAgent(num_clients=1, server_config={"seed": 3})
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        api = ApiClient(address=f"http://127.0.0.1:{http.port}")
+        try:
+            job = mock.job()
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            job.task_groups[0].tasks[0].config = {"run_for": "30s"}
+            job.task_groups[0].tasks[0].resources.networks = []
+            eval_id = agent.server.job_register(job)
+            deadline = time_mod.monotonic() + 10
+            while time_mod.monotonic() < deadline:
+                ev = agent.server.state.eval_by_id(eval_id)
+                if ev is not None and ev.status == "complete":
+                    break
+                time_mod.sleep(0.05)
+            m = api.metrics()
+            timers = m["stages"]["timers"]
+            for stage in (
+                "worker.invoke_scheduler.service",
+                "plan.evaluate",
+                "plan.submit",
+                "plan.apply",
+            ):
+                assert stage in timers, f"missing stage timer {stage}"
+                assert timers[stage]["count"] >= 1
+                assert timers[stage]["p99_ms"] >= 0
+            assert m["stages"]["counters"]["worker.evals_processed.service"] >= 1
+            assert job.id in m["job_summary"]
+        finally:
+            http.stop()
+            agent.stop()
