@@ -1332,6 +1332,7 @@ class HTTPServer:
                 "Name": t.name,
                 "Type": t.type,
                 "Policies": list(t.policies),
+                "Global": t.global_token,
             }
             for t in self.server.state.acl_tokens()
         ], self.server.state.latest_index()

@@ -642,6 +642,9 @@ class Server:
         self._reaper.start()
         self._gc_scheduler = threading.Thread(target=self._schedule_core_gc, daemon=True)
         self._gc_scheduler.start()
+        if self._acl_replication_target():
+            t = threading.Thread(target=self._acl_replication_loop, daemon=True)
+            t.start()
         self._reconcile_gossip_members()
         logger.info("server %s: leadership established", self.raft.node_id)
 
@@ -790,6 +793,116 @@ class Server:
             self._acl_cache.clear()
         self._acl_cache[key] = acl
         return acl
+
+    # ------------------------------------------------------------------
+    # ACL replication (ref leader.go:277 replicateACLPolicies/Tokens:
+    # non-authoritative region leaders mirror policies and global tokens
+    # from the authoritative region over its HTTP surface)
+    # ------------------------------------------------------------------
+    def _acl_replication_target(self) -> Optional[str]:
+        acl_cfg = self.config.get("acl", {})
+        auth = acl_cfg.get("authoritative_region")
+        if not acl_cfg.get("enabled") or not auth or auth == self.region:
+            return None
+        return auth
+
+    def _acl_replication_loop(self):
+        interval = float(
+            self.config.get("acl", {}).get("replication_interval", 1.0)
+        )
+        while self._leader and self._running:
+            try:
+                self.replicate_acl_once()
+            except Exception:
+                logger.exception("acl replication round failed")
+            time.sleep(interval)
+
+    def replicate_acl_once(self) -> dict:
+        """One replication round; returns {policies_upserted, policies_
+        deleted, tokens_upserted, tokens_deleted} (exposed for tests and
+        operator debugging)."""
+        stats = {
+            "policies_upserted": 0,
+            "policies_deleted": 0,
+            "tokens_upserted": 0,
+            "tokens_deleted": 0,
+        }
+        auth = self._acl_replication_target()
+        if auth is None:
+            return stats
+        peers = self.region_http_servers(auth)
+        if not peers:
+            return stats
+        from ..api.client import ApiClient
+        from ..structs.model import AclPolicy, AclToken
+
+        api = ApiClient(
+            address=peers[0],
+            token=self.config.get("acl", {}).get("replication_token", ""),
+        )
+
+        # policies: authoritative region owns the namespace wholesale
+        remote_names = {p["Name"] for p in api.acl_policies()}
+        upserts = []
+        for name in remote_names:
+            doc = api.acl_policy(name)
+            local = self.state.acl_policy_by_name(name)
+            if local is None or local.rules != doc["Rules"]:
+                upserts.append(
+                    AclPolicy(
+                        name=name,
+                        description=doc.get("Description", ""),
+                        rules=doc["Rules"],
+                    )
+                )
+        if upserts:
+            self.acl_upsert_policies(upserts)
+            stats["policies_upserted"] = len(upserts)
+        stale = [
+            p.name
+            for p in self.state.acl_policies()
+            if p.name not in remote_names
+        ]
+        if stale:
+            self.acl_delete_policies(stale)
+            stats["policies_deleted"] = len(stale)
+
+        # tokens: only global ones replicate (ref leader.go
+        # replicateACLTokens; local tokens stay region-scoped)
+        remote_tokens = {
+            t["AccessorID"]: t for t in api.acl_tokens() if t.get("Global")
+        }
+        token_upserts = []
+        for accessor, row in remote_tokens.items():
+            local = self.state.acl_token_by_accessor(accessor)
+            if local is not None and local.policies == row.get("Policies"):
+                continue
+            doc = api.acl_token(accessor)  # full doc incl. the secret
+            token_upserts.append(
+                AclToken(
+                    accessor_id=doc["AccessorID"],
+                    secret_id=doc["SecretID"],
+                    name=doc.get("Name", ""),
+                    type=doc.get("Type", "client"),
+                    policies=list(doc.get("Policies", [])),
+                    global_token=True,
+                )
+            )
+        if token_upserts:
+            self._apply(
+                fsm_mod.ACL_TOKEN_UPSERT,
+                {"tokens": [t.to_dict() for t in token_upserts]},
+            )
+            stats["tokens_upserted"] = len(token_upserts)
+        stale_tokens = [
+            t.accessor_id
+            for t in self.state.acl_tokens()
+            if t.global_token and t.accessor_id not in remote_tokens
+        ]
+        if stale_tokens:
+            self.acl_delete_tokens(stale_tokens)
+            stats["tokens_deleted"] = len(stale_tokens)
+        return stats
 
     def acl_bootstrap(self):
         """One-shot creation of the initial management token

@@ -1,0 +1,188 @@
+"""Multi-region ACL replication (ref leader.go:277 replicateACLPolicies /
+replicateACLTokens): non-authoritative region leaders mirror policies and
+global tokens from the authoritative region."""
+
+import time
+
+import pytest
+
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_region_server(name, region, transport, seeds=None, acl=None):
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "region": region,
+        "bootstrap": True,
+        "gossip": {"bind": ("127.0.0.1", 0), "join": seeds or []},
+        "acl": acl or {},
+        "raft": {
+            "node_id": name,
+            "address": f"raft-{name}",
+            "transport": transport,
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=0, wait_for_leader=5.0)
+    return s
+
+
+class TestAclReplication:
+    def test_policies_and_global_tokens_replicate(self):
+        transport = InmemTransport()
+        auth = make_region_server(
+            "auth-1", "global", transport, acl={"enabled": True}
+        )
+        http_auth = HTTPServer(auth, port=0)
+        http_auth.start()
+        west = None
+        http_west = None
+        try:
+            boot = auth.acl_bootstrap()
+
+            west = make_region_server(
+                "west-1",
+                "west",
+                transport,
+                seeds=[list(auth.gossip.addr)],
+                acl={
+                    "enabled": True,
+                    "authoritative_region": "global",
+                    "replication_token": boot.secret_id,
+                    "replication_interval": 0.2,
+                },
+            )
+            wait_until(
+                lambda: len(west.gossip.alive_members()) == 2,
+                msg="regions federated",
+            )
+
+            from nomad_tpu.structs.model import AclPolicy, AclToken
+
+            auth.acl_upsert_policies(
+                [
+                    AclPolicy(
+                        name="readonly",
+                        description="read everything",
+                        rules='namespace "default" { policy = "read" }',
+                    )
+                ]
+            )
+            global_token = auth.acl_create_token(
+                AclToken(
+                    name="shared",
+                    type="client",
+                    policies=["readonly"],
+                    global_token=True,
+                )
+            )
+            local_token = auth.acl_create_token(
+                AclToken(
+                    name="region-only",
+                    type="client",
+                    policies=["readonly"],
+                    global_token=False,
+                )
+            )
+
+            wait_until(
+                lambda: west.state.acl_policy_by_name("readonly") is not None
+                and west.state.acl_token_by_accessor(global_token.accessor_id)
+                is not None,
+                msg="policy + global token replicated",
+            )
+            # secrets replicate byte-for-byte so one token works everywhere
+            replicated = west.state.acl_token_by_accessor(
+                global_token.accessor_id
+            )
+            assert replicated.secret_id == global_token.secret_id
+            # the bootstrap management token is global too
+            assert (
+                west.state.acl_token_by_accessor(boot.accessor_id) is not None
+            )
+            # region-local tokens must NOT replicate
+            time.sleep(0.5)
+            assert (
+                west.state.acl_token_by_accessor(local_token.accessor_id)
+                is None
+            )
+
+            # deletions converge: remove the policy upstream
+            auth.acl_delete_policies(["readonly"])
+            wait_until(
+                lambda: west.state.acl_policy_by_name("readonly") is None,
+                msg="policy deletion replicated",
+            )
+        finally:
+            http_auth.stop()
+            if west is not None:
+                west.stop()
+            auth.stop()
+
+    def test_replication_enforces_acl_on_target_region(self):
+        """A globally-replicated token authorizes requests against the
+        non-authoritative region's HTTP surface."""
+        transport = InmemTransport()
+        auth = make_region_server(
+            "auth-2", "global", transport, acl={"enabled": True}
+        )
+        http_auth = HTTPServer(auth, port=0)
+        http_auth.start()
+        west = None
+        http_west = None
+        try:
+            boot = auth.acl_bootstrap()
+            west = make_region_server(
+                "west-2",
+                "west",
+                transport,
+                seeds=[list(auth.gossip.addr)],
+                acl={
+                    "enabled": True,
+                    "authoritative_region": "global",
+                    "replication_token": boot.secret_id,
+                    "replication_interval": 0.2,
+                },
+            )
+            http_west = HTTPServer(west, port=0)
+            http_west.start()
+            wait_until(
+                lambda: west.state.acl_token_by_accessor(boot.accessor_id)
+                is not None,
+                msg="bootstrap token replicated",
+            )
+            from nomad_tpu.api.client import APIError
+
+            anon = ApiClient(address=http_west.address)
+            with pytest.raises(APIError) as err:
+                anon.jobs()
+            assert err.value.status == 403
+            authed = ApiClient(
+                address=http_west.address, token=boot.secret_id
+            )
+            assert authed.jobs() == []
+        finally:
+            http_auth.stop()
+            if http_west is not None:
+                http_west.stop()
+            if west is not None:
+                west.stop()
+            auth.stop()
