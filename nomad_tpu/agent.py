@@ -56,6 +56,31 @@ class DevAgent:
         return self.server.job_register(job)
 
 
+def apply_client_config(agent: "DevAgent", config: dict) -> None:
+    """Apply agent-config client settings to the (not yet started) agent's
+    clients: host_volume declarations land on the node before registration
+    (ref client config HostVolumes), meta merges into node metadata."""
+    client_cfg = config.get("client", {}) or {}
+    volumes = client_cfg.get("host_volume") or {}
+    meta = client_cfg.get("meta") or {}
+    if not volumes and not meta:
+        return
+    from .structs.model import ClientHostVolumeConfig
+    from .structs.node_class import compute_class
+
+    for client in agent.clients:
+        for vol_name, body in volumes.items():
+            body = body or {}
+            client.node.host_volumes[vol_name] = ClientHostVolumeConfig(
+                name=vol_name,
+                path=str(body.get("path", "")),
+                read_only=bool(body.get("read_only", False)),
+            )
+        for k, v in meta.items():
+            client.node.meta[str(k)] = str(v)
+        compute_class(client.node)
+
+
 class ServerAgent:
     """A server with a network RPC listener (ref command/agent/agent.go
     server mode + nomad/rpc.go listener).

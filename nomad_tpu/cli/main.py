@@ -88,6 +88,9 @@ def cmd_agent(args):
         server_config=server_cfg,
         num_workers=int(config.get("server", {}).get("num_schedulers", 2)),
     )
+    from ..agent import apply_client_config
+
+    apply_client_config(agent, config)
     agent.start()
     port = args.port if args.port is not None else int(
         config.get("ports", {}).get("http", 4646)

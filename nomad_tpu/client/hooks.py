@@ -91,15 +91,67 @@ def artifacts_hook(task, task_dir: str, env: dict, node=None):
                     shutil.copy(path, dest_base)
             elif parsed.scheme in ("http", "https"):
                 name = os.path.basename(parsed.path) or "artifact"
+                local = os.path.join(dest_base, name)
                 with urllib.request.urlopen(source, timeout=30) as resp:
-                    with open(os.path.join(dest_base, name), "wb") as f:
+                    with open(local, "wb") as f:
                         shutil.copyfileobj(resp, f)
+                _maybe_unpack(local, dest_base)
+            elif parsed.scheme == "git" or source.startswith("git::"):
+                # go-getter's git mode: git::<url>[?ref=<ref>]
+                url = source[len("git::"):] if source.startswith("git::") else source
+                ref = ""
+                if "?ref=" in url:
+                    url, _, ref = url.partition("?ref=")
+                import subprocess
+
+                target = os.path.join(
+                    dest_base,
+                    os.path.basename(url.rstrip("/")).removesuffix(".git"),
+                )
+                cmd = ["git", "clone", "--depth", "1"]
+                if ref:
+                    cmd += ["--branch", ref]
+                cmd += [url, target]
+                out = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=120
+                )
+                if out.returncode != 0:
+                    raise HookError(
+                        f"git clone failed: {out.stderr.strip()[:300]}"
+                    )
             else:
                 raise HookError(f"unsupported artifact getter: {source}")
         except HookError:
             raise
         except Exception as e:
             raise HookError(f"artifact fetch failed for {source}: {e}") from e
+
+
+def _maybe_unpack(path: str, dest: str):
+    """go-getter auto-unpacks recognized archives; same here. The archive
+    file is removed after a successful extraction."""
+    import tarfile
+    import zipfile
+
+    lowered = path.lower()
+    try:
+        if lowered.endswith((".tar.gz", ".tgz", ".tar.bz2", ".tar")):
+            with tarfile.open(path) as tf:
+                tf.extractall(dest, filter="data")
+        elif lowered.endswith(".zip"):
+            with zipfile.ZipFile(path) as zf:
+                for info in zf.infolist():
+                    target = os.path.join(dest, info.filename)
+                    if not os.path.realpath(target).startswith(
+                        os.path.realpath(dest)
+                    ):
+                        raise HookError(f"zip escapes dest: {info.filename}")
+                zf.extractall(dest)
+        else:
+            return
+    except (tarfile.TarError, zipfile.BadZipFile) as e:
+        raise HookError(f"archive unpack failed for {path}: {e}") from e
+    os.remove(path)
 
 
 def templates_hook(task, task_dir: str, env: dict, node=None):

@@ -56,6 +56,43 @@ server { default_scheduler = "tpu-batch" }
         assert server_cfg["acl"]["enabled"] is True
         assert server_cfg["default_scheduler"] == "tpu-batch"
 
+    def test_host_volume_config_reaches_node(self, tmp_path):
+        """client { host_volume "x" { path } } lands on the node before
+        registration so HostVolumeChecker can match it (the same
+        apply_client_config path cmd_agent uses)."""
+        from nomad_tpu.agent import DevAgent, apply_client_config
+        from nomad_tpu.config import load_agent_config, server_config_from_agent
+
+        data = tmp_path / "shared"
+        data.mkdir()
+        cfg = tmp_path / "agent.hcl"
+        cfg.write_text(
+            f"""
+client {{
+  enabled = true
+  meta {{ rack = "r7" }}
+  host_volume "shared-data" {{
+    path = "{data}"
+    read_only = true
+  }}
+}}
+server {{ enabled = true }}
+"""
+        )
+        config = load_agent_config([str(cfg)])
+        agent = DevAgent(
+            num_clients=1, server_config=server_config_from_agent(config)
+        )
+        apply_client_config(agent, config)
+        agent.start()
+        try:
+            node = agent.server.state.node_by_id(agent.clients[0].node.id)
+            assert node.host_volumes["shared-data"].path == str(data)
+            assert node.host_volumes["shared-data"].read_only is True
+            assert node.meta["rack"] == "r7"
+        finally:
+            agent.stop()
+
     def test_deep_merge_scalars_and_dicts(self):
         merged = deep_merge(
             {"a": 1, "b": {"x": 1, "y": 2}}, {"b": {"y": 3, "z": 4}, "c": 5}

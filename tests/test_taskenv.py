@@ -90,6 +90,75 @@ class TestHooks:
         assert (alloc_dir / "data").is_dir()
         assert prepared.env["NOMAD_ALLOC_ID"] == alloc.id
 
+    def test_artifact_http_tarball_unpacks(self, tmp_path):
+        """go-getter auto-unpack: an http tar.gz artifact extracts into
+        the destination and the archive itself is removed."""
+        import http.server
+        import tarfile
+        import threading
+
+        payload = tmp_path / "inner.txt"
+        payload.write_text("packed-content")
+        archive = tmp_path / "bundle.tar.gz"
+        with tarfile.open(archive, "w:gz") as tf:
+            tf.add(payload, arcname="inner.txt")
+
+        class Quiet(http.server.SimpleHTTPRequestHandler):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, directory=str(tmp_path), **kw)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Quiet)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            alloc = make_alloc()
+            task = alloc.job.task_groups[0].tasks[0]
+            task.artifacts = [
+                TaskArtifact(
+                    getter_source=f"http://127.0.0.1:{port}/bundle.tar.gz"
+                )
+            ]
+            task.templates = []
+            task_dir = tmp_path / "task-http"
+            hooks.run_prestart(
+                alloc, task, mock.node(), str(task_dir), str(tmp_path / "a")
+            )
+            assert (
+                task_dir / "local" / "inner.txt"
+            ).read_text() == "packed-content"
+            assert not (task_dir / "local" / "bundle.tar.gz").exists()
+        finally:
+            httpd.shutdown()
+
+    def test_artifact_git_clone(self, tmp_path):
+        import subprocess
+
+        repo = tmp_path / "upstream"
+        repo.mkdir()
+        (repo / "README.md").write_text("cloned-ok")
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "add", "."],
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-q", "-m", "init"],
+        ):
+            subprocess.run(cmd, cwd=repo, check=True)
+
+        alloc = make_alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.artifacts = [TaskArtifact(getter_source=f"git::file://{repo}")]
+        task.templates = []
+        task_dir = tmp_path / "task-git"
+        hooks.run_prestart(
+            alloc, task, mock.node(), str(task_dir), str(tmp_path / "b")
+        )
+        assert (
+            task_dir / "local" / "upstream" / "README.md"
+        ).read_text() == "cloned-ok"
+
     def test_artifact_escape_rejected(self, tmp_path):
         alloc = make_alloc()
         task = alloc.job.task_groups[0].tasks[0]
