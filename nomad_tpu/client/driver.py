@@ -224,23 +224,30 @@ class RawExecDriver(Driver):
 
     def _spawn(self, task: Task, argv: list, cwd, log_base=None) -> TaskHandle:
         """Shared Popen → TaskHandle → waiter tail for the exec family.
-        stdout/stderr are captured to ``<log_base or cwd>/logs/`` (the
-        logmon role, ref client/logmon/: per-task log files the fs/logs
-        API serves)."""
-        stdout = stderr = subprocess.DEVNULL
+        stdout/stderr flow through in-process logmon copiers into rotated
+        ``<log_base or cwd>/logs/<task>.<stream>.<n>`` files honoring the
+        task's LogConfig (ref client/logmon/ + logging/logrotator)."""
+        import os
+
+        from .logmon import RotatingWriter, start_copier
+
         log_base = log_base or cwd
         log_dir = task_log_dir(log_base) if log_base else None
+        stdout = stderr = subprocess.DEVNULL
+        pipes = []  # (read_fd, writer)
+        if log_dir is not None:
+            cfg = task.log_config
+            max_files = cfg.max_files if cfg is not None else 10
+            max_mb = cfg.max_file_size_mb if cfg is not None else 10
+            out_r, stdout = os.pipe()
+            err_r, stderr = os.pipe()
+            pipes = [
+                (out_r, RotatingWriter(log_dir, task.name, "stdout",
+                                       max_files, max_mb)),
+                (err_r, RotatingWriter(log_dir, task.name, "stderr",
+                                       max_files, max_mb)),
+            ]
         try:
-            if log_dir is not None:
-                import os
-
-                os.makedirs(log_dir, exist_ok=True)
-                stdout = open(
-                    os.path.join(log_dir, f"{task.name}.stdout.0"), "ab"
-                )
-                stderr = open(
-                    os.path.join(log_dir, f"{task.name}.stderr.0"), "ab"
-                )
             proc = subprocess.Popen(
                 argv,
                 cwd=cwd,
@@ -248,12 +255,18 @@ class RawExecDriver(Driver):
                 stderr=stderr,
                 env={"PATH": "/usr/bin:/bin:/usr/local/bin", **task.env},
             )
+        except Exception:
+            for fd, writer in pipes:
+                os.close(fd)
+                writer.close()
+            raise
         finally:
-            # the child holds the fds now (or Popen/open raised)
-            if stdout is not subprocess.DEVNULL:
-                stdout.close()
-            if stderr is not subprocess.DEVNULL:
-                stderr.close()
+            # the child holds the write ends now (or Popen raised)
+            for end in (stdout, stderr):
+                if end is not subprocess.DEVNULL:
+                    os.close(end)
+        for fd, writer in pipes:
+            start_copier(fd, writer)
         handle = TaskHandle(
             task_name=task.name,
             driver=self.name,

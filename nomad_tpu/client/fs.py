@@ -53,7 +53,15 @@ def logs(
 ) -> dict:
     if kind not in ("stdout", "stderr"):
         raise ValueError("type must be stdout or stderr")
-    path = contained_path(alloc_dir, f"{task}/logs/{task}.{kind}.0")
+    # rotation (logmon) writes <task>.<kind>.<n>; serve the newest index
+    log_dir = contained_path(alloc_dir, f"{task}/logs")
+    prefix = f"{task}.{kind}."
+    newest = 0
+    if os.path.isdir(log_dir):
+        for name in os.listdir(log_dir):
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                newest = max(newest, int(name[len(prefix):]))
+    path = os.path.join(log_dir, prefix + str(newest))
     if not os.path.exists(path):
         return {"Data": "", "Offset": 0}
     size = os.path.getsize(path)

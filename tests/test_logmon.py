@@ -1,0 +1,108 @@
+"""Log rotation (ref client/logmon + logging/logrotator: rotated
+<task>.<stream>.<n> files bounded by LogConfig)."""
+
+import os
+import time
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.client.logmon import RotatingWriter
+from nomad_tpu.structs.model import LogConfig
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestRotatingWriter:
+    def test_rotates_and_reaps(self, tmp_path):
+        w = RotatingWriter(str(tmp_path), "t", "stdout",
+                           max_files=3, max_file_size_mb=1)
+        chunk = b"x" * (512 * 1024)
+        for _ in range(10):  # 5 MiB total → indexes advance, old reaped
+            w.write(chunk)
+        w.close()
+        files = sorted(
+            f for f in os.listdir(tmp_path) if f.startswith("t.stdout.")
+        )
+        assert len(files) <= 3
+        indexes = sorted(int(f.rsplit(".", 1)[1]) for f in files)
+        assert indexes[-1] >= 3  # rotation actually happened
+        # contiguous newest window
+        assert indexes == list(range(indexes[0], indexes[-1] + 1))
+
+    def test_resumes_at_newest_index(self, tmp_path):
+        w = RotatingWriter(str(tmp_path), "t", "stdout",
+                           max_files=5, max_file_size_mb=1)
+        w.write(b"y" * (1024 * 1024 + 1))
+        w.write(b"z")  # forces rotation to .1
+        w.close()
+        resumed = RotatingWriter(str(tmp_path), "t", "stdout",
+                                 max_files=5, max_file_size_mb=1)
+        assert resumed.index == 1
+        resumed.close()
+
+
+class TestTaskLogRotation:
+    def test_raw_exec_logs_rotate_and_serve_newest(self, tmp_path):
+        agent = DevAgent(num_clients=1, server_config={"seed": 113})
+        agent.start()
+        try:
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+            # ~3 MiB of output forces at least two rotations
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "i=0; while [ $i -lt 48 ]; do "
+                    "head -c 65536 /dev/zero | tr '\\0' 'a'; "
+                    "i=$((i+1)); done; echo END-MARKER",
+                ],
+            }
+            task.resources.networks = []
+            agent.server.job_register(job)
+            wait_until(
+                lambda: [
+                    a.client_status
+                    for a in agent.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ]
+                == ["complete"],
+                msg="writer task complete",
+            )
+            (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+            log_dir = os.path.join(
+                agent.clients[0].data_dir, "allocs", alloc.id, "web", "logs"
+            )
+            files = [
+                f for f in os.listdir(log_dir) if f.startswith("web.stdout.")
+            ]
+            assert len(files) <= 2, files
+            assert all(
+                os.path.getsize(os.path.join(log_dir, f)) <= 1024 * 1024 + 65536
+                for f in files
+            )
+            # the fs/logs surface serves the newest index (END-MARKER tail)
+            from nomad_tpu.client import fs
+
+            out = fs.logs(
+                os.path.dirname(log_dir).rsplit("/web", 1)[0],
+                "web",
+                "stdout",
+                origin="end",
+                offset=64,
+            )
+            assert "END-MARKER" in out["Data"]
+        finally:
+            agent.stop()
