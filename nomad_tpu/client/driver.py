@@ -239,14 +239,32 @@ class RawExecDriver(Driver):
             cfg = task.log_config
             max_files = cfg.max_files if cfg is not None else 10
             max_mb = cfg.max_file_size_mb if cfg is not None else 10
-            out_r, stdout = os.pipe()
-            err_r, stderr = os.pipe()
-            pipes = [
-                (out_r, RotatingWriter(log_dir, task.name, "stdout",
-                                       max_files, max_mb)),
-                (err_r, RotatingWriter(log_dir, task.name, "stderr",
-                                       max_files, max_mb)),
-            ]
+            raw_fds: list[int] = []
+            writers: list[RotatingWriter] = []
+            try:
+                out_r, stdout = os.pipe()
+                raw_fds += [out_r, stdout]
+                err_r, stderr = os.pipe()
+                raw_fds += [err_r, stderr]
+                writers.append(
+                    RotatingWriter(log_dir, task.name, "stdout",
+                                   max_files, max_mb)
+                )
+                writers.append(
+                    RotatingWriter(log_dir, task.name, "stderr",
+                                   max_files, max_mb)
+                )
+                pipes = [(out_r, writers[0]), (err_r, writers[1])]
+            except Exception:
+                # a half-built io setup must not leak fds per restart
+                for fd in raw_fds:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                for writer in writers:
+                    writer.close()
+                raise
         try:
             proc = subprocess.Popen(
                 argv,
@@ -265,8 +283,7 @@ class RawExecDriver(Driver):
             for end in (stdout, stderr):
                 if end is not subprocess.DEVNULL:
                     os.close(end)
-        for fd, writer in pipes:
-            start_copier(fd, writer)
+        copiers = [start_copier(fd, writer) for fd, writer in pipes]
         handle = TaskHandle(
             task_name=task.name,
             driver=self.name,
@@ -277,7 +294,14 @@ class RawExecDriver(Driver):
         handle._proc_start = _proc_start_time(proc.pid)
 
         def waiter():
-            handle.finish(proc.wait())
+            code = proc.wait()
+            # drain the pipes before completion is observable: a caller
+            # reacting to the exit must find the final log bytes on disk
+            # (copiers end at EOF, which the child's exit guarantees soon;
+            # the timeout guards grandchildren holding the pipe open)
+            for t in copiers:
+                t.join(timeout=5.0)
+            handle.finish(code)
 
         threading.Thread(target=waiter, daemon=True).start()
         return handle

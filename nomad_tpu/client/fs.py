@@ -53,26 +53,49 @@ def logs(
 ) -> dict:
     if kind not in ("stdout", "stderr"):
         raise ValueError("type must be stdout or stderr")
-    # rotation (logmon) writes <task>.<kind>.<n>; serve the newest index
+    # Rotation (logmon) writes <task>.<kind>.<n>; the surviving files are
+    # served as ONE logical stream so a follower's offset cursor crosses
+    # rotation boundaries without losing the old file's tail (the frames
+    # model of the reference's fs_endpoint.go Logs). Data reaped by
+    # max_files ages out of the logical stream from the front.
+    from .logmon import rotated_indexes
+
     log_dir = contained_path(alloc_dir, f"{task}/logs")
     prefix = f"{task}.{kind}."
-    newest = 0
-    if os.path.isdir(log_dir):
-        for name in os.listdir(log_dir):
-            if name.startswith(prefix) and name[len(prefix):].isdigit():
-                newest = max(newest, int(name[len(prefix):]))
-    path = os.path.join(log_dir, prefix + str(newest))
-    if not os.path.exists(path):
+    indexes = (
+        rotated_indexes(log_dir, prefix) if os.path.isdir(log_dir) else []
+    )
+    if not indexes:
         return {"Data": "", "Offset": 0}
-    size = os.path.getsize(path)
-    start = max(size - offset, 0) if origin == "end" else min(offset, size)
-    with open(path, "rb") as f:
-        f.seek(start)
-        data = f.read(limit)
+    segments = []  # (path, size) oldest → newest
+    total = 0
+    for idx in indexes:
+        path = os.path.join(log_dir, prefix + str(idx))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        segments.append((path, size))
+        total += size
+    start = max(total - offset, 0) if origin == "end" else min(offset, total)
+    chunks = []
+    remaining = limit
+    position = 0
+    for path, size in segments:
+        if remaining <= 0:
+            break
+        seg_start = max(start - position, 0)
+        if seg_start < size:
+            with open(path, "rb") as f:
+                f.seek(seg_start)
+                chunks.append(f.read(min(remaining, size - seg_start)))
+            remaining -= len(chunks[-1])
+        position += size
+    data = b"".join(chunks)
     return {
         "Data": data.decode("utf-8", "replace"),
         "Offset": start + len(data),
-        "Size": size,
+        "Size": total,
     }
 
 

@@ -15,6 +15,19 @@ import threading
 CHUNK = 65536
 
 
+def rotated_indexes(log_dir: str, prefix: str) -> list[int]:
+    """Sorted indexes of the rotated files for one stream (single
+    definition shared by the writer and the fs/logs reader)."""
+    out = []
+    try:
+        for name in os.listdir(log_dir):
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                out.append(int(name[len(prefix):]))
+    except OSError:
+        pass
+    return sorted(out)
+
+
 class RotatingWriter:
     """Append-to-current-index writer with size-based rotation."""
 
@@ -34,16 +47,8 @@ class RotatingWriter:
         return os.path.join(self.log_dir, self.prefix + str(index))
 
     def _newest_index(self) -> int:
-        newest = 0
-        try:
-            for name in os.listdir(self.log_dir):
-                if name.startswith(self.prefix):
-                    suffix = name[len(self.prefix):]
-                    if suffix.isdigit():
-                        newest = max(newest, int(suffix))
-        except OSError:
-            pass
-        return newest
+        indexes = rotated_indexes(self.log_dir, self.prefix)
+        return indexes[-1] if indexes else 0
 
     def write(self, data: bytes):
         if self._size + len(data) > self.max_bytes and self._size > 0:

@@ -48,6 +48,39 @@ class TestRotatingWriter:
         resumed.close()
 
 
+class TestLogicalStream:
+    def test_follow_cursor_survives_rotation(self, tmp_path):
+        """fs.logs serves surviving rotated files as one logical stream:
+        a follower's offset cursor crosses the .0→.1 boundary without
+        losing the old file's tail."""
+        from nomad_tpu.client import fs
+
+        alloc_dir = tmp_path
+        log_dir = alloc_dir / "web" / "logs"
+        w = RotatingWriter(str(log_dir), "web", "stdout",
+                           max_files=5, max_file_size_mb=1)
+        first = b"A" * (1024 * 1024 - 10)  # nearly fills .0
+        w.write(first)
+
+        # follower reads everything so far
+        out = fs.logs(str(alloc_dir), "web", "stdout", offset=0, limit=1 << 22)
+        cursor = out["Offset"]
+        collected = out["Data"]
+        assert cursor == len(first)
+
+        # rotation happens between polls
+        second = b"B" * 64
+        w.write(b"C" * 20)   # overflows → rotates to .1 mid-stream
+        w.write(second)
+        w.close()
+
+        out = fs.logs(
+            str(alloc_dir), "web", "stdout", offset=cursor, limit=1 << 22
+        )
+        collected += out["Data"]
+        assert collected == (first + b"C" * 20 + second).decode()
+
+
 class TestTaskLogRotation:
     def test_raw_exec_logs_rotate_and_serve_newest(self, tmp_path):
         agent = DevAgent(num_clients=1, server_config={"seed": 113})
