@@ -615,6 +615,9 @@ class Client:
                 "unique.storage.volume": self.data_dir,
                 "unique.storage.bytestotal": str(disk_total * 1024 * 1024),
                 "unique.storage.bytesfree": str(disk_free * 1024 * 1024),
+                # cloud env probes: empty off-cloud (env_aws.go/env_gce.go)
+                **fp_mod.env_aws_fingerprint(),
+                **fp_mod.env_gce_fingerprint(),
             },
             node_resources=NodeResources(
                 cpu=NodeCpuResources(cpu_shares=cpu["total_compute"]),

@@ -88,6 +88,82 @@ def host_fingerprint() -> dict:
     }
 
 
+_ENV_PROBE_CACHE: dict[str, dict] = {}
+
+
+def env_aws_fingerprint(base: str = "", timeout: float = 0.25) -> dict:
+    """EC2 metadata-service probe (ref fingerprint/env_aws.go). Returns
+    platform.aws.* attributes, or {} when the node isn't on EC2 — the
+    probe's short timeout keeps non-cloud boots fast, and the default
+    endpoint is probed once per process (cloudiness doesn't change)."""
+    import urllib.request
+
+    if not base and "aws" in _ENV_PROBE_CACHE:
+        return dict(_ENV_PROBE_CACHE["aws"])
+    cache_key = "aws" if not base else None
+    base = base or "http://169.254.169.254/latest/meta-data/"
+    attrs = {}
+    keys = {
+        "instance-id": "unique.platform.aws.instance-id",
+        "instance-type": "platform.aws.instance-type",
+        "placement/availability-zone": "platform.aws.placement.availability-zone",
+        "local-ipv4": "unique.platform.aws.local-ipv4",
+        "local-hostname": "unique.platform.aws.local-hostname",
+        "ami-id": "platform.aws.ami-id",
+    }
+    for path, attr in keys.items():
+        try:
+            with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+                attrs[attr] = resp.read().decode().strip()
+        except Exception:
+            if not attrs:
+                attrs = {}
+                break  # first probe failed: not on EC2, stop probing
+            continue  # partial metadata: keep what answered
+    if cache_key:
+        _ENV_PROBE_CACHE[cache_key] = dict(attrs)
+    return attrs
+
+
+def env_gce_fingerprint(base: str = "", timeout: float = 0.25) -> dict:
+    """GCE metadata-service probe (ref fingerprint/env_gce.go): requires
+    the Metadata-Flavor header, so a generic http server won't false-
+    positive."""
+    import urllib.request
+
+    if not base and "gce" in _ENV_PROBE_CACHE:
+        return dict(_ENV_PROBE_CACHE["gce"])
+    cache_key = "gce" if not base else None
+    base = base or "http://metadata.google.internal/computeMetadata/v1/instance/"
+    attrs = {}
+    keys = {
+        "id": "unique.platform.gce.id",
+        "hostname": "unique.platform.gce.hostname",
+        "machine-type": "platform.gce.machine-type",
+        "zone": "platform.gce.zone",
+    }
+    for path, attr in keys.items():
+        req = urllib.request.Request(
+            base + path, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                if resp.headers.get("Metadata-Flavor") != "Google":
+                    attrs = {}
+                    break  # something answered, but not GCE metadata
+                value = resp.read().decode().strip()
+        except Exception:
+            if not attrs:
+                attrs = {}
+                break
+            continue
+        # machine-type/zone arrive as long resource paths; keep the leaf
+        attrs[attr] = value.rsplit("/", 1)[-1] if "/" in value else value
+    if cache_key:
+        _ENV_PROBE_CACHE[cache_key] = dict(attrs)
+    return attrs
+
+
 def network_fingerprint() -> list[NetworkResource]:
     """Usable links with an address (ref fingerprint/network.go: interface
     speed from sysfs, default-route IP detection; loopback as last

@@ -40,6 +40,105 @@ class TestFingerprinters:
         assert nets[0].mbits > 0
 
 
+class TestEnvFingerprint:
+    def _serve(self, handler_cls):
+        import http.server
+        import threading
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), handler_cls)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/"
+
+    def test_aws_detected_against_fake_metadata(self):
+        import http.server
+
+        from nomad_tpu.client.fingerprint import env_aws_fingerprint
+
+        answers = {
+            "/instance-id": "i-0abc",
+            "/instance-type": "m5.large",
+            "/placement/availability-zone": "us-east-1a",
+            "/local-ipv4": "10.0.0.7",
+            "/local-hostname": "ip-10-0-0-7",
+            "/ami-id": "ami-123",
+        }
+
+        class Meta(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = answers.get(self.path)
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        httpd, base = self._serve(Meta)
+        try:
+            attrs = env_aws_fingerprint(base=base)
+            assert attrs["unique.platform.aws.instance-id"] == "i-0abc"
+            assert attrs["platform.aws.instance-type"] == "m5.large"
+            assert (
+                attrs["platform.aws.placement.availability-zone"]
+                == "us-east-1a"
+            )
+        finally:
+            httpd.shutdown()
+
+    def test_gce_detected_and_flavor_enforced(self):
+        import http.server
+
+        from nomad_tpu.client.fingerprint import env_gce_fingerprint
+
+        class Meta(http.server.BaseHTTPRequestHandler):
+            flavored = True
+
+            def do_GET(self):
+                values = {
+                    "/id": "1234567",
+                    "/hostname": "vm.c.proj.internal",
+                    "/machine-type": "projects/1/machineTypes/n1-standard-4",
+                    "/zone": "projects/1/zones/us-central1-a",
+                }
+                data = values.get(self.path, "").encode()
+                self.send_response(200)
+                if type(self).flavored:
+                    self.send_header("Metadata-Flavor", "Google")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):
+                pass
+
+        httpd, base = self._serve(Meta)
+        try:
+            attrs = env_gce_fingerprint(base=base)
+            assert attrs["platform.gce.machine-type"] == "n1-standard-4"
+            assert attrs["platform.gce.zone"] == "us-central1-a"
+            # a generic http server (no flavor header) must not pass
+            Meta.flavored = False
+            assert env_gce_fingerprint(base=base) == {}
+        finally:
+            httpd.shutdown()
+
+    def test_off_cloud_returns_empty(self):
+        from nomad_tpu.client.fingerprint import (
+            env_aws_fingerprint,
+            env_gce_fingerprint,
+        )
+
+        # unroutable/refused endpoints: both probes come back empty
+        assert env_aws_fingerprint(base="http://127.0.0.1:9/") == {}
+        assert env_gce_fingerprint(base="http://127.0.0.1:9/") == {}
+
+
 class TestClientFingerprint:
     def test_node_reflects_real_host(self, tmp_path):
         from nomad_tpu.client.client import Client
