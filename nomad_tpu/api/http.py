@@ -15,6 +15,7 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
 from ..jobspec.hcl import parse_duration
+from ..raft import NotLeaderError
 from ..structs.model import Allocation, Job
 
 _ROUTES: list[tuple[str, re.Pattern, str, object]] = []
@@ -194,10 +195,62 @@ class HTTPServer:
                             self._respond(403, {"error": str(e)}, None)
                         except ValueError as e:
                             self._respond(400, {"error": str(e)}, None)
+                        except NotLeaderError as e:
+                            # a write landed on a follower: proxy to the
+                            # leader's HTTP surface (the reference forwards
+                            # the RPC internally, rpc.go forward())
+                            self._forward_leader(
+                                method, e, parsed, query, body
+                            )
                         except Exception as e:
                             self._respond(500, {"error": str(e)}, None)
                         return
                 self._respond(404, {"error": f"no handler for {parsed.path}"}, None)
+
+            def _forward_leader(self, method, err, parsed, query, body):
+                """Proxy the request to the raft leader's HTTP address,
+                resolved from its gossip tags or the static
+                ``server_http_addrs`` config map."""
+                leader_id = getattr(err, "leader_id", None) or getattr(
+                    api.server.raft, "leader_id", None
+                )
+                target = None
+                if leader_id:
+                    gossip = getattr(api.server, "gossip", None)
+                    if gossip is not None:
+                        with gossip._lock:
+                            member = gossip.members.get(leader_id)
+                        if member is not None:
+                            target = member.tags.get("http")
+                    if target is None:
+                        target = (
+                            api.server.config.get("server_http_addrs") or {}
+                        ).get(leader_id)
+                if not target:
+                    self._respond(
+                        500,
+                        {"error": f"not the leader and no route to it ({err})"},
+                        None,
+                    )
+                    return
+                from .client import APIError, ApiClient
+
+                proxy = ApiClient(
+                    address=target,
+                    token=self.headers.get("X-Nomad-Token") or "",
+                )
+                path = parsed.path + (
+                    "?" + parsed.query if parsed.query else ""
+                )
+                try:
+                    payload, index = proxy._request(method, path, body=body)
+                    self._respond(200, payload, index)
+                except APIError as e:
+                    self._respond(e.status, {"error": str(e)}, None)
+                except Exception as e:
+                    self._respond(
+                        500, {"error": f"leader forward failed: {e}"}, None
+                    )
 
             def _forward_region(self, method, region, parsed, query, body):
                 from .client import APIError, ApiClient

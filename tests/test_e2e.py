@@ -1,0 +1,200 @@
+"""End-to-end cluster scenario over the real network tier (the e2e/
+suite's role, SURVEY §4.6): a 3-server TCP raft cluster with two remote
+node agents runs a service job through rolling update, node drain, leader
+failure, and GC."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, ServerAgent
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.structs.model import UpdateStrategy
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestClusterLifecycle:
+    def test_full_lifecycle(self):
+        # -- cluster formation ------------------------------------------
+        agents = [
+            ServerAgent(f"e2e-s{i}", config={"seed": 42, "heartbeat_ttl": 10.0})
+            for i in range(3)
+        ]
+        voters = {a.name: a.address for a in agents}
+        for a in agents:
+            a.start(voters=dict(voters), num_workers=2)
+        clients = []
+        https = []
+        try:
+            leader = None
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and leader is None:
+                leader = next(
+                    (a for a in agents if a.server.is_leader()), None
+                )
+                time.sleep(0.05)
+            assert leader is not None, "no leader elected"
+
+            server_addrs = [a.address for a in agents]
+            clients = [ClientAgent(list(server_addrs)) for _ in range(2)]
+            for c in clients:
+                c.start()
+            wait_until(
+                lambda: all(
+                    leader.server.state.node_by_id(c.node.id) is not None
+                    for c in clients
+                ),
+                msg="both nodes registered",
+            )
+
+            # -- HTTP on every server; writes through a follower must
+            # leader-forward (static http table: no gossip in this cluster)
+            https = []
+            for a in agents:
+                h = HTTPServer(a.server, port=0)
+                h.start()
+                https.append(h)
+            table = {
+                a.name: h.address for a, h in zip(agents, https)
+            }
+            for a in agents:
+                a.server.config["server_http_addrs"] = table
+            follower = next(a for a in agents if a is not leader)
+            api = ApiClient(
+                address=table[follower.name]
+            )
+
+            job = mock.job()
+            job.id = "e2e-web"
+            tg = job.task_groups[0]
+            tg.count = 2
+            tg.update = UpdateStrategy(
+                max_parallel=1,
+                min_healthy_time=int(0.1 * 1e9),
+                healthy_deadline=int(20 * 1e9),
+                progress_deadline=int(60 * 1e9),
+                auto_revert=False,
+            )
+            task = tg.tasks[0]
+            task.driver = "mock_driver"
+            task.config = {"run_for": "600s"}
+            task.resources.networks = []
+            out = api.register_job(job.to_dict())
+            assert out["EvalID"]
+
+            def running_allocs():
+                return [
+                    a
+                    for a in leader.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                    if a.client_status == "running"
+                ]
+
+            wait_until(
+                lambda: len(running_allocs()) == 2, msg="v0 allocs running"
+            )
+
+            # -- rolling update drives a deployment to success ----------
+            job_v1 = job.copy()
+            job_v1.task_groups[0].tasks[0].config = {"run_for": "601s"}
+            api.register_job(job_v1.to_dict())
+            wait_until(
+                lambda: any(
+                    d.status == "successful"
+                    for d in leader.server.state.deployments_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                timeout=60,
+                msg="rolling update deployment successful",
+            )
+            wait_until(
+                lambda: len(running_allocs()) == 2, msg="v1 allocs running"
+            )
+
+            # -- drain the node with at least one alloc -----------------
+            victim_node = running_allocs()[0].node_id
+            leader.server.node_drain(victim_node, drain=True)
+            other = next(
+                c.node.id for c in clients if c.node.id != victim_node
+            )
+            wait_until(
+                lambda: len(running_allocs()) == 2
+                and all(a.node_id == other for a in running_allocs()),
+                timeout=60,
+                msg="allocs migrated off the drained node",
+            )
+
+            # -- leader failure: cluster re-elects, scheduling resumes --
+            old_leader = leader
+            old_leader.stop()
+            agents.remove(old_leader)
+            deadline = time.monotonic() + 15
+            leader = None
+            while time.monotonic() < deadline and leader is None:
+                leader = next(
+                    (a for a in agents if a.server.is_leader()), None
+                )
+                time.sleep(0.05)
+            assert leader is not None, "no new leader after failure"
+
+            batch = mock.batch_job()
+            batch.id = "e2e-batch"
+            btg = batch.task_groups[0]
+            btg.count = 1
+            btg.tasks[0].driver = "mock_driver"
+            btg.tasks[0].config = {"run_for": "0s"}
+            btg.tasks[0].resources.networks = []
+            leader.server.job_register(batch)
+            wait_until(
+                lambda: [
+                    a.client_status
+                    for a in leader.server.state.allocs_by_job(
+                        batch.namespace, batch.id
+                    )
+                ]
+                == ["complete"],
+                timeout=60,
+                msg="batch job completes after failover",
+            )
+
+            # -- teardown: stop + purge + force GC bounds state ---------
+            leader.server.job_deregister(job.namespace, job.id, purge=True)
+            leader.server.job_deregister(
+                batch.namespace, batch.id, purge=True
+            )
+            wait_until(
+                lambda: leader.server.state.job_by_id(job.namespace, job.id)
+                is None,
+                msg="job purged",
+            )
+            def gc_converged():
+                # force-GC each round: allocs reach terminal status
+                # asynchronously as clients confirm their stops
+                leader.server.system_gc()
+                time.sleep(0.2)
+                return not [
+                    a
+                    for a in leader.server.state.allocs()
+                    if a.job_id in ("e2e-web", "e2e-batch")
+                ]
+
+            wait_until(gc_converged, timeout=60, msg="allocs reaped")
+        finally:
+            for h in https:
+                h.stop()
+            for c in clients:
+                c.stop()
+            for a in agents:
+                a.stop()
