@@ -221,7 +221,9 @@ class BatchDrainWorker(Worker):
             return
 
         shared = SharedCluster(snapshot)
-        collector = KernelBatchCollector(shared, expected=len(batch))
+        collector = KernelBatchCollector(
+            shared, expected=len(batch), pad_evals=self.batch_size
+        )
         threads = []
         for ev, token in batch:
             # one planner per eval: SubmitPlan attaches per-eval tokens and

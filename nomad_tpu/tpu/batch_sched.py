@@ -13,6 +13,7 @@ scalar oracle path, so behavior is complete while the hot path is dense.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -113,6 +114,10 @@ def counters_snapshot() -> dict:
 #: one-step-per-placement program validated against the scalar oracle).
 EXACT_ONLY = False
 
+#: solo evals at or below this many placements use the scalar oracle
+#: (device-launch latency dominates tiny problems); 0 disables the gate
+SMALL_EVAL_ORACLE_MAX = int(os.environ.get("NOMAD_TPU_SMALL_EVAL_MAX", "8"))
+
 
 class TPUBatchScheduler(GenericScheduler):
     """GenericScheduler with the batched placement kernel."""
@@ -188,6 +193,16 @@ class TPUBatchScheduler(GenericScheduler):
         nodes, by_dc = self.state.ready_nodes_in_dcs(self.job.datacenters)
         if not nodes:
             _count_fallback("no_ready_nodes")
+            return super()._compute_placements(destructive, place)
+
+        # Tiny solo evals ride the scalar oracle: a device launch costs
+        # ~100ms regardless of size, while the oracle places a handful of
+        # allocs over a log2-bounded candidate ring in well under a
+        # millisecond. Fused drain batches amortize the launch and keep the
+        # kernel; this gate only affects the solo path (e.g. the refresh
+        # retry after a partial commit, which replans 1-4 allocs).
+        if len(place) <= SMALL_EVAL_ORACLE_MAX and not EXACT_ONLY:
+            _count_fallback("small_eval")
             return super()._compute_placements(destructive, place)
 
         _count_kernel()

@@ -91,10 +91,15 @@ class KernelBatchCollector:
     arrive runs the combined kernel for everyone.
     """
 
-    def __init__(self, shared: SharedCluster, expected: int, timeout: float = 60.0):
+    def __init__(self, shared: SharedCluster, expected: int, timeout: float = 60.0,
+                 pad_evals: int = 0):
         self.shared = shared
         self.timeout = timeout
         self._expected = expected
+        #: stable padding floor (the worker's configured drain size): fused
+        #: batches of varying occupancy then share ONE compiled shape
+        #: instead of recompiling per batch-size bucket
+        self.pad_evals = max(pad_evals, expected)
         self._lock = threading.Lock()
         self._parked: list[_Parked] = []
         self._consumed: set[str] = set()
@@ -163,10 +168,18 @@ class KernelBatchCollector:
         shared = self.shared
         n_real = len(shared.nodes)
         N = _bucket(n_real)
-        E = _bucket(len(parked))
-        G = _bucket(sum(len(p.prep.planes_list) for p in parked))
+        # padding floors keyed to the configured drain size: partial batches
+        # reuse the full batch's compiled shape (shape churn was costing a
+        # fresh XLA compile per batch)
+        E = _bucket(max(len(parked), self.pad_evals))
+        G = _bucket(
+            max(
+                sum(len(p.prep.planes_list) for p in parked),
+                self.pad_evals,
+            )
+        )
         A_real = sum(len(p.prep.gid_real) for p in parked)
-        A = _bucket(A_real)
+        A = _bucket(max(A_real, self.pad_evals * 4))
         V = _bucket(
             max(
                 max(
@@ -174,7 +187,7 @@ class KernelBatchCollector:
                      if pl.counts0 is not None),
                     default=1,
                 ),
-                1,
+                8,
             )
         )
 
@@ -309,3 +322,7 @@ class KernelBatchCollector:
             kernel_s=t_kernel - t_build,
             padded=(E, G, A, N, V),
         )
+        from .. import metrics
+
+        metrics.sample("drain.batch_build", t_build - t0)
+        metrics.sample("drain.batch_kernel", t_kernel - t_build)

@@ -282,9 +282,11 @@ class TestGossipOperator:
                 "bootstrap": i == 0,
                 "gossip": {
                     "bind": ("127.0.0.1", 0),
-                    "probe_interval": 0.1,
-                    "ack_timeout": 0.1,
-                    "suspect_timeout": 0.4,
+                    "probe_interval": 0.15,
+                    # generous ack/suspect windows: a loaded CI box can
+                    # stall a probe thread long enough to false-suspect
+                    "ack_timeout": 0.5,
+                    "suspect_timeout": 1.0,
                     "reap_timeout": 60.0,
                 },
                 "raft": {
@@ -321,7 +323,7 @@ class TestGossipOperator:
             leader.set_autopilot_config({"cleanup_dead_servers": False})
             victim = servers[2]
             victim.gossip.stop()
-            deadline = time.monotonic() + 2
+            deadline = time.monotonic() + 8
             while time.monotonic() < deadline:
                 m = leader.gossip.members.get("g2")
                 if m is not None and m.status == "dead":
