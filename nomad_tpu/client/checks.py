@@ -89,6 +89,9 @@ class CheckRunner:
         self._thread: threading.Thread | None = None
         # (service, check) → next fire time
         self._schedule: dict[tuple[str, str], float] = {}
+        # check name → consecutive critical results (check_restart)
+        self._fail_streak: dict[str, int] = {}
+        self._started_at = time.monotonic()
 
     def start(self):
         checks = [
@@ -127,6 +130,8 @@ class CheckRunner:
                         getattr(tr, "_env", None) or {},
                     )
                     self._publish(chk.name or svc.name, status, output)
+                    if self._maybe_restart(chk, status):
+                        return  # restart kills the process; this run ends
                     interval = max(
                         (chk.interval / 1e9) if chk.interval else DEFAULT_INTERVAL_S,
                         MIN_INTERVAL_S,
@@ -135,6 +140,39 @@ class CheckRunner:
                     self._schedule[key] = due
                 next_fire = min(next_fire, due)
             self._stop.wait(max(next_fire - time.monotonic(), MIN_INTERVAL_S))
+
+    def _maybe_restart(self, check, status: str) -> bool:
+        """check_restart (ref structs.go CheckRestart + taskrunner's
+        checkRestarter): ``limit`` consecutive critical results after the
+        ``grace`` window restart the task through the normal user-restart
+        path (outside the restart-policy budget, like the reference's
+        Restart(force))."""
+        cr = check.check_restart
+        if cr is None or cr.limit <= 0:
+            return False
+        name = check.name
+        if status == PASSING:
+            self._fail_streak[name] = 0
+            return False
+        if time.monotonic() - self._started_at < (cr.grace / 1e9):
+            return False
+        self._fail_streak[name] = self._fail_streak.get(name, 0) + 1
+        if self._fail_streak[name] < cr.limit:
+            return False
+        tr = self.task_runner
+        logger.warning(
+            "check %s failed %d times; restarting task %s",
+            name, self._fail_streak[name], tr.task.name,
+        )
+        tr._event(
+            "Restart Signaled",
+            f"healthcheck: check {name!r} unhealthy",
+        )
+        try:
+            tr.restart()
+        except ValueError:
+            pass  # already stopping/stopped
+        return True
 
     def _publish(self, name: str, status: str, output: str):
         tr = self.task_runner

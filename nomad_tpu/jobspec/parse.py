@@ -21,6 +21,7 @@ from ..structs.model import (
     Resources,
     RestartPolicy,
     RequestedDevice,
+    CheckRestart,
     ConsulConnect,
     ConsulProxy,
     ConsulSidecarService,
@@ -167,19 +168,24 @@ def parse_service(name_default: str, d: dict) -> Service:
         canary_tags=[str(t) for t in _listify(d.get("canary_tags"))],
     )
     for body in _listify(d.get("check")):
-        svc.checks.append(
-            ServiceCheck(
-                name=body.get("name", ""),
-                type=body.get("type", ""),
-                command=body.get("command", ""),
-                args=[str(a) for a in _listify(body.get("args"))],
-                path=body.get("path", ""),
-                protocol=body.get("protocol", ""),
-                port_label=str(body.get("port", "")),
-                interval=parse_duration(body.get("interval", 0)),
-                timeout=parse_duration(body.get("timeout", 0)),
-            )
+        check = ServiceCheck(
+            name=body.get("name", ""),
+            type=body.get("type", ""),
+            command=body.get("command", ""),
+            args=[str(a) for a in _listify(body.get("args"))],
+            path=body.get("path", ""),
+            protocol=body.get("protocol", ""),
+            port_label=str(body.get("port", "")),
+            interval=parse_duration(body.get("interval", 0)),
+            timeout=parse_duration(body.get("timeout", 0)),
         )
+        for cr in _listify(body.get("check_restart")):
+            cr = cr or {}
+            check.check_restart = CheckRestart(
+                limit=int(cr.get("limit", 0)),
+                grace=parse_duration(cr.get("grace", 0)),
+            )
+        svc.checks.append(check)
     for body in _listify(d.get("connect")):
         connect = ConsulConnect()
         for sidecar in _listify(body.get("sidecar_service")):

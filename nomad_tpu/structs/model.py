@@ -673,6 +673,15 @@ class LogConfig(Base):
 
 
 @dataclass
+class CheckRestart(Base):
+    """ref structs.go CheckRestart: restart the task after ``limit``
+    consecutive failing results, once ``grace`` has passed since start."""
+
+    limit: int = 0
+    grace: int = 0  # ns
+
+
+@dataclass
 class ServiceCheck(Base):
     name: str = ""
     type: str = ""
@@ -683,6 +692,7 @@ class ServiceCheck(Base):
     port_label: str = ""
     interval: int = 0
     timeout: int = 0
+    check_restart: Optional[CheckRestart] = None
 
 
 @dataclass

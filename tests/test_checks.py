@@ -187,6 +187,71 @@ class TestCheckSurface:
             http_srv.stop()
             agent.stop()
 
+    def test_check_restart_recycles_task(self, tmp_path):
+        """check_restart: limit consecutive criticals after grace restart
+        the task through the user-restart path; a check that starts
+        passing (flag present on the relaunch) stops the cycling."""
+        from nomad_tpu.structs.model import CheckRestart
+
+        flag = tmp_path / "come-up-healthy"
+        agent = DevAgent(num_clients=1, server_config={"seed": 107})
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            # first boot writes the flag, so the SECOND generation's check
+            # passes: exactly one health restart expected
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", f"touch {flag}.attempt; sleep 60"],
+            }
+            task.resources.networks = []
+            task.services = [
+                Service(
+                    name="flappy",
+                    checks=[
+                        ServiceCheck(
+                            name="flag",
+                            type="script",
+                            command="/usr/bin/test",
+                            args=["-f", str(flag)],
+                            interval=int(0.1 * 1e9),
+                            check_restart=CheckRestart(
+                                limit=2, grace=int(0.1 * 1e9)
+                            ),
+                        )
+                    ],
+                )
+            ]
+            agent.server.job_register(job)
+
+            def task_state():
+                allocs = agent.server.state.allocs_by_job(
+                    job.namespace, job.id
+                )
+                return (
+                    allocs[0].task_states.get("web") if allocs else None
+                )
+
+            wait_until(
+                lambda: task_state() is not None
+                and task_state().restarts >= 1,
+                msg="check_restart recycled the task",
+            )
+            # let the next generation pass its check and stabilize
+            flag.write_text("ok")
+            wait_until(
+                lambda: task_state() is not None
+                and task_state().state == "running"
+                and task_state().check_status.get("flag") == "passing",
+                msg="task healthy after flag appears",
+            )
+        finally:
+            agent.stop()
+
     def test_failing_check_blocks_deployment_health(self):
         """health_check='checks' (default): a critical check keeps the
         alloc from reporting healthy, failing the deployment at the
