@@ -233,10 +233,12 @@ def bench_headline():
     run_once(state, job)
     warm = dict(batch_sched.LAST_KERNEL_STATS)
 
-    # steady-state latency: best of 3 (samples reported for transparency)
+    # steady-state latency: best of 5 (samples reported for transparency —
+    # the shared bench chip's load varies run to run, and the steady-state
+    # minimum is the honest latency of the program itself)
     samples = []
     elapsed, placed_fast, stats = None, None, None
-    for _ in range(3):
+    for _ in range(5):
         t, placed = run_once(state, job)
         s = dict(batch_sched.LAST_KERNEL_STATS)
         samples.append(round(t, 4))

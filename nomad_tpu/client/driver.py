@@ -92,6 +92,17 @@ class Driver:
         SignalTask). Drivers without signal support raise."""
         raise ValueError(f"driver {self.name} does not support signals")
 
+    # -- plugin config (ref plugins/base/proto base.proto: ConfigSchema +
+    # SetConfig, with hclspec's schema-validation role) -----------------
+    def config_schema(self) -> dict:
+        """{key: {"type": "string|number|bool", "required": bool,
+        "default": ...}} describing the driver's plugin config."""
+        return {}
+
+    def set_config(self, config: dict):
+        """Apply validated plugin configuration."""
+        self.plugin_config = dict(config)
+
     # -- recovery (ref plugins/drivers/proto/driver.proto:35 RecoverTask) --
     def handle_data(self, handle: TaskHandle) -> dict:
         """Serializable reattach info persisted in the client state DB."""
@@ -117,6 +128,24 @@ class MockDriver(Driver):
 
     def __init__(self):
         self._timers: dict[int, threading.Timer] = {}
+        self.plugin_config: dict = {}
+
+    def config_schema(self) -> dict:
+        """ref drivers/mock config options (subset), exercised by the
+        plugin-protocol ConfigSchema/SetConfig tests."""
+        return {
+            "fingerprint_attr": {"type": "string"},
+            "shutdown_delay_s": {"type": "number", "default": 0},
+            "fail_fingerprint": {"type": "bool", "default": False},
+        }
+
+    def fingerprint(self) -> dict:
+        if self.plugin_config.get("fail_fingerprint"):
+            return {"detected": True, "healthy": False, "attributes": {}}
+        attrs = {}
+        if self.plugin_config.get("fingerprint_attr"):
+            attrs["driver.mock.config"] = self.plugin_config["fingerprint_attr"]
+        return {"detected": True, "healthy": True, "attributes": attrs}
 
     def start_task(self, task: Task, task_dir: str) -> TaskHandle:
         cfg = task.config or {}

@@ -32,6 +32,39 @@ logger = logging.getLogger("nomad_tpu.plugins.external")
 LAUNCH_TIMEOUT = 10.0
 
 
+def validate_plugin_config(schema: dict, config: dict) -> dict:
+    """Validate a plugin config against its declared schema and fold in
+    defaults (the hclspec role, plugins/shared/hclspec). Schema entries:
+    {key: {"type": "string"|"number"|"bool", "required": bool,
+    "default": value}}. Unknown keys and type mismatches raise."""
+    types = {
+        "string": (str,),
+        "number": (int, float),
+        "bool": (bool,),
+    }
+    out = {}
+    for key in config:
+        if key not in schema:
+            raise PluginError(f"unknown plugin config key {key!r}")
+    for key, spec in (schema or {}).items():
+        spec = spec or {}
+        if key in config:
+            value = config[key]
+            expected = types.get(spec.get("type", "string"), (object,))
+            if spec.get("type") == "number" and isinstance(value, bool):
+                raise PluginError(f"plugin config {key!r} must be a number")
+            if not isinstance(value, expected):
+                raise PluginError(
+                    f"plugin config {key!r} must be {spec.get('type')}"
+                )
+            out[key] = value
+        elif "default" in spec:
+            out[key] = spec["default"]
+        elif spec.get("required"):
+            raise PluginError(f"plugin config {key!r} is required")
+    return out
+
+
 class PluginError(RuntimeError):
     pass
 
@@ -98,11 +131,19 @@ class _Conn:
 class ExternalDriver(Driver):
     """A Driver whose implementation runs in a plugin subprocess."""
 
-    def __init__(self, driver_spec: str, name: Optional[str] = None):
+    def __init__(
+        self,
+        driver_spec: str,
+        name: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
         """``driver_spec`` is 'pkg.module:factory' resolved inside the
-        plugin process (e.g. 'nomad_tpu.client.driver:MockDriver')."""
+        plugin process (e.g. 'nomad_tpu.client.driver:MockDriver').
+        ``config`` is validated against the plugin's declared schema at
+        handshake and pushed via SetConfig (base.proto)."""
         self.spec = driver_spec
         self.name = name or driver_spec.rsplit(":", 1)[-1].lower()
+        self.config = dict(config or {})
         self._proc: Optional[subprocess.Popen] = None
         self._conn: Optional[_Conn] = None
         self._lock = threading.Lock()
@@ -141,9 +182,25 @@ class ExternalDriver(Driver):
             try:
                 s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 s.connect(sock_path)
-                self._conn = _Conn(s)
-                info = self._conn.call("Plugin.Info", {})
-                self.name = info.get("name", self.name)
+                conn = _Conn(s)
+                try:
+                    info = conn.call("Plugin.Info", {})
+                    self.name = info.get("name", self.name)
+                    # base.proto handshake tail: fetch the schema, validate
+                    # our config against it, push it (every (re)launch — a
+                    # crashed plugin must come back configured)
+                    schema = conn.call("Plugin.ConfigSchema", {}) or {}
+                    config = validate_plugin_config(schema, self.config)
+                    if config or schema:
+                        conn.call("Plugin.SetConfig", {"config": config})
+                except Exception:
+                    # a half-shaken-hands plugin must not be reused: tear
+                    # down so the next attempt (and this error) are clean
+                    conn.close()
+                    self._proc.terminate()
+                    self._proc = None
+                    raise
+                self._conn = conn
                 return self._conn
             except (FileNotFoundError, ConnectionRefusedError, OSError) as e:
                 last_err = e

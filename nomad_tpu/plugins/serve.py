@@ -67,6 +67,17 @@ class _DriverService:
             "api_version": 1,
         }
 
+    def config_schema(self, payload: dict) -> dict:
+        """ref base.proto ConfigSchema (the hclspec role)."""
+        return getattr(self.driver, "config_schema", dict)() or {}
+
+    def set_config(self, payload: dict) -> dict:
+        """ref base.proto SetConfig."""
+        setter = getattr(self.driver, "set_config", None)
+        if setter is not None:
+            setter(payload.get("config") or {})
+        return {}
+
     def fingerprint(self, payload: dict) -> dict:
         return self.driver.fingerprint()
 
@@ -115,6 +126,8 @@ class _DriverService:
 
     METHODS = {
         "Plugin.Info": plugin_info,
+        "Plugin.ConfigSchema": config_schema,
+        "Plugin.SetConfig": set_config,
         "Driver.Fingerprint": fingerprint,
         "Driver.StartTask": start_task,
         "Driver.WaitTask": wait_task,

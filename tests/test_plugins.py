@@ -132,6 +132,61 @@ class TestExternalDriverProtocol:
 
 
 @pytest.mark.skipif(not isolation_ok, reason="namespace isolation unavailable")
+class TestPluginConfig:
+    def test_schema_validation(self):
+        from nomad_tpu.plugins.external import (
+            PluginError,
+            validate_plugin_config,
+        )
+
+        schema = {
+            "addr": {"type": "string", "required": True},
+            "retries": {"type": "number", "default": 3},
+            "debug": {"type": "bool", "default": False},
+        }
+        out = validate_plugin_config(schema, {"addr": "http://x"})
+        assert out == {"addr": "http://x", "retries": 3, "debug": False}
+        with pytest.raises(PluginError, match="required"):
+            validate_plugin_config(schema, {})
+        with pytest.raises(PluginError, match="unknown"):
+            validate_plugin_config(schema, {"addr": "x", "bogus": 1})
+        with pytest.raises(PluginError, match="must be number"):
+            validate_plugin_config(schema, {"addr": "x", "retries": "five"})
+        with pytest.raises(PluginError, match="must be a number"):
+            validate_plugin_config(schema, {"addr": "x", "retries": True})
+
+    def test_config_reaches_subprocess_plugin(self):
+        """SetConfig lands in the plugin process: the configured attribute
+        shows up in fingerprints across the boundary."""
+        driver = ExternalDriver(
+            "nomad_tpu.client.driver:MockDriver",
+            name="mock_driver",
+            config={"fingerprint_attr": "configured-abc"},
+        )
+        try:
+            fp = driver.fingerprint()
+            assert fp["attributes"]["driver.mock.config"] == "configured-abc"
+        finally:
+            driver.shutdown()
+
+    def test_invalid_config_fails_launch(self):
+        from nomad_tpu.plugins.external import PluginError
+
+        driver = ExternalDriver(
+            "nomad_tpu.client.driver:MockDriver",
+            name="mock_driver",
+            config={"no_such_knob": 1},
+        )
+        try:
+            # the handshake rejects the config...
+            with pytest.raises(PluginError, match="unknown"):
+                driver._ensure()
+            # ...and the driver degrades to undetected, keeping jobs off
+            assert driver.fingerprint()["detected"] is False
+        finally:
+            driver.shutdown()
+
+
 class TestExecDriver:
     def test_isolated_hostname_and_exit(self):
         driver = ExecDriver()
