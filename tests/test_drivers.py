@@ -177,8 +177,11 @@ class TestDockerDriver:
                 ;;
               wait)
                 name="$1"
-                while [ ! -f "$STATE/$name.exit" ]; do sleep 0.05; done
-                cat "$STATE/$name.exit"
+                while [ ! -f "$STATE/$name.exit" ]; do
+                  grep -q running "$STATE/$name.state" 2>/dev/null || break
+                  sleep 0.05
+                done
+                cat "$STATE/$name.exit" 2>/dev/null || echo 130
                 ;;
               stop)
                 shift; name="$2"  # after -t N
@@ -259,9 +262,12 @@ class TestDockerDriver:
         assert recovered.recovered is True
         assert recovered._container == handle._container
 
-        # a stopped container is not recoverable
+        # a stopped container is not recoverable — and stopping also ends
+        # the recovered handle's waiter (no leaked pollers)
         (state / f"{handle._container}.state").write_text("stopped")
         assert fresh.recover_task(task, data) is None
+        assert recovered.wait(5), "recovered waiter must end with the container"
+        assert handle.wait(5)
 
     def test_run_failure_raises(self, tmp_path):
         script = write_script(
