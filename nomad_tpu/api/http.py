@@ -866,6 +866,28 @@ class HTTPServer:
         """ref agent_endpoint.go AgentServersRequest"""
         return sorted(self.server.raft.voters_snapshot().values()), None
 
+    @route("PUT", r"/v1/agent/keyring/(?P<op>install|use|remove|list)", acl="agent:write")
+    def agent_keyring(self, m, query, body):
+        """Gossip keyring management (ref agent keyring API + serf
+        keyring): install/use/remove a base64 key, or list the ring."""
+        gossip = getattr(self.server, "gossip", None)
+        keyring = getattr(gossip, "keyring", None) if gossip else None
+        if keyring is None:
+            raise ValueError("gossip encryption is not enabled on this agent")
+        op = m["op"]
+        if op == "list":
+            return keyring.list_keys(), None
+        key = (body or {}).get("Key", "")
+        if not key:
+            raise ValueError("missing Key")
+        if op == "install":
+            keyring.install(key)
+        elif op == "use":
+            keyring.use(key)
+        elif op == "remove":
+            keyring.remove(key)
+        return keyring.list_keys(), None
+
     @route("GET", r"/v1/agent/health", acl="anonymous")
     def agent_health(self, m, query, body):
         """ref agent_endpoint.go HealthRequest"""

@@ -737,6 +737,29 @@ def cmd_operator_autopilot_set(args):
     return 0
 
 
+def cmd_operator_keygen(args):
+    from ..gossip.keyring import generate_key
+
+    print(generate_key())
+    return 0
+
+
+def cmd_operator_keyring(args):
+    client = _client(args)
+    if args.install:
+        out = client.put("/v1/agent/keyring/install", body={"Key": args.install})[0]
+    elif args.use:
+        out = client.put("/v1/agent/keyring/use", body={"Key": args.use})[0]
+    elif args.remove:
+        out = client.put("/v1/agent/keyring/remove", body={"Key": args.remove})[0]
+    else:
+        out = client.put("/v1/agent/keyring/list")[0]
+    print(f"Primary: {out['PrimaryKey'][:12]}…")
+    for k in out["Keys"]:
+        print(f"  {k[:12]}…")
+    return 0
+
+
 def cmd_system_gc(args):
     _client(args).system_gc()
     print("System GC triggered")
@@ -1046,6 +1069,13 @@ def build_parser() -> argparse.ArgumentParser:
     orr = opraftsub.add_parser("remove-peer")
     orr.add_argument("peer_id")
     orr.set_defaults(fn=cmd_operator_raft_remove)
+    okg = opsub.add_parser("keygen", help="generate a gossip encryption key")
+    okg.set_defaults(fn=cmd_operator_keygen)
+    okr = opsub.add_parser("keyring", help="manage the gossip keyring")
+    okr.add_argument("-install", "--install")
+    okr.add_argument("-use", "--use")
+    okr.add_argument("-remove", "--remove")
+    okr.set_defaults(fn=cmd_operator_keyring)
     opap = opsub.add_parser("autopilot")
     opapsub = opap.add_subparsers(dest="autopilot_cmd")
     oag = opapsub.add_parser("get-config")

@@ -175,6 +175,9 @@ class Server:
             reap_timeout=float(gcfg.get("reap_timeout", 3.0)),
             on_event=self._gossip_event,
             rng=random_mod.Random(seed),
+            # serf encryption: server { encrypt = "<base64>" } in agent HCL
+            encrypt_key=gcfg.get("encrypt")
+            or self.config.get("encrypt", ""),
         )
 
     def _gossip_event(self, event: str, member):

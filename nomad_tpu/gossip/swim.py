@@ -93,7 +93,15 @@ class Gossip:
         reap_timeout: float = 3.0,
         on_event: Optional[Callable[[str, Member], None]] = None,
         rng: Optional[random.Random] = None,
+        encrypt_key: str = "",
     ):
+        #: AES-GCM keyring sealing every frame (ref serf encryption);
+        #: None = plaintext gossip
+        self.keyring = None
+        if encrypt_key:
+            from .keyring import Keyring
+
+            self.keyring = Keyring(encrypt_key)
         self.name = name
         self.probe_interval = probe_interval
         self.ack_timeout = ack_timeout
@@ -208,8 +216,11 @@ class Gossip:
 
     def _send(self, addr: tuple[str, int], msg: dict):
         msg["from"] = self.name
+        data = msgpack.packb(msg, use_bin_type=True)
+        if self.keyring is not None:
+            data = self.keyring.seal(data)
         try:
-            self._sock.sendto(msgpack.packb(msg, use_bin_type=True), tuple(addr))
+            self._sock.sendto(data, tuple(addr))
         except OSError:
             pass
 
@@ -222,6 +233,10 @@ class Gossip:
                 continue
             except OSError:
                 return
+            if self.keyring is not None:
+                data = self.keyring.open(data)
+                if data is None:
+                    continue  # unauthenticated frame: drop silently
             try:
                 msg = msgpack.unpackb(data, raw=False)
             except Exception:

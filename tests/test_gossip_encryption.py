@@ -1,0 +1,163 @@
+"""Gossip encryption + keyring (ref serf encryption, `operator keygen`,
+agent keyring API)."""
+
+import time
+
+import pytest
+
+from nomad_tpu.gossip import Gossip
+from nomad_tpu.gossip.keyring import Keyring, generate_key
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestKeyring:
+    def test_seal_open_roundtrip(self):
+        ring = Keyring(generate_key())
+        frame = ring.seal(b"hello gossip")
+        assert ring.open(frame) == b"hello gossip"
+        assert frame != b"hello gossip"
+
+    def test_wrong_key_drops(self):
+        a = Keyring(generate_key())
+        b = Keyring(generate_key())
+        assert b.open(a.seal(b"x")) is None
+        assert a.open(b"short") is None
+        assert a.open(b"garbage-that-is-long-enough-to-parse") is None
+
+    def test_rotation(self):
+        old, new = generate_key(), generate_key()
+        ring = Keyring(old)
+        ring.install(new)
+        # still decrypts frames sealed under either key
+        assert ring.open(Keyring(new).seal(b"a")) == b"a"
+        assert ring.open(Keyring(old).seal(b"b")) == b"b"
+        ring.use(new)
+        with pytest.raises(ValueError):
+            ring.remove(new)  # primary is protected
+        ring.remove(old)
+        assert ring.open(Keyring(old).seal(b"c")) is None
+        assert ring.list_keys()["PrimaryKey"] == new
+
+    def test_bad_key_material(self):
+        with pytest.raises(ValueError):
+            Keyring("dG9vLXNob3J0")  # 9 bytes
+
+
+class TestEncryptedGossip:
+    def test_same_key_federates_wrong_key_does_not(self):
+        key = generate_key()
+        a = Gossip(name="enc-a", bind=("127.0.0.1", 0), encrypt_key=key,
+                   probe_interval=0.1, ack_timeout=0.3)
+        b = Gossip(name="enc-b", bind=("127.0.0.1", 0), encrypt_key=key,
+                   probe_interval=0.1, ack_timeout=0.3)
+        intruder = Gossip(
+            name="enc-x", bind=("127.0.0.1", 0), encrypt_key=generate_key(),
+            probe_interval=0.1, ack_timeout=0.3,
+        )
+        plaintext = Gossip(
+            name="enc-p", bind=("127.0.0.1", 0),
+            probe_interval=0.1, ack_timeout=0.3,
+        )
+        for g in (a, b, intruder, plaintext):
+            g.start()
+        try:
+            assert b.join(a.addr)
+            wait_until(
+                lambda: len(a.alive_members()) == 2
+                and len(b.alive_members()) == 2,
+                msg="encrypted pair federates",
+            )
+            # wrong key and plaintext joins never merge
+            assert not intruder.join(a.addr, timeout=1.0)
+            assert not plaintext.join(a.addr, timeout=1.0)
+            assert len(a.alive_members()) == 2
+        finally:
+            for g in (a, b, intruder, plaintext):
+                g.stop()
+
+    def test_keyring_rotation_live(self):
+        """Rotate the cluster key without a partition: install new on
+        both, switch primaries, drop the old key everywhere."""
+        old = generate_key()
+        a = Gossip(name="rot-a", bind=("127.0.0.1", 0), encrypt_key=old,
+                   probe_interval=0.1, ack_timeout=0.3)
+        b = Gossip(name="rot-b", bind=("127.0.0.1", 0), encrypt_key=old,
+                   probe_interval=0.1, ack_timeout=0.3)
+        a.start()
+        b.start()
+        try:
+            assert b.join(a.addr)
+            new = generate_key()
+            for g in (a, b):
+                g.keyring.install(new)
+            for g in (a, b):
+                g.keyring.use(new)
+            for g in (a, b):
+                g.keyring.remove(old)
+            # still exchanging: no suspect/dead transitions after rotation
+            time.sleep(0.8)
+            assert len(a.alive_members()) == 2
+            assert len(b.alive_members()) == 2
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestKeyringSurface:
+    def test_http_keyring_and_cli_keygen(self, capsys):
+        from nomad_tpu.api.client import ApiClient
+        from nomad_tpu.api.http import HTTPServer
+        from nomad_tpu.cli.main import main
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.raft import InmemTransport, RaftConfig
+
+        assert main(["operator", "keygen"]) == 0
+        key = capsys.readouterr().out.strip()
+        assert len(key) > 40
+
+        server = Server(
+            {
+                "seed": 3,
+                "heartbeat_ttl": 60.0,
+                "bootstrap": True,
+                "gossip": {"bind": ("127.0.0.1", 0), "encrypt": key},
+                "raft": {
+                    "node_id": "k0",
+                    "address": "kraft0",
+                    "voters": {"k0": "kraft0"},
+                    "transport": InmemTransport(),
+                    "config": RaftConfig(
+                        heartbeat_interval=0.02,
+                        election_timeout_min=0.05,
+                        election_timeout_max=0.1,
+                    ),
+                },
+            }
+        )
+        server.start(num_workers=0, wait_for_leader=5.0)
+        http = HTTPServer(server, port=0)
+        http.start()
+        api = ApiClient(address=http.address)
+        try:
+            ring = api.put("/v1/agent/keyring/list")[0]
+            assert ring["PrimaryKey"] == key
+            from nomad_tpu.gossip.keyring import generate_key as gen
+
+            new = gen()
+            api.put("/v1/agent/keyring/install", body={"Key": new})
+            api.put("/v1/agent/keyring/use", body={"Key": new})
+            api.put("/v1/agent/keyring/remove", body={"Key": key})
+            ring = api.put("/v1/agent/keyring/list")[0]
+            assert ring["PrimaryKey"] == new
+            assert key not in ring["Keys"]
+        finally:
+            http.stop()
+            server.stop()
