@@ -481,6 +481,24 @@ def cmd_eval_status(args):
     print(f"Status        = {ev['status']}")
     if ev.get("status_description"):
         print(f"Description   = {ev['status_description']}")
+    queued = {k: v for k, v in (ev.get("queued_allocations") or {}).items() if v}
+    if queued:
+        print(f"Queued        = {queued}")
+    # placement failure breakdown (ref command/monitor.go
+    # formatAllocMetrics: the signature debugging surface)
+    for tg, metric in (ev.get("failed_tg_allocs") or {}).items():
+        print(f"\nTask Group {tg!r} (failed to place"
+              + (f", {metric['coalesced_failures']} coalesced" if metric.get("coalesced_failures") else "")
+              + "):")
+        print(f"  Nodes evaluated = {metric.get('nodes_evaluated', 0)}")
+        print(f"  Nodes filtered  = {metric.get('nodes_filtered', 0)}")
+        print(f"  Nodes exhausted = {metric.get('nodes_exhausted', 0)}")
+        for constraint, n in (metric.get("constraint_filtered") or {}).items():
+            print(f"  Constraint {constraint!r} filtered {n} nodes")
+        for dim, n in (metric.get("dimension_exhausted") or {}).items():
+            print(f"  Resource {dim!r} exhausted on {n} nodes")
+        for cls, n in (metric.get("class_filtered") or {}).items():
+            print(f"  Class {cls!r} filtered {n} nodes")
     return 0
 
 
@@ -570,10 +588,35 @@ def cmd_job_periodic_force(args):
 def cmd_job_history(args):
     client = _client(args)
     versions = client.job_versions(args.job_id)
+    by_version = {v["version"]: v for v in versions}
     for v in versions:
         print(f"Version     = {v['version']}")
         print(f"Stable      = {v['stable']}")
         print(f"Submit Date = {v.get('submit_time', 0)}")
+        if getattr(args, "diffs", False) and (v["version"] - 1) in by_version:
+            # ref command/job_history.go -p: structural diff vs previous
+            from ..structs.diff import job_diff
+            from ..structs.model import Job
+
+            prev = Job.from_dict(by_version[v["version"] - 1])
+            cur = Job.from_dict(v)
+            diff = job_diff(prev, cur)
+            if diff and diff.get("Type") != "None":
+                print("Diff        =")
+                for fd in diff.get("Fields", []):
+                    _render_field_diff(fd, "  ")
+                for tg in diff.get("TaskGroups", []):
+                    if tg["Type"] == "None":
+                        continue
+                    print(f"  ~ Task Group {tg['Name']!r}")
+                    for fd in tg.get("Fields", []):
+                        _render_field_diff(fd, "    ")
+                    for td in tg.get("Tasks", []):
+                        if td["Type"] == "None":
+                            continue
+                        print(f"    ~ Task {td['Name']!r}")
+                        for fd in td.get("Fields", []):
+                            _render_field_diff(fd, "      ")
         print()
     return 0
 
@@ -912,6 +955,8 @@ def build_parser() -> argparse.ArgumentParser:
     jrv.add_argument("version", type=int)
     jrv.set_defaults(fn=cmd_job_revert)
     jh = jsub.add_parser("history")
+    jh.add_argument("-p", "--diffs", action="store_true", dest="diffs",
+                    help="show structural diffs between versions")
     jh.add_argument("job_id")
     jh.set_defaults(fn=cmd_job_history)
     jd = jsub.add_parser("deployments")
