@@ -527,6 +527,26 @@ class ExecDriver(RawExecDriver):
         if not command:
             raise RuntimeError("exec requires a command")
         args = [self._nsexec, "--workdir", task_dir or "/"]
+        if cfg.get("chroot") and task_dir:
+            # filesystem isolation (ref exec's default chroot env +
+            # alloc-dir bind): the task dir becomes "/", system paths are
+            # read-only binds, the shared alloc dir mounts at /alloc —
+            # NOMAD_* dir vars are re-rooted to the in-chroot paths
+            import os as os_mod
+
+            alloc_shared = os_mod.path.join(
+                os_mod.path.dirname(task_dir), "alloc"
+            )
+            os_mod.makedirs(alloc_shared, exist_ok=True)
+            args += ["--chroot", task_dir, "--bind", f"{alloc_shared}:/alloc"]
+            task = task.copy()
+            task.env = {
+                **task.env,
+                "NOMAD_TASK_DIR": "/local",
+                "NOMAD_ALLOC_DIR": "/alloc",
+                "NOMAD_SECRETS_DIR": "/secrets",
+            }
+            cfg = task.config or {}
         # resource enforcement via the shepherd's cgroup (the executor's
         # resource-container role): best-effort, keyed uniquely per start
         if cfg.get("enforce_resources", True):

@@ -10,8 +10,13 @@
 //
 // usage:
 //   nsexec --check                     exit 0 iff isolation is available
-//   nsexec [--workdir D] [--hostname H] [--cgroup NAME]
+//   nsexec [--workdir D] [--hostname H] [--cgroup NAME] [--chroot D]
 //          [--memory-mb N] [--cpu-shares N] -- cmd [args...]
+//
+// --chroot pivots the task into D after read-only bind-mounting the
+// default chroot env (/bin /usr /lib ... — the reference's
+// config.DefaultChrootEnv, drivers/shared/executor): the task then sees
+// only its own task dir plus immutable system paths.
 //
 // --cgroup enables best-effort resource limits (the executor's
 // resource-container role, drivers/shared/executor resourceContainer):
@@ -152,6 +157,107 @@ static void install_forwarders(void) {
   signal(SIGQUIT, forward_signal);
 }
 
+// default chroot env (ref client/allocdir config.DefaultChrootEnv):
+// host path → same path inside the chroot, read-only
+static const char *CHROOT_PATHS[] = {
+    "/bin", "/usr", "/lib", "/lib32", "/lib64", "/sbin",
+    "/etc/ld.so.cache", "/etc/ld.so.conf", "/etc/ld.so.conf.d",
+    "/etc/passwd", "/etc/group", "/etc/resolv.conf", "/etc/ssl",
+    "/etc/alternatives", NULL,
+};
+
+static int mkdirs(char *path) {
+  // mkdir -p; mutates path temporarily
+  for (char *p = path + 1; *p; p++) {
+    if (*p == '/') {
+      *p = '\0';
+      if (mkdir(path, 0755) != 0 && errno != EEXIST) { *p = '/'; return -1; }
+      *p = '/';
+    }
+  }
+  if (mkdir(path, 0755) != 0 && errno != EEXIST) return -1;
+  return 0;
+}
+
+static int bind_readonly(const char *src, const char *dst, int is_dir) {
+  if (is_dir) {
+    char tmp[1024];
+    snprintf(tmp, sizeof tmp, "%s", dst);
+    if (mkdirs(tmp) != 0) return -1;
+  } else {
+    // bind target for a file must be an existing file
+    char tmp[1024];
+    snprintf(tmp, sizeof tmp, "%s", dst);
+    char *slash = strrchr(tmp, '/');
+    if (slash) { *slash = '\0'; if (mkdirs(tmp) != 0) return -1; *slash = '/'; }
+    int fd = open(dst, O_WRONLY | O_CREAT, 0644);
+    if (fd < 0) return -1;
+    close(fd);
+  }
+  if (mount(src, dst, NULL, MS_BIND | MS_REC, NULL) != 0) return -1;
+  // bind mounts need a remount to actually apply MS_RDONLY
+  mount(NULL, dst, NULL, MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID, NULL);
+  return 0;
+}
+
+// writable binds into the chroot (the alloc shared dir's mount: the
+// reference bind-mounts alloc/ into every task container at /alloc)
+#define MAX_BINDS 16
+static const char *bind_src[MAX_BINDS];
+static const char *bind_dst[MAX_BINDS];
+static int n_binds = 0;
+
+static int setup_chroot(const char *root) {
+  char dst[1024];
+  struct stat st;
+  for (int i = 0; CHROOT_PATHS[i] != NULL; i++) {
+    const char *src = CHROOT_PATHS[i];
+    if (stat(src, &st) != 0) continue;  // absent on this host: skip
+    snprintf(dst, sizeof dst, "%s%s", root, src);
+    if (bind_readonly(src, dst, S_ISDIR(st.st_mode)) != 0)
+      fprintf(stderr, "nsexec: warning: chroot bind %s: %s\n", src,
+              strerror(errno));
+  }
+  // private scratch + dev essentials inside the root
+  snprintf(dst, sizeof dst, "%s/tmp", root);
+  mkdir(dst, 01777);
+  snprintf(dst, sizeof dst, "%s/dev", root);
+  mkdir(dst, 0755);
+  const char *devs[] = {"null", "zero", "urandom", "random", NULL};
+  for (int i = 0; devs[i] != NULL; i++) {
+    char src[64];
+    snprintf(src, sizeof src, "/dev/%s", devs[i]);
+    snprintf(dst, sizeof dst, "%s/dev/%s", root, devs[i]);
+    if (stat(src, &st) == 0) {
+      int fd = open(dst, O_WRONLY | O_CREAT, 0666);
+      if (fd >= 0) close(fd);
+      if (mount(src, dst, NULL, MS_BIND, NULL) != 0)
+        fprintf(stderr, "nsexec: warning: bind %s: %s\n", src, strerror(errno));
+    }
+  }
+  // writable binds (alloc shared dir etc.)
+  for (int i = 0; i < n_binds; i++) {
+    snprintf(dst, sizeof dst, "%s%s", root, bind_dst[i]);
+    char tmp[1024];
+    snprintf(tmp, sizeof tmp, "%s", dst);
+    if (mkdirs(tmp) != 0 ||
+        mount(bind_src[i], dst, NULL, MS_BIND | MS_REC, NULL) != 0)
+      fprintf(stderr, "nsexec: warning: bind %s -> %s: %s\n", bind_src[i],
+              bind_dst[i], strerror(errno));
+  }
+  // the namespace-local /proc must live INSIDE the new root
+  snprintf(dst, sizeof dst, "%s/proc", root);
+  mkdir(dst, 0555);
+  if (mount("proc", dst, "proc", MS_NOSUID | MS_NODEV | MS_NOEXEC, NULL) != 0)
+    fprintf(stderr, "nsexec: warning: chroot /proc: %s\n", strerror(errno));
+  if (chroot(root) != 0) {
+    fprintf(stderr, "nsexec: chroot %s: %s\n", root, strerror(errno));
+    return -1;
+  }
+  if (chdir("/") != 0) return -1;
+  return 0;
+}
+
 static int ns_flags() {
   return CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWIPC | CLONE_NEWUTS;
 }
@@ -173,6 +279,7 @@ int main(int argc, char **argv) {
   const char *workdir = NULL;
   const char *hostname = "nomad-task";
   const char *cgroup = NULL;
+  const char *chroot_dir = NULL;
   long memory_mb = 0;
   long cpu_shares = 0;
   int i = 1;
@@ -185,6 +292,20 @@ int main(int argc, char **argv) {
       hostname = argv[++i];
     } else if (strcmp(argv[i], "--cgroup") == 0 && i + 1 < argc) {
       cgroup = argv[++i];
+    } else if (strcmp(argv[i], "--chroot") == 0 && i + 1 < argc) {
+      chroot_dir = argv[++i];
+    } else if (strcmp(argv[i], "--bind") == 0 && i + 1 < argc) {
+      // SRC:DST with DST relative to the chroot root
+      char *spec = argv[++i];
+      char *colon = strrchr(spec, ':');
+      if (colon == NULL || n_binds >= MAX_BINDS) {
+        fprintf(stderr, "nsexec: bad --bind %s\n", spec);
+        return SHEPHERD_ERR;
+      }
+      *colon = '\0';
+      bind_src[n_binds] = spec;
+      bind_dst[n_binds] = colon + 1;
+      n_binds++;
     } else if (strcmp(argv[i], "--memory-mb") == 0 && i + 1 < argc) {
       memory_mb = atol(argv[++i]);
     } else if (strcmp(argv[i], "--cpu-shares") == 0 && i + 1 < argc) {
@@ -233,7 +354,12 @@ int main(int argc, char **argv) {
     fprintf(stderr, "nsexec: private mounts: %s\n", strerror(errno));
     _exit(SHEPHERD_ERR);
   }
-  if (mount("proc", "/proc", "proc", MS_NOSUID | MS_NODEV | MS_NOEXEC, NULL) != 0) {
+  if (chroot_dir != NULL) {
+    if (setup_chroot(chroot_dir) != 0) _exit(SHEPHERD_ERR);
+    // the task dir is now "/"; a --workdir under it is re-rooted
+    workdir = "/";
+  } else if (mount("proc", "/proc", "proc",
+                   MS_NOSUID | MS_NODEV | MS_NOEXEC, NULL) != 0) {
     // non-fatal: /proc may be read-only in constrained sandboxes
     fprintf(stderr, "nsexec: warning: mount /proc: %s\n", strerror(errno));
   }

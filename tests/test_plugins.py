@@ -188,6 +188,46 @@ class TestPluginConfig:
 
 
 class TestExecDriver:
+    def test_chroot_filesystem_isolation(self, tmp_path):
+        """chroot mode: the task sees only its task dir (as /) plus
+        read-only system binds and the alloc dir at /alloc; host paths
+        like /root are invisible (ref exec's DefaultChrootEnv)."""
+        from nomad_tpu.client.driver import ExecDriver
+        from nomad_tpu.structs.model import Task
+
+        driver = ExecDriver()
+        if not driver._healthy:
+            pytest.skip("namespace isolation unavailable")
+        task_dir = tmp_path / "alloc1" / "web"
+        (task_dir / "local").mkdir(parents=True)
+        task = Task(
+            name="web",
+            driver="exec",
+            config={
+                "chroot": True,
+                "enforce_resources": False,
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'pwd > /local/cwd.txt; '
+                    'ls /root > /local/escape.txt 2>&1; '
+                    'echo shared > "$NOMAD_ALLOC_DIR/from-chroot"; '
+                    "exit 0",
+                ],
+            },
+            env={},
+        )
+        task.resources.networks = []
+        handle = driver.start_task(task, str(task_dir))
+        assert handle.wait(20)
+        assert handle.exit_code == 0
+        assert (task_dir / "local" / "cwd.txt").read_text().strip() == "/"
+        assert "No such file" in (task_dir / "local" / "escape.txt").read_text()
+        # the alloc-dir bind surfaces writes on the host side
+        assert (
+            tmp_path / "alloc1" / "alloc" / "from-chroot"
+        ).read_text().strip() == "shared"
+
     def test_isolated_hostname_and_exit(self):
         driver = ExecDriver()
         fp = driver.fingerprint()
