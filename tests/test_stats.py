@@ -38,16 +38,13 @@ class TestCollectors:
         sum(i * i for i in range(200_000))
         second = c.collect()
         cpu = second["cpu"]
-        assert 0.0 <= cpu["total_percent"] <= 100.0
-        assert (
-            abs(
-                cpu["user_percent"]
-                + cpu["system_percent"]
-                + cpu["idle_percent"]
-                - 100.0
-            )
-            < 15.0  # delta rounding + unaccounted states (steal, irq)
-        )
+        # each component is a valid percentage; their sum is NOT asserted
+        # against 100 because irq/steal/guest time is intentionally
+        # unaccounted and can be large on a loaded/virtualized box
+        for key in ("total_percent", "user_percent", "system_percent", "idle_percent"):
+            assert 0.0 <= cpu[key] <= 100.0, (key, cpu)
+        # busy + idle partition the total by construction
+        assert abs(cpu["total_percent"] + cpu["idle_percent"] - 100.0) < 1.0
 
     def test_disk_stats_used_percent(self):
         d = disk_stats("/tmp")
