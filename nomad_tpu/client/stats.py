@@ -102,6 +102,12 @@ class HostStatsCollector:
         cur = _read_proc_stat()
         cpu = {"total_percent": 0.0, "user_percent": 0.0, "system_percent": 0.0, "idle_percent": 0.0}
         if cur is not None and self._prev is not None:
+            # iowait (folded into idle) is documented non-monotonic in
+            # proc(5): clamp each delta so a decreasing counter can't push
+            # a percentage below 0 / above 100
+            cur = {
+                k: max(v, self._prev[k]) for k, v in cur.items()
+            }
             d_total = cur["total"] - self._prev["total"]
             if d_total > 0:
                 cpu = {
