@@ -1,0 +1,120 @@
+"""Preemption through the full server loop (ref scheduler/preemption.go +
+plan_apply preemption commit + the preemption follow-up eval). Faithful to
+the 0.10 OSS reference, only the SYSTEM scheduler preempts (service/batch
+preemption was enterprise-gated; stack.go:231 gates on
+SystemSchedulerEnabled): a high-priority system job evicts a low-priority
+service alloc on a full node, the client stops the victim, and the
+preemption eval re-queues the victim's job."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import DevAgent
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestPreemptionE2E:
+    def test_high_priority_evicts_and_victim_requeues(self):
+        agent = DevAgent(num_clients=1, server_config={"seed": 131})
+        # pin the operator preemption config explicitly (system preemption
+        # is the one the OSS scheduler honors, stack.go:231)
+        agent.start()
+        try:
+            agent.server._apply(
+                __import__(
+                    "nomad_tpu.core.fsm", fromlist=["fsm"]
+                ).SCHEDULER_CONFIG,
+                {
+                    "config": {
+                        "preemption_config": {
+                            "service_scheduler_enabled": True,
+                            "batch_scheduler_enabled": True,
+                            "system_scheduler_enabled": True,
+                        }
+                    }
+                },
+            )
+            node = agent.clients[0].node
+            total_cpu = node.node_resources.cpu.cpu_shares
+            reserved = (
+                node.reserved_resources.cpu.cpu_shares
+                if node.reserved_resources
+                else 0
+            )
+            usable = total_cpu - reserved
+
+            low = mock.job()
+            low.id = "low-prio"
+            low.priority = 10
+            tg = low.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].config = {"run_for": "600s"}
+            tg.tasks[0].resources.cpu = int(usable * 0.7)
+            tg.tasks[0].resources.networks = []
+            agent.server.job_register(low)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in agent.server.state.allocs_by_job(
+                        low.namespace, low.id
+                    )
+                ),
+                msg="low-priority alloc running",
+            )
+            (victim,) = agent.server.state.allocs_by_job(low.namespace, low.id)
+
+            high = mock.system_job()
+            high.id = "high-prio"
+            high.priority = 90
+            htg = high.task_groups[0]
+            htg.tasks[0].driver = "mock_driver"
+            htg.tasks[0].config = {"run_for": "600s"}
+            htg.tasks[0].resources.cpu = int(usable * 0.7)
+            htg.tasks[0].resources.networks = []
+            agent.server.job_register(high)
+
+            # the high-priority alloc places by preempting the victim
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in agent.server.state.allocs_by_job(
+                        high.namespace, high.id
+                    )
+                ),
+                msg="high-priority alloc running",
+            )
+            wait_until(
+                lambda: agent.server.state.alloc_by_id(victim.id)
+                .desired_status
+                == "evict",
+                msg="victim marked evicted",
+            )
+            evicted = agent.server.state.alloc_by_id(victim.id)
+            assert evicted.preempted_by_allocation, "victim records preemptor"
+            wait_until(
+                lambda: agent.server.state.alloc_by_id(victim.id)
+                .client_status
+                in ("complete", "failed"),
+                msg="client stopped the victim",
+            )
+
+            # the preemption follow-up eval exists for the victim's job
+            evals = [
+                e
+                for e in agent.server.state.evals()
+                if e.job_id == low.id and e.triggered_by == "preemption"
+            ]
+            assert evals, "preemption follow-up eval created"
+        finally:
+            agent.stop()
