@@ -276,8 +276,21 @@ class TaskRunner:
     def stop(self):
         self._stop.set()
         if self.handle is not None:
+            # shutdown_delay: hold the kill so service deregistration can
+            # propagate (ref task_runner kill path + shutdown_delay docs);
+            # capped so a misconfigured job can't wedge alloc teardown
+            delay = min(self.task.shutdown_delay / 1e9, 30.0)
+            if delay > 0 and not self.handle._done.is_set():
+                self._event(
+                    "Waiting", f"Shutdown delay of {delay:g}s before kill"
+                )
+                self.handle.wait(delay)
             self._event("Killing", "Task being killed")
-            self.driver.stop_task(self.handle)
+            self.driver.stop_task(
+                self.handle,
+                timeout=max(self.task.kill_timeout / 1e9, 0.1),
+                signal_name=self.task.kill_signal,
+            )
 
     def restart(self):
         """User-initiated restart (ref client_alloc_endpoint.go Restart →
@@ -291,7 +304,11 @@ class TaskRunner:
             raise ValueError(f"task {self.task.name!r} is not running")
         self._restarting = True
         self._event("Restart Signaled", "User requested task restart")
-        self.driver.stop_task(self.handle)
+        self.driver.stop_task(
+            self.handle,
+            timeout=max(self.task.kill_timeout / 1e9, 0.1),
+            signal_name=self.task.kill_signal,
+        )
 
     def signal(self, signal_name: str):
         """Deliver a signal to the running task (ref SignalTask RPC)."""
@@ -556,6 +573,10 @@ class Client:
         self.device_manager = DeviceManager(device_plugins)
         # durable local state: alloc docs, task states, driver handles and
         # the node identity (ref client/state/state_database.go:107)
+        #: terminal alloc dirs retained for log/fs access, reclaimed FIFO
+        #: beyond gc_max_allocs (ref client config gc_max_allocs=50)
+        self.gc_max_allocs = 50
+        self._terminal_alloc_dirs: list[str] = []
         self.state_db = None
         if persist:
             from .state import ClientStateDB
@@ -863,7 +884,7 @@ class Client:
             if alloc_id not in desired:
                 runner.destroy()
                 del self.alloc_runners[alloc_id]
-                self._forget_alloc(alloc_id)
+                self._forget_alloc(alloc_id, reclaim=True)
             elif runner._destroyed and runner.client_status() in (
                 "complete",
                 "failed",
@@ -880,14 +901,25 @@ class Client:
         except Exception:
             logger.exception("persisting alloc failed")
 
-    def _forget_alloc(self, alloc_id: str):
+    def _forget_alloc(self, alloc_id: str, reclaim: bool = False):
+        """Drop a runner's durable state. Alloc-dir GC (ref client/gc.go
+        AllocGarbageCollector): with ``reclaim`` (the alloc vanished
+        server-side — purge/GC) the directory goes immediately; otherwise
+        terminal dirs are RETAINED until gc_max_allocs is exceeded, so
+        `alloc logs`/`alloc fs` keep working on recently stopped allocs."""
         if self.state_db is not None:
             try:
                 self.state_db.delete_alloc(alloc_id)
             except Exception:
                 logger.exception("deleting alloc state failed")
-        # alloc-dir GC (ref client/gc.go AllocGarbageCollector): a forgotten
-        # alloc's directory tree is reclaimed, or the data dir grows forever
+        if reclaim:
+            self._reclaim_alloc_dir(alloc_id)
+            return
+        self._terminal_alloc_dirs.append(alloc_id)
+        while len(self._terminal_alloc_dirs) > self.gc_max_allocs:
+            self._reclaim_alloc_dir(self._terminal_alloc_dirs.pop(0))
+
+    def _reclaim_alloc_dir(self, alloc_id: str):
         import shutil
 
         d = os.path.join(self.data_dir, "allocs", alloc_id)

@@ -74,7 +74,11 @@ class Driver:
     def start_task(self, task: Task, task_dir: str) -> TaskHandle:
         raise NotImplementedError
 
-    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0,
+                  signal_name: str = ""):
+        """Gracefully stop: deliver ``signal_name`` (or the platform
+        default), escalate to a hard kill after ``timeout`` (ref
+        driver.proto StopTask's kill_timeout + the task kill_signal)."""
         raise NotImplementedError
 
     def destroy_task(self, handle: TaskHandle):
@@ -177,10 +181,13 @@ class MockDriver(Driver):
             t.start()
         return handle
 
-    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0,
+                  signal_name: str = ""):
         t = self._timers.pop(id(handle), None)
         if t is not None:
             t.cancel()
+        if signal_name:
+            handle.stop_signal = signal_name
         if not handle._done.is_set():
             handle.finish(130, "killed")
 
@@ -343,25 +350,36 @@ class RawExecDriver(Driver):
         args = [command] + list(cfg.get("args", []))
         return self._spawn(task, args, task_dir or None)
 
-    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0,
+                  signal_name: str = ""):
+        import signal as signal_mod
+
+        sig = signal_mod.SIGTERM
+        if signal_name:
+            name = str(signal_name).upper()
+            if not name.startswith("SIG"):
+                name = "SIG" + name
+            resolved = getattr(signal_mod, name, None)
+            if isinstance(resolved, signal_mod.Signals):
+                sig = resolved
         proc = handle.proc
         if proc is not None:
             if proc.poll() is not None:
                 return
-            proc.terminate()
+            proc.send_signal(sig)
             try:
                 proc.wait(timeout)
             except subprocess.TimeoutExpired:
                 proc.kill()
             return
         # recovered handle: not our child; signal by pid with the same
-        # term → wait → kill escalation the child path gets
+        # graceful → wait → kill escalation the child path gets
         if handle.pid and not handle._done.is_set():
             import os
             import signal
 
             try:
-                os.kill(handle.pid, signal.SIGTERM)
+                os.kill(handle.pid, sig)
             except ProcessLookupError:
                 return
             deadline = time.monotonic() + timeout

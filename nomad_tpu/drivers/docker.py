@@ -174,11 +174,21 @@ class DockerDriver(Driver):
         threading.Thread(target=waiter, daemon=True).start()
 
     # ------------------------------------------------------------------
-    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0,
+                  signal_name: str = ""):
         container = getattr(handle, "_container", None)
         if container is None or handle._done.is_set():
             return
         try:
+            if signal_name:
+                # custom kill_signal first; docker stop's escalation
+                # window then delivers SIGKILL if the task lingers
+                name = str(signal_name).upper()
+                if not name.startswith("SIG"):
+                    name = "SIG" + name
+                self._run("kill", "--signal", name, container, timeout=30)
+                if handle.wait(timeout):
+                    return
             self._run(
                 "stop", "-t", str(int(timeout)), container,
                 timeout=timeout + 30,

@@ -150,6 +150,77 @@ class TestLocalRestartSignal:
         assert err.value.status == 400
 
 
+class TestGracefulKill:
+    def test_kill_signal_reaches_task_before_escalation(self, dev):
+        """kill_signal delivers the configured signal; the task traps it,
+        cleans up, and exits inside kill_timeout (ref task kill_signal/
+        kill_timeout semantics)."""
+        agent, client = dev
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.kill_signal = "SIGUSR1"
+        task.kill_timeout = int(5 * 1e9)
+        task.config = {
+            "command": "/bin/sh",
+            "args": [
+                "-c",
+                'trap "echo graceful > cleanup.txt; exit 0" USR1; '
+                "while true; do sleep 0.1; done",
+            ],
+        }
+        task.resources.networks = []
+        agent.server.job_register(job)
+        wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+            ),
+            msg="task running",
+        )
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        runner = agent.clients[0].alloc_runners[alloc.id]
+        import os
+
+        cleanup = os.path.join(runner.task_dir("web"), "cleanup.txt")
+        client.alloc_stop(alloc.id)
+        wait_until(
+            lambda: os.path.exists(cleanup),
+            msg="task trapped the configured kill signal",
+        )
+
+    def test_shutdown_delay_waits_before_kill(self, dev):
+        agent, _ = dev
+        job = mock.job()
+        job.id = "delay-job"
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "mock_driver"
+        task.shutdown_delay = int(0.5 * 1e9)
+        task.config = {"run_for": "60s"}
+        task.resources.networks = []
+        agent.server.job_register(job)
+        wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in agent.server.state.allocs_by_job(job.namespace, job.id)
+            ),
+            msg="task running",
+        )
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        runner = agent.clients[0].alloc_runners[alloc.id]
+        tr = runner.task_runners["web"]
+        t0 = time.monotonic()
+        tr.stop()
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.5, "kill must wait out the shutdown delay"
+        events = [e["type"] for e in tr.state.events]
+        assert "Waiting" in events
+
+
 class TestAllocStop:
     def test_stop_reschedules_elsewhere(self, dev):
         agent, client = dev
