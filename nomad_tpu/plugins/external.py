@@ -268,14 +268,19 @@ class ExternalDriver(Driver):
         )
         return self._local_handle(desc, task)
 
-    def stop_task(self, handle: TaskHandle, timeout: float = 5.0):
+    def stop_task(self, handle: TaskHandle, timeout: float = 5.0,
+                  signal_name: str = ""):
         conn = self._conn
         if conn is None or not hasattr(handle, "_plugin_id"):
             return
         try:
             conn.call(
                 "Driver.StopTask",
-                {"handle_id": handle._plugin_id, "timeout": timeout},
+                {
+                    "handle_id": handle._plugin_id,
+                    "timeout": timeout,
+                    "signal": signal_name,
+                },
                 timeout=timeout + 10.0,
             )
         except PluginError as e:

@@ -98,7 +98,11 @@ class _DriverService:
 
     def stop_task(self, payload: dict) -> dict:
         handle = self._handle(payload["handle_id"])
-        self.driver.stop_task(handle, timeout=payload.get("timeout", 5.0))
+        self.driver.stop_task(
+            handle,
+            timeout=payload.get("timeout", 5.0),
+            signal_name=payload.get("signal", ""),
+        )
         return {}
 
     def destroy_task(self, payload: dict) -> dict:
