@@ -242,12 +242,24 @@ class TestReconcileSummaries:
             server.state.latest_index() + 1, bogus
         )
         client.reconcile_summaries()
-        fixed = server.state.job_summary_by_id(job.namespace, job.id)
-        tg = fixed.summary[job.task_groups[0].name]
-        assert tg.failed == 0
-        assert tg.running + tg.starting == len(
-            [a for a in allocs if not a.terminal_status()]
-        )
+
+        def summary_consistent():
+            # compare against a fresh snapshot: allocs keep transitioning
+            # (starting→running) while we assert
+            snap = server.state.snapshot()
+            fixed = snap.job_summary_by_id(job.namespace, job.id)
+            tg = fixed.summary[job.task_groups[0].name]
+            live = [
+                a
+                for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            ]
+            return tg.failed == 0 and tg.running + tg.starting == len(live)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not summary_consistent():
+            time.sleep(0.05)
+        assert summary_consistent()
 
     def test_eval_allocations_route(self, http_cluster):
         agent, _, client = http_cluster
