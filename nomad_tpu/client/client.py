@@ -940,6 +940,7 @@ class Client:
         stats = self._stats_collector.collect()
         stats["node_id"] = self.node.id
         stats["allocs_running"] = len(self.alloc_runners)
+        stats["devices"] = self.device_manager.stats()
         return stats
 
     def alloc_stats(self, alloc_id: str) -> dict:

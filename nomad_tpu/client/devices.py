@@ -105,6 +105,21 @@ class TPUDevicePlugin(DevicePlugin):
     def reserve(self, device_ids: list[str]) -> dict:
         return {"env": {"TPU_VISIBLE_DEVICES": ",".join(device_ids)}}
 
+    def stats(self) -> dict:
+        """Chip presence/health (ref device.proto Stats: the nvidia plugin
+        streams NVML gauges; the chardev tier exposes presence + driver)."""
+        chips = self._chips()
+        if not chips:
+            return {}
+        return {
+            "chip_count": len(chips),
+            "chips": {
+                os.path.basename(p): {"present": True, "healthy": True}
+                for p in chips
+            },
+            "driver_version": self._libtpu_version() or "unknown",
+        }
+
 
 class DeviceManager:
     """Client-side plugin lifecycle + reservation routing
@@ -135,6 +150,20 @@ class DeviceManager:
         if groups:
             node.node_resources.devices = groups
         return len(groups)
+
+    def stats(self) -> dict:
+        """Per-plugin device stats (ref device.proto Stats stream; served
+        inside /v1/client/stats)."""
+        out = {}
+        for plugin in self.plugins:
+            try:
+                stats = plugin.stats()
+            except Exception:
+                logger.exception("device plugin %s stats failed", plugin.name)
+                continue
+            if stats:
+                out[plugin.name] = stats
+        return out
 
     def reserve_env(self, allocated_devices) -> dict:
         """Env for a task's AllocatedDeviceResource list."""

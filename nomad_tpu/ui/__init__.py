@@ -132,8 +132,21 @@ const routes = {
   },
   async allocation(id) {
     const a = await api('/v1/allocation/' + id);
+    const tasks = Object.keys(a.task_states || {});
+    let logsHtml = '';
+    for (const t of tasks) {
+      for (const kind of ['stdout', 'stderr']) {
+        try {
+          const l = await api(`/v1/client/fs/logs/${a.id}?task=${encodeURIComponent(t)}&type=${kind}&origin=end&offset=8192`);
+          if (l.Data) {
+            logsHtml += `<h3>${esc(t)} · ${kind} (tail)</h3><pre>${esc(l.Data)}</pre>`;
+          }
+        } catch {}
+      }
+    }
     return `<div class="crumb"><a href="#/allocations">allocations</a> / ${esc(a.id.slice(0,8))}</div>` +
-      `<pre>${esc(JSON.stringify(a, null, 2))}</pre>`;
+      logsHtml +
+      `<h3>Allocation</h3><pre>${esc(JSON.stringify(a, null, 2))}</pre>`;
   },
   async evaluations() {
     const evals = await api('/v1/evaluations');
