@@ -80,6 +80,9 @@ def server_config_from_agent(config: dict) -> dict:
     if config.get("gossip"):
         out["gossip"] = dict(config["gossip"])
         out["bootstrap"] = bool(server.get("bootstrap_expect", 1) <= 1)
+    # serf encryption: reference agents put `encrypt` in the server stanza
+    if server.get("encrypt"):
+        out["encrypt"] = server["encrypt"]
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",
