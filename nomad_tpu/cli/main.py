@@ -1172,6 +1172,14 @@ def main(argv=None) -> int:
         return 1
     try:
         return fn(args)
+    except BrokenPipeError:
+        # `nomad ... | head` closed our stdout: normal unix behavior,
+        # not an error worth reporting
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
     except APIError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
