@@ -147,6 +147,8 @@ class ServerAgent:
         cfg = dict(self.config)
         cfg["name"] = self.name
         cfg["raft"] = raft_cfg
+        if self.data_dir:
+            cfg.setdefault("data_dir", self.data_dir)
         self.server = Server(cfg)
         # the HTTP agent's client-fs forwarding pool must dial client RPC
         # listeners with the same mTLS identity

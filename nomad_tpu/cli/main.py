@@ -272,6 +272,27 @@ def cmd_job_status(args):
                   f"{s['failed']:<7} {s['complete']:<9} {s['lost']}")
     except APIError:
         pass
+    # placement failures from the newest eval (ref job_status.go's
+    # "Placement Failure" section via the monitor's metric formatter)
+    try:
+        evals = client.job_evaluations(args.job_id)
+        newest_failed = next(
+            (
+                e
+                for e in sorted(
+                    evals,
+                    key=lambda e: e.get("modify_index", 0),
+                    reverse=True,
+                )
+                if e.get("failed_tg_allocs")
+            ),
+            None,
+        )
+        if newest_failed is not None:
+            print("\nPlacement Failure")
+            _render_alloc_metrics(newest_failed["failed_tg_allocs"])
+    except APIError:
+        pass
     allocs = client.job_allocations(args.job_id)
     if allocs:
         print("\nAllocations")
@@ -484,9 +505,14 @@ def cmd_eval_status(args):
     queued = {k: v for k, v in (ev.get("queued_allocations") or {}).items() if v}
     if queued:
         print(f"Queued        = {queued}")
-    # placement failure breakdown (ref command/monitor.go
-    # formatAllocMetrics: the signature debugging surface)
-    for tg, metric in (ev.get("failed_tg_allocs") or {}).items():
+    _render_alloc_metrics(ev.get("failed_tg_allocs") or {})
+    return 0
+
+
+def _render_alloc_metrics(failed_tg_allocs: dict):
+    """Placement failure breakdown (ref command/monitor.go
+    formatAllocMetrics: the signature debugging surface)."""
+    for tg, metric in failed_tg_allocs.items():
         print(f"\nTask Group {tg!r} (failed to place"
               + (f", {metric['coalesced_failures']} coalesced" if metric.get("coalesced_failures") else "")
               + "):")
@@ -499,7 +525,6 @@ def cmd_eval_status(args):
             print(f"  Resource {dim!r} exhausted on {n} nodes")
         for cls, n in (metric.get("class_filtered") or {}).items():
             print(f"  Class {cls!r} filtered {n} nodes")
-    return 0
 
 
 def cmd_deployment_list(args):

@@ -15,6 +15,7 @@ server bootstraps itself as leader in milliseconds (the reference's
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -178,6 +179,13 @@ class Server:
             # serf encryption: server { encrypt = "<base64>" } in agent HCL
             encrypt_key=gcfg.get("encrypt")
             or self.config.get("encrypt", ""),
+            # runtime-installed keys survive restarts when a data dir
+            # exists (serf's keyring file)
+            keyring_path=(
+                os.path.join(self.config["data_dir"], "keyring.json")
+                if self.config.get("data_dir")
+                else ""
+            ),
         )
 
     def _gossip_event(self, event: str, member):

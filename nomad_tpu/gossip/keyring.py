@@ -30,31 +30,75 @@ def _decode(key: str) -> bytes:
 
 
 class Keyring:
-    """Primary + installed keys with serf's use/install/remove semantics."""
+    """Primary + installed keys with serf's use/install/remove semantics.
+    With ``path`` the ring persists as JSON (serf's keyring file role), so
+    keys installed at runtime survive agent restarts."""
 
-    def __init__(self, primary: str):
+    def __init__(self, primary: str, path: str = ""):
         raw = _decode(primary)
         self._lock = threading.Lock()
         self._keys: dict[str, bytes] = {primary: raw}
         self._primary = primary
+        self._path = path
+        if path:
+            self._load()
+
+    def _load(self):
+        import json
+
+        try:
+            with open(self._path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        with self._lock:
+            for key in doc.get("keys", []):
+                try:
+                    self._keys[key] = _decode(key)
+                except Exception:
+                    continue
+            primary = doc.get("primary")
+            if primary in self._keys:
+                self._primary = primary
+
+    def _persist_locked(self):
+        if not self._path:
+            return
+        import json
+        import tempfile
+
+        doc = {"primary": self._primary, "keys": list(self._keys)}
+        d = os.path.dirname(self._path) or "."
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".keyring-")
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f)
+            os.chmod(tmp, 0o600)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass
 
     # -- management (ref serf keyring InstallKey/UseKey/RemoveKey/List) --
     def install(self, key: str):
         raw = _decode(key)
         with self._lock:
             self._keys[key] = raw
+            self._persist_locked()
 
     def use(self, key: str):
         with self._lock:
             if key not in self._keys:
                 raise KeyError("key is not installed")
             self._primary = key
+            self._persist_locked()
 
     def remove(self, key: str):
         with self._lock:
             if key == self._primary:
                 raise ValueError("cannot remove the primary key")
             self._keys.pop(key, None)
+            self._persist_locked()
 
     def list_keys(self) -> dict:
         with self._lock:

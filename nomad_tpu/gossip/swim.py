@@ -94,6 +94,7 @@ class Gossip:
         on_event: Optional[Callable[[str, Member], None]] = None,
         rng: Optional[random.Random] = None,
         encrypt_key: str = "",
+        keyring_path: str = "",
     ):
         #: AES-GCM keyring sealing every frame (ref serf encryption);
         #: None = plaintext gossip
@@ -101,7 +102,7 @@ class Gossip:
         if encrypt_key:
             from .keyring import Keyring
 
-            self.keyring = Keyring(encrypt_key)
+            self.keyring = Keyring(encrypt_key, path=keyring_path)
         self.name = name
         self.probe_interval = probe_interval
         self.ack_timeout = ack_timeout

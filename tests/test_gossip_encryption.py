@@ -46,6 +46,22 @@ class TestKeyring:
         assert ring.open(Keyring(old).seal(b"c")) is None
         assert ring.list_keys()["PrimaryKey"] == new
 
+    def test_persistence_across_restarts(self, tmp_path):
+        """Runtime-installed keys + primary choice reload from the keyring
+        file (serf keyring file role)."""
+        path = str(tmp_path / "keyring.json")
+        boot, extra = generate_key(), generate_key()
+        ring = Keyring(boot, path=path)
+        ring.install(extra)
+        ring.use(extra)
+
+        reloaded = Keyring(boot, path=path)  # agent restarts with config key
+        listed = reloaded.list_keys()
+        assert listed["PrimaryKey"] == extra
+        assert set(listed["Keys"]) == {boot, extra}
+        # frames sealed before the restart still open
+        assert reloaded.open(ring.seal(b"pre-restart")) == b"pre-restart"
+
     def test_bad_key_material(self):
         with pytest.raises(ValueError):
             Keyring("dG9vLXNob3J0")  # 9 bytes
