@@ -193,6 +193,11 @@ class ClientAgent:
             node=node,
             drivers=drivers,
         )
+        # Connect sidecars ride the same cluster identity: with TLS
+        # configured, sidecar↔sidecar hops are mutually authenticated
+        # (the Consul-CA role the reference delegates)
+        self.client.tls_server_context = server_ctx
+        self.client.tls_client_context = client_ctx
         # the client's own RPC listener: servers/agents forward alloc
         # fs/logs/exec here (the reverse-streaming path of
         # client_fs_endpoint.go, served as plain RPC). ``bind`` must be a

@@ -45,9 +45,12 @@ def _pump(a: socket.socket, b: socket.socket):
 
 
 class _Listener:
-    """Accept loop forwarding each connection to dial()'s target."""
+    """Accept loop forwarding each connection to dial()'s target.
+    ``tls_context`` (server-side) wraps accepted connections — the
+    inbound half of sidecar mTLS."""
 
-    def __init__(self, bind: tuple[str, int], dial, name: str):
+    def __init__(self, bind: tuple[str, int], dial, name: str,
+                 tls_context=None):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(bind)
@@ -55,6 +58,7 @@ class _Listener:
         self.addr = self._sock.getsockname()
         self._dial = dial
         self._name = name
+        self._tls = tls_context
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -77,6 +81,16 @@ class _Listener:
             ).start()
 
     def _handle(self, conn: socket.socket):
+        if self._tls is not None:
+            try:
+                conn = self._tls.wrap_socket(conn, server_side=True)
+            except Exception as e:
+                logger.warning("%s: mTLS handshake failed: %s", self._name, e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         target = None
         try:
             target = self._dial()
@@ -131,7 +145,13 @@ class ConnectHook:
                     return socket.create_connection(("127.0.0.1", port), 10)
 
                 inbound = _Listener(
-                    ("127.0.0.1", 0), dial_local, f"sidecar:{svc.name}"
+                    ("127.0.0.1", 0),
+                    dial_local,
+                    f"sidecar:{svc.name}",
+                    # inbound hop authenticates peers under the cluster CA
+                    tls_context=getattr(
+                        self.client, "tls_server_context", None
+                    ),
                 )
                 self._listeners.append(inbound)
                 self.proxies[svc.name] = {
@@ -145,10 +165,17 @@ class ConnectHook:
                 dest = upstream.destination_name
 
                 def dial_upstream(dest=dest):
-                    target = self._resolve(dest)
-                    if target is None:
+                    resolved = self._resolve(dest)
+                    if resolved is None:
                         raise OSError(f"no live sidecar for {dest!r}")
-                    return socket.create_connection(target, 10)
+                    target, is_sidecar = resolved
+                    sock = socket.create_connection(target, 10)
+                    ctx = getattr(self.client, "tls_client_context", None)
+                    if ctx is not None and is_sidecar:
+                        # sidecar→sidecar hop presents our cluster
+                        # identity; plain-service fallbacks stay raw TCP
+                        sock = ctx.wrap_socket(sock)
+                    return sock
 
                 outbound = _Listener(
                     ("127.0.0.1", upstream.local_bind_port),
@@ -159,13 +186,16 @@ class ConnectHook:
                 started = True
         return started
 
-    def _resolve(self, dest: str) -> Optional[tuple[str, int]]:
-        """A live sidecar for the destination, else the plain service
-        (non-connect destinations stay reachable)."""
+    def _resolve(self, dest: str) -> Optional[tuple[tuple[str, int], bool]]:
+        """((ip, port), is_sidecar) of a live sidecar for the destination,
+        else the plain service (non-connect destinations stay reachable)."""
         lookup = getattr(self.client.server, "catalog_service", None)
         if lookup is None:
             return None
-        for name in (f"{dest}-sidecar-proxy", dest):
+        for name, is_sidecar in (
+            (f"{dest}-sidecar-proxy", True),
+            (dest, False),
+        ):
             try:
                 entries = lookup(name)
             except Exception:
@@ -173,7 +203,10 @@ class ConnectHook:
                 return None
             for entry in entries:
                 if entry.get("Status") == "passing" and entry.get("Port"):
-                    return entry.get("Address") or "127.0.0.1", entry["Port"]
+                    return (
+                        entry.get("Address") or "127.0.0.1",
+                        entry["Port"],
+                    ), is_sidecar
         return None
 
     def stop(self):

@@ -164,6 +164,111 @@ class TestMeshEndToEnd:
         finally:
             agent.stop()
 
+    def test_mtls_sidecar_hops(self, tmp_path):
+        """With cluster TLS, sidecar↔sidecar traffic is mutually
+        authenticated: the mesh works end-to-end under TLS and a raw-TCP
+        (unauthenticated) probe of the sidecar port is rejected."""
+        import socket
+        import tempfile
+
+        from nomad_tpu.tlsutil import generate_dev_certs
+
+        d = tempfile.mkdtemp(prefix="connect_tls_")
+        server_tls = generate_dev_certs(d, "server")
+        client_tls = generate_dev_certs(d, "client")
+
+        server = ServerAgent(
+            "ct0", config={"seed": 151, "heartbeat_ttl": 5.0, "tls": server_tls}
+        )
+        server.start(num_workers=2)
+        node_agent = ClientAgent([server.address], tls=client_tls)
+        try:
+            node_agent.start()
+            wait_until(
+                lambda: server.server.state.node_by_id(node_agent.node.id)
+                is not None,
+                msg="tls node registered",
+            )
+            api_job = mock.job()
+            api_job.id = "tls-api"
+            tg = api_job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.name = "api"
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'echo tls-mesh > index.html; '
+                    'exec python3 -m http.server "$NOMAD_PORT_api_http" '
+                    "--bind 127.0.0.1",
+                ],
+            }
+            task.resources.networks = [
+                NetworkResource(mbits=1, dynamic_ports=[Port(label="http")])
+            ]
+            task.services = [connect_service("api", port_label="http")]
+            server.server.job_register(api_job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running" and a.connect_proxies.get("api")
+                    for a in server.server.state.allocs_by_job(
+                        api_job.namespace, api_job.id
+                    )
+                ),
+                msg="tls api sidecar published",
+            )
+
+            bind_port = 29878
+            out_file = tmp_path / "tls.txt"
+            web = mock.job()
+            web.id = "tls-web"
+            wtg = web.task_groups[0]
+            wtg.count = 1
+            wtask = wtg.tasks[0]
+            wtask.name = "web"
+            wtask.driver = "raw_exec"
+            wtask.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "for i in $(seq 1 100); do "
+                    f'python3 -c "import urllib.request;'
+                    f"open('{out_file}','w').write("
+                    f'urllib.request.urlopen(\'http://127.0.0.1:{bind_port}/\').read().decode())" '
+                    "2>/dev/null && break; sleep 0.3; done; sleep 60",
+                ],
+            }
+            wtask.resources.networks = []
+            wtask.services = [
+                connect_service("web", upstreams=[("api", bind_port)])
+            ]
+            server.server.job_register(web)
+            wait_until(
+                lambda: out_file.exists()
+                and out_file.read_text().strip() == "tls-mesh",
+                timeout=60,
+                msg="payload fetched through the mTLS mesh",
+            )
+
+            # a raw-TCP client without cluster identity gets nothing
+            (alloc,) = server.server.state.allocs_by_job(
+                api_job.namespace, api_job.id
+            )
+            ep = alloc.connect_proxies["api"]
+            with socket.create_connection((ep["ip"], ep["port"]), 5) as s:
+                s.sendall(b"GET / HTTP/1.0\r\n\r\n")
+                s.settimeout(3)
+                try:
+                    data = s.recv(1024)
+                except (ConnectionResetError, socket.timeout, OSError):
+                    data = b""
+            assert b"tls-mesh" not in data, "plaintext probe must not reach the service"
+        finally:
+            node_agent.stop()
+            server.stop()
+
     def test_remote_client_resolves_upstream_over_rpc(self, tmp_path):
         """Two node agents on the RPC tier: the consumer's upstream proxy
         resolves the destination sidecar via the Catalog.Service RPC."""
