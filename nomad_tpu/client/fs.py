@@ -203,6 +203,26 @@ def register_fs_rpc(rpc_server, client):
             limit=int(p.get("limit", 1 << 20)),
         ),
     )
+    def logs_follow(payload):
+        """Streaming log follow (ref fs_endpoint.go Logs with follow=true
+        over streaming RPC): pushes a frame whenever the logical stream
+        grows, for up to ``duration`` seconds (default 60)."""
+        import time as time_mod
+
+        base = alloc_dir(payload)
+        task = payload["task"]
+        kind = payload.get("type", "stdout")
+        offset = int(payload.get("offset", 0))
+        deadline = time_mod.monotonic() + float(payload.get("duration", 60.0))
+        while time_mod.monotonic() < deadline:
+            window = logs(base, task, kind, offset=offset, origin="start")
+            if window["Data"]:
+                offset = window["Offset"]
+                yield window
+            else:
+                time_mod.sleep(0.2)
+
+    rpc_server.register_stream("ClientFS.LogsFollow", logs_follow)
     rpc_server.register(
         "ClientFS.Exec",
         lambda p: exec_in(

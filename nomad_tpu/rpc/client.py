@@ -87,6 +87,32 @@ class _Conn:
                 )
             return result
 
+    def call_stream(self, method: str, payload, timeout: Optional[float] = None):
+        """Streaming RPC (ref structs/streaming_rpc.go): yields each chunk
+        frame until the server's end-of-stream marker. Holds the
+        connection for the stream's duration."""
+        with self.lock:
+            if timeout is not None:
+                self.sock.settimeout(timeout)
+            seq = next(self.seq)
+            try:
+                write_frame(self.sock, [seq, method, payload])
+            except (ConnectionClosed, OSError) as e:
+                raise _SendFailed(e) from e
+            while True:
+                rseq, error, result = read_frame(self.sock)
+                if rseq != seq:
+                    raise ConnectionClosed("rpc sequence mismatch")
+                if error is not None:
+                    raise RpcError(
+                        error.get("code", "error"),
+                        error.get("message", ""),
+                        error.get("leader_rpc_addr"),
+                    )
+                if not result.get("more"):
+                    return
+                yield result.get("chunk")
+
     def close(self):
         try:
             self.sock.close()
@@ -182,6 +208,28 @@ class ConnPool:
         except (ConnectionClosed, OSError) as e:
             conn.close()
             raise RpcError("connection", f"{addr}: {e}")
+
+    def call_stream(self, addr: str, method: str, payload,
+                    timeout: Optional[float] = None):
+        """Streaming RPC on a dedicated connection (yields chunks). The
+        connection returns to the pool only after the stream completes;
+        a broken stream closes it."""
+        try:
+            conn, _ = self._acquire(addr)
+        except OSError as e:
+            raise RpcError("connect", f"{addr}: {e}")
+        ok = False
+        try:
+            for chunk in conn.call_stream(
+                method, payload, timeout=timeout or self.timeout
+            ):
+                yield chunk
+            ok = True
+        finally:
+            if ok:
+                self._release(addr, conn)
+            else:
+                conn.close()
 
     def close(self):
         with self._lock:

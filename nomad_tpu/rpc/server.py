@@ -38,6 +38,7 @@ class RpcServer:
         #: accepted connection handshakes and must present a CA-signed cert
         self.tls_context = tls_context
         self.handlers: dict[str, Callable] = {}
+        self.stream_handlers: dict[str, Callable] = {}
         self.raft_handlers: dict[str, Callable] = {}
         # maps raft node_id -> rpc "host:port" (fed by config/gossip) so
         # NotLeaderError responses can carry a dialable leader address
@@ -49,6 +50,13 @@ class RpcServer:
         self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
         self._running = False
         self._threads: list[threading.Thread] = []
+
+    def register_stream(self, method: str, handler: Callable):
+        """Register a streaming method (ref structs/streaming_rpc.go): the
+        handler is a GENERATOR; each yielded item goes out as its own
+        frame `[seq, None, {"chunk": item, "more": True}]`, terminated by
+        `{"more": False}` (or an error frame)."""
+        self.stream_handlers[method] = handler
 
     def register(self, method: str, handler: Callable):
         self.handlers[method] = handler
@@ -135,6 +143,16 @@ class RpcServer:
             except (ConnectionClosed, OSError):
                 return
             try:
+                stream = self.stream_handlers.get(method)
+                if stream is not None and dispatch == self._dispatch:
+                    # streaming method: one frame per yielded chunk, then
+                    # an end-of-stream marker (streaming_rpc.go framing)
+                    for chunk in stream(payload):
+                        write_frame(
+                            conn, [seq, None, {"chunk": chunk, "more": True}]
+                        )
+                    write_frame(conn, [seq, None, {"more": False}])
+                    continue
                 result = dispatch(method, payload)
                 write_frame(conn, [seq, None, result])
             except NotLeaderError as e:

@@ -13,9 +13,12 @@ from nomad_tpu.rpc import ConnPool, RpcError, ServerProxy
 
 
 FAST_RAFT = dict(
-    heartbeat_interval=0.02,
-    election_timeout_min=0.05,
-    election_timeout_max=0.10,
+    heartbeat_interval=0.05,
+    # election windows must tolerate GIL pauses on a loaded interpreter
+    # (a single slow gc/compile stall past the window flaps leadership
+    # mid-test, which can fail an in-flight eval)
+    election_timeout_min=0.3,
+    election_timeout_max=0.6,
 )
 
 

@@ -1,0 +1,131 @@
+"""Streaming RPC (ref structs/streaming_rpc.go): multi-frame responses on
+the RPC tier, exercised by the client agent's log-follow stream."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, ServerAgent
+from nomad_tpu.rpc import ConnPool, RpcServer
+from nomad_tpu.rpc.client import RpcError
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestStreamFraming:
+    def test_chunks_then_eof(self):
+        server = RpcServer("127.0.0.1", 0)
+
+        def counter(payload):
+            for i in range(int(payload["n"])):
+                yield {"i": i}
+
+        server.register_stream("Test.Count", counter)
+        server.register("Test.Plain", lambda p: {"ok": True})
+        server.start()
+        pool = ConnPool()
+        try:
+            chunks = list(pool.call_stream(server.address, "Test.Count", {"n": 5}))
+            assert [c["i"] for c in chunks] == [0, 1, 2, 3, 4]
+            # the connection returns to the pool and serves plain calls
+            assert pool.call(server.address, "Test.Plain", {})["ok"] is True
+            # a second stream on the same (pooled) connection
+            chunks = list(pool.call_stream(server.address, "Test.Count", {"n": 2}))
+            assert len(chunks) == 2
+        finally:
+            pool.close()
+            server.stop()
+
+    def test_stream_handler_error_frames(self):
+        server = RpcServer("127.0.0.1", 0)
+
+        def boom(payload):
+            raise ValueError("bad stream request")
+            yield  # pragma: no cover
+
+        server.register_stream("Test.Boom", boom)
+        server.start()
+        pool = ConnPool()
+        try:
+            with pytest.raises(RpcError) as err:
+                list(pool.call_stream(server.address, "Test.Boom", {}))
+            assert err.value.code == "invalid"
+        finally:
+            pool.close()
+            server.stop()
+
+
+class TestLogFollowStream:
+    def test_follow_pushes_growing_logs(self):
+        """A task that writes continuously streams its log growth as push
+        frames over the client's RPC listener."""
+        server = ServerAgent("ls1", config={"seed": 157, "heartbeat_ttl": 5.0})
+        server.start(num_workers=2)
+        node_agent = ClientAgent([server.address])
+        pool = ConnPool()
+        try:
+            node_agent.start()
+            wait_until(
+                lambda: server.server.state.node_by_id(node_agent.node.id)
+                is not None,
+                msg="node registered",
+            )
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "i=0; while true; do echo line-$i; i=$((i+1)); sleep 0.1; done",
+                ],
+            }
+            task.resources.networks = []
+            server.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in server.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="writer running",
+            )
+            (alloc,) = server.server.state.allocs_by_job(job.namespace, job.id)
+            node = server.server.state.node_by_id(alloc.node_id)
+            addr = node.attributes["unique.advertise.client_rpc"]
+
+            collected = ""
+            frames = 0
+            for chunk in pool.call_stream(
+                addr,
+                "ClientFS.LogsFollow",
+                {
+                    "alloc_id": alloc.id,
+                    "secret": node.secret_id,
+                    "task": "web",
+                    "type": "stdout",
+                    "duration": 2.0,
+                },
+                timeout=10.0,
+            ):
+                collected += chunk["Data"]
+                frames += 1
+            assert frames >= 2, "follow must push multiple frames"
+            assert "line-0" in collected
+            # growth across frames: later lines arrived in later frames
+            assert "line-5" in collected
+        finally:
+            pool.close()
+            node_agent.stop()
+            server.stop()
