@@ -98,7 +98,7 @@ class TestJavaDriver:
         )
         task.env = {"JAVA_OUT": str(out_file)}
         handle = driver.start_task(task, str(tmp_path))
-        assert handle.wait(10)
+        assert handle.wait(30)
         assert handle.exit_code == 0
         assert out_file.read_text().strip() == "ran: -Xmx128m -jar /srv/app.jar serve"
 
@@ -137,7 +137,7 @@ class TestQemuDriver:
         )
         task.env = {"QEMU_OUT": str(out_file)}
         handle = driver.start_task(task, str(tmp_path))
-        assert handle.wait(10)
+        assert handle.wait(30)
         argv = out_file.read_text()
         assert "-m 1024M" in argv
         assert "accel=tcg" in argv
@@ -233,10 +233,14 @@ class TestDockerDriver:
         assert not handle._done.is_set()
 
         driver.signal_task(handle, "HUP")
-        assert (state / f"{container}.signals").read_text().strip() == "SIGHUP"
+        sig_file = state / f"{container}.signals"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not sig_file.exists():
+            time.sleep(0.05)
+        assert sig_file.read_text().strip() == "SIGHUP"
 
         driver.stop_task(handle, timeout=1.0)
-        assert handle.wait(10)
+        assert handle.wait(30)
         assert handle.exit_code == 0
 
         # docklog role: container output landed in the task log files
