@@ -34,6 +34,7 @@ from ..structs.model import (
     generate_uuids,
 )
 from .columnar import (
+    R_COLS,
     ColumnarCluster,
     build_group_planes,
     compute_limit,
@@ -138,7 +139,10 @@ class TPUBatchScheduler(GenericScheduler):
         if any(p.previous_alloc is not None or p.canary for p in place):
             return False
         groups = {p.task_group.name: p.task_group for p in place}
-        if not all(kernel_supported(self.job, tg) for tg in groups.values()):
+        if not all(
+            kernel_supported(self.job, tg, allow_networks=True)
+            for tg in groups.values()
+        ):
             return False
         if self.plan.node_update:
             return False
@@ -186,7 +190,10 @@ class TPUBatchScheduler(GenericScheduler):
             )
             return super()._compute_placements(destructive, place)
         groups = {p.task_group.name: p.task_group for p in place}
-        if not all(kernel_supported(self.job, tg) for tg in groups.values()):
+        if not all(
+            kernel_supported(self.job, tg, allow_networks=True)
+            for tg in groups.values()
+        ):
             _count_fallback("unsupported_group")  # ports/devices/distinct_*
             return super()._compute_placements(destructive, place)
 
@@ -226,7 +233,7 @@ class TPUBatchScheduler(GenericScheduler):
         G = len(group_names)
         n_nodes = len(cluster.nodes)
 
-        g_demand = np.zeros((G, 3), dtype=np.int32)
+        g_demand = np.zeros((G, R_COLS), dtype=np.int32)
         g_limit = np.zeros(G, dtype=np.int32)
         collisions0 = np.zeros((G, n_nodes), dtype=np.int32)
         for name, gi in g_index.items():
@@ -235,6 +242,12 @@ class TPUBatchScheduler(GenericScheduler):
                 sum(t.resources.cpu for t in tg.tasks),
                 sum(t.resources.memory_mb for t in tg.tasks),
                 tg.ephemeral_disk.size_mb,
+                # bandwidth ask (AssignNetwork's mbits dimension)
+                sum(
+                    net.mbits
+                    for t in tg.tasks
+                    for net in t.resources.networks
+                ),
             )
             planes = planes_list[gi]
             g_limit[gi] = min(
@@ -372,7 +385,7 @@ class TPUBatchScheduler(GenericScheduler):
         A = _bucket(a_real)
         group_ids = np.zeros(A, dtype=np.int32)
         group_ids[:a_real] = gid_real
-        demands = np.zeros((A, 3), dtype=np.int32)
+        demands = np.zeros((A, R_COLS), dtype=np.int32)
         demands[:a_real] = g_demand[gid_real]
         limits = np.zeros(A, dtype=np.int32)
         limits[:a_real] = g_limit[gid_real]
@@ -556,13 +569,58 @@ class TPUBatchScheduler(GenericScheduler):
         exhausted = feasible & over.any(axis=1)
         metrics.nodes_exhausted = int(exhausted.sum())
         first_dim = np.where(over[:, 0], 0, np.where(over[:, 1], 1, 2))
-        for d, name in enumerate(("cpu", "memory", "disk")):
+        for d, name in enumerate(("cpu", "memory", "disk", "network: bandwidth exceeded")):
             c = int((exhausted & (first_dim == d)).sum())
             if c:
                 metrics.dimension_exhausted[name] = c
         return metrics
 
     # ------------------------------------------------------------------
+    def _assign_networks(self, node, entry, net_indexes):
+        """Per-alloc dynamic-port assignment on the kernel's chosen node
+        (the oracle's rank.go:292-338 ask, replayed host-side post-choice).
+        One NetworkIndex per touched node, fed lazily with the node's live
+        allocs + this plan's earlier grants; returns AllocatedResources or
+        None when the node's port space is exhausted."""
+        from ..structs.model import remove_allocs
+        from ..structs.network import NetworkIndex
+
+        tg, asks = entry
+        idx = net_indexes.get(node.id)
+        if idx is None:
+            idx = NetworkIndex(rng=self.ctx.rng)
+            idx.set_node(node)
+            existing = self.state.allocs_by_node_terminal(node.id, False)
+            stops = self.plan.node_update.get(node.id, [])
+            if stops:
+                existing = remove_allocs(existing, stops)
+            idx.add_allocs(existing)
+            for prior in self.plan.node_allocation.get(node.id, []):
+                if prior.allocated_resources is not None:
+                    for tr in prior.allocated_resources.tasks.values():
+                        for net in tr.networks:
+                            idx.add_reserved(net)
+            net_indexes[node.id] = idx
+        offers = {}
+        for task_name, ask in asks:
+            offer, _err = idx.assign_network(ask.copy())
+            if offer is None:
+                return None
+            idx.add_reserved(offer)
+            offers[task_name] = offer
+        tasks = {
+            t.name: AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=t.resources.cpu),
+                memory=AllocatedMemoryResources(memory_mb=t.resources.memory_mb),
+                networks=[offers[t.name]] if t.name in offers else [],
+            )
+            for t in tg.tasks
+        }
+        return AllocatedResources(
+            tasks=tasks,
+            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+        )
+
     def _materialize(
         self, place, placements, nodes, by_dc, planes_list, g_index,
         gid_real, used0, capacity, g_demand, t_dispatch=None, eligible=None,
@@ -632,11 +690,49 @@ class TPUBatchScheduler(GenericScheduler):
             if all_valid
             else np.flatnonzero(valid_mask).tolist()
         )
+        # dynamic-port post-pass (SURVEY §7: bandwidth rides the kernel's
+        # 4th resource column; exact port assignment happens host-side on
+        # the chosen node only): groups with network asks get per-alloc
+        # NetworkIndex offers instead of the shared template resources
+        net_asks = {}
+        for name, gi in g_index.items():
+            tg = next(p.task_group for p in place if p.task_group.name == name)
+            asks = [
+                (t.name, t.resources.networks[0])
+                for t in tg.tasks
+                if t.resources.networks
+            ]
+            if asks:
+                net_asks[name] = (tg, asks)
+        net_indexes: dict[str, object] = {}
         DT = DesiredTransition
         for i in success:
             p = place[i]
             node_idx = placed_list[i]
             node_id = node_ids[node_idx]
+            overrides = {}
+            if net_asks:
+                entry = net_asks.get(p.task_group.name)
+                if entry is not None:
+                    resources = self._assign_networks(
+                        nodes[node_idx], entry, net_indexes
+                    )
+                    if resources is None:
+                        # port space exhausted on the chosen node — record
+                        # the failure honestly (rare: the bandwidth column
+                        # already gated capacity)
+                        metric = self.failed_tg_allocs.get(p.task_group.name)
+                        if metric is None:
+                            metric = AllocMetric()
+                            metric.nodes_evaluated = n_evaluated
+                            metric.nodes_available = dict(by_dc)
+                            metric.nodes_exhausted = 1
+                            metric.dimension_exhausted = {"network: ports": 1}
+                            self.failed_tg_allocs[p.task_group.name] = metric
+                        else:
+                            metric.coalesced_failures += 1
+                        continue
+                    overrides["allocated_resources"] = resources
             alloc = alloc_new(Allocation)
             alloc.__dict__ = dict(
                 template_by_group[p.task_group.name],
@@ -647,6 +743,7 @@ class TPUBatchScheduler(GenericScheduler):
                 task_states={},
                 desired_transition=DT(),
                 preempted_allocations=[],
+                **overrides,
             )
             bucket = node_alloc.get(node_id)
             if bucket is None:

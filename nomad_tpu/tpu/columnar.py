@@ -57,6 +57,12 @@ class GroupPlanes:
 _SHARED_CLUSTERS: list = []
 
 
+#: dense resource columns: cpu MHz, memory MB, disk MB, network mbits
+#: (bandwidth is the AssignNetwork dimension the kernel CAN model densely;
+#: ports stay a host post-pass, SURVEY §7)
+R_COLS = 4
+
+
 class ColumnarCluster:
     """Dense arrays for a set of candidate nodes."""
 
@@ -64,18 +70,20 @@ class ColumnarCluster:
         self.nodes = nodes
         self.index = {n.id: i for i, n in enumerate(nodes)}
         n = len(nodes)
-        self.capacity = np.zeros((n, 3), dtype=np.int64)
-        self.reserved = np.zeros((n, 3), dtype=np.int64)
+        self.capacity = np.zeros((n, R_COLS), dtype=np.int64)
+        self.reserved = np.zeros((n, R_COLS), dtype=np.int64)
         for i, node in enumerate(nodes):
             res = node.node_resources
             self.capacity[i] = (
                 res.cpu.cpu_shares,
                 res.memory.memory_mb,
                 res.disk.disk_mb,
+                # AvailBandwidth: device-backed links only (network.go:72)
+                sum(net.mbits for net in res.networks if net.device),
             )
             if node.reserved_resources is not None:
                 rr = node.reserved_resources
-                self.reserved[i] = (
+                self.reserved[i, :3] = (
                     rr.cpu.cpu_shares,
                     rr.memory.memory_mb,
                     rr.disk.disk_mb,
@@ -114,7 +122,7 @@ class ColumnarCluster:
         resource accumulation (AllocsFit's summation, funcs.go:104-117);
         single definition shared by the plane builders and the fallback
         recompute paths."""
-        used = into if into is not None else np.zeros(3, dtype=np.int64)
+        used = into if into is not None else np.zeros(R_COLS, dtype=np.int64)
         for a in allocs:
             if a.allocated_resources is None:
                 continue
@@ -122,6 +130,13 @@ class ColumnarCluster:
             used[0] += c.flattened.cpu.cpu_shares
             used[1] += c.flattened.memory.memory_mb
             used[2] += c.shared.disk_mb
+            # bandwidth (NetworkIndex.AddAllocs' used-bandwidth sum)
+            res = a.allocated_resources
+            for tr in res.tasks.values():
+                for net in tr.networks:
+                    used[3] += net.mbits
+            for net in res.shared.networks:
+                used[3] += net.mbits
         return used
 
     def _live_allocs_by_node(self, state) -> dict[str, list]:
@@ -172,14 +187,28 @@ class ColumnarCluster:
         return counts
 
 
-def kernel_supported(job: Job, tg: TaskGroup) -> bool:
-    """Whether the fast kernel covers this group; anything else falls back to
-    the scalar oracle (ports, devices, distinct_*, sticky disk, multi-spread)."""
+def kernel_supported(job: Job, tg: TaskGroup, allow_networks: bool = False) -> bool:
+    """Whether the fast kernel covers this group; anything else falls back
+    to the scalar oracle (devices, distinct_*, sticky disk, multi-spread).
+
+    With ``allow_networks`` (the tpu-batch path), network asks ride the
+    kernel too: bandwidth is the 4th dense resource column and DYNAMIC
+    ports are assigned host-side after node choice (SURVEY §7's port
+    post-pass). Reserved-port asks still fall back — their collisions
+    constrain node choice itself, which the dense planes don't model."""
     if tg.networks:
         return False
     for task in tg.tasks:
-        if task.resources.networks or task.resources.devices:
+        if task.resources.devices:
             return False
+        nets = task.resources.networks
+        if nets and not allow_networks:
+            return False
+        if len(nets) > 1:
+            return False
+        for net in nets:
+            if net.reserved_ports:
+                return False
     if tg.ephemeral_disk.sticky:
         return False
     constraints = list(job.constraints) + list(tg.constraints)

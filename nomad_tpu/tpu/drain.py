@@ -80,6 +80,7 @@ class _Parked:
 
 
 from .batch_sched import _bucket  # one padding-bucket policy for all kernels
+from .columnar import R_COLS
 
 
 class KernelBatchCollector:
@@ -191,11 +192,11 @@ class KernelBatchCollector:
             )
         )
 
-        capacity = np.zeros((N, 3), dtype=np.int32)
+        capacity = np.zeros((N, R_COLS), dtype=np.int32)
         capacity[:n_real] = shared.capacity
         usable = np.ones((N, 2), dtype=np.float32)
         usable[:n_real] = shared.usable
-        used0 = np.full((N, 3), 2**30, dtype=np.int32)
+        used0 = np.full((N, R_COLS), 2**30, dtype=np.int32)
         used0[:n_real] = shared.used0
 
         feasible = np.zeros((G, N), dtype=bool)
@@ -215,7 +216,7 @@ class KernelBatchCollector:
         perm = np.tile(np.arange(N, dtype=np.int32), (E, 1))
         ring = np.zeros(E, dtype=np.int32)
 
-        demands = np.zeros((A, 3), dtype=np.int32)
+        demands = np.zeros((A, R_COLS), dtype=np.int32)
         groups = np.zeros(A, dtype=np.int32)
         limits = np.zeros(A, dtype=np.int32)
         valid = np.zeros(A, dtype=bool)

@@ -76,6 +76,7 @@ class TPUSystemScheduler(SystemScheduler):
                     sum(t.resources.cpu for t in tg.tasks),
                     sum(t.resources.memory_mb for t in tg.tasks),
                     tg.ephemeral_disk.size_mb,
+                    0,  # tpu-system stays gated to no-network groups
                 ),
                 dtype=np.int64,
             )

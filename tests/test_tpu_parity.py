@@ -172,6 +172,76 @@ class TestKernelParity:
         assert dict(m_oracle.dimension_exhausted) == dict(m_batch.dimension_exhausted)
         assert m_oracle.nodes_filtered == m_batch.nodes_filtered
 
+    def test_dynamic_port_jobs_ride_the_kernel(self):
+        """Network asks with only dynamic ports ride the kernel: bandwidth
+        is the dense 4th resource column, ports are assigned host-side on
+        the chosen node. Placements match the oracle and every alloc gets
+        distinct dynamic ports per node."""
+        from nomad_tpu.structs.model import NetworkResource, Port
+        from nomad_tpu.tpu import batch_sched
+
+        nodes = build_cluster(24)
+
+        def add_ports(job):
+            task = job.task_groups[0].tasks[0]
+            task.resources.networks = [
+                NetworkResource(
+                    mbits=10,
+                    dynamic_ports=[Port(label="http"), Port(label="admin")],
+                )
+            ]
+
+        job = make_job(40, mutate=add_ports)
+        before = batch_sched.counters_snapshot()
+        p_oracle, _, _ = run(nodes, job, "service")
+        p_batch, _, h = run(nodes, job, "tpu-batch")
+        after = batch_sched.counters_snapshot()
+        assert after["kernel_evals"] > before["kernel_evals"], (
+            "port job must ride the kernel, not fall back"
+        )
+        assert p_oracle == p_batch
+
+        # per-node port uniqueness + offers present
+        by_node: dict = {}
+        for a in h.state.allocs_by_job(job.namespace, job.id):
+            tr = a.allocated_resources.tasks["web"]
+            assert len(tr.networks) == 1
+            ports = [p.value for p in tr.networks[0].dynamic_ports]
+            assert len(ports) == 2 and all(v > 0 for v in ports)
+            by_node.setdefault(a.node_id, []).extend(ports)
+        for node_id, ports in by_node.items():
+            assert len(ports) == len(set(ports)), (
+                f"duplicate ports on node {node_id[:8]}: {sorted(ports)}"
+            )
+
+    def test_bandwidth_exhaustion_matches_oracle(self):
+        """The 4th column enforces AssignNetwork's bandwidth dimension:
+        nodes run out of mbits exactly like the oracle says."""
+        from nomad_tpu.structs.model import NetworkResource, Port
+
+        nodes = build_cluster(6)
+        for n in nodes:
+            n.node_resources.cpu.cpu_shares = 100000
+            n.node_resources.memory.memory_mb = 100000
+            n.node_resources.networks[0].mbits = 100
+
+        def add_hungry_net(job):
+            task = job.task_groups[0].tasks[0]
+            task.resources.cpu = 10
+            task.resources.memory_mb = 10
+            task.resources.networks = [
+                NetworkResource(mbits=60, dynamic_ports=[Port(label="p")])
+            ]
+
+        # 12 asks of 60mbits over 6 nodes with 100mbits: exactly one per
+        # node fits (the second would exceed bandwidth)
+        job = make_job(12, mutate=add_hungry_net)
+        p_oracle, s_oracle, _ = run(nodes, job, "service")
+        p_batch, s_batch, _ = run(nodes, job, "tpu-batch")
+        assert len(p_oracle) == 6
+        assert p_oracle == p_batch
+        assert len({v for v in p_batch.values()}) == 6  # one per node
+
     def test_larger_parity_ratio(self):
         # 100 nodes x 80 allocs: allow tiny divergence from float rounding
         nodes = build_cluster(100)
