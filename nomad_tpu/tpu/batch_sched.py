@@ -14,6 +14,7 @@ scalar oracle path, so behavior is complete while the hot path is dense.
 from __future__ import annotations
 
 import os
+from dataclasses import replace
 from typing import Optional
 
 import numpy as np
@@ -172,6 +173,8 @@ class TPUBatchScheduler(GenericScheduler):
                         collector.shared.capacity,
                         prep.g_demand,
                         eligible=eligible,
+                        shared_net_indexes=collector.net_indexes,
+                        shared_net_lock=collector.net_lock,
                     )
                     return
             collector.leave(self.eval.id)
@@ -280,6 +283,10 @@ class TPUBatchScheduler(GenericScheduler):
         nodes_elig, by_dc = self.state.ready_nodes_in_dcs(self.job.datacenters)
         if not nodes_elig:
             return None
+        if self._group_asks_network(place) and not bool(
+            shared.cluster.single_nic.all()
+        ):
+            return None  # per-device bandwidth: the solo path's oracle escape
 
         shuffled = list(nodes_elig)
         shuffle_nodes(ctx, shuffled)
@@ -321,11 +328,15 @@ class TPUBatchScheduler(GenericScheduler):
         ctx = self.ctx
         n_real = len(nodes)
 
+        # escape hatches must fire BEFORE the seeded shuffle: the oracle
+        # fallback replays the same rng stream the pure-oracle run uses
+        cluster = ColumnarCluster.shared(self.state, nodes)
+        if self._multi_nic_network_escape(place, cluster):
+            return super()._compute_placements([], place)
+
         # Same seeded shuffle the oracle's stack.set_nodes performs
         shuffled = list(nodes)
         shuffle_nodes(ctx, shuffled)
-
-        cluster = ColumnarCluster.shared(self.state, nodes)
         perm_real = np.array([cluster.index[n.id] for n in shuffled], dtype=np.int32)
 
         planes_list, g_index, g_demand, g_limit, gid_real, collisions0_real = (
@@ -568,7 +579,11 @@ class TPUBatchScheduler(GenericScheduler):
         over = used_final + demand[None, :] > capacity[:n_real]
         exhausted = feasible & over.any(axis=1)
         metrics.nodes_exhausted = int(exhausted.sum())
-        first_dim = np.where(over[:, 0], 0, np.where(over[:, 1], 1, 2))
+        first_dim = np.where(
+            over[:, 0],
+            0,
+            np.where(over[:, 1], 1, np.where(over[:, 2], 2, 3)),
+        )
         for d, name in enumerate(("cpu", "memory", "disk", "network: bandwidth exceeded")):
             c = int((exhausted & (first_dim == d)).sum())
             if c:
@@ -576,12 +591,34 @@ class TPUBatchScheduler(GenericScheduler):
         return metrics
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _group_asks_network(place) -> bool:
+        return any(
+            t.resources.networks
+            for p in place
+            for t in p.task_group.tasks
+        )
+
+    def _multi_nic_network_escape(self, place, cluster) -> bool:
+        """AssignNetwork enforces bandwidth PER DEVICE; the dense sum is
+        exact only on single-NIC nodes. Network-asking evals over clusters
+        containing multi-NIC nodes ride the oracle (its per-device
+        accounting), the same escape-hatch pattern as devices/distinct_*."""
+        if not self._group_asks_network(place):
+            return False
+        if bool(cluster.single_nic.all()):
+            return False
+        _count_fallback("multi_nic_network")
+        return True
+
     def _assign_networks(self, node, entry, net_indexes):
         """Per-alloc dynamic-port assignment on the kernel's chosen node
         (the oracle's rank.go:292-338 ask, replayed host-side post-choice).
         One NetworkIndex per touched node, fed lazily with the node's live
-        allocs + this plan's earlier grants; returns AllocatedResources or
-        None when the node's port space is exhausted."""
+        allocs + this plan's earlier grants; returns (AllocatedResources,
+        None) or (None, error) when assignment fails. ``net_indexes`` may
+        be shared across a fused drain batch (the collector's map), so
+        sibling evals can't double-book ports on a node."""
         from ..structs.model import remove_allocs
         from ..structs.network import NetworkIndex
 
@@ -603,9 +640,9 @@ class TPUBatchScheduler(GenericScheduler):
             net_indexes[node.id] = idx
         offers = {}
         for task_name, ask in asks:
-            offer, _err = idx.assign_network(ask.copy())
+            offer, err = idx.assign_network(ask.copy())
             if offer is None:
-                return None
+                return None, err
             idx.add_reserved(offer)
             offers[task_name] = offer
         tasks = {
@@ -616,14 +653,20 @@ class TPUBatchScheduler(GenericScheduler):
             )
             for t in tg.tasks
         }
-        return AllocatedResources(
-            tasks=tasks,
-            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+        return (
+            AllocatedResources(
+                tasks=tasks,
+                shared=AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb
+                ),
+            ),
+            None,
         )
 
     def _materialize(
         self, place, placements, nodes, by_dc, planes_list, g_index,
         gid_real, used0, capacity, g_demand, t_dispatch=None, eligible=None,
+        shared_net_indexes=None, shared_net_lock=None,
     ):
         import time
 
@@ -695,8 +738,8 @@ class TPUBatchScheduler(GenericScheduler):
         # the chosen node only): groups with network asks get per-alloc
         # NetworkIndex offers instead of the shared template resources
         net_asks = {}
-        for name, gi in g_index.items():
-            tg = next(p.task_group for p in place if p.task_group.name == name)
+        tg_by_name = {p.task_group.name: p.task_group for p in place}
+        for name, tg in tg_by_name.items():
             asks = [
                 (t.name, t.resources.networks[0])
                 for t in tg.tasks
@@ -704,7 +747,12 @@ class TPUBatchScheduler(GenericScheduler):
             ]
             if asks:
                 net_asks[name] = (tg, asks)
-        net_indexes: dict[str, object] = {}
+        # fused drain batches share one per-node index (+lock) across all
+        # participating evals; solo evals get a private map
+        net_indexes = (
+            shared_net_indexes if shared_net_indexes is not None else {}
+        )
+        net_lock = shared_net_lock
         DT = DesiredTransition
         for i in success:
             p = place[i]
@@ -714,20 +762,25 @@ class TPUBatchScheduler(GenericScheduler):
             if net_asks:
                 entry = net_asks.get(p.task_group.name)
                 if entry is not None:
-                    resources = self._assign_networks(
-                        nodes[node_idx], entry, net_indexes
-                    )
+                    if net_lock is not None:
+                        with net_lock:
+                            resources, err = self._assign_networks(
+                                nodes[node_idx], entry, net_indexes
+                            )
+                    else:
+                        resources, err = self._assign_networks(
+                            nodes[node_idx], entry, net_indexes
+                        )
                     if resources is None:
-                        # port space exhausted on the chosen node — record
-                        # the failure honestly (rare: the bandwidth column
-                        # already gated capacity)
+                        # assignment failed on the chosen node — record the
+                        # oracle's label (rank.py exhausted_node)
                         metric = self.failed_tg_allocs.get(p.task_group.name)
                         if metric is None:
                             metric = AllocMetric()
                             metric.nodes_evaluated = n_evaluated
                             metric.nodes_available = dict(by_dc)
                             metric.nodes_exhausted = 1
-                            metric.dimension_exhausted = {"network: ports": 1}
+                            metric.dimension_exhausted = {f"network: {err}": 1}
                             self.failed_tg_allocs[p.task_group.name] = metric
                         else:
                             metric.coalesced_failures += 1

@@ -90,6 +90,17 @@ class ColumnarCluster:
                 )
         # Scoring denominators (ScoreFit: total - reserved; funcs.go:160-165)
         self.usable = (self.capacity[:, :2] - self.reserved[:, :2]).astype(np.float32)
+        # AssignNetwork enforces bandwidth PER DEVICE; the dense sum is
+        # exact only for single-NIC nodes. Network-asking groups mask
+        # multi-NIC nodes out of kernel feasibility (conservative: the
+        # oracle may still use them via its per-device accounting).
+        self.single_nic = np.array(
+            [
+                sum(1 for net in n.node_resources.networks if net.device) <= 1
+                for n in nodes
+            ],
+            dtype=bool,
+        )
         # per-(job version, group) feasibility/affinity/spread planes —
         # valid for this cluster's exact node set (see build_group_planes)
         self.planes_cache: dict = {}

@@ -105,6 +105,11 @@ class KernelBatchCollector:
         self._parked: list[_Parked] = []
         self._consumed: set[str] = set()
         self.invocations = 0
+        #: shared per-node NetworkIndexes: every eval in the batch assigns
+        #: dynamic ports through the same map (+lock) so siblings can't
+        #: double-book a port on a node before either plan commits
+        self.net_indexes: dict = {}
+        self.net_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def consumed(self, eval_id: str) -> bool:

@@ -242,6 +242,77 @@ class TestKernelParity:
         assert p_oracle == p_batch
         assert len({v for v in p_batch.values()}) == 6  # one per node
 
+    def test_multi_nic_network_jobs_escape_to_oracle(self):
+        """AssignNetwork enforces bandwidth per device: a cluster with
+        dual-NIC nodes routes network evals to the oracle, so placements
+        (and counts) match exactly instead of over-packing summed NICs."""
+        from nomad_tpu.structs.model import NetworkResource, Port
+        from nomad_tpu.tpu import batch_sched
+
+        nodes = build_cluster(10)
+        for n in nodes:
+            n.node_resources.cpu.cpu_shares = 100000
+            n.node_resources.memory.memory_mb = 100000
+        # 5 dual-NIC nodes (150+150) + 5 single-NIC nodes (300)
+        for i, n in enumerate(nodes):
+            if i < 5:
+                n.node_resources.networks = [
+                    NetworkResource(device="eth0", ip="192.168.1.1", cidr="192.168.1.1/32", mbits=150),
+                    NetworkResource(device="eth1", ip="192.168.1.2", cidr="192.168.1.2/32", mbits=150),
+                ]
+            else:
+                n.node_resources.networks = [
+                    NetworkResource(device="eth0", ip="192.168.1.1", cidr="192.168.1.1/32", mbits=300),
+                ]
+
+        def add_net(job):
+            task = job.task_groups[0].tasks[0]
+            task.resources.cpu = 10
+            task.resources.memory_mb = 10
+            task.resources.networks = [
+                NetworkResource(mbits=100, dynamic_ports=[Port(label="p")])
+            ]
+
+        job = make_job(25, mutate=add_net)
+        before = batch_sched.counters_snapshot()
+        p_oracle, _, _ = run(nodes, job, "service")
+        p_batch, _, _ = run(nodes, job, "tpu-batch")
+        after = batch_sched.counters_snapshot()
+        assert len(p_oracle) == 25  # per-device accounting fits them all
+        assert p_oracle == p_batch
+        assert (
+            after["fallback_reasons"].get("multi_nic_network", 0)
+            > before["fallback_reasons"].get("multi_nic_network", 0)
+        )
+
+    def test_bandwidth_failure_metric_label(self):
+        """Bandwidth-bound failures report the oracle's dimension label,
+        not 'disk' (first_dim must cover the 4th column)."""
+        from nomad_tpu.structs.model import NetworkResource, Port
+
+        nodes = build_cluster(4)
+        for n in nodes:
+            n.node_resources.cpu.cpu_shares = 100000
+            n.node_resources.memory.memory_mb = 100000
+            n.node_resources.networks[0].mbits = 50
+
+        def add_net(job):
+            task = job.task_groups[0].tasks[0]
+            task.resources.cpu = 10
+            task.resources.memory_mb = 10
+            task.resources.networks = [
+                NetworkResource(mbits=40, dynamic_ports=[Port(label="p")])
+            ]
+
+        job = make_job(12, mutate=add_net)  # 1 fits per node, 8 fail
+        _, s_oracle, _ = run(nodes, job, "service")
+        _, s_batch, _ = run(nodes, job, "tpu-batch")
+        m_oracle = s_oracle.failed_tg_allocs["web"]
+        m_batch = s_batch.failed_tg_allocs["web"]
+        assert "network: bandwidth exceeded" in m_oracle.dimension_exhausted
+        assert "network: bandwidth exceeded" in m_batch.dimension_exhausted
+        assert "disk" not in m_batch.dimension_exhausted
+
     def test_larger_parity_ratio(self):
         # 100 nodes x 80 allocs: allow tiny divergence from float rounding
         nodes = build_cluster(100)
