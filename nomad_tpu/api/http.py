@@ -931,6 +931,68 @@ class HTTPServer:
                 entries = [e for e in entries if e["level"] in keep]
         return {"Entries": entries, "Index": index}, None
 
+    @route("PUT", r"/v1/jobs/parse", acl="anonymous")
+    def jobs_parse(self, m, query, body):
+        """HCL jobspec → canonical job document (ref command/agent
+        job_endpoint.go JobsParseRequest): lets non-HCL clients submit
+        specs they got from users."""
+        from ..jobspec import parse_job
+
+        body = body or {}
+        hcl = body.get("JobHCL", "")
+        if not hcl:
+            raise ValueError("request must contain JobHCL")
+        return parse_job(hcl).to_dict(), None
+
+    @route("PUT", r"/v1/client/gc", acl="node:write")
+    def client_gc(self, m, query, body):
+        """Force the local client's alloc-dir GC (ref client_endpoint.go
+        GarbageCollect): reclaims every retained terminal alloc dir."""
+        clients = []
+        if self.agent is not None:
+            clients = getattr(self.agent, "clients", None) or [
+                getattr(self.agent, "client", None)
+            ]
+        reclaimed = 0
+        for client in clients:
+            if client is None:
+                continue
+            retained, client._terminal_alloc_dirs = (
+                client._terminal_alloc_dirs,
+                [],
+            )
+            for alloc_id in retained:
+                client._reclaim_alloc_dir(alloc_id)
+                reclaimed += 1
+        if not clients:
+            raise KeyError("this agent runs no client")
+        return {"Reclaimed": reclaimed}, None
+
+    @route("GET", r"/debug/pprof/(?P<profile>[a-z]*)", acl="agent:read")
+    def debug_pprof(self, m, query, body):
+        """Runtime introspection (the Go pprof handlers' role,
+        http.go:218-222): thread stacks + gc stats, gated on
+        enable_debug exactly like the reference."""
+        if not self.server.config.get("enable_debug"):
+            raise PermissionError("debug endpoints are disabled (enable_debug)")
+        import gc as gc_mod
+        import sys
+        import threading as threading_mod
+        import traceback
+
+        names = {t.ident: t.name for t in threading_mod.enumerate()}
+        stacks = {}
+        for ident, frame in sys._current_frames().items():
+            stacks[names.get(ident, str(ident))] = traceback.format_stack(frame)
+        return {
+            "threads": stacks,
+            "thread_count": len(stacks),
+            "gc": {
+                "counts": gc_mod.get_count(),
+                "stats": gc_mod.get_stats(),
+            },
+        }, None
+
     @route("PUT", r"/v1/validate/job", acl="ns:submit-job")
     def validate_job(self, m, query, body):
         """Dry validation without registering (ref job_endpoint.go

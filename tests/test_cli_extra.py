@@ -111,6 +111,71 @@ class TestMonitorAndStatus:
         assert code == 0 and "/ui/" in out
 
 
+class TestParseGcPprof:
+    def test_jobs_parse(self, cluster):
+        _, _, client = cluster
+        doc = client.put(
+            "/v1/jobs/parse",
+            body={
+                "JobHCL": 'job "parsed" { group "g" { count = 3 '
+                'task "t" { driver = "mock_driver" } } }'
+            },
+        )[0]
+        assert doc["id"] == "parsed"
+        assert doc["task_groups"][0]["count"] == 3
+        from nomad_tpu.api.client import APIError
+
+        with pytest.raises(APIError):
+            client.put("/v1/jobs/parse", body={"JobHCL": "job ==="})
+
+    def test_client_gc_reclaims_retained_alloc_dirs(self, cluster):
+        import os
+
+        agent, _, client = cluster
+        job = mock.job()
+        job.id = "gc-dir-job"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "mock_driver"
+        tg.tasks[0].config = {"run_for": "120s"}
+        tg.tasks[0].resources.networks = []
+        # tiny ask: the module-scoped agent already runs earlier tests' jobs
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 10
+        agent.server.job_register(job)
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            allocs = agent.server.state.allocs_by_job(job.namespace, job.id)
+            if allocs and allocs[0].client_status == "running":
+                break
+            time.sleep(0.05)
+        (alloc,) = agent.server.state.allocs_by_job(job.namespace, job.id)
+        d = os.path.join(agent.clients[0].data_dir, "allocs", alloc.id)
+        assert os.path.isdir(d)
+        # job stop: the client destroys the runner and RETAINS the dir
+        # for log access; forced client GC then reclaims it
+        agent.server.job_deregister(job.namespace, job.id, purge=False)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if alloc.id in agent.clients[0]._terminal_alloc_dirs:
+                break
+            time.sleep(0.05)
+        assert alloc.id in agent.clients[0]._terminal_alloc_dirs
+        assert os.path.isdir(d), "dir retained until GC for log access"
+        out = client.put("/v1/client/gc")[0]
+        assert out["Reclaimed"] >= 1
+        assert not os.path.isdir(d)
+
+    def test_pprof_gated_on_enable_debug(self, cluster):
+        _, _, client = cluster
+        from nomad_tpu.api.client import APIError
+
+        with pytest.raises(APIError) as err:
+            client.get("/debug/pprof/")
+        assert err.value.status == 403
+
+
 class TestAclCommands:
     def test_acl_lifecycle(self, capsys, tmp_path):
         """ACL commands against an ACL-enabled agent: bootstrap, policy
