@@ -16,9 +16,11 @@ Parity at bench scale is measured two ways:
   * parity_exact  — the fast-path (runs/windowed) placements vs the exact
     one-step-per-placement scan kernel over ALL 50K placements (the exact
     scan is itself oracle-validated by tests/test_tpu_parity.py), and
-  * parity_oracle — the scalar oracle run for the first K placements of the
-    very same eval (a placement depends only on its predecessors, so the
-    truncated prefix is exact) compared position-by-position.
+  * parity_oracle — the scalar oracle re-run position-by-position over four
+    windows of the very same eval: the empty-state prefix plus mid-sequence
+    windows restarted from the kernel's own intermediate state at 20/50/80%
+    (valid because placement i depends only on its predecessors), checking
+    ≥1% of the full-scale placements directly against the oracle.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "detail": ...}
@@ -39,17 +41,30 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
 N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", "50000"))
-PARITY_K = int(os.environ.get("BENCH_PARITY_K", "48"))
+#: oracle placements checked PER WINDOW (4 windows: empty-prefix + mid-
+#: sequence at 20/50/80% — ≥1% of the 50K placements oracle-checked total)
+PARITY_K = int(os.environ.get("BENCH_PARITY_K", "128"))
 TARGET_S = 1.0
 
 
 def build_nodes(n, networks=False, devices_every=0):
-    """Heterogeneous cluster: 4 hardware classes x 4 datacenters."""
+    """Heterogeneous cluster: 4 hardware classes x 4 datacenters. Node IDs
+    are deterministic (seeded) so parity workers in other processes can
+    rebuild the byte-identical cluster instead of pickling 10K nodes."""
     from nomad_tpu import mock
     from nomad_tpu.structs import compute_class
-    from nomad_tpu.structs.model import generate_uuid
 
     rng = random.Random(7)
+    idrng = random.Random(7001)
+
+    def det_uuid():
+        return "%08x-%04x-%04x-%04x-%012x" % (
+            idrng.getrandbits(32),
+            idrng.getrandbits(16),
+            idrng.getrandbits(16),
+            idrng.getrandbits(16),
+            idrng.getrandbits(48),
+        )
     # build one template per class, then stamp copies (compute_class is
     # identical within a class, so hash once)
     templates = []
@@ -80,7 +95,7 @@ def build_nodes(n, networks=False, devices_every=0):
         else:
             t = templates[rng.randrange(len(templates))]
         node = t.copy()
-        node.id = generate_uuid()
+        node.id = det_uuid()
         nodes.append(node)
     return nodes
 
@@ -208,6 +223,110 @@ def parity(a: dict, b: dict, keys=None) -> float:
     return sum(1 for k in keys if a.get(k) == b.get(k)) / len(keys)
 
 
+def _alloc_index(name: str) -> int:
+    return int(name.rsplit("[", 1)[1][:-1])
+
+
+def _oracle_window_worker(payload):
+    """Run the scalar oracle for placements [M, M+K) of the headline eval
+    and return {name: node_id} for those K.
+
+    Valid mid-sequence because placement i depends only on its
+    predecessors: the state after the fast path's first M placements is
+    reconstructed exactly by inserting M live allocs matching them (same
+    usage, job-anti-affinity collisions, and spread counts the scan carry
+    held at step M; verified against the exact-scan kernel re-run from the
+    same reconstruction). The allocs carry the STORE's job copy so the
+    reconciler sees them as current — a job_modify_index mismatch would
+    in-place-update them into the plan and double-count every spread/anti
+    plane (propertyset.go combines existing + proposed)."""
+    import pickle
+
+    M, K, job_blob, placed_items, n_nodes, seed = payload
+    job = pickle.loads(job_blob)
+    placed = dict(placed_items)
+    names = sorted(placed, key=_alloc_index)
+
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs.model import (
+        ALLOC_CLIENT_STATUS_RUNNING,
+        ALLOC_DESIRED_STATUS_RUN,
+        AllocatedCpuResources,
+        AllocatedMemoryResources,
+        AllocatedResources,
+        AllocatedSharedResources,
+        AllocatedTaskResources,
+        Allocation,
+        generate_uuid,
+    )
+
+    state = StateStore()
+    state.upsert_nodes(1, build_nodes(n_nodes))
+    state.upsert_job(2, job)
+    stored_job = state.job_by_id(job.namespace, job.id)
+    tg = job.task_groups[0]
+    task = tg.tasks[0]
+    allocs = []
+    for i in range(M):
+        nm = names[i]
+        a = Allocation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            job_id=job.id,
+            task_group=tg.name,
+            name=nm,
+            node_id=placed[nm],
+            desired_status=ALLOC_DESIRED_STATUS_RUN,
+            client_status=ALLOC_CLIENT_STATUS_RUNNING,
+            allocated_resources=AllocatedResources(
+                tasks={
+                    task.name: AllocatedTaskResources(
+                        cpu=AllocatedCpuResources(cpu_shares=task.resources.cpu),
+                        memory=AllocatedMemoryResources(
+                            memory_mb=task.resources.memory_mb
+                        ),
+                    )
+                },
+                shared=AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb
+                ),
+            ),
+        )
+        a.job = stored_job
+        allocs.append(a)
+    if allocs:
+        state.upsert_allocs(3, allocs)
+
+    _, placed_oracle = run_once(state, job, factory="service", prefix=K, seed=seed)
+    return M, {k: placed_oracle.get(k) for k in names[M : M + K]}
+
+
+def oracle_parity_windows(job, placed_fast, windows, seed=11):
+    """Scalar-oracle parity over several windows of the full-scale eval,
+    run in parallel worker processes (each window is independent; the
+    oracle costs ~0.4s/placement at 10K nodes). Returns
+    (matched, checked, per_window)."""
+    import pickle
+    from concurrent.futures import ProcessPoolExecutor
+    import multiprocessing as mp
+
+    job_blob = pickle.dumps(job)
+    items = list(placed_fast.items())
+    payloads = [(M, K, job_blob, items, N_NODES, seed) for M, K in windows]
+    ctx = mp.get_context("spawn")
+    matched = checked = 0
+    per_window = {}
+    with ProcessPoolExecutor(
+        max_workers=min(len(payloads), 4), mp_context=ctx
+    ) as pool:
+        for M, got in pool.map(_oracle_window_worker, payloads):
+            m = sum(1 for k, v in got.items() if v == placed_fast.get(k))
+            matched += m
+            checked += len(got)
+            per_window[M] = round(m / max(len(got), 1), 5)
+    return matched, checked, per_window
+
+
 def bench_headline():
     from nomad_tpu.state import StateStore
     from nomad_tpu.tpu import batch_sched
@@ -229,7 +348,14 @@ def bench_headline():
         },
     )
 
-    # warmup: triggers XLA compilation for these shapes
+    # backend init first (TPU client connect is seconds of one-off latency
+    # and not compilation — keep it out of the compile_s measurement)
+    import jax.numpy as jnp
+
+    jnp.zeros(8).block_until_ready()
+
+    # warmup: triggers XLA compilation for these shapes (or a persistent-
+    # cache load when a previous process compiled them; tpu/__init__.py)
     run_once(state, job)
     warm = dict(batch_sched.LAST_KERNEL_STATS)
 
@@ -253,9 +379,23 @@ def bench_headline():
         batch_sched.EXACT_ONLY = False
     parity_exact = parity(placed_exact, placed_fast)
 
-    # parity, oracle link: scalar oracle prefix of the same eval
-    oracle_s, placed_oracle = run_once(state, job, factory="service", prefix=PARITY_K)
-    parity_oracle = parity(placed_oracle, placed_fast, keys=placed_oracle)
+    # parity, oracle link: scalar oracle re-run for 4 windows of the very
+    # same eval — the empty-state prefix plus mid-sequence windows started
+    # from the kernel's own intermediate state at 20/50/80% (valid because
+    # placement i depends only on its predecessors); ≥1% of the full-scale
+    # placements are oracle-checked position-by-position
+    if PARITY_K > 0:
+        windows = [(0, PARITY_K)] + [
+            (int(N_ALLOCS * f), PARITY_K) for f in (0.2, 0.5, 0.8)
+        ]
+        t_or = time.monotonic()
+        matched, checked, per_window = oracle_parity_windows(
+            job, placed_fast, windows
+        )
+        oracle_s = time.monotonic() - t_or
+        parity_oracle = matched / max(checked, 1)
+    else:
+        checked, per_window, oracle_s, parity_oracle = 0, {}, 0.0, 0.0
 
     return {
         "end_to_end_s": round(elapsed, 4),
@@ -267,8 +407,10 @@ def bench_headline():
         "spread": spread,
         "compile_s": round(warm.get("kernel_s", 0.0), 4),
         "parity_exact_full": round(parity_exact, 5),
-        "parity_oracle_prefix": round(parity_oracle, 5),
-        "parity_oracle_k": PARITY_K,
+        "parity_oracle": round(parity_oracle, 5),
+        "parity_oracle_checked": checked,
+        "parity_oracle_windows": per_window,
+        "parity_oracle_wall_s": round(oracle_s, 2),
         "exact_scan_s": round(exact_s, 4),
     }
 
@@ -460,75 +602,114 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
 
 def bench_config5(n_nodes=10000):
     """Mixed service+system jobs with device{} asks + NetworkIndex port
-    collisions at 10K nodes. Devices and ports are exact-semantics host
-    paths, so these evals exercise the scalar-oracle fallback inside
-    tpu-batch; the value is honest end-to-end wall-clock for that path."""
+    collisions at 10K nodes. Bandwidth and device counts ride the kernel as
+    dense resource columns; exact port numbers and device instance IDs are
+    host post-passes on the winners (SURVEY §7 step 4). One untimed warmup
+    pass pays XLA compilation for these shapes (same methodology as the
+    headline/config3 steady-state measurement); counts are from the timed
+    pass."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler import Harness
     from nomad_tpu.structs.model import Constraint, NetworkResource, Port, RequestedDevice
 
-    h = Harness(seed=29)
     nodes = build_nodes(n_nodes, networks=True, devices_every=10)
-    for n in nodes:
-        h.state.upsert_node(h.next_index(), n)
 
-    # service job with dynamic ports + a reserved port (port collisions: two
-    # allocs with the same reserved port can never share a node)
-    port_job = mock.job()
-    port_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
-    tg = port_job.task_groups[0]
-    tg.count = 1000
-    tg.tasks[0].resources.cpu = 100
-    tg.tasks[0].resources.memory_mb = 64
-    tg.tasks[0].resources.networks = [
-        NetworkResource(
-            mbits=10,
-            dynamic_ports=[Port(label="http"), Port(label="admin")],
+    def fresh_harness():
+        h = Harness(seed=29)
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), n)
+        return h
+
+    def make_jobs():
+        # service job with dynamic ports (port numbers arbitrated host-side
+        # per winner; two allocs can never double-book a port on a node)
+        port_job = mock.job()
+        port_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        tg = port_job.task_groups[0]
+        tg.count = 1000
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 64
+        tg.tasks[0].resources.networks = [
+            NetworkResource(
+                mbits=10,
+                dynamic_ports=[Port(label="http"), Port(label="admin")],
+            )
+        ]
+
+        # service job asking for a TPU device
+        dev_job = mock.job()
+        dev_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        dtg = dev_job.task_groups[0]
+        dtg.count = 200
+        dtg.tasks[0].resources.cpu = 100
+        dtg.tasks[0].resources.memory_mb = 64
+        dtg.tasks[0].resources.networks = []
+        dtg.tasks[0].resources.devices = [RequestedDevice(name="tpu", count=1)]
+
+        # system job constrained to the device nodes (one alloc per node)
+        sys_job = mock.system_job()
+        sys_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        sys_job.constraints.append(
+            Constraint(l_target="${attr.tpu.count}", r_target="0", operand=">")
         )
-    ]
-
-    # service job asking for a TPU device
-    dev_job = mock.job()
-    dev_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
-    dtg = dev_job.task_groups[0]
-    dtg.count = 200
-    dtg.tasks[0].resources.cpu = 100
-    dtg.tasks[0].resources.memory_mb = 64
-    dtg.tasks[0].resources.networks = []
-    dtg.tasks[0].resources.devices = [RequestedDevice(name="tpu", count=1)]
-
-    # system job constrained to the device nodes (one alloc per feasible node)
-    sys_job = mock.system_job()
-    sys_job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
-    sys_job.constraints.append(
-        Constraint(l_target="${attr.tpu.count}", r_target="0", operand=">")
-    )
-    stg = sys_job.task_groups[0]
-    stg.tasks[0].resources.cpu = 50
-    stg.tasks[0].resources.memory_mb = 32
-    stg.tasks[0].resources.networks = []
-
-    t0 = time.monotonic()
-    placed = {}
-    for job, factory in (
-        (port_job, "tpu-batch"),
-        (dev_job, "tpu-batch"),
-        (sys_job, "system"),
-    ):
-        h.state.upsert_job(h.next_index(), job)
-        ev = make_eval(job)
-        h.state.upsert_evals(h.next_index(), [ev])
-        h.process(factory, ev)
-        placed[job.id] = sum(
-            1 for a in h.state.allocs_by_job(job.namespace, job.id)
+        stg = sys_job.task_groups[0]
+        stg.tasks[0].resources.cpu = 50
+        stg.tasks[0].resources.memory_mb = 32
+        stg.tasks[0].resources.networks = []
+        return (
+            (port_job, "tpu-batch"),
+            (dev_job, "tpu-batch"),
+            (sys_job, "tpu-system"),
         )
-    elapsed = time.monotonic() - t0
+
+    def run(jobs):
+        # fresh cluster per sample: every run schedules identical work
+        # against the identical empty state (the headline gets this for
+        # free from its NullPlanner; the Harness applies plans, so reusing
+        # one would load the cluster a little more each sample)
+        h = fresh_harness()
+        t0 = time.monotonic()
+        placed = []
+        for job, factory in jobs:
+            h.state.upsert_job(h.next_index(), job)
+            ev = make_eval(job)
+            h.state.upsert_evals(h.next_index(), [ev])
+            h.process(factory, ev)
+            placed.append(
+                sum(1 for a in h.state.allocs_by_job(job.namespace, job.id))
+            )
+        return time.monotonic() - t0, placed
+
+    from nomad_tpu.tpu.batch_sched import counters_snapshot
+
+    def reasons_delta(before, after):
+        return {
+            k: v - before.get(k, 0)
+            for k, v in after.items()
+            if v - before.get(k, 0)
+        }
+
+    compile_s, _ = run(make_jobs())  # warmup: XLA compiles for these shapes
+    # steady-state: best of 3 (same chip-load-noise guard as the headline)
+    before = counters_snapshot()["fallback_reasons"]
+    samples = []
+    elapsed, placed = None, None
+    for _ in range(3):
+        t, p = run(make_jobs())
+        samples.append(round(t, 4))
+        if elapsed is None or t < elapsed:
+            elapsed, placed = t, p
+    after = counters_snapshot()["fallback_reasons"]
+
     return {
         "nodes": n_nodes,
         "wall_s": round(elapsed, 4),
-        "port_allocs": placed[port_job.id],
-        "device_allocs": placed[dev_job.id],
-        "system_allocs": placed[sys_job.id],
+        "samples_s": samples,
+        "first_run_s": round(compile_s, 4),
+        "port_allocs": placed[0],
+        "device_allocs": placed[1],
+        "system_allocs": placed[2],
+        "fallback_reasons": reasons_delta(before, after),
     }
 
 
@@ -541,7 +722,7 @@ def main():
         detail["config5"] = bench_config5()
         detail["drain"] = bench_drain()
     e2e = headline["end_to_end_s"]
-    parities = [headline["parity_exact_full"], headline["parity_oracle_prefix"]]
+    parities = [headline["parity_exact_full"], headline["parity_oracle"]]
     detail["parity"] = round(min(parities), 5)
     suffix = "_spread" if headline["spread"] else ""
     result = {

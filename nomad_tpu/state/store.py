@@ -157,16 +157,54 @@ class StateReader:
     def allocs(self) -> Iterable[Allocation]:
         return self._gen.allocs.values()
 
+    def _alloc_node_index(self) -> dict[str, list[Allocation]]:
+        """Lazy per-generation secondary index node_id → allocs (the memdb
+        ``alloc.node_id`` index, schema.go:472). Generations are immutable
+        after publication, so the index is built at most once per generation
+        on first by-node read and shared by every snapshot of it; one build
+        costs the same single table scan a lone allocs_by_node() used to,
+        after which lookups are O(allocs on node) — the difference between
+        O(A) and O(A²) for per-node sweeps like the port/device post-passes.
+        Benign if two threads race: both build identical maps and the
+        attribute publish is atomic."""
+        gen = self._gen
+        idx = gen.__dict__.get("_by_node")
+        if idx is None:
+            idx = {}
+            for a in gen.allocs.values():
+                bucket = idx.get(a.node_id)
+                if bucket is None:
+                    bucket = idx[a.node_id] = []
+                bucket.append(a)
+            object.__setattr__(gen, "_by_node", idx)
+        return idx
+
+    def _alloc_job_index(self) -> dict[tuple[str, str], list[Allocation]]:
+        """Lazy per-generation index (namespace, job_id) → allocs; same
+        contract as ``_alloc_node_index``."""
+        gen = self._gen
+        idx = gen.__dict__.get("_by_job")
+        if idx is None:
+            idx = {}
+            for a in gen.allocs.values():
+                key = (a.namespace, a.job_id)
+                bucket = idx.get(key)
+                if bucket is None:
+                    bucket = idx[key] = []
+                bucket.append(a)
+            object.__setattr__(gen, "_by_job", idx)
+        return idx
+
     def allocs_by_node(self, node_id: str) -> list[Allocation]:
-        return [a for a in self._gen.allocs.values() if a.node_id == node_id]
+        return list(self._alloc_node_index().get(node_id, ()))
 
     def allocs_by_node_terminal(
         self, node_id: str, terminal: bool
     ) -> list[Allocation]:
         return [
             a
-            for a in self._gen.allocs.values()
-            if a.node_id == node_id and a.terminal_status() == terminal
+            for a in self._alloc_node_index().get(node_id, ())
+            if a.terminal_status() == terminal
         ]
 
     def allocs_by_job(
@@ -175,11 +213,7 @@ class StateReader:
         """Allocs for a job; with any_create_index=False only allocs belonging
         to the currently registered incarnation of the job are returned
         (ref state_store.go AllocsByJob)."""
-        out = [
-            a
-            for a in self._gen.allocs.values()
-            if a.namespace == namespace and a.job_id == job_id
-        ]
+        out = list(self._alloc_job_index().get((namespace, job_id), ()))
         if not any_create_index:
             job = self._gen.jobs.get((namespace, job_id))
             if job is not None:

@@ -24,14 +24,15 @@ def allocs_fit(
     checks cpu/memory/disk superset, then port collisions / bandwidth via the
     NetworkIndex, then optional device oversubscription.
     """
+    resources, reserved = node.comparable_cached()
     used = ComparableResources()
-    used.add(node.comparable_reserved_resources())
+    used.add(reserved)
     for alloc in allocs:
         if alloc.terminal_status() or alloc.allocated_resources is None:
             continue
-        used.add(alloc.comparable_resources())
+        used.add(alloc.comparable_cached())
 
-    superset, dimension = node.comparable_resources().superset(used)
+    superset, dimension = resources.superset(used)
     if not superset:
         return False, dimension, used
 
@@ -55,8 +56,7 @@ def score_fit(node: Node, util: ComparableResources) -> float:
     """Bin-packing score: 20 - (10^freeCpuPct + 10^freeMemPct), clamped to
     [0, 18] — BestFit v3 from the Google datacenter-scheduling slides
     (ref funcs.go:154-188)."""
-    reserved = node.comparable_reserved_resources()
-    res = node.comparable_resources()
+    res, reserved = node.comparable_cached()
 
     node_cpu = float(res.flattened.cpu.cpu_shares)
     node_mem = float(res.flattened.memory.memory_mb)

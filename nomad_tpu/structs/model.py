@@ -573,6 +573,22 @@ class Node(Base):
             return None
         return self.reserved_resources.comparable()
 
+    def comparable_cached(self) -> tuple:
+        """(resources, reserved) as SHARED read-only ComparableResources —
+        built once per node object. Callers must never mutate the result
+        (use the uncached accessors for that, e.g. Preemptor.set_node which
+        subtracts in place). Safe because published nodes are immutable and
+        the dict-roundtrip copy() drops this cache; rebuilding
+        ComparableResources per score was ~35% of the oracle's per-option
+        cost at 10K nodes."""
+        cr = self.__dict__.get("_cr")
+        if cr is None:
+            cr = self.__dict__["_cr"] = (
+                self.comparable_resources(),
+                self.comparable_reserved_resources(),
+            )
+        return cr
+
     def terminal_status(self) -> bool:
         return self.status == NODE_STATUS_DOWN
 
@@ -1073,6 +1089,16 @@ class Allocation(Base):
 
     def comparable_resources(self) -> ComparableResources:
         return self.allocated_resources.comparable()
+
+    def comparable_cached(self) -> ComparableResources:
+        """SHARED read-only comparable view, built once per alloc object.
+        Valid because allocated_resources is immutable after placement
+        (mutation paths clone the alloc; fast_alloc_clone shares it, which
+        keeps the cache correct). Callers must not mutate the result."""
+        cr = self.__dict__.get("_cr")
+        if cr is None:
+            cr = self.__dict__["_cr"] = self.comparable_resources()
+        return cr
 
     def ran_successfully(self) -> bool:
         return any(ts.successful() for ts in self.task_states.values()) and not any(

@@ -194,10 +194,10 @@ class TPUBatchScheduler(GenericScheduler):
             return super()._compute_placements(destructive, place)
         groups = {p.task_group.name: p.task_group for p in place}
         if not all(
-            kernel_supported(self.job, tg, allow_networks=True)
+            kernel_supported(self.job, tg, allow_networks=True, allow_devices=True)
             for tg in groups.values()
         ):
-            _count_fallback("unsupported_group")  # ports/devices/distinct_*
+            _count_fallback("unsupported_group")  # reserved ports/distinct_*
             return super()._compute_placements(destructive, place)
 
         nodes, by_dc = self.state.ready_nodes_in_dcs(self.job.datacenters)
@@ -333,6 +333,25 @@ class TPUBatchScheduler(GenericScheduler):
         cluster = ColumnarCluster.shared(self.state, nodes)
         if self._multi_nic_network_escape(place, cluster):
             return super()._compute_placements([], place)
+        dev_entries, dev_escape = self._device_asks(place)
+        if dev_escape:
+            _count_fallback("device_mixed_signature")
+            return super()._compute_placements([], place)
+        dev_plane = None
+        if dev_entries:
+            ask0 = next(iter(dev_entries.values()))[1][0][1]
+            dev_plane = cluster.device_plane(ask0)
+            max_count = max(
+                d.count
+                for _, (tg, asks) in dev_entries.items()
+                for _, d in asks
+            )
+            if dev_plane[2] and max_count > 1:
+                # the summed column can't promise ``count`` instances from
+                # one group (assign_device's contract) when a node carries
+                # several matching groups — those evals ride the oracle
+                _count_fallback("device_multi_group")
+                return super()._compute_placements([], place)
 
         # Same seeded shuffle the oracle's stack.set_nodes performs
         shuffled = list(nodes)
@@ -344,11 +363,35 @@ class TPUBatchScheduler(GenericScheduler):
         )
         G = len(planes_list)
 
+        capacity_real = cluster.capacity
+        used0_real = cluster.initial_used(self.state, self.plan)
+        dev_match_sets = None
+        if dev_entries:
+            # dense device column (SURVEY §7: feasibility/accounting on
+            # device, instance-ID arbitration host-side per winner): free
+            # matching instances become the 5th resource column and each
+            # group's ask count its demand entry
+            dev_capacity, dev_match_sets, _ = dev_plane
+            dev_used0 = cluster.device_used(
+                self.state, dev_match_sets, self.plan
+            )
+            capacity_real = np.concatenate(
+                [capacity_real, dev_capacity[:, None].astype(np.int64)], axis=1
+            )
+            used0_real = np.concatenate(
+                [used0_real, dev_used0[:, None].astype(np.int64)], axis=1
+            )
+            dev_counts = np.zeros(G, dtype=np.int32)
+            for name, (tg, asks) in dev_entries.items():
+                if name in g_index:
+                    dev_counts[g_index[name]] = sum(d.count for _, d in asks)
+            g_demand = np.concatenate([g_demand, dev_counts[:, None]], axis=1)
+
         # pad node axis
         N = _bucket(n_real)
-        capacity = _pad_to(cluster.capacity, N).astype(np.int32)
+        capacity = _pad_to(capacity_real, N).astype(np.int32)
         usable = _pad_to(cluster.usable, N, fill=1.0).astype(np.float32)
-        used0 = _pad_to(cluster.initial_used(self.state, self.plan), N, fill=2**30).astype(np.int32)
+        used0 = _pad_to(used0_real, N, fill=2**30).astype(np.int32)
         perm = np.concatenate(
             [perm_real, np.arange(n_real, N, dtype=np.int32)]
         )
@@ -396,7 +439,7 @@ class TPUBatchScheduler(GenericScheduler):
         A = _bucket(a_real)
         group_ids = np.zeros(A, dtype=np.int32)
         group_ids[:a_real] = gid_real
-        demands = np.zeros((A, R_COLS), dtype=np.int32)
+        demands = np.zeros((A, g_demand.shape[1]), dtype=np.int32)
         demands[:a_real] = g_demand[gid_real]
         limits = np.zeros(A, dtype=np.int32)
         limits[:a_real] = g_limit[gid_real]
@@ -459,6 +502,7 @@ class TPUBatchScheduler(GenericScheduler):
             self._materialize(
                 place, placements, nodes, by_dc, planes_list, g_index,
                 gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+                dev_entries=dev_entries,
             )
             return
 
@@ -504,6 +548,7 @@ class TPUBatchScheduler(GenericScheduler):
             self._materialize(
                 place, placements, nodes, by_dc, planes_list, g_index,
                 gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+                dev_entries=dev_entries,
             )
             return
 
@@ -550,6 +595,7 @@ class TPUBatchScheduler(GenericScheduler):
         self._materialize(
             place, placements, nodes, by_dc, planes_list, g_index,
             gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+            dev_entries=dev_entries,
         )
 
     # ------------------------------------------------------------------
@@ -579,15 +625,14 @@ class TPUBatchScheduler(GenericScheduler):
         over = used_final + demand[None, :] > capacity[:n_real]
         exhausted = feasible & over.any(axis=1)
         metrics.nodes_exhausted = int(exhausted.sum())
-        first_dim = np.where(
-            over[:, 0],
-            0,
-            np.where(over[:, 1], 1, np.where(over[:, 2], 2, 3)),
-        )
-        for d, name in enumerate(("cpu", "memory", "disk", "network: bandwidth exceeded")):
+        # first failing dimension in superset-check order (argmax = first
+        # True; rows with no True are masked out by ``exhausted``)
+        first_dim = np.argmax(over, axis=1)
+        names = ("cpu", "memory", "disk", "network: bandwidth exceeded", "devices")
+        for d in range(over.shape[1]):
             c = int((exhausted & (first_dim == d)).sum())
             if c:
-                metrics.dimension_exhausted[name] = c
+                metrics.dimension_exhausted[names[d]] = c
         return metrics
 
     # ------------------------------------------------------------------
@@ -598,6 +643,30 @@ class TPUBatchScheduler(GenericScheduler):
             for p in place
             for t in p.task_group.tasks
         )
+
+    @staticmethod
+    def _device_asks(place):
+        """Collect device asks per task group for the dense 5th-column path:
+        returns ({tg_name: (tg, [(task_name, ask), ...])}, escape). Escape is
+        True when the eval's groups ask for more than one distinct device
+        signature — one shared count column can't account two different
+        device populations, so those (rare) evals ride the oracle."""
+        entries = {}
+        sigs = set()
+        for p in place:
+            tg = p.task_group
+            if tg.name in entries:
+                continue
+            asks = [
+                (t.name, d)
+                for t in tg.tasks
+                for d in t.resources.devices
+            ]
+            if asks:
+                entries[tg.name] = (tg, asks)
+                for _, d in asks:
+                    sigs.add(d.device_id())
+        return entries, len(sigs) > 1
 
     def _multi_nic_network_escape(self, place, cluster) -> bool:
         """AssignNetwork enforces bandwidth PER DEVICE; the dense sum is
@@ -663,10 +732,54 @@ class TPUBatchScheduler(GenericScheduler):
             None,
         )
 
+    def _assign_devices(self, node, entry, accounters):
+        """Concrete device-instance arbitration on the kernel's chosen node
+        (the oracle's device.go:40-131 assignment, replayed host-side
+        post-choice). One DeviceAllocator per touched node, lazily fed the
+        node's live allocs + this plan's earlier grants; returns
+        ({task_name: [AllocatedDeviceResource]}, None) or (None, error)."""
+        from ..scheduler.device import DeviceAllocator
+        from ..structs.model import remove_allocs
+
+        tg, asks = entry
+        acc = accounters.get(node.id)
+        if acc is None:
+            acc = DeviceAllocator(self.ctx, node)
+            existing = self.state.allocs_by_node_terminal(node.id, False)
+            stops = self.plan.node_update.get(node.id, [])
+            if stops:
+                existing = remove_allocs(existing, stops)
+            acc.add_allocs(existing)
+            for prior in self.plan.node_allocation.get(node.id, []):
+                if prior.allocated_resources is not None:
+                    for tr in prior.allocated_resources.tasks.values():
+                        for dr in tr.devices:
+                            acc.add_reserved(dr)
+            accounters[node.id] = acc
+        offers: dict[str, list] = {}
+        granted: list = []
+        for task_name, ask in asks:
+            offer, _score, err = acc.assign_device(ask)
+            if offer is None:
+                # roll back earlier grants of this alloc — the accounter is
+                # shared by every later winner on this node, and phantom
+                # usage from a half-assigned alloc would cascade failures
+                for prior in granted:
+                    inst = acc.devices.get(prior.device_id())
+                    if inst is not None:
+                        for iid in prior.device_ids:
+                            if iid in inst.instances:
+                                inst.instances[iid] -= 1
+                return None, err
+            acc.add_reserved(offer)
+            granted.append(offer)
+            offers.setdefault(task_name, []).append(offer)
+        return offers, None
+
     def _materialize(
         self, place, placements, nodes, by_dc, planes_list, g_index,
         gid_real, used0, capacity, g_demand, t_dispatch=None, eligible=None,
-        shared_net_indexes=None, shared_net_lock=None,
+        shared_net_indexes=None, shared_net_lock=None, dev_entries=None,
     ):
         import time
 
@@ -753,7 +866,23 @@ class TPUBatchScheduler(GenericScheduler):
             shared_net_indexes if shared_net_indexes is not None else {}
         )
         net_lock = shared_net_lock
+        dev_accounters: dict = {}
         DT = DesiredTransition
+
+        def record_exhaustion(tg_name: str, label: str):
+            # post-pass assignment failed on the chosen node — record the
+            # oracle's label (rank.py exhausted_node)
+            metric = self.failed_tg_allocs.get(tg_name)
+            if metric is None:
+                metric = AllocMetric()
+                metric.nodes_evaluated = n_evaluated
+                metric.nodes_available = dict(by_dc)
+                metric.nodes_exhausted = 1
+                metric.dimension_exhausted = {label: 1}
+                self.failed_tg_allocs[tg_name] = metric
+            else:
+                metric.coalesced_failures += 1
+
         for i in success:
             p = place[i]
             node_idx = placed_list[i]
@@ -772,20 +901,40 @@ class TPUBatchScheduler(GenericScheduler):
                             nodes[node_idx], entry, net_indexes
                         )
                     if resources is None:
-                        # assignment failed on the chosen node — record the
-                        # oracle's label (rank.py exhausted_node)
-                        metric = self.failed_tg_allocs.get(p.task_group.name)
-                        if metric is None:
-                            metric = AllocMetric()
-                            metric.nodes_evaluated = n_evaluated
-                            metric.nodes_available = dict(by_dc)
-                            metric.nodes_exhausted = 1
-                            metric.dimension_exhausted = {f"network: {err}": 1}
-                            self.failed_tg_allocs[p.task_group.name] = metric
-                        else:
-                            metric.coalesced_failures += 1
+                        record_exhaustion(p.task_group.name, f"network: {err}")
                         continue
                     overrides["allocated_resources"] = resources
+            if dev_entries:
+                entry = dev_entries.get(p.task_group.name)
+                if entry is not None:
+                    offers, err = self._assign_devices(
+                        nodes[node_idx], entry, dev_accounters
+                    )
+                    if offers is None:
+                        record_exhaustion(p.task_group.name, f"devices: {err}")
+                        continue
+                    resources = overrides.get("allocated_resources")
+                    if resources is None:
+                        tg = entry[0]
+                        resources = AllocatedResources(
+                            tasks={
+                                t.name: AllocatedTaskResources(
+                                    cpu=AllocatedCpuResources(
+                                        cpu_shares=t.resources.cpu
+                                    ),
+                                    memory=AllocatedMemoryResources(
+                                        memory_mb=t.resources.memory_mb
+                                    ),
+                                )
+                                for t in tg.tasks
+                            },
+                            shared=AllocatedSharedResources(
+                                disk_mb=tg.ephemeral_disk.size_mb
+                            ),
+                        )
+                        overrides["allocated_resources"] = resources
+                    for task_name, offer_list in offers.items():
+                        resources.tasks[task_name].devices.extend(offer_list)
             alloc = alloc_new(Allocation)
             alloc.__dict__ = dict(
                 template_by_group[p.task_group.name],

@@ -104,6 +104,8 @@ class ColumnarCluster:
         # per-(job version, group) feasibility/affinity/spread planes —
         # valid for this cluster's exact node set (see build_group_planes)
         self.planes_cache: dict = {}
+        # per-ask-ID dense device capacity planes (see device_plane)
+        self.device_planes_cache: dict = {}
 
     @classmethod
     def shared(cls, state, nodes: list[Node]) -> "ColumnarCluster":
@@ -137,7 +139,7 @@ class ColumnarCluster:
         for a in allocs:
             if a.allocated_resources is None:
                 continue
-            c = a.comparable_resources()
+            c = a.comparable_cached()
             used[0] += c.flattened.cpu.cpu_shares
             used[1] += c.flattened.memory.memory_mb
             used[2] += c.shared.disk_mb
@@ -186,6 +188,88 @@ class ColumnarCluster:
             self.sum_alloc_usage(allocs, into=used[i])
         return used
 
+    def device_plane(self, ask) -> tuple[np.ndarray, list, bool]:
+        """Dense device capacity for one constraint-free ask: per node, the
+        count of healthy instances in device groups whose ID matches the
+        ask (feasible.go:1007-1012 ID match only — constraint-bearing asks
+        never reach this path), plus per-node {matching DeviceIdTuple →
+        healthy instance-id set} for the usage counter. Also returns
+        whether any node has MORE THAN ONE matching group: the summed
+        column is exact there only for count-1 asks (total free ≥ 1 ⇒ some
+        single group has a free instance), while assign_device requires all
+        ``count`` instances from one group — multi-instance asks on such
+        clusters must escape to the oracle. Cached per cluster by the
+        ask's ID tuple; node devices are static for the cluster's life."""
+        key = ask.device_id()
+        cached = self.device_planes_cache.get(key)
+        if cached is not None:
+            return cached
+        n = len(self.nodes)
+        capacity = np.zeros(n, dtype=np.int32)
+        match_sets: list = [None] * n
+        multi_group = False
+        for i, node in enumerate(self.nodes):
+            res = node.node_resources
+            if res is None or not res.devices:
+                continue
+            matched = None
+            total = 0
+            for dev in res.devices:
+                if not dev.device_id().matches(key):
+                    continue
+                if matched is None:
+                    matched = {}
+                elif dev.device_id() not in matched:
+                    multi_group = True
+                healthy = {
+                    inst.id for inst in dev.instances if inst.healthy
+                }
+                matched.setdefault(dev.device_id(), set()).update(healthy)
+                total += len(healthy)
+            capacity[i] = total
+            match_sets[i] = matched
+        self.device_planes_cache[key] = (capacity, match_sets, multi_group)
+        return capacity, match_sets, multi_group
+
+    def device_used(self, state, match_sets: list, plan=None) -> np.ndarray:
+        """Per-node count of matching HEALTHY device instances consumed by
+        live allocs (DeviceAccounter.add_allocs' accounting, devices.go:
+        35-55 — instances held on now-unhealthy devices don't count, since
+        the accounter drops them from its table and the capacity column
+        above counts healthy only), minus any plan-stopped allocs and plus
+        the plan's earlier grants."""
+        used = np.zeros(len(self.nodes), dtype=np.int32)
+        by_node = self._live_allocs_by_node(state)
+
+        def count(alloc, i) -> int:
+            res = alloc.allocated_resources
+            if res is None:
+                return 0
+            c = 0
+            for tr in res.tasks.values():
+                for dr in tr.devices:
+                    healthy = match_sets[i].get(dr.device_id())
+                    if healthy:
+                        c += sum(1 for iid in dr.device_ids if iid in healthy)
+            return c
+
+        for i, node in enumerate(self.nodes):
+            if match_sets[i] is None:
+                continue
+            allocs = by_node[node.id]
+            if plan is not None:
+                from ..structs.model import remove_allocs
+
+                update = plan.node_update.get(node.id, [])
+                if update:
+                    allocs = remove_allocs(allocs, update)
+            for a in allocs:
+                used[i] += count(a, i)
+            if plan is not None:
+                for a in plan.node_allocation.get(node.id, []):
+                    used[i] += count(a, i)
+        return used
+
     def collision_counts(self, state, job_id: str, tg_name: str) -> np.ndarray:
         """Existing same-job/same-group alloc counts per node (the
         JobAntiAffinityIterator's collision input, rank.go:498-505)."""
@@ -198,20 +282,35 @@ class ColumnarCluster:
         return counts
 
 
-def kernel_supported(job: Job, tg: TaskGroup, allow_networks: bool = False) -> bool:
+def kernel_supported(
+    job: Job,
+    tg: TaskGroup,
+    allow_networks: bool = False,
+    allow_devices: bool = False,
+) -> bool:
     """Whether the fast kernel covers this group; anything else falls back
-    to the scalar oracle (devices, distinct_*, sticky disk, multi-spread).
+    to the scalar oracle (distinct_*, sticky disk, multi-spread).
 
     With ``allow_networks`` (the tpu-batch path), network asks ride the
     kernel too: bandwidth is the 4th dense resource column and DYNAMIC
     ports are assigned host-side after node choice (SURVEY §7's port
     post-pass). Reserved-port asks still fall back — their collisions
-    constrain node choice itself, which the dense planes don't model."""
+    constrain node choice itself, which the dense planes don't model.
+
+    With ``allow_devices``, constraint- and affinity-free device asks ride
+    the kernel as an eval-local 5th resource column (free matching
+    instances per node; SURVEY §7's device post-pass assigns concrete
+    instance IDs host-side on the winner). Asks with device constraints or
+    affinities fall back — they filter/score per device *group*, which one
+    dense count column can't express (ref scheduler/device.go:40-131)."""
     if tg.networks:
         return False
     for task in tg.tasks:
-        if task.resources.devices:
-            return False
+        for dev in task.resources.devices:
+            if not allow_devices:
+                return False
+            if dev.constraints or dev.affinities:
+                return False
         nets = task.resources.networks
         if nets and not allow_networks:
             return False

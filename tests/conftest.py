@@ -14,6 +14,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Tests compile tiny CPU programs quickly; sharing the persistent cache with
+# TPU-process runs risks loading XLA:CPU AOT entries whose machine-feature
+# flags don't match this process (cpu_aot_loader warns of possible SIGILL).
+os.environ.setdefault("NOMAD_TPU_COMPILE_CACHE", "off")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # This image pins JAX_PLATFORMS=axon (real TPU); the env var is overridden by

@@ -1,0 +1,316 @@
+"""Sharded execution of all three placement planners on the 8-device
+virtual mesh (conftest.py): the node axis — the framework's scale axis — is
+partitioned with NamedSharding(P("nodes")) and every planner must produce
+EXACTLY the placements of its unsharded run (GSPMD inserts the cross-shard
+argmax/gather collectives; semantics may not drift).
+
+This is the multi-chip contract the driver's dryrun validates at compile
+level; these tests pin value-level equality so a sharding regression in any
+planner fails the suite (VERDICT r2 next-round #1)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nomad_tpu.tpu.kernel import (
+    BatchArgs,
+    BatchState,
+    RunArgs,
+    WindowArgs,
+    plan_batch,
+    plan_batch_runs,
+    plan_batch_windowed,
+)
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    if len(devices) < N_DEV:
+        pytest.skip(f"need {N_DEV} virtual devices, have {len(devices)}")
+    return Mesh(np.array(devices[:N_DEV]), ("nodes",))
+
+
+def build_cluster(n_nodes, n_allocs, n_values=4, seed=0):
+    """Heterogeneous capacities, ~10% infeasible nodes, spread classes."""
+    rng = np.random.default_rng(seed)
+    capacity = np.stack(
+        [
+            rng.choice([4000, 8000, 16000, 32000], n_nodes),
+            rng.choice([8192, 16384, 32768], n_nodes),
+            np.full(n_nodes, 100 * 1024),
+            np.full(n_nodes, 1000),
+        ],
+        axis=1,
+    ).astype(np.int32)
+    reserved = np.tile(np.array([100, 256, 4096, 0], dtype=np.int32), (n_nodes, 1))
+    usable = (capacity[:, :2] - reserved[:, :2]).astype(np.float32)
+    feasible = rng.random(n_nodes) > 0.1
+    node_value = (np.arange(n_nodes) % n_values).astype(np.int32)
+    perm = rng.permutation(n_nodes).astype(np.int32)
+    demand = np.array([100, 128, 10, 5], dtype=np.int32)
+    return dict(
+        capacity=capacity,
+        reserved=reserved,
+        usable=usable,
+        feasible=feasible,
+        node_value=node_value,
+        perm=perm,
+        demand=demand,
+        n_allocs=n_allocs,
+        n_values=n_values,
+    )
+
+
+def exact_args(c, spread=True):
+    n_nodes = c["capacity"].shape[0]
+    n_allocs = c["n_allocs"]
+    V = c["n_values"]
+    args = BatchArgs(
+        capacity=c["capacity"],
+        usable=c["usable"],
+        feasible=c["feasible"][None, :],
+        affinity=np.zeros((1, n_nodes), dtype=np.float32),
+        affinity_present=np.zeros((1, n_nodes), dtype=bool),
+        group_count=np.full(1, n_allocs, dtype=np.int32),
+        group_eval=np.zeros(1, dtype=np.int32),
+        node_value=c["node_value"][None, :],
+        spread_desired=np.full(
+            (1, V), float(n_allocs) / V if spread else -1.0, dtype=np.float32
+        ),
+        spread_implicit=np.full(1, -1.0, dtype=np.float32),
+        spread_weight_frac=np.ones(1, dtype=np.float32),
+        spread_even=np.zeros(1, dtype=bool),
+        spread_active=np.full(1, spread, dtype=bool),
+        perm=c["perm"][None, :],
+        ring=np.array([n_nodes], dtype=np.int32),
+        demands=np.tile(c["demand"], (n_allocs, 1)),
+        groups=np.zeros(n_allocs, dtype=np.int32),
+        limits=np.full(n_allocs, n_nodes, dtype=np.int32),
+        valid=np.ones(n_allocs, dtype=bool),
+    )
+    init = BatchState(
+        used=c["reserved"].copy(),
+        collisions=np.zeros((1, n_nodes), dtype=np.int32),
+        spread_counts=np.zeros((1, V), dtype=np.int32),
+        spread_present=np.zeros((1, V), dtype=bool),
+        offset=np.zeros(1, dtype=np.int32),
+    )
+    return args, init
+
+
+def exact_shardings(mesh):
+    rows = NamedSharding(mesh, P("nodes", None))
+    cols = NamedSharding(mesh, P(None, "nodes"))
+    rep = NamedSharding(mesh, P())
+    args = BatchArgs(
+        capacity=rows, usable=rows, feasible=cols, affinity=cols,
+        affinity_present=cols, group_count=rep, group_eval=rep,
+        node_value=cols, spread_desired=rep, spread_implicit=rep,
+        spread_weight_frac=rep, spread_even=rep, spread_active=rep,
+        perm=cols, ring=rep, demands=rep, groups=rep, limits=rep, valid=rep,
+    )
+    state = BatchState(
+        used=rows, collisions=cols, spread_counts=rep,
+        spread_present=rep, offset=rep,
+    )
+    return args, state
+
+
+def test_exact_scan_sharded_equals_unsharded(mesh):
+    """Exact sequential-scan kernel at 1K nodes: node axis over 8 devices."""
+    c = build_cluster(1024, 96)
+    args, init = exact_args(c)
+    n_real = 1024
+
+    _, want = plan_batch(
+        BatchArgs(*[jnp.asarray(a) for a in args]),
+        BatchState(*[jnp.asarray(s) for s in init]),
+        n_real,
+    )
+    want = np.asarray(want)
+
+    arg_sh, st_sh = exact_shardings(mesh)
+    d_args = jax.device_put(BatchArgs(*[jnp.asarray(a) for a in args]), arg_sh)
+    d_init = jax.device_put(BatchState(*[jnp.asarray(s) for s in init]), st_sh)
+    _, got = plan_batch(d_args, d_init, n_real)
+    got = np.asarray(got)
+
+    assert (want >= 0).sum() == c["n_allocs"]
+    np.testing.assert_array_equal(want, got)
+
+
+def _run_args(c, affinity=True, spread=True):
+    n_nodes = c["capacity"].shape[0]
+    V = c["n_values"]
+    perm = c["perm"]
+    aff = np.where(
+        np.arange(n_nodes) % 5 == 0, 0.5, 0.0
+    ).astype(np.float32) if affinity else np.zeros(n_nodes, dtype=np.float32)
+    rargs = RunArgs(
+        capacity=c["capacity"][perm],
+        usable=c["usable"][perm],
+        feasible=c["feasible"][perm],
+        affinity=aff[perm],
+        affinity_present=(aff > 0)[perm],
+        group_count=np.int32(c["n_allocs"]),
+        node_value=c["node_value"][perm],
+        spread_desired=np.full(
+            V, float(c["n_allocs"]) / V if spread else -1.0, dtype=np.float32
+        ),
+        spread_implicit=np.float32(-1.0),
+        spread_weight_frac=np.float32(1.0),
+        spread_even=False,
+        spread_active=spread,
+        perm=perm,
+        demand=c["demand"],
+        n_allocs=np.int32(c["n_allocs"]),
+    )
+    init = (
+        c["reserved"][perm],
+        np.zeros(n_nodes, dtype=np.int32),
+        np.zeros(V, dtype=np.int32),
+        np.zeros(V, dtype=bool),
+    )
+    return rargs, init
+
+
+def test_runs_planner_sharded_equals_unsharded(mesh):
+    """Run-based full-ring planner under NamedSharding(P('nodes'))."""
+    c = build_cluster(1024, 512, seed=3)
+    rargs, init = _run_args(c)
+    a_pad = 512
+
+    want = np.asarray(
+        plan_batch_runs(
+            RunArgs(*[jnp.asarray(a) for a in rargs]),
+            tuple(jnp.asarray(x) for x in init),
+            a_pad,
+            False,
+        )
+    )
+
+    node = NamedSharding(mesh, P("nodes"))
+    rows = NamedSharding(mesh, P("nodes", None))
+    rep = NamedSharding(mesh, P())
+    arg_sh = RunArgs(
+        capacity=rows, usable=rows, feasible=node, affinity=node,
+        affinity_present=node, group_count=rep, node_value=node,
+        spread_desired=rep, spread_implicit=rep, spread_weight_frac=rep,
+        spread_even=rep, spread_active=rep, perm=node, demand=rep,
+        n_allocs=rep,
+    )
+    d_args = jax.device_put(RunArgs(*[jnp.asarray(a) for a in rargs]), arg_sh)
+    d_init = (
+        jax.device_put(jnp.asarray(init[0]), rows),
+        jax.device_put(jnp.asarray(init[1]), node),
+        jax.device_put(jnp.asarray(init[2]), rep),
+        jax.device_put(jnp.asarray(init[3]), rep),
+    )
+    got = np.asarray(plan_batch_runs(d_args, d_init, a_pad, False))
+
+    assert (want >= 0).sum() > 0
+    np.testing.assert_array_equal(want, got)
+
+
+def test_windowed_planner_sharded_equals_unsharded(mesh):
+    """Rotation-parallel windowed planner under NamedSharding(P('nodes'))."""
+    c = build_cluster(1024, 512, seed=5)
+    n_real, a_pad = 1024, 512
+    wargs = WindowArgs(
+        capacity=c["capacity"],
+        usable=c["usable"],
+        feasible=c["feasible"],
+        perm=c["perm"],
+        demand=c["demand"],
+        group_count=np.int32(c["n_allocs"]),
+        limit=np.int32(10),  # log2(1024)
+        n_allocs=np.int32(c["n_allocs"]),
+    )
+    used0 = c["reserved"].copy()
+    coll0 = np.zeros(n_real, dtype=np.int32)
+
+    want = np.asarray(
+        plan_batch_windowed(
+            WindowArgs(*[jnp.asarray(a) for a in wargs]),
+            jnp.asarray(used0),
+            jnp.asarray(coll0),
+            n_real,
+            a_pad,
+        )
+    )
+
+    node = NamedSharding(mesh, P("nodes"))
+    rows = NamedSharding(mesh, P("nodes", None))
+    rep = NamedSharding(mesh, P())
+    arg_sh = WindowArgs(
+        capacity=rows, usable=rows, feasible=node, perm=node,
+        demand=rep, group_count=rep, limit=rep, n_allocs=rep,
+    )
+    d_args = jax.device_put(WindowArgs(*[jnp.asarray(a) for a in wargs]), arg_sh)
+    got = np.asarray(
+        plan_batch_windowed(
+            d_args,
+            jax.device_put(jnp.asarray(used0), rows),
+            jax.device_put(jnp.asarray(coll0), node),
+            n_real,
+            a_pad,
+        )
+    )
+
+    assert (want >= 0).sum() > 0
+    np.testing.assert_array_equal(want, got)
+
+
+def test_exact_scan_sharded_multi_group(mesh):
+    """Two groups with different demands sharing the usage plane, sharded."""
+    n_nodes, n_allocs = 512, 64
+    c = build_cluster(n_nodes, n_allocs, seed=9)
+    args, init = exact_args(c, spread=False)
+    # second group: double demand, no spread
+    args = args._replace(
+        feasible=np.concatenate([args.feasible, args.feasible]),
+        affinity=np.concatenate([args.affinity, args.affinity]),
+        affinity_present=np.concatenate(
+            [args.affinity_present, args.affinity_present]
+        ),
+        group_count=np.array([n_allocs // 2, n_allocs // 2], dtype=np.int32),
+        group_eval=np.zeros(2, dtype=np.int32),
+        node_value=np.concatenate([args.node_value, args.node_value]),
+        spread_desired=np.full((2, c["n_values"]), -1.0, dtype=np.float32),
+        spread_implicit=np.full(2, -1.0, dtype=np.float32),
+        spread_weight_frac=np.zeros(2, dtype=np.float32),
+        spread_even=np.zeros(2, dtype=bool),
+        spread_active=np.zeros(2, dtype=bool),
+        demands=np.where(
+            (np.arange(n_allocs) % 2 == 0)[:, None],
+            c["demand"],
+            c["demand"] * 2,
+        ).astype(np.int32),
+        groups=(np.arange(n_allocs) % 2).astype(np.int32),
+    )
+    init = init._replace(
+        collisions=np.zeros((2, n_nodes), dtype=np.int32),
+        spread_counts=np.zeros((2, c["n_values"]), dtype=np.int32),
+        spread_present=np.zeros((2, c["n_values"]), dtype=bool),
+    )
+
+    _, want = plan_batch(
+        BatchArgs(*[jnp.asarray(a) for a in args]),
+        BatchState(*[jnp.asarray(s) for s in init]),
+        n_nodes,
+    )
+    want = np.asarray(want)
+
+    arg_sh, st_sh = exact_shardings(mesh)
+    d_args = jax.device_put(BatchArgs(*[jnp.asarray(a) for a in args]), arg_sh)
+    d_init = jax.device_put(BatchState(*[jnp.asarray(s) for s in init]), st_sh)
+    _, got = plan_batch(d_args, d_init, n_nodes)
+
+    assert (want >= 0).sum() == n_allocs
+    np.testing.assert_array_equal(want, np.asarray(got))

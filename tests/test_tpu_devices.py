@@ -1,0 +1,195 @@
+"""Dense device path: constraint-free device{} asks ride the kernel as the
+5th resource column, with concrete instance IDs arbitrated host-side on the
+winner (SURVEY §7 step 4; ref scheduler/device.go:40-131 for the oracle
+semantics being matched)."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import compute_class
+from nomad_tpu.structs.model import (
+    Affinity,
+    Constraint,
+    Evaluation,
+    RequestedDevice,
+    generate_uuid,
+)
+from nomad_tpu.tpu import batch_sched
+
+
+def build_nodes(n, devices_every=4):
+    rng = random.Random(7)
+    templates = []
+    for cpu, mem in ((4000, 8192), (8000, 16384)):
+        t = mock.node()
+        t.node_resources.cpu.cpu_shares = cpu
+        t.node_resources.memory.memory_mb = mem
+        t.node_resources.networks = []
+        t.reserved_resources.networks.reserved_host_ports = ""
+        compute_class(t)
+        templates.append(t)
+    tpu_t = mock.tpu_node()
+    tpu_t.node_resources.networks = []
+    tpu_t.reserved_resources.networks.reserved_host_ports = ""
+    compute_class(tpu_t)
+    nodes = []
+    for i in range(n):
+        t = tpu_t if i % devices_every == 0 else templates[rng.randrange(2)]
+        node = t.copy()
+        node.id = generate_uuid()
+        nodes.append(node)
+    return nodes
+
+
+def device_job(count, dev_count=1, name="tpu"):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 64
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.devices = [
+        RequestedDevice(name=name, count=dev_count)
+    ]
+    return job
+
+
+def make_eval(job):
+    return Evaluation(
+        id=generate_uuid(),
+        namespace=job.namespace,
+        priority=job.priority,
+        type=job.type,
+        triggered_by="job-register",
+        job_id=job.id,
+        status="pending",
+    )
+
+
+def run(factory, job, nodes, seed=29, harness=None):
+    h = harness or Harness(seed=seed)
+    if harness is None:
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), n)
+    h.state.upsert_job(h.next_index(), job)
+    ev = make_eval(job)
+    h.state.upsert_evals(h.next_index(), [ev])
+    sched = h.process(factory, ev)
+    run.last_sched = sched
+    return h, h.state.allocs_by_job(job.namespace, job.id)
+
+
+def assert_unique_instances(allocs):
+    seen = set()
+    for a in allocs:
+        devs = [
+            d
+            for tr in a.allocated_resources.tasks.values()
+            for d in tr.devices
+        ]
+        assert devs, f"alloc {a.name} placed without a device grant"
+        for d in devs:
+            assert d.device_ids
+            for iid in d.device_ids:
+                key = (a.node_id, d.vendor, d.type, d.name, iid)
+                assert key not in seen, f"instance double-booked: {key}"
+                seen.add(key)
+    return seen
+
+
+def test_device_parity_with_oracle():
+    """Kernel placements for a device job match the scalar oracle node-for-
+    node (both sides built from the same seed, compared by node index)."""
+    nodes_a = build_nodes(80)
+    _, oracle = run("service", device_job(24), nodes_a)
+    nodes_b = build_nodes(80)
+    batch_sched.LAST_KERNEL_STATS.clear()
+    _, kernel = run("tpu-batch", device_job(24), nodes_b)
+    assert batch_sched.LAST_KERNEL_STATS.get("mode") == "windowed"
+
+    idx_a = {n.id: i for i, n in enumerate(nodes_a)}
+    idx_b = {n.id: i for i, n in enumerate(nodes_b)}
+    by_name_a = {a.name.split(".")[-1]: idx_a[a.node_id] for a in oracle}
+    by_name_b = {a.name.split(".")[-1]: idx_b[a.node_id] for a in kernel}
+    assert by_name_a == by_name_b
+    assert_unique_instances(kernel)
+
+
+def test_device_exhaustion_partial_placement():
+    """More asks than instances: the kernel places exactly the capacity and
+    reports the device dimension in the failure metric."""
+    nodes = build_nodes(40, devices_every=4)  # 10 tpu nodes x 2 instances
+    h, allocs = run("tpu-batch", device_job(32), nodes)
+    assert len(allocs) == 20
+    assert_unique_instances(allocs)
+    failed = run.last_sched.failed_tg_allocs
+    assert failed, "exhaustion must surface failed_tg_allocs"
+    metrics = next(iter(failed.values()))
+    assert "devices" in metrics.dimension_exhausted
+
+
+def test_device_used_accounting_across_evals():
+    """A second job's kernel pass must see instances consumed by the first
+    job's allocs (cluster.device_used) and overflow to free nodes only."""
+    nodes = build_nodes(40, devices_every=4)
+    h, first = run("tpu-batch", device_job(10), nodes)
+    _, second = run("tpu-batch", device_job(10), nodes, harness=h)
+    assert len(first) == 10 and len(second) == 10
+    assert_unique_instances(list(first) + list(second))
+
+
+def test_device_constraint_falls_back():
+    """Constraint-bearing device asks ride the oracle (they filter per
+    device group, which the dense column can't express)."""
+    nodes = build_nodes(40)
+    job = device_job(12)
+    job.task_groups[0].tasks[0].resources.devices[0].constraints = [
+        Constraint(l_target="${device.attr.memory}", r_target="8", operand=">=")
+    ]
+    before = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "unsupported_group", 0
+    )
+    run("tpu-batch", job, nodes)
+    after = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "unsupported_group", 0
+    )
+    assert after == before + 1
+
+
+def test_device_affinity_falls_back():
+    nodes = build_nodes(40)
+    job = device_job(12)
+    job.task_groups[0].tasks[0].resources.devices[0].affinities = [
+        Affinity(l_target="${device.attr.memory}", r_target="8", operand=">=", weight=50)
+    ]
+    before = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "unsupported_group", 0
+    )
+    run("tpu-batch", job, nodes)
+    after = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "unsupported_group", 0
+    )
+    assert after == before + 1
+
+
+def test_mixed_signature_escapes_before_shuffle():
+    """Two groups asking different device signatures in one eval escape to
+    the oracle wholesale (one shared count column can't serve both)."""
+    nodes = build_nodes(40)
+    job = device_job(12)
+    tg2 = job.task_groups[0].copy()
+    tg2.name = "other"
+    tg2.count = 12
+    tg2.tasks[0].resources.devices = [RequestedDevice(name="gpu", count=1)]
+    job.task_groups.append(tg2)
+    before = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "device_mixed_signature", 0
+    )
+    h, allocs = run("tpu-batch", job, nodes)
+    after = batch_sched.counters_snapshot()["fallback_reasons"].get(
+        "device_mixed_signature", 0
+    )
+    assert after == before + 1
