@@ -40,6 +40,33 @@ class ApiClient:
         # bearer secret sent as X-Nomad-Token (ref api.Client SecretID)
         self.token = token or os.environ.get("NOMAD_TPU_TOKEN") or ""
 
+    def alloc_exec_session(
+        self, alloc_id: str, task: str, cmd: list, tty: bool = False
+    ):
+        """Open the interactive exec websocket (ref api/allocations.go
+        Exec); returns an ExecWsSession with send_stdin/recv_frame/close."""
+        from .ws import WsClient
+
+        params = urllib.parse.urlencode(
+            {
+                "task": task,
+                "command": json.dumps(list(cmd)),
+                "tty": "true" if tty else "false",
+            }
+        )
+        address = self.address
+        tls = address.startswith("https://")
+        for prefix in ("http://", "https://"):
+            if address.startswith(prefix):
+                address = address[len(prefix):]
+        ws = WsClient(
+            address,
+            f"/v1/client/allocation/{_q(alloc_id)}/exec?{params}",
+            token=self.token,
+            tls=tls,
+        )
+        return ExecWsSession(ws)
+
     def _request(self, method: str, path: str, params=None, body=None):
         url = self.address + path
         params = dict(params or {})
@@ -318,3 +345,57 @@ class ApiClient:
 
     def alloc_stats(self, alloc_id: str) -> dict:
         return self.get(f"/v1/client/allocation/{_q(alloc_id)}/stats")[0]
+
+
+class ExecWsSession:
+    """Typed wrapper over the exec websocket's JSON frames (ref
+    api/allocations.go execSession): base64 payloads decoded to bytes."""
+
+    def __init__(self, ws):
+        self._ws = ws
+
+    def send_stdin(self, data: bytes):
+        import base64
+
+        self._ws.send(
+            json.dumps({"stdin": {"data": base64.b64encode(data).decode()}})
+        )
+
+    def close_stdin(self):
+        self._ws.send(json.dumps({"stdin": {"close": True}}))
+
+    def resize(self, rows: int, cols: int):
+        self._ws.send(
+            json.dumps({"tty_size": {"height": rows, "width": cols}})
+        )
+
+    def recv_frame(self, timeout=None) -> Optional[dict]:
+        """Next decoded frame: {"stdout"/"stderr": bytes} or
+        {"exited": True, "exit_code": N} or {"error": msg}; None at
+        websocket close."""
+        import base64
+
+        from .ws import WsClosed
+
+        try:
+            payload = self._ws.recv(timeout=timeout)
+        except WsClosed:
+            return None
+        try:
+            obj = json.loads(payload.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        out = {}
+        for key in ("stdout", "stderr"):
+            part = obj.get(key) or {}
+            if part.get("data"):
+                out[key] = __import__("base64").b64decode(part["data"])
+        if obj.get("exited"):
+            out["exited"] = True
+            out["exit_code"] = (obj.get("result") or {}).get("exit_code", 0)
+        if obj.get("error"):
+            out["error"] = obj["error"]
+        return out
+
+    def close(self):
+        self._ws.close()

@@ -133,6 +133,68 @@ class HTTPServer:
                     self.wfile.write(data)
                     return
                 query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                # websocket upgrade: the interactive exec surface
+                # (ref command/agent/alloc_endpoint.go execStream)
+                if (
+                    method == "GET"
+                    and "websocket"
+                    in self.headers.get("Upgrade", "").lower()
+                ):
+                    ws_m = re.match(
+                        r"^/v1/client/allocation/([^/]+)/exec$", parsed.path
+                    )
+                    if ws_m:
+                        server = api.server
+                        if server is not None and server.acl_enabled():
+                            # browsers can't set headers on a ws dial;
+                            # accept the token as a query param too
+                            secret = self.headers.get(
+                                "X-Nomad-Token", ""
+                            ) or query.get("token", "")
+                            try:
+                                acl_obj = server.resolve_token(secret)
+                            except PermissionError as e:
+                                self._respond(403, {"error": str(e)}, None)
+                                return
+                            if not _acl_allows(
+                                acl_obj, "ns:alloc-exec", query
+                            ):
+                                self._respond(
+                                    403, {"error": "Permission denied"}, None
+                                )
+                                return
+                            query["__acl__"] = acl_obj
+                        self.close_connection = True
+                        try:
+                            api._serve_exec_ws(self, ws_m.group(1), query)
+                        except KeyError as e:
+                            try:
+                                self._respond(404, {"error": str(e)}, None)
+                            except OSError:
+                                pass
+                        except ValueError as e:
+                            try:
+                                self._respond(400, {"error": str(e)}, None)
+                            except OSError:
+                                pass
+                        except PermissionError as e:
+                            # the fine-grained per-resource namespace check
+                            # (the coarse gate above used caller-chosen
+                            # ?namespace=) — still a clean 403
+                            try:
+                                self._respond(403, {"error": str(e)}, None)
+                            except OSError:
+                                pass
+                        except OSError:
+                            pass
+                        except Exception as e:
+                            # RpcError (hosting node unreachable) and
+                            # friends: a diagnosable 502, not a traceback
+                            try:
+                                self._respond(502, {"error": str(e)}, None)
+                            except OSError:
+                                pass
+                        return
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
@@ -1321,6 +1383,127 @@ class HTTPServer:
                 {"task": task, "cmd": cmd, "timeout": timeout},
             ), None
         return fs.exec_in(base, task, cmd, timeout=timeout), None
+
+    def _serve_exec_ws(self, handler, alloc_id: str, query: dict):
+        """Interactive exec over a websocket (ref command/agent/
+        alloc_endpoint.go execStream; api/allocations.go Exec): JSON
+        frames — {"stdin":{"data":b64}} / {"stdin":{"close":true}} /
+        {"tty_size":{"height":H,"width":W}} up, {"stdout"/"stderr":
+        {"data":b64}} and {"exited":true,"result":{"exit_code":N}} down.
+        Local allocs bridge straight to the driver; remote allocs ride the
+        server's duplex RPC forward to the hosting node."""
+        import base64
+        import threading as threading_mod
+
+        from ..rpc.mux import StreamClosed, StreamError, pipe_streams
+        from . import ws as ws_mod
+
+        task = query.get("task", "")
+        try:
+            cmd = json.loads(query.get("command", "[]"))
+        except json.JSONDecodeError:
+            raise ValueError("command must be a JSON array")
+        if not isinstance(cmd, list) or not cmd:
+            raise ValueError("command is required")
+        tty = str(query.get("tty", "false")).lower() in ("true", "1")
+        self._check_alloc_ns(query, alloc_id, "alloc-exec")
+
+        # resolve the exec source BEFORE upgrading, so failures are
+        # ordinary HTTP errors rather than a dead websocket
+        client = self._local_client_with_alloc(alloc_id)
+        if client is not None:
+            from ..client.execstream import bridge_exec
+
+            proc = client.exec_session(alloc_id, task, cmd, tty=tty)
+            stream, remote = pipe_streams()
+            threading_mod.Thread(
+                target=bridge_exec, args=(proc, remote), daemon=True,
+                name="exec-ws-bridge",
+            ).start()
+        else:
+            stream = self.server.open_client_exec(
+                alloc_id, {"task": task, "cmd": cmd, "tty": tty}
+            )
+
+        sock = ws_mod.server_handshake(handler)
+
+        def down():
+            try:
+                for frame in stream:
+                    if frame.get("stdout"):
+                        ws_mod.send_message(sock, json.dumps({
+                            "stdout": {
+                                "data": base64.b64encode(
+                                    frame["stdout"]
+                                ).decode()
+                            }
+                        }))
+                    if frame.get("stderr"):
+                        ws_mod.send_message(sock, json.dumps({
+                            "stderr": {
+                                "data": base64.b64encode(
+                                    frame["stderr"]
+                                ).decode()
+                            }
+                        }))
+                    if "exit" in frame:
+                        ws_mod.send_message(sock, json.dumps({
+                            "exited": True,
+                            "result": {"exit_code": frame["exit"]},
+                        }))
+            except StreamError as e:
+                try:
+                    ws_mod.send_message(
+                        sock, json.dumps({"error": str(e)})
+                    )
+                except OSError:
+                    pass
+            except OSError:
+                pass
+            finally:
+                ws_mod.send_close(sock)
+
+        dt = threading_mod.Thread(target=down, daemon=True, name="exec-ws-down")
+        dt.start()
+        try:
+            while True:
+                try:
+                    _, payload = ws_mod.read_message(sock)
+                except (ws_mod.WsClosed, OSError):
+                    break
+                try:
+                    obj = json.loads(payload.decode())
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                try:
+                    stdin = obj.get("stdin") or {}
+                    if stdin.get("data"):
+                        stream.send(
+                            {"stdin": base64.b64decode(stdin["data"])}
+                        )
+                    if stdin.get("close"):
+                        stream.send({"eof": True})
+                    size = obj.get("tty_size") or {}
+                    if size:
+                        stream.send({
+                            "resize": [
+                                int(size.get("height", 24)),
+                                int(size.get("width", 80)),
+                            ]
+                        })
+                except StreamClosed:
+                    break
+        finally:
+            # the websocket is gone (or the session ended): tear the exec
+            # down fully — a half-close would leave an orphaned process
+            # pumping output nowhere
+            if hasattr(stream, "abort"):
+                stream.abort()  # local pipe: kills the process via bridge
+            else:
+                stream.close(
+                    {"code": "connection", "message": "websocket closed"}
+                )
+            dt.join(timeout=5.0)
 
     # -- alloc lifecycle (ref alloc_endpoint.go Stop +
     # client_alloc_endpoint.go Restart/Signal) ---------------------------

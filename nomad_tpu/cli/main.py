@@ -75,6 +75,12 @@ def cmd_agent(args):
     apply_log_level(config)
     server_cfg = server_config_from_agent(config)
     server_cfg["name"] = config.get("name", "server-1")
+    # agents prewarm the planner shape ladder by default (first-eval
+    # latency; server.prewarm_kernels=false in the HCL config disables)
+    server_cfg.setdefault(
+        "prewarm_kernels",
+        bool(config.get("server", {}).get("prewarm_kernels", True)),
+    )
 
     num_clients = args.clients
     if (
@@ -426,19 +432,89 @@ def cmd_alloc_fs(args):
 
 
 def cmd_alloc_exec(args):
-    """ref command/alloc_exec.go (one-shot captured exec)"""
+    """ref command/alloc_exec.go: interactive streaming session with
+    -i/-t (websocket → server → client → driver exec-in-context), or the
+    legacy one-shot captured exec without."""
     client = _client(args)
-    resp = client.put(
-        f"/v1/client/exec/{args.alloc_id}",
-        body={"Task": args.task, "Cmd": args.cmd},
-    )[0]
-    if resp.get("Stdout"):
-        print(resp["Stdout"], end="")
-    if resp.get("Stderr"):
-        import sys
+    # resolve a short alloc-id prefix to the full id (ref command/meta:
+    # every alloc command accepts prefixes)
+    alloc_id = client.allocation(args.alloc_id)["id"]
+    if not (args.interactive or args.tty):
+        resp = client.put(
+            f"/v1/client/exec/{alloc_id}",
+            body={"Task": args.task, "Cmd": args.cmd},
+        )[0]
+        if resp.get("Stdout"):
+            print(resp["Stdout"], end="")
+        if resp.get("Stderr"):
+            import sys
 
-        print(resp["Stderr"], end="", file=sys.stderr)
-    return resp.get("ExitCode", 0)
+            print(resp["Stderr"], end="", file=sys.stderr)
+        return resp.get("ExitCode", 0)
+
+    import os
+    import sys
+    import threading
+
+    session = client.alloc_exec_session(
+        alloc_id, args.task, args.cmd, tty=args.tty
+    )
+    exit_code = [0]
+    done = threading.Event()
+
+    raw = False
+    if args.tty and sys.stdin.isatty():
+        import termios
+        import tty as tty_mod
+
+        saved = termios.tcgetattr(sys.stdin.fileno())
+        tty_mod.setraw(sys.stdin.fileno())
+        raw = True
+        try:
+            cols, rows = os.get_terminal_size()
+            session.resize(rows, cols)
+        except OSError:
+            pass
+
+    def stdin_pump():
+        try:
+            while not done.is_set():
+                data = os.read(sys.stdin.fileno(), 4096)
+                if not data:
+                    session.close_stdin()
+                    return
+                session.send_stdin(data)
+        except (OSError, ValueError):
+            pass
+
+    t = threading.Thread(target=stdin_pump, daemon=True)
+    t.start()
+    try:
+        while True:
+            frame = session.recv_frame(timeout=3600)
+            if frame is None:
+                break
+            if frame.get("stdout"):
+                sys.stdout.buffer.write(frame["stdout"])
+                sys.stdout.flush()
+            if frame.get("stderr"):
+                sys.stderr.buffer.write(frame["stderr"])
+                sys.stderr.flush()
+            if frame.get("error"):
+                print(frame["error"], file=sys.stderr)
+                exit_code[0] = 1
+                break
+            if frame.get("exited"):
+                exit_code[0] = int(frame.get("exit_code", 0))
+                break
+    finally:
+        done.set()
+        session.close()
+        if raw:
+            termios.tcsetattr(
+                sys.stdin.fileno(), termios.TCSADRAIN, saved
+            )
+    return exit_code[0]
 
 
 def cmd_alloc_status(args):
@@ -1030,10 +1106,20 @@ def build_parser() -> argparse.ArgumentParser:
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?")
     afs.set_defaults(fn=cmd_alloc_fs)
-    aex = asub.add_parser("exec", help="run a command in the task dir")
+    aex = asub.add_parser(
+        "exec", help="run a command in the task's execution context"
+    )
     aex.add_argument("alloc_id")
     aex.add_argument("task")
     aex.add_argument("cmd", nargs="+")
+    aex.add_argument(
+        "-i", "--interactive", action="store_true",
+        help="stream stdin to the command (websocket session)",
+    )
+    aex.add_argument(
+        "-t", "--tty", action="store_true",
+        help="allocate a pseudo-terminal (implies streaming)",
+    )
     aex.set_defaults(fn=cmd_alloc_exec)
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")

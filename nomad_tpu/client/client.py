@@ -986,6 +986,35 @@ class Client:
             raise KeyError(f"alloc not found on this client: {alloc_id}")
         return runner.restart_task(task_name)
 
+    def exec_session(
+        self, alloc_id: str, task_name: str, cmd: list, tty: bool = False
+    ):
+        """Open a streaming exec INSIDE a running task's execution context
+        (ref client Allocations.Exec → driver ExecTaskStreaming,
+        plugins/drivers/proto/driver.proto:72-76); returns an
+        execstream.ExecProcess the caller bridges to a duplex stream."""
+        runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"alloc not found on this client: {alloc_id}")
+        if not cmd:
+            raise ValueError("exec requires a command")
+        tr = runner.task_runners.get(task_name)
+        if tr is None:
+            if len(runner.task_runners) == 1 and not task_name:
+                tr = next(iter(runner.task_runners.values()))
+            else:
+                raise KeyError(f"task not found in alloc: {task_name}")
+        if tr.handle is None:
+            raise ValueError("task has not started")
+        task_dir = runner.task_dir(tr.task.name)
+        return tr.driver.exec_streaming(
+            tr.handle,
+            list(cmd),
+            tty=tty,
+            task_dir=task_dir,
+            env=dict(tr.task.env),
+        )
+
     def alloc_signal(
         self, alloc_id: str, signal_name: str, task_name: str = ""
     ) -> list[str]:

@@ -96,6 +96,20 @@ class Driver:
         SignalTask). Drivers without signal support raise."""
         raise ValueError(f"driver {self.name} does not support signals")
 
+    def exec_streaming(
+        self,
+        handle: TaskHandle,
+        cmd: list,
+        tty: bool = False,
+        task_dir: str = "",
+        env: Optional[dict] = None,
+    ):
+        """Run a command INSIDE the task's execution context with
+        bidirectional streaming IO (ref driver.proto:72-76
+        ExecTaskStreaming); returns a client.execstream.ExecProcess.
+        Drivers without an execution context to enter raise."""
+        raise ValueError(f"driver {self.name} does not support exec")
+
     # -- plugin config (ref plugins/base/proto base.proto: ConfigSchema +
     # SetConfig, with hclspec's schema-validation role) -----------------
     def config_schema(self) -> dict:
@@ -204,6 +218,27 @@ class MockDriver(Driver):
         signals.append(signal_name)
         if cfg.get("exit_on_signal") and not handle._done.is_set():
             self.stop_task(handle)
+
+    def exec_streaming(
+        self,
+        handle: TaskHandle,
+        cmd: list,
+        tty: bool = False,
+        task_dir: str = "",
+        env: Optional[dict] = None,
+    ):
+        """Test hook: mock tasks have no real process, so exec runs the
+        command in the task dir (exercises the full streaming path)."""
+        from .execstream import ExecProcess
+
+        if handle._done.is_set():
+            raise ValueError("task is not running")
+        return ExecProcess(
+            list(cmd),
+            cwd=task_dir or None,
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin", **(env or {})},
+            tty=tty,
+        )
 
     def handle_data(self, handle: TaskHandle) -> dict:
         return {
@@ -392,6 +427,27 @@ class RawExecDriver(Driver):
             except ProcessLookupError:
                 pass
 
+    def exec_streaming(
+        self,
+        handle: TaskHandle,
+        cmd: list,
+        tty: bool = False,
+        task_dir: str = "",
+        env: Optional[dict] = None,
+    ):
+        """raw_exec's context is the task dir + env (no isolation to
+        enter, ref drivers/rawexec): the command runs beside the task."""
+        from .execstream import ExecProcess
+
+        if handle._done.is_set():
+            raise ValueError("task is not running")
+        return ExecProcess(
+            list(cmd),
+            cwd=task_dir or None,
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin", **(env or {})},
+            tty=tty,
+        )
+
     def signal_task(self, handle: TaskHandle, signal_name: str):
         """os-level signal delivery by pid (ref drivers/rawexec SignalTask)."""
         import os
@@ -577,6 +633,45 @@ class ExecDriver(RawExecDriver):
                 args += ["--cpu-shares", str(task.resources.cpu)]
         args += ["--", command] + list(cfg.get("args", []))
         return self._spawn(task, args, None, log_base=task_dir)
+
+    def exec_streaming(
+        self,
+        handle: TaskHandle,
+        cmd: list,
+        tty: bool = False,
+        task_dir: str = "",
+        env: Optional[dict] = None,
+    ):
+        """Exec INSIDE the task's namespaces: nsexec --enter joins the
+        namespace init's pid/mnt/ipc/uts via setns (the reference re-enters
+        through its nsenter shim for ExecTaskStreaming). The namespace
+        init is the shepherd's direct child (handle.pid is the shepherd,
+        which lives OUTSIDE the pid namespace it created)."""
+        from .execstream import ExecProcess
+
+        if handle._done.is_set():
+            raise ValueError("task is not running")
+        child = _first_child(handle.pid)
+        if child is None:
+            raise ValueError("task namespace init not found")
+        argv = [self._nsexec, "--enter", str(child), "--"] + list(cmd)
+        return ExecProcess(
+            argv,
+            env={"PATH": "/usr/bin:/bin:/usr/local/bin", **(env or {})},
+            tty=tty,
+        )
+
+
+def _first_child(pid: int) -> Optional[int]:
+    """First child of a pid (/proc children list); None when childless."""
+    try:
+        with open(
+            f"/proc/{pid}/task/{pid}/children", "r", encoding="ascii"
+        ) as f:
+            kids = f.read().split()
+        return int(kids[0]) if kids else None
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 BUILTIN_DRIVERS = {

@@ -157,10 +157,27 @@ def register_alloc_rpc(rpc_server, client):
         check(payload)
         return client.host_stats()
 
+    def exec_stream(payload, stream):
+        """Duplex streaming exec (ref ExecTaskStreaming framing,
+        plugins/drivers/proto/driver.proto:72-76,295): stdin frames in,
+        stdout/stderr/exit frames out, bridged onto the task's execution
+        context by the driver."""
+        from .execstream import bridge_exec
+
+        check(payload)
+        proc = client.exec_session(
+            payload["alloc_id"],
+            payload.get("task", ""),
+            [str(c) for c in payload.get("cmd", [])],
+            tty=bool(payload.get("tty")),
+        )
+        bridge_exec(proc, stream)
+
     rpc_server.register("ClientAllocations.Restart", restart)
     rpc_server.register("ClientAllocations.Signal", signal)
     rpc_server.register("ClientAllocations.Stats", stats)
     rpc_server.register("ClientStats.Stats", host_stats)
+    rpc_server.register_duplex("ClientAllocations.Exec", exec_stream)
 
 
 def register_fs_rpc(rpc_server, client):

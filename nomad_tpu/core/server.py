@@ -553,6 +553,13 @@ class Server:
     # ------------------------------------------------------------------
     def start(self, num_workers: int = 2, wait_for_leader: Optional[float] = None):
         self._running = True
+        if self.config.get("prewarm_kernels"):
+            # compile the planner shape ladder in the background so the
+            # first real eval doesn't eat the cold-compile latency
+            # (tpu/warmup.py; persists via the on-disk compilation cache)
+            from ..tpu.warmup import prewarm_async
+
+            prewarm_async()
         self.raft.start()
         if self.gossip is not None:
             self.gossip.start()
@@ -1463,6 +1470,39 @@ class Server:
             params or {}, alloc_id=alloc_id, secret=node.secret_id
         )
         return pool.call(addr, f"ClientFS.{method}", payload, timeout=30.0)
+
+    def _client_rpc_target(self, alloc_id: str):
+        """(client rpc addr, node secret) for the node hosting an alloc."""
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        node = self.state.node_by_id(alloc.node_id)
+        addr = (
+            node.attributes.get("unique.advertise.client_rpc")
+            if node is not None
+            else None
+        )
+        if not addr:
+            raise KeyError(
+                f"alloc {alloc_id} is on a node without a client RPC address"
+            )
+        return addr, node.secret_id
+
+    def open_client_exec(self, alloc_id: str, params: dict):
+        """Dial the hosting node and open the duplex exec stream (the
+        server hop of agent→server→client exec forwarding — the path the
+        reference serves via client_alloc_endpoint.go exec streaming).
+        Returns the live client-side stream for the caller to bridge."""
+        addr, secret = self._client_rpc_target(alloc_id)
+        from ..rpc import ConnPool
+
+        pool = getattr(self, "_client_fs_pool", None)
+        if pool is None:
+            pool = self._client_fs_pool = ConnPool(
+                tls_context=getattr(self, "tls_client_context", None)
+            )
+        payload = dict(params or {}, alloc_id=alloc_id, secret=secret)
+        return pool.call_duplex(addr, "ClientAllocations.Exec", payload)
 
     def reconcile_summaries(self):
         """Rebuild job summaries from the alloc table through raft

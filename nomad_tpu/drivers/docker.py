@@ -216,6 +216,22 @@ class DockerDriver(Driver):
         if out.returncode != 0:
             raise ValueError(f"docker kill failed: {out.stderr.strip()}")
 
+    def exec_streaming(self, handle: TaskHandle, cmd: list, tty: bool = False,
+                       task_dir: str = "", env=None):
+        """Exec inside the container (`docker exec`, the in-context path
+        the reference drives via the docker API's exec endpoints,
+        drivers/docker/driver.go ExecTaskStreaming)."""
+        from ..client.execstream import ExecProcess
+
+        container = getattr(handle, "_container", None)
+        if container is None or handle._done.is_set():
+            raise ValueError("task is not running")
+        argv = [self._docker, "exec", "-i"]
+        if tty:
+            argv.append("-t")
+        argv += [container] + list(cmd)
+        return ExecProcess(argv, tty=tty)
+
     def inspect_task(self, handle: TaskHandle) -> dict:
         base = super().inspect_task(handle)
         base["container"] = getattr(handle, "_container", None)

@@ -262,6 +262,89 @@ static int ns_flags() {
   return CLONE_NEWPID | CLONE_NEWNS | CLONE_NEWIPC | CLONE_NEWUTS;
 }
 
+// --enter PID: join an existing task's namespaces and run a command inside
+// them — the exec driver's exec-in-context path (the reference re-enters
+// via its nsenter shim for ExecTaskStreaming,
+// plugins/drivers/proto/driver.proto:72-76). Opens the target's ns fds
+// first (they stay valid even if the target exits mid-setns), joins
+// mnt/ipc/uts, then pid last, forks so the child is born inside the pid
+// namespace, and propagates the child's exit status.
+// Best-effort: place the calling process in the target's cgroup(s) so an
+// exec'd command inherits the task's memory/cpu limits (the reference puts
+// ExecTaskStreaming processes into the task cgroup). Parses
+// /proc/<pid>/cgroup: "0::<path>" (v2 unified) and "N:<ctrl>:<path>" (v1).
+// Must run BEFORE setns(mnt) — the target's mount view may hide
+// /sys/fs/cgroup.
+static void join_target_cgroups(pid_t target) {
+  char path[64], line[768];
+  snprintf(path, sizeof path, "/proc/%d/cgroup", (int)target);
+  FILE *f = fopen(path, "r");
+  if (f == NULL) return;
+  while (fgets(line, sizeof line, f) != NULL) {
+    line[strcspn(line, "\n")] = '\0';
+    char *c1 = strchr(line, ':');
+    if (c1 == NULL) continue;
+    char *c2 = strchr(c1 + 1, ':');
+    if (c2 == NULL) continue;
+    *c2 = '\0';
+    const char *ctrl = c1 + 1;
+    const char *cpath = c2 + 1;
+    if (strcmp(cpath, "/") == 0) continue;
+    char procs[1024];
+    if (*ctrl == '\0') {  // v2 unified hierarchy
+      snprintf(procs, sizeof procs, "/sys/fs/cgroup%s/cgroup.procs", cpath);
+    } else if (strstr(ctrl, "memory") != NULL || strstr(ctrl, "cpu") != NULL) {
+      snprintf(procs, sizeof procs, "/sys/fs/cgroup/%s%s/cgroup.procs", ctrl,
+               cpath);
+    } else {
+      continue;
+    }
+    if (write_file(procs, "0") != 0)
+      fprintf(stderr, "nsexec: warning: cgroup join %s: %s\n", procs,
+              strerror(errno));
+  }
+  fclose(f);
+}
+
+static int enter_namespaces(pid_t target, char **cmd) {
+  const char *names[] = {"mnt", "ipc", "uts", "pid"};
+  int fds[4];
+  char path[64];
+  for (int i = 0; i < 4; i++) {
+    snprintf(path, sizeof path, "/proc/%d/ns/%s", (int)target, names[i]);
+    fds[i] = open(path, O_RDONLY);
+    if (fds[i] < 0) {
+      fprintf(stderr, "nsexec: open %s: %s\n", path, strerror(errno));
+      return SHEPHERD_ERR;
+    }
+  }
+  join_target_cgroups(target);
+  for (int i = 0; i < 4; i++) {
+    if (setns(fds[i], 0) != 0) {
+      fprintf(stderr, "nsexec: setns %s: %s\n", names[i], strerror(errno));
+      return SHEPHERD_ERR;
+    }
+    close(fds[i]);
+  }
+  // joining the pid ns affects children only: fork so the command runs
+  // inside, shepherd waits outside
+  pid_t pid = fork();
+  if (pid < 0) return SHEPHERD_ERR;
+  if (pid == 0) {
+    // mnt join already switched root/cwd to the target's; stay at /
+    if (chdir("/") != 0) { /* best effort */ }
+    execvp(cmd[0], cmd);
+    fprintf(stderr, "nsexec: exec %s: %s\n", cmd[0], strerror(errno));
+    _exit(127);
+  }
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {
+  }
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
+  return SHEPHERD_ERR;
+}
+
 static int check_isolation() {
   // fork first: unshare(CLONE_NEWPID) changes what fork() creates, and we
   // don't want to disturb the caller's process
@@ -283,9 +366,12 @@ int main(int argc, char **argv) {
   long memory_mb = 0;
   long cpu_shares = 0;
   int i = 1;
+  long enter_pid = 0;
   for (; i < argc; i++) {
     if (strcmp(argv[i], "--check") == 0) {
       return check_isolation();
+    } else if (strcmp(argv[i], "--enter") == 0 && i + 1 < argc) {
+      enter_pid = atol(argv[++i]);
     } else if (strcmp(argv[i], "--workdir") == 0 && i + 1 < argc) {
       workdir = argv[++i];
     } else if (strcmp(argv[i], "--hostname") == 0 && i + 1 < argc) {
@@ -323,6 +409,10 @@ int main(int argc, char **argv) {
     return SHEPHERD_ERR;
   }
   char **cmd = &argv[i];
+
+  if (enter_pid > 0) {
+    return enter_namespaces((pid_t)enter_pid, cmd);
+  }
 
   if (cgroup != NULL) setup_cgroups(cgroup, memory_mb, cpu_shares);
 

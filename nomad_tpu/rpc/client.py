@@ -12,14 +12,10 @@ node agent (client/client.py) works identically in-process or over TCP.
 
 from __future__ import annotations
 
-import itertools
-import select
 import socket
 import threading
 import time
 from typing import Optional
-
-from .codec import RPC_NOMAD, ConnectionClosed, read_frame, write_frame
 
 
 class RpcError(Exception):
@@ -30,127 +26,76 @@ class RpcError(Exception):
         self.leader_rpc_addr = leader_rpc_addr
 
 
-class _SendFailed(Exception):
-    """The request frame failed to SEND: the server cannot have received a
-    complete frame, so it cannot have executed the call — re-sending on a
-    fresh connection is safe even for non-idempotent writes. Failures
-    after the frame was flushed must NOT be retried (the server may have
-    executed the call and died before answering)."""
-
-    def __init__(self, cause: BaseException):
-        super().__init__(str(cause))
-        self.cause = cause
-
-
-class _Conn:
-    def __init__(self, addr: str, timeout: float, tls_context=None):
-        host, port = addr.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if tls_context is not None:
-            self.sock = tls_context.wrap_socket(self.sock)
-        self.sock.sendall(bytes([RPC_NOMAD]))
-        self.lock = threading.Lock()
-        self.seq = itertools.count(1)
-
-    def stale(self) -> bool:
-        """A pooled conn that is readable while idle has either been
-        closed by the server (EOF/RST pending) or is protocol-broken
-        (unsolicited bytes); both mean it must not carry the next call.
-        select-based so it works for TLS sockets too (SSLSocket rejects
-        MSG_PEEK)."""
-        try:
-            readable, _, _ = select.select([self.sock], [], [], 0)
-        except (OSError, ValueError):
-            return True
-        return bool(readable)
-
-    def call(self, method: str, payload, timeout: Optional[float] = None):
-        with self.lock:
-            if timeout is not None:
-                self.sock.settimeout(timeout)
-            seq = next(self.seq)
-            try:
-                write_frame(self.sock, [seq, method, payload])
-            except socket.timeout:
-                raise
-            except (ConnectionClosed, OSError) as e:
-                raise _SendFailed(e) from e
-            rseq, error, result = read_frame(self.sock)
-            if rseq != seq:
-                raise ConnectionClosed("rpc sequence mismatch")
-            if error is not None:
-                raise RpcError(
-                    error.get("code", "error"),
-                    error.get("message", ""),
-                    error.get("leader_rpc_addr"),
-                )
-            return result
-
-    def call_stream(self, method: str, payload, timeout: Optional[float] = None):
-        """Streaming RPC (ref structs/streaming_rpc.go): yields each chunk
-        frame until the server's end-of-stream marker. Holds the
-        connection for the stream's duration."""
-        with self.lock:
-            if timeout is not None:
-                self.sock.settimeout(timeout)
-            seq = next(self.seq)
-            try:
-                write_frame(self.sock, [seq, method, payload])
-            except (ConnectionClosed, OSError) as e:
-                raise _SendFailed(e) from e
-            while True:
-                rseq, error, result = read_frame(self.sock)
-                if rseq != seq:
-                    raise ConnectionClosed("rpc sequence mismatch")
-                if error is not None:
-                    raise RpcError(
-                        error.get("code", "error"),
-                        error.get("message", ""),
-                        error.get("leader_rpc_addr"),
-                    )
-                if not result.get("more"):
-                    return
-                yield result.get("chunk")
-
-    def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
-
-
 class ConnPool:
-    """Persistent connections per server address (ref helper/pool)."""
+    """ONE multiplexed session per server address (the yamux-analog pool,
+    ref helper/pool + nomad/rpc.go:243): every concurrent call — unary,
+    streaming, or duplex — is a logical stream on the shared connection,
+    so the process holds one socket per peer regardless of in-flight call
+    count. Dead sessions are replaced on next use."""
 
     def __init__(self, timeout: float = 10.0, tls_context=None):
         self.timeout = timeout
         self.tls_context = tls_context
-        self._conns: dict[str, list[_Conn]] = {}
+        self._sessions: dict[str, "MuxSession"] = {}
         self._lock = threading.Lock()
 
-    def _acquire(self, addr: str) -> tuple[_Conn, bool]:
-        """→ (conn, pooled): pooled connections may be stale — the server
-        can have closed them between calls — so callers retry once with a
-        fresh connection on a connection-level failure."""
-        while True:
-            with self._lock:
-                conns = self._conns.setdefault(addr, [])
-                conn = conns.pop() if conns else None
-            if conn is None:
-                break
-            # server-closed-idle conns are detected HERE, before the
-            # request is written, so the at-most-once retry rule below
-            # rarely has to reject a genuinely-safe resend
-            if conn.stale():
-                conn.close()
-                continue
-            return conn, True
-        return _Conn(addr, self.timeout, tls_context=self.tls_context), False
+    def _session(self, addr: str):
+        """→ (session, cached): a cached session may have died since its
+        last use; callers retry once on a fresh one when opening fails.
+        The dial (and TLS handshake) happens OUTSIDE the pool lock — one
+        unreachable server must not stall calls to every other address."""
+        from .codec import RPC_STREAMING
+        from .mux import MuxSession
 
-    def _release(self, addr: str, conn: _Conn):
         with self._lock:
-            self._conns.setdefault(addr, []).append(conn)
+            sess = self._sessions.get(addr)
+            if sess is not None and not sess.dead:
+                return sess, True
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self.timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.tls_context is not None:
+            sock = self.tls_context.wrap_socket(sock)
+        sock.sendall(bytes([RPC_STREAMING]))
+        sess = MuxSession(sock).start()
+        with self._lock:
+            racer = self._sessions.get(addr)
+            if racer is not None and not racer.dead:
+                # another thread dialed first; one session per addr wins
+                sess.close()
+                return racer, True
+            self._sessions[addr] = sess
+            return sess, False
+
+    def _open(self, addr: str, method: str, payload, retry_stale: bool):
+        """Open a stream, retrying once on a fresh session if the cached
+        one died — safe because a failed open means the request frame
+        never reached the server whole."""
+        from .mux import StreamClosed
+
+        try:
+            sess, cached = self._session(addr)
+        except OSError as e:
+            raise RpcError("connect", f"{addr}: {e}")
+        try:
+            return sess.open(method, payload)
+        except StreamClosed:
+            with self._lock:
+                if self._sessions.get(addr) is sess:
+                    del self._sessions[addr]
+            if cached and retry_stale:
+                return self._open(addr, method, payload, retry_stale=False)
+            raise RpcError("connection", f"{addr}: session closed")
+
+    @staticmethod
+    def _rpc_error(err: dict) -> RpcError:
+        return RpcError(
+            err.get("code", "error"),
+            err.get("message", ""),
+            err.get("leader_rpc_addr"),
+        )
 
     def call(
         self,
@@ -162,81 +107,69 @@ class ConnPool:
         retry_stale: bool = True,
     ):
         """One RPC. On a not_leader error with a leader hint, retries once
-        against the leader (follower→leader forwarding); a stale POOLED
-        connection (closed by the server between calls) retries once on a
-        fresh connection (helper/pool's reconnect-on-reuse) — but ONLY
-        when the request frame failed to send, so the server cannot have
-        executed it. Failures after the frame was flushed — including a
-        timeout, where the handler may still be running — are never
-        retried: re-sending would duplicate a non-idempotent write. The
-        stale retry fires at most once per call (retry_stale), even if
-        another thread repopulates the pool between attempts."""
+        against the leader (follower→leader forwarding). A dead cached
+        session retries once on a fresh one — but ONLY when the open
+        failed to send, so the server cannot have executed the call.
+        Failures after the request was flushed — including a timeout,
+        where the handler may still be running — are never retried:
+        re-sending would duplicate a non-idempotent write."""
+        from .mux import StreamClosed, StreamError
+
+        stream = self._open(addr, method, payload, retry_stale)
         try:
-            conn, pooled = self._acquire(addr)
-        except OSError as e:
-            raise RpcError("connect", f"{addr}: {e}")
-        try:
-            result = conn.call(method, payload, timeout=timeout or self.timeout)
-            self._release(addr, conn)
+            result = stream.recv(timeout=timeout or self.timeout)
+            stream.close()
             return result
-        except RpcError as e:
-            self._release(addr, conn)
-            if e.code == "not_leader" and retry_leader and e.leader_rpc_addr:
+        except StreamError as e:
+            stream.close()
+            err = self._rpc_error(e.error)
+            if (
+                err.code == "not_leader"
+                and retry_leader
+                and err.leader_rpc_addr
+            ):
                 return self.call(
-                    e.leader_rpc_addr, method, payload,
+                    err.leader_rpc_addr, method, payload,
                     timeout=timeout, retry_leader=False,
                 )
-            raise
-        except socket.timeout as e:
-            conn.close()
-            raise RpcError("timeout", f"{addr}: {method}: {e}")
-        except _SendFailed as e:
-            conn.close()
-            if pooled and retry_stale:
-                # drop every pooled conn to this addr (likely all stale)
-                # and run the call on a fresh connection; safe because the
-                # request frame never reached the server whole
-                with self._lock:
-                    for stale in self._conns.pop(addr, []):
-                        stale.close()
-                return self.call(
-                    addr, method, payload,
-                    timeout=timeout, retry_leader=retry_leader,
-                    retry_stale=False,
-                )
-            raise RpcError("connection", f"{addr}: {e.cause}")
-        except (ConnectionClosed, OSError) as e:
-            conn.close()
-            raise RpcError("connection", f"{addr}: {e}")
+            raise err
+        except TimeoutError:
+            stream.close()
+            raise RpcError("timeout", f"{addr}: {method}: timed out")
+        except StreamClosed:
+            stream.close()  # release the local stream record
+            raise RpcError("connection", f"{addr}: stream closed")
 
     def call_stream(self, addr: str, method: str, payload,
                     timeout: Optional[float] = None):
-        """Streaming RPC on a dedicated connection (yields chunks). The
-        connection returns to the pool only after the stream completes;
-        a broken stream closes it."""
+        """Streaming RPC: yields chunk frames until end of stream. Rides
+        the shared session — other calls proceed concurrently."""
+        from .mux import StreamClosed, StreamError
+
+        stream = self._open(addr, method, payload, retry_stale=True)
         try:
-            conn, _ = self._acquire(addr)
-        except OSError as e:
-            raise RpcError("connect", f"{addr}: {e}")
-        ok = False
-        try:
-            for chunk in conn.call_stream(
-                method, payload, timeout=timeout or self.timeout
-            ):
-                yield chunk
-            ok = True
+            while True:
+                try:
+                    yield stream.recv(timeout=timeout or self.timeout)
+                except StreamClosed:
+                    return
+                except StreamError as e:
+                    raise self._rpc_error(e.error)
+                except TimeoutError:
+                    raise RpcError("timeout", f"{addr}: {method}: timed out")
         finally:
-            if ok:
-                self._release(addr, conn)
-            else:
-                conn.close()
+            stream.close()
+
+    def call_duplex(self, addr: str, method: str, payload):
+        """Open a BIDIRECTIONAL stream (the exec path): returns the live
+        mux Stream; the caller drives send()/recv()/close()."""
+        return self._open(addr, method, payload, retry_stale=True)
 
     def close(self):
         with self._lock:
-            for conns in self._conns.values():
-                for c in conns:
-                    c.close()
-            self._conns.clear()
+            for sess in self._sessions.values():
+                sess.close()
+            self._sessions.clear()
 
 
 class ServerProxy:

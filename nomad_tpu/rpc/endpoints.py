@@ -104,6 +104,49 @@ def register_endpoints(server, rpc) -> None:
         ),
     )
 
+    def exec_forward(payload, stream):
+        """Server hop of the interactive exec path: open the duplex stream
+        to the hosting node's client and pump frames both ways until
+        either side ends (the agent→server→client forwarding of the
+        reference's alloc exec)."""
+        import threading as _threading
+
+        from .mux import StreamClosed, StreamError
+
+        client_stream = server.open_client_exec(
+            payload["alloc_id"],
+            {
+                "task": payload.get("task", ""),
+                "cmd": payload.get("cmd", []),
+                "tty": payload.get("tty", False),
+            },
+        )
+
+        def pump(src, dst):
+            try:
+                for frame in src:
+                    dst.send(frame)
+                dst.close()
+            except StreamError as e:
+                # the node ended with a typed error (task not found, ...):
+                # relay it verbatim instead of degrading to "internal"
+                src.close()
+                dst.close(e.error)
+            except (StreamClosed, TimeoutError, OSError):
+                # either side dropped mid-bridge (peer disconnect or pool
+                # teardown) — close both directions and stop quietly
+                src.close()
+                dst.close()
+
+        up = _threading.Thread(
+            target=pump, args=(stream, client_stream), daemon=True
+        )
+        up.start()
+        pump(client_stream, stream)
+        up.join(timeout=5.0)
+
+    rpc.register_duplex("ClientAllocations.ExecForward", exec_forward)
+
     rpc.register("Status.Ping", lambda p: {"ok": True})
     rpc.register(
         "Status.Leader",

@@ -22,10 +22,12 @@ from ..raft import NotLeaderError
 from .codec import (
     RPC_NOMAD,
     RPC_RAFT,
+    RPC_STREAMING,
     ConnectionClosed,
     read_frame,
     write_frame,
 )
+from .mux import MuxSession, Stream, StreamClosed
 
 logger = logging.getLogger("nomad_tpu.rpc")
 
@@ -39,6 +41,7 @@ class RpcServer:
         self.tls_context = tls_context
         self.handlers: dict[str, Callable] = {}
         self.stream_handlers: dict[str, Callable] = {}
+        self.duplex_handlers: dict[str, Callable] = {}
         self.raft_handlers: dict[str, Callable] = {}
         # maps raft node_id -> rpc "host:port" (fed by config/gossip) so
         # NotLeaderError responses can carry a dialable leader address
@@ -55,8 +58,18 @@ class RpcServer:
         """Register a streaming method (ref structs/streaming_rpc.go): the
         handler is a GENERATOR; each yielded item goes out as its own
         frame `[seq, None, {"chunk": item, "more": True}]`, terminated by
-        `{"more": False}` (or an error frame)."""
+        `{"more": False}` (or an error frame). On the multiplexed protocol
+        each yield is one stream data frame instead."""
         self.stream_handlers[method] = handler
+
+    def register_duplex(self, method: str, handler: Callable):
+        """Register a BIDIRECTIONAL streaming method (the reference's
+        ExecTaskStreaming shape, plugins/drivers/proto/driver.proto:72-76):
+        ``handler(payload, stream)`` runs on its own thread with a live
+        mux Stream — it may recv() input frames (stdin) and send() output
+        frames concurrently. Only reachable over the multiplexed
+        protocol."""
+        self.duplex_handlers[method] = handler
 
     def register(self, method: str, handler: Callable):
         self.handlers[method] = handler
@@ -119,6 +132,8 @@ class RpcServer:
                 self._serve_rpc(conn, self._dispatch)
             elif proto[0] == RPC_RAFT:
                 self._serve_rpc(conn, self._dispatch_raft)
+            elif proto[0] == RPC_STREAMING:
+                self._serve_mux(conn)
             else:
                 logger.warning("unknown rpc protocol byte %r", proto)
         except ssl.SSLError as e:
@@ -184,6 +199,71 @@ class RpcServer:
                 write_frame(
                     conn, [seq, {"code": "internal", "message": str(e)}, None]
                 )
+
+    # ------------------------------------------------------------------
+    # multiplexed protocol (yamux analog, rpc/mux.py): every RPC —
+    # unary, streaming, or duplex — is one logical stream on a shared
+    # connection, so client fd count stays flat at cluster scale
+    # ------------------------------------------------------------------
+    def _serve_mux(self, conn: socket.socket):
+        def on_open(stream: Stream, method: str, payload):
+            t = threading.Thread(
+                target=self._run_mux_stream,
+                args=(stream, method, payload),
+                daemon=True,
+                name=f"mux-{method}",
+            )
+            t.start()
+
+        session = MuxSession(conn, on_open=on_open)
+        # this thread IS the session's reader loop (one thread per conn,
+        # same as the legacy protocol; per-stream work runs on on_open
+        # threads)
+        session._read_loop()
+
+    def _run_mux_stream(self, stream: Stream, method: str, payload):
+        try:
+            duplex = self.duplex_handlers.get(method)
+            if duplex is not None:
+                duplex(payload, stream)
+                stream.close()
+                return
+            gen = self.stream_handlers.get(method)
+            if gen is not None:
+                for chunk in gen(payload):
+                    stream.send(chunk)
+                stream.close()
+                return
+            result = self._dispatch(method, payload)
+            stream.send(result)
+            stream.close()
+        except StreamClosed:
+            pass
+        except Exception as e:
+            if not isinstance(
+                e, (NotLeaderError, KeyError, ValueError)
+            ):
+                logger.exception("rpc handler error for %s", method)
+            try:
+                stream.close(self._error_obj(e))
+            except StreamClosed:
+                pass
+
+    def _error_obj(self, e: Exception) -> dict:
+        if isinstance(e, NotLeaderError):
+            leader_rpc = None
+            if e.leader_id and e.leader_id in self.server_rpc_addrs:
+                leader_rpc = self.server_rpc_addrs[e.leader_id]
+            return {
+                "code": "not_leader",
+                "message": str(e),
+                "leader_rpc_addr": leader_rpc,
+            }
+        if isinstance(e, KeyError):
+            return {"code": "not_found", "message": str(e)}
+        if isinstance(e, ValueError):
+            return {"code": "invalid", "message": str(e)}
+        return {"code": "internal", "message": str(e)}
 
     def _dispatch(self, method: str, payload):
         handler = self.handlers.get(method)

@@ -1,0 +1,300 @@
+"""Interactive streaming exec end-to-end (VERDICT r2 #3; ref
+plugins/drivers/proto/driver.proto:72-76 ExecTaskStreaming + the agent→
+server→client forwarding of alloc exec): stdin echoes back through
+agent → server RPC → client RPC → driver, over real TCP."""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.agent import ClientAgent, ServerAgent
+from nomad_tpu.rpc import ConnPool
+from nomad_tpu.rpc.mux import StreamClosed
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cluster():
+    server = ServerAgent("exec-s0", config={"seed": 7, "heartbeat_ttl": 10.0})
+    server.start(num_workers=2, wait_for_leader=10.0)
+    client = ClientAgent([server.address])
+    client.start()
+    try:
+        wait_until(
+            lambda: server.server.state.node_by_id(client.node.id) is not None,
+            msg="node registered",
+        )
+        yield server, client
+    finally:
+        client.stop()
+        server.stop()
+
+
+def run_task(server, client, command="sleep", args=("60",)):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": command, "args": list(args)}
+    task.resources.networks = []
+    server.server.job_register(job)
+    state = server.server.state
+
+    def running():
+        allocs = state.allocs_by_job(job.namespace, job.id)
+        return allocs and all(
+            a.client_status == "running" for a in allocs
+        )
+
+    wait_until(running, msg="alloc running")
+    return state.allocs_by_job(job.namespace, job.id)[0]
+
+
+def collect(stream, timeout=15.0):
+    """Drain output frames until exit; returns (bytes, exit_code)."""
+    out = b""
+    code = None
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            frame = stream.recv(timeout=timeout)
+        except StreamClosed:
+            break
+        if "stdout" in frame and frame["stdout"]:
+            out += frame["stdout"]
+        if "stderr" in frame and frame["stderr"]:
+            out += frame["stderr"]
+        if "exit" in frame:
+            code = frame["exit"]
+            break
+    return out, code
+
+
+def test_interactive_stdin_echo_through_server(cluster):
+    """agent→server→client→driver: `cat` run inside the task context
+    echoes interactive stdin frames back, then reports exit 0 on EOF."""
+    server, client = cluster
+    alloc = run_task(server, client)
+
+    pool = ConnPool()
+    try:
+        stream = pool.call_duplex(
+            server.address,
+            "ClientAllocations.ExecForward",
+            {"alloc_id": alloc.id, "task": "web", "cmd": ["cat"]},
+        )
+        stream.send({"stdin": b"hello exec\n"})
+        frame = stream.recv(timeout=15)
+        assert frame.get("stdout") == b"hello exec\n", frame
+        stream.send({"stdin": b"round 2\n"})
+        frame = stream.recv(timeout=15)
+        assert frame.get("stdout") == b"round 2\n", frame
+        # half-close = stdin EOF -> cat exits 0
+        stream.close()
+        out, code = collect(stream)
+        assert code == 0
+    finally:
+        pool.close()
+
+
+def test_exec_runs_in_task_context(cluster):
+    """The exec command sees the task's working directory and env."""
+    server, client = cluster
+    alloc = run_task(server, client)
+    task_dir = client.client.alloc_runners[alloc.id].task_dir("web")
+
+    pool = ConnPool()
+    try:
+        stream = pool.call_duplex(
+            server.address,
+            "ClientAllocations.ExecForward",
+            {"alloc_id": alloc.id, "task": "web", "cmd": ["pwd"]},
+        )
+        stream.close()
+        out, code = collect(stream)
+        assert code == 0
+        assert out.decode().strip() == task_dir
+    finally:
+        pool.close()
+
+
+def test_exec_tty_allocates_terminal(cluster):
+    server, client = cluster
+    alloc = run_task(server, client)
+
+    pool = ConnPool()
+    try:
+        stream = pool.call_duplex(
+            server.address,
+            "ClientAllocations.ExecForward",
+            {
+                "alloc_id": alloc.id,
+                "task": "web",
+                "cmd": ["sh", "-c", "tty && stty size"],
+                "tty": True,
+            },
+        )
+        stream.send({"resize": [40, 120]})
+        out, code = collect(stream)
+        assert code == 0
+        text = out.decode()
+        assert "/dev/pts/" in text or "/dev/tty" in text, text
+    finally:
+        pool.close()
+
+
+def test_exec_unknown_alloc_errors(cluster):
+    server, client = cluster
+    pool = ConnPool()
+    try:
+        stream = pool.call_duplex(
+            server.address,
+            "ClientAllocations.ExecForward",
+            {"alloc_id": "nope", "task": "web", "cmd": ["cat"]},
+        )
+        with pytest.raises(Exception) as exc:
+            stream.recv(timeout=10)
+        assert "not found" in str(exc.value)
+    finally:
+        pool.close()
+
+
+def test_exec_in_namespace_with_exec_driver(cluster):
+    """The exec driver's exec-in-context enters the task's namespaces via
+    nsexec --enter: the exec'd process must see the task's UTS hostname,
+    which only exists inside the namespace."""
+    from nomad_tpu.client.driver import ExecDriver
+
+    drv = ExecDriver()
+    if not drv._healthy:
+        pytest.skip("namespace isolation unavailable")
+    server, client = cluster
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "exec"
+    task.config = {"command": "sleep", "args": ["60"]}
+    task.resources.networks = []
+    server.server.job_register(job)
+    state = server.server.state
+    wait_until(
+        lambda: (
+            (allocs := state.allocs_by_job(job.namespace, job.id))
+            and all(a.client_status == "running" for a in allocs)
+        ),
+        msg="exec-driver alloc running",
+    )
+    alloc = state.allocs_by_job(job.namespace, job.id)[0]
+
+    pool = ConnPool()
+    try:
+        stream = pool.call_duplex(
+            server.address,
+            "ClientAllocations.ExecForward",
+            {"alloc_id": alloc.id, "task": "web", "cmd": ["hostname"]},
+        )
+        stream.close()
+        out, code = collect(stream)
+        assert code == 0
+        # nsexec sets the namespace hostname to "nomad-task" by default
+        assert out.decode().strip() == "nomad-task"
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# websocket surface (agent HTTP -> exec; ref alloc_endpoint.go execStream)
+# ---------------------------------------------------------------------------
+
+
+def test_exec_ws_local_devagent():
+    """DevAgent: the websocket exec bridges straight to the in-process
+    client's driver; stdin echoes and the exit frame arrives."""
+    from nomad_tpu.agent import DevAgent
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HTTPServer
+
+    agent = DevAgent(num_clients=1, server_config={"heartbeat_ttl": 10.0})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    try:
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "sleep", "args": ["60"]}
+        task.resources.networks = []
+        agent.run_job(job)
+        state = agent.server.state
+        wait_until(
+            lambda: (
+                (allocs := state.allocs_by_job(job.namespace, job.id))
+                and all(a.client_status == "running" for a in allocs)
+            ),
+            msg="alloc running",
+        )
+        alloc = state.allocs_by_job(job.namespace, job.id)[0]
+
+        api = ApiClient(address=http.address)
+        session = api.alloc_exec_session(alloc.id, "web", ["cat"])
+        session.send_stdin(b"ws echo\n")
+        frame = session.recv_frame(timeout=15)
+        assert frame and frame.get("stdout") == b"ws echo\n", frame
+        session.close_stdin()
+        code = None
+        for _ in range(50):
+            frame = session.recv_frame(timeout=15)
+            if frame is None:
+                break
+            if frame.get("exited"):
+                code = frame["exit_code"]
+                break
+        assert code == 0
+        session.close()
+    finally:
+        http.stop()
+        agent.stop()
+
+
+def test_exec_ws_remote_forward(cluster):
+    """ServerAgent HTTP (no local client) forwards the websocket session
+    over the duplex RPC to the hosting node."""
+    from nomad_tpu.api.client import ApiClient
+    from nomad_tpu.api.http import HTTPServer
+
+    server, client = cluster
+    alloc = run_task(server, client)
+    http = HTTPServer(server.server, port=0)
+    http.start()
+    try:
+        api = ApiClient(address=http.address)
+        session = api.alloc_exec_session(alloc.id, "web", ["cat"])
+        session.send_stdin(b"remote ws\n")
+        frame = session.recv_frame(timeout=15)
+        assert frame and frame.get("stdout") == b"remote ws\n", frame
+        session.close_stdin()
+        code = None
+        for _ in range(50):
+            frame = session.recv_frame(timeout=15)
+            if frame is None:
+                break
+            if frame.get("exited"):
+                code = frame["exit_code"]
+                break
+        assert code == 0
+        session.close()
+    finally:
+        http.stop()
