@@ -63,6 +63,11 @@ def apply_client_config(agent: "DevAgent", config: dict) -> None:
     client_cfg = config.get("client", {}) or {}
     volumes = client_cfg.get("host_volume") or {}
     meta = client_cfg.get("meta") or {}
+    # vault{address} flows to clients for template ${vault.*} reads
+    vault_cfg = config.get("vault") or {}
+    if vault_cfg.get("address"):
+        for client in agent.clients:
+            client.vault_config = dict(vault_cfg)
     if not volumes and not meta:
         return
     from .structs.model import ClientHostVolumeConfig

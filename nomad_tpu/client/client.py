@@ -104,12 +104,36 @@ class TaskRunner:
             restart_policy = tg.restart_policy
 
         while not self._stop.is_set():
+            tmpl_mgr = None
+            recovered_changed: list = []
             if self._recovered_handle is not None:
                 # reattached by RecoverTask after a client restart: skip
                 # driver start, resume supervision of the live handle
                 self.handle = self._recovered_handle
                 self._recovered_handle = None
                 self._event("Recovered", "Task reattached after client restart")
+                # live templates resume watching across client restarts
+                # (env rebuilt; rendered files already on disk, so only
+                # genuinely changed content rewrites/restarts)
+                try:
+                    from . import hooks, taskenv
+
+                    task_dir = self.alloc_runner.task_dir(self.task.name)
+                    env = taskenv.build_env(
+                        self.alloc_runner.alloc,
+                        self.task,
+                        self.alloc_runner.client.node,
+                        task_dir,
+                        self.alloc_runner.alloc_dir(),
+                    )
+                    self._env = env
+                    tmpl_mgr = self._template_manager(task_dir, env)
+                    if tmpl_mgr is not None:
+                        # content that changed while the client was down
+                        # still owes its change_mode once running
+                        recovered_changed = tmpl_mgr.render_all()
+                except Exception:
+                    logger.exception("template recovery failed")
             else:
                 try:
                     self._event("Task Setup", "Building task directory and environment")
@@ -126,9 +150,18 @@ class TaskRunner:
                         task_dir,
                         self.alloc_runner.alloc_dir(),
                         extra_env=self.alloc_runner.device_env(self.task.name),
+                        # the TemplateManager below is the single renderer
+                        # (dynamic sources resolved, no blank first write)
+                        skip_templates=bool(self.task.templates),
                     )
                     self._env = env  # service checks interpolate against it
                     self._vault_hook(task, task_dir)
+                    # live templates: dynamic sources (${service.*},
+                    # ${vault.*}) render before start and are then watched
+                    # for change_mode restart/signal (template.go:408-445)
+                    tmpl_mgr = self._template_manager(task_dir, env)
+                    if tmpl_mgr is not None:
+                        tmpl_mgr.render_all(first=True)
                     self.handle = self.driver.start_task(task, task_dir)
                 except Exception as e:
                     # Start failures route through the restart policy like any
@@ -159,10 +192,16 @@ class TaskRunner:
 
             check_runner = CheckRunner(self)
             check_runner.start()
+            if tmpl_mgr is not None:
+                tmpl_mgr.start()
+                if recovered_changed:
+                    tmpl_mgr._apply_change_modes(recovered_changed)
             try:
                 self.handle.wait()
             finally:
                 check_runner.stop()
+                if tmpl_mgr is not None:
+                    tmpl_mgr.stop()
             exit_code = self.handle.exit_code or 0
             failed = exit_code != 0
 
@@ -170,6 +209,7 @@ class TaskRunner:
                 # user-initiated restart (ref taskrunner Restart): loop
                 # without touching the restart-policy budget
                 self._restarting = False
+                self._destroy_handle()  # release container/image refs
                 self.state = TaskState(
                     state="pending", restarts=self.state.restarts + 1
                 )
@@ -186,6 +226,7 @@ class TaskRunner:
                     restarts=self.state.restarts,
                 )
                 self.alloc_runner.task_state_updated()
+                self._destroy_handle()
                 return
 
             if not failed:
@@ -198,10 +239,12 @@ class TaskRunner:
                 )
                 self._event("Terminated", f"Exit Code: {exit_code}")
                 self.alloc_runner.task_state_updated()
+                self._destroy_handle()
                 return
 
             # Restart policy (ref client/allocrunner/taskrunner/restarts/)
             if restart_policy is not None and self._restart_or_wait(restart_policy):
+                self._destroy_handle()  # release container/image refs
                 self.state = TaskState(
                     state="pending", restarts=self.state.restarts + 1
                 )
@@ -220,7 +263,49 @@ class TaskRunner:
             )
             self._event("Terminated", f"Exit Code: {exit_code}, failed")
             self.alloc_runner.task_state_updated()
+            self._destroy_handle()
             return
+
+    def _template_manager(self, task_dir: str, env: dict):
+        """Build the live-template manager when the task has templates
+        (dynamic refs populate its watch set on the first render; a task
+        with only static templates gets a manager that never starts)."""
+        if not self.task.templates:
+            return None
+        from .template import TemplateManager, TemplateSources
+
+        client = self.alloc_runner.client
+        vault_cfg = getattr(client, "vault_config", None) or {}
+        sources = TemplateSources(
+            catalog=getattr(client.server, "catalog_service", None),
+            vault_addr=vault_cfg.get("address", ""),
+            vault_token=self._vault_token or "",
+        )
+        return TemplateManager(
+            self.task,
+            task_dir,
+            env,
+            client.node,
+            sources,
+            restart_fn=self.restart,
+            signal_fn=self.signal,
+            event_fn=self._event,
+            poll_interval=getattr(client, "template_poll_interval", 3.0),
+        )
+
+    def _destroy_handle(self):
+        """Release driver-held task resources (containers, image refs) at
+        terminal exit — loudly: a failed cleanup lands on the task
+        timeline instead of leaking (ref taskrunner destroy path)."""
+        if self.handle is None:
+            return
+        try:
+            self.driver.destroy_task(self.handle)
+        except Exception as e:
+            self._event("Driver Failure", f"failed to destroy task: {e}")
+            logger.error(
+                "destroy_task failed for %s: %s", self.task.name, e
+            )
 
     def _vault_hook(self, task, task_dir: str):
         """Derive the task's vault token and deliver it into secrets/
@@ -286,11 +371,18 @@ class TaskRunner:
                 )
                 self.handle.wait(delay)
             self._event("Killing", "Task being killed")
-            self.driver.stop_task(
-                self.handle,
-                timeout=max(self.task.kill_timeout / 1e9, 0.1),
-                signal_name=self.task.kill_signal,
-            )
+            try:
+                self.driver.stop_task(
+                    self.handle,
+                    timeout=max(self.task.kill_timeout / 1e9, 0.1),
+                    signal_name=self.task.kill_signal,
+                )
+            except Exception as e:
+                # a failed kill must be LOUD on the task timeline — a
+                # wedged container/process is an operator problem, not a
+                # silent leak (ref TaskEvent TaskKilling failures)
+                self._event("Driver Failure", f"failed to stop task: {e}")
+                logger.error("stop_task failed for %s: %s", self.task.name, e)
 
     def restart(self):
         """User-initiated restart (ref client_alloc_endpoint.go Restart →
@@ -304,11 +396,19 @@ class TaskRunner:
             raise ValueError(f"task {self.task.name!r} is not running")
         self._restarting = True
         self._event("Restart Signaled", "User requested task restart")
-        self.driver.stop_task(
-            self.handle,
-            timeout=max(self.task.kill_timeout / 1e9, 0.1),
-            signal_name=self.task.kill_signal,
-        )
+        try:
+            self.driver.stop_task(
+                self.handle,
+                timeout=max(self.task.kill_timeout / 1e9, 0.1),
+                signal_name=self.task.kill_signal,
+            )
+        except Exception as e:
+            # the task is still running: clear the flag so its NEXT exit
+            # isn't misread as a user restart (which would bypass the
+            # restart-policy budget)
+            self._restarting = False
+            self._event("Driver Failure", f"failed to stop task: {e}")
+            raise
 
     def signal(self, signal_name: str):
         """Deliver a signal to the running task (ref SignalTask RPC)."""
@@ -567,6 +667,10 @@ class Client:
         # Optional cap on restart backoff (dev/test speedup); None = honor
         # the task group's configured delay in full
         self.max_restart_delay: Optional[float] = None
+        #: vault{address} for template ${vault.*} reads (agent config)
+        self.vault_config: dict = {}
+        #: live-template watch poll cadence (template.go's retry ticker)
+        self.template_poll_interval = 3.0
         self.drivers = drivers or default_drivers()
         from .devices import DeviceManager
 
@@ -941,13 +1045,36 @@ class Client:
         stats["node_id"] = self.node.id
         stats["allocs_running"] = len(self.alloc_runners)
         stats["devices"] = self.device_manager.stats()
+        # workload rollup: total task usage across local allocs (the
+        # reference aggregates TaskResourceUsage into client metrics).
+        # TTL-cached: driver stats can shell out (docker stats ~2s per
+        # container), which must not ride every /v1/client/stats poll
+        cached = getattr(self, "_rollup_cache", None)
+        now = time.monotonic()
+        if cached is not None and now - cached[1] < 10.0:
+            stats["allocs_usage"] = cached[0]
+            return stats
+        rollup = {"cpu_time_s": 0.0, "rss_bytes": 0, "pids": 0}
+        for alloc_id in list(self.alloc_runners):
+            try:
+                total = self.alloc_stats(alloc_id).get("resource_usage", {})
+            except KeyError:
+                continue
+            rollup["cpu_time_s"] = round(
+                rollup["cpu_time_s"] + total.get("cpu_time_s", 0.0), 3
+            )
+            rollup["rss_bytes"] += total.get("rss_bytes", 0)
+            rollup["pids"] += total.get("pids", 0)
+        self._rollup_cache = (rollup, now)
+        stats["allocs_usage"] = rollup
         return stats
 
     def alloc_stats(self, alloc_id: str) -> dict:
         """Per-task resource usage for a local alloc (ref
-        client_alloc_endpoint.go Stats → TaskResourceUsage)."""
-        from .stats import task_resource_usage
-
+        client_alloc_endpoint.go Stats → TaskResourceUsage), sourced from
+        each task's DRIVER (driver.proto:59 TaskStats): the exec family
+        walks the process tree, docker asks the engine — container
+        processes aren't our children."""
         runner = self.alloc_runners.get(alloc_id)
         if runner is None:
             raise KeyError(f"alloc not found on this client: {alloc_id}")
@@ -955,10 +1082,11 @@ class Client:
         total = {"cpu_time_s": 0.0, "rss_bytes": 0, "pids": 0}
         for name, tr in runner.task_runners.items():
             usage = (
-                task_resource_usage(tr.handle)
+                tr.driver.task_stats(tr.handle)
                 if tr.handle is not None
                 else {
                     "cpu_time_s": 0.0,
+                    "cpu_percent": 0.0,
                     "rss_bytes": 0,
                     "pids": 0,
                     "timestamp": now_ns(),

@@ -110,6 +110,17 @@ class Driver:
         Drivers without an execution context to enter raise."""
         raise ValueError(f"driver {self.name} does not support exec")
 
+    def task_stats(self, handle: TaskHandle) -> dict:
+        """Per-task resource usage (ref driver.proto:59 TaskStats →
+        TaskResourceUsage): cumulative cpu seconds, sampled cpu percent,
+        RSS and pid count. The default walks the handle's process tree —
+        right for every driver whose task is a local child (exec family,
+        java, qemu); container runtimes override with their own stats
+        source (docker stats)."""
+        from .stats import task_resource_usage
+
+        return task_resource_usage(handle)
+
     # -- plugin config (ref plugins/base/proto base.proto: ConfigSchema +
     # SetConfig, with hclspec's schema-validation role) -----------------
     def config_schema(self) -> dict:

@@ -205,16 +205,22 @@ def volumes_hook(alloc, task, node, task_dir: str):
         os.symlink(host.path, target)
 
 
-def run_prestart(alloc, task, node, task_dir: str, alloc_dir: str, extra_env=None):
+def run_prestart(
+    alloc, task, node, task_dir: str, alloc_dir: str, extra_env=None,
+    skip_templates: bool = False,
+):
     """The prestart pipeline; returns the prepared (interpolated) task copy
-    and its full environment."""
+    and its full environment. ``skip_templates`` hands template rendering
+    to the caller's TemplateManager (the live-template path renders once
+    with dynamic sources instead of a static pass here)."""
     task_dir_hook(task_dir, alloc_dir)
     volumes_hook(alloc, task, node, task_dir)
     env = taskenv.build_env(alloc, task, node, task_dir, alloc_dir)
     env.update(extra_env or {})
     dispatch_payload_hook(alloc, task, task_dir)
     artifacts_hook(task, task_dir, env, node)
-    templates_hook(task, task_dir, env, node)
+    if not skip_templates:
+        templates_hook(task, task_dir, env, node)
 
     prepared = task.copy()
     prepared.env = {

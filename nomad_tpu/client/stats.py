@@ -162,9 +162,13 @@ def pid_stats(pid: int) -> Optional[dict]:
 
 def task_resource_usage(handle) -> dict:
     """ResourceUsage doc for one task handle (ref
-    drivers/shared/executor TaskStats → TaskResourceUsage)."""
+    drivers/shared/executor TaskStats → TaskResourceUsage). CPU percent
+    comes from the delta against the previous sample cached on the handle
+    — the reference's stats collector uses the same consecutive-sample
+    ticker model."""
     usage = {
         "cpu_time_s": 0.0,
+        "cpu_percent": 0.0,
         "rss_bytes": 0,
         "pids": 0,
         "timestamp": time.time_ns(),
@@ -180,6 +184,22 @@ def task_resource_usage(handle) -> dict:
             usage["cpu_time_s"] = round(usage["cpu_time_s"] + st["cpu_time_s"], 3)
             usage["rss_bytes"] += st["rss_bytes"]
             usage["pids"] += 1
+    prev = getattr(handle, "_stats_prev", None)
+    if prev is not None:
+        dt = (usage["timestamp"] - prev[1]) / 1e9
+        if dt < 1.0:
+            # two samplers (host rollup + alloc endpoint) share this slot:
+            # a sub-second delta is numerically worthless, so reuse the
+            # last percent and KEEP the baseline — otherwise concurrent
+            # pollers corrupt each other's deltas
+            usage["cpu_percent"] = prev[2]
+            return usage
+        usage["cpu_percent"] = round(
+            max(usage["cpu_time_s"] - prev[0], 0.0) / dt * 100.0, 2
+        )
+    handle._stats_prev = (
+        usage["cpu_time_s"], usage["timestamp"], usage["cpu_percent"]
+    )
     return usage
 
 

@@ -23,6 +23,16 @@ class BlockedEvals:
         self._jobs: dict[tuple[str, str], Evaluation] = {}
         # eval id -> eval
         self._captured: dict[str, Evaluation] = {}
+        # SYSTEM evals block per (job, node) instead of per job (ref
+        # blocked_evals_system.go:5-27): a system job that failed on one
+        # node must unblock when THAT node frees capacity, independently
+        # of its evals blocked on other nodes
+        self._system: dict[tuple[str, str, str], Evaluation] = {}
+        self._system_by_node: dict[str, set[tuple[str, str, str]]] = {}
+        # per-node capacity-change indexes: closes the same
+        # capacity-arrived-while-blocking race for system evals that
+        # _unblock_indexes closes per class
+        self._node_unblock_indexes: dict[str, int] = {}
         # last state index at which capacity changed, globally and per class
         # (closes the race where capacity arrives while a scheduler is still
         # deciding to block; ref blocked_evals.go unblockIndexes)
@@ -50,17 +60,28 @@ class BlockedEvals:
             # (ref blocked_evals.go missedUnblock)
             if ev.snapshot_index and self._missed_unblock(ev):
                 requeue = True
-            key = (ev.namespace, ev.job_id)
-            # Dedup: one blocked eval per job; keep the newer
-            existing = self._jobs.get(key)
-            if existing is not None:
-                self._captured.pop(existing.id, None)
-                self._escaped.discard(existing.id)
-            if not requeue:
-                self._jobs[key] = ev
-                self._captured[ev.id] = ev
-                if ev.escaped_computed_class:
-                    self._escaped.add(ev.id)
+            if ev.node_id:
+                # per-node system blocked eval (one per job+node,
+                # ref blocked_evals_system.go); never touches the
+                # job-level dedup maps
+                if not requeue:
+                    skey = (ev.namespace, ev.job_id, ev.node_id)
+                    self._system[skey] = ev
+                    self._system_by_node.setdefault(
+                        ev.node_id, set()
+                    ).add(skey)
+            else:
+                key = (ev.namespace, ev.job_id)
+                # Dedup: one blocked eval per job; keep the newer
+                existing = self._jobs.get(key)
+                if existing is not None:
+                    self._captured.pop(existing.id, None)
+                    self._escaped.discard(existing.id)
+                if not requeue:
+                    self._jobs[key] = ev
+                    self._captured[ev.id] = ev
+                    if ev.escaped_computed_class:
+                        self._escaped.add(ev.id)
         if requeue:
             requeued = ev.copy()
             requeued.status = EVAL_STATUS_PENDING
@@ -68,6 +89,12 @@ class BlockedEvals:
 
     def _missed_unblock(self, ev: Evaluation) -> bool:
         """Did a relevant capacity change land after the eval's snapshot?"""
+        if ev.node_id:
+            # system eval: only ITS node's capacity changes matter
+            return (
+                self._node_unblock_indexes.get(ev.node_id, 0)
+                > ev.snapshot_index
+            )
         if ev.escaped_computed_class:
             return self._unblock_index > ev.snapshot_index
         elig = ev.class_eligibility or {}
@@ -85,6 +112,36 @@ class BlockedEvals:
             if ev is not None:
                 self._captured.pop(ev.id, None)
                 self._escaped.discard(ev.id)
+            for skey in [
+                k for k in self._system if k[0] == namespace and k[1] == job_id
+            ]:
+                self._system.pop(skey, None)
+                nodes = self._system_by_node.get(skey[2])
+                if nodes is not None:
+                    nodes.discard(skey)
+
+    # ------------------------------------------------------------------
+    def unblock_node(self, node_id: str, index: int):
+        """Capacity on one node changed (alloc became terminal, node
+        re-registered or turned ready): re-enqueue the SYSTEM evals
+        blocked on exactly that node (ref blocked_evals_system.go
+        UnblockNode)."""
+        to_unblock = []
+        with self._lock:
+            if not self.enabled:
+                return
+            self._unblock_index = max(self._unblock_index, index)
+            self._node_unblock_indexes[node_id] = max(
+                self._node_unblock_indexes.get(node_id, 0), index
+            )
+            for skey in self._system_by_node.pop(node_id, set()):
+                ev = self._system.pop(skey, None)
+                if ev is not None:
+                    to_unblock.append(ev)
+        for ev in to_unblock:
+            requeued = ev.copy()
+            requeued.status = EVAL_STATUS_PENDING
+            self.broker.enqueue(requeued)
 
     # ------------------------------------------------------------------
     def unblock(self, computed_class: str, index: int):
@@ -113,9 +170,12 @@ class BlockedEvals:
         """Unblock everything (e.g. new node registered with unknown class)."""
         with self._lock:
             evals = list(self._captured.values())
+            evals.extend(self._system.values())
             self._captured.clear()
             self._escaped.clear()
             self._jobs.clear()
+            self._system.clear()
+            self._system_by_node.clear()
         for ev in evals:
             requeued = ev.copy()
             requeued.status = EVAL_STATUS_PENDING
@@ -156,10 +216,13 @@ class BlockedEvals:
             self._jobs.clear()
             self._captured.clear()
             self._escaped.clear()
+            self._system.clear()
+            self._system_by_node.clear()
 
     def stats(self) -> dict:
         with self._lock:
             return {
-                "total_blocked": len(self._captured),
+                "total_blocked": len(self._captured) + len(self._system),
                 "total_escaped": len(self._escaped),
+                "total_system_blocked": len(self._system),
             }

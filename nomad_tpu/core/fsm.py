@@ -153,8 +153,10 @@ class FSM:
         node = Node.from_dict(payload["node"])
         self.state.upsert_node(index, node)
         # new capacity unblocks class-matching blocked evals
-        if self.blocked_evals is not None and node.computed_class:
-            self.blocked_evals.unblock(node.computed_class, index)
+        if self.blocked_evals is not None:
+            if node.computed_class:
+                self.blocked_evals.unblock(node.computed_class, index)
+            self.blocked_evals.unblock_node(node.id, index)
         return index
 
     def _apply_node_deregister(self, index: int, payload: dict):
@@ -172,6 +174,7 @@ class FSM:
             node = self.state.node_by_id(payload["node_id"])
             if node is not None and node.computed_class:
                 self.blocked_evals.unblock(node.computed_class, index)
+            self.blocked_evals.unblock_node(payload["node_id"], index)
         return index
 
     def _apply_node_drain_update(self, index: int, payload: dict):
@@ -312,6 +315,13 @@ class FSM:
     def _apply_alloc_client_update(self, index: int, payload: dict):
         allocs = [Allocation.from_dict(d) for d in payload["allocs"]]
         self.state.update_allocs_from_client(index, allocs)
+        # an alloc turning terminal frees capacity on ITS node: per-node
+        # system blocked evals re-enter (ref blocked_evals_system.go;
+        # the fsm's applyAllocClientUpdate → UnblockNode)
+        if self.blocked_evals is not None:
+            for a in allocs:
+                if a.node_id and a.terminal_status():
+                    self.blocked_evals.unblock_node(a.node_id, index)
         # evals created by the endpoint ride the same log entry
         # (ref node_endpoint.go UpdateAlloc → AllocUpdateRequest.Evals)
         self._apply_eval_update(index, {"evals": payload.get("evals", [])})

@@ -54,12 +54,117 @@ class InternalProvider:
             return accessor in self._live
 
 
+class HTTPProvider:
+    """Real-Vault provider: token create/revoke against an external Vault
+    server with a renewable management token (ref nomad/vault.go
+    vaultClient: establishConnection + renewal loop + CreateToken +
+    RevokeTokens)."""
+
+    def __init__(
+        self,
+        address: str,
+        token: str,
+        renew_interval: float = 300.0,
+        timeout: float = 10.0,
+    ):
+        self.address = address.rstrip("/")
+        self.token = token
+        self.renew_interval = renew_interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._renewer: Optional[threading.Thread] = None
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        import json
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.address}/v1/{path.lstrip('/')}",
+            data=data,
+            method=method,
+            headers={"X-Vault-Token": self.token},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("errors", [str(e)])
+            except Exception:
+                detail = [str(e)]
+            raise RuntimeError(f"vault {path}: {'; '.join(map(str, detail))}")
+
+    # -- VaultProvider surface -----------------------------------------
+    def create_token(self, policies: list[str]) -> tuple[str, str]:
+        doc = self._req(
+            "POST",
+            "auth/token/create",
+            {
+                "policies": list(policies),
+                # task tokens must outlive the management connection and
+                # die on their own TTL, like the reference's role tokens
+                "no_parent": True,
+                "renewable": True,
+            },
+        )
+        auth = doc.get("auth") or {}
+        token = auth.get("client_token", "")
+        accessor = auth.get("accessor", "")
+        if not token or not accessor:
+            raise RuntimeError("vault create_token: malformed auth response")
+        return token, accessor
+
+    def revoke_accessor(self, accessor: str) -> None:
+        self._req("POST", "auth/token/revoke-accessor", {"accessor": accessor})
+
+    # -- management-token renewal (vault.go renewal loop) --------------
+    def renew_self(self) -> None:
+        self._req("POST", "auth/token/renew-self", {})
+
+    def start_renewal(self):
+        if self._renewer is not None:
+            return
+        def loop():
+            while not self._stop.wait(self.renew_interval):
+                try:
+                    self.renew_self()
+                except Exception:
+                    logger.warning("vault token renewal failed", exc_info=True)
+        self._renewer = threading.Thread(
+            target=loop, daemon=True, name="vault-renewal"
+        )
+        self._renewer.start()
+
+    def stop(self):
+        self._stop.set()
+
+
+def provider_from_config(config: dict) -> "VaultProvider":
+    """vault{address, token} in the server config selects the real-Vault
+    HTTP provider (with background self-renewal); without an address the
+    self-minting internal provider serves dev mode."""
+    vcfg = config.get("vault", {}) or {}
+    if vcfg.get("address"):
+        provider = HTTPProvider(
+            vcfg["address"],
+            vcfg.get("token", ""),
+            renew_interval=float(vcfg.get("renew_interval_s", 300.0)),
+        )
+        provider.start_renewal()
+        return provider
+    return InternalProvider()
+
+
 class VaultClient:
     """Server-side vault workflow (ref vault.go vaultClient)."""
 
     def __init__(self, server, provider: Optional[VaultProvider] = None):
         self.server = server
-        self.provider = provider or InternalProvider()
+        self.provider = provider or provider_from_config(
+            getattr(server, "config", {}) or {}
+        )
 
     def enabled(self) -> bool:
         return bool(self.server.config.get("vault", {}).get("enabled"))

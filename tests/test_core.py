@@ -434,3 +434,116 @@ class TestNormalizedPlanCommit:
             assert stored_placement.job.id == preemptor_job.id
         finally:
             server.stop()
+
+
+class TestSystemBlockedEvals:
+    """Per-node blocked evals for system jobs (ref
+    blocked_evals_system.go:5-27): a system eval blocked on node A
+    unblocks when A frees capacity — independently of evals blocked on
+    other nodes, and without displacing the job-level dedup."""
+
+    def _mk(self):
+        class FakeBroker:
+            def __init__(self):
+                self.enqueued = []
+
+            def enqueue(self, ev):
+                self.enqueued.append(ev)
+
+        from nomad_tpu.core.blocked_evals import BlockedEvals
+
+        broker = FakeBroker()
+        be = BlockedEvals(broker)
+        be.set_enabled(True)
+        return broker, be
+
+    def _sys_eval(self, job_id, node_id):
+        from nomad_tpu.structs.model import Evaluation, generate_uuid
+
+        return Evaluation(
+            id=generate_uuid(),
+            namespace="default",
+            job_id=job_id,
+            type="system",
+            status="blocked",
+            node_id=node_id,
+        )
+
+    def test_per_node_tracking_and_unblock(self):
+        broker, be = self._mk()
+        e1 = self._sys_eval("sysjob", "node-a")
+        e2 = self._sys_eval("sysjob", "node-b")
+        be.block(e1)
+        be.block(e2)
+        assert be.stats()["total_system_blocked"] == 2
+
+        be.unblock_node("node-a", index=10)
+        assert [e.job_id for e in broker.enqueued] == ["sysjob"]
+        assert be.stats()["total_system_blocked"] == 1
+        # node-b's eval is untouched
+        be.unblock_node("node-b", index=11)
+        assert len(broker.enqueued) == 2
+
+    def test_system_does_not_displace_job_level(self):
+        from nomad_tpu.structs.model import Evaluation, generate_uuid
+
+        broker, be = self._mk()
+        service_ev = Evaluation(
+            id=generate_uuid(),
+            namespace="default",
+            job_id="sysjob",
+            type="service",
+            status="blocked",
+        )
+        be.block(service_ev)
+        be.block(self._sys_eval("sysjob", "node-a"))
+        stats = be.stats()
+        assert stats["total_system_blocked"] == 1
+        assert stats["total_blocked"] == 2  # job-level eval survived
+
+    def test_untrack_covers_system(self):
+        broker, be = self._mk()
+        be.block(self._sys_eval("sysjob", "node-a"))
+        be.block(self._sys_eval("sysjob", "node-b"))
+        be.untrack("default", "sysjob")
+        assert be.stats()["total_system_blocked"] == 0
+        be.unblock_node("node-a", index=5)
+        assert broker.enqueued == []
+
+    def test_terminal_alloc_unblocks_node_e2e(self):
+        """FSM path: a client update marking an alloc terminal re-enqueues
+        the system evals blocked on that alloc's node."""
+        broker, be = self._mk()
+        from nomad_tpu.core.fsm import FSM
+        from nomad_tpu.state import StateStore
+        import nomad_tpu.mock as mock
+        from nomad_tpu.structs.model import (
+            ALLOC_CLIENT_STATUS_FAILED,
+            Allocation,
+            generate_uuid,
+        )
+
+        state = StateStore()
+        fsm = FSM(state, eval_broker=None, blocked_evals=be)
+        node = mock.node()
+        job = mock.job()
+        state.upsert_job(1, job)
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace="default",
+            job_id=job.id,
+            task_group=job.task_groups[0].name,
+            node_id=node.id,
+            client_status="running",
+            desired_status="run",
+        )
+        alloc.job = job
+        state.upsert_allocs(1, [alloc])
+        be.block(self._sys_eval("sysjob", node.id))
+
+        done = alloc.copy()
+        done.client_status = ALLOC_CLIENT_STATUS_FAILED
+        fsm._apply_alloc_client_update(
+            2, {"allocs": [done.to_dict()], "evals": []}
+        )
+        assert [e.job_id for e in broker.enqueued] == ["sysjob"]

@@ -194,6 +194,9 @@ class TestDockerDriver:
                 echo "$sig" >> "$STATE/$name.signals"
                 ;;
               logs) echo "hello-docker";;
+              stats)
+                echo '{{"CPUPerc":"12.5%","MemUsage":"24.5MiB / 1.9GiB","PIDs":"3"}}'
+                ;;
               inspect)
                 name="$3"
                 [ "$3" = "--format" ] && name="$4"
@@ -286,3 +289,208 @@ class TestDockerDriver:
         driver = DockerDriver(binary=script)
         with pytest.raises(RuntimeError, match="no such image"):
             driver.start_task(make_task(config={"image": "nope"}), str(tmp_path))
+
+
+class TestTaskStats:
+    def test_docker_task_stats_from_engine(self, tmp_path):
+        """Docker per-task usage comes from `docker stats`, not the pid
+        tree (container processes aren't the driver's children; ref
+        drivers/docker/stats.go)."""
+        from tests.test_drivers import write_script  # self-import safe
+
+        state = tmp_path / "docker-state"
+        state.mkdir()
+        script = write_script(
+            tmp_path / "docker",
+            f"""
+            STATE="{state}"
+            case "$1" in
+              version) echo "24.0.5";;
+              run) echo running > "$STATE/c.state"; echo deadbeef;;
+              wait) sleep 60;;
+              stats)
+                echo '{{"CPUPerc":"12.5%","MemUsage":"24.5MiB / 1.9GiB","PIDs":"3"}}'
+                ;;
+            esac
+            """,
+        )
+        from nomad_tpu.drivers.docker import DockerDriver
+
+        driver = DockerDriver(binary=script)
+        handle = driver.start_task(
+            make_task(config={"image": "busybox"}), str(tmp_path)
+        )
+        try:
+            usage = driver.task_stats(handle)
+            assert usage["cpu_percent"] == 12.5
+            assert usage["rss_bytes"] == int(24.5 * 1024 * 1024)
+            assert usage["pids"] == 3
+        finally:
+            handle.finish(0)
+
+    def test_docker_size_parsing(self):
+        from nomad_tpu.drivers.docker import _parse_percent, _parse_size
+
+        assert _parse_size("24.5MiB") == int(24.5 * 1024**2)
+        assert _parse_size("1.5GB") == int(1.5 * 1000**3)
+        assert _parse_size("512B") == 512
+        assert _parse_size("garbage") == 0
+        assert _parse_percent("7.25%") == 7.25
+        assert _parse_percent("x") == 0.0
+
+    def test_default_driver_stats_pid_tree(self, tmp_path):
+        """Exec-family drivers report usage from the process tree with a
+        sampled cpu_percent on the second reading."""
+        from nomad_tpu.client.driver import RawExecDriver
+
+        driver = RawExecDriver()
+        task = make_task(config={"command": "/bin/sleep", "args": ["30"]})
+        handle = driver.start_task(task, str(tmp_path))
+        try:
+            u1 = driver.task_stats(handle)
+            assert u1["pids"] >= 1
+            assert u1["rss_bytes"] > 0
+            u2 = driver.task_stats(handle)
+            assert "cpu_percent" in u2
+        finally:
+            driver.stop_task(handle, timeout=1.0)
+
+
+class TestImageCoordinator:
+    def fake(self, tmp_path, state):
+        return write_script(
+            tmp_path / "docker",
+            f"""
+            STATE="{state}"
+            if [ "$1" = "--config" ]; then
+              echo "$2" >> "$STATE/config_dirs"; shift 2
+            fi
+            cmd=$1; shift
+            case "$cmd" in
+              version) echo "24.0.5";;
+              pull) echo "$1" >> "$STATE/pulls";;
+              image) exit 1;;  # inspect: never present locally
+              rmi) echo "$1" >> "$STATE/rmis";;
+              run)
+                name=""; prev=""
+                for a in "$@"; do
+                  [ "$prev" = "--name" ] && name="$a"; prev="$a"
+                done
+                echo running > "$STATE/$name.state"; echo "c-$name";;
+              wait) sleep 30;;
+              rm) echo "$2" >> "$STATE/rms";;
+            esac
+            """,
+        )
+
+    def test_refcounted_pull_and_delayed_gc(self, tmp_path):
+        """Two tasks sharing an image pull once; the image is removed only
+        after BOTH release it and the grace delay passes (ref
+        drivers/docker/coordinator.go:72-90)."""
+        from nomad_tpu.drivers.docker import DockerDriver
+
+        state = tmp_path / "st"
+        state.mkdir()
+        driver = DockerDriver(binary=self.fake(tmp_path, state))
+        driver.coordinator.remove_delay = 0.2
+        h1 = driver.start_task(
+            make_task(name="a", config={"image": "redis:7"}), str(tmp_path)
+        )
+        h2 = driver.start_task(
+            make_task(name="b", config={"image": "redis:7"}), str(tmp_path)
+        )
+        pulls = (state / "pulls").read_text().splitlines()
+        assert pulls == ["redis:7"], pulls
+
+        h1.finish(0)
+        driver.destroy_task(h1)
+        time.sleep(0.4)
+        assert not (state / "rmis").exists(), "image removed while referenced"
+        h2.finish(0)
+        driver.destroy_task(h2)
+        time.sleep(0.5)
+        assert (state / "rmis").read_text().splitlines() == ["redis:7"]
+
+    def test_reacquire_cancels_delayed_delete(self, tmp_path):
+        from nomad_tpu.drivers.docker import DockerDriver
+
+        state = tmp_path / "st"
+        state.mkdir()
+        driver = DockerDriver(binary=self.fake(tmp_path, state))
+        driver.coordinator.remove_delay = 0.4
+        h1 = driver.start_task(
+            make_task(name="a", config={"image": "nginx:1"}), str(tmp_path)
+        )
+        h1.finish(0)
+        driver.destroy_task(h1)
+        # re-acquire during the grace window
+        h2 = driver.start_task(
+            make_task(name="b", config={"image": "nginx:1"}), str(tmp_path)
+        )
+        time.sleep(0.8)
+        assert not (state / "rmis").exists(), "delete not cancelled"
+        h2.finish(0)
+        driver.destroy_task(h2)
+
+    def test_registry_auth_config(self, tmp_path):
+        """auth{} in task config materializes a private docker CLI config
+        with the base64 credential and rides every pull/run."""
+        import base64
+        import json
+
+        from nomad_tpu.drivers.docker import DockerDriver
+
+        state = tmp_path / "st"
+        state.mkdir()
+        driver = DockerDriver(binary=self.fake(tmp_path, state))
+        task_dir = tmp_path / "taskdir"
+        task_dir.mkdir()
+        driver.start_task(
+            make_task(
+                name="a",
+                config={
+                    "image": "registry.example/app:1",
+                    "auth": {
+                        "username": "bob",
+                        "password": "hunter2",
+                        "server_address": "registry.example",
+                    },
+                },
+            ),
+            str(task_dir),
+        )
+        cfg = json.loads(
+            (task_dir / "secrets" / "docker" / "config.json").read_text()
+        )
+        assert cfg["auths"]["registry.example"]["auth"] == base64.b64encode(
+            b"bob:hunter2"
+        ).decode()
+        dirs = (state / "config_dirs").read_text().splitlines()
+        assert str(task_dir / "secrets" / "docker") in dirs
+
+    def test_stop_failure_is_loud(self, tmp_path):
+        """A wedged container surfaces as an error, not a silent leak."""
+        from nomad_tpu.drivers.docker import DockerDriver
+
+        state = tmp_path / "st"
+        state.mkdir()
+        script = write_script(
+            tmp_path / "docker",
+            """
+            case "$1" in
+              version) echo "24.0.5";;
+              stop) echo "cannot stop container" >&2; exit 1;;
+              rm) echo "permission denied" >&2; exit 1;;
+            esac
+            """,
+        )
+        from nomad_tpu.client.driver import TaskHandle
+
+        driver = DockerDriver(binary=script)
+        handle = TaskHandle(task_name="t", driver="docker")
+        handle._container = "wedged"
+        handle._image = "img"
+        with pytest.raises(RuntimeError, match="cannot stop"):
+            driver.stop_task(handle, timeout=0.2)
+        with pytest.raises(RuntimeError, match="permission denied"):
+            driver.destroy_task(handle)

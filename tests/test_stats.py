@@ -165,3 +165,38 @@ class TestStatsSurface:
             http.stop()
             node_agent.stop()
             server.stop()
+
+
+class TestWorkloadRollup:
+    def test_client_stats_include_alloc_usage(self):
+        """Host stats carry the per-task usage rollup across local allocs
+        (driver TaskStats aggregated client-side)."""
+        agent = DevAgent(num_clients=1, server_config={"seed": 47})
+        agent.start()
+        http = HTTPServer(agent.server, port=0, agent=agent)
+        http.start()
+        client = ApiClient(address=http.address)
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "raw_exec"
+            tg.tasks[0].config = {"command": "/bin/sleep", "args": ["60"]}
+            tg.tasks[0].resources.networks = []
+            agent.server.job_register(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in agent.server.state.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                ),
+                msg="raw_exec running",
+            )
+            stats = client.client_stats()
+            usage = stats["allocs_usage"]
+            assert usage["pids"] >= 1
+            assert usage["rss_bytes"] > 0
+        finally:
+            http.stop()
+            agent.stop()
