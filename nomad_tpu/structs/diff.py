@@ -11,8 +11,18 @@ reference's diffable(false) tags."""
 
 from __future__ import annotations
 
+import json
+
 from dataclasses import fields, is_dataclass
 from typing import Any, Optional
+
+
+def _canonical(v: Any) -> str:
+    """Key-order-insensitive string form for free-form container values."""
+    try:
+        return json.dumps(v, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return repr(v)
 
 DIFF_TYPE_NONE = "None"
 DIFF_TYPE_ADDED = "Added"
@@ -101,10 +111,23 @@ def diff_objects(name: str, old: Any, new: Any) -> Optional[dict]:
                     d = _field_diff(f"{f.name}[{key}]", a, b)
                     if d:
                         field_diffs.append(d)
-                else:
+                elif is_dataclass(a) or is_dataclass(b):
                     d = diff_objects(f"{f.name}[{key}]", a, b)
                     if d:
                         object_diffs.append(d)
+                else:
+                    # free-form container values (task config's nested
+                    # lists/dicts — e.g. args): compare a canonical,
+                    # key-order-insensitive serialization; recursing into
+                    # fields() would blow up on non-dataclass values and
+                    # repr() would flag reordered-but-equal dicts
+                    d = _field_diff(
+                        f"{f.name}[{key}]",
+                        None if a is None else _canonical(a),
+                        None if b is None else _canonical(b),
+                    )
+                    if d:
+                        field_diffs.append(d)
         elif isinstance(ov, (list, tuple)) or isinstance(nv, (list, tuple)):
             object_diffs.extend(_diff_lists(f.name, ov or [], nv or []))
         elif is_dataclass(ov) or is_dataclass(nv):

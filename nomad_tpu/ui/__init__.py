@@ -1,6 +1,9 @@
 """Web UI (ref ui/: the reference ships an Ember SPA at /ui/; this is a
 single-file SPA over the same /v1/* API — jobs, nodes, allocations and
-evaluations with drill-down, auto-refresh, and ACL token support)."""
+evaluations with drill-down, auto-refresh, and ACL token support, plus the
+operational surfaces: job submit with plan-diff preview (ref ui job-run
+routes), an allocation filesystem browser (ref ui fs routes), and an
+interactive exec terminal over the exec websocket (ref ui exec routes)."""
 
 INDEX_HTML = """<!doctype html>
 <html lang="en">
@@ -43,6 +46,23 @@ INDEX_HTML = """<!doctype html>
   .err { color:var(--bad); padding:.6rem 0; }
   .crumb { color:var(--dim); margin-bottom:.8rem; }
   .crumb a { color:var(--accent); text-decoration:none; }
+  textarea { width:100%; min-height:16rem; background:var(--panel);
+             color:var(--text); border:1px solid var(--line);
+             border-radius:6px; padding:.8rem; font:13px/1.5 monospace; }
+  button { background:var(--accent); color:#fff; border:none;
+           border-radius:4px; padding:.4rem .9rem; cursor:pointer;
+           margin-right:.5rem; }
+  button.ghost { background:var(--panel); color:var(--text);
+                 border:1px solid var(--line); }
+  .diff-add { color:var(--ok); } .diff-del { color:var(--bad); }
+  .diff-edit { color:var(--warn); }
+  #term { background:#0d0f14; border:1px solid var(--line);
+          border-radius:6px; padding:.8rem; font:13px/1.45 monospace;
+          height:20rem; overflow:auto; white-space:pre-wrap; }
+  #termin { width:100%; background:var(--panel); color:var(--text);
+            border:1px solid var(--line); border-radius:4px;
+            padding:.4rem .6rem; font:13px monospace; margin-top:.4rem; }
+  .fspath a { color:var(--accent); text-decoration:none; }
 </style>
 </head>
 <body>
@@ -56,6 +76,7 @@ INDEX_HTML = """<!doctype html>
     <a href="#/deployments">Deployments</a>
     <a href="#/services">Services</a>
     <a href="#/servers">Servers</a>
+    <a href="#/run">Run</a>
   </nav>
   <input id="token" placeholder="ACL token (X-Nomad-Token)" />
 </header>
@@ -68,16 +89,27 @@ tokenInput.addEventListener('change', () => {
   localStorage.setItem('nomad_token', tokenInput.value); render();
 });
 
-async function api(path) {
+async function api(path, method, body) {
   const headers = {};
   if (tokenInput.value) headers['X-Nomad-Token'] = tokenInput.value;
-  const resp = await fetch(path, {headers});
+  const opts = {headers, method: method || 'GET'};
+  if (body !== undefined) {
+    headers['Content-Type'] = 'application/json';
+    opts.body = JSON.stringify(body);
+  }
+  const resp = await fetch(path, opts);
   if (!resp.ok) throw new Error(resp.status + ' ' + ((await resp.json()).error || ''));
   return resp.json();
 }
 const badge = s => `<span class="status s-${s}">${s}</span>`;
 const esc = x => String(x ?? '').replace(/[&<>"]/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+// UTF-8-safe base64 (btoa alone throws on non-latin1 and mojibakes UTF-8);
+// also the only safe way to embed untrusted strings (file names!) inside
+// inline JS handlers — base64's charset can't break out of a JS string
+const b64e = s => btoa(String.fromCharCode(...new TextEncoder().encode(s)));
+const b64d = s => new TextDecoder().decode(
+  Uint8Array.from(atob(s), c => c.charCodeAt(0)));
 
 function table(headers, rows, onclickPrefix) {
   return `<table><tr>${headers.map(h=>`<th>${h}</th>`).join('')}</tr>` +
@@ -144,9 +176,31 @@ const routes = {
         } catch {}
       }
     }
+    const taskOpts = tasks.map(t => `<option>${esc(t)}</option>`).join('');
+    window._postRender = () => fsGo(a.id, b64e('/'));
     return `<div class="crumb"><a href="#/allocations">allocations</a> / ${esc(a.id.slice(0,8))}</div>` +
+      `<h3>Exec</h3>
+       <div>task <select id="termtask">${taskOpts}</select>
+         <button onclick="termConnect('${a.id}')">Connect /bin/sh</button>
+         <button class="ghost" onclick="termClose()">Disconnect</button></div>
+       <div id="term">(not connected)</div>
+       <input id="termin" placeholder="command… (Enter to send)"
+              onkeydown="if(event.key==='Enter')termSend()" />` +
+      `<h3>Filesystem</h3><div id="fspath" class="fspath"></div>
+       <div id="fsview">Loading…</div>` +
       logsHtml +
       `<h3>Allocation</h3><pre>${esc(JSON.stringify(a, null, 2))}</pre>`;
+  },
+  async run() {
+    const saved = localStorage.getItem('nomad_run_hcl') ||
+      'job "example" {\\n  datacenters = ["dc1"]\\n  group "web" {\\n    task "web" {\\n      driver = "raw_exec"\\n      config {\\n        command = "sleep"\\n        args    = ["300"]\\n      }\\n      resources {\\n        cpu    = 100\\n        memory = 64\\n      }\\n    }\\n  }\\n}\\n';
+    return `<h3>Run a job</h3>
+      <textarea id="hcl">${esc(saved)}</textarea>
+      <div style="margin:.6rem 0">
+        <button class="ghost" onclick="planJob()">Plan</button>
+        <button onclick="runJob()">Run</button>
+      </div>
+      <div id="planout"></div>`;
   },
   async evaluations() {
     const evals = await api('/v1/evaluations');
@@ -194,15 +248,171 @@ const routes = {
   },
 };
 
+// ---- job submit + plan-diff (ref ui job-run routes) ----
+async function parseHcl() {
+  const hcl = document.getElementById('hcl').value;
+  localStorage.setItem('nomad_run_hcl', hcl);
+  return api('/v1/jobs/parse', 'PUT', {JobHCL: hcl});
+}
+function renderDiff(diff) {
+  if (!diff) return '(no diff)';
+  const lines = [];
+  const mark = t => t === 'Added' ? 'diff-add' : t === 'Deleted' ? 'diff-del'
+    : t === 'Edited' ? 'diff-edit' : '';
+  const field = (f, pad) => lines.push(
+    `${pad}<span class="${mark(f.Type)}">${esc(f.Name)}: ` +
+    `${esc(f.Old||'∅')} → ${esc(f.New||'∅')}</span>`);
+  const objects = (objs, pad) => {
+    for (const o of (objs || [])) {
+      lines.push(`${pad}<span class="${mark(o.Type)}">${esc(o.Name)}: ${esc(o.Type||'None')}</span>`);
+      for (const f of (o.Fields || [])) field(f, pad + '  ');
+      objects(o.Objects, pad + '  ');
+    }
+  };
+  lines.push(`<span class="${mark(diff.Type)}">job ${esc(diff.Name)}: ${esc(diff.Type||'None')}</span>`);
+  for (const f of (diff.Fields || [])) field(f, '  ');
+  objects(diff.Objects, '  ');
+  for (const tg of (diff.TaskGroups || [])) {
+    lines.push(`  <span class="${mark(tg.Type)}">group ${esc(tg.Name)}: ${esc(tg.Type||'None')}</span>`);
+    for (const f of (tg.Fields || [])) field(f, '    ');
+    objects(tg.Objects, '    ');
+    for (const t of (tg.Tasks || [])) {
+      lines.push(`    <span class="${mark(t.Type)}">task ${esc(t.Name)}: ${esc(t.Type||'None')}</span>`);
+      for (const f of (t.Fields || [])) field(f, '      ');
+      objects(t.Objects, '      ');
+    }
+  }
+  return lines.join('\\n');
+}
+async function planJob() {
+  const out = document.getElementById('planout');
+  try {
+    const job = await parseHcl();
+    const plan = await api('/v1/job/' + encodeURIComponent(job.id) + '/plan',
+      'PUT', {Job: job, Diff: true});
+    let html = `<h3>Plan</h3><pre>${renderDiff(plan.Diff)}</pre>`;
+    if (plan.Annotations)
+      html += `<pre>${esc(JSON.stringify(plan.Annotations, null, 2))}</pre>`;
+    if (plan.FailedTGAllocs && Object.keys(plan.FailedTGAllocs).length)
+      html += `<div class="err">Placement failures: ` +
+        esc(JSON.stringify(plan.FailedTGAllocs)) + '</div>';
+    out.innerHTML = html;
+  } catch (e) { out.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+async function runJob() {
+  const out = document.getElementById('planout');
+  try {
+    const job = await parseHcl();
+    const r = await api('/v1/jobs', 'PUT', {Job: job});
+    out.innerHTML = `<div>Submitted: eval <code>${esc(r.EvalID || '')}</code>
+      — <a href="#/job/${encodeURIComponent(job.id)}">view job</a></div>`;
+  } catch (e) { out.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+
+// ---- allocation fs browser (ref ui fs routes) ----
+// paths ride handlers base64-encoded: file names are UNTRUSTED (any
+// workload writes them) and must never reach an inline-JS string raw
+async function fsGo(allocId, pathB64) {
+  const path = b64d(pathB64);
+  const pathDiv = document.getElementById('fspath');
+  const viewDiv = document.getElementById('fsview');
+  if (!pathDiv || !viewDiv) return;
+  const parts = path.split('/').filter(Boolean);
+  let crumbs = `<a href="javascript:fsGo('${allocId}','${b64e('/')}')">alloc</a>`;
+  let acc = '';
+  for (const p of parts) {
+    acc += '/' + p;
+    crumbs += ` / <a href="javascript:fsGo('${allocId}','${b64e(acc)}')">${esc(p)}</a>`;
+  }
+  pathDiv.innerHTML = crumbs;
+  try {
+    const entries = await api('/v1/client/fs/ls/' + allocId +
+      '?path=' + encodeURIComponent(path));
+    viewDiv.innerHTML = '<table><tr><th>Name</th><th>Size</th></tr>' +
+      entries.map(e => {
+        const full = b64e((path === '/' ? '' : path) + '/' + e.Name);
+        const go = e.IsDir ? `fsGo('${allocId}','${full}')`
+                           : `fsCat('${allocId}','${full}')`;
+        return `<tr class="row" onclick="${go}"><td>${e.IsDir?'📁 ':''}${esc(e.Name)}</td>` +
+               `<td>${e.IsDir?'-':e.Size}</td></tr>`;
+      }).join('') + '</table>';
+  } catch (e) { viewDiv.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+async function fsCat(allocId, pathB64) {
+  const path = b64d(pathB64);
+  const viewDiv = document.getElementById('fsview');
+  const parent = b64e(path.split('/').slice(0,-1).join('/') || '/');
+  try {
+    const doc = await api('/v1/client/fs/cat/' + allocId +
+      '?path=' + encodeURIComponent(path));
+    viewDiv.innerHTML = `<div class="crumb">${esc(path)}
+      (<a href="javascript:fsGo('${allocId}','${parent}')">back</a>)</div>` +
+      `<pre>${esc(doc.Data)}</pre>`;
+  } catch (e) { viewDiv.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+
+// ---- exec terminal over the exec websocket (ref ui exec routes) ----
+let termWs = null;
+function termWrite(text) {
+  const term = document.getElementById('term');
+  if (!term) return;
+  term.textContent += text;
+  term.scrollTop = term.scrollHeight;
+}
+function termConnect(allocId) {
+  termClose();
+  const task = document.getElementById('termtask').value;
+  const proto = location.protocol === 'https:' ? 'wss:' : 'ws:';
+  let url = `${proto}//${location.host}/v1/client/allocation/${allocId}/exec` +
+    `?task=${encodeURIComponent(task)}&command=${encodeURIComponent('["/bin/sh"]')}`;
+  if (tokenInput.value) url += `&token=${encodeURIComponent(tokenInput.value)}`;
+  document.getElementById('term').textContent = '';
+  termWrite('[connecting…]\\n');
+  termWs = new WebSocket(url);
+  termWs.onmessage = ev => {
+    try {
+      const m = JSON.parse(ev.data);
+      if (m.stdout && m.stdout.data) termWrite(b64d(m.stdout.data));
+      if (m.stderr && m.stderr.data) termWrite(b64d(m.stderr.data));
+      if (m.exited) termWrite(`\\n[exited ${(m.result||{}).exit_code}]\\n`);
+      if (m.error) termWrite(`\\n[error: ${m.error}]\\n`);
+    } catch {}
+  };
+  termWs.onopen = () => termWrite('[connected]\\n$ ');
+  termWs.onclose = () => { termWrite('\\n[disconnected]\\n'); termWs = null; };
+}
+function termSend() {
+  const input = document.getElementById('termin');
+  if (!termWs || termWs.readyState !== 1) return;
+  const line = input.value + '\\n';
+  termWrite(line);
+  termWs.send(JSON.stringify({stdin: {data: b64e(line)}}));
+  input.value = '';
+}
+function termClose() {
+  if (termWs) { try { termWs.close(); } catch {} termWs = null; }
+}
+
 async function render() {
   const hash = location.hash || '#/jobs';
   const [, page, id] = hash.split('/');
   document.querySelectorAll('nav a').forEach(a =>
     a.classList.toggle('active', a.getAttribute('href') === '#/' + page));
   const fn = routes[page] || routes.jobs;
-  try { view.innerHTML = await fn(id); }
-  catch (e) { view.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+  const gen = ++renderGen;
+  window._postRender = null;
+  try {
+    const html = await fn(id);
+    if (gen !== renderGen) return;  // superseded by a newer navigation
+    view.innerHTML = html;
+    if (window._postRender) window._postRender();
+  }
+  catch (e) {
+    if (gen !== renderGen) return;
+    view.innerHTML = `<div class="err">${esc(e.message)}</div>`;
+  }
 }
+let renderGen = 0;
 window.addEventListener('hashchange', render);
 setInterval(() => { if (!(location.hash||'').match(/#\\/(job|node|allocation)\\//)) render(); }, 3000);
 render();

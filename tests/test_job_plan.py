@@ -207,3 +207,29 @@ job "web-plan" {
         finally:
             http.stop()
             server.stop()
+
+
+def test_diff_handles_freeform_config_containers():
+    """Task config values are free-form (lists/dicts, e.g. raw_exec args):
+    the differ must compare them as values, not recurse into dataclass
+    fields (crashed with TypeError before)."""
+    old = mock.job()
+    new = old.copy()
+    old.task_groups[0].tasks[0].config = {
+        "command": "sleep", "args": ["60"], "env": {"A": "1"},
+    }
+    new.task_groups[0].tasks[0].config = {
+        "command": "sleep", "args": ["120"], "env": {"A": "1"},
+    }
+    d = job_diff(old, new)
+    assert d["Type"] == "Edited"
+    task_fields = [
+        f for tg in d["TaskGroups"] for t in tg["Tasks"] for f in t["Fields"]
+    ]
+    names = [f["Name"] for f in task_fields]
+    assert "config[args]" in names
+    assert "config[env]" not in names  # unchanged container: no diff
+
+    # new job against nothing (the first-plan path) must not crash either
+    d2 = job_diff(None, new)
+    assert d2["Type"] == "Added"
