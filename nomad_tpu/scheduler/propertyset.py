@@ -71,6 +71,7 @@ class PropertySet:
 
     # -- population --------------------------------------------------------
     def _populate_existing(self):
+        self._combined_cache = None
         allocs = self.ctx.state.allocs_by_job(self.namespace, self.job_id)
         allocs = self._filter_allocs(allocs, filter_terminal=True)
         nodes = self._build_node_map(allocs)
@@ -78,6 +79,7 @@ class PropertySet:
 
     def populate_proposed(self):
         """ref propertyset.go:160-208"""
+        self._combined_cache = None
         self.proposed_values = {}
         self.cleared_values = {}
 
@@ -126,7 +128,13 @@ class PropertySet:
         return n_value, "", combined.get(n_value, 0)
 
     def get_combined_use_map(self) -> dict[str, int]:
-        """ref propertyset.go:250-274"""
+        """ref propertyset.go:250-274. Cached between populate calls: the
+        spread iterator asks once PER NODE OPTION while the inputs only
+        change per Select (populate_proposed on reset) — rebuilding the
+        map 10K times per placement was pure overhead."""
+        cached = getattr(self, "_combined_cache", None)
+        if cached is not None:
+            return cached
         combined: dict[str, int] = {}
         for used in (self.existing_values, self.proposed_values):
             for value, count in used.items():
@@ -135,6 +143,7 @@ class PropertySet:
             if value not in combined:
                 continue
             combined[value] = max(combined[value] - cleared, 0)
+        self._combined_cache = combined
         return combined
 
     # -- helpers -----------------------------------------------------------

@@ -105,6 +105,30 @@ class StaticRankIterator:
         self.seen = 0
 
 
+#: shared empty index for the no-networks fast path: never mutated, every
+#: check (overcommitted, collisions) is vacuously false on it
+_EMPTY_NET_INDEX = NetworkIndex()
+
+
+class _ProposedAlloc:
+    """Stand-in for the would-be allocation inside the per-option fit
+    check: allocs_fit only reads terminal_status/allocated_resources/
+    comparable_cached, and a full Allocation dataclass __init__ per node
+    option was measurable at 10K options per placement. No caching — the
+    resources are still being accumulated when this is built."""
+
+    __slots__ = ("allocated_resources",)
+
+    def __init__(self, resources):
+        self.allocated_resources = resources
+
+    def terminal_status(self) -> bool:
+        return False
+
+    def comparable_cached(self):
+        return self.allocated_resources.comparable()
+
+
 class BinPackIterator:
     """Scores nodes by bin-packing fit, assigning networks and devices along
     the way; optionally preempts lower-priority allocs (ref rank.go:146-451)."""
@@ -123,6 +147,12 @@ class BinPackIterator:
 
     def set_task_group(self, task_group: TaskGroup):
         self.task_group = task_group
+        # hoisted per-option guards: at 10K options per placement, even
+        # constructing an unused helper object per node is real money
+        self._tg_nets = bool(task_group.networks) or any(
+            t.resources.networks for t in task_group.tasks
+        )
+        self._tg_devs = any(t.resources.devices for t in task_group.tasks)
 
     def next(self) -> Optional[RankedNode]:
         from .preemption import Preemptor
@@ -133,15 +163,27 @@ class BinPackIterator:
                 return None
 
             proposed = option.proposed_allocs(self.ctx)
+            node_res = option.node.node_resources
 
-            net_idx = NetworkIndex(rng=self.ctx.rng)
-            net_idx.set_node(option.node)
-            net_idx.add_allocs(proposed)
+            # network/device accounting only where it can matter: a node
+            # with no NICs serving a group with no asks can neither offer
+            # nor collide (the shared empty index answers every check)
+            if self._tg_nets or (node_res is not None and node_res.networks):
+                net_idx = NetworkIndex(rng=self.ctx.rng)
+                net_idx.set_node(option.node)
+                net_idx.add_allocs(proposed)
+            else:
+                net_idx = _EMPTY_NET_INDEX
 
-            from .device import DeviceAllocator
+            # only group device ASKS read the allocator (allocs_fit runs
+            # with check_devices=False here) — node-side devices alone
+            # don't warrant building one per option
+            dev_allocator = None
+            if self._tg_devs:
+                from .device import DeviceAllocator
 
-            dev_allocator = DeviceAllocator(self.ctx, option.node)
-            dev_allocator.add_allocs(proposed)
+                dev_allocator = DeviceAllocator(self.ctx, option.node)
+                dev_allocator.add_allocs(proposed)
 
             total_device_affinity_weight = 0.0
             sum_matching_affinities = 0.0
@@ -154,15 +196,16 @@ class BinPackIterator:
             )
 
             allocs_to_preempt: list[Allocation] = []
-            preemptor = Preemptor(self.priority, self.ctx, self.job_id)
-            preemptor.set_node(option.node)
-
-            current_preemptions = [
-                a
-                for allocs in self.ctx.plan.node_preemptions.values()
-                for a in allocs
-            ]
-            preemptor.set_preemptions(current_preemptions)
+            preemptor = None
+            if self.evict:
+                preemptor = Preemptor(self.priority, self.ctx, self.job_id)
+                preemptor.set_node(option.node)
+                current_preemptions = [
+                    a
+                    for allocs in self.ctx.plan.node_preemptions.values()
+                    for a in allocs
+                ]
+                preemptor.set_preemptions(current_preemptions)
 
             exhausted = False
 
@@ -280,7 +323,7 @@ class BinPackIterator:
 
             # Store current set before adding the new alloc's resources
             current = proposed
-            proposed = proposed + [Allocation(allocated_resources=total)]
+            proposed = proposed + [_ProposedAlloc(total)]
 
             fit, dim, util = allocs_fit(option.node, proposed, net_idx, False)
             if not fit:

@@ -6,9 +6,15 @@ Registered in the factory map alongside service/batch/system
 retries are shared with the oracle; only computePlacements
 (generic_sched.go:426-566) is replaced — the per-alloc Select walk becomes a
 single lax.scan over all pending placements. Anything the kernel does not
-model (ports, devices, distinct_* constraints, reschedules with penalty
+model (reserved ports, distinct_* constraints, reschedules with penalty
 nodes, sticky disk, destructive updates) transparently falls back to the
 scalar oracle path, so behavior is complete while the hot path is dense.
+
+Preemption semantics are preserved without a device-side pick: at this
+reference version only the SYSTEM scheduler preempts (service/batch
+preemption was enterprise-gated, stack.go:231), and tpu-system's dense
+planes fall back per node to the preempting oracle walk when the fit
+fails — see tests/test_preemption_e2e.py::TestTPUSystemPreemption.
 """
 
 from __future__ import annotations

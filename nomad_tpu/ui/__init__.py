@@ -368,8 +368,10 @@ function termConnect(allocId) {
   if (tokenInput.value) url += `&token=${encodeURIComponent(tokenInput.value)}`;
   document.getElementById('term').textContent = '';
   termWrite('[connecting…]\\n');
-  termWs = new WebSocket(url);
-  termWs.onmessage = ev => {
+  const ws = new WebSocket(url);
+  termWs = ws;
+  ws.onmessage = ev => {
+    if (termWs !== ws) return;  // superseded by a reconnect
     try {
       const m = JSON.parse(ev.data);
       if (m.stdout && m.stdout.data) termWrite(b64d(m.stdout.data));
@@ -378,8 +380,12 @@ function termConnect(allocId) {
       if (m.error) termWrite(`\\n[error: ${m.error}]\\n`);
     } catch {}
   };
-  termWs.onopen = () => termWrite('[connected]\\n$ ');
-  termWs.onclose = () => { termWrite('\\n[disconnected]\\n'); termWs = null; };
+  ws.onopen = () => { if (termWs === ws) termWrite('[connected]\\n$ '); };
+  ws.onclose = () => {
+    // an OLD socket closing must not null out (or scribble over) a newer
+    // live connection's state
+    if (termWs === ws) { termWrite('\\n[disconnected]\\n'); termWs = null; }
+  };
 }
 function termSend() {
   const input = document.getElementById('termin');
@@ -414,7 +420,13 @@ async function render() {
 }
 let renderGen = 0;
 window.addEventListener('hashchange', render);
-setInterval(() => { if (!(location.hash||'').match(/#\\/(job|node|allocation)\\//)) render(); }, 3000);
+setInterval(() => {
+  const h = location.hash || '';
+  // no auto-refresh on detail pages or the Run editor (it would wipe
+  // in-progress HCL edits and the plan output)
+  if (h.match(/#\\/(job|node|allocation)\\//) || h.startsWith('#/run')) return;
+  render();
+}, 3000);
 render();
 </script>
 </body>

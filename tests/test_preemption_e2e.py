@@ -118,3 +118,95 @@ class TestPreemptionE2E:
             assert evals, "preemption follow-up eval created"
         finally:
             agent.stop()
+
+
+class TestTPUSystemPreemption:
+    def test_dense_path_preserves_preemption(self):
+        """tpu-system's plane-batched path: nodes failing the dense fit
+        fall back to the per-node oracle walk, which preempts — the dense
+        planes must not cost the system scheduler its preemption semantics
+        (VERDICT r2 weak #5)."""
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.structs import compute_class
+        from nomad_tpu.structs.model import (
+            ALLOC_CLIENT_STATUS_RUNNING,
+            ALLOC_DESIRED_STATUS_RUN,
+            AllocatedCpuResources,
+            AllocatedMemoryResources,
+            AllocatedResources,
+            AllocatedSharedResources,
+            AllocatedTaskResources,
+            Allocation,
+            Evaluation,
+            generate_uuid,
+        )
+
+        h = Harness(seed=17)
+        nodes = []
+        for i in range(40):  # >= BATCH_THRESHOLD so the planes path runs
+            n = mock.node()
+            n.node_resources.cpu.cpu_shares = 4000
+            n.node_resources.memory.memory_mb = 8192
+            n.node_resources.networks = []
+            n.reserved_resources.networks.reserved_host_ports = ""
+            compute_class(n)
+            h.state.upsert_node(h.next_index(), n)
+            nodes.append(n)
+
+        low = mock.job()
+        low.priority = 10
+        ltg = low.task_groups[0]
+        h.state.upsert_job(h.next_index(), low)
+        stored_low = h.state.job_by_id(low.namespace, low.id)
+        victims = []
+        for n in nodes:
+            a = Allocation(
+                id=generate_uuid(),
+                namespace=low.namespace,
+                job_id=low.id,
+                task_group=ltg.name,
+                name=f"{low.id}.{ltg.name}[{len(victims)}]",
+                node_id=n.id,
+                desired_status=ALLOC_DESIRED_STATUS_RUN,
+                client_status=ALLOC_CLIENT_STATUS_RUNNING,
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=3500),
+                            memory=AllocatedMemoryResources(memory_mb=1024),
+                        )
+                    },
+                    shared=AllocatedSharedResources(disk_mb=10),
+                ),
+            )
+            a.job = stored_low
+            victims.append(a)
+        h.state.upsert_allocs(h.next_index(), victims)
+
+        sys_job = mock.system_job()
+        sys_job.priority = 90
+        stg = sys_job.task_groups[0]
+        stg.tasks[0].resources.cpu = 2000  # only fits by evicting the victim
+        stg.tasks[0].resources.memory_mb = 256
+        stg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), sys_job)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=sys_job.namespace,
+            priority=90,
+            type="system",
+            triggered_by="job-register",
+            job_id=sys_job.id,
+            status="pending",
+        )
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("tpu-system", ev)
+
+        placed = h.state.allocs_by_job(sys_job.namespace, sys_job.id)
+        assert len(placed) == 40, f"placed {len(placed)}/40"
+        preempted = {
+            pid for a in placed for pid in (a.preempted_allocations or [])
+        }
+        assert len(preempted) == 40, "every placement must evict its victim"
+        victim_ids = {v.id for v in victims}
+        assert preempted <= victim_ids
