@@ -379,15 +379,29 @@ def bench_headline():
         batch_sched.EXACT_ONLY = False
     parity_exact = parity(placed_exact, placed_fast)
 
-    # parity, oracle link: scalar oracle re-run for 4 windows of the very
-    # same eval — the empty-state prefix plus mid-sequence windows started
-    # from the kernel's own intermediate state at 20/50/80% (valid because
-    # placement i depends only on its predecessors); ≥1% of the full-scale
-    # placements are oracle-checked position-by-position
+    # parity, oracle link: ≥1% of the full-scale placements oracle-checked
+    # position-by-position. With spread (the default headline): 4 windows —
+    # the empty-state prefix plus mid-sequence windows restarted from the
+    # kernel's own intermediate state at 20/50/80% (valid because placement
+    # i depends only on its predecessors and limit=∞ keeps the candidate
+    # cursor stationary). Without spread: one long empty-state prefix of
+    # the same total size (mid-sequence restarts can't reproduce the
+    # log₂-bounded candidate cursor, so load-regime coverage there rests on
+    # parity_exact_full instead).
     if PARITY_K > 0:
-        windows = [(0, PARITY_K)] + [
-            (int(N_ALLOCS * f), PARITY_K) for f in (0.2, 0.5, 0.8)
-        ]
+        if spread:
+            # spread ⇒ limit=∞ ⇒ every Select scans the full ring and the
+            # rotating cursor is irrelevant, so a mid-sequence restart from
+            # reconstructed state is exact
+            windows = [(0, PARITY_K)] + [
+                (int(N_ALLOCS * f), PARITY_K) for f in (0.2, 0.5, 0.8)
+            ]
+        else:
+            # no spread ⇒ bounded candidate window ⇒ placements depend on
+            # the StaticIterator cursor accumulated over the whole prefix,
+            # which a mid-sequence restart cannot reproduce — check the
+            # same placement count as one long prefix instead
+            windows = [(0, PARITY_K * 4)]
         t_or = time.monotonic()
         matched, checked, per_window = oracle_parity_windows(
             job, placed_fast, windows
@@ -410,6 +424,11 @@ def bench_headline():
         "parity_oracle": round(parity_oracle, 5),
         "parity_oracle_checked": checked,
         "parity_oracle_windows": per_window,
+        "parity_oracle_coverage": (
+            "prefix+mid-sequence" if spread else
+            "prefix-only (bounded-window cursor not reconstructable; "
+            "load-regime parity covered by parity_exact_full)"
+        ),
         "parity_oracle_wall_s": round(oracle_s, 2),
         "exact_scan_s": round(exact_s, 4),
     }
