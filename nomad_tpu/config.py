@@ -83,6 +83,10 @@ def server_config_from_agent(config: dict) -> dict:
     # serf encryption: reference agents put `encrypt` in the server stanza
     if server.get("encrypt"):
         out["encrypt"] = server["encrypt"]
+    # vault{enabled, address, token}: the server selects the real-Vault
+    # HTTP provider when an address is configured (core/vault.py)
+    if config.get("vault"):
+        out["vault"] = dict(config["vault"])
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",

@@ -142,11 +142,13 @@ class HTTPProvider:
 
 
 def provider_from_config(config: dict) -> "VaultProvider":
-    """vault{address, token} in the server config selects the real-Vault
-    HTTP provider (with background self-renewal); without an address the
-    self-minting internal provider serves dev mode."""
+    """vault{enabled, address, token} in the server config selects the
+    real-Vault HTTP provider (with background self-renewal); without an
+    address — or with enabled=false, the documented way to switch the
+    integration off while keeping the stanza — the self-minting internal
+    provider serves instead (and VaultClient.enabled() gates derivation)."""
     vcfg = config.get("vault", {}) or {}
-    if vcfg.get("address"):
+    if vcfg.get("address") and vcfg.get("enabled", True):
         provider = HTTPProvider(
             vcfg["address"],
             vcfg.get("token", ""),

@@ -181,3 +181,23 @@ class TestTaskEvents:
         finally:
             client.stop()
             server.stop()
+
+
+def test_vault_stanza_reaches_server_config(tmp_path):
+    from nomad_tpu.config import load_agent_config, server_config_from_agent
+
+    p = tmp_path / "agent.hcl"
+    p.write_text(
+        '''
+        vault {
+          enabled = true
+          address = "http://127.0.0.1:8200"
+          token   = "root"
+        }
+        '''
+    )
+    cfg = load_agent_config([str(p)])
+    server_cfg = server_config_from_agent(cfg)
+    assert server_cfg["vault"]["address"] == "http://127.0.0.1:8200"
+    assert server_cfg["vault"]["token"] == "root"
+    assert server_cfg["vault"]["enabled"] is True

@@ -369,3 +369,15 @@ class TestHTTPProvider:
         )
         assert out == "pw=hunter2 key=k123"
         assert ("vault", "secret/app") in watch
+
+
+def test_provider_disabled_stanza_stays_internal():
+    """vault { enabled = false, address = ... } — the documented off
+    switch — must not construct the HTTP provider or start its renewal
+    loop against the external server."""
+    from nomad_tpu.core.vault import InternalProvider, provider_from_config
+
+    p = provider_from_config(
+        {"vault": {"enabled": False, "address": "http://127.0.0.1:1", "token": "x"}}
+    )
+    assert isinstance(p, InternalProvider)
