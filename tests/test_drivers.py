@@ -307,7 +307,7 @@ class TestTaskStats:
             case "$1" in
               version) echo "24.0.5";;
               run) echo running > "$STATE/c.state"; echo deadbeef;;
-              wait) sleep 60;;
+              wait) sleep 2;;  # short: leaked waiters must not outlive the test run
               stats)
                 echo '{{"CPUPerc":"12.5%","MemUsage":"24.5MiB / 1.9GiB","PIDs":"3"}}'
                 ;;
@@ -377,7 +377,7 @@ class TestImageCoordinator:
                   [ "$prev" = "--name" ] && name="$a"; prev="$a"
                 done
                 echo running > "$STATE/$name.state"; echo "c-$name";;
-              wait) sleep 30;;
+              wait) sleep 2;;  # short: leaked waiters must not outlive the test run
               rm) echo "$2" >> "$STATE/rms";;
             esac
             """,
