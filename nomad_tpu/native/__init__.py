@@ -60,6 +60,73 @@ def nsexec_path(rebuild: bool = False) -> str:
         return out
 
 
+_FASTOBJ = None
+_FASTOBJ_TRIED = False
+
+
+def fastobj():
+    """The C batch-materialization module (_fastobj.c), compiled on demand
+    like nsexec; returns None when no toolchain is available so callers
+    fall back to the pure-Python loops (same semantics, ~5x slower at
+    50K-alloc plan scale)."""
+    global _FASTOBJ, _FASTOBJ_TRIED
+    if _FASTOBJ_TRIED:
+        return _FASTOBJ
+    with _BUILD_LOCK:
+        if _FASTOBJ_TRIED:
+            return _FASTOBJ
+        try:
+            _FASTOBJ = _build_fastobj()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "C fast-path (_fastobj) unavailable, using the pure-Python "
+                "loops (~5x slower at 50K-alloc plan scale): %s", e
+            )
+            _FASTOBJ = None
+        _FASTOBJ_TRIED = True
+    return _FASTOBJ
+
+
+def _build_fastobj():
+    import importlib.machinery
+    import importlib.util
+    import sysconfig
+
+    import sys
+
+    src = os.path.join(_HERE, "_fastobj.c")
+    # cache tag in the filename: a stale .so built against another
+    # interpreter ABI must never be dlopen'd (mtime alone can't tell)
+    out = os.path.join(
+        _build_dir(), f"_fastobj.{sys.implementation.cache_tag}.so"
+    )
+    if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+        cc = (
+            shutil.which("cc")
+            or shutil.which("gcc")
+            or shutil.which("clang")
+        )
+        if cc is None:
+            raise NativeBuildError("no C compiler on PATH")
+        inc = sysconfig.get_paths()["include"]
+        tmp = out + ".tmp.so"
+        proc = subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", f"-I{inc}", "-o", tmp, src],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise NativeBuildError(f"_fastobj build failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+    loader = importlib.machinery.ExtensionFileLoader("_fastobj", out)
+    spec = importlib.util.spec_from_file_location("_fastobj", out, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
 def isolation_available() -> bool:
     """Whether namespace isolation works here (nsexec --check)."""
     try:
