@@ -12,15 +12,20 @@ Also runs the remaining BASELINE configs:
   #5 — mixed service+system jobs with device{} asks and NetworkIndex port
        collisions at 10K nodes (the exact-semantics oracle fallback path).
 
-Parity at bench scale is measured two ways:
+Parity at bench scale is measured three ways:
   * parity_exact  — the fast-path (runs/windowed) placements vs the exact
     one-step-per-placement scan kernel over ALL 50K placements (the exact
-    scan is itself oracle-validated by tests/test_tpu_parity.py), and
-  * parity_oracle — the scalar oracle re-run position-by-position over four
-    windows of the very same eval: the empty-state prefix plus mid-sequence
-    windows restarted from the kernel's own intermediate state at 20/50/80%
-    (valid because placement i depends only on its predecessors), checking
-    ≥1% of the full-scale placements directly against the oracle.
+    scan is itself oracle-validated by tests/test_tpu_parity.py),
+  * parity_oracle — oracle engines re-run position-by-position over windows
+    of the very same eval (empty-state prefix + mid-sequence windows
+    restarted from the fast path's own intermediate state at 20/50/80%,
+    valid because placement i depends only on its predecessors): the
+    vectorized float64 oracle (tpu/exact_np.py) carries >10% coverage and
+    the scalar iterator chain adds spot windows, and
+  * parity_np_scalar_pin — scalar-chain vs vectorized-oracle agreement at
+    the SAME positions inside this run, keeping the trust chain rooted in
+    the per-node Go-semantics walk (plus tests/test_tpu_parity.py's
+    TestVectorOracleParity shape coverage).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., "detail": ...}
@@ -41,9 +46,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
 N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", "50000"))
-#: oracle placements checked PER WINDOW (4 windows: empty-prefix + mid-
-#: sequence at 20/50/80% — ≥1% of the 50K placements oracle-checked total)
+#: scalar-chain oracle placements checked PER WINDOW (2 spot windows that
+#: pin the vectorized oracle; ~0.3s/placement at 10K nodes)
 PARITY_K = int(os.environ.get("BENCH_PARITY_K", "128"))
+#: vectorized-oracle (oracle-np) placements checked PER WINDOW (4 windows:
+#: empty prefix + mid-sequence at 20/50/80% — >10% of the 50K placements
+#: oracle-checked in total at ~1.7ms/placement)
+PARITY_NP_K = int(os.environ.get("BENCH_PARITY_NP_K", "1536"))
 TARGET_S = 1.0
 
 
@@ -188,7 +197,8 @@ def run_once(state, job, factory="tpu-batch", seed=11, prefix=None):
     — valid for parity sampling because placement i depends only on
     placements < i (the spread/anti-affinity planes and capacity are updated
     sequentially), so the truncated run's placements equal the full run's
-    first K.
+    first K. Supported for the scalar oracle ("service") and the vectorized
+    float64 oracle ("oracle-np", tpu/exact_np.py).
     """
     from nomad_tpu.scheduler.generic import GenericScheduler
     from nomad_tpu.scheduler.scheduler import new_scheduler
@@ -198,15 +208,24 @@ def run_once(state, job, factory="tpu-batch", seed=11, prefix=None):
     snap = state.snapshot()
     if prefix is None:
         sched = new_scheduler(factory, snap, planner, rng=rng)
-    else:
-        if factory != "service":
-            raise ValueError("prefix sampling drives the scalar oracle")
+    elif factory == "service":
 
         class PrefixOracle(GenericScheduler):
             def _compute_placements(self, destructive, place):
                 return super()._compute_placements(destructive, place[:prefix])
 
         sched = PrefixOracle(snap, planner, batch=False, rng=rng)
+    elif factory == "oracle-np":
+        from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+
+        class PrefixNpOracle(TPUBatchScheduler):
+            def _compute_placements(self, destructive, place):
+                return super()._compute_placements(destructive, place[:prefix])
+
+        sched = PrefixNpOracle(snap, planner, batch=False, rng=rng)
+        sched.exact_numpy = True
+    else:
+        raise ValueError("prefix sampling drives the oracle engines")
     ev = make_eval(job)
     t0 = time.monotonic()
     sched.process(ev)
@@ -228,8 +247,8 @@ def _alloc_index(name: str) -> int:
 
 
 def _oracle_window_worker(payload):
-    """Run the scalar oracle for placements [M, M+K) of the headline eval
-    and return {name: node_id} for those K.
+    """Run an oracle engine (scalar chain or the float64 numpy stepper) for
+    placements [M, M+K) of the headline eval; return {name: node_id}.
 
     Valid mid-sequence because placement i depends only on its
     predecessors: the state after the fast path's first M placements is
@@ -242,7 +261,7 @@ def _oracle_window_worker(payload):
     plane (propertyset.go combines existing + proposed)."""
     import pickle
 
-    M, K, job_blob, placed_items, n_nodes, seed = payload
+    M, K, job_blob, placed_items, n_nodes, seed, engine = payload
     job = pickle.loads(job_blob)
     placed = dict(placed_items)
     names = sorted(placed, key=_alloc_index)
@@ -297,34 +316,40 @@ def _oracle_window_worker(payload):
     if allocs:
         state.upsert_allocs(3, allocs)
 
-    _, placed_oracle = run_once(state, job, factory="service", prefix=K, seed=seed)
-    return M, {k: placed_oracle.get(k) for k in names[M : M + K]}
+    _, placed_oracle = run_once(state, job, factory=engine, prefix=K, seed=seed)
+    return engine, M, {k: placed_oracle.get(k) for k in names[M : M + K]}
 
 
-def oracle_parity_windows(job, placed_fast, windows, seed=11):
-    """Scalar-oracle parity over several windows of the full-scale eval,
-    run in parallel worker processes (each window is independent; the
-    oracle costs ~0.4s/placement at 10K nodes). Returns
-    (matched, checked, per_window)."""
+def oracle_parity_windows(job, placed_fast, window_specs, seed=11):
+    """Oracle parity over windows of the full-scale eval, run in parallel
+    worker processes (each window is independent). ``window_specs`` is a
+    list of (engine, M, K): the scalar chain ("service", ~0.3s/placement at
+    10K nodes) spot-pins the vectorized float64 oracle ("oracle-np",
+    ~1.7ms/placement), which carries the wide coverage. Returns
+    ({engine: (matched, checked, per_window)}, {engine: {name: node}})."""
     import pickle
     from concurrent.futures import ProcessPoolExecutor
     import multiprocessing as mp
 
     job_blob = pickle.dumps(job)
     items = list(placed_fast.items())
-    payloads = [(M, K, job_blob, items, N_NODES, seed) for M, K in windows]
+    payloads = [
+        (M, K, job_blob, items, N_NODES, seed, engine)
+        for engine, M, K in window_specs
+    ]
     ctx = mp.get_context("spawn")
-    matched = checked = 0
-    per_window = {}
+    stats = {}
+    results = {}
     with ProcessPoolExecutor(
         max_workers=min(len(payloads), 4), mp_context=ctx
     ) as pool:
-        for M, got in pool.map(_oracle_window_worker, payloads):
+        for engine, M, got in pool.map(_oracle_window_worker, payloads):
             m = sum(1 for k, v in got.items() if v == placed_fast.get(k))
-            matched += m
-            checked += len(got)
+            matched, checked, per_window = stats.get(engine, (0, 0, {}))
             per_window[M] = round(m / max(len(got), 1), 5)
-    return matched, checked, per_window
+            stats[engine] = (matched + m, checked + len(got), per_window)
+            results.setdefault(engine, {}).update(got)
+    return stats, results
 
 
 def bench_headline():
@@ -379,37 +404,57 @@ def bench_headline():
         batch_sched.EXACT_ONLY = False
     parity_exact = parity(placed_exact, placed_fast)
 
-    # parity, oracle link: ≥1% of the full-scale placements oracle-checked
-    # position-by-position. With spread (the default headline): 4 windows —
-    # the empty-state prefix plus mid-sequence windows restarted from the
-    # kernel's own intermediate state at 20/50/80% (valid because placement
-    # i depends only on its predecessors and limit=∞ keeps the candidate
-    # cursor stationary). Without spread: one long empty-state prefix of
-    # the same total size (mid-sequence restarts can't reproduce the
-    # log₂-bounded candidate cursor, so load-regime coverage there rests on
-    # parity_exact_full instead).
+    # parity, oracle link: placements oracle-checked position-by-position.
+    # The float64 numpy oracle (tpu/exact_np.py — scalar-chain semantics at
+    # ~1.7ms/placement) carries the wide coverage (>10% of the headline
+    # eval); the scalar iterator chain itself spot-pins the numpy oracle
+    # inside this same run, so the chain of trust stays rooted in the
+    # per-node Go-semantics walk. With spread (the default headline):
+    # mid-sequence windows restart from the fast path's own intermediate
+    # state at 20/50/80% (valid because placement i depends only on its
+    # predecessors and limit=∞ keeps the candidate cursor stationary).
+    # Without spread: one long empty-state prefix (mid-sequence restarts
+    # can't reproduce the log₂-bounded candidate cursor).
     if PARITY_K > 0:
         if spread:
-            # spread ⇒ limit=∞ ⇒ every Select scans the full ring and the
-            # rotating cursor is irrelevant, so a mid-sequence restart from
-            # reconstructed state is exact
-            windows = [(0, PARITY_K)] + [
-                (int(N_ALLOCS * f), PARITY_K) for f in (0.2, 0.5, 0.8)
+            specs = [("oracle-np", 0, PARITY_NP_K)] + [
+                ("oracle-np", int(N_ALLOCS * f), PARITY_NP_K)
+                for f in (0.2, 0.5, 0.8)
+            ]
+            specs += [
+                ("service", 0, PARITY_K),
+                ("service", int(N_ALLOCS * 0.5), PARITY_K),
             ]
         else:
-            # no spread ⇒ bounded candidate window ⇒ placements depend on
-            # the StaticIterator cursor accumulated over the whole prefix,
-            # which a mid-sequence restart cannot reproduce — check the
-            # same placement count as one long prefix instead
-            windows = [(0, PARITY_K * 4)]
+            specs = [
+                ("oracle-np", 0, PARITY_NP_K * 4),
+                ("service", 0, PARITY_K * 2),
+            ]
         t_or = time.monotonic()
-        matched, checked, per_window = oracle_parity_windows(
-            job, placed_fast, windows
+        stats_by_engine, results = oracle_parity_windows(
+            job, placed_fast, specs
         )
         oracle_s = time.monotonic() - t_or
+        np_matched, np_checked, np_windows = stats_by_engine.get(
+            "oracle-np", (0, 0, {})
+        )
+        sc_matched, sc_checked, sc_windows = stats_by_engine.get(
+            "service", (0, 0, {})
+        )
+        # the pin: scalar-chain and numpy-oracle decisions at the SAME
+        # positions must agree exactly (scalar windows ⊆ numpy windows)
+        np_got = results.get("oracle-np", {})
+        sc_got = results.get("service", {})
+        pin_keys = [k for k in sc_got if k in np_got]
+        pin_match = sum(1 for k in pin_keys if sc_got[k] == np_got[k])
+        matched = np_matched + sc_matched
+        checked = np_checked + sc_checked
         parity_oracle = matched / max(checked, 1)
     else:
-        checked, per_window, oracle_s, parity_oracle = 0, {}, 0.0, 0.0
+        checked = np_checked = sc_checked = 0
+        np_windows = sc_windows = {}
+        pin_keys, pin_match = [], 0
+        oracle_s, parity_oracle = 0.0, 0.0
 
     return {
         "end_to_end_s": round(elapsed, 4),
@@ -423,7 +468,14 @@ def bench_headline():
         "parity_exact_full": round(parity_exact, 5),
         "parity_oracle": round(parity_oracle, 5),
         "parity_oracle_checked": checked,
-        "parity_oracle_windows": per_window,
+        "parity_oracle_np_checked": np_checked,
+        "parity_oracle_np_windows": np_windows,
+        "parity_oracle_scalar_checked": sc_checked,
+        "parity_oracle_scalar_windows": sc_windows,
+        "parity_np_scalar_pin": (
+            round(pin_match / len(pin_keys), 5) if pin_keys else None
+        ),
+        "parity_np_scalar_pin_checked": len(pin_keys),
         "parity_oracle_coverage": (
             "prefix+mid-sequence" if spread else
             "prefix-only (bounded-window cursor not reconstructable; "

@@ -111,7 +111,10 @@ def _build_fastobj():
         if cc is None:
             raise NativeBuildError("no C compiler on PATH")
         inc = sysconfig.get_paths()["include"]
-        tmp = out + ".tmp.so"
+        # per-process tmp name: _BUILD_LOCK is per-process, so two fresh
+        # processes may build concurrently — each must os.replace its own
+        # fully-written file (the rename is atomic; last writer wins)
+        tmp = f"{out}.tmp.{os.getpid()}.so"
         proc = subprocess.run(
             [cc, "-O2", "-shared", "-fPIC", f"-I{inc}", "-o", tmp, src],
             capture_output=True,

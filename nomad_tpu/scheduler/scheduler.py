@@ -60,6 +60,20 @@ def _tpu_system_factory(state, planner, rng=None):
     return TPUSystemScheduler(state, planner, rng=rng)
 
 
+def _oracle_np_factory(state, planner, rng=None):
+    """The vectorized oracle (tpu/exact_np.py): scalar-chain semantics in
+    float64 numpy, one dense pass per placement — used by bench parity
+    windows; not a production backend."""
+    try:
+        from ..tpu.batch_sched import TPUBatchScheduler
+    except ImportError as e:
+        raise ValueError(f"scheduler 'oracle-np' backend unavailable: {e}") from e
+
+    sched = TPUBatchScheduler(state, planner, rng=rng)
+    sched.exact_numpy = True
+    return sched
+
+
 # ref scheduler.go:23-29 BuiltinSchedulers + the new TPU backends
 BUILTIN_SCHEDULERS: dict[str, Callable] = {
     "service": _service_factory,
@@ -67,6 +81,7 @@ BUILTIN_SCHEDULERS: dict[str, Callable] = {
     "system": _system_factory,
     "tpu-batch": _tpu_batch_factory,
     "tpu-system": _tpu_system_factory,
+    "oracle-np": _oracle_np_factory,
 }
 
 

@@ -39,8 +39,23 @@ def enable_compile_cache(path: str | None = None) -> str:
     return path
 
 
-enable_compile_cache()
+# Lazy re-exports (PEP 562): importing this package must not pull jax —
+# the vectorized-oracle workers (bench.py spawn processes, tpu/exact_np.py)
+# route through batch_sched with numpy only, and jax's cold init is seconds
+# per process. The compile cache is enabled from kernel.py's module import,
+# which still precedes every jit compile.
+_LAZY = {
+    "TPUBatchScheduler": ("batch_sched", "TPUBatchScheduler"),
+    "ColumnarCluster": ("columnar", "ColumnarCluster"),
+    "plan_batch": ("kernel", "plan_batch"),
+}
 
-from .batch_sched import TPUBatchScheduler
-from .columnar import ColumnarCluster
-from .kernel import plan_batch
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(name)
+    import importlib
+
+    mod = importlib.import_module(f".{entry[0]}", __name__)
+    return getattr(mod, entry[1])

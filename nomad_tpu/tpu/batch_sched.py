@@ -168,6 +168,10 @@ class TPUBatchScheduler(GenericScheduler):
         # when set, the first placement pass routes through the multi-eval
         # drain collector (tpu/drain.py); refresh retries run solo
         self.drain_collector = None
+        # when True (the "oracle-np" factory), every placement runs the
+        # float64 numpy exact stepper instead of the device kernel — the
+        # vectorized oracle for bench parity windows (tpu/exact_np.py)
+        self.exact_numpy = False
 
     # ------------------------------------------------------------------
     def _batchable(self, destructive: list, place: list) -> bool:
@@ -382,10 +386,6 @@ class TPUBatchScheduler(GenericScheduler):
     ):
         import time
 
-        import jax.numpy as jnp
-
-        from .kernel import BatchArgs, BatchState, plan_batch
-
         t_start = time.monotonic()
         ctx = self.ctx
         n_real = len(nodes)
@@ -507,6 +507,56 @@ class TPUBatchScheduler(GenericScheduler):
         limits[:a_real] = g_limit[gid_real]
         valid = np.zeros(A, dtype=bool)
         valid[:a_real] = True
+
+        # Vectorized-oracle path: the float64 numpy stepper, one dense pass
+        # per placement with the scalar chain's exact semantics (no device)
+        if self.exact_numpy:
+            from .exact_np import plan_exact_np
+
+            t_columnar = time.monotonic()
+            placements = plan_exact_np(
+                capacity_real.astype(np.int64),
+                cluster.usable.astype(np.float64),
+                feasible[:, :n_real],
+                affinity[:, :n_real].astype(np.float64),
+                affinity_present[:, :n_real],
+                group_count.astype(np.int64),
+                node_value[:, :n_real].astype(np.int64),
+                spread_desired.astype(np.float64),
+                spread_implicit.astype(np.float64),
+                spread_weight_frac.astype(np.float64),
+                spread_even,
+                spread_active,
+                perm_real.astype(np.int64),
+                demands[:a_real].astype(np.int64),
+                group_ids[:a_real].astype(np.int64),
+                limits[:a_real].astype(np.int64),
+                used0_real.astype(np.int64),
+                collisions0[:, :n_real].astype(np.int64),
+                counts0.astype(np.int64),
+                present0,
+            )
+            LAST_KERNEL_STATS.update(
+                columnar_s=t_columnar - t_start,
+                kernel_s=time.monotonic() - t_columnar,
+                n_nodes=n_real,
+                n_allocs=a_real,
+                mode="exact-np",
+            )
+            _count_mode("exact-np")
+            self._materialize(
+                place, placements, nodes, by_dc, planes_list, g_index,
+                gid_real, used0, capacity, g_demand,
+                dev_entries=dev_entries, groups=groups,
+            )
+            return
+
+        # jax enters only below this line: the exact-np path above is pure
+        # numpy, so oracle workers (bench.py spawn-context processes) never
+        # pay jax's cold init, and 'oracle-np' works without jax installed
+        import jax.numpy as jnp
+
+        from .kernel import BatchArgs, BatchState, plan_batch
 
         # Run-based fast path: one group with affinity/spread (limit=∞,
         # full-ring selection) → resolve fill runs and sweep tie-runs one

@@ -30,6 +30,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from . import enable_compile_cache
+
+# must precede every jit compile; this module is the jax entry point for
+# the whole scheduler tier (batch_sched/drain/system_sched import it)
+enable_compile_cache()
+
 MAX_SKIP = 3  # ref stack.go:17
 NEG_INF = -1e30
 

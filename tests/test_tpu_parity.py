@@ -469,3 +469,113 @@ class TestKernelParity:
         p_batch, _, _ = run(nodes, make_job(6, mutate), "tpu-batch")
         assert len(p_batch) == 6
         assert len(set(p_batch.values())) == 6
+
+
+class TestVectorOracleParity:
+    """The float64 numpy stepper (factory ``oracle-np``, tpu/exact_np.py)
+    must reproduce the scalar iterator chain EXACTLY — it is the bench's
+    wide-coverage oracle, so spot divergence here would poison the whole
+    parity argument. Counts stay above the small-eval gate so the stepper
+    (not the scalar fallback) actually runs; the mode counter proves it."""
+
+    def _assert_exact(self, nodes, job):
+        from nomad_tpu.tpu import batch_sched
+
+        before = batch_sched.counters_snapshot()["modes"].get("exact-np", 0)
+        p_oracle, _, _ = run(nodes, job, "service")
+        p_np, _, _ = run(nodes, job, "oracle-np")
+        after = batch_sched.counters_snapshot()["modes"].get("exact-np", 0)
+        assert after > before, "stepper did not run (fell back?)"
+        assert p_oracle == p_np
+
+    def test_basic_binpack(self):
+        self._assert_exact(build_cluster(20), make_job(15))
+
+    def test_bounded_limit_rotation(self):
+        # no affinity/spread => log2-bounded candidate window and a live
+        # rotating cursor across Selects
+        self._assert_exact(build_cluster(40), make_job(30))
+
+    def test_with_constraints(self):
+        nodes = build_cluster(20)
+        for i, n in enumerate(nodes):
+            n.attributes["rack_class"] = "a" if i % 2 == 0 else "b"
+            from nomad_tpu.structs import compute_class
+
+            compute_class(n)
+
+        def mutate(job):
+            job.constraints.append(
+                Constraint(l_target="${attr.rack_class}", r_target="a", operand="=")
+            )
+
+        self._assert_exact(nodes, make_job(12, mutate))
+
+    def test_with_affinity(self):
+        nodes = build_cluster(16)
+        for i, n in enumerate(nodes):
+            n.meta["ssd"] = "true" if i < 4 else "false"
+
+        def mutate(job):
+            job.affinities = [
+                Affinity(l_target="${meta.ssd}", r_target="true", operand="=", weight=50)
+            ]
+
+        self._assert_exact(nodes, make_job(12, mutate))
+
+    def test_with_spread_targets(self):
+        nodes = build_cluster(12, dcs=("dc1", "dc2"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2"]
+            job.spreads = [
+                Spread(
+                    attribute="${node.datacenter}",
+                    weight=100,
+                    spread_target=[
+                        SpreadTarget(value="dc1", percent=50),
+                        SpreadTarget(value="dc2", percent=50),
+                    ],
+                )
+            ]
+
+        self._assert_exact(nodes, make_job(10, mutate))
+
+    def test_with_even_spread(self):
+        nodes = build_cluster(12, dcs=("dc1", "dc2", "dc3"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+
+        self._assert_exact(nodes, make_job(9, mutate))
+
+    def test_exhaustion(self):
+        # more asks than the cluster fits: the unplaced tail and failure
+        # metrics must match the scalar chain
+        nodes = build_cluster(3)
+        job = make_job(60)
+        p_oracle, s_oracle, _ = run(nodes, job, "service")
+        p_np, s_np, _ = run(nodes, job, "oracle-np")
+        assert p_oracle == p_np
+        m_o = s_oracle.failed_tg_allocs["web"]
+        m_n = s_np.failed_tg_allocs["web"]
+        assert m_o.coalesced_failures == m_n.coalesced_failures
+        assert m_o.nodes_exhausted == m_n.nodes_exhausted
+
+    def test_larger_scale_spread(self):
+        nodes = build_cluster(120, dcs=("dc1", "dc2", "dc3", "dc4"))
+
+        def mutate(job):
+            job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+            job.spreads = [
+                Spread(
+                    attribute="${node.datacenter}",
+                    weight=100,
+                    spread_target=[
+                        SpreadTarget(value=f"dc{i}", percent=25) for i in (1, 2, 3, 4)
+                    ],
+                )
+            ]
+
+        self._assert_exact(nodes, make_job(200, mutate))
