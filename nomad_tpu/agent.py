@@ -9,6 +9,7 @@ node agent that talks to servers over RPC via ServerProxy.
 
 from __future__ import annotations
 
+import logging
 import os
 import tempfile
 from typing import Optional
@@ -68,6 +69,50 @@ def apply_client_config(agent: "DevAgent", config: dict) -> None:
     if vault_cfg.get("address"):
         for client in agent.clients:
             client.vault_config = dict(vault_cfg)
+    # plugin "name" { type = "driver"|"device", spec = "pkg.mod:factory",
+    # config {...} } — external subprocess plugins (ref command/agent
+    # plugin stanza + helper/pluginutils/loader; device.proto / driver.proto)
+    plugins = config.get("plugin") or {}
+    if plugins:
+        from .plugins.external import ExternalDevicePlugin, ExternalDriver
+        from .structs.node_class import compute_class as _cc
+
+        for pname, body in plugins.items():
+            body = body or {}
+            spec = str(body.get("spec", ""))
+            if not spec:
+                logging.getLogger("nomad_tpu.agent").warning(
+                    "plugin %r has no spec; skipped", pname
+                )
+                continue
+            kind = str(body.get("type", "driver"))
+            if kind not in ("driver", "device"):
+                logging.getLogger("nomad_tpu.agent").warning(
+                    "plugin %r has unknown type %r (want driver|device); "
+                    "skipped", pname, kind
+                )
+                continue
+            pconfig = body.get("config") or {}
+            for client in agent.clients:
+                if kind == "device":
+                    plugin = ExternalDevicePlugin(
+                        spec, name=pname, config=pconfig
+                    )
+                    client.device_manager.plugins.append(plugin)
+                    # the node was fingerprinted at construction; merge the
+                    # new plugin's device groups before registration
+                    client.device_manager.fingerprint_node(client.node)
+                    _cc(client.node)
+                else:
+                    client.drivers[pname] = ExternalDriver(
+                        spec, name=pname, config=pconfig
+                    )
+                    # re-fingerprint so node.drivers advertises the new
+                    # driver at registration (feasible.py filters nodes
+                    # missing a task's driver); the device branch merges
+                    # symmetrically above
+                    client._fingerprint_drivers(client.node)
+                    _cc(client.node)
     if not volumes and not meta:
         return
     from .structs.model import ClientHostVolumeConfig

@@ -815,6 +815,18 @@ class Client:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        # external device plugins stream fingerprint changes (chip health
+        # transitions, hotplug); a change re-registers the node so the
+        # scheduler sees the new device groups (device.proto Fingerprint)
+        self.device_manager.start_watches(self._on_device_change)
+
+    def _on_device_change(self):
+        try:
+            self.device_manager.fingerprint_node(self.node)
+            compute_class(self.node)
+            self.server.node_register(self.node)
+        except Exception:
+            logger.exception("device-change node re-registration failed")
 
     def stop(self, destroy_allocs: bool = True):
         """``destroy_allocs=False`` leaves tasks running (the crash/restart
@@ -829,6 +841,16 @@ class Client:
         for t in self._threads:
             t.join(timeout=1.0)
         self._threads = []
+        self.device_manager.shutdown()
+        # external driver plugins own subprocesses; in-process drivers
+        # have no shutdown and are skipped
+        for driver in self.drivers.values():
+            stop_fn = getattr(driver, "shutdown", None)
+            if stop_fn is not None:
+                try:
+                    stop_fn()
+                except Exception:
+                    logger.exception("driver %s shutdown failed", driver.name)
         if self.state_db is not None:
             self.state_db.close()
 

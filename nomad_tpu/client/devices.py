@@ -57,6 +57,15 @@ class TPUDevicePlugin(DevicePlugin):
             "NOMAD_TPU_DEV_GLOB", "/dev/accel*"
         )
 
+    def config_schema(self) -> dict:
+        """base.proto ConfigSchema: the subprocess-plugin handshake pushes
+        the agent's plugin{config{}} stanza through this schema."""
+        return {"dev_glob": {"type": "string"}}
+
+    def set_config(self, config: dict) -> None:
+        if config.get("dev_glob"):
+            self.dev_glob = config["dev_glob"]
+
     def _chips(self) -> list[str]:
         chips = sorted(glob.glob(self.dev_glob))
         # vfio fallback: chips bound to vfio show up as numbered group files
@@ -129,11 +138,18 @@ class DeviceManager:
         self.plugins = plugins if plugins is not None else [TPUDevicePlugin()]
         # (vendor, type, name) → owning plugin, filled by fingerprint_node
         self._owners: dict[tuple, DevicePlugin] = {}
+        # node attribute keys this manager set, so a shrinking device set
+        # clears its stale count attributes
+        self._attr_keys: set[str] = set()
 
     def fingerprint_node(self, node) -> int:
         """Merge all plugins' device groups into the node; returns the
-        number of device groups found."""
+        number of device groups found. Assigns unconditionally — a set that
+        shrinks to empty (last chip pulled/unhealthy) must CLEAR the node's
+        advertised devices, or the scheduler keeps placing device jobs on a
+        chipless node (the change watch makes shrink a live path)."""
         groups = []
+        attr_keys = set()
         for plugin in self.plugins:
             try:
                 found = plugin.fingerprint()
@@ -144,11 +160,13 @@ class DeviceManager:
                 key = (group.vendor, group.type, group.name)
                 self._owners[key] = plugin
                 groups.append(group)
-                node.attributes[f"device.{group.vendor}.{group.type}.count"] = str(
-                    len(group.instances)
-                )
-        if groups:
-            node.node_resources.devices = groups
+                attr_key = f"device.{group.vendor}.{group.type}.count"
+                node.attributes[attr_key] = str(len(group.instances))
+                attr_keys.add(attr_key)
+        for stale in self._attr_keys - attr_keys:
+            node.attributes.pop(stale, None)
+        self._attr_keys = attr_keys
+        node.node_resources.devices = groups
         return len(groups)
 
     def stats(self) -> dict:
@@ -164,6 +182,33 @@ class DeviceManager:
             if stats:
                 out[plugin.name] = stats
         return out
+
+    def start_watches(self, on_change) -> None:
+        """Start change watches on plugins that stream fingerprints
+        (external subprocess plugins; ref device.proto's Fingerprint
+        stream). ``on_change()`` should re-fingerprint and re-register the
+        node."""
+        for plugin in self.plugins:
+            watch = getattr(plugin, "watch", None)
+            if watch is not None:
+                try:
+                    watch(on_change)
+                except Exception:
+                    logger.exception(
+                        "device plugin %s watch failed to start", plugin.name
+                    )
+
+    def shutdown(self) -> None:
+        """Tear down external plugin processes (no-op for in-process)."""
+        for plugin in self.plugins:
+            stop = getattr(plugin, "shutdown", None)
+            if stop is not None:
+                try:
+                    stop()
+                except Exception:
+                    logger.exception(
+                        "device plugin %s shutdown failed", plugin.name
+                    )
 
     def reserve_env(self, allocated_devices) -> dict:
         """Env for a task's AllocatedDeviceResource list."""

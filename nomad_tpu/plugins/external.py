@@ -128,28 +128,27 @@ class _Conn:
             pass
 
 
-class ExternalDriver(Driver):
-    """A Driver whose implementation runs in a plugin subprocess."""
+class PluginProcess:
+    """Subprocess lifecycle + base-protocol handshake shared by every
+    external plugin kind (ref helper/pluginutils/loader: go-plugin's managed
+    process + the base.proto Info/ConfigSchema/SetConfig handshake). Owns
+    launch, reconnect-after-crash, config push, and teardown; the typed
+    wrappers (ExternalDriver, ExternalDevicePlugin) add their protocol."""
 
-    def __init__(
-        self,
-        driver_spec: str,
-        name: Optional[str] = None,
-        config: Optional[dict] = None,
-    ):
-        """``driver_spec`` is 'pkg.module:factory' resolved inside the
-        plugin process (e.g. 'nomad_tpu.client.driver:MockDriver').
-        ``config`` is validated against the plugin's declared schema at
-        handshake and pushed via SetConfig (base.proto)."""
-        self.spec = driver_spec
-        self.name = name or driver_spec.rsplit(":", 1)[-1].lower()
+    def __init__(self, kind_flag: str, spec: str, config: Optional[dict] = None):
+        self.kind_flag = kind_flag  # "--driver" | "--device"
+        self.spec = spec
         self.config = dict(config or {})
+        self.info: dict = {}
         self._proc: Optional[subprocess.Popen] = None
         self._conn: Optional[_Conn] = None
         self._lock = threading.Lock()
 
-    # -- process management --------------------------------------------
-    def _ensure(self) -> _Conn:
+    @property
+    def conn(self) -> Optional[_Conn]:
+        return self._conn
+
+    def ensure(self) -> _Conn:
         with self._lock:
             if self._conn is not None and self._proc is not None and self._proc.poll() is None:
                 return self._conn
@@ -164,7 +163,7 @@ class ExternalDriver(Driver):
                 sys.executable,
                 "-m",
                 "nomad_tpu.plugins.serve",
-                "--driver",
+                self.kind_flag,
                 self.spec,
                 "--socket",
                 sock_path,
@@ -184,8 +183,7 @@ class ExternalDriver(Driver):
                 s.connect(sock_path)
                 conn = _Conn(s)
                 try:
-                    info = conn.call("Plugin.Info", {})
-                    self.name = info.get("name", self.name)
+                    self.info = conn.call("Plugin.Info", {})
                     # base.proto handshake tail: fetch the schema, validate
                     # our config against it, push it (every (re)launch — a
                     # crashed plugin must come back configured)
@@ -219,6 +217,47 @@ class ExternalDriver(Driver):
                 except subprocess.TimeoutExpired:
                     self._proc.kill()
                 self._proc = None
+
+
+class ExternalDriver(Driver):
+    """A Driver whose implementation runs in a plugin subprocess."""
+
+    def __init__(
+        self,
+        driver_spec: str,
+        name: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        """``driver_spec`` is 'pkg.module:factory' resolved inside the
+        plugin process (e.g. 'nomad_tpu.client.driver:MockDriver').
+        ``config`` is validated against the plugin's declared schema at
+        handshake and pushed via SetConfig (base.proto)."""
+        self.spec = driver_spec
+        self.name = name or driver_spec.rsplit(":", 1)[-1].lower()
+        self._pp = PluginProcess("--driver", driver_spec, config)
+
+    # -- process management --------------------------------------------
+    @property
+    def _conn(self) -> Optional[_Conn]:
+        return self._pp.conn
+
+    @property
+    def _proc(self) -> Optional[subprocess.Popen]:
+        return self._pp._proc
+
+    @property
+    def config(self) -> dict:
+        """The live handshake config (mutations flow to the next
+        SetConfig on relaunch) — one source of truth in the process."""
+        return self._pp.config
+
+    def _ensure(self) -> _Conn:
+        conn = self._pp.ensure()
+        self.name = self._pp.info.get("name", self.name)
+        return conn
+
+    def shutdown(self):
+        self._pp.shutdown()
 
     # -- handle plumbing ------------------------------------------------
     def _local_handle(self, desc: dict, task: Task) -> TaskHandle:
@@ -316,3 +355,110 @@ class ExternalDriver(Driver):
         if not desc.get("recovered"):
             return None
         return self._local_handle(desc, task)
+
+
+class ExternalDevicePlugin:
+    """A DevicePlugin served from a plugin subprocess (the framework's
+    analog of the reference's out-of-process gRPC device plugins,
+    plugins/device/proto/device.proto:1-40: a vendor ships a binary; the
+    client manages its process and talks Fingerprint/Reserve/Stats).
+
+    Implements the same duck-typed surface the in-process plugins expose
+    (client/devices.py DevicePlugin), so DeviceManager treats both kinds
+    identically. ``watch`` mirrors the reference's streaming Fingerprint:
+    a long-poll thread that fires ``on_change`` whenever the plugin's
+    detected device set changes (new chip, health transition), letting the
+    client re-register the node."""
+
+    #: long-poll window for the change watch (device.proto's stream has no
+    #: polling; one server-side blocked call per window is the analog)
+    WATCH_TIMEOUT = 30.0
+
+    def __init__(
+        self,
+        device_spec: str,
+        name: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        self.spec = device_spec
+        self.name = name or device_spec.rsplit(":", 1)[-1].lower()
+        self._pp = PluginProcess("--device", device_spec, config)
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._generation: Optional[int] = None
+
+    def _ensure(self) -> _Conn:
+        conn = self._pp.ensure()
+        self.name = self._pp.info.get("name", self.name)
+        return conn
+
+    @property
+    def config(self) -> dict:
+        return self._pp.config
+
+    # -- DevicePlugin surface ------------------------------------------
+    def fingerprint(self) -> list:
+        from ..structs.model import NodeDeviceResource
+
+        r = self._ensure().call("Device.Fingerprint", {})
+        self._generation = r.get("generation")
+        return [NodeDeviceResource.from_dict(g) for g in r.get("groups", [])]
+
+    def reserve(self, device_ids: list) -> dict:
+        return self._ensure().call(
+            "Device.Reserve", {"device_ids": list(device_ids)}
+        )
+
+    def stats(self) -> dict:
+        try:
+            return self._ensure().call("Device.Stats", {}) or {}
+        except PluginError as e:
+            logger.warning("device plugin stats failed: %s", e)
+            return {}
+
+    # -- streaming fingerprint (device.proto Fingerprint stream) --------
+    def watch(self, on_change) -> None:
+        """Start the change watch: ``on_change()`` fires whenever the
+        plugin's device set generation moves past the last fingerprint()."""
+        if self._watch_thread is not None:
+            return
+        # fresh event per watch: a stopped client can start again, and the
+        # new watch must not inherit the previous shutdown's stop flag
+        self._watch_stop = threading.Event()
+
+        def loop():
+            while not self._watch_stop.is_set():
+                try:
+                    r = self._ensure().call(
+                        "Device.Fingerprint",
+                        {
+                            "generation": self._generation,
+                            "timeout": self.WATCH_TIMEOUT,
+                        },
+                        timeout=self.WATCH_TIMEOUT + 15.0,
+                    )
+                except PluginError as e:
+                    if self._watch_stop.is_set():
+                        return
+                    logger.warning("device plugin watch failed: %s", e)
+                    self._watch_stop.wait(1.0)
+                    continue
+                gen = r.get("generation")
+                if self._generation is not None and gen != self._generation:
+                    self._generation = gen
+                    try:
+                        on_change()
+                    except Exception:
+                        logger.exception("device change callback failed")
+                elif self._generation is None:
+                    self._generation = gen
+
+        self._watch_thread = threading.Thread(target=loop, daemon=True)
+        self._watch_thread.start()
+
+    def shutdown(self):
+        self._watch_stop.set()
+        self._pp.shutdown()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2.0)
+            self._watch_thread = None

@@ -143,9 +143,90 @@ class _DriverService:
     }
 
 
+class _DeviceService:
+    """Method table mapping the wire protocol onto a DevicePlugin instance
+    (ref plugins/device/proto/device.proto:1-40: Fingerprint is a server
+    stream pushing device-group changes; here the same liveness comes from
+    a generation-tagged long poll — the client repolls with the last
+    generation it saw and the call returns early when the detected set
+    changes, e.g. a chip going unhealthy)."""
+
+    POLL_INTERVAL = 0.25
+
+    def __init__(self, plugin):
+        self.plugin = plugin
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._last_blob: object = None
+
+    def _current(self) -> tuple[int, list]:
+        groups = self.plugin.fingerprint()
+        blob = [g.to_dict() for g in groups]
+        with self._lock:
+            if blob != self._last_blob:
+                self._generation += 1
+                self._last_blob = blob
+            return self._generation, blob
+
+    # -- protocol methods ----------------------------------------------
+    def plugin_info(self, payload: dict) -> dict:
+        return {
+            "name": getattr(self.plugin, "name", "device"),
+            "type": "device",
+            "api_version": 1,
+        }
+
+    def config_schema(self, payload: dict) -> dict:
+        return getattr(self.plugin, "config_schema", dict)() or {}
+
+    def set_config(self, payload: dict) -> dict:
+        setter = getattr(self.plugin, "set_config", None)
+        if setter is not None:
+            setter(payload.get("config") or {})
+        return {}
+
+    def fingerprint(self, payload: dict) -> dict:
+        """Long-poll: returns immediately when the caller has no generation
+        (or a stale one), otherwise blocks until the detected device set
+        changes or ``timeout`` elapses (device.proto Fingerprint stream)."""
+        import time as _time
+
+        known = payload.get("generation")
+        deadline = _time.monotonic() + float(payload.get("timeout", 0.0))
+        while True:
+            gen, blob = self._current()
+            if known is None or gen != known or _time.monotonic() >= deadline:
+                return {"generation": gen, "groups": blob}
+            _time.sleep(self.POLL_INTERVAL)
+
+    def reserve(self, payload: dict) -> dict:
+        """ref device.proto Reserve → ContainerReservation."""
+        return self.plugin.reserve(list(payload.get("device_ids") or []))
+
+    def stats(self, payload: dict) -> dict:
+        return self.plugin.stats() or {}
+
+    METHODS = {
+        "Plugin.Info": plugin_info,
+        "Plugin.ConfigSchema": config_schema,
+        "Plugin.SetConfig": set_config,
+        "Device.Fingerprint": fingerprint,
+        "Device.Reserve": reserve,
+        "Device.Stats": stats,
+    }
+
+
 def serve_driver(driver, socket_path: str, ready_event=None):
     """Serve one Driver on a unix socket until the client disconnects."""
-    service = _DriverService(driver)
+    return _serve(_DriverService(driver), socket_path, ready_event)
+
+
+def serve_device(plugin, socket_path: str, ready_event=None):
+    """Serve one DevicePlugin on a unix socket until the client disconnects."""
+    return _serve(_DeviceService(plugin), socket_path, ready_event)
+
+
+def _serve(service, socket_path: str, ready_event=None):
     try:
         os.unlink(socket_path)
     except FileNotFoundError:
@@ -204,12 +285,17 @@ def _resolve(spec: str):
 
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="nomad-tpu-plugin")
-    parser.add_argument("--driver", required=True, help="pkg.module:factory")
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--driver", help="pkg.module:factory")
+    group.add_argument("--device", help="pkg.module:factory")
     parser.add_argument("--socket", required=True)
     args = parser.parse_args(argv)
-    factory = _resolve(args.driver)
-    driver = factory() if callable(factory) else factory
-    serve_driver(driver, args.socket)
+    factory = _resolve(args.driver or args.device)
+    plugin = factory() if callable(factory) else factory
+    if args.driver:
+        serve_driver(plugin, args.socket)
+    else:
+        serve_device(plugin, args.socket)
 
 
 if __name__ == "__main__":

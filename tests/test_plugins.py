@@ -413,3 +413,154 @@ class TestTPUDevicePlugin:
                 client.stop()
             finally:
                 server.stop()
+
+
+class TestExternalDevicePlugin:
+    """The out-of-process device-plugin protocol (ref
+    plugins/device/proto/device.proto:1-40): a plugin subprocess serves
+    Fingerprint/Reserve/Stats over the framed socket, with the base
+    handshake pushing config, and the long-poll watch standing in for the
+    reference's streaming fingerprint."""
+
+    def _fake_dev(self, tmp, n=4):
+        for i in range(n):
+            open(os.path.join(tmp, f"accel{i}"), "w").close()
+        return os.path.join(tmp, "accel*")
+
+    def _plugin(self, glob_pat):
+        from nomad_tpu.plugins.external import ExternalDevicePlugin
+
+        return ExternalDevicePlugin(
+            "nomad_tpu.client.devices:TPUDevicePlugin",
+            config={"dev_glob": glob_pat},
+        )
+
+    def test_fingerprint_reserve_stats_over_subprocess(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = self._plugin(self._fake_dev(tmp, n=3))
+            try:
+                groups = plugin.fingerprint()
+                assert len(groups) == 1
+                g = groups[0]
+                assert (g.vendor, g.type, g.name) == ("google", "tpu", "tpu")
+                assert [i.id for i in g.instances] == ["0", "1", "2"]
+                assert plugin.name == "tpu"  # handshake Info name
+
+                res = plugin.reserve(["0", "2"])
+                assert res["env"] == {"TPU_VISIBLE_DEVICES": "0,2"}
+
+                stats = plugin.stats()
+                assert stats["chip_count"] == 3
+            finally:
+                plugin.shutdown()
+
+    def test_watch_fires_on_device_change(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = self._plugin(self._fake_dev(tmp, n=1))
+            changed = []
+            try:
+                assert len(plugin.fingerprint()[0].instances) == 1
+                plugin.watch(lambda: changed.append(True))
+                time.sleep(0.3)
+                assert not changed, "no change yet"
+                # hotplug a second chip: the long-poll must fire
+                open(os.path.join(tmp, "accel1"), "w").close()
+                wait_until(lambda: changed, timeout=10.0, msg="watch fired")
+                assert len(plugin.fingerprint()[0].instances) == 2
+            finally:
+                plugin.shutdown()
+
+    def test_plugin_process_restarts_after_crash(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = self._plugin(self._fake_dev(tmp, n=2))
+            try:
+                assert len(plugin.fingerprint()[0].instances) == 2
+                plugin._pp._proc.kill()
+                plugin._pp._proc.wait(timeout=5.0)
+                # next call relaunches and re-pushes config (SetConfig on
+                # every launch: a crashed plugin must come back configured)
+                assert len(plugin.fingerprint()[0].instances) == 2
+            finally:
+                plugin.shutdown()
+
+    def test_device_job_e2e_through_subprocess_plugin(self):
+        """End-to-end VERDICT item: a device plugin running as a separate
+        process serves fingerprint/reserve to the client, and a scheduler
+        device{} ask flows through it into the task env."""
+        server = make_server()
+        data_dir = tempfile.mkdtemp(prefix="ext_device_client_")
+        with tempfile.TemporaryDirectory() as tmp:
+            plugin = self._plugin(self._fake_dev(tmp, n=2))
+            client = Client(
+                server,
+                data_dir=data_dir,
+                device_plugins=[plugin],
+            )
+            try:
+                assert client.node.node_resources.devices, (
+                    "TPUs fingerprinted via the subprocess plugin"
+                )
+                client.start()
+
+                job = mock.batch_job()
+                tg = job.task_groups[0]
+                tg.count = 1
+                task = tg.tasks[0]
+                task.driver = "raw_exec"
+                task.config = {
+                    "command": "/bin/sh",
+                    "args": ["-c", "echo -n $TPU_VISIBLE_DEVICES > tpu_env"],
+                }
+                task.resources.networks = []
+                task.resources.devices = [RequestedDevice(name="tpu", count=1)]
+                server.job_register(job)
+
+                wait_until(
+                    lambda: all(
+                        a.client_status == "complete"
+                        for a in server.state.allocs_by_job(job.namespace, job.id)
+                    )
+                    and len(server.state.allocs_by_job(job.namespace, job.id)) == 1,
+                    msg="device job completes",
+                )
+                (alloc,) = server.state.allocs_by_job(job.namespace, job.id)
+                devices = alloc.allocated_resources.tasks["web"].devices
+                assert devices and devices[0].type == "tpu"
+
+                out = os.path.join(
+                    data_dir, "allocs", alloc.id, "web", "tpu_env"
+                )
+                with open(out) as f:
+                    assert f.read() == devices[0].device_ids[0]
+                client.stop()
+            finally:
+                plugin.shutdown()
+                server.stop()
+
+    def test_agent_plugin_stanza_wires_device_plugin(self):
+        """plugin "name" { type="device" spec=... config{} } in the agent
+        config lands an external device plugin on the client (ref
+        command/agent plugin stanza + pluginutils/loader catalog)."""
+        from nomad_tpu.agent import DevAgent, apply_client_config
+
+        with tempfile.TemporaryDirectory() as tmp:
+            glob_pat = self._fake_dev(tmp, n=2)
+            agent = DevAgent()
+            try:
+                config = {
+                    "plugin": {
+                        "tpu-ext": {
+                            "type": "device",
+                            "spec": "nomad_tpu.client.devices:TPUDevicePlugin",
+                            "config": {"dev_glob": glob_pat},
+                        }
+                    }
+                }
+                apply_client_config(agent, config)
+                node = agent.clients[0].node
+                assert node.node_resources.devices, "stanza plugin fingerprinted"
+                assert (
+                    node.attributes.get("device.google.tpu.count") == "2"
+                )
+            finally:
+                agent.stop()
