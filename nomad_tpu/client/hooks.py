@@ -228,4 +228,13 @@ def run_prestart(
         **env,
     }
     prepared.config = taskenv.interpolate(task.config, prepared.env, node)
+    # drivers see the ALLOCATED networks (NetworkIndex's granted host
+    # ports), not the jobspec ask whose dynamic ports are still 0 — the
+    # reference builds the driver TaskConfig from the alloc's resources
+    # (drivers/task_handle + driver.go createContainerConfig port binds)
+    ar = getattr(alloc, "allocated_resources", None)
+    if ar is not None:
+        tr = ar.tasks.get(task.name)
+        if tr is not None and tr.networks:
+            prepared.resources.networks = [n.copy() for n in tr.networks]
     return prepared, env

@@ -1,17 +1,37 @@
-"""Docker task driver (ref drivers/docker/driver.go), built on the docker
-CLI rather than the engine API socket: run/wait/stop/kill/rm/inspect cover
-the reference driver's container lifecycle, `docker logs -f` feeds the
-task log files (the docklog companion's role), and recovery re-attaches to
-a still-running container by name (RecoverTask).
+"""Docker task driver (ref drivers/docker/driver.go + config.go), built on
+the docker CLI rather than the engine API socket: run/wait/stop/kill/rm/
+inspect cover the reference driver's container lifecycle, `docker logs -f`
+feeds the task log files (the docklog companion's role), and recovery
+re-attaches to a still-running container by name (RecoverTask).
 
-Task config:
-  image         required
-  command/args  override the image entrypoint
-  network_mode  --network value
-  volumes       ["host:container", ...]
-  labels        {k: v} container labels
-  port_map      {label: container_port} publish task ports
-  force_pull    pull the image even when present
+Task config (the reference's taskConfigSpec surface, drivers/docker/
+config.go; unknown keys are rejected like hclspec would):
+  image            required
+  command/args     override the image CMD
+  entrypoint       override the image ENTRYPOINT (list)
+  auth             {username, password, server_address} registry login
+  force_pull       pull the image even when present
+  load             image tarball (relative to the task dir) docker-load'd
+  network_mode     --network value
+  network_aliases  extra names on the container network
+  ipv4_address / ipv6_address / mac_address / hostname
+  port_map         {label: container_port} publish NetworkIndex ports
+  volumes          ["host:container[:ro]", ...] (+ volume_driver)
+  mounts           [{type: bind|volume|tmpfs, target, source, readonly}]
+  devices          [{host_path, container_path, cgroup_permissions}]
+  dns_servers / dns_search_domains / dns_options / extra_hosts
+  privileged       requires plugin config allow_privileged
+  cap_add/cap_drop capabilities, checked against plugin allow_caps
+  ulimit           {name: "soft[:hard]"}
+  sysctl           {key: value}
+  security_opt / storage_opt
+  pid_mode / ipc_mode / uts_mode / userns_mode
+  readonly_rootfs / shm_size (bytes) / pids_limit
+  cpu_hard_limit   CFS quota from resources.cpu (+ cpu_cfs_period)
+  memory_hard_limit  MB; resources.memory_mb becomes the soft reservation
+  work_dir / interactive / tty
+  logging          {driver|type, config: {k: v}} → --log-driver/--log-opt
+  labels           {k: v} container labels
 """
 
 from __future__ import annotations
@@ -25,6 +45,33 @@ import uuid
 
 from ..client.driver import Driver, TaskHandle, task_log_dir
 from ..structs.model import Task
+
+
+class DockerConfigError(RuntimeError):
+    """Invalid task config; surfaces as a task event via the runner's
+    driver-failure path (ref drivers/docker/config.go validation)."""
+
+
+#: the reference's default capability whitelist (drivers/docker/driver.go
+#: nvidia-era defaults; linux defaults minus the risky ones)
+DEFAULT_ALLOWED_CAPS = (
+    "CHOWN,DAC_OVERRIDE,FSETID,FOWNER,MKNOD,NET_RAW,SETGID,SETUID,"
+    "SETFCAP,SETPCAP,NET_BIND_SERVICE,SYS_CHROOT,KILL,AUDIT_WRITE"
+)
+
+#: every task-config key the builder understands; anything else is a
+#: config error (the hclspec role: a typo'd stanza must not silently no-op)
+_KNOWN_CONFIG_KEYS = {
+    "image", "command", "args", "entrypoint", "auth", "force_pull", "load",
+    "network_mode", "network_aliases", "ipv4_address", "ipv6_address",
+    "mac_address", "hostname", "port_map", "volumes", "volume_driver",
+    "mounts", "devices", "dns_servers", "dns_search_domains", "dns_options",
+    "extra_hosts", "privileged", "cap_add", "cap_drop", "ulimit", "sysctl",
+    "security_opt", "storage_opt", "pid_mode", "ipc_mode", "uts_mode",
+    "userns_mode", "readonly_rootfs", "shm_size", "pids_limit",
+    "cpu_hard_limit", "cpu_cfs_period", "memory_hard_limit", "work_dir",
+    "interactive", "tty", "logging", "labels",
+}
 
 
 class ImageCoordinator:
@@ -134,6 +181,9 @@ class DockerDriver(Driver):
         return {
             "image_gc_delay_s": {"type": "number", "default": 180},
             "image_cleanup": {"type": "bool", "default": True},
+            # ref docker plugin config allow_privileged / allow_caps
+            "allow_privileged": {"type": "bool", "default": False},
+            "allow_caps": {"type": "string", "default": DEFAULT_ALLOWED_CAPS},
         }
 
     def set_config(self, config: dict):
@@ -221,12 +271,25 @@ class DockerDriver(Driver):
             raise RuntimeError("docker requires an image")
         container = f"nomad-{task.name}-{uuid.uuid4().hex[:8]}"
 
+        # config validation FIRST: a typo'd stanza must fail before any
+        # image pull is paid or a coordinator reference is taken
+        argv = self._container_args(task, cfg, container, task_dir)
+
         # registry auth (task config auth{}) rides a task-private CLI
         # config; the refcounted coordinator pulls each image at most once
         # and GCs it after the last reference + delay
         config_dir = ""
         if cfg.get("auth"):
             config_dir = self._auth_config_dir(dict(cfg["auth"]), task_dir)
+        if cfg.get("load"):
+            # image arrives as a tarball in the task dir (artifact stanza),
+            # not from a registry (config.go `load`)
+            tar = os.path.join(task_dir or ".", str(cfg["load"]))
+            out = self._run("load", "-i", tar, timeout=600)
+            if out.returncode != 0:
+                raise DockerConfigError(
+                    f"docker load {cfg['load']!r} failed: {out.stderr.strip()}"
+                )
         self.coordinator.acquire(
             image,
             container,
@@ -234,36 +297,11 @@ class DockerDriver(Driver):
             config_dir=config_dir,
         )
 
-        argv = ["run", "-d", "--name", container]
-        if task.resources.memory_mb:
-            argv += ["--memory", f"{task.resources.memory_mb}m"]
-        if task.resources.cpu:
-            argv += ["--cpu-shares", str(task.resources.cpu)]
-        for k, v in (task.env or {}).items():
-            argv += ["-e", f"{k}={v}"]
-        for volume in cfg.get("volumes", []):
-            argv += ["-v", str(volume)]
-        if cfg.get("network_mode"):
-            argv += ["--network", str(cfg["network_mode"])]
-        for k, v in (cfg.get("labels") or {}).items():
-            argv += ["--label", f"{k}={v}"]
-        # port publishing: task port labels → container ports
-        # (ref docker driver's port_map + publishedPorts)
-        port_map = cfg.get("port_map") or {}
-        ports = {}
-        for net in task.resources.networks:
-            for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                ports[p.label] = p.value
-        for label, container_port in port_map.items():
-            host_port = ports.get(label)
-            if host_port:
-                argv += ["-p", f"{host_port}:{container_port}"]
-        argv.append(image)
-        if cfg.get("command"):
-            argv.append(str(cfg["command"]))
-        argv += [str(a) for a in cfg.get("args", [])]
-
-        out = self._run(*argv, timeout=600, config_dir=config_dir)
+        try:
+            out = self._run(*argv, timeout=600, config_dir=config_dir)
+        except (OSError, subprocess.TimeoutExpired):
+            self.coordinator.release(image, container)
+            raise
         if out.returncode != 0:
             self.coordinator.release(image, container)
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
@@ -275,6 +313,241 @@ class DockerDriver(Driver):
         handle._image = image
         self._supervise(handle, container, task_dir)
         return handle
+
+    def _container_args(
+        self, task: Task, cfg: dict, container: str, task_dir: str
+    ) -> list:
+        """`docker run` argv for the task's full container-config surface
+        (ref drivers/docker/config.go taskConfigSpec → driver.go
+        createContainerConfig). Config errors raise DockerConfigError,
+        which the task runner records as a driver-failure task event."""
+        unknown = set(cfg) - _KNOWN_CONFIG_KEYS
+        if unknown:
+            raise DockerConfigError(
+                f"unknown docker config keys: {', '.join(sorted(unknown))}"
+            )
+
+        argv = ["run", "-d", "--name", container]
+
+        # -- resources (driver.go memory/cpu wiring) --------------------
+        hard_mb = cfg.get("memory_hard_limit")
+        if hard_mb:
+            if task.resources.memory_mb and int(hard_mb) < task.resources.memory_mb:
+                raise DockerConfigError(
+                    f"memory_hard_limit ({hard_mb}MB) must be at least the "
+                    f"task's memory reservation ({task.resources.memory_mb}MB)"
+                )
+            argv += ["--memory", f"{int(hard_mb)}m"]
+            if task.resources.memory_mb:
+                argv += [
+                    "--memory-reservation", f"{task.resources.memory_mb}m"
+                ]
+        elif task.resources.memory_mb:
+            argv += ["--memory", f"{task.resources.memory_mb}m"]
+        if task.resources.cpu:
+            argv += ["--cpu-shares", str(task.resources.cpu)]
+        if cfg.get("cpu_hard_limit"):
+            # CFS quota from the task's MHz share (driver.go cpu_hard_limit:
+            # quota = period * cpu / node_mhz is engine-side; the CLI path
+            # uses the same period knob with quota scaled by shares/1024)
+            period = int(cfg.get("cpu_cfs_period", 100000))
+            if not 1000 <= period <= 1000000:
+                raise DockerConfigError(
+                    "cpu_cfs_period must be in [1000, 1000000]"
+                )
+            quota = max(int(period * task.resources.cpu / 1024), 1000)
+            argv += ["--cpu-period", str(period), "--cpu-quota", str(quota)]
+        if cfg.get("pids_limit"):
+            argv += ["--pids-limit", str(int(cfg["pids_limit"]))]
+        if cfg.get("shm_size"):
+            argv += ["--shm-size", str(int(cfg["shm_size"]))]
+
+        # -- identity / namespaces --------------------------------------
+        if cfg.get("hostname"):
+            argv += ["--hostname", str(cfg["hostname"])]
+        if cfg.get("mac_address"):
+            argv += ["--mac-address", str(cfg["mac_address"])]
+        for key, flag in (
+            ("pid_mode", "--pid"),
+            ("ipc_mode", "--ipc"),
+            ("uts_mode", "--uts"),
+            ("userns_mode", "--userns"),
+        ):
+            if cfg.get(key):
+                argv += [flag, str(cfg[key])]
+        if task.user:
+            argv += ["--user", str(task.user)]
+        if cfg.get("work_dir"):
+            argv += ["--workdir", str(cfg["work_dir"])]
+
+        # -- privilege / capabilities (gated by plugin config) ----------
+        if cfg.get("privileged"):
+            if not self.plugin_config.get("allow_privileged", False):
+                raise DockerConfigError(
+                    "privileged containers are disabled on this node "
+                    "(plugin config allow_privileged)"
+                )
+            argv += ["--privileged"]
+        allowed = {
+            c.strip().upper()
+            for c in str(
+                self.plugin_config.get("allow_caps", DEFAULT_ALLOWED_CAPS)
+            ).split(",")
+            if c.strip()
+        }
+        for cap in cfg.get("cap_add") or []:
+            cap_u = str(cap).upper()
+            if "ALL" not in allowed and cap_u not in allowed:
+                raise DockerConfigError(
+                    f"cap_add {cap_u} is not in the allowed capability list"
+                )
+            argv += ["--cap-add", cap_u]
+        for cap in cfg.get("cap_drop") or []:
+            argv += ["--cap-drop", str(cap).upper()]
+        for opt in cfg.get("security_opt") or []:
+            argv += ["--security-opt", str(opt)]
+        for k, v in (cfg.get("storage_opt") or {}).items():
+            argv += ["--storage-opt", f"{k}={v}"]
+        if cfg.get("readonly_rootfs"):
+            argv += ["--read-only"]
+        for k, v in (cfg.get("sysctl") or {}).items():
+            argv += ["--sysctl", f"{k}={v}"]
+        for name, lim in (cfg.get("ulimit") or {}).items():
+            lim = str(lim)
+            try:
+                # negatives are legal (-1 = unlimited, e.g. memlock)
+                parts = [int(p) for p in lim.split(":")]
+            except ValueError:
+                parts = []
+            if not 1 <= len(parts) <= 2:
+                raise DockerConfigError(
+                    f"ulimit {name} must be 'soft[:hard]' numbers, got {lim!r}"
+                )
+            argv += ["--ulimit", f"{name}={lim}"]
+
+        # -- networking -------------------------------------------------
+        if cfg.get("network_mode"):
+            argv += ["--network", str(cfg["network_mode"])]
+        for alias in cfg.get("network_aliases") or []:
+            argv += ["--network-alias", str(alias)]
+        if cfg.get("ipv4_address"):
+            argv += ["--ip", str(cfg["ipv4_address"])]
+        if cfg.get("ipv6_address"):
+            argv += ["--ip6", str(cfg["ipv6_address"])]
+        for server in cfg.get("dns_servers") or []:
+            argv += ["--dns", str(server)]
+        for domain in cfg.get("dns_search_domains") or []:
+            argv += ["--dns-search", str(domain)]
+        for opt in cfg.get("dns_options") or []:
+            argv += ["--dns-option", str(opt)]
+        for host in cfg.get("extra_hosts") or []:
+            if ":" not in str(host):
+                raise DockerConfigError(
+                    f"extra_hosts entry {host!r} must be 'hostname:ip'"
+                )
+            argv += ["--add-host", str(host)]
+
+        # port publishing: task port labels → container ports (the
+        # reference's port_map + publishedPorts; host ports come from
+        # NetworkIndex's per-node assignment, never from the jobspec)
+        port_map = cfg.get("port_map") or {}
+        ports = {}
+        for net in task.resources.networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                ports[p.label] = p.value
+        for label, container_port in port_map.items():
+            host_port = ports.get(label)
+            if host_port is None:
+                raise DockerConfigError(
+                    f"port_map references undeclared port label {label!r}"
+                )
+            if not host_port:
+                # an unassigned dynamic port (value 0) would let docker
+                # bind an arbitrary host port Nomad doesn't advertise
+                raise DockerConfigError(
+                    f"port label {label!r} has no assigned host port"
+                )
+            argv += ["-p", f"{host_port}:{container_port}"]
+
+        # -- storage ----------------------------------------------------
+        for volume in cfg.get("volumes") or []:
+            argv += ["-v", str(volume)]
+        if cfg.get("volume_driver"):
+            argv += ["--volume-driver", str(cfg["volume_driver"])]
+        for m in cfg.get("mounts") or []:
+            m = dict(m or {})
+            mtype = str(m.get("type", "volume"))
+            if mtype not in ("bind", "volume", "tmpfs"):
+                raise DockerConfigError(
+                    f"mount type {mtype!r} must be bind|volume|tmpfs"
+                )
+            target = m.get("target")
+            if not target:
+                raise DockerConfigError("mount requires a target")
+            parts = [f"type={mtype}", f"target={target}"]
+            if m.get("source"):
+                parts.append(f"source={m['source']}")
+            elif mtype == "bind":
+                raise DockerConfigError("bind mount requires a source")
+            if m.get("readonly"):
+                parts.append("readonly")
+            argv += ["--mount", ",".join(parts)]
+        for d in cfg.get("devices") or []:
+            d = dict(d or {})
+            host_path = d.get("host_path")
+            if not host_path:
+                raise DockerConfigError("device requires host_path")
+            # docker's spec is host[:container[:perms]]; permissions
+            # require the container path, which defaults to the host path
+            # (a requested permission must never silently widen to rwm)
+            container_path = d.get("container_path") or (
+                str(host_path) if d.get("cgroup_permissions") else ""
+            )
+            spec = str(host_path)
+            if container_path:
+                spec += f":{container_path}"
+                if d.get("cgroup_permissions"):
+                    perms = str(d["cgroup_permissions"])
+                    if not (perms and set(perms) <= set("rwm")):
+                        raise DockerConfigError(
+                            f"device cgroup_permissions {perms!r} must be "
+                            "drawn from 'rwm'"
+                        )
+                    spec += f":{perms}"
+            argv += ["--device", spec]
+
+        # -- logging / misc ---------------------------------------------
+        logging_cfg = cfg.get("logging") or {}
+        log_driver = logging_cfg.get("driver") or logging_cfg.get("type")
+        if log_driver:
+            argv += ["--log-driver", str(log_driver)]
+            for k, v in (logging_cfg.get("config") or {}).items():
+                argv += ["--log-opt", f"{k}={v}"]
+        for k, v in (cfg.get("labels") or {}).items():
+            argv += ["--label", f"{k}={v}"]
+        if cfg.get("interactive"):
+            argv += ["-i"]
+        if cfg.get("tty"):
+            argv += ["-t"]
+        for k, v in (task.env or {}).items():
+            argv += ["-e", f"{k}={v}"]
+
+        # --entrypoint takes one binary; extra entrypoint elements become
+        # the leading container args (the CLI shape of config.go's list)
+        entrypoint = cfg.get("entrypoint")
+        ep_rest: list = []
+        if entrypoint:
+            if isinstance(entrypoint, str):
+                entrypoint = [entrypoint]
+            argv += ["--entrypoint", str(entrypoint[0])]
+            ep_rest = [str(e) for e in entrypoint[1:]]
+
+        argv.append(str(cfg["image"]))
+        argv += ep_rest
+        if cfg.get("command"):
+            argv.append(str(cfg["command"]))
+        argv += [str(a) for a in cfg.get("args", [])]
+        return argv
 
     def _supervise(self, handle: TaskHandle, container: str, task_dir: str):
         """Wait for exit + follow logs into the task log files (the
