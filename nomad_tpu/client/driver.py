@@ -66,6 +66,8 @@ class Driver:
     """Driver plugin interface (ref plugins/drivers/driver.go)."""
 
     name = "driver"
+    #: class-level read-only default; set_config rebinds per instance
+    plugin_config: dict = {}
 
     def fingerprint(self) -> dict:
         """Returns {detected, healthy, attributes}."""
@@ -558,6 +560,7 @@ class ExecDriver(RawExecDriver):
     name = "exec"
 
     def __init__(self):
+        super().__init__()
         self._nsexec = None
         self._healthy = False
         try:
@@ -569,6 +572,35 @@ class ExecDriver(RawExecDriver):
         except Exception:
             self._healthy = False
         self._sweep_stale_cgroups()
+
+    def config_schema(self) -> dict:
+        return {
+            # node-wide default for tasks that don't set their own
+            # seccomp stanza ("default" turns filtering on fleet-wide)
+            "default_seccomp": {"type": "string", "default": "off"},
+        }
+
+    def set_config(self, config: dict):
+        # a typo'd node-wide profile must fail HERE (one clear SetConfig
+        # error), not at every subsequent task start
+        profile = config.get("default_seccomp", "off")
+        if profile not in ("default", "off"):
+            raise ValueError(
+                f"default_seccomp must be default|off, got {profile!r}"
+            )
+        super().set_config(config)
+
+    def handle_data(self, handle: TaskHandle) -> dict:
+        data = super().handle_data(handle)
+        data["seccomp"] = getattr(handle, "_seccomp", "off")
+        return data
+
+    def recover_task(self, task: Task, data: dict) -> Optional[TaskHandle]:
+        handle = super().recover_task(task, data)
+        if handle is not None:
+            # exec-into-task after a client restart still applies the filter
+            handle._seccomp = data.get("seccomp", "off")
+        return handle
 
     @staticmethod
     def _sweep_stale_cgroups():
@@ -642,8 +674,24 @@ class ExecDriver(RawExecDriver):
                 args += ["--memory-mb", str(task.resources.memory_mb)]
             if task.resources.cpu:
                 args += ["--cpu-shares", str(task.resources.cpu)]
+        # syscall filtering (SURVEY §2.9; ref libcontainer's seccomp
+        # profile): task config seccomp = "default"|"off", defaulting to
+        # the plugin config's default_seccomp (off unless configured)
+        profile = cfg.get(
+            "seccomp", self.plugin_config.get("default_seccomp", "off")
+        )
+        if profile not in ("default", "off"):
+            raise RuntimeError(
+                f"exec seccomp profile must be default|off, got {profile!r}"
+            )
+        if profile == "default":
+            args += ["--seccomp", "default"]
         args += ["--", command] + list(cfg.get("args", []))
-        return self._spawn(task, args, None, log_base=task_dir)
+        handle = self._spawn(task, args, None, log_base=task_dir)
+        # exec_streaming must re-apply the task's filter when it joins the
+        # namespaces; recovery restores it from handle_data
+        handle._seccomp = profile
+        return handle
 
     def exec_streaming(
         self,
@@ -665,7 +713,12 @@ class ExecDriver(RawExecDriver):
         child = _first_child(handle.pid)
         if child is None:
             raise ValueError("task namespace init not found")
-        argv = [self._nsexec, "--enter", str(child), "--"] + list(cmd)
+        argv = [self._nsexec, "--enter", str(child)]
+        if getattr(handle, "_seccomp", "off") == "default":
+            # the exec'd process inherits the task's syscall filter — an
+            # unfiltered shell inside a filtered sandbox defeats the point
+            argv += ["--seccomp", "default"]
+        argv += ["--"] + list(cmd)
         return ExecProcess(
             argv,
             env={"PATH": "/usr/bin:/bin:/usr/local/bin", **(env or {})},

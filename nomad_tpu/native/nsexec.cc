@@ -11,7 +11,16 @@
 // usage:
 //   nsexec --check                     exit 0 iff isolation is available
 //   nsexec [--workdir D] [--hostname H] [--cgroup NAME] [--chroot D]
-//          [--memory-mb N] [--cpu-shares N] -- cmd [args...]
+//          [--memory-mb N] [--cpu-shares N] [--seccomp default]
+//          -- cmd [args...]
+//
+// --seccomp default installs a fixed-BPF syscall denylist (no libseccomp;
+// the reference gets this via libcontainer's vendored seccomp profile):
+// container-escape and host-tamper vectors (mount family, module loading,
+// reboot, kexec, raw io ports, clock setting, bpf, userfaultfd, ...)
+// return EPERM inside the task while everything else proceeds normally.
+// Applied with PR_SET_NO_NEW_PRIVS immediately before exec, after all
+// shepherd-side setup (which itself needs mount/sethostname).
 //
 // --chroot pivots the task into D after read-only bind-mounting the
 // default chroot env (/bin /usr /lib ... — the reference's
@@ -28,20 +37,223 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
 #include <sched.h>
 #include <signal.h>
+#include <stddef.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mount.h>
 #include <sys/prctl.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
 static const int SHEPHERD_ERR = 125;
 static pid_t task_pid = -1;
+
+// ---------------------------------------------------------------------------
+// seccomp: fixed-BPF denylist (SURVEY §2.9; the reference vendors
+// libseccomp via libcontainer — a hand-built cBPF program needs no
+// library and the profile is static anyway)
+// ---------------------------------------------------------------------------
+
+#if defined(__x86_64__)
+#define NSEXEC_AUDIT_ARCH AUDIT_ARCH_X86_64
+#elif defined(__aarch64__)
+#define NSEXEC_AUDIT_ARCH AUDIT_ARCH_AARCH64
+#elif defined(__i386__)
+#define NSEXEC_AUDIT_ARCH AUDIT_ARCH_I386
+#else
+#define NSEXEC_AUDIT_ARCH 0
+#endif
+
+// syscalls denied under --seccomp default: kernel/host tampering and
+// container-escape vectors (docker's default-profile denials that matter
+// for an already-namespaced task). Guarded per-arch: a number missing on
+// this architecture simply isn't filtered.
+static const long DENIED_SYSCALLS[] = {
+#ifdef __NR_mount
+    __NR_mount,
+#endif
+#ifdef __NR_umount2
+    __NR_umount2,
+#endif
+#ifdef __NR_pivot_root
+    __NR_pivot_root,
+#endif
+#ifdef __NR_chroot
+    __NR_chroot,
+#endif
+#ifdef __NR_init_module
+    __NR_init_module,
+#endif
+#ifdef __NR_finit_module
+    __NR_finit_module,
+#endif
+#ifdef __NR_delete_module
+    __NR_delete_module,
+#endif
+#ifdef __NR_kexec_load
+    __NR_kexec_load,
+#endif
+#ifdef __NR_kexec_file_load
+    __NR_kexec_file_load,
+#endif
+#ifdef __NR_reboot
+    __NR_reboot,
+#endif
+#ifdef __NR_swapon
+    __NR_swapon,
+#endif
+#ifdef __NR_swapoff
+    __NR_swapoff,
+#endif
+#ifdef __NR_settimeofday
+    __NR_settimeofday,
+#endif
+#ifdef __NR_clock_settime
+    __NR_clock_settime,
+#endif
+#ifdef __NR_clock_adjtime
+    __NR_clock_adjtime,
+#endif
+#ifdef __NR_adjtimex
+    __NR_adjtimex,
+#endif
+#ifdef __NR_iopl
+    __NR_iopl,
+#endif
+#ifdef __NR_ioperm
+    __NR_ioperm,
+#endif
+#ifdef __NR_acct
+    __NR_acct,
+#endif
+#ifdef __NR_quotactl
+    __NR_quotactl,
+#endif
+#ifdef __NR_bpf
+    __NR_bpf,
+#endif
+#ifdef __NR_userfaultfd
+    __NR_userfaultfd,
+#endif
+#ifdef __NR_perf_event_open
+    __NR_perf_event_open,
+#endif
+#ifdef __NR_open_by_handle_at
+    __NR_open_by_handle_at,
+#endif
+#ifdef __NR_add_key
+    __NR_add_key,
+#endif
+#ifdef __NR_request_key
+    __NR_request_key,
+#endif
+#ifdef __NR_keyctl
+    __NR_keyctl,
+#endif
+#ifdef __NR_ptrace
+    __NR_ptrace,
+#endif
+#ifdef __NR_process_vm_readv
+    __NR_process_vm_readv,
+#endif
+#ifdef __NR_process_vm_writev
+    __NR_process_vm_writev,
+#endif
+#ifdef __NR_setns
+    __NR_setns,
+#endif
+#ifdef __NR_unshare
+    __NR_unshare,
+#endif
+#ifdef __NR_mknod
+    __NR_mknod,
+#endif
+#ifdef __NR_mknodat
+    __NR_mknodat,
+#endif
+#ifdef __NR_nfsservctl
+    __NR_nfsservctl,
+#endif
+#ifdef __NR_personality
+    __NR_personality,
+#endif
+#ifdef __NR_vhangup
+    __NR_vhangup,
+#endif
+};
+
+#define N_DENIED (sizeof(DENIED_SYSCALLS) / sizeof(DENIED_SYSCALLS[0]))
+
+#ifndef SECCOMP_RET_KILL_PROCESS
+#define SECCOMP_RET_KILL_PROCESS SECCOMP_RET_KILL
+#endif
+
+// Build and install: ARCH check, then one JEQ → RET ERRNO(EPERM) per
+// denied number, default ALLOW. Denials return EPERM (not SIGKILL) so a
+// task probing a denied call sees a normal error, matching the
+// reference profile's errno action.
+static int install_seccomp(void) {
+  if (NSEXEC_AUDIT_ARCH == 0) {
+    fprintf(stderr, "nsexec: seccomp unsupported on this architecture\n");
+    return -1;
+  }
+  // 3 arch-check + 1 nr-load + 2 x32-guard + 2 per denial + 1 default-allow
+  struct sock_filter prog[7 + 2 * N_DENIED];
+  size_t n = 0;
+  // [0] load arch, kill on mismatch (a foreign-arch syscall table would
+  // make every JEQ below meaningless)
+  prog[n++] = (struct sock_filter)BPF_STMT(
+      BPF_LD | BPF_W | BPF_ABS, offsetof(struct seccomp_data, arch));
+  prog[n++] = (struct sock_filter)BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                                           NSEXEC_AUDIT_ARCH, 1, 0);
+  prog[n++] = (struct sock_filter)BPF_STMT(BPF_RET | BPF_K,
+                                           SECCOMP_RET_KILL_PROCESS);
+  // [1] load the syscall number once
+  prog[n++] = (struct sock_filter)BPF_STMT(
+      BPF_LD | BPF_W | BPF_ABS, offsetof(struct seccomp_data, nr));
+#if defined(__x86_64__)
+  // x32 ABI syscalls (__X32_SYSCALL_BIT set) report AUDIT_ARCH_X86_64 but
+  // use different numbers — without this guard every denial below is
+  // bypassable via syscall(0x40000000|nr). Same hole docker's default
+  // profile closes.
+  prog[n++] = (struct sock_filter)BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K,
+                                           0x40000000u, 0, 1);
+  prog[n++] = (struct sock_filter)BPF_STMT(
+      BPF_RET | BPF_K, SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA));
+#endif
+  for (size_t d = 0; d < N_DENIED; d++) {
+    prog[n++] = (struct sock_filter)BPF_JUMP(
+        BPF_JMP | BPF_JEQ | BPF_K, (unsigned)DENIED_SYSCALLS[d], 0, 1);
+    prog[n++] = (struct sock_filter)BPF_STMT(
+        BPF_RET | BPF_K, SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA));
+  }
+  prog[n++] = (struct sock_filter)BPF_STMT(BPF_RET | BPF_K,
+                                           SECCOMP_RET_ALLOW);
+
+  struct sock_fprog fprog;
+  fprog.len = (unsigned short)n;
+  fprog.filter = prog;
+  // required for an unprivileged process to install a filter; also the
+  // right hardening default for task workloads
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) {
+    fprintf(stderr, "nsexec: no_new_privs: %s\n", strerror(errno));
+    return -1;
+  }
+  if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog) != 0) {
+    fprintf(stderr, "nsexec: seccomp: %s\n", strerror(errno));
+    return -1;
+  }
+  return 0;
+}
 
 static int write_file(const char *path, const char *value) {
   int fd = open(path, O_WRONLY);
@@ -306,7 +518,8 @@ static void join_target_cgroups(pid_t target) {
   fclose(f);
 }
 
-static int enter_namespaces(pid_t target, char **cmd) {
+static int enter_namespaces(pid_t target, char **cmd,
+                            const char *seccomp_profile) {
   const char *names[] = {"mnt", "ipc", "uts", "pid"};
   int fds[4];
   char path[64];
@@ -333,6 +546,12 @@ static int enter_namespaces(pid_t target, char **cmd) {
   if (pid == 0) {
     // mnt join already switched root/cwd to the target's; stay at /
     if (chdir("/") != 0) { /* best effort */ }
+    // an exec'd process must inherit the task's filter (the reference
+    // exec path inherits the container's seccomp profile) — otherwise
+    // `nomad alloc exec` is an unfiltered shell inside the sandbox
+    if (seccomp_profile != NULL && strcmp(seccomp_profile, "default") == 0) {
+      if (install_seccomp() != 0) _exit(SHEPHERD_ERR);
+    }
     execvp(cmd[0], cmd);
     fprintf(stderr, "nsexec: exec %s: %s\n", cmd[0], strerror(errno));
     _exit(127);
@@ -363,6 +582,7 @@ int main(int argc, char **argv) {
   const char *hostname = "nomad-task";
   const char *cgroup = NULL;
   const char *chroot_dir = NULL;
+  const char *seccomp_profile = NULL;
   long memory_mb = 0;
   long cpu_shares = 0;
   int i = 1;
@@ -396,6 +616,14 @@ int main(int argc, char **argv) {
       memory_mb = atol(argv[++i]);
     } else if (strcmp(argv[i], "--cpu-shares") == 0 && i + 1 < argc) {
       cpu_shares = atol(argv[++i]);
+    } else if (strcmp(argv[i], "--seccomp") == 0 && i + 1 < argc) {
+      seccomp_profile = argv[++i];
+      if (strcmp(seccomp_profile, "default") != 0 &&
+          strcmp(seccomp_profile, "off") != 0) {
+        fprintf(stderr, "nsexec: unknown seccomp profile %s\n",
+                seccomp_profile);
+        return SHEPHERD_ERR;
+      }
     } else if (strcmp(argv[i], "--") == 0) {
       i++;
       break;
@@ -411,7 +639,7 @@ int main(int argc, char **argv) {
   char **cmd = &argv[i];
 
   if (enter_pid > 0) {
-    return enter_namespaces((pid_t)enter_pid, cmd);
+    return enter_namespaces((pid_t)enter_pid, cmd, seccomp_profile);
   }
 
   if (cgroup != NULL) setup_cgroups(cgroup, memory_mb, cpu_shares);
@@ -466,6 +694,11 @@ int main(int argc, char **argv) {
       _exit(SHEPHERD_ERR);
     }
     prctl(PR_SET_PDEATHSIG, SIGKILL);
+    // last setup step before exec: the filter survives execve and the
+    // shepherd-side mount/sethostname above stay unfiltered
+    if (seccomp_profile != NULL && strcmp(seccomp_profile, "default") == 0) {
+      if (install_seccomp() != 0) _exit(SHEPHERD_ERR);
+    }
     execvp(cmd[0], cmd);
     fprintf(stderr, "nsexec: exec %s: %s\n", cmd[0], strerror(errno));
     _exit(SHEPHERD_ERR);
