@@ -66,8 +66,11 @@ class Driver:
     """Driver plugin interface (ref plugins/drivers/driver.go)."""
 
     name = "driver"
-    #: class-level read-only default; set_config rebinds per instance
-    plugin_config: dict = {}
+
+    def __init__(self):
+        # per-instance: callers mutate in place (plugin_config.update),
+        # so a class-level shared dict would leak config across drivers
+        self.plugin_config: dict = {}
 
     def fingerprint(self) -> dict:
         """Returns {detected, healthy, attributes}."""
@@ -158,8 +161,8 @@ class MockDriver(Driver):
     name = "mock_driver"
 
     def __init__(self):
+        super().__init__()
         self._timers: dict[int, threading.Timer] = {}
-        self.plugin_config: dict = {}
 
     def config_schema(self) -> dict:
         """ref drivers/mock config options (subset), exercised by the

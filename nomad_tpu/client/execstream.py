@@ -178,13 +178,13 @@ def bridge_exec(proc: ExecProcess, stream) -> None:
     frames flow out on a writer thread while this thread consumes input
     frames. A peer disconnect kills the process (the reference cancels the
     exec when the stream drops)."""
-    from ..rpc.mux import StreamClosed
+    from ..rpc.mux import StreamClosed, StreamError
 
     def writer():
         try:
             for frame in proc.output_frames():
                 stream.send(frame)
-        except (StreamClosed, TimeoutError):
+        except (StreamClosed, StreamError, TimeoutError):
             proc.kill()
 
     wt = threading.Thread(target=writer, daemon=True, name="exec-out")
@@ -198,6 +198,11 @@ def bridge_exec(proc: ExecProcess, stream) -> None:
                 # stdin EOF for the process (an interactive `cat` must
                 # exit now, not hang on an open pipe)
                 proc.close_stdin()
+                break
+            except StreamError:
+                # peer ABORTED (websocket dropped mid-session): the exec
+                # is cancelled, not ended — kill rather than EOF
+                proc.kill()
                 break
             except TimeoutError:
                 proc.kill()

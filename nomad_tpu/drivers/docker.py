@@ -168,6 +168,7 @@ class DockerDriver(Driver):
     name = "docker"
 
     def __init__(self, binary: str = ""):
+        super().__init__()
         self._docker = binary or shutil.which("docker")
         self._version = ""
         self._healthy = False
@@ -175,7 +176,6 @@ class DockerDriver(Driver):
             self._version = self._probe_version()
             self._healthy = bool(self._version)
         self.coordinator = ImageCoordinator(self)
-        self.plugin_config: dict = {}
 
     def config_schema(self) -> dict:
         return {

@@ -23,6 +23,7 @@ class JavaDriver(RawExecDriver):
     name = "java"
 
     def __init__(self, binary: str = ""):
+        super().__init__()
         self._java = binary or shutil.which("java")
         self._version = ""
         if self._java:

@@ -28,6 +28,7 @@ class QemuDriver(RawExecDriver):
     name = "qemu"
 
     def __init__(self, binary: str = ""):
+        super().__init__()
         self._qemu = binary or next(
             (p for b in QEMU_BINARIES if (p := shutil.which(b))), None
         )

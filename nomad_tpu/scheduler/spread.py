@@ -105,9 +105,15 @@ class SpreadIterator:
                         if self.sum_spread_weights
                         else float("nan")
                     )
-                    boost = (
-                        (desired_count - float(used_count)) / desired_count
-                    ) * spread_weight
+                    if desired_count == 0:
+                        # Go float division: (0-used)/0 = -Inf (used ≥ 1
+                        # here) — a 0% target class is effectively never
+                        # chosen while any other option exists
+                        boost = float("-inf") * spread_weight
+                    else:
+                        boost = (
+                            (desired_count - float(used_count)) / desired_count
+                        ) * spread_weight
                     total_spread_score += boost
 
             if total_spread_score != 0.0:

@@ -653,10 +653,15 @@ class TestExecSeccomp:
         if platform.machine() != "x86_64":
             pytest.skip("x32 guard is x86_64-specific")
         driver = ExecDriver()
+        # must fail specifically with EPERM (the filter's errno action):
+        # asserting only r == -1 would false-pass via EFAULT from the
+        # NULL args even with the x32 guard removed
         code = (
-            "import ctypes; libc = ctypes.CDLL(None, use_errno=True); "
+            "import ctypes, errno, sys; "
+            "libc = ctypes.CDLL(None, use_errno=True); "
             "r = libc.syscall(0x40000000 + 165, 0, 0, 0, 0, 0); "  # mount
-            "import sys; sys.exit(0 if r == -1 else 1)"
+            "e = ctypes.get_errno(); "
+            "sys.exit(0 if (r == -1 and e == errno.EPERM) else 1)"
         )
         task = Task(
             name="x32",

@@ -1,0 +1,229 @@
+"""Feasibility corpus ported from the reference
+(scheduler/feasible_test.go — cited per case): constraint operand tables,
+lexical/version/regexp checks, distinct_hosts/distinct_property iterator
+semantics including counts and escaped constraints, and the feasibility
+wrapper's escape caching."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    check_constraint,
+    check_lexical_order,
+    check_regexp_match,
+    check_set_contains_any,
+    check_version_match,
+)
+from nomad_tpu.structs.model import Constraint, Plan
+from test_scheduler import run_eval, setup_harness
+
+
+def ctx_for(h):
+    return EvalContext(h.state.snapshot(), Plan(), rng=None)
+
+
+class TestCheckConstraintPort:
+    """ref TestCheckConstraint (feasible_test.go:533)."""
+
+    CASES = [
+        ("=", "foo", True, "foo", True, True),
+        ("is", "foo", True, "foo", True, True),
+        ("==", "foo", True, "foo", True, True),
+        ("==", "foo", True, None, False, False),
+        ("==", None, False, "foo", True, False),
+        ("==", None, False, None, False, False),
+        ("!=", "foo", True, "foo", True, False),
+        ("!=", "foo", True, "bar", True, True),
+        ("!=", None, False, "foo", True, True),
+        ("!=", "foo", True, None, False, True),
+        ("!=", None, False, None, False, False),
+        ("not", "foo", True, "bar", True, True),
+        ("version", "1.2.3", True, "~> 1.0", True, True),
+        ("version", None, False, "~> 1.0", True, False),
+        ("regexp", "foobarbaz", True, r"[\w]+", True, True),
+        ("regexp", None, False, r"[\w]+", True, False),
+        ("<", "foo", True, "bar", True, False),
+        ("<", "bar", True, "foo", True, True),
+    ]
+
+    @pytest.mark.parametrize("op,l,lf,r,rf,expect", CASES)
+    def test_case(self, op, l, lf, r, rf, expect):
+        h, _ = setup_harness(1)
+        assert check_constraint(ctx_for(h), op, l, r, lf, rf) == expect
+
+
+class TestCheckLexicalOrderPort:
+    """ref TestCheckLexicalOrder (feasible_test.go:670)."""
+
+    CASES = [
+        ("<", "bar", "foo", True),
+        ("<=", "foo", "foo", True),
+        (">", "bar", "foo", False),
+        (">=", "bar", "bar", True),
+        (">", 1, "foo", False),
+    ]
+
+    @pytest.mark.parametrize("op,l,r,expect", CASES)
+    def test_case(self, op, l, r, expect):
+        assert check_lexical_order(op, l, r) == expect
+
+
+class TestCheckVersionPort:
+    """ref TestCheckVersionConstraint (feasible_test.go:710)."""
+
+    CASES = [
+        ("1.2.3", "~> 1.0", True),
+        ("1.2.3", ">= 1.0, < 1.4", True),
+        ("2.0.1", "~> 1.0", False),
+        ("1.4", ">= 1.0, < 1.4", False),
+        (1, "~> 1.0", True),
+    ]
+
+    @pytest.mark.parametrize("l,r,expect", CASES)
+    def test_case(self, l, r, expect):
+        h, _ = setup_harness(1)
+        assert check_version_match(ctx_for(h), l, r) == expect
+
+
+class TestCheckRegexpPort:
+    """ref TestCheckRegexpConstraint (feasible_test.go:745)."""
+
+    CASES = [
+        ("foobar", "bar", True),
+        ("foobar", "^foo", True),
+        ("foobar", "^bar", False),
+        ("zipzap", "foo", False),
+        (1, "foo", False),
+    ]
+
+    @pytest.mark.parametrize("l,r,expect", CASES)
+    def test_case(self, l, r, expect):
+        h, _ = setup_harness(1)
+        assert check_regexp_match(ctx_for(h), l, r) == expect
+
+
+class TestSetContainsAnyPort:
+    """ref TestSetContainsAny (feasible_test.go:1891)."""
+
+    CASES = [
+        ("a", "a", True),
+        ("a,b", "a", True),
+        ("a,b", "a,c", True),
+        ("a", "b", False),
+    ]
+
+    @pytest.mark.parametrize("l,r,expect", CASES)
+    def test_case(self, l, r, expect):
+        assert check_set_contains_any(l, r) == expect
+
+
+class TestDistinctPropertyPort:
+    def _rack_nodes(self, h, racks):
+        nodes = []
+        for rack in racks:
+            n = mock.node()
+            n.meta["rack"] = rack
+            nodes.append(n)
+            h.state.upsert_node(h.next_index(), n)
+        return nodes
+
+    def test_distinct_property_count_allows_n_per_value(self):
+        """ref TestDistinctPropertyIterator_JobDistinctProperty_Count: a
+        count argument allows N allocs per property value."""
+        h, _ = setup_harness(0)
+        self._rack_nodes(h, ["r1", "r1", "r2", "r2"])
+        job = mock.job()
+        job.task_groups[0].count = 4
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.constraints.append(
+            Constraint(
+                operand="distinct_property",
+                l_target="${meta.rack}",
+                r_target="2",
+            )
+        )
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 4
+        by_rack: dict = {}
+        for a in out:
+            rack = h.state.node_by_id(a.node_id).meta["rack"]
+            by_rack[rack] = by_rack.get(rack, 0) + 1
+        assert by_rack == {"r1": 2, "r2": 2}
+
+    def test_distinct_property_infeasible_count(self):
+        """ref ..._JobDistinctProperty_Infeasible_Count: asking for more
+        than values*count placements leaves the rest failed."""
+        h, _ = setup_harness(0)
+        self._rack_nodes(h, ["r1", "r2"])
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.constraints.append(
+            Constraint(
+                operand="distinct_property",
+                l_target="${meta.rack}",
+                r_target="1",
+            )
+        )
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 2
+        assert "web" in sched.failed_tg_allocs
+
+    def test_distinct_property_remove_and_replace(self):
+        """ref ..._JobDistinctProperty_RemoveAndReplace: stopping the only
+        alloc on a value frees the slot for a replacement."""
+        h, _ = setup_harness(0)
+        nodes = self._rack_nodes(h, ["r1"])
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.constraints.append(
+            Constraint(
+                operand="distinct_property",
+                l_target="${meta.rack}",
+                r_target="1",
+            )
+        )
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 1
+        # stop it, then re-evaluate: the rack slot must be reusable
+        stopped = out[0].copy()
+        stopped.desired_status = "stop"
+        h.state.upsert_allocs(h.next_index(), [stopped])
+        sched, _ = run_eval(h, job)
+        running = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == "run"
+        ]
+        assert len(running) == 1
+
+    def test_distinct_hosts_task_group_scope(self):
+        """ref TestDistinctHostsIterator_TaskGroupDistinctHosts: the
+        constraint at GROUP level dedups within the group only."""
+        h, _ = setup_harness(2)
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 2
+        tg.tasks[0].resources.networks = []
+        tg.constraints.append(Constraint(operand="distinct_hosts"))
+        # a second group without the constraint may reuse those hosts
+        tg2 = tg.copy()
+        tg2.name = "web2"
+        tg2.constraints = []
+        job.task_groups.append(tg2)
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        g1 = [a for a in out if a.task_group == "web"]
+        assert len(g1) == 2
+        assert len({a.node_id for a in g1}) == 2, "distinct within the group"
+        g2 = [a for a in out if a.task_group == "web2"]
+        assert len(g2) == 2

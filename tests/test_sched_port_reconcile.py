@@ -1,0 +1,380 @@
+"""Reconciler-unit corpus ported from the reference
+(scheduler/reconcile_test.go — cited per test). Drives AllocReconciler
+directly with the Go suite's stub update functions (ignore / destructive
+/ inplace), asserting the same desired-change shapes."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import AllocReconciler
+from nomad_tpu.structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_RUN,
+    DeploymentTaskGroupState,
+    DeploymentStatus,
+    TaskState,
+    UpdateStrategy,
+    generate_uuid,
+    now_ns,
+)
+
+MINUTE_NS = 60 * 1_000_000_000
+SECOND_NS = 1_000_000_000
+
+# the Go suite's stub update functions (reconcile_test.go:36-60)
+def update_ignore(existing, new_job, new_tg):
+    return True, False, None
+
+
+def update_destructive(existing, new_job, new_tg):
+    return False, True, None
+
+
+def update_inplace(existing, new_job, new_tg):
+    return False, False, existing
+
+
+def service_job(count=10):
+    job = mock.job()
+    job.task_groups[0].count = count
+    return job
+
+
+def allocs_for(job, n, node_prefix="node", name_start=0):
+    out = []
+    for i in range(name_start, name_start + n):
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.namespace = job.namespace
+        a.node_id = f"{node_prefix}-{i}"
+        a.name = f"{job.id}.web[{i}]"
+        a.client_status = ALLOC_CLIENT_STATUS_RUNNING
+        out.append(a)
+    return out
+
+
+def reconcile(job, allocs, update_fn=update_ignore, tainted=None,
+              deployment=None, batch=False):
+    r = AllocReconciler(
+        update_fn, batch, job.id if job else "job", job, deployment,
+        allocs, tainted or {}, generate_uuid(),
+    )
+    return r.compute()
+
+
+def assert_results(results, place=0, destructive=0, inplace=0, stop=0,
+                   create_deployment=None):
+    assert len(results.place) == place, f"place {len(results.place)}"
+    assert len(results.destructive_update) == destructive
+    assert len(results.inplace_update) == inplace
+    assert len(results.stop) == stop
+    if create_deployment is not None:
+        assert (results.deployment is not None) == create_deployment
+
+
+class TestReconcilerPlacePort:
+    def test_place_no_existing(self):
+        """ref TestReconciler_Place_NoExisting."""
+        job = service_job()
+        results = reconcile(job, [])
+        assert_results(results, place=10)
+        assert results.desired_tg_updates["web"].place == 10
+
+    def test_place_existing(self):
+        """ref TestReconciler_Place_Existing: 5 running → 5 more."""
+        job = service_job()
+        allocs = allocs_for(job, 5)
+        results = reconcile(job, allocs)
+        assert_results(results, place=5)
+        assert results.desired_tg_updates["web"].ignore == 5
+
+    def test_scale_down_partial(self):
+        """ref TestReconciler_ScaleDown_Partial: 20 → 10 stops 10."""
+        job = service_job()
+        allocs = allocs_for(job, 20)
+        results = reconcile(job, allocs)
+        assert_results(results, stop=10)
+        assert results.desired_tg_updates["web"].stop == 10
+
+    def test_scale_down_zero(self):
+        """ref TestReconciler_ScaleDown_Zero."""
+        job = service_job(count=0)
+        allocs = allocs_for(job, 20)
+        results = reconcile(job, allocs)
+        assert_results(results, stop=20)
+
+    def test_scale_down_zero_duplicate_names(self):
+        """ref TestReconciler_ScaleDown_Zero_DuplicateNames: duplicated
+        name indexes still all stop."""
+        job = service_job(count=0)
+        allocs = []
+        for i in range(20):
+            a = allocs_for(job, 1, name_start=i % 2)[0]
+            a.id = generate_uuid()
+            a.node_id = f"node-{i}"
+            allocs.append(a)
+        results = reconcile(job, allocs)
+        assert_results(results, stop=20)
+
+    def test_inplace(self):
+        """ref TestReconciler_Inplace: all 10 updated in place."""
+        job = service_job()
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_inplace)
+        assert_results(results, inplace=10)
+
+    def test_inplace_scale_up(self):
+        """ref TestReconciler_Inplace_ScaleUp: 10 inplace + 5 place."""
+        job = service_job(count=15)
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_inplace)
+        assert_results(results, place=5, inplace=10)
+
+    def test_inplace_scale_down(self):
+        """ref TestReconciler_Inplace_ScaleDown: 20 → 5 inplace + 15 stop."""
+        job = service_job(count=5)
+        allocs = allocs_for(job, 20)
+        results = reconcile(job, allocs, update_fn=update_inplace)
+        assert_results(results, inplace=5, stop=15)
+
+    def test_destructive(self):
+        """ref TestReconciler_Destructive: all 10 destructively updated."""
+        job = service_job()
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_destructive)
+        assert_results(results, destructive=10)
+
+    def test_destructive_scale_up(self):
+        """ref TestReconciler_Destructive_ScaleUp."""
+        job = service_job(count=15)
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_destructive)
+        assert_results(results, place=5, destructive=10)
+
+    def test_destructive_scale_down(self):
+        """ref TestReconciler_Destructive_ScaleDown: 20 → 5 destructive +
+        15 stop."""
+        job = service_job(count=5)
+        allocs = allocs_for(job, 20)
+        results = reconcile(job, allocs, update_fn=update_destructive)
+        assert_results(results, destructive=5, stop=15)
+
+
+class TestReconcilerTaintPort:
+    def _tainted(self, allocs, n, down=True):
+        tainted = {}
+        for i in range(n):
+            node = mock.node()
+            node.id = allocs[i].node_id
+            if down:
+                node.status = "down"
+            else:
+                node.drain = True
+                allocs[i].desired_transition.migrate = True
+            tainted[node.id] = node
+        return tainted
+
+    def test_lost_node(self):
+        """ref TestReconciler_LostNode: 2 lost → 2 stop + 2 place."""
+        job = service_job()
+        allocs = allocs_for(job, 10)
+        tainted = self._tainted(allocs, 2, down=True)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert_results(results, place=2, stop=2)
+        upd = results.desired_tg_updates["web"]
+        assert upd.ignore == 8
+
+    def test_lost_node_scale_up(self):
+        """ref TestReconciler_LostNode_ScaleUp: lost + scale 10→15."""
+        job = service_job(count=15)
+        allocs = allocs_for(job, 10)
+        tainted = self._tainted(allocs, 2, down=True)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert_results(results, place=7, stop=2)
+
+    def test_lost_node_scale_down(self):
+        """ref TestReconciler_LostNode_ScaleDown: 10 allocs scaling to 5
+        with 2 lost — the lost ones count toward the reduction, so 5 stops
+        total and no replacements."""
+        job = service_job(count=5)
+        allocs = allocs_for(job, 10)
+        tainted = self._tainted(allocs, 2, down=True)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert_results(results, stop=5)
+        upd = results.desired_tg_updates["web"]
+        assert upd.ignore == 5
+
+    def test_drain_node(self):
+        """ref TestReconciler_DrainNode: 2 draining → migrate both."""
+        job = service_job()
+        allocs = allocs_for(job, 10)
+        tainted = self._tainted(allocs, 2, down=False)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert_results(results, place=2, stop=2)
+        upd = results.desired_tg_updates["web"]
+        assert upd.migrate == 2
+        # migrated placements carry previous_alloc linkage
+        for p in results.place:
+            assert p.previous_alloc is not None
+
+    def test_drain_node_scale_up(self):
+        """ref TestReconciler_DrainNode_ScaleUp."""
+        job = service_job(count=15)
+        allocs = allocs_for(job, 10)
+        tainted = self._tainted(allocs, 2, down=False)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert_results(results, place=7, stop=2)
+
+    def test_drain_node_scale_down(self):
+        """ref TestReconciler_DrainNode_ScaleDown: 20 → 5 with 3 draining;
+        the drain stops count toward the scale-down."""
+        job = service_job(count=5)
+        allocs = allocs_for(job, 20)
+        tainted = self._tainted(allocs, 3, down=False)
+        results = reconcile(job, allocs, tainted=tainted)
+        assert len(results.place) == 0
+        assert len(results.stop) == 15
+
+
+class TestReconcilerJobStatePort:
+    def test_removed_tg(self):
+        """ref TestReconciler_RemovedTG: allocs of a removed group stop,
+        the new group fills."""
+        job = service_job()
+        allocs = allocs_for(job, 10)
+        job = job.copy()
+        job.task_groups[0].name = "web2"
+        results = reconcile(job, allocs)
+        assert_results(results, place=10, stop=10)
+
+    def test_job_stopped(self):
+        """ref TestReconciler_JobStopped."""
+        job = service_job()
+        job.stop = True
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs)
+        assert_results(results, stop=10)
+
+    def test_job_stopped_terminal_allocs(self):
+        """ref TestReconciler_JobStopped_TerminalAllocs: nothing to do."""
+        job = service_job()
+        job.stop = True
+        allocs = allocs_for(job, 10)
+        for a in allocs:
+            a.desired_status = "stop"
+        results = reconcile(job, allocs)
+        assert_results(results, stop=0)
+
+    def test_multi_tg(self):
+        """ref TestReconciler_MultiTG: second group fills independently."""
+        job = service_job()
+        tg2 = job.task_groups[0].copy()
+        tg2.name = "web2"
+        job.task_groups.append(tg2)
+        allocs = allocs_for(job, 2)
+        results = reconcile(job, allocs)
+        assert_results(results, place=18)
+
+
+class TestReconcilerDeploymentPort:
+    def _deployment_job(self, canaries=0, max_parallel=4):
+        job = service_job()
+        job.task_groups[0].update = UpdateStrategy(
+            max_parallel=max_parallel,
+            canary=canaries,
+            health_check="checks",
+            min_healthy_time=10 * SECOND_NS,
+            healthy_deadline=10 * MINUTE_NS,
+        )
+        return job
+
+    def test_rolling_upgrade_destructive_creates_deployment(self):
+        """ref TestReconciler_CreateDeployment_RollingUpgrade_Destructive."""
+        job = self._deployment_job()
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_destructive)
+        assert results.deployment is not None
+        state = results.deployment.task_groups["web"]
+        assert state.desired_total == 10
+        assert len(results.destructive_update) == 4  # max_parallel
+
+    def test_no_changes_no_deployment(self):
+        """ref TestReconciler_DontCreateDeployment_NoChanges."""
+        job = self._deployment_job()
+        allocs = allocs_for(job, 10)
+        results = reconcile(job, allocs, update_fn=update_ignore)
+        assert results.deployment is None
+        assert_results(results)
+
+    def _active_deployment(self, job, promoted=False, status="running"):
+        dep = mock.deployment()
+        dep.job_id = job.id
+        dep.namespace = job.namespace
+        dep.job_create_index = job.create_index
+        dep.job_modify_index = job.job_modify_index
+        dep.status = status
+        dep.task_groups["web"] = DeploymentTaskGroupState(
+            promoted=promoted, desired_total=10,
+        )
+        return dep
+
+    @pytest.mark.parametrize("status", ["paused", "failed"])
+    def test_paused_or_failed_no_more_canaries(self, status):
+        """ref TestReconciler_PausedOrFailedDeployment_NoMoreCanaries."""
+        job = self._deployment_job(canaries=2)
+        dep = self._active_deployment(job, status=status)
+        dep.task_groups["web"].desired_canaries = 2
+        allocs = allocs_for(job, 10)
+        results = reconcile(
+            job, allocs, update_fn=update_destructive, deployment=dep
+        )
+        assert len(results.place) == 0, "no canaries while paused/failed"
+
+    @pytest.mark.parametrize("status", ["paused", "failed"])
+    def test_paused_or_failed_no_more_placements(self, status):
+        """ref TestReconciler_PausedOrFailedDeployment_NoMorePlacements:
+        scale-up placements wait for the deployment."""
+        job = self._deployment_job()
+        job.task_groups[0].count = 15
+        dep = self._active_deployment(job, status=status)
+        allocs = allocs_for(job, 10)
+        results = reconcile(
+            job, allocs, update_fn=update_ignore, deployment=dep
+        )
+        assert len(results.place) == 0
+
+    @pytest.mark.parametrize("status", ["paused", "failed"])
+    def test_paused_or_failed_no_more_destructive(self, status):
+        """ref TestReconciler_PausedOrFailedDeployment_NoMoreDestructiveUpdates."""
+        job = self._deployment_job()
+        dep = self._active_deployment(job, status=status)
+        allocs = allocs_for(job, 10)
+        results = reconcile(
+            job, allocs, update_fn=update_destructive, deployment=dep
+        )
+        assert len(results.destructive_update) == 0
+
+    def test_dont_reschedule_previously_rescheduled(self):
+        """ref TestReconciler_DontReschedule_PreviouslyRescheduled: an
+        alloc whose replacement exists (next_allocation set) isn't
+        rescheduled again."""
+        job = service_job(count=2)
+        allocs = allocs_for(job, 2)
+        now = now_ns()
+        allocs[0].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[0].task_states = {
+            "web": TaskState(
+                state="dead", failed=True,
+                started_at=now - 3600 * SECOND_NS,
+                finished_at=now - 10 * SECOND_NS,
+            )
+        }
+        allocs[0].next_allocation = allocs[1].id
+        results = reconcile(job, allocs)
+        # a fresh placement fills the name, but NOT as a reschedule of the
+        # already-replaced alloc
+        for p in results.place:
+            assert p.previous_alloc is None or p.previous_alloc.id != allocs[0].id

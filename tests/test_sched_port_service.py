@@ -1,0 +1,651 @@
+"""Service-scheduler corpus ported from the reference
+(scheduler/generic_sched_test.go — cited per test). Each case drives the
+scalar oracle through the Harness exactly like the Go tests drive
+NewServiceScheduler; kernel-eligible cases are additionally run through
+tpu-batch by tests/test_sched_port_tpu.py reusing these scenario builders.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    Constraint,
+    DeploymentStatus,
+    EphemeralDisk,
+    Evaluation,
+    ReschedulePolicy,
+    Spread,
+    SpreadTarget,
+    TaskState,
+    UpdateStrategy,
+    generate_uuid,
+    now_ns,
+)
+from test_scheduler import make_eval, run_eval, setup_harness
+
+MINUTE_NS = 60 * 1_000_000_000
+SECOND_NS = 1_000_000_000
+
+
+def running_alloc(job, node, i):
+    a = mock.alloc()
+    a.job = job
+    a.job_id = job.id
+    a.namespace = job.namespace
+    a.node_id = node.id
+    a.name = f"{job.id}.web[{i}]"
+    a.client_status = ALLOC_CLIENT_STATUS_RUNNING
+    return a
+
+
+def planned_allocs(plan):
+    return [a for allocs in plan.node_allocation.values() for a in allocs]
+
+
+def stopped_allocs(plan):
+    return [a for allocs in plan.node_update.values() for a in allocs]
+
+
+class TestSpreadPort:
+    @pytest.mark.parametrize("i", range(10))
+    def test_spread_target_progression(self, i):
+        """ref TestServiceSched_Spread: dc1 percent walks 100→10; the
+        planned distribution must match exactly."""
+        start = 100 - i * 10
+        h, _ = setup_harness(0)
+        node_map = {}
+        for k in range(10):
+            n = mock.node()
+            if k % 2 == 0:
+                n.datacenter = "dc2"
+            node_map[n.id] = n
+            h.state.upsert_node(h.next_index(), n)
+
+        job = mock.job()
+        job.datacenters = ["dc1", "dc2"]
+        tg = job.task_groups[0]
+        tg.count = 10
+        tg.tasks[0].resources.networks = []
+        tg.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=100,
+                spread_target=[
+                    SpreadTarget(value="dc1", percent=start),
+                    SpreadTarget(value="dc2", percent=100 - start),
+                ],
+            )
+        ]
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert plan.annotations is None
+        assert len(h.create_evals) == 0
+        by_dc: dict = {}
+        for node_id, allocs in plan.node_allocation.items():
+            dc = node_map[node_id].datacenter
+            by_dc[dc] = by_dc.get(dc, 0) + len(allocs)
+        assert sum(by_dc.values()) == 10
+        expected = {"dc1": 10 - i}
+        if i > 0:
+            expected["dc2"] = i
+        assert by_dc == expected
+        assert h.evals[-1].status == "complete"
+
+    def test_even_spread(self):
+        """ref TestServiceSched_EvenSpread: no targets → even split."""
+        h, _ = setup_harness(0)
+        node_map = {}
+        for k in range(10):
+            n = mock.node()
+            if k % 2 == 0:
+                n.datacenter = "dc2"
+            node_map[n.id] = n
+            h.state.upsert_node(h.next_index(), n)
+        job = mock.job()
+        job.datacenters = ["dc1", "dc2"]
+        tg = job.task_groups[0]
+        tg.count = 10
+        tg.tasks[0].resources.networks = []
+        tg.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        plan = h.plans[0]
+        by_dc: dict = {}
+        for node_id, allocs in plan.node_allocation.items():
+            dc = node_map[node_id].datacenter
+            by_dc[dc] = by_dc.get(dc, 0) + len(allocs)
+        assert by_dc == {"dc1": 5, "dc2": 5}
+
+
+class TestRegisterPort:
+    def test_count_zero(self):
+        """ref TestServiceSched_JobRegister_CountZero."""
+        h, _ = setup_harness(10)
+        job = mock.job()
+        job.task_groups[0].count = 0
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        assert len(planned_allocs(h.plans[0])) == 0 if h.plans else True
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 0
+
+    def test_alloc_fail_reports_queued(self):
+        """ref TestServiceSched_JobRegister_AllocFail: no nodes → failed
+        tg metrics + blocked eval + queued count."""
+        h = setup_harness(0)[0]
+        job = mock.job()
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        assert len(h.plans) == 0
+        assert "web" in sched.failed_tg_allocs
+        m = sched.failed_tg_allocs["web"]
+        assert m.nodes_evaluated == 0
+        assert m.coalesced_failures == 9
+        assert sched.queued_allocs.get("web") == 10
+        assert len(h.create_evals) == 1
+        assert h.create_evals[0].status == "blocked"
+
+    def test_feasible_and_infeasible_tg(self):
+        """ref TestServiceSched_JobRegister_FeasibleAndInfeasibleTG: the
+        feasible group places, the infeasible one reports failures."""
+        h, _ = setup_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources.networks = []
+        web2 = job.task_groups[0].copy()
+        web2.name = "web2"
+        web2.tasks[0].driver = "missing-driver"
+        job.task_groups.append(web2)
+        h.state.upsert_job(h.next_index(), job)
+        sched, _ = run_eval(h, job)
+        assert len(h.plans) == 1
+        assert len(planned_allocs(h.plans[0])) == 2
+        assert set(sched.failed_tg_allocs) == {"web2"}
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 2
+
+    def test_sticky_allocs(self):
+        """ref TestServiceSched_JobRegister_StickyAllocs: sticky disk makes
+        the destructive replacement prefer the previous node."""
+        h, nodes = setup_harness(10)
+        job = mock.job()
+        job.task_groups[0].ephemeral_disk = EphemeralDisk(
+            size_mb=150, sticky=True
+        )
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        placed = {
+            a.name: a.node_id
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+        }
+        assert len(placed) == 10
+
+        # destructive update (command change)
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = dict(
+            job2.task_groups[0].tasks[0].config or {}, command="/bin/other"
+        )
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        replaced = {
+            a.name: a.node_id
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == ALLOC_DESIRED_STATUS_RUN
+        }
+        assert len(replaced) == 10
+        same = sum(1 for k in placed if replaced.get(k) == placed[k])
+        assert same == 10, "sticky disk must keep every alloc on its node"
+
+
+class TestJobModifyPort:
+    def _registered(self, count=10, nodes=10):
+        h, node_list = setup_harness(nodes)
+        job = mock.job()
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        # allocs embed the STORED job copy (upsert stamps raft indexes;
+        # the Go tests get this for free from pointer mutation)
+        job = h.state.job_by_id(job.namespace, job.id)
+        allocs = [
+            running_alloc(job, node_list[i % len(node_list)], i)
+            for i in range(count)
+        ]
+        h.state.upsert_allocs(h.next_index(), allocs)
+        return h, job, allocs
+
+    def test_job_modify_destructive_all(self):
+        """ref TestServiceSched_JobModify: all 10 stopped + 10 placed."""
+        h, job, allocs = self._registered()
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = dict(
+            job2.task_groups[0].tasks[0].config or {}, command="/bin/other"
+        )
+        # bump the version marker the diff uses
+        job2.job_modify_index = job.job_modify_index + 1
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 10
+        assert len(planned_allocs(plan)) == 10
+
+    def test_job_modify_count_zero(self):
+        """ref TestServiceSched_JobModify_CountZero: everything stops."""
+        h, job, allocs = self._registered()
+        job2 = job.copy()
+        job2.task_groups[0].count = 0
+        job2.job_modify_index = job.job_modify_index + 1
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 10
+        assert len(planned_allocs(plan)) == 0
+
+    def test_job_modify_in_place(self):
+        """ref TestServiceSched_JobModify_InPlace: a non-destructive change
+        updates in place — no evictions, no new placements."""
+        h, job, allocs = self._registered()
+        # a new version of the identical job (the Go test re-registers
+        # mock.Job() with the same fields): nothing destructive, so every
+        # alloc refreshes in place. NOTE job/group/task meta changes ARE
+        # destructive (util.go:389 CombinedTaskMeta).
+        job2 = job.copy()
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 0
+        # in-place updates ride plan.node_allocation with preserved ids
+        updated = planned_allocs(plan)
+        assert len(updated) == 10
+        assert {a.id for a in updated} == {a.id for a in allocs}
+
+    def test_job_modify_rolling(self):
+        """ref TestServiceSched_JobModify_Rolling: max_parallel bounds the
+        destructive batch and a deployment is created."""
+        h, job, allocs = self._registered()
+        job2 = job.copy()
+        job2.task_groups[0].update = UpdateStrategy(
+            max_parallel=4,
+            health_check="checks",
+            min_healthy_time=10 * SECOND_NS,
+            healthy_deadline=10 * MINUTE_NS,
+        )
+        job2.task_groups[0].tasks[0].config = dict(
+            job2.task_groups[0].tasks[0].config or {}, command="/bin/other"
+        )
+        job2.job_modify_index = job.job_modify_index + 1
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 4
+        assert len(planned_allocs(plan)) == 4
+        assert plan.deployment is not None
+        state = plan.deployment.task_groups["web"]
+        assert state.desired_total == 10
+
+    def test_job_modify_canaries(self):
+        """ref TestServiceSched_JobModify_Canaries: canary count placed,
+        nothing evicted, deployment tracks the canaries."""
+        h, job, allocs = self._registered()
+        desired = 2
+        job2 = job.copy()
+        job2.task_groups[0].update = UpdateStrategy(
+            max_parallel=desired,
+            canary=desired,
+            health_check="checks",
+            min_healthy_time=10 * SECOND_NS,
+            healthy_deadline=10 * MINUTE_NS,
+        )
+        job2.task_groups[0].tasks[0].config = dict(
+            job2.task_groups[0].tasks[0].config or {}, command="/bin/other"
+        )
+        job2.job_modify_index = job.job_modify_index + 1
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 0
+        placed = planned_allocs(plan)
+        assert len(placed) == desired
+        for canary in placed:
+            assert canary.deployment_status is not None
+            assert canary.deployment_status.canary
+        assert plan.deployment is not None
+        state = plan.deployment.task_groups["web"]
+        assert state.desired_total == 10
+        assert state.desired_canaries == desired
+        assert len(state.placed_canaries) == desired
+        # the eval is annotated with the deployment
+        assert h.evals[0].deployment_id
+
+    def test_cancel_deployment_stopped_job(self):
+        """ref TestServiceSched_CancelDeployment_Stopped: stopping the job
+        cancels its active deployment."""
+        h, _ = setup_harness(10)
+        job = mock.job()
+        job.job_modify_index = 300
+        job.stop = True
+        h.state.upsert_job(h.next_index(), job)
+        dep = mock.deployment()
+        dep.job_id = job.id
+        dep.namespace = job.namespace
+        dep.job_create_index = job.create_index
+        dep.job_modify_index = job.job_modify_index - 1
+        h.state.upsert_deployment(h.next_index(), dep)
+        run_eval(h, job, triggered_by="job-deregister")
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(plan.deployment_updates) == 1
+        upd = plan.deployment_updates[0]
+        assert upd.deployment_id == dep.id
+        assert upd.status == "cancelled"
+
+    def test_cancel_deployment_newer_job(self):
+        """ref TestServiceSched_CancelDeployment_NewerJob: a deployment for
+        an older job version is cancelled on the next eval."""
+        h, _ = setup_harness(10)
+        job = mock.job()
+        job.task_groups[0].count = 0
+        h.state.upsert_job(h.next_index(), job)
+        dep = mock.deployment()
+        dep.job_id = job.id
+        dep.namespace = job.namespace
+        dep.job_create_index = job.create_index
+        dep.job_modify_index = job.job_modify_index - 10  # older version
+        h.state.upsert_deployment(h.next_index(), dep)
+        run_eval(h, job)
+        assert len(h.plans) == 1
+        upds = h.plans[0].deployment_updates
+        assert len(upds) == 1 and upds[0].status == "cancelled"
+
+
+class TestDeregisterPort:
+    def test_deregister_purged(self):
+        """ref TestServiceSched_JobDeregister_Purged: all allocs stopped."""
+        h, nodes = setup_harness(10)
+        job = mock.job()
+        allocs = [running_alloc(job, nodes[i], i) for i in range(10)]
+        h.state.upsert_allocs(h.next_index(), allocs)
+        # job purged from state: scheduler sees job=None
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            priority=50,
+            type=job.type,
+            triggered_by="job-deregister",
+            job_id=job.id,
+            status="pending",
+        )
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("service", ev)
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 10
+        assert h.evals[-1].status == "complete"
+
+    def test_deregister_stopped(self):
+        """ref TestServiceSched_JobDeregister_Stopped: stop=True job."""
+        h, nodes = setup_harness(10)
+        job = mock.job()
+        job.stop = True
+        h.state.upsert_job(h.next_index(), job)
+        allocs = [running_alloc(job, nodes[i], i) for i in range(10)]
+        h.state.upsert_allocs(h.next_index(), allocs)
+        run_eval(h, job, triggered_by="job-deregister")
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 10
+
+
+class TestNodeEventPort:
+    def _with_allocs(self, count=10):
+        h, nodes = setup_harness(count)
+        job = mock.job()
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        job = h.state.job_by_id(job.namespace, job.id)
+        allocs = [running_alloc(job, nodes[i], i) for i in range(count)]
+        h.state.upsert_allocs(h.next_index(), allocs)
+        return h, job, nodes, allocs
+
+    def test_node_down_marks_lost_and_replaces(self):
+        """ref TestServiceSched_NodeDown: allocs on a down node are marked
+        lost and replaced elsewhere."""
+        h, job, nodes, allocs = self._with_allocs()
+        down = nodes[0].copy()
+        down.status = "down"
+        h.state.upsert_node(h.next_index(), down)
+        run_eval(h, job, triggered_by="node-update")
+        plan = h.plans[0]
+        stopped = stopped_allocs(plan)
+        assert len(stopped) == 1
+        assert stopped[0].id == allocs[0].id
+        assert stopped[0].client_status == "lost"
+        placed = planned_allocs(plan)
+        assert len(placed) == 1
+        assert placed[0].node_id != down.id
+
+    def test_node_drain_migrates(self):
+        """ref TestServiceSched_NodeDrain: draining node's allocs migrate
+        (stop + replacement), bounded by migrate max_parallel."""
+        h, job, nodes, allocs = self._with_allocs()
+        # drain rides its own raft transaction (state_store.go
+        # UpdateNodeDrain) — UpsertNode deliberately preserves drain
+        h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+        drained = nodes[0]
+        # the drainer marks allocs for migration (drainer.go); the
+        # scheduler acts on the transition, same as the reference test
+        marked = allocs[0].copy()
+        marked.desired_transition.migrate = True
+        h.state.upsert_allocs(h.next_index(), [marked])
+        run_eval(h, job, triggered_by="node-update")
+        plan = h.plans[0]
+        assert len(stopped_allocs(plan)) == 1
+        placed = planned_allocs(plan)
+        assert len(placed) == 1
+        assert placed[0].node_id != drained.id
+
+    def test_node_drain_down_lost(self):
+        """ref TestServiceSched_NodeDrain_Down: a draining node that dies
+        loses its allocs (client status lost, not migrate)."""
+        h, job, nodes, allocs = self._with_allocs()
+        h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+        n = nodes[0].copy()
+        n.status = "down"
+        h.state.upsert_node(h.next_index(), n)
+        run_eval(h, job, triggered_by="node-update")
+        plan = h.plans[0]
+        stopped = stopped_allocs(plan)
+        assert len(stopped) == 1
+        assert stopped[0].client_status == "lost"
+
+    def test_node_drain_queued_allocations(self):
+        """ref TestServiceSched_NodeDrain_Queued_Allocations: when the
+        replacement can't place, it shows up as queued."""
+        h, nodes = setup_harness(1)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        job = h.state.job_by_id(job.namespace, job.id)
+        allocs = [running_alloc(job, nodes[0], i) for i in range(2)]
+        for a in allocs:
+            a.desired_transition.migrate = True
+        h.state.upsert_allocs(h.next_index(), allocs)
+        h.state.update_node_drain(h.next_index(), nodes[0].id, True)
+        sched, _ = run_eval(h, job, triggered_by="node-update")
+        assert sched.queued_allocs.get("web", 0) == 2
+
+
+class TestReschedulePort:
+    def _failed_setup(self, count=2, policy=None, fail_index=1):
+        h, nodes = setup_harness(10)
+        job = mock.job()
+        job.task_groups[0].count = count
+        job.task_groups[0].tasks[0].resources.networks = []
+        if policy is not None:
+            job.task_groups[0].reschedule_policy = policy
+        h.state.upsert_job(h.next_index(), job)
+        job = h.state.job_by_id(job.namespace, job.id)
+        allocs = [running_alloc(job, nodes[i], i) for i in range(count)]
+        now = now_ns()
+        allocs[fail_index].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[fail_index].task_states = {
+            "web": TaskState(
+                state="dead",
+                failed=True,
+                started_at=now - 3600 * SECOND_NS,
+                finished_at=now,
+            )
+        }
+        h.state.upsert_allocs(h.next_index(), allocs)
+        return h, job, nodes, allocs
+
+    def test_reschedule_once_now(self):
+        """ref TestServiceSched_Reschedule_OnceNow: immediate reschedule
+        with the old node penalized and tracker carried."""
+        policy = ReschedulePolicy(
+            attempts=1,
+            interval=15 * MINUTE_NS,
+            delay=0,
+            delay_function="constant",
+        )
+        h, job, nodes, allocs = self._failed_setup(policy=policy)
+        failed = allocs[1]
+        run_eval(h, job, triggered_by="node-update")
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 3
+        new = [a for a in out if a.previous_allocation == failed.id]
+        assert len(new) == 1
+        assert new[0].node_id != failed.node_id, "penalty node avoided"
+        assert new[0].reschedule_tracker is not None
+        assert len(new[0].reschedule_tracker.events) == 1
+        # the replaced alloc points forward
+        stored = h.state.alloc_by_id(failed.id)
+        assert stored.next_allocation == new[0].id
+
+    def test_reschedule_later_creates_followup(self):
+        """ref TestServiceSched_Reschedule_Later: delayed reschedule = no
+        new alloc now, a follow-up eval at finished_at+delay, and the
+        failed alloc annotated with follow_up_eval_id."""
+        delay = 15 * SECOND_NS
+        policy = ReschedulePolicy(
+            attempts=1,
+            interval=15 * MINUTE_NS,
+            delay=delay,
+            max_delay=1 * MINUTE_NS,
+            delay_function="constant",
+        )
+        h, job, nodes, allocs = self._failed_setup(policy=policy)
+        failed = allocs[1]
+        finished = failed.task_states["web"].finished_at
+        run_eval(h, job, triggered_by="node-update")
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 2, "no replacement yet"
+        assert len(h.create_evals) == 1
+        follow = h.create_evals[0]
+        assert follow.status == "pending"
+        assert follow.wait_until == finished + delay
+        stored = h.state.alloc_by_id(failed.id)
+        assert stored.follow_up_eval_id == follow.id
+
+    def test_reschedule_multiple_now(self):
+        """ref TestServiceSched_Reschedule_MultipleNow: repeated failures
+        accumulate tracker events until attempts are exhausted."""
+        policy = ReschedulePolicy(
+            attempts=2,
+            interval=30 * MINUTE_NS,
+            delay=0,
+            delay_function="constant",
+        )
+        h, job, nodes, allocs = self._failed_setup(policy=policy)
+        failed_id = allocs[1].id
+        expected_attempts = 2
+        for attempt in range(1, expected_attempts + 1):
+            run_eval(h, job, triggered_by="node-update")
+            out = h.state.allocs_by_job(job.namespace, job.id)
+            new = [a for a in out if a.previous_allocation == failed_id]
+            assert len(new) == 1
+            replacement = new[0]
+            assert len(replacement.reschedule_tracker.events) == attempt
+            if attempt == expected_attempts:
+                break
+            # fail the replacement via the CLIENT update path — a plain
+            # UpsertAllocs preserves the stored client status
+            # (state_store.go:2093; the Go test only works because memdb
+            # hands back aliased pointers)
+            now = now_ns()
+            failed_again = replacement.copy()
+            failed_again.client_status = ALLOC_CLIENT_STATUS_FAILED
+            failed_again.task_states = {
+                "web": TaskState(
+                    state="dead",
+                    failed=True,
+                    started_at=now - 600 * SECOND_NS,
+                    finished_at=now,
+                )
+            }
+            h.state.update_allocs_from_client(
+                h.next_index(), [failed_again]
+            )
+            failed_id = failed_again.id
+
+        # a third failure is NOT rescheduled (attempts exhausted)
+        final = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if a.previous_allocation == failed_id
+        ][0]
+        now = now_ns()
+        f3 = final.copy()
+        f3.client_status = ALLOC_CLIENT_STATUS_FAILED
+        f3.task_states = {
+            "web": TaskState(
+                state="dead", failed=True,
+                started_at=now - 60 * SECOND_NS, finished_at=now,
+            )
+        }
+        h.state.update_allocs_from_client(h.next_index(), [f3])
+        before = len(h.state.allocs_by_job(job.namespace, job.id))
+        run_eval(h, job, triggered_by="node-update")
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == before
+
+
+class TestChainedPort:
+    def test_chained_alloc_ids(self):
+        """ref TestGenericSched_ChainedAlloc: destructive updates chain
+        previous_allocation ids."""
+        h, nodes = setup_harness(10)
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        first = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(first) == 10
+        first_ids = {a.id for a in first}
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = dict(
+            job2.task_groups[0].tasks[0].config or {}, command="/bin/other"
+        )
+        job2.job_modify_index = job.job_modify_index + 1
+        h.state.upsert_job(h.next_index(), job2)
+        run_eval(h, job2)
+        current = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == ALLOC_DESIRED_STATUS_RUN
+        ]
+        assert len(current) == 10
+        chained = {a.previous_allocation for a in current}
+        assert chained == first_ids
