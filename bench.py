@@ -598,11 +598,15 @@ def bench_config3(n_allocs=10000, n_nodes=1000):
     }
 
 
-def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
+def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
     """Evals/sec through the REAL server path: jobs registered against a
     running server with default_scheduler=tpu-batch and batch_drain workers,
     evals fused into multi-eval kernel batches by the broker drain
-    (worker.go:105-276 / SURVEY §2.3 north-star bridge)."""
+    (worker.go:105-276 / SURVEY §2.3 north-star bridge). Samples the plan
+    queue depth while running so worker scaling is a measured curve, not
+    an assertion (VERDICT r3 weak #6)."""
+    import threading
+
     from nomad_tpu import mock
     from nomad_tpu.core.server import Server
     from nomad_tpu.raft import InmemTransport, RaftConfig
@@ -627,7 +631,14 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
         },
     }
     server = Server(cfg)
-    server.start(num_workers=2, wait_for_leader=5.0)
+    server.start(num_workers=workers, wait_for_leader=5.0)
+    depth_samples: list[int] = []
+    stop_sampler = threading.Event()
+
+    def sampler():
+        while not stop_sampler.wait(0.05):
+            depth_samples.append(server.planner.queue.depth())
+
     try:
         for node in build_nodes(n_nodes):
             server.node_register(node)
@@ -643,6 +654,7 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
             tg.tasks[0].resources.networks = []
             jobs.append(job)
 
+        threading.Thread(target=sampler, daemon=True).start()
         t0 = time.monotonic()
         eval_ids = [server.job_register(j) for j in jobs]
         pending = set(eval_ids)
@@ -654,20 +666,27 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32):
                     pending.discard(eid)
             time.sleep(0.02)
         elapsed = time.monotonic() - t0
+        stop_sampler.set()
         placed = sum(
             len(server.state.allocs_by_job(j.namespace, j.id)) for j in jobs
         )
         return {
             "jobs": n_jobs,
             "nodes": n_nodes,
+            "workers": workers,
             "unfinished": len(pending),
             "placed": placed,
             "wall_s": round(elapsed, 3),
             "evals_per_s": round(n_jobs / elapsed, 1),
             "drain_batches": drain_mod.DRAIN_COUNTERS["batches"],
             "drain_evals": drain_mod.DRAIN_COUNTERS["evals"],
+            "plan_queue_depth_max": max(depth_samples, default=0),
+            "plan_queue_depth_mean": round(
+                sum(depth_samples) / max(len(depth_samples), 1), 2
+            ),
         }
     finally:
+        stop_sampler.set()
         server.stop()
 
 
@@ -792,6 +811,13 @@ def main():
         detail["config3"] = bench_config3()
         detail["config5"] = bench_config5()
         detail["drain"] = bench_drain()
+        # worker-scaling curve over the same real-server drain path (the
+        # 1-core bench box bounds speedup; the curve + queue depth shows
+        # WHERE the control plane saturates)
+        detail["worker_scaling"] = [
+            bench_drain(n_jobs=200, n_nodes=500, workers=w)
+            for w in (1, 2, 4)
+        ]
     e2e = headline["end_to_end_s"]
     parities = [headline["parity_exact_full"], headline["parity_oracle"]]
     detail["parity"] = round(min(parities), 5)

@@ -782,7 +782,12 @@ class HTTPServer:
                     "broker": self.server.eval_broker.stats(),
                     "blocked_evals": self.server.blocked_evals.stats(),
                 },
-                "member": {"Name": "server-1", "Status": "alive"},
+                "member": {
+                    "Name": self.server.raft.node_id,
+                    "Status": "alive",
+                    "rpc_addr": self.server.raft.address,
+                    "is_leader": self.server.raft.is_leader(),
+                },
                 "clients": clients,
             },
             None,
@@ -884,7 +889,10 @@ class HTTPServer:
 
     @route("GET", r"/v1/status/leader", acl="anonymous")
     def status_leader(self, m, query, body):
-        return f"{self.host}:{self.port}", None
+        """ref status_endpoint.go Leader: the raft leader's RPC address
+        (NOT this agent's HTTP address — any member answers with the same
+        cluster-wide value)."""
+        return self.server.leader_address() or "", None
 
     @route("GET", r"/v1/status/peers", acl="anonymous")
     def status_peers(self, m, query, body):

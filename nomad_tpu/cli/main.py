@@ -55,6 +55,89 @@ def _client(args) -> ApiClient:
     )
 
 
+def _wait_for_signals(cleanup):
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        cleanup()
+    return 0
+
+
+def _run_networked_server(args, config: dict):
+    """One real cluster member per process (ref command/agent server mode;
+    the forked-binary e2e harness spawns three of these)."""
+    from ..agent import ServerAgent
+    from ..api.http import HTTPServer
+    from ..config import server_config_from_agent
+
+    server_stanza = config.get("server", {}) or {}
+    name = config.get("name", "server-1")
+    server_cfg = server_config_from_agent(config)
+    agent = ServerAgent(
+        name,
+        bind=config.get("bind_addr", "127.0.0.1"),
+        port=int(server_stanza.get("rpc_port", 0)),
+        data_dir=(config.get("data_dir") or None),
+        config=server_cfg,
+    )
+    voters = {str(k): str(v) for k, v in server_stanza["voters"].items()}
+    agent.start(
+        voters=voters,
+        num_workers=int(server_stanza.get("num_schedulers", 2)),
+    )
+    port = args.port if args.port is not None else int(
+        config.get("ports", {}).get("http", 4646)
+    )
+    http = HTTPServer(agent.server, host=args.bind, port=port)
+    http.start()
+    print(
+        f"==> nomad-tpu server {name} started: http {http.address} "
+        f"rpc {agent.address}", flush=True,
+    )
+
+    def cleanup():
+        print("==> shutting down", flush=True)
+        http.stop()
+        agent.stop()
+
+    return _wait_for_signals(cleanup)
+
+
+def _run_networked_client(args, config: dict):
+    """A node agent connected to remote servers over RPC (ref command/agent
+    client mode)."""
+    from ..agent import ClientAgent, apply_client_config
+
+    client_stanza = config.get("client", {}) or {}
+    servers = [str(s) for s in client_stanza.get("servers", [])]
+    agent = ClientAgent(
+        servers,
+        data_dir=(config.get("data_dir") or None),
+        bind=config.get("bind_addr", "127.0.0.1"),
+    )
+
+    # reuse the stanza plumbing (host volumes, meta, plugins, vault)
+    class _Shim:
+        clients = [agent.client]
+
+    apply_client_config(_Shim, config)
+    agent.start()
+    print(
+        f"==> nomad-tpu client started: node {agent.node.id[:8]} "
+        f"servers {servers}", flush=True,
+    )
+
+    def cleanup():
+        print("==> shutting down", flush=True)
+        agent.stop()
+
+    return _wait_for_signals(cleanup)
+
+
 def cmd_agent(args):
     """ref command/agent/command.go: -dev mode, or HCL config files with
     merge semantics and SIGHUP log-level reload."""
@@ -73,6 +156,23 @@ def cmd_agent(args):
 
     config = load_agent_config(config_paths)
     apply_log_level(config)
+
+    # networked modes (the forked-binary topology of testutil/server.go:
+    # each `nomad agent` process is one real cluster member):
+    #   server { enabled, rpc_port, voters { name = "host:port" } }
+    #   client { enabled, servers = ["host:port", ...] }  (no local server)
+    server_stanza = config.get("server", {}) or {}
+    client_stanza = config.get("client", {}) or {}
+    if not args.dev and server_stanza.get("voters"):
+        return _run_networked_server(args, config)
+    if (
+        not args.dev
+        and not server_stanza.get("enabled")
+        and client_stanza.get("enabled")
+        and client_stanza.get("servers")
+    ):
+        return _run_networked_client(args, config)
+
     server_cfg = server_config_from_agent(config)
     server_cfg["name"] = config.get("name", "server-1")
     # agents prewarm the planner shape ladder by default (first-eval

@@ -81,6 +81,13 @@ class PlanQueue:
             self._cond.notify_all()
         return pending
 
+    def depth(self) -> int:
+        """Plans waiting for the applier (observability: the bench's
+        worker-scaling curve samples this to show where the control plane
+        saturates; ref plan_queue.go Stats)."""
+        with self._lock:
+            return len(self._heap)
+
     def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:

@@ -1,0 +1,206 @@
+"""Forked-binary e2e harness (ref testutil/server.go:1-28: the reference's
+TestServer forks the real nomad binary; this spawns real
+``python -m nomad_tpu agent`` processes). Catches packaging/CLI/signal
+regressions the in-process harness (tests/test_e2e.py) can't: module
+entrypoint, HCL boot path, real TCP raft between processes, and leader
+failover across OS processes."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient
+
+
+def free_ports(n):
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def wait_until(fn, timeout=45.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return
+        except Exception as e:  # servers still booting
+            last = e
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {msg} (last: {last})")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """Three server processes + one client process, torn down hard."""
+    ports = free_ports(7)
+    rpc = ports[:3]
+    http = ports[3:6]
+    names = ["s1", "s2", "s3"]
+    voters = "\n".join(
+        f'    {n} = "127.0.0.1:{p}"' for n, p in zip(names, rpc)
+    )
+    procs = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu", NOMAD_TPU_COMPILE_CACHE="off")
+    try:
+        for i, name in enumerate(names):
+            cfg = tmp_path / f"{name}.hcl"
+            cfg.write_text(f"""
+name = "{name}"
+ports {{ http = {http[i]} }}
+server {{
+  enabled = true
+  rpc_port = {rpc[i]}
+  num_schedulers = 1
+  heartbeat_ttl = 3
+  prewarm_kernels = false
+  voters {{
+{voters}
+  }}
+}}
+""")
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "nomad_tpu", "agent",
+                     "-config", str(cfg)],
+                    stdout=open(tmp_path / f"{name}.log", "wb"),
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+            )
+        apis = [ApiClient(address=f"http://127.0.0.1:{p}") for p in http]
+        wait_until(
+            lambda: any(_leader(api) for api in apis),
+            msg="leader election across processes",
+        )
+
+        client_cfg = tmp_path / "client.hcl"
+        servers = ", ".join(f'"127.0.0.1:{p}"' for p in rpc)
+        client_cfg.write_text(f"""
+name = "c1"
+data_dir = "{tmp_path / 'client-data'}"
+client {{
+  enabled = true
+  servers = [{servers}]
+}}
+""")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "nomad_tpu", "agent",
+                 "-config", str(client_cfg)],
+                stdout=open(tmp_path / "c1.log", "wb"),
+                stderr=subprocess.STDOUT,
+                env=env,
+            )
+        )
+        wait_until(
+            lambda: any(
+                n.get("Status") == "ready"
+                for api in apis
+                if _alive(api)
+                for n in api.nodes()
+            ),
+            msg="client node registers over RPC",
+        )
+        yield procs, apis
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _alive(api) -> bool:
+    try:
+        api.get("/v1/status/leader")
+        return True
+    except Exception:
+        return False
+
+
+def _leader(api):
+    try:
+        return bool(api.get("/v1/status/leader"))
+    except Exception:
+        return False
+
+
+def _run_job(apis):
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.restart_policy.attempts = 0
+    tg.restart_policy.mode = "fail"
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "echo done"]}
+    task.resources.networks = []
+    api = next(a for a in apis if _alive(a))
+    api.register_job(job.to_dict())
+
+    def complete():
+        for a in apis:
+            if not _alive(a):
+                continue
+            allocs = a.job_allocations(job.id)
+            return allocs and all(
+                al.get("ClientStatus") == "complete" for al in allocs
+            )
+        return False
+
+    wait_until(complete, msg=f"job {job.id[:8]} completes")
+    return job
+
+
+@pytest.mark.slow
+def test_three_server_cluster_survives_leader_kill(cluster):
+    procs, apis = cluster
+    # a job runs through the forked cluster
+    _run_job(apis)
+
+    # find and SIGKILL the leader PROCESS (harsher than the in-process
+    # leader-kill test: the OS process dies mid-heartbeat)
+    leader_addr = next(
+        api.get("/v1/status/leader") for api in apis if _alive(api)
+    )
+    leader_idx = None
+    for i, api in enumerate(apis):
+        try:
+            if api.get("/v1/agent/self")["member"]["is_leader"]:
+                leader_idx = i
+        except Exception:
+            pass
+    assert leader_idx is not None, f"leader {leader_addr} not found"
+    procs[leader_idx].send_signal(signal.SIGKILL)
+    procs[leader_idx].wait(timeout=10)
+
+    survivors = [api for i, api in enumerate(apis) if i != leader_idx]
+    wait_until(
+        lambda: any(
+            _leader(api) and api.get("/v1/status/leader") != leader_addr
+            for api in survivors
+        ),
+        msg="new leader elected after process kill",
+    )
+    # the cluster still schedules work
+    _run_job(survivors)
