@@ -67,7 +67,8 @@ class ApiClient:
         )
         return ExecWsSession(ws)
 
-    def _request(self, method: str, path: str, params=None, body=None):
+    def _request(self, method: str, path: str, params=None, body=None,
+                 headers=None):
         url = self.address + path
         params = dict(params or {})
         # the client's namespace rides every request unless overridden
@@ -79,6 +80,8 @@ class ApiClient:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         if self.token:
             req.add_header("X-Nomad-Token", self.token)
         try:

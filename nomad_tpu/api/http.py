@@ -270,24 +270,36 @@ class HTTPServer:
                 self._respond(404, {"error": f"no handler for {parsed.path}"}, None)
 
             def _forward_leader(self, method, err, parsed, query, body):
-                """Proxy the request to the raft leader's HTTP address,
-                resolved from its gossip tags or the static
-                ``server_http_addrs`` config map."""
+                """Proxy the request to the raft leader's HTTP address (ref
+                nomad/rpc.go:280-340 forward()). The address resolves from
+                gossip tags or static config when present, else over the
+                server RPC tier (Status.HTTPAddr at the leader's raft
+                address, which every voter knows) — so forwarding works in
+                voters-only topologies with no gossip configured."""
+                # bounded hop count: leadership can move while a forward
+                # is in flight (old leader forwards onward), but a cycle
+                # must terminate (the reference bounds forwardLeader the
+                # same way)
+                try:
+                    ttl = int(self.headers.get("X-Nomad-Forward-TTL") or 2)
+                except ValueError:
+                    ttl = 0
+                if ttl <= 0:
+                    self._respond(
+                        500,
+                        {"error": f"forwarding loop: not the leader ({err})"},
+                        None,
+                    )
+                    return
                 leader_id = getattr(err, "leader_id", None) or getattr(
                     api.server.raft, "leader_id", None
                 )
-                target = None
-                if leader_id:
-                    gossip = getattr(api.server, "gossip", None)
-                    if gossip is not None:
-                        with gossip._lock:
-                            member = gossip.members.get(leader_id)
-                        if member is not None:
-                            target = member.tags.get("http")
-                    if target is None:
-                        target = (
-                            api.server.config.get("server_http_addrs") or {}
-                        ).get(leader_id)
+                leader_rpc = getattr(err, "leader_addr", None) or (
+                    api.server.raft.leader_address()
+                )
+                target = api.server.resolve_server_http_addr(
+                    leader_id, leader_rpc
+                )
                 if not target:
                     self._respond(
                         500,
@@ -305,11 +317,18 @@ class HTTPServer:
                     "?" + parsed.query if parsed.query else ""
                 )
                 try:
-                    payload, index = proxy._request(method, path, body=body)
+                    payload, index = proxy._request(
+                        method, path, body=body,
+                        headers={"X-Nomad-Forward-TTL": str(ttl - 1)},
+                    )
                     self._respond(200, payload, index)
                 except APIError as e:
                     self._respond(e.status, {"error": str(e)}, None)
                 except Exception as e:
+                    # a stale address (peer restarted onto a new HTTP
+                    # port) must not wedge forwarding forever — quarantine
+                    # it so the next resolution consults the live sources
+                    api.server.forget_server_http_addr(leader_rpc, target)
                     self._respond(
                         500, {"error": f"leader forward failed: {e}"}, None
                     )
@@ -775,9 +794,23 @@ class HTTPServer:
         clients = []
         if self.agent is not None:
             clients = [c.node.id for c in getattr(self.agent, "clients", [])]
+
+        def jsonable(v):
+            try:
+                json.dumps(v)
+                return True
+            except (TypeError, ValueError):
+                return False
+
         return (
             {
-                "config": {k: v for k, v in self.server.config.items()},
+                # live wiring (raft transport/log-store handles) rides in
+                # config in networked mode — serve only the plain values
+                "config": {
+                    k: v
+                    for k, v in self.server.config.items()
+                    if k != "raft" and jsonable(v)
+                },
                 "stats": {
                     "broker": self.server.eval_broker.stats(),
                     "blocked_evals": self.server.blocked_evals.stats(),

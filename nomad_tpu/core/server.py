@@ -101,6 +101,13 @@ class Server:
         self._leader_cond = threading.Condition()
         self._reaper: Optional[threading.Thread] = None
         self._gc_scheduler: Optional[threading.Thread] = None
+        #: this server's advertised HTTP address (set by HTTPServer.start
+        #: via advertise_http); served to peers over Status.HTTPAddr
+        self.http_advertise_addr: Optional[str] = None
+        #: rpc_addr → peer HTTP address learned over Status.HTTPAddr
+        self._peer_http_addrs: dict[str, str] = {}
+        #: http addr → monotonic time a proxy to it last failed
+        self._bad_http_addrs: dict[str, float] = {}
         # secret → compiled ACL, invalidated by acl table indexes in the key
         self._acl_cache: dict = {}
 
@@ -417,11 +424,90 @@ class Server:
         return out
 
     def advertise_http(self, address: str):
-        """Publish this server's HTTP address into its gossip tags so other
-        regions can forward to it."""
+        """Publish this server's HTTP address: always recorded locally (the
+        Status.HTTPAddr RPC serves it to peers, so leader forwarding works
+        in voters-only topologies) and additionally into gossip tags so
+        other regions can forward to it."""
+        self.http_advertise_addr = address
         if self.gossip is None:
             return
         self.gossip.set_tags({"http": address})
+
+    def _conn_pool(self):
+        """The server's outbound RPC pool (client-fs forwarding, exec
+        bridging, peer Status lookups), created on first use so the mTLS
+        client context attached during agent wiring is picked up."""
+        pool = getattr(self, "_outbound_pool", None)
+        if pool is None:
+            from ..rpc import ConnPool
+
+            pool = self._outbound_pool = ConnPool(
+                tls_context=getattr(self, "tls_client_context", None)
+            )
+        return pool
+
+    def resolve_server_http_addr(
+        self, server_id: Optional[str], rpc_addr: Optional[str]
+    ) -> Optional[str]:
+        """HTTP address of the peer server ``server_id``/``rpc_addr``, for
+        follower→leader request forwarding (ref nomad/rpc.go:280-340
+        forward(): the reference forwards over its server RPC connections
+        and never needs an HTTP address map — here the HTTP proxy layer
+        asks the peer for its HTTP address over that same RPC tier).
+
+        Resolution order: gossip tags and the static ``server_http_addrs``
+        config (both free, possibly absent), then a Status.HTTPAddr RPC to
+        the peer's raft/RPC address — which every server always knows from
+        its voter map, so this works with no gossip configured. RPC
+        answers are cached per rpc_addr. A failed proxy reports back via
+        ``forget_server_http_addr``, which quarantines the bad address for
+        a few seconds so a stale gossip tag / static entry / cached answer
+        can't shadow the live sources forever (a peer restarted onto a new
+        HTTP port)."""
+
+        def ok(addr):
+            if not addr:
+                return False
+            bad_at = self._bad_http_addrs.get(addr)
+            return bad_at is None or time.monotonic() - bad_at > 10.0
+
+        if server_id:
+            if self.gossip is not None:
+                with self.gossip._lock:
+                    member = self.gossip.members.get(server_id)
+                if member is not None and ok(member.tags.get("http")):
+                    return member.tags["http"]
+            static = (self.config.get("server_http_addrs") or {}).get(
+                server_id
+            )
+            if ok(static):
+                return static
+        if not rpc_addr:
+            return None
+        cached = self._peer_http_addrs.get(rpc_addr)
+        if ok(cached):
+            return cached
+        try:
+            resp = self._conn_pool().call(
+                rpc_addr, "Status.HTTPAddr", {}, timeout=5.0
+            )
+        except Exception:
+            return None
+        addr = (resp or {}).get("http_addr")
+        if addr:
+            self._peer_http_addrs[rpc_addr] = addr
+            self._bad_http_addrs.pop(addr, None)
+        return addr
+
+    def forget_server_http_addr(
+        self, rpc_addr: Optional[str], http_addr: Optional[str] = None
+    ):
+        """Record a failed proxy target: drops the RPC-learned cache entry
+        and quarantines ``http_addr`` so gossip/static sources holding the
+        same stale value are skipped on the next resolution."""
+        self._peer_http_addrs.pop(rpc_addr, None)
+        if http_addr:
+            self._bad_http_addrs[http_addr] = time.monotonic()
 
     def _reconcile_gossip_members(self):
         """On leadership: fold the current gossip view into raft membership
@@ -617,6 +703,9 @@ class Server:
         self.workers = []
         self._revoke_leadership()
         self.raft.shutdown()
+        pool = getattr(self, "_outbound_pool", None)
+        if pool is not None:
+            pool.close()
 
     def is_leader(self) -> bool:
         return self.raft.is_leader()
@@ -1459,17 +1548,12 @@ class Server:
             raise KeyError(
                 f"alloc {alloc_id} is on a node without a client RPC address"
             )
-        from ..rpc import ConnPool
-
-        pool = getattr(self, "_client_fs_pool", None)
-        if pool is None:
-            pool = self._client_fs_pool = ConnPool(
-                tls_context=getattr(self, "tls_client_context", None)
-            )
         payload = dict(
             params or {}, alloc_id=alloc_id, secret=node.secret_id
         )
-        return pool.call(addr, f"ClientFS.{method}", payload, timeout=30.0)
+        return self._conn_pool().call(
+            addr, f"ClientFS.{method}", payload, timeout=30.0
+        )
 
     def _client_rpc_target(self, alloc_id: str):
         """(client rpc addr, node secret) for the node hosting an alloc."""
@@ -1494,15 +1578,10 @@ class Server:
         reference serves via client_alloc_endpoint.go exec streaming).
         Returns the live client-side stream for the caller to bridge."""
         addr, secret = self._client_rpc_target(alloc_id)
-        from ..rpc import ConnPool
-
-        pool = getattr(self, "_client_fs_pool", None)
-        if pool is None:
-            pool = self._client_fs_pool = ConnPool(
-                tls_context=getattr(self, "tls_client_context", None)
-            )
         payload = dict(params or {}, alloc_id=alloc_id, secret=secret)
-        return pool.call_duplex(addr, "ClientAllocations.Exec", payload)
+        return self._conn_pool().call_duplex(
+            addr, "ClientAllocations.Exec", payload
+        )
 
     def reconcile_summaries(self):
         """Rebuild job summaries from the alloc table through raft

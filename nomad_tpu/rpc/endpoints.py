@@ -161,3 +161,11 @@ def register_endpoints(server, rpc) -> None:
         lambda p: {"peers": dict(server.raft.voters)},
     )
     rpc.register("Status.RaftStats", lambda p: server.raft.stats())
+    # the peer-HTTP-address lookup behind follower→leader forwarding
+    # (ref nomad/rpc.go:280-340 forward(): the reference forwards over the
+    # server RPC tier; our HTTP proxy layer resolves the leader's HTTP
+    # address over that same tier so forwarding needs no gossip/config)
+    rpc.register(
+        "Status.HTTPAddr",
+        lambda p: {"http_addr": server.http_advertise_addr},
+    )

@@ -138,11 +138,17 @@ def _alive(api) -> bool:
         return False
 
 
-def _leader(api):
+def _leader_addr(api):
+    """The leader's rpc address per this server, or None (ApiClient.get
+    returns a (payload, index) tuple — unpack the payload)."""
     try:
-        return bool(api.get("/v1/status/leader"))
+        return api.get("/v1/status/leader")[0] or None
     except Exception:
-        return False
+        return None
+
+
+def _leader(api):
+    return _leader_addr(api) is not None
 
 
 def _run_job(apis):
@@ -179,25 +185,31 @@ def test_three_server_cluster_survives_leader_kill(cluster):
     _run_job(apis)
 
     # find and SIGKILL the leader PROCESS (harsher than the in-process
-    # leader-kill test: the OS process dies mid-heartbeat)
-    leader_addr = next(
-        api.get("/v1/status/leader") for api in apis if _alive(api)
-    )
-    leader_idx = None
-    for i, api in enumerate(apis):
-        try:
-            if api.get("/v1/agent/self")["member"]["is_leader"]:
-                leader_idx = i
-        except Exception:
-            pass
-    assert leader_idx is not None, f"leader {leader_addr} not found"
+    # leader-kill test: the OS process dies mid-heartbeat). Elections can
+    # still be churning right after the job ran, so poll until some
+    # process self-reports leadership rather than sampling once.
+    found = {}
+
+    def _find_leader():
+        for i, api in enumerate(apis):
+            try:
+                if api.get("/v1/agent/self")[0]["member"]["is_leader"]:
+                    found["idx"] = i
+                    found["addr"] = _leader_addr(api)
+                    return True
+            except Exception:
+                pass
+        return False
+
+    wait_until(_find_leader, msg="a server self-reports leadership")
+    leader_idx, leader_addr = found["idx"], found["addr"]
     procs[leader_idx].send_signal(signal.SIGKILL)
     procs[leader_idx].wait(timeout=10)
 
     survivors = [api for i, api in enumerate(apis) if i != leader_idx]
     wait_until(
         lambda: any(
-            _leader(api) and api.get("/v1/status/leader") != leader_addr
+            _leader_addr(api) not in (None, leader_addr)
             for api in survivors
         ),
         msg="new leader elected after process kill",

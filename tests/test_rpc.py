@@ -158,6 +158,66 @@ class TestRpcCluster:
             for a in agents:
                 a.stop()
 
+    def test_http_write_on_follower_forwards_without_gossip(self):
+        """A follower-addressed HTTP write succeeds in a VOTERS-ONLY
+        topology (no gossip, no static server_http_addrs): the follower
+        resolves the leader's HTTP address over the server RPC tier
+        (Status.HTTPAddr at the leader's raft address — ref
+        nomad/rpc.go:280-340 forward(), which likewise needs only the
+        existing server RPC connections)."""
+        from nomad_tpu.api.client import ApiClient
+        from nomad_tpu.api.http import HTTPServer
+
+        agents = make_tcp_cluster(3)
+        https = []
+        try:
+            for a in agents:
+                h = HTTPServer(a.server, port=0)
+                h.start()
+                https.append(h)
+            leader = wait_leader(agents)
+            assert all(a.server.gossip is None for a in agents)
+            assert all(
+                not a.server.config.get("server_http_addrs") for a in agents
+            )
+
+            follower_idx = next(
+                i for i, a in enumerate(agents) if a is not leader
+            )
+            api = ApiClient(address=https[follower_idx].address)
+            job = mock.job()
+            job.task_groups[0].count = 1
+            resp = api.register_job(job.to_dict())
+            assert resp.get("EvalID")
+
+            # the write really landed: visible through the leader's state
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if leader.server.state.job_by_id(job.namespace, job.id):
+                    break
+                time.sleep(0.05)
+            assert leader.server.state.job_by_id(job.namespace, job.id)
+
+            # the learned address is cached for subsequent forwards
+            assert (
+                agents[follower_idx].server._peer_http_addrs
+            ), "Status.HTTPAddr result should be cached"
+
+            # Status.HTTPAddr itself answers with the advertised address
+            pool = ConnPool()
+            try:
+                got = pool.call(leader.address, "Status.HTTPAddr", {})
+                assert got["http_addr"] == next(
+                    h.address for h, a in zip(https, agents) if a is leader
+                )
+            finally:
+                pool.close()
+        finally:
+            for h in https:
+                h.stop()
+            for a in agents:
+                a.stop()
+
     def test_unknown_method_and_validation_errors(self):
         agents = make_tcp_cluster(1)
         pool = ConnPool()
