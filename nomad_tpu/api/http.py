@@ -1782,6 +1782,11 @@ def _alloc_stub(a: Allocation) -> dict:
         "TaskGroup": a.task_group,
         "DesiredStatus": a.desired_status,
         "ClientStatus": a.client_status,
+        "DeploymentStatus": (
+            a.deployment_status.to_dict()
+            if a.deployment_status is not None
+            else None
+        ),
         "CreateIndex": a.create_index,
         "ModifyIndex": a.modify_index,
     }

@@ -23,9 +23,20 @@ INDEX_HTML = """<!doctype html>
   nav a { color:var(--dim); text-decoration:none; margin-right:1rem;
           padding:.2rem 0; }
   nav a.active { color:var(--text); border-bottom:2px solid var(--accent); }
-  header input { margin-left:auto; background:var(--bg); color:var(--text);
+  header input { background:var(--bg); color:var(--text);
                  border:1px solid var(--line); border-radius:4px;
                  padding:.3rem .5rem; width:16rem; }
+  #search { margin-left:auto; width:14rem; }
+  .kv { display:grid; grid-template-columns:14rem 1fr; gap:.15rem .8rem;
+        background:var(--panel); border:1px solid var(--line);
+        border-radius:6px; padding:.8rem 1rem; margin-bottom:1rem; }
+  .kv dt { color:var(--dim); } .kv dd { margin:0; }
+  .panel { background:var(--panel); border:1px solid var(--line);
+           border-radius:6px; padding:.8rem 1rem; margin-bottom:1rem; }
+  .spark { vertical-align:middle; margin-right:.6rem; }
+  .sparkval { color:var(--dim); font-size:.8rem; margin-right:1.2rem; }
+  .actions button { margin-bottom:.6rem; }
+  .ok-note { color:var(--ok); } .warn-note { color:var(--warn); }
   main { padding:1rem 1.2rem; }
   table { width:100%; border-collapse:collapse; background:var(--panel);
           border:1px solid var(--line); border-radius:6px; overflow:hidden; }
@@ -78,6 +89,8 @@ INDEX_HTML = """<!doctype html>
     <a href="#/servers">Servers</a>
     <a href="#/run">Run</a>
   </nav>
+  <input id="search" placeholder="Search… (Enter)"
+         onkeydown="if(event.key==='Enter')location.hash='#/search/'+encodeURIComponent(this.value)" />
   <input id="token" placeholder="ACL token (X-Nomad-Token)" />
 </header>
 <main id="view">Loading…</main>
@@ -127,15 +140,44 @@ const routes = {
   },
   async job(id) {
     const j = await api('/v1/job/' + id);
-    let allocs = [];
+    let allocs = [], deps = [], evals = [], summary = null;
     try { allocs = await api('/v1/job/' + id + '/allocations'); } catch {}
-    return `<div class="crumb"><a href="#/jobs">jobs</a> / ${esc(j.id)}</div>` +
+    try { deps = await api('/v1/job/' + id + '/deployments'); } catch {}
+    try { evals = await api('/v1/job/' + id + '/evaluations'); } catch {}
+    try { summary = await api('/v1/job/' + id + '/summary'); } catch {}
+    let html = `<div class="crumb"><a href="#/jobs">jobs</a> / ${esc(j.id)}</div>`;
+    if (summary && summary.summary) {
+      html += '<h3>Summary</h3>' +
+        '<table><tr><th>Group</th><th>Queued</th><th>Starting</th><th>Running</th>' +
+        '<th>Complete</th><th>Failed</th><th>Lost</th></tr>' +
+        Object.entries(summary.summary).map(([g, s]) =>
+          `<tr><td>${esc(g)}</td><td>${s.queued||0}</td><td>${s.starting||0}</td>` +
+          `<td>${s.running||0}</td><td>${s.complete||0}</td><td>${s.failed||0}</td>` +
+          `<td>${s.lost||0}</td></tr>`).join('') + '</table>';
+    }
+    html += '<h3>Allocations</h3>' +
       table(['Alloc','Group','Desired','Client','Node'], allocs.map(a => ({
         id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.TaskGroup),
           badge(esc(a.DesiredStatus)), badge(esc(a.ClientStatus)),
           esc((a.NodeID||'').slice(0,8))]
-      })), '#/allocation') +
-      `<h3>Spec</h3><pre>${esc(JSON.stringify(j, null, 2))}</pre>`;
+      })), '#/allocation');
+    if (deps.length) {
+      html += '<h3>Deployments</h3>' +
+        table(['ID','Version','Status','Description'], deps.map(d => ({
+          id: d.id, cells: [esc(d.id.slice(0,8)), d.job_version,
+            badge(esc(d.status)), esc(d.status_description || '')]
+        })), '#/deployment');
+    }
+    if (evals.length) {
+      html += '<h3>Evaluations</h3>' +
+        table(['ID','Type','Triggered By','Status','Placement Failures'],
+          evals.map(e => ({
+            id: e.id, cells: [esc(e.id.slice(0,8)), esc(e.type),
+              esc(e.triggered_by), badge(esc(e.status)),
+              Object.keys(e.failed_tg_allocs || {}).length ? 'yes' : '']
+          })), '#/evaluation');
+    }
+    return html + `<h3>Spec</h3><pre>${esc(JSON.stringify(j, null, 2))}</pre>`;
   },
   async nodes() {
     const nodes = await api('/v1/nodes');
@@ -165,6 +207,25 @@ const routes = {
   async allocation(id) {
     const a = await api('/v1/allocation/' + id);
     const tasks = Object.keys(a.task_states || {});
+    // task drill-down: state, lifecycle actions, events, live stats
+    let tasksHtml = '<h3>Tasks</h3>';
+    for (const t of tasks) {
+      const ts = a.task_states[t];
+      const ev = (ts.events || []).slice(-8);
+      tasksHtml += `<div class="panel"><b>${esc(t)}</b> ${badge(esc(ts.state))}` +
+        (ts.failed ? ' <span class="err">failed</span>' : '') +
+        ` · restarts ${ts.restarts || 0}` +
+        ` <button class="ghost" onclick="taskAction('${a.id}','restart','${b64e(t)}')">Restart</button>` +
+        ` <button class="ghost" onclick="taskAction('${a.id}','signal','${b64e(t)}')">SIGINT</button>` +
+        `<div id="spark-${esc(t)}" style="margin:.5rem 0"></div>` +
+        (ev.length ? '<table><tr><th>Time</th><th>Type</th><th>Message</th></tr>' +
+          ev.map(e => `<tr><td>${new Date((e.time||0)/1e6).toLocaleTimeString()}</td>` +
+            `<td>${esc(e.type)}</td><td>${esc(e.message)}</td></tr>`).join('') +
+          '</table>' : '') + '</div>';
+    }
+    tasksHtml += `<div class="actions">
+      <button class="ghost" onclick="allocAction('${a.id}','stop')">Stop allocation</button>
+      <span id="allocout"></span></div>`;
     let logsHtml = '';
     for (const t of tasks) {
       for (const kind of ['stdout', 'stderr']) {
@@ -177,8 +238,9 @@ const routes = {
       }
     }
     const taskOpts = tasks.map(t => `<option>${esc(t)}</option>`).join('');
-    window._postRender = () => fsGo(a.id, b64e('/'));
+    window._postRender = () => { fsGo(a.id, b64e('/')); statsStart(a.id); };
     return `<div class="crumb"><a href="#/allocations">allocations</a> / ${esc(a.id.slice(0,8))}</div>` +
+      tasksHtml +
       `<h3>Exec</h3>
        <div>task <select id="termtask">${taskOpts}</select>
          <button onclick="termConnect('${a.id}')">Connect /bin/sh</button>
@@ -204,22 +266,109 @@ const routes = {
   },
   async evaluations() {
     const evals = await api('/v1/evaluations');
-    return table(['ID','Job','Type','Triggered By','Status'], evals.map(e => ({
-      id: e.id, cells: [esc(e.id.slice(0,8)), esc(e.job_id), esc(e.type),
-        esc(e.triggered_by), badge(esc(e.status))]
-    })), '#/evaluations');
+    return table(['ID','Job','Type','Triggered By','Status','Placement Failures'],
+      evals.map(e => ({
+        id: e.id, cells: [esc(e.id.slice(0,8)), esc(e.job_id), esc(e.type),
+          esc(e.triggered_by), badge(esc(e.status)),
+          Object.keys(e.failed_tg_allocs || {}).length ? 'yes' : '']
+      })), '#/evaluation');
+  },
+  async evaluation(id) {
+    const e = await api('/v1/evaluation/' + id);
+    let allocs = [];
+    try { allocs = await api('/v1/evaluation/' + id + '/allocations'); } catch {}
+    let html = `<div class="crumb"><a href="#/evaluations">evaluations</a> / ${esc(e.id.slice(0,8))}</div>` +
+      `<dl class="kv">
+        <dt>Job</dt><dd><a href="#/job/${encodeURIComponent(e.job_id)}">${esc(e.job_id)}</a></dd>
+        <dt>Type</dt><dd>${esc(e.type)}</dd>
+        <dt>Triggered by</dt><dd>${esc(e.triggered_by)}</dd>
+        <dt>Status</dt><dd>${badge(esc(e.status))} ${esc(e.status_description || '')}</dd>
+        <dt>Priority</dt><dd>${e.priority}</dd>
+        ${e.blocked_eval ? `<dt>Blocked eval</dt><dd><a href="#/evaluation/${e.blocked_eval}">${esc(e.blocked_eval.slice(0,8))}</a></dd>` : ''}
+        ${e.queued_allocations ? `<dt>Queued allocs</dt><dd>${esc(JSON.stringify(e.queued_allocations))}</dd>` : ''}
+      </dl>`;
+    const failed = e.failed_tg_allocs || {};
+    if (Object.keys(failed).length) {
+      html += '<h3 class="err">Placement failures</h3>';
+      for (const [tg, m] of Object.entries(failed)) {
+        const rows = [];
+        rows.push(['Nodes evaluated', m.nodes_evaluated]);
+        rows.push(['Nodes available', esc(JSON.stringify(m.nodes_available || {}))]);
+        for (const [cls, n] of Object.entries(m.class_filtered || {}))
+          rows.push([`Class ${esc(cls)} filtered`, n]);
+        for (const [c, n] of Object.entries(m.constraint_filtered || {}))
+          rows.push([`Constraint ${esc(c)}`, n]);
+        rows.push(['Nodes exhausted', m.nodes_exhausted]);
+        for (const [d, n] of Object.entries(m.dimension_exhausted || {}))
+          rows.push([`Dimension ${esc(d)} exhausted`, n]);
+        for (const [q, n] of Object.entries(m.quota_exhausted || {}))
+          rows.push([`Quota ${esc(q)} exhausted`, n]);
+        if (m.coalesced_failures)
+          rows.push(['Coalesced failures', m.coalesced_failures]);
+        html += `<div class="panel"><b>${esc(tg)}</b><table>` +
+          rows.filter(([,v]) => v !== undefined && v !== 0 && v !== '{}')
+            .map(([k,v]) => `<tr><td>${k}</td><td>${v}</td></tr>`).join('') +
+          '</table></div>';
+      }
+    }
+    if (allocs.length) {
+      html += '<h3>Placed allocations</h3>' +
+        table(['Alloc','Group','Desired','Client'], allocs.map(a => ({
+          id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.TaskGroup),
+            badge(esc(a.DesiredStatus)), badge(esc(a.ClientStatus))]
+        })), '#/allocation');
+    }
+    return html;
   },
   async deployments() {
     const deps = await api('/v1/deployments');
     return table(['ID','Job','Version','Status','Description'], deps.map(d => ({
-      id: d.ID, cells: [esc(d.ID.slice(0,8)), esc(d.JobID), d.JobVersion,
-        badge(esc(d.Status)), esc(d.StatusDescription || '')]
+      id: d.id, cells: [esc(d.id.slice(0,8)), esc(d.job_id), d.job_version,
+        badge(esc(d.status)), esc(d.status_description || '')]
     })), '#/deployment');
   },
   async deployment(id) {
     const d = await api('/v1/deployment/' + id);
-    return `<div class="crumb"><a href="#/deployments">deployments</a> / ${esc(id.slice(0,8))}</div>` +
-      `<pre>${esc(JSON.stringify(d, null, 2))}</pre>`;
+    let allocs = [];
+    try { allocs = await api('/v1/deployment/allocations/' + d.id); } catch {}
+    const active = d.status === 'running' || d.status === 'paused';
+    const needsPromote = Object.values(d.task_groups || {}).some(
+      s => s.desired_canaries > 0 && !s.promoted);
+    let html = `<div class="crumb"><a href="#/deployments">deployments</a> / ${esc(d.id.slice(0,8))}</div>` +
+      `<dl class="kv">
+        <dt>Job</dt><dd><a href="#/job/${encodeURIComponent(d.job_id)}">${esc(d.job_id)}</a> (version ${d.job_version})</dd>
+        <dt>Status</dt><dd>${badge(esc(d.status))} ${esc(d.status_description || '')}</dd>
+      </dl>`;
+    html += `<div class="actions">
+      <button onclick="deployAction('${d.id}','promote',{All:true})"
+        ${active && needsPromote ? '' : 'disabled'}>Promote canaries</button>
+      <button class="ghost" onclick="deployAction('${d.id}','pause',{Pause:true})"
+        ${d.status === 'running' ? '' : 'disabled'}>Pause</button>
+      <button class="ghost" onclick="deployAction('${d.id}','pause',{Pause:false})"
+        ${d.status === 'paused' ? '' : 'disabled'}>Resume</button>
+      <button class="ghost" onclick="deployAction('${d.id}','fail')"
+        ${active ? '' : 'disabled'}>Fail</button>
+      <span id="deployout"></span></div>`;
+    html += '<h3>Task groups</h3>' +
+      '<table><tr><th>Group</th><th>Promoted</th><th>Desired</th><th>Canaries</th>' +
+      '<th>Placed</th><th>Healthy</th><th>Unhealthy</th><th>Progress deadline</th></tr>' +
+      Object.entries(d.task_groups || {}).map(([g, s]) =>
+        `<tr><td>${esc(g)}</td>` +
+        `<td>${s.desired_canaries > 0 ? (s.promoted ? '<span class="ok-note">yes</span>' : '<span class="warn-note">awaiting</span>') : '-'}</td>` +
+        `<td>${s.desired_total}</td><td>${s.placed_canaries ? s.placed_canaries.length : 0}/${s.desired_canaries}</td>` +
+        `<td>${s.placed_allocs}</td><td>${s.healthy_allocs}</td><td>${s.unhealthy_allocs}</td>` +
+        `<td>${s.progress_deadline ? (s.progress_deadline / 1e9) + 's' : '-'}</td></tr>`
+      ).join('') + '</table>';
+    if (allocs.length) {
+      html += '<h3>Allocations</h3>' +
+        table(['Alloc','Group','Desired','Client','Healthy'], allocs.map(a => ({
+          id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.TaskGroup),
+            badge(esc(a.DesiredStatus)), badge(esc(a.ClientStatus)),
+            a.DeploymentStatus && a.DeploymentStatus.healthy != null
+              ? (a.DeploymentStatus.healthy ? 'yes' : 'no') : '-']
+        })), '#/allocation');
+    }
+    return html;
   },
   async services() {
     const svcs = await api('/v1/services');
@@ -230,6 +379,24 @@ const routes = {
         badge(esc(s.Status)),
         esc(Object.entries(s.Checks || {}).map(([k,v]) => k + '=' + v).join(' ') || '-')]
     })), '#/allocation');
+  },
+  async search(rawPrefix) {
+    const prefix = decodeURIComponent(rawPrefix || '');
+    if (!prefix) return '<div class="crumb">type a prefix in the search box</div>';
+    const r = await api('/v1/search', 'PUT', {Prefix: prefix, Context: 'all'});
+    const links = {jobs: '#/job/', evals: '#/evaluation/', allocs: '#/allocation/',
+                   nodes: '#/node/', deployments: '#/deployment/'};
+    let html = `<div class="crumb">search results for <b>${esc(prefix)}</b></div>`;
+    let any = false;
+    for (const [ctx, ids] of Object.entries(r.matches || {})) {
+      if (!ids || !ids.length) continue;
+      any = true;
+      html += `<h3>${esc(ctx)}${(r.truncations||{})[ctx] ? ' (truncated)' : ''}</h3>` +
+        '<table>' + ids.map(i =>
+          `<tr class="row" onclick="location.hash='${links[ctx] || '#/jobs'}${encodeURIComponent(i)}'">` +
+          `<td>${esc(i)}</td></tr>`).join('') + '</table>';
+    }
+    return any ? html : html + '<div class="crumb">no matches</div>';
   },
   async servers() {
     const m = await api('/v1/agent/members');
@@ -307,6 +474,73 @@ async function runJob() {
     out.innerHTML = `<div>Submitted: eval <code>${esc(r.EvalID || '')}</code>
       — <a href="#/job/${encodeURIComponent(job.id)}">view job</a></div>`;
   } catch (e) { out.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+}
+
+// ---- deployment + alloc lifecycle actions (ref ui deployment adapters
+// promote/fail/pause and alloc restart/signal/stop routes) ----
+async function deployAction(id, action, body) {
+  const out = document.getElementById('deployout');
+  try {
+    await api('/v1/deployment/' + action + '/' + id, 'PUT', body || {});
+    render();  // show the new deployment state
+  } catch (e) { if (out) out.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+async function taskAction(allocId, action, taskB64) {
+  const out = document.getElementById('allocout');
+  const task = b64d(taskB64);
+  try {
+    const body = action === 'signal' ? {Signal: 'SIGINT', TaskName: task}
+                                     : {TaskName: task};
+    await api(`/v1/client/allocation/${allocId}/${action}`, 'PUT', body);
+    if (out) out.innerHTML = `<span class="ok-note">${esc(action)} sent to ${esc(task)}</span>`;
+  } catch (e) { if (out) out.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+async function allocAction(allocId, action) {
+  const out = document.getElementById('allocout');
+  try {
+    await api(`/v1/allocation/${allocId}/${action}`, 'PUT', {});
+    if (out) out.innerHTML = `<span class="ok-note">${esc(action)} requested</span>`;
+  } catch (e) { if (out) out.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+
+// ---- per-task live stats sparklines (ref ui stats charts; one measure
+// per chart — CPU and memory never share an axis) ----
+let statsTimer = null;
+const statsHist = {};  // task -> {cpu: [..], rss: [..]}
+function sparkline(points, fmt) {
+  if (!points.length) return '';
+  const w = 140, h = 28, pad = 2;
+  const max = Math.max(...points, 1e-9);
+  const step = points.length > 1 ? (w - 2*pad) / (points.length - 1) : 0;
+  const ys = points.map(v => h - pad - (v / max) * (h - 2*pad));
+  const d = ys.map((y, i) => `${(pad + i*step).toFixed(1)},${y.toFixed(1)}`).join(' ');
+  return `<svg class="spark" width="${w}" height="${h}">` +
+    `<polyline points="${d}" fill="none" stroke="#5b8dee" stroke-width="2"/></svg>` +
+    `<span class="sparkval">${fmt(points[points.length-1])}</span>`;
+}
+async function statsPoll(allocId) {
+  let s;
+  try { s = await api(`/v1/client/allocation/${allocId}/stats`); }
+  catch { return; }
+  for (const [t, u] of Object.entries(s.tasks || {})) {
+    const el = document.getElementById('spark-' + t);
+    if (!el) continue;
+    const hist = statsHist[t] = statsHist[t] || {cpu: [], rss: []};
+    hist.cpu = hist.cpu.concat([u.cpu_percent || 0]).slice(-60);
+    hist.rss = hist.rss.concat([(u.rss_bytes || 0) / 1048576]).slice(-60);
+    el.innerHTML =
+      'cpu ' + sparkline(hist.cpu, v => v.toFixed(1) + '%') +
+      'mem ' + sparkline(hist.rss, v => v.toFixed(1) + ' MiB');
+  }
+}
+function statsStart(allocId) {
+  statsStop();
+  statsPoll(allocId);
+  statsTimer = setInterval(() => statsPoll(allocId), 2000);
+}
+function statsStop() {
+  if (statsTimer) { clearInterval(statsTimer); statsTimer = null; }
+  for (const k of Object.keys(statsHist)) delete statsHist[k];
 }
 
 // ---- allocation fs browser (ref ui fs routes) ----
@@ -402,6 +636,7 @@ function termClose() {
 async function render() {
   const hash = location.hash || '#/jobs';
   const [, page, id] = hash.split('/');
+  if (page !== 'allocation') statsStop();
   document.querySelectorAll('nav a').forEach(a =>
     a.classList.toggle('active', a.getAttribute('href') === '#/' + page));
   const fn = routes[page] || routes.jobs;

@@ -1,0 +1,322 @@
+"""Web UI operational-surface tests (ref ui/app/adapters/deployment.js
+promote + the job-deployment components; ui exec/fs/stats routes).
+
+The SPA is a single HTML file whose behavior is fetch calls against
+/v1/*; these tests drive the EXACT request sequences the UI issues —
+same paths, methods, and bodies as the inline handlers (deployAction,
+statsPoll, the search box, the evaluation drill-down) — so a green run
+means the buttons work end-to-end, not just that the endpoints exist.
+"""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.structs.model import (
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    UpdateStrategy,
+)
+from nomad_tpu.ui import INDEX_HTML
+
+SECOND_NS = 1_000_000_000
+
+
+def _wait(fn, timeout=20.0, interval=0.1):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+def _agent_http():
+    from nomad_tpu.agent import DevAgent
+    from nomad_tpu.api import ApiClient, HTTPServer
+
+    agent = DevAgent(num_clients=1, server_config={"seed": 11})
+    agent.start()
+    http = HTTPServer(agent.server, port=0, agent=agent)
+    http.start()
+    return agent, http, ApiClient(address=http.address)
+
+
+def _deploy_job(canary=0, run_for=60):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": run_for, "exit_code": 0}
+    tg.tasks[0].resources.networks = []
+    tg.restart_policy.attempts = 0
+    tg.reschedule_policy.attempts = 0
+    tg.reschedule_policy.unlimited = False
+    tg.update = UpdateStrategy(
+        max_parallel=2,
+        health_check="task_states",
+        min_healthy_time=int(0.3 * SECOND_NS),
+        healthy_deadline=10 * SECOND_NS,
+        progress_deadline=30 * SECOND_NS,
+        canary=canary,
+        auto_promote=False,
+    )
+    return job
+
+
+class TestUiServed:
+    def test_index_served_with_operational_controls(self):
+        agent, http, client = _agent_http()
+        try:
+            import urllib.request
+
+            html = (
+                urllib.request.urlopen(http.address + "/ui", timeout=10)
+                .read()
+                .decode()
+            )
+            assert html == INDEX_HTML
+            # the operational surfaces this round added must be wired in
+            for needle in (
+                "deployAction",  # promote/fail/pause/resume buttons
+                "Promote canaries",
+                "taskAction",  # task restart / signal
+                "statsPoll",  # live per-task stats sparklines
+                "sparkline",
+                "evaluation(id)",  # eval drill-down route
+                "Placement failures",
+                'id="search"',  # global search box
+                "'/v1/search'",
+            ):
+                assert needle in html, f"UI missing {needle!r}"
+        finally:
+            http.stop()
+            agent.stop()
+
+
+class TestUiCanaryPromote:
+    def test_canary_promote_through_ui_request_sequence(self):
+        """v0 deploys, v1 adds a canary; the UI's deployment page request
+        chain (list → detail → allocations → promote with {All:true} →
+        re-render) promotes it and the deployment completes."""
+        agent, http, client = _agent_http()
+        try:
+            job = _deploy_job()
+            agent.run_job(job)
+            _wait(
+                lambda: (
+                    d := agent.state.latest_deployment_by_job_id(
+                        job.namespace, job.id
+                    )
+                )
+                is not None
+                and d.status == DEPLOYMENT_STATUS_SUCCESSFUL
+            )
+
+            v1 = job.copy()
+            v1.task_groups[0].tasks[0].config = {"run_for": 61, "exit_code": 0}
+            v1.task_groups[0].update.canary = 1
+            agent.run_job(v1)
+
+            # the deployments LIST as the UI reads it (snake_case rows)
+            def ui_list_row():
+                rows, _ = client.get("/v1/deployments")
+                for d in rows:
+                    if d["job_id"] == job.id and any(
+                        s["desired_canaries"] > 0
+                        for s in d["task_groups"].values()
+                    ):
+                        return d
+                return None
+
+            row = _wait(ui_list_row)
+            assert row is not None, "canary deployment never listed"
+            dep_id = row["id"]
+
+            # detail page data: wait until the canary is placed + healthy,
+            # i.e. the moment the Promote button enables
+            def promotable():
+                d, _ = client.get("/v1/deployment/" + dep_id)
+                active = d["status"] in ("running", "paused")
+                needs = any(
+                    s["desired_canaries"] > 0 and not s["promoted"]
+                    for s in d["task_groups"].values()
+                )
+                healthy = all(
+                    s["healthy_allocs"] >= s["desired_canaries"]
+                    for s in d["task_groups"].values()
+                    if s["desired_canaries"] > 0
+                )
+                return d if (active and needs and healthy) else None
+
+            assert _wait(promotable), "canary never became promotable"
+
+            # the detail page also loads the deployment's allocations
+            allocs, _ = client.get("/v1/deployment/allocations/" + dep_id)
+            assert allocs and allocs[0]["JobID"] == job.id
+            # canary allocs carry DeploymentStatus for the Healthy column
+            assert any(a.get("DeploymentStatus") for a in allocs)
+
+            # deployAction('promote', {All:true}) — the button's exact call
+            out, _ = client.put(
+                "/v1/deployment/promote/" + dep_id, body={"All": True}
+            )
+            assert out["DeploymentModifyIndex"] > 0
+
+            # re-render shows the group promoted; deployment completes
+            def promoted():
+                d, _ = client.get("/v1/deployment/" + dep_id)
+                return all(
+                    s["promoted"]
+                    for s in d["task_groups"].values()
+                    if s["desired_canaries"] > 0
+                ) and d
+
+            assert _wait(promoted), "promote did not take effect"
+            final = _wait(
+                lambda: (d := client.get("/v1/deployment/" + dep_id)[0])[
+                    "status"
+                ]
+                == DEPLOYMENT_STATUS_SUCCESSFUL
+                and d,
+                timeout=30,
+            )
+            assert final, "deployment did not complete after promote"
+
+            # pause/resume buttons on a fresh deployment: v2 rollout
+            v2 = job.copy()
+            v2.task_groups[0].tasks[0].config = {"run_for": 62, "exit_code": 0}
+            agent.run_job(v2)
+            d2 = _wait(
+                lambda: (
+                    d := agent.state.latest_deployment_by_job_id(
+                        job.namespace, job.id
+                    )
+                )
+                is not None
+                and d.id != dep_id
+                and d
+            )
+            client.put(
+                "/v1/deployment/pause/" + d2.id, body={"Pause": True}
+            )
+            assert (
+                client.get("/v1/deployment/" + d2.id)[0]["status"] == "paused"
+            )
+            client.put(
+                "/v1/deployment/pause/" + d2.id, body={"Pause": False}
+            )
+            assert (
+                client.get("/v1/deployment/" + d2.id)[0]["status"] == "running"
+            )
+        finally:
+            http.stop()
+            agent.stop()
+
+
+class TestUiEvalAndSearch:
+    def test_eval_placement_failure_breakdown_and_search(self):
+        agent, http, client = _agent_http()
+        try:
+            # an unplaceable job: memory demand beyond any node
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock_driver"
+            tg.tasks[0].resources.memory_mb = 10**9
+            tg.tasks[0].resources.networks = []
+            tg.reschedule_policy.attempts = 0
+            tg.reschedule_policy.unlimited = False
+            agent.run_job(job)
+
+            # evaluations list as the UI renders it: the row must expose
+            # failed_tg_allocs so the 'Placement Failures' column lights up
+            def failed_eval():
+                evals, _ = client.get("/v1/evaluations")
+                for e in evals:
+                    if e["job_id"] == job.id and e.get("failed_tg_allocs"):
+                        return e
+                return None
+
+            row = _wait(failed_eval)
+            assert row is not None, "no eval with placement failures"
+
+            # the eval drill-down page's metric breakdown
+            ev, _ = client.get("/v1/evaluation/" + row["id"])
+            metric = ev["failed_tg_allocs"][tg.name]
+            assert metric["nodes_evaluated"] >= 1
+            assert metric.get("dimension_exhausted") or metric.get(
+                "constraint_filtered"
+            ), metric
+
+            # the search box: PUT /v1/search {Prefix, Context:'all'}
+            res, _ = client.put(
+                "/v1/search",
+                body={"Prefix": job.id[:8], "Context": "all"},
+            )
+            assert job.id in res["matches"]["jobs"]
+        finally:
+            http.stop()
+            agent.stop()
+
+
+class TestUiTaskDrilldown:
+    def test_task_states_events_and_live_stats(self):
+        agent, http, client = _agent_http()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+            task.resources.networks = []
+            agent.run_job(job)
+
+            def running_alloc():
+                allocs, _ = client.get(f"/v1/job/{job.id}/allocations")
+                for a in allocs:
+                    if a["ClientStatus"] == "running":
+                        return a
+                return None
+
+            stub = _wait(running_alloc)
+            assert stub, "alloc never ran"
+
+            # the allocation page's task panel: states + events
+            alloc, _ = client.get("/v1/allocation/" + stub["ID"])
+            states = alloc["task_states"]
+            assert states, "no task states"
+            ts = states[task.name]
+            assert ts["state"] == "running"
+            events = ts["events"]
+            assert events and all(
+                "type" in e and "message" in e and "time" in e for e in events
+            )
+            assert any(e["type"] == "Started" for e in events), events
+
+            # statsPoll's endpoint: per-task cpu/rss for the sparklines
+            stats, _ = client.get(
+                f"/v1/client/allocation/{stub['ID']}/stats"
+            )
+            usage = stats["tasks"][task.name]
+            assert "cpu_percent" in usage and "rss_bytes" in usage
+            assert usage["rss_bytes"] >= 0
+
+            # taskAction('restart'): the button's exact call
+            out, _ = client.put(
+                f"/v1/client/allocation/{stub['ID']}/restart",
+                body={"TaskName": task.name},
+            )
+            assert out["tasks"] == [task.name]
+            _wait(
+                lambda: client.get("/v1/allocation/" + stub["ID"])[0][
+                    "task_states"
+                ][task.name]["restarts"]
+                >= 1
+            )
+            restarted = client.get("/v1/allocation/" + stub["ID"])[0]
+            assert restarted["task_states"][task.name]["restarts"] >= 1
+        finally:
+            http.stop()
+            agent.stop()
