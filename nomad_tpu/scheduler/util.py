@@ -71,10 +71,12 @@ class SetStatusError(Exception):
         self.eval_status = eval_status
 
 
-def materialize_task_groups(job: Job) -> dict[str, TaskGroup]:
-    """Expand task group counts into named slots (ref util.go:22-35)."""
+def materialize_task_groups(job: Optional[Job]) -> dict[str, TaskGroup]:
+    """Expand task group counts into named slots (ref util.go:22-35; a
+    purged job arrives as None and materializes nothing, so every live
+    alloc diffs to stop)."""
     out: dict[str, TaskGroup] = {}
-    if job.stopped():
+    if job is None or job.stopped():
         return out
     for tg in job.task_groups:
         for i in range(tg.count):

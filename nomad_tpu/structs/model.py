@@ -441,7 +441,20 @@ class AllocatedTaskResources(Base):
     def add(self, other: "AllocatedTaskResources"):
         self.cpu.add(other.cpu)
         self.memory.add(other.memory)
-        self.networks.extend(other.networks)
+        # merge networks by device (ref structs.go AllocatedTaskResources.Add
+        # → NetIndex match + NetworkResource.Add): flattening a task net and
+        # a group net on the same NIC yields ONE entry with summed mbits —
+        # preemption reads networks[0] and undercounts if they stay split
+        for n in other.networks:
+            mine = next(
+                (m for m in self.networks if m.device == n.device), None
+            )
+            if mine is None:
+                self.networks.append(n.copy())
+            else:
+                mine.mbits += n.mbits
+                mine.reserved_ports = mine.reserved_ports + n.reserved_ports
+                mine.dynamic_ports = mine.dynamic_ports + n.dynamic_ports
 
     def subtract(self, other: "AllocatedTaskResources"):
         self.cpu.subtract(other.cpu)
@@ -472,8 +485,12 @@ class AllocatedResources(Base):
         c = ComparableResources(shared=AllocatedSharedResources(disk_mb=self.shared.disk_mb))
         for t in self.tasks.values():
             c.flattened.add(t)
-        # Add network resources that are at the task group level
-        c.flattened.networks.extend(self.shared.networks)
+        # Add network resources that are at the task group level, merging
+        # by device like the per-task nets (ref structs.go Comparable →
+        # Flattened.Add)
+        c.flattened.add(
+            AllocatedTaskResources(networks=self.shared.networks)
+        )
         return c
 
 

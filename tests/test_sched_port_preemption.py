@@ -1,0 +1,734 @@
+"""Preemption corpus ported from the reference
+(scheduler/preemption_test.go — cited per test): the resource-distance
+table and the full 18-case TestPreemption table, driven through the
+BinPackIterator with eviction enabled exactly the way the Go test drives
+NewBinPackIterator(ctx, static, true, priority).
+"""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.preemption import basic_resource_distance
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    RankedNode,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.attribute import Attribute
+from nomad_tpu.structs.model import (
+    AllocatedCpuResources,
+    AllocatedDeviceResource,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    ComparableResources,
+    EphemeralDisk,
+    Job,
+    NetworkResource,
+    NodeCpuResources,
+    NodeDeviceResource,
+    NodeDevice,
+    NodeDiskResources,
+    NodeMemoryResources,
+    NodeReservedResources,
+    NodeResources,
+    Plan,
+    Port,
+    RequestedDevice,
+    Resources,
+    Task,
+    TaskGroup,
+    generate_uuid,
+)
+
+
+def comparable(cpu=0, mem=0, disk=0, mbits=None):
+    nets = [NetworkResource(device="eth0", mbits=mbits)] if mbits else []
+    return ComparableResources(
+        flattened=AllocatedTaskResources(
+            cpu=AllocatedCpuResources(cpu_shares=cpu),
+            memory=AllocatedMemoryResources(memory_mb=mem),
+            networks=nets,
+        ),
+        shared=AllocatedSharedResources(disk_mb=disk),
+    )
+
+
+class TestResourceDistancePort:
+    """ref TestResourceDistance (preemption_test.go:16)."""
+
+    ASK = comparable(cpu=2048, mem=512, disk=4096, mbits=1024)
+
+    CASES = [
+        (comparable(cpu=2048, mem=512, disk=4096, mbits=1024), "0.000"),
+        (comparable(cpu=1024, mem=400, disk=1024, mbits=1024), "0.928"),
+        (comparable(cpu=8192, mem=200, disk=1024, mbits=512), "3.152"),
+        (comparable(cpu=2048, mem=500, disk=4096, mbits=1024), "0.023"),
+    ]
+
+    @pytest.mark.parametrize("used,expected", CASES)
+    def test_distance(self, used, expected):
+        assert f"{basic_resource_distance(self.ASK, used):.3f}" == expected
+
+
+# ---------------------------------------------------------------------------
+# TestPreemption (preemption_test.go:144): the full 18-case table.
+# ---------------------------------------------------------------------------
+
+# persistent alloc ids shared across cases, like the Go test's allocIDs
+ALLOC_IDS = [generate_uuid() for _ in range(6)]
+DEVICE_IDS = [f"dev{i}" for i in range(10)]
+
+
+def high_prio_job() -> Job:
+    j = mock.job()
+    j.priority = 100
+    return j
+
+
+def low_prio_job() -> Job:
+    j = mock.job()
+    j.priority = 30
+    return j
+
+
+def low_prio_job2() -> Job:
+    j = mock.job()
+    j.priority = 40
+    return j
+
+
+def default_node_resources() -> NodeResources:
+    """The test node: 4000 cpu / 8192 mem / 100GiB disk / eth0 1000mbits,
+    plus two GPU models and an FPGA (preemption_test.go:173-271)."""
+    return NodeResources(
+        cpu=NodeCpuResources(cpu_shares=4000),
+        memory=NodeMemoryResources(memory_mb=8192),
+        disk=NodeDiskResources(disk_mb=100 * 1024),
+        networks=[
+            NetworkResource(
+                device="eth0", cidr="192.168.0.100/32", ip="192.168.0.100",
+                mbits=1000,
+            )
+        ],
+        devices=[
+            NodeDeviceResource(
+                type="gpu", vendor="nvidia", name="1080ti",
+                attributes={
+                    "memory": Attribute.of_int(11, "GiB"),
+                    "cuda_cores": Attribute.of_int(3584, ""),
+                    "graphics_clock": Attribute.of_int(1480, "MHz"),
+                    "memory_bandwidth": Attribute.of_int(11, "GB/s"),
+                },
+                instances=[
+                    NodeDevice(id=DEVICE_IDS[i], healthy=True)
+                    for i in range(4)
+                ],
+            ),
+            NodeDeviceResource(
+                type="gpu", vendor="nvidia", name="2080ti",
+                attributes={
+                    "memory": Attribute.of_int(11, "GiB"),
+                    "cuda_cores": Attribute.of_int(3584, ""),
+                    "graphics_clock": Attribute.of_int(1480, "MHz"),
+                    "memory_bandwidth": Attribute.of_int(11, "GB/s"),
+                },
+                instances=[
+                    NodeDevice(id=DEVICE_IDS[i], healthy=True)
+                    for i in range(4, 9)
+                ],
+            ),
+            NodeDeviceResource(
+                type="fpga", vendor="intel", name="F100",
+                attributes={"memory": Attribute.of_int(4, "GiB")},
+                instances=[
+                    NodeDevice(id="fpga1", healthy=True),
+                    NodeDevice(id="fpga2", healthy=False),
+                ],
+            ),
+        ],
+    )
+
+
+def reserved_node_resources() -> NodeReservedResources:
+    return NodeReservedResources(
+        cpu=NodeCpuResources(cpu_shares=100),
+        memory=NodeMemoryResources(memory_mb=256),
+        disk=NodeDiskResources(disk_mb=4 * 1024),
+    )
+
+
+def two_nic_node_resources() -> NodeResources:
+    """preemption_test.go:452-476: a node with two NICs, no devices."""
+    return NodeResources(
+        cpu=NodeCpuResources(cpu_shares=4000),
+        memory=NodeMemoryResources(memory_mb=8192),
+        disk=NodeDiskResources(disk_mb=100 * 1024),
+        networks=[
+            NetworkResource(
+                device="eth0", cidr="192.168.0.100/32", ip="192.168.0.100",
+                mbits=1000,
+            ),
+            NetworkResource(
+                device="eth1", cidr="192.168.1.100/32", ip="192.168.1.100",
+                mbits=1000,
+            ),
+        ],
+    )
+
+
+def net(device="eth0", ip="192.168.0.100", mbits=0, reserved=None, dynamic=None):
+    return NetworkResource(
+        device=device, ip=ip, mbits=mbits,
+        reserved_ports=list(reserved or []), dynamic_ports=list(dynamic or []),
+    )
+
+
+def create_alloc(aid, job, cpu, mem, disk, networks=None, device=None,
+                 tg_network=None):
+    """ref preemption_test.go:1385-1435 createAllocInner."""
+    shared = AllocatedSharedResources(disk_mb=disk)
+    if tg_network is not None:
+        shared.networks = [tg_network]
+    task_res = AllocatedTaskResources(
+        cpu=AllocatedCpuResources(cpu_shares=cpu),
+        memory=AllocatedMemoryResources(memory_mb=mem),
+        networks=list(networks or []),
+    )
+    if device is not None:
+        task_res.devices = [device]
+    a = Allocation(
+        id=aid,
+        eval_id=generate_uuid(),
+        job_id=job.id,
+        namespace=job.namespace,
+        task_group="web",
+        desired_status="run",
+        client_status="running",
+        allocated_resources=AllocatedResources(
+            tasks={"web": task_res}, shared=shared
+        ),
+    )
+    a.job = job
+    a.name = f"{job.id}.web[0]"
+    return a
+
+
+def gpu(name, *ids):
+    return AllocatedDeviceResource(
+        type="gpu", vendor="nvidia", name=name, device_ids=list(ids)
+    )
+
+
+def fpga(*ids):
+    return AllocatedDeviceResource(
+        type="fpga", vendor="intel", name="F100", device_ids=list(ids)
+    )
+
+
+def run_preemption_case(
+    current_allocations,
+    resource_ask: Resources,
+    job_priority: int,
+    node_capacity: NodeResources = None,
+    current_preemptions=None,
+):
+    """Drive the BinPackIterator with eviction exactly like the reference
+    runner (preemption_test.go:1327-1381); returns the ranked option (or
+    None) whose preempted_allocs carry the chosen victims."""
+    node = mock.node()
+    node.node_resources = node_capacity or default_node_resources()
+    node.reserved_resources = reserved_node_resources()
+
+    h = Harness(seed=42)
+    h.state.upsert_node(h.next_index(), node)
+    for a in current_allocations:
+        a.node_id = node.id
+    h.state.upsert_allocs(h.next_index(), current_allocations)
+
+    plan = Plan()
+    if current_preemptions:
+        plan.node_preemptions[node.id] = list(current_preemptions)
+    ctx = EvalContext(h.state.snapshot(), plan, rng=None)
+
+    static = StaticRankIterator(ctx, [RankedNode(node)])
+    binpack = BinPackIterator(ctx, static, evict=True, priority=job_priority)
+    job = mock.job()
+    job.priority = job_priority
+    binpack.set_job(job)
+    tg = TaskGroup(
+        name="web",
+        ephemeral_disk=EphemeralDisk(),
+        tasks=[Task(name="web", resources=resource_ask)],
+    )
+    binpack.set_task_group(tg)
+    return binpack.next()
+
+
+def assert_victims(option, expected_ids):
+    if expected_ids is None:
+        assert option is None, (
+            f"expected no preemption option, got victims "
+            f"{[a.id for a in option.preempted_allocs]}"
+        )
+        return
+    assert option is not None, "expected a preemption option, got none"
+    got = {a.id for a in option.preempted_allocs}
+    assert got == set(expected_ids), (got, set(expected_ids))
+
+
+class TestPreemptionPort:
+    """ref TestPreemption (preemption_test.go:144) — one method per table
+    case, same descriptions."""
+
+    def test_no_preemption_because_existing_allocs_are_not_low_priority(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 3200, 7256, 4 * 1024,
+                    networks=[net(mbits=50)],
+                )
+            ],
+            Resources(
+                cpu=2000, memory_mb=256, disk_mb=4 * 1024,
+                networks=[net(
+                    mbits=1, reserved=[Port(label="ssh", value=22)]
+                )],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, None)
+
+    def test_preempting_low_priority_not_enough_for_ask(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 3200, 7256, 4 * 1024,
+                    networks=[net(mbits=50)],
+                )
+            ],
+            Resources(
+                cpu=4000, memory_mb=8192, disk_mb=4 * 1024,
+                networks=[net(
+                    mbits=1, reserved=[Port(label="ssh", value=22)]
+                )],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, None)
+
+    def test_impossible_static_port_used_by_higher_priority(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], high_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(
+                        ip="192.168.0.200", mbits=600,
+                        reserved=[Port(label="db", value=88)],
+                    )],
+                ),
+            ],
+            Resources(
+                cpu=600, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(
+                    mbits=700, reserved=[Port(label="db", value=88)]
+                )],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, None)
+
+    def test_preempt_only_from_device_with_unused_reserved_port(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], high_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(
+                        device="eth1", ip="192.168.0.200", mbits=600,
+                        reserved=[Port(label="db", value=88)],
+                    )],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=600)],
+                ),
+            ],
+            Resources(
+                cpu=600, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(
+                    device="", mbits=700,
+                    reserved=[Port(label="db", value=88)],
+                )],
+            ),
+            job_priority=100,
+            node_capacity=two_nic_node_resources(),
+        )
+        assert_victims(option, [ALLOC_IDS[2]])
+
+    def test_combination_high_low_priority_without_static_ports(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 2800, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=200)],
+                    tg_network=net(ip="192.168.0.201", mbits=300),
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(mbits=300)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[3], low_prio_job(), 700, 256, 4 * 1024,
+                ),
+            ],
+            Resources(
+                cpu=1100, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(mbits=840)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1], ALLOC_IDS[2], ALLOC_IDS[3]])
+
+    def test_preempt_allocs_with_network_devices(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 2800, 2256, 4 * 1024
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=800)],
+                ),
+            ],
+            Resources(
+                cpu=1100, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(mbits=840)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1]])
+
+    def test_ignore_allocs_with_close_enough_priority(self):
+        lpj = low_prio_job()
+        option = run_preemption_case(
+            [
+                create_alloc(ALLOC_IDS[0], lpj, 2800, 2256, 4 * 1024),
+                create_alloc(
+                    ALLOC_IDS[1], lpj, 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=800)],
+                ),
+            ],
+            Resources(
+                cpu=1100, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(mbits=840)],
+            ),
+            job_priority=lpj.priority + 5,
+        )
+        assert_victims(option, None)
+
+    def test_preemption_needed_for_all_resources_except_network(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 2800, 2256, 40 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=50)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 512, 25 * 1024
+                ),
+                create_alloc(
+                    ALLOC_IDS[3], low_prio_job(), 700, 276, 20 * 1024
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=3000, disk_mb=50 * 1024,
+                networks=[net(mbits=50)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1], ALLOC_IDS[2], ALLOC_IDS[3]])
+
+    def test_only_one_low_priority_alloc_needs_preemption(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(mbits=500)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=320)],
+                ),
+            ],
+            Resources(
+                cpu=300, memory_mb=500, disk_mb=5 * 1024,
+                networks=[net(mbits=320)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[2]])
+
+    def test_one_alloc_meets_static_port_other_meets_mbits(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(
+                        ip="192.168.0.200", mbits=500,
+                        reserved=[Port(label="db", value=88)],
+                    )],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(mbits=200)],
+                ),
+            ],
+            Resources(
+                cpu=2700, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(
+                    mbits=800, reserved=[Port(label="db", value=88)]
+                )],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1], ALLOC_IDS[2]])
+
+    def test_alloc_meeting_static_port_also_meets_other_needs(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(
+                        ip="192.168.0.200", mbits=600,
+                        reserved=[Port(label="db", value=88)],
+                    )],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(mbits=100)],
+                ),
+            ],
+            Resources(
+                cpu=600, memory_mb=1000, disk_mb=25 * 1024,
+                networks=[net(
+                    mbits=700, reserved=[Port(label="db", value=88)]
+                )],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1]])
+
+    def test_alloc_from_job_with_existing_evictions_not_chosen(self):
+        lpj2 = low_prio_job2()
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1200, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 256, 4 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=500)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], lpj2, 200, 256, 4 * 1024,
+                    networks=[net(mbits=300)],
+                ),
+            ],
+            Resources(
+                cpu=300, memory_mb=500, disk_mb=5 * 1024,
+                networks=[net(mbits=320)],
+            ),
+            job_priority=100,
+            current_preemptions=[
+                create_alloc(
+                    ALLOC_IDS[4], lpj2, 200, 256, 4 * 1024,
+                    networks=[net(mbits=300)],
+                )
+            ],
+        )
+        assert_victims(option, [ALLOC_IDS[1]])
+
+    def test_preemption_one_device_instance_per_alloc(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 500, 512, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[0]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 512, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[1]),
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=512, disk_mb=4 * 1024,
+                devices=[RequestedDevice(name="nvidia/gpu/1080ti", count=4)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[0], ALLOC_IDS[1]])
+
+    def test_preemption_multiple_devices_used(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 500, 512, 4 * 1024,
+                    device=gpu("1080ti", *DEVICE_IDS[:4]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 512, 4 * 1024,
+                    device=fpga("fpga1"),
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=512, disk_mb=4 * 1024,
+                devices=[RequestedDevice(name="nvidia/gpu/1080ti", count=4)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[0]])
+
+    def test_preemption_allocs_across_multiple_matching_devices(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 500, 512, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[0], DEVICE_IDS[1]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], high_prio_job(), 200, 100, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[2]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    device=gpu("2080ti", DEVICE_IDS[4], DEVICE_IDS[5]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[3], low_prio_job(), 100, 256, 4 * 1024,
+                    device=gpu("2080ti", DEVICE_IDS[6], DEVICE_IDS[7]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[4], low_prio_job(), 200, 512, 4 * 1024,
+                    device=fpga("fpga1"),
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=512, disk_mb=4 * 1024,
+                devices=[RequestedDevice(name="gpu", count=4)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[2], ALLOC_IDS[3]])
+
+    def test_preemption_lower_higher_priority_combinations(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 500, 512, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[0], DEVICE_IDS[1]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job2(), 200, 100, 4 * 1024,
+                    device=gpu("1080ti", DEVICE_IDS[2], DEVICE_IDS[3]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 200, 256, 4 * 1024,
+                    device=gpu("2080ti", DEVICE_IDS[4], DEVICE_IDS[5]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[3], low_prio_job(), 100, 256, 4 * 1024,
+                    device=gpu("2080ti", DEVICE_IDS[6], DEVICE_IDS[7]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[4], low_prio_job(), 100, 256, 4 * 1024,
+                    device=gpu("2080ti", DEVICE_IDS[8]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[5], low_prio_job(), 200, 512, 4 * 1024,
+                    device=fpga("fpga1"),
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=512, disk_mb=4 * 1024,
+                devices=[RequestedDevice(name="gpu", count=4)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[2], ALLOC_IDS[3]])
+
+    def test_device_preemption_impossible_more_instances_than_available(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], low_prio_job(), 500, 512, 4 * 1024,
+                    device=gpu("1080ti", *DEVICE_IDS[:4]),
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 200, 512, 4 * 1024,
+                    device=fpga("fpga1"),
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=512, disk_mb=4 * 1024,
+                devices=[RequestedDevice(name="gpu", count=6)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, None)
+
+    def test_filter_out_allocs_whose_superset_also_preempted(self):
+        option = run_preemption_case(
+            [
+                create_alloc(
+                    ALLOC_IDS[0], high_prio_job(), 1800, 2256, 4 * 1024,
+                    networks=[net(mbits=150)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[1], low_prio_job(), 1500, 256, 5 * 1024,
+                    networks=[net(mbits=100)],
+                ),
+                create_alloc(
+                    ALLOC_IDS[2], low_prio_job(), 600, 256, 5 * 1024,
+                    networks=[net(ip="192.168.0.200", mbits=300)],
+                ),
+            ],
+            Resources(
+                cpu=1000, memory_mb=256, disk_mb=5 * 1024,
+                networks=[net(mbits=50)],
+            ),
+            job_priority=100,
+        )
+        assert_victims(option, [ALLOC_IDS[1]])

@@ -1,0 +1,798 @@
+"""System-scheduler corpus ported from the reference
+(scheduler/system_sched_test.go — cited per test). Each case drives the
+scalar system scheduler through the Harness exactly like the Go tests
+drive NewSystemScheduler; kernel-eligible cases additionally run through
+tpu-system at the bottom (TestTPUSystemPortParity).
+"""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness, RejectPlan
+from nomad_tpu.structs import compute_class
+from nomad_tpu.structs.model import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_DESIRED_STATUS_EVICT,
+    ALLOC_DESIRED_STATUS_STOP,
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Constraint,
+    NetworkResource,
+    NODE_SCHED_INELIGIBLE,
+    NodeCpuResources,
+    NodeDiskResources,
+    NodeMemoryResources,
+    NodeResources,
+    Port,
+    Resources,
+    UpdateStrategy,
+    generate_uuid,
+)
+from test_scheduler import make_eval, run_eval, setup_harness
+
+
+def planned_allocs(plan):
+    return [a for allocs in plan.node_allocation.values() for a in allocs]
+
+
+def updated_allocs(plan):
+    return [a for allocs in plan.node_update.values() for a in allocs]
+
+
+def stored_job(h, job):
+    """The state store's copy of an upserted job: allocs must reference IT
+    (the Go tests alias the same pointer UpsertJob indexed; this store
+    copies on upsert, so alloc.job built from the in-memory original would
+    spuriously read as a destructive update)."""
+    return h.state.job_by_id(job.namespace, job.id) or job
+
+
+def sys_alloc(job, node, tg="web"):
+    a = mock.alloc()
+    a.job = job
+    a.job_id = job.id
+    a.namespace = job.namespace
+    a.node_id = node.id
+    a.task_group = tg
+    a.name = f"my-job.{tg}[0]"
+    return a
+
+
+def non_terminal(allocs):
+    return [a for a in allocs if not a.terminal_status()]
+
+
+class TestSystemSchedPort:
+    def test_job_register(self):
+        # ref TestSystemSched_JobRegister (system_sched_test.go:18)
+        h, _ = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        sched, ev = run_eval(h, job)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert plan.annotations is None
+        assert len(planned_allocs(plan)) == 10
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+        # available-node metric records the dc
+        assert out[0].metrics.nodes_available.get("dc1") == 10
+        assert h.evals[0].queued_allocations.get("web", 0) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_job_register_sticky_allocs(self):
+        # ref TestSystemSched_JobRegister_StickyAllocs (:92)
+        h, _ = setup_harness(10)
+        job = mock.system_job()
+        job.task_groups[0].ephemeral_disk.sticky = True
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        plan = h.plans[0]
+        assert len(planned_allocs(plan)) == 10
+
+        # fail one alloc on its node; the replacement must stay there
+        failed = planned_allocs(plan)[4].copy()
+        failed.client_status = ALLOC_CLIENT_STATUS_FAILED
+        h.state.update_allocs_from_client(h.next_index(), [failed])
+
+        h1 = Harness(state=h.state, seed=42)
+        h1._next_index = h._next_index
+        ev = make_eval(job, triggered_by="node-update")
+        h1.state.upsert_evals(h1.next_index(), [ev])
+        h1.process("system", ev)
+        new_planned = planned_allocs(h1.plans[0])
+        assert len(new_planned) == 1
+        assert new_planned[0].node_id == failed.node_id
+        assert new_planned[0].previous_allocation == failed.id
+
+    def test_job_register_ephemeral_disk_constraint(self):
+        # ref TestSystemSched_JobRegister_EphemeralDiskConstraint (:168)
+        h, _ = setup_harness(1)
+        job = mock.system_job()
+        job.task_groups[0].ephemeral_disk.size_mb = 60 * 1024
+        h.state.upsert_job(h.next_index(), job)
+        job1 = mock.system_job()
+        job1.task_groups[0].ephemeral_disk.size_mb = 60 * 1024
+        h.state.upsert_job(h.next_index(), job1)
+
+        run_eval(h, job)
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 1
+
+        h1 = Harness(state=h.state, seed=42)
+        h1._next_index = h._next_index
+        ev1 = make_eval(job1)
+        h1.state.upsert_evals(h1.next_index(), [ev1])
+        h1.process("system", ev1)
+        assert len(h1.state.allocs_by_job(job1.namespace, job1.id)) == 0
+
+    def test_exhaust_resources_preempts_service(self):
+        # ref TestSystemSched_ExhaustResources (:237)
+        h, _ = setup_harness(1)
+        h.state.set_scheduler_config(
+            h.next_index(),
+            {"preemption_config": {"system_scheduler_enabled": True}},
+        )
+        svc = mock.job()
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].tasks[0].resources.cpu = 3600
+        h.state.upsert_job(h.next_index(), svc)
+        run_eval(h, svc, sched_type="service")
+
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        new_plan = h.plans[1]
+        assert len(new_plan.node_allocation) == 1
+        assert len(new_plan.node_preemptions) == 1
+        for allocs in new_plan.node_allocation.values():
+            assert len(allocs) == 1
+            assert allocs[0].job_id == job.id
+        for allocs in new_plan.node_preemptions.values():
+            assert len(allocs) == 1
+            assert allocs[0].job_id == svc.id
+        assert h.evals[1].queued_allocations.get("web", 0) == 0
+
+    def test_job_register_annotate(self):
+        # ref TestSystemSched_JobRegister_Annotate (:315)
+        h = Harness(seed=42)
+        for i in range(10):
+            n = mock.node()
+            n.node_class = "foo" if i < 9 else "bar"
+            compute_class(n)
+            h.state.upsert_node(h.next_index(), n)
+        job = mock.system_job()
+        job.constraints.append(
+            Constraint(l_target="${node.class}", r_target="foo", operand="==")
+        )
+        h.state.upsert_job(h.next_index(), job)
+        ev = make_eval(job, annotate_plan=True)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(planned_allocs(plan)) == 9
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 9
+        assert out[0].metrics.nodes_available.get("dc1") == 10
+        assert h.evals[0].status == "complete"
+
+        assert plan.annotations is not None
+        desired = plan.annotations.desired_tg_updates
+        assert set(desired) == {"web"}
+        assert desired["web"].place == 9
+        assert desired["web"].stop == 0
+        assert desired["web"].ignore == 0
+
+    def test_job_register_add_node(self):
+        # ref TestSystemSched_JobRegister_AddNode (:411)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        job = stored_job(h, job)
+        allocs = [sys_alloc(job, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        new_node = mock.node()
+        h.state.upsert_node(h.next_index(), new_node)
+        ev = make_eval(job, triggered_by="node-update")
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(updated_allocs(plan)) == 0
+        assert len(planned_allocs(plan)) == 1
+        assert new_node.id in plan.node_allocation
+        out = non_terminal(h.state.allocs_by_job(job.namespace, job.id))
+        assert len(out) == 11
+        assert h.evals[0].status == "complete"
+
+    def test_job_register_alloc_fail_no_nodes(self):
+        # ref TestSystemSched_JobRegister_AllocFail (:501)
+        h, _ = setup_harness(0)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        # no-op: no plan at all
+        assert len(h.plans) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_job_modify(self):
+        # ref TestSystemSched_JobModify (:533)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        job = stored_job(h, job)
+        allocs = [sys_alloc(job, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        # terminal allocs are ignored
+        terminal = []
+        for i in range(5):
+            a = sys_alloc(job, nodes[i])
+            a.desired_status = ALLOC_DESIRED_STATUS_STOP
+            terminal.append(a)
+        h.state.upsert_allocs(h.next_index(), terminal)
+
+        job2 = mock.system_job()
+        job2.id = job.id
+        job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+        h.state.upsert_job(h.next_index(), job2)
+
+        run_eval(h, job2)
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(updated_allocs(plan)) == len(allocs)
+        assert len(planned_allocs(plan)) == 10
+        out = non_terminal(h.state.allocs_by_job(job.namespace, job.id))
+        assert len(out) == 10
+        assert h.evals[0].status == "complete"
+
+    def test_job_modify_rolling(self):
+        # ref TestSystemSched_JobModify_Rolling (:635)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        job = stored_job(h, job)
+        allocs = [sys_alloc(job, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        job2 = mock.system_job()
+        job2.id = job.id
+        job2.update = UpdateStrategy(
+            stagger=30 * 1_000_000_000, max_parallel=5
+        )
+        job2.task_groups[0].tasks[0].config["command"] = "/bin/other"
+        h.state.upsert_job(h.next_index(), job2)
+
+        run_eval(h, job2)
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(updated_allocs(plan)) == job2.update.max_parallel
+        assert len(planned_allocs(plan)) == job2.update.max_parallel
+        assert h.evals[0].status == "complete"
+
+        # a follow-up rolling eval was created and linked
+        assert h.evals[0].next_eval
+        assert h.create_evals
+        create = h.create_evals[0]
+        assert h.evals[0].next_eval == create.id
+        assert create.previous_eval == h.evals[0].id
+        assert create.triggered_by == "rolling-update"
+
+    def test_job_modify_in_place(self):
+        # ref TestSystemSched_JobModify_InPlace (:738)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        job = stored_job(h, job)
+        allocs = [sys_alloc(job, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        job2 = mock.system_job()
+        job2.id = job.id
+        h.state.upsert_job(h.next_index(), job2)
+
+        run_eval(h, job2)
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(updated_allocs(plan)) == 0
+        planned = planned_allocs(plan)
+        assert len(planned) == 10
+        # every existing alloc was updated in place to the new job version
+        job2_stored = stored_job(h, job2)
+        out = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+        for a in out:
+            assert a.job.job_modify_index == job2_stored.job_modify_index
+        assert h.evals[0].status == "complete"
+
+    def test_job_deregister_purged(self):
+        # ref TestSystemSched_JobDeregister_Purged (:837)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()  # NOT in state: purged
+        allocs = [sys_alloc(job, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        ev = make_eval(job, triggered_by="job-deregister")
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        for n in nodes:
+            assert len(plan.node_update.get(n.id, [])) == 1
+        out = non_terminal(h.state.allocs_by_job(job.namespace, job.id))
+        assert len(out) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_job_deregister_stopped(self):
+        # ref TestSystemSched_JobDeregister_Stopped (:909)
+        h, nodes = setup_harness(10)
+        job = mock.system_job()
+        job.stop = True
+        h.state.upsert_job(h.next_index(), job)
+        job_s = stored_job(h, job)
+        allocs = [sys_alloc(job_s, n) for n in nodes]
+        h.state.upsert_allocs(h.next_index(), allocs)
+
+        ev = make_eval(job, triggered_by="job-deregister")
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        for n in nodes:
+            assert len(plan.node_update.get(n.id, [])) == 1
+        out = non_terminal(h.state.allocs_by_job(job.namespace, job.id))
+        assert len(out) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_node_down(self):
+        # ref TestSystemSched_NodeDown (:983)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.status = "down"
+        h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        a = sys_alloc(stored_job(h, job), node)
+        a.desired_transition.migrate = True
+        h.state.upsert_allocs(h.next_index(), [a])
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(plan.node_update.get(node.id, [])) == 1
+        planned = updated_allocs(plan)
+        assert len(planned) == 1
+        assert (
+            planned[0].desired_status == ALLOC_DESIRED_STATUS_STOP
+            or planned[0].client_status == "lost"
+        )
+        assert h.evals[0].status == "complete"
+
+    def test_node_drain_down(self):
+        # ref TestSystemSched_NodeDrain_Down (:1050)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.drain = True
+        node.status = "down"
+        h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        a = sys_alloc(stored_job(h, job), node)
+        h.state.upsert_allocs(h.next_index(), [a])
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        lost = [x.id for x in plan.node_update.get(node.id, [])]
+        assert lost == [a.id]
+        assert h.evals[0].status == "complete"
+
+    def test_node_drain(self):
+        # ref TestSystemSched_NodeDrain (:1112)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.drain = True
+        h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        a = sys_alloc(stored_job(h, job), node)
+        a.desired_transition.migrate = True
+        h.state.upsert_allocs(h.next_index(), [a])
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert len(plan.node_update.get(node.id, [])) == 1
+        planned = updated_allocs(plan)
+        assert len(planned) == 1
+        assert planned[0].desired_status == ALLOC_DESIRED_STATUS_STOP
+        assert h.evals[0].status == "complete"
+
+    def test_node_update_no_changes(self):
+        # ref TestSystemSched_NodeUpdate (:1179)
+        h = Harness(seed=42)
+        node = mock.node()
+        h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        a = sys_alloc(stored_job(h, job), node)
+        h.state.upsert_allocs(h.next_index(), [a])
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert h.evals[0].queued_allocations.get("web", 0) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_retry_limit(self):
+        # ref TestSystemSched_RetryLimit (:1223)
+        h, _ = setup_harness(10)
+        h.planner = RejectPlan(h)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        assert len(h.plans) > 0
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 0
+        assert h.evals[0].status == "failed"
+
+    def test_queued_with_constraints(self):
+        # ref TestSystemSched_Queued_With_Constraints (:1276)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.attributes["kernel.name"] = "darwin"
+        h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()  # constrained to kernel.name = linux
+        h.state.upsert_job(h.next_index(), job)
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert h.evals[0].queued_allocations.get("web", 0) == 0
+
+    def test_constraint_errors(self):
+        # ref TestSystemSched_ConstraintErrors (:1314)
+        h = Harness(seed=42)
+        node = None
+        for tag in ["aaaaaa", "foo", "foo", "foo"]:
+            node = mock.node()
+            node.meta["tag"] = tag
+            compute_class(node)
+            h.state.upsert_node(h.next_index(), node)
+        # mark the last node ineligible (via the dedicated transition —
+        # plain re-registration retains the stored eligibility, matching
+        # the reference's upsertNodeTxn; the Go test leans on memdb
+        # pointer aliasing to mutate in place)
+        h.state.update_node_eligibility(
+            h.next_index(), node.id, NODE_SCHED_INELIGIBLE
+        )
+
+        job = mock.system_job()
+        job.constraints.append(
+            Constraint(l_target="${meta.tag}", r_target="foo", operand="=")
+        )
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        assert h.evals[0].status == "complete"
+        assert h.evals[0].queued_allocations.get("web") == 0
+        assert len(h.plans) == 1
+        assert h.plans[0].annotations is None
+        # two eligible matching nodes
+        assert len(h.plans[0].node_allocation) == 2
+        allocs = h.state.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 2
+        # no spurious failed-TG metrics
+        assert not h.evals[0].failed_tg_allocs
+
+    def test_chained_alloc(self):
+        # ref TestSystemSched_ChainedAlloc (:1385)
+        h, _ = setup_harness(10)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+        alloc_ids = sorted(a.id for a in planned_allocs(h.plans[0]))
+
+        h1 = Harness(state=h.state, seed=42)
+        h1._next_index = h._next_index
+        job1 = mock.system_job()
+        job1.id = job.id
+        job1.task_groups[0].tasks[0].env = {"foo": "bar"}
+        h1.state.upsert_job(h1.next_index(), job1)
+        for _ in range(2):
+            h1.state.upsert_node(h1.next_index(), mock.node())
+
+        ev1 = make_eval(job1)
+        h1.state.upsert_evals(h1.next_index(), [ev1])
+        h1.process("system", ev1)
+
+        plan = h1.plans[0]
+        prev_allocs, new_allocs = [], []
+        for a in planned_allocs(plan):
+            if a.previous_allocation:
+                prev_allocs.append(a.previous_allocation)
+            else:
+                new_allocs.append(a.id)
+        # every replacement chains to one of the original allocs; the two
+        # new nodes get unchained placements
+        assert sorted(prev_allocs) == alloc_ids
+        assert len(new_allocs) == 2
+
+    def test_plan_with_drained_node(self):
+        # ref TestSystemSched_PlanWithDrainedNode (:1479)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.node_class = "green"
+        node.drain = True
+        compute_class(node)
+        h.state.upsert_node(h.next_index(), node)
+        node2 = mock.node()
+        node2.node_class = "blue"
+        compute_class(node2)
+        h.state.upsert_node(h.next_index(), node2)
+
+        job = mock.system_job()
+        tg1 = job.task_groups[0]
+        tg1.constraints.append(
+            Constraint(l_target="${node.class}", r_target="green", operand="==")
+        )
+        tg2 = tg1.copy()
+        tg2.name = "web2"
+        tg2.constraints[-1].r_target = "blue"
+        job.task_groups.append(tg2)
+        h.state.upsert_job(h.next_index(), job)
+        job_s = stored_job(h, job)
+
+        a = sys_alloc(job_s, node)
+        a.desired_transition.migrate = True
+        a2 = sys_alloc(job_s, node2, tg="web2")
+        h.state.upsert_allocs(h.next_index(), [a, a2])
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        planned = plan.node_update.get(node.id, [])
+        assert len(planned) == 1
+        assert len(plan.node_allocation) == 0
+        assert planned[0].desired_status == ALLOC_DESIRED_STATUS_STOP
+        assert h.evals[0].status == "complete"
+
+    def test_queued_allocs_multiple_tgs(self):
+        # ref TestSystemSched_QueuedAllocsMultTG (:1570)
+        h = Harness(seed=42)
+        node = mock.node()
+        node.node_class = "green"
+        compute_class(node)
+        h.state.upsert_node(h.next_index(), node)
+        node2 = mock.node()
+        node2.node_class = "blue"
+        compute_class(node2)
+        h.state.upsert_node(h.next_index(), node2)
+
+        job = mock.system_job()
+        tg1 = job.task_groups[0]
+        tg1.constraints.append(
+            Constraint(l_target="${node.class}", r_target="green", operand="==")
+        )
+        tg2 = tg1.copy()
+        tg2.name = "web2"
+        tg2.constraints[-1].r_target = "blue"
+        job.task_groups.append(tg2)
+        h.state.upsert_job(h.next_index(), job)
+
+        ev = make_eval(job, triggered_by="node-update", node_id=node.id)
+        h.state.upsert_evals(h.next_index(), [ev])
+        h.process("system", ev)
+
+        assert len(h.plans) == 1
+        qa = h.evals[0].queued_allocations
+        assert qa.get("web", 0) == 0 and qa.get("web2", 0) == 0
+        assert h.evals[0].status == "complete"
+
+    def test_system_preemption_two_nodes(self):
+        # ref TestSystemSched_Preemption (:1631)
+        h = Harness(seed=42)
+        nodes = []
+        for _ in range(2):
+            n = mock.node()
+            n.node_resources = NodeResources(
+                cpu=NodeCpuResources(cpu_shares=3072),
+                memory=NodeMemoryResources(memory_mb=5034),
+                disk=NodeDiskResources(disk_mb=20 * 1024),
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/32",
+                        ip="192.168.0.100", mbits=1000,
+                    )
+                ],
+            )
+            h.state.upsert_node(h.next_index(), n)
+            nodes.append(n)
+
+        h.state.set_scheduler_config(
+            h.next_index(),
+            {"preemption_config": {"system_scheduler_enabled": True}},
+        )
+
+        def batch_with_alloc(priority, cpu, mem, networks, disk, name):
+            j = mock.batch_job()
+            j.type = "batch"
+            j.priority = priority
+            a = mock.alloc()
+            a.job = j
+            a.job_id = j.id
+            a.namespace = j.namespace
+            a.node_id = nodes[0].id
+            a.name = name
+            a.task_group = j.task_groups[0].name
+            a.allocated_resources = AllocatedResources(
+                tasks={
+                    "web": AllocatedTaskResources(
+                        cpu=AllocatedCpuResources(cpu_shares=cpu),
+                        memory=AllocatedMemoryResources(memory_mb=mem),
+                        networks=networks,
+                    )
+                },
+                shared=AllocatedSharedResources(disk_mb=disk),
+            )
+            return j, a
+
+        job1, alloc1 = batch_with_alloc(
+            20, 512, 1024,
+            [NetworkResource(
+                device="eth0", ip="192.168.0.100", mbits=200,
+                reserved_ports=[Port(label="web", value=80)],
+            )],
+            5 * 1024, "my-job[0]",
+        )
+        h.state.upsert_job(h.next_index(), job1)
+        job2, alloc2 = batch_with_alloc(
+            20, 512, 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=200)],
+            5 * 1024, "my-job[2]",
+        )
+        h.state.upsert_job(h.next_index(), job2)
+        job3, alloc3 = batch_with_alloc(
+            40, 1024, 25,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=400)],
+            5 * 1024, "my-job[0]",
+        )
+        h.state.upsert_job(h.next_index(), job3)
+        h.state.upsert_allocs(
+            h.next_index(), [alloc1, alloc2, alloc3]
+        )
+
+        # high-priority allocs must NOT be preempted
+        job4, alloc4 = batch_with_alloc(
+            100, 1024, 2048,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=100)],
+            2 * 1024, "my-job4[0]",
+        )
+        h.state.upsert_job(h.next_index(), job4)
+        h.state.upsert_allocs(h.next_index(), [alloc4])
+
+        job = mock.system_job()
+        job.task_groups[0].tasks[0].resources = Resources(
+            cpu=1948, memory_mb=256,
+            networks=[NetworkResource(
+                mbits=800, dynamic_ports=[Port(label="http")]
+            )],
+        )
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        assert plan.annotations is None
+        assert len(plan.node_allocation) == 2
+        preempting_alloc_id = next(
+            a.id
+            for a in plan.node_allocation.get(nodes[0].id, [])
+        )
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == 2
+
+        assert nodes[0].id in plan.node_preemptions
+        victims = plan.node_preemptions[nodes[0].id]
+        assert len(victims) == 3
+        expected_jobs = {job1.id, job2.id, job3.id}
+        assert {v.job_id for v in victims} == expected_jobs
+
+        # committed state: victims evicted with the preemptor recorded
+        for jid in expected_jobs:
+            for a in h.state.allocs_by_job("default", jid):
+                assert a.desired_status == ALLOC_DESIRED_STATUS_EVICT
+                assert preempting_alloc_id in a.desired_description
+        assert h.evals[0].status == "complete"
+
+
+class TestTPUSystemPortParity:
+    """Kernel-eligible system corpus cases re-run through tpu-system — the
+    placement sets must match the scalar oracle exactly."""
+
+    @pytest.mark.parametrize("num_nodes", [1, 7, 10])
+    def test_register_all_nodes_via_kernel(self, num_nodes):
+        h, _ = setup_harness(num_nodes)
+        job = mock.system_job()
+        h.state.upsert_job(h.next_index(), job)
+        run_eval(h, job, sched_type="tpu-system")
+        assert len(h.state.allocs_by_job(job.namespace, job.id)) == num_nodes
+
+        h2, _ = setup_harness(num_nodes)
+        job2 = mock.system_job()
+        h2.state.upsert_job(h2.next_index(), job2)
+        run_eval(h2, job2, sched_type="system")
+        assert len(h2.state.allocs_by_job(job2.namespace, job2.id)) == num_nodes
+
+    def test_annotate_constraint_subset_via_kernel(self):
+        def scenario(sched_type):
+            h = Harness(seed=42)
+            for i in range(10):
+                n = mock.node()
+                n.node_class = "foo" if i < 9 else "bar"
+                compute_class(n)
+                h.state.upsert_node(h.next_index(), n)
+            job = mock.system_job()
+            job.constraints.append(
+                Constraint(
+                    l_target="${node.class}", r_target="foo", operand="=="
+                )
+            )
+            h.state.upsert_job(h.next_index(), job)
+            run_eval(h, job, sched_type=sched_type)
+            return len(h.state.allocs_by_job(job.namespace, job.id))
+
+        assert scenario("tpu-system") == scenario("system") == 9
+
+    def test_drain_migration_via_kernel(self):
+        def scenario(sched_type):
+            h = Harness(seed=42)
+            nodes = []
+            for _ in range(4):
+                n = mock.node()
+                nodes.append(n)
+                h.state.upsert_node(h.next_index(), n)
+            job = mock.system_job()
+            h.state.upsert_job(h.next_index(), job)
+            allocs = [sys_alloc(job, n) for n in nodes]
+            allocs[0].desired_transition.migrate = True
+            h.state.upsert_allocs(h.next_index(), allocs)
+            drained = nodes[0].copy()
+            drained.drain = True
+            h.state.upsert_node(h.next_index(), drained)
+            ev = make_eval(
+                job, triggered_by="node-update", node_id=drained.id
+            )
+            h.state.upsert_evals(h.next_index(), [ev])
+            h.process(sched_type, ev)
+            stops = sorted(
+                a.id
+                for a in h.plans[0].node_update.get(drained.id, [])
+            )
+            return stops, allocs[0].id
+
+        kernel_stops, kid = scenario("tpu-system")
+        oracle_stops, oid = scenario("system")
+        assert len(kernel_stops) == len(oracle_stops) == 1
