@@ -94,6 +94,9 @@ def _run_networked_server(args, config: dict):
     )
     http = HTTPServer(agent.server, host=args.bind, port=port)
     http.start()
+    from ..metrics import configure_telemetry
+
+    telemetry = configure_telemetry(config)
     print(
         f"==> nomad-tpu server {name} started: http {http.address} "
         f"rpc {agent.address}", flush=True,
@@ -101,6 +104,8 @@ def _run_networked_server(args, config: dict):
 
     def cleanup():
         print("==> shutting down", flush=True)
+        if telemetry is not None:
+            telemetry.stop()
         http.stop()
         agent.stop()
 
@@ -203,6 +208,9 @@ def cmd_agent(args):
     )
     http = HTTPServer(agent.server, host=args.bind, port=port, agent=agent)
     http.start()
+    from ..metrics import configure_telemetry
+
+    telemetry = configure_telemetry(config)
     print(f"==> nomad-tpu agent started: {http.address} "
           f"(region {agent.server.region!r})")
     print(f"    clients: {[c.node.id[:8] for c in agent.clients]}")
@@ -225,6 +233,8 @@ def cmd_agent(args):
             time.sleep(0.2)
     finally:
         print("==> shutting down")
+        if telemetry is not None:
+            telemetry.stop()
         http.stop()
         agent.stop()
     return 0

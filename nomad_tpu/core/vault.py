@@ -66,11 +66,20 @@ class HTTPProvider:
         token: str,
         renew_interval: float = 300.0,
         timeout: float = 10.0,
+        backoff_base: float = 1.0,
     ):
         self.address = address.rstrip("/")
         self.token = token
         self.renew_interval = renew_interval
         self.timeout = timeout
+        #: first retry delay after a failed renewal; doubles per
+        #: consecutive failure up to renew_interval (ref nomad/vault.go
+        #: renewal loop backoff)
+        self.backoff_base = backoff_base
+        #: consecutive renewal failures; reset on success. Exposed so
+        #: operators (and tests) can observe the loop degrading.
+        self.consecutive_failures = 0
+        self.last_renewal_error: Optional[str] = None
         self._stop = threading.Event()
         self._renewer: Optional[threading.Thread] = None
 
@@ -95,6 +104,11 @@ class HTTPProvider:
             except Exception:
                 detail = [str(e)]
             raise RuntimeError(f"vault {path}: {'; '.join(map(str, detail))}")
+        except (urllib.error.URLError, OSError) as e:
+            # timeouts and connection refusals surface as retriable vault
+            # errors, not raw socket tracebacks (the renewal loop backoff
+            # and the derive path both key off this)
+            raise RuntimeError(f"vault {path}: {e}")
 
     # -- VaultProvider surface -----------------------------------------
     def create_token(self, policies: list[str]) -> tuple[str, str]:
@@ -126,12 +140,34 @@ class HTTPProvider:
     def start_renewal(self):
         if self._renewer is not None:
             return
+
         def loop():
-            while not self._stop.wait(self.renew_interval):
+            # healthy cadence is renew_interval; a failure switches to an
+            # exponential backoff (base, 2*base, 4*base, ... capped at the
+            # interval) so a flapping Vault is retried promptly without
+            # being hammered, and success restores the normal cadence
+            # (ref nomad/vault.go renewal loop)
+            delay = self.renew_interval
+            while not self._stop.wait(delay):
                 try:
                     self.renew_self()
-                except Exception:
-                    logger.warning("vault token renewal failed", exc_info=True)
+                    self.consecutive_failures = 0
+                    self.last_renewal_error = None
+                    delay = self.renew_interval
+                except Exception as e:
+                    self.consecutive_failures += 1
+                    self.last_renewal_error = str(e)
+                    delay = min(
+                        self.backoff_base
+                        * (2 ** (self.consecutive_failures - 1)),
+                        self.renew_interval,
+                    )
+                    logger.warning(
+                        "vault token renewal failed (attempt %d, retry in "
+                        "%.1fs): %s",
+                        self.consecutive_failures, delay, e,
+                    )
+
         self._renewer = threading.Thread(
             target=loop, daemon=True, name="vault-renewal"
         )
@@ -153,6 +189,7 @@ def provider_from_config(config: dict) -> "VaultProvider":
             vcfg["address"],
             vcfg.get("token", ""),
             renew_interval=float(vcfg.get("renew_interval_s", 300.0)),
+            backoff_base=float(vcfg.get("renew_backoff_s", 1.0)),
         )
         provider.start_renewal()
         return provider

@@ -186,6 +186,99 @@ class DockerDriver(Driver):
             "allow_caps": {"type": "string", "default": DEFAULT_ALLOWED_CAPS},
         }
 
+    @staticmethod
+    def task_config_spec() -> dict:
+        """The docker TaskConfig as a typed hclspec tree (ref
+        drivers/docker/driver.go taskConfigSpec, expressed through
+        plugins/shared/hclspec/hcl_spec.proto node types): nested blocks
+        for auth/mounts/devices/logging, typed maps for
+        labels/sysctl/ulimit/port_map/storage_opt, string lists for the
+        dns/caps surfaces. validate_task_config rejects a typo'd stanza
+        with the failing field's full path before any image pull."""
+        from ..plugins.hclspec import Attr, Block, BlockList
+
+        return {
+            "image": Attr("string", required=True),
+            "command": Attr("string"),
+            "args": Attr("list(string)"),
+            "entrypoint": Attr("list(string)"),
+            "work_dir": Attr("string"),
+            "hostname": Attr("string"),
+            "interactive": Attr("bool"),
+            "tty": Attr("bool"),
+            "force_pull": Attr("bool"),
+            "load": Attr("string"),
+            "privileged": Attr("bool"),
+            "readonly_rootfs": Attr("bool"),
+            "network_mode": Attr("string"),
+            "network_aliases": Attr("list(string)"),
+            "mac_address": Attr("string"),
+            "memory_hard_limit": Attr("number"),
+            "cpu_hard_limit": Attr("bool"),
+            "cpu_cfs_period": Attr("number"),
+            "pids_limit": Attr("number"),
+            "shm_size": Attr("number"),
+            "volume_driver": Attr("string"),
+            "volumes": Attr("list(string)"),
+            "extra_hosts": Attr("list(string)"),
+            "dns_servers": Attr("list(string)"),
+            "dns_search_domains": Attr("list(string)"),
+            "dns_options": Attr("list(string)"),
+            "security_opt": Attr("list(string)"),
+            "cap_add": Attr("list(string)"),
+            "cap_drop": Attr("list(string)"),
+            "labels": Attr("map(string)"),
+            "sysctl": Attr("map(string)"),
+            "ulimit": Attr("map(string)"),
+            "port_map": Attr("map(number)"),
+            "storage_opt": Attr("map(string)"),
+            "auth": Block({
+                "username": Attr("string"),
+                "password": Attr("string"),
+                "email": Attr("string"),
+                "server_address": Attr("string"),
+            }),
+            "logging": Block({
+                "type": Attr("string"),
+                "driver": Attr("string"),
+                "config": Attr("map(string)"),
+            }),
+            "mounts": BlockList({
+                "type": Attr("string"),
+                "target": Attr("string"),
+                "source": Attr("string"),
+                "readonly": Attr("bool"),
+                "volume_options": Block({
+                    "no_copy": Attr("bool"),
+                    "labels": Attr("map(string)"),
+                    "driver_config": Block({
+                        "name": Attr("string"),
+                        "options": Attr("map(string)"),
+                    }),
+                }),
+                "bind_options": Block({
+                    "propagation": Attr("string"),
+                }),
+                "tmpfs_options": Block({
+                    "size": Attr("number"),
+                    "mode": Attr("number"),
+                }),
+            }),
+            "devices": BlockList({
+                "host_path": Attr("string", required=True),
+                "container_path": Attr("string"),
+                "cgroup_permissions": Attr("string"),
+            }),
+        }
+
+    def validate_task_config(self, cfg: dict) -> dict:
+        from ..plugins.hclspec import SpecError, validate_spec
+
+        try:
+            return validate_spec(self.task_config_spec(), cfg or {})
+        except SpecError as e:
+            raise DockerConfigError(f"docker task {e}") from e
+
     def set_config(self, config: dict):
         super().set_config(config)
         if "image_gc_delay_s" in config:
@@ -265,7 +358,9 @@ class DockerDriver(Driver):
     def start_task(self, task: Task, task_dir: str) -> TaskHandle:
         if not self._healthy:
             raise RuntimeError("docker daemon is not available on this node")
-        cfg = task.config or {}
+        # typed-spec decode FIRST: a typo'd or mistyped stanza fails with
+        # the field's full path before any image pull is paid
+        cfg = self.validate_task_config(task.config or {})
         image = cfg.get("image")
         if not image:
             raise RuntimeError("docker requires an image")

@@ -65,3 +65,120 @@ def reset():
     with _LOCK:
         _COUNTERS.clear()
         _TIMERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Push sinks (the go-metrics FanoutSink role: the reference fans every
+# metric out to statsite/statsd/datadog/circonus sinks configured in the
+# telemetry stanza, command/agent/config.go:500-577). Pull via /v1/metrics
+# stays the primary surface; sinks PUSH the same registry on an interval.
+# ---------------------------------------------------------------------------
+
+
+class StatsdSink:
+    """statsd line-protocol over UDP (the go-metrics statsd sink role):
+    counters as ``name:delta|c``, timer means as ``name:ms|ms``. Deltas are
+    tracked per sink so restarts of the receiver don't double-count.
+    Datagrams are batched newline-separated under ~1400 bytes (one MTU)."""
+
+    MAX_DATAGRAM = 1400
+
+    def __init__(self, address: str, prefix: str = "nomad"):
+        import socket
+
+        host, _, port = address.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.prefix = prefix
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._last_counters: dict[str, float] = {}
+
+    def _fmt(self, name: str) -> str:
+        return f"{self.prefix}.{name}".replace(":", "_").replace("|", "_")
+
+    def emit(self, counters: dict, timers: dict):
+        lines = []
+        for name, total in sorted(counters.items()):
+            delta = total - self._last_counters.get(name, 0.0)
+            self._last_counters[name] = total
+            if delta:
+                lines.append(f"{self._fmt(name)}:{delta:g}|c")
+        for name, stats in sorted(timers.items()):
+            lines.append(f"{self._fmt(name)}.mean:{stats['mean_ms']:g}|ms")
+            lines.append(f"{self._fmt(name)}.p99:{stats['p99_ms']:g}|ms")
+        batch = b""
+        for line in lines:
+            data = line.encode()
+            if batch and len(batch) + 1 + len(data) > self.MAX_DATAGRAM:
+                self._send(batch)
+                batch = b""
+            batch = batch + b"\n" + data if batch else data
+        if batch:
+            self._send(batch)
+
+    def _send(self, payload: bytes):
+        try:
+            self._sock.sendto(payload, self.addr)
+        except OSError:
+            pass  # UDP telemetry is best-effort, never a failure source
+
+    def close(self):
+        self._sock.close()
+
+
+class SinkFlusher:
+    """Periodically snapshots the registry into every configured sink
+    (the collection_interval loop of the reference's telemetry setup)."""
+
+    def __init__(self, sinks, interval: float = 10.0):
+        self.sinks = list(sinks)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="metrics-sink-flusher"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self.flush()
+
+    def flush(self):
+        snap = snapshot()
+        for sink in self.sinks:
+            try:
+                sink.emit(snap["counters"], snap["timers"])
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:
+                pass
+
+
+def configure_telemetry(config: dict):
+    """Build + start the sink fan-out from an agent config's telemetry
+    stanza (ref command/agent/config.go:500-577: statsd_address,
+    collection_interval). Returns a running SinkFlusher or None."""
+    stanza = (config or {}).get("telemetry") or {}
+    sinks = []
+    addr = stanza.get("statsd_address")
+    if addr:
+        sinks.append(StatsdSink(str(addr)))
+    if not sinks:
+        return None
+    interval = stanza.get("collection_interval", 10.0)
+    if isinstance(interval, str):
+        from .jobspec.hcl import parse_duration
+
+        interval = parse_duration(interval) / 1e9
+    return SinkFlusher(sinks, interval=float(interval)).start()

@@ -34,35 +34,16 @@ LAUNCH_TIMEOUT = 10.0
 
 def validate_plugin_config(schema: dict, config: dict) -> dict:
     """Validate a plugin config against its declared schema and fold in
-    defaults (the hclspec role, plugins/shared/hclspec). Schema entries:
-    {key: {"type": "string"|"number"|"bool", "required": bool,
-    "default": value}}. Unknown keys and type mismatches raise."""
-    types = {
-        "string": (str,),
-        "number": (int, float),
-        "bool": (bool,),
-    }
-    out = {}
-    for key in config:
-        if key not in schema:
-            raise PluginError(f"unknown plugin config key {key!r}")
-    for key, spec in (schema or {}).items():
-        spec = spec or {}
-        if key in config:
-            value = config[key]
-            expected = types.get(spec.get("type", "string"), (object,))
-            if spec.get("type") == "number" and isinstance(value, bool):
-                raise PluginError(f"plugin config {key!r} must be a number")
-            if not isinstance(value, expected):
-                raise PluginError(
-                    f"plugin config {key!r} must be {spec.get('type')}"
-                )
-            out[key] = value
-        elif "default" in spec:
-            out[key] = spec["default"]
-        elif spec.get("required"):
-            raise PluginError(f"plugin config {key!r} is required")
-    return out
+    defaults (the hclspec role, plugins/shared/hclspec). Flat entries
+    ({key: {"type", "required", "default"}}) and typed nested spec nodes
+    (hclspec.Attr/Block/BlockList) both work; errors carry the failing
+    field's full path."""
+    from .hclspec import SpecError, validate_spec
+
+    try:
+        return validate_spec(schema or {}, config)
+    except SpecError as e:
+        raise PluginError(str(e))
 
 
 class PluginError(RuntimeError):

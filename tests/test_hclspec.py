@@ -1,0 +1,198 @@
+"""Typed plugin config specs (ref plugins/shared/hclspec/hcl_spec.proto:
+Attr/Block/BlockList/Default/Literal composition, pathed decode errors)."""
+
+import pytest
+
+from nomad_tpu.drivers.docker import DockerDriver
+from nomad_tpu.plugins.external import PluginError, validate_plugin_config
+from nomad_tpu.plugins.hclspec import (
+    Attr,
+    Block,
+    BlockList,
+    Default,
+    Literal,
+    SpecError,
+    validate_spec,
+)
+
+
+class TestAttrTypes:
+    def test_primitives(self):
+        spec = {
+            "name": Attr("string"),
+            "count": Attr("number"),
+            "on": Attr("bool"),
+        }
+        out = validate_spec(spec, {"name": "x", "count": 2.5, "on": True})
+        assert out == {"name": "x", "count": 2.5, "on": True}
+
+    def test_bool_is_not_a_number(self):
+        with pytest.raises(SpecError, match="count: must be number, got bool"):
+            validate_spec({"count": Attr("number")}, {"count": True})
+
+    def test_list_and_map_types(self):
+        spec = {"args": Attr("list(string)"), "env": Attr("map(string)")}
+        out = validate_spec(
+            spec, {"args": ["a", "b"], "env": {"K": "v"}}
+        )
+        assert out == {"args": ["a", "b"], "env": {"K": "v"}}
+
+    def test_list_element_error_carries_index(self):
+        with pytest.raises(SpecError, match=r"args\[1\]: must be string"):
+            validate_spec({"args": Attr("list(string)")}, {"args": ["a", 3]})
+
+    def test_map_value_error_carries_key(self):
+        with pytest.raises(SpecError, match=r"ports\.http: must be number"):
+            validate_spec(
+                {"ports": Attr("map(number)")}, {"ports": {"http": "80"}}
+            )
+
+
+class TestBlocks:
+    SPEC = {
+        "image": Attr("string", required=True),
+        "auth": Block({
+            "username": Attr("string"),
+            "password": Attr("string"),
+        }),
+        "mounts": BlockList({
+            "target": Attr("string", required=True),
+            "volume_options": Block({"labels": Attr("map(string)")}),
+        }),
+    }
+
+    def test_nested_decode(self):
+        out = validate_spec(self.SPEC, {
+            "image": "redis:7",
+            "auth": {"username": "u", "password": "p"},
+            "mounts": [
+                {"target": "/data",
+                 "volume_options": {"labels": {"a": "b"}}},
+            ],
+        })
+        assert out["mounts"][0]["volume_options"]["labels"] == {"a": "b"}
+
+    def test_single_block_accepted_for_block_list(self):
+        out = validate_spec(self.SPEC, {
+            "image": "redis:7", "mounts": {"target": "/data"},
+        })
+        assert out["mounts"] == [{"target": "/data"}]
+
+    def test_bad_nested_value_yields_pathed_error_not_keyerror(self):
+        with pytest.raises(
+            SpecError,
+            match=r"mounts\[0\]\.volume_options\.labels\.a: must be string",
+        ):
+            validate_spec(self.SPEC, {
+                "image": "redis:7",
+                "mounts": [
+                    {"target": "/d",
+                     "volume_options": {"labels": {"a": 1}}},
+                ],
+            })
+
+    def test_unknown_nested_key_pathed(self):
+        with pytest.raises(SpecError, match=r"auth\.passwrod: unknown"):
+            validate_spec(self.SPEC, {
+                "image": "x", "auth": {"passwrod": "oops"},
+            })
+
+    def test_missing_required_nested_field(self):
+        with pytest.raises(
+            SpecError, match=r"mounts\[0\]\.target: required"
+        ):
+            validate_spec(self.SPEC, {"image": "x", "mounts": [{}]})
+
+    def test_block_list_min_max(self):
+        spec = {"groups": BlockList({"name": Attr("string")}, min=1, max=2)}
+        with pytest.raises(SpecError, match="at least 1"):
+            validate_spec(spec, {"groups": []})
+        with pytest.raises(SpecError, match="at most 2"):
+            validate_spec(spec, {"groups": [{}, {}, {}]})
+
+
+class TestDefaultsAndLiterals:
+    def test_default_folds_when_absent(self):
+        spec = {"retries": Default(Attr("number"), 3)}
+        assert validate_spec(spec, {}) == {"retries": 3}
+        assert validate_spec(spec, {"retries": 5}) == {"retries": 5}
+
+    def test_literal_always_injected(self):
+        spec = {"version": Literal("v1")}
+        assert validate_spec(spec, {}) == {"version": "v1"}
+
+    def test_legacy_flat_schema_lifts(self):
+        out = validate_plugin_config(
+            {
+                "addr": {"type": "string", "required": True},
+                "port": {"type": "number", "default": 8080},
+            },
+            {"addr": "1.2.3.4"},
+        )
+        assert out == {"addr": "1.2.3.4", "port": 8080}
+        with pytest.raises(PluginError, match="addr: required"):
+            validate_plugin_config(
+                {"addr": {"type": "string", "required": True}}, {}
+            )
+        with pytest.raises(PluginError, match="bogus: unknown"):
+            validate_plugin_config({}, {"bogus": 1})
+
+
+class TestDockerTaskConfigSpec:
+    def test_full_valid_config_decodes(self):
+        drv = DockerDriver.__new__(DockerDriver)  # no docker binary probe
+        out = drv.validate_task_config({
+            "image": "redis:7",
+            "args": ["--maxmemory", "64mb"],
+            "port_map": {"db": 6379},
+            "labels": {"team": "infra"},
+            "auth": {"username": "u", "password": "p"},
+            "mounts": [
+                {"type": "volume", "target": "/data", "source": "vol1",
+                 "volume_options": {
+                     "no_copy": True,
+                     "driver_config": {
+                         "name": "local", "options": {"o": "bind"}
+                     },
+                 }},
+            ],
+            "devices": [{"host_path": "/dev/fuse"}],
+        })
+        assert out["port_map"] == {"db": 6379}
+        assert out["mounts"][0]["volume_options"]["no_copy"] is True
+
+    def test_bad_nested_docker_config_is_pathed(self):
+        drv = DockerDriver.__new__(DockerDriver)
+        with pytest.raises(
+            RuntimeError,
+            match=r"mounts\[0\]\.volume_options\.no_copy: must be bool",
+        ):
+            drv.validate_task_config({
+                "image": "redis:7",
+                "mounts": [
+                    {"target": "/d", "volume_options": {"no_copy": "yes"}},
+                ],
+            })
+
+    def test_devices_require_host_path(self):
+        drv = DockerDriver.__new__(DockerDriver)
+        with pytest.raises(
+            RuntimeError, match=r"devices\[0\]\.host_path: required"
+        ):
+            drv.validate_task_config(
+                {"image": "x", "devices": [{"container_path": "/dev/x"}]}
+            )
+
+    def test_typo_key_rejected_with_path(self):
+        drv = DockerDriver.__new__(DockerDriver)
+        with pytest.raises(RuntimeError, match="imge: unknown config key"):
+            drv.validate_task_config({"imge": "redis:7"})
+
+    def test_port_map_values_must_be_numbers(self):
+        drv = DockerDriver.__new__(DockerDriver)
+        with pytest.raises(
+            RuntimeError, match=r"port_map\.db: must be number"
+        ):
+            drv.validate_task_config(
+                {"image": "x", "port_map": {"db": "6379"}}
+            )

@@ -152,7 +152,7 @@ class TestPluginConfig:
             validate_plugin_config(schema, {"addr": "x", "bogus": 1})
         with pytest.raises(PluginError, match="must be number"):
             validate_plugin_config(schema, {"addr": "x", "retries": "five"})
-        with pytest.raises(PluginError, match="must be a number"):
+        with pytest.raises(PluginError, match="must be number"):
             validate_plugin_config(schema, {"addr": "x", "retries": True})
 
     def test_config_reaches_subprocess_plugin(self):

@@ -1,0 +1,120 @@
+"""Telemetry push-sink fan-out (ref command/agent/config.go:500-577: the
+reference fans metrics out to statsite/statsd/datadog sinks on a
+collection interval; pull via /v1/metrics remains primary)."""
+
+import socket
+import time
+
+from nomad_tpu import metrics
+
+
+def recv_lines(sock, deadline=5.0):
+    sock.settimeout(deadline)
+    lines = []
+    try:
+        data, _ = sock.recvfrom(65536)
+        lines.extend(data.decode().split("\n"))
+    except socket.timeout:
+        pass
+    return lines
+
+
+class TestStatsdSink:
+    def setup_method(self):
+        metrics.reset()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+
+    def teardown_method(self):
+        self.sock.close()
+        metrics.reset()
+
+    def test_counters_and_timers_reach_udp_listener(self):
+        metrics.incr("plan.submitted", 3)
+        metrics.sample("rpc.job_register", 0.012)
+        sink = metrics.StatsdSink(self.addr)
+        try:
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], snap["timers"])
+            lines = recv_lines(self.sock)
+            assert "nomad.plan.submitted:3|c" in lines
+            assert any(
+                l.startswith("nomad.rpc.job_register.mean:") and l.endswith("|ms")
+                for l in lines
+            )
+            assert any(
+                l.startswith("nomad.rpc.job_register.p99:") for l in lines
+            )
+        finally:
+            sink.close()
+
+    def test_counter_deltas_not_totals(self):
+        sink = metrics.StatsdSink(self.addr)
+        try:
+            metrics.incr("evals.processed", 5)
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})
+            assert "nomad.evals.processed:5|c" in recv_lines(self.sock)
+
+            metrics.incr("evals.processed", 2)
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})
+            # second flush carries only the delta, so the receiver's own
+            # accumulation stays correct
+            assert "nomad.evals.processed:2|c" in recv_lines(self.sock)
+
+            # no change -> nothing emitted for that counter
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})
+            assert not any(
+                "evals.processed" in l for l in recv_lines(self.sock, 0.5)
+            )
+        finally:
+            sink.close()
+
+    def test_large_batches_split_under_mtu(self):
+        for i in range(200):
+            metrics.incr(f"bulk.counter_{i:03d}")
+        sink = metrics.StatsdSink(self.addr)
+        try:
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})
+            got = set()
+            self.sock.settimeout(2.0)
+            try:
+                while len(got) < 200:
+                    data, _ = self.sock.recvfrom(65536)
+                    assert len(data) <= metrics.StatsdSink.MAX_DATAGRAM
+                    got.update(
+                        l.split(":")[0] for l in data.decode().split("\n")
+                    )
+            except socket.timeout:
+                pass
+            assert len(got) == 200
+        finally:
+            sink.close()
+
+    def test_configure_telemetry_flushes_on_interval(self):
+        flusher = metrics.configure_telemetry(
+            {"telemetry": {
+                "statsd_address": self.addr,
+                "collection_interval": 0.05,
+            }}
+        )
+        assert flusher is not None
+        try:
+            metrics.incr("flusher.ticks", 7)
+            deadline = time.monotonic() + 5
+            seen = []
+            while time.monotonic() < deadline:
+                seen = recv_lines(self.sock, 1.0)
+                if "nomad.flusher.ticks:7|c" in seen:
+                    break
+            assert "nomad.flusher.ticks:7|c" in seen, seen
+        finally:
+            flusher.stop()
+
+    def test_configure_telemetry_absent_stanza_is_none(self):
+        assert metrics.configure_telemetry({}) is None
+        assert metrics.configure_telemetry({"telemetry": {}}) is None

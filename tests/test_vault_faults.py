@@ -1,0 +1,249 @@
+"""Vault fault injection (ref nomad/vault.go: the renewal loop backs off
+on failures and task-token derivation surfaces errors, never hangs).
+Covers the three fault classes: 5xx storms, request timeouts, and a
+management-token expiry race."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu.core.vault import HTTPProvider
+
+
+class FaultyVault:
+    """A fake Vault whose failure mode is switchable at runtime:
+    ``mode`` in {"ok", "5xx", "hang", "expired"}. Records the monotonic
+    time of every renew-self attempt so backoff timing is assertable."""
+
+    def __init__(self):
+        self.mode = "ok"
+        self.renew_times: list[float] = []
+        self.renew_ok = 0
+        self.counter = 0
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                if self.path == "/v1/auth/token/renew-self":
+                    fake.renew_times.append(time.monotonic())
+                if fake.mode == "hang":
+                    time.sleep(1.0)  # beyond the provider timeout
+                    return self._json(200, {"auth": {}})
+                if fake.mode == "5xx":
+                    return self._json(
+                        500, {"errors": ["internal server error"]}
+                    )
+                if fake.mode == "expired":
+                    return self._json(403, {"errors": ["permission denied"]})
+                if self.path == "/v1/auth/token/create":
+                    fake.counter += 1
+                    return self._json(200, {
+                        "auth": {
+                            "client_token": f"s.tok{fake.counter}",
+                            "accessor": f"acc-{fake.counter}",
+                        }
+                    })
+                if self.path == "/v1/auth/token/renew-self":
+                    fake.renew_ok += 1
+                    return self._json(200, {"auth": {}})
+                if self.path == "/v1/auth/token/revoke-accessor":
+                    return self._json(200, {})
+                self._json(404, {"errors": ["no handler"]})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = "http://127.0.0.1:%d" % self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture
+def vault():
+    v = FaultyVault()
+    yield v
+    v.stop()
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestRenewalBackoff:
+    def test_5xx_storm_backs_off_then_recovers(self, vault):
+        # healthy cadence 0.4s; failure backoff starts at 0.05s
+        p = HTTPProvider(
+            vault.address, "root", renew_interval=0.4,
+            backoff_base=0.05, timeout=2.0,
+        )
+        vault.mode = "5xx"
+        p.start_renewal()
+        try:
+            # backoff retries are FASTER than the healthy interval: after
+            # the first scheduled renewal fails, retries land at 0.05,
+            # 0.1, 0.2, ... — so >= 4 attempts arrive well inside two
+            # healthy intervals
+            wait_until(
+                lambda: len(vault.renew_times) >= 4,
+                timeout=3.0, msg="backoff retries",
+            )
+            assert p.consecutive_failures >= 3
+            assert "internal server error" in (p.last_renewal_error or "")
+            # the first backoff gap is far below the healthy interval
+            gaps = [
+                b - a
+                for a, b in zip(vault.renew_times, vault.renew_times[1:])
+            ]
+            assert min(gaps) < 0.3, gaps
+
+            # heal: the loop recovers and resets its failure counter
+            vault.mode = "ok"
+            wait_until(
+                lambda: vault.renew_ok >= 1 and p.consecutive_failures == 0,
+                timeout=3.0, msg="renewal recovery",
+            )
+            assert p.last_renewal_error is None
+        finally:
+            p.stop()
+
+    def test_timeouts_are_survived_and_reported(self, vault):
+        p = HTTPProvider(
+            vault.address, "root", renew_interval=0.2,
+            backoff_base=0.05, timeout=0.2,
+        )
+        vault.mode = "hang"
+        p.start_renewal()
+        try:
+            wait_until(
+                lambda: p.consecutive_failures >= 2,
+                timeout=6.0, msg="timeout failures recorded",
+            )
+            assert "timed out" in (p.last_renewal_error or "").lower()
+            vault.mode = "ok"
+            wait_until(
+                lambda: p.consecutive_failures == 0 and vault.renew_ok >= 1,
+                timeout=6.0, msg="recovery after timeouts",
+            )
+        finally:
+            p.stop()
+
+    def test_token_expiry_race(self, vault):
+        """The management token expires server-side mid-flight: renewals
+        403 forever, derivation fails fast with the Vault error — neither
+        hangs nor crashes the loop."""
+        p = HTTPProvider(
+            vault.address, "root", renew_interval=0.2,
+            backoff_base=0.05, timeout=2.0,
+        )
+        p.start_renewal()
+        try:
+            # a token derives fine while the management token is live
+            token, accessor = p.create_token(["app"])
+            assert token and accessor
+
+            vault.mode = "expired"
+            wait_until(
+                lambda: p.consecutive_failures >= 2,
+                timeout=6.0, msg="expiry failures recorded",
+            )
+            assert "permission denied" in (p.last_renewal_error or "")
+            with pytest.raises(RuntimeError, match="permission denied"):
+                p.create_token(["app"])
+        finally:
+            p.stop()
+
+
+class TestDeriveFaults:
+    def test_create_token_timeout_raises_not_hangs(self, vault):
+        p = HTTPProvider(vault.address, "root", timeout=0.2)
+        vault.mode = "hang"
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="vault auth/token/create"):
+            p.create_token(["app"])
+        assert time.monotonic() - t0 < 2.0
+
+    def test_connection_refused_is_retriable_error(self):
+        p = HTTPProvider("http://127.0.0.1:1", "root", timeout=0.5)
+        with pytest.raises(RuntimeError, match="vault auth/token/create"):
+            p.create_token(["app"])
+
+
+class TestVaultTaskHookUnderFaults:
+    def test_task_with_vault_stanza_fails_cleanly_when_vault_down(
+        self, vault, tmp_path
+    ):
+        """End-to-end: the server's Vault is expired; a task with a vault
+        stanza fails its prestart hook through the restart policy instead
+        of wedging the alloc (ref vault_hook.go failure path)."""
+        from nomad_tpu import mock
+        from nomad_tpu.agent import DevAgent
+        from nomad_tpu.structs.model import Vault
+
+        vault.mode = "expired"
+        agent = DevAgent(
+            num_clients=1,
+            server_config={
+                "seed": 7,
+                "vault": {
+                    "enabled": True,
+                    "address": vault.address,
+                    "token": "root",
+                    "renew_interval_s": 300,
+                },
+            },
+        )
+        agent.start()
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "mock_driver"
+            task.config = {"run_for": 5}
+            task.resources.networks = []
+            task.vault = Vault(policies=["app-secrets"])
+            tg.restart_policy.attempts = 0
+            tg.restart_policy.mode = "fail"
+            tg.reschedule_policy.attempts = 0
+            tg.reschedule_policy.unlimited = False
+            agent.run_job(job)
+
+            def failed_with_vault_event():
+                allocs = agent.state.allocs_by_job(job.namespace, job.id)
+                for a in allocs:
+                    ts = a.task_states.get(task.name)
+                    if ts is None or not ts.failed:
+                        continue
+                    return any(
+                        "vault" in e.get("message", "").lower()
+                        or "permission denied" in e.get("message", "")
+                        for e in ts.events
+                    )
+                return False
+
+            wait_until(
+                failed_with_vault_event,
+                timeout=20.0,
+                msg="task fails with a vault-derivation event",
+            )
+        finally:
+            agent.stop()
