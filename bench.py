@@ -384,15 +384,27 @@ def bench_headline():
     run_once(state, job)
     warm = dict(batch_sched.LAST_KERNEL_STATS)
 
-    # steady-state latency: best of 5 (samples reported for transparency —
-    # the shared bench chip's load varies run to run, and the steady-state
-    # minimum is the honest latency of the program itself)
+    # steady-state latency: best of 5, with EVERY sample's stage split
+    # recorded (kernel / columnar prep / host-side materialization) so an
+    # outlier sample is attributable — chip contention inflates kernel_s,
+    # a recompile shows up as a kernel_s spike on one sample only, and a
+    # GC/materialization tail inflates other_s with kernel_s flat
     samples = []
+    samples_detail = []
     elapsed, placed_fast, stats = None, None, None
     for _ in range(5):
         t, placed = run_once(state, job)
         s = dict(batch_sched.LAST_KERNEL_STATS)
         samples.append(round(t, 4))
+        k = s.get("kernel_s", 0.0)
+        c = s.get("columnar_s", 0.0)
+        samples_detail.append({
+            "total_s": round(t, 4),
+            "kernel_s": round(k, 4),
+            "columnar_s": round(c, 4),
+            "other_s": round(max(t - k - c, 0.0), 4),
+            "mode": s.get("mode"),
+        })
         if elapsed is None or t < elapsed:
             elapsed, placed_fast, stats = t, placed, s
 
@@ -456,9 +468,13 @@ def bench_headline():
         pin_keys, pin_match = [], 0
         oracle_s, parity_oracle = 0.0, 0.0
 
+    ordered = sorted(samples)
     return {
         "end_to_end_s": round(elapsed, 4),
         "samples_s": samples,
+        "samples_detail": samples_detail,
+        "median_s": round(ordered[len(ordered) // 2], 4),
+        "worst_s": round(ordered[-1], 4),
         "placed": len(placed_fast),
         "kernel_s": round(stats.get("kernel_s", 0.0), 4),
         "columnar_s": round(stats.get("columnar_s", 0.0), 4),
@@ -613,6 +629,9 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
     from nomad_tpu.tpu import drain as drain_mod
 
     drain_mod.DRAIN_COUNTERS.update(batches=0, evals=0)
+    from nomad_tpu import metrics as metrics_mod
+
+    metrics_mod.reset()  # per-run stage timers
     cfg = {
         "seed": 42,
         "heartbeat_ttl": 600.0,
@@ -670,6 +689,16 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
         placed = sum(
             len(server.state.allocs_by_job(j.namespace, j.id)) for j in jobs
         )
+        # per-stage timers (plan.queue_wait / plan.evaluate /
+        # plan.raft_apply / plan.submit / worker.invoke): the breakdown
+        # that names the saturation stage instead of guessing at it
+        from nomad_tpu import metrics as metrics_mod
+
+        stages = {
+            k: v
+            for k, v in metrics_mod.snapshot()["timers"].items()
+            if k.startswith("plan.") or k.startswith("worker.")
+        }
         return {
             "jobs": n_jobs,
             "nodes": n_nodes,
@@ -684,6 +713,7 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
             "plan_queue_depth_mean": round(
                 sum(depth_samples) / max(len(depth_samples), 1), 2
             ),
+            "stages": stages,
         }
     finally:
         stop_sampler.set()

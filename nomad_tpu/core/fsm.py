@@ -54,6 +54,7 @@ ALLOC_UPDATE = "alloc_update"
 ALLOC_CLIENT_UPDATE = "alloc_client_update"
 ALLOC_DESIRED_TRANSITION = "alloc_desired_transition"
 APPLY_PLAN_RESULTS = "apply_plan_results"
+APPLY_PLAN_RESULTS_BATCH = "apply_plan_results_batch"
 DEPLOYMENT_STATUS_UPDATE = "deployment_status_update"
 DEPLOYMENT_PROMOTE = "deployment_promote"
 DEPLOYMENT_ALLOC_HEALTH = "deployment_alloc_health"
@@ -104,6 +105,7 @@ class FSM:
             ALLOC_CLIENT_UPDATE: self._apply_alloc_client_update,
             ALLOC_DESIRED_TRANSITION: self._apply_alloc_desired_transition,
             APPLY_PLAN_RESULTS: self._apply_plan_results,
+            APPLY_PLAN_RESULTS_BATCH: self._apply_plan_results_batch,
             DEPLOYMENT_STATUS_UPDATE: self._apply_deployment_status_update,
             DEPLOYMENT_PROMOTE: self._apply_deployment_promote,
             DEPLOYMENT_ALLOC_HEALTH: self._apply_deployment_alloc_health,
@@ -349,6 +351,18 @@ class FSM:
     # ------------------------------------------------------------------
     # plan apply (ref fsm.go applyPlanResults → UpsertPlanResults)
     # ------------------------------------------------------------------
+    def _apply_plan_results_batch(self, index: int, payload: dict):
+        """Several independent verified plans committed in ONE raft entry
+        (one fsync, one consensus round-trip): the applier batches queued
+        plans it has verified against stacked optimistic snapshots, so the
+        sequential application here reproduces exactly the world each was
+        verified against (ref plan_apply.go:49-180 — the reference keeps
+        one commit in flight; batching amortizes the consensus cost the
+        same way its async applyPlan pipelining does)."""
+        for item in payload.get("plans", []):
+            self._apply_plan_results(index, item)
+        return index
+
     def _apply_plan_results(self, index: int, payload: dict):
         plan = Plan.from_dict(payload["plan"])
         if payload.get("normalized"):

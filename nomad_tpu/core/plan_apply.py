@@ -37,6 +37,7 @@ class PendingPlan:
         self.plan = plan
         self.result: Optional[PlanResult] = None
         self.error: Optional[Exception] = None
+        self.enqueued_at = time.monotonic()
         self._done = threading.Event()
 
     def respond(self, result: Optional[PlanResult], error: Optional[Exception]):
@@ -98,9 +99,28 @@ class PlanQueue:
                 self._cond.wait(remaining if remaining is not None else 1.0)
             return heapq.heappop(self._heap)[2]
 
-    def depth(self) -> int:
+    def drain(self, max_n: int) -> list[PendingPlan]:
+        """Pop up to ``max_n`` already-queued plans without waiting — the
+        applier batches whatever has accumulated behind the plan it just
+        dequeued into one consensus round."""
+        out: list[PendingPlan] = []
         with self._lock:
-            return len(self._heap)
+            while self._heap and len(out) < max_n:
+                out.append(heapq.heappop(self._heap)[2])
+        return out
+
+    def requeue(self, pendings: list[PendingPlan]):
+        """Return unprocessed plans to the queue (rare applier bail-out)."""
+        with self._lock:
+            if not self.enabled:
+                for p in pendings:
+                    p.respond(None, RuntimeError("plan queue is disabled"))
+                return
+            for p in pendings:
+                heapq.heappush(
+                    self._heap, (-p.plan.priority, next(self._counter), p)
+                )
+            self._cond.notify_all()
 
 
 def evaluate_node_plan(
@@ -318,6 +338,9 @@ class Planner:
         # raft ApplyPlanResults instead of written directly (plan_apply.go
         # applyPlan → raftApplyFuture).
         self.commit_fn = None
+        # batch commit hook: ([(plan, result, preemption_evals)]) -> index;
+        # commits several independently-verified plans in ONE raft entry.
+        self.commit_batch_fn = None
 
     def start(self):
         self.queue.set_enabled(True)
@@ -331,13 +354,46 @@ class Planner:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
+    #: max plans folded into one consensus round; bounded so a commit
+    #: failure (which fails the whole batch) stays cheap to retry
+    MAX_APPLY_BATCH = 16
+
+    def _verify_batch(self, live, snap):
+        """Verify each plan against the CUMULATIVE optimistic snapshot so
+        later plans in the batch can't double-book capacity earlier ones
+        took. Returns (entries, snap, leftovers): entries =
+        [(pending, result)] to commit, snap = the stacked snapshot, and
+        leftovers = plans to requeue if optimistic stacking ever fails
+        mid-batch (verifying them against a snapshot missing an accepted
+        sibling would double-book)."""
+        entries = []
+        for i, p in enumerate(live):
+            try:
+                with metrics.measure("plan.evaluate"):
+                    result = evaluate_plan(snap, p.plan)
+            except Exception as e:
+                p.respond(None, e)
+                continue
+            if result.is_no_op() and result.refresh_index:
+                p.respond(result, None)
+                continue
+            entries.append((p, result))
+            try:
+                snap = self._optimistic_snapshot(snap, p.plan, result)
+            except Exception:
+                return entries, snap, live[i + 1:]
+        return entries, snap, []
+
     def _apply_loop(self):
         """Overlap verify(N+1) with raft-apply(N) (ref plan_apply.go:49-180):
-        after dispatching plan N's commit asynchronously, plan N+1 is
+        after dispatching batch N's commit asynchronously, batch N+1 is
         verified against an OPTIMISTIC snapshot that already contains N's
-        result — so back-to-back plans can't double-book capacity while the
-        consensus round-trip is in flight. The submitting worker is answered
-        only after its commit really lands (unhappy-path safety)."""
+        results — so back-to-back plans can't double-book capacity while
+        the consensus round-trip is in flight. Queued plans that piled up
+        behind the head are folded into ONE raft entry (MAX_APPLY_BATCH),
+        amortizing the fsync + consensus round-trip that otherwise caps
+        the applier at ~1/commit-latency plans per second. The submitting
+        workers are answered only after their commit really lands."""
         outstanding: Optional[tuple[threading.Thread, dict]] = None
         prev_index = 0
         snap: Optional[StateSnapshot] = None
@@ -348,18 +404,28 @@ class Planner:
         snap_base_index = 0
 
         while not self._stop.is_set():
-            pending = self.queue.dequeue(timeout=0.2)
-            if pending is None:
+            head = self.queue.dequeue(timeout=0.2)
+            if head is None:
                 continue
-
-            if self.token_check_fn is not None and not self.token_check_fn(
-                pending.plan
-            ):
-                # the submitting worker gave up (timeout) and its eval moved
-                # on — committing this orphan would double-place the eval
-                pending.respond(
-                    None, RuntimeError("plan rejected: eval token no longer live")
-                )
+            batch = [head] + self.queue.drain(self.MAX_APPLY_BATCH - 1)
+            now = time.monotonic()
+            live = []
+            for p in batch:
+                # time spent waiting for the applier: the stage that names
+                # the saturation point when workers outrun the commit
+                metrics.sample("plan.queue_wait", now - p.enqueued_at)
+                if self.token_check_fn is not None and not self.token_check_fn(
+                    p.plan
+                ):
+                    # the submitting worker gave up (timeout) and its eval
+                    # moved on — committing the orphan would double-place
+                    p.respond(
+                        None,
+                        RuntimeError("plan rejected: eval token no longer live"),
+                    )
+                else:
+                    live.append(p)
+            if not live:
                 continue
 
             # harvest a commit that finished while we were idle
@@ -368,11 +434,12 @@ class Planner:
                 outstanding = None
                 snap = None
 
-            min_index = max(prev_index, pending.plan.snapshot_index)
+            batch_min = max(p.plan.snapshot_index for p in live)
+            min_index = max(prev_index, batch_min)
             if snap is not None and snap_base_index < min_index:
                 snap = None
             if snap is None:
-                # a replacement snapshot must contain the in-flight plan's
+                # a replacement snapshot must contain the in-flight batch's
                 # placements — unrelated writes advancing the store index
                 # would otherwise satisfy min_index with a snapshot that
                 # misses them and double-books their capacity
@@ -380,22 +447,19 @@ class Planner:
                     outstanding[0].join()
                     prev_index = max(prev_index, outstanding[1].get("index", 0))
                     outstanding = None
-                    min_index = max(prev_index, pending.plan.snapshot_index)
+                    min_index = max(prev_index, batch_min)
                 try:
                     snap = self.state.snapshot_min_index(min_index, timeout=5.0)
                     snap_base_index = snap.latest_index()
                 except Exception as e:
-                    pending.respond(None, e)
+                    for p in live:
+                        p.respond(None, e)
                     continue
 
-            try:
-                with metrics.measure("plan.evaluate"):
-                    result = evaluate_plan(snap, pending.plan)
-            except Exception as e:
-                pending.respond(None, e)
-                continue
-            if result.is_no_op() and result.refresh_index:
-                pending.respond(result, None)
+            entries, snap, leftovers = self._verify_batch(live, snap)
+            if leftovers:
+                self.queue.requeue(leftovers)
+            if not entries:
                 continue
 
             # one commit in flight at a time: wait out the previous one and
@@ -406,37 +470,46 @@ class Planner:
                 prev_index = max(prev_index, committed)
                 outstanding = None
                 try:
-                    snap = self.state.snapshot_min_index(
-                        max(prev_index, pending.plan.snapshot_index), timeout=5.0
+                    fresh = self.state.snapshot_min_index(
+                        max(
+                            prev_index,
+                            max(p.plan.snapshot_index for p, _ in entries),
+                        ),
+                        timeout=5.0,
                     )
-                    snap_base_index = snap.latest_index()
                 except Exception as e:
-                    pending.respond(None, e)
+                    for p, _ in entries:
+                        p.respond(None, e)
                     continue
+                snap_base_index = fresh.latest_index()
                 if not committed:
-                    # the previous commit FAILED: this plan was verified
+                    # the previous commit FAILED: this batch was verified
                     # against an optimistic world that never materialized —
                     # re-verify against reality before committing
+                    entries, snap, leftovers = self._verify_batch(
+                        [p for p, _ in entries], fresh
+                    )
+                    if leftovers:
+                        self.queue.requeue(leftovers)
+                    if not entries:
+                        continue
+                else:
+                    # re-base: the fresh snapshot holds the committed batch
+                    # for real; stack this batch's results back on top for
+                    # the next iteration's verify base
+                    snap = fresh
                     try:
-                        with metrics.measure("plan.evaluate"):
-                            result = evaluate_plan(snap, pending.plan)
-                    except Exception as e:
-                        pending.respond(None, e)
-                        continue
-                    if result.is_no_op() and result.refresh_index:
-                        pending.respond(result, None)
-                        continue
-
-            # next iteration verifies against this plan's optimistic world
-            try:
-                snap = self._optimistic_snapshot(snap, pending.plan, result)
-            except Exception:
-                snap = None  # fall back to a fresh snapshot next round
+                        for p, result in entries:
+                            snap = self._optimistic_snapshot(
+                                snap, p.plan, result
+                            )
+                    except Exception:
+                        snap = None  # fresh snapshot next round
 
             box: dict = {}
             t = threading.Thread(
-                target=self._async_commit,
-                args=(pending, result, box),
+                target=self._async_commit_batch,
+                args=(entries, box),
                 daemon=True,
             )
             t.start()
@@ -457,6 +530,48 @@ class Planner:
         scratch.upsert_plan_results(None, plan, result)
         return scratch.snapshot()
 
+    def _async_commit_batch(
+        self, entries: list[tuple[PendingPlan, PlanResult]], box: dict
+    ):
+        """Commit a batch of verified results in one consensus round and
+        answer every submitting worker (ref plan_apply.go:367
+        asyncPlanWait; batching amortizes the raft fsync)."""
+        try:
+            items = []
+            for pending, result in entries:
+                preemption_evals: list[Evaluation] = []
+                if (
+                    self.preemption_evals_fn is not None
+                    and result.node_preemptions
+                ):
+                    preemption_evals = self.preemption_evals_fn(result)
+                items.append((pending.plan, result, preemption_evals))
+            if self.commit_batch_fn is not None:
+                with metrics.measure("plan.raft_apply"):
+                    index = self.commit_batch_fn(items)
+            elif self.commit_fn is not None:
+                with metrics.measure("plan.raft_apply"):
+                    index = 0
+                    for plan, result, pevals in items:
+                        index = self.commit_fn(plan, result, pevals)
+            else:
+                index = 0
+                for plan, result, pevals in items:
+                    index = self.state.upsert_plan_results(
+                        None, plan, result, preemption_evals=pevals
+                    )
+                    if pevals and self.on_preemption_evals is not None:
+                        self.on_preemption_evals(
+                            [self.state.eval_by_id(e.id) for e in pevals]
+                        )
+            box["index"] = index
+            for pending, result in entries:
+                result.alloc_index = index
+                pending.respond(result, None)
+        except Exception as e:
+            for pending, _ in entries:
+                pending.respond(None, e)
+
     def _async_commit(self, pending: PendingPlan, result: PlanResult, box: dict):
         """Commit the verified result via consensus and answer the worker
         (ref plan_apply.go:367 asyncPlanWait)."""
@@ -466,7 +581,7 @@ class Planner:
             if self.preemption_evals_fn is not None and result.node_preemptions:
                 preemption_evals = self.preemption_evals_fn(result)
             if self.commit_fn is not None:
-                with metrics.measure("plan.apply"):
+                with metrics.measure("plan.raft_apply"):
                     index = self.commit_fn(plan, result, preemption_evals)
             else:
                 index = self.state.upsert_plan_results(

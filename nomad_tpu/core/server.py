@@ -90,6 +90,7 @@ class Server:
         )
         self.planner = Planner(self.state)
         self.planner.commit_fn = self._commit_plan
+        self.planner.commit_batch_fn = self._commit_plan_batch
         self.planner.preemption_evals_fn = self._make_preemption_evals
         self.planner.token_check_fn = self._plan_token_live
         self.workers: list[Worker] = []
@@ -561,8 +562,28 @@ class Server:
             dispatcher.restore(self.state)
 
     def _commit_plan(self, plan, result, preemption_evals):
-        """Replicate the verified plan result — NORMALIZED (the reference's
-        plan normalization for raft-log size, structs.go Plan.NormalizeAllocations):
+        """Replicate one verified plan result via consensus."""
+        return self._apply(
+            fsm_mod.APPLY_PLAN_RESULTS,
+            self._plan_payload(plan, result, preemption_evals),
+        )
+
+    def _commit_plan_batch(self, items):
+        """Replicate several independently-verified plan results in ONE
+        raft entry (one fsync + round-trip for the whole batch; the FSM
+        applies them sequentially). ``items`` =
+        [(plan, result, preemption_evals), ...] in verify order."""
+        if len(items) == 1:
+            return self._commit_plan(*items[0])
+        return self._apply(
+            fsm_mod.APPLY_PLAN_RESULTS_BATCH,
+            {"plans": [self._plan_payload(*item) for item in items]},
+        )
+
+    def _plan_payload(self, plan, result, preemption_evals) -> dict:
+        """The raft payload for a verified plan result — NORMALIZED (the
+        reference's plan normalization for raft-log size, structs.go
+        Plan.NormalizeAllocations):
         the plan ships without its alloc maps (the result carries the
         verified subset), and stopped/preempted allocs ship as id+field
         diffs the FSM rehydrates from each replica's own state, since the
@@ -624,15 +645,12 @@ class Server:
             ],
             "refresh_index": result.refresh_index,
         }
-        return self._apply(
-            fsm_mod.APPLY_PLAN_RESULTS,
-            {
-                "plan": slim_plan.to_dict(),
-                "result": result_doc,
-                "normalized": True,
-                "preemption_evals": [e.to_dict() for e in preemption_evals],
-            },
-        )
+        return {
+            "plan": slim_plan.to_dict(),
+            "result": result_doc,
+            "normalized": True,
+            "preemption_evals": [e.to_dict() for e in preemption_evals],
+        }
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -220,7 +220,7 @@ class TestStageTimers:
                 "worker.invoke_scheduler.service",
                 "plan.evaluate",
                 "plan.submit",
-                "plan.apply",
+                "plan.raft_apply",
             ):
                 assert stage in timers, f"missing stage timer {stage}"
                 assert timers[stage]["count"] >= 1

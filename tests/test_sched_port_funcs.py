@@ -1,0 +1,472 @@
+"""Core-funcs + NetworkIndex corpus ported from the reference
+(nomad/structs/funcs_test.go and network_test.go — cited per test; the
+_Old COMPAT variants target the legacy pre-0.9 resource structs this
+framework never had and are deliberately not ported)."""
+
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.structs.funcs import allocs_fit, score_fit
+from nomad_tpu.structs.network import NetworkIndex
+from nomad_tpu.structs.model import (
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    AllocatedCpuResources,
+    AllocatedDeviceResource,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    NetworkResource,
+    Node,
+    NodeCpuResources,
+    NodeDiskResources,
+    NodeMemoryResources,
+    NodeReservedNetworkResources,
+    NodeReservedResources,
+    NodeResources,
+    Port,
+    filter_terminal_allocs,
+    remove_allocs,
+)
+
+
+class TestRemoveAllocsPort:
+    def test_removes_by_id(self):
+        # ref TestRemoveAllocs (funcs_test.go:14)
+        l = [Allocation(id=i) for i in ("foo", "bar", "baz", "zip")]
+        out = remove_allocs(l, [l[1], l[3]])
+        assert [a.id for a in out] == ["foo", "baz"]
+
+
+class TestFilterTerminalAllocsPort:
+    def test_splits_live_and_latest_terminal_by_name(self):
+        # ref TestFilterTerminalAllocs (funcs_test.go:31)
+        l = [
+            Allocation(id="bar", name="myname1", desired_status="evict"),
+            Allocation(id="baz", desired_status="stop"),
+            Allocation(
+                id="foo", desired_status="run", client_status="pending"
+            ),
+            Allocation(
+                id="bam", name="myname", desired_status="run",
+                client_status="complete", create_index=5,
+            ),
+            Allocation(
+                id="lol", name="myname", desired_status="run",
+                client_status="complete", create_index=2,
+            ),
+        ]
+        out, terminal = filter_terminal_allocs(l)
+        assert [a.id for a in out] == ["foo"]
+        assert len(terminal) == 3
+        # the HIGHEST create_index terminal alloc wins per name
+        assert terminal["myname"].id == "bam"
+
+
+def fit_node():
+    """funcs_test.go:273: 2000cpu/2048mem/10000disk minus 1000/1024/5000
+    reserved, one eth0 NIC, host port 80 reserved."""
+    return Node(
+        id="fit-node",
+        node_resources=NodeResources(
+            cpu=NodeCpuResources(cpu_shares=2000),
+            memory=NodeMemoryResources(memory_mb=2048),
+            disk=NodeDiskResources(disk_mb=10000),
+            networks=[
+                NetworkResource(
+                    device="eth0", cidr="10.0.0.0/8", ip="10.0.0.1",
+                    mbits=100,
+                )
+            ],
+        ),
+        reserved_resources=NodeReservedResources(
+            cpu=NodeCpuResources(cpu_shares=1000),
+            memory=NodeMemoryResources(memory_mb=1024),
+            disk=NodeDiskResources(disk_mb=5000),
+            networks=NodeReservedNetworkResources(reserved_host_ports="80"),
+        ),
+    )
+
+
+def fit_alloc(reserved_port_to=0):
+    return Allocation(
+        id="a1",
+        allocated_resources=AllocatedResources(
+            tasks={
+                "web": AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=1000),
+                    memory=AllocatedMemoryResources(memory_mb=1024),
+                    networks=[
+                        NetworkResource(
+                            device="eth0", ip="10.0.0.1", mbits=50,
+                            reserved_ports=[
+                                Port(
+                                    label="main", value=8000,
+                                    to=reserved_port_to,
+                                )
+                            ],
+                        )
+                    ],
+                )
+            },
+            shared=AllocatedSharedResources(disk_mb=5000),
+        ),
+    )
+
+
+class TestAllocsFitPort:
+    def test_one_fits_two_do_not(self):
+        # ref TestAllocsFit (funcs_test.go:273)
+        n = fit_node()
+        a1 = fit_alloc()
+        fit, _, used = allocs_fit(n, [a1], None, False)
+        assert fit
+        assert used.flattened.cpu.cpu_shares == 2000
+        assert used.flattened.memory.memory_mb == 2048
+
+        fit, _, used = allocs_fit(n, [a1, a1], None, False)
+        assert not fit
+        assert used.flattened.cpu.cpu_shares == 3000
+        assert used.flattened.memory.memory_mb == 3072
+
+    def test_terminal_alloc_does_not_count(self):
+        # ref TestAllocsFit_TerminalAlloc (funcs_test.go:356)
+        n = fit_node()
+        a1 = fit_alloc(reserved_port_to=80)
+        fit, _, used = allocs_fit(n, [a1], None, False)
+        assert fit
+        a2 = a1.copy()
+        a2.id = "a2"
+        a2.desired_status = "stop"
+        fit, dim, used = allocs_fit(n, [a1, a2], None, False)
+        assert fit, dim
+        assert used.flattened.cpu.cpu_shares == 2000
+        assert used.flattened.memory.memory_mb == 2048
+
+    def test_device_collision_detected_when_enabled(self):
+        # ref TestAllocsFit_Devices (funcs_test.go:443)
+        n = mock.nvidia_node()
+        dev_id = n.node_resources.devices[0].instances[0].id
+
+        def gpu_alloc(aid):
+            return Allocation(
+                id=aid,
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=1000),
+                            memory=AllocatedMemoryResources(memory_mb=1024),
+                            devices=[
+                                AllocatedDeviceResource(
+                                    type="gpu", vendor="nvidia",
+                                    name="1080ti", device_ids=[dev_id],
+                                )
+                            ],
+                        )
+                    },
+                    shared=AllocatedSharedResources(disk_mb=5000),
+                ),
+            )
+
+        a1, a2 = gpu_alloc("a1"), gpu_alloc("a2")
+        fit, _, _ = allocs_fit(n, [a1], None, True)
+        assert fit
+        fit, msg, _ = allocs_fit(n, [a1, a2], None, True)
+        assert not fit
+        assert msg == "device oversubscribed"
+        # with device checking disabled the collision goes unnoticed
+        fit, _, _ = allocs_fit(n, [a1, a2], None, False)
+        assert fit
+
+
+class TestScoreFitPort:
+    def _node(self):
+        return Node(
+            node_resources=NodeResources(
+                cpu=NodeCpuResources(cpu_shares=4096),
+                memory=NodeMemoryResources(memory_mb=8192),
+            ),
+            reserved_resources=NodeReservedResources(
+                cpu=NodeCpuResources(cpu_shares=2048),
+                memory=NodeMemoryResources(memory_mb=4096),
+            ),
+        )
+
+    def _util(self, cpu, mem):
+        from nomad_tpu.structs.model import ComparableResources
+
+        return ComparableResources(
+            flattened=AllocatedTaskResources(
+                cpu=AllocatedCpuResources(cpu_shares=cpu),
+                memory=AllocatedMemoryResources(memory_mb=mem),
+            )
+        )
+
+    def test_perfect_worst_and_mid_fit(self):
+        # ref TestScoreFit (funcs_test.go:569)
+        node = self._node()
+        assert score_fit(node, self._util(2048, 4096)) == 18.0
+        assert score_fit(node, self._util(0, 0)) == 0.0
+        mid = score_fit(node, self._util(1024, 2048))
+        assert 10.0 < mid < 16.0
+
+
+class TestNetworkIndexPort:
+    def test_overcommitted(self):
+        # ref TestNetworkIndex_Overcommitted (network_test.go:12)
+        idx = NetworkIndex(rng=random.Random(1))
+        reserved = NetworkResource(
+            device="eth0", ip="192.168.0.100", mbits=505,
+            reserved_ports=[
+                Port(label="one", value=8000), Port(label="two", value=9000)
+            ],
+        )
+        assert not idx.add_reserved(reserved)
+        assert idx.overcommitted()
+
+        n = Node(
+            node_resources=NodeResources(
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/32", mbits=1000
+                    )
+                ]
+            )
+        )
+        idx.set_node(n)
+        assert not idx.overcommitted()
+        idx.add_reserved(reserved)
+        assert idx.overcommitted()
+
+    def test_set_node(self):
+        # ref TestNetworkIndex_SetNode (network_test.go:54)
+        idx = NetworkIndex(rng=random.Random(1))
+        n = Node(
+            node_resources=NodeResources(
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/32",
+                        ip="192.168.0.100", mbits=1000,
+                    )
+                ]
+            ),
+            reserved_resources=NodeReservedResources(
+                networks=NodeReservedNetworkResources(
+                    reserved_host_ports="22"
+                )
+            ),
+        )
+        assert not idx.set_node(n)
+        assert len(idx.avail_networks) == 1
+        assert idx.avail_bandwidth["eth0"] == 1000
+        assert idx.used_ports["192.168.0.100"].check(22)
+
+    def test_add_allocs(self):
+        # ref TestNetworkIndex_AddAllocs (network_test.go:89)
+        idx = NetworkIndex(rng=random.Random(1))
+
+        def task_alloc(task, mbits, ports):
+            return Allocation(
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        task: AllocatedTaskResources(
+                            networks=[
+                                NetworkResource(
+                                    device="eth0", ip="192.168.0.100",
+                                    mbits=mbits, reserved_ports=ports,
+                                )
+                            ]
+                        )
+                    }
+                )
+            )
+
+        allocs = [
+            task_alloc(
+                "web", 20,
+                [Port(label="one", value=8000), Port(label="two", value=9000)],
+            ),
+            task_alloc("api", 50, [Port(label="one", value=10000)]),
+        ]
+        assert not idx.add_allocs(allocs)
+        assert idx.used_bandwidth["eth0"] == 70
+        for p in (8000, 9000, 10000):
+            assert idx.used_ports["192.168.0.100"].check(p)
+
+    def test_add_reserved_collides_on_repeat(self):
+        # ref TestNetworkIndex_AddReserved (network_test.go:144)
+        idx = NetworkIndex(rng=random.Random(1))
+        reserved = NetworkResource(
+            device="eth0", ip="192.168.0.100", mbits=20,
+            reserved_ports=[
+                Port(label="one", value=8000), Port(label="two", value=9000)
+            ],
+        )
+        assert not idx.add_reserved(reserved)
+        assert idx.used_bandwidth["eth0"] == 20
+        assert idx.used_ports["192.168.0.100"].check(8000)
+        assert idx.used_ports["192.168.0.100"].check(9000)
+        assert idx.add_reserved(reserved)
+
+    def test_yield_ips_expands_cidr(self):
+        # ref TestNetworkIndex_yieldIP (network_test.go:177)
+        idx = NetworkIndex(rng=random.Random(1))
+        n = Node(
+            node_resources=NodeResources(
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/30", mbits=1000
+                    )
+                ]
+            )
+        )
+        idx.set_node(n)
+        out = []
+
+        def cb(net, ip):
+            out.append(ip)
+            return False
+
+        idx._yield_ips(cb)
+        assert out == [
+            "192.168.0.100", "192.168.0.101",
+            "192.168.0.102", "192.168.0.103",
+        ]
+
+    def _assign_fixture(self):
+        idx = NetworkIndex(rng=random.Random(1))
+        n = Node(
+            node_resources=NodeResources(
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/30", mbits=1000
+                    )
+                ]
+            )
+        )
+        idx.set_node(n)
+        idx.add_allocs([
+            Allocation(
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            networks=[
+                                NetworkResource(
+                                    device="eth0", ip="192.168.0.100",
+                                    mbits=20,
+                                    reserved_ports=[
+                                        Port(label="one", value=8000),
+                                        Port(label="two", value=9000),
+                                    ],
+                                )
+                            ]
+                        )
+                    }
+                )
+            ),
+            Allocation(
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "api": AllocatedTaskResources(
+                            networks=[
+                                NetworkResource(
+                                    device="eth0", ip="192.168.0.100",
+                                    mbits=50,
+                                    reserved_ports=[
+                                        Port(label="main", value=10000)
+                                    ],
+                                )
+                            ]
+                        )
+                    }
+                )
+            ),
+        ])
+        return idx
+
+    def test_assign_network(self):
+        # ref TestNetworkIndex_AssignNetwork (network_test.go:205)
+        idx = self._assign_fixture()
+
+        # a reserved port already used on .100 moves the offer to .101
+        offer, err = idx.assign_network(
+            NetworkResource(reserved_ports=[Port(label="main", value=8000)])
+        )
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.101"
+        assert [
+            (p.label, p.value, p.to) for p in offer.reserved_ports
+        ] == [("main", 8000, 0)]
+
+        # dynamic ports land on the first IP with port room; an
+        # unmapped (to == -1) port maps to itself
+        offer, err = idx.assign_network(
+            NetworkResource(
+                dynamic_ports=[
+                    Port(label="http", to=80), Port(label="https", to=443),
+                    Port(label="admin", to=-1),
+                ]
+            )
+        )
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.100"
+        assert len(offer.dynamic_ports) == 3
+        admin = next(
+            p for p in offer.dynamic_ports if p.label == "admin"
+        )
+        assert all(p.value for p in offer.dynamic_ports)
+        assert admin.to == admin.value
+
+        # reserved + dynamic together
+        offer, err = idx.assign_network(
+            NetworkResource(
+                reserved_ports=[Port(label="main", value=2345)],
+                dynamic_ports=[
+                    Port(label="http", to=80), Port(label="https", to=443),
+                    Port(label="admin", to=8080),
+                ],
+            )
+        )
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.100"
+        assert [
+            (p.label, p.value, p.to) for p in offer.reserved_ports
+        ] == [("main", 2345, 0)]
+
+        # too much bandwidth
+        offer, err = idx.assign_network(NetworkResource(mbits=1000))
+        assert offer is None
+        assert err == "bandwidth exceeded"
+
+    def test_dynamic_contention_finds_last_free_port(self):
+        # ref TestNetworkIndex_AssignNetwork_Dynamic_Contention
+        # (network_test.go:308): every dynamic port but the last is
+        # host-reserved; the allocator must still place one
+        idx = NetworkIndex(rng=random.Random(1))
+        n = Node(
+            node_resources=NodeResources(
+                networks=[
+                    NetworkResource(
+                        device="eth0", cidr="192.168.0.100/32",
+                        ip="192.168.0.100", mbits=1000,
+                    )
+                ]
+            ),
+            reserved_resources=NodeReservedResources(
+                networks=NodeReservedNetworkResources(
+                    reserved_host_ports=(
+                        f"{MIN_DYNAMIC_PORT}-{MAX_DYNAMIC_PORT - 1}"
+                    )
+                )
+            ),
+        )
+        idx.set_node(n)
+        offer, err = idx.assign_network(
+            NetworkResource(dynamic_ports=[Port(label="http", to=80)])
+        )
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.100"
+        assert len(offer.dynamic_ports) == 1
+        assert offer.dynamic_ports[0].value == MAX_DYNAMIC_PORT

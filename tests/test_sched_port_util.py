@@ -1,0 +1,687 @@
+"""Scheduler-util corpus ported from the reference
+(scheduler/util_test.go — cited per test): the diff engines that decide
+place/update/migrate/stop/ignore/lost, the taint/ready node sets, the
+tasks_updated destructive-vs-inplace matrix, evict_and_place limits,
+set_status, the in-place update path, and the queued-alloc bookkeeping.
+"""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import shuffle_nodes
+from nomad_tpu.scheduler.stack import GenericStack, task_group_constraints
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.scheduler.util import (
+    AllocTuple,
+    DiffResult,
+    adjust_queued_allocations,
+    desired_updates,
+    diff_allocs,
+    diff_system_allocs,
+    evict_and_place,
+    generic_alloc_update_fn,
+    materialize_task_groups,
+    progress_made,
+    retry_max,
+    set_status,
+    tainted_nodes,
+    tasks_updated,
+    update_non_terminal_allocs_to_lost,
+)
+from nomad_tpu.structs.model import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_STOP,
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedTaskResources,
+    Allocation,
+    Constraint,
+    Deployment,
+    DeploymentStatusUpdate,
+    EphemeralDisk,
+    Plan,
+    PlanResult,
+    Port,
+    Resources,
+    Service,
+    Task,
+    TaskGroup,
+    Vault,
+    generate_uuid,
+)
+
+
+def named_alloc(name, node_id, job):
+    return Allocation(
+        id=generate_uuid(), node_id=node_id, name=name, job=job,
+        job_id=job.id, namespace=job.namespace,
+    )
+
+
+class TestMaterializeTaskGroupsPort:
+    def test_expands_counts_into_named_slots(self):
+        # ref TestMaterializeTaskGroups (util_test.go:23)
+        job = mock.job()
+        index = materialize_task_groups(job)
+        assert len(index) == 10
+        for i in range(10):
+            assert index[f"my-job.web[{i}]"] is job.task_groups[0]
+
+    def test_stopped_and_purged_jobs_materialize_nothing(self):
+        job = mock.job()
+        job.stop = True
+        assert materialize_task_groups(job) == {}
+        assert materialize_task_groups(None) == {}
+
+
+class TestDiffAllocsPort:
+    def test_full_diff_matrix(self):
+        # ref TestDiffAllocs (util_test.go:42)
+        job = mock.job()
+        required = materialize_task_groups(job)
+        old_job = job.copy()
+        old_job.job_modify_index -= 1
+
+        drain_node = mock.node()
+        drain_node.drain = True
+        dead_node = mock.node()
+        dead_node.status = "down"
+        tainted = {"dead": dead_node, "drainNode": drain_node}
+
+        update0 = named_alloc("my-job.web[0]", "zip", old_job)
+        ignore1 = named_alloc("my-job.web[1]", "zip", job)
+        stop10 = named_alloc("my-job.web[10]", "zip", old_job)
+        migrate2 = named_alloc("my-job.web[2]", "drainNode", old_job)
+        migrate2.desired_transition.migrate = True
+        lost3 = named_alloc("my-job.web[3]", "dead", old_job)
+        allocs = [update0, ignore1, stop10, migrate2, lost3]
+
+        terminal = {
+            f"my-job.web[{i}]": named_alloc(f"my-job.web[{i}]", "zip", job)
+            for i in (4, 5, 6)
+        }
+
+        diff = diff_allocs(job, tainted, required, allocs, terminal)
+        assert [t.alloc for t in diff.update] == [update0]
+        assert [t.alloc for t in diff.ignore] == [ignore1]
+        assert [t.alloc for t in diff.stop] == [stop10]
+        assert [t.alloc for t in diff.migrate] == [migrate2]
+        assert [t.alloc for t in diff.lost] == [lost3]
+        assert len(diff.place) == 6
+        # replacements of terminal allocs carry the terminal alloc
+        for tup in diff.place:
+            if tup.name in terminal:
+                assert tup.alloc is terminal[tup.name]
+
+
+class TestDiffSystemAllocsPort:
+    def test_per_node_diff(self):
+        # ref TestDiffSystemAllocs (util_test.go:179)
+        job = mock.system_job()
+        old_job = job.copy()
+        old_job.job_modify_index -= 1
+
+        drain_node = mock.node()
+        drain_node.drain = True
+        dead_node = mock.node()
+        dead_node.status = "down"
+        tainted = {dead_node.id: dead_node, drain_node.id: drain_node}
+
+        from nomad_tpu.structs.model import Node
+
+        nodes = [
+            Node(id="foo"), Node(id="bar"), Node(id="baz"),
+            Node(id="pipe"), Node(id=drain_node.id), Node(id=dead_node.id),
+        ]
+
+        update_baz = named_alloc("my-job.web[0]", "baz", old_job)
+        ignore_bar = named_alloc("my-job.web[0]", "bar", job)
+        migrate_drain = named_alloc("my-job.web[0]", drain_node.id, old_job)
+        migrate_drain.desired_transition.migrate = True
+        lost_dead = named_alloc("my-job.web[0]", dead_node.id, old_job)
+        allocs = [update_baz, ignore_bar, migrate_drain, lost_dead]
+
+        terminal = {
+            "my-job.web[0]": named_alloc("my-job.web[0]", "pipe", job)
+        }
+
+        diff = diff_system_allocs(job, nodes, tainted, allocs, terminal)
+        assert [t.alloc for t in diff.update] == [update_baz]
+        assert [t.alloc for t in diff.ignore] == [ignore_bar]
+        assert diff.stop == []
+        assert [t.alloc for t in diff.migrate] == [migrate_drain]
+        assert [t.alloc for t in diff.lost] == [lost_dead]
+        # foo and pipe get placements (bar/baz have allocs; tainted nodes
+        # never get system placements)
+        assert len(diff.place) == 2
+        for tup in diff.place:
+            if tup.alloc is not None and tup.alloc.node_id == "pipe":
+                assert tup.alloc is terminal["my-job.web[0]"]
+
+
+class TestNodeSetsPort:
+    def _state(self):
+        h = Harness(seed=42)
+        n1 = mock.node()
+        n2 = mock.node()
+        n2.datacenter = "dc2"
+        n3 = mock.node()
+        n3.datacenter = "dc2"
+        n3.status = "down"
+        n4 = mock.node()
+        n4.drain = True
+        for i, n in enumerate((n1, n2, n3, n4)):
+            h.state.upsert_node(1000 + i, n)
+        return h, (n1, n2, n3, n4)
+
+    def test_ready_nodes_in_dcs(self):
+        # ref TestReadyNodesInDCs (util_test.go:299)
+        h, (n1, n2, n3, n4) = self._state()
+        nodes, dc = h.state.snapshot().ready_nodes_in_dcs(["dc1", "dc2"])
+        assert len(nodes) == 2
+        assert all(n.id not in (n3.id, n4.id) for n in nodes)
+        assert dc == {"dc1": 1, "dc2": 1}
+
+    def test_tainted_nodes(self):
+        # ref TestTaintedNodes (util_test.go:379)
+        h, (n1, n2, n3, n4) = self._state()
+        allocs = [
+            Allocation(node_id=n1.id), Allocation(node_id=n2.id),
+            Allocation(node_id=n3.id), Allocation(node_id=n4.id),
+            Allocation(node_id="12345678-abcd-efab-cdef-123456789abc"),
+        ]
+        tainted = tainted_nodes(h.state.snapshot(), allocs)
+        assert len(tainted) == 3
+        assert n1.id not in tainted and n2.id not in tainted
+        assert tainted[n3.id] is not None
+        assert tainted[n4.id] is not None
+        # unknown node: present with None (treated as gone)
+        assert tainted["12345678-abcd-efab-cdef-123456789abc"] is None
+
+
+class TestRetryMaxPort:
+    def test_retry_exhaustion_reset_and_success(self):
+        # ref TestRetryMax (util_test.go:334)
+        calls = [0]
+
+        def bad():
+            calls[0] += 1
+            return False
+
+        with pytest.raises(Exception):
+            retry_max(3, bad, None)
+        assert calls[0] == 3
+
+        calls[0] = 0
+        first = [True]
+
+        def reset():
+            if calls[0] == 3 and first[0]:
+                first[0] = False
+                return True
+            return False
+
+        with pytest.raises(Exception):
+            retry_max(3, bad, reset)
+        assert calls[0] == 6
+
+        calls[0] = 0
+
+        def good():
+            calls[0] += 1
+            return True
+
+        retry_max(3, good, None)
+        assert calls[0] == 1
+
+
+class TestShuffleNodesPort:
+    def test_seeded_shuffle_changes_order(self):
+        # ref TestShuffleNodes (util_test.go:430)
+        nodes = [mock.node() for _ in range(10)]
+        orig = list(nodes)
+        ctx = EvalContext(None, Plan(), rng=random.Random(7))
+        shuffle_nodes(ctx, nodes)
+        assert nodes != orig
+        assert sorted(n.id for n in nodes) == sorted(n.id for n in orig)
+
+
+class TestTasksUpdatedPort:
+    """ref TestTasksUpdated (util_test.go:453): every change that must
+    force a destructive update, plus the no-change baseline."""
+
+    def test_identical_jobs_not_updated(self):
+        j1, j2 = mock.job(), mock.job()
+        assert not tasks_updated(j1, j2, j1.task_groups[0].name)
+
+    def _changed(self, mutate):
+        j1 = mock.job()
+        j2 = mock.job()
+        mutate(j2)
+        return tasks_updated(j1, j2, j1.task_groups[0].name)
+
+    def test_changed_command(self):
+        assert self._changed(
+            lambda j: j.task_groups[0].tasks[0].config.__setitem__(
+                "command", "/bin/other"
+            )
+        )
+
+    def test_changed_task_name(self):
+        assert self._changed(
+            lambda j: setattr(j.task_groups[0].tasks[0], "name", "foo")
+        )
+
+    def test_changed_driver(self):
+        assert self._changed(
+            lambda j: setattr(j.task_groups[0].tasks[0], "driver", "foo")
+        )
+
+    def test_added_task(self):
+        assert self._changed(
+            lambda j: j.task_groups[0].tasks.append(j.task_groups[0].tasks[0])
+        )
+
+    def test_changed_dynamic_ports(self):
+        def mutate(j):
+            j.task_groups[0].tasks[0].resources.networks[0].dynamic_ports = [
+                Port(label="http"), Port(label="https"), Port(label="admin"),
+            ]
+        assert self._changed(mutate)
+
+    def test_changed_env(self):
+        assert self._changed(
+            lambda j: j.task_groups[0].tasks[0].env.__setitem__(
+                "NEW_ENV", "NEW_VALUE"
+            )
+        )
+
+    def test_changed_user(self):
+        assert self._changed(
+            lambda j: setattr(j.task_groups[0].tasks[0], "user", "foo")
+        )
+
+    def test_changed_artifacts(self):
+        from nomad_tpu.structs.model import TaskArtifact
+
+        def mutate(j):
+            j.task_groups[0].tasks[0].artifacts = [
+                TaskArtifact(getter_source="http://foo.com/bar")
+            ]
+        assert self._changed(mutate)
+
+    def test_changed_task_meta(self):
+        assert self._changed(
+            lambda j: j.task_groups[0].tasks[0].meta.__setitem__(
+                "baz", "boom"
+            )
+        )
+
+    def test_changed_cpu(self):
+        assert self._changed(
+            lambda j: setattr(j.task_groups[0].tasks[0].resources, "cpu", 1337)
+        )
+
+    def test_changed_mbits(self):
+        assert self._changed(
+            lambda j: setattr(
+                j.task_groups[0].tasks[0].resources.networks[0], "mbits", 100
+            )
+        )
+
+    def test_changed_dynamic_port_label(self):
+        def mutate(j):
+            j.task_groups[0].tasks[0].resources.networks[0].dynamic_ports[
+                0
+            ].label = "foobar"
+        assert self._changed(mutate)
+
+    def test_changed_reserved_ports(self):
+        def mutate(j):
+            j.task_groups[0].tasks[0].resources.networks[0].reserved_ports = [
+                Port(label="foo", value=1312)
+            ]
+        assert self._changed(mutate)
+
+    def test_changed_vault(self):
+        assert self._changed(
+            lambda j: setattr(
+                j.task_groups[0].tasks[0], "vault", Vault(policies=["foo"])
+            )
+        )
+
+    def test_changed_sticky_disk(self):
+        assert self._changed(
+            lambda j: setattr(j.task_groups[0].ephemeral_disk, "sticky", True)
+        )
+
+    def test_changed_group_meta(self):
+        assert self._changed(
+            lambda j: j.task_groups[0].meta.__setitem__(
+                "j17_test", "roll_baby_roll"
+            )
+        )
+
+    def test_changed_job_meta(self):
+        assert self._changed(
+            lambda j: j.meta.__setitem__("j18_test", "roll_baby_roll")
+        )
+
+
+class TestEvictAndPlacePort:
+    def _tuples(self, n=4):
+        return [
+            AllocTuple(alloc=Allocation(id=generate_uuid())) for _ in range(n)
+        ]
+
+    def _ctx(self):
+        h = Harness(seed=42)
+        return EvalContext(h.state.snapshot(), Plan(), rng=random.Random(1))
+
+    def test_limit_less_than_allocs(self):
+        # ref TestEvictAndPlace_LimitLessThanAllocs (util_test.go:575)
+        ctx = self._ctx()
+        diff = DiffResult()
+        limit = [2]
+        assert evict_and_place(ctx, diff, self._tuples(), "", limit)
+        assert limit[0] == 0
+        assert len(diff.place) == 2
+
+    def test_limit_equal_to_allocs(self):
+        # ref TestEvictAndPlace_LimitEqualToAllocs (util_test.go:599)
+        ctx = self._ctx()
+        diff = DiffResult()
+        limit = [4]
+        assert not evict_and_place(ctx, diff, self._tuples(), "", limit)
+        assert limit[0] == 0
+        assert len(diff.place) == 4
+
+    def test_limit_greater_than_allocs(self):
+        # ref TestEvictAndPlace_LimitGreaterThanAllocs (util_test.go:948)
+        ctx = self._ctx()
+        diff = DiffResult()
+        limit = [6]
+        assert not evict_and_place(ctx, diff, self._tuples(), "", limit)
+        assert limit[0] == 2
+        assert len(diff.place) == 4
+
+
+class TestSetStatusPort:
+    """ref TestSetStatus (util_test.go:623)."""
+
+    def test_status_and_description(self):
+        h = Harness(seed=42)
+        ev = mock.evaluation()
+        set_status(h, ev, None, None, {}, "a", "b", None, "")
+        assert len(h.evals) == 1
+        got = h.evals[0]
+        assert got.id == ev.id and got.status == "a"
+        assert got.status_description == "b"
+
+    def test_next_eval_link(self):
+        h = Harness(seed=42)
+        ev, nxt = mock.evaluation(), mock.evaluation()
+        set_status(h, ev, nxt, None, {}, "a", "b", None, "")
+        assert h.evals[0].next_eval == nxt.id
+
+    def test_blocked_eval_link(self):
+        h = Harness(seed=42)
+        ev, blocked = mock.evaluation(), mock.evaluation()
+        set_status(h, ev, None, blocked, {}, "a", "b", None, "")
+        assert h.evals[0].blocked_eval == blocked.id
+
+    def test_failed_tg_metrics(self):
+        h = Harness(seed=42)
+        ev = mock.evaluation()
+        metrics = {"foo": None}
+        set_status(h, ev, None, None, metrics, "a", "b", None, "")
+        assert h.evals[0].failed_tg_allocs == metrics
+
+    def test_queued_allocations(self):
+        h = Harness(seed=42)
+        ev = mock.evaluation()
+        set_status(h, ev, None, None, {}, "a", "b", {"web": 1}, "")
+        assert h.evals[0].queued_allocations == {"web": 1}
+
+    def test_deployment_id(self):
+        h = Harness(seed=42)
+        ev = mock.evaluation()
+        did = generate_uuid()
+        set_status(h, ev, None, None, {}, "a", "b", None, did)
+        assert h.evals[0].deployment_id == did
+
+
+def _inplace_fixture(new_tg, job_tg=None):
+    """An existing alloc + the update_fn the reconciler uses for it
+    (the repo's per-alloc analog of the reference's batch inplaceUpdate,
+    util.go:759-856). ``job_tg`` is what the NEW JOB carries (drives the
+    tasks_updated destructive check); ``new_tg`` is the group handed to
+    the updater (drives the select ask). The Go Success test aliases the
+    two through a shared Tasks slice — here they are explicit."""
+    h = Harness(seed=42)
+    node = mock.node()
+    h.state.upsert_node(900, node)
+    job = mock.job()
+    h.state.upsert_job(901, job)
+    stored = h.state.job_by_id(job.namespace, job.id)
+
+    alloc = Allocation(
+        namespace="default",
+        id=generate_uuid(),
+        eval_id=generate_uuid(),
+        node_id=node.id,
+        job_id=stored.id,
+        job=stored,
+        task_group="web",
+        desired_status="run",
+        allocated_resources=AllocatedResources(
+            tasks={
+                "web": AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=2048),
+                    memory=AllocatedMemoryResources(memory_mb=2048),
+                )
+            }
+        ),
+    )
+    h.state.upsert_allocs(1001, [alloc])
+    stored_alloc = h.state.alloc_by_id(alloc.id)
+
+    new_job = stored.copy()
+    new_job.job_modify_index += 1
+    new_job.task_groups = [job_tg if job_tg is not None else new_tg]
+
+    ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(3))
+    stack = GenericStack(False, ctx)
+    stack.set_job(new_job)
+    fn = generic_alloc_update_fn(ctx, stack, generate_uuid())
+    return fn, stored_alloc, new_job, ctx
+
+
+class TestInplaceUpdatePort:
+    def test_changed_task_group_is_destructive(self):
+        # ref TestInplaceUpdate_ChangedTaskGroup (util_test.go:723)
+        tg = TaskGroup(
+            name="web", count=1, ephemeral_disk=EphemeralDisk(),
+            tasks=[Task(name="FOO", resources=Resources())],
+        )
+        fn, alloc, new_job, ctx = _inplace_fixture(tg)
+        ignore, destructive, new_alloc = fn(alloc, new_job, tg)
+        assert (ignore, destructive) == (False, True)
+        assert new_alloc is None
+        assert not ctx.plan.node_allocation
+
+    def test_no_fit_is_destructive(self):
+        # ref TestInplaceUpdate_NoMatch (util_test.go:783)
+        job = mock.job()
+        tg = job.task_groups[0].copy()
+        tg.tasks[0].resources = Resources(cpu=9999)
+        fn, alloc, new_job, ctx = _inplace_fixture(tg)
+        ignore, destructive, new_alloc = fn(alloc, new_job, tg)
+        assert (ignore, destructive) == (False, True)
+        assert new_alloc is None
+
+    def test_success_updates_resources_in_place(self):
+        # ref TestInplaceUpdate_Success (util_test.go:839)
+        job = mock.job()
+        tg = job.task_groups[0].copy()
+        tg.tasks[0].resources = Resources(cpu=737, memory_mb=256)
+        tg.tasks[0].services = [
+            Service(name="dummy-service", port_label="http"),
+            Service(name="dummy-service2", port_label="http"),
+        ]
+        # the Go test's shared-Tasks aliasing makes tasksUpdated compare
+        # the job against itself; reproduce that by giving the new job an
+        # UNCHANGED group while the updater receives the new ask
+        fn, alloc, new_job, ctx = _inplace_fixture(
+            tg, job_tg=job.task_groups[0]
+        )
+        ignore, destructive, new_alloc = fn(alloc, new_job, tg)
+        assert (ignore, destructive) == (False, False)
+        assert new_alloc is not None and new_alloc.id == alloc.id
+        assert (
+            new_alloc.allocated_resources.tasks["web"].cpu.cpu_shares == 737
+        )
+
+
+class TestTaskGroupConstraintsPort:
+    def test_combined_constraints_and_drivers(self):
+        # ref TestTaskGroupConstraints (util_test.go:972)
+        constr = Constraint(r_target="bar")
+        constr2 = Constraint(l_target="foo")
+        constr3 = Constraint(operand="<")
+        tg = TaskGroup(
+            name="web", count=10, constraints=[constr],
+            ephemeral_disk=EphemeralDisk(),
+            tasks=[
+                Task(
+                    name="a", driver="exec",
+                    resources=Resources(cpu=500, memory_mb=256),
+                    constraints=[constr2],
+                ),
+                Task(
+                    name="b", driver="docker",
+                    resources=Resources(cpu=500, memory_mb=256),
+                    constraints=[constr3],
+                ),
+            ],
+        )
+        constraints, drivers = task_group_constraints(tg)
+        assert constraints == [constr, constr2, constr3]
+        assert drivers == {"exec", "docker"}
+
+
+class TestProgressMadePort:
+    def test_progress_variants(self):
+        # ref TestProgressMade (util_test.go:1015)
+        assert not progress_made(None)
+        assert not progress_made(PlanResult())
+        m = {"foo": [mock.alloc()]}
+        assert progress_made(PlanResult(node_allocation=m, node_update=m))
+        assert progress_made(PlanResult(node_update=m))
+        assert progress_made(PlanResult(node_allocation=m))
+        assert progress_made(PlanResult(deployment=Deployment()))
+        assert progress_made(
+            PlanResult(
+                deployment_updates=[
+                    DeploymentStatusUpdate(deployment_id=generate_uuid())
+                ]
+            )
+        )
+
+
+class TestDesiredUpdatesPort:
+    def test_per_group_rollup(self):
+        # ref TestDesiredUpdates (util_test.go:1042)
+        tg1 = TaskGroup(name="foo")
+        tg2 = TaskGroup(name="bar")
+        a2 = Allocation(task_group="bar")
+        diff = DiffResult()
+        diff.place = [
+            AllocTuple(task_group=tg1), AllocTuple(task_group=tg1),
+            AllocTuple(task_group=tg1), AllocTuple(task_group=tg2),
+        ]
+        diff.stop = [
+            AllocTuple(task_group=tg2, alloc=a2),
+            AllocTuple(task_group=tg2, alloc=a2),
+        ]
+        diff.ignore = [AllocTuple(task_group=tg1)]
+        diff.migrate = [AllocTuple(task_group=tg2)]
+        inplace = [AllocTuple(task_group=tg1), AllocTuple(task_group=tg1)]
+        destructive = [
+            AllocTuple(task_group=tg1),
+            AllocTuple(task_group=tg2), AllocTuple(task_group=tg2),
+        ]
+        desired = desired_updates(diff, inplace, destructive)
+        assert desired["foo"].place == 3
+        assert desired["foo"].ignore == 1
+        assert desired["foo"].in_place_update == 2
+        assert desired["foo"].destructive_update == 1
+        assert desired["bar"].place == 1
+        assert desired["bar"].stop == 2
+        assert desired["bar"].migrate == 1
+        assert desired["bar"].destructive_update == 2
+
+
+class TestQueuedAllocBookkeepingPort:
+    def test_adjust_queued_allocations(self):
+        # ref TestUtil_AdjustQueuedAllocations (util_test.go:1100)
+        alloc1 = mock.alloc()
+        alloc2 = mock.alloc()
+        alloc2.create_index = 4
+        alloc2.modify_index = 4
+        alloc3 = mock.alloc()
+        alloc3.create_index = 3
+        alloc3.modify_index = 5
+        alloc4 = mock.alloc()
+        alloc4.create_index = 6
+        alloc4.modify_index = 8
+
+        result = PlanResult(
+            node_update={"node-1": [alloc1]},
+            node_allocation={
+                "node-1": [alloc2],
+                "node-2": [alloc3, alloc4],
+            },
+            refresh_index=3,
+            alloc_index=16,  # must not be considered
+        )
+        queued = {"web": 2}
+        adjust_queued_allocations(result, queued)
+        assert queued["web"] == 1
+
+    def test_update_non_terminal_allocs_to_lost(self):
+        # ref TestUtil_UpdateNonTerminalAllocsToLost (util_test.go:1137)
+        node = mock.node()
+        node.status = "down"
+
+        def stopped(client_status):
+            a = mock.alloc()
+            a.node_id = node.id
+            a.desired_status = ALLOC_DESIRED_STATUS_STOP
+            a.client_status = client_status
+            return a
+
+        alloc1 = stopped("pending")
+        alloc2 = stopped(ALLOC_CLIENT_STATUS_RUNNING)
+        alloc3 = stopped(ALLOC_CLIENT_STATUS_COMPLETE)
+        alloc4 = stopped(ALLOC_CLIENT_STATUS_FAILED)
+        allocs = [alloc1, alloc2, alloc3, alloc4]
+
+        plan = Plan()
+        update_non_terminal_allocs_to_lost(plan, {node.id: node}, allocs)
+        assert [a.id for a in plan.node_update.get(node.id, [])] == [
+            alloc1.id, alloc2.id,
+        ]
+
+        # a READY tainted node (drain) must not mark anything lost
+        plan = Plan()
+        node2 = node.copy()
+        node2.status = "ready"
+        update_non_terminal_allocs_to_lost(plan, {node2.id: node2}, allocs)
+        assert plan.node_update.get(node2.id, []) == []
