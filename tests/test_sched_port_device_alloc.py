@@ -1,0 +1,258 @@
+"""deviceAllocator corpus ported from the reference
+(scheduler/device_test.go — cited per test): generic and fully-qualified
+device asks, instance exhaustion, constraint filtering over device
+attributes (with unit conversion), and affinity scoring."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.device import DeviceAllocator
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.attribute import Attribute
+from nomad_tpu.structs.model import (
+    Affinity,
+    Constraint,
+    NodeDevice,
+    NodeDeviceResource,
+    Plan,
+    RequestedDevice,
+    generate_uuid,
+)
+
+
+def make_ctx():
+    h = Harness(seed=42)
+    return EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+
+
+def dev_node():
+    # ref device_test.go:27 devNode (gpu pair + intel FPGA, one unhealthy)
+    n = mock.nvidia_node()
+    n.node_resources.devices.append(
+        NodeDeviceResource(
+            type="fpga", vendor="intel", name="F100",
+            attributes={"memory": Attribute.of_int(4, "GiB")},
+            instances=[
+                NodeDevice(id=generate_uuid(), healthy=True),
+                NodeDevice(id=generate_uuid(), healthy=False),
+            ],
+        )
+    )
+    return n
+
+
+def multiple_nvidia_node():
+    # ref device_test.go:51 multipleNvidiaNode (1080ti + 2080ti)
+    n = mock.nvidia_node()
+    n.node_resources.devices.append(
+        NodeDeviceResource(
+            type="gpu", vendor="nvidia", name="2080ti",
+            attributes={
+                "memory": Attribute.of_int(11, "GiB"),
+                "cuda_cores": Attribute.of_int(4352, ""),
+                "graphics_clock": Attribute.of_int(1350, "MHz"),
+                "memory_bandwidth": Attribute.of_int(14, "GB/s"),
+            },
+            instances=[
+                NodeDevice(id=generate_uuid(), healthy=True),
+                NodeDevice(id=generate_uuid(), healthy=True),
+            ],
+        )
+    )
+    return n
+
+
+def instance_ids(*devices):
+    return [i.id for d in devices for i in d.instances]
+
+
+class TestDeviceAllocatorPort:
+    def test_generic_request(self):
+        # ref TestDeviceAllocator_Allocate_GenericRequest (:90)
+        n = dev_node()
+        d = DeviceAllocator(make_ctx(), n)
+        out, score, err = d.assign_device(RequestedDevice(name="gpu", count=1))
+        assert out is not None, err
+        assert score == 0
+        assert len(out.device_ids) == 1
+        assert out.device_ids[0] in instance_ids(n.node_resources.devices[0])
+
+    def test_fully_qualified_request(self):
+        # ref TestDeviceAllocator_Allocate_FullyQualifiedRequest (:110)
+        n = dev_node()
+        d = DeviceAllocator(make_ctx(), n)
+        out, score, err = d.assign_device(
+            RequestedDevice(name="intel/fpga/F100", count=1)
+        )
+        assert out is not None, err
+        assert score == 0
+        assert len(out.device_ids) == 1
+        assert out.device_ids[0] in instance_ids(n.node_resources.devices[1])
+
+    def test_not_enough_instances(self):
+        # ref TestDeviceAllocator_Allocate_NotEnoughInstances (:131)
+        n = dev_node()
+        d = DeviceAllocator(make_ctx(), n)
+        out, _, err = d.assign_device(RequestedDevice(name="gpu", count=4))
+        assert out is None
+        assert "no devices match request" in err
+
+    # ref TestDeviceAllocator_Allocate_Constraints (:147)
+    CONSTRAINT_CASES = [
+        (
+            "gpu-more-cores",
+            "gpu",
+            [Constraint(
+                l_target="${device.attr.cuda_cores}", operand=">",
+                r_target="4000",
+            )],
+            1,  # expects the 2080ti (device index 1)
+            False,
+        ),
+        (
+            "gpu-fewer-cores",
+            "gpu",
+            [Constraint(
+                l_target="${device.attr.cuda_cores}", operand="<",
+                r_target="4000",
+            )],
+            0,  # expects the 1080ti
+            False,
+        ),
+        (
+            "nvidia-unit-conversions",
+            "nvidia/gpu",
+            [
+                Constraint(
+                    l_target="${device.attr.memory_bandwidth}",
+                    operand=">", r_target="10 GB/s",
+                ),
+                Constraint(
+                    l_target="${device.attr.memory}",
+                    operand="is", r_target="11264 MiB",
+                ),
+                Constraint(
+                    l_target="${device.attr.graphics_clock}",
+                    operand=">", r_target="1.4 GHz",
+                ),
+            ],
+            0,
+            False,
+        ),
+        ("wrong-vendor", "intel/gpu", [], None, True),
+        (
+            "clock-rules-both-out",
+            "nvidia/gpu",
+            [
+                Constraint(
+                    l_target="${device.attr.memory_bandwidth}",
+                    operand=">", r_target="10 GB/s",
+                ),
+                Constraint(
+                    l_target="${device.attr.memory}",
+                    operand="is", r_target="11264 MiB",
+                ),
+                Constraint(
+                    l_target="${device.attr.graphics_clock}",
+                    operand=">", r_target="2.4 GHz",
+                ),
+            ],
+            None,
+            True,
+        ),
+    ]
+
+    @pytest.mark.parametrize(
+        "name,ask_name,constraints,expected_idx,no_placement",
+        CONSTRAINT_CASES,
+        ids=[c[0] for c in CONSTRAINT_CASES],
+    )
+    def test_constraints(
+        self, name, ask_name, constraints, expected_idx, no_placement
+    ):
+        n = multiple_nvidia_node()
+        d = DeviceAllocator(make_ctx(), n)
+        out, score, err = d.assign_device(
+            RequestedDevice(
+                name=ask_name, count=1, constraints=constraints
+            )
+        )
+        if no_placement:
+            assert out is None
+        else:
+            assert out is not None, err
+            assert score == 0
+            assert len(out.device_ids) == 1
+            assert out.device_ids[0] in instance_ids(
+                n.node_resources.devices[expected_idx]
+            )
+
+    # ref TestDeviceAllocator_Allocate_Affinities (:253)
+    AFFINITY_CASES = [
+        (
+            "prefer-more-cores",
+            [Affinity(
+                l_target="${device.attr.cuda_cores}", operand=">",
+                r_target="4000", weight=60,
+            )],
+            1, False,
+        ),
+        (
+            "prefer-fewer-cores",
+            [Affinity(
+                l_target="${device.attr.cuda_cores}", operand="<",
+                r_target="4000", weight=10,
+            )],
+            0, False,
+        ),
+        (
+            "anti-affinity-avoids-match",
+            [Affinity(
+                l_target="${device.attr.cuda_cores}", operand=">",
+                r_target="4000", weight=-20,
+            )],
+            0, True,
+        ),
+        (
+            "weighted-combination",
+            [
+                Affinity(
+                    l_target="${device.attr.memory_bandwidth}",
+                    operand=">", r_target="10 GB/s", weight=20,
+                ),
+                Affinity(
+                    l_target="${device.attr.memory}",
+                    operand="is", r_target="11264 MiB", weight=20,
+                ),
+                Affinity(
+                    l_target="${device.attr.graphics_clock}",
+                    operand=">", r_target="1.4 GHz", weight=90,
+                ),
+            ],
+            0, False,
+        ),
+    ]
+
+    @pytest.mark.parametrize(
+        "name,affinities,expected_idx,zero_score",
+        AFFINITY_CASES,
+        ids=[c[0] for c in AFFINITY_CASES],
+    )
+    def test_affinities(self, name, affinities, expected_idx, zero_score):
+        n = multiple_nvidia_node()
+        d = DeviceAllocator(make_ctx(), n)
+        out, score, err = d.assign_device(
+            RequestedDevice(name="gpu", count=1, affinities=affinities)
+        )
+        assert out is not None, err
+        if zero_score:
+            assert score == 0
+        else:
+            assert score != 0
+        assert len(out.device_ids) == 1
+        assert out.device_ids[0] in instance_ids(
+            n.node_resources.devices[expected_idx]
+        )

@@ -1,0 +1,158 @@
+"""DeviceAccounter + Bitmap corpus ported from the reference
+(nomad/structs/devices_test.go and bitmap_test.go — cited per test)."""
+
+from nomad_tpu import mock
+from nomad_tpu.structs.attribute import Attribute
+from nomad_tpu.structs.bitmap import Bitmap
+from nomad_tpu.structs.devices import DeviceAccounter
+from nomad_tpu.structs.model import (
+    AllocatedDeviceResource,
+    NodeDevice,
+    NodeDeviceResource,
+    generate_uuid,
+)
+
+
+def nvidia_allocated_device():
+    # ref devices_test.go:12 nvidiaAllocatedDevice
+    return AllocatedDeviceResource(
+        type="gpu", vendor="nvidia", name="1080ti",
+        device_ids=[generate_uuid()],
+    )
+
+
+def nvidia_alloc():
+    # ref devices_test.go:22 nvidiaAlloc
+    a = mock.alloc()
+    a.allocated_resources.tasks["web"].devices = [nvidia_allocated_device()]
+    return a
+
+
+def dev_node():
+    """ref devices_test.go:32 devNode: an nvidia GPU pair plus an intel
+    FPGA with one healthy and one unhealthy instance."""
+    n = mock.nvidia_node()
+    n.node_resources.devices.append(
+        NodeDeviceResource(
+            type="fpga", vendor="intel", name="F100",
+            attributes={"memory": Attribute.of_int(4, "GiB")},
+            instances=[
+                NodeDevice(id=generate_uuid(), healthy=True),
+                NodeDevice(id=generate_uuid(), healthy=False),
+            ],
+        )
+    )
+    return n
+
+
+class TestDeviceAccounterPort:
+    def test_add_allocs_no_device_node(self):
+        # ref TestDeviceAccounter_AddAllocs_NoDeviceNode (:55)
+        d = DeviceAccounter(mock.node())
+        a1, a2, a3 = mock.alloc(), nvidia_alloc(), mock.alloc()
+        a3.desired_status = "stop"
+        assert not d.add_allocs([a1, a2, a3])
+        assert len(d.devices) == 0
+
+    def test_add_allocs(self):
+        # ref TestDeviceAccounter_AddAllocs (:72)
+        n = dev_node()
+        d = DeviceAccounter(n)
+        a1, a2, a3 = mock.alloc(), nvidia_alloc(), mock.alloc()
+        nvidia_dev0 = n.node_resources.devices[0].instances[0].id
+        intel_dev0 = n.node_resources.devices[1].instances[0].id
+        a2.allocated_resources.tasks["web"].devices[0].device_ids = [
+            nvidia_dev0
+        ]
+        a3.desired_status = "stop"
+
+        assert not d.add_allocs([a1, a2, a3])
+        assert len(d.devices) == 2
+
+        nvidia = d.devices[n.node_resources.devices[0].device_id()]
+        assert len(nvidia.instances) == 2
+        assert nvidia.instances[nvidia_dev0] == 1
+
+        # only the HEALTHY intel instance is tracked
+        intel = d.devices[n.node_resources.devices[1].device_id()]
+        assert len(intel.instances) == 1
+        assert intel.instances[intel_dev0] == 0
+
+    def test_add_allocs_unknown_id(self):
+        # ref TestDeviceAccounter_AddAllocs_UnknownID (:109): an alloc
+        # whose device instance is no longer tracked must not wedge
+        n = dev_node()
+        d = DeviceAccounter(n)
+        a1, a2, a3 = mock.alloc(), nvidia_alloc(), mock.alloc()
+        a3.desired_status = "stop"
+        assert not d.add_allocs([a1, a2, a3])
+        assert len(d.devices) == 2
+        nvidia = d.devices[n.node_resources.devices[0].device_id()]
+        assert len(nvidia.instances) == 2
+        assert all(v == 0 for v in nvidia.instances.values())
+
+    def test_add_allocs_collision(self):
+        # ref TestDeviceAccounter_AddAllocs_Collision (:137)
+        n = dev_node()
+        d = DeviceAccounter(n)
+        a1, a2 = nvidia_alloc(), nvidia_alloc()
+        nvidia_dev0 = n.node_resources.devices[0].instances[0].id
+        for a in (a1, a2):
+            a.allocated_resources.tasks["web"].devices[0].device_ids = [
+                nvidia_dev0
+            ]
+        assert d.add_allocs([a1, a2])
+
+    def test_add_reserved_no_device_node(self):
+        # ref TestDeviceAccounter_AddReserved_NoDeviceNode (:154)
+        d = DeviceAccounter(mock.node())
+        assert not d.add_reserved(nvidia_allocated_device())
+        assert len(d.devices) == 0
+
+    def test_add_reserved(self):
+        # ref TestDeviceAccounter_AddReserved (:165)
+        n = dev_node()
+        d = DeviceAccounter(n)
+        nvidia_dev0 = n.node_resources.devices[0].instances[0].id
+        intel_dev0 = n.node_resources.devices[1].instances[0].id
+        res = nvidia_allocated_device()
+        res.device_ids = [nvidia_dev0]
+        assert not d.add_reserved(res)
+        assert len(d.devices) == 2
+        nvidia = d.devices[n.node_resources.devices[0].device_id()]
+        assert nvidia.instances[nvidia_dev0] == 1
+        intel = d.devices[n.node_resources.devices[1].device_id()]
+        assert len(intel.instances) == 1
+        assert intel.instances[intel_dev0] == 0
+
+    def test_add_reserved_collision(self):
+        # ref TestDeviceAccounter_AddReserved_Collision (:196)
+        n = dev_node()
+        d = DeviceAccounter(n)
+        nvidia_dev0 = n.node_resources.devices[0].instances[0].id
+        a1 = nvidia_alloc()
+        a1.allocated_resources.tasks["web"].devices[0].device_ids = [
+            nvidia_dev0
+        ]
+        assert not d.add_allocs([a1])
+        res = nvidia_allocated_device()
+        res.device_ids = [nvidia_dev0]
+        assert d.add_reserved(res)
+
+
+class TestBitmapPort:
+    def test_bitmap(self):
+        # ref TestBitmap (bitmap_test.go:8)
+        b = Bitmap(16)
+        assert not b.check(8)
+        b.set(8)
+        assert b.check(8)
+        # a second bit
+        b.set(15)
+        assert b.check(15)
+        assert not b.check(0)
+        assert sorted(b.indexes_in_range(True, 0, 15)) == [8, 15]
+        assert 8 not in b.indexes_in_range(False, 0, 15)
+        b.unset(8)
+        assert not b.check(8)
+        assert b.check(15)

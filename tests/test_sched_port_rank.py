@@ -1,0 +1,435 @@
+"""Rank-iterator corpus ported from the reference
+(scheduler/rank_test.go — cited per test): bin-pack scoring against
+planned/existing/evicted allocs, task + group network offers, the job
+anti-affinity / rescheduling-penalty / node-affinity scorers, and score
+normalization. (TestBinPackIterator_Devices' allocator table is covered
+by the device cases of test_sched_port_preemption.py and the device
+feasibility suite, and is not re-ported.)"""
+
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import StaticIterator
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    NodeAffinityIterator,
+    NodeReschedulingPenaltyIterator,
+    RankedNode,
+    ScoreNormalizationIterator,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.model import (
+    Affinity,
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    EphemeralDisk,
+    NetworkResource,
+    Node,
+    NodeCpuResources,
+    NodeMemoryResources,
+    NodeReservedNetworkResources,
+    NodeReservedResources,
+    NodeResources,
+    Plan,
+    Resources,
+    Task,
+    TaskGroup,
+    generate_uuid,
+)
+
+
+def make_ctx(state=None):
+    h = Harness(seed=42)
+    snap = (state or h.state).snapshot()
+    return h, EvalContext(snap, Plan(), rng=random.Random(7))
+
+
+def collect_ranked(iterator):
+    out = []
+    while True:
+        nxt = iterator.next()
+        if nxt is None:
+            return out
+        out.append(nxt)
+
+
+def cpu_mem_node(cpu, mem, r_cpu=0, r_mem=0, networks=None,
+                 reserved_ports=""):
+    n = Node(
+        id=generate_uuid(),
+        node_resources=NodeResources(
+            cpu=NodeCpuResources(cpu_shares=cpu),
+            memory=NodeMemoryResources(memory_mb=mem),
+            networks=list(networks or []),
+        ),
+    )
+    if r_cpu or r_mem or reserved_ports:
+        n.reserved_resources = NodeReservedResources(
+            cpu=NodeCpuResources(cpu_shares=r_cpu),
+            memory=NodeMemoryResources(memory_mb=r_mem),
+            networks=NodeReservedNetworkResources(
+                reserved_host_ports=reserved_ports
+            ),
+        )
+    else:
+        n.reserved_resources = None
+    return n
+
+
+def web_tg(cpu=1024, mem=1024, task_networks=None, group_networks=None):
+    return TaskGroup(
+        name="web",
+        # the Go tests build a zero-value EphemeralDisk literal; the
+        # dataclass default is the jobspec default (150MB), which these
+        # disk-less test nodes could never fit
+        ephemeral_disk=EphemeralDisk(size_mb=0),
+        networks=list(group_networks or []),
+        tasks=[
+            Task(
+                name="web",
+                resources=Resources(
+                    cpu=cpu, memory_mb=mem,
+                    networks=list(task_networks or []),
+                ),
+            )
+        ],
+    )
+
+
+def planned_fill(cpu, mem):
+    return Allocation(
+        id=generate_uuid(),
+        allocated_resources=AllocatedResources(
+            tasks={
+                "web": AllocatedTaskResources(
+                    cpu=AllocatedCpuResources(cpu_shares=cpu),
+                    memory=AllocatedMemoryResources(memory_mb=mem),
+                )
+            }
+        ),
+    )
+
+
+class TestFeasibleRankIteratorPort:
+    def test_passes_all_nodes_through(self):
+        # ref TestFeasibleRankIterator (rank_test.go:12)
+        h, ctx = make_ctx()
+        nodes = [mock.node() for _ in range(10)]
+        static = StaticIterator(ctx, nodes)
+        feasible = FeasibleRankIterator(ctx, static)
+        assert len(collect_ranked(feasible)) == 10
+
+
+class TestBinPackIteratorPort:
+    def test_no_existing_alloc_scoring(self):
+        # ref TestBinPackIterator_NoExistingAlloc (rank_test.go:28)
+        h, ctx = make_ctx()
+        perfect = RankedNode(cpu_mem_node(2048, 2048, 1024, 1024))
+        overloaded = RankedNode(cpu_mem_node(1024, 1024, 512, 512))
+        half = RankedNode(cpu_mem_node(4096, 4096, 1024, 1024))
+        static = StaticRankIterator(ctx, [perfect, overloaded, half])
+
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(web_tg())
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+
+        assert out == [perfect, half]
+        assert out[0].final_score == 1.0
+        assert 0.75 < out[1].final_score < 0.95
+
+    def test_network_offers_at_task_and_group_level(self):
+        # ref TestBinPackIterator_Network_Success (rank_test.go:131)
+        h, ctx = make_ctx()
+        nic = lambda: NetworkResource(
+            mode="host", device="eth0", cidr="192.168.0.100/32",
+            ip="192.168.0.100", mbits=1000,
+        )
+        perfect = RankedNode(
+            cpu_mem_node(2048, 2048, 1024, 1024, [nic()], "1000-2000")
+        )
+        half = RankedNode(
+            cpu_mem_node(4096, 4096, 1024, 1024, [nic()], "1000-2000")
+        )
+        static = StaticRankIterator(ctx, [perfect, half])
+
+        tg = web_tg(
+            task_networks=[NetworkResource(device="eth0", mbits=300)],
+            group_networks=[NetworkResource(device="eth0", mbits=500)],
+        )
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(tg)
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+
+        assert out == [perfect, half]
+        assert out[0].final_score == 1.0
+        assert 0.75 < out[1].final_score < 0.95
+        # group-level offer rides alloc_resources, task-level the task map
+        for rn in out:
+            assert rn.alloc_resources.networks[0].mbits == 500
+            assert rn.task_resources["web"].networks[0].mbits == 300
+
+    def test_network_overprovision_fails_with_dimension(self):
+        # ref TestBinPackIterator_Network_Failure (rank_test.go:257)
+        h, ctx = make_ctx()
+        node = RankedNode(
+            cpu_mem_node(
+                4096, 4096, 1024, 1024,
+                [NetworkResource(
+                    mode="host", device="eth0", cidr="192.168.0.100/32",
+                    ip="192.168.0.100", mbits=1000,
+                )],
+                "1000-2000",
+            )
+        )
+        # a planned alloc that takes 700 mbits (300 task + 400 group)
+        ctx.plan.node_allocation[node.node.id] = [
+            Allocation(
+                id=generate_uuid(),
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=2048),
+                            memory=AllocatedMemoryResources(memory_mb=2048),
+                            networks=[
+                                NetworkResource(
+                                    device="eth0", ip="192.168.0.1",
+                                    mbits=300,
+                                )
+                            ],
+                        )
+                    },
+                    shared=AllocatedSharedResources(
+                        networks=[
+                            NetworkResource(
+                                device="eth0", ip="192.168.0.1", mbits=400
+                            )
+                        ]
+                    ),
+                ),
+            )
+        ]
+        static = StaticRankIterator(ctx, [node])
+        tg = web_tg(
+            task_networks=[NetworkResource(device="eth0", mbits=300)],
+            group_networks=[NetworkResource(device="eth0", mbits=250)],
+        )
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(tg)
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+
+        # 550 asked, only 300 free -> no options, exhaustion recorded
+        assert out == []
+        assert (
+            ctx.metrics.dimension_exhausted[
+                "network: bandwidth exceeded"
+            ] == 1
+        )
+
+    def test_planned_alloc_consumes_capacity(self):
+        # ref TestBinPackIterator_PlannedAlloc (rank_test.go:370)
+        h, ctx = make_ctx()
+        n1 = RankedNode(cpu_mem_node(2048, 2048))
+        n2 = RankedNode(cpu_mem_node(2048, 2048))
+        ctx.plan.node_allocation[n1.node.id] = [planned_fill(2048, 2048)]
+        ctx.plan.node_allocation[n2.node.id] = [planned_fill(1024, 1024)]
+
+        static = StaticRankIterator(ctx, [n1, n2])
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(web_tg())
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+        assert out == [n2]
+        assert out[0].final_score == 1.0
+
+    def _existing_alloc_state(self, n1, n2):
+        h = Harness(seed=42)
+
+        def existing(node, cpu, mem):
+            j = mock.job()
+            return Allocation(
+                namespace="default",
+                id=generate_uuid(),
+                eval_id=generate_uuid(),
+                node_id=node.id,
+                job_id=j.id,
+                job=j,
+                task_group="web",
+                desired_status="run",
+                client_status="pending",
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=cpu),
+                            memory=AllocatedMemoryResources(memory_mb=mem),
+                        )
+                    }
+                ),
+            )
+
+        alloc1 = existing(n1.node, 2048, 2048)
+        alloc2 = existing(n2.node, 1024, 1024)
+        h.state.upsert_allocs(1000, [alloc1, alloc2])
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+        return ctx, alloc1, alloc2
+
+    def test_existing_alloc_consumes_capacity(self):
+        # ref TestBinPackIterator_ExistingAlloc (rank_test.go:472)
+        n1 = RankedNode(cpu_mem_node(2048, 2048))
+        n2 = RankedNode(cpu_mem_node(2048, 2048))
+        ctx, _, _ = self._existing_alloc_state(n1, n2)
+        static = StaticRankIterator(ctx, [n1, n2])
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(web_tg())
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+        assert out == [n2]
+        assert out[0].final_score == 1.0
+
+    def test_existing_alloc_with_planned_evict_frees_capacity(self):
+        # ref TestBinPackIterator_ExistingAlloc_PlannedEvict (rank_test.go:587)
+        n1 = RankedNode(cpu_mem_node(2048, 2048))
+        n2 = RankedNode(cpu_mem_node(2048, 2048))
+        ctx, alloc1, _ = self._existing_alloc_state(n1, n2)
+        ctx.plan.node_update[n1.node.id] = [alloc1]
+
+        static = StaticRankIterator(ctx, [n1, n2])
+        binp = BinPackIterator(ctx, static, False, 0)
+        binp.set_task_group(web_tg())
+        out = collect_ranked(ScoreNormalizationIterator(ctx, binp))
+        assert out == [n1, n2]
+        assert 0.50 < out[0].final_score < 0.95
+        assert out[1].final_score == 1.0
+
+
+class TestScorerIteratorsPort:
+    def _two_bare_nodes(self, ctx):
+        return (
+            RankedNode(Node(id=generate_uuid())),
+            RankedNode(Node(id=generate_uuid())),
+        )
+
+    def test_job_anti_affinity_planned_alloc(self):
+        # ref TestJobAntiAffinity_PlannedAlloc (rank_test.go:1033)
+        h, ctx = make_ctx()
+        n1, n2 = self._two_bare_nodes(ctx)
+        job = mock.job()
+        job.id = "foo"
+        tg = job.task_groups[0]
+        tg.count = 4
+        ctx.plan.node_allocation[n1.node.id] = [
+            Allocation(id=generate_uuid(), job_id="foo", task_group=tg.name),
+            Allocation(id=generate_uuid(), job_id="foo", task_group=tg.name),
+        ]
+        ctx.plan.node_allocation[n2.node.id] = [
+            Allocation(id=generate_uuid(), job_id="bar")
+        ]
+
+        static = StaticRankIterator(ctx, [n1, n2])
+        anti = JobAntiAffinityIterator(ctx, static, "foo")
+        anti.set_job(job)
+        anti.set_task_group(tg)
+        out = collect_ranked(ScoreNormalizationIterator(ctx, anti))
+
+        assert out == [n1, n2]
+        # -(collisions + 1) / desired_count = -(3/4)
+        assert out[0].final_score == -0.75
+        assert out[1].final_score == 0.0
+
+    def test_node_rescheduling_penalty(self):
+        # ref TestNodeAntiAffinity_PenaltyNodes (rank_test.go:1113)
+        h, ctx = make_ctx()
+        n1, n2 = self._two_bare_nodes(ctx)
+        static = StaticRankIterator(ctx, [n1, n2])
+        pen = NodeReschedulingPenaltyIterator(ctx, static)
+        pen.set_penalty_nodes({n1.node.id})
+        out = collect_ranked(ScoreNormalizationIterator(ctx, pen))
+        assert [rn.node.id for rn in out] == [n1.node.id, n2.node.id]
+        assert out[0].final_score == -1.0
+        assert out[1].final_score == 0.0
+
+    def test_score_normalization_averages_scorers(self):
+        # ref TestScoreNormalizationIterator (rank_test.go:1149)
+        h, ctx = make_ctx()
+        n1, n2 = self._two_bare_nodes(ctx)
+        job = mock.job()
+        job.id = "foo"
+        tg = job.task_groups[0]
+        tg.count = 4
+        ctx.plan.node_allocation[n1.node.id] = [
+            Allocation(id=generate_uuid(), job_id="foo", task_group=tg.name),
+            Allocation(id=generate_uuid(), job_id="foo", task_group=tg.name),
+        ]
+        ctx.plan.node_allocation[n2.node.id] = [
+            Allocation(id=generate_uuid(), job_id="bar")
+        ]
+
+        static = StaticRankIterator(ctx, [n1, n2])
+        anti = JobAntiAffinityIterator(ctx, static, "foo")
+        anti.set_job(job)
+        anti.set_task_group(tg)
+        pen = NodeReschedulingPenaltyIterator(ctx, anti)
+        pen.set_penalty_nodes({n1.node.id})
+        out = collect_ranked(ScoreNormalizationIterator(ctx, pen))
+
+        assert out == [n1, n2]
+        # average of -0.75 (anti-affinity) and -1.0 (penalty)
+        assert out[0].final_score == -0.875
+        assert out[1].final_score == 0.0
+
+    def test_node_affinity_scores(self):
+        # ref TestNodeAffinityIterator (rank_test.go:1214)
+        h, ctx = make_ctx()
+        nodes = [RankedNode(mock.node()) for _ in range(4)]
+        nodes[0].node.attributes["kernel.version"] = "4.9"
+        nodes[1].node.datacenter = "dc2"
+        nodes[2].node.datacenter = "dc2"
+        nodes[2].node.node_class = "large"
+
+        affinities = [
+            Affinity(
+                operand="=", l_target="${node.datacenter}",
+                r_target="dc1", weight=100,
+            ),
+            Affinity(
+                operand="=", l_target="${node.datacenter}",
+                r_target="dc2", weight=-100,
+            ),
+            Affinity(
+                operand="version", l_target="${attr.kernel.version}",
+                r_target=">4.0", weight=50,
+            ),
+            Affinity(
+                operand="is", l_target="${node.class}",
+                r_target="large", weight=50,
+            ),
+        ]
+        job = mock.job()
+        job.id = "foo"
+        tg = job.task_groups[0]
+        tg.affinities = affinities
+
+        static = StaticRankIterator(ctx, nodes)
+        aff = NodeAffinityIterator(ctx, static)
+        aff.set_task_group(tg)
+        out = collect_ranked(ScoreNormalizationIterator(ctx, aff))
+
+        expected = {
+            # dc1 (100) + kernel version (50) of total weight 300
+            nodes[0].node.id: 0.5,
+            # dc2 anti-affinity (-100)
+            nodes[1].node.id: -(1.0 / 3.0),
+            # dc2 (-100) + class large (50)
+            nodes[2].node.id: -(1.0 / 6.0),
+            # dc1 (100)
+            nodes[3].node.id: 1.0 / 3.0,
+        }
+        for rn in out:
+            assert abs(rn.final_score - expected[rn.node.id]) < 1e-9, (
+                rn.node.id, rn.final_score, expected[rn.node.id],
+            )

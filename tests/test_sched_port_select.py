@@ -1,0 +1,316 @@
+"""Select-iterator + eval-context corpus ported from the reference
+(scheduler/select_test.go and context_test.go — cited per test): the
+bounded-limit scan with score-threshold skipping, max-score selection,
+proposed-alloc overlays, and the computed-class eligibility cache."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import (
+    EVAL_COMPUTED_CLASS_ELIGIBLE,
+    EVAL_COMPUTED_CLASS_INELIGIBLE,
+    EVAL_COMPUTED_CLASS_UNKNOWN,
+    EvalContext,
+    EvalEligibility,
+)
+from nomad_tpu.scheduler.rank import RankedNode, StaticRankIterator
+from nomad_tpu.scheduler.select import LimitIterator, MaxScoreIterator
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.model import (
+    AllocatedCpuResources,
+    AllocatedMemoryResources,
+    AllocatedResources,
+    AllocatedTaskResources,
+    Allocation,
+    Constraint,
+    Node,
+    NodeCpuResources,
+    NodeMemoryResources,
+    NodeResources,
+    Plan,
+    generate_uuid,
+)
+
+
+def make_ctx(state=None):
+    h = Harness(seed=42)
+    snap = (state or h.state).snapshot()
+    return h, EvalContext(snap, Plan(), rng=random.Random(7))
+
+
+def collect_ranked(iterator):
+    out = []
+    while True:
+        nxt = iterator.next()
+        if nxt is None:
+            return out
+        out.append(nxt)
+
+
+def scored(node, score):
+    rn = RankedNode(node)
+    rn.final_score = score
+    return rn
+
+
+class TestLimitIteratorPort:
+    def test_limit_and_reset(self):
+        # ref TestLimitIterator (select_test.go:11)
+        h, ctx = make_ctx()
+        nodes = [scored(mock.node(), s) for s in (1, 2, 3)]
+        static = StaticRankIterator(ctx, nodes)
+        limit = LimitIterator(ctx, static, 1, 0, 2)
+        limit.set_limit(2)
+
+        out = collect_ranked(limit)
+        assert len(out) == 2
+        assert out[0] in nodes and out[1] in nodes
+
+        # exhausted until reset
+        assert collect_ranked(limit) == []
+        limit.reset()
+        out = collect_ranked(limit)
+        assert len(out) == 2
+
+    # ref TestLimitIterator_ScoreThreshold (select_test.go:54): each case
+    # feeds scored nodes through limit=2 / threshold=-1 / max_skip=2
+    THRESHOLD_CASES = [
+        (
+            "skips one low scoring node",
+            [-1, 2, 3],
+            [1, 2],
+        ),
+        (
+            "skips max_skip scoring nodes",
+            [-1, -2, 3, 4],
+            [2, 3],
+        ),
+        (
+            "max_skip limit reached",
+            [-1, -6, -3, -4],
+            [2, 3],
+        ),
+        (
+            "draw both from skipped nodes",
+            [-1, -6],
+            [0, 1],
+        ),
+        (
+            "one node above threshold, one skipped node",
+            [-1, 5],
+            [1, 0],
+        ),
+        (
+            "low scoring nodes interspersed",
+            [-1, 5, -2, 2],
+            [1, 3],
+        ),
+        (
+            "only one node, score below threshold",
+            [-1],
+            [0],
+        ),
+    ]
+
+    @pytest.mark.parametrize(
+        "desc,scores,expected_idx",
+        THRESHOLD_CASES,
+        ids=[c[0] for c in THRESHOLD_CASES],
+    )
+    def test_score_threshold(self, desc, scores, expected_idx):
+        h, ctx = make_ctx()
+        base = [mock.node() for _ in range(len(scores))]
+        ranked = [scored(n, s) for n, s in zip(base, scores)]
+        static = StaticRankIterator(ctx, ranked)
+        limit = LimitIterator(ctx, static, 1, -1, 2)
+        limit.set_limit(2)
+        out = collect_ranked(limit)
+        assert [rn.node.id for rn in out] == [
+            base[i].id for i in expected_idx
+        ], desc
+        limit.reset()
+        assert limit.skipped_node_index == 0
+        assert limit.skipped_nodes == []
+
+    def test_max_skip_more_than_available(self):
+        # last THRESHOLD_CASES entry of the Go table uses max_skip=10
+        h, ctx = make_ctx()
+        base = [mock.node(), mock.node()]
+        ranked = [scored(base[0], -2), scored(base[1], 1)]
+        static = StaticRankIterator(ctx, ranked)
+        limit = LimitIterator(ctx, static, 1, -1, 10)
+        limit.set_limit(2)
+        out = collect_ranked(limit)
+        assert [rn.node.id for rn in out] == [base[1].id, base[0].id]
+
+
+class TestMaxScoreIteratorPort:
+    def test_max_score_and_reset(self):
+        # ref TestMaxScoreIterator (select_test.go:307)
+        h, ctx = make_ctx()
+        nodes = [scored(mock.node(), s) for s in (1, 2, 3)]
+        static = StaticRankIterator(ctx, nodes)
+        max_iter = MaxScoreIterator(ctx, static)
+
+        out = collect_ranked(max_iter)
+        assert out == [nodes[2]]
+        assert collect_ranked(max_iter) == []
+        max_iter.reset()
+        assert collect_ranked(max_iter) == [nodes[2]]
+
+
+class TestEvalContextProposedAllocPort:
+    def test_proposed_allocs_overlay_plan(self):
+        # ref TestEvalContext_ProposedAlloc (context_test.go:28)
+        h = Harness(seed=42)
+        n1 = Node(
+            id=generate_uuid(),
+            node_resources=NodeResources(
+                cpu=NodeCpuResources(cpu_shares=2048),
+                memory=NodeMemoryResources(memory_mb=2048),
+            ),
+        )
+        n2 = Node(
+            id=generate_uuid(),
+            node_resources=NodeResources(
+                cpu=NodeCpuResources(cpu_shares=2048),
+                memory=NodeMemoryResources(memory_mb=2048),
+            ),
+        )
+
+        def existing(node, cpu, mem):
+            j = mock.job()
+            return Allocation(
+                id=generate_uuid(),
+                namespace="default",
+                eval_id=generate_uuid(),
+                node_id=node.id,
+                job_id=j.id,
+                job=j,
+                task_group="web",
+                desired_status="run",
+                client_status="pending",
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=cpu),
+                            memory=AllocatedMemoryResources(memory_mb=mem),
+                        )
+                    }
+                ),
+            )
+
+        alloc1 = existing(n1, 2048, 2048)
+        alloc2 = existing(n2, 1024, 1024)
+        h.state.upsert_allocs(1000, [alloc1, alloc2])
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+
+        # plan: evict alloc1 from n1; place a new alloc on n2
+        ctx.plan.node_update[n1.id] = [alloc1]
+        ctx.plan.node_allocation[n2.id] = [
+            Allocation(
+                id=generate_uuid(),
+                allocated_resources=AllocatedResources(
+                    tasks={
+                        "web": AllocatedTaskResources(
+                            cpu=AllocatedCpuResources(cpu_shares=1024),
+                            memory=AllocatedMemoryResources(memory_mb=1024),
+                        )
+                    }
+                ),
+            )
+        ]
+
+        assert ctx.proposed_allocs(n1.id) == []
+        assert len(ctx.proposed_allocs(n2.id)) == 2
+
+
+class TestEvalEligibilityPort:
+    def test_job_status(self):
+        # ref TestEvalEligibility_JobStatus (context_test.go:152)
+        e = EvalEligibility()
+        cc = "v1:100"
+        assert e.job_status(cc) == EVAL_COMPUTED_CLASS_UNKNOWN
+        e.set_job_eligibility(False, cc)
+        assert e.job_status(cc) == EVAL_COMPUTED_CLASS_INELIGIBLE
+        e.set_job_eligibility(True, cc)
+        assert e.job_status(cc) == EVAL_COMPUTED_CLASS_ELIGIBLE
+
+    def test_task_group_status(self):
+        # ref TestEvalEligibility_TaskGroupStatus (context_test.go:173)
+        e = EvalEligibility()
+        cc, tg = "v1:100", "foo"
+        assert e.task_group_status(tg, cc) == EVAL_COMPUTED_CLASS_UNKNOWN
+        e.set_task_group_eligibility(False, tg, cc)
+        assert e.task_group_status(tg, cc) == EVAL_COMPUTED_CLASS_INELIGIBLE
+        e.set_task_group_eligibility(True, tg, cc)
+        assert e.task_group_status(tg, cc) == EVAL_COMPUTED_CLASS_ELIGIBLE
+
+    def test_set_job_marks_escaped_constraints(self):
+        # ref TestEvalEligibility_SetJob (context_test.go:195)
+        e = EvalEligibility()
+        ne1 = Constraint(
+            l_target="${attr.kernel.name}", r_target="linux", operand="="
+        )
+        e1 = Constraint(
+            l_target="${attr.unique.kernel.name}", r_target="linux",
+            operand="=",
+        )
+        e2 = Constraint(
+            l_target="${meta.unique.key_foo}", r_target="linux", operand="<"
+        )
+        e3 = Constraint(
+            l_target="${meta.unique.key_foo}", r_target="Windows",
+            operand="<",
+        )
+        job = mock.job()
+        job.constraints = [ne1, e1, e2]
+        tg = job.task_groups[0]
+        tg.constraints = [e1]
+        tg.tasks[0].constraints = [e3]
+
+        e.set_job(job)
+        assert e.has_escaped()
+        assert e.job_escaped
+        assert e.tg_escaped.get(tg.name) is True
+
+    def test_get_classes(self):
+        # ref TestEvalEligibility_GetClasses (context_test.go:240)
+        e = EvalEligibility()
+        e.set_job_eligibility(True, "v1:1")
+        e.set_job_eligibility(False, "v1:2")
+        e.set_task_group_eligibility(True, "foo", "v1:3")
+        e.set_task_group_eligibility(False, "bar", "v1:4")
+        e.set_task_group_eligibility(True, "bar", "v1:5")
+        e.set_task_group_eligibility(False, "fizz", "v1:1")
+        e.set_task_group_eligibility(False, "fizz", "v1:3")
+        assert e.get_classes() == {
+            "v1:1": False,
+            "v1:2": False,
+            "v1:3": True,
+            "v1:4": False,
+            "v1:5": True,
+        }
+
+    def test_get_classes_job_eligible_task_group_ineligible(self):
+        # ref TestEvalEligibility_GetClasses_JobEligible_TaskGroupIneligible
+        # (context_test.go:263)
+        e = EvalEligibility()
+        e.set_job_eligibility(True, "v1:1")
+        e.set_task_group_eligibility(False, "foo", "v1:1")
+
+        e.set_job_eligibility(True, "v1:2")
+        e.set_task_group_eligibility(False, "foo", "v1:2")
+        e.set_task_group_eligibility(True, "bar", "v1:2")
+
+        e.set_job_eligibility(True, "v1:3")
+        e.set_task_group_eligibility(False, "foo", "v1:3")
+        e.set_task_group_eligibility(False, "bar", "v1:3")
+
+        assert e.get_classes() == {
+            "v1:1": False,
+            "v1:2": True,
+            "v1:3": False,
+        }

@@ -1,0 +1,294 @@
+"""Spread-iterator corpus ported from the reference
+(scheduler/spread_test.go — cited per test): targeted percent spreads,
+multi-attribute combination, even spread boosts across planning rounds,
+max-penalty cases, and the even-spread boost helper."""
+
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.propertyset import PropertySet
+from nomad_tpu.scheduler.rank import (
+    RankedNode,
+    ScoreNormalizationIterator,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.spread import SpreadIterator, even_spread_score_boost
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.model import (
+    Allocation,
+    Node,
+    Plan,
+    Spread,
+    SpreadTarget,
+    generate_uuid,
+)
+
+
+def collect_ranked(iterator):
+    out = []
+    while True:
+        nxt = iterator.next()
+        if nxt is None:
+            return out
+        out.append(nxt)
+
+
+def job_alloc(job, tg, node_id):
+    return Allocation(
+        namespace="default",
+        task_group=tg.name,
+        job_id=job.id,
+        job=job,
+        id=generate_uuid(),
+        eval_id=generate_uuid(),
+        node_id=node_id,
+    )
+
+
+def run_spread(ctx, nodes, job, tg):
+    for rn in nodes:
+        rn.scores = []
+        rn.final_score = 0.0
+    static = StaticRankIterator(ctx, nodes)
+    it = SpreadIterator(ctx, static)
+    it.set_job(job)
+    it.set_task_group(tg)
+    return collect_ranked(ScoreNormalizationIterator(ctx, it))
+
+
+class TestSpreadIteratorSingleAttribute:
+    def test_targeted_percent_boosts_then_saturates(self):
+        # ref TestSpreadIterator_SingleAttribute (spread_test.go:15)
+        h = Harness(seed=42)
+        dcs = ["dc1", "dc2", "dc1", "dc1"]
+        nodes = []
+        for i, dc in enumerate(dcs):
+            n = mock.node()
+            n.datacenter = dc
+            h.state.upsert_node(100 + i, n)
+            nodes.append(RankedNode(n))
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 10
+        h.state.upsert_allocs(1000, [
+            job_alloc(job, tg, nodes[0].node.id),
+            job_alloc(job, tg, nodes[2].node.id),
+        ])
+
+        tg.spreads = [
+            Spread(
+                weight=100, attribute="${node.datacenter}",
+                spread_target=[SpreadTarget(value="dc1", percent=80)],
+            )
+        ]
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+        out = run_spread(ctx, nodes, job, tg)
+
+        # boost = (desired - actual) / desired: dc1 (8-3)/8 -> .625 after
+        # this placement; dc2 (2-1)/2 -> .5
+        expected = {"dc1": 0.625, "dc2": 0.5}
+        for rn in out:
+            assert rn.final_score == expected[rn.node.datacenter], (
+                rn.node.datacenter, rn.final_score,
+            )
+
+        # add planned allocs until dc1 meets its desired count; dc1 stops
+        # boosting, dc2 keeps its boost. A different job's alloc on the
+        # same node must be ignored.
+        ctx.plan.node_allocation[nodes[0].node.id] = [
+            job_alloc(job, tg, nodes[0].node.id),
+            job_alloc(job, tg, nodes[0].node.id),
+            Allocation(
+                namespace="default", task_group="bbb", job_id="ignore 2",
+                job=job, id=generate_uuid(), node_id=nodes[0].node.id,
+            ),
+        ]
+        ctx.plan.node_allocation[nodes[3].node.id] = [
+            job_alloc(job, tg, nodes[3].node.id) for _ in range(3)
+        ]
+        out = run_spread(ctx, nodes, job, tg)
+        expected = {"dc1": 0.0, "dc2": 0.5}
+        for rn in out:
+            assert rn.final_score == expected[rn.node.datacenter]
+
+
+class TestSpreadIteratorMultipleAttributes:
+    def test_two_weighted_spreads_combine(self):
+        # ref TestSpreadIterator_MultipleAttributes (spread_test.go:173)
+        h = Harness(seed=42)
+        dcs = ["dc1", "dc2", "dc1", "dc1"]
+        racks = ["r1", "r1", "r2", "r2"]
+        nodes = []
+        for i, dc in enumerate(dcs):
+            n = mock.node()
+            n.datacenter = dc
+            n.meta["rack"] = racks[i]
+            h.state.upsert_node(100 + i, n)
+            nodes.append(RankedNode(n))
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 10
+        h.state.upsert_allocs(1000, [
+            job_alloc(job, tg, nodes[0].node.id),
+            job_alloc(job, tg, nodes[2].node.id),
+        ])
+
+        tg.spreads = [
+            Spread(
+                weight=100, attribute="${node.datacenter}",
+                spread_target=[
+                    SpreadTarget(value="dc1", percent=60),
+                    SpreadTarget(value="dc2", percent=40),
+                ],
+            ),
+            Spread(
+                weight=50, attribute="${meta.rack}",
+                spread_target=[
+                    SpreadTarget(value="r1", percent=40),
+                    SpreadTarget(value="r2", percent=60),
+                ],
+            ),
+        ]
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+        out = run_spread(ctx, nodes, job, tg)
+
+        expected = {
+            nodes[0].node.id: 0.500,
+            nodes[1].node.id: 0.667,
+            nodes[2].node.id: 0.556,
+            nodes[3].node.id: 0.556,
+        }
+        for rn in out:
+            assert f"{rn.final_score:.3f}" == f"{expected[rn.node.id]:.3f}"
+
+
+class TestSpreadIteratorEvenSpread:
+    def test_even_spread_across_planning_rounds(self):
+        # ref TestSpreadIterator_EvenSpread (spread_test.go:274)
+        h = Harness(seed=42)
+        dcs = [
+            "dc1", "dc2", "dc1", "dc2", "dc1",
+            "dc2", "dc2", "dc1", "dc1", "dc1",
+        ]
+        nodes = []
+        for i, dc in enumerate(dcs):
+            n = mock.node()
+            n.datacenter = dc
+            h.state.upsert_node(100 + i, n)
+            nodes.append(RankedNode(n))
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 10
+        tg.spreads = [
+            Spread(weight=100, attribute="${node.datacenter}")
+        ]
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+
+        # nothing placed: every node scores 0
+        out = run_spread(ctx, nodes, job, tg)
+        for rn in out:
+            assert f"{rn.final_score:.3f}" == "0.000"
+
+        # one alloc in each of two dc1 nodes: dc1 penalized, dc2 boosted
+        ctx.plan.node_allocation[nodes[0].node.id] = [
+            job_alloc(job, tg, nodes[0].node.id)
+        ]
+        ctx.plan.node_allocation[nodes[2].node.id] = [
+            job_alloc(job, tg, nodes[2].node.id)
+        ]
+        out = run_spread(ctx, nodes, job, tg)
+        expected = {"dc1": -1.0, "dc2": 1.0}
+        for rn in out:
+            assert rn.final_score == expected[rn.node.datacenter]
+
+        # three allocs in dc2 vs two in dc1: boosts flip proportionally
+        ctx.plan.node_allocation[nodes[1].node.id] = [
+            job_alloc(job, tg, nodes[1].node.id) for _ in range(2)
+        ]
+        ctx.plan.node_allocation[nodes[3].node.id] = [
+            job_alloc(job, tg, nodes[3].node.id)
+        ]
+        out = run_spread(ctx, nodes, job, tg)
+        expected = {"dc1": 0.5, "dc2": -0.5}
+        for rn in out:
+            assert f"{rn.final_score:.3f}" == f"{expected[rn.node.datacenter]:.3f}"
+
+        # a fresh dc3 node appears and dc1 catches up to dc2: the empty
+        # dc gets the max boost, the full ones the max penalty
+        n = mock.node()
+        n.datacenter = "dc3"
+        h.state.upsert_node(1111, n)
+        nodes.append(RankedNode(n))
+        ctx = EvalContext(
+            h.state.snapshot(), ctx.plan, rng=random.Random(7)
+        )
+        ctx.plan.node_allocation[nodes[4].node.id] = [
+            job_alloc(job, tg, nodes[4].node.id)
+        ]
+        out = run_spread(ctx, nodes, job, tg)
+        expected = {"dc1": -1.0, "dc2": -1.0, "dc3": 1.0}
+        for rn in out:
+            assert f"{rn.final_score:.3f}" == f"{expected[rn.node.datacenter]:.3f}"
+
+
+class TestSpreadIteratorMaxPenalty:
+    def test_unmatched_target_and_missing_attribute_score_minus_one(self):
+        # ref TestSpreadIterator_MaxPenalty (spread_test.go:462)
+        h = Harness(seed=42)
+        nodes = []
+        for i in range(5):
+            n = mock.node()
+            n.datacenter = "dc3"
+            h.state.upsert_node(100 + i, n)
+            nodes.append(RankedNode(n))
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 5
+        tg.spreads = [
+            Spread(
+                weight=100, attribute="${node.datacenter}",
+                spread_target=[
+                    SpreadTarget(value="dc1", percent=80),
+                    SpreadTarget(value="dc2", percent=20),
+                ],
+            )
+        ]
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+        out = run_spread(ctx, nodes, job, tg)
+        for rn in out:
+            assert rn.final_score == -1.0
+
+        # spread on an attribute no node carries: also max penalty
+        tg.spreads = [
+            Spread(
+                weight=100, attribute="${meta.foo}",
+                spread_target=[
+                    SpreadTarget(value="bar", percent=80),
+                    SpreadTarget(value="baz", percent=20),
+                ],
+            )
+        ]
+        out = run_spread(ctx, nodes, job, tg)
+        for rn in out:
+            assert rn.final_score == -1.0
+
+
+class TestEvenSpreadScoreBoostHelper:
+    def test_cleared_values_do_not_divide_by_zero(self):
+        # ref Test_evenSpreadScoreBoost (spread_test.go:549)
+        job = mock.job()
+        h = Harness(seed=42)
+        ctx = EvalContext(h.state.snapshot(), Plan(), rng=random.Random(7))
+        pset = PropertySet(ctx, job)
+        pset.existing_values = {}
+        pset.proposed_values = {"dc2": 1, "dc1": 1, "dc3": 1}
+        pset.cleared_values = {"dc2": 1, "dc3": 1}
+        pset.target_attribute = "${node.datacenter}"
+
+        boost = even_spread_score_boost(pset, Node(datacenter="dc2"))
+        assert boost == 1.0
