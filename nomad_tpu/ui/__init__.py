@@ -190,12 +190,38 @@ const routes = {
     const n = await api('/v1/node/' + id);
     let allocs = [];
     try { allocs = await api('/v1/node/' + id + '/allocations'); } catch {}
-    return `<div class="crumb"><a href="#/nodes">nodes</a> / ${esc(n.name)}</div>` +
+    let html = `<div class="crumb"><a href="#/nodes">nodes</a> / ${esc(n.name)}</div>` +
+      `<dl class="kv">
+        <dt>Status</dt><dd>${badge(esc(n.status))}</dd>
+        <dt>Eligibility</dt><dd>${badge(esc(n.scheduling_eligibility))}${n.drain ? ' (draining)' : ''}</dd>
+        <dt>Datacenter</dt><dd>${esc(n.datacenter)}</dd>
+        <dt>Class</dt><dd>${esc(n.node_class || '-')}</dd>
+        <dt>Drivers</dt><dd>${esc(Object.keys(n.drivers || {}).join(', ') || '-')}</dd>
+      </dl>`;
+    // node operator actions (ref ui node drain/eligibility controls)
+    html += `<div class="actions">
+      <button onclick="nodeAction('${n.id}','drain',{DrainSpec:{}})"
+        ${n.drain ? 'disabled' : ''}>Drain</button>
+      <button class="ghost" onclick="nodeAction('${n.id}','drain',{MarkEligible:true})"
+        ${n.drain ? '' : 'disabled'}>Stop drain</button>
+      <button class="ghost" onclick="nodeAction('${n.id}','eligibility',{Eligibility:'ineligible'})"
+        ${n.scheduling_eligibility === 'eligible' ? '' : 'disabled'}>Mark ineligible</button>
+      <button class="ghost" onclick="nodeAction('${n.id}','eligibility',{Eligibility:'eligible'})"
+        ${n.scheduling_eligibility === 'eligible' ? 'disabled' : ''}>Mark eligible</button>
+      <span id="nodeout"></span></div>`;
+    html += '<h3>Allocations</h3>' +
       table(['Alloc','Job','Group','Client'], allocs.map(a => ({
         id: a.ID, cells: [esc(a.ID.slice(0,8)), esc(a.JobID), esc(a.TaskGroup),
           badge(esc(a.ClientStatus))]
-      })), '#/allocation') +
-      `<h3>Node</h3><pre>${esc(JSON.stringify(n, null, 2))}</pre>`;
+      })), '#/allocation');
+    const events = (n.events || []).slice(-8);
+    if (events.length) {
+      html += '<h3>Events</h3><table><tr><th>Time</th><th>Subsystem</th><th>Message</th></tr>' +
+        events.map(e => `<tr><td>${new Date((e.timestamp||0)/1e6).toLocaleTimeString()}</td>` +
+          `<td>${esc(e.subsystem)}</td><td>${esc(e.message)}</td></tr>`).join('') +
+        '</table>';
+    }
+    return html + `<h3>Node</h3><pre>${esc(JSON.stringify(n, null, 2))}</pre>`;
   },
   async allocations() {
     const allocs = await api('/v1/allocations');
@@ -483,6 +509,13 @@ async function deployAction(id, action, body) {
   try {
     await api('/v1/deployment/' + action + '/' + id, 'PUT', body || {});
     render();  // show the new deployment state
+  } catch (e) { if (out) out.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
+}
+async function nodeAction(nodeId, action, body) {
+  const out = document.getElementById('nodeout');
+  try {
+    await api(`/v1/node/${nodeId}/${action}`, 'PUT', body || {});
+    render();  // show the new node state
   } catch (e) { if (out) out.innerHTML = `<span class="err">${esc(e.message)}</span>`; }
 }
 async function taskAction(allocId, action, taskB64) {

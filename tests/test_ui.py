@@ -320,3 +320,68 @@ class TestUiTaskDrilldown:
         finally:
             http.stop()
             agent.stop()
+
+
+class TestUiNodeActions:
+    def test_drain_and_eligibility_through_ui_request_sequence(self):
+        """The node page's operator buttons: drain with default spec,
+        stop-drain with MarkEligible, and the eligibility toggles — the
+        exact PUT bodies the inline nodeAction handler sends."""
+        agent, http, client = _agent_http()
+        try:
+            node_id = agent.clients[0].node.id
+
+            # Drain (DrainSpec {} = enable with defaults). With nothing
+            # placed the drainer completes immediately, but the node must
+            # come out ineligible until explicitly re-marked.
+            client.put(f"/v1/node/{node_id}/drain", body={"DrainSpec": {}})
+            assert _wait(
+                lambda: client.get("/v1/node/" + node_id)[0][
+                    "scheduling_eligibility"
+                ]
+                == "ineligible"
+            ), "drain did not mark the node ineligible"
+
+            # Stop drain, restoring eligibility
+            client.put(
+                f"/v1/node/{node_id}/drain", body={"MarkEligible": True}
+            )
+            n, _ = client.get("/v1/node/" + node_id)
+            assert n["drain"] is False
+            assert n["scheduling_eligibility"] == "eligible"
+
+            # Eligibility toggles
+            client.put(
+                f"/v1/node/{node_id}/eligibility",
+                body={"Eligibility": "ineligible"},
+            )
+            assert (
+                client.get("/v1/node/" + node_id)[0][
+                    "scheduling_eligibility"
+                ]
+                == "ineligible"
+            )
+            client.put(
+                f"/v1/node/{node_id}/eligibility",
+                body={"Eligibility": "eligible"},
+            )
+            assert (
+                client.get("/v1/node/" + node_id)[0][
+                    "scheduling_eligibility"
+                ]
+                == "eligible"
+            )
+
+            # the SPA carries the controls
+            import urllib.request
+
+            html = (
+                urllib.request.urlopen(http.address + "/ui", timeout=10)
+                .read()
+                .decode()
+            )
+            for needle in ("nodeAction", "Drain", "Mark ineligible"):
+                assert needle in html, f"UI missing {needle!r}"
+        finally:
+            http.stop()
+            agent.stop()
