@@ -1,0 +1,627 @@
+"""Reconciler canary / deployment-lifecycle / reschedule corpus ported
+from the reference (scheduler/reconcile_test.go — cited per test),
+extending tests/test_sched_port_reconcile.py with the families round 4
+left unported: new-canary creation across scale changes, canary
+promotion and replacement on tainted nodes, deployment cancellation and
+completion, max_parallel gating, and the reschedule now/later paths."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import AllocReconciler
+from nomad_tpu.structs.model import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_STOP,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    Deployment,
+    DeploymentStatus,
+    DeploymentTaskGroupState,
+    ReschedulePolicy,
+    RescheduleEvent,
+    RescheduleTracker,
+    TaskState,
+    UpdateStrategy,
+    generate_uuid,
+)
+
+MINUTE_NS = 60 * 1_000_000_000
+SECOND_NS = 1_000_000_000
+HOUR_NS = 60 * MINUTE_NS
+
+
+def update_ignore(existing, new_job, new_tg):
+    return True, False, None
+
+
+def update_destructive(existing, new_job, new_tg):
+    return False, True, None
+
+
+def update_fn_mock(handled, fallback):
+    """ref reconcile_test.go allocUpdateFnMock: per-alloc-id dispatch."""
+
+    def fn(existing, new_job, new_tg):
+        h = handled.get(existing.id)
+        if h is not None:
+            return h(existing, new_job, new_tg)
+        return fallback(existing, new_job, new_tg)
+
+    return fn
+
+
+def canary_update():
+    # ref reconcile_test.go:22 canaryUpdate
+    return UpdateStrategy(
+        canary=2, max_parallel=2, health_check="checks",
+        min_healthy_time=10 * SECOND_NS, healthy_deadline=10 * MINUTE_NS,
+        stagger=31 * SECOND_NS,
+    )
+
+
+def no_canary_update():
+    # ref reconcile_test.go:31 noCanaryUpdate
+    return UpdateStrategy(
+        max_parallel=4, health_check="checks",
+        min_healthy_time=10 * SECOND_NS, healthy_deadline=10 * MINUTE_NS,
+        stagger=31 * SECOND_NS,
+    )
+
+
+def old_allocs(job, n, tg_name="web"):
+    out = []
+    for i in range(n):
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.namespace = job.namespace
+        a.node_id = generate_uuid()
+        a.name = f"{job.id}.{tg_name}[{i}]"
+        a.task_group = tg_name
+        a.client_status = ALLOC_CLIENT_STATUS_RUNNING
+        out.append(a)
+    return out
+
+
+def make_canaries(job, deployment, state, n, tg_name="web"):
+    out = []
+    for i in range(n):
+        c = mock.alloc()
+        c.job = job
+        c.job_id = job.id
+        c.namespace = job.namespace
+        c.node_id = generate_uuid()
+        c.name = f"{job.id}.{tg_name}[{i}]"
+        c.task_group = tg_name
+        c.client_status = ALLOC_CLIENT_STATUS_RUNNING
+        c.deployment_id = deployment.id
+        state.placed_canaries = list(state.placed_canaries) + [c.id]
+        out.append(c)
+    return out
+
+
+def reconcile(job, allocs, update_fn=update_ignore, tainted=None,
+              deployment=None, batch=False, job_id=None, now_ns_=None):
+    r = AllocReconciler(
+        update_fn, batch, job_id or (job.id if job else "job"), job,
+        deployment, allocs, tainted or {}, generate_uuid(),
+        now_ns_=now_ns_,
+    )
+    return r.compute()
+
+
+def assert_results(results, place=0, destructive=0, inplace=0, stop=0,
+                   create_deployment=None):
+    assert len(results.place) == place, f"place {len(results.place)}"
+    assert len(results.destructive_update) == destructive, (
+        f"destructive {len(results.destructive_update)}"
+    )
+    assert len(results.inplace_update) == inplace
+    assert len(results.stop) == stop, f"stop {len(results.stop)}"
+    if create_deployment is not None:
+        assert (results.deployment is not None) == create_deployment
+
+
+def place_indexes(results):
+    return sorted(int(p.name.rsplit("[", 1)[1][:-1]) for p in results.place)
+
+
+def stop_indexes(results):
+    return sorted(
+        int(s.alloc.name.rsplit("[", 1)[1][:-1]) for s in results.stop
+    )
+
+
+class TestNewCanariesPort:
+    def test_new_canaries(self):
+        """ref TestReconciler_NewCanaries: job change under a canary
+        stanza places 2 canaries, touches nothing else, and creates a
+        deployment needing promotion."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, update_fn=update_destructive)
+
+        assert_results(r, place=2, create_deployment=True)
+        state = r.deployment.task_groups["web"]
+        assert state.desired_canaries == 2
+        assert state.desired_total == 10
+        upd = r.desired_tg_updates["web"]
+        assert upd.canary == 2 and upd.ignore == 10
+        assert place_indexes(r) == [0, 1]
+
+    def test_new_canaries_count_greater(self):
+        """ref TestReconciler_NewCanaries_CountGreater: canary count above
+        the group count places that many canaries."""
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].update.canary = 7
+        allocs = old_allocs(job, 3)
+        r = reconcile(job, allocs, update_fn=update_destructive)
+
+        assert_results(r, place=7, create_deployment=True)
+        state = r.deployment.task_groups["web"]
+        assert state.desired_canaries == 7
+        assert state.desired_total == 3
+        assert place_indexes(r) == [0, 1, 2, 3, 4, 5, 6]
+
+    def test_new_canaries_multi_tg(self):
+        """ref TestReconciler_NewCanaries_MultiTG."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        tg2 = job.task_groups[0].copy()
+        job.task_groups[0].name = "tg2"
+        job.task_groups.append(tg2)
+        allocs = old_allocs(job, 10, tg_name="tg2") + old_allocs(
+            job, 10, tg_name="web"
+        )
+        r = reconcile(job, allocs, update_fn=update_destructive)
+
+        assert_results(r, place=4, create_deployment=True)
+        for name in ("tg2", "web"):
+            state = r.deployment.task_groups[name]
+            assert state.desired_canaries == 2
+            assert state.desired_total == 10
+            upd = r.desired_tg_updates[name]
+            assert upd.canary == 2 and upd.ignore == 10
+
+    def test_new_canaries_scale_up(self):
+        """ref TestReconciler_NewCanaries_ScaleUp: canaries precede the
+        scale-up placements."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].count = 15
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, update_fn=update_destructive)
+
+        assert_results(r, place=2, create_deployment=True)
+        state = r.deployment.task_groups["web"]
+        assert state.desired_canaries == 2
+        assert state.desired_total == 15
+        assert place_indexes(r) == [0, 1]
+
+    def test_new_canaries_scale_down(self):
+        """ref TestReconciler_NewCanaries_ScaleDown: the scale-down stops
+        happen alongside the canary placements."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].count = 5
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, update_fn=update_destructive)
+
+        assert_results(r, place=2, stop=5, create_deployment=True)
+        assert place_indexes(r) == [0, 1]
+        assert stop_indexes(r) == [5, 6, 7, 8, 9]
+
+    def test_new_canaries_fill_names(self):
+        """ref TestReconciler_NewCanaries_FillNames: partially placed
+        canaries keep their names; the fill picks the gaps."""
+        job = mock.job()
+        job.task_groups[0].update = UpdateStrategy(
+            canary=4, max_parallel=2, health_check="checks",
+            min_healthy_time=10 * SECOND_NS,
+            healthy_deadline=10 * MINUTE_NS,
+        )
+        d = Deployment.new_for_job(job)
+        s = DeploymentTaskGroupState(
+            promoted=False, desired_total=10, desired_canaries=4,
+            placed_allocs=2,
+        )
+        d.task_groups["web"] = s
+        allocs = old_allocs(job, 10)
+        # canaries at the name ends: web[0] and web[3]
+        for i in (0, 3):
+            c = mock.alloc()
+            c.job = job
+            c.job_id = job.id
+            c.namespace = job.namespace
+            c.node_id = generate_uuid()
+            c.name = f"{job.id}.web[{i}]"
+            c.task_group = "web"
+            c.client_status = ALLOC_CLIENT_STATUS_RUNNING
+            c.deployment_id = d.id
+            s.placed_canaries = list(s.placed_canaries) + [c.id]
+            allocs.append(c)
+
+        r = reconcile(
+            job, allocs, update_fn=update_destructive, deployment=d
+        )
+        assert_results(r, place=2, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.canary == 2 and upd.ignore == 12
+        assert place_indexes(r) == [1, 2]
+
+
+class TestPromoteCanariesPort:
+    def test_promote_canaries_unblock(self):
+        """ref TestReconciler_PromoteCanaries_Unblock: after promotion the
+        rolling update resumes under max_parallel, stopping old allocs."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        d = Deployment.new_for_job(job)
+        s = DeploymentTaskGroupState(
+            promoted=True, desired_total=10, desired_canaries=2,
+            placed_allocs=2,
+        )
+        d.task_groups["web"] = s
+        allocs = old_allocs(job, 10)
+        handled = {}
+        for c in make_canaries(job, d, s, 2):
+            c.deployment_status = DeploymentStatus(healthy=True)
+            allocs.append(c)
+            handled[c.id] = update_ignore
+
+        r = reconcile(
+            job, allocs,
+            update_fn=update_fn_mock(handled, update_destructive),
+            deployment=d,
+        )
+        assert_results(r, destructive=2, stop=2, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.stop == 2
+        assert upd.destructive_update == 2
+        assert upd.ignore == 8
+        # no canary may be stopped
+        canary_ids = set(s.placed_canaries)
+        assert all(x.alloc.id not in canary_ids for x in r.stop)
+        assert stop_indexes(r) == [0, 1]
+
+    def test_promote_canaries_equal_count(self):
+        """ref TestReconciler_PromoteCanaries_CanariesEqualCount: promoted
+        canaries equal the count — old allocs stop, deployment completes."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].count = 2
+        d = Deployment.new_for_job(job)
+        s = DeploymentTaskGroupState(
+            promoted=True, desired_total=2, desired_canaries=2,
+            placed_allocs=2, healthy_allocs=2,
+        )
+        d.task_groups["web"] = s
+        allocs = old_allocs(job, 2)
+        handled = {}
+        for c in make_canaries(job, d, s, 2):
+            c.deployment_status = DeploymentStatus(healthy=True)
+            allocs.append(c)
+            handled[c.id] = update_ignore
+
+        r = reconcile(
+            job, allocs,
+            update_fn=update_fn_mock(handled, update_destructive),
+            deployment=d,
+        )
+        assert_results(r, stop=2, create_deployment=False)
+        assert len(r.deployment_updates) == 1
+        assert r.deployment_updates[0].status == DEPLOYMENT_STATUS_SUCCESSFUL
+        canary_ids = set(s.placed_canaries)
+        assert all(x.alloc.id not in canary_ids for x in r.stop)
+
+    def test_stop_old_canaries(self):
+        """ref TestReconciler_StopOldCanaries: a newer job version cancels
+        the previous deployment, stops its canaries, and places fresh
+        ones under a new deployment."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        d = Deployment.new_for_job(job)
+        s = DeploymentTaskGroupState(
+            promoted=False, desired_total=10, desired_canaries=2,
+            placed_allocs=2,
+        )
+        d.task_groups["web"] = s
+        job.version += 10
+        allocs = old_allocs(job, 10)
+        allocs.extend(make_canaries(job, d, s, 2))
+
+        r = reconcile(
+            job, allocs, update_fn=update_destructive, deployment=d
+        )
+        assert_results(r, place=2, stop=2, create_deployment=True)
+        assert len(r.deployment_updates) == 1
+        up = r.deployment_updates[0]
+        assert up.deployment_id == d.id
+        assert up.status == DEPLOYMENT_STATUS_CANCELLED
+        new_state = r.deployment.task_groups["web"]
+        assert new_state.desired_canaries == 2
+        assert new_state.desired_total == 10
+
+
+class TestCanaryTaintPort:
+    def _canary_fixture(self):
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        d = Deployment.new_for_job(job)
+        s = DeploymentTaskGroupState(
+            promoted=False, desired_total=10, desired_canaries=2,
+            placed_allocs=2,
+        )
+        d.task_groups["web"] = s
+        allocs = old_allocs(job, 10)
+        handled = {}
+        for c in make_canaries(job, d, s, 2):
+            allocs.append(c)
+            handled[c.id] = update_ignore
+        return job, d, allocs, handled
+
+    def test_drain_node_canary(self):
+        """ref TestReconciler_DrainNode_Canary: a draining canary is
+        replaced BY another canary."""
+        job, d, allocs, handled = self._canary_fixture()
+        n = mock.node()
+        n.id = allocs[11].node_id
+        n.drain = True
+        allocs[11].desired_transition.migrate = True
+        tainted = {n.id: n}
+
+        r = reconcile(
+            job, allocs,
+            update_fn=update_fn_mock(handled, update_destructive),
+            tainted=tainted, deployment=d,
+        )
+        assert_results(r, place=1, stop=1, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.canary == 1
+        assert upd.ignore == 11
+        assert stop_indexes(r) == [1]
+        assert place_indexes(r) == [1]
+
+    def test_lost_node_canary(self):
+        """ref TestReconciler_LostNode_Canary: a canary on a down node is
+        replaced by a new canary."""
+        job, d, allocs, handled = self._canary_fixture()
+        n = mock.node()
+        n.id = allocs[11].node_id
+        n.status = "down"
+        tainted = {n.id: n}
+
+        r = reconcile(
+            job, allocs,
+            update_fn=update_fn_mock(handled, update_destructive),
+            tainted=tainted, deployment=d,
+        )
+        assert_results(r, place=1, stop=1, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.canary == 1
+        assert upd.ignore == 11
+        assert stop_indexes(r) == [1]
+        assert place_indexes(r) == [1]
+
+
+class TestDeploymentLifecyclePort:
+    @pytest.mark.parametrize("failed_deployment,cancel", [
+        (False, True), (True, False),
+    ])
+    def test_cancel_deployment_job_stop(self, failed_deployment, cancel):
+        """ref TestReconciler_CancelDeployment_JobStop (stopped-job rows):
+        a running deployment cancels; a failed one is left alone."""
+        job = mock.job()
+        job.stop = True
+        d = Deployment.new_for_job(job)
+        if failed_deployment:
+            d.status = DEPLOYMENT_STATUS_FAILED
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, deployment=d)
+
+        if cancel:
+            assert len(r.deployment_updates) == 1
+            up = r.deployment_updates[0]
+            assert up.deployment_id == d.id
+            assert up.status == DEPLOYMENT_STATUS_CANCELLED
+        else:
+            assert r.deployment_updates == []
+        assert len(r.stop) == 10
+
+    @pytest.mark.parametrize("failed_deployment,cancel", [
+        (False, True), (True, False),
+    ])
+    def test_cancel_deployment_job_update(self, failed_deployment, cancel):
+        """ref TestReconciler_CancelDeployment_JobUpdate: a newer job
+        version cancels a RUNNING deployment only."""
+        job = mock.job()
+        d = Deployment.new_for_job(job)
+        if failed_deployment:
+            d.status = DEPLOYMENT_STATUS_FAILED
+        job.version += 10
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, deployment=d)
+
+        if cancel:
+            assert len(r.deployment_updates) == 1
+            assert r.deployment_updates[0].status == (
+                DEPLOYMENT_STATUS_CANCELLED
+            )
+        else:
+            assert r.deployment_updates == []
+        assert_results(r, create_deployment=False)
+        assert r.desired_tg_updates["web"].ignore == 10
+
+    def test_mark_deployment_complete(self):
+        """ref TestReconciler_MarkDeploymentComplete: all placed and
+        healthy under a promoted deployment — one successful update."""
+        job = mock.job()
+        job.task_groups[0].update = no_canary_update()
+        d = Deployment.new_for_job(job)
+        d.task_groups["web"] = DeploymentTaskGroupState(
+            promoted=True, desired_total=10, placed_allocs=10,
+            healthy_allocs=10,
+        )
+        allocs = old_allocs(job, 10)
+        for a in allocs:
+            a.deployment_id = d.id
+            a.deployment_status = DeploymentStatus(healthy=True)
+        r = reconcile(job, allocs, deployment=d)
+
+        assert_results(r, create_deployment=False)
+        assert len(r.deployment_updates) == 1
+        up = r.deployment_updates[0]
+        assert up.deployment_id == d.id
+        assert up.status == DEPLOYMENT_STATUS_SUCCESSFUL
+        assert r.desired_tg_updates["web"].ignore == 10
+
+    def test_destructive_max_parallel_zero_means_all(self):
+        """ref TestReconciler_DestructiveMaxParallel (mock.MaxParallelJob:
+        the default update stanza with max_parallel=0): every alloc
+        updates destructively in one round."""
+        job = mock.job()
+        job.task_groups[0].update = no_canary_update()
+        job.task_groups[0].update.max_parallel = 0
+        allocs = old_allocs(job, 10)
+        r = reconcile(job, allocs, update_fn=update_destructive)
+        assert_results(r, destructive=10)
+        assert r.desired_tg_updates["web"].destructive_update == 10
+
+
+class TestReschedulePort:
+    def _reschedule_job(self, count=5):
+        job = mock.job()
+        job.task_groups[0].count = count
+        job.task_groups[0].update = no_canary_update()
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval=24 * HOUR_NS, delay=5 * SECOND_NS,
+            max_delay=1 * HOUR_NS, unlimited=False,
+        )
+        return job
+
+    def test_reschedule_now_service(self):
+        """ref TestReconciler_RescheduleNow_Service: one failed alloc with
+        reschedule budget left places now with previous-alloc linkage; a
+        failed alloc already rescheduled once only gets a bare
+        replacement; desired-stop allocs are replaced."""
+        now = 1_700_000_000 * SECOND_NS
+        job = self._reschedule_job()
+        allocs = old_allocs(job, 5)
+
+        allocs[0].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[0].reschedule_tracker = RescheduleTracker(events=[
+            RescheduleEvent(
+                reschedule_time=now - 1 * HOUR_NS,
+                prev_alloc_id=generate_uuid(),
+                prev_node_id=generate_uuid(),
+            )
+        ])
+        allocs[1].task_states = {
+            "web": TaskState(
+                state="start", started_at=now - 1 * HOUR_NS,
+                finished_at=now - 10 * SECOND_NS,
+            )
+        }
+        allocs[1].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[4].desired_status = ALLOC_DESIRED_STATUS_STOP
+
+        r = reconcile(job, allocs, now_ns_=now)
+
+        assert not r.desired_followup_evals.get("web")
+        assert_results(r, place=2, stop=1, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.place == 2 and upd.ignore == 3 and upd.stop == 1
+        assert place_indexes(r) == [1, 4]
+        rescheduled = [
+            p for p in r.place if p.previous_alloc is not None
+        ]
+        assert len(rescheduled) == 1
+
+    def test_reschedule_later_service(self):
+        """ref TestReconciler_RescheduleLater_Service: a failure inside
+        the delay window yields a follow-up eval at now+delay and the
+        failed alloc is annotated with its id."""
+        now = 1_700_000_000 * SECOND_NS
+        delay = 15 * SECOND_NS
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval=24 * HOUR_NS, delay=delay,
+            max_delay=1 * HOUR_NS, unlimited=False,
+        )
+        allocs = old_allocs(job, 5)
+        allocs[0].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[0].reschedule_tracker = RescheduleTracker(events=[
+            RescheduleEvent(
+                reschedule_time=now - 1 * HOUR_NS,
+                prev_alloc_id=generate_uuid(),
+                prev_node_id=generate_uuid(),
+            )
+        ])
+        allocs[1].task_states = {
+            "web": TaskState(
+                state="start", started_at=now - 1 * HOUR_NS,
+                finished_at=now,
+            )
+        }
+        allocs[1].client_status = ALLOC_CLIENT_STATUS_FAILED
+        allocs[4].desired_status = ALLOC_DESIRED_STATUS_STOP
+
+        r = reconcile(job, allocs, now_ns_=now)
+
+        evals = r.desired_followup_evals.get("web")
+        assert evals is not None and len(evals) == 1
+        assert evals[0].wait_until == now + delay
+        assert_results(r, place=1, create_deployment=False)
+        assert len(r.attribute_updates) == 1
+        annotated = next(iter(r.attribute_updates.values()))
+        assert annotated.follow_up_eval_id == evals[0].id
+        assert annotated.name.endswith("[1]")
+        assert place_indexes(r) == [4]
+
+    def test_reschedule_not_service(self):
+        """ref TestReconciler_RescheduleNot_Service: attempts exhausted —
+        the failed alloc is neither replaced nor annotated."""
+        now = 1_700_000_000 * SECOND_NS
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].update = no_canary_update()
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=0, interval=24 * HOUR_NS, delay=5 * SECOND_NS,
+            max_delay=1 * HOUR_NS, unlimited=False,
+        )
+        allocs = old_allocs(job, 5)
+        allocs[1].task_states = {
+            "web": TaskState(
+                state="start", started_at=now - 1 * HOUR_NS,
+                finished_at=now - 10 * SECOND_NS,
+            )
+        }
+        allocs[1].client_status = ALLOC_CLIENT_STATUS_FAILED
+
+        r = reconcile(job, allocs, now_ns_=now)
+
+        assert not r.desired_followup_evals.get("web")
+        # no reschedule: the failed alloc is left failed, nothing placed
+        assert_results(r, place=0, stop=0, create_deployment=False)
+        upd = r.desired_tg_updates["web"]
+        assert upd.ignore == 5
+
+    def test_batch_rerun(self):
+        """ref TestReconciler_Batch_Rerun: completed batch allocs are not
+        re-placed when the job is re-evaluated unchanged."""
+        job = mock.job()
+        job.type = "batch"
+        job.task_groups[0].count = 10
+        allocs = old_allocs(job, 10)
+        for a in allocs:
+            a.client_status = "complete"
+
+        r = reconcile(job, allocs, batch=True)
+        assert_results(r, place=0, stop=0, create_deployment=False)
+        assert r.desired_tg_updates["web"].ignore == 10
