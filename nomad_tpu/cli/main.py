@@ -94,9 +94,11 @@ def _run_networked_server(args, config: dict):
     )
     http = HTTPServer(agent.server, host=args.bind, port=port)
     http.start()
+    from ..client.consul_sync import syncer_from_config
     from ..metrics import configure_telemetry
 
     telemetry = configure_telemetry(config)
+    consul_sync = syncer_from_config(config, agent.server.state.snapshot)
     print(
         f"==> nomad-tpu server {name} started: http {http.address} "
         f"rpc {agent.address}", flush=True,
@@ -104,6 +106,8 @@ def _run_networked_server(args, config: dict):
 
     def cleanup():
         print("==> shutting down", flush=True)
+        if consul_sync is not None:
+            consul_sync.stop()
         if telemetry is not None:
             telemetry.stop()
         http.stop()
@@ -208,9 +212,11 @@ def cmd_agent(args):
     )
     http = HTTPServer(agent.server, host=args.bind, port=port, agent=agent)
     http.start()
+    from ..client.consul_sync import syncer_from_config
     from ..metrics import configure_telemetry
 
     telemetry = configure_telemetry(config)
+    consul_sync = syncer_from_config(config, agent.server.state.snapshot)
     print(f"==> nomad-tpu agent started: {http.address} "
           f"(region {agent.server.region!r})")
     print(f"    clients: {[c.node.id[:8] for c in agent.clients]}")
@@ -233,6 +239,8 @@ def cmd_agent(args):
             time.sleep(0.2)
     finally:
         print("==> shutting down")
+        if consul_sync is not None:
+            consul_sync.stop()
         if telemetry is not None:
             telemetry.stop()
         http.stop()

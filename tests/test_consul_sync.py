@@ -1,0 +1,199 @@
+"""External-Consul sync adapter (ref command/agent/consul/client.go:212
+ServiceClient batching sync): the native catalog's service entries are
+diff-synced into a (fake) Consul agent — register with TTL check, health
+transitions via check updates, deregister on stop, dereg-all on
+shutdown, and outage tolerance."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.consul_sync import (
+    ConsulSyncer,
+    ID_PREFIX,
+    service_entries,
+    syncer_from_config,
+)
+
+
+class FakeConsul:
+    """Records the agent-API calls nomad-sync issues."""
+
+    def __init__(self):
+        self.services: dict[str, dict] = {}
+        self.check_updates: list[tuple[str, str]] = []
+        self.down = False
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_PUT(self):
+                if fake.down:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = (
+                    json.loads(self.rfile.read(length))
+                    if length
+                    else None
+                )
+                if self.path == "/v1/agent/service/register":
+                    fake.services[body["ID"]] = body
+                elif self.path.startswith("/v1/agent/service/deregister/"):
+                    fake.services.pop(
+                        self.path.rsplit("/", 1)[1], None
+                    )
+                elif self.path.startswith("/v1/agent/check/update/"):
+                    fake.check_updates.append(
+                        (self.path.rsplit("/", 1)[1], body["Status"])
+                    )
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = "http://127.0.0.1:%d" % self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture
+def consul():
+    c = FakeConsul()
+    yield c
+    c.stop()
+
+
+def snapshot_with_running_alloc():
+    """A minimal state-shaped snapshot source: one alloc with a service."""
+    from nomad_tpu.scheduler.testing import Harness
+    from nomad_tpu.structs.model import Service, TaskState
+
+    h = Harness(seed=42)
+    job = mock.job()
+    job.task_groups[0].tasks[0].services = [
+        Service(name="web-frontend", port_label="http", tags=["pci:cart"])
+    ]
+    h.state.upsert_job(1, job)
+    stored = h.state.job_by_id(job.namespace, job.id)
+    a = mock.alloc()
+    a.job = stored
+    a.job_id = stored.id
+    a.namespace = stored.namespace
+    a.task_states = {"web": TaskState(state="running")}
+    h.state.upsert_allocs(2, [a])
+    return h, stored, a
+
+
+class TestServiceEntries:
+    def test_extraction_shape(self):
+        h, job, a = snapshot_with_running_alloc()
+        entries = service_entries(h.state.snapshot())
+        assert entries, "no services extracted"
+        sid, entry = next(iter(entries.items()))
+        assert sid.startswith(f"{ID_PREFIX}-{a.id}")
+        assert entry["Name"] == "web-frontend"
+        assert entry["status"] == "passing"
+        # the mock service port rides the alloc's reserved 'admin' port?
+        # no — web-frontend uses port_label http (dynamic 9876)
+        assert entry["Port"] == 9876
+
+    def test_terminal_allocs_excluded(self):
+        h, job, a = snapshot_with_running_alloc()
+        stopped = h.state.alloc_by_id(a.id).copy()
+        stopped.desired_status = "stop"
+        h.state.upsert_allocs(3, [stopped])
+        assert service_entries(h.state.snapshot()) == {}
+
+
+class TestConsulSyncerPort:
+    def test_register_health_deregister_lifecycle(self, consul):
+        h, job, a = snapshot_with_running_alloc()
+        syncer = ConsulSyncer(
+            h.state.snapshot, consul.address, interval=30.0
+        )
+
+        ops = syncer.sync_once()
+        assert ops["register"] == 1
+        (sid, reg), = consul.services.items()
+        assert reg["Name"] == "web-frontend"
+        assert reg["Port"] == 9876
+        assert reg["Check"]["Status"] == "passing"
+        assert reg["Check"]["TTL"].endswith("s")
+
+        # no change: second pass only refreshes the TTL
+        ops = syncer.sync_once()
+        assert ops == {"register": 0, "update": 0, "deregister": 0}
+        assert (f"{sid}-ttl", "passing") in consul.check_updates
+
+        # health transition -> one check update, no re-register
+        from nomad_tpu.structs.model import TaskState
+
+        failed = h.state.alloc_by_id(a.id).copy()
+        failed.task_states = {
+            "web": TaskState(state="dead", failed=True)
+        }
+        # task states are client-reported fields: they ride the client
+        # update path, not server-side upserts
+        h.state.update_allocs_from_client(3, [failed])
+        ops = syncer.sync_once()
+        assert ops["update"] == 1 and ops["register"] == 0
+        assert (f"{sid}-ttl", "critical") in consul.check_updates
+
+        # alloc stops -> deregistered
+        stopped = h.state.alloc_by_id(a.id).copy()
+        stopped.desired_status = "stop"
+        h.state.upsert_allocs(4, [stopped])
+        ops = syncer.sync_once()
+        assert ops["deregister"] == 1
+        assert consul.services == {}
+
+    def test_shutdown_deregisters_everything(self, consul):
+        h, job, a = snapshot_with_running_alloc()
+        syncer = ConsulSyncer(
+            h.state.snapshot, consul.address, interval=30.0
+        )
+        syncer.sync_once()
+        assert consul.services
+        syncer.stop()
+        assert consul.services == {}
+
+    def test_consul_outage_is_retried_not_fatal(self, consul):
+        h, job, a = snapshot_with_running_alloc()
+        syncer = ConsulSyncer(
+            h.state.snapshot, consul.address, interval=30.0
+        )
+        consul.down = True
+        ops = syncer.sync_once()  # must not raise
+        assert consul.services == {}
+        consul.down = False
+        ops = syncer.sync_once()
+        assert ops["register"] == 1
+        assert consul.services
+
+    def test_syncer_from_config(self, consul):
+        h, job, a = snapshot_with_running_alloc()
+        s = syncer_from_config(
+            {"consul": {"address": consul.address,
+                        "sync_interval_s": 0.05}},
+            h.state.snapshot,
+        )
+        assert s is not None
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not consul.services:
+                time.sleep(0.02)
+            assert consul.services, "interval sync never registered"
+        finally:
+            s.stop()
+        assert syncer_from_config({}, h.state.snapshot) is None
