@@ -392,7 +392,13 @@ def bench_headline():
     samples = []
     samples_detail = []
     elapsed, placed_fast, stats = None, None, None
+    import gc
+
     for _ in range(5):
+        # collect BETWEEN samples so a generational GC pause triggered by
+        # the previous run's garbage doesn't land inside a timed window
+        # (a suspect for the r4 1.09s outlier sample)
+        gc.collect()
         t, placed = run_once(state, job)
         s = dict(batch_sched.LAST_KERNEL_STATS)
         samples.append(round(t, 4))
