@@ -230,6 +230,25 @@ class EvalBroker:
                 return "", False
             return unack[1], True
 
+    def outstanding_reset(self, eval_id: str, token: str):
+        """Restart the nack timer — the worker's lease extension while it
+        is still making progress (ref eval_broker.go OutstandingReset,
+        called from the worker's WaitForIndex heartbeat)."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise BrokerError("evaluation is not outstanding")
+            ev, utoken, timer = unack
+            if utoken != token:
+                raise BrokerError("evaluation token does not match")
+            timer.cancel()
+            fresh = threading.Timer(
+                self.nack_timeout, self._nack_timeout, args=(eval_id, token)
+            )
+            fresh.daemon = True
+            self._unack[eval_id] = (ev, token, fresh)
+            fresh.start()
+
     def pause_nack_timeout(self, eval_id: str, token: str):
         """Pause the nack timer while the eval's plan waits in the plan
         queue — progress is being made; also the token guard: a stale

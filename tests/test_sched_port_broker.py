@@ -1,0 +1,365 @@
+"""EvalBroker corpus ported from the reference
+(nomad/eval_broker_test.go — cited per test): the ack/nack/token state
+machine with stats at every step, nack re-enqueue delays, disable-flush
+of every queue, dequeue timeout/blocking, priority + FIFO ordering,
+nack-timer reset/pause/resume timing, the delivery-limit failed queue,
+and delayed (wait_until) evals. (The reference's deprecated Wait
+duration field is consolidated into wait_until here — the rolling
+follow-up evals set wait_until directly, model.py next_rolling_eval.)"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.broker import FAILED_QUEUE, BrokerError, EvalBroker
+from nomad_tpu.structs.model import now_ns
+
+SERVICE = ["service"]
+
+
+def make_broker(nack_timeout=5.0, **kw):
+    kw.setdefault("initial_nack_delay", 0.005)
+    kw.setdefault("subsequent_nack_delay", 0.02)
+    return EvalBroker(nack_timeout=nack_timeout, delivery_limit=3, **kw)
+
+
+def wait_until(fn, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestEnqueueDequeueNackAckPort:
+    def test_full_walk_with_stats(self):
+        # ref TestEvalBroker_Enqueue_Dequeue_Nack_Ack (eval_broker_test.go:52)
+        b = make_broker()
+        ev = mock.evaluation()
+
+        # enqueue while disabled: nothing happens
+        b.enqueue(ev)
+        assert b.stats()["total_ready"] == 0
+        assert not b.enabled
+
+        b.set_enabled(True)
+        b.enqueue(ev)
+        b.enqueue(ev)  # double enqueue is a no-op
+        stats = b.stats()
+        assert stats["total_ready"] == 1
+        assert stats["by_scheduler"][ev.type] == 1
+
+        out, token = b.dequeue(SERVICE, timeout=1.0)
+        assert out.id == ev.id
+        tok, ok = b.outstanding(ev.id)
+        assert ok and tok == token
+
+        # outstanding_reset validates id then token
+        with pytest.raises(BrokerError, match="not outstanding"):
+            b.outstanding_reset("nope", "foo")
+        with pytest.raises(BrokerError, match="token"):
+            b.outstanding_reset(ev.id, "foo")
+        b.outstanding_reset(ev.id, token)
+
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 1
+
+        # nack with wrong token fails; right token requeues
+        with pytest.raises(BrokerError):
+            b.nack(ev.id, "foobarbaz")
+        b.nack(ev.id, token)
+        assert not b.outstanding(ev.id)[1]
+        wait_until(
+            lambda: b.stats()["total_ready"] == 1
+            and b.stats()["total_unacked"] == 0
+            and b.stats()["total_waiting"] == 0,
+            msg="nacked eval re-enqueued",
+        )
+
+        out2, token2 = b.dequeue(SERVICE, timeout=1.0)
+        assert out2.id == ev.id
+        assert token2 != token
+
+        with pytest.raises(BrokerError):
+            b.ack(ev.id, "zip")
+        b.ack(ev.id, token2)
+        assert not b.outstanding(ev.id)[1]
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+
+
+class TestNackDelayPort:
+    def test_nack_waits_then_requeues_with_growing_delay(self):
+        # ref TestEvalBroker_Nack_Delay (eval_broker_test.go:228)
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+
+        out, token = b.dequeue(SERVICE, timeout=1.0)
+        b.nack(ev.id, token)
+        # immediately after the nack the eval sits in WAITING, not ready
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+        assert stats["total_waiting"] == 1
+
+        wait_until(lambda: b.stats()["total_ready"] == 1, msg="requeue")
+        out2, token2 = b.dequeue(SERVICE, timeout=1.0)
+        assert token2 != token
+
+        start = time.monotonic()
+        b.nack(ev.id, token2)
+        wait_until(lambda: b.stats()["total_ready"] == 1, msg="requeue 2")
+        # the SECOND nack waits at least subsequent_nack_delay
+        assert time.monotonic() - start >= b.subsequent_nack_delay
+
+        out3, token3 = b.dequeue(SERVICE, timeout=1.0)
+        assert token3 not in (token, token2)
+        b.ack(ev.id, token3)
+        assert b.stats()["total_ready"] == 0
+
+
+class TestDisableFlushPort:
+    def test_disable_flushes_ready(self):
+        # ref TestEvalBroker_Enqueue_Disable (eval_broker_test.go:625)
+        b = make_broker()
+        ev = mock.evaluation()
+        b.set_enabled(True)
+        b.enqueue(ev)
+        b.set_enabled(False)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+
+    def test_disable_flushes_waiting_and_rejects_new(self):
+        # ref TestEvalBroker_Enqueue_Disable_Delay (eval_broker_test.go:650)
+        b = make_broker()
+        base = mock.evaluation()
+        b.set_enabled(True)
+
+        b.enqueue(base.copy())
+        delayed = mock.evaluation()
+        delayed.wait_until = now_ns() + 30 * 1_000_000_000
+        b.enqueue(delayed)
+
+        b.set_enabled(False)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_waiting"] == 0
+        assert stats["total_blocked"] == 0
+        assert stats["total_unacked"] == 0
+
+        # enqueues while disabled are dropped
+        b.enqueue(mock.evaluation())
+        late = mock.evaluation()
+        late.wait_until = now_ns() + 30 * 1_000_000_000
+        b.enqueue(late)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_waiting"] == 0
+
+
+class TestDequeueOrderingPort:
+    def test_dequeue_timeout(self):
+        # ref TestEvalBroker_Dequeue_Timeout (eval_broker_test.go:708)
+        b = make_broker()
+        b.set_enabled(True)
+        start = time.monotonic()
+        out, _ = b.dequeue(SERVICE, timeout=0.005)
+        assert out is None
+        assert time.monotonic() - start >= 0.005
+
+    def test_dequeue_blocks_until_enqueue(self):
+        # ref TestEvalBroker_Dequeue_Blocked (eval_broker_test.go:864)
+        b = make_broker()
+        b.set_enabled(True)
+        got = []
+
+        def worker():
+            out, _ = b.dequeue(SERVICE, timeout=1.0)
+            got.append(out)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        time.sleep(0.005)
+        assert not got, "dequeue should still be blocked"
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        t.join(timeout=1.0)
+        assert got and got[0].id == ev.id
+
+    def test_dequeue_priority(self):
+        # ref TestEvalBroker_Dequeue_Priority (eval_broker_test.go:766)
+        b = make_broker()
+        b.set_enabled(True)
+        e1, e2, e3 = (mock.evaluation() for _ in range(3))
+        e1.priority, e2.priority, e3.priority = 10, 30, 20
+        for e in (e1, e2, e3):
+            b.enqueue(e)
+        assert b.dequeue(SERVICE, 1.0)[0].id == e2.id
+        assert b.dequeue(SERVICE, 1.0)[0].id == e3.id
+        assert b.dequeue(SERVICE, 1.0)[0].id == e1.id
+
+    def test_dequeue_fifo_within_priority(self):
+        # ref TestEvalBroker_Dequeue_FIFO (eval_broker_test.go:800)
+        b = make_broker()
+        b.set_enabled(True)
+        n = 100
+        for i in range(n):
+            e = mock.evaluation()
+            e.create_index = i
+            e.modify_index = i
+            b.enqueue(e)
+        for i in range(n):
+            out, _ = b.dequeue(SERVICE, 1.0)
+            assert out.create_index == i, (i, out.create_index)
+
+
+class TestNackTimerPort:
+    def test_nack_timeout_requeues(self):
+        # ref TestEvalBroker_Nack_Timeout (eval_broker_test.go:903)
+        b = make_broker(nack_timeout=0.005)
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        out, _ = b.dequeue(SERVICE, 1.0)
+        start = time.monotonic()
+        # do NOT ack: the timer must nack for us
+        out2, _ = b.dequeue(SERVICE, 2.0)
+        assert out2.id == ev.id
+        assert time.monotonic() - start >= 0.005
+
+    def test_outstanding_reset_extends_the_lease(self):
+        # ref TestEvalBroker_Nack_TimeoutReset (eval_broker_test.go:939)
+        b = make_broker(nack_timeout=0.05)
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        out, token = b.dequeue(SERVICE, 1.0)
+        start = time.monotonic()
+        time.sleep(0.02)
+        b.outstanding_reset(out.id, token)
+        out2, _ = b.dequeue(SERVICE, 2.0)
+        assert out2.id == ev.id
+        # the reset restarted the 50ms window at t=20ms: >= 70ms total
+        # (75 in the Go test; allow scheduler slop downward)
+        assert time.monotonic() - start >= 0.065
+
+    def test_pause_resume_nack_timeout(self):
+        # ref TestEvalBroker_PauseResumeNackTimeout (eval_broker_test.go:980)
+        b = make_broker(nack_timeout=0.05)
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        out, token = b.dequeue(SERVICE, 1.0)
+        start = time.monotonic()
+        time.sleep(0.02)
+        b.pause_nack_timeout(out.id, token)
+
+        def resume():
+            time.sleep(0.02)
+            b.resume_nack_timeout(out.id, token)
+
+        threading.Thread(target=resume, daemon=True).start()
+        out2, _ = b.dequeue(SERVICE, 2.0)
+        assert out2.id == ev.id
+        # 20ms + 20ms pause + full fresh 50ms window ≈ 90ms minimum
+        assert time.monotonic() - start >= 0.085
+
+
+class TestDeliveryLimitPort:
+    def test_delivery_limit_routes_to_failed_queue(self):
+        # ref TestEvalBroker_DeliveryLimit (eval_broker_test.go:1028)
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        for _ in range(3):
+            out, token = b.dequeue(SERVICE, 1.0)
+            assert out.id == ev.id
+            b.nack(ev.id, token)
+            wait_until(
+                lambda: b.stats()["total_ready"] == 1, msg="requeue"
+            )
+
+        stats = b.stats()
+        assert stats["total_ready"] == 1
+        assert stats["by_scheduler"].get(FAILED_QUEUE) == 1
+
+        out, token = b.dequeue([FAILED_QUEUE], 1.0)
+        assert out.id == ev.id
+        assert b.stats()["total_unacked"] == 1
+        b.ack(out.id, token)
+        assert not b.outstanding(out.id)[1]
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+
+    def test_ack_at_delivery_limit_is_clean(self):
+        # ref TestEvalBroker_AckAtDeliveryLimit (eval_broker_test.go:1118)
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        for i in range(3):
+            out, token = b.dequeue(SERVICE, 1.0)
+            assert out.id == ev.id
+            if i == 2:
+                b.ack(ev.id, token)
+            else:
+                b.nack(ev.id, token)
+                wait_until(
+                    lambda: b.stats()["total_ready"] == 1, msg="requeue"
+                )
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+        assert not stats["by_scheduler"].get(FAILED_QUEUE)
+
+
+class TestDelayedEvalsPort:
+    def test_wait_until_holds_then_releases(self):
+        # ref TestEvalBroker_Wait (eval_broker_test.go:1161) — the repo
+        # expresses the deprecated Wait duration through wait_until
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        ev.wait_until = now_ns() + 10_000_000  # 10ms
+        b.enqueue(ev)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_waiting"] == 1
+        wait_until(
+            lambda: b.stats()["total_ready"] == 1
+            and b.stats()["total_waiting"] == 0,
+            msg="wait elapses",
+        )
+        out, _ = b.dequeue(SERVICE, 1.0)
+        assert out.id == ev.id
+
+    def test_wait_until_ordering(self):
+        # ref TestEvalBroker_WaitUntil (eval_broker_test.go:1203)
+        b = make_broker()
+        b.set_enabled(True)
+        now = now_ns()
+        e1, e2, e3 = (mock.evaluation() for _ in range(3))
+        e1.wait_until = now + 1_000_000_000
+        e1.create_index = 1
+        e2.wait_until = now + 100_000_000
+        e2.create_index = 2
+        e3.wait_until = now + 20_000_000
+        e3.create_index = 1
+        for e in (e1, e2, e3):
+            b.enqueue(e)
+        assert b.stats()["total_waiting"] == 3
+        time.sleep(0.2)
+        assert b.dequeue(SERVICE, 1.0)[0].id == e3.id
+        assert b.dequeue(SERVICE, 1.0)[0].id == e2.id
+        assert b.dequeue(SERVICE, 2.0)[0].id == e1.id
+        assert b.stats()["total_waiting"] == 0
