@@ -76,6 +76,13 @@ class Worker:
                 snapshot = self.server.state.snapshot_min_index(
                     ev.modify_index, timeout=RAFT_SYNC_LIMIT
                 )
+                # the wait is progress: extend the lease so a slow raft
+                # sync can't nack an eval out from under a live worker
+                # (ref worker.go waitForIndex → OutstandingReset)
+                try:
+                    self.server.eval_broker.outstanding_reset(ev.id, token)
+                except BrokerError:
+                    pass
             self._eval_token = token
             self._eval = ev
             self._snapshot_index = snapshot.latest_index()
