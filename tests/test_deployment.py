@@ -260,3 +260,73 @@ class TestDeploymentHTTP:
         finally:
             http.stop()
             agent.stop()
+
+
+class TestProgressDeadline:
+    def test_progress_deadline_expiry_fails_deployment(self):
+        """A deployment whose allocs can never become healthy before the
+        per-group progress deadline is failed by the watcher with the
+        deadline description (ref deployments_watcher progress deadline;
+        deployment_watcher.py DESC_PROGRESS_DEADLINE)."""
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=1, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = _deploy_job(count=1)
+            tg = job.task_groups[0]
+            # healthy requires 5s of uptime but the deadline is 1s: no
+            # alloc can make progress in time — and none report UNhealthy
+            # either, so only the deadline can fail the deployment
+            tg.update.min_healthy_time = 5 * SECOND_NS
+            tg.update.healthy_deadline = 20 * SECOND_NS
+            tg.update.progress_deadline = 1 * SECOND_NS
+            agent.run_job(job)
+
+            def deadline_failed():
+                for d in agent.state.deployments():
+                    if (
+                        d.job_id == job.id
+                        and d.status == DEPLOYMENT_STATUS_FAILED
+                        and "progress deadline" in d.status_description
+                    ):
+                        return d
+                return None
+
+            d = _wait(deadline_failed, timeout=30)
+            assert d is not None, [
+                (x.status, x.status_description)
+                for x in agent.state.deployments()
+            ]
+        finally:
+            agent.stop()
+
+    def test_healthy_alloc_extends_progress_deadline(self):
+        """Each healthy alloc re-arms the deadline: a rollout whose steps
+        each fit inside the window completes even though the TOTAL time
+        exceeds one deadline period."""
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=2, server_config={"seed": 7})
+        agent.start()
+        try:
+            job = _deploy_job(count=4)
+            tg = job.task_groups[0]
+            tg.update.max_parallel = 1  # one-at-a-time rollout
+            tg.update.min_healthy_time = int(0.4 * SECOND_NS)
+            tg.update.progress_deadline = 3 * SECOND_NS
+            agent.run_job(job)
+            _wait(
+                lambda: (d := agent.state.latest_deployment_by_job_id(
+                    job.namespace, job.id
+                )) is not None and d.status == DEPLOYMENT_STATUS_SUCCESSFUL,
+                timeout=30,
+            )
+            d = agent.state.latest_deployment_by_job_id(
+                job.namespace, job.id
+            )
+            assert d.status == DEPLOYMENT_STATUS_SUCCESSFUL, (
+                d.status, d.status_description,
+            )
+        finally:
+            agent.stop()
