@@ -40,6 +40,12 @@ class BlockedEvals:
         self._unblock_indexes: dict[str, int] = {}
         # evals that escaped computed classes unblock on any change
         self._escaped: set[str] = set()
+        # superseded duplicates awaiting the leader's cancellation reap
+        # (ref blocked_evals.go duplicates + GetDuplicates): dedup keeps
+        # the NEWER eval per job; the loser lands here so its raft record
+        # doesn't sit 'blocked' forever
+        self._duplicates: list = []
+        self._dup_cond = threading.Condition(self._lock)
 
     def set_enabled(self, enabled: bool):
         with self._lock:
@@ -72,11 +78,24 @@ class BlockedEvals:
                     ).add(skey)
             else:
                 key = (ev.namespace, ev.job_id)
-                # Dedup: one blocked eval per job; keep the newer
+                # Dedup: one blocked eval per job; the NEWER create_index
+                # wins and the loser joins the duplicates reap list
+                # (ref blocked_evals.go Block dedup semantics)
                 existing = self._jobs.get(key)
-                if existing is not None:
+                if existing is not None and existing.id == ev.id:
+                    # re-block of the already-tracked eval (leader restore
+                    # replay, FSM + caller double-routing): refresh only
+                    existing = None
+                if existing is not None and not requeue:
+                    if existing.create_index <= ev.create_index:
+                        loser, winner = existing, ev
+                    else:
+                        loser, winner = ev, existing
                     self._captured.pop(existing.id, None)
                     self._escaped.discard(existing.id)
+                    self._duplicates.append(loser)
+                    self._dup_cond.notify_all()
+                    ev = winner
                 if not requeue:
                     self._jobs[key] = ev
                     self._captured[ev.id] = ev
@@ -104,6 +123,17 @@ class BlockedEvals:
             if elig.get(cls, True):  # eligible or never-evaluated class
                 return True
         return False
+
+    def get_duplicates(self, timeout: float = 0.0) -> list:
+        """Drain superseded duplicate evals, optionally blocking up to
+        ``timeout`` for one to appear (ref blocked_evals.go GetDuplicates;
+        the leader's reap loop cancels what this returns)."""
+        with self._dup_cond:
+            if not self._duplicates and timeout > 0:
+                self._dup_cond.wait(timeout)
+            out = self._duplicates
+            self._duplicates = []
+            return out
 
     def untrack(self, namespace: str, job_id: str):
         """Stop tracking a job's blocked eval (e.g. job deregistered)."""
@@ -218,6 +248,7 @@ class BlockedEvals:
             self._escaped.clear()
             self._system.clear()
             self._system_by_node.clear()
+            self._duplicates = []
 
     def stats(self) -> dict:
         with self._lock:

@@ -24,6 +24,7 @@ from ..raft import InmemTransport, NotLeaderError, Raft, RaftConfig
 from ..raft.log import InmemLogStore, SnapshotStore, StableStore
 from ..state.store import StateStore
 from ..structs.model import (
+    EVAL_STATUS_CANCELLED,
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_JOB_DEREGISTER,
     EVAL_TRIGGER_JOB_REGISTER,
@@ -765,6 +766,9 @@ class Server:
             self._leader_cond.notify_all()
         self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
         self._reaper.start()
+        threading.Thread(
+            target=self._reap_dup_blocked_evals, daemon=True
+        ).start()
         self._gc_scheduler = threading.Thread(target=self._schedule_core_gc, daemon=True)
         self._gc_scheduler.start()
         if self._acl_replication_target():
@@ -836,6 +840,30 @@ class Server:
                 return
             except Exception:
                 logger.exception("failed-eval reaping error for %s", ev.id)
+
+    def _reap_dup_blocked_evals(self):
+        """Cancel blocked evals superseded by a newer one for the same job
+        (ref leader.go:524 reapDupBlockedEvaluations): BlockedEvals dedup
+        keeps one eval per job; the losers must not sit 'blocked' in raft
+        state forever."""
+        while self._running and self._leader:
+            dups = self.blocked_evals.get_duplicates(timeout=0.5)
+            if not dups:
+                continue
+            try:
+                cancelled = []
+                for ev in dups:
+                    c = ev.copy()
+                    c.status = EVAL_STATUS_CANCELLED
+                    c.status_description = (
+                        "existing blocked evaluation exists for this job"
+                    )
+                    cancelled.append(c.to_dict())
+                self._apply(fsm_mod.EVAL_UPDATE, {"evals": cancelled})
+            except NotLeaderError:
+                return
+            except Exception:
+                logger.exception("duplicate blocked eval reaping error")
 
     def _schedule_core_gc(self):
         """Leader cron enqueuing GC core-job evals on their intervals

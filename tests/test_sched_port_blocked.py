@@ -1,0 +1,283 @@
+"""BlockedEvals corpus ported from the reference
+(nomad/blocked_evals_test.go — cited per test): tracking gates, per-job
+dedup with duplicate reaping, class-eligibility unblocks, the
+missed-unblock race closures, escaped-class behavior, untrack, per-node
+system unblocks, and the failed-eval cooldown requeue. (Quota tests are
+not ported: namespace quotas are enterprise-gated in the reference OSS
+tree and likewise absent here — PARITY.md divergences.)"""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.core.blocked_evals import BlockedEvals
+from nomad_tpu.core.broker import EvalBroker
+from nomad_tpu.structs.model import EVAL_TRIGGER_MAX_PLANS
+
+
+def make_pair():
+    broker = EvalBroker(nack_timeout=5.0, initial_nack_delay=0.001,
+                        subsequent_nack_delay=0.005)
+    broker.set_enabled(True)
+    blocked = BlockedEvals(broker)
+    blocked.set_enabled(True)
+    return blocked, broker
+
+
+def blocked_eval(**kw):
+    e = mock.evaluation()
+    e.status = "blocked"
+    for k, v in kw.items():
+        setattr(e, k, v)
+    return e
+
+
+class TestBlockedEvalsPort:
+    def test_block_disabled(self):
+        # ref TestBlockedEvals_Block_Disabled (blocked_evals_test.go:24)
+        blocked, _ = make_pair()
+        blocked.set_enabled(False)
+        blocked.block(blocked_eval(escaped_computed_class=True))
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 0
+        assert stats["total_escaped"] == 0
+
+    def test_block_same_job_dedups(self):
+        # ref TestBlockedEvals_Block_SameJob (:42)
+        blocked, _ = make_pair()
+        e = blocked_eval()
+        e2 = blocked_eval(job_id=e.job_id, namespace=e.namespace)
+        blocked.block(e)
+        blocked.block(e2)
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 1
+        assert stats["total_escaped"] == 0
+
+    def test_block_prior_unblocks_requeue_immediately(self):
+        # ref TestBlockedEvals_Block_PriorUnblocks (:76): an unblock for a
+        # class the eval did NOT mark ineligible, landing after its
+        # snapshot, means capacity may already exist — requeue, don't block
+        blocked, broker = make_pair()
+        blocked.unblock("v1:123", 1000)
+        blocked.unblock("v1:123", 1001)
+        e = blocked_eval(
+            class_eligibility={"v1:123": False, "v1:456": False},
+            snapshot_index=999,
+        )
+        blocked.block(e)
+        # every seen class is ineligible: the unblocks are irrelevant and
+        # the eval stays tracked
+        assert blocked.stats()["total_blocked"] == 1
+        assert broker.stats()["total_ready"] == 0
+
+    def test_duplicates_reaped_newest_wins(self):
+        # ref TestBlockedEvals_GetDuplicates (:98)
+        blocked, _ = make_pair()
+        e = blocked_eval(create_index=100)
+        e2 = blocked_eval(
+            job_id=e.job_id, namespace=e.namespace, create_index=101
+        )
+        e3 = blocked_eval(
+            job_id=e.job_id, namespace=e.namespace, create_index=102
+        )
+        e4 = blocked_eval(
+            job_id=e.job_id, namespace=e.namespace, create_index=100
+        )
+        blocked.block(e)
+        blocked.block(e2)
+        assert blocked.stats()["total_blocked"] == 1
+        # the OLDER e lost to e2
+        out = blocked.get_duplicates(0)
+        assert [d.id for d in out] == [e.id]
+
+        # a newer block raises a duplicate that a blocking wait observes
+        import threading
+
+        def later():
+            time.sleep(0.05)
+            blocked.block(e3)
+
+        threading.Thread(target=later, daemon=True).start()
+        out = blocked.get_duplicates(1.0)
+        assert [d.id for d in out] == [e2.id]
+        assert blocked.stats()["total_blocked"] == 1
+
+        # an OLDER eval arriving after is itself the duplicate
+        blocked.block(e4)
+        out = blocked.get_duplicates(0)
+        assert [d.id for d in out] == [e4.id]
+        assert blocked.stats()["total_blocked"] == 1
+
+    def test_unblock_escaped(self):
+        # ref TestBlockedEvals_UnblockEscaped (:161)
+        blocked, broker = make_pair()
+        blocked.block(blocked_eval(escaped_computed_class=True))
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 1
+        assert stats["total_escaped"] == 1
+        blocked.unblock("v1:123", 1000)
+        assert broker.stats()["total_ready"] == 1
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 0
+        assert stats["total_escaped"] == 0
+
+    def test_unblock_eligible_class(self):
+        # ref TestBlockedEvals_UnblockEligible (:200)
+        blocked, broker = make_pair()
+        blocked.block(blocked_eval(class_eligibility={"v1:123": True}))
+        assert blocked.stats()["total_blocked"] == 1
+        blocked.unblock("v1:123", 1000)
+        assert broker.stats()["total_ready"] == 1
+        assert blocked.stats()["total_blocked"] == 0
+
+    def test_unblock_ineligible_class_stays_blocked(self):
+        # ref TestBlockedEvals_UnblockIneligible (:221)
+        blocked, broker = make_pair()
+        blocked.block(blocked_eval(class_eligibility={"v1:123": False}))
+        blocked.unblock("v1:123", 1000)
+        assert broker.stats()["total_ready"] == 0
+        assert blocked.stats()["total_blocked"] == 1
+
+    def test_unblock_unknown_class_unblocks(self):
+        # ref TestBlockedEvals_UnblockUnknown (:258): a class the eval
+        # never evaluated could fit it — unblock
+        blocked, broker = make_pair()
+        blocked.block(
+            blocked_eval(
+                class_eligibility={"v1:123": True, "v1:456": False}
+            )
+        )
+        blocked.unblock("v1:789", 1000)
+        assert broker.stats()["total_ready"] == 1
+        assert blocked.stats()["total_blocked"] == 0
+
+    def test_immediate_unblock_escaped(self):
+        # ref TestBlockedEvals_Block_ImmediateUnblock_Escaped (:380)
+        blocked, broker = make_pair()
+        blocked.unblock("v1:123", 1000)
+        blocked.block(
+            blocked_eval(escaped_computed_class=True, snapshot_index=900)
+        )
+        assert blocked.stats()["total_blocked"] == 0
+        assert broker.stats()["total_ready"] == 1
+
+    def test_immediate_unblock_unseen_class_after_snapshot(self):
+        # ref ..._ImmediateUnblock_UnseenClass_After (:407): the unblocked
+        # class is absent from the eval's eligibility map (never checked)
+        # and landed after its snapshot — requeue immediately
+        blocked, broker = make_pair()
+        blocked.unblock("v1:123", 1000)
+        blocked.block(
+            blocked_eval(
+                class_eligibility={"v1:456": False}, snapshot_index=900
+            )
+        )
+        assert blocked.stats()["total_blocked"] == 0
+        assert broker.stats()["total_ready"] == 1
+
+    def test_immediate_unblock_unseen_class_before_snapshot(self):
+        # ref ..._ImmediateUnblock_UnseenClass_Before (:434): the unblock
+        # predates the snapshot, so the scheduler already saw that world
+        blocked, broker = make_pair()
+        blocked.unblock("v1:123", 500)
+        blocked.block(
+            blocked_eval(
+                class_eligibility={"v1:456": False}, snapshot_index=900
+            )
+        )
+        assert blocked.stats()["total_blocked"] == 1
+        assert broker.stats()["total_ready"] == 0
+
+    def test_immediate_unblock_seen_ineligible_class(self):
+        # ref ..._ImmediateUnblock_SeenClass (:458): the unblocked class
+        # was explicitly marked ineligible — stay blocked
+        blocked, broker = make_pair()
+        blocked.unblock("v1:123", 1000)
+        blocked.block(
+            blocked_eval(
+                class_eligibility={"v1:123": False}, snapshot_index=900
+            )
+        )
+        assert blocked.stats()["total_blocked"] == 1
+        assert broker.stats()["total_ready"] == 0
+
+    def test_unblock_failed_cooldown(self):
+        # ref TestBlockedEvals_UnblockFailed (:508)
+        blocked, broker = make_pair()
+        e = blocked_eval(triggered_by=EVAL_TRIGGER_MAX_PLANS)
+        blocked.block(e)
+        assert blocked.stats()["total_blocked"] == 1
+        blocked.unblock_failed()
+        assert broker.stats()["total_ready"] == 1
+        assert blocked.stats()["total_blocked"] == 0
+
+    def test_untrack(self):
+        # ref TestBlockedEvals_Untrack (:550)
+        blocked, broker = make_pair()
+        e = blocked_eval()
+        blocked.block(e)
+        assert blocked.stats()["total_blocked"] == 1
+        blocked.untrack(e.namespace, e.job_id)
+        assert blocked.stats()["total_blocked"] == 0
+        assert broker.stats()["total_ready"] == 0
+
+    def test_system_untrack_and_node_unblock(self):
+        # ref TestBlockedEvals_SystemUntrack (:624) + UnblockNode (:600)
+        blocked, broker = make_pair()
+        e = blocked_eval(node_id="node-1")
+        blocked.block(e)
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 1
+        assert stats["total_system_blocked"] == 1
+
+        blocked.untrack(e.namespace, e.job_id)
+        assert blocked.stats()["total_blocked"] == 0
+
+        e2 = blocked_eval(node_id="node-2")
+        blocked.block(e2)
+        blocked.unblock_node("node-2", 1000)
+        assert blocked.stats()["total_blocked"] == 0
+        assert broker.stats()["total_ready"] == 1
+
+    def test_system_disable_flush(self):
+        # ref TestBlockedEvals_SystemDisableFlush (:648)
+        blocked, broker = make_pair()
+        blocked.block(blocked_eval(node_id="node-1"))
+        assert blocked.stats()["total_blocked"] == 1
+        blocked.set_enabled(False)
+        stats = blocked.stats()
+        assert stats["total_blocked"] == 0
+        assert stats["total_system_blocked"] == 0
+
+
+class TestDuplicateReapLeaderDuty:
+    def test_leader_cancels_superseded_blocked_evals(self):
+        """The duplicate loser's raft record is marked cancelled by the
+        leader reap loop (ref leader.go:524 reapDupBlockedEvaluations)."""
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(num_clients=0, server_config={"seed": 7})
+        agent.start()
+        try:
+            server = agent.server
+            e = blocked_eval(create_index=100)
+            e2 = blocked_eval(
+                job_id=e.job_id, namespace=e.namespace, create_index=101
+            )
+            # replicating blocked evals routes them into BlockedEvals
+            # via the FSM; the second supersedes the first
+            server.update_evals([e])
+            server.update_evals([e2])
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                got = server.state.eval_by_id(e.id)
+                if got is not None and got.status == "canceled":
+                    break
+                time.sleep(0.05)
+            got = server.state.eval_by_id(e.id)
+            assert got.status == "canceled", got.status
+            assert "existing blocked" in got.status_description
+            # the winner stays blocked
+            assert server.state.eval_by_id(e2.id).status == "blocked"
+        finally:
+            agent.stop()
