@@ -1,0 +1,173 @@
+"""Heartbeat corpus ported from the reference
+(nomad/heartbeat_test.go — cited per test): leader-side TTL timers are
+initialized from state, renewed by heartbeats, cleared on deregister and
+leadership revocation, and invalidation marks the node down and creates
+node evals."""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs.model import NODE_STATUS_DOWN, NODE_STATUS_READY
+
+
+def make_server(ttl=60.0):
+    s = Server({"seed": 42, "heartbeat_ttl": ttl})
+    s.start(num_workers=0, wait_for_leader=5.0)
+    return s
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestHeartbeatPort:
+    def test_initialize_heartbeat_timers(self):
+        # ref TestHeartbeat_InitializeHeartbeatTimers (heartbeat_test.go:16)
+        s = make_server()
+        try:
+            node = mock.node()
+            s.node_register(node)
+            # registration armed a timer; wipe and re-initialize like a
+            # fresh leader restoring from state
+            with s._lock:
+                for t in s._heartbeat_timers.values():
+                    t.cancel()
+                s._heartbeat_timers.clear()
+            s._initialize_heartbeat_timers()
+            assert node.id in s._heartbeat_timers
+        finally:
+            s.stop()
+
+    def test_initialize_skips_down_nodes(self):
+        # down nodes get no timer on leader restore (heartbeat_test.go:21)
+        s = make_server()
+        try:
+            node = mock.node()
+            s.node_register(node)
+            s.node_update_status(node.id, NODE_STATUS_DOWN)
+            with s._lock:
+                for t in s._heartbeat_timers.values():
+                    t.cancel()
+                s._heartbeat_timers.clear()
+            s._initialize_heartbeat_timers()
+            assert node.id not in s._heartbeat_timers
+        finally:
+            s.stop()
+
+    def test_reset_heartbeat_timer(self):
+        # ref TestHeartbeat_ResetHeartbeatTimer (:42)
+        s = make_server()
+        try:
+            s._reset_heartbeat("foo")
+            assert "foo" in s._heartbeat_timers
+        finally:
+            s.stop()
+
+    def test_reset_heartbeat_timer_nonleader(self):
+        # ref TestHeartbeat_ResetHeartbeatTimer_Nonleader (:64): only the
+        # leader arms TTL timers
+        s = Server({"seed": 42, "heartbeat_ttl": 60.0})
+        try:
+            # never started: not leader
+            s._reset_heartbeat("foo")
+            assert "foo" not in s._heartbeat_timers
+        finally:
+            s.stop()
+
+    def test_invalidation_marks_down_and_makes_evals(self):
+        # ref TestHeartbeat_ResetHeartbeatTimerLocked (:81) +
+        # TestHeartbeat_InvalidateHeartbeat (:141)
+        s = make_server(ttl=0.05)
+        try:
+            node = mock.node()
+            s.node_register(node)
+            job = mock.job()
+            job.type = "service"
+            s.state.upsert_job(s.state.latest_index() + 1, job)
+            a = mock.alloc()
+            a.job = s.state.job_by_id(job.namespace, job.id)
+            a.job_id = job.id
+            a.namespace = job.namespace
+            a.node_id = node.id
+            a.client_status = "running"
+            s.state.upsert_allocs(s.state.latest_index() + 1, [a])
+
+            wait_until(
+                lambda: s.state.node_by_id(node.id).status
+                == NODE_STATUS_DOWN,
+                msg="missed heartbeat marks the node down",
+            )
+            assert node.id not in s._heartbeat_timers
+            # node-down evals exist for the job with allocs there
+            wait_until(
+                lambda: any(
+                    ev.job_id == job.id
+                    and ev.triggered_by == "node-update"
+                    for ev in s.state.evals()
+                ),
+                msg="node-down evals created",
+            )
+        finally:
+            s.stop()
+
+    def test_renew_extends_the_window(self):
+        # ref TestHeartbeat_ResetHeartbeatTimerLocked_Renew (:102)
+        s = make_server(ttl=0.1)
+        try:
+            node = mock.node()
+            s.node_register(node)
+            # renew at 60ms intervals: 3 renewals > 2 TTLs of wall time
+            for _ in range(4):
+                time.sleep(0.06)
+                out = s.node_heartbeat(node.id)
+                assert out["heartbeat_ttl"] == s.heartbeat_ttl
+            assert (
+                s.state.node_by_id(node.id).status == NODE_STATUS_READY
+            )
+        finally:
+            s.stop()
+
+    def test_heartbeat_revives_down_node(self):
+        # the heartbeat path of node_endpoint.go UpdateStatus: a down
+        # node's heartbeat transitions it back to ready
+        s = make_server()
+        try:
+            node = mock.node()
+            s.node_register(node)
+            s.node_update_status(node.id, NODE_STATUS_DOWN)
+            s.node_heartbeat(node.id)
+            assert (
+                s.state.node_by_id(node.id).status == NODE_STATUS_READY
+            )
+        finally:
+            s.stop()
+
+    def test_clear_heartbeat_timer_on_deregister(self):
+        # ref TestHeartbeat_ClearHeartbeatTimer (:165)
+        s = make_server()
+        try:
+            node = mock.node()
+            s.node_register(node)
+            assert node.id in s._heartbeat_timers
+            s.node_deregister(node.id)
+            assert node.id not in s._heartbeat_timers
+        finally:
+            s.stop()
+
+    def test_clear_all_heartbeat_timers_on_revoke(self):
+        # ref TestHeartbeat_ClearAllHeartbeatTimers (:185)
+        s = make_server()
+        try:
+            for _ in range(3):
+                s.node_register(mock.node())
+            assert len(s._heartbeat_timers) == 3
+            s._revoke_leadership()
+            assert len(s._heartbeat_timers) == 0
+        finally:
+            s.stop()
