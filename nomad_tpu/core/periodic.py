@@ -229,7 +229,17 @@ class PeriodicDispatch:
 
     # ------------------------------------------------------------------
     def add(self, job: Job):
-        """Called by the FSM as periodic jobs are applied (fsm.go:330)."""
+        """Called by the FSM as jobs are applied (fsm.go:330). Self-gating
+        like the reference's Add (periodic.go:216-248): a non-periodic,
+        parameterized, or stopped job untracks instead of tracking — an
+        update can flip any of those on a job we were dispatching."""
+        if (
+            not job.is_periodic()
+            or job.parameterized_job is not None
+            or job.stopped()
+        ):
+            self.remove(*job.namespaced_id())
+            return
         with self._cv:
             if not self._enabled:
                 return

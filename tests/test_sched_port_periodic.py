@@ -1,0 +1,158 @@
+"""PeriodicDispatch tracker corpus ported from the reference
+(nomad/periodic_test.go — cited per test): add/update/remove gating,
+namespacing, force-run errors, and running-children detection. The
+launch-timing flows (timer fires, overlap prohibition, restore catch-up)
+are covered by tests/test_periodic.py."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.periodic import derive_periodic_job
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs.model import (
+    ParameterizedJobConfig,
+    now_ns,
+)
+
+
+def make_dispatcher():
+    """An UNSTARTED server's dispatcher, enabled directly — the
+    tracker-unit fixture (ref testPeriodicDispatcher)."""
+    s = Server({"seed": 42, "heartbeat_ttl": 60.0})
+    s.periodic.set_enabled(True)
+    return s, s.periodic
+
+
+class TestPeriodicTrackerPort:
+    def test_set_enabled_and_track(self):
+        # ref TestPeriodicDispatch_SetEnabled (periodic_test.go:105)
+        s, p = make_dispatcher()
+        p.set_enabled(True)
+        p.set_enabled(False)
+        p.set_enabled(True)
+        p.add(mock.periodic_job())
+        assert len(p.tracked()) == 1
+
+    def test_add_non_periodic_is_noop(self):
+        # ref TestPeriodicDispatch_Add_NonPeriodic (:128)
+        s, p = make_dispatcher()
+        p.add(mock.job())
+        assert p.tracked() == []
+
+    def test_add_parameterized_periodic_is_noop(self):
+        # ref TestPeriodicDispatch_Add_Periodic_Parameterized (:142)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        job.parameterized_job = ParameterizedJobConfig()
+        p.add(job)
+        assert p.tracked() == []
+
+    def test_add_stopped_periodic_is_noop(self):
+        # ref TestPeriodicDispatch_Add_Periodic_Stopped (:157)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        job.stop = True
+        p.add(job)
+        assert p.tracked() == []
+
+    def test_add_updates_tracked_job(self):
+        # ref TestPeriodicDispatch_Add_UpdateJob (:172)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        p.add(job)
+        assert len(p.tracked()) == 1
+
+        updated = job.copy()
+        updated.periodic.spec = "*/10 * * * *"
+        p.add(updated)
+        tracked = p.tracked()
+        assert len(tracked) == 1
+        assert tracked[0].periodic.spec == "*/10 * * * *"
+
+    def test_add_remove_namespaced(self):
+        # ref TestPeriodicDispatch_Add_Remove_Namespaced (:201)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        job2 = mock.periodic_job()
+        job2.namespace = "test"
+        p.add(job)
+        p.add(job2)
+        assert len(p.tracked()) == 2
+        p.remove(job2.namespace, job2.id)
+        tracked = p.tracked()
+        assert len(tracked) == 1
+        assert tracked[0].id == job.id
+
+    def test_update_to_non_periodic_removes(self):
+        # ref TestPeriodicDispatch_Add_RemoveJob (:219)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        p.add(job)
+        assert len(p.tracked()) == 1
+        updated = job.copy()
+        updated.periodic = None
+        p.add(updated)
+        assert p.tracked() == []
+
+    def test_remove_untracked_is_noop(self):
+        # ref TestPeriodicDispatch_Remove_Untracked (:287)
+        s, p = make_dispatcher()
+        p.remove("default", "foo")  # must not raise
+        assert p.tracked() == []
+
+    def test_remove_tracked(self):
+        # ref TestPeriodicDispatch_Remove_Tracked (:295)
+        s, p = make_dispatcher()
+        job = mock.periodic_job()
+        p.add(job)
+        assert len(p.tracked()) == 1
+        p.remove(job.namespace, job.id)
+        assert p.tracked() == []
+
+    def test_force_run_untracked_raises(self):
+        # ref TestPeriodicDispatch_ForceRun_Untracked (:349)
+        s, p = make_dispatcher()
+        with pytest.raises(KeyError):
+            p.force_launch("default", "foo")
+
+
+class TestRunningChildrenPort:
+    def _server_with_job(self):
+        s = Server({"seed": 42, "heartbeat_ttl": 60.0})
+        job = mock.periodic_job()
+        s.state.upsert_job(1000, job)
+        return s, s.state.job_by_id(job.namespace, job.id)
+
+    def test_no_children(self):
+        # ref TestPeriodicDispatch_RunningChildren_NoEvals (:656)
+        s, job = self._server_with_job()
+        assert not s.periodic._has_live_child(job)
+
+    def test_live_child_detected(self):
+        # ref TestPeriodicDispatch_RunningChildren_ActiveEvals (:679):
+        # a derived child with a non-terminal eval blocks overlap
+        s, job = self._server_with_job()
+        child = derive_periodic_job(job, now_ns())
+        s.state.upsert_job(1001, child)
+        ev = mock.evaluation()
+        ev.namespace = child.namespace
+        ev.job_id = child.id
+        ev.status = "pending"
+        s.state.upsert_evals(1002, [ev])
+        assert s.periodic._has_live_child(job)
+
+    def test_dead_child_not_counted(self):
+        # ref TestPeriodicDispatch_RunningChildren_ActiveAllocs tail: a
+        # child whose evals are all terminal (and no live allocs) derives
+        # status dead and no longer blocks the next launch
+        s, job = self._server_with_job()
+        child = derive_periodic_job(job, now_ns())
+        s.state.upsert_job(1001, child)
+        ev = mock.evaluation()
+        ev.namespace = child.namespace
+        ev.job_id = child.id
+        ev.status = "complete"
+        s.state.upsert_evals(1002, [ev])
+        stored = s.state.job_by_id(child.namespace, child.id)
+        assert stored.status == "dead", stored.status
+        assert not s.periodic._has_live_child(job)
