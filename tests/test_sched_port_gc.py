@@ -1,0 +1,520 @@
+"""CoreScheduler GC corpus ported from the reference
+(nomad/core_sched_test.go — cited per test): eval GC with reschedule
+awareness, batch-job protection, partial reaps, node GC with live-alloc
+gating, job GC with outstanding evals/allocs and periodic/parameterized
+parents, deployment GC, the alloc GC-eligibility matrix, and reap
+partitioning."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.core_sched import (
+    CORE_JOB_EVAL_GC,
+    CORE_JOB_DEPLOYMENT_GC,
+    CORE_JOB_JOB_GC,
+    CORE_JOB_NODE_GC,
+    MAX_IDS_PER_REAP,
+    CoreScheduler,
+    _partition,
+    core_job_eval,
+)
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.model import (
+    Deployment,
+    ReschedulePolicy,
+    RescheduleEvent,
+    RescheduleTracker,
+    generate_uuid,
+)
+
+
+def make_server():
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "gc0",
+            "address": "gc0",
+            "voters": {"gc0": "gc0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=0, wait_for_leader=5.0)
+    # indexes at or below 5000 are "old enough" for every GC threshold
+    s.time_table.witness(5000, when=time.time() - 10 * 24 * 3600)
+    return s
+
+
+def run_gc(server, core_job):
+    core = CoreScheduler(server, server.state.snapshot())
+    core.process(core_job_eval(core_job, 5000))
+
+
+def dead_eval(job, status="failed"):
+    ev = mock.evaluation()
+    ev.namespace = job.namespace
+    ev.job_id = job.id
+    ev.status = status
+    ev.modify_index = 1000
+    return ev
+
+
+def terminal_alloc(job, ev, desired="stop", client="complete",
+                   tracker=None):
+    a = mock.alloc()
+    a.namespace = job.namespace
+    a.job_id = job.id
+    a.job = job
+    a.eval_id = ev.id
+    a.desired_status = desired
+    a.client_status = client
+    a.task_group = job.task_groups[0].name
+    a.reschedule_tracker = tracker
+    return a
+
+
+class TestEvalGCPort:
+    def test_dead_eval_and_allocs_reaped(self):
+        # ref TestCoreScheduler_EvalGC (core_sched_test.go:17)
+        s = make_server()
+        try:
+            job = mock.job()
+            job.task_groups[0].reschedule_policy = ReschedulePolicy(
+                attempts=0, interval=0, unlimited=False
+            )
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            ev = dead_eval(stored)
+            s.state.upsert_evals(1000, [ev])
+            stopped = terminal_alloc(stored, ev, desired="stop")
+            lost = terminal_alloc(stored, ev, desired="run", client="lost")
+            s.state.upsert_allocs(1001, [stopped, lost])
+
+            run_gc(s, CORE_JOB_EVAL_GC)
+
+            assert s.state.eval_by_id(ev.id) is None
+            assert s.state.alloc_by_id(stopped.id) is None
+            assert s.state.alloc_by_id(lost.id) is None
+        finally:
+            s.stop()
+
+    def test_reschedulable_failed_alloc_blocks_gc(self):
+        # ref TestCoreScheduler_EvalGC_ReschedulingAllocs (:110)
+        s = make_server()
+        try:
+            job = mock.job()
+            job.task_groups[0].reschedule_policy = ReschedulePolicy(
+                attempts=3, interval=24 * 3600 * 10**9, unlimited=False
+            )
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            # a pending eval keeps the job alive (reference inserts one)
+            live_ev = dead_eval(stored, status="pending")
+            ev = dead_eval(stored)
+            s.state.upsert_evals(1000, [live_ev, ev])
+            failed = terminal_alloc(
+                stored, ev, desired="run", client="failed",
+                tracker=RescheduleTracker(events=[
+                    RescheduleEvent(
+                        reschedule_time=time.time_ns(),
+                        prev_alloc_id=generate_uuid(),
+                        prev_node_id=generate_uuid(),
+                    )
+                ]),
+            )
+            s.state.upsert_allocs(1001, [failed])
+
+            run_gc(s, CORE_JOB_EVAL_GC)
+
+            # the failed alloc still owes reschedules: eval + alloc stay
+            assert s.state.eval_by_id(ev.id) is not None
+            assert s.state.alloc_by_id(failed.id) is not None
+        finally:
+            s.stop()
+
+    def test_stopped_job_reschedulable_alloc_gcs(self):
+        # ref TestCoreScheduler_EvalGC_StoppedJob_Reschedulable (:214)
+        s = make_server()
+        try:
+            job = mock.job()
+            job.stop = True
+            job.task_groups[0].reschedule_policy = ReschedulePolicy(
+                attempts=3, interval=24 * 3600 * 10**9, unlimited=False
+            )
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            ev = dead_eval(stored)
+            s.state.upsert_evals(1000, [ev])
+            failed = terminal_alloc(
+                stored, ev, desired="run", client="failed"
+            )
+            s.state.upsert_allocs(1001, [failed])
+
+            run_gc(s, CORE_JOB_EVAL_GC)
+
+            # stopped job: reschedule budget is irrelevant
+            assert s.state.eval_by_id(ev.id) is None
+            assert s.state.alloc_by_id(failed.id) is None
+        finally:
+            s.stop()
+
+    def test_live_batch_job_protected(self):
+        # ref TestCoreScheduler_EvalGC_Batch (:289): a LIVE batch job's
+        # terminal evals/allocs are never reaped by eval GC
+        s = make_server()
+        try:
+            job = mock.batch_job()
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            # keep the job alive: one running alloc under another eval
+            ev = dead_eval(stored)
+            ev.type = "batch"
+            s.state.upsert_evals(1000, [ev])
+            complete = terminal_alloc(stored, ev, desired="run",
+                                      client="complete")
+            running = terminal_alloc(stored, ev, desired="run",
+                                     client="running")
+            s.state.upsert_allocs(1001, [complete, running])
+
+            run_gc(s, CORE_JOB_EVAL_GC)
+
+            assert s.state.eval_by_id(ev.id) is not None
+            assert s.state.alloc_by_id(complete.id) is not None
+            assert s.state.alloc_by_id(running.id) is not None
+        finally:
+            s.stop()
+
+    def test_partial_reap(self):
+        # ref TestCoreScheduler_EvalGC_Partial (:610): ineligible allocs
+        # keep the eval, but eligible ones are reaped
+        s = make_server()
+        try:
+            job = mock.job()
+            job.task_groups[0].reschedule_policy = ReschedulePolicy(
+                attempts=0, interval=0, unlimited=False
+            )
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            ev = dead_eval(stored)
+            s.state.upsert_evals(1000, [ev])
+            gone = terminal_alloc(stored, ev, desired="stop")
+            kept = terminal_alloc(stored, ev, desired="run",
+                                  client="running")
+            s.state.upsert_allocs(1001, [gone, kept])
+
+            run_gc(s, CORE_JOB_EVAL_GC)
+
+            assert s.state.eval_by_id(ev.id) is not None
+            assert s.state.alloc_by_id(gone.id) is None
+            assert s.state.alloc_by_id(kept.id) is not None
+        finally:
+            s.stop()
+
+    def test_recent_eval_not_reaped(self):
+        # the threshold gate itself: an eval newer than the cutoff stays
+        s = make_server()
+        try:
+            job = mock.job()
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            ev = dead_eval(stored)
+            ev.modify_index = 100000  # beyond the witnessed horizon
+            s.state.upsert_evals(100000, [ev])
+            run_gc(s, CORE_JOB_EVAL_GC)
+            assert s.state.eval_by_id(ev.id) is not None
+        finally:
+            s.stop()
+
+
+class TestNodeGCPort:
+    def _down_node(self, s, index=1000):
+        node = mock.node()
+        s.state.upsert_node(index, node)
+        s.state.update_node_status(index + 1, node.id, "down")
+        return s.state.node_by_id(node.id)
+
+    def test_old_down_node_reaped(self):
+        # ref TestCoreScheduler_NodeGC (:809)
+        s = make_server()
+        try:
+            node = self._down_node(s)
+            run_gc(s, CORE_JOB_NODE_GC)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and s.state.node_by_id(node.id):
+                time.sleep(0.02)
+            assert s.state.node_by_id(node.id) is None
+        finally:
+            s.stop()
+
+    def test_terminal_allocs_do_not_block(self):
+        # ref TestCoreScheduler_NodeGC_TerminalAllocs (:865)
+        s = make_server()
+        try:
+            node = self._down_node(s)
+            a = mock.alloc()
+            a.node_id = node.id
+            a.desired_status = "stop"
+            s.state.upsert_allocs(1002, [a])
+            run_gc(s, CORE_JOB_NODE_GC)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and s.state.node_by_id(node.id):
+                time.sleep(0.02)
+            assert s.state.node_by_id(node.id) is None
+        finally:
+            s.stop()
+
+    def test_running_allocs_block(self):
+        # ref TestCoreScheduler_NodeGC_RunningAllocs (:920)
+        s = make_server()
+        try:
+            node = self._down_node(s)
+            a = mock.alloc()
+            a.node_id = node.id
+            a.desired_status = "run"
+            a.client_status = "running"
+            s.state.upsert_allocs(1002, [a])
+            run_gc(s, CORE_JOB_NODE_GC)
+            assert s.state.node_by_id(node.id) is not None
+        finally:
+            s.stop()
+
+
+class TestJobGCPort:
+    def _dead_stopped_job(self, s):
+        job = mock.job()
+        job.stop = True
+        s.state.upsert_job(999, job)
+        return s.state.job_by_id(job.namespace, job.id)
+
+    def test_outstanding_eval_blocks(self):
+        # ref TestCoreScheduler_JobGC_OutstandingEvals (:1020)
+        s = make_server()
+        try:
+            job = self._dead_stopped_job(s)
+            ev = dead_eval(job, status="pending")
+            s.state.upsert_evals(1000, [ev])
+            run_gc(s, CORE_JOB_JOB_GC)
+            assert s.state.job_by_id(job.namespace, job.id) is not None
+            assert s.state.eval_by_id(ev.id) is not None
+        finally:
+            s.stop()
+
+    def test_outstanding_alloc_blocks(self):
+        # ref TestCoreScheduler_JobGC_OutstandingAllocs (:1143)
+        s = make_server()
+        try:
+            job = self._dead_stopped_job(s)
+            ev = dead_eval(job)
+            s.state.upsert_evals(1000, [ev])
+            running = terminal_alloc(job, ev, desired="run",
+                                     client="running")
+            s.state.upsert_allocs(1001, [running])
+            run_gc(s, CORE_JOB_JOB_GC)
+            assert s.state.job_by_id(job.namespace, job.id) is not None
+        finally:
+            s.stop()
+
+    def test_one_shot_batch_fully_reaped(self):
+        # ref TestCoreScheduler_JobGC_OneShot (:1288): a DEAD batch job is
+        # purged along with its terminal evals and allocs (allow_batch)
+        s = make_server()
+        try:
+            job = mock.batch_job()
+            job.stop = True
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            ev = dead_eval(stored)
+            ev.type = "batch"
+            s.state.upsert_evals(1000, [ev])
+            done = terminal_alloc(stored, ev, desired="run",
+                                  client="complete")
+            s.state.upsert_allocs(1001, [done])
+            # status recomputed on the eval/alloc writes (published
+            # objects are immutable — re-fetch)
+            assert s.state.job_by_id(job.namespace, job.id).status == "dead"
+
+            run_gc(s, CORE_JOB_JOB_GC)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and s.state.job_by_id(
+                job.namespace, job.id
+            ):
+                time.sleep(0.02)
+            assert s.state.job_by_id(job.namespace, job.id) is None
+            assert s.state.eval_by_id(ev.id) is None
+            assert s.state.alloc_by_id(done.id) is None
+        finally:
+            s.stop()
+
+    def test_parameterized_parent_kept_until_stopped(self):
+        # ref TestCoreScheduler_JobGC_Parameterized (:1571)
+        s = make_server()
+        try:
+            from nomad_tpu.structs.model import ParameterizedJobConfig
+
+            job = mock.batch_job()
+            job.parameterized_job = ParameterizedJobConfig()
+            s.state.upsert_job(999, job)
+            run_gc(s, CORE_JOB_JOB_GC)
+            assert s.state.job_by_id(job.namespace, job.id) is not None
+        finally:
+            s.stop()
+
+    def test_periodic_parent_kept_until_stopped(self):
+        # ref TestCoreScheduler_JobGC_Periodic (:1650)
+        s = make_server()
+        try:
+            job = mock.periodic_job()
+            job.type = "batch"
+            s.state.upsert_job(999, job)
+            run_gc(s, CORE_JOB_JOB_GC)
+            assert s.state.job_by_id(job.namespace, job.id) is not None
+        finally:
+            s.stop()
+
+
+class TestDeploymentGCPort:
+    def test_terminal_deployment_reaped_active_kept(self):
+        # ref TestCoreScheduler_DeploymentGC (:1724)
+        s = make_server()
+        try:
+            job = mock.job()
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            old = Deployment.new_for_job(stored)
+            old.status = "failed"
+            active = Deployment.new_for_job(stored)
+            s.state.upsert_deployment(1000, old)
+            s.state.upsert_deployment(1001, active)
+
+            run_gc(s, CORE_JOB_DEPLOYMENT_GC)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and s.state.deployment_by_id(
+                old.id
+            ):
+                time.sleep(0.02)
+            assert s.state.deployment_by_id(old.id) is None
+            assert s.state.deployment_by_id(active.id) is not None
+        finally:
+            s.stop()
+
+    def test_deployment_with_live_alloc_kept(self):
+        # the live-alloc reference gate (core_sched.go:560-575)
+        s = make_server()
+        try:
+            job = mock.job()
+            s.state.upsert_job(999, job)
+            stored = s.state.job_by_id(job.namespace, job.id)
+            d = Deployment.new_for_job(stored)
+            d.status = "failed"
+            s.state.upsert_deployment(1000, d)
+            a = mock.alloc()
+            a.namespace = stored.namespace
+            a.job_id = stored.id
+            a.job = stored
+            a.deployment_id = d.id
+            a.client_status = "running"
+            s.state.upsert_allocs(1001, [a])
+
+            run_gc(s, CORE_JOB_DEPLOYMENT_GC)
+            assert s.state.deployment_by_id(d.id) is not None
+        finally:
+            s.stop()
+
+
+class TestReapPartitioningPort:
+    def test_partition_sizes(self):
+        # ref TestCoreScheduler_PartitionEvalReap/-DeploymentReap/-JobReap
+        items = [str(i) for i in range(MAX_IDS_PER_REAP * 2 + 3)]
+        chunks = _partition(items, MAX_IDS_PER_REAP)
+        assert len(chunks) == 3
+        assert all(len(c) <= MAX_IDS_PER_REAP for c in chunks)
+        assert [x for c in chunks for x in c] == items
+
+
+class TestAllocGCEligiblePort:
+    """ref TestAllocation_GCEligible (core_sched_test.go:1925): the
+    failed-alloc reschedule matrix driven through _alloc_gc_eligible."""
+
+    def _core(self):
+        s = make_server()
+        return s, CoreScheduler(s, s.state.snapshot())
+
+    def _job(self, attempts=None, unlimited=False):
+        job = mock.job()
+        if attempts is None:
+            job.task_groups[0].reschedule_policy = None
+        else:
+            job.task_groups[0].reschedule_policy = ReschedulePolicy(
+                attempts=attempts, interval=3600 * 10**9,
+                unlimited=unlimited,
+            )
+        return job
+
+    def _alloc(self, job, client="failed", desired="run", events=0):
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.task_group = job.task_groups[0].name
+        a.client_status = client
+        a.desired_status = desired
+        a.modify_index = 100
+        if events:
+            a.reschedule_tracker = RescheduleTracker(events=[
+                RescheduleEvent(
+                    reschedule_time=time.time_ns(),
+                    prev_alloc_id=generate_uuid(),
+                    prev_node_id=generate_uuid(),
+                )
+                for _ in range(events)
+            ])
+        return a
+
+    def test_matrix(self):
+        s, core = self._core()
+        try:
+            T = 10**6
+            cases = [
+                # (job kwargs, alloc kwargs, eligible)
+                # non-terminal never eligible
+                ({}, {"client": "running"}, False),
+                # complete alloc always eligible
+                ({"attempts": 3}, {"client": "complete"}, True),
+                # desired stop eligible regardless of policy
+                ({"attempts": 3}, {"client": "failed",
+                                   "desired": "stop"}, True),
+                # failed with no policy: eligible
+                ({"attempts": None}, {"client": "failed"}, True),
+                # failed with attempts=0: eligible
+                ({"attempts": 0}, {"client": "failed"}, True),
+                # failed with budget remaining: NOT eligible
+                ({"attempts": 3}, {"client": "failed", "events": 1}, False),
+                # failed with attempts exhausted: eligible
+                ({"attempts": 2}, {"client": "failed", "events": 2}, True),
+                # unlimited policy: never eligible while job lives
+                ({"attempts": 1, "unlimited": True},
+                 {"client": "failed", "events": 5}, False),
+            ]
+            for i, (jkw, akw, want) in enumerate(cases):
+                job = self._job(**jkw)
+                alloc = self._alloc(job, **akw)
+                got = core._alloc_gc_eligible(alloc, job, T)
+                assert got == want, (i, jkw, akw, got, want)
+
+            # dead/stopped job: everything terminal is eligible
+            job = self._job(attempts=3)
+            job.stop = True
+            alloc = self._alloc(job, client="failed")
+            assert core._alloc_gc_eligible(alloc, job, T)
+            # job gone entirely
+            assert core._alloc_gc_eligible(alloc, None, T)
+        finally:
+            s.stop()
