@@ -93,47 +93,71 @@ class HostStatsCollector:
     """Sampled host stats; CPU percent from /proc/stat deltas between
     calls (ref client/stats/cpu.go HostCpuStatsCalculator)."""
 
+    _ZERO_CPU = {
+        "total_percent": 0.0,
+        "user_percent": 0.0,
+        "system_percent": 0.0,
+        "idle_percent": 0.0,
+    }
+
     def __init__(self, data_dir: str = "/"):
         self.data_dir = data_dir
         self._prev = _read_proc_stat()
         self._prev_t = time.monotonic()
+        # last computed percentages: re-served on a zero-tick delta (two
+        # back-to-back collects inside one /proc/stat tick), where 0% CPU
+        # would be a lie rather than a measurement
+        self._last_cpu: Optional[dict] = None
+        # one settle-and-resample per collector, not per call: a kernel
+        # with no CPU accounting at all (sandboxed /proc/stat stuck at 0)
+        # must not cost every collect() a sleep
+        self._retry_spent = False
+
+    def _cpu_percentages(self, retry: bool = True) -> dict:
+        cur = _read_proc_stat()
+        if cur is None or self._prev is None:
+            if cur is not None:
+                self._prev = cur
+            return self._last_cpu or dict(self._ZERO_CPU)
+        # iowait (folded into idle) is documented non-monotonic in
+        # proc(5): clamp each delta so a decreasing counter can't push
+        # a percentage below 0 / above 100
+        cur = {k: max(v, self._prev[k]) for k, v in cur.items()}
+        d_total = cur["total"] - self._prev["total"]
+        if d_total <= 0:
+            if self._last_cpu is not None:
+                return self._last_cpu
+            if retry and not self._retry_spent:
+                # first-ever sample landed inside one tick: wait ~5 jiffies
+                # and resample once instead of reporting 0%
+                self._retry_spent = True
+                time.sleep(0.05)
+                return self._cpu_percentages(retry=False)
+            return dict(self._ZERO_CPU)
+        cpu = {
+            "total_percent": round(
+                100.0 * (d_total - (cur["idle"] - self._prev["idle"])) / d_total,
+                2,
+            ),
+            "user_percent": round(
+                100.0 * (cur["user"] - self._prev["user"]) / d_total, 2
+            ),
+            "system_percent": round(
+                100.0 * (cur["system"] - self._prev["system"]) / d_total, 2
+            ),
+            "idle_percent": round(
+                100.0 * (cur["idle"] - self._prev["idle"]) / d_total, 2
+            ),
+        }
+        self._prev = cur
+        self._prev_t = time.monotonic()
+        self._last_cpu = cpu
+        return cpu
 
     def collect(self) -> dict:
-        cur = _read_proc_stat()
-        cpu = {"total_percent": 0.0, "user_percent": 0.0, "system_percent": 0.0, "idle_percent": 0.0}
-        if cur is not None and self._prev is not None:
-            # iowait (folded into idle) is documented non-monotonic in
-            # proc(5): clamp each delta so a decreasing counter can't push
-            # a percentage below 0 / above 100
-            cur = {
-                k: max(v, self._prev[k]) for k, v in cur.items()
-            }
-            d_total = cur["total"] - self._prev["total"]
-            if d_total > 0:
-                cpu = {
-                    "total_percent": round(
-                        100.0
-                        * (d_total - (cur["idle"] - self._prev["idle"]))
-                        / d_total,
-                        2,
-                    ),
-                    "user_percent": round(
-                        100.0 * (cur["user"] - self._prev["user"]) / d_total, 2
-                    ),
-                    "system_percent": round(
-                        100.0 * (cur["system"] - self._prev["system"]) / d_total,
-                        2,
-                    ),
-                    "idle_percent": round(
-                        100.0 * (cur["idle"] - self._prev["idle"]) / d_total, 2
-                    ),
-                }
-        if cur is not None:
-            self._prev = cur
-            self._prev_t = time.monotonic()
         return {
             "timestamp": time.time_ns(),
-            "cpu": cpu,
+            "cpu": self._cpu_percentages(),
             "memory": _read_meminfo(),
             "disk": disk_stats(self.data_dir),
             "uptime_s": _read_uptime(),

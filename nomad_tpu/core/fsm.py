@@ -44,6 +44,7 @@ NODE_DEREGISTER = "node_deregister"
 NODE_STATUS_UPDATE = "node_status_update"
 NODE_DRAIN_UPDATE = "node_drain_update"
 NODE_ELIGIBILITY_UPDATE = "node_eligibility_update"
+NODE_EVENTS_UPSERT = "node_events_upsert"
 JOB_REGISTER = "job_register"
 JOB_DEREGISTER = "job_deregister"
 JOB_BATCH_DEREGISTER = "job_batch_deregister"
@@ -95,6 +96,7 @@ class FSM:
             NODE_STATUS_UPDATE: self._apply_node_status_update,
             NODE_DRAIN_UPDATE: self._apply_node_drain_update,
             NODE_ELIGIBILITY_UPDATE: self._apply_node_eligibility_update,
+            NODE_EVENTS_UPSERT: self._apply_node_events_upsert,
             JOB_REGISTER: self._apply_job_register,
             JOB_DEREGISTER: self._apply_job_deregister,
             JOB_BATCH_DEREGISTER: self._apply_job_batch_deregister,
@@ -200,6 +202,13 @@ class FSM:
             payload["eligibility"],
             updated_at_ns=payload.get("updated_at", 0),
         )
+        return index
+
+    def _apply_node_events_upsert(self, index: int, payload: dict):
+        """ref fsm.go applyUpsertNodeEvent (NodeEventsUpsertRequestType):
+        operational events — driver health flaps, device faults — appended
+        to each node's bounded event ring."""
+        self.state.upsert_node_events(index, payload["events"])
         return index
 
     # ------------------------------------------------------------------

@@ -19,6 +19,7 @@ from typing import Optional
 
 from .. import metrics
 from ..state.store import StateSnapshot, StateStore
+from ..testing import faults as _faults
 from ..structs.funcs import allocs_fit
 from ..structs.model import (
     NODE_SCHED_INELIGIBLE,
@@ -381,7 +382,14 @@ class Planner:
             try:
                 snap = self._optimistic_snapshot(snap, p.plan, result)
             except Exception:
-                return entries, snap, live[i + 1:]
+                # entry i IS being committed but the stacked snap is
+                # missing its placements: hand back snap=None so the apply
+                # loop joins the outstanding commit and re-fetches a fresh
+                # post-commit snapshot before verifying anything else —
+                # reusing the partial snap would double-book entry i's
+                # capacity (the pre-batching code forced snap=None on
+                # exactly this failure)
+                return entries, None, live[i + 1:]
         return entries, snap, []
 
     def _apply_loop(self):
@@ -537,6 +545,9 @@ class Planner:
         answer every submitting worker (ref plan_apply.go:367
         asyncPlanWait; batching amortizes the raft fsync)."""
         try:
+            # chaos seam: a rule here fails/partitions the leader at the
+            # worst moment — results verified, consensus not yet reached
+            _faults.fault_point("plan.raft_apply")
             items = []
             for pending, result in entries:
                 preemption_evals: list[Evaluation] = []
@@ -568,6 +579,15 @@ class Planner:
             for pending, result in entries:
                 result.alloc_index = index
                 pending.respond(result, None)
+        except _faults.SimulatedCrash:
+            # injected leader death mid-commit: the entry never reached
+            # consensus. Answer the workers with failure so their evals
+            # nack-requeue — the same outcome a real dead leader produces
+            # for them via RPC failure — instead of leaving them parked on
+            # a 30s wait with a dead commit thread
+            err = RuntimeError("plan commit crashed (injected leader death)")
+            for pending, _ in entries:
+                pending.respond(None, err)
         except Exception as e:
             for pending, _ in entries:
                 pending.respond(None, e)

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import metrics
 from ..raft import InmemTransport, NotLeaderError, Raft, RaftConfig
 from ..raft.log import InmemLogStore, SnapshotStore, StableStore
 from ..state.store import StateStore
@@ -57,6 +58,8 @@ from .worker import Worker
 logger = logging.getLogger("nomad_tpu.server")
 
 DEFAULT_HEARTBEAT_TTL = 30.0
+#: seconds a failed proxy HTTP address stays quarantined
+HTTP_ADDR_QUARANTINE = 10.0
 
 
 class Server:
@@ -110,6 +113,10 @@ class Server:
         self._peer_http_addrs: dict[str, str] = {}
         #: http addr → monotonic time a proxy to it last failed
         self._bad_http_addrs: dict[str, float] = {}
+        # both maps are touched from concurrent HTTP handler threads;
+        # check-then-pop sequences need real mutual exclusion, and expired
+        # quarantine entries are pruned so the map can't grow unboundedly
+        self._http_addr_lock = threading.Lock()
         # secret → compiled ACL, invalidated by acl table indexes in the key
         self._acl_cache: dict = {}
 
@@ -470,8 +477,16 @@ class Server:
         def ok(addr):
             if not addr:
                 return False
-            bad_at = self._bad_http_addrs.get(addr)
-            return bad_at is None or time.monotonic() - bad_at > 10.0
+            with self._http_addr_lock:
+                bad_at = self._bad_http_addrs.get(addr)
+                if (
+                    bad_at is not None
+                    and time.monotonic() - bad_at > HTTP_ADDR_QUARANTINE
+                ):
+                    # quarantine served its term; stop tracking the addr
+                    del self._bad_http_addrs[addr]
+                    bad_at = None
+            return bad_at is None
 
         if server_id:
             if self.gossip is not None:
@@ -486,7 +501,8 @@ class Server:
                 return static
         if not rpc_addr:
             return None
-        cached = self._peer_http_addrs.get(rpc_addr)
+        with self._http_addr_lock:
+            cached = self._peer_http_addrs.get(rpc_addr)
         if ok(cached):
             return cached
         try:
@@ -497,8 +513,9 @@ class Server:
             return None
         addr = (resp or {}).get("http_addr")
         if addr:
-            self._peer_http_addrs[rpc_addr] = addr
-            self._bad_http_addrs.pop(addr, None)
+            with self._http_addr_lock:
+                self._peer_http_addrs[rpc_addr] = addr
+                self._bad_http_addrs.pop(addr, None)
         return addr
 
     def forget_server_http_addr(
@@ -507,9 +524,20 @@ class Server:
         """Record a failed proxy target: drops the RPC-learned cache entry
         and quarantines ``http_addr`` so gossip/static sources holding the
         same stale value are skipped on the next resolution."""
-        self._peer_http_addrs.pop(rpc_addr, None)
-        if http_addr:
-            self._bad_http_addrs[http_addr] = time.monotonic()
+        now = time.monotonic()
+        with self._http_addr_lock:
+            self._peer_http_addrs.pop(rpc_addr, None)
+            if http_addr:
+                self._bad_http_addrs[http_addr] = now
+            # sweep quarantine entries past their term: failed addrs must
+            # not accumulate forever (ADVICE r5 low)
+            expired = [
+                a
+                for a, t0 in self._bad_http_addrs.items()
+                if now - t0 > HTTP_ADDR_QUARANTINE
+            ]
+            for a in expired:
+                del self._bad_http_addrs[a]
 
     def _reconcile_gossip_members(self):
         """On leadership: fold the current gossip view into raft membership
@@ -1253,6 +1281,44 @@ class Server:
         """ref node_endpoint.go DeriveVaultToken"""
         self._check_leader()
         return self.vault.derive_token(alloc_id, task_name)
+
+    def upsert_node_events(self, events_by_node: dict[str, list]) -> int:
+        """Replicate operational node events (ref node_endpoint.go
+        EmitEvents → raft NodeEventsUpsertRequestType). Leader-only; event
+        docs carry their own timestamps so replicas apply identically."""
+        self._check_leader()
+        return self._apply(
+            fsm_mod.NODE_EVENTS_UPSERT, {"events": events_by_node}
+        )
+
+    #: node-event fanout cap for a single kernel fault: the witness needs
+    #: a few TPU-plane nodes, not a raft write touching every device host
+    MAX_KERNEL_FAULT_EVENT_NODES = 8
+
+    def note_kernel_fault(self, ev: Optional[Evaluation], reason: str):
+        """Witness a device-tier scheduler fault (TPU placement kernel
+        error/NaN) that the scheduler degraded around: a metric for the
+        telemetry surface plus a node event on the TPU device plane so
+        operators see WHERE the accelerator tier is unhealthy — the eval
+        itself completed on the exact-np host oracle."""
+        metrics.incr("tpu.kernel_fault")
+        targets = []
+        for node in self.state.nodes():
+            devices = getattr(node.node_resources, "devices", None) or []
+            if any(getattr(d, "type", "") == "tpu" for d in devices):
+                targets.append(node.id)
+                if len(targets) >= self.MAX_KERNEL_FAULT_EVENT_NODES:
+                    break
+        if not targets:
+            return
+        event = {
+            "timestamp": now_ns(),
+            "subsystem": "TPU",
+            "message": f"placement kernel fault: {reason}; "
+            "degraded to exact-np planner",
+            "details": {"eval_id": ev.id if ev is not None else ""},
+        }
+        self.upsert_node_events({node_id: [event] for node_id in targets})
 
     def system_gc(self):
         """Force-GC everything eligible (ref system_endpoint.go GarbageCollect

@@ -11,9 +11,11 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Optional
 
 from ..scheduler.scheduler import new_scheduler
+from ..testing import faults as _faults
 from ..structs.model import (
     EVAL_STATUS_FAILED,
     Evaluation,
@@ -64,21 +66,54 @@ class Worker:
             )
             if ev is None:
                 continue
-            self.process_eval(ev, token)
+            try:
+                self.process_eval(ev, token)
+            except _faults.SimulatedCrash:
+                # the chaos harness killed this worker "process": no ack,
+                # no nack — the broker's nack timer requeues the eval when
+                # the lease expires, as with a real worker death
+                logger.warning("worker crash injected; thread exiting")
+                return
 
     # ------------------------------------------------------------------
+    def _snapshot_with_lease(self, ev: Evaluation, token: str):
+        """Wait for the eval's raft index in sub-lease slices, extending
+        the broker lease between slices so a sync that outlasts
+        nack_timeout can't nack the eval out from under a live worker
+        (ref worker.go waitForIndex, which resets the lease periodically
+        INSIDE the wait — a single post-wait reset fires only after the
+        nack already landed)."""
+        broker = self.server.eval_broker
+        slice_ = max(min(broker.nack_timeout / 2.0, RAFT_SYNC_LIMIT), 0.05)
+        deadline = time.monotonic() + RAFT_SYNC_LIMIT
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                return self.server.state.snapshot_min_index(
+                    ev.modify_index,
+                    timeout=min(slice_, max(remaining, 0.01)),
+                )
+            except TimeoutError:
+                if time.monotonic() >= deadline:
+                    raise
+                # still waiting, still making progress: extend the lease
+                try:
+                    broker.outstanding_reset(ev.id, token)
+                except BrokerError:
+                    pass
+
     def process_eval(self, ev: Evaluation, token: str, snapshot=None, collector=None):
         """Dequeue → snapshot ≥ wait index → invoke scheduler → ack/nack
         (ref worker.go:142-276). ``snapshot``/``collector`` are supplied by
         the batch-drain path (one shared snapshot, fused kernel)."""
         try:
+            # inside the try so an "error"-action rule nacks like any
+            # processing failure; a "crash" rule raises SimulatedCrash
+            # (BaseException) straight past the handler, like a real death
+            _faults.fault_point("worker.post_dequeue")
             if snapshot is None:
-                snapshot = self.server.state.snapshot_min_index(
-                    ev.modify_index, timeout=RAFT_SYNC_LIMIT
-                )
-                # the wait is progress: extend the lease so a slow raft
-                # sync can't nack an eval out from under a live worker
-                # (ref worker.go waitForIndex → OutstandingReset)
+                snapshot = self._snapshot_with_lease(ev, token)
+                # fresh lease for the scheduling pass itself
                 try:
                     self.server.eval_broker.outstanding_reset(ev.id, token)
                 except BrokerError:
@@ -146,6 +181,7 @@ class Worker:
         a fresh snapshot when the applier asks for a refresh."""
         from .. import metrics
 
+        _faults.fault_point("worker.pre_submit")
         plan.eval_token = self._eval_token
         plan.snapshot_index = self.server.state.latest_index()
         with metrics.measure("plan.submit"):
@@ -181,6 +217,16 @@ class Worker:
             ev.snapshot_index = self._snapshot_index
         self.server.update_evals([ev])
 
+    def note_kernel_fault(self, reason: str):
+        """Surface a device-tier fault the scheduler degraded around
+        (tpu/batch_sched.py exact-np fallback): metric + node event on the
+        TPU plane. Best-effort — the eval itself already succeeded, and a
+        leadership change mid-emission must not fail it retroactively."""
+        try:
+            self.server.note_kernel_fault(self._eval, reason)
+        except Exception:
+            logger.exception("kernel-fault event emission failed")
+
 
 class BatchDrainWorker(Worker):
     """Worker that drains up to ``batch_size`` ready evals per cycle and
@@ -205,7 +251,13 @@ class BatchDrainWorker(Worker):
             )
             if not batch:
                 continue
-            self.process_batch(batch)
+            try:
+                self.process_batch(batch)
+            except _faults.SimulatedCrash:
+                # single-eval batches run on this thread: an injected
+                # crash kills the whole worker, leases clean up
+                logger.warning("drain worker crash injected; thread exiting")
+                return
 
     def process_batch(self, batch: list):
         if len(batch) == 1:
@@ -236,12 +288,22 @@ class BatchDrainWorker(Worker):
             # one planner per eval: SubmitPlan attaches per-eval tokens and
             # refresh snapshots, so workers can't be shared across threads
             w = Worker(self.server, self.schedulers, seed=self.seed)
-            t = threading.Thread(
-                target=w.process_eval,
-                args=(ev, token),
-                kwargs={"snapshot": snapshot, "collector": collector},
-                daemon=True,
-            )
+
+            def run_one(w=w, ev=ev, token=token):
+                try:
+                    w.process_eval(
+                        ev, token, snapshot=snapshot, collector=collector
+                    )
+                except _faults.SimulatedCrash:
+                    # injected death of one drain lane: no ack/nack — the
+                    # broker lease expiry requeues the eval
+                    logger.warning(
+                        "drain worker crash injected; eval %s left to "
+                        "lease expiry",
+                        ev.id,
+                    )
+
+            t = threading.Thread(target=run_one, daemon=True)
             threads.append(t)
             t.start()
         for t in threads:

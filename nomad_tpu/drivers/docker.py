@@ -212,7 +212,16 @@ class DockerDriver(Driver):
             "readonly_rootfs": Attr("bool"),
             "network_mode": Attr("string"),
             "network_aliases": Attr("list(string)"),
+            "ipv4_address": Attr("string"),
+            "ipv6_address": Attr("string"),
             "mac_address": Attr("string"),
+            # namespace modes start_task consumes (docker.py:465-472; ref
+            # drivers/docker/config.go:261-310) — validate_spec rejects
+            # unknown keys, so omitting these failed previously-valid jobs
+            "pid_mode": Attr("string"),
+            "ipc_mode": Attr("string"),
+            "uts_mode": Attr("string"),
+            "userns_mode": Attr("string"),
             "memory_hard_limit": Attr("number"),
             "cpu_hard_limit": Attr("bool"),
             "cpu_cfs_period": Attr("number"),

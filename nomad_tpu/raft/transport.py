@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..testing import faults as _faults
+
 
 class Transport:
     """Point-to-point RPCs a raft node sends to its peers. ``target`` is
@@ -61,6 +63,15 @@ class InmemTransport(Transport):
             handlers = self.registry.get(target)
         if handlers is None:
             raise ConnectionError(f"no raft node at {target}")
+        plane = _faults.ACTIVE
+        if plane is not None:
+            act = plane.on_raft(req.get("_from") or "", target, method)
+            if act in ("drop", "sever"):
+                raise ConnectionError(f"injected {act}: {target} {method}")
+            if act == "duplicate":
+                # deliver twice (duplicated datagram); the handler must be
+                # idempotent per raft's term/index rules
+                handlers[method](req)
         return handlers[method](req)
 
     def request_vote(self, target: str, req: dict) -> dict:

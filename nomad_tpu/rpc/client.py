@@ -6,6 +6,14 @@ leader address the error carries — the follower→leader forwarding model
 (the reference forwards server-side, rpc.go:433; doing it client-side
 keeps the wire format trivial and the hop count identical).
 
+A per-address circuit breaker quarantines peers whose connections keep
+failing (severed/partitioned servers): after ``circuit_threshold``
+consecutive connection-class failures the address fails fast with a
+``circuit_open`` error for ``circuit_cooldown`` seconds instead of
+re-dialing in a hot loop (the reference reaches the same outcome through
+its server manager's failure-ranked rebalancing, client/servers/
+manager.go).
+
 ``ServerProxy`` exposes the same method surface as ``core.Server`` so the
 node agent (client/client.py) works identically in-process or over TCP.
 """
@@ -16,6 +24,9 @@ import socket
 import threading
 import time
 from typing import Optional
+
+from .. import metrics
+from ..testing import faults as _faults
 
 
 class RpcError(Exception):
@@ -33,11 +44,98 @@ class ConnPool:
     so the process holds one socket per peer regardless of in-flight call
     count. Dead sessions are replaced on next use."""
 
-    def __init__(self, timeout: float = 10.0, tls_context=None):
+    #: consecutive connection-class failures before the circuit opens
+    CIRCUIT_THRESHOLD = 3
+    #: seconds a tripped address fails fast before a probe dial is allowed
+    CIRCUIT_COOLDOWN = 5.0
+
+    def __init__(self, timeout: float = 10.0, tls_context=None, name: str = "",
+                 circuit_threshold: Optional[int] = None,
+                 circuit_cooldown: Optional[float] = None):
         self.timeout = timeout
         self.tls_context = tls_context
+        #: identity reported to the fault plane as the call source
+        self.name = name
+        self.circuit_threshold = (
+            circuit_threshold
+            if circuit_threshold is not None
+            else self.CIRCUIT_THRESHOLD
+        )
+        self.circuit_cooldown = (
+            circuit_cooldown
+            if circuit_cooldown is not None
+            else self.CIRCUIT_COOLDOWN
+        )
         self._sessions: dict[str, "MuxSession"] = {}
+        # addr -> [consecutive_failures, open_until_monotonic]
+        self._circuit: dict[str, list] = {}
         self._lock = threading.Lock()
+
+    # -- circuit breaker -----------------------------------------------
+    def _circuit_check(self, addr: str):
+        """Fail fast while ``addr``'s circuit is open; past the cooldown
+        the next call probes the address again (half-open)."""
+        with self._lock:
+            entry = self._circuit.get(addr)
+            if entry is not None and entry[1] > time.monotonic():
+                raise RpcError(
+                    "circuit_open",
+                    f"{addr}: quarantined after {entry[0]} connection failures",
+                )
+
+    def _circuit_record(self, addr: str, ok: bool):
+        with self._lock:
+            if ok:
+                self._circuit.pop(addr, None)
+                return
+            entry = self._circuit.setdefault(addr, [0, 0.0])
+            entry[0] += 1
+            if entry[0] >= self.circuit_threshold:
+                entry[1] = time.monotonic() + self.circuit_cooldown
+                metrics.incr("rpc.circuit_open")
+
+    def circuit_state(self, addr: str) -> dict:
+        """Observability/test hook: {failures, open} for ``addr``."""
+        with self._lock:
+            entry = self._circuit.get(addr)
+            return {
+                "failures": entry[0] if entry else 0,
+                "open": bool(entry and entry[1] > time.monotonic()),
+            }
+
+    def _sever(self, addr: str):
+        """Kill the cached session to ``addr`` as if the transport failed
+        (the fault plane's sever action; every in-flight stream errors)."""
+        with self._lock:
+            sess = self._sessions.pop(addr, None)
+        if sess is not None:
+            sess.inject_failure()
+
+    def _inject(self, addr: str, method: str, duplicable: bool = True) -> bool:
+        """Consult the fault plane; returns True when the call must be
+        duplicated. Raises RpcError for drop/sever — which feed the
+        circuit breaker like any real connection failure, so simulated
+        partitions trip it exactly as a dead peer would. Seams that
+        cannot honor duplication (streams) pass ``duplicable=False`` and
+        duplicate rules are skipped without a false trip."""
+        plane = _faults.ACTIVE
+        if plane is None:
+            return False
+        # an open circuit short-circuits BEFORE the injected network: the
+        # client never dials, so simulated faults can't fire either
+        self._circuit_check(addr)
+        act = plane.on_rpc(
+            self.name, addr, method,
+            exclude=() if duplicable else ("duplicate",),
+        )
+        if act == "drop":
+            self._circuit_record(addr, ok=False)
+            raise RpcError("connection", f"{addr}: {method}: injected drop")
+        if act == "sever":
+            self._sever(addr)
+            self._circuit_record(addr, ok=False)
+            raise RpcError("connection", f"{addr}: {method}: injected sever")
+        return act == "duplicate"
 
     def _session(self, addr: str):
         """→ (session, cached): a cached session may have died since its
@@ -72,22 +170,28 @@ class ConnPool:
     def _open(self, addr: str, method: str, payload, retry_stale: bool):
         """Open a stream, retrying once on a fresh session if the cached
         one died — safe because a failed open means the request frame
-        never reached the server whole."""
+        never reached the server whole. Checks the circuit breaker first
+        and records connection-class outcomes into it."""
         from .mux import StreamClosed
 
+        self._circuit_check(addr)
         try:
             sess, cached = self._session(addr)
         except OSError as e:
+            self._circuit_record(addr, ok=False)
             raise RpcError("connect", f"{addr}: {e}")
         try:
-            return sess.open(method, payload)
+            stream = sess.open(method, payload)
         except StreamClosed:
             with self._lock:
                 if self._sessions.get(addr) is sess:
                     del self._sessions[addr]
             if cached and retry_stale:
                 return self._open(addr, method, payload, retry_stale=False)
+            self._circuit_record(addr, ok=False)
             raise RpcError("connection", f"{addr}: session closed")
+        self._circuit_record(addr, ok=True)
+        return stream
 
     @staticmethod
     def _rpc_error(err: dict) -> RpcError:
@@ -115,10 +219,21 @@ class ConnPool:
         re-sending would duplicate a non-idempotent write."""
         from .mux import StreamClosed, StreamError
 
+        duplicate = self._inject(addr, method)
         stream = self._open(addr, method, payload, retry_stale)
         try:
             result = stream.recv(timeout=timeout or self.timeout)
             stream.close()
+            if duplicate:
+                # fault plane: deliver the request a second time (at-least-
+                # once transport semantics); the duplicate's outcome is
+                # discarded like a lost response would be
+                try:
+                    dup = self._open(addr, method, payload, retry_stale=False)
+                    dup.recv(timeout=timeout or self.timeout)
+                    dup.close()
+                except (RpcError, StreamError, StreamClosed, TimeoutError):
+                    pass
             return result
         except StreamError as e:
             stream.close()
@@ -128,6 +243,10 @@ class ConnPool:
                 and retry_leader
                 and err.leader_rpc_addr
             ):
+                # brief backoff before the leader hop: a hint that points
+                # at a just-severed peer otherwise hot-loops through the
+                # circuit breaker
+                time.sleep(0.02)
                 return self.call(
                     err.leader_rpc_addr, method, payload,
                     timeout=timeout, retry_leader=False,
@@ -138,6 +257,7 @@ class ConnPool:
             raise RpcError("timeout", f"{addr}: {method}: timed out")
         except StreamClosed:
             stream.close()  # release the local stream record
+            self._circuit_record(addr, ok=False)
             raise RpcError("connection", f"{addr}: stream closed")
 
     def call_stream(self, addr: str, method: str, payload,
@@ -146,6 +266,7 @@ class ConnPool:
         the shared session — other calls proceed concurrently."""
         from .mux import StreamClosed, StreamError
 
+        self._inject(addr, method, duplicable=False)
         stream = self._open(addr, method, payload, retry_stale=True)
         try:
             while True:
@@ -163,6 +284,7 @@ class ConnPool:
     def call_duplex(self, addr: str, method: str, payload):
         """Open a BIDIRECTIONAL stream (the exec path): returns the live
         mux Stream; the caller drives send()/recv()/close()."""
+        self._inject(addr, method, duplicable=False)
         return self._open(addr, method, payload, retry_stale=True)
 
     def close(self):
@@ -194,6 +316,12 @@ class ServerProxy:
             self.servers = list(servers)
             self._current = 0
 
+    #: rotation backoff: base * 2^attempt, capped (manager.go's failure
+    #: backoff; nonzero from the FIRST failure so a severed cluster is
+    #: polled, not hammered)
+    RETRY_BACKOFF_BASE = 0.05
+    RETRY_BACKOFF_MAX = 1.0
+
     def _call(self, method: str, payload, timeout: Optional[float] = None):
         last_err = None
         for attempt in range(self.max_retries):
@@ -202,12 +330,22 @@ class ServerProxy:
             try:
                 return self.pool.call(addr, method, payload, timeout=timeout)
             except RpcError as e:
-                if e.code in ("connect", "connection", "not_leader"):
-                    # rotate to the next server (manager.go NotifyFailedServer)
+                if e.code in (
+                    "connect", "connection", "not_leader", "circuit_open"
+                ):
+                    # rotate to the next server (manager.go
+                    # NotifyFailedServer); a circuit_open peer costs no
+                    # dial, so the sleep is what paces the loop
                     with self._lock:
                         self._current += 1
                     last_err = e
-                    time.sleep(0.05 * attempt)
+                    if attempt + 1 < self.max_retries:
+                        time.sleep(
+                            min(
+                                self.RETRY_BACKOFF_BASE * (2 ** attempt),
+                                self.RETRY_BACKOFF_MAX,
+                            )
+                        )
                     continue
                 raise
         raise last_err

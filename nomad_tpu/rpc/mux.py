@@ -299,6 +299,13 @@ class MuxSession:
     def close(self):
         self._die()
 
+    def inject_failure(self):
+        """Chaos seam: tear the session down as if the transport failed
+        mid-flight — every open stream observes the connection-closed end
+        and blocked senders wake, exactly the observable a peer crash or
+        cable pull produces."""
+        self._die()
+
     def _read_frame_blocking(self):
         """read_frame that treats the socket's send-bound timeout as an
         idle tick on the receive side: a quiet connection is healthy, and

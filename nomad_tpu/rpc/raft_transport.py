@@ -10,6 +10,7 @@ import threading
 from typing import Callable, Optional
 
 from ..raft.transport import Transport
+from ..testing import faults as _faults
 from .codec import RPC_RAFT, ConnectionClosed, read_frame, write_frame
 
 
@@ -65,6 +66,16 @@ class TcpRaftTransport(Transport):
             return c
 
     def _call(self, target: str, method: str, req: dict):
+        plane = _faults.ACTIVE
+        if plane is not None:
+            act = plane.on_raft(req.get("_from") or "", target, method)
+            if act in ("drop", "sever"):
+                if act == "sever":
+                    with self._lock:
+                        c = self._conns.pop(target, None)
+                    if c is not None:
+                        c.close()
+                raise ConnectionError(f"injected {act}: {target} {method}")
         req = {k: v for k, v in req.items() if k != "_from"}
         try:
             return self._conn(target).call(method, req)

@@ -446,6 +446,38 @@ class StateStore(StateReader):
         )
 
     @_write_txn
+    def upsert_node_events(self, index: int, events_by_node: dict[str, list[dict]]):
+        """Append operational events to nodes' bounded event rings
+        (ref state_store.go UpsertNodeEvents). Unknown node ids are
+        skipped — an event for a node GC'd between emission and apply is
+        not an error."""
+        gen = self._gen
+        table = dict(gen.nodes)
+        changed = False
+        for node_id, events in events_by_node.items():
+            node = table.get(node_id)
+            if node is None or not events:
+                continue
+            node = node.copy()
+            node.events = (list(node.events) + list(events))[
+                -self.MAX_NODE_EVENTS:
+            ]
+            node.modify_index = index
+            table[node_id] = node
+            changed = True
+        # publish even when nothing matched: the raft index must land in
+        # the store so min-index waiters see this entry applied
+        self._publish(
+            index=index,
+            nodes=table if changed else gen.nodes,
+            table_indexes=(
+                self._bump(gen, index, "nodes")
+                if changed
+                else self._bump(gen, index)
+            ),
+        )
+
+    @_write_txn
     def delete_node(self, index: int, node_id: str):
         gen = self._gen
         nodes = dict(gen.nodes)

@@ -1,0 +1,194 @@
+"""Deterministic, seeded fault-injection plane (the Jepsen-style nemesis
+for in-process clusters; cf. PAPERS.md partition-testing entries).
+
+A ``FaultPlane`` holds an ordered list of :class:`FaultRule`. Production
+seams call the module-level gates at well-known points:
+
+- ``on_rpc(src, dst, method)`` — ConnPool (rpc/client.py) before every
+  call: drop, delay, duplicate, or sever the session to ``dst``.
+- ``on_raft(src, dst, method)`` — the raft transport
+  (raft/transport.py): drop/delay/duplicate AppendEntries, votes, and
+  snapshots per (src, dst, method).
+- ``fault_point(name)`` — process-level points: ``worker.post_dequeue``
+  and ``worker.pre_submit`` (kill a scheduler worker mid-eval),
+  ``plan.raft_apply`` (fail/partition the leader mid plan-commit batch),
+  ``tpu.kernel`` (device error / NaN at kernel dispatch).
+
+Every decision is drawn from one seeded ``random.Random`` under a lock,
+so a deterministic call sequence yields a deterministic fault schedule.
+Rules record ``matches``/``trips`` and the plane keeps a ``log`` of every
+injected fault for test assertions.
+
+Install with ``install(FaultPlane(seed=...))`` (or the ``plane()``
+context manager) and always ``uninstall()`` — the pointer is global to
+the process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fnmatch
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+class SimulatedCrash(BaseException):
+    """A fault-plane "kill -9": derives from BaseException so no ordinary
+    ``except Exception`` recovery path (nack handlers, retry loops) can
+    observe it — exactly like a process death, the component simply stops
+    mid-operation and the cluster's leases/timers must clean up."""
+
+
+@dataclass
+class FaultRule:
+    """One match-and-inject rule. Patterns are fnmatch globs; ``scope``
+    selects the seam ("rpc", "raft", or "point"). ``action`` is one of
+    drop | delay | duplicate | sever | crash | error | callback."""
+
+    scope: str
+    action: str
+    src: str = "*"
+    dst: str = "*"
+    method: str = "*"  # RPC/raft method, or the fault-point name
+    p: float = 1.0  # trip probability per match (seeded)
+    delay: float = 0.0  # seconds, for action == "delay"
+    count: Optional[int] = None  # max trips; None = unlimited
+    after: int = 0  # skip the first N matches
+    error: Optional[BaseException] = None  # payload for action == "error"
+    callback: Optional[Callable[[], None]] = None  # runs on every trip
+    matches: int = 0
+    trips: int = 0
+
+    def _matches(self, scope: str, src: str, dst: str, method: str) -> bool:
+        return (
+            self.scope == scope
+            and fnmatch.fnmatch(src, self.src)
+            and fnmatch.fnmatch(dst, self.dst)
+            and fnmatch.fnmatch(method, self.method)
+        )
+
+
+class FaultPlane:
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        #: every injected fault as (scope, src, dst, method, action)
+        self.log: list[tuple] = []
+        self._lock = threading.Lock()
+
+    # -- rule construction ---------------------------------------------
+    def rule(self, scope: str, action: str, **kw) -> FaultRule:
+        r = FaultRule(scope=scope, action=action, **kw)
+        with self._lock:
+            self.rules.append(r)
+        return r
+
+    def trips(self, scope: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                r.trips for r in self.rules if scope is None or r.scope == scope
+            )
+
+    # -- decision core -------------------------------------------------
+    def _decide(
+        self, scope: str, src: str, dst: str, method: str,
+        exclude: tuple = (),
+    ) -> Optional[FaultRule]:
+        """First rule that matches AND trips (probability, after, count
+        all drawn/checked under the lock for determinism). Rules whose
+        action is in ``exclude`` are skipped entirely — no match, no trip
+        — so a seam that cannot honor an action (duplicating a stream)
+        never falsely reports it injected."""
+        with self._lock:
+            for r in self.rules:
+                if r.action in exclude:
+                    continue
+                if not r._matches(scope, src, dst, method):
+                    continue
+                r.matches += 1
+                if r.matches <= r.after:
+                    continue
+                if r.count is not None and r.trips >= r.count:
+                    continue
+                if r.p < 1.0 and self.rng.random() >= r.p:
+                    continue
+                r.trips += 1
+                self.log.append((scope, src, dst, method, r.action))
+                return r
+        return None
+
+    def _fire(self, rule: FaultRule, what: str) -> Optional[str]:
+        """Run the rule's side effects; returns the action the caller must
+        apply itself ("drop"/"duplicate"/"sever"), or None."""
+        if rule.callback is not None:
+            rule.callback()
+        if rule.action == "delay":
+            time.sleep(rule.delay)
+            return None
+        if rule.action == "crash":
+            raise SimulatedCrash(what)
+        if rule.action == "error":
+            raise rule.error if rule.error is not None else RuntimeError(
+                f"injected fault: {what}"
+            )
+        if rule.action == "callback":
+            return None
+        return rule.action
+
+    # -- seams ----------------------------------------------------------
+    def on_rpc(
+        self, src: str, dst: str, method: str, exclude: tuple = ()
+    ) -> Optional[str]:
+        rule = self._decide("rpc", src, dst, method, exclude=exclude)
+        if rule is None:
+            return None
+        return self._fire(rule, f"rpc {src}->{dst} {method}")
+
+    def on_raft(self, src: str, dst: str, method: str) -> Optional[str]:
+        rule = self._decide("raft", src, dst, method)
+        if rule is None:
+            return None
+        return self._fire(rule, f"raft {src}->{dst} {method}")
+
+    def on_point(self, point: str) -> Optional[str]:
+        rule = self._decide("point", "", "", point)
+        if rule is None:
+            return None
+        return self._fire(rule, point)
+
+
+#: the installed plane; production seams read this once per fault point
+ACTIVE: Optional[FaultPlane] = None
+
+
+def install(plane_: FaultPlane) -> FaultPlane:
+    global ACTIVE
+    ACTIVE = plane_
+    return plane_
+
+
+def uninstall():
+    global ACTIVE
+    ACTIVE = None
+
+
+@contextlib.contextmanager
+def plane(seed: int = 0):
+    p = install(FaultPlane(seed=seed))
+    try:
+        yield p
+    finally:
+        uninstall()
+
+
+def fault_point(point: str):
+    """Process-level fault gate: no-op unless a plane is installed and a
+    "point"-scoped rule matches ``point``. May sleep (delay), raise
+    SimulatedCrash (crash) or an injected error, or run a test callback
+    (e.g. partition the leader at exactly this moment)."""
+    p = ACTIVE
+    if p is not None:
+        p.on_point(point)

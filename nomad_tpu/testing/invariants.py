@@ -1,0 +1,96 @@
+"""Cluster-invariant checker: the end-of-scenario oracle every chaos test
+runs against the final state snapshot.
+
+The invariants are the ones the reference's design guarantees across any
+fault schedule (eval_broker at-least-once + plan-applier optimistic
+concurrency + raft):
+
+1. no allocation is placed twice — at most one non-terminal alloc per
+   (namespace, job, alloc name);
+2. no node is over-committed — ``AllocsFit`` holds for every node's
+   live allocs (cpu/mem/disk superset, ports, devices);
+3. every non-blocked evaluation reached a terminal state (nothing stuck
+   ``pending`` once the cluster quiesced);
+4. state indexes are monotonic and consistent — every object's
+   create_index ≤ modify_index ≤ latest_index, and no table index
+   exceeds the store's latest index.
+"""
+
+from __future__ import annotations
+
+from ..structs.funcs import allocs_fit
+
+
+def check_cluster_invariants(state) -> list[str]:
+    """Run every invariant against ``state`` (a StateReader — a live
+    store or a snapshot); returns human-readable violations (empty =
+    healthy). Call only after the scenario quiesced: in-flight evals are
+    legitimately ``pending`` while workers still run."""
+    violations: list[str] = []
+
+    # 1. no alloc placed twice
+    live_by_name: dict[tuple, list] = {}
+    for a in state.allocs():
+        if a.terminal_status():
+            continue
+        live_by_name.setdefault((a.namespace, a.job_id, a.name), []).append(a)
+    for (ns, job_id, name), group in live_by_name.items():
+        if len(group) > 1:
+            violations.append(
+                f"alloc placed twice: {len(group)} live allocs named "
+                f"{name!r} for {ns}/{job_id}: {[a.id for a in group]}"
+            )
+
+    # 2. no node over-committed vs AllocsFit
+    for node in state.nodes():
+        allocs = state.allocs_by_node_terminal(node.id, False)
+        if not allocs:
+            continue
+        fit, dimension, _ = allocs_fit(node, allocs, None, True)
+        if not fit:
+            violations.append(
+                f"node {node.id} over-committed: {dimension} "
+                f"({len(allocs)} live allocs)"
+            )
+
+    # 3. every non-blocked eval reached a terminal state
+    for ev in state.evals():
+        if not ev.terminal_status() and not ev.should_block():
+            violations.append(
+                f"eval {ev.id} ({ev.type}, job {ev.job_id}) stuck in "
+                f"status {ev.status!r}"
+            )
+
+    # 4. index monotonicity
+    latest = state.latest_index()
+    for table, idx in state._gen.table_indexes.items():
+        if idx > latest:
+            violations.append(
+                f"table {table} index {idx} exceeds latest index {latest}"
+            )
+    for kind, objects in (
+        ("node", state.nodes()),
+        ("eval", state.evals()),
+        ("alloc", state.allocs()),
+        ("job", state.jobs()),
+    ):
+        for obj in objects:
+            if obj.create_index > obj.modify_index:
+                violations.append(
+                    f"{kind} {obj.id if hasattr(obj, 'id') else obj}: "
+                    f"create_index {obj.create_index} > modify_index "
+                    f"{obj.modify_index}"
+                )
+            if obj.modify_index > latest:
+                violations.append(
+                    f"{kind} {getattr(obj, 'id', obj)}: modify_index "
+                    f"{obj.modify_index} exceeds latest index {latest}"
+                )
+    return violations
+
+
+def assert_cluster_invariants(state):
+    violations = check_cluster_invariants(state)
+    assert not violations, "cluster invariants violated:\n" + "\n".join(
+        violations
+    )

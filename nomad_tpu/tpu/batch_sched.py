@@ -19,6 +19,7 @@ fails — see tests/test_preemption_e2e.py::TestTPUSystemPreemption.
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import replace
 from typing import Optional
@@ -47,6 +48,16 @@ from .columnar import (
     compute_limit,
     kernel_supported,
 )
+
+
+logger = logging.getLogger("nomad_tpu.tpu.batch_sched")
+
+
+class KernelFault(Exception):
+    """Device-tier failure — an XLA runtime error, a debug-nans trip, or
+    an injected chaos fault — surfaced at kernel dispatch or at the
+    placement sync point. The scheduler catches exactly this and degrades
+    the eval to the exact-np host oracle instead of failing it."""
 
 
 _ALLOC_CLASS_DEFAULTS: Optional[dict] = None
@@ -508,13 +519,13 @@ class TPUBatchScheduler(GenericScheduler):
         valid = np.zeros(A, dtype=bool)
         valid[:a_real] = True
 
-        # Vectorized-oracle path: the float64 numpy stepper, one dense pass
-        # per placement with the scalar chain's exact semantics (no device)
-        if self.exact_numpy:
+        def run_exact_np():
+            """The float64 numpy stepper: one dense pass per placement
+            with the scalar chain's exact semantics, no device. Shared by
+            the oracle-np factory and the kernel-fault degrade path."""
             from .exact_np import plan_exact_np
 
-            t_columnar = time.monotonic()
-            placements = plan_exact_np(
+            return plan_exact_np(
                 capacity_real.astype(np.int64),
                 cluster.usable.astype(np.float64),
                 feasible[:, :n_real],
@@ -536,6 +547,12 @@ class TPUBatchScheduler(GenericScheduler):
                 counts0.astype(np.int64),
                 present0,
             )
+
+        # Vectorized-oracle path: the float64 numpy stepper, one dense pass
+        # per placement with the scalar chain's exact semantics (no device)
+        if self.exact_numpy:
+            t_columnar = time.monotonic()
+            placements = run_exact_np()
             LAST_KERNEL_STATS.update(
                 columnar_s=t_columnar - t_start,
                 kernel_s=time.monotonic() - t_columnar,
@@ -550,6 +567,40 @@ class TPUBatchScheduler(GenericScheduler):
                 dev_entries=dev_entries, groups=groups,
             )
             return
+
+        def degrade_to_exact(reason: str):
+            """The device tier failed (XLA error, debug-nans trip, chaos
+            injection): replan the SAME columnar problem on the host
+            oracle so the eval completes normally, one tier slower —
+            metric + node event, not a failed eval. Safe to re-enter
+            because _materialize mutates no scheduler state before its
+            placement sync point."""
+            from .. import metrics
+
+            logger.warning(
+                "tpu kernel fault (%s); degrading eval %s to exact-np",
+                reason,
+                self.eval.id if self.eval is not None else "?",
+            )
+            metrics.incr("scheduler.kernel_fault_degrade")
+            _count_fallback("kernel_fault")
+            note = getattr(self.planner, "note_kernel_fault", None)
+            if note is not None:
+                note(reason)
+            t_degrade = time.monotonic()
+            placements = run_exact_np()
+            LAST_KERNEL_STATS.update(
+                kernel_s=time.monotonic() - t_degrade,
+                n_nodes=n_real,
+                n_allocs=a_real,
+                mode="exact-np-degraded",
+            )
+            _count_mode("exact-np-degraded")
+            self._materialize(
+                place, placements, nodes, by_dc, planes_list, g_index,
+                gid_real, used0, capacity, g_demand,
+                dev_entries=dev_entries, groups=groups,
+            )
 
         # jax enters only below this line: the exact-np path above is pure
         # numpy, so oracle workers (bench.py spawn-context processes) never
@@ -572,34 +623,37 @@ class TPUBatchScheduler(GenericScheduler):
             from .kernel import RunArgs, plan_batch_runs
 
             t_columnar = time.monotonic()
-            rargs = RunArgs(
-                capacity=jnp.asarray(capacity[perm]),
-                usable=jnp.asarray(usable[perm]),
-                feasible=jnp.asarray(feasible[0][perm]),
-                affinity=jnp.asarray(affinity[0][perm]),
-                affinity_present=jnp.asarray(affinity_present[0][perm]),
-                group_count=jnp.asarray(np.int32(group_count[0])),
-                node_value=jnp.asarray(node_value[0][perm]),
-                spread_desired=jnp.asarray(spread_desired[0]),
-                spread_implicit=jnp.asarray(np.float32(spread_implicit[0])),
-                spread_weight_frac=jnp.asarray(np.float32(spread_weight_frac[0])),
-                spread_even=jnp.asarray(bool(spread_even[0])),
-                spread_active=jnp.asarray(bool(spread_active[0])),
-                perm=jnp.asarray(perm),
-                demand=jnp.asarray(demands[0]),
-                n_allocs=jnp.asarray(np.int32(a_real)),
-            )
-            placements = plan_batch_runs(
-                rargs,
-                (
-                    jnp.asarray(used0[perm]),
-                    jnp.asarray(collisions0[0][perm]),
-                    jnp.asarray(counts0[0]),
-                    jnp.asarray(present0[0]),
-                ),
-                A,
-                bool(spread_even[0]),
-            )
+            try:
+                rargs = RunArgs(
+                    capacity=jnp.asarray(capacity[perm]),
+                    usable=jnp.asarray(usable[perm]),
+                    feasible=jnp.asarray(feasible[0][perm]),
+                    affinity=jnp.asarray(affinity[0][perm]),
+                    affinity_present=jnp.asarray(affinity_present[0][perm]),
+                    group_count=jnp.asarray(np.int32(group_count[0])),
+                    node_value=jnp.asarray(node_value[0][perm]),
+                    spread_desired=jnp.asarray(spread_desired[0]),
+                    spread_implicit=jnp.asarray(np.float32(spread_implicit[0])),
+                    spread_weight_frac=jnp.asarray(np.float32(spread_weight_frac[0])),
+                    spread_even=jnp.asarray(bool(spread_even[0])),
+                    spread_active=jnp.asarray(bool(spread_active[0])),
+                    perm=jnp.asarray(perm),
+                    demand=jnp.asarray(demands[0]),
+                    n_allocs=jnp.asarray(np.int32(a_real)),
+                )
+                placements = plan_batch_runs(
+                    rargs,
+                    (
+                        jnp.asarray(used0[perm]),
+                        jnp.asarray(collisions0[0][perm]),
+                        jnp.asarray(counts0[0]),
+                        jnp.asarray(present0[0]),
+                    ),
+                    A,
+                    bool(spread_even[0]),
+                )
+            except Exception as e:
+                return degrade_to_exact(f"dispatch: {e}")
             LAST_KERNEL_STATS.update(
                 columnar_s=t_columnar - t_start,
                 n_nodes=n_real,
@@ -611,11 +665,14 @@ class TPUBatchScheduler(GenericScheduler):
             _count_mode("runs")
             # dispatch is async: _materialize builds templates/ids while the
             # device runs, then blocks on the placements
-            self._materialize(
-                place, placements, nodes, by_dc, planes_list, g_index,
-                gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
-                dev_entries=dev_entries, groups=groups,
-            )
+            try:
+                self._materialize(
+                    place, placements, nodes, by_dc, planes_list, g_index,
+                    gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+                    dev_entries=dev_entries, groups=groups,
+                )
+            except KernelFault as e:
+                return degrade_to_exact(str(e))
             return
 
         # Rotation-parallel fast path: one group, bounded candidate window,
@@ -631,23 +688,26 @@ class TPUBatchScheduler(GenericScheduler):
             from .kernel import WindowArgs, plan_batch_windowed
 
             t_columnar = time.monotonic()
-            wargs = WindowArgs(
-                capacity=jnp.asarray(capacity),
-                usable=jnp.asarray(usable),
-                feasible=jnp.asarray(feasible[0]),
-                perm=jnp.asarray(perm),
-                demand=jnp.asarray(demands[0]),
-                group_count=jnp.asarray(np.int32(group_count[0])),
-                limit=jnp.asarray(np.int32(limits[0])),
-                n_allocs=jnp.asarray(np.int32(a_real)),
-            )
-            placements = plan_batch_windowed(
-                wargs,
-                jnp.asarray(used0),
-                jnp.asarray(collisions0[0]),
-                n_real,
-                A,
-            )
+            try:
+                wargs = WindowArgs(
+                    capacity=jnp.asarray(capacity),
+                    usable=jnp.asarray(usable),
+                    feasible=jnp.asarray(feasible[0]),
+                    perm=jnp.asarray(perm),
+                    demand=jnp.asarray(demands[0]),
+                    group_count=jnp.asarray(np.int32(group_count[0])),
+                    limit=jnp.asarray(np.int32(limits[0])),
+                    n_allocs=jnp.asarray(np.int32(a_real)),
+                )
+                placements = plan_batch_windowed(
+                    wargs,
+                    jnp.asarray(used0),
+                    jnp.asarray(collisions0[0]),
+                    n_real,
+                    A,
+                )
+            except Exception as e:
+                return degrade_to_exact(f"dispatch: {e}")
             LAST_KERNEL_STATS.update(
                 columnar_s=t_columnar - t_start,
                 n_nodes=n_real,
@@ -657,44 +717,49 @@ class TPUBatchScheduler(GenericScheduler):
                 mode="windowed",
             )
             _count_mode("windowed")
-            self._materialize(
-                place, placements, nodes, by_dc, planes_list, g_index,
-                gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
-                dev_entries=dev_entries, groups=groups,
-            )
+            try:
+                self._materialize(
+                    place, placements, nodes, by_dc, planes_list, g_index,
+                    gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+                    dev_entries=dev_entries, groups=groups,
+                )
+            except KernelFault as e:
+                return degrade_to_exact(str(e))
             return
 
-        args = BatchArgs(
-            capacity=jnp.asarray(capacity),
-            usable=jnp.asarray(usable),
-            feasible=jnp.asarray(feasible),
-            affinity=jnp.asarray(affinity),
-            affinity_present=jnp.asarray(affinity_present),
-            group_count=jnp.asarray(group_count),
-            group_eval=jnp.zeros(G, dtype=np.int32),
-            node_value=jnp.asarray(node_value),
-            spread_desired=jnp.asarray(spread_desired),
-            spread_implicit=jnp.asarray(spread_implicit),
-            spread_weight_frac=jnp.asarray(spread_weight_frac),
-            spread_even=jnp.asarray(spread_even),
-            spread_active=jnp.asarray(spread_active),
-            perm=jnp.asarray(perm[None, :]),
-            ring=jnp.asarray(np.array([n_real], dtype=np.int32)),
-            demands=jnp.asarray(demands),
-            groups=jnp.asarray(group_ids),
-            limits=jnp.asarray(limits),
-            valid=jnp.asarray(valid),
-        )
-        init = BatchState(
-            used=jnp.asarray(used0),
-            collisions=jnp.asarray(collisions0),
-            spread_counts=jnp.asarray(counts0),
-            spread_present=jnp.asarray(present0),
-            offset=jnp.zeros(1, dtype=np.int32),
-        )
-
         t_columnar = time.monotonic()
-        _, placements = plan_batch(args, init, n_real)
+        try:
+            args = BatchArgs(
+                capacity=jnp.asarray(capacity),
+                usable=jnp.asarray(usable),
+                feasible=jnp.asarray(feasible),
+                affinity=jnp.asarray(affinity),
+                affinity_present=jnp.asarray(affinity_present),
+                group_count=jnp.asarray(group_count),
+                group_eval=jnp.zeros(G, dtype=np.int32),
+                node_value=jnp.asarray(node_value),
+                spread_desired=jnp.asarray(spread_desired),
+                spread_implicit=jnp.asarray(spread_implicit),
+                spread_weight_frac=jnp.asarray(spread_weight_frac),
+                spread_even=jnp.asarray(spread_even),
+                spread_active=jnp.asarray(spread_active),
+                perm=jnp.asarray(perm[None, :]),
+                ring=jnp.asarray(np.array([n_real], dtype=np.int32)),
+                demands=jnp.asarray(demands),
+                groups=jnp.asarray(group_ids),
+                limits=jnp.asarray(limits),
+                valid=jnp.asarray(valid),
+            )
+            init = BatchState(
+                used=jnp.asarray(used0),
+                collisions=jnp.asarray(collisions0),
+                spread_counts=jnp.asarray(counts0),
+                spread_present=jnp.asarray(present0),
+                offset=jnp.zeros(1, dtype=np.int32),
+            )
+            _, placements = plan_batch(args, init, n_real)
+        except Exception as e:
+            return degrade_to_exact(f"dispatch: {e}")
         LAST_KERNEL_STATS.update(
             columnar_s=t_columnar - t_start,
             n_nodes=n_real,
@@ -704,11 +769,14 @@ class TPUBatchScheduler(GenericScheduler):
             mode="exact-scan",
         )
         _count_mode("exact-scan")
-        self._materialize(
-            place, placements, nodes, by_dc, planes_list, g_index,
-            gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
-            dev_entries=dev_entries, groups=groups,
-        )
+        try:
+            self._materialize(
+                place, placements, nodes, by_dc, planes_list, g_index,
+                gid_real, used0, capacity, g_demand, t_dispatch=t_columnar,
+                dev_entries=dev_entries, groups=groups,
+            )
+        except KernelFault as e:
+            return degrade_to_exact(str(e))
 
     # ------------------------------------------------------------------
     def _failed_group_metric(
@@ -912,7 +980,13 @@ class TPUBatchScheduler(GenericScheduler):
         )
         ids = generate_uuids(len(place))
 
-        placements = np.asarray(placements)
+        # the device sync point: an async XLA failure (device error, NaN
+        # trip) surfaces here, BEFORE any scheduler state is mutated — so
+        # the degrade path can safely replan from scratch
+        try:
+            placements = np.asarray(placements)
+        except Exception as e:
+            raise KernelFault(f"device sync: {e}") from e
         if t_dispatch is not None:
             LAST_KERNEL_STATS["kernel_s"] = time.monotonic() - t_dispatch
 

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from . import enable_compile_cache
+from ..testing import faults as _faults
 
 # must precede every jit compile; this module is the jax entry point for
 # the whole scheduler tier (batch_sched/drain/system_sched import it)
@@ -290,8 +291,7 @@ def _step(n_real: int, args: BatchArgs, state: BatchState, alloc):
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
-def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
-    """Run the placement scan; returns (final_state, node index per alloc or -1)."""
+def _plan_batch_jit(args: BatchArgs, init: BatchState, n_real: int):
     def step(state, alloc):
         return _step(n_real, args, state, alloc)
 
@@ -301,6 +301,15 @@ def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
         (args.demands, args.groups, args.limits, args.valid),
     )
     return final_state, placements
+
+
+def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
+    """Run the placement scan; returns (final_state, node index per alloc
+    or -1). The ``tpu.kernel`` fault point models device errors / NaN
+    trips (jax debug-nans raises at dispatch) — the scheduler degrades to
+    the exact-np host oracle when this raises."""
+    _faults.fault_point("tpu.kernel")
+    return _plan_batch_jit(args, init, n_real)
 
 
 # ---------------------------------------------------------------------------
@@ -398,7 +407,6 @@ def _run_class_boosts(args: RunArgs, counts, present):
 RUNCAP = 512  # max placements resolved by a single fill run
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
 def plan_batch_runs(
     args: RunArgs,
     init,
@@ -407,6 +415,17 @@ def plan_batch_runs(
 ):
     """Place ``n_allocs`` identical asks under full-ring (limit=∞) selection;
     returns node index per alloc slot (length ``a_pad``, -1 = unplaced)."""
+    _faults.fault_point("tpu.kernel")
+    return _plan_batch_runs_jit(args, init, a_pad, even_mode)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _plan_batch_runs_jit(
+    args: RunArgs,
+    init,
+    a_pad: int,
+    even_mode: bool = False,
+):
     n_pad = args.capacity.shape[0]
     used0, coll0, counts0, present0 = init
     V = counts0.shape[0]
@@ -609,13 +628,21 @@ class WindowArgs(NamedTuple):
     n_allocs: jax.Array  # i32 scalar
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
 def plan_batch_windowed(
     args: WindowArgs, used0: jax.Array, collisions0: jax.Array,
     n_real: int, a_pad: int
 ):
     """Place ``n_allocs`` identical asks; returns node index per alloc slot
     (length ``a_pad``, -1 = unplaced)."""
+    _faults.fault_point("tpu.kernel")
+    return _plan_batch_windowed_jit(args, used0, collisions0, n_real, a_pad)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _plan_batch_windowed_jit(
+    args: WindowArgs, used0: jax.Array, collisions0: jax.Array,
+    n_real: int, a_pad: int
+):
     n_pad = args.capacity.shape[0]
     positions = jnp.arange(n_pad)
     in_ring = positions < n_real

@@ -30,14 +30,16 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
     import numpy as np
     import jax.numpy as jnp
 
+    # the jitted internals: warmup needs .lower() for AOT compilation,
+    # which the fault-gated public wrappers don't carry
     from .kernel import (
         BatchArgs,
         BatchState,
         RunArgs,
         WindowArgs,
-        plan_batch,
-        plan_batch_runs,
-        plan_batch_windowed,
+        _plan_batch_jit as plan_batch,
+        _plan_batch_runs_jit as plan_batch_runs,
+        _plan_batch_windowed_jit as plan_batch_windowed,
     )
 
     compiled = 0

@@ -183,6 +183,26 @@ class TestDockerTaskConfigSpec:
                 {"image": "x", "devices": [{"container_path": "/dev/x"}]}
             )
 
+    def test_namespace_and_address_keys_validate(self):
+        """Keys start_task consumes must validate (regression: the spec
+        omitted them, so previously-valid jobs using static container IPs
+        or host namespaces were rejected with 'unknown config key')."""
+        drv = DockerDriver.__new__(DockerDriver)
+        out = drv.validate_task_config({
+            "image": "redis:7",
+            "network_mode": "bridge",
+            "ipv4_address": "172.18.0.10",
+            "ipv6_address": "2001:db8::10",
+            "pid_mode": "host",
+            "ipc_mode": "host",
+            "uts_mode": "host",
+            "userns_mode": "host",
+        })
+        assert out["ipv4_address"] == "172.18.0.10"
+        assert out["userns_mode"] == "host"
+        with pytest.raises(RuntimeError, match=r"pid_mode: must be string"):
+            drv.validate_task_config({"image": "x", "pid_mode": 1})
+
     def test_typo_key_rejected_with_path(self):
         drv = DockerDriver.__new__(DockerDriver)
         with pytest.raises(RuntimeError, match="imge: unknown config key"):
