@@ -346,8 +346,97 @@ class ApiClient:
         params = {"node_id": node_id} if node_id else {}
         return self.get("/v1/client/stats", **params)[0]
 
+    def event_stream(
+        self,
+        topics=None,
+        index: int = 0,
+        namespace: Optional[str] = None,
+        heartbeat: Optional[float] = None,
+    ) -> "EventStream":
+        """Subscribe to /v1/event/stream (ref api/event.go EventStream):
+        returns an iterator of frame dicts. ``topics`` is a list of
+        "Topic" / "Topic:key" specs (default: all topics); ``index=N``
+        resumes after raft index N (pass the last index you received).
+        Heartbeat frames are filtered out; lost-gap and error frames are
+        yielded so callers see drops explicitly."""
+        params: list = [("topic", t) for t in (topics or [])]
+        if index:
+            params.append(("index", str(index)))
+        # unlike every other endpoint the server-side default here is the
+        # wildcard, so "default" must travel explicitly — omitting it
+        # would silently widen the stream to every namespace
+        ns = namespace if namespace is not None else self.namespace
+        if ns:
+            params.append(("namespace", ns))
+        if heartbeat is not None:
+            params.append(("heartbeat", str(heartbeat)))
+        url = self.address + "/v1/event/stream"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="GET")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
+        try:
+            resp = urllib.request.urlopen(req, timeout=330)
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise APIError(e.code, message) from e
+        return EventStream(resp)
+
     def alloc_stats(self, alloc_id: str) -> dict:
         return self.get(f"/v1/client/allocation/{_q(alloc_id)}/stats")[0]
+
+
+class EventStream:
+    """Iterator over /v1/event/stream frames: yields dicts shaped
+    {"Index": N, "Events": [...]}, {"LostGap": True, "Index": N}, or
+    {"Error": msg, "ResumeIndex": N}; heartbeat frames are skipped.
+    Tracks ``last_index`` so a severed consumer can reconnect with
+    ``client.event_stream(index=stream.last_index)`` for exactly-once
+    resumption."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self.last_index = 0
+        self.closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        while True:
+            try:
+                line = self._resp.readline()
+            except (OSError, ValueError, AttributeError):
+                # AttributeError: close() from another thread mid-read
+                # nulls http.client's buffered fp
+                self.close()
+                raise StopIteration
+            if not line:
+                self.close()
+                raise StopIteration
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                frame = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not frame:
+                continue  # heartbeat
+            if frame.get("Index") and frame.get("Events"):
+                self.last_index = max(self.last_index, int(frame["Index"]))
+            return frame
+
+    def close(self):
+        self.closed = True
+        try:
+            self._resp.close()
+        except OSError:
+            pass
 
 
 class ExecWsSession:

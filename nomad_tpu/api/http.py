@@ -133,6 +133,22 @@ class HTTPServer:
                     self.wfile.write(data)
                     return
                 query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                # cluster event stream: long-lived push connection, served
+                # outside the request/response route table (ref
+                # command/agent/event_endpoint.go). Handles both chunked
+                # HTTP and a websocket upgrade on the same path.
+                if method == "GET" and parsed.path == "/v1/event/stream":
+                    self.close_connection = True
+                    try:
+                        api._serve_event_stream(self, parsed, query)
+                    except OSError:
+                        pass
+                    except Exception as e:
+                        try:
+                            self._respond(500, {"error": str(e)}, None)
+                        except OSError:
+                            pass
+                    return
                 # websocket upgrade: the interactive exec surface
                 # (ref command/agent/alloc_endpoint.go execStream)
                 if (
@@ -1205,6 +1221,11 @@ class HTTPServer:
         payload = {
             "broker": self.server.eval_broker.stats(),
             "blocked_evals": self.server.blocked_evals.stats(),
+            "event_broker": (
+                self.server.event_broker.stats()
+                if self.server.event_broker is not None
+                else {}
+            ),
             "plan_queue_depth": self.server.planner.queue.depth(),
             "state_index": self.server.state.latest_index(),
             # per-stage timers + counters (the go-metrics MeasureSince role)
@@ -1642,6 +1663,184 @@ class HTTPServer:
         return self._forward_client_fs(
             m["alloc_id"], "ClientAllocations.Stats", {}
         ), None
+
+    # -- cluster event stream (ref command/agent/event_endpoint.go +
+    # nomad/stream/): newline-delimited JSON frames over chunked HTTP or
+    # the same frames over a websocket upgrade. Frames:
+    #   {"Index": N, "Events": [...]}    — one raft apply's events
+    #   {}                               — heartbeat (idle keep-alive)
+    #   {"LostGap": true, "Index": N}    — ring overwrote events ≤ N
+    #   {"Error": msg, "ResumeIndex": N} — closed (slow consumer /
+    #                                      restore / shutdown); reconnect
+    #                                      with index=N
+    # --------------------------------------------------------------------
+    EVENT_STREAM_HEARTBEAT = 10.0
+
+    def _serve_event_stream(self, handler, parsed, query):
+        from ..events import ALL_TOPICS, required_capability
+
+        broker = getattr(self.server, "event_broker", None)
+        if broker is None:
+            handler._respond(
+                400, {"error": "event broker is disabled on this agent"}, None
+            )
+            return
+        topics: dict[str, set] = {}
+        # parse_qs already percent-decoded each spec; a second unquote
+        # would corrupt keys legitimately containing %xx sequences
+        for spec in parse_qs(parsed.query).get("topic", []) or ["*"]:
+            topic, _, key = spec.partition(":")
+            if topic != "*" and topic not in ALL_TOPICS:
+                handler._respond(
+                    400, {"error": f"unknown event topic {topic!r}"}, None
+                )
+                return
+            topics.setdefault(topic, set()).add(key or "*")
+        try:
+            from_index = int(query.get("index", 0))
+        except ValueError:
+            handler._respond(400, {"error": "index must be an integer"}, None)
+            return
+        heartbeat = self.EVENT_STREAM_HEARTBEAT
+        if query.get("heartbeat"):
+            try:
+                heartbeat = float(query["heartbeat"])
+            except ValueError:
+                try:
+                    heartbeat = parse_duration(query["heartbeat"]) / 1e9
+                except Exception:
+                    handler._respond(
+                        400,
+                        {"error": f"bad heartbeat {query['heartbeat']!r}"},
+                        None,
+                    )
+                    return
+        # a non-positive heartbeat would turn the frame loop into a
+        # client-controlled busy-spin on a server thread
+        heartbeat = max(heartbeat, 0.1)
+        # the stream spans all namespaces the token can read unless the
+        # caller narrows it; the subscribe-time gate below must evaluate
+        # against the SAME scope the subscription will cover, so the
+        # wildcard is the shared default (per-event filtering still
+        # re-checks each event's own namespace at delivery)
+        namespace = query.get("namespace", "*")
+        query["namespace"] = namespace
+        acl_obj = None
+        if self.server is not None and self.server.acl_enabled():
+            # browsers can't set headers on EventSource/ws dials; accept
+            # the token as a query param too (same rule as the exec ws)
+            secret = handler.headers.get("X-Nomad-Token", "") or query.get(
+                "token", ""
+            )
+            try:
+                acl_obj = self.server.resolve_token(secret)
+            except PermissionError as e:
+                handler._respond(403, {"error": str(e)}, None)
+                return
+            # subscribe-time gate per requested topic; each delivered
+            # event is re-filtered against ITS namespace. The wildcard
+            # topic spans node-scoped + namespaced events, so it needs
+            # the union of both capabilities.
+            for topic in topics:
+                wanted = ALL_TOPICS if topic == "*" else (topic,)
+                for t in wanted:
+                    if not _acl_allows(
+                        acl_obj, required_capability(t), query
+                    ):
+                        handler._respond(
+                            403, {"error": "Permission denied"}, None
+                        )
+                        return
+        sub = broker.subscribe(
+            topics,
+            from_index=from_index,
+            acl=acl_obj,
+            namespace=namespace,
+        )
+        try:
+            if "websocket" in handler.headers.get("Upgrade", "").lower():
+                self._event_stream_ws(handler, sub, heartbeat)
+            else:
+                self._event_stream_chunked(handler, sub, heartbeat)
+        finally:
+            sub.close()
+
+    @staticmethod
+    def _event_frames(sub, heartbeat):
+        """Shared frame loop: yields JSON-able frame dicts until the
+        subscription closes (the final Error frame is yielded too)."""
+        from ..events import SubscriptionClosedError
+
+        while True:
+            try:
+                frame = sub.next(timeout=heartbeat)
+            except SubscriptionClosedError as e:
+                yield {"Error": e.reason, "ResumeIndex": e.resume_index}
+                return
+            if frame is None:
+                yield {}  # heartbeat: keeps the connection visibly live
+                continue
+            index, events = frame
+            if events is None:
+                yield {"LostGap": True, "Index": index}
+            else:
+                yield {
+                    "Index": index,
+                    "Events": [e.to_dict() for e in events],
+                }
+
+    def _event_stream_chunked(self, handler, sub, heartbeat):
+        wfile = handler.wfile
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.send_header(
+            "X-Nomad-Index", str(self.server.state.latest_index())
+        )
+        handler.end_headers()
+        try:
+            for doc in self._event_frames(sub, heartbeat):
+                data = json.dumps(doc).encode() + b"\n"
+                wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                wfile.flush()
+                if "Error" in doc:
+                    break
+            wfile.write(b"0\r\n\r\n")
+            wfile.flush()
+        except OSError:
+            pass  # client went away; the subscription closes in the caller
+
+    def _event_stream_ws(self, handler, sub, heartbeat):
+        import threading as threading_mod
+
+        from . import ws as ws_mod
+
+        sock = ws_mod.server_handshake(handler)
+
+        def reader():
+            # drain client frames (answers pings inside read_message);
+            # a close/EOF tears the subscription down so the send loop
+            # exits at its next frame instead of writing into a dead pipe
+            try:
+                while True:
+                    ws_mod.read_message(sock)
+            except (ws_mod.WsClosed, OSError):
+                pass
+            finally:
+                sub.close()
+
+        threading_mod.Thread(
+            target=reader, daemon=True, name="event-stream-ws-reader"
+        ).start()
+        try:
+            for doc in self._event_frames(sub, heartbeat):
+                ws_mod.send_message(sock, json.dumps(doc))
+                if "Error" in doc:
+                    break
+        except OSError:
+            pass
+        finally:
+            ws_mod.send_close(sock)
 
     # -- acl (ref acl_endpoint.go + command/agent/acl_endpoint.go) -------
     @route("PUT", r"/v1/acl/bootstrap", acl="anonymous")

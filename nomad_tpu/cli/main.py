@@ -1066,6 +1066,87 @@ def cmd_monitor(args):
         return 0
 
 
+def cmd_event_stream(args):
+    """Follow the cluster event stream (ref command/event/stream.go
+    `nomad event stream`): one JSON object per line, or a compact
+    human-readable line with -short."""
+    import time as time_mod
+
+    client = _client(args)
+    index = args.index or 0
+    delay = 1.0
+    while True:
+        try:
+            stream = client.event_stream(
+                topics=args.topic or None,
+                index=index,
+                namespace=args.namespace,
+            )
+        except KeyboardInterrupt:
+            return 0
+        except Exception as e:
+            # connection refused / reset: exactly what -reconnect is for
+            if not args.reconnect:
+                raise
+            print(
+                f"stream dial failed: {e}; retrying in {delay:.0f}s",
+                file=sys.stderr,
+            )
+            try:
+                time_mod.sleep(delay)
+            except KeyboardInterrupt:
+                return 0
+            delay = min(delay * 2, 15.0)
+            continue
+        delay = 1.0
+        try:
+            for frame in stream:
+                if frame.get("Error"):
+                    # resume from OUR last consumed index (exactly-once);
+                    # the server's ResumeIndex is only a floor for a
+                    # consumer that never received anything — resuming
+                    # below it would just re-print delivered events
+                    index = (
+                        stream.last_index
+                        or frame.get("ResumeIndex", 0)
+                        or index
+                    )
+                    print(
+                        f"stream closed: {frame['Error']} "
+                        f"(resuming from index {index})",
+                        file=sys.stderr,
+                    )
+                    break
+                if frame.get("LostGap"):
+                    print(
+                        f"[gap] events through index {frame.get('Index', 0)} "
+                        "were dropped before this subscriber read them",
+                        file=sys.stderr,
+                    )
+                    continue
+                if args.short:
+                    for e in frame.get("Events", []):
+                        key = e.get("Key", "")
+                        print(
+                            f"{e.get('Index', 0):>8}  "
+                            f"{e.get('Topic', ''):<11} "
+                            f"{e.get('Type', ''):<28} {key[:36]}"
+                        )
+                else:
+                    print(json.dumps(frame))
+                sys.stdout.flush()
+                index = stream.last_index or index
+        except KeyboardInterrupt:
+            stream.close()
+            return 0
+        if not args.reconnect:
+            return 0
+        try:
+            time_mod.sleep(1.0)  # never hot-loop re-dials on instant closes
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_status(args):
     """Generic prefix dispatch (ref command/status.go): search all
     contexts and show the best match."""
@@ -1371,6 +1452,29 @@ def build_parser() -> argparse.ArgumentParser:
     srecsub = srec.add_subparsers(dest="reconcile_cmd")
     srs = srecsub.add_parser("summaries")
     srs.set_defaults(fn=cmd_system_reconcile)
+
+    event = sub.add_parser("event", help="cluster event stream")
+    evsub = event.add_subparsers(dest="subcommand")
+    evs = evsub.add_parser(
+        "stream", help="follow /v1/event/stream (NDJSON frames)"
+    )
+    evs.add_argument(
+        "-topic", action="append",
+        help='topic filter, "Topic" or "Topic:key" (repeatable; default all)',
+    )
+    evs.add_argument(
+        "-index", type=int, default=0,
+        help="resume after this raft index (exclusive)",
+    )
+    evs.add_argument(
+        "-short", action="store_true",
+        help="one compact line per event instead of raw JSON frames",
+    )
+    evs.add_argument(
+        "-reconnect", action="store_true",
+        help="auto-reconnect from the last index when the stream closes",
+    )
+    evs.set_defaults(fn=cmd_event_stream)
 
     mon = sub.add_parser("monitor", help="stream agent logs")
     mon.add_argument("-log-level", "--log-level", dest="log_level")

@@ -18,6 +18,10 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     "client": {"enabled": False, "servers": []},
     "acl": {"enabled": False},
     "gossip": {},
+    # telemetry-style stanza for the cluster event stream (events/):
+    # event_broker { enabled = true  event_buffer_size = 4096
+    #                subscriber_buffer = 1024 }
+    "event_broker": {},
 }
 
 
@@ -77,6 +81,8 @@ def server_config_from_agent(config: dict) -> dict:
         "region": config.get("region", "global"),
         "acl": dict(config.get("acl", {})),
     }
+    if config.get("event_broker"):
+        out["event_broker"] = dict(config["event_broker"])
     if config.get("gossip"):
         out["gossip"] = dict(config["gossip"])
         out["bootstrap"] = bool(server.get("bootstrap_expect", 1) <= 1)

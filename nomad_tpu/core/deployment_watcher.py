@@ -93,31 +93,77 @@ class DeploymentWatcher:
     def _run(self):
         state = self.server.state
         min_index = 0
+        sub = self._subscribe(state.latest_index())
         self._arm_deadlines()
-        while not self._stop.is_set():
-            d = state.deployment_by_id(self.deployment_id)
-            if d is None or not d.active():
-                break
-            try:
-                if self._tick(d):
+        try:
+            while not self._stop.is_set():
+                d = state.deployment_by_id(self.deployment_id)
+                if d is None or not d.active():
                     break
-            except Exception:
-                logger.exception(
-                    "deployment watcher %s tick failed", self.deployment_id[:8]
-                )
-            # Wake on deployment/alloc change or at the next deadline edge
-            timeout = self._next_deadline_wait()
-
-            def query(snap):
-                return (
-                    snap.table_index("deployment"),
-                    snap.table_index("allocs"),
-                )
-
-            _, min_index = state.blocking_query(
-                query, min_index=min_index, timeout=timeout
-            )
+                try:
+                    if self._tick(d):
+                        break
+                except Exception:
+                    logger.exception(
+                        "deployment watcher %s tick failed",
+                        self.deployment_id[:8],
+                    )
+                # Wake on a deployment/alloc event (push) or at the next
+                # deadline edge; polls the MVCC store only when no event
+                # broker is configured
+                timeout = self._next_deadline_wait()
+                if sub is not None:
+                    sub = self._wait_event(sub, timeout)
+                else:
+                    min_index = self._wait_blocking(state, min_index, timeout)
+        finally:
+            if sub is not None:
+                sub.close()
         self.parent._watcher_done(self.deployment_id, self)
+
+    def _subscribe(self, from_index: int):
+        """Push path: this deployment's Deployment events plus Alloc
+        events carrying its id as a filter key (placements, client
+        health updates) — no store polling while the rollout is idle."""
+        broker = getattr(self.server, "event_broker", None)
+        if broker is None:
+            return None
+        from ..events import TOPIC_ALLOC, TOPIC_DEPLOYMENT
+
+        return broker.subscribe(
+            {
+                TOPIC_DEPLOYMENT: {self.deployment_id},
+                TOPIC_ALLOC: {self.deployment_id},
+            },
+            from_index=from_index,
+        )
+
+    def _wait_event(self, sub, timeout: float):
+        from ..events import SubscriptionClosedError
+
+        try:
+            if sub.next(timeout=timeout) is not None:
+                # coalesce the burst: one tick per batch of queued
+                # frames, not one full state re-read per frame
+                while sub.next(timeout=0) is not None:
+                    pass
+            return sub
+        except SubscriptionClosedError:
+            # broker reset (restore) or backpressure close: the next tick
+            # re-reads state anyway, so just re-subscribe from now
+            return self._subscribe(self.server.state.latest_index())
+
+    def _wait_blocking(self, state, min_index: int, timeout: float) -> int:
+        def query(snap):
+            return (
+                snap.table_index("deployment"),
+                snap.table_index("allocs"),
+            )
+
+        _, min_index = state.blocking_query(
+            query, min_index=min_index, timeout=timeout
+        )
+        return min_index
 
     def _arm_deadlines(self):
         d = self.server.state.deployment_by_id(self.deployment_id)
@@ -284,7 +330,8 @@ class DeploymentsWatcher:
                 )
                 self._thread.start()
             else:
-                # the manager loop notices within its 2s poll window
+                # the manager loop notices at its next wake (≤10s push
+                # path, ≤2s blocking-query fallback)
                 for w in self._watchers.values():
                     w.stop()
                 self._watchers.clear()
@@ -293,30 +340,65 @@ class DeploymentsWatcher:
         state = self.server.state
         min_index = 0
         me = threading.current_thread()
-        while True:
-            with self._lock:
-                # exit if disabled OR superseded by a newer manager thread
-                # (leadership flap inside the 2s blocking-query window)
-                if not self._enabled or self._thread is not me:
-                    return
-                active = {
-                    d.id
-                    for d in state.deployments()
-                    if d.status in (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
-                }
-                for did in active - set(self._watchers):
-                    w = DeploymentWatcher(self, did)
-                    self._watchers[did] = w
-                    w.start()
-                for did in set(self._watchers) - active:
-                    self._watchers.pop(did).stop()
+        # push path: new/terminal deployments announce themselves on the
+        # event stream, so the manager wakes on Deployment events instead
+        # of re-running a blocking query that fires on EVERY state write;
+        # the 10s timeout is only a fallback rescan + disable-notice bound
+        # (ref deployments_watcher.go watchDeployments — the reference
+        # made the same poll→push switch in 1.0)
+        broker = getattr(self.server, "event_broker", None)
+        sub = None
+        if broker is not None:
+            from ..events import TOPIC_DEPLOYMENT
 
-            def query(snap):
-                return snap.table_index("deployment")
-
-            _, min_index = state.blocking_query(
-                query, min_index=min_index, timeout=2.0
+            # from latest: the first loop iteration scans state anyway,
+            # so replaying the ring's history would only re-wake the scan
+            sub = broker.subscribe(
+                {TOPIC_DEPLOYMENT: {"*"}}, from_index=state.latest_index()
             )
+        try:
+            while True:
+                with self._lock:
+                    # exit if disabled OR superseded by a newer manager
+                    # thread (leadership flap inside the wait window)
+                    if not self._enabled or self._thread is not me:
+                        return
+                    active = {
+                        d.id
+                        for d in state.deployments()
+                        if d.status in (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
+                    }
+                    for did in active - set(self._watchers):
+                        w = DeploymentWatcher(self, did)
+                        self._watchers[did] = w
+                        w.start()
+                    for did in set(self._watchers) - active:
+                        self._watchers.pop(did).stop()
+
+                if sub is not None:
+                    from ..events import SubscriptionClosedError
+
+                    try:
+                        if sub.next(timeout=10.0) is not None:
+                            # one rescan per burst of deployment events
+                            while sub.next(timeout=0) is not None:
+                                pass
+                    except SubscriptionClosedError:
+                        sub = broker.subscribe(
+                            {TOPIC_DEPLOYMENT: {"*"}},
+                            from_index=state.latest_index(),
+                        )
+                    continue
+
+                def query(snap):
+                    return snap.table_index("deployment")
+
+                _, min_index = state.blocking_query(
+                    query, min_index=min_index, timeout=2.0
+                )
+        finally:
+            if sub is not None:
+                sub.close()
 
     def _watcher_done(self, deployment_id: str, watcher: "DeploymentWatcher"):
         with self._lock:

@@ -84,12 +84,18 @@ class FSM:
         blocked_evals=None,
         periodic_dispatcher=None,
         time_table=None,
+        event_broker=None,
     ):
         self.state = state if state is not None else StateStore()
         self.eval_broker = eval_broker
         self.blocked_evals = blocked_evals
         self.periodic_dispatcher = periodic_dispatcher
         self.time_table = time_table
+        #: cluster event stream source (events/broker.py): every apply
+        #: derives typed events tagged with its raft index — on every
+        #: server, so followers serve /v1/event/stream too (ref
+        #: nomad/state/events.go eventsFromChanges)
+        self.event_broker = event_broker
         self._appliers: dict[str, Callable[[int, dict], Any]] = {
             NODE_REGISTER: self._apply_node_register,
             NODE_DEREGISTER: self._apply_node_deregister,
@@ -138,7 +144,50 @@ class FSM:
         if self.time_table is not None:
             # witness index→time for GC age thresholds (fsm.go:258)
             self.time_table.witness(index)
-        return applier(index, payload)
+        pre = None
+        if self.event_broker is not None and msg_type in (
+            DEPLOYMENT_DELETE, EVAL_DELETE,
+        ):
+            # deletions derive their events from objects that no longer
+            # exist post-apply: capture them first so the events carry
+            # the real namespace instead of a guessed 'default'
+            pre = self._capture_pre_delete(msg_type, payload)
+        resp = applier(index, payload)
+        if self.event_broker is not None and msg_type in (
+            ACL_POLICY_UPSERT, ACL_POLICY_DELETE,
+            ACL_TOKEN_UPSERT, ACL_TOKEN_DELETE,
+        ):
+            # capabilities may have shrunk: token-backed stream
+            # subscriptions must re-resolve, not keep old grants
+            self.event_broker.acl_changed()
+        if self.event_broker is not None:
+            # events derive AFTER the applier so lookups see post-apply
+            # state; a derivation bug must never stall replication
+            try:
+                events = derive_events(
+                    self.state, index, msg_type, payload, pre=pre
+                )
+                if events:
+                    self.event_broker.publish(index, events)
+            except Exception:
+                logger.exception(
+                    "fsm: event derivation failed for %r at index %d",
+                    msg_type, index,
+                )
+        return resp
+
+    def _capture_pre_delete(self, msg_type: str, payload: dict) -> dict:
+        """The soon-to-be-deleted objects, keyed by id (event derivation
+        needs their namespace/job after the applier removed them)."""
+        if msg_type == DEPLOYMENT_DELETE:
+            return {
+                did: self.state.deployment_by_id(did)
+                for did in payload.get("deployment_ids") or []
+            }
+        return {
+            eid: self.state.eval_by_id(eid)
+            for eid in payload.get("eval_ids") or []
+        }
 
     # ------------------------------------------------------------------
     # snapshot / restore (ref fsm.go:1059,1073)
@@ -148,6 +197,11 @@ class FSM:
 
     def restore(self, data: dict):
         self.state.restore(data)
+        if self.event_broker is not None:
+            # the event ring is re-derivable, never snapshotted: reset it
+            # to the restored index so resuming subscribers observe an
+            # explicit gap instead of silently missing the history
+            self.event_broker.reset(self.state.latest_index())
 
     # ------------------------------------------------------------------
     # node appliers (ref fsm.go applyUpsertNode / applyDeregisterNode /
@@ -559,3 +613,435 @@ class FSM:
         if hasattr(self.state, "delete_acl_tokens"):
             self.state.delete_acl_tokens(index, payload["accessors"])
         return index
+
+
+# ----------------------------------------------------------------------
+# Event derivation (ref nomad/state/events.go eventsFromChanges: each
+# applied message type maps to typed events tagged with its raft index).
+# Module-level and pure-ish (reads post-apply state for lookups only) so
+# the mapping is testable without a full FSM.
+# ----------------------------------------------------------------------
+
+def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
+    """Canonical slim alloc doc from post-apply state (client updates
+    ship only client-owned fields, so the payload alone can't provide
+    job/deployment filter keys); falls back to the payload doc when the
+    alloc is already GC'd."""
+    stored = state.alloc_by_id(alloc_id)
+    if stored is None:
+        return dict(fallback or {}, id=alloc_id)
+    return {
+        "id": stored.id,
+        "namespace": stored.namespace,
+        "job_id": stored.job_id,
+        "node_id": stored.node_id,
+        "task_group": stored.task_group,
+        "desired_status": stored.desired_status,
+        "client_status": stored.client_status,
+        "eval_id": stored.eval_id,
+        "deployment_id": stored.deployment_id,
+    }
+
+
+def _alloc_event(index: int, doc: dict, event_type: str) -> "Event":
+    from ..events import TOPIC_ALLOC, Event
+
+    filter_keys = tuple(
+        k for k in (
+            doc.get("job_id"), doc.get("node_id"),
+            doc.get("eval_id"), doc.get("deployment_id"),
+        ) if k
+    )
+    return Event(
+        topic=TOPIC_ALLOC,
+        type=event_type,
+        key=doc.get("id", ""),
+        index=index,
+        namespace=doc.get("namespace", "default"),
+        payload={
+            "ID": doc.get("id", ""),
+            "JobID": doc.get("job_id", ""),
+            "NodeID": doc.get("node_id", ""),
+            "TaskGroup": doc.get("task_group", ""),
+            "DesiredStatus": doc.get("desired_status", ""),
+            "ClientStatus": doc.get("client_status", ""),
+            "DeploymentID": doc.get("deployment_id", ""),
+        },
+        filter_keys=filter_keys,
+    )
+
+
+def _eval_events(index: int, evals: list, event_type: str = "EvalUpdated"):
+    from ..events import TOPIC_EVAL, Event
+
+    out = []
+    for doc in evals or []:
+        out.append(
+            Event(
+                topic=TOPIC_EVAL,
+                type=event_type,
+                key=doc.get("id", ""),
+                index=index,
+                namespace=doc.get("namespace", "default"),
+                payload={
+                    "ID": doc.get("id", ""),
+                    "JobID": doc.get("job_id", ""),
+                    "Status": doc.get("status", ""),
+                    "Type": doc.get("type", ""),
+                    "TriggeredBy": doc.get("triggered_by", ""),
+                    "DeploymentID": doc.get("deployment_id", ""),
+                },
+                filter_keys=tuple(
+                    k for k in (doc.get("job_id"), doc.get("deployment_id"))
+                    if k
+                ),
+            )
+        )
+    return out
+
+
+def _node_event(index: int, node_id: str, event_type: str, payload: dict):
+    from ..events import TOPIC_NODE, Event
+
+    return Event(
+        topic=TOPIC_NODE,
+        type=event_type,
+        key=node_id,
+        index=index,
+        payload=dict(payload, ID=node_id),
+    )
+
+
+def _deployment_event(
+    state, index: int, deployment_id: str, event_type: str, payload: dict,
+    deployment=None,
+):
+    from ..events import TOPIC_DEPLOYMENT, Event
+
+    d = deployment if deployment is not None else state.deployment_by_id(
+        deployment_id
+    )
+    return Event(
+        topic=TOPIC_DEPLOYMENT,
+        type=event_type,
+        key=deployment_id,
+        index=index,
+        namespace=d.namespace if d is not None else "default",
+        payload=dict(
+            payload,
+            ID=deployment_id,
+            JobID=d.job_id if d is not None else "",
+            Status=d.status if d is not None else "",
+        ),
+        filter_keys=(d.job_id,) if d is not None and d.job_id else (),
+    )
+
+
+def _job_event(index: int, namespace: str, job_id: str, event_type: str,
+               payload: Optional[dict] = None):
+    from ..events import TOPIC_JOB, Event
+
+    return Event(
+        topic=TOPIC_JOB,
+        type=event_type,
+        key=job_id,
+        index=index,
+        namespace=namespace or "default",
+        payload=dict(payload or {}, ID=job_id, Namespace=namespace),
+    )
+
+
+def _job_registered_event(state, index: int, job_doc: dict):
+    """The registered-job event, versioned from POST-apply state: the
+    store assigns the version during apply (existing.version + 1), so the
+    raft payload's own version field is stale on every update."""
+    ns = job_doc.get("namespace", "default")
+    job_id = job_doc.get("id", "")
+    stored = state.job_by_id(ns, job_id)
+    return _job_event(
+        index, ns, job_id, "JobRegistered",
+        {
+            "Type": (
+                stored.type if stored is not None
+                else job_doc.get("type", "")
+            ),
+            "Version": (
+                stored.version if stored is not None
+                else job_doc.get("version", 0)
+            ),
+        },
+    )
+
+
+def _plan_events(state, index: int, payload: dict) -> list:
+    from ..events import TOPIC_PLAN_RESULT, Event
+
+    plan = payload.get("plan") or {}
+    result = payload.get("result") or {}
+    events = []
+    n_place = sum(
+        len(v) for v in (result.get("node_allocation") or {}).values()
+    )
+    n_stop = sum(len(v) for v in (result.get("node_update") or {}).values())
+    n_preempt = sum(
+        len(v) for v in (result.get("node_preemptions") or {}).values()
+    )
+    events.append(
+        Event(
+            topic=TOPIC_PLAN_RESULT,
+            type="PlanResult",
+            key=plan.get("eval_id", ""),
+            index=index,
+            namespace=(plan.get("job") or {}).get("namespace", "default"),
+            payload={
+                "EvalID": plan.get("eval_id", ""),
+                "JobID": plan.get("job_id", "")
+                or (plan.get("job") or {}).get("id", ""),
+                "NodeAllocation": n_place,
+                "NodeUpdate": n_stop,
+                "NodePreemptions": n_preempt,
+                "Deployment": (result.get("deployment") or {}).get("id", ""),
+            },
+            filter_keys=tuple(
+                k for k in (
+                    plan.get("job_id")
+                    or (plan.get("job") or {}).get("id"),
+                ) if k
+            ),
+        )
+    )
+    for allocs in (result.get("node_allocation") or {}).values():
+        for doc in allocs:
+            events.append(_alloc_event(index, doc, "AllocationUpdated"))
+    # stops/preemptions travel as id+field diffs when normalized; the
+    # full documents live in this replica's (post-apply) state
+    for diff_map, etype in (
+        (result.get("node_update") or {}, "AllocationStopped"),
+        (result.get("node_preemptions") or {}, "AllocationPreempted"),
+    ):
+        for diffs in diff_map.values():
+            for d in diffs:
+                events.append(
+                    _alloc_event(
+                        index, _alloc_doc(state, d.get("id", ""), d), etype
+                    )
+                )
+    deployment = result.get("deployment")
+    if deployment:
+        events.append(
+            _deployment_event(
+                state, index, deployment.get("id", ""),
+                "DeploymentStatusUpdate", {},
+            )
+        )
+    for update in result.get("deployment_updates") or []:
+        events.append(
+            _deployment_event(
+                state, index, update.get("deployment_id", ""),
+                "DeploymentStatusUpdate",
+                {"StatusDescription": update.get("status_description", "")},
+            )
+        )
+    events.extend(_eval_events(index, payload.get("preemption_evals")))
+    return events
+
+
+def derive_events(
+    state, index: int, msg_type: str, payload: dict, pre: Optional[dict] = None
+) -> list:
+    """Typed events for one applied log entry (called post-apply; ``pre``
+    carries pre-apply snapshots of objects a delete entry removed)."""
+    from ..events import TOPIC_NODE_EVENT, Event
+
+    if msg_type == NODE_REGISTER:
+        node = payload.get("node") or {}
+        return [
+            _node_event(
+                index, node.get("id", ""), "NodeRegistration",
+                {"Name": node.get("name", ""), "Status": node.get("status", "")},
+            )
+        ]
+    if msg_type == NODE_DEREGISTER:
+        return [
+            _node_event(index, payload.get("node_id", ""),
+                        "NodeDeregistration", {})
+        ]
+    if msg_type == NODE_STATUS_UPDATE:
+        return [
+            _node_event(
+                index, payload.get("node_id", ""), "NodeStatusUpdate",
+                {"Status": payload.get("status", "")},
+            )
+        ]
+    if msg_type == NODE_DRAIN_UPDATE:
+        return [
+            _node_event(
+                index, payload.get("node_id", ""), "NodeDrain",
+                {"Drain": bool(payload.get("drain"))},
+            )
+        ]
+    if msg_type == NODE_ELIGIBILITY_UPDATE:
+        return [
+            _node_event(
+                index, payload.get("node_id", ""), "NodeEligibility",
+                {"Eligibility": payload.get("eligibility", "")},
+            )
+        ]
+    if msg_type == NODE_EVENTS_UPSERT:
+        return [
+            Event(
+                topic=TOPIC_NODE_EVENT,
+                type="NodeEvent",
+                key=node_id,
+                index=index,
+                payload={"ID": node_id, "Events": list(node_events)},
+            )
+            for node_id, node_events in (payload.get("events") or {}).items()
+        ]
+    if msg_type == JOB_REGISTER:
+        return [_job_registered_event(state, index, payload.get("job") or {})]
+    if msg_type == JOB_DEREGISTER:
+        return [
+            _job_event(
+                index, payload.get("namespace", "default"),
+                payload.get("job_id", ""), "JobDeregistered",
+                {"Purge": bool(payload.get("purge"))},
+            )
+        ]
+    if msg_type == JOB_BATCH_DEREGISTER:
+        events = [
+            _job_event(
+                index, item.get("namespace", "default"),
+                item.get("job_id", ""), "JobDeregistered",
+                {"Purge": bool(item.get("purge"))},
+            )
+            for item in payload.get("jobs") or []
+        ]
+        events.extend(_eval_events(index, payload.get("evals")))
+        return events
+    if msg_type == JOB_STABILITY:
+        return [
+            _job_event(
+                index, payload.get("namespace", "default"),
+                payload.get("job_id", ""), "JobStabilityUpdated",
+                {
+                    "Version": payload.get("version", 0),
+                    "Stable": bool(payload.get("stable")),
+                },
+            )
+        ]
+    if msg_type == EVAL_UPDATE:
+        return _eval_events(index, payload.get("evals"))
+    if msg_type == EVAL_DELETE:
+        from ..events import TOPIC_EVAL
+
+        events = []
+        for eval_id in payload.get("eval_ids") or []:
+            stored = (pre or {}).get(eval_id)
+            events.append(
+                Event(
+                    topic=TOPIC_EVAL, type="EvalDeleted", key=eval_id,
+                    index=index,
+                    namespace=(
+                        stored.namespace if stored is not None else "default"
+                    ),
+                    payload={
+                        "ID": eval_id,
+                        "JobID": stored.job_id if stored is not None else "",
+                    },
+                    filter_keys=(
+                        (stored.job_id,)
+                        if stored is not None and stored.job_id
+                        else ()
+                    ),
+                )
+            )
+        return events
+    if msg_type in (ALLOC_UPDATE, ALLOC_CLIENT_UPDATE):
+        etype = (
+            "AllocationClientUpdated"
+            if msg_type == ALLOC_CLIENT_UPDATE
+            else "AllocationUpdated"
+        )
+        events = [
+            _alloc_event(
+                index, _alloc_doc(state, doc.get("id", ""), doc), etype
+            )
+            for doc in payload.get("allocs") or []
+        ]
+        events.extend(_eval_events(index, payload.get("evals")))
+        return events
+    if msg_type == ALLOC_DESIRED_TRANSITION:
+        events = [
+            _alloc_event(
+                index, _alloc_doc(state, alloc_id),
+                "AllocationDesiredTransition",
+            )
+            for alloc_id in (payload.get("allocs") or {})
+        ]
+        events.extend(_eval_events(index, payload.get("evals")))
+        return events
+    if msg_type == APPLY_PLAN_RESULTS:
+        return _plan_events(state, index, payload)
+    if msg_type == APPLY_PLAN_RESULTS_BATCH:
+        events = []
+        for item in payload.get("plans") or []:
+            events.extend(_plan_events(state, index, item))
+        return events
+    if msg_type == DEPLOYMENT_STATUS_UPDATE:
+        update = payload.get("update") or {}
+        events = [
+            _deployment_event(
+                state, index, update.get("deployment_id", ""),
+                "DeploymentStatusUpdate",
+                {"StatusDescription": update.get("status_description", "")},
+            )
+        ]
+        if payload.get("job"):
+            events.append(
+                _job_registered_event(state, index, payload["job"])
+            )
+        events.extend(
+            _eval_events(index, [payload["eval"]] if payload.get("eval") else [])
+        )
+        return events
+    if msg_type == DEPLOYMENT_PROMOTE:
+        events = [
+            _deployment_event(
+                state, index, payload.get("deployment_id", ""),
+                "DeploymentPromotion",
+                {"All": bool(payload.get("all")),
+                 "Groups": list(payload.get("groups") or [])},
+            )
+        ]
+        events.extend(
+            _eval_events(index, [payload["eval"]] if payload.get("eval") else [])
+        )
+        return events
+    if msg_type == DEPLOYMENT_ALLOC_HEALTH:
+        events = [
+            _deployment_event(
+                state, index, payload.get("deployment_id", ""),
+                "DeploymentAllocHealth",
+                {
+                    "Healthy": list(payload.get("healthy_ids") or []),
+                    "Unhealthy": list(payload.get("unhealthy_ids") or []),
+                },
+            )
+        ]
+        events.extend(
+            _eval_events(index, [payload["eval"]] if payload.get("eval") else [])
+        )
+        return events
+    if msg_type == DEPLOYMENT_DELETE:
+        return [
+            _deployment_event(
+                state, index, did, "DeploymentDeleted", {},
+                deployment=(pre or {}).get(did),
+            )
+            for did in payload.get("deployment_ids") or []
+        ]
+    # config/ACL/vault/periodic-launch entries carry no stream events
+    # (ACL/vault payloads are sensitive; the rest are operator plumbing,
+    # matching the reference's 7-topic surface)
+    return []

@@ -86,11 +86,25 @@ class Server:
         self.time_table = TimeTable(
             granularity=float(self.config.get("time_table_granularity", 60.0))
         )
+        # cluster event stream (events/broker.py): FSM-sourced, so every
+        # server — leader or follower — can serve /v1/event/stream.
+        # Configured by the telemetry-style event_broker{} stanza; on by
+        # default (the ring is a few thousand slim dicts).
+        eb_cfg = self.config.get("event_broker") or {}
+        self.event_broker = None
+        if eb_cfg.get("enabled", True):
+            from ..events import EventBroker
+
+            self.event_broker = EventBroker(
+                size=int(eb_cfg.get("event_buffer_size", 4096)),
+                subscriber_buffer=int(eb_cfg.get("subscriber_buffer", 1024)),
+            )
         self.fsm = FSM(
             state=self.state,
             eval_broker=self.eval_broker,
             blocked_evals=self.blocked_evals,
             time_table=self.time_table,
+            event_broker=self.event_broker,
         )
         self.planner = Planner(self.state)
         self.planner.commit_fn = self._commit_plan
@@ -750,6 +764,8 @@ class Server:
         self.workers = []
         self._revoke_leadership()
         self.raft.shutdown()
+        if self.event_broker is not None:
+            self.event_broker.shutdown()
         pool = getattr(self, "_outbound_pool", None)
         if pool is not None:
             pool.close()

@@ -1,0 +1,37 @@
+"""Cluster event stream (ref nomad/stream/: the Nomad 1.0 event broker
+behind /v1/event/stream). FSM-sourced typed events in a bounded ring
+buffer, fanned out to per-subscriber queues with topic/key filters."""
+
+from .broker import (
+    ALL_TOPICS,
+    TOPIC_ALLOC,
+    TOPIC_DEPLOYMENT,
+    TOPIC_EVAL,
+    TOPIC_JOB,
+    TOPIC_NODE,
+    TOPIC_NODE_EVENT,
+    TOPIC_PLAN_RESULT,
+    Event,
+    EventBroker,
+    Subscription,
+    SubscriptionClosedError,
+    event_visible,
+    required_capability,
+)
+
+__all__ = [
+    "ALL_TOPICS",
+    "TOPIC_ALLOC",
+    "TOPIC_DEPLOYMENT",
+    "TOPIC_EVAL",
+    "TOPIC_JOB",
+    "TOPIC_NODE",
+    "TOPIC_NODE_EVENT",
+    "TOPIC_PLAN_RESULT",
+    "Event",
+    "EventBroker",
+    "Subscription",
+    "SubscriptionClosedError",
+    "event_visible",
+    "required_capability",
+]

@@ -1,0 +1,410 @@
+"""Event broker: FSM-sourced, index-ordered cluster events fanned out to
+subscribers (ref nomad/stream/event_broker.go, event_buffer.go,
+subscription.go + nomad/state/events.go eventsFromChanges).
+
+Every server (leader or follower) derives the same events from the same
+applied raft log, so any server can serve ``/v1/event/stream`` — exactly
+the property the reference gets from sourcing events in the FSM rather
+than in the leader's endpoints. Events are held in ONE bounded ring
+buffer shared by all subscribers (oldest entries dropped when full) and
+each subscriber drains its own bounded queue:
+
+- a subscriber that asks for ``index=N`` replays retained events with
+  index > N from the ring; when the ring has already overwritten part of
+  that range the subscription starts with an explicit lost-gap marker
+  instead of silently skipping (the chaos invariant);
+- a subscriber that stops draining (slow consumer) is CLOSED, not
+  buffered without bound — the close carries a resume floor (the highest
+  index the ring has evicted) so reconnecting with ``index=floor``
+  replays everything still retained, and a consumer resuming from its
+  own older index observes the gap explicitly (ref event_broker.go's
+  ErrSubscriberClosed path).
+
+The ring's contents are deliberately NOT snapshotted: after a restore
+the broker resets to the restored state index and live subscribers are
+closed with that index (re-derivable state, same as the reference's
+in-memory event buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Eval"
+TOPIC_ALLOC = "Alloc"
+TOPIC_DEPLOYMENT = "Deployment"
+TOPIC_NODE = "Node"
+TOPIC_NODE_EVENT = "NodeEvent"
+TOPIC_PLAN_RESULT = "PlanResult"
+TOPIC_ALL = "*"
+
+ALL_TOPICS = (
+    TOPIC_JOB,
+    TOPIC_EVAL,
+    TOPIC_ALLOC,
+    TOPIC_DEPLOYMENT,
+    TOPIC_NODE,
+    TOPIC_NODE_EVENT,
+    TOPIC_PLAN_RESULT,
+)
+
+#: topics whose events are cluster-scoped (no namespace): gated by the
+#: node:read coarse capability rather than a namespace capability
+NODE_TOPICS = (TOPIC_NODE, TOPIC_NODE_EVENT)
+
+
+def required_capability(topic: str) -> str:
+    """The ACL requirement for subscribing to ``topic`` (ref
+    command/agent/event_endpoint.go aclCheckForEvents): node-scoped
+    topics need node:read, everything else the namespace's read-job."""
+    if topic in NODE_TOPICS:
+        return "node:read"
+    return "ns:read-job"
+
+
+def event_visible(acl, event: "Event") -> bool:
+    """Per-event ACL filter applied at delivery (the subscribe-time check
+    used the caller-chosen namespace; each event re-checks against ITS
+    namespace, the same cross-namespace rule as list endpoints)."""
+    if acl is None or acl.management:
+        return True
+    if event.topic in NODE_TOPICS:
+        return acl.allow_node_read()
+    return acl.allow_namespace_operation(
+        event.namespace or "default", "read-job"
+    )
+
+
+@dataclass
+class Event:
+    """One typed cluster event (ref stream/event.go Event)."""
+
+    topic: str
+    type: str
+    key: str
+    index: int
+    namespace: str = ""
+    payload: dict = field(default_factory=dict)
+    #: secondary match keys (ref structs.Event.FilterKeys): an Alloc
+    #: event matches subscriptions keyed by its job/eval/deployment id
+    filter_keys: tuple = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "Topic": self.topic,
+            "Type": self.type,
+            "Key": self.key,
+            "Namespace": self.namespace,
+            "FilterKeys": list(self.filter_keys),
+            "Index": self.index,
+            "Payload": self.payload,
+        }
+
+
+class SubscriptionClosedError(Exception):
+    """Raised from Subscription.next once the broker has closed the
+    subscription. ``resume_index`` is the highest index already evicted
+    from the ring at close time (the resume floor): reconnecting with
+    ``index=resume_index`` replays every frame still retained — nothing
+    is silently skipped — and a consumer resuming from its OWN older
+    index instead gets the explicit lost-gap marker."""
+
+    def __init__(self, reason: str, resume_index: int):
+        super().__init__(reason)
+        self.reason = reason
+        self.resume_index = resume_index
+
+
+class Subscription:
+    """One consumer's bounded queue over the broker's fan-out (ref
+    stream/subscription.go). Frames are ``(index, [Event, ...])``; a
+    lost-gap frame is ``(index, None)`` meaning events up to ``index``
+    were overwritten before this subscriber could read them."""
+
+    def __init__(
+        self,
+        broker: "EventBroker",
+        topics: dict[str, set[str]],
+        acl=None,
+        namespace: str = "*",
+        max_queued: int = 1024,
+    ):
+        self.broker = broker
+        self.topics = topics
+        self.acl = acl
+        self.namespace = namespace
+        self.max_queued = max_queued
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._close_reason = ""
+        self._resume_index = 0
+
+    # -- filtering ------------------------------------------------------
+    def _topic_keys(self, topic: str) -> Optional[set[str]]:
+        keys = self.topics.get(topic)
+        if keys is None:
+            keys = self.topics.get(TOPIC_ALL)
+        return keys
+
+    def matches(self, event: Event) -> bool:
+        keys = self._topic_keys(event.topic)
+        if keys is None:
+            return False
+        if TOPIC_ALL not in keys:
+            if event.key not in keys and not keys.intersection(
+                event.filter_keys
+            ):
+                return False
+        if (
+            self.namespace not in ("*", "")
+            and event.namespace
+            and event.namespace != self.namespace
+        ):
+            return False
+        return event_visible(self.acl, event)
+
+    # -- delivery (broker side, under the broker lock) ------------------
+    def _offer(self, index: int, events: list[Event]) -> bool:
+        """Enqueue one frame; False means this subscriber is too slow and
+        must be closed (no-slow-consumer backpressure)."""
+        wanted = [e for e in events if self.matches(e)]
+        if not wanted:
+            return True
+        with self._cond:
+            if self._closed:
+                return True
+            if len(self._queue) >= self.max_queued:
+                return False
+            self._queue.append((index, wanted))
+            self._cond.notify_all()
+        return True
+
+    def _offer_gap(self, through_index: int):
+        with self._cond:
+            if not self._closed:
+                self._queue.append((through_index, None))
+                self._cond.notify_all()
+
+    def _close(self, reason: str, resume_index: int):
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._close_reason = reason
+            self._resume_index = resume_index
+            self._cond.notify_all()
+
+    # -- consumer side --------------------------------------------------
+    def next(self, timeout: Optional[float] = None):
+        """Next frame ``(index, [Event, ...])`` (or ``(index, None)`` for
+        a lost gap), ``None`` on timeout, SubscriptionClosedError once the
+        broker closed this subscription and its queue is drained."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._queue or self._closed, timeout
+            )
+            if self._queue:
+                return self._queue.popleft()
+            if self._closed:
+                raise SubscriptionClosedError(
+                    self._close_reason or "subscription closed",
+                    self._resume_index,
+                )
+            return None
+
+    def close(self):
+        """Consumer-initiated unsubscribe."""
+        self.broker.unsubscribe(self)
+        self._close("unsubscribed", self._resume_index)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+
+class EventBroker:
+    """Bounded ring of published frames + subscriber fan-out (ref
+    stream/event_broker.go EventBroker)."""
+
+    def __init__(self, size: int = 4096, subscriber_buffer: int = 1024):
+        #: max EVENTS retained across all frames (oldest dropped first)
+        self.size = max(1, int(size))
+        self.subscriber_buffer = max(1, int(subscriber_buffer))
+        self._lock = threading.Lock()
+        #: ring of (index, [Event, ...]) frames, index-ascending
+        self._frames: deque = deque()
+        self._n_events = 0
+        self._latest_index = 0
+        #: highest index ever evicted from the ring (lost-gap watermark)
+        self._dropped_through = 0
+        self._subs: list[Subscription] = []
+        self._published = 0
+        self._closed_slow = 0
+
+    # -- publish (FSM apply path) ---------------------------------------
+    def publish(self, index: int, events: list[Event]):
+        if not events:
+            return
+        with self._lock:
+            self._latest_index = max(self._latest_index, index)
+            self._frames.append((index, list(events)))
+            self._n_events += len(events)
+            self._published += len(events)
+            while self._n_events > self.size and len(self._frames) > 1:
+                old_index, old_events = self._frames.popleft()
+                self._n_events -= len(old_events)
+                self._dropped_through = max(self._dropped_through, old_index)
+            subs = list(self._subs)
+        for sub in subs:
+            if not sub._offer(index, events):
+                self._close_slow(sub)
+
+    def _resume_floor_locked(self) -> int:
+        """The index to advertise on a close: reconnecting with
+        ``index=floor`` replays every frame still retained (from_index is
+        exclusive), so nothing retained is silently skipped — and a
+        consumer resuming from its own older index still gets the
+        explicit gap marker."""
+        return self._dropped_through
+
+    def _close_slow(self, sub: Subscription):
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+            self._closed_slow += 1
+            resume = self._resume_floor_locked()
+        sub._close(
+            "subscription closed: slow consumer (queue overflow)", resume
+        )
+
+    # -- subscribe ------------------------------------------------------
+    def subscribe(
+        self,
+        topics: Optional[dict[str, Iterable[str]]] = None,
+        from_index: int = 0,
+        acl=None,
+        namespace: str = "*",
+        max_queued: Optional[int] = None,
+    ) -> Subscription:
+        """Register a subscriber. ``topics`` maps topic → keys ("*" for
+        all); ``from_index=N`` replays retained events with index > N
+        (the blocking-query convention: pass the last index you saw).
+        An explicit resume (N > 0) older than the ring's retention gets a
+        lost-gap frame first, then everything still retained.
+        ``from_index=0`` is a FRESH subscribe — "whatever is retained,
+        then live" — and makes no completeness claim, so it never emits a
+        gap frame (every fresh subscriber on a long-lived cluster would
+        otherwise start with one)."""
+        norm: dict[str, set[str]] = {}
+        for topic, keys in (topics or {TOPIC_ALL: ("*",)}).items():
+            keyset = {k for k in keys} or {"*"}
+            norm[topic] = keyset
+        sub = Subscription(
+            self,
+            norm,
+            acl=acl,
+            namespace=namespace,
+            max_queued=max_queued or self.subscriber_buffer,
+        )
+        with self._lock:
+            replay = [
+                (index, events)
+                for index, events in self._frames
+                if index > from_index
+            ]
+            # cap the replay to the NEWEST frames that fit the queue with
+            # headroom for live publishes — an uncapped replay would close
+            # the subscription mid-replay on any cluster retaining more
+            # frames than one queue, so index-less consumers (the UI)
+            # could never reach the live tail
+            cap = max(1, sub.max_queued - 1)
+            trimmed_through = 0
+            if len(replay) > cap:
+                trimmed_through = replay[-cap - 1][0]
+                replay = replay[-cap:]
+            if from_index and (
+                self._dropped_through > from_index or trimmed_through
+            ):
+                # an explicit resume lost part of its range (ring eviction
+                # and/or replay trim): say so, never silently skip. A
+                # fresh subscribe (from_index=0) makes no completeness
+                # claim, so trims there stay silent.
+                sub._offer_gap(
+                    max(self._dropped_through, trimmed_through)
+                )
+            for index, events in replay:
+                sub._offer(index, events)
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription):
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    # -- introspection --------------------------------------------------
+    def oldest_index(self) -> int:
+        """Oldest raft index still retained (resume floor)."""
+        with self._lock:
+            if self._frames:
+                return self._frames[0][0]
+            return self._latest_index
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._latest_index
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "events_buffered": self._n_events,
+                "events_published": self._published,
+                "subscribers": len(self._subs),
+                "slow_consumers_closed": self._closed_slow,
+                "oldest_index": (
+                    self._frames[0][0] if self._frames else self._latest_index
+                ),
+                "latest_index": self._latest_index,
+            }
+
+    def acl_changed(self):
+        """ACL token/policy writes applied: close every token-backed
+        subscription so its capabilities re-resolve on reconnect (ref
+        event_broker.go closing subscriptions on ACL changes — a revoked
+        token must not keep streaming until it disconnects by itself).
+        Anonymous/ACL-off subscriptions (acl=None, in-proc consumers like
+        the deployment watcher) are untouched."""
+        with self._lock:
+            affected = [s for s in self._subs if s.acl is not None]
+            for sub in affected:
+                self._subs.remove(sub)
+            resume = self._resume_floor_locked()
+        for sub in affected:
+            sub._close("subscription closed: ACL change", resume)
+
+    # -- lifecycle ------------------------------------------------------
+    def reset(self, index: int):
+        """Restore-path reset (FSM.restore): the ring is re-derivable
+        state, so drop it and close live subscribers with the restored
+        index as their resume point."""
+        with self._lock:
+            self._frames.clear()
+            self._n_events = 0
+            self._latest_index = index
+            self._dropped_through = index
+            subs, self._subs = self._subs, []
+        for sub in subs:
+            sub._close("event buffer reset (snapshot restore)", index)
+
+    def shutdown(self):
+        with self._lock:
+            subs, self._subs = self._subs, []
+            resume = self._resume_floor_locked()
+        for sub in subs:
+            sub._close("event broker shut down", resume)

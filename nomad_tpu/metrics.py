@@ -95,18 +95,30 @@ class StatsdSink:
     def _fmt(self, name: str) -> str:
         return f"{self.prefix}.{name}".replace(":", "_").replace("|", "_")
 
-    def emit(self, counters: dict, timers: dict):
+    def _suffix(self) -> str:
+        """Per-line suffix hook (dogstatsd appends its tag block)."""
+        return ""
+
+    def _lines(self, counters: dict, timers: dict) -> list[str]:
+        suffix = self._suffix()
         lines = []
         for name, total in sorted(counters.items()):
             delta = total - self._last_counters.get(name, 0.0)
             self._last_counters[name] = total
             if delta:
-                lines.append(f"{self._fmt(name)}:{delta:g}|c")
+                lines.append(f"{self._fmt(name)}:{delta:g}|c{suffix}")
         for name, stats in sorted(timers.items()):
-            lines.append(f"{self._fmt(name)}.mean:{stats['mean_ms']:g}|ms")
-            lines.append(f"{self._fmt(name)}.p99:{stats['p99_ms']:g}|ms")
+            lines.append(
+                f"{self._fmt(name)}.mean:{stats['mean_ms']:g}|ms{suffix}"
+            )
+            lines.append(
+                f"{self._fmt(name)}.p99:{stats['p99_ms']:g}|ms{suffix}"
+            )
+        return lines
+
+    def emit(self, counters: dict, timers: dict):
         batch = b""
-        for line in lines:
+        for line in self._lines(counters, timers):
             data = line.encode()
             if batch and len(batch) + 1 + len(data) > self.MAX_DATAGRAM:
                 self._send(batch)
@@ -123,6 +135,85 @@ class StatsdSink:
 
     def close(self):
         self._sock.close()
+
+
+class DogstatsdSink(StatsdSink):
+    """dogstatsd: the statsd line protocol plus a ``|#key:value,...`` tag
+    block on every line (the go-metrics datadog sink role, ref
+    command/agent/config.go datadog_address/datadog_tags). Tags come from
+    the telemetry stanza and ride every metric, so one receiver can split
+    series by node/region without name-mangling."""
+
+    def __init__(self, address: str, prefix: str = "nomad", tags=None):
+        super().__init__(address, prefix=prefix)
+        if isinstance(tags, dict):
+            tags = [f"{k}:{v}" for k, v in sorted(tags.items())]
+        self.tags = [str(t) for t in (tags or [])]
+
+    def _suffix(self) -> str:
+        if not self.tags:
+            return ""
+        # tag values must not smuggle protocol delimiters — ',' splits
+        # tags, '|' splits fields, newline splits lines
+        clean = [
+            t.replace("|", "_").replace("\n", "_").replace(",", "_")
+            for t in self.tags
+        ]
+        return "|#" + ",".join(clean)
+
+
+class StatsiteSink(StatsdSink):
+    """statsite line protocol over TCP (the go-metrics statsite sink
+    role): the same ``name:value|type`` lines, newline-terminated on one
+    persistent connection. TCP gives ordering + no datagram size limit;
+    a broken pipe drops the connection and the next flush redials —
+    telemetry stays best-effort, never a failure source."""
+
+    def __init__(self, address: str, prefix: str = "nomad"):
+        # reuse the statsd formatting/delta machinery; replace transport
+        super().__init__(address, prefix=prefix)
+        self._sock.close()
+        self._sock = None
+        self._conn = None
+
+    def _connect(self):
+        import socket
+
+        if self._conn is None:
+            self._conn = socket.create_connection(self.addr, timeout=2.0)
+        return self._conn
+
+    def emit(self, counters: dict, timers: dict):
+        # _lines consumes the counter deltas; keep the pre-flush marks so
+        # a fully-failed send re-carries the counts next interval instead
+        # of undercounting the receiver after every transient outage.
+        # Deliberately at-least-once: sendall can't report partial
+        # progress, so a connection dying mid-send may double-count the
+        # flushed prefix on retry — the rarer and more benign failure
+        # than silently losing every delta across an outage.
+        marks = dict(self._last_counters)
+        lines = self._lines(counters, timers)
+        if not lines:
+            return
+        payload = ("\n".join(lines) + "\n").encode()
+        for _ in range(2):  # one redial after a stale-connection failure
+            try:
+                self._connect().sendall(payload)
+                return
+            except OSError:
+                self._drop()
+        self._last_counters = marks
+
+    def _drop(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def close(self):
+        self._drop()
 
 
 class SinkFlusher:
@@ -168,12 +259,21 @@ class SinkFlusher:
 def configure_telemetry(config: dict):
     """Build + start the sink fan-out from an agent config's telemetry
     stanza (ref command/agent/config.go:500-577: statsd_address,
+    statsite_address, datadog_address + datadog_tags,
     collection_interval). Returns a running SinkFlusher or None."""
     stanza = (config or {}).get("telemetry") or {}
     sinks = []
     addr = stanza.get("statsd_address")
     if addr:
         sinks.append(StatsdSink(str(addr)))
+    addr = stanza.get("statsite_address")
+    if addr:
+        sinks.append(StatsiteSink(str(addr)))
+    addr = stanza.get("datadog_address")
+    if addr:
+        sinks.append(
+            DogstatsdSink(str(addr), tags=stanza.get("datadog_tags"))
+        )
     if not sinks:
         return None
     interval = stanza.get("collection_interval", 10.0)

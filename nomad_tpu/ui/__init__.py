@@ -688,11 +688,54 @@ async function render() {
 }
 let renderGen = 0;
 window.addEventListener('hashchange', render);
-setInterval(() => {
+
+// ---- live updates over /v1/event/stream (push instead of poll; the
+// 3s poll below stays as the blocking-query-style fallback whenever the
+// stream is unavailable — no broker, ACL denial, proxy buffering) ----
+let streamLive = false, streamPending = false;
+function refreshable() {
   const h = location.hash || '';
   // no auto-refresh on detail pages or the Run editor (it would wipe
-  // in-progress HCL edits and the plan output)
-  if (h.match(/#\\/(job|node|allocation)\\//) || h.startsWith('#/run')) return;
+  // in-progress HCL edits, the exec terminal, and the plan output)
+  return !(h.match(/#\\/(job|node|allocation)\\//) || h.startsWith('#/run'));
+}
+async function eventStream() {
+  try {
+    const headers = {};
+    if (tokenInput.value) headers['X-Nomad-Token'] = tokenInput.value;
+    const resp = await fetch('/v1/event/stream', {headers});
+    if (!resp.ok || !resp.body) throw new Error('stream unavailable');
+    streamLive = true;
+    const reader = resp.body.getReader();
+    const dec = new TextDecoder();
+    let buf = '';
+    for (;;) {
+      const {value, done} = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, {stream: true});
+      let nl, saw = false;
+      while ((nl = buf.indexOf('\\n')) >= 0) {
+        const line = buf.slice(0, nl); buf = buf.slice(nl + 1);
+        if (!line.trim()) continue;
+        try {
+          const f = JSON.parse(line);
+          if ((f.Events && f.Events.length) || f.LostGap) saw = true;
+        } catch {}
+      }
+      if (saw && !streamPending && refreshable()) {
+        // coalesce event bursts into at most one re-render per 500ms
+        streamPending = true;
+        setTimeout(() => { streamPending = false; if (refreshable()) render(); }, 500);
+      }
+    }
+  } catch {}
+  streamLive = false;
+  setTimeout(eventStream, 3000);  // reconnect with backoff
+}
+eventStream();
+
+setInterval(() => {
+  if (streamLive || !refreshable()) return;  // push path is driving
   render();
 }, 3000);
 render();

@@ -1,0 +1,577 @@
+"""Cluster event stream (events/broker.py + /v1/event/stream): broker
+semantics, FSM-sourced emission, the chunked-HTTP and websocket tiers
+through the real in-proc server, per-topic ACL enforcement, resume from
+index, and the slow-consumer / lost-gap contracts."""
+
+import json
+import threading
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.api.client import APIError, ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.api.ws import WsClient
+from nomad_tpu.core import fsm as fsm_mod
+from nomad_tpu.core.server import Server
+from nomad_tpu.events import (
+    ALL_TOPICS,
+    Event,
+    EventBroker,
+    SubscriptionClosedError,
+)
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_server(extra=None):
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    cfg.update(extra or {})
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def ev(index, topic="Job", type="JobRegistered", key="j1", ns="default"):
+    return Event(topic=topic, type=type, key=key, index=index, namespace=ns)
+
+
+class TestEventBrokerUnit:
+    def test_publish_fanout_in_index_order(self):
+        b = EventBroker(size=100)
+        sub = b.subscribe()
+        for i in range(1, 6):
+            b.publish(i, [ev(i)])
+        seen = []
+        while True:
+            frame = sub.next(timeout=0.1)
+            if frame is None:
+                break
+            idx, events = frame
+            assert events is not None
+            assert all(e.index == idx for e in events)
+            seen.append(idx)
+        assert seen == [1, 2, 3, 4, 5]
+
+    def test_topic_and_key_filters(self):
+        b = EventBroker(size=100)
+        only_j2 = b.subscribe({"Job": {"j2"}})
+        only_nodes = b.subscribe({"Node": {"*"}})
+        b.publish(1, [ev(1, key="j1")])
+        b.publish(2, [ev(2, key="j2")])
+        b.publish(3, [ev(3, topic="Node", type="NodeRegistration", key="n1")])
+        idx, events = only_j2.next(timeout=0.5)
+        assert idx == 2 and events[0].key == "j2"
+        assert only_j2.next(timeout=0.05) is None
+        idx, events = only_nodes.next(timeout=0.5)
+        assert idx == 3 and events[0].topic == "Node"
+
+    def test_filter_keys_match_secondary_ids(self):
+        b = EventBroker(size=100)
+        by_deploy = b.subscribe({"Alloc": {"dep-1"}})
+        b.publish(
+            1,
+            [
+                Event(
+                    topic="Alloc", type="AllocationUpdated", key="a1",
+                    index=1, namespace="default",
+                    filter_keys=("job-1", "dep-1"),
+                )
+            ],
+        )
+        b.publish(
+            2,
+            [
+                Event(
+                    topic="Alloc", type="AllocationUpdated", key="a2",
+                    index=2, namespace="default", filter_keys=("job-2",),
+                )
+            ],
+        )
+        idx, events = by_deploy.next(timeout=0.5)
+        assert idx == 1 and events[0].key == "a1"
+        assert by_deploy.next(timeout=0.05) is None
+
+    def test_resume_replays_only_after_index(self):
+        b = EventBroker(size=100)
+        for i in range(1, 8):
+            b.publish(i, [ev(i)])
+        sub = b.subscribe(from_index=4)
+        seen = []
+        while True:
+            frame = sub.next(timeout=0.1)
+            if frame is None:
+                break
+            seen.append(frame[0])
+        assert seen == [5, 6, 7]
+
+    def test_ring_overflow_yields_explicit_gap(self):
+        b = EventBroker(size=3)
+        for i in range(1, 10):
+            b.publish(i, [ev(i)])
+        sub = b.subscribe(from_index=1)
+        idx, events = sub.next(timeout=0.5)
+        assert events is None, "first frame must be the lost-gap marker"
+        assert idx >= 6  # events ≤ idx were overwritten
+        rest = []
+        while True:
+            frame = sub.next(timeout=0.1)
+            if frame is None:
+                break
+            rest.append(frame[0])
+        assert rest == sorted(rest) and rest[-1] == 9
+        assert rest[0] == idx + 1
+
+    def test_slow_consumer_closed_with_resume_index(self):
+        b = EventBroker(size=100, subscriber_buffer=4)
+        sub = b.subscribe()
+        for i in range(1, 10):
+            b.publish(i, [ev(i)])
+        # queue cap 4: the subscriber was closed, not buffered unboundedly
+        drained = 0
+        with pytest.raises(SubscriptionClosedError) as e:
+            while True:
+                if sub.next(timeout=0.1) is None:
+                    break
+                drained += 1
+        assert drained <= 4
+        # the advertised resume is a FLOOR: reconnecting with it replays
+        # every frame the ring still retains (from_index is exclusive)
+        resume = e.value.resume_index
+        assert resume < b.oldest_index()
+        sub2 = b.subscribe(from_index=resume, max_queued=100)
+        idx, events = sub2.next(timeout=0.5)
+        assert events is not None, "resume at the floor must not re-gap"
+        assert idx == b.oldest_index(), "oldest retained frame replayed"
+        assert b.stats()["slow_consumers_closed"] == 1
+
+    def test_huge_replay_trims_to_newest_instead_of_closing(self):
+        # an index-less subscriber on a busy cluster must reach the live
+        # tail: the replay caps at the newest frames, silently for a
+        # fresh subscribe, with an explicit gap for an explicit resume
+        b = EventBroker(size=10000, subscriber_buffer=8)
+        for i in range(1, 101):
+            b.publish(i, [ev(i)])
+        fresh = b.subscribe()
+        idx, events = fresh.next(timeout=0.5)
+        assert events is not None, "fresh subscribe must not start gapped"
+        assert idx > 90, "replay kept the newest frames"
+        assert not fresh.closed
+        b.publish(101, [ev(101)])
+        seen = []
+        while True:
+            frame = fresh.next(timeout=0.2)
+            if frame is None:
+                break
+            seen.append(frame[0])
+        assert seen[-1] == 101, "live publishes reach the subscriber"
+        resumer = b.subscribe(from_index=5)
+        idx, events = resumer.next(timeout=0.5)
+        assert events is None, "explicit resume sees the trim as a gap"
+        assert idx > 5
+
+    def test_reset_closes_subscribers_at_restored_index(self):
+        b = EventBroker(size=100)
+        sub = b.subscribe()
+        b.publish(1, [ev(1)])
+        sub.next(timeout=0.5)
+        b.reset(41)
+        with pytest.raises(SubscriptionClosedError) as e:
+            sub.next(timeout=0.5)
+        assert e.value.resume_index == 41
+        # post-reset publishes reach new subscribers only
+        sub2 = b.subscribe()
+        b.publish(42, [ev(42)])
+        idx, _ = sub2.next(timeout=0.5)
+        assert idx == 42
+
+
+class TestFsmEmission:
+    def test_apply_tags_events_with_raft_index(self):
+        from nomad_tpu.core.fsm import FSM
+
+        broker = EventBroker(size=100)
+        f = FSM(event_broker=broker)
+        sub = broker.subscribe()
+        node = mock.node()
+        f.apply(7, fsm_mod.NODE_REGISTER, {"node": node.to_dict()})
+        f.apply(
+            8, fsm_mod.NODE_EVENTS_UPSERT,
+            {"events": {node.id: [
+                {"subsystem": "Driver", "message": "boom", "timestamp": 1}
+            ]}},
+        )
+        idx, events = sub.next(timeout=0.5)
+        assert idx == 7
+        assert events[0].topic == "Node"
+        assert events[0].type == "NodeRegistration"
+        assert events[0].key == node.id
+        idx, events = sub.next(timeout=0.5)
+        assert idx == 8
+        assert events[0].topic == "NodeEvent"
+        assert events[0].payload["Events"][0]["message"] == "boom"
+
+    def test_restore_resets_broker_to_state_index(self):
+        from nomad_tpu.core.fsm import FSM
+
+        broker = EventBroker(size=100)
+        f = FSM(event_broker=broker)
+        f.apply(3, fsm_mod.JOB_REGISTER, {"job": mock.job().to_dict()})
+        snap = f.snapshot()
+        sub = broker.subscribe()
+        f2 = FSM(event_broker=broker)
+        f2.restore(snap)
+        # already-queued frames drain first; then the reset close surfaces
+        with pytest.raises(SubscriptionClosedError) as e:
+            while True:
+                sub.next(timeout=0.5)
+        assert e.value.resume_index == f2.state.latest_index()
+
+
+class TestEventStreamE2E:
+    """The acceptance path: register a job through the real in-proc
+    server and watch Job/Eval/PlanResult/Alloc (plus Node/NodeEvent/
+    Deployment) frames arrive over /v1/event/stream, index-ordered."""
+
+    def setup_method(self):
+        self.server = make_server()
+        self.http = HTTPServer(self.server, port=0)
+        self.http.start()
+        self.client = ApiClient(address=self.http.address)
+
+    def teardown_method(self):
+        self.http.stop()
+        self.server.stop()
+
+    def _drive_all_topics(self):
+        node = mock.node()
+        self.server.node_register(node)
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.networks = []
+        self.client.register_job(job.to_dict())
+        wait_until(
+            lambda: self.server.state.allocs_by_job("default", job.id),
+            msg="allocs placed",
+        )
+        # node-operational + deployment entries ride the same log
+        self.server._apply(
+            fsm_mod.NODE_EVENTS_UPSERT,
+            {"events": {node.id: [
+                {"subsystem": "Driver", "message": "health flap",
+                 "timestamp": 1}
+            ]}},
+        )
+        self.server._apply(
+            fsm_mod.DEPLOYMENT_STATUS_UPDATE,
+            {"update": {
+                "deployment_id": "dep-e2e", "status": "running",
+                "status_description": "Deployment is running",
+            }},
+        )
+        return job
+
+    def test_all_seven_topics_index_ordered(self):
+        stream = self.client.event_stream(heartbeat=0.2)
+        frames = []
+        done = threading.Event()
+
+        def drain():
+            for frame in stream:
+                frames.append(frame)
+                topics = {
+                    e["Topic"] for f in frames for e in f.get("Events", [])
+                }
+                if len(topics) >= 7:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        self._drive_all_topics()
+        assert done.wait(15.0), (
+            "topics seen: "
+            + str({e["Topic"] for f in frames for e in f.get("Events", [])})
+        )
+        stream.close()
+        topics = {e["Topic"] for f in frames for e in f.get("Events", [])}
+        assert topics == set(ALL_TOPICS)
+        # index-ordered frames; every event tagged with its frame index
+        indexes = [f["Index"] for f in frames if f.get("Events")]
+        assert indexes == sorted(indexes)
+        for f in frames:
+            for e in f.get("Events", []):
+                assert e["Index"] == f["Index"]
+
+    def test_resume_from_index_after_disconnect_no_dupes_no_loss(self):
+        job = self._drive_all_topics()
+        stream = self.client.event_stream(heartbeat=0.2)
+        first = []
+        for frame in stream:
+            if frame.get("Events"):
+                first.append(frame)
+            if len(first) >= 2:
+                break
+        stream.close()  # severed mid-stream
+        cut = stream.last_index
+        assert cut > 0
+        # more writes while disconnected
+        self.client.deregister_job(job.id)
+        wait_until(
+            lambda: self.server.state.latest_index() > cut + 1,
+            msg="more writes applied",
+        )
+        resumed = self.client.event_stream(index=cut, heartbeat=0.2)
+        seen = []
+        deadline = time.monotonic() + 10
+        for frame in resumed:
+            if frame.get("Events"):
+                seen.append(frame["Index"])
+                if any(
+                    e["Type"] == "JobDeregistered"
+                    for e in frame["Events"]
+                ):
+                    break
+            if time.monotonic() > deadline:
+                break
+        resumed.close()
+        assert seen, "resumed stream delivered nothing"
+        assert all(i > cut for i in seen), (cut, seen)
+        assert seen == sorted(seen)
+        # exactly-once across the sever: the resumed indexes pick up at
+        # the very next applied index after the cut
+        assert seen[0] == cut + 1
+
+    def test_topic_filter_only_matching_frames(self):
+        stream = self.client.event_stream(
+            topics=["Eval", "Job:specific-job"], heartbeat=0.2
+        )
+        collected = []
+        done = threading.Event()
+
+        def drain():
+            for frame in stream:
+                for e in frame.get("Events", []):
+                    collected.append(e)
+                    if e["Topic"] == "Eval":
+                        done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        self.server.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.networks = []
+        self.client.register_job(job.to_dict())
+        assert done.wait(10.0)
+        stream.close()
+        assert collected, "no events matched"
+        for e in collected:
+            assert e["Topic"] == "Eval" or (
+                e["Topic"] == "Job" and e["Key"] == "specific-job"
+            ), e
+
+    def test_unknown_topic_rejected(self):
+        with pytest.raises(APIError) as e:
+            self.client.event_stream(topics=["Bogus"])
+        assert e.value.status == 400
+
+    def test_lost_gap_frame_when_ring_overwrote(self):
+        # tiny ring: writes while disconnected overrun retention
+        self.server.event_broker.size = 4
+        job = self._drive_all_topics()
+        for i in range(12):
+            self.server._apply(
+                fsm_mod.NODE_EVENTS_UPSERT,
+                {"events": {"n-x": [
+                    {"subsystem": "t", "message": str(i), "timestamp": i}
+                ]}},
+            )
+        stream = self.client.event_stream(index=1, heartbeat=0.2)
+        frame = next(iter(stream))
+        stream.close()
+        assert frame.get("LostGap") is True
+        assert frame.get("Index", 0) > 1
+        assert job is not None
+
+    def test_websocket_tier_serves_same_frames(self):
+        ws = WsClient(
+            f"127.0.0.1:{self.http.port}",
+            "/v1/event/stream?heartbeat=0.2&topic=Job",
+        )
+        try:
+            self.server.node_register(mock.node())
+            job = mock.job()
+            job.task_groups[0].tasks[0].resources.networks = []
+            self.client.register_job(job.to_dict())
+            deadline = time.monotonic() + 10
+            frame = None
+            while time.monotonic() < deadline:
+                doc = json.loads(ws.recv(timeout=5.0).decode())
+                if doc.get("Events"):
+                    frame = doc
+                    break
+            assert frame is not None, "no event frame over websocket"
+            assert frame["Events"][0]["Topic"] == "Job"
+            assert frame["Events"][0]["Key"] == job.id
+        finally:
+            ws.close()
+
+    def test_metrics_exposes_event_broker_stats(self):
+        self._drive_all_topics()
+        stats = self.client.metrics()["event_broker"]
+        assert stats["events_published"] > 0
+        assert stats["latest_index"] > 0
+
+
+class TestEventStreamACL:
+    def setup_method(self):
+        self.server = make_server(extra={"acl": {"enabled": True}})
+        self.http = HTTPServer(self.server, port=0)
+        self.http.start()
+        anon = ApiClient(address=self.http.address)
+        boot = anon.put("/v1/acl/bootstrap")[0]
+        self.mgmt = ApiClient(address=self.http.address, token=boot["SecretID"])
+        self.mgmt.put(
+            "/v1/acl/policy/readonly",
+            body={"Rules": 'namespace "default" { policy = "read" }'},
+        )
+        tok = self.mgmt.put(
+            "/v1/acl/token",
+            body={"Name": "ro", "Type": "client", "Policies": ["readonly"]},
+        )[0]
+        self.ro = ApiClient(address=self.http.address, token=tok["SecretID"])
+
+    def teardown_method(self):
+        self.http.stop()
+        self.server.stop()
+
+    def test_anonymous_denied(self):
+        anon = ApiClient(address=self.http.address)
+        with pytest.raises(APIError) as e:
+            anon.event_stream(topics=["Job"])
+        assert e.value.status == 403
+
+    def test_node_topic_needs_node_read(self):
+        with pytest.raises(APIError) as e:
+            self.ro.event_stream(topics=["Node"])
+        assert e.value.status == 403
+        with pytest.raises(APIError) as e:
+            self.ro.event_stream(topics=["Job", "NodeEvent"])
+        assert e.value.status == 403
+
+    def test_wildcard_topic_needs_union_of_capabilities(self):
+        # "*" spans node-scoped topics, which this token can't read
+        with pytest.raises(APIError) as e:
+            self.ro.event_stream()
+        assert e.value.status == 403
+        # management sees everything
+        stream = self.mgmt.event_stream(heartbeat=0.2)
+        stream.close()
+
+    def test_acl_write_closes_token_backed_streams(self):
+        # a revoked/changed token must not keep streaming on old grants:
+        # ACL writes close every token-backed subscription (resumable)
+        stream = self.ro.event_stream(topics=["Job"], heartbeat=0.2)
+        self.mgmt.put(
+            "/v1/acl/policy/other",
+            body={"Rules": 'namespace "x" { policy = "read" }'},
+        )
+        got_error = None
+        deadline = time.monotonic() + 10
+        for frame in stream:
+            if frame.get("Error"):
+                got_error = frame
+                break
+            if time.monotonic() > deadline:
+                break
+        stream.close()
+        assert got_error is not None, "stream survived an ACL change"
+        assert "ACL" in got_error["Error"]
+        assert "ResumeIndex" in got_error
+
+    def test_acl_change_leaves_in_proc_subscriptions_alone(self):
+        # acl=None consumers (deployment watcher et al.) are not
+        # token-backed and must survive ACL churn
+        sub = self.server.event_broker.subscribe()
+        self.mgmt.put(
+            "/v1/acl/policy/another",
+            body={"Rules": 'namespace "y" { policy = "read" }'},
+        )
+        assert not sub.closed
+        sub.close()
+
+    def test_namespaced_topics_filtered_per_event(self):
+        stream = self.ro.event_stream(
+            topics=["Job"], namespace="*", heartbeat=0.2
+        )
+        got = []
+        done = threading.Event()
+
+        def drain():
+            for frame in stream:
+                for e in frame.get("Events", []):
+                    got.append(e)
+                    if e["Key"] == "visible-job":
+                        done.set()
+
+        threading.Thread(target=drain, daemon=True).start()
+        secret = mock.job()
+        secret.id = secret.name = "secret-job"
+        secret.namespace = "ops"
+        secret.task_groups[0].tasks[0].resources.networks = []
+        self.server.job_register(secret)
+        visible = mock.job()
+        visible.id = visible.name = "visible-job"
+        visible.task_groups[0].tasks[0].resources.networks = []
+        self.server.job_register(visible)
+        assert done.wait(10.0)
+        stream.close()
+        keys = {e["Key"] for e in got}
+        assert "visible-job" in keys
+        assert "secret-job" not in keys, (
+            "event from an unauthorized namespace leaked"
+        )
+
+
+class TestDeploymentWatcherOnStream:
+    def test_watcher_subscribes_instead_of_polling(self):
+        server = make_server()
+        try:
+            assert server.event_broker is not None
+            wait_until(
+                lambda: server.event_broker.stats()["subscribers"] >= 1,
+                timeout=5.0,
+                msg="deployments-watcher manager subscription",
+            )
+        finally:
+            server.stop()
+
+    def test_watcher_falls_back_to_blocking_query(self):
+        server = make_server(extra={"event_broker": {"enabled": False}})
+        try:
+            assert server.event_broker is None
+            # deployment machinery still runs on the poll path
+            assert server.deployment_watcher is not None
+        finally:
+            server.stop()

@@ -118,3 +118,148 @@ class TestStatsdSink:
     def test_configure_telemetry_absent_stanza_is_none(self):
         assert metrics.configure_telemetry({}) is None
         assert metrics.configure_telemetry({"telemetry": {}}) is None
+
+
+class TestDogstatsdSink:
+    """dogstatsd = statsd + |#k:v tag blocks (the go-metrics datadog
+    sink role, selected by telemetry{datadog_address})."""
+
+    def setup_method(self):
+        metrics.reset()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+
+    def teardown_method(self):
+        self.sock.close()
+        metrics.reset()
+
+    def test_tags_ride_every_line(self):
+        metrics.incr("plan.submitted", 2)
+        metrics.sample("rpc.plan", 0.004)
+        sink = metrics.DogstatsdSink(
+            self.addr, tags={"node": "n1", "region": "global"}
+        )
+        try:
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], snap["timers"])
+            lines = [l for l in recv_lines(self.sock) if l]
+            assert lines
+            assert all(l.endswith("|#node:n1,region:global") for l in lines), lines
+            assert "nomad.plan.submitted:2|c|#node:n1,region:global" in lines
+        finally:
+            sink.close()
+
+    def test_no_tags_is_plain_statsd(self):
+        metrics.incr("a.b", 1)
+        sink = metrics.DogstatsdSink(self.addr)
+        try:
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], snap["timers"])
+            assert "nomad.a.b:1|c" in recv_lines(self.sock)
+        finally:
+            sink.close()
+
+    def test_configured_from_stanza(self):
+        flusher = metrics.configure_telemetry(
+            {"telemetry": {
+                "datadog_address": self.addr,
+                "datadog_tags": ["dc:dc1"],
+                "collection_interval": 0.05,
+            }}
+        )
+        assert flusher is not None
+        try:
+            metrics.incr("dd.ticks", 3)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if "nomad.dd.ticks:3|c|#dc:dc1" in recv_lines(self.sock, 1.0):
+                    return
+            raise AssertionError("tagged metric never arrived")
+        finally:
+            flusher.stop()
+
+
+class TestStatsiteSink:
+    """statsite = the same line protocol over one persistent TCP
+    connection (telemetry{statsite_address})."""
+
+    def setup_method(self):
+        metrics.reset()
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(2)
+        self.addr = f"127.0.0.1:{self.listener.getsockname()[1]}"
+
+    def teardown_method(self):
+        self.listener.close()
+        metrics.reset()
+
+    def _accept_lines(self, deadline=5.0):
+        self.listener.settimeout(deadline)
+        conn, _ = self.listener.accept()
+        conn.settimeout(deadline)
+        data = b""
+        try:
+            while not data.endswith(b"\n"):
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        except socket.timeout:
+            pass
+        finally:
+            conn.close()
+        return data.decode().splitlines()
+
+    def test_lines_reach_tcp_listener(self):
+        metrics.incr("plan.submitted", 4)
+        metrics.sample("rpc.plan", 0.002)
+        sink = metrics.StatsiteSink(self.addr)
+        try:
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], snap["timers"])
+            lines = self._accept_lines()
+            assert "nomad.plan.submitted:4|c" in lines
+            assert any(
+                l.startswith("nomad.rpc.plan.mean:") and l.endswith("|ms")
+                for l in lines
+            )
+        finally:
+            sink.close()
+
+    def test_reconnects_after_receiver_restart(self):
+        sink = metrics.StatsiteSink(self.addr)
+        try:
+            metrics.incr("s.ticks", 1)
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})
+            assert "nomad.s.ticks:1|c" in self._accept_lines()
+            # the receiver closed that connection. A write into the
+            # half-closed socket may "succeed" before the RST arrives, so
+            # flush until the sink notices and redials — it must land on
+            # a fresh connection within a few attempts, never raise.
+            for attempt in range(10):
+                sink.emit({"s.reconnect": float(attempt + 1)}, {})
+                try:
+                    lines = self._accept_lines(0.5)
+                except socket.timeout:
+                    continue
+                assert any(
+                    l.startswith("nomad.s.reconnect:") for l in lines
+                )
+                return
+            raise AssertionError("sink never redialed the receiver")
+        finally:
+            sink.close()
+
+    def test_unreachable_receiver_never_raises(self):
+        self.listener.close()
+        sink = metrics.StatsiteSink(self.addr)
+        try:
+            metrics.incr("x.y", 1)
+            snap = metrics.snapshot()
+            sink.emit(snap["counters"], {})  # best-effort: swallows
+        finally:
+            sink.close()
