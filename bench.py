@@ -352,6 +352,24 @@ def oracle_parity_windows(job, placed_fast, window_specs, seed=11):
     return stats, results
 
 
+def _kernel_cache_size() -> int:
+    """Total compiled-program cache entries across the jitted planners —
+    the recompile detector: a sample whose delta is nonzero paid an XLA
+    trace+compile inside its timed window (shape-ladder miss), which is
+    exactly the outlier signature the samples_detail splits can't separate
+    from chip contention on their own."""
+    try:
+        from nomad_tpu.tpu import kernel
+
+        return (
+            kernel._plan_batch_jit._cache_size()
+            + kernel._plan_batch_runs_jit._cache_size()
+            + kernel._plan_batch_windowed_jit._cache_size()
+        )
+    except Exception:
+        return -1
+
+
 def bench_headline():
     from nomad_tpu.state import StateStore
     from nomad_tpu.tpu import batch_sched
@@ -399,17 +417,25 @@ def bench_headline():
         # the previous run's garbage doesn't land inside a timed window
         # (a suspect for the r4 1.09s outlier sample)
         gc.collect()
+        cache0 = _kernel_cache_size()
         t, placed = run_once(state, job)
         s = dict(batch_sched.LAST_KERNEL_STATS)
         samples.append(round(t, 4))
         k = s.get("kernel_s", 0.0)
         c = s.get("columnar_s", 0.0)
+        cache1 = _kernel_cache_size()
         samples_detail.append({
             "total_s": round(t, 4),
             "kernel_s": round(k, 4),
             "columnar_s": round(c, 4),
             "other_s": round(max(t - k - c, 0.0), 4),
             "mode": s.get("mode"),
+            # nonzero ⇒ this sample paid an XLA compile (shape-ladder
+            # miss); None ⇒ the detector itself is unavailable (private
+            # jax cache API changed) — never a silent 0
+            "recompiles": (
+                cache1 - cache0 if cache0 >= 0 and cache1 >= 0 else None
+            ),
         })
         if elapsed is None or t < elapsed:
             elapsed, placed_fast, stats = t, placed, s
@@ -643,6 +669,9 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
         "heartbeat_ttl": 600.0,
         "default_scheduler": "tpu-batch",
         "batch_drain": drain,
+        # fold whole drain waves into one consensus round (the knob the
+        # plan.apply_batch_size histogram in /v1/metrics is tuned against)
+        "plan_apply_batch": drain,
         "raft": {
             "node_id": "s0",
             "address": "raft0",
@@ -667,6 +696,12 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
     try:
         for node in build_nodes(n_nodes):
             server.node_register(node)
+        # compile the fused drain-batch shapes before the timed window
+        # (same methodology as the headline's untimed warmup pass; the
+        # persistent .jax_cache makes this a load after the first run)
+        from nomad_tpu.tpu.warmup import prewarm_drain
+
+        prewarm_drain(n_nodes, drain)
         rng = random.Random(11)
         jobs = []
         for _ in range(n_jobs):
@@ -700,11 +735,20 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
         # that names the saturation stage instead of guessing at it
         from nomad_tpu import metrics as metrics_mod
 
+        snap_metrics = metrics_mod.snapshot()
         stages = {
             k: v
-            for k, v in metrics_mod.snapshot()["timers"].items()
-            if k.startswith("plan.") or k.startswith("worker.")
+            for k, v in snap_metrics["timers"].items()
+            if k.startswith("plan.")
+            or k.startswith("worker.")
+            or k.startswith("mirror.")
+            or k.startswith("drain.")
         }
+        mirror_stats = (
+            server.columnar_mirror.stats()
+            if server.columnar_mirror is not None
+            else {}
+        )
         return {
             "jobs": n_jobs,
             "nodes": n_nodes,
@@ -720,6 +764,15 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
                 sum(depth_samples) / max(len(depth_samples), 1), 2
             ),
             "stages": stages,
+            # incremental columnar mirror accounting (tpu/mirror.py): how
+            # many drain batches were served by O(delta) patches vs full
+            # rebuilds, plus the observed plan-fold histogram
+            "mirror_hits": mirror_stats.get("hits", 0),
+            "mirror_rebuilds": mirror_stats.get("rebuilds", 0),
+            "mirror_rebuild_reasons": mirror_stats.get("rebuild_reasons", {}),
+            "plan_apply_batch_hist": snap_metrics.get("hists", {}).get(
+                "plan.apply_batch_size", {}
+            ),
         }
     finally:
         stop_sampler.set()
@@ -874,19 +927,52 @@ def main():
         f"median={headline.get('median_s')}s",
         f"worst={headline.get('worst_s')}s",
         f"parity={detail['parity']}",
+        "recompiles="
+        + (
+            "unknown"
+            if any(
+                d.get("recompiles") is None
+                for d in headline.get("samples_detail", [])
+            )
+            else str(
+                sum(
+                    d.get("recompiles", 0)
+                    for d in headline.get("samples_detail", [])
+                )
+            )
+        ),
     ]
     if "config2" in detail:
         parts.append(f"cfg2={detail['config2'].get('evals_per_s')}evals/s")
         parts.append(f"cfg3={detail['config3'].get('end_to_end_s')}s")
         parts.append(f"cfg5={detail['config5'].get('wall_s')}s")
-        parts.append(f"drain={detail['drain'].get('evals_per_s')}evals/s")
+        drain_d = detail["drain"]
+        parts.append(f"drain={drain_d.get('evals_per_s')}evals/s")
+        parts.append(
+            f"mirror={drain_d.get('mirror_hits')}hit/"
+            f"{drain_d.get('mirror_rebuilds')}rebuild"
+        )
+        apply_delta = (drain_d.get("stages") or {}).get(
+            "mirror.apply_delta", {}
+        )
+        parts.append(f"mirror_apply_mean={apply_delta.get('mean_ms', 0)}ms")
+        parts.append(f"mirror_apply_p99={apply_delta.get('p99_ms', 0)}ms")
+        ws = detail.get("worker_scaling", [])
         parts.append(
             "workers="
-            + "/".join(
-                str(w.get("evals_per_s"))
-                for w in detail.get("worker_scaling", [])
-            )
+            + "/".join(str(w.get("evals_per_s")) for w in ws)
             + "evals/s@1,2,4"
+        )
+        invokes = [
+            (w.get("stages") or {})
+            .get("worker.invoke_scheduler.tpu-batch", {})
+            .get("mean_ms")
+            for w in ws
+        ]
+        parts.append(
+            "invoke_mean="
+            + "/".join(str(v) for v in invokes)
+            + "ms@1,2,4"
         )
     print("BENCH_SUMMARY " + " ".join(parts))
 

@@ -1235,6 +1235,13 @@ class HTTPServer:
             # evals rode the TPU path, by mode, and why the rest didn't
             "tpu_scheduler": batch_sched.counters_snapshot(),
             "drain": dict(drain_mod.DRAIN_COUNTERS),
+            # incremental columnar mirror (tpu/mirror.py): delta-apply hit
+            # rate vs full rebuilds, by rebuild reason
+            "tpu_mirror": (
+                self.server.columnar_mirror.stats()
+                if getattr(self.server, "columnar_mirror", None) is not None
+                else {}
+            ),
         }
         if query.get("format") == "prometheus":
             # text exposition (the reference's prometheus telemetry sink,

@@ -105,6 +105,9 @@ def server_config_from_agent(config: dict) -> dict:
         "deployment_gc_threshold",
         "default_scheduler",
         "batch_drain",
+        "plan_apply_batch",
+        "prewarm_kernels",
+        "prewarm_drain_nodes",
         "seed",
     ):
         if key in server:

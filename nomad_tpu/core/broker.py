@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
 import time
 from typing import Optional
 
 from ..structs.model import Evaluation, generate_uuid
+
+logger = logging.getLogger("nomad_tpu.eval_broker")
 
 FAILED_QUEUE = "_failed"
 
@@ -29,6 +32,92 @@ DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
 
 class BrokerError(Exception):
     pass
+
+
+class _TimerHandle:
+    """Cancelable entry in the shared timer wheel; mimics the only part of
+    the threading.Timer surface the broker used (``cancel``)."""
+
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class _TimerWheel:
+    """ONE shared timer thread replacing per-eval ``threading.Timer``s.
+
+    ``threading.Timer`` spawns a whole OS thread per arm — and the broker
+    arms on every dequeue, lease reset, pause/resume and nack re-enqueue.
+    At drain batch sizes that was hundreds of thread spawns per second on
+    the scheduling hot path (it profiled as the single largest non-wait
+    cost in the drain worker). Entries are lazily invalidated: ``cancel``
+    flips a flag and the wheel skips the entry at its deadline — the same
+    guarantee Timer.cancel gives (an already-running callback can't be
+    stopped either way; the broker's lock + paused-set checks remain the
+    real guards)."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._compact_at = 64
+
+    def arm(self, delay: float, fn, args: tuple) -> _TimerHandle:
+        handle = _TimerHandle()
+        deadline = time.monotonic() + delay
+        with self._cond:
+            heapq.heappush(
+                self._heap, (deadline, next(self._seq), handle, fn, args)
+            )
+            if len(self._heap) >= self._compact_at:
+                # drop cancelled entries eagerly: most nack timers cancel
+                # within milliseconds of a 60s deadline, and a lazily-kept
+                # entry pins its broker (bound method) until the deadline
+                self._heap = [e for e in self._heap if not e[2].cancelled]
+                heapq.heapify(self._heap)
+                self._compact_at = max(64, 2 * len(self._heap))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="eval-broker-timers"
+                )
+                self._thread.start()
+            self._cond.notify()
+        return handle
+
+    def _run(self):
+        while True:
+            due = []
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    while self._heap and self._heap[0][0] <= now:
+                        due.append(heapq.heappop(self._heap))
+                    if due:
+                        break
+                    wait = self._heap[0][0] - now if self._heap else None
+                    self._cond.wait(wait)
+            for _, _, handle, fn, args in due:
+                if handle.cancelled:
+                    continue
+                try:
+                    fn(*args)
+                except Exception:
+                    # never kill the wheel, but never lose the trace either
+                    # (a failed _enqueue_waiting means a silently lost eval)
+                    logger.exception(
+                        "broker timer callback %s%r failed",
+                        getattr(fn, "__name__", fn), args,
+                    )
+
+
+#: module-level singleton: brokers come and go (tests spin up servers by
+#: the dozen) but at most one timer thread ever exists
+_WHEEL = _TimerWheel()
 
 
 class _PendingHeap:
@@ -76,14 +165,14 @@ class EvalBroker:
         # scheduler type -> ready heap
         self._ready: dict[str, _PendingHeap] = {}
         # eval id -> (eval, token, nack timer)
-        self._unack: dict[str, tuple[Evaluation, str, threading.Timer]] = {}
+        self._unack: dict[str, tuple[Evaluation, str, _TimerHandle]] = {}
         # evals whose nack timer is paused (plan in flight); checked by the
         # timer path under the lock since cancel() can't stop a fired timer
         self._paused: set[str] = set()
         # token -> eval to requeue on ack
         self._requeue: dict[str, Evaluation] = {}
         # eval id -> wait timer
-        self._time_wait: dict[str, threading.Timer] = {}
+        self._time_wait: dict[str, _TimerHandle] = {}
 
     # ------------------------------------------------------------------
     def set_enabled(self, enabled: bool):
@@ -125,10 +214,9 @@ class EvalBroker:
             now = time.time_ns()
             delay = max((ev.wait_until - now) / 1e9, 0.0)
             if delay > 0:
-                timer = threading.Timer(delay, self._enqueue_waiting, args=(ev,))
-                timer.daemon = True
-                self._time_wait[ev.id] = timer
-                timer.start()
+                self._time_wait[ev.id] = _WHEEL.arm(
+                    delay, self._enqueue_waiting, (ev,)
+                )
                 return
 
         self._enqueue_locked(ev, ev.type)
@@ -210,10 +298,9 @@ class EvalBroker:
         token = generate_uuid()
         self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
 
-        timer = threading.Timer(self.nack_timeout, self._nack_timeout, args=(ev.id, token))
-        timer.daemon = True
-        self._unack[ev.id] = (ev, token, timer)
-        timer.start()
+        self._unack[ev.id] = (
+            ev, token, _WHEEL.arm(self.nack_timeout, self._nack_timeout, (ev.id, token))
+        )
         return ev, token
 
     def _nack_timeout(self, eval_id: str, token: str):
@@ -242,12 +329,10 @@ class EvalBroker:
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
             timer.cancel()
-            fresh = threading.Timer(
-                self.nack_timeout, self._nack_timeout, args=(eval_id, token)
+            self._unack[eval_id] = (
+                ev, token,
+                _WHEEL.arm(self.nack_timeout, self._nack_timeout, (eval_id, token)),
             )
-            fresh.daemon = True
-            self._unack[eval_id] = (ev, token, fresh)
-            fresh.start()
 
     def pause_nack_timeout(self, eval_id: str, token: str):
         """Pause the nack timer while the eval's plan waits in the plan
@@ -279,12 +364,10 @@ class EvalBroker:
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
             self._paused.discard(eval_id)
-            timer = threading.Timer(
-                self.nack_timeout, self._nack_timeout, args=(eval_id, token)
+            self._unack[eval_id] = (
+                ev, token,
+                _WHEEL.arm(self.nack_timeout, self._nack_timeout, (eval_id, token)),
             )
-            timer.daemon = True
-            self._unack[eval_id] = (ev, token, timer)
-            timer.start()
 
     def ack(self, eval_id: str, token: str):
         """ref eval_broker.go:531-592"""
@@ -339,10 +422,9 @@ class EvalBroker:
             else:
                 delay = self._nack_reenqueue_delay(dequeues)
                 if delay > 0:
-                    t = threading.Timer(delay, self._enqueue_waiting, args=(ev,))
-                    t.daemon = True
-                    self._time_wait[ev.id] = t
-                    t.start()
+                    self._time_wait[ev.id] = _WHEEL.arm(
+                        delay, self._enqueue_waiting, (ev,)
+                    )
                 else:
                     self._enqueue_locked(ev, ev.type)
             self._cond.notify_all()

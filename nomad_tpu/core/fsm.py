@@ -626,10 +626,16 @@ def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
     """Canonical slim alloc doc from post-apply state (client updates
     ship only client-owned fields, so the payload alone can't provide
     job/deployment filter keys); falls back to the payload doc when the
-    alloc is already GC'd."""
+    alloc is already GC'd. Carries the alloc's dense usage vector and
+    terminal flag so the columnar mirror (tpu/mirror.py) can patch its
+    ``used`` plane from the event alone — derived here, synchronously
+    inside the apply, so the vector reflects exactly this raft index."""
     stored = state.alloc_by_id(alloc_id)
     if stored is None:
-        return dict(fallback or {}, id=alloc_id)
+        # already deleted: whatever it contributed is gone with it
+        return dict(fallback or {}, id=alloc_id, _terminal=True)
+    from ..tpu.mirror import usage_vec
+
     return {
         "id": stored.id,
         "namespace": stored.namespace,
@@ -640,6 +646,8 @@ def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
         "client_status": stored.client_status,
         "eval_id": stored.eval_id,
         "deployment_id": stored.deployment_id,
+        "_terminal": stored.terminal_status(),
+        "_usage": usage_vec(stored),
     }
 
 
@@ -652,21 +660,28 @@ def _alloc_event(index: int, doc: dict, event_type: str) -> "Event":
             doc.get("eval_id"), doc.get("deployment_id"),
         ) if k
     )
+    payload = {
+        "ID": doc.get("id", ""),
+        "JobID": doc.get("job_id", ""),
+        "NodeID": doc.get("node_id", ""),
+        "TaskGroup": doc.get("task_group", ""),
+        "DesiredStatus": doc.get("desired_status", ""),
+        "ClientStatus": doc.get("client_status", ""),
+        "DeploymentID": doc.get("deployment_id", ""),
+    }
+    if "_terminal" in doc:
+        # mirror-plane fields (tpu/mirror.py): terminality + the alloc's
+        # dense (cpu, mem, disk, mbits) contribution at this raft index
+        payload["Terminal"] = bool(doc["_terminal"])
+        if doc.get("_usage") is not None:
+            payload["Resources"] = list(doc["_usage"])
     return Event(
         topic=TOPIC_ALLOC,
         type=event_type,
         key=doc.get("id", ""),
         index=index,
         namespace=doc.get("namespace", "default"),
-        payload={
-            "ID": doc.get("id", ""),
-            "JobID": doc.get("job_id", ""),
-            "NodeID": doc.get("node_id", ""),
-            "TaskGroup": doc.get("task_group", ""),
-            "DesiredStatus": doc.get("desired_status", ""),
-            "ClientStatus": doc.get("client_status", ""),
-            "DeploymentID": doc.get("deployment_id", ""),
-        },
+        payload=payload,
         filter_keys=filter_keys,
     )
 
@@ -812,7 +827,15 @@ def _plan_events(state, index: int, payload: dict) -> list:
     )
     for allocs in (result.get("node_allocation") or {}).values():
         for doc in allocs:
-            events.append(_alloc_event(index, doc, "AllocationUpdated"))
+            # placements were just upserted: read them back post-apply so
+            # the event carries the canonical doc (incl. the usage vector
+            # the columnar mirror patches from)
+            events.append(
+                _alloc_event(
+                    index, _alloc_doc(state, doc.get("id", ""), doc),
+                    "AllocationUpdated",
+                )
+            )
     # stops/preemptions travel as id+field diffs when normalized; the
     # full documents live in this replica's (post-apply) state
     for diff_map, etype in (

@@ -342,6 +342,10 @@ class Planner:
         # batch commit hook: ([(plan, result, preemption_evals)]) -> index;
         # commits several independently-verified plans in ONE raft entry.
         self.commit_batch_fn = None
+        # per-instance fold cap (server stanza `plan_apply_batch`); the
+        # class constant stays as the default so direct constructions and
+        # old call sites keep the historical behavior
+        self.max_apply_batch = self.MAX_APPLY_BATCH
 
     def start(self):
         self.queue.set_enabled(True)
@@ -355,19 +359,30 @@ class Planner:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
-    #: max plans folded into one consensus round; bounded so a commit
-    #: failure (which fails the whole batch) stays cheap to retry
+    #: default max plans folded into one consensus round; bounded so a
+    #: commit failure (which fails the whole batch) stays cheap to retry.
+    #: Tunable per server via the `plan_apply_batch` stanza key (set on
+    #: ``max_apply_batch``); observed fold sizes land in the
+    #: plan.apply_batch_size histogram so the knob can be tuned against
+    #: the worker-scaling knee without a code change.
     MAX_APPLY_BATCH = 16
 
     def _verify_batch(self, live, snap):
         """Verify each plan against the CUMULATIVE optimistic snapshot so
         later plans in the batch can't double-book capacity earlier ones
-        took. Returns (entries, snap, leftovers): entries =
-        [(pending, result)] to commit, snap = the stacked snapshot, and
+        took. Returns (entries, snap, leftovers, noops): entries =
+        [(pending, result)] to commit, snap = the stacked snapshot,
         leftovers = plans to requeue if optimistic stacking ever fails
         mid-batch (verifying them against a snapshot missing an accepted
-        sibling would double-book)."""
+        sibling would double-book), and noops = fully-rejected plans whose
+        response must wait for a REAL index (see _respond_refreshed: an
+        optimistic snapshot's latest_index is synthetic — bumped once per
+        stacked plan while a batched commit advances the real store index
+        once per BATCH — so handing it out as a refresh index makes the
+        worker wait for an index the store may reach only much later, or
+        never between writes)."""
         entries = []
+        noops = []
         for i, p in enumerate(live):
             try:
                 with metrics.measure("plan.evaluate"):
@@ -376,7 +391,7 @@ class Planner:
                 p.respond(None, e)
                 continue
             if result.is_no_op() and result.refresh_index:
-                p.respond(result, None)
+                noops.append((p, result))
                 continue
             entries.append((p, result))
             try:
@@ -389,8 +404,22 @@ class Planner:
                 # reusing the partial snap would double-book entry i's
                 # capacity (the pre-batching code forced snap=None on
                 # exactly this failure)
-                return entries, None, live[i + 1:]
-        return entries, snap, []
+                return entries, None, live[i + 1:], noops
+        return entries, snap, [], noops
+
+    def _respond_refreshed(self, noops, index: Optional[int] = None):
+        """Answer fully-rejected plans with a refresh index that is REAL:
+        the just-committed batch's index when one exists (it contains the
+        whole optimistic world the rejection was computed against), else
+        the store's current index. Never the synthetic optimistic index —
+        a worker must not block on an index that only exists inside the
+        applier's scratch overlay."""
+        if not noops:
+            return
+        real = index if index is not None else self.state.latest_index()
+        for p, result in noops:
+            result.refresh_index = min(result.refresh_index, real)
+            p.respond(result, None)
 
     def _apply_loop(self):
         """Overlap verify(N+1) with raft-apply(N) (ref plan_apply.go:49-180):
@@ -415,7 +444,7 @@ class Planner:
             head = self.queue.dequeue(timeout=0.2)
             if head is None:
                 continue
-            batch = [head] + self.queue.drain(self.MAX_APPLY_BATCH - 1)
+            batch = [head] + self.queue.drain(self.max_apply_batch - 1)
             now = time.monotonic()
             live = []
             for p in batch:
@@ -464,10 +493,11 @@ class Planner:
                         p.respond(None, e)
                     continue
 
-            entries, snap, leftovers = self._verify_batch(live, snap)
+            entries, snap, leftovers, noops = self._verify_batch(live, snap)
             if leftovers:
                 self.queue.requeue(leftovers)
             if not entries:
+                self._respond_refreshed(noops)
                 continue
 
             # one commit in flight at a time: wait out the previous one and
@@ -488,18 +518,26 @@ class Planner:
                 except Exception as e:
                     for p, _ in entries:
                         p.respond(None, e)
+                    # the rejected siblings need nothing from the commit:
+                    # answer them with their (valid) no-op verdicts at the
+                    # store's real index instead of surfacing the failure
+                    self._respond_refreshed(noops)
                     continue
                 snap_base_index = fresh.latest_index()
                 if not committed:
                     # the previous commit FAILED: this batch was verified
                     # against an optimistic world that never materialized —
-                    # re-verify against reality before committing
-                    entries, snap, leftovers = self._verify_batch(
-                        [p for p, _ in entries], fresh
+                    # re-verify against reality before committing. The
+                    # noops re-verify too: one may have been judged no-op
+                    # only because a phantom sibling took its capacity.
+                    entries, snap, leftovers, noops = self._verify_batch(
+                        [p for p, _ in entries] + [p for p, _ in noops],
+                        fresh,
                     )
                     if leftovers:
                         self.queue.requeue(leftovers)
                     if not entries:
+                        self._respond_refreshed(noops)
                         continue
                 else:
                     # re-base: the fresh snapshot holds the committed batch
@@ -517,7 +555,7 @@ class Planner:
             box: dict = {}
             t = threading.Thread(
                 target=self._async_commit_batch,
-                args=(entries, box),
+                args=(entries, noops, box),
                 daemon=True,
             )
             t.start()
@@ -539,15 +577,23 @@ class Planner:
         return scratch.snapshot()
 
     def _async_commit_batch(
-        self, entries: list[tuple[PendingPlan, PlanResult]], box: dict
+        self, entries: list[tuple[PendingPlan, PlanResult]], noops: list,
+        box: dict,
     ):
         """Commit a batch of verified results in one consensus round and
         answer every submitting worker (ref plan_apply.go:367
-        asyncPlanWait; batching amortizes the raft fsync)."""
+        asyncPlanWait; batching amortizes the raft fsync). Fully-rejected
+        siblings (``noops``) are answered here too, carrying the commit's
+        REAL index as their refresh point — the optimistic index they were
+        verified at exists only inside the applier's scratch overlay."""
         try:
             # chaos seam: a rule here fails/partitions the leader at the
             # worst moment — results verified, consensus not yet reached
             _faults.fault_point("plan.raft_apply")
+            # observed fold size (how many plans actually share this
+            # consensus round) — the histogram operators tune
+            # `plan_apply_batch` against
+            metrics.observe("plan.apply_batch_size", len(entries))
             items = []
             for pending, result in entries:
                 preemption_evals: list[Evaluation] = []
@@ -578,7 +624,12 @@ class Planner:
             box["index"] = index
             for pending, result in entries:
                 result.alloc_index = index
+                if result.refresh_index:
+                    # partial commits carry a refresh point: clamp the
+                    # synthetic optimistic index to the real committed one
+                    result.refresh_index = min(result.refresh_index, index)
                 pending.respond(result, None)
+            self._respond_refreshed(noops, index)
         except _faults.SimulatedCrash:
             # injected leader death mid-commit: the entry never reached
             # consensus. Answer the workers with failure so their evals
@@ -588,8 +639,12 @@ class Planner:
             err = RuntimeError("plan commit crashed (injected leader death)")
             for pending, _ in entries:
                 pending.respond(None, err)
+            for pending, _ in noops:
+                pending.respond(None, err)
         except Exception as e:
             for pending, _ in entries:
+                pending.respond(None, e)
+            for pending, _ in noops:
                 pending.respond(None, e)
 
     def _async_commit(self, pending: PendingPlan, result: PlanResult, box: dict):

@@ -106,7 +106,23 @@ class Server:
             time_table=self.time_table,
             event_broker=self.event_broker,
         )
+        # event-driven incremental columnar mirror (tpu/mirror.py): the
+        # TPU drain path's dense state plane, patched O(delta) from the
+        # broker's Node/Alloc/PlanResult frames instead of rebuilt per
+        # state generation. Subscribes lazily on first drain batch.
+        self.columnar_mirror = None
+        if self.event_broker is not None:
+            from ..tpu.mirror import ColumnarMirror
+
+            self.columnar_mirror = ColumnarMirror(self.state, self.event_broker)
         self.planner = Planner(self.state)
+        # max independently-verified plans folded into ONE raft entry
+        # (server stanza `plan_apply_batch`; the observed fold sizes are
+        # exported as the plan.apply_batch_size histogram in /v1/metrics)
+        self.planner.max_apply_batch = max(
+            1, int(self.config.get("plan_apply_batch",
+                                   self.planner.max_apply_batch))
+        )
         self.planner.commit_fn = self._commit_plan
         self.planner.commit_batch_fn = self._commit_plan_batch
         self.planner.preemption_evals_fn = self._make_preemption_evals
@@ -703,10 +719,17 @@ class Server:
         if self.config.get("prewarm_kernels"):
             # compile the planner shape ladder in the background so the
             # first real eval doesn't eat the cold-compile latency
-            # (tpu/warmup.py; persists via the on-disk compilation cache)
+            # (tpu/warmup.py; persists via the on-disk compilation cache).
+            # With batch_drain + an expected cluster size, the fused
+            # drain-batch shapes prewarm too.
             from ..tpu.warmup import prewarm_async
 
-            prewarm_async()
+            drain_shape = None
+            drain_cfg = int(self.config.get("batch_drain", 0))
+            nodes_hint = int(self.config.get("prewarm_drain_nodes", 0))
+            if drain_cfg > 1 and nodes_hint > 0:
+                drain_shape = (nodes_hint, drain_cfg)
+            self._prewarm_thread = prewarm_async(drain=drain_shape)
         self.raft.start()
         if self.gossip is not None:
             self.gossip.start()
@@ -764,6 +787,8 @@ class Server:
         self.workers = []
         self._revoke_leadership()
         self.raft.shutdown()
+        if self.columnar_mirror is not None:
+            self.columnar_mirror.close()
         if self.event_broker is not None:
             self.event_broker.shutdown()
         pool = getattr(self, "_outbound_pool", None)

@@ -13,6 +13,7 @@ from contextlib import contextmanager
 _LOCK = threading.Lock()
 _COUNTERS: dict[str, float] = {}
 _TIMERS: dict[str, list[float]] = {}
+_HISTS: dict[str, dict[int, int]] = {}
 
 TIMER_WINDOW = 512  # samples retained per timer
 
@@ -20,6 +21,16 @@ TIMER_WINDOW = 512  # samples retained per timer
 def incr(name: str, value: float = 1.0):
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0.0) + value
+
+
+def observe(name: str, value):
+    """Exact-value histogram: counts per observed integer value (e.g. the
+    plan.apply_batch_size distribution). Values are small discrete sizes,
+    so no bucketing scheme is needed."""
+    with _LOCK:
+        hist = _HISTS.setdefault(name, {})
+        key = int(value)
+        hist[key] = hist.get(key, 0) + 1
 
 
 def sample(name: str, seconds: float):
@@ -41,10 +52,12 @@ def measure(name: str):
 
 
 def snapshot() -> dict:
-    """{counters: {...}, timers: {name: {count, mean_ms, p99_ms, max_ms}}}"""
+    """{counters, timers: {name: {count, mean_ms, p99_ms, max_ms}},
+    hists: {name: {value: count}}}"""
     with _LOCK:
         counters = dict(_COUNTERS)
         timers = {k: list(v) for k, v in _TIMERS.items()}
+        hists = {k: dict(v) for k, v in _HISTS.items()}
     out_timers = {}
     for name, samples in timers.items():
         if not samples:
@@ -57,7 +70,7 @@ def snapshot() -> dict:
             "p99_ms": round(p99 * 1e3, 3),
             "max_ms": round(ordered[-1] * 1e3, 3),
         }
-    return {"counters": counters, "timers": out_timers}
+    return {"counters": counters, "timers": out_timers, "hists": hists}
 
 
 def reset():
@@ -65,6 +78,7 @@ def reset():
     with _LOCK:
         _COUNTERS.clear()
         _TIMERS.clear()
+        _HISTS.clear()
 
 
 # ---------------------------------------------------------------------------

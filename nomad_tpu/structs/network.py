@@ -54,7 +54,16 @@ class NetworkIndex:
         self.avail_bandwidth: dict[str, int] = {}
         self.used_ports: dict[str, Bitmap] = {}
         self.used_bandwidth: dict[str, int] = {}
-        self.rng = rng or random.Random()
+        # lazy: seeding a fresh Mersenne state costs ~ms-scale urandom
+        # reads, and the plan-verify hot path builds a NetworkIndex per
+        # touched node without ever assigning a port
+        self._rng = rng
+
+    @property
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random()
+        return self._rng
 
     def release(self):
         """No-op (the Go version pools bitmaps; numpy makes this unnecessary)."""

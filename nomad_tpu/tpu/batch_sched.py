@@ -212,24 +212,49 @@ class TPUBatchScheduler(GenericScheduler):
                 prep = self._prepare_drain(place, collector.shared)
                 if prep is not None:
                     placements, used0 = collector.submit(prep)
-                    _count_kernel(drain=True)
                     eligible = np.zeros(len(collector.shared.nodes), dtype=bool)
                     eligible[prep.perm_eligible] = True
-                    self._materialize(
-                        place,
-                        placements,
-                        collector.shared.nodes,
-                        prep.by_dc,
-                        prep.planes_list,
-                        prep.g_index,
-                        prep.gid_real,
-                        used0,
-                        collector.shared.capacity,
-                        prep.g_demand,
-                        eligible=eligible,
-                        shared_net_indexes=collector.net_indexes,
-                        shared_net_lock=collector.net_lock,
-                    )
+                    try:
+                        # placements/used0 are device arrays handed back at
+                        # dispatch; _materialize's np.asarray is the sync
+                        # point, overlapping template/id prep with device
+                        # compute (an async XLA failure surfaces there)
+                        self._materialize(
+                            place,
+                            placements,
+                            collector.shared.nodes,
+                            prep.by_dc,
+                            prep.planes_list,
+                            prep.g_index,
+                            prep.gid_real,
+                            used0,
+                            collector.shared.capacity,
+                            prep.g_demand,
+                            eligible=eligible,
+                            shared_net_indexes=collector.net_indexes,
+                            shared_net_lock=collector.net_lock,
+                        )
+                    except KernelFault as e:
+                        # the fused device tier failed after dispatch:
+                        # degrade THIS eval to the scalar oracle so it
+                        # completes normally, one tier slower
+                        from .. import metrics
+
+                        logger.warning(
+                            "drain kernel fault (%s); eval %s degrades to "
+                            "the oracle path",
+                            e,
+                            self.eval.id if self.eval is not None else "?",
+                        )
+                        metrics.incr("scheduler.kernel_fault_degrade")
+                        _count_fallback("kernel_fault")
+                        note = getattr(self.planner, "note_kernel_fault", None)
+                        if note is not None:
+                            note(str(e))
+                        return super()._compute_placements([], place)
+                    # counted only on success so an eval degraded by a
+                    # device fault isn't attributed to both tiers
+                    _count_kernel(drain=True)
                     return
             collector.leave(self.eval.id)
 
@@ -356,21 +381,32 @@ class TPUBatchScheduler(GenericScheduler):
         if not nodes_elig:
             return None
         groups = {p.task_group.name: p.task_group for p in place}
-        if self._group_asks_network(groups) and not bool(
-            shared.cluster.single_nic.all()
-        ):
-            return None  # per-device bandwidth: the solo path's oracle escape
-
-        shuffled = list(nodes_elig)
-        shuffle_nodes(ctx, shuffled)
         index = shared.cluster.index
         try:
-            perm_eligible = np.fromiter(
-                (index[n.id] for n in shuffled), dtype=np.int32, count=len(shuffled)
+            elig_rows = np.fromiter(
+                (index[n.id] for n in nodes_elig),
+                dtype=np.int32,
+                count=len(nodes_elig),
             )
         except KeyError:
             # eligible node missing from the shared cluster (snapshot skew)
             return None
+        if self._group_asks_network(groups) and not bool(
+            shared.cluster.single_nic[elig_rows].all()
+        ):
+            # per-device bandwidth: the solo path's oracle escape — BEFORE
+            # the seeded shuffle so the fallback replays the same rng
+            # stream. Checked over THIS eval's eligible ring only: the
+            # mirror's cluster spans all nodes, and a down multi-NIC node
+            # that can never be placed on must not unbatch every
+            # network-asking eval.
+            return None
+
+        shuffled = list(nodes_elig)
+        shuffle_nodes(ctx, shuffled)
+        perm_eligible = np.fromiter(
+            (index[n.id] for n in shuffled), dtype=np.int32, count=len(shuffled)
+        )
 
         planes_list, g_index, g_demand, g_limit, gid_real, collisions0 = (
             self._assemble_groups(
@@ -992,13 +1028,25 @@ class TPUBatchScheduler(GenericScheduler):
 
         placed_idx = placements[: len(place)]
         valid_mask = (placed_idx >= 0) & (placed_idx < n_real)
+        if not valid_mask.all():
+            # failure accounting needs the usage plane, which on the drain
+            # path is a SEPARATE device dispatch from the placements: sync
+            # it here, BEFORE the loops below mutate failed_tg_allocs, so
+            # an async device failure still reaches the degrade path with
+            # no scheduler state touched
+            try:
+                used0 = np.asarray(used0)
+            except Exception as e:
+                raise KernelFault(f"device sync: {e}") from e
 
         def used_at(fail_idx: int) -> np.ndarray:
             """Per-node usage as of placement ``fail_idx`` (placements are in
             scan order, so the prefix of granted demands reconstructs the
             usage the oracle would have seen at that failure moment — later
             placements of other groups don't leak in)."""
-            used = used0[:n_real].astype(np.int64).copy()
+            # used0 was synced to a host array above, before any failure
+            # bookkeeping ran
+            used = np.asarray(used0)[:n_real].astype(np.int64).copy()
             prior = valid_mask.copy()
             prior[fail_idx:] = False
             for gj in range(len(planes_list)):

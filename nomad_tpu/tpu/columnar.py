@@ -11,6 +11,7 @@ String-world constraint evaluation happens here, host-side, exactly once per
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
@@ -53,8 +54,46 @@ class GroupPlanes:
     present0: Optional[np.ndarray] = None  # bool[V]
 
 
-#: small LRU of (nodes_table_index, node-identity fingerprint, cluster)
+#: small LRU of (nodes_table_index, node-identity fingerprint, cluster),
+#: bounded by estimated BYTE size, not entry count — four 10K-node
+#: clusters whose planes caches each hold hundreds of per-group rows can
+#: pin hundreds of MB, while dozens of toy-cluster entries are harmless
 _SHARED_CLUSTERS: list = []
+_SHARED_CLUSTERS_MAX_BYTES = (
+    int(os.environ.get("NOMAD_TPU_CLUSTER_CACHE_MB", "256")) << 20
+)
+#: secondary guard so thousands of byte-tiny toy clusters (test suites)
+#: can't make the lookup scan linear-slow
+_SHARED_CLUSTERS_MAX_ENTRIES = 64
+
+
+def _cluster_nbytes(cluster: "ColumnarCluster") -> int:
+    """Estimated resident bytes of one cached cluster: the dense node-axis
+    arrays plus everything its planes/device caches accumulated (those
+    grow per (job version, group) and dominate on busy clusters)."""
+    total = (
+        cluster.capacity.nbytes
+        + cluster.reserved.nbytes
+        + cluster.usable.nbytes
+        + cluster.single_nic.nbytes
+    )
+    try:
+        # other scheduler threads insert into these caches concurrently;
+        # a torn iteration just under-estimates this sweep — it's a size
+        # heuristic, not an inventory
+        for planes in list(cluster.planes_cache.values()):
+            for arr in (
+                planes.feasible, planes.affinity, planes.affinity_present,
+                planes.node_value, planes.desired, planes.counts0,
+                planes.present0,
+            ):
+                if arr is not None:
+                    total += arr.nbytes
+        for entry in list(cluster.device_planes_cache.values()):
+            total += entry[0].nbytes
+    except RuntimeError:
+        pass
+    return total
 
 
 #: dense resource columns: cpu MHz, memory MB, disk MB, network mbits
@@ -126,7 +165,16 @@ class ColumnarCluster:
                 return entry[2]
         cluster = cls(nodes)
         _SHARED_CLUSTERS.insert(0, (key, fingerprint, cluster))
-        del _SHARED_CLUSTERS[4:]
+        # evict by estimated byte size from the LRU tail (the newest entry
+        # always survives, even when it alone exceeds the budget)
+        total = 0
+        cut = min(len(_SHARED_CLUSTERS), _SHARED_CLUSTERS_MAX_ENTRIES)
+        for i, entry in enumerate(_SHARED_CLUSTERS[:cut]):
+            total += _cluster_nbytes(entry[2])
+            if total > _SHARED_CLUSTERS_MAX_BYTES and i > 0:
+                cut = i
+                break
+        del _SHARED_CLUSTERS[cut:]
         return cluster
 
     @staticmethod

@@ -39,11 +39,29 @@ DRAIN_COUNTERS = {"batches": 0, "evals": 0}
 
 
 class SharedCluster:
-    """The node-axis arrays every eval in a drain batch shares: all ready
-    nodes (any datacenter — per-eval DC eligibility lives in each eval's
-    ring permutation), their capacity planes, and the snapshot usage."""
+    """The node-axis arrays every eval in a drain batch shares (per-eval DC
+    eligibility lives in each eval's ring permutation), their capacity
+    planes, and the snapshot usage.
 
-    def __init__(self, snapshot):
+    With a :class:`~..tpu.mirror.ColumnarMirror` (the server path), the
+    arrays come from the long-lived event-patched mirror — O(delta) per
+    batch, device-resident planes — and span ALL nodes (non-ready nodes
+    simply never enter a ring). Without one (tests, direct harnesses), the
+    legacy ready-node rebuild path is kept."""
+
+    def __init__(self, snapshot, mirror=None):
+        self.gen = getattr(snapshot, "_gen", snapshot)
+        self.mirror = None
+        if mirror is not None:
+            view = mirror.sync(snapshot)
+            if view is not None:
+                self.mirror = mirror
+                self.cluster = view
+                self.nodes = view.nodes
+                self.used0 = view.initial_used(snapshot)
+                self.capacity = view.capacity
+                self.usable = view.usable
+                return
         nodes = [n for n in snapshot.nodes() if n.ready()]
         self.nodes = nodes
         self.cluster = ColumnarCluster.shared(snapshot, nodes)
@@ -74,9 +92,81 @@ class _Parked:
     def __init__(self, prep: DrainPrep):
         self.prep = prep
         self.event = threading.Event()
-        self.placements: Optional[np.ndarray] = None
-        self.used0: Optional[np.ndarray] = None
+        #: this eval's placement slice — a DEVICE array handed back at
+        #: dispatch time; the consumer's np.asarray is the sync point, so
+        #: host-side materialization overlaps device compute
+        self.placements = None
+        #: per-node usage base including every earlier eval's grants,
+        #: computed on device alongside the scan (also handed back lazily)
+        self.used0 = None
         self.error: Optional[BaseException] = None
+
+
+class _LazySlice:
+    """A view of one eval's slice of a batch-wide DEVICE array. Slicing a
+    jax array per parked eval costs a dispatched device op each; this
+    defers to ONE host transfer of the full array (jax caches the host
+    copy on the array) sliced with plain numpy at each consumer's own
+    sync point. np.asarray() works transparently via __array__. The
+    optional ``on_sync`` callback fires after the first successful sync —
+    the collector threads one (shared, once-only) callback through a
+    batch's slices to timestamp device completion without a dedicated
+    watcher thread."""
+
+    __slots__ = ("arr", "sl", "on_sync")
+
+    def __init__(self, arr, sl, on_sync=None):
+        self.arr = arr
+        self.sl = sl
+        self.on_sync = on_sync
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.asarray(self.arr)[self.sl]
+        cb = self.on_sync
+        if cb is not None:
+            self.on_sync = None
+            try:
+                cb()
+            except Exception:  # timing must never fail a consumer
+                logger.debug("lazy-slice sync callback failed", exc_info=True)
+        if dtype is not None and out.dtype != dtype:
+            out = out.astype(dtype)
+        return out
+
+
+#: cached jitted per-eval usage-base program (built on first drain batch;
+#: lazy so oracle-only processes never touch jax)
+_USED_BASES_JIT = None
+
+
+def _used_bases_fn():
+    global _USED_BASES_JIT
+    if _USED_BASES_JIT is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(4,))
+        def bases(used0, placements, demands, eval_of, E, n_real):
+            """used-before-eval-e = used0 + Σ earlier evals' granted
+            demands (exclusive prefix over the eval axis) — the same
+            accounting the host loop used to do after a blocking sync."""
+            N, R = used0.shape
+            valid = (placements >= 0) & (placements < n_real)
+            rows = eval_of * N + jnp.clip(placements, 0, N - 1)
+            contrib = jnp.where(valid[:, None], demands, 0)
+            delta = (
+                jnp.zeros((E * N, R), dtype=used0.dtype).at[rows].add(contrib)
+            ).reshape(E, N, R)
+            shift = jnp.concatenate(
+                [jnp.zeros((1, N, R), dtype=used0.dtype),
+                 jnp.cumsum(delta, axis=0)[:-1]]
+            )
+            return used0[None, :, :] + shift
+
+        _USED_BASES_JIT = bases
+    return _USED_BASES_JIT
 
 
 from .batch_sched import _bucket  # one padding-bucket policy for all kernels
@@ -197,12 +287,24 @@ class KernelBatchCollector:
             )
         )
 
-        capacity = np.zeros((N, R_COLS), dtype=np.int32)
-        capacity[:n_real] = shared.capacity
-        usable = np.ones((N, 2), dtype=np.float32)
-        usable[:n_real] = shared.usable
-        used0 = np.full((N, R_COLS), 2**30, dtype=np.int32)
-        used0[:n_real] = shared.used0
+        # Device-resident state plane (mirror path): capacity/usable were
+        # device_put once per node-axis epoch and the used plane arrives
+        # via dirty-row scatter updates — no O(N) host→device transfer per
+        # batch. Fallback (no mirror / stale gen): pad + upload this
+        # batch's host arrays.
+        cap_in = usable_in = used_in = None
+        if shared.mirror is not None:
+            ds = shared.mirror.device_state(N, shared.gen)
+            if ds is not None:
+                cap_in, usable_in, used_in = ds
+        if used_in is None:
+            capacity = np.zeros((N, R_COLS), dtype=np.int32)
+            capacity[:n_real] = shared.capacity
+            usable = np.ones((N, 2), dtype=np.float32)
+            usable[:n_real] = shared.usable
+            used0 = np.full((N, R_COLS), 2**30, dtype=np.int32)
+            used0[:n_real] = shared.used0
+            cap_in, usable_in, used_in = capacity, usable, used0
 
         feasible = np.zeros((G, N), dtype=bool)
         affinity = np.zeros((G, N), dtype=np.float32)
@@ -232,9 +334,10 @@ class KernelBatchCollector:
         for e, park in enumerate(parked):
             prep = park.prep
             n_elig = len(prep.perm_eligible)
-            rest = np.setdiff1d(
-                np.arange(N, dtype=np.int32), prep.perm_eligible, assume_unique=False
-            )
+            # boolean-mask complement (setdiff1d sorts; ~10x slower here)
+            elig_mask = np.ones(N, dtype=bool)
+            elig_mask[prep.perm_eligible] = False
+            rest = np.flatnonzero(elig_mask).astype(np.int32)
             perm[e] = np.concatenate([prep.perm_eligible, rest])
             ring[e] = n_elig
             for gi, planes in enumerate(prep.planes_list):
@@ -265,8 +368,8 @@ class KernelBatchCollector:
             a_off += a_len
 
         args = BatchArgs(
-            capacity=jnp.asarray(capacity),
-            usable=jnp.asarray(usable),
+            capacity=jnp.asarray(cap_in),
+            usable=jnp.asarray(usable_in),
             feasible=jnp.asarray(feasible),
             affinity=jnp.asarray(affinity),
             affinity_present=jnp.asarray(affinity_present),
@@ -286,7 +389,7 @@ class KernelBatchCollector:
             valid=jnp.asarray(valid),
         )
         init = BatchState(
-            used=jnp.asarray(used0),
+            used=jnp.asarray(used_in),
             collisions=jnp.asarray(collisions0),
             spread_counts=jnp.asarray(counts0),
             spread_present=jnp.asarray(present0),
@@ -294,28 +397,47 @@ class KernelBatchCollector:
         )
         t_build = time.monotonic()
         _, placements = plan_batch(args, init, n_real)
-        placements = np.asarray(placements)
-        t_kernel = time.monotonic()
 
-        # split slices and hand each eval a usage base that includes all
-        # earlier evals' grants (exact sequential semantics for its own
-        # failure accounting)
-        running = shared.used0.copy()
-        for park, a_start, a_len in slices:
-            park.placements = placements[a_start : a_start + a_len]
-            park.used0 = running
-            placed = park.placements
-            ok = (placed >= 0) & (placed < n_real)
-            if ok.any():
-                running = running.copy()
-                prep = park.prep
-                for gj in range(len(prep.planes_list)):
-                    m = ok & (prep.gid_real == gj)
-                    if m.any():
-                        counts = np.bincount(placed[m], minlength=n_real)
-                        running[:n_real] += (
-                            counts[:, None] * prep.g_demand[gj][None, :]
-                        ).astype(np.int64)
+        # per-eval usage bases computed ON DEVICE in the same dispatch
+        # wave (double-buffering: the parked threads wake NOW, at dispatch
+        # — their host-side materialization, and the next batch's group
+        # assembly, overlap this batch's device compute; each consumer's
+        # np.asarray is its sync point)
+        eval_of = group_eval[groups]
+        bases = _used_bases_fn()(
+            init.used,
+            placements,
+            args.demands,
+            jnp.asarray(eval_of),
+            E,
+            jnp.int32(n_real),
+        )
+        # dispatch→first-consumer-sync wall clock (an UPPER BOUND on
+        # device time: the first consumer's host-side template/id prep
+        # rides in front of its sync — still the outlier detector wanted,
+        # recompiles and chip contention dominate it — without a watcher
+        # thread per batch)
+        from .. import metrics
+
+        fired = []
+        fire_lock = threading.Lock()
+        t_dispatch = t_build
+
+        def record_kernel():
+            with fire_lock:
+                if fired:
+                    return
+                fired.append(True)
+            dt = time.monotonic() - t_dispatch
+            LAST_DRAIN_STATS["kernel_s"] = dt
+            metrics.sample("drain.batch_kernel", dt)
+
+        for e, (park, a_start, a_len) in enumerate(slices):
+            park.placements = _LazySlice(
+                placements, slice(a_start, a_start + a_len),
+                on_sync=record_kernel,
+            )
+            park.used0 = _LazySlice(bases, e, on_sync=record_kernel)
 
         self.invocations += 1
         DRAIN_COUNTERS["batches"] += 1
@@ -325,10 +447,7 @@ class KernelBatchCollector:
             n_allocs=A_real,
             n_nodes=n_real,
             build_s=t_build - t0,
-            kernel_s=t_kernel - t_build,
+            mirror=shared.mirror is not None,
             padded=(E, G, A, N, V),
         )
-        from .. import metrics
-
         metrics.sample("drain.batch_build", t_build - t0)
-        metrics.sample("drain.batch_kernel", t_kernel - t_build)

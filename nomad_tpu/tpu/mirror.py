@@ -1,0 +1,557 @@
+"""Event-driven incremental columnar mirror of cluster state.
+
+``ColumnarCluster.shared`` (columnar.py) rebuilds the whole dense mirror on
+any nodes-table bump, and ``initial_used``/``_live_allocs_by_node`` rescan
+the entire alloc table once per state generation — under a drain, where
+every plan commit publishes a generation, each eval pays O(total allocs) of
+host work plus a fresh host→device transfer. The :class:`ColumnarMirror`
+replaces that rebuild-on-invalidate scheme with a long-lived,
+raft-index-versioned state plane that subscribes to the in-process
+EventBroker (all topics; Node/Alloc/PlanResult frames carry the deltas) and
+applies O(delta) patches:
+
+- node upsert/remove edits rows (capacity/reserved/usable planes, plus a
+  by-node alloc rescan for a re-appearing node);
+- alloc transitions add/subtract their ``sum_alloc_usage`` contribution to
+  the per-node ``used`` matrix, keyed by the per-alloc usage vector the FSM
+  embeds in every Alloc event;
+- same-job collision counts are maintained per (job, task group).
+
+The mirror's dense planes are also kept **device-resident**
+(:class:`DeviceState`): the capacity/usable planes are ``device_put`` once
+per node-axis epoch and the ``used`` plane is patched with small
+dirty-row scatter updates into a fresh buffer (double-buffered against
+the in-flight kernels still reading the old one), so a fused drain batch
+starts from arrays already on the chip instead of re-uploading O(N)
+state per eval.
+
+Degradation contract (never silently drift): a lost-gap frame, a severed
+subscription, an index skew, a sync timeout, or a periodic checksum
+mismatch against a fresh rebuild all force a full rebuild from the target
+snapshot, counted in ``tpu.mirror_rebuild*`` metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from .columnar import R_COLS, ColumnarCluster
+
+logger = logging.getLogger("nomad_tpu.tpu.mirror")
+
+#: how long sync() waits for an expected event frame before declaring the
+#: publish lost and rebuilding. The FSM publishes synchronously inside the
+#: same apply that bumped the table index the sync is chasing, so the only
+#: legitimate gap is the microseconds between the store swap and the
+#: publish; kept SHORT because sync holds the mirror lock while waiting —
+#: a lost frame (derivation bug, event-less alloc GC) should cost one
+#: bounded rebuild, not a long stall of every fast-path reader
+SYNC_WAIT_S = 0.05
+
+#: every Nth incremental sync is checksummed against a from-scratch
+#: ``initial_used`` recompute; 0 disables (the property tests re-enable)
+VERIFY_EVERY = int(os.environ.get("NOMAD_TPU_MIRROR_VERIFY_EVERY", "64"))
+
+
+def usage_vec(alloc) -> Optional[tuple]:
+    """The (cpu, memory_mb, disk_mb, mbits) contribution of one alloc —
+    exactly ``ColumnarCluster.sum_alloc_usage`` restricted to one element,
+    so mirror patches and full rebuilds can never disagree on the math."""
+    if alloc.allocated_resources is None:
+        return None
+    c = alloc.comparable_cached()
+    bw = 0
+    res = alloc.allocated_resources
+    for tr in res.tasks.values():
+        for net in tr.networks:
+            bw += net.mbits
+    for net in res.shared.networks:
+        bw += net.mbits
+    return (
+        c.flattened.cpu.cpu_shares,
+        c.flattened.memory.memory_mb,
+        c.shared.disk_mb,
+        bw,
+    )
+
+
+class MirrorCluster(ColumnarCluster):
+    """A ColumnarCluster whose usage plane and collision counts are
+    maintained incrementally by a :class:`ColumnarMirror`. Built over ALL
+    nodes in the state (not just ready ones) so per-eval eligibility is a
+    ring permutation, never a node-axis change; a node status flap costs a
+    pointer swap instead of a full rebuild.
+
+    The fast paths serve only the exact generation the mirror last synced
+    to; any other generation falls back to the base class's scan-the-table
+    implementations, so a stale reader can never observe a half-applied
+    patch set."""
+
+    def __init__(self, nodes, lock: threading.RLock):
+        super().__init__(nodes)
+        self._mirror_lock = lock
+        #: reserved + Σ live-alloc contributions per row (int64, [N, R])
+        self.mirror_used = self.reserved.copy()
+        #: the state generation the incremental planes currently equal
+        self._synced_gen = None
+        #: alloc id → (node_id, usage vec, job_id, task_group)
+        self._alloc_rec: dict[str, tuple] = {}
+        #: (job_id, task_group) → {node_id: live alloc count}
+        self._job_counts: dict[tuple, dict] = {}
+
+    # -- incremental fast paths -----------------------------------------
+    def initial_used(self, state, plan=None) -> np.ndarray:
+        gen = getattr(state, "_gen", state)
+        with self._mirror_lock:
+            if gen is self._synced_gen:
+                used = self.mirror_used.copy()
+                if plan is not None:
+                    for node_id, stops in plan.node_update.items():
+                        row = self.index.get(node_id)
+                        if row is None:
+                            continue
+                        for a in stops:
+                            rec = self._alloc_rec.get(a.id)
+                            if rec is not None and rec[0] == node_id:
+                                used[row] -= np.asarray(
+                                    rec[1], dtype=np.int64
+                                )
+                return used
+        # stale generation: the O(total allocs) rescan runs OUTSIDE the
+        # lock — a reader one generation behind must not serialize the
+        # other worker's sync/device refresh behind a full table scan
+        return super().initial_used(state, plan)
+
+    def collision_counts(self, state, job_id: str, tg_name: str) -> np.ndarray:
+        gen = getattr(state, "_gen", state)
+        with self._mirror_lock:
+            if gen is self._synced_gen:
+                counts = np.zeros(len(self.nodes), dtype=np.int32)
+                for node_id, c in self._job_counts.get(
+                    (job_id, tg_name), {}
+                ).items():
+                    row = self.index.get(node_id)
+                    if row is not None:
+                        counts[row] = c
+                return counts
+        return super().collision_counts(state, job_id, tg_name)
+
+
+class DeviceState:
+    """Device-resident kernel state for one (epoch, padded-N) pair: the
+    capacity/usable planes uploaded once, and a ``used`` plane maintained
+    by scatter updates of just the dirty rows. Updates deliberately COPY
+    rather than donate the retired buffer: every refresh follows a
+    hand-out to an asynchronously-dispatched kernel that may still be
+    reading it (the collector wakes consumers at dispatch), and with two
+    drain workers the other worker's batch can hold it too — donating a
+    buffer a live computation reads is undefined. The old buffer is freed
+    as soon as the last kernel holding it completes."""
+
+    #: dirty-row scatter shapes are bucketed so row-count churn doesn't
+    #: compile a fresh scatter program per batch
+    _ROW_BUCKETS = (8, 64, 512, 4096)
+
+    def __init__(self, epoch: int, n_pad: int, capacity, usable, used):
+        import jax
+
+        self.epoch = epoch
+        self.n_pad = n_pad
+        n = capacity.shape[0]
+        cap = np.zeros((n_pad, R_COLS), dtype=np.int32)
+        cap[:n] = np.clip(capacity, 0, 2**31 - 1)
+        usa = np.ones((n_pad, 2), dtype=np.float32)
+        usa[:n] = usable
+        use = np.full((n_pad, R_COLS), 2**30, dtype=np.int32)
+        use[:n] = np.clip(used, 0, 2**30)
+        self.capacity = jax.device_put(cap)
+        self.usable = jax.device_put(usa)
+        self.used = jax.device_put(use)
+        self.pending: set[int] = set()
+
+    @staticmethod
+    def _row_bucket(n: int) -> int:
+        for b in DeviceState._ROW_BUCKETS:
+            if n <= b:
+                return b
+        return ((n + 4095) // 4096) * 4096
+
+    def refresh(self, used_host: np.ndarray):
+        """Push pending dirty rows to the device as one scatter update."""
+        if not self.pending:
+            return
+        import jax
+
+        rows = np.fromiter(self.pending, dtype=np.int32, count=len(self.pending))
+        self.pending.clear()
+        b = self._row_bucket(len(rows))
+        padded = np.zeros(b, dtype=np.int32)
+        padded[: len(rows)] = rows  # pad lanes repeat row 0: same-value set, idempotent
+        vals = np.clip(used_host[padded], 0, 2**30).astype(np.int32)
+        self.used = _scatter_rows(
+            self.used, jax.device_put(padded), jax.device_put(vals)
+        )
+
+    def arrays(self):
+        """(capacity, usable, used) device refs — immutable snapshots: a
+        later refresh produces a NEW used buffer, so an in-flight kernel's
+        captured ref never changes underneath it."""
+        return self.capacity, self.usable, self.used
+
+
+_scatter_rows = None
+
+
+def _init_scatter_fns():
+    global _scatter_rows
+    if _scatter_rows is None:
+        import jax
+
+        _scatter_rows = jax.jit(lambda used, rows, vals: used.at[rows].set(vals))
+
+
+class _Structural(Exception):
+    """A node joined or left: the node axis (and every plane keyed to it)
+    must be rebuilt from the target snapshot."""
+
+
+class ColumnarMirror:
+    """The long-lived, event-patched columnar state plane for one server."""
+
+    def __init__(self, state, broker, verify_every: int = VERIFY_EVERY):
+        # ``state`` is accepted for construction-site symmetry but never
+        # consulted: every read comes from the snapshot each sync() is
+        # given — the mirror must reflect that exact generation, never
+        # the live store
+        self._broker = broker
+        self._lock = threading.RLock()
+        self._sub = None
+        self._cluster: Optional[MirrorCluster] = None
+        #: highest frame index consumed (any topic)
+        self._applied = 0
+        #: highest frame index that touched the node/alloc planes
+        self._applied_na = 0
+        #: bumped whenever the node axis changes (device planes re-upload)
+        self._epoch = 0
+        self._device: dict[int, DeviceState] = {}
+        self.verify_every = verify_every
+        self._syncs = 0
+        self.counters = {
+            "hits": 0,
+            "rebuilds": 0,
+            "stale": 0,
+            "events_applied": 0,
+            "rebuild_reasons": {},
+        }
+
+    # ------------------------------------------------------------------
+    def sync(self, snapshot) -> Optional[MirrorCluster]:
+        """Bring the mirror to exactly ``snapshot``'s node/alloc state and
+        return the shared MirrorCluster, or None when this snapshot is
+        older than what the mirror already applied (the caller then builds
+        a one-off legacy cluster instead — the mirror never runs
+        backwards)."""
+        from .. import metrics
+        from ..events.broker import SubscriptionClosedError
+
+        target = max(
+            snapshot.table_index("nodes"), snapshot.table_index("allocs")
+        )
+        with self._lock:
+            if self._cluster is not None and self._applied_na > target:
+                self.counters["stale"] += 1
+                metrics.incr("tpu.mirror_stale")
+                return None
+            if self._cluster is None or self._sub is None:
+                self._rebuild(snapshot, target, "init")
+                return self._finish(snapshot, rebuilt=True)
+            rebuilt = False
+            deadline = time.monotonic() + SYNC_WAIT_S
+            t0 = time.monotonic()
+            try:
+                while self._applied < target:
+                    frame = self._next_frame(deadline)
+                    if frame is None:
+                        self._rebuild(snapshot, target, "timeout")
+                        rebuilt = True
+                        break
+                    index, events = frame
+                    if events is None:  # explicit lost-gap marker
+                        self._rebuild(snapshot, target, "gap")
+                        rebuilt = True
+                        break
+                    if index > target:
+                        # the write at ``target`` published nothing we saw:
+                        # resync from scratch (the rebuild's fresh
+                        # subscription re-covers this frame's range — its
+                        # content ≤ snapshot is in the rebuild, anything
+                        # newer replays from the ring)
+                        self._rebuild(snapshot, target, "skew")
+                        rebuilt = True
+                        break
+                    try:
+                        self._apply_frame(snapshot, index, events)
+                    except _Structural:
+                        self._rebuild(snapshot, target, "node_axis")
+                        rebuilt = True
+                        break
+            except SubscriptionClosedError:
+                self._rebuild(snapshot, target, "severed")
+                rebuilt = True
+            if not rebuilt:
+                metrics.sample("mirror.apply_delta", time.monotonic() - t0)
+            return self._finish(snapshot, rebuilt=rebuilt)
+
+    def _next_frame(self, deadline: float):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        return self._sub.next(timeout=remaining)
+
+    # ------------------------------------------------------------------
+    def _finish(self, snapshot, rebuilt: bool) -> MirrorCluster:
+        from .. import metrics
+
+        cluster = self._cluster
+        self._syncs += 1
+        if (
+            not rebuilt
+            and self.verify_every
+            and self._syncs % self.verify_every == 0
+            and not self._verify(snapshot)
+        ):
+            metrics.incr("tpu.mirror_checksum_mismatch")
+            self._rebuild(
+                snapshot,
+                max(snapshot.table_index("nodes"), snapshot.table_index("allocs")),
+                "checksum",
+            )
+            cluster = self._cluster
+            rebuilt = True
+        if rebuilt:
+            self.counters["rebuilds"] += 1
+        else:
+            self.counters["hits"] += 1
+            metrics.incr("tpu.mirror_hit")
+        cluster._synced_gen = getattr(snapshot, "_gen", snapshot)
+        return cluster
+
+    def _verify(self, snapshot) -> bool:
+        """Checksum the incrementally-maintained ``used`` plane against the
+        from-scratch recompute over the same node rows."""
+        cluster = self._cluster
+        fresh = ColumnarCluster.initial_used(cluster, snapshot)
+        ok = np.array_equal(fresh, cluster.mirror_used)
+        if not ok:
+            logger.warning(
+                "mirror checksum mismatch at index %d (max row delta %s); "
+                "rebuilding",
+                self._applied,
+                np.abs(fresh - cluster.mirror_used).max(),
+            )
+        return ok
+
+    # ------------------------------------------------------------------
+    def _rebuild(self, snapshot, target: int, reason: str):
+        """Full O(N + A) rebuild from ``snapshot`` + fresh subscription.
+        The old subscription (if any) is dropped, so frames the rebuild
+        already covers are never replayed into the new plane."""
+        from .. import metrics
+        from ..events.broker import TOPIC_ALL
+
+        t0 = time.monotonic()
+        if self._sub is not None:
+            try:
+                self._sub.close()
+            except Exception:
+                pass
+        # subscribe BEFORE reading the snapshot tables: frames for writes
+        # after ``snapshot`` queue up and are applied by later syncs;
+        # frames at or before the snapshot index are filtered below
+        self._sub = self._broker.subscribe(
+            topics={TOPIC_ALL: ("*",)}, from_index=snapshot.latest_index()
+        )
+        cluster = MirrorCluster(list(snapshot.nodes()), self._lock)
+        for alloc in snapshot.allocs():
+            if alloc.terminal_status():
+                continue
+            if alloc.node_id not in cluster.index:
+                continue
+            self._track(cluster, alloc.id, alloc.node_id,
+                        usage_vec(alloc), alloc.job_id, alloc.task_group)
+        self._cluster = cluster
+        self._applied = snapshot.latest_index()
+        self._applied_na = target
+        self._epoch += 1
+        self._device.clear()
+        metrics.incr(f"tpu.mirror_rebuild.{reason}")
+        metrics.sample("mirror.rebuild", time.monotonic() - t0)
+        reasons = self.counters["rebuild_reasons"]
+        reasons[reason] = reasons.get(reason, 0) + 1
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _track(cluster: MirrorCluster, alloc_id, node_id, vec, job_id, tg):
+        row = cluster.index.get(node_id)
+        if row is None:
+            return
+        if vec is None:
+            # allocated_resources=None contributes nothing to ``used``
+            # (sum_alloc_usage skips it) but MUST still count for same-job
+            # collisions — the base collision_counts counts every
+            # non-terminal matching alloc regardless of resources
+            vec = (0, 0, 0, 0)
+        cluster.mirror_used[row] += np.asarray(vec, dtype=np.int64)
+        cluster._alloc_rec[alloc_id] = (node_id, vec, job_id, tg)
+        jc = cluster._job_counts.setdefault((job_id, tg), {})
+        jc[node_id] = jc.get(node_id, 0) + 1
+
+    def _untrack(self, alloc_id: str) -> Optional[int]:
+        """Remove one alloc's contribution; returns the dirty row or None."""
+        cluster = self._cluster
+        rec = cluster._alloc_rec.pop(alloc_id, None)
+        if rec is None:
+            return None
+        node_id, vec, job_id, tg = rec
+        jc = cluster._job_counts.get((job_id, tg))
+        if jc is not None:
+            c = jc.get(node_id, 0) - 1
+            if c > 0:
+                jc[node_id] = c
+            else:
+                jc.pop(node_id, None)
+                if not jc:
+                    cluster._job_counts.pop((job_id, tg), None)
+        row = cluster.index.get(node_id)
+        if row is None:
+            return None
+        cluster.mirror_used[row] -= np.asarray(vec, dtype=np.int64)
+        return row
+
+    def _mark_dirty(self, row: int):
+        for ds in self._device.values():
+            ds.pending.add(int(row))
+
+    # ------------------------------------------------------------------
+    def _apply_frame(self, snapshot, index: int, events: list):
+        from ..events import TOPIC_ALLOC, TOPIC_NODE, TOPIC_NODE_EVENT
+
+        mutated = False
+        for e in events:
+            if e.topic == TOPIC_ALLOC:
+                self._apply_alloc(e)
+                mutated = True
+            elif e.topic == TOPIC_NODE:
+                self._apply_node(snapshot, e)
+                mutated = True
+            elif e.topic == TOPIC_NODE_EVENT:
+                mutated = True  # nodes-table bump; resources unchanged
+        self._applied = index
+        if mutated:
+            self._applied_na = index
+        self.counters["events_applied"] += len(events)
+
+    def _apply_alloc(self, e):
+        p = e.payload
+        alloc_id = p.get("ID", "")
+        row = self._untrack(alloc_id)
+        if row is not None:
+            self._mark_dirty(row)
+        if p.get("Terminal") or "Terminal" not in p:
+            # terminal, or an event lacking the mirror fields entirely
+            # (a fallback doc for an already-deleted alloc): nothing live
+            # to track
+            return
+        vec = p.get("Resources")
+        cluster = self._cluster
+        node_id = p.get("NodeID", "")
+        self._track(
+            cluster, alloc_id, node_id,
+            tuple(vec) if vec is not None else None,
+            p.get("JobID", ""), p.get("TaskGroup", ""),
+        )
+        r = cluster.index.get(node_id)
+        if r is not None:
+            self._mark_dirty(r)
+
+    def _apply_node(self, snapshot, e):
+        cluster = self._cluster
+        node_id = e.key
+        if e.type in ("NodeRegistration", "NodeDeregistration"):
+            # node joined, left, or RE-registered (attributes/resources
+            # may have changed, invalidating every node-axis plane): the
+            # axis rebuilds from the target snapshot. Membership changes
+            # are rare next to the status/alloc churn the O(delta) paths
+            # below absorb.
+            raise _Structural(node_id)
+        # status / drain / eligibility flaps: same resources, same
+        # attributes — swap the object so identity reads stay current, and
+        # leave every dense plane untouched (the O(1) win over the old
+        # rebuild-on-any-nodes-bump cache)
+        node = snapshot.node_by_id(node_id)
+        row = cluster.index.get(node_id)
+        if node is not None and row is not None:
+            cluster.nodes[row] = node
+
+    # ------------------------------------------------------------------
+    # device-resident kernel state
+    # ------------------------------------------------------------------
+    def device_state(self, n_pad: int, gen) -> Optional[tuple]:
+        """Device refs (capacity, usable, used) for the node plane padded
+        to ``n_pad``, valid for state generation ``gen``; None when the
+        mirror has moved past that generation (caller falls back to a host
+        transfer of its own snapshot arrays)."""
+        with self._lock:
+            cluster = self._cluster
+            if cluster is None or cluster._synced_gen is not gen:
+                return None
+            _init_scatter_fns()
+            ds = self._device.get(n_pad)
+            if ds is None or ds.epoch != self._epoch:
+                ds = DeviceState(
+                    self._epoch, n_pad, cluster.capacity,
+                    cluster.usable, cluster.mirror_used,
+                )
+                self._device[n_pad] = ds
+            else:
+                ds.refresh(cluster.mirror_used)
+            return ds.arrays()
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["rebuild_reasons"] = dict(self.counters["rebuild_reasons"])
+            out["applied_index"] = self._applied
+            out["nodes"] = (
+                len(self._cluster.nodes) if self._cluster is not None else 0
+            )
+            out["tracked_allocs"] = (
+                len(self._cluster._alloc_rec)
+                if self._cluster is not None
+                else 0
+            )
+            return out
+
+    def close(self):
+        with self._lock:
+            if self._sub is not None:
+                try:
+                    self._sub.close()
+                except Exception:
+                    pass
+                self._sub = None
+
+    # -- test hook ------------------------------------------------------
+    def sever(self):
+        """Cut the mirror's subscription (chaos harness): the next sync
+        observes SubscriptionClosedError and must rebuild."""
+        with self._lock:
+            if self._sub is not None:
+                self._broker._close_slow(self._sub)

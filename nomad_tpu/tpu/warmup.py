@@ -17,8 +17,25 @@ from __future__ import annotations
 
 import threading
 
-#: default ladder: dev/CI clusters and the 10K-node / 50K-alloc headline
-DEFAULT_SHAPES = ((128, 128), (1024, 1024), (10240, 51200))
+from .batch_sched import _bucket
+
+
+def bucket_shape(n_nodes: int, n_allocs: int) -> tuple[int, int]:
+    """The exact padded shape production hits for a real (nodes, allocs)
+    pair — computed through the ONE bucketing policy (batch_sched._bucket)
+    so the prewarm ladder can never drift from the scheduler again. (The
+    previous hand-written ladder listed 51200 for the 50K-alloc headline
+    while the scheduler pads 50K to 50176: the prewarmed program was never
+    the one the headline ran, so the first real eval at that shape still
+    compiled.)"""
+    return _bucket(n_nodes), _bucket(n_allocs)
+
+
+#: default ladder: dev/CI clusters and the 10K-node / 50K-alloc headline,
+#: expressed as the REAL cluster sizes and bucketed through production's
+#: padding policy
+DEFAULT_SIZES = ((100, 100), (1000, 1000), (10000, 50000))
+DEFAULT_SHAPES = tuple(bucket_shape(n, a) for n, a in DEFAULT_SIZES)
 #: spread value-table width compiled for (datacenter-style spreads)
 DEFAULT_V = 4
 
@@ -132,10 +149,78 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
     return compiled
 
 
-def prewarm_async(shapes=DEFAULT_SHAPES) -> threading.Thread:
-    """Fire-and-forget prewarm; returns the daemon thread."""
-    t = threading.Thread(
-        target=prewarm, args=(shapes,), name="tpu-prewarm", daemon=True
-    )
+def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8) -> int:
+    """Compile the FUSED drain-batch shapes for a (cluster size, drain
+    size) pair: the multi-eval ``plan_batch`` program plus the per-eval
+    usage-base program the collector dispatches alongside it
+    (drain.py:_run computes exactly these paddings). Returns programs
+    compiled; failures are swallowed like ``prewarm``."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from .drain import _used_bases_fn
+    from .kernel import BatchArgs, BatchState, _plan_batch_jit
+
+    N = _bucket(n_nodes)
+    E = _bucket(batch)
+    G = _bucket(batch)
+    A = _bucket(batch * 4)
+    V = _bucket(max(v_values, 8))
+    compiled = 0
+    try:
+        args = BatchArgs(
+            capacity=jnp.ones((N, 4), dtype=jnp.int32),
+            usable=jnp.ones((N, 2), dtype=jnp.float32),
+            feasible=jnp.ones((G, N), dtype=bool),
+            affinity=jnp.zeros((G, N), dtype=jnp.float32),
+            affinity_present=jnp.zeros((G, N), dtype=bool),
+            group_count=jnp.ones(G, dtype=jnp.int32),
+            group_eval=jnp.zeros(G, dtype=jnp.int32),
+            node_value=jnp.full((G, N), -1, dtype=jnp.int32),
+            spread_desired=jnp.full((G, V), -1.0, dtype=jnp.float32),
+            spread_implicit=jnp.full(G, -1.0, dtype=jnp.float32),
+            spread_weight_frac=jnp.zeros(G, dtype=jnp.float32),
+            spread_even=jnp.zeros(G, dtype=bool),
+            spread_active=jnp.zeros(G, dtype=bool),
+            perm=jnp.tile(jnp.arange(N, dtype=jnp.int32), (E, 1)),
+            ring=jnp.full(E, n_nodes, dtype=jnp.int32),
+            demands=jnp.ones((A, 4), dtype=jnp.int32),
+            groups=jnp.zeros(A, dtype=jnp.int32),
+            limits=jnp.full(A, 2, dtype=jnp.int32),
+            valid=jnp.ones(A, dtype=bool),
+        )
+        init = BatchState(
+            used=jnp.zeros((N, 4), dtype=jnp.int32),
+            collisions=jnp.zeros((G, N), dtype=jnp.int32),
+            spread_counts=jnp.zeros((G, V), dtype=jnp.int32),
+            spread_present=jnp.zeros((G, V), dtype=bool),
+            offset=jnp.zeros(E, dtype=jnp.int32),
+        )
+        _plan_batch_jit.lower(args, init, n_nodes).compile()
+        compiled += 1
+        _used_bases_fn().lower(
+            init.used,
+            jnp.full(A, -1, dtype=jnp.int32),
+            args.demands,
+            jnp.zeros(A, dtype=jnp.int32),
+            E,
+            jnp.int32(n_nodes),
+        ).compile()
+        compiled += 1
+    except Exception:
+        pass
+    return compiled
+
+
+def prewarm_async(shapes=DEFAULT_SHAPES, drain: tuple = None) -> threading.Thread:
+    """Fire-and-forget prewarm; returns the daemon thread. ``drain``
+    optionally adds the fused (n_nodes, batch) drain shapes."""
+
+    def run():
+        prewarm(shapes)
+        if drain is not None:
+            prewarm_drain(*drain)
+
+    t = threading.Thread(target=run, name="tpu-prewarm", daemon=True)
     t.start()
     return t

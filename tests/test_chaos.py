@@ -728,3 +728,178 @@ class TestEventStreamSever:
         finally:
             http.stop()
             server.stop()
+
+
+class TestMirrorSeverMidDrain:
+    """The columnar mirror's subscription is cut between fused drain
+    batches; the invariant: the rebuild fallback produces EXACTLY the
+    placements an unsevered (or mirror-less) run produces — degradation is
+    a performance event, never a placement event."""
+
+    def _fsm_world(self, node_docs, job_docs):
+        """A deterministic scheduler world whose plan applications flow
+        through a real FSM + event broker, so the columnar mirror sees the
+        same Alloc/PlanResult frames a server's drain path would."""
+        from nomad_tpu.core import fsm as fsm_mod
+        from nomad_tpu.core.fsm import FSM
+        from nomad_tpu.events import EventBroker
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.structs.model import PlanResult
+        from nomad_tpu.tpu.mirror import ColumnarMirror
+
+        broker = EventBroker()
+        state = StateStore()
+        fsm = FSM(state=state, event_broker=broker)
+        mirror = ColumnarMirror(state, broker, verify_every=0)
+
+        class FsmHarness(Harness):
+            """Harness whose plan/eval writes go through FSM.apply, so
+            every mutation publishes its typed events."""
+
+            def submit_plan(self, plan):
+                self.plans.append(plan)
+                index = self.next_index()
+                result = PlanResult(
+                    node_update=plan.node_update,
+                    node_allocation=plan.node_allocation,
+                    node_preemptions=plan.node_preemptions,
+                    alloc_index=index,
+                )
+                fsm.apply(
+                    index,
+                    fsm_mod.APPLY_PLAN_RESULTS,
+                    {"plan": plan.to_dict(), "result": result.to_dict()},
+                )
+                return result, None
+
+            def update_eval(self, ev):
+                self.evals.append(ev)
+                fsm.apply(
+                    self.next_index(),
+                    fsm_mod.EVAL_UPDATE,
+                    {"evals": [ev.to_dict()]},
+                )
+
+        h = FsmHarness(state=state, seed=7)
+        for doc in node_docs:
+            fsm.apply(h.next_index(), fsm_mod.NODE_REGISTER, {"node": doc})
+        for doc in job_docs:
+            fsm.apply(h.next_index(), fsm_mod.JOB_REGISTER, {"job": doc})
+        return h, fsm, mirror
+
+    def _run_wave(self, h, mirror, jobs, seed):
+        """One fused drain batch over the current state; returns True when
+        the shared cluster was mirror-backed."""
+        import threading
+
+        from nomad_tpu.structs.model import Evaluation
+        from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+        from nomad_tpu.tpu.drain import KernelBatchCollector, SharedCluster
+
+        evs = []
+        for job in jobs:
+            ev = Evaluation(
+                id=f"ev-{job.id}",
+                namespace=job.namespace,
+                priority=job.priority,
+                type="service",
+                triggered_by="job-register",
+                job_id=job.id,
+                status="pending",
+                create_index=h.next_index(),
+            )
+            h.state.upsert_evals(h.next_index(), [ev])
+            evs.append(ev)
+        snapshot = h.state.snapshot()
+        shared = SharedCluster(snapshot, mirror=mirror)
+        collector = KernelBatchCollector(shared, expected=len(evs))
+        errors = []
+
+        def run_one(ev):
+            try:
+                sched = TPUBatchScheduler(
+                    snapshot, h, rng=random.Random(seed)
+                )
+                sched.drain_collector = collector
+                sched.process(ev)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+            finally:
+                if not collector.consumed(ev.id):
+                    collector.leave(ev.id)
+
+        threads = [
+            threading.Thread(target=run_one, args=(ev,)) for ev in evs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        return shared.mirror is not None
+
+    def _placements(self, h, jobs):
+        return {
+            (j.id, a.name): a.node_id
+            for j in jobs
+            for a in h.state.allocs_by_job(j.namespace, j.id)
+            if not a.terminal_status()
+        }
+
+    def test_sever_mid_drain_preserves_placement_parity(self):
+        rng = random.Random(4242)
+        node_docs = []
+        for _ in range(8):
+            n = mock.node()
+            n.node_resources.cpu.cpu_shares = rng.choice([2000, 4000, 8000])
+            n.node_resources.networks = []
+            node_docs.append(n.to_dict())
+        job_docs = []
+        for i in range(4):
+            j = mock.job()
+            j.task_groups[0].count = 3
+            j.task_groups[0].tasks[0].resources.networks = []
+            j.task_groups[0].tasks[0].resources.cpu = 100
+            j.task_groups[0].tasks[0].resources.memory_mb = 64
+            job_docs.append(j.to_dict())
+
+        results = {}
+        for severed in (False, True):
+            h, fsm, mirror = self._fsm_world(node_docs, job_docs)
+            jobs = sorted(h.state.jobs(), key=lambda j: j.id)
+            used_mirror = self._run_wave(h, mirror, jobs[:2], seed=5)
+            assert used_mirror, "first wave must ride the mirror"
+            if severed:
+                mirror.sever()  # chaos: subscription cut mid-drain
+            # a write lands while (possibly) severed: stop one wave-1
+            # alloc through the FSM, in BOTH worlds — the severed mirror
+            # must notice it can't have seen the frame and rebuild
+            victim = sorted(
+                h.state.allocs_by_job(jobs[0].namespace, jobs[0].id),
+                key=lambda a: a.name,
+            )[0]
+            from nomad_tpu.core.fsm import ALLOC_CLIENT_UPDATE
+
+            stopped = victim.copy()
+            stopped.client_status = "complete"
+            fsm.apply(
+                h.next_index(),
+                ALLOC_CLIENT_UPDATE,
+                {"allocs": [stopped.to_dict()]},
+            )
+            used_mirror2 = self._run_wave(h, mirror, jobs[2:], seed=5)
+            assert used_mirror2, (
+                "second wave must still be mirror-backed (rebuild path)"
+            )
+            if severed:
+                assert (
+                    mirror.counters["rebuild_reasons"].get("severed", 0) >= 1
+                ), mirror.counters
+            results[severed] = self._placements(h, jobs)
+            # 4 jobs × 3 allocs, minus the one stopped mid-scenario
+            assert len(results[severed]) == 11
+            assert_cluster_invariants(h.state)
+
+        assert results[False] == results[True], (
+            "severed-mirror rebuild changed placements"
+        )
