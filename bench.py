@@ -942,6 +942,13 @@ def main():
             )
         ),
     ]
+    # 0 on a clean tree, -1 if the analyzer itself broke: drift shows up
+    # in the perf trajectory next to the numbers the analyzer protects
+    # (the shape-bucket rules exist because of a bench regression; see
+    # ANALYSIS.md)
+    from nomad_tpu.analysis import count_new_findings
+
+    parts.append(f"analysis_findings={count_new_findings()}")
     if "config2" in detail:
         parts.append(f"cfg2={detail['config2'].get('evals_per_s')}evals/s")
         parts.append(f"cfg3={detail['config3'].get('end_to_end_s')}s")

@@ -1,0 +1,55 @@
+"""Static analysis for the nomad_tpu control plane and device plane.
+
+``python -m nomad_tpu.analysis`` runs every registered checker over the
+package and exits nonzero on findings not in the committed
+``ANALYSIS_BASELINE.json``. See ANALYSIS.md for the checker catalog,
+suppression syntax, and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .framework import (  # noqa: F401
+    BASELINE_NAME,
+    CHECKER_DOCS,
+    CHECKERS,
+    Finding,
+    ModuleInfo,
+    Project,
+    load_baseline,
+    partition,
+    run,
+    write_baseline,
+)
+
+# importing the checker modules registers them
+from . import imports, jax_hygiene, lockgraph, raft_hygiene  # noqa: F401,E402
+
+
+def repo_root() -> str:
+    """The directory holding the nomad_tpu package (and the baseline)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def analyze(
+    root: str = None, checkers=None
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined) findings for the tree at ``root``."""
+    root = root or repo_root()
+    project = Project.load(root)
+    findings = run(project, checkers)
+    baseline = load_baseline(os.path.join(root, BASELINE_NAME))
+    return partition(findings, baseline)
+
+
+def count_new_findings(root: str = None) -> int:
+    """New (non-baseline) finding count — bench.py surfaces this in
+    BENCH_SUMMARY so analyzer drift shows up in the perf trajectory."""
+    try:
+        new, _ = analyze(root)
+        return len(new)
+    except Exception:
+        return -1  # analyzer itself broke: surface as a sentinel

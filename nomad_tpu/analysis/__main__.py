@@ -1,0 +1,132 @@
+"""CLI: ``python -m nomad_tpu.analysis``.
+
+Exit codes: 0 = clean (modulo baseline), 1 = new findings, 2 = usage or
+internal error. ``--write-baseline`` accepts the current findings as the
+new baseline (use after deliberately burning findings down, never to
+bury a regression).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    BASELINE_NAME,
+    CHECKER_DOCS,
+    CHECKERS,
+    Project,
+    load_baseline,
+    partition,
+    repo_root,
+    run,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="lock-order + JAX hot-path + raft-index static analyzer",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--root", default=None, help="repo root (default: auto-detect)"
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline path (default: <root>/{BASELINE_NAME})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, including baselined ones",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept current findings as the new baseline",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated checker subset (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the checker catalog and exit",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="limit findings to these repo-relative path prefixes",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(CHECKERS):
+            print(f"{name}: {CHECKER_DOCS.get(name, '')}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in CHECKERS]
+        if unknown:
+            print(f"unknown rules: {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    root = args.root or repo_root()
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    try:
+        project = Project.load(root)
+        findings = run(project, rules)
+    except Exception as e:
+        print(f"analysis failed: {e}", file=sys.stderr)
+        return 2
+
+    if args.paths:
+        findings = [
+            f
+            for f in findings
+            if any(f.path.startswith(p) for p in args.paths)
+        ]
+
+    if args.write_baseline:
+        write_baseline(findings, baseline_path)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, known = partition(findings, baseline)
+
+    if args.format == "json":
+        by_rule: dict[str, int] = {}
+        for f in new:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        print(
+            json.dumps(
+                {
+                    "new": [f.to_dict() for f in new],
+                    "new_count": len(new),
+                    "baselined_count": len(known),
+                    "by_rule": by_rule,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.format())
+        print(
+            f"{len(new)} new finding(s), {len(known)} baselined",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

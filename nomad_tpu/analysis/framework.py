@@ -1,0 +1,286 @@
+"""AST-walking lint framework for the nomad_tpu control plane.
+
+The last two PRs each burned a debugging cycle on mechanically-detectable
+bug classes (workers stalled on synthetic optimistic raft indexes; the
+warmup ladder compiling shape 51200 while production padded to 50176).
+This framework hosts the checkers that catch those classes at analysis
+time instead of at p99 time:
+
+- :mod:`.lockgraph` — cross-module lock-acquisition graph: deadlock
+  cycles and locks held across blocking calls;
+- :mod:`.jax_hygiene` — host-sync forcers and impurity inside jit'd
+  code, ``device_put`` in loops, shapes reaching kernels without
+  rounding through ``batch_sched._bucket``;
+- :mod:`.raft_hygiene` — raft indexes minted from arithmetic and
+  cross-store index comparisons;
+- :mod:`.imports` — top-level import cycles and dead modules.
+
+Mechanics shared by every checker:
+
+- **suppressions**: a trailing ``# nta: ignore`` comment suppresses every
+  rule on that line; ``# nta: ignore[rule-a, rule-b]`` suppresses just
+  those rules. Suppressions are for findings that are deliberate and
+  locally justified — add a WHY next to each one.
+- **baseline**: pre-existing findings live in a committed
+  ``ANALYSIS_BASELINE.json`` (finding key → count) so they don't block
+  CI while they're burned down; only NEW findings fail the run. Keys
+  deliberately omit line numbers so unrelated edits don't churn the
+  baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+#: rules suppressed via ``# nta: ignore`` with no rule list
+ALL_RULES = "*"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*nta:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit. ``key`` identifies the finding for baseline
+    matching and deliberately excludes the line number (edits above a
+    pre-existing finding must not turn it "new")."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class ModuleInfo:
+    """One parsed source module: path, AST, and per-line suppressions."""
+
+    def __init__(self, relpath: str, source: str, modname: Optional[str] = None):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        if modname is None:
+            modname = self.relpath[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+        self.modname = modname
+        self.is_package = self.relpath.endswith("__init__.py")
+        self.tree = ast.parse(source, filename=relpath)
+        #: line → set of suppressed rule names (or {ALL_RULES})
+        self.suppressions: dict[int, set[str]] = {}
+        lines = source.splitlines()
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = (
+                {r.strip() for r in m.group(1).split(",") if r.strip()}
+                if m.group(1)
+                else {ALL_RULES}
+            )
+            self.suppressions.setdefault(i, set()).update(rules)
+            if line.strip().startswith("#"):
+                # a standalone suppression comment (usually carrying the
+                # WHY across several lines) applies to the next code line
+                j = i + 1
+                while j <= len(lines) and (
+                    not lines[j - 1].strip()
+                    or lines[j - 1].strip().startswith("#")
+                ):
+                    j += 1
+                if j <= len(lines):
+                    self.suppressions.setdefault(j, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        if rules is None:
+            return False
+        return ALL_RULES in rules or rule in rules
+
+
+class Project:
+    """The analyzed module set plus lookup helpers for checkers."""
+
+    def __init__(self, root: str, modules: list[ModuleInfo]):
+        self.root = root
+        self.modules = modules
+        self.by_path = {m.relpath: m for m in modules}
+        self.by_modname = {m.modname: m for m in modules}
+
+    @classmethod
+    def load(cls, root: str, package: str = "nomad_tpu") -> "Project":
+        """Walk ``root/package`` and parse every .py file. Unparseable
+        files become a synthetic ``syntax-error`` finding at run time
+        rather than killing the whole analysis (compileall already guards
+        syntax; the analyzer should degrade, not crash)."""
+        modules = []
+        errors = []
+        pkg_dir = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                relpath = os.path.relpath(path, root)
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                try:
+                    modules.append(ModuleInfo(relpath, src))
+                except SyntaxError as e:
+                    errors.append((relpath, e))
+        project = cls(root, modules)
+        project.parse_errors = errors
+        return project
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "Project":
+        """Build a project from in-memory {relpath: source} — the fixture
+        path tests/test_analysis.py drives every checker through."""
+        modules = [ModuleInfo(rp, src) for rp, src in sources.items()]
+        project = cls("<memory>", modules)
+        project.parse_errors = []
+        return project
+
+    def iter_modules(self, prefix: str = "") -> Iterable[ModuleInfo]:
+        for m in self.modules:
+            if m.relpath.startswith(prefix):
+                yield m
+
+
+# ----------------------------------------------------------------------
+# checker registry
+# ----------------------------------------------------------------------
+
+#: name → checker callable (Project) -> list[Finding]
+CHECKERS: dict[str, Callable[[Project], list[Finding]]] = {}
+#: name → one-line description (the ANALYSIS.md catalog is generated
+#: from the same source of truth the CLI uses)
+CHECKER_DOCS: dict[str, str] = {}
+
+
+def register(name: str, doc: str):
+    def deco(fn):
+        CHECKERS[name] = fn
+        CHECKER_DOCS[name] = doc
+        return fn
+
+    return deco
+
+
+def run(project: Project, checkers: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Run the (selected) checkers; suppressions applied, output sorted
+    and deterministic."""
+    names = list(checkers) if checkers is not None else sorted(CHECKERS)
+    findings: list[Finding] = []
+    for relpath, err in getattr(project, "parse_errors", []):
+        findings.append(
+            Finding("syntax-error", relpath, err.lineno or 0, str(err.msg))
+        )
+    for name in names:
+        fn = CHECKERS.get(name)
+        if fn is None:
+            raise KeyError(f"unknown checker: {name}")
+        for f in fn(project):
+            mod = project.by_path.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(findings: list[Finding], path: str):
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "Pre-existing analyzer findings accepted at baseline "
+                    "time; python -m nomad_tpu.analysis fails only on "
+                    "findings NOT in this file. Regenerate with "
+                    "--write-baseline after burning one down."
+                ),
+                "findings": dict(sorted(counts.items())),
+            },
+            f,
+            indent=2,
+            sort_keys=False,
+        )
+        f.write("\n")
+
+
+def partition(
+    findings: list[Finding], baseline: dict[str, int]
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, baselined): the first ``baseline[key]`` occurrences of each
+    key are accepted; extra occurrences (or unknown keys) are new."""
+    seen: dict[str, int] = {}
+    new: list[Finding] = []
+    known: list[Finding] = []
+    for f in findings:
+        seen[f.key] = seen.get(f.key, 0) + 1
+        if seen[f.key] <= baseline.get(f.key, 0):
+            known.append(f)
+        else:
+            new.append(f)
+    return new, known
+
+
+# ----------------------------------------------------------------------
+# shared AST helpers
+# ----------------------------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target: ``a.b.c(...)`` →
+    "a.b.c"; unresolvable pieces render as ``?``."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{dotted(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{dotted(node.value)}[]"
+    return "?"

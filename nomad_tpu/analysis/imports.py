@@ -1,0 +1,249 @@
+"""Import-graph checkers: top-level cycles and dead modules.
+
+- ``import-cycle`` — modules whose *top-level* imports form a cycle
+  (the package's convention is to defer heavy/circular imports into
+  functions; a top-level cycle breaks that convention and will blow up
+  depending on import order);
+- ``dead-module`` — a module no other module, test, or tool imports at
+  all (top-level or deferred): either wire it up or delete it.
+
+``module_import_errors`` is the hook :mod:`nomad_tpu.testing.jscheck`'s
+compileall sweep calls so an import-graph regression fails the same
+tier-1 smoke test that guards syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .framework import Finding, ModuleInfo, Project, register
+
+#: modules that are roots by role, not by being imported
+_ENTRY_SUFFIXES = ("__init__", "__main__", "conftest")
+
+
+def _top_level_imports(mod: ModuleInfo) -> set[str]:
+    """Modules imported at the top level (cycle-relevant)."""
+    return _imports(mod, top_only=True)
+
+
+def _all_imports(mod: ModuleInfo) -> set[str]:
+    """Every import, including deferred ones (deadness-relevant)."""
+    return _imports(mod, top_only=False)
+
+
+def _imports(mod: ModuleInfo, top_only: bool) -> set[str]:
+    out: set[str] = set()
+    nodes = (
+        mod.tree.body
+        if top_only
+        else [n for n in ast.walk(mod.tree)]
+    )
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            target = _resolve(mod, node)
+            if target:
+                out.add(target)
+                # "from pkg import name" may bind a submodule
+                for alias in node.names:
+                    out.add(f"{target}.{alias.name}")
+    return out
+
+
+def _resolve(mod: ModuleInfo, node: ast.ImportFrom) -> Optional[str]:
+    if node.level == 0:
+        return node.module
+    parts = mod.modname.split(".")
+    # from a package __init__, level 1 is the package itself (ModuleInfo
+    # strips the .__init__ suffix, so only strip level-1 components)
+    level = node.level - 1 if mod.is_package else node.level
+    base = parts[: len(parts) - level] if level else parts
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base) if base else None
+
+
+def _cycle_imports(mod: ModuleInfo, known: set[str]) -> set[str]:
+    """Top-level imports as CYCLE edges. ``from . import sub`` where
+    ``sub`` is a known submodule binds the submodule, not a package
+    attribute — edge to the submodule only (Python resolves it fine even
+    mid-parent-init), while ``from . import NAME`` for a non-module NAME
+    really does read the package __init__ and keeps the package edge."""
+    out: set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            target = _resolve(mod, node)
+            if not target:
+                continue
+            for alias in node.names:
+                sub = f"{target}.{alias.name}"
+                out.add(sub if sub in known else target)
+    return out
+
+
+def _edges(project: Project, top_only: bool) -> dict[str, set[str]]:
+    known = set(project.by_modname)
+    graph: dict[str, set[str]] = {}
+    for mod in project.modules:
+        deps = set()
+        imps = (
+            _cycle_imports(mod, known)
+            if top_only
+            else _imports(mod, top_only)
+        )
+        for imp in imps:
+            # normalize to the longest known module prefix
+            parts = imp.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = ".".join(parts[:i])
+                if cand in known and cand != mod.modname:
+                    deps.add(cand)
+                    break
+        graph[mod.modname] = deps
+    return graph
+
+
+def _sccs(graph: dict[str, set[str]]) -> list[list[str]]:
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def connect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph.get(v, ()):
+            if w not in index:
+                connect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            connect(v)
+    return out
+
+
+@register(
+    "import-cycle",
+    "top-level import cycle between modules (deferred imports inside "
+    "functions are the package convention and exempt)",
+)
+def check_import_cycles(project: Project) -> list[Finding]:
+    graph = _edges(project, top_only=True)
+    findings = []
+    for comp in _sccs(graph):
+        anchor = project.by_modname.get(comp[0])
+        findings.append(
+            Finding(
+                "import-cycle",
+                anchor.relpath if anchor else comp[0],
+                1,
+                f"top-level import cycle: {' -> '.join(comp)}",
+            )
+        )
+    return findings
+
+
+def _external_roots(root: str) -> set[str]:
+    """nomad_tpu modules referenced from tests/, bench.py, and other
+    repo-level tooling (they keep a module alive)."""
+    refs: set[str] = set()
+    candidates = []
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for fn in os.listdir(tests_dir):
+            if fn.endswith(".py"):
+                candidates.append(os.path.join(tests_dir, fn))
+    for extra in ("bench.py", "conftest.py", "__graft_entry__.py"):
+        path = os.path.join(root, extra)
+        if os.path.exists(path):
+            candidates.append(path)
+    for path in candidates:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    refs.add(alias.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                refs.add(node.module)
+                for alias in node.names:
+                    refs.add(f"{node.module}.{alias.name}")
+    return refs
+
+
+@register(
+    "dead-module",
+    "module imported by nothing (package, tests, bench, or tooling): "
+    "wire it up or delete it",
+)
+def check_dead_modules(project: Project) -> list[Finding]:
+    imported: set[str] = set()
+    known = set(project.by_modname)
+    # importing pkg.sub imports pkg too: credit EVERY known prefix
+    for mod in project.modules:
+        for imp in _all_imports(mod):
+            parts = imp.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = ".".join(parts[:i])
+                if cand in known and cand != mod.modname:
+                    imported.add(cand)
+    for ref in _external_roots(project.root):
+        parts = ref.split(".")
+        for i in range(len(parts), 0, -1):
+            cand = ".".join(parts[:i])
+            if cand in known:
+                imported.add(cand)
+    findings = []
+    for mod in project.modules:
+        stem = mod.relpath.rsplit("/", 1)[-1][:-3]
+        if stem in _ENTRY_SUFFIXES:
+            continue
+        if mod.modname not in imported:
+            findings.append(
+                Finding(
+                    "dead-module", mod.relpath, 1,
+                    f"{mod.modname} is imported by nothing in the repo",
+                )
+            )
+    return findings
+
+
+def module_import_errors(root: str, package: str = "nomad_tpu") -> list[str]:
+    """Import-cycle + dead-module findings as plain strings — the hook
+    the jscheck compileall sweep runs under tier-1."""
+    project = Project.load(root, package)
+    out = []
+    for f in check_import_cycles(project) + check_dead_modules(project):
+        mod = project.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f.format())
+    return out

@@ -1,0 +1,383 @@
+"""JAX hot-path hygiene checkers.
+
+The device plane (``nomad_tpu/tpu/``) lives or dies on two invariants:
+jit'd code must stay pure and device-resident (a stray ``float()`` or
+``np.asarray`` on a tracer forces a host sync in the middle of the fused
+scan), and every shape reaching a compiled entry point must round
+through the ONE padding policy (``batch_sched._bucket``) — the warmup
+ladder once compiled shape 51200 while production padded the 50K-alloc
+headline to 50176, so the prewarmed program was never the one that ran.
+
+Rules:
+
+- ``jit-host-sync`` — inside jit-compiled code: ``.item()``,
+  ``np.asarray``/``np.array``, or ``float()``/``int()``/``bool()`` on a
+  non-constant, non-static argument (static_argnums parameters are
+  compile-time Python values and exempt);
+- ``jit-impure-call`` — ``time.time``/``monotonic``/``perf_counter``,
+  ``random.*`` or ``np.random.*`` reachable inside jit'd code (traced
+  once at compile time: the "randomness" freezes into the program);
+- ``device-put-in-loop`` — ``device_put`` lexically inside a
+  ``for``/``while`` body (one transfer per iteration; batch it);
+- ``shape-literal-unbucketed`` — an integer literal ≥ 1024 used directly
+  as a dimension in an array constructor or ``.lower()`` call in
+  ``tpu/`` without rounding through ``_bucket``/``bucket_shape``;
+- ``jit-shape-unbucketed`` — a locally-computed size (from ``len()``,
+  arithmetic, or a literal) passed to a known jit entry point without
+  rounding through ``_bucket`` (deliberate static args get a suppression
+  with a WHY).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .framework import Finding, ModuleInfo, Project, dotted, register
+
+#: names that mark an expression as rounded through the padding policy
+_BUCKET_FNS = {"_bucket", "bucket_shape", "_row_bucket"}
+
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "tile", "arange"}
+
+_IMPURE = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+}
+
+
+def _jit_functions(mod: ModuleInfo) -> list[ast.AST]:
+    """Function defs compiled by jax.jit in this module: decorated defs
+    (``@jax.jit``, ``@partial(jax.jit, ...)``/``@functools.partial``),
+    defs wrapped by ``name = jax.jit(f)``, and lambdas passed straight
+    to ``jax.jit(...)``."""
+    out = []
+    wrapped_names = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    out.append(arg)
+                elif isinstance(arg, ast.Name):
+                    wrapped_names.add(arg.id)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in wrapped_names or any(
+                _is_jit_decorator(d) for d in node.decorator_list
+            ):
+                out.append(node)
+    return out
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = dotted(node.func)
+    return name in ("jax.jit", "jit") or (
+        name in ("functools.partial", "partial")
+        and node.args
+        and dotted(node.args[0]) in ("jax.jit", "jit")
+    )
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        return _is_jit_call(dec)
+    return dotted(dec) in ("jax.jit", "jit")
+
+
+def _static_params(fn: ast.AST) -> set[str]:
+    """Parameter names marked static via static_argnums/static_argnames
+    on the jit decorator — plain Python values at trace time, exempt
+    from host-sync rules."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    params = [a.arg for a in fn.args.args]
+    static: set[str] = set()
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_call(dec)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnums":
+                for el in _int_elements(kw.value):
+                    if 0 <= el < len(params):
+                        static.add(params[el])
+            elif kw.arg == "static_argnames":
+                for el in _str_elements(kw.value):
+                    static.add(el)
+    return static
+
+
+def _int_elements(node: ast.AST) -> Iterable[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _int_elements(el)
+
+
+def _str_elements(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _str_elements(el)
+
+
+@register(
+    "jit-host-sync",
+    "host-sync forcer inside jit'd code: .item(), np.asarray/np.array, "
+    "or float()/int()/bool() on a traced value",
+)
+def check_host_sync(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        for fn in _jit_functions(mod):
+            static = _static_params(fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    if name.endswith(".item") and not node.args:
+                        findings.append(
+                            Finding(
+                                "jit-host-sync", mod.relpath, node.lineno,
+                                f"{name}() forces a host sync inside "
+                                "jit'd code",
+                            )
+                        )
+                    elif name in ("np.asarray", "np.array", "numpy.asarray",
+                                  "numpy.array"):
+                        findings.append(
+                            Finding(
+                                "jit-host-sync", mod.relpath, node.lineno,
+                                f"{name}() on a traced value forces a "
+                                "host transfer inside jit'd code",
+                            )
+                        )
+                    elif (
+                        name in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and not isinstance(node.args[0], ast.Constant)
+                        and not (
+                            isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in static
+                        )
+                    ):
+                        findings.append(
+                            Finding(
+                                "jit-host-sync", mod.relpath, node.lineno,
+                                f"{name}({dotted(node.args[0])}) "
+                                "concretizes a traced value inside "
+                                "jit'd code",
+                            )
+                        )
+    return findings
+
+
+@register(
+    "jit-impure-call",
+    "Python time/random reachable inside jit'd code: traced once at "
+    "compile time, frozen into the program",
+)
+def check_impure(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        for fn in _jit_functions(mod):
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    if name in _IMPURE or name.startswith(
+                        ("random.", "np.random.", "numpy.random.")
+                    ):
+                        findings.append(
+                            Finding(
+                                "jit-impure-call", mod.relpath, node.lineno,
+                                f"{name}() inside jit'd code is evaluated "
+                                "once at trace time, not per call",
+                            )
+                        )
+    return findings
+
+
+@register(
+    "device-put-in-loop",
+    "device_put inside a loop body: one host->device transfer per "
+    "iteration — batch the upload",
+)
+def check_device_put_in_loop(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop:
+                    continue
+                if isinstance(node, ast.Call) and dotted(node.func).endswith(
+                    "device_put"
+                ):
+                    findings.append(
+                        Finding(
+                            "device-put-in-loop", mod.relpath, node.lineno,
+                            f"{dotted(node.func)}() inside a "
+                            f"{'for' if isinstance(loop, ast.For) else 'while'}"
+                            " loop",
+                        )
+                    )
+    return findings
+
+
+def _under_bucket(node: ast.AST, parents: dict) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            tail = dotted(cur.func).rsplit(".", 1)[-1]
+            if tail in _BUCKET_FNS:
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+#: dims below this are tile/lane constants, not cluster-scale shapes
+SHAPE_LITERAL_MIN = 1024
+
+
+@register(
+    "shape-literal-unbucketed",
+    "large integer literal used directly as an array dimension in tpu/ "
+    "without rounding through _bucket (the 51200-vs-50176 bug class)",
+)
+def check_shape_literals(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.iter_modules("nomad_tpu/tpu/"):
+        parents = _parent_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted(node.func).rsplit(".", 1)[-1]
+            if tail not in _ARRAY_CTORS and tail != "lower":
+                continue
+            for arg in node.args:
+                for lit in ast.walk(arg):
+                    if not (
+                        isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, int)
+                        and lit.value >= SHAPE_LITERAL_MIN
+                    ):
+                        continue
+                    if _under_bucket(lit, parents):
+                        continue
+                    findings.append(
+                        Finding(
+                            "shape-literal-unbucketed", mod.relpath,
+                            lit.lineno,
+                            f"literal dim {lit.value} in {tail}() does "
+                            "not round through _bucket; production "
+                            "padding will compile a different shape",
+                        )
+                    )
+    return findings
+
+
+def _jit_entry_names(project: Project) -> set[str]:
+    """Names of jit-compiled callables across the project: decorated
+    defs and ``name = jax.jit(...)`` assignments."""
+    names: set[str] = set()
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_decorator(d) for d in node.decorator_list):
+                    names.add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if (
+                    isinstance(tgt, ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value)
+                ):
+                    names.add(tgt.id)
+    return names
+
+
+@register(
+    "jit-shape-unbucketed",
+    "locally-computed size passed to a jit entry point without rounding "
+    "through _bucket: each distinct value compiles a fresh program",
+)
+def check_jit_shapes(project: Project) -> list[Finding]:
+    entries = _jit_entry_names(project)
+    if not entries:
+        return []
+    findings = []
+    for mod in project.iter_modules("nomad_tpu/tpu/"):
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            bucketed: set[str] = set()
+            raw: set[str] = set()  # size-like names NOT via _bucket
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                    ):
+                        continue
+                    name = node.targets[0].id
+                    val = node.value
+                    if (
+                        isinstance(val, ast.Call)
+                        and dotted(val.func).rsplit(".", 1)[-1]
+                        in _BUCKET_FNS
+                    ):
+                        bucketed.add(name)
+                        raw.discard(name)
+                    elif isinstance(val, ast.Call) and dotted(
+                        val.func
+                    ) == "len":
+                        raw.add(name)
+                    elif isinstance(val, ast.BinOp) or (
+                        isinstance(val, ast.Constant)
+                        and isinstance(val.value, int)
+                        and val.value >= SHAPE_LITERAL_MIN
+                    ):
+                        raw.add(name)
+            if not raw:
+                continue
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    tail = dotted(node.func).rsplit(".", 1)[-1]
+                    if tail not in entries:
+                        continue
+                    for arg in node.args:
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in raw
+                            and arg.id not in bucketed
+                        ):
+                            findings.append(
+                                Finding(
+                                    "jit-shape-unbucketed", mod.relpath,
+                                    node.lineno,
+                                    f"{arg.id} reaches jit entry "
+                                    f"{tail}() without rounding through "
+                                    "_bucket",
+                                )
+                            )
+    return findings

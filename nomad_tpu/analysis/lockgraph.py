@@ -1,0 +1,848 @@
+"""Cross-module lock-acquisition graph + deadlock/blocking lints.
+
+Builds a best-effort static model of the threaded control plane:
+
+1. **lock definitions** — ``self.X = threading.Lock()/RLock()`` inside a
+   class, module-level ``X = threading.Lock()``, and
+   ``threading.Condition(self.Y)`` aliases (the condition guards Y's
+   lock; ``Condition()`` with no argument owns a fresh one);
+2. **per-function acquisition facts** — ``with self.X:`` scopes, nested
+   acquisitions, and every call made while a known lock is held;
+3. **call resolution** — ``self.m()`` through the class (and bases),
+   ``self.attr.m()`` through ``self.attr = ClassName(...)`` assignments,
+   module-level instances (``_WHEEL.arm``), imported names, and — for
+   otherwise-unresolvable attribute calls — a unique-method-name
+   fallback (if exactly one analyzed class defines ``m``, use it);
+4. **fixpoints** — ``may_acquire`` (locks a function can take,
+   transitively) and ``may_block`` (function reaches a blocking
+   primitive: ``time.sleep``, condition/event waits, thread joins,
+   blocking RPC/raft/store waits, ``block_until_ready``).
+
+Findings:
+
+- ``lock-order-cycle``: a strongly-connected component in the edge set
+  {held lock → acquired lock} — two threads taking the locks in
+  opposite orders can deadlock;
+- ``lock-held-blocking-call``: a known lock held across a call that can
+  block (raft apply, RPC round-trip, device sync, ``time.sleep``,
+  waiting on a foreign condition/queue). A ``Condition.wait`` on the
+  condition's OWN lock is the sanctioned pattern and is exempt at the
+  direct level — but still marks the enclosing function as blocking for
+  callers that hold other locks.
+
+The model is intentionally heuristic: it resolves what it can and stays
+silent about the rest. The runtime lockdep witness
+(:mod:`nomad_tpu.testing.lockdep`) cross-validates the edges this pass
+derives against orders actually observed under tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .framework import Finding, ModuleInfo, Project, dotted, register
+
+#: call targets that block by themselves (seed set for may_block);
+#: matched on the LAST attribute / name segment plus receiver hints
+_BLOCKING_METHODS = {
+    "block_until_ready",
+    "snapshot_min_index",
+    "raft_apply",
+    "recv",
+    "accept",
+}
+_SUBPROCESS_FNS = {"run", "check_output", "check_call", "call"}
+
+
+def _short(modname: str) -> str:
+    return modname[len("nomad_tpu."):] if modname.startswith("nomad_tpu.") else modname
+
+
+@dataclass
+class LockDef:
+    lock_id: str
+    relpath: str
+    line: int
+    #: lock id this name aliases (Condition(self.X) guards X's lock)
+    alias_of: Optional[str] = None
+
+
+@dataclass
+class FuncInfo:
+    qualname: str  # "core.broker.EvalBroker.dequeue"
+    relpath: str
+    line: int
+    #: (lock_id, line) acquired directly in this function
+    acquires: list = field(default_factory=list)
+    #: (outer_lock, inner_lock, line) from lexically nested acquisition
+    nested: list = field(default_factory=list)
+    #: (held_locks tuple, CallRef, line) for every call expression
+    calls: list = field(default_factory=list)
+    #: (held_locks tuple, reason, line) direct blocking primitives
+    blocking: list = field(default_factory=list)
+    #: does this function block regardless of findings (cond.wait on own
+    #: lock still blocks its CALLERS)
+    self_blocking: Optional[str] = None
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    relpath: str
+    bases: list  # base class name strings (resolved lazily)
+    #: attr → lock id (this class's own locks; aliases resolved)
+    lock_attrs: dict = field(default_factory=dict)
+    #: attr → class qualname (from ``self.attr = ClassName(...)``)
+    attr_types: dict = field(default_factory=dict)
+    methods: dict = field(default_factory=dict)  # name → FuncInfo
+
+
+class _ModuleSymbols:
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.imports: dict[str, str] = {}  # local name → dotted target
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.module_locks: dict[str, LockDef] = {}
+        self.module_instances: dict[str, str] = {}  # name → class qualname
+
+
+def _resolve_relative(mod: ModuleInfo, node: ast.ImportFrom) -> str:
+    if node.level == 0:
+        return node.module or ""
+    parts = mod.modname.split(".")
+    # from a package __init__, level 1 is the package ITSELF (ModuleInfo
+    # strips the .__init__ suffix, so only strip level-1 components)
+    level = node.level - 1 if mod.is_package else node.level
+    base = parts[: len(parts) - level] if level else parts
+    if node.module:
+        base = base + node.module.split(".")
+    return ".".join(base)
+
+
+def _annotation_class(node: ast.AST) -> Optional[str]:
+    """Class name out of a type annotation: unwraps Optional[X]/list[X]
+    and string annotations; returns None for unions of real types."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        base = dotted(node.value).rsplit(".", 1)[-1]
+        if base in ("Optional", "List", "list"):
+            return _annotation_class(node.slice)
+        return None
+    name = dotted(node).rsplit(".", 1)[-1]
+    if name and name[:1].isupper() and name not in ("None", "Any"):
+        return name
+    return None
+
+
+def _lock_ctor(node: ast.AST) -> Optional[str]:
+    """"lock" | "rlock" | "condition" when ``node`` constructs one."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id == "threading":
+            name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name == "Lock":
+        return "lock"
+    if name == "RLock":
+        return "rlock"
+    if name == "Condition":
+        return "condition"
+    return None
+
+
+class Model:
+    """The project-wide lock/call model."""
+
+    def __init__(self, project: Project, prefixes: tuple = ("nomad_tpu/",)):
+        self.project = project
+        self.symbols: dict[str, _ModuleSymbols] = {}
+        self.locks: dict[str, LockDef] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        #: method name → [class qualnames defining it]
+        self.method_index: dict[str, list] = {}
+        for mod in project.modules:
+            if not any(mod.relpath.startswith(p) for p in prefixes):
+                continue
+            self._scan_symbols(mod)
+        # declare every function BEFORE walking any body: forward
+        # references within a class (sync calling _rebuild defined
+        # below it) must resolve
+        declared = []
+        for syms in self.symbols.values():
+            declared.extend(self._declare_module(syms))
+        for syms, node, fi, ci in declared:
+            self._walk_block(syms, ci, fi, node.body, held=())
+        self._fix_may_acquire()
+        self._fix_may_block()
+
+    # -- pass 1: symbols, lock defs, attr types -------------------------
+    def _scan_symbols(self, mod: ModuleInfo):
+        syms = _ModuleSymbols(mod)
+        self.symbols[mod.modname] = syms
+        short = _short(mod.modname)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    syms.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                target = _resolve_relative(mod, node)
+                for alias in node.names:
+                    syms.imports[alias.asname or alias.name] = (
+                        f"{target}.{alias.name}" if target else alias.name
+                    )
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    kind = _lock_ctor(node.value)
+                    if kind is not None:
+                        lid = f"{short}.{tgt.id}"
+                        ld = LockDef(lid, mod.relpath, node.lineno)
+                        syms.module_locks[tgt.id] = ld
+                        self.locks[lid] = ld
+                    elif isinstance(node.value, ast.Call) and isinstance(
+                        node.value.func, ast.Name
+                    ):
+                        syms.module_instances[tgt.id] = node.value.func.id
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    qualname=f"{short}.{node.name}",
+                    relpath=mod.relpath,
+                    bases=[dotted(b) for b in node.bases],
+                )
+                syms.classes[node.name] = ci
+                self.classes[ci.qualname] = ci
+                self._scan_class_attrs(mod, syms, node, ci)
+
+    def _scan_class_attrs(
+        self, mod: ModuleInfo, syms: _ModuleSymbols, node: ast.ClassDef,
+        ci: ClassInfo,
+    ):
+        # lock/instance attributes from every method body (not just
+        # __init__ — lazily-created locks count too)
+        pending_aliases = []  # (attr, referenced self attr)
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in meth.args.args}
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.AnnAssign):
+                    # ``self._sub: Optional[Subscription] = ...`` — the
+                    # annotation types the attribute for call resolution
+                    tgt = sub.target
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        tname = _annotation_class(sub.annotation)
+                        if tname is not None:
+                            ci.attr_types.setdefault(tgt.attr, tname)
+                    continue
+                if not (
+                    isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                ):
+                    continue
+                tgt = sub.targets[0]
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                attr = tgt.attr
+                kind = _lock_ctor(sub.value)
+                if kind == "condition" and sub.value.args:
+                    arg = sub.value.args[0]
+                    if (
+                        isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"
+                    ):
+                        pending_aliases.append((attr, arg.attr, sub.lineno))
+                    continue
+                if kind is not None:
+                    lid = f"{ci.qualname}.{attr}"
+                    ld = LockDef(lid, mod.relpath, sub.lineno)
+                    ci.lock_attrs[attr] = lid
+                    self.locks[lid] = ld
+                    continue
+                if (
+                    isinstance(sub.value, ast.Name)
+                    and sub.value.id in params
+                    and "lock" in sub.value.id.lower()
+                ):
+                    # a lock passed in by the constructor (MirrorCluster
+                    # takes the mirror's RLock): track it under this
+                    # class's name — identity is imperfect but holds and
+                    # edges still register
+                    lid = f"{ci.qualname}.{attr}"
+                    ci.lock_attrs[attr] = lid
+                    self.locks[lid] = LockDef(lid, mod.relpath, sub.lineno)
+                    continue
+                if isinstance(sub.value, ast.Call):
+                    ctor = sub.value.func
+                    cname = None
+                    if isinstance(ctor, ast.Name):
+                        cname = ctor.id
+                    elif isinstance(ctor, ast.Attribute) and isinstance(
+                        ctor.value, ast.Name
+                    ):
+                        cname = ctor.attr
+                    if cname and cname[:1].isupper():
+                        ci.attr_types.setdefault(attr, cname)
+        for attr, target, line in pending_aliases:
+            base = ci.lock_attrs.get(target)
+            if base is not None:
+                ci.lock_attrs[attr] = base  # alias: same underlying lock
+            else:
+                lid = f"{ci.qualname}.{attr}"
+                ci.lock_attrs[attr] = lid
+                self.locks[lid] = LockDef(lid, mod.relpath, line)
+
+    # -- pass 2: declare functions (no bodies yet) -----------------------
+    def _declare_module(self, syms: _ModuleSymbols) -> list:
+        mod = syms.mod
+        short = _short(mod.modname)
+        declared = []
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._declare_function(
+                    syms, node, f"{short}.{node.name}"
+                )
+                syms.functions[node.name] = fi
+                declared.append((syms, node, fi, None))
+            elif isinstance(node, ast.ClassDef):
+                ci = syms.classes[node.name]
+                for meth in node.body:
+                    if isinstance(
+                        meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        fi = self._declare_function(
+                            syms, meth, f"{ci.qualname}.{meth.name}"
+                        )
+                        ci.methods[meth.name] = fi
+                        declared.append((syms, meth, fi, ci))
+        return declared
+
+    def _declare_function(self, syms, node, qualname: str) -> FuncInfo:
+        fi = FuncInfo(qualname, syms.mod.relpath, node.lineno)
+        self.funcs[qualname] = fi
+        name = qualname.rsplit(".", 1)[-1]
+        self.method_index.setdefault(name, []).append(qualname)
+        return fi
+
+    def _lock_of(self, syms, ci, expr) -> Optional[str]:
+        """Resolve an expression to a known lock id, if possible."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and ci is not None
+        ):
+            lid = self._class_lock(ci, expr.attr)
+            if lid is not None:
+                return lid
+        if isinstance(expr, ast.Name):
+            ld = syms.module_locks.get(expr.id)
+            if ld is not None:
+                return ld.lock_id
+        return None
+
+    def _class_lock(self, ci: ClassInfo, attr: str) -> Optional[str]:
+        seen = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop()
+            if cur.qualname in seen:
+                continue
+            seen.add(cur.qualname)
+            if attr in cur.lock_attrs:
+                return cur.lock_attrs[attr]
+            for base in cur.bases:
+                bci = self._resolve_class(cur, base)
+                if bci is not None:
+                    stack.append(bci)
+        return None
+
+    def _resolve_class(self, ci: ClassInfo, name: str) -> Optional[ClassInfo]:
+        # name may be dotted ("module.Class"); try the tail
+        tail = name.rsplit(".", 1)[-1]
+        mod_short = ci.qualname.rsplit(".", 2)[0]
+        for qual, cand in self.classes.items():
+            if qual.endswith(f".{tail}"):
+                if qual.rsplit(".", 1)[0] == mod_short or tail == name:
+                    return cand
+        for qual, cand in self.classes.items():
+            if qual.endswith(f".{tail}"):
+                return cand
+        return None
+
+    def _walk_block(self, syms, ci, fi: FuncInfo, body, held: tuple):
+        for stmt in body:
+            self._walk_stmt(syms, ci, fi, stmt, held)
+
+    def _walk_stmt(self, syms, ci, fi: FuncInfo, stmt, held: tuple):
+        if isinstance(stmt, ast.With):
+            new_held = held
+            for item in stmt.items:
+                lid = self._lock_of(syms, ci, item.context_expr)
+                if lid is not None:
+                    fi.acquires.append((lid, stmt.lineno))
+                    for h in new_held:
+                        if h != lid:
+                            fi.nested.append((h, lid, stmt.lineno))
+                    if lid not in new_held:
+                        new_held = new_held + (lid,)
+                else:
+                    self._visit_expr(
+                        syms, ci, fi, item.context_expr, held
+                    )
+            self._walk_block(syms, ci, fi, stmt.body, new_held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs when called, not under the current held set
+            self._scan_nested(syms, ci, fi, stmt)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._visit_expr(syms, ci, fi, child, held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(syms, ci, fi, child, held)
+            elif isinstance(child, (ast.excepthandler,)):
+                self._walk_block(syms, ci, fi, child.body, held)
+            elif isinstance(child, ast.withitem):
+                pass
+
+    def _scan_nested(self, syms, ci, parent: FuncInfo, node):
+        qual = f"{parent.qualname}.<{node.name}>"
+        fi = self._declare_function(syms, node, qual)
+        self._walk_block(syms, ci, fi, node.body, held=())
+        return fi
+
+    def _visit_expr(self, syms, ci, fi: FuncInfo, expr, held: tuple):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._record_call(syms, ci, fi, node, held)
+
+    # -- call classification --------------------------------------------
+    def _record_call(self, syms, ci, fi: FuncInfo, node: ast.Call, held):
+        fn = node.func
+        line = node.lineno
+        # explicit lock method calls: acquire/release on a known lock
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            meth = fn.attr
+            lid = self._lock_of(syms, ci, recv)
+            if lid is not None:
+                if meth == "acquire":
+                    fi.acquires.append((lid, line))
+                    for h in held:
+                        if h != lid:
+                            fi.nested.append((h, lid, line))
+                elif meth in ("wait", "wait_for"):
+                    # Condition.wait releases its own lock: sanctioned
+                    # when the ONLY held lock is the condition's own;
+                    # blocking for callers regardless
+                    fi.self_blocking = fi.self_blocking or (
+                        f"{lid}.wait"
+                    )
+                    others = tuple(h for h in held if h != lid)
+                    if others:
+                        fi.blocking.append(
+                            (others, f"wait on {lid}", line)
+                        )
+                return
+            if meth in ("wait", "wait_for"):
+                # event/future/foreign-cond wait: blocking
+                fi.self_blocking = fi.self_blocking or (
+                    f"{dotted(recv)}.wait"
+                )
+                if held:
+                    fi.blocking.append(
+                        (held, f"{dotted(recv)}.wait()", line)
+                    )
+                return
+            if meth == "sleep" and isinstance(recv, ast.Name) and recv.id == "time":
+                fi.self_blocking = fi.self_blocking or "time.sleep"
+                if held:
+                    fi.blocking.append((held, "time.sleep()", line))
+                return
+            if meth == "join" and not node.args:
+                # no-positional-arg join: a thread/queue join, not
+                # str.join/os.path.join (those take positionals)
+                fi.self_blocking = fi.self_blocking or (
+                    f"{dotted(recv)}.join"
+                )
+                if held:
+                    fi.blocking.append(
+                        (held, f"{dotted(recv)}.join()", line)
+                    )
+                return
+            if (
+                isinstance(recv, ast.Name)
+                and recv.id == "subprocess"
+                and meth in _SUBPROCESS_FNS
+            ):
+                fi.self_blocking = fi.self_blocking or f"subprocess.{meth}"
+                if held:
+                    fi.blocking.append((held, f"subprocess.{meth}()", line))
+                return
+            if meth == "device_put" or (
+                meth == "asarray"
+                and isinstance(recv, ast.Name)
+                and recv.id == "jnp"
+            ):
+                # host<->device transfer: dispatch + possible sync; a
+                # lock held across it serializes every sibling behind
+                # device work
+                fi.self_blocking = fi.self_blocking or (
+                    f"{dotted(recv)}.{meth} (device transfer)"
+                )
+                if held:
+                    fi.blocking.append(
+                        (held, f"{dotted(recv)}.{meth}() device transfer",
+                         line)
+                    )
+                return
+            if meth in _BLOCKING_METHODS:
+                fi.self_blocking = fi.self_blocking or meth
+                if held:
+                    fi.blocking.append(
+                        (held, f"{dotted(recv)}.{meth}()", line)
+                    )
+                # fall through: also resolve as a call (the callee may
+                # additionally take locks)
+            fi.calls.append(
+                (held, self._callee_ref(syms, ci, recv, meth), line)
+            )
+            return
+        if isinstance(fn, ast.Name):
+            fi.calls.append((held, self._name_ref(syms, ci, fn.id), line))
+
+    def _callee_ref(self, syms, ci, recv, meth: str):
+        """Resolve ``recv.meth`` to a FuncInfo qualname, or None."""
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and ci is not None:
+                target = self._find_method(ci, meth)
+                if target is not None:
+                    return target
+                return self._unique_method(meth)
+            inst = syms.module_instances.get(recv.id)
+            if inst is not None:
+                tci = self._resolve_class_by_name(syms, inst)
+                if tci is not None:
+                    target = self._find_method(tci, meth)
+                    if target is not None:
+                        return target
+            imported = syms.imports.get(recv.id)
+            if imported is not None:
+                target_syms = self.symbols.get(imported)
+                if target_syms is not None:
+                    f = target_syms.functions.get(meth)
+                    qual = f"{_short(imported)}.{meth}"
+                    if qual in self.funcs:
+                        return qual
+                return None  # stdlib / external module
+            tci = syms.classes.get(recv.id)
+            if tci is not None:  # ClassName.method / classmethod style
+                return self._find_method(tci, meth)
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and ci is not None
+        ):
+            tname = ci.attr_types.get(recv.attr)
+            if tname is not None:
+                tci = self._resolve_class_by_name(syms, tname)
+                if tci is not None:
+                    target = self._find_method(tci, meth)
+                    if target is not None:
+                        return target
+            return self._unique_method(meth)
+        if isinstance(recv, ast.Call) and isinstance(recv.func, ast.Name):
+            if recv.func.id == "super" and ci is not None:
+                for base in ci.bases:
+                    bci = self._resolve_class(ci, base)
+                    if bci is not None:
+                        target = self._find_method(bci, meth)
+                        if target is not None:
+                            return target
+        return None
+
+    def _name_ref(self, syms, ci, name: str):
+        short = _short(syms.mod.modname)
+        qual = f"{short}.{name}"
+        if qual in self.funcs:
+            return qual
+        imported = syms.imports.get(name)
+        if imported is not None and imported.startswith("nomad_tpu."):
+            mod, _, sym = imported.rpartition(".")
+            qual = f"{_short(mod)}.{sym}"
+            if qual in self.funcs:
+                return qual
+        return None
+
+    def _find_method(self, ci: ClassInfo, meth: str) -> Optional[str]:
+        seen = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop()
+            if cur.qualname in seen:
+                continue
+            seen.add(cur.qualname)
+            if meth in cur.methods:
+                return cur.methods[meth].qualname
+            for base in cur.bases:
+                bci = self._resolve_class(cur, base)
+                if bci is not None:
+                    stack.append(bci)
+        return None
+
+    #: method names too generic to trust the unique-name fallback for
+    #: (they collide with builtin container/stdlib methods)
+    _COMMON_METHODS = frozenset(
+        {
+            "get", "pop", "append", "add", "items", "keys", "values",
+            "copy", "update", "clear", "join", "split", "remove",
+            "discard", "setdefault", "sort", "extend", "popleft", "put",
+            "read", "write", "send", "start", "index", "count", "format",
+        }
+    )
+
+    def _unique_method(self, meth: str) -> Optional[str]:
+        if meth in self._COMMON_METHODS or meth.startswith("__"):
+            return None
+        # only trust uniqueness for distinctive names
+        cands = [
+            q
+            for q in self.method_index.get(meth, ())
+            if "<" not in q  # nested defs aren't call targets for this
+        ]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _resolve_class_by_name(self, syms, name: str) -> Optional[ClassInfo]:
+        tci = syms.classes.get(name)
+        if tci is not None:
+            return tci
+        imported = syms.imports.get(name)
+        if imported is not None:
+            mod, _, sym = imported.rpartition(".")
+            return self.classes.get(f"{_short(mod)}.{sym}")
+        for qual, cand in self.classes.items():
+            if qual.endswith(f".{name}"):
+                return cand
+        return None
+
+    # -- fixpoints ------------------------------------------------------
+    def _fix_may_acquire(self):
+        self.may_acquire: dict[str, set] = {
+            q: {l for l, _ in fi.acquires} for q, fi in self.funcs.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.funcs.items():
+                cur = self.may_acquire[q]
+                for _, callee, _ in fi.calls:
+                    if callee is None or callee == q:
+                        continue
+                    extra = self.may_acquire.get(callee)
+                    if extra and not extra <= cur:
+                        cur |= extra
+                        changed = True
+
+    def _fix_may_block(self):
+        #: qualname → human-readable reason it can block
+        self.may_block: dict[str, str] = {
+            q: fi.self_blocking
+            for q, fi in self.funcs.items()
+            if fi.self_blocking
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.funcs.items():
+                if q in self.may_block:
+                    continue
+                for _, callee, _ in fi.calls:
+                    if callee is None or callee == q:
+                        continue
+                    reason = self.may_block.get(callee)
+                    if reason is not None:
+                        self.may_block[q] = (
+                            f"{callee.rsplit('.', 1)[-1]} → {reason}"
+                        )
+                        changed = True
+                        break
+
+    # -- outputs --------------------------------------------------------
+    def edges(self) -> dict[tuple, tuple]:
+        """{(outer_lock, inner_lock) → (func, line, via)} — first witness
+        per ordered pair."""
+        out: dict[tuple, tuple] = {}
+        for q, fi in self.funcs.items():
+            for outer, inner, line in fi.nested:
+                out.setdefault((outer, inner), (q, line, "nested with"))
+            for held, callee, line in fi.calls:
+                if callee is None or not held:
+                    continue
+                for inner in self.may_acquire.get(callee, ()):
+                    for outer in held:
+                        if outer != inner:
+                            out.setdefault(
+                                (outer, inner),
+                                (q, line, f"call {callee.rsplit('.', 1)[-1]}"),
+                            )
+        return out
+
+    def lock_sites(self) -> dict[str, tuple]:
+        """lock id → (relpath, line) of its creation site: the join key
+        against the runtime lockdep witness, which identifies locks by
+        allocation site."""
+        return {lid: (ld.relpath, ld.line) for lid, ld in self.locks.items()}
+
+
+def _cycles(edges: dict) -> list[list]:
+    """Strongly-connected components with ≥2 nodes (Tarjan)."""
+    graph: dict[str, list] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list[list] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def build_model(project: Project) -> Model:
+    # memoized per project: the two AST passes + fixpoints dominate an
+    # analyzer run, and both lock checkers (plus the lockdep
+    # cross-validation test) want the same model
+    model = getattr(project, "_lock_model", None)
+    if model is None:
+        model = project._lock_model = Model(project)
+    return model
+
+
+@register(
+    "lock-order-cycle",
+    "cross-module lock-acquisition cycle: threads taking these locks in "
+    "opposite orders can deadlock",
+)
+def check_lock_cycles(project: Project) -> list[Finding]:
+    model = build_model(project)
+    edges = model.edges()
+    findings = []
+    for comp in _cycles(edges):
+        witnesses = sorted(
+            (pair, where)
+            for pair, where in edges.items()
+            if pair[0] in comp and pair[1] in comp
+        )
+        # anchor the finding at the first witness edge's function
+        _, (func, line, via) = witnesses[0]
+        relpath = model.funcs[func].relpath
+        detail = "; ".join(
+            f"{a}->{b} ({w[0].rsplit('.', 1)[-1]}, {w[2]})"
+            for (a, b), w in witnesses
+        )
+        findings.append(
+            Finding(
+                "lock-order-cycle",
+                relpath,
+                line,
+                f"lock cycle {{{', '.join(comp)}}}: {detail}",
+            )
+        )
+    return findings
+
+
+@register(
+    "lock-held-blocking-call",
+    "a known lock is held across a call that can block (raft apply, RPC "
+    "round-trip, device sync, sleep, foreign condition wait)",
+)
+def check_blocking_under_lock(project: Project) -> list[Finding]:
+    model = build_model(project)
+    findings = []
+    for q, fi in model.funcs.items():
+        for held, reason, line in fi.blocking:
+            findings.append(
+                Finding(
+                    "lock-held-blocking-call",
+                    fi.relpath,
+                    line,
+                    f"{' + '.join(held)} held across {reason} in "
+                    f"{q.rsplit('.', 1)[-1]}",
+                )
+            )
+        for held, callee, line in fi.calls:
+            if callee is None or not held:
+                continue
+            reason = model.may_block.get(callee)
+            if reason is None:
+                continue
+            # cond.wait on the one held lock is the callee's own
+            # sanctioned pattern only when the callee IS that wait; the
+            # propagated case can't tell, so report and let deliberate
+            # sites suppress with a WHY
+            findings.append(
+                Finding(
+                    "lock-held-blocking-call",
+                    fi.relpath,
+                    line,
+                    f"{' + '.join(held)} held across blocking call "
+                    f"{callee.rsplit('.', 1)[-1]}() [{reason}] in "
+                    f"{q.rsplit('.', 1)[-1]}",
+                )
+            )
+    return findings

@@ -1,0 +1,168 @@
+"""Raft-index hygiene checkers.
+
+PR 3 burned a debugging cycle on exactly this class: the plan applier
+handed workers a *synthetic* optimistic refresh index (bumped once per
+stacked plan while the real store advanced once per batch), so workers
+blocked up to 5s waiting for an index no store would ever reach. The
+invariant: **raft indexes are minted by committed applies, never by
+consumer arithmetic**, and indexes are only comparable within one store.
+
+Rules (scoped OUTSIDE ``raft/`` and ``state/`` — the raft log and the
+store legitimately do index arithmetic; consumers must not):
+
+- ``raft-index-arith`` — an index-flavored value built from ``± N``
+  arithmetic and then stored into an index-named slot or passed to an
+  index-waiting call (``snapshot_min_index``, ``wait_for_index``,
+  ``subscribe(from_index=...)``);
+- ``raft-index-cross-store`` — a comparison whose two sides read
+  ``latest_index()``/``table_index()`` from *different* receivers:
+  indexes from two stores (or a store and a scratch overlay) are not on
+  the same axis.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .framework import Finding, Project, dotted, register
+
+#: modules allowed to do index arithmetic (they mint/maintain indexes)
+_EXEMPT_PREFIXES = ("nomad_tpu/raft/", "nomad_tpu/state/")
+
+_INDEX_NAME_RE = re.compile(r"(^|_)(index|idx)$", re.IGNORECASE)
+
+_INDEX_CALLS = {"latest_index", "table_index"}
+
+_INDEX_SINKS = {"snapshot_min_index", "wait_for_index", "waitForIndex"}
+_INDEX_KWARGS = {"from_index", "min_index", "index"}
+
+
+def _index_flavored(node: ast.AST) -> bool:
+    """Is this expression an index-valued read?"""
+    if isinstance(node, ast.Name):
+        return bool(_INDEX_NAME_RE.search(node.id))
+    if isinstance(node, ast.Attribute):
+        return bool(_INDEX_NAME_RE.search(node.attr))
+    if isinstance(node, ast.Call):
+        tail = dotted(node.func).rsplit(".", 1)[-1]
+        return tail in _INDEX_CALLS
+    return False
+
+
+def _minted_index(node: ast.AST) -> Optional[str]:
+    """A description when ``node`` mints an index by arithmetic:
+    ``<index expr> ± <int>``."""
+    if not isinstance(node, ast.BinOp) or not isinstance(
+        node.op, (ast.Add, ast.Sub)
+    ):
+        return None
+    left, right = node.left, node.right
+    for a, b in ((left, right), (right, left)):
+        if (
+            isinstance(b, ast.Constant)
+            and isinstance(b.value, int)
+            and _index_flavored(a)
+        ):
+            op = "+" if isinstance(node.op, ast.Add) else "-"
+            return f"{dotted(a)} {op} {b.value}"
+    return None
+
+
+@register(
+    "raft-index-arith",
+    "raft index minted from arithmetic instead of a committed apply "
+    "result (the stalled-worker bug class)",
+)
+def check_index_arith(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if any(mod.relpath.startswith(p) for p in _EXEMPT_PREFIXES):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                desc = _minted_index(node.value)
+                if desc is None:
+                    continue
+                for tgt in node.targets:
+                    if _index_flavored(tgt):
+                        findings.append(
+                            Finding(
+                                "raft-index-arith", mod.relpath,
+                                node.lineno,
+                                f"index minted by arithmetic: "
+                                f"{dotted(tgt)} = {desc}; use the "
+                                "committed apply's returned index",
+                            )
+                        )
+            elif isinstance(node, ast.Call):
+                tail = dotted(node.func).rsplit(".", 1)[-1]
+                for arg in node.args:
+                    desc = _minted_index(arg)
+                    if desc is not None and tail in _INDEX_SINKS:
+                        findings.append(
+                            Finding(
+                                "raft-index-arith", mod.relpath,
+                                node.lineno,
+                                f"arithmetic index {desc} passed to "
+                                f"{tail}(); a store may never reach it",
+                            )
+                        )
+                for kw in node.keywords:
+                    desc = kw.arg and _minted_index(kw.value)
+                    if desc and kw.arg in _INDEX_KWARGS:
+                        findings.append(
+                            Finding(
+                                "raft-index-arith", mod.relpath,
+                                node.lineno,
+                                f"arithmetic index {desc} passed as "
+                                f"{kw.arg}= to {tail}(); a store may "
+                                "never reach it",
+                            )
+                        )
+    return findings
+
+
+def _index_call_receiver(node: ast.AST) -> Optional[str]:
+    """Receiver chain of an ``X.latest_index()`` read, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _INDEX_CALLS:
+        return None
+    return dotted(fn.value)
+
+
+@register(
+    "raft-index-cross-store",
+    "comparison between indexes read from different stores/snapshots: "
+    "not on the same axis",
+)
+def check_cross_store(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if any(mod.relpath.startswith(p) for p in _EXEMPT_PREFIXES):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            recvs = [(_index_call_receiver(s), s) for s in sides]
+            named = [(r, s) for r, s in recvs if r is not None]
+            if len(named) < 2:
+                continue
+            for i in range(len(named) - 1):
+                a, _ = named[i]
+                b, sb = named[i + 1]
+                if a != b:
+                    findings.append(
+                        Finding(
+                            "raft-index-cross-store", mod.relpath,
+                            node.lineno,
+                            f"comparing {a}.latest/table_index() with "
+                            f"{b}.latest/table_index(): indexes are "
+                            "only ordered within one store",
+                        )
+                    )
+    return findings

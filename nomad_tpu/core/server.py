@@ -1423,6 +1423,9 @@ class Server:
         scratch.upsert_job(None, planned)
 
         harness = Harness(state=scratch, seed=self.config.get("seed"))
+        # nta: ignore[raft-index-arith] — scratch dry-run world: this
+        # index seeds the harness's private overlay and is never
+        # published, compared, or waited on against a real store
         harness._next_index = scratch.latest_index() + 1
         ev = Evaluation(
             id=generate_uuid(),

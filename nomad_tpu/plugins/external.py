@@ -187,17 +187,20 @@ class PluginProcess:
         raise PluginError(f"plugin socket never came up: {last_err}")
 
     def shutdown(self):
+        # detach under the lock, reap outside it: wait(timeout=5.0) on a
+        # wedged plugin otherwise blocks every concurrent ensure() for
+        # the full grace period (analyzer: lock-held-blocking-call)
         with self._lock:
-            if self._conn is not None:
-                self._conn.close()
-                self._conn = None
-            if self._proc is not None:
-                self._proc.terminate()
-                try:
-                    self._proc.wait(timeout=5.0)
-                except subprocess.TimeoutExpired:
-                    self._proc.kill()
-                self._proc = None
+            conn, self._conn = self._conn, None
+            proc, self._proc = self._proc, None
+        if conn is not None:
+            conn.close()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
 
 class ExternalDriver(Driver):

@@ -29,6 +29,9 @@ class _RaftConn:
         with self.lock:
             seq = next(self.seq)
             write_frame(self.sock, [seq, method, payload])
+            # nta: ignore[lock-held-blocking-call] — the per-conn lock IS
+            # the request/response framing: one RPC in flight per socket,
+            # concurrent callers use their own conns (transport pool)
             rseq, error, result = read_frame(self.sock)
             if error is not None:
                 raise ConnectionError(f"raft rpc error: {error}")

@@ -206,6 +206,35 @@ def check_js(src: str) -> str:
     return "tokenizer"
 
 
+def check_package(root: str, package: str = "nomad_tpu") -> list[str]:
+    """The tier-1 shipped-but-unexercised-code sweep: a ``compileall``
+    pass (an import-time syntax error in ANY module fails, including
+    ones no test imports) plus the static analyzer's import-graph
+    checks (top-level import cycles, dead modules). Returns a list of
+    error strings — empty means clean."""
+    import subprocess
+    import sys
+
+    errors: list[str] = []
+    proc = subprocess.run(
+        [sys.executable, "-m", "compileall", "-q", package],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=root,
+    )
+    if proc.returncode != 0:
+        errors.append(
+            "compileall failed:\n" + proc.stdout + proc.stderr
+        )
+    # deferred: the analyzer is pure stdlib but there's no reason to
+    # parse ~200 modules on jscheck import
+    from ..analysis.imports import module_import_errors
+
+    errors.extend(module_import_errors(root, package))
+    return errors
+
+
 def extract_scripts(html: str) -> list[str]:
     """The <script> bodies of an HTML document (the SPA has one)."""
     out = []

@@ -215,7 +215,8 @@ class KernelBatchCollector:
                 return
             self._consumed.add(eval_id)
             self._expected -= 1
-            self._maybe_run_locked()
+            batch = self._take_batch_locked()
+        self._run_batch(batch)
 
     def submit(self, prep: DrainPrep) -> tuple[np.ndarray, np.ndarray]:
         """Park this eval's inputs; returns (placements slice, usage base
@@ -224,7 +225,8 @@ class KernelBatchCollector:
         with self._lock:
             self._consumed.add(prep.eval_id)
             self._parked.append(park)
-            self._maybe_run_locked()
+            batch = self._take_batch_locked()
+        self._run_batch(batch)
         if not park.event.wait(self.timeout):
             raise RuntimeError("drain kernel batch timed out")
         if park.error is not None:
@@ -232,11 +234,22 @@ class KernelBatchCollector:
         return park.placements, park.used0
 
     # ------------------------------------------------------------------
-    def _maybe_run_locked(self):
+    def _take_batch_locked(self) -> Optional[list]:
+        """Detach the complete batch under the lock; the caller runs it
+        AFTER releasing. The fused build + device dispatch used to run
+        inside the collector lock, so a sibling eval's ``leave()``
+        (worker finally-guard) or ``consumed()`` probe serialized behind
+        an entire kernel invocation — the analyzer's
+        lock-held-blocking-call finding this refactor burned down."""
         if len(self._parked) < self._expected or not self._parked:
-            return
+            return None
         parked, self._parked = self._parked, []
         self._expected = 0
+        return parked
+
+    def _run_batch(self, parked: Optional[list]):
+        if not parked:
+            return
         # deterministic sequencing regardless of thread arrival order:
         # highest priority first, then submission order (the broker's
         # dequeue ordering), so capacity threads through the fused scan the

@@ -231,7 +231,13 @@ class ColumnarMirror:
         # the live store
         self._broker = broker
         self._lock = threading.RLock()
-        self._sub = None
+        #: serializes sync() callers; the ONLY lock held across the
+        #: bounded frame wait, so data-plane readers (device_state,
+        #: MirrorCluster fast paths, stats) never stall behind it. Order:
+        #: _sync_lock before _lock, never the reverse.
+        self._sync_lock = threading.Lock()
+        self._closed = False
+        self._sub: Optional["Subscription"] = None
         self._cluster: Optional[MirrorCluster] = None
         #: highest frame index consumed (any topic)
         self._applied = 0
@@ -263,20 +269,57 @@ class ColumnarMirror:
         target = max(
             snapshot.table_index("nodes"), snapshot.table_index("allocs")
         )
-        with self._lock:
-            if self._cluster is not None and self._applied_na > target:
-                self.counters["stale"] += 1
-                metrics.incr("tpu.mirror_stale")
-                return None
-            if self._cluster is None or self._sub is None:
-                self._rebuild(snapshot, target, "init")
-                return self._finish(snapshot, rebuilt=True)
+        # _sync_lock serializes sync callers and is the only lock held
+        # across the bounded frame wait; _lock (which the fast-path
+        # readers contend on) is taken per-mutation. The analyzer's
+        # lock-held-blocking-call finding on the old single-lock sync —
+        # every device_state/stats reader stalled behind a 50ms wait for
+        # a frame that may never come — is what this split burned down.
+        with self._sync_lock:
+            with self._lock:
+                if self._closed:
+                    return None
+                if self._cluster is not None and self._applied_na > target:
+                    self.counters["stale"] += 1
+                    metrics.incr("tpu.mirror_stale")
+                    return None
+                if self._cluster is None or self._sub is None:
+                    self._rebuild(snapshot, target, "init")
+                    return self._finish(snapshot, rebuilt=True)
+                sub = self._sub
+                # invalidate the fast path BEFORE patching: _lock is now
+                # released between frame applications, so a reader at the
+                # previous generation must fall back to the scan path
+                # rather than observe a half-applied patch set (_finish
+                # republishes the generation once the planes are whole)
+                self._cluster._synced_gen = None
             rebuilt = False
             deadline = time.monotonic() + SYNC_WAIT_S
             t0 = time.monotonic()
-            try:
-                while self._applied < target:
-                    frame = self._next_frame(deadline)
+            while True:
+                with self._lock:
+                    if self._closed:
+                        return None
+                    if self._applied >= target:
+                        break
+                try:
+                    # the wait: no data lock held (sync callers are
+                    # already serialized by _sync_lock, so frames can't
+                    # be consumed out of order)
+                    frame = self._next_frame(sub, deadline)  # nta: ignore[lock-held-blocking-call] — _sync_lock exists to be held here; readers use _lock
+                except SubscriptionClosedError:
+                    with self._lock:
+                        if self._closed:
+                            return None
+                        self._rebuild(snapshot, target, "severed")
+                    rebuilt = True
+                    break
+                with self._lock:
+                    # close() may have run while we waited with _lock
+                    # released: a rebuild here would mint a fresh broker
+                    # subscription nothing will ever close
+                    if self._closed:
+                        return None
                     if frame is None:
                         self._rebuild(snapshot, target, "timeout")
                         rebuilt = True
@@ -287,8 +330,8 @@ class ColumnarMirror:
                         rebuilt = True
                         break
                     if index > target:
-                        # the write at ``target`` published nothing we saw:
-                        # resync from scratch (the rebuild's fresh
+                        # the write at ``target`` published nothing we
+                        # saw: resync from scratch (the rebuild's fresh
                         # subscription re-covers this frame's range — its
                         # content ≤ snapshot is in the rebuild, anything
                         # newer replays from the ring)
@@ -301,18 +344,18 @@ class ColumnarMirror:
                         self._rebuild(snapshot, target, "node_axis")
                         rebuilt = True
                         break
-            except SubscriptionClosedError:
-                self._rebuild(snapshot, target, "severed")
-                rebuilt = True
             if not rebuilt:
                 metrics.sample("mirror.apply_delta", time.monotonic() - t0)
-            return self._finish(snapshot, rebuilt=rebuilt)
+            with self._lock:
+                if self._closed:
+                    return None
+                return self._finish(snapshot, rebuilt=rebuilt)
 
-    def _next_frame(self, deadline: float):
+    def _next_frame(self, sub, deadline: float):
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             return None
-        return self._sub.next(timeout=remaining)
+        return sub.next(timeout=remaining)
 
     # ------------------------------------------------------------------
     def _finish(self, snapshot, rebuilt: bool) -> MirrorCluster:
@@ -541,6 +584,7 @@ class ColumnarMirror:
 
     def close(self):
         with self._lock:
+            self._closed = True
             if self._sub is not None:
                 try:
                     self._sub.close()

@@ -1,0 +1,10 @@
+#!/usr/bin/env sh
+# CI entry point for the static analyzer (ANALYSIS.md).
+# Exit 0 = clean modulo the committed ANALYSIS_BASELINE.json;
+# exit 1 = new findings (printed as JSON); exit 2 = analyzer error.
+# Extra args pass through, e.g.:
+#   scripts/analyze.sh --rules lock-order-cycle nomad_tpu/tpu/
+set -eu
+
+cd "$(dirname "$0")/.."
+exec python -m nomad_tpu.analysis --format json "$@"

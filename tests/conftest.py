@@ -21,8 +21,32 @@ os.environ.setdefault("NOMAD_TPU_COMPILE_CACHE", "off")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lockdep witness (nomad_tpu/testing/lockdep.py): installed BEFORE
+# jax/nomad_tpu modules create their locks, so every control-plane lock is
+# allocation-site tracked and any observed acquisition-order inversion
+# fails the test that produced it (see the autouse guard below). Disable
+# with NOMAD_TPU_LOCKDEP=0 to bisect witness overhead.
+from nomad_tpu.testing import lockdep  # noqa: E402
+
+if os.environ.get("NOMAD_TPU_LOCKDEP", "1") != "0":
+    lockdep.install()
+
 # This image pins JAX_PLATFORMS=axon (real TPU); the env var is overridden by
 # the platform plugin, so force the CPU backend through the config API.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard():
+    """Fail the test during which a lock-order inversion was first
+    observed (background threads may attribute a violation to the test
+    running when they fired — still a run failure, which is the
+    contract: tier-1 passes only with zero observed inversions)."""
+    before = lockdep.violation_count()
+    yield
+    now = lockdep.violations()
+    assert len(now) == before, "\n".join(now[before:])

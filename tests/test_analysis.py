@@ -1,0 +1,648 @@
+"""Self-tests for the static analyzer (nomad_tpu/analysis/) and the
+runtime lockdep witness (nomad_tpu/testing/lockdep.py).
+
+Every checker is driven through seeded-violation fixture snippets —
+positive AND negative cases — so the checkers themselves are regression
+tested; the tree-clean test then asserts the real repo has no findings
+beyond the committed ANALYSIS_BASELINE.json. The lockdep tests provoke a
+real order inversion on two threads and cross-validate runtime-observed
+edges against the static lock graph.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import (
+    BASELINE_NAME,
+    CHECKERS,
+    Project,
+    analyze,
+    load_baseline,
+    partition,
+    repo_root,
+    run,
+)
+from nomad_tpu.analysis.framework import Finding
+from nomad_tpu.analysis.lockgraph import build_model
+from nomad_tpu.testing import lockdep
+
+pytestmark = pytest.mark.analysis
+
+ROOT = repo_root()
+
+
+def findings_for(sources: dict, rule: str) -> list:
+    project = Project.from_sources(sources)
+    return [f for f in run(project, [rule]) if f.rule == rule]
+
+
+# ----------------------------------------------------------------------
+# lock-order checkers
+# ----------------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_nested_with_cycle_detected(self):
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.l1 = threading.Lock()\n"
+            "        self.l2 = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self.l1:\n"
+            "            with self.l2:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self.l2:\n"
+            "            with self.l1:\n"
+            "                pass\n"
+        )
+        found = findings_for({"nomad_tpu/core/fix.py": src}, "lock-order-cycle")
+        assert len(found) == 1
+        assert "core.fix.A.l1" in found[0].message
+        assert "core.fix.A.l2" in found[0].message
+
+    def test_consistent_order_is_clean(self):
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.l1 = threading.Lock()\n"
+            "        self.l2 = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self.l1:\n"
+            "            with self.l2:\n"
+            "                pass\n"
+            "    def g(self):\n"
+            "        with self.l1:\n"
+            "            with self.l2:\n"
+            "                pass\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/fix.py": src}, "lock-order-cycle"
+        )
+
+    def test_cross_class_cycle_through_calls(self):
+        # A holds its lock and calls into B (which locks); B holds its
+        # lock and calls into A: the deadlock is only visible by
+        # resolving calls across classes
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self, b):\n"
+            "        self.lock = threading.Lock()\n"
+            "        self.b = b\n"
+            "    def locked_op(self):\n"
+            "        with self.lock:\n"
+            "            self.b.poke()\n"
+            "    def poke_back(self):\n"
+            "        with self.lock:\n"
+            "            pass\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "        self.a = None\n"
+            "    def poke(self):\n"
+            "        with self.lock:\n"
+            "            pass\n"
+            "    def locked_op2(self):\n"
+            "        with self.lock:\n"
+            "            self.a.poke_back()\n"
+        )
+        # attr types for a/b are untyped; annotate to resolve
+        src = src.replace(
+            "        self.b = b\n",
+            "        self.b: 'B' = b\n",
+        ).replace(
+            "        self.a = None\n",
+            "        self.a: 'A' = None\n",
+        )
+        found = findings_for({"nomad_tpu/core/ab.py": src}, "lock-order-cycle")
+        assert len(found) == 1
+
+    def test_sleep_under_lock_flagged(self):
+        src = (
+            "import threading\n"
+            "import time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self.lock:\n"
+            "            time.sleep(1.0)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/fix.py": src}, "lock-held-blocking-call"
+        )
+        assert len(found) == 1
+        assert "time.sleep" in found[0].message
+
+    def test_condition_wait_on_own_lock_is_sanctioned(self):
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "        self.cond = threading.Condition(self.lock)\n"
+            "    def f(self):\n"
+            "        with self.cond:\n"
+            "            self.cond.wait(1.0)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/fix.py": src}, "lock-held-blocking-call"
+        )
+
+    def test_foreign_wait_under_lock_flagged(self):
+        src = (
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "        self.done = threading.Event()\n"
+            "    def f(self):\n"
+            "        with self.lock:\n"
+            "            self.done.wait(5.0)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/fix.py": src}, "lock-held-blocking-call"
+        )
+        assert len(found) == 1
+
+    def test_blocking_propagates_through_calls(self):
+        src = (
+            "import threading\n"
+            "import time\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "    def helper(self):\n"
+            "        time.sleep(0.5)\n"
+            "    def f(self):\n"
+            "        with self.lock:\n"
+            "            self.helper()\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/fix.py": src}, "lock-held-blocking-call"
+        )
+        assert len(found) == 1
+        assert "helper" in found[0].message
+
+    def test_device_transfer_under_lock_flagged(self):
+        src = (
+            "import threading\n"
+            "import jax.numpy as jnp\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.lock = threading.Lock()\n"
+            "    def f(self, x):\n"
+            "        with self.lock:\n"
+            "            return jnp.asarray(x)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "lock-held-blocking-call"
+        )
+        assert len(found) == 1
+        assert "device transfer" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# JAX hygiene checkers
+# ----------------------------------------------------------------------
+
+
+class TestJaxHygiene:
+    def test_float_on_tracer_flagged_static_exempt(self):
+        src = (
+            "import functools\n"
+            "import jax\n"
+            "@functools.partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    return x * float(n) + float(x)\n"
+        )
+        found = findings_for({"nomad_tpu/tpu/k.py": src}, "jit-host-sync")
+        assert len(found) == 1  # float(x) only; float(n) is static
+        assert "float(x)" in found[0].message
+
+    def test_item_and_asarray_flagged(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.asarray(x) + x.sum().item()\n"
+        )
+        found = findings_for({"nomad_tpu/tpu/k.py": src}, "jit-host-sync")
+        assert len(found) == 2
+
+    def test_pure_jit_clean(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jnp.where(x > 0, x, 0).sum()\n"
+        )
+        assert not findings_for({"nomad_tpu/tpu/k.py": src}, "jit-host-sync")
+
+    def test_time_and_random_in_jit_flagged(self):
+        src = (
+            "import jax\n"
+            "import random\n"
+            "import time\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * random.random() + time.time()\n"
+        )
+        found = findings_for({"nomad_tpu/tpu/k.py": src}, "jit-impure-call")
+        assert len(found) == 2
+
+    def test_device_put_in_loop_flagged(self):
+        src = (
+            "import jax\n"
+            "def f(rows):\n"
+            "    out = []\n"
+            "    for r in rows:\n"
+            "        out.append(jax.device_put(r))\n"
+            "    return out\n"
+            "def g(rows):\n"
+            "    return jax.device_put(rows)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/k.py": src}, "device-put-in-loop"
+        )
+        assert len(found) == 1
+        assert found[0].line == 5
+
+    def test_shape_literal_unbucketed(self):
+        # the exact 51200-vs-50176 bug class: a literal padded dim that
+        # never rounded through the one bucketing policy
+        src = (
+            "import numpy as np\n"
+            "from .batch_sched import _bucket\n"
+            "def bad():\n"
+            "    return np.zeros((51200, 4))\n"
+            "def good():\n"
+            "    return np.zeros((_bucket(50000), 4))\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/w.py": src}, "shape-literal-unbucketed"
+        )
+        assert len(found) == 1
+        assert "51200" in found[0].message
+
+    def test_jit_shape_unbucketed(self):
+        src = (
+            "import jax\n"
+            "from .batch_sched import _bucket\n"
+            "@jax.jit\n"
+            "def kern(x, n):\n"
+            "    return x[:n]\n"
+            "def bad(x, nodes):\n"
+            "    n = len(nodes)\n"
+            "    return kern(x, n)\n"
+            "def good(x, nodes):\n"
+            "    n = _bucket(len(nodes))\n"
+            "    return kern(x, n)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/w.py": src}, "jit-shape-unbucketed"
+        )
+        assert len(found) == 1
+        assert "kern" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# raft-index hygiene checkers
+# ----------------------------------------------------------------------
+
+
+class TestRaftHygiene:
+    def test_minted_index_flagged(self):
+        src = (
+            "def f(self, snap):\n"
+            "    self.refresh_index = snap.latest_index() + 1\n"
+        )
+        found = findings_for({"nomad_tpu/core/x.py": src}, "raft-index-arith")
+        assert len(found) == 1
+
+    def test_minted_index_into_wait_flagged(self):
+        src = (
+            "def f(self, state, index):\n"
+            "    return state.snapshot_min_index(index + 1, timeout=5.0)\n"
+        )
+        found = findings_for({"nomad_tpu/core/x.py": src}, "raft-index-arith")
+        assert len(found) == 1
+
+    def test_committed_index_clean_and_raft_exempt(self):
+        clean = (
+            "def f(self, state, plan, result):\n"
+            "    index = state.upsert_plan_results(None, plan, result)\n"
+            "    return state.snapshot_min_index(index, timeout=5.0)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": clean}, "raft-index-arith"
+        )
+        # the raft log itself legitimately mints indexes
+        minty = "def f(self, last_index):\n    self.next_index = last_index + 1\n"
+        assert not findings_for(
+            {"nomad_tpu/raft/x.py": minty}, "raft-index-arith"
+        )
+
+    def test_cross_store_comparison_flagged(self):
+        src = (
+            "def f(self, snap):\n"
+            "    if snap.latest_index() < self.state.latest_index():\n"
+            "        return True\n"
+            "    return snap.latest_index() <= snap.latest_index()\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/x.py": src}, "raft-index-cross-store"
+        )
+        assert len(found) == 1
+        assert found[0].line == 2
+
+
+# ----------------------------------------------------------------------
+# import-graph checkers
+# ----------------------------------------------------------------------
+
+
+class TestImports:
+    def test_top_level_cycle_flagged_deferred_clean(self):
+        cyc = {
+            "nomad_tpu/aa.py": "from nomad_tpu import bb\n",
+            "nomad_tpu/bb.py": "from nomad_tpu import aa\n",
+        }
+        found = findings_for(cyc, "import-cycle")
+        assert len(found) == 1
+        deferred = {
+            "nomad_tpu/aa.py": "from nomad_tpu import bb\n",
+            "nomad_tpu/bb.py": (
+                "def f():\n    from nomad_tpu import aa\n    return aa\n"
+            ),
+        }
+        assert not findings_for(deferred, "import-cycle")
+
+    def test_submodule_binding_is_not_a_package_cycle(self):
+        # ``from . import sub`` inside a package whose __init__ imports
+        # the importer: binds a submodule, not an __init__ attribute —
+        # Python resolves it mid-parent-init, so no cycle finding
+        srcs = {
+            "nomad_tpu/p/__init__.py": "from .server import Server\n",
+            "nomad_tpu/p/server.py": (
+                "from . import fsm as fsm_mod\nclass Server:\n    pass\n"
+            ),
+            "nomad_tpu/p/fsm.py": "X = 1\n",
+        }
+        assert not findings_for(srcs, "import-cycle")
+
+    def test_dead_module_flagged(self):
+        srcs = {
+            "nomad_tpu/__init__.py": "from . import live\n",
+            "nomad_tpu/live.py": "X = 1\n",
+            "nomad_tpu/dead.py": "Y = 2\n",
+        }
+        found = findings_for(srcs, "dead-module")
+        assert [f.path for f in found] == ["nomad_tpu/dead.py"]
+
+
+# ----------------------------------------------------------------------
+# framework mechanics: suppressions + baseline
+# ----------------------------------------------------------------------
+
+
+class TestFramework:
+    SRC = "def f(self, snap):\n    self.x_index = snap.latest_index() + 1{}\n"
+
+    def test_inline_suppression(self):
+        src = self.SRC.format("  # nta: ignore[raft-index-arith]")
+        assert not findings_for({"nomad_tpu/core/x.py": src}, "raft-index-arith")
+
+    def test_comment_above_suppression(self):
+        src = (
+            "def f(self, snap):\n"
+            "    # nta: ignore[raft-index-arith] — fixture WHY\n"
+            "    # (continuation of the why)\n"
+            "    self.x_index = snap.latest_index() + 1\n"
+        )
+        assert not findings_for({"nomad_tpu/core/x.py": src}, "raft-index-arith")
+
+    def test_unrelated_suppression_does_not_mask(self):
+        src = self.SRC.format("  # nta: ignore[lock-order-cycle]")
+        assert findings_for({"nomad_tpu/core/x.py": src}, "raft-index-arith")
+
+    def test_baseline_partition_counts(self):
+        f1 = Finding("r", "p.py", 3, "same message")
+        f2 = Finding("r", "p.py", 9, "same message")
+        f3 = Finding("r", "p.py", 12, "other message")
+        baseline = {f1.key: 1}
+        new, known = partition([f1, f2, f3], baseline)
+        # one occurrence absorbed by the baseline, the duplicate and the
+        # unknown key are new
+        assert len(known) == 1 and len(new) == 2
+
+    def test_every_checker_has_a_doc(self):
+        from nomad_tpu.analysis import CHECKER_DOCS
+
+        for name in CHECKERS:
+            assert CHECKER_DOCS.get(name), name
+
+
+# ----------------------------------------------------------------------
+# the tree itself
+# ----------------------------------------------------------------------
+
+
+class TestTreeClean:
+    def test_tree_clean_modulo_baseline(self):
+        new, known = analyze(ROOT)
+        assert new == [], "new analyzer findings:\n" + "\n".join(
+            f.format() for f in new
+        )
+
+    def test_cli_exits_zero_and_emits_json(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "nomad_tpu.analysis", "--format", "json"],
+            capture_output=True,
+            text=True,
+            cwd=ROOT,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["new_count"] == 0
+
+    def test_baseline_keys_still_exist(self):
+        # a baselined finding that no longer fires should be burned out
+        # of the file, not carried forever
+        baseline = load_baseline(os.path.join(ROOT, BASELINE_NAME))
+        project = Project.load(ROOT)
+        current = {f.key for f in run(project)}
+        stale = [k for k in baseline if k not in current]
+        assert not stale, f"stale baseline entries: {stale}"
+
+
+# ----------------------------------------------------------------------
+# runtime lockdep witness
+# ----------------------------------------------------------------------
+
+needs_lockdep = pytest.mark.skipif(
+    not lockdep.installed(), reason="lockdep disabled (NOMAD_TPU_LOCKDEP=0)"
+)
+
+
+class TestLockdep:
+    @needs_lockdep
+    def test_wrapper_records_edges(self):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        sites = {w._site for w in (a, b)}
+        assert len(sites) == 2
+        assert any(
+            pair == (a._site, b._site) for pair in lockdep.edges()
+        )
+
+    @needs_lockdep
+    def test_inversion_detected_across_threads(self):
+        base = lockdep.violation_count()
+        a = threading.Lock()
+        b = threading.Lock()
+
+        with a:
+            with b:
+                pass
+
+        def reversed_order():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=reversed_order)
+        t.start()
+        t.join(timeout=5.0)
+        try:
+            got = lockdep.violations()[base:]
+            assert len(got) == 1
+            assert "inversion" in got[0]
+        finally:
+            # the provoked inversion must not fail the autouse guard or
+            # poison later tests' edge accumulation
+            lockdep.reset()
+
+    @needs_lockdep
+    def test_rlock_reentrancy_and_condition_wait_clean(self):
+        base = lockdep.violation_count()
+        r = threading.RLock()
+        with r:
+            with r:  # re-entrant: no self edge
+                pass
+        cond = threading.Condition(r)
+        other = threading.Lock()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=0.05)
+            # after the wait TIMES OUT the lock is re-acquired and then
+            # released: held stack must be empty again
+            with other:
+                with r:
+                    pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(timeout=5.0)
+        # reverse order on the main thread: other after r was recorded
+        # as other->r by the waiter; r->other here would invert — but we
+        # take the SAME order, so no violation
+        with other:
+            with r:
+                pass
+        assert lockdep.violation_count() == base
+
+    @needs_lockdep
+    def test_condition_inner_lock_keyed_to_caller_site(self):
+        """A no-arg Condition allocates its RLock inside threading.py;
+        the witness must key it to the Condition() CALL site — otherwise
+        every bare Condition in the codebase collapses to one stdlib
+        site, manufacturing false cross-subsystem inversions and
+        blinding the witness to real ones."""
+        c1 = threading.Condition()
+        c2 = threading.Condition()
+        s1, s2 = c1._lock._site, c2._lock._site
+        assert "threading.py" not in s1, s1
+        assert "test_analysis.py" in s1, s1
+        assert s1 != s2  # distinct call lines -> distinct identities
+
+    @needs_lockdep
+    def test_same_site_pairs_skipped(self):
+        base = lockdep.violation_count()
+
+        def make():
+            return threading.Lock()
+
+        a = make()
+        b = make()  # same allocation site as a
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert lockdep.violation_count() == base
+
+    @needs_lockdep
+    def test_runtime_edges_consistent_with_static_graph(self):
+        """Cross-validation: an order observed at runtime must not be
+        the REVERSAL of a reachable order in the static lock graph —
+        that pair would be a deadlock the static pass already models."""
+        project = Project.load(ROOT)
+        model = build_model(project)
+        static_edges = model.edges()
+        site_to_lock = {}
+        for lock_id, (relpath, line) in model.lock_sites().items():
+            site_to_lock[f"{relpath}:{line}"] = lock_id
+
+        # static reachability closure
+        succ = {}
+        for (a, b) in static_edges:
+            succ.setdefault(a, set()).add(b)
+
+        def reachable(src, dst):
+            seen, stack = set(), [src]
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(succ.get(cur, ()))
+            return False
+
+        def normalize(site):
+            path, _, line = site.rpartition(":")
+            idx = path.find("nomad_tpu/")
+            return (path[idx:] + ":" + line) if idx >= 0 else site
+
+        contradictions = []
+        for (sa, sb), witness in lockdep.edges().items():
+            la = site_to_lock.get(normalize(sa))
+            lb = site_to_lock.get(normalize(sb))
+            if la is None or lb is None or la == lb:
+                continue
+            if reachable(lb, la):
+                contradictions.append(
+                    f"runtime {la} -> {lb} ({witness}) reverses a static "
+                    f"path {lb} ~> {la}"
+                )
+        assert not contradictions, "\n".join(contradictions)

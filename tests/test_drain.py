@@ -246,3 +246,64 @@ class TestBatchDrain:
 
         assert len(h.state.allocs_by_job(job_ok.namespace, job_ok.id)) == 3
         assert len(h.state.allocs_by_job(job_ports.namespace, job_ports.id)) == 2
+
+
+class TestCollectorLockScope:
+    def test_sibling_probes_do_not_block_on_running_kernel(self, monkeypatch):
+        """Regression for the analyzer's lock-held-blocking-call finding on
+        KernelBatchCollector: the fused build + device dispatch used to run
+        INSIDE the collector lock, so a sibling eval's ``consumed()`` probe
+        or finally-guard ``leave()`` serialized behind an entire kernel
+        invocation. The batch must now be detached under the lock and run
+        after releasing it."""
+        kernel_running = threading.Event()
+        release_kernel = threading.Event()
+
+        def slow_run(self, parked):
+            kernel_running.set()
+            assert release_kernel.wait(10.0)
+
+        monkeypatch.setattr(KernelBatchCollector, "_run", slow_run)
+
+        collector = KernelBatchCollector.__new__(KernelBatchCollector)
+        collector.shared = None
+        collector.timeout = 10.0
+        collector._expected = 1
+        collector.pad_evals = 1
+        collector._lock = threading.Lock()
+        collector._parked = []
+        collector._consumed = set()
+        collector.invocations = 0
+
+        prep = drain_mod.DrainPrep(
+            eval_id="ev-batched",
+            priority=50,
+            create_index=1,
+            planes_list=[],
+            g_index={},
+            g_demand=None,
+            g_limit=None,
+            gid_real=None,
+            perm_eligible=None,
+            collisions0=None,
+            by_dc={},
+        )
+        submitter = threading.Thread(
+            target=lambda: collector.submit(prep), daemon=True
+        )
+        submitter.start()
+        assert kernel_running.wait(5.0), "batch never dispatched"
+        try:
+            # the kernel is mid-flight; sibling probes must not queue
+            # behind it on the collector lock
+            t0 = time.monotonic()
+            assert collector.consumed("ev-batched")
+            collector.leave("ev-late-sibling")
+            elapsed = time.monotonic() - t0
+            assert elapsed < 1.0, (
+                f"probe blocked {elapsed:.2f}s behind a running kernel"
+            )
+        finally:
+            release_kernel.set()
+            submitter.join(timeout=10.0)
+        assert not submitter.is_alive()

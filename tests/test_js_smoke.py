@@ -3,9 +3,6 @@ JavaScript (node --check when available, else the tokenizer sanity pass)
 and a compileall sweep so an import-time syntax error in ANY module —
 including ones no test imports — fails collection (VERDICT r5 weak #5)."""
 
-import subprocess
-import sys
-
 import pytest
 
 from nomad_tpu.testing import jscheck
@@ -43,10 +40,26 @@ class TestSpaJavascript:
         jscheck.tokenize_check(src)
 
     def test_compileall_whole_package(self):
-        proc = subprocess.run(
-            [sys.executable, "-m", "compileall", "-q", "nomad_tpu"],
-            capture_output=True,
-            text=True,
-            timeout=120,
-        )
-        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # compileall + the analyzer's import-cycle/dead-module checks
+        # (jscheck.check_package): a module that stops being imported —
+        # or starts being imported at the top of a cycle — fails the
+        # same smoke test that guards syntax
+        from nomad_tpu.analysis import repo_root
+
+        errors = jscheck.check_package(repo_root())
+        assert not errors, "\n".join(errors)
+
+    def test_check_package_catches_import_regressions(self, tmp_path):
+        # the sweep must actually sweep: a seeded cycle and a dead
+        # module in a scratch package both surface
+        from nomad_tpu.analysis.imports import module_import_errors
+
+        pkg = tmp_path / "nomad_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("from . import a\n")
+        (pkg / "a.py").write_text("from nomad_tpu import b\n")
+        (pkg / "b.py").write_text("from nomad_tpu import a\n")
+        (pkg / "dead.py").write_text("X = 1\n")
+        errors = module_import_errors(str(tmp_path), "nomad_tpu")
+        assert any("import-cycle" in e for e in errors), errors
+        assert any("dead-module" in e for e in errors), errors

@@ -419,3 +419,94 @@ class TestSatellites:
         finally:
             columnar._SHARED_CLUSTERS_MAX_BYTES = saved_budget
             columnar._SHARED_CLUSTERS[:] = saved
+
+
+class TestSyncLockScope:
+    def test_readers_not_blocked_while_sync_waits_for_frames(self, monkeypatch):
+        """Regression for the analyzer's lock-held-blocking-call finding on
+        ColumnarMirror.sync: the bounded frame wait used to run under the
+        single data lock, so every device_state/stats/fast-path reader
+        stalled up to SYNC_WAIT_S behind a frame that might never come.
+        The wait must now hold only _sync_lock (sync-caller serialization)
+        with _lock taken per-mutation."""
+        import threading
+        import time as time_mod
+
+        h = _Harness()
+        job = mock.job()
+        h.apply(fsm_mod.JOB_REGISTER, {"job": job.to_dict()})
+        for _ in range(3):
+            h.apply(fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()})
+        assert isinstance(h.mirror.sync(h.state.snapshot()), MirrorCluster)
+
+        # one more write: the next sync must consume its frame, and we
+        # wedge the frame wait to widen the window
+        h.apply(fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()})
+
+        waiting = threading.Event()
+        release = threading.Event()
+        real_next = h.mirror._next_frame
+
+        def wedged_next(sub, deadline):
+            waiting.set()
+            assert release.wait(10.0)
+            return real_next(sub, deadline)
+
+        monkeypatch.setattr(h.mirror, "_next_frame", wedged_next)
+
+        out = {}
+        syncer = threading.Thread(
+            target=lambda: out.update(view=h.mirror.sync(h.state.snapshot())),
+            daemon=True,
+        )
+        syncer.start()
+        assert waiting.wait(5.0), "sync never reached the frame wait"
+        try:
+            t0 = time_mod.monotonic()
+            assert h.mirror._lock.acquire(timeout=1.0), (
+                "data lock held across the frame wait"
+            )
+            h.mirror._lock.release()
+            assert time_mod.monotonic() - t0 < 1.0
+        finally:
+            release.set()
+            syncer.join(timeout=10.0)
+        assert not syncer.is_alive()
+        assert isinstance(out.get("view"), MirrorCluster)
+        monkeypatch.undo()
+        assert_mirror_equals_rebuild(h)
+
+    def test_close_during_sync_does_not_resurrect(self, monkeypatch):
+        """close() racing an in-flight sync: the rebuild paths must bail
+        instead of minting a fresh broker subscription nothing will ever
+        close (and _finish must not hand out a view of a closed mirror)."""
+        import threading
+
+        h = _Harness()
+        h.apply(fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()})
+        assert isinstance(h.mirror.sync(h.state.snapshot()), MirrorCluster)
+        h.apply(fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()})
+
+        waiting = threading.Event()
+        release = threading.Event()
+        real_next = h.mirror._next_frame
+
+        def wedged_next(sub, deadline):
+            waiting.set()
+            assert release.wait(10.0)
+            return real_next(sub, deadline)
+
+        monkeypatch.setattr(h.mirror, "_next_frame", wedged_next)
+        out = {}
+        syncer = threading.Thread(
+            target=lambda: out.update(view=h.mirror.sync(h.state.snapshot())),
+            daemon=True,
+        )
+        syncer.start()
+        assert waiting.wait(5.0)
+        h.mirror.close()
+        release.set()
+        syncer.join(timeout=10.0)
+        assert not syncer.is_alive()
+        assert out.get("view") is None
+        assert h.mirror._sub is None, "closed mirror resurrected a subscription"

@@ -708,3 +708,55 @@ class TestExecSeccomp:
         finally:
             driver.stop_task(handle, timeout=1.0)
             handle.wait(timeout=10.0)
+
+
+class TestShutdownLockScope:
+    def test_shutdown_reaps_outside_the_launch_lock(self):
+        """Regression for the analyzer's lock-held-blocking-call finding on
+        PluginProcess.shutdown: proc.wait(timeout=5.0) on a wedged plugin
+        used to run under _lock, blocking every concurrent ensure() for the
+        full grace period. shutdown must detach conn/proc under the lock
+        and reap after releasing it."""
+        import threading
+
+        from nomad_tpu.plugins.external import PluginProcess
+
+        reap_started = threading.Event()
+        release_reap = threading.Event()
+
+        class WedgedProc:
+            def terminate(self):
+                pass
+
+            def wait(self, timeout=None):
+                reap_started.set()
+                assert release_reap.wait(10.0)
+                return 0
+
+            def poll(self):
+                return None
+
+        class FakeConn:
+            def close(self):
+                pass
+
+        p = PluginProcess("--driver", "dummy")
+        p._proc = WedgedProc()
+        p._conn = FakeConn()
+
+        shutter = threading.Thread(target=p.shutdown, daemon=True)
+        shutter.start()
+        assert reap_started.wait(5.0), "shutdown never reached the reap"
+        try:
+            # mid-reap: the launch lock must be free (a concurrent
+            # ensure() would take it to relaunch) and the stale handles
+            # already detached
+            assert p._lock.acquire(timeout=1.0), (
+                "launch lock held across proc.wait()"
+            )
+            p._lock.release()
+            assert p._proc is None and p._conn is None
+        finally:
+            release_reap.set()
+            shutter.join(timeout=10.0)
+        assert not shutter.is_alive()
