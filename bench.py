@@ -892,6 +892,34 @@ def bench_config5(n_nodes=10000):
     }
 
 
+def bench_soak_smoke(seed=20260803):
+    """The tier-1 smoke storm from the churn-soak load plane
+    (nomad_tpu/loadgen), run as a bench section so the soak's headline
+    health signals ride the BENCH_SUMMARY trajectory: a ~30s seeded mixed
+    storm (submit/scale/update/flap/drain/dispatch/GC) through the real
+    RPC+HTTP surface, scored continuously. Zero invariant violations is
+    the contract; rss_peak/slope are the leak-class canaries."""
+    from nomad_tpu.loadgen import get_scenario
+    from nomad_tpu.loadgen.runner import run_scenario
+
+    report = run_scenario(get_scenario("smoke"), seed, driver_workers=6)
+    return {
+        "scenario": report["scenario"],
+        "seed": seed,
+        "ops_fired": report["driver"]["fired"],
+        "ops_failed": report["driver"]["failed"],
+        "invariant_violations": report["invariants"]["violations"],
+        "invariant_sweeps": report["invariants"]["sweeps"],
+        "rss_peak_mb": report["rss_peak_mb"],
+        "rss_tail_slope_mb_per_min": report["rss_tail_slope_mb_per_min"],
+        "eval_e2e_p99_ms_max": report["eval_e2e_p99_ms_max"],
+        "subscriber_lag_max": report["subscriber_lag_max"],
+        "quiesced": report["quiesced"],
+        "slo_score": report["slo"]["score"],
+        "stream_digest": report["stream_digest"][:12],
+    }
+
+
 def main():
     headline = bench_headline()
     detail = dict(headline)
@@ -900,6 +928,7 @@ def main():
         detail["config3"] = bench_config3()
         detail["config5"] = bench_config5()
         detail["drain"] = bench_drain()
+        detail["soak_smoke"] = bench_soak_smoke()
         # worker-scaling curve over the same real-server drain path (the
         # 1-core bench box bounds speedup; the curve + queue depth shows
         # WHERE the control plane saturates)
@@ -981,6 +1010,12 @@ def main():
             + "/".join(str(v) for v in invokes)
             + "ms@1,2,4"
         )
+        soak = detail["soak_smoke"]
+        parts.append(
+            f"soak_invariant_violations={soak['invariant_violations']}"
+        )
+        parts.append(f"soak_rss_peak_mb={soak['rss_peak_mb']}")
+        parts.append(f"soak_slo_score={soak['slo_score']}")
     print("BENCH_SUMMARY " + " ".join(parts))
 
 

@@ -24,7 +24,7 @@ from .framework import (  # noqa: F401
 )
 
 # importing the checker modules registers them
-from . import imports, jax_hygiene, lockgraph, raft_hygiene  # noqa: F401,E402
+from . import growth, imports, jax_hygiene, lockgraph, raft_hygiene  # noqa: F401,E402
 
 
 def repo_root() -> str:

@@ -1,0 +1,336 @@
+"""Unbounded-cache checker: the static encoding of the ``_bad_http_addrs``
+leak class (r5) and its churn-soak relatives (BlockedEvals'
+``_node_unblock_indexes``, PeriodicDispatch's ``_gen``).
+
+The shape: a long-lived dict/list/set — an instance attribute created in
+``__init__`` or a module-level global — that some steady-state code path
+*grows* (keyed insert, ``append``, ``add``, ``setdefault``) while **no**
+path ever shrinks it (``pop``/``del``/``clear``/``remove``/rebind). On a
+server that lives for months, every such container is a leak whose key
+cardinality is only bounded by traffic: per-address maps, per-node-id
+maps, per-job generation counters.
+
+Rule ``unbounded-cache`` flags the *container*, at its creation site,
+listing where it grows. Bounded-by-construction registries (one entry
+per checker module, per RPC method, per scheduler factory — populated at
+import/startup and never from request traffic) are the expected
+suppression class: mark them ``# nta: ignore[unbounded-cache]`` with a
+WHY.
+
+Heuristics (kept conservative on the shrink side — ANY shrink/rebind
+anywhere in the owning scope clears the container, since this checker
+cannot prove the path is reachable):
+
+- growth must happen inside a function/method other than the creating
+  ``__init__`` (top-level one-shot registration isn't steady-state);
+- instance attrs are tracked per class; ``self.X`` rebinds anywhere in
+  the class count as shrink. Module globals are tracked per module;
+- aliasing (``y = self.X`` then mutations through ``y``) is resolved one
+  hop inside the same function body.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .framework import Finding, Project, register
+
+#: planes whose objects are scoped to one evaluation/run by construction
+#: (scheduler iterator stacks, struct scratch builders, the one-shot
+#: analysis CLI, the loadgen client whose accumulators ARE the run's
+#: measurement): a container there dies with its short-lived owner
+_EXEMPT_PREFIXES = (
+    "nomad_tpu/scheduler/",
+    "nomad_tpu/structs/",
+    "nomad_tpu/analysis/",
+    "nomad_tpu/loadgen/",
+)
+
+#: functions whose growth is startup/import-time registration, not
+#: steady-state traffic (route tables, endpoint registries, thread
+#: launch lists): growth seen ONLY here doesn't flag
+_STARTUP_FN_RE = re.compile(
+    r"^(start|setup|_setup\w*|register\w*|route|deco|install\w*)$"
+)
+
+#: call attrs that grow a container
+_GROW_METHODS = {
+    "append", "add", "setdefault", "extend", "insert", "update",
+    "appendleft", "push",
+}
+#: call attrs that shrink (or can shrink) a container
+_SHRINK_METHODS = {
+    "pop", "popitem", "clear", "remove", "discard", "popleft",
+}
+#: constructor calls that create an empty growable container
+_CONTAINER_CALLS = {"dict", "set", "list", "defaultdict", "OrderedDict", "deque"}
+
+
+def _is_container_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        # literal {} / [] — non-empty literals are config tables, not caches
+        return not getattr(node, "keys", None) and not getattr(node, "elts", None)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _CONTAINER_CALLS
+    return False
+
+
+class _Access:
+    """One observed use of a tracked container: grow, shrink, or rebind."""
+
+    __slots__ = ("kind", "line", "how")
+
+    def __init__(self, kind: str, line: int, how: str):
+        self.kind = kind
+        self.line = line
+        self.how = how
+
+
+def _attr_of_self(node: ast.AST) -> Optional[str]:
+    """'x' for a ``self.x`` expression."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _scan_function(fn: ast.AST, names: set, is_attr: bool, out: dict):
+    """Collect accesses to tracked containers inside one function body.
+
+    ``names`` are attr names (for ``self.X``) or global names; accesses
+    land in ``out[name] -> list[_Access]``. One level of aliasing inside
+    the function (``alias = self.X``) is followed.
+    """
+    aliases: dict[str, str] = {}
+
+    # module-global mode: a plain ``NAME = ...`` without a ``global NAME``
+    # declaration makes NAME function-LOCAL for the whole scope (Python
+    # scoping), so every access to it in this function touches the local
+    # shadow, not the tracked global — misreading the shadow as a
+    # rebind/shrink of the global silences the rule for exactly the leak
+    # class it exists to catch
+    shadowed: set = set()
+    if not is_attr:
+        declared_global: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                tgts = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in tgts:
+                    if (
+                        isinstance(t, ast.Name)
+                        and t.id in names
+                        and t.id not in declared_global
+                    ):
+                        shadowed.add(t.id)
+
+    def target_name(expr: ast.AST) -> Optional[str]:
+        if is_attr:
+            name = _attr_of_self(expr)
+            if name in names:
+                return name
+            if isinstance(expr, ast.Name) and expr.id in aliases:
+                return aliases[expr.id]
+            return None
+        if (
+            isinstance(expr, ast.Name)
+            and expr.id in names
+            and expr.id not in shadowed
+        ):
+            return expr.id
+        return None
+
+    fname = getattr(fn, "name", "<fn>")
+    in_init = fname == "__init__"
+    # pre-pass: register aliases (``m = self.X``) before the access walk,
+    # so walk order can't matter and the alias assignment itself isn't
+    # misread as a rebind of the container
+    alias_nodes: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            src = None
+            if is_attr:
+                src = _attr_of_self(val)
+            elif isinstance(val, ast.Name) and val.id in names:
+                src = val.id
+            if (
+                src in names
+                and isinstance(tgt, ast.Name)
+                and not isinstance(val, ast.Call)
+            ):
+                aliases[tgt.id] = src
+                alias_nodes.add(id(node))
+    for node in ast.walk(fn):
+        if id(node) in alias_nodes:
+            continue
+        # rebind: self.X = <anything> outside the creating __init__
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target] if node.value is not None else []
+            else:
+                targets = [node.target]
+            for tgt in targets:
+                name = target_name(tgt)
+                if name is not None and not isinstance(tgt, ast.Subscript):
+                    if isinstance(node, ast.AugAssign):
+                        # ``x += [e]`` / ``m |= d`` accumulate INTO the
+                        # container — growth, not a rebind. Only the
+                        # subtractive ops shrink (``s -= other``,
+                        # ``s &= other``); anything else counts as grow
+                        # so a leak can't hide behind an odd operator
+                        if isinstance(node.op, (ast.Sub, ast.BitAnd)):
+                            out.setdefault(name, []).append(
+                                _Access("shrink", node.lineno, "augassign")
+                            )
+                        elif not in_init:
+                            out.setdefault(name, []).append(
+                                _Access(
+                                    "grow", node.lineno, f"{fname}: augassign"
+                                )
+                            )
+                    elif not in_init:
+                        out.setdefault(name, []).append(
+                            _Access("shrink", node.lineno, "rebind")
+                        )
+                    continue
+                # keyed insert: self.X[k] = v  (AugAssign on a key is
+                # accumulation into an existing slot, not new growth)
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(tgt, ast.Subscript)
+                ):
+                    name = target_name(tgt.value)
+                    if name is not None and not in_init:
+                        out.setdefault(name, []).append(
+                            _Access("grow", node.lineno, f"{fname}: [k] =")
+                        )
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                name = target_name(base)
+                if name is not None:
+                    out.setdefault(name, []).append(
+                        _Access("shrink", node.lineno, "del")
+                    )
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            name = target_name(node.func.value)
+            if name is None:
+                continue
+            meth = node.func.attr
+            if meth in _GROW_METHODS and not in_init:
+                out.setdefault(name, []).append(
+                    _Access("grow", node.lineno, f"{fname}: .{meth}()")
+                )
+            elif meth in _SHRINK_METHODS:
+                out.setdefault(name, []).append(
+                    _Access("shrink", node.lineno, f".{meth}()")
+                )
+
+
+def _check_class(mod, cls: ast.ClassDef) -> list[Finding]:
+    # containers created in __init__ as self.X = {} / [] / set() / ...
+    created: dict[str, int] = {}
+    for stmt in cls.body:
+        if not (
+            isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__"
+        ):
+            continue
+        for node in ast.walk(stmt):
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, val = node.target, node.value
+            if tgt is not None:
+                name = _attr_of_self(tgt)
+                if name is not None and _is_container_ctor(val):
+                    created[name] = node.lineno
+    if not created:
+        return []
+    accesses: dict[str, list[_Access]] = {}
+    for stmt in ast.walk(cls):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(stmt, set(created), True, accesses)
+    return _emit(mod, cls.name, created, accesses)
+
+
+def _check_module_globals(mod) -> list[Finding]:
+    created: dict[str, int] = {}
+    for stmt in mod.tree.body:
+        tgt = val = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt, val = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tgt, val = stmt.target, stmt.value
+        if (
+            tgt is not None
+            and isinstance(tgt, ast.Name)
+            and _is_container_ctor(val)
+        ):
+            created[tgt.id] = stmt.lineno
+    if not created:
+        return []
+    accesses: dict[str, list[_Access]] = {}
+    for stmt in ast.walk(mod.tree):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(stmt, set(created), False, accesses)
+    return _emit(mod, None, created, accesses)
+
+
+def _emit(mod, cls_name, created, accesses) -> list[Finding]:
+    findings = []
+    for name, line in sorted(created.items()):
+        acc = accesses.get(name, [])
+        grows = [
+            a
+            for a in acc
+            if a.kind == "grow"
+            and not _STARTUP_FN_RE.match(a.how.split(":", 1)[0])
+        ]
+        shrinks = [a for a in acc if a.kind == "shrink"]
+        if not grows or shrinks:
+            continue
+        owner = f"{cls_name}.{name}" if cls_name else name
+        hows = sorted({a.how for a in grows})
+        findings.append(
+            Finding(
+                "unbounded-cache", mod.relpath, line,
+                f"{owner} only ever grows ({'; '.join(hows[:4])}) — no "
+                "eviction/pop/clear/rebind on any path; bound it or "
+                "suppress with a WHY if key cardinality is fixed",
+            )
+        )
+    return findings
+
+
+@register(
+    "unbounded-cache",
+    "long-lived dict/list/set grown on steady-state paths with no "
+    "eviction anywhere (the _bad_http_addrs leak class)",
+)
+def check_unbounded_cache(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.modules:
+        if any(mod.relpath.startswith(p) for p in _EXEMPT_PREFIXES):
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(mod, node))
+        findings.extend(_check_module_globals(mod))
+    return findings

@@ -88,8 +88,12 @@ class CheckRunner:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # (service, check) → next fire time
+        # nta: ignore[unbounded-cache] WHY: keyed by the task's
+        # (service, check) set; the runner dies with its task
         self._schedule: dict[tuple[str, str], float] = {}
         # check name → consecutive critical results (check_restart)
+        # nta: ignore[unbounded-cache] WHY: keyed by the task's check
+        # names; the runner dies with its task
         self._fail_streak: dict[str, int] = {}
         self._started_at = time.monotonic()
 

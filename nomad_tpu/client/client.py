@@ -428,6 +428,8 @@ class AllocRunner:
     def __init__(self, client, alloc: Allocation):
         self.client = client
         self.alloc = alloc
+        # nta: ignore[unbounded-cache] WHY: one entry per task in the
+        # group; the alloc runner dies with its alloc
         self.task_runners: dict[str, TaskRunner] = {}
         self._destroyed = False
         self._connect = None  # ConnectHook when the group runs sidecars

@@ -137,6 +137,8 @@ class DeviceManager:
     def __init__(self, plugins: Optional[list[DevicePlugin]] = None):
         self.plugins = plugins if plugins is not None else [TPUDevicePlugin()]
         # (vendor, type, name) → owning plugin, filled by fingerprint_node
+        # nta: ignore[unbounded-cache] WHY: keyed by device instance
+        # ids on this node — hardware-bounded
         self._owners: dict[tuple, DevicePlugin] = {}
         # node attribute keys this manager set, so a shrinking device set
         # clears its stale count attributes

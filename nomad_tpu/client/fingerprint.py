@@ -88,6 +88,8 @@ def host_fingerprint() -> dict:
     }
 
 
+# nta: ignore[unbounded-cache] WHY: process-wide memo keyed by the two
+# probe names (aws/gce) — fixed cardinality by construction
 _ENV_PROBE_CACHE: dict[str, dict] = {}
 
 

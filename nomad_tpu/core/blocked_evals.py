@@ -15,6 +15,16 @@ from ..structs.model import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluat
 
 
 class BlockedEvals:
+    #: prune cadence / age floor for the capacity-change index maps (ref
+    #: blocked_evals.go pruneInterval=5m / pruneThreshold=15m). An entry
+    #: older than PRUNE_THRESHOLD can only change the answer for a
+    #: scheduler snapshot at least that stale — which the nack/lease
+    #: machinery retires long before. Without pruning these maps grow one
+    #: entry per node id / computed class *forever* (the `_bad_http_addrs`
+    #: unbounded-growth class; surfaced by the churn soak's node flaps).
+    PRUNE_INTERVAL = 60.0
+    PRUNE_THRESHOLD = 900.0
+
     def __init__(self, broker):
         self.broker = broker
         self.enabled = False
@@ -38,6 +48,10 @@ class BlockedEvals:
         # deciding to block; ref blocked_evals.go unblockIndexes)
         self._unblock_index = 0
         self._unblock_indexes: dict[str, int] = {}
+        # last-touch timestamps driving the prune (one per index-map key)
+        self._unblock_at: dict[str, float] = {}
+        self._node_unblock_at: dict[str, float] = {}
+        self._last_prune = time.monotonic()
         # evals that escaped computed classes unblock on any change
         self._escaped: set[str] = set()
         # superseded duplicates awaiting the leader's cancellation reap
@@ -151,6 +165,24 @@ class BlockedEvals:
                     nodes.discard(skey)
 
     # ------------------------------------------------------------------
+    def _prune_locked(self):
+        """Drop index-map entries idle past PRUNE_THRESHOLD (ref
+        blocked_evals.go pruneUnblockIndexes). A dropped entry reads as 0
+        in ``_missed_unblock`` — the same answer a node/class that never
+        changed capacity gives — so the only behavior change is for
+        snapshots older than the threshold."""
+        now = time.monotonic()
+        if now - self._last_prune < self.PRUNE_INTERVAL:
+            return
+        self._last_prune = now
+        cutoff = now - self.PRUNE_THRESHOLD
+        for key in [k for k, t in self._unblock_at.items() if t < cutoff]:
+            del self._unblock_at[key]
+            self._unblock_indexes.pop(key, None)
+        for key in [k for k, t in self._node_unblock_at.items() if t < cutoff]:
+            del self._node_unblock_at[key]
+            self._node_unblock_indexes.pop(key, None)
+
     def unblock_node(self, node_id: str, index: int):
         """Capacity on one node changed (alloc became terminal, node
         re-registered or turned ready): re-enqueue the SYSTEM evals
@@ -164,6 +196,8 @@ class BlockedEvals:
             self._node_unblock_indexes[node_id] = max(
                 self._node_unblock_indexes.get(node_id, 0), index
             )
+            self._node_unblock_at[node_id] = time.monotonic()
+            self._prune_locked()
             for skey in self._system_by_node.pop(node_id, set()):
                 ev = self._system.pop(skey, None)
                 if ev is not None:
@@ -185,6 +219,8 @@ class BlockedEvals:
             self._unblock_indexes[computed_class] = max(
                 self._unblock_indexes.get(computed_class, 0), index
             )
+            self._unblock_at[computed_class] = time.monotonic()
+            self._prune_locked()
             for eval_id, ev in list(self._captured.items()):
                 if self._should_unblock(ev, computed_class):
                     to_unblock.append(ev)
@@ -248,6 +284,13 @@ class BlockedEvals:
             self._escaped.clear()
             self._system.clear()
             self._system_by_node.clear()
+            # the index maps are leadership-scoped state like everything
+            # else here: a revoked leader must not carry them into its
+            # next term (and an unflushed map is an unbounded one)
+            self._unblock_indexes.clear()
+            self._node_unblock_indexes.clear()
+            self._unblock_at.clear()
+            self._node_unblock_at.clear()
             self._duplicates = []
 
     def stats(self) -> dict:

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import metrics
 from ..structs.model import Evaluation, generate_uuid
 
 logger = logging.getLogger("nomad_tpu.eval_broker")
@@ -116,8 +117,17 @@ class _TimerWheel:
 
 
 #: module-level singleton: brokers come and go (tests spin up servers by
-#: the dozen) but at most one timer thread ever exists
+#: the dozen) but at most one timer thread ever exists. Shared beyond the
+#: broker: server heartbeat timers arm here too — threading.Timer is one
+#: OS thread per arm, and one-thread-per-NODE capped the cluster at the
+#: environment's thread limit (~4K nodes; surfaced by the churn soak's
+#: 10K-node ramp, which was killed at exactly the thread cap)
 _WHEEL = _TimerWheel()
+
+
+def shared_timer_wheel() -> _TimerWheel:
+    """The process-wide timer wheel (see _WHEEL above)."""
+    return _WHEEL
 
 
 class _PendingHeap:
@@ -173,6 +183,11 @@ class EvalBroker:
         self._requeue: dict[str, Evaluation] = {}
         # eval id -> wait timer
         self._time_wait: dict[str, _TimerHandle] = {}
+        # eval id -> first-enqueue monotonic time; the eval.e2e latency
+        # tap (enqueue -> ack) the churn-soak scorekeeper samples. Popped
+        # on ack, cleared on flush — lives exactly as long as the eval is
+        # the broker's responsibility
+        self._enqueue_t: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     def set_enabled(self, enabled: bool):
@@ -209,6 +224,7 @@ class EvalBroker:
                 self._requeue[token] = ev
             return
         self._evals[ev.id] = 0
+        self._enqueue_t[ev.id] = time.monotonic()
 
         if ev.wait_until:
             now = time.time_ns()
@@ -383,6 +399,9 @@ class EvalBroker:
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
             self._paused.discard(eval_id)
+            t0 = self._enqueue_t.pop(eval_id, None)
+            if t0 is not None:
+                metrics.sample("eval.e2e", time.monotonic() - t0)
 
             key = (ev.namespace, ev.job_id)
             self._job_evals.pop(key, None)
@@ -453,6 +472,7 @@ class EvalBroker:
             self._requeue.clear()
             self._paused.clear()
             self._time_wait.clear()
+            self._enqueue_t.clear()
             self._cond.notify_all()
 
     def stats(self) -> dict:

@@ -77,6 +77,8 @@ class DeploymentWatcher:
         # group → monotonic deadline; armed from the deployment's
         # progress_deadline, extended whenever a healthy alloc lands
         # (ref deployment_watcher.go getDeploymentProgressCutoff)
+        # nta: ignore[unbounded-cache] WHY: keyed by ONE deployment's
+        # task-group names; the watcher dies with its deployment
         self._progress_deadline: dict[str, float] = {}
         self._last_counts: Optional[tuple] = None
 

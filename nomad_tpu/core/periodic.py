@@ -197,6 +197,9 @@ class PeriodicDispatch:
             else:
                 self._tracked.clear()
                 self._heap = []
+                # with the heap gone no stale entry can ever match: the
+                # generation map is droppable wholesale
+                self._gen.clear()
                 self._cv.notify_all()
 
     def restore(self, state):
@@ -265,6 +268,24 @@ class PeriodicDispatch:
             self._tracked.pop(key, None)
             self._gen[key] = self._gen.get(key, 0) + 1
             # stale heap entries are skipped lazily in _run
+            self._compact_gen_locked()
+
+    def _compact_gen_locked(self):
+        """Evict generation counters no live state references. The FSM
+        routes EVERY job apply through add() — non-periodic jobs fall
+        through to remove(), which used to mint a counter per job id and
+        keep it forever (the `_bad_http_addrs` unbounded-growth class;
+        one entry per job ever registered, surfaced by the churn soak's
+        job churn). A key is droppable once it is neither tracked nor
+        referenced by any heap entry: no stale entry can then match, and
+        a later add() restarting its generation at 1 collides with
+        nothing."""
+        if len(self._gen) <= 2 * len(self._tracked) + 64:
+            return
+        live = set(self._tracked)
+        live.update(key for _, key, _ in self._heap)
+        for key in [k for k in self._gen if k not in live]:
+            del self._gen[key]
 
     def tracked(self) -> list[Job]:
         with self._cv:

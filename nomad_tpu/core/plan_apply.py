@@ -342,6 +342,14 @@ class Planner:
         # batch commit hook: ([(plan, result, preemption_evals)]) -> index;
         # commits several independently-verified plans in ONE raft entry.
         self.commit_batch_fn = None
+        # hook: (timeout_exc) -> None; commits+applies a consensus barrier
+        # (raft noop) and PROVES the timed-out entry applied, raising if it
+        # cannot. A raft apply that timed out has already stored its entry,
+        # which may yet commit — a barrier proposed behind it applying in
+        # the SAME TERM (exc.raft_term; terms are monotonic, so an
+        # unchanged current term means leadership was never lost) proves by
+        # log matching that the entry applied too.
+        self.barrier_fn = None
         # per-instance fold cap (server stanza `plan_apply_batch`); the
         # class constant stays as the default so direct constructions and
         # old call sites keep the historical behavior
@@ -407,6 +415,34 @@ class Planner:
                 return entries, None, live[i + 1:], noops
         return entries, snap, [], noops
 
+    def _commit_resolving(self, commit):
+        """Run a consensus commit, resolving indeterminate timeouts.
+
+        A raft apply that times out has ALREADY stored its entry in the
+        log — the entry may still commit seconds later. Treating the
+        timeout as "nothing happened" lets every subsequent batch verify
+        against snapshots missing the in-flight entry, double-booking its
+        capacity when it lands (the over-commit class the first full-scale
+        soak surfaced: raft-apply p99 was ~4x the apply timeout under
+        storm backlog). On timeout, a barrier committed BEHIND the entry
+        proves by log matching that the entry applied; the commit then
+        reports the entry's real index. If the barrier itself fails, the
+        original timeout propagates — still carrying ``raft_index`` so the
+        apply loop can floor its snapshots past the unresolved entry."""
+        try:
+            return commit()
+        except TimeoutError as e:
+            index = getattr(e, "raft_index", None)
+            if index is None or self.barrier_fn is None:
+                raise
+            try:
+                self.barrier_fn(e)
+            except Exception:
+                metrics.incr("plan.commit_timeout_unresolved")
+                raise e
+            metrics.incr("plan.commit_timeout_resolved")
+            return index
+
     def _respond_refreshed(self, noops, index: Optional[int] = None):
         """Answer fully-rejected plans with a refresh index that is REAL:
         the just-committed batch's index when one exists (it contains the
@@ -433,6 +469,11 @@ class Planner:
         workers are answered only after their commit really lands."""
         outstanding: Optional[tuple[threading.Thread, dict]] = None
         prev_index = 0
+        # snapshots must never be taken below this index: a commit that
+        # failed INDETERMINATELY (apply timeout + failed barrier) may still
+        # land at its entry index — verifying any batch against state below
+        # it risks double-booking the in-flight entry's capacity
+        floor = 0
         snap: Optional[StateSnapshot] = None
         # the REAL store index the current snap is based on: an optimistic
         # overlay bumps the snapshot's own index synthetically, which must
@@ -468,11 +509,12 @@ class Planner:
             # harvest a commit that finished while we were idle
             if outstanding is not None and not outstanding[0].is_alive():
                 prev_index = max(prev_index, outstanding[1].get("index", 0))
+                floor = max(floor, outstanding[1].get("floor", 0))
                 outstanding = None
                 snap = None
 
             batch_min = max(p.plan.snapshot_index for p in live)
-            min_index = max(prev_index, batch_min)
+            min_index = max(prev_index, batch_min, floor)
             if snap is not None and snap_base_index < min_index:
                 snap = None
             if snap is None:
@@ -483,8 +525,9 @@ class Planner:
                 if outstanding is not None:
                     outstanding[0].join()
                     prev_index = max(prev_index, outstanding[1].get("index", 0))
+                    floor = max(floor, outstanding[1].get("floor", 0))
                     outstanding = None
-                    min_index = max(prev_index, batch_min)
+                    min_index = max(prev_index, batch_min, floor)
                 try:
                     snap = self.state.snapshot_min_index(min_index, timeout=5.0)
                     snap_base_index = snap.latest_index()
@@ -506,12 +549,14 @@ class Planner:
                 outstanding[0].join()
                 committed = outstanding[1].get("index", 0)
                 prev_index = max(prev_index, committed)
+                floor = max(floor, outstanding[1].get("floor", 0))
                 outstanding = None
                 try:
                     fresh = self.state.snapshot_min_index(
                         max(
                             prev_index,
                             max(p.plan.snapshot_index for p, _ in entries),
+                            floor,
                         ),
                         timeout=5.0,
                     )
@@ -605,12 +650,18 @@ class Planner:
                 items.append((pending.plan, result, preemption_evals))
             if self.commit_batch_fn is not None:
                 with metrics.measure("plan.raft_apply"):
-                    index = self.commit_batch_fn(items)
+                    index = self._commit_resolving(
+                        lambda: self.commit_batch_fn(items)
+                    )
             elif self.commit_fn is not None:
                 with metrics.measure("plan.raft_apply"):
                     index = 0
                     for plan, result, pevals in items:
-                        index = self.commit_fn(plan, result, pevals)
+                        index = self._commit_resolving(
+                            lambda p=plan, r=result, pe=pevals: self.commit_fn(
+                                p, r, pe
+                            )
+                        )
             else:
                 index = 0
                 for plan, result, pevals in items:
@@ -642,6 +693,12 @@ class Planner:
             for pending, _ in noops:
                 pending.respond(None, err)
         except Exception as e:
+            # an unresolved in-flight entry (timeout + failed barrier) may
+            # still land: floor the apply loop's snapshots past it so no
+            # batch is ever verified against state that could be missing it
+            floor = getattr(e, "raft_index", 0)
+            if floor:
+                box["floor"] = max(box.get("floor", 0), floor)
             for pending, _ in entries:
                 pending.respond(None, e)
             for pending, _ in noops:
@@ -657,7 +714,9 @@ class Planner:
                 preemption_evals = self.preemption_evals_fn(result)
             if self.commit_fn is not None:
                 with metrics.measure("plan.raft_apply"):
-                    index = self.commit_fn(plan, result, preemption_evals)
+                    index = self._commit_resolving(
+                        lambda: self.commit_fn(plan, result, preemption_evals)
+                    )
             else:
                 index = self.state.upsert_plan_results(
                     None, plan, result, preemption_evals=preemption_evals
@@ -670,6 +729,8 @@ class Planner:
             box["index"] = index
             pending.respond(result, None)
         except Exception as e:
+            if getattr(e, "raft_index", 0):
+                box["floor"] = max(box.get("floor", 0), e.raft_index)
             pending.respond(None, e)
 
     def apply(self, plan: Plan) -> PlanResult:

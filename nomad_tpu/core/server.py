@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import threading
 import time
 from typing import Optional
@@ -47,7 +48,7 @@ from ..structs.model import (
 from ..structs.node_class import compute_class
 from . import fsm as fsm_mod
 from .blocked_evals import BlockedEvals
-from .broker import EvalBroker
+from .broker import EvalBroker, shared_timer_wheel
 from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
 from .drainer import NodeDrainer
 from .periodic import PeriodicDispatch, derive_dispatch_job
@@ -125,11 +126,23 @@ class Server:
         )
         self.planner.commit_fn = self._commit_plan
         self.planner.commit_batch_fn = self._commit_plan_batch
+        self.planner.barrier_fn = self._plan_commit_barrier
         self.planner.preemption_evals_fn = self._make_preemption_evals
         self.planner.token_check_fn = self._plan_token_live
         self.workers: list[Worker] = []
         self.heartbeat_ttl = self.config.get("heartbeat_ttl", DEFAULT_HEARTBEAT_TTL)
-        self._heartbeat_timers: dict[str, threading.Timer] = {}
+        # node id -> cancelable handle on the SHARED timer wheel. These
+        # were threading.Timer — one OS thread per tracked node for the
+        # whole TTL, which capped the fleet at the environment's thread
+        # limit (~4K); the 10K-node churn soak dies there instantly
+        self._heartbeat_timers: dict = {}
+        # expiry handoff: the wheel runs callbacks inline on its ONE
+        # process-wide thread, and an expiry is two raft applies + eval
+        # fan-out — thousands at once when a leader loses its clients —
+        # so the wheel callback only enqueues here; a lazily-started
+        # per-server drainer does the work
+        self._hb_expire_q: queue.Queue = queue.Queue()
+        self._hb_expire_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._running = False
         self._leader = False
@@ -627,6 +640,25 @@ class Server:
             self._plan_payload(plan, result, preemption_evals),
         )
 
+    def _plan_commit_barrier(self, exc):
+        """Resolve an INDETERMINATE plan commit (raft apply timeout): a
+        barrier committed behind the timed-out entry applying in the same
+        leadership proves — by log matching — that the entry applied too.
+        Same leadership must be PROVEN, not assumed: if the term moved at
+        any point since the entry was proposed (terms are monotonic, so a
+        changed current term is conclusive), an intervening leader may
+        have truncated the entry — the resolution fails and the applier
+        falls back to flooring its snapshots past the entry. Generous
+        timeout: under storm backlog the barrier waits out the same apply
+        queue that made the commit slow in the first place."""
+        self.raft.barrier(timeout=120.0)
+        term = getattr(exc, "raft_term", 0)
+        if term and self.raft.current_term != term:
+            raise RuntimeError(
+                f"plan commit entry {exc.raft_index} unresolvable: term "
+                f"moved {term} -> {self.raft.current_term} during the wait"
+            )
+
     def _commit_plan_batch(self, items):
         """Replicate several independently-verified plan results in ONE
         raft entry (one fsync + round-trip for the whole batch; the FSM
@@ -776,6 +808,7 @@ class Server:
 
     def stop(self):
         self._running = False
+        self._hb_expire_q.put(None)  # unpark the expiry drainer, if any
         if self.gossip is not None:
             try:
                 self.gossip.leave()
@@ -1834,17 +1867,63 @@ class Server:
             old = self._heartbeat_timers.pop(node_id, None)
             if old is not None:
                 old.cancel()
-            t = threading.Timer(
-                self.heartbeat_ttl, self._invalidate_heartbeat, args=(node_id,)
+            handle_box: list = []
+            handle = shared_timer_wheel().arm(
+                self.heartbeat_ttl,
+                self._enqueue_heartbeat_expiry,
+                (node_id, handle_box),
             )
-            t.daemon = True
-            self._heartbeat_timers[node_id] = t
-            t.start()
+            # the callback identity-checks against the map under this
+            # same lock, so it can't observe the box empty
+            handle_box.append(handle)
+            self._heartbeat_timers[node_id] = handle
+
+    def _enqueue_heartbeat_expiry(self, node_id: str, handle_box: list):
+        """Wheel callback: never do raft work on the wheel thread — a
+        mass expiry would serialize there and freeze every other timer
+        in the process (nack timeouts, other in-process servers). A
+        queued expiry can't be retracted the way a timer cancel() could,
+        so the map entry is claimed HERE, under the lock, only if this
+        firing's handle is still the node's current one — and the
+        drainer re-checks before acting."""
+        with self._lock:
+            if not self._running:
+                return
+            if self._heartbeat_timers.get(node_id) is not handle_box[0]:
+                return  # stale fire: a heartbeat re-armed this node
+            del self._heartbeat_timers[node_id]
+            t = self._hb_expire_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(
+                    target=self._drain_heartbeat_expirations,
+                    name="heartbeat-expiry",
+                    daemon=True,
+                )
+                self._hb_expire_thread = t
+                t.start()
+        self._hb_expire_q.put(node_id)
+
+    def _drain_heartbeat_expirations(self):
+        while True:
+            node_id = self._hb_expire_q.get()
+            if node_id is None:
+                # stop() sentinel. A server can stop()+start() again,
+                # and stop() enqueues unconditionally — a sentinel from
+                # a PREVIOUS life must not kill the new life's drainer
+                # (stranding that batch's expirations behind it)
+                if not self._running:
+                    return
+                continue
+            self._invalidate_heartbeat(node_id)
 
     def _invalidate_heartbeat(self, node_id: str):
         """Heartbeat missed → node down → node evals (ref heartbeat.go:150)."""
         with self._lock:
-            self._heartbeat_timers.pop(node_id, None)
+            if node_id in self._heartbeat_timers:
+                # the node heartbeated between the expiry firing and this
+                # drain — it is alive and freshly armed; downing it now
+                # would flap a healthy node
+                return
         try:
             node = self.state.node_by_id(node_id)
             if node is not None and node.status != NODE_STATUS_DOWN:

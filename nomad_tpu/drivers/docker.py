@@ -99,28 +99,37 @@ class ImageCoordinator:
     ):
         """Reference an image, pulling it if absent (or force_pull). A
         pending delayed-delete for the image is cancelled."""
-        with self._lock:
-            timer = self._timers.pop(image, None)
-            pull_lock = self._pulls.setdefault(image, threading.Lock())
-        if timer is not None:
-            timer.cancel()
-        with pull_lock:  # one puller; others wait and reuse
+        while True:
             with self._lock:
-                refs = self._refs.setdefault(image, set())
-                first_ref = not refs
-                refs.add(container)
-            need_pull = force_pull or (
-                first_ref and not self._present(image, config_dir)
-            )
-            if need_pull:
-                out = self.driver._run(
-                    "pull", image, timeout=600, config_dir=config_dir
+                timer = self._timers.pop(image, None)
+                pull_lock = self._pulls.setdefault(image, threading.Lock())
+            if timer is not None:
+                timer.cancel()
+            with pull_lock:  # one puller; others wait and reuse
+                with self._lock:
+                    if self._pulls.get(image) is not pull_lock:
+                        # _remove evicted this lock while we waited on it:
+                        # later acquirers are serializing on a replacement,
+                        # so first_ref bookkeeping under the stale lock
+                        # could let them skip the presence check while we
+                        # are still mid-pull. Start over on the live lock.
+                        continue
+                    refs = self._refs.setdefault(image, set())
+                    first_ref = not refs
+                    refs.add(container)
+                need_pull = force_pull or (
+                    first_ref and not self._present(image, config_dir)
                 )
-                if out.returncode != 0:
-                    self.release(image, container)
-                    raise RuntimeError(
-                        f"docker pull failed: {out.stderr.strip()}"
+                if need_pull:
+                    out = self.driver._run(
+                        "pull", image, timeout=600, config_dir=config_dir
                     )
+                    if out.returncode != 0:
+                        self.release(image, container)
+                        raise RuntimeError(
+                            f"docker pull failed: {out.stderr.strip()}"
+                        )
+                return
 
     def _present(self, image: str, config_dir: str = "") -> bool:
         try:
@@ -162,6 +171,17 @@ class ImageCoordinator:
                 self.driver._run("rmi", image, timeout=120)
             except (OSError, subprocess.TimeoutExpired):
                 pass
+            with self._lock:
+                # the image is gone and unreferenced: drop its pull lock
+                # too, or a long-lived client leaks one Lock per distinct
+                # image ever pulled (the unbounded-cache class). A waiter
+                # already blocked on this lock object detects the eviction
+                # (identity check in acquire()) and restarts on the
+                # replacement lock, so all acquirers stay serialized.
+                if self._pulls.get(image) is pull_lock and not self._refs.get(
+                    image
+                ):
+                    del self._pulls[image]
 
 
 class DockerDriver(Driver):

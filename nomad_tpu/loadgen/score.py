@@ -1,0 +1,398 @@
+"""Continuous scorekeeper: samples the cluster's health signals on an
+interval for the whole storm, runs the incremental invariant checker
+throughout (never just at the end), and grades the run against the
+scenario's SLOs into a ``SOAK_r*.json`` artifact + one ``SOAK_SUMMARY``
+trailing line (same log-tail-survival contract as BENCH_SUMMARY).
+
+Sampled per tick:
+
+- **RSS** (/proc/self/statm): the ceiling + the post-ramp growth slope —
+  the signal that catches unbounded-growth classes like the r5
+  ``_bad_http_addrs`` leak;
+- **eval latency**: the ``eval.e2e`` timer (enqueue→ack, core/broker.py
+  tap) p99, a timeline because the timer window slides;
+- **event-stream subscriber lag**: probe subscribers riding the real
+  ``/v1/event/stream`` HTTP surface; lag = broker latest index − the
+  probe's last delivered index;
+- **plan plane**: ``plan.queue_wait`` / ``plan.submit`` p99, queue depth;
+- **mirror**: hit/rebuild counters (tpu/mirror.py) when a mirror exists;
+- **store shape**: object counts per table (alloc/eval/job/node).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..testing.invariants import (
+    IncrementalInvariantChecker,
+    check_cluster_invariants,
+)
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_mb() -> float:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE / 1e6
+    except OSError:  # non-linux fallback
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+class _StreamProbe:
+    """One event-stream consumer over the real HTTP surface; tracks the
+    last index it has fully received so the scorekeeper can compute
+    delivery lag against the broker's head."""
+
+    def __init__(self, http_address: str, probe_id: int):
+        self.http_address = http_address
+        self.probe_id = probe_id
+        self.last_index = 0
+        self.frames = 0
+        self.gaps = 0
+        self.reconnects = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"ldg-probe-{probe_id}", daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        from ..api.client import ApiClient
+
+        client = ApiClient(address=self.http_address)
+        while not self._stop.is_set():
+            try:
+                stream = client.event_stream(
+                    index=self.last_index, heartbeat=0.5
+                )
+                for frame in stream:
+                    if self._stop.is_set():
+                        stream.close()
+                        break
+                    if frame.get("LostGap"):
+                        self.gaps += 1
+                        self.last_index = max(
+                            self.last_index, frame.get("Index", 0)
+                        )
+                        continue
+                    if frame.get("Error"):
+                        break
+                    if frame.get("Index"):
+                        self.last_index = max(
+                            self.last_index, frame["Index"]
+                        )
+                        self.frames += 1
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self.reconnects += 1
+                self._stop.wait(0.5)
+
+
+class Scorekeeper:
+    """Samples ``server`` (the in-process core.Server) on ``interval``
+    seconds; the *reads* use in-process taps (metrics registry, broker
+    stats, store snapshots — all lock-free or O(1)), while the probe
+    subscribers consume the real HTTP stream like external watchers."""
+
+    def __init__(
+        self,
+        server,
+        http_address: str | None = None,
+        interval: float = 1.0,
+        invariants_every: int = 5,
+        probes: int = 2,
+        max_fit_nodes: int = 512,
+        seed: int = 0,
+    ):
+        self.server = server
+        self.http_address = http_address
+        self.interval = interval
+        self.invariants_every = max(1, invariants_every)
+        self.samples: list[dict] = []
+        self.checker = IncrementalInvariantChecker(
+            server.state, max_fit_nodes=max_fit_nodes, seed=seed
+        )
+        # the checker is single-threaded state; stop() joins the sampler
+        # with a bounded timeout, so a production-scale sweep still in
+        # flight can outlive stop() and race final_check() without this.
+        # _closed (flipped under the lock by stop()) makes stop() a real
+        # barrier: a zombie tick that lost the race drops its results
+        # instead of appending to a report already being built
+        self._checker_lock = threading.Lock()
+        self._closed = False
+        self.violation_log: list[dict] = []
+        self.rss_baseline_mb = rss_mb()
+        self._probes = [
+            _StreamProbe(http_address, i)
+            for i in range(probes if http_address else 0)
+        ]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="ldg-scorekeeper", daemon=True
+        )
+        self._marks: list[tuple[float, str]] = []
+        self._t0 = None
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self._t0 = time.monotonic()
+        for p in self._probes:
+            p.start()
+        self._thread.start()
+
+    def mark(self, label: str):
+        """Annotate the timeline (phase boundaries land in the artifact)."""
+        if self._t0 is not None:
+            self._marks.append((round(time.monotonic() - self._t0, 2), label))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        with self._checker_lock:
+            self._closed = True
+        for p in self._probes:
+            p.stop()
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        ticks = 0
+        while not self._stop.wait(self.interval):
+            ticks += 1
+            try:
+                self._sample(ticks)
+            except Exception:  # keep sampling; one bad tick is data loss,
+                import logging  # a dead scorekeeper is a blind soak
+
+                logging.getLogger("nomad_tpu.loadgen.score").exception(
+                    "scorekeeper tick failed"
+                )
+
+    def _sample(self, ticks: int):
+        from .. import metrics
+
+        t = round(time.monotonic() - self._t0, 2)
+        snap_metrics = metrics.snapshot()
+        timers = snap_metrics["timers"]
+        gen = self.server.state._gen
+        broker = self.server.event_broker
+        broker_stats = broker.stats() if broker is not None else {}
+        head = broker_stats.get("latest_index", 0)
+        sample = {
+            "t": t,
+            "rss_mb": round(rss_mb(), 1),
+            "index": self.server.state.latest_index(),
+            "allocs": len(gen.allocs),
+            "evals": len(gen.evals),
+            "jobs": len(gen.jobs),
+            "nodes": len(gen.nodes),
+            "deployments": len(gen.deployments),
+            "eval_e2e_p99_ms": timers.get("eval.e2e", {}).get("p99_ms", 0.0),
+            "eval_e2e_mean_ms": timers.get("eval.e2e", {}).get("mean_ms", 0.0),
+            "plan_queue_wait_p99_ms": timers.get("plan.queue_wait", {}).get(
+                "p99_ms", 0.0
+            ),
+            "plan_submit_p99_ms": timers.get("plan.submit", {}).get(
+                "p99_ms", 0.0
+            ),
+            "plan_queue_depth": (
+                self.server.planner.queue.depth()
+                if getattr(self.server, "planner", None) is not None
+                else 0
+            ),
+            "broker_ready": self.server.eval_broker.stats().get(
+                "total_ready", 0
+            ) if getattr(self.server, "eval_broker", None) else 0,
+            "subscribers": broker_stats.get("subscribers", 0),
+            "slow_consumers_closed": broker_stats.get(
+                "slow_consumers_closed", 0
+            ),
+            "probe_lag": [
+                max(0, head - p.last_index) for p in self._probes
+            ],
+        }
+        mirror = getattr(self.server, "columnar_mirror", None)
+        if mirror is not None:
+            ms = mirror.stats()
+            sample["mirror_hits"] = ms.get("hits", 0)
+            sample["mirror_rebuilds"] = ms.get("rebuilds", 0)
+        sweep = ticks % self.invariants_every == 0
+        with self._checker_lock:
+            if self._closed:
+                return
+            if sweep:
+                t_chk = time.monotonic()
+                new = self.checker.check(quiesced=False)
+                sample["invariant_check_s"] = round(
+                    time.monotonic() - t_chk, 3
+                )
+                for v in new:
+                    self.violation_log.append({"t": t, "violation": v})
+            self.samples.append(sample)
+
+    # ------------------------------------------------------------------
+    def final_check(self, quiesced: bool = True) -> list[str]:
+        """The trailing sweep after the cluster quiesced; with the
+        incremental checker's state it completes coverage of everything
+        the sampled sweeps deferred."""
+        t = (
+            round(time.monotonic() - self._t0, 2)
+            if self._t0 is not None
+            else 0.0
+        )
+        with self._checker_lock:
+            new = self.checker.check(quiesced=quiesced)
+        for v in new:
+            self.violation_log.append({"t": t, "violation": v, "final": True})
+        return new
+
+    def full_check(self) -> list[str]:
+        """One classic full-sweep check (the oracle the incremental mode
+        is pinned against); used by the smoke storm's final assertion."""
+        return check_cluster_invariants(self.server.state)
+
+    # ------------------------------------------------------------------
+    def report(self, scenario, seed: int, stream, driver_report) -> dict:
+        samples = self.samples
+        rss_series = [s["rss_mb"] for s in samples]
+        p99_series = [s["eval_e2e_p99_ms"] for s in samples]
+        lag_series = [
+            max(s["probe_lag"]) for s in samples if s.get("probe_lag")
+        ]
+        # post-ramp growth slope: least-squares fit over the last 60% of
+        # samples, so a one-tick RSS transient on either endpoint can't
+        # flip the bounded-growth SLO (endpoint deltas are hostage to
+        # single-sample noise)
+        slope = 0.0
+        tail = samples[int(len(samples) * 0.4):]
+        if len(tail) >= 2 and tail[-1]["t"] > tail[0]["t"]:
+            ts = [s["t"] / 60.0 for s in tail]
+            ys = [s["rss_mb"] for s in tail]
+            n = len(tail)
+            t_mean = sum(ts) / n
+            y_mean = sum(ys) / n
+            var = sum((t - t_mean) ** 2 for t in ts)
+            cov = sum(
+                (t - t_mean) * (y - y_mean) for t, y in zip(ts, ys)
+            )
+            slope = cov / max(var, 1e-9)
+        mirror = getattr(self.server, "columnar_mirror", None)
+        report = {
+            "scenario": scenario.name,
+            "seed": seed,
+            "stream_digest": stream.digest(),
+            "stream_ops": len(stream.ops),
+            "op_counts": stream.counts(),
+            "driver": driver_report.to_dict(),
+            "samples": samples,
+            "marks": [{"t": t, "label": lbl} for t, lbl in self._marks],
+            "rss_baseline_mb": round(self.rss_baseline_mb, 1),
+            "rss_peak_mb": round(max(rss_series, default=0.0), 1),
+            "rss_final_mb": rss_series[-1] if rss_series else 0.0,
+            "rss_tail_slope_mb_per_min": round(slope, 2),
+            "eval_e2e_p99_ms_max": max(p99_series, default=0.0),
+            "subscriber_lag_max": max(lag_series, default=0),
+            "subscriber_gaps": sum(p.gaps for p in self._probes),
+            "subscriber_frames": sum(p.frames for p in self._probes),
+            "invariants": {
+                **self.checker.stats(),
+                "violation_log": self.violation_log,
+            },
+            "mirror": mirror.stats() if mirror is not None else None,
+            "final_state": samples[-1] if samples else {},
+        }
+        report["slo"] = grade(report, scenario.slos)
+        return report
+
+
+# ---------------------------------------------------------------------------
+# SLO grading
+# ---------------------------------------------------------------------------
+
+#: slo key -> (report path extractor, comparator description)
+def grade(report: dict, slos: dict) -> dict:
+    """Grade the report against the scenario's SLO targets. Known keys:
+
+    - ``max_invariant_violations`` (almost always 0)
+    - ``max_rss_tail_slope_mb_per_min`` — bounded-growth ceiling
+    - ``max_rss_peak_mb``
+    - ``max_eval_e2e_p99_ms``
+    - ``max_subscriber_lag`` (indexes behind the broker head)
+    - ``max_op_failure_rate`` (real failures / fired, shed+expected excluded)
+    - ``max_shed_rate``
+
+    Returns {checks: {name: {target, actual, pass}}, passed, failed,
+    score} where score is the passed fraction (0..1).
+    """
+    driver = report["driver"]
+    fired = max(driver["fired"], 1)
+    actuals = {
+        "max_invariant_violations": report["invariants"]["violations"],
+        "max_rss_tail_slope_mb_per_min": report["rss_tail_slope_mb_per_min"],
+        "max_rss_peak_mb": report["rss_peak_mb"],
+        "max_eval_e2e_p99_ms": report["eval_e2e_p99_ms_max"],
+        "max_subscriber_lag": report["subscriber_lag_max"],
+        "max_op_failure_rate": driver["failed"] / fired,
+        "max_shed_rate": driver["shed"] / fired,
+    }
+    checks = {}
+    for name, target in sorted(slos.items()):
+        actual = actuals.get(name)
+        if actual is None:
+            checks[name] = {"target": target, "actual": None, "pass": False}
+            continue
+        checks[name] = {
+            "target": target,
+            "actual": round(actual, 4) if isinstance(actual, float) else actual,
+            "pass": actual <= target,
+        }
+    passed = sum(1 for c in checks.values() if c["pass"])
+    return {
+        "checks": checks,
+        "passed": passed,
+        "failed": len(checks) - passed,
+        "score": round(passed / max(len(checks), 1), 3),
+    }
+
+
+def summary_line(report: dict) -> str:
+    """The one trailing line that must survive a truncated log tail."""
+    slo = report["slo"]
+    inv = report["invariants"]
+    parts = [
+        f"scenario={report['scenario']}",
+        f"seed={report['seed']}",
+        f"ops={report['driver']['fired']}",
+        f"ok={report['driver']['ok']}",
+        f"failed={report['driver']['failed']}",
+        f"shed={report['driver']['shed']}",
+        f"allocs={report['final_state'].get('allocs', 0)}",
+        f"nodes={report['final_state'].get('nodes', 0)}",
+        f"invariant_violations={inv['violations']}",
+        f"invariant_sweeps={inv['sweeps']}",
+        f"rss_peak_mb={report['rss_peak_mb']}",
+        f"rss_slope_mb_min={report['rss_tail_slope_mb_per_min']}",
+        f"eval_p99_max_ms={report['eval_e2e_p99_ms_max']}",
+        f"sub_lag_max={report['subscriber_lag_max']}",
+        f"slo={slo['passed']}/{slo['passed'] + slo['failed']}",
+        f"score={slo['score']}",
+        f"digest={report['stream_digest'][:12]}",
+    ]
+    return "SOAK_SUMMARY " + " ".join(parts)
+
+
+def write_report(report: dict, path: str):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")

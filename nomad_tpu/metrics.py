@@ -104,6 +104,8 @@ class StatsdSink:
         self.addr = (host or "127.0.0.1", int(port))
         self.prefix = prefix
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # nta: ignore[unbounded-cache] WHY: keyed by metric name — the
+        # name set is code-bounded (no per-request interpolation)
         self._last_counters: dict[str, float] = {}
 
     def _fmt(self, name: str) -> str:

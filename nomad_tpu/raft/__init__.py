@@ -10,5 +10,5 @@ production — nomad_tpu.rpc).
 """
 
 from .log import FileLogStore, InmemLogStore, LogEntry  # noqa: F401
-from .raft import NotLeaderError, Raft, RaftConfig  # noqa: F401
+from .raft import ApplyTimeout, NotLeaderError, Raft, RaftConfig  # noqa: F401
 from .transport import InmemTransport, Transport  # noqa: F401

@@ -193,6 +193,8 @@ class StableStore:
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
+        # nta: ignore[unbounded-cache] WHY: the durable stable store
+        # (currentTerm/votedFor); the key set is protocol-fixed
         self._data: dict = {}
         self._lock = threading.Lock()
         if path and os.path.exists(path):

@@ -37,6 +37,24 @@ class NotLeaderError(Exception):
         self.leader_id = leader_id
 
 
+class ApplyTimeout(TimeoutError):
+    """The apply wait expired with the entry's outcome still UNKNOWN: it is
+    already stored in the leader's log and may yet commit and apply. Callers
+    must not treat this as "nothing happened" — a later write computed
+    against state missing this entry can double-apply its effects (the plan
+    applier resolves the outcome through a barrier instead). Carries the
+    entry's log index and the term it was proposed in: a resolver must
+    prove the term never changed, or the entry may have been truncated
+    under an intervening leader."""
+
+    def __init__(self, index: int, term: int = 0):
+        super().__init__(
+            f"raft apply timed out (entry {index} term {term} still in flight)"
+        )
+        self.raft_index = index
+        self.raft_term = term
+
+
 @dataclass
 class RaftConfig:
     heartbeat_interval: float = 0.05
@@ -106,8 +124,13 @@ class Raft:
         self.last_snapshot_term = 0
         self._last_contact = time.monotonic()
         self._futures: dict[int, _Future] = {}
+        # nta: ignore[unbounded-cache] WHY: the three per-peer maps
+        # below are keyed by voter id — bounded by the configured peer
+        # set (membership changes republish the voter map)
         self._match_index: dict[str, int] = {}
+        # nta: ignore[unbounded-cache] WHY: per-voter, see above
         self._peer_contact: dict[str, float] = {}  # last successful append ack
+        # nta: ignore[unbounded-cache] WHY: per-voter, see above
         self._next_index: dict[str, int] = {}
         self._replicators: dict[str, threading.Thread] = {}
         self._repl_conds: dict[str, threading.Condition] = {}
@@ -673,7 +696,12 @@ class Raft:
             self._futures[index] = fut
         self._kick_replicators()
         self._maybe_advance_commit()
-        return fut.wait(timeout or self.config.apply_timeout)
+        try:
+            return fut.wait(timeout or self.config.apply_timeout)
+        except ApplyTimeout:
+            raise
+        except TimeoutError:
+            raise ApplyTimeout(index, entry.term) from None
 
     def barrier(self, timeout: Optional[float] = None):
         """Commit + apply a noop, guaranteeing all prior entries applied."""

@@ -370,6 +370,26 @@ class ServerProxy:
             "Node.UpdateStatus", {"node_id": node_id, "status": status}
         )
 
+    def node_drain(
+        self, node_id: str, drain: bool, deadline_ns: int = 0,
+        mark_eligible: bool | None = None,
+    ) -> dict:
+        return self._call(
+            "Node.Drain",
+            {
+                "node_id": node_id,
+                "drain": drain,
+                "deadline_ns": deadline_ns,
+                "mark_eligible": mark_eligible,
+            },
+        )
+
+    def node_update_eligibility(self, node_id: str, eligibility: str) -> dict:
+        return self._call(
+            "Node.Eligibility",
+            {"node_id": node_id, "eligibility": eligibility},
+        )
+
     def get_client_allocs(self, node_id: str, min_index: int = 0, timeout: float = 30.0):
         resp = self._call(
             "Node.GetClientAllocs",

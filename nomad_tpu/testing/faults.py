@@ -74,8 +74,12 @@ class FaultRule:
 class FaultPlane:
     def __init__(self, seed: int = 0):
         self.rng = random.Random(seed)
+        # nta: ignore[unbounded-cache] WHY: a plane is scenario-scoped
+        # and its rule list is the test's specification
         self.rules: list[FaultRule] = []
         #: every injected fault as (scope, src, dst, method, action)
+        # nta: ignore[unbounded-cache] WHY: scenario-scoped assertion
+        # surface (tests read it); dies with the plane
         self.log: list[tuple] = []
         self._lock = threading.Lock()
 

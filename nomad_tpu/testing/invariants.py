@@ -18,6 +18,8 @@ concurrency + raft):
 
 from __future__ import annotations
 
+import random
+
 from ..structs.funcs import allocs_fit
 
 
@@ -38,7 +40,7 @@ def check_cluster_invariants(state) -> list[str]:
         if len(group) > 1:
             violations.append(
                 f"alloc placed twice: {len(group)} live allocs named "
-                f"{name!r} for {ns}/{job_id}: {[a.id for a in group]}"
+                f"{name!r} for {ns}/{job_id}: {sorted(a.id for a in group)}"
             )
 
     # 2. no node over-committed vs AllocsFit
@@ -94,3 +96,229 @@ def assert_cluster_invariants(state):
     assert not violations, "cluster invariants violated:\n" + "\n".join(
         violations
     )
+
+
+class IncrementalInvariantChecker:
+    """The same invariants, cheap enough to run *mid-storm*.
+
+    A full :func:`check_cluster_invariants` sweep runs ``allocs_fit``
+    against every node and rebuilds the duplicate-name map from scratch —
+    serializing a large server for seconds per check. This checker keys
+    its work off the raft index instead: each :meth:`check` takes one
+    immutable snapshot, skips wholesale any table whose table index did
+    not advance past the previous sweep, filters the tables that did to
+    the objects whose ``modify_index`` advanced (plus allocs deleted
+    since, found by key-set difference), and re-verifies exactly the
+    state those changes can have perturbed. The filter itself is one
+    O(table) dict iteration per *changed* table — the store has no
+    modify-index-ordered iterator — so what this buys is skipping the
+    expensive work (``allocs_fit``, group rebuilds, per-object index
+    checks), not the raw table walk of a mid-storm allocs table:
+
+    - duplicate-placement groups are maintained incrementally (alloc id →
+      name-key membership) and only touched groups re-checked;
+    - ``allocs_fit`` runs only on nodes whose alloc set or node object
+      changed, capped per sweep by ``max_fit_nodes`` with a seeded sample
+      (skipped nodes are *counted* in ``sampled_out``, and a node left
+      over-committed stays dirty until a later sweep clears it — coverage
+      degrades visibly, never silently);
+    - index monotonicity is checked on the changed objects only;
+    - the "every non-blocked eval terminal" clause only applies to a
+      quiesced cluster, so it runs when ``quiesced=True`` (the final
+      sweep) — exactly the contract of the full checker's docstring.
+
+    On a quiesced cluster a trailing ``check(quiesced=True)`` after the
+    last write makes the accumulated ``violations`` equal to what one
+    full check would report — pinned by tests/test_loadgen.py.
+    """
+
+    def __init__(self, state, max_fit_nodes: int = 512, seed: int = 0):
+        self.state = state
+        self.max_fit_nodes = max_fit_nodes
+        self._rng = random.Random(seed)
+        self._last_index = -1
+        #: alloc id -> (namespace, job_id, name) for every LIVE alloc seen
+        self._live_key: dict[str, tuple] = {}
+        #: name-key -> set of live alloc ids
+        self._groups: dict[tuple, set] = {}
+        #: every alloc id currently in the table (for deletion detection)
+        self._known_ids: set = set()
+        #: alloc id -> node_id (so deletions dirty the right node)
+        self._node_of: dict[str, str] = {}
+        #: nodes needing an allocs_fit pass (carried across sweeps when
+        #: the per-sweep cap defers them)
+        self._dirty_nodes: set = set()
+        #: the subset of ``_dirty_nodes`` already counted in
+        #: ``sampled_out`` — a node deferred across k sweeps counts once,
+        #: not k times
+        self._deferred: set = set()
+        self.sweeps = 0
+        self.objects_scanned = 0
+        self.fit_checks = 0
+        self.sampled_out = 0
+        #: distinct violations, in discovery order
+        # nta: ignore[unbounded-cache] WHY: the checker is run-scoped
+        # and the distinct-violation list IS its deliverable
+        self.violations: list[str] = []
+        # nta: ignore[unbounded-cache] WHY: dedup set over the
+        # run-scoped deliverable above
+        self._seen_violations: set = set()
+
+    # ------------------------------------------------------------------
+    def _add(self, violation: str):
+        if violation not in self._seen_violations:
+            self._seen_violations.add(violation)
+            self.violations.append(violation)
+
+    def check(self, quiesced: bool = False) -> list[str]:
+        """One incremental sweep; returns the NEW violations it found."""
+        snap = self.state.snapshot()
+        found_at = len(self.violations)
+        latest = snap.latest_index()
+        if latest == self._last_index and not quiesced and not self._dirty_nodes:
+            return []
+        self.sweeps += 1
+        since = self._last_index
+
+        # ---- table indexes never exceed the store's latest index
+        for table, idx in snap._gen.table_indexes.items():
+            if idx > latest:
+                self._add(
+                    f"table {table} index {idx} exceeds latest index {latest}"
+                )
+
+        table_indexes = snap._gen.table_indexes
+        # upserts AND deletes bump a table's index (store._bump), so a
+        # table whose index hasn't advanced needs no walk at all
+        allocs_changed = table_indexes.get("allocs", 0) > since
+
+        # ---- deleted allocs: leave their groups, dirty their nodes
+        gone_ids = (
+            self._known_ids - snap._gen.allocs.keys() if allocs_changed else ()
+        )
+        for gone in gone_ids:
+            self._known_ids.discard(gone)
+            node = self._node_of.pop(gone, None)
+            if node is not None:
+                self._dirty_nodes.add(node)
+            key = self._live_key.pop(gone, None)
+            if key is not None:
+                group = self._groups.get(key)
+                if group is not None:
+                    group.discard(gone)
+                    if not group:
+                        del self._groups[key]
+
+        # ---- changed allocs: update group membership + dirty nodes
+        touched_groups: set = set()
+        for a in snap.allocs() if allocs_changed else ():
+            if a.modify_index <= since:
+                continue
+            self.objects_scanned += 1
+            self._index_check("alloc", a, latest)
+            self._known_ids.add(a.id)
+            self._node_of[a.id] = a.node_id
+            self._dirty_nodes.add(a.node_id)
+            key = (a.namespace, a.job_id, a.name)
+            old_key = self._live_key.get(a.id)
+            live = not a.terminal_status()
+            if old_key is not None and (not live or old_key != key):
+                group = self._groups.get(old_key)
+                if group is not None:
+                    group.discard(a.id)
+                    if not group:
+                        del self._groups[old_key]
+                del self._live_key[a.id]
+            if live:
+                self._live_key[a.id] = key
+                self._groups.setdefault(key, set()).add(a.id)
+                touched_groups.add(key)
+
+        for key in touched_groups:
+            group = self._groups.get(key, ())
+            if len(group) > 1:
+                ns, job_id, name = key
+                self._add(
+                    f"alloc placed twice: {len(group)} live allocs named "
+                    f"{name!r} for {ns}/{job_id}: {sorted(group)}"
+                )
+
+        # ---- changed nodes are dirty too (drain/eligibility/capacity)
+        nodes_changed = table_indexes.get("nodes", 0) > since
+        for node in snap.nodes() if nodes_changed else ():
+            if node.modify_index > since:
+                self.objects_scanned += 1
+                self._index_check("node", node, latest)
+                self._dirty_nodes.add(node.id)
+
+        # ---- allocs_fit over dirty nodes, sampled under the per-sweep cap
+        dirty = self._dirty_nodes
+        if not quiesced and len(dirty) > self.max_fit_nodes:
+            picked = set(
+                self._rng.sample(sorted(dirty), self.max_fit_nodes)
+            )
+            deferred = dirty - picked  # carried to later sweeps, not dropped
+            self.sampled_out += len(deferred - self._deferred)
+            self._deferred = deferred
+            self._dirty_nodes = deferred
+            dirty = picked
+        else:
+            self._dirty_nodes = set()
+            self._deferred = set()
+        for node_id in dirty:
+            node = snap.node_by_id(node_id)
+            if node is None:
+                continue
+            allocs = snap.allocs_by_node_terminal(node_id, False)
+            if not allocs:
+                continue
+            self.fit_checks += 1
+            fit, dimension, _ = allocs_fit(node, allocs, None, True)
+            if not fit:
+                self._add(
+                    f"node {node_id} over-committed: {dimension} "
+                    f"({len(allocs)} live allocs)"
+                )
+
+        # ---- changed evals: index checks always; terminal-state only at
+        # quiesce (in-flight evals are legitimately pending mid-storm —
+        # and the quiesced sweep must walk ALL evals, changed or not)
+        evals_changed = quiesced or table_indexes.get("evals", 0) > since
+        for ev in snap.evals() if evals_changed else ():
+            if ev.modify_index > since:
+                self.objects_scanned += 1
+                self._index_check("eval", ev, latest)
+            if quiesced and not ev.terminal_status() and not ev.should_block():
+                self._add(
+                    f"eval {ev.id} ({ev.type}, job {ev.job_id}) stuck in "
+                    f"status {ev.status!r}"
+                )
+        jobs_changed = table_indexes.get("jobs", 0) > since
+        for job in snap.jobs() if jobs_changed else ():
+            if job.modify_index > since:
+                self.objects_scanned += 1
+                self._index_check("job", job, latest)
+
+        self._last_index = latest
+        return self.violations[found_at:]
+
+    def _index_check(self, kind: str, obj, latest: int):
+        if obj.create_index > obj.modify_index:
+            self._add(
+                f"{kind} {getattr(obj, 'id', obj)}: create_index "
+                f"{obj.create_index} > modify_index {obj.modify_index}"
+            )
+        if obj.modify_index > latest:
+            self._add(
+                f"{kind} {getattr(obj, 'id', obj)}: modify_index "
+                f"{obj.modify_index} exceeds latest index {latest}"
+            )
+
+    def stats(self) -> dict:
+        return {
+            "sweeps": self.sweeps,
+            "objects_scanned": self.objects_scanned,
+            "fit_checks": self.fit_checks,
+            "sampled_out": self.sampled_out,
+            "violations": len(self.violations),
+        }

@@ -144,6 +144,8 @@ class ColumnarCluster:
         # valid for this cluster's exact node set (see build_group_planes)
         self.planes_cache: dict = {}
         # per-ask-ID dense device capacity planes (see device_plane)
+        # nta: ignore[unbounded-cache] WHY: per-cluster cache; the
+        # _SHARED_CLUSTERS byte-cap evicts whole clusters, bounding it
         self.device_planes_cache: dict = {}
 
     @classmethod

@@ -1,0 +1,31 @@
+#!/usr/bin/env sh
+# Churn-soak entry point (nomad_tpu/loadgen; README "Churn-soak load
+# plane" + PERF.md soak section). Runs the production-scale soak by
+# default and writes the scored artifact; exit 0 = every SLO passed.
+#
+#   scripts/soak.sh                        # full soak -> SOAK_r01.json
+#   scripts/soak.sh --scenario smoke       # the ~30s tier-1 storm
+#   SOAK_ALLOCS=200000 SOAK_NODES=2000 scripts/soak.sh   # scaled down
+#   scripts/soak.sh --seed 7 --print-stream              # determinism eyeball
+#
+# Scale knobs (env): SOAK_NODES, SOAK_ALLOCS, SOAK_CHURN_S,
+# SOAK_CHURN_RATE, SOAK_WORKERS, SOAK_QUIESCE_S.
+# Numbers are only comparable A/B on the same box (see PERF.md).
+set -eu
+
+cd "$(dirname "$0")/.."
+
+out=""
+for arg in "$@"; do
+  case "$arg" in
+    --out|--out=*|--print-stream|--list) out="explicit" ;;
+  esac
+done
+if [ -z "$out" ]; then
+  n=1
+  while [ -e "$(printf 'SOAK_r%02d.json' "$n")" ]; do n=$((n + 1)); done
+  set -- --out "$(printf 'SOAK_r%02d.json' "$n")" "$@"
+fi
+
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m nomad_tpu.loadgen --scenario soak "$@"

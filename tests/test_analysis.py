@@ -418,6 +418,160 @@ class TestImports:
 # ----------------------------------------------------------------------
 
 
+class TestUnboundedCache:
+    """The `_bad_http_addrs` leak class: grow-only long-lived containers
+    (nomad_tpu/analysis/growth.py)."""
+
+    def test_grow_only_instance_map_flagged(self):
+        src = (
+            "class Server:\n"
+            "    def __init__(self):\n"
+            "        self._bad_http_addrs = {}\n"
+            "    def mark(self, addr, now):\n"
+            "        self._bad_http_addrs[addr] = now\n"
+        )
+        fs = findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+        assert len(fs) == 1 and "_bad_http_addrs" in fs[0].message
+
+    def test_annotated_creation_is_seen(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._m: dict[str, int] = {}\n"
+            "    def grow(self, k):\n"
+            "        self._m[k] = 1\n"
+        )
+        assert findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+
+    def test_any_eviction_path_clears(self):
+        for shrink in (
+            "        self._m.pop(k, None)\n",
+            "        del self._m[k]\n",
+            "        self._m.clear()\n",
+            "        self._m = {}\n",
+        ):
+            src = (
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._m = {}\n"
+                "    def grow(self, k):\n"
+                "        self._m[k] = 1\n"
+                "    def evict(self, k):\n" + shrink
+            )
+            assert not findings_for(
+                {"nomad_tpu/core/x.py": src}, "unbounded-cache"
+            ), shrink
+
+    def test_startup_registration_not_flagged(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self.handlers = {}\n"
+            "    def register(self, name, fn):\n"
+            "        self.handlers[name] = fn\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/rpc/x.py": src}, "unbounded-cache"
+        )
+
+    def test_module_global_cache_flagged(self):
+        src = (
+            "CACHE = {}\n"
+            "def remember(k, v):\n"
+            "    CACHE[k] = v\n"
+        )
+        fs = findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+        assert len(fs) == 1 and "CACHE" in fs[0].message
+
+    def test_local_shadow_does_not_silence_module_global(self):
+        # a function-local `CACHE = {}` (no `global`) binds a LOCAL for
+        # that whole scope — it must not read as a shrink/rebind of the
+        # tracked module global, or the leak ships unflagged
+        src = (
+            "CACHE = {}\n"
+            "def remember(k, v):\n"
+            "    CACHE[k] = v\n"
+            "def unrelated():\n"
+            "    CACHE = {}\n"
+            "    return CACHE\n"
+        )
+        fs = findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+        assert len(fs) == 1 and "CACHE" in fs[0].message
+
+    def test_declared_global_rebind_still_counts_as_reset(self):
+        src = (
+            "CACHE = {}\n"
+            "def remember(k, v):\n"
+            "    CACHE[k] = v\n"
+            "def reset():\n"
+            "    global CACHE\n"
+            "    CACHE = {}\n"
+        )
+        fs = findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+        assert fs == []
+
+    def test_augassign_growth_is_flagged(self):
+        # `self._events += [e]` accumulates into the container — it must
+        # not read as a shrink/rebind and silence the rule
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._events = []\n"
+            "    def on_event(self, e):\n"
+            "        self._events += [e]\n"
+        )
+        fs = findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+        assert len(fs) == 1 and "_events" in fs[0].message
+
+    def test_augassign_subtract_counts_as_shrink(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._seen = set()\n"
+            "    def add(self, k):\n"
+            "        self._seen |= {k}\n"
+            "    def expire(self, old):\n"
+            "        self._seen -= old\n"
+        )
+        assert findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache") == []
+
+    def test_alias_mutation_followed_one_hop(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._m = {}\n"
+            "    def grow(self, k):\n"
+            "        m = self._m\n"
+            "        m.setdefault(k, []).append(1)\n"
+        )
+        assert findings_for({"nomad_tpu/core/x.py": src}, "unbounded-cache")
+
+    def test_scheduler_plane_out_of_scope(self):
+        src = (
+            "class PerEval:\n"
+            "    def __init__(self):\n"
+            "        self._m = {}\n"
+            "    def grow(self, k):\n"
+            "        self._m[k] = 1\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/scheduler/x.py": src}, "unbounded-cache"
+        )
+
+    def test_why_suppression_clears(self):
+        src = (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        # nta: ignore[unbounded-cache] WHY: fixture-bounded\n"
+            "        self._m = {}\n"
+            "    def grow(self, k):\n"
+            "        self._m[k] = 1\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "unbounded-cache"
+        )
+
+
 class TestFramework:
     SRC = "def f(self, snap):\n    self.x_index = snap.latest_index() + 1{}\n"
 

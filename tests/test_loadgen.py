@@ -1,0 +1,715 @@
+"""Churn-soak load plane tests (nomad_tpu/loadgen/).
+
+Three tiers:
+
+- grammar/scorekeeper units — fast, no cluster;
+- the incremental invariant checker pinned sampled == full against a
+  seeded cluster with *injected* violations;
+- the tier-1 smoke soak: a ~30s seeded mixed storm through the real
+  RPC/HTTP surface that must end quiesced with zero invariant
+  violations, bounded leak maps, and a byte-identical op stream across
+  two compiles of the same seed.
+"""
+
+import json
+import random
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import metrics
+from nomad_tpu.loadgen import compile_stream, get_scenario, named_rng
+from nomad_tpu.loadgen.grammar import World, build_job, build_node
+from nomad_tpu.loadgen.score import grade, summary_line
+from nomad_tpu.state import StateStore
+from nomad_tpu.testing.invariants import (
+    IncrementalInvariantChecker,
+    check_cluster_invariants,
+)
+
+pytestmark = pytest.mark.soak
+
+
+# ---------------------------------------------------------------------------
+# grammar: determinism + coherence
+# ---------------------------------------------------------------------------
+
+
+class TestGrammar:
+    def test_same_seed_compiles_byte_identical(self):
+        sc = get_scenario("smoke")
+        a = compile_stream(sc, 1234)
+        b = compile_stream(get_scenario("smoke"), 1234)
+        assert a.encode() == b.encode()
+        assert a.digest() == b.digest()
+
+    def test_different_seeds_differ(self):
+        sc = get_scenario("smoke")
+        assert (
+            compile_stream(sc, 1).encode() != compile_stream(sc, 2).encode()
+        )
+
+    def test_named_rng_streams_independent(self):
+        # drawing from one stream must not perturb another
+        a1 = named_rng(7, "s", "p", "x").random()
+        _ = named_rng(7, "s", "p", "y").random()
+        a2 = named_rng(7, "s", "p", "x").random()
+        assert a1 == a2
+
+    def test_stream_covers_the_storm_op_classes(self):
+        counts = compile_stream(get_scenario("smoke"), 99).counts()
+        for kind in (
+            "node.register", "node.down", "node.up", "node.drain",
+            "job.submit", "job.scale", "job.update", "job.stop",
+            "job.dispatch_register", "job.dispatch",
+        ):
+            assert counts.get(kind, 0) >= 1, (kind, counts)
+
+    def test_ops_reference_coherent_world_state(self):
+        """Every scale/update/stop references a slot that is live at that
+        point of the stream; every drain references a registered node."""
+        stream = compile_stream(get_scenario("smoke"), 31)
+        world = World()
+        for op in stream.ops:
+            if op.kind in ("job.scale", "job.update", "job.stop"):
+                slot = world.jobs.get(op.args["slot"])
+                assert slot is not None and slot.live, op.encode()
+            if op.kind in ("node.down", "node.drain"):
+                assert op.args["node"] in world.nodes, op.encode()
+            world.apply(op)
+
+    def test_build_node_is_deterministic_per_slot(self):
+        n1, n2 = build_node(5), build_node(5)
+        assert n1.id == n2.id
+        assert n1.node_resources.cpu.cpu_shares == n2.node_resources.cpu.cpu_shares
+
+    def test_build_job_carries_version_nonce_and_update_stanza(self):
+        args = {
+            "slot": 3, "category": "svc", "type": "service", "count": 2,
+            "cpu": 100, "memory_mb": 64, "version": 4,
+        }
+        job = build_job(args)
+        assert job.task_groups[0].tasks[0].env["LDG_VERSION"] == "4"
+        assert job.task_groups[0].update.max_parallel == 2
+        dsp = build_job({**args, "category": "dsp", "type": "batch"})
+        assert dsp.is_parameterized()
+
+
+# ---------------------------------------------------------------------------
+# incremental invariants: sampled == full on a seeded violating cluster
+# ---------------------------------------------------------------------------
+
+
+def _normalize(violations):
+    # both checkers sort the duplicate-name alloc ids, so messages are
+    # compared whole — any divergence in the id lists fails the pin
+    return set(violations)
+
+
+class TestIncrementalInvariants:
+    def _mk_alloc(self, job, node, name, cpu=100, mem=64):
+        from nomad_tpu.structs.model import (
+            AllocatedCpuResources,
+            AllocatedMemoryResources,
+            AllocatedResources,
+            AllocatedSharedResources,
+            AllocatedTaskResources,
+            Allocation,
+            generate_uuid,
+        )
+
+        return Allocation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            job_id=job.id,
+            job=job,
+            node_id=node.id,
+            name=name,
+            task_group="web",
+            allocated_resources=AllocatedResources(
+                tasks={
+                    "web": AllocatedTaskResources(
+                        cpu=AllocatedCpuResources(cpu_shares=cpu),
+                        memory=AllocatedMemoryResources(memory_mb=mem),
+                    )
+                },
+                shared=AllocatedSharedResources(disk_mb=10),
+            ),
+            desired_status="run",
+            client_status="running",
+        )
+
+    def test_sampled_equals_full_on_seeded_cluster(self):
+        rng = random.Random(4711)
+        state = StateStore()
+        nodes = []
+        for _ in range(24):
+            n = mock.node()
+            n.node_resources.cpu.cpu_shares = 2000
+            n.node_resources.memory.memory_mb = 4096
+            n.node_resources.networks = []
+            nodes.append(n)
+        state.upsert_nodes(None, nodes)
+        job = mock.job()
+        state.upsert_job(None, job)
+        job = state.job_by_id(job.namespace, job.id)
+
+        # tiny per-sweep cap so sampling + dirty-carryover really engage
+        checker = IncrementalInvariantChecker(state, max_fit_nodes=3, seed=1)
+
+        # interleave writes and sweeps: healthy churn + three violation
+        # classes (duplicate name, over-commit, stuck eval)
+        for round_no in range(8):
+            batch = []
+            for i in range(rng.randint(3, 9)):
+                node = nodes[rng.randrange(len(nodes))]
+                batch.append(
+                    self._mk_alloc(job, node, f"{job.id}.web[{round_no}-{i}]")
+                )
+            if round_no == 3:  # duplicate live name on two nodes
+                batch.append(self._mk_alloc(job, nodes[0], "dup.web[0]"))
+                batch.append(self._mk_alloc(job, nodes[1], "dup.web[0]"))
+            if round_no == 5:  # blow past node 2's cpu
+                for _ in range(4):
+                    batch.append(
+                        self._mk_alloc(
+                            job, nodes[2], f"fat.web[{rng.random()}]",
+                            cpu=900,
+                        )
+                    )
+            state.upsert_allocs(None, batch)
+            checker.check()
+
+        # a stuck eval (pending, not blocked) — a quiesce-time violation
+        ev = mock.evaluation()
+        ev.status = "pending"
+        state.upsert_evals(None, [ev])
+
+        # terminal-ize one of the duplicate pair: the group must shrink
+        # (an incremental checker that only ever adds members would
+        # over-report)
+        dup = [
+            a for a in state.allocs()
+            if a.name == "dup.web[0]" and not a.terminal_status()
+        ]
+        fixed = dup[0].copy()
+        fixed.client_status = "failed"
+        state.upsert_allocs(None, [fixed])
+        checker.check()
+
+        final_new = checker.check(quiesced=True)
+        full = check_cluster_invariants(state)
+        assert _normalize(checker.violations) >= _normalize(full)
+        # everything still true at quiesce is in the final sweep too
+        assert _normalize(full) <= _normalize(checker.violations)
+        # the one-member dup group is no longer a CURRENT violation
+        assert not any("placed twice" in v for v in full) or any(
+            "placed twice" in v for v in checker.violations
+        )
+        assert checker.stats()["sweeps"] >= 9
+        assert final_new is not None
+
+    def test_clean_cluster_stays_clean_and_cheap(self):
+        state = StateStore()
+        n = mock.node()
+        n.node_resources.networks = []
+        state.upsert_node(None, n)
+        job = mock.job()
+        state.upsert_job(None, job)
+        checker = IncrementalInvariantChecker(state)
+        assert checker.check() == []
+        scanned_once = checker.objects_scanned
+        # no writes since: the sweep must be a no-op (index-keyed)
+        assert checker.check() == []
+        assert checker.objects_scanned == scanned_once
+        assert check_cluster_invariants(state) == []
+
+    def test_deletion_is_observed(self):
+        """Allocs removed from the table (eval GC) leave their duplicate
+        groups instead of haunting them."""
+        state = StateStore()
+        node = mock.node()
+        node.node_resources.networks = []
+        state.upsert_node(None, node)
+        job = mock.job()
+        state.upsert_job(None, job)
+        job = state.job_by_id(job.namespace, job.id)
+        a1 = self._mk_alloc(job, node, "x.web[0]")
+        a2 = self._mk_alloc(job, node, "x.web[0]")
+        ev = mock.evaluation()
+        a1.eval_id = a2.eval_id = ev.id
+        state.upsert_evals(None, [ev])
+        state.upsert_allocs(None, [a1, a2])
+        checker = IncrementalInvariantChecker(state)
+        new = checker.check()
+        assert any("placed twice" in v for v in new)
+        # GC both: the group must empty out, not report again
+        state.delete_evals(None, [ev.id], [a1.id, a2.id])
+        checker.check(quiesced=True)
+        assert not check_cluster_invariants(state)
+        assert not checker._groups.get((job.namespace, job.id, "x.web[0]"))
+
+
+# ---------------------------------------------------------------------------
+# scorekeeper units
+# ---------------------------------------------------------------------------
+
+
+class TestGrading:
+    def _report(self, **over):
+        rep = {
+            "invariants": {"violations": 0},
+            "rss_tail_slope_mb_per_min": 3.0,
+            "rss_peak_mb": 900.0,
+            "eval_e2e_p99_ms_max": 120.0,
+            "subscriber_lag_max": 10,
+            "driver": {"fired": 100, "failed": 0, "shed": 0},
+        }
+        rep.update(over)
+        return rep
+
+    def test_all_pass(self):
+        slo = grade(
+            self._report(),
+            {"max_invariant_violations": 0, "max_op_failure_rate": 0.02},
+        )
+        assert slo["failed"] == 0 and slo["score"] == 1.0
+
+    def test_violation_fails_and_unknown_key_fails_closed(self):
+        slo = grade(
+            self._report(invariants={"violations": 2}),
+            {"max_invariant_violations": 0, "max_frobnication": 1},
+        )
+        assert not slo["checks"]["max_invariant_violations"]["pass"]
+        assert not slo["checks"]["max_frobnication"]["pass"]
+
+    def test_summary_line_carries_the_headline_numbers(self):
+        report = {
+            "scenario": "smoke", "seed": 9,
+            "driver": {"fired": 10, "ok": 10, "failed": 0, "shed": 0},
+            "final_state": {"allocs": 5, "nodes": 3},
+            "invariants": {"violations": 0, "sweeps": 4},
+            "rss_peak_mb": 500.0, "rss_tail_slope_mb_per_min": 1.0,
+            "eval_e2e_p99_ms_max": 50.0, "subscriber_lag_max": 0,
+            "slo": {"passed": 5, "failed": 0, "score": 1.0},
+            "stream_digest": "ab" * 32,
+        }
+        line = summary_line(report)
+        assert line.startswith("SOAK_SUMMARY ")
+        for key in (
+            "invariant_violations=0", "rss_peak_mb=500.0", "slo=5/5",
+            "scenario=smoke",
+        ):
+            assert key in line, line
+
+
+# ---------------------------------------------------------------------------
+# leak regressions (the unbounded-growth classes the soak's RSS audit is
+# built to catch; each was a real grow-only map before this PR)
+# ---------------------------------------------------------------------------
+
+
+class TestLeakRegressions:
+    def test_blocked_evals_unblock_indexes_prune(self):
+        from nomad_tpu.core.blocked_evals import BlockedEvals
+
+        class _Broker:
+            def enqueue(self, ev):
+                pass
+
+        b = BlockedEvals(_Broker())
+        b.set_enabled(True)
+        b.PRUNE_INTERVAL = 0.0  # prune eligibility on every call
+        b.PRUNE_THRESHOLD = 0.0  # every pre-existing entry is stale
+        for i in range(500):
+            b.unblock_node(f"node-{i}", i + 1)
+            b.unblock(f"class-{i}", i + 1)
+        # the maps hold only entries younger than the threshold — with a
+        # zero threshold that is just the entry the current call wrote
+        assert len(b._node_unblock_indexes) <= 1
+        assert len(b._unblock_indexes) <= 1
+        # and flush forgets leadership-scoped index state entirely
+        b.unblock_node("node-x", 1000)
+        b.flush()
+        assert not b._node_unblock_indexes and not b._unblock_indexes
+        assert not b._unblock_at and not b._node_unblock_at
+
+    def test_blocked_evals_prune_keeps_fresh_entries(self):
+        from nomad_tpu.core.blocked_evals import BlockedEvals
+
+        class _Broker:
+            def enqueue(self, ev):
+                pass
+
+        b = BlockedEvals(_Broker())
+        b.set_enabled(True)
+        b.PRUNE_INTERVAL = 0.0
+        # default 15-minute threshold: nothing here is stale, nothing
+        # may be dropped — pruning must never eat live signal
+        for i in range(50):
+            b.unblock_node(f"node-{i}", i + 1)
+        assert len(b._node_unblock_indexes) == 50
+
+    def test_periodic_gen_map_bounded_under_job_churn(self):
+        from nomad_tpu.core.periodic import PeriodicDispatch
+
+        class _Server:
+            def attach_periodic(self, p):
+                pass
+
+        pd = PeriodicDispatch(_Server())
+        pd._enabled = True  # track without spinning the loop thread
+        # the FSM calls add() for EVERY job apply; non-periodic jobs fall
+        # through to remove() — which used to mint a _gen entry per job
+        # id forever
+        for i in range(5000):
+            job = mock.job()
+            job.id = f"churn-{i}"
+            pd.add(job)  # non-periodic -> remove() path
+        assert len(pd._gen) <= 2 * len(pd._tracked) + 64 + 1
+        pd.set_enabled(False)
+        assert not pd._gen
+
+    def test_docker_pull_locks_evicted_with_image(self):
+        from nomad_tpu.drivers.docker import ImageCoordinator
+
+        class _Driver:
+            def _run(self, *a, **kw):
+                class R:
+                    returncode = 0
+                    stderr = ""
+                return R()
+
+        coord = ImageCoordinator(_Driver(), remove_delay=0.0)
+        for i in range(100):
+            img = f"img-{i}"
+            coord.acquire(img, "c0")
+            coord.release(img, "c0")
+            coord._remove(img)  # what the (cancelled-in-test) timer runs
+        assert not coord._pulls, "per-image pull locks must die with the image"
+        assert not coord._refs
+
+    def test_docker_pull_lock_eviction_cannot_skip_presence_check(self):
+        """Evicting the per-image pull lock must not let a later acquirer
+        serialize on the replacement lock, see a non-empty ref set from a
+        waiter that is still mid-pull under the STALE lock, and return
+        while the image does not exist: a waiter that wakes on an evicted
+        lock has to detect the swap and restart on the live one."""
+        import threading
+
+        from nomad_tpu.drivers.docker import ImageCoordinator
+
+        pull_gate = threading.Event()
+        pull_started = threading.Event()
+
+        class _Driver:
+            def __init__(self):
+                self.present = False
+                self.pulls = 0
+
+            def _run(self, *args, **kw):
+                class R:
+                    returncode = 0
+                    stderr = ""
+
+                if args[0] == "pull":
+                    pull_started.set()
+                    pull_gate.wait(10)
+                    self.pulls += 1
+                    self.present = True
+                elif args[:2] == ("image", "inspect"):
+                    R.returncode = 0 if self.present else 1
+                return R()
+
+        driver = _Driver()
+        coord = ImageCoordinator(driver, remove_delay=0.0)
+        # stage the race _remove leaves behind: T2 is parked on the
+        # per-image lock (held here, standing in for _remove's rmi
+        # critical section) when the map entry gets evicted under it
+        with coord._lock:
+            stale = coord._pulls.setdefault("img", threading.Lock())
+        stale.acquire()
+        t2 = threading.Thread(target=coord.acquire, args=("img", "t2"))
+        t2.start()
+        time.sleep(0.1)  # let t2 grab the stale reference and park on it
+        with coord._lock:
+            del coord._pulls["img"]  # what _remove does after rmi
+        stale.release()
+        assert pull_started.wait(5), "woken waiter must restart the pull"
+        # T3 arrives while T2's pull is in flight on the REPLACEMENT
+        # lock: it must block until the image exists, never return early
+        t3 = threading.Thread(target=coord.acquire, args=("img", "t3"))
+        t3.start()
+        t3.join(0.3)
+        assert t3.is_alive(), "acquire returned while the image was absent"
+        pull_gate.set()
+        t2.join(5)
+        t3.join(5)
+        assert not t2.is_alive() and not t3.is_alive()
+        assert driver.present and driver.pulls == 1
+        assert coord._refs["img"] == {"t2", "t3"}
+
+    def test_heartbeat_timers_do_not_spawn_threads(self):
+        """One threading.Timer per node = one OS THREAD per node for the
+        whole TTL; the 10K-node soak ramp died at the environment's
+        ~4K-thread cap before this rode the shared timer wheel. A node
+        fleet must not move the process thread count."""
+        import threading
+
+        from nomad_tpu.core.server import Server
+
+        server = Server({"seed": 42, "heartbeat_ttl": 3600.0})
+        server.start(num_workers=0)
+        try:
+            baseline = threading.active_count()
+            for i in range(200):
+                n = mock.node()
+                n.id = f"hb-{i:04d}-{n.id[8:]}"
+                server.node_register(n)
+            assert len(server._heartbeat_timers) == 200
+            # the wheel is ONE thread, and node events may lazily start a
+            # few other singletons — but 200 tracked nodes must not add
+            # anywhere near 200 threads
+            assert threading.active_count() <= baseline + 8
+            # deregister cancels the handle and forgets the node
+            some_id = next(iter(server._heartbeat_timers))
+            server.node_deregister(some_id)
+            assert some_id not in server._heartbeat_timers
+        finally:
+            server.stop()
+        assert not server._heartbeat_timers
+
+    def test_eval_e2e_tap_samples_on_ack(self):
+        from nomad_tpu.core.broker import EvalBroker
+
+        metrics.reset()
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        got, token = b.dequeue([ev.type], timeout=1.0)
+        assert got.id == ev.id
+        b.ack(ev.id, token)
+        snap = metrics.snapshot()
+        assert snap["timers"].get("eval.e2e", {}).get("count", 0) == 1
+        assert not b._enqueue_t, "tap state must not outlive the eval"
+
+
+class TestDriverCancellation:
+    def test_stop_cancels_saturated_pacer(self):
+        """Under backlog every remaining op is past due (delay <= 0), so
+        the pacer's sleep never runs — cancellation must be observed per
+        op or a stopped storm fires its whole compiled stream anyway."""
+        import threading
+
+        from nomad_tpu.loadgen.driver import StormDriver
+
+        stream = compile_stream(get_scenario("smoke"), 7)
+        d = StormDriver(
+            stream, rpc_servers=[], http_address="", workers=0,
+            time_scale=0.0,  # everything past due: the sleep path is dead
+        )
+        d.stop()
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.update(r=d.run()), daemon=True
+        )
+        th.start()
+        th.join(5)
+        assert not th.is_alive(), "cancelled run did not return"
+        rep = out["r"]
+        assert rep.fired == 0, "cancelled storm fired ops"
+
+
+# ---------------------------------------------------------------------------
+# plan-commit indeterminacy (the over-commit class the first full-scale
+# soak surfaced)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanCommitIndeterminacy:
+    """A raft apply that times out has already stored its entry — it may
+    still commit seconds later. The applier must NOT treat the timeout as
+    "nothing happened": the next batch would be verified against snapshots
+    missing the in-flight entry, double-booking its capacity when it lands
+    (at full scale, raft-apply p99 ran ~4x the 10s apply timeout and the
+    soak ended with hundreds of nodes over cpu capacity)."""
+
+    @staticmethod
+    def _mk_plan(store, job, tag, ncpu, count):
+        from nomad_tpu.structs.model import Plan
+
+        plan = Plan()
+        plan.priority = 50
+        plan.eval_id = ""
+        plan.snapshot_index = store.latest_index()
+        allocs = []
+        for i in range(count):
+            a = mock.alloc()
+            a.id = f"{tag}-{i}"
+            a.name = f"{job.id}.web[{tag}-{i}]"
+            a.node_id = "n-0"
+            a.job_id = job.id
+            a.job = job
+            for t in a.allocated_resources.tasks.values():
+                t.cpu.cpu_shares = ncpu
+                t.memory.memory_mb = 1
+                t.networks = []
+            a.allocated_resources.shared.networks = []
+            allocs.append(a)
+        plan.node_allocation["n-0"] = allocs
+        return plan
+
+    def test_timed_out_commit_cannot_double_book(self):
+        import threading
+
+        from nomad_tpu.core.plan_apply import Planner
+        from nomad_tpu.raft import ApplyTimeout
+        from nomad_tpu.structs.funcs import allocs_fit
+
+        store = StateStore()
+        node = mock.node()
+        node.id = "n-0"
+        node.node_resources.cpu.cpu_shares = 1000
+        node.node_resources.memory.memory_mb = 100000
+        node.node_resources.networks = []
+        store.upsert_node(1, node)
+        job = mock.job()
+        job.id = "j-indet"
+        store.upsert_job(2, job)
+
+        planner = Planner(store)
+        applied = threading.Event()
+        commit_started = threading.Event()
+        first = {"pending": None}
+
+        def commit_batch_fn(items):
+            if first["pending"] is None:
+                # the raft apply-timeout contract: the entry is in the
+                # log and WILL apply — just not before the wait expires
+                first["pending"] = items
+                commit_started.set()
+
+                def late_apply():
+                    time.sleep(0.5)
+                    for plan, result, pevals in items:
+                        store.upsert_plan_results(None, plan, result)
+                    applied.set()
+
+                threading.Thread(target=late_apply, daemon=True).start()
+                raise ApplyTimeout(store.latest_index() + 1)
+            index = None
+            for plan, result, pevals in items:
+                index = store.upsert_plan_results(None, plan, result)
+            return store.latest_index()
+
+        def barrier_fn(exc):
+            # a barrier commits behind the in-flight entry: it cannot
+            # apply before the entry does (same term throughout, so the
+            # log-matching proof holds)
+            assert exc.raft_index
+            assert applied.wait(10), "barrier outran the in-flight entry"
+
+        planner.commit_batch_fn = commit_batch_fn
+        planner.commit_fn = None
+        planner.barrier_fn = barrier_fn
+        planner.start()
+        try:
+            # plan A: 600/1000 cpu — fits; its commit "times out" but the
+            # entry lands ~0.5s later
+            pa = planner.queue.enqueue(self._mk_plan(store, job, "a", 100, 6))
+            assert commit_started.wait(5)
+            # plan B: another 600 cpu — must see A's usage once A resolves
+            pb = planner.queue.enqueue(self._mk_plan(store, job, "b", 100, 6))
+            ra, ea = pa.wait(timeout=10)
+            rb, eb = pb.wait(timeout=10)
+            assert ea is None and ra is not None, f"plan A failed: {ea}"
+            assert eb is None and rb is not None, f"plan B failed: {eb}"
+            # B must have been rejected (refresh) — committing it would
+            # put 1200 cpu on a 1000-share node
+            assert rb.refresh_index, "conflicting plan committed"
+            snap = store.snapshot()
+            live = snap.allocs_by_node_terminal("n-0", False)
+            fit, dim, used = allocs_fit(node, live, None, True)
+            assert fit, (
+                f"node over-committed after timed-out commit resolution: "
+                f"{dim}, {used.flattened.cpu.cpu_shares}/1000 cpu "
+                f"({len(live)} live allocs)"
+            )
+        finally:
+            planner.stop()
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke soak: real RPC/HTTP surface, ~30s
+# ---------------------------------------------------------------------------
+
+
+class TestSmokeStorm:
+    def test_smoke_storm_clean_invariants_and_bounded_growth(self, tmp_path):
+        from nomad_tpu.loadgen.runner import run_scenario
+
+        scenario = get_scenario("smoke")
+        stream = compile_stream(scenario, 20260803)
+        # the determinism acceptance: byte-identical op streams from the
+        # same seed (fresh scenario object, fresh compile)
+        again = compile_stream(get_scenario("smoke"), 20260803)
+        assert stream.encode() == again.encode()
+
+        seen = {}
+
+        def inspect(server, report):
+            # leak maps bounded under the storm (regression tie-in):
+            # these are keyed by node id / job id and the storm churned
+            # both — growth must stay in the same order as the fleet
+            seen["node_unblock"] = len(
+                server.blocked_evals._node_unblock_indexes
+            )
+            seen["periodic_gen"] = len(server.periodic._gen)
+            seen["tracked"] = len(server.periodic._tracked)
+            seen["full_check"] = server.state and check_cluster_invariants(
+                server.state
+            )
+
+        out = tmp_path / "SOAK_smoke.json"
+        report = run_scenario(
+            scenario, 20260803, out=str(out), driver_workers=6,
+            inspect=inspect,
+        )
+
+        # ---- the storm really ran against the cluster
+        assert report["driver"]["fired"] >= 200
+        assert report["driver"]["shed"] == 0
+        fired = report["driver"]["fired"]
+        assert report["driver"]["failed"] / fired <= 0.02, report["driver"][
+            "errors"
+        ]
+        assert report["final_state"].get("nodes", 0) >= 40
+        assert report["quiesced"], "cluster failed to quiesce after storm"
+
+        # ---- continuous + final invariants all clean (the acceptance)
+        assert report["invariants"]["violations"] == 0, report["invariants"][
+            "violation_log"
+        ]
+        assert report["invariants"]["sweeps"] >= 3
+        assert seen["full_check"] == [], seen["full_check"]
+
+        # ---- bounded growth: leak maps stay fleet-sized
+        assert seen["node_unblock"] <= 200
+        assert seen["periodic_gen"] <= 2 * seen["tracked"] + 65
+
+        # ---- subscriber probes actually rode the stream
+        assert report["subscriber_frames"] > 0
+
+        # ---- artifact written with the scored shape
+        data = json.loads(out.read_text())
+        for key in (
+            "samples", "slo", "stream_digest", "rss_peak_mb", "driver",
+            "invariants",
+        ):
+            assert key in data, key
+        assert data["stream_digest"] == stream.digest()
+        assert summary_line(report).startswith("SOAK_SUMMARY ")
+        # the overall SLO verdict of the tier-1 storm must be green
+        assert report["slo"]["failed"] == 0, report["slo"]

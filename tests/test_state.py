@@ -311,3 +311,469 @@ class TestDeployments:
         s.upsert_deployment(1, d1)
         s.upsert_deployment(2, d2)
         assert s.latest_deployment_by_job_id(j.namespace, j.id).id == d2.id
+
+
+# ---------------------------------------------------------------------------
+# state_store_test.go corpus port (slice): the upsert/delete/index-
+# monotonicity semantics the churn soak's storm leans on. Each class maps
+# to a family of reference tests (named in the docstrings).
+# ---------------------------------------------------------------------------
+
+
+class TestNodeCorpus:
+    """ref TestStateStore_UpsertNode_Node / _DeleteNode / _UpdateNodeDrain /
+    _UpdateNodeEligibility / _AddSingleNodeEvent."""
+
+    def test_register_emits_node_event(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1000, n)
+        got = s.node_by_id(n.id)
+        assert [e["message"] for e in got.events] == ["Node registered"]
+        s.upsert_node(1001, n)
+        got = s.node_by_id(n.id)
+        assert [e["message"] for e in got.events] == [
+            "Node registered",
+            "Node re-registered",
+        ]
+
+    def test_node_event_ring_is_bounded(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        for i in range(2, 2 + 3 * StateStore.MAX_NODE_EVENTS):
+            s.update_node_status(i, n.id, "ready" if i % 2 else "down")
+        got = s.node_by_id(n.id)
+        assert len(got.events) == StateStore.MAX_NODE_EVENTS
+        # the ring keeps the newest events, oldest dropped
+        assert got.events[-1]["message"].startswith("Node status changed")
+
+    def test_delete_node(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1000, n)
+        s.delete_node(1001, n.id)
+        assert s.node_by_id(n.id) is None
+        assert s.table_index("nodes") == 1001
+        # deleting an already-GC'd node is an idempotent raft replay, not
+        # an error — but the index must still land
+        s.delete_node(1002, n.id)
+        assert s.table_index("nodes") == 1002
+
+    def test_update_missing_node_raises(self):
+        s = StateStore()
+        with pytest.raises(KeyError):
+            s.update_node_status(1, "nope", "down")
+        with pytest.raises(KeyError):
+            s.update_node_drain(2, "nope", True)
+        with pytest.raises(KeyError):
+            s.update_node_eligibility(3, "nope", "eligible")
+
+    def test_drain_strategy_round_trip(self):
+        from nomad_tpu.structs.model import DrainStrategy
+
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        strategy = DrainStrategy(deadline=5_000_000_000)
+        s.update_node_drain(2, n.id, True, strategy=strategy)
+        got = s.node_by_id(n.id)
+        assert got.drain is True
+        assert got.drain_strategy == strategy
+        assert got.scheduling_eligibility == "ineligible"
+        assert got.modify_index == 2 and got.create_index == 1
+        # drain completion clears the strategy but NOT eligibility...
+        s.update_node_drain(3, n.id, False)
+        got = s.node_by_id(n.id)
+        assert got.drain is False and got.drain_strategy is None
+        assert got.scheduling_eligibility == "ineligible"
+        # ...unless the caller explicitly re-marks eligible
+        s.update_node_drain(4, n.id, True, strategy=strategy)
+        s.update_node_drain(5, n.id, False, mark_eligible=True)
+        assert s.node_by_id(n.id).scheduling_eligibility == "eligible"
+
+    def test_drain_survives_reregistration(self):
+        from nomad_tpu.structs.model import DrainStrategy
+
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        strategy = DrainStrategy(deadline=9)
+        s.update_node_drain(2, n.id, True, strategy=strategy)
+        # client restart re-registers: drain + strategy + eligibility must
+        # all survive or the drainer loses its force deadline
+        s.upsert_node(3, n)
+        got = s.node_by_id(n.id)
+        assert got.drain is True
+        assert got.drain_strategy == strategy
+        assert got.scheduling_eligibility == "ineligible"
+
+    def test_eligibility_toggle(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        s.update_node_eligibility(2, n.id, "ineligible")
+        assert s.node_by_id(n.id).scheduling_eligibility == "ineligible"
+        s.update_node_eligibility(3, n.id, "eligible")
+        assert s.node_by_id(n.id).scheduling_eligibility == "eligible"
+
+    def test_node_by_prefix(self):
+        s = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        n1.id = "aaaa-1111"
+        n2.id = "aabb-2222"
+        s.upsert_nodes(1, [n1, n2])
+        assert {n.id for n in s.node_by_prefix("aa")} == {n1.id, n2.id}
+        assert [n.id for n in s.node_by_prefix("aaaa")] == [n1.id]
+        assert s.node_by_prefix("zz") == []
+
+
+class TestJobCorpus:
+    """ref TestStateStore_UpsertJob_Job / _UpdateUpsertJob_Job /
+    _DeleteJob_Job / upsertJobVersion retention."""
+
+    def test_version_history_capped(self):
+        from nomad_tpu.state.store import JOB_TRACKED_VERSIONS
+
+        s = StateStore()
+        j = mock.job()
+        total = JOB_TRACKED_VERSIONS + 4
+        for i in range(total):
+            jv = j.copy()
+            jv.priority = 50 + i
+            s.upsert_job(1000 + i, jv)
+        versions = s.job_versions(j.namespace, j.id)
+        assert len(versions) == JOB_TRACKED_VERSIONS
+        # newest first, contiguous, ending at the latest version
+        assert [v.version for v in versions] == list(
+            range(total - 1, total - 1 - JOB_TRACKED_VERSIONS, -1)
+        )
+        # the pruned oldest versions are really gone
+        assert s.job_by_id_and_version(j.namespace, j.id, 0) is None
+        got = s.job_by_id_and_version(j.namespace, j.id, total - 1)
+        assert got is not None and got.priority == 50 + total - 1
+
+    def test_keep_version_upsert(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1000, j)
+        # callers of keep_version re-submit the STORED job (deployment
+        # promotion, periodic children) — version fields ride the payload
+        j2 = s.job_by_id(j.namespace, j.id).copy()
+        j2.stable = True
+        s.upsert_job(1001, j2, keep_version=True)
+        got = s.job_by_id(j.namespace, j.id)
+        # a stability flip is not a new version: version and
+        # job_modify_index hold, modify_index advances
+        assert got.version == 0
+        assert got.job_modify_index == 1000
+        assert got.modify_index == 1001
+
+    def test_delete_missing_job_raises(self):
+        s = StateStore()
+        with pytest.raises(KeyError):
+            s.delete_job(1, "default", "nope")
+
+    def test_delete_clears_versions_summary_launch(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1000, j)
+        s.upsert_job(1001, j.copy())
+        s.upsert_periodic_launch(1002, j.namespace, j.id, 12345)
+        assert s.periodic_launch_by_id(j.namespace, j.id) is not None
+        s.delete_job(1003, j.namespace, j.id)
+        assert s.job_by_id(j.namespace, j.id) is None
+        assert s.job_versions(j.namespace, j.id) == []
+        assert s.job_summary_by_id(j.namespace, j.id) is None
+        assert s.periodic_launch_by_id(j.namespace, j.id) is None
+        for table in ("jobs", "job_summary", "job_version", "periodic_launch"):
+            assert s.table_index(table) == 1003, table
+
+
+class TestEvalCorpus:
+    """ref TestStateStore_UpsertEvals_Eval / _Update / _DeleteEval_Eval."""
+
+    def test_update_preserves_create_index(self):
+        s = StateStore()
+        e = mock.evaluation()
+        s.upsert_evals(1000, [e])
+        e2 = e.copy()
+        e2.status = "complete"
+        s.upsert_evals(1001, [e2])
+        got = s.eval_by_id(e.id)
+        assert got.status == "complete"
+        assert got.create_index == 1000 and got.modify_index == 1001
+        assert s.table_index("evals") == 1001
+
+    def test_delete_evals_removes_evals_and_allocs(self):
+        s = StateStore()
+        a = mock.alloc()
+        e = mock.evaluation()
+        a.eval_id = e.id
+        s.upsert_job(1, a.job)
+        s.upsert_evals(2, [e])
+        s.upsert_allocs(3, [a])
+        s.delete_evals(4, [e.id], [a.id])
+        assert s.eval_by_id(e.id) is None
+        assert s.alloc_by_id(a.id) is None
+        assert s.allocs_by_eval(e.id) == []
+        assert s.table_index("evals") == 4
+        assert s.table_index("allocs") == 4
+        # GC replay with already-collected ids is idempotent
+        s.delete_evals(5, [e.id, "ghost"], [a.id, "ghost"])
+        assert s.table_index("evals") == 5
+
+    def test_evals_by_job(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        evals = []
+        for _ in range(3):
+            e = mock.evaluation()
+            e.job_id = j.id
+            e.namespace = j.namespace
+            evals.append(e)
+        s.upsert_evals(2, evals)
+        assert {e.id for e in s.evals_by_job(j.namespace, j.id)} == {
+            e.id for e in evals
+        }
+
+
+class TestAllocCorpus:
+    """ref TestStateStore_UpsertAlloc_Alloc / _UpdateAlloc_Alloc /
+    _UpdateAllocsFromClient."""
+
+    def test_update_preserves_create_and_task_states(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        s.upsert_allocs(2, [a])
+        # client reports task states
+        up = a.copy()
+        up.client_status = "running"
+        up.task_states = {"web": {"state": "running"}}
+        s.update_allocs_from_client(3, [up])
+        # scheduler re-upsert (e.g. desired-status flip) must not clobber
+        # the client-owned task states or client status
+        sched = a.copy()
+        sched.desired_status = "stop"
+        s.upsert_allocs(4, [sched])
+        got = s.alloc_by_id(a.id)
+        assert got.desired_status == "stop"
+        assert got.client_status == "running"
+        assert got.task_states == {"web": {"state": "running"}}
+        assert got.create_index == 2
+        assert got.modify_index == 4 and got.alloc_modify_index == 4
+
+    def test_client_update_for_unknown_alloc_is_skipped(self):
+        """A status update racing alloc GC applies as a no-op — but the
+        raft index still lands so min-index waiters progress."""
+        s = StateStore()
+        ghost = mock.alloc()
+        s.update_allocs_from_client(7, [ghost])
+        assert s.alloc_by_id(ghost.id) is None
+        assert s.latest_index() == 7
+
+    def test_previous_allocation_back_link(self):
+        s = StateStore()
+        a1 = mock.alloc()
+        s.upsert_job(1, a1.job)
+        a1.job = s.job_by_id(a1.namespace, a1.job_id)
+        s.upsert_allocs(2, [a1])
+        a2 = mock.alloc()
+        a2.job = a1.job
+        a2.job_id = a1.job_id
+        a2.namespace = a1.namespace
+        a2.previous_allocation = a1.id
+        s.upsert_allocs(3, [a2])
+        prev = s.alloc_by_id(a1.id)
+        assert prev.next_allocation == a2.id
+        assert prev.modify_index == 3 and prev.create_index == 2
+
+
+class TestIndexMonotonicity:
+    """The property the churn soak's continuous invariant sweep keys on:
+    under arbitrary interleaved churn, (a) the store index never moves
+    backwards, (b) per-table indexes never exceed the store index, (c) no
+    object's modify_index precedes its create_index or exceeds its
+    table's index (ref state_store_test.go Index assertions, folded into
+    one seeded property)."""
+
+    def _assert_invariants(self, s, floor):
+        latest = s.latest_index()
+        assert latest >= floor
+        snap = s.snapshot()
+        for table, idx in snap._gen.table_indexes.items():
+            assert idx <= latest, (table, idx, latest)
+        tables = {
+            "nodes": list(snap.nodes()),
+            "jobs": list(snap.jobs()),
+            "evals": list(snap.evals()),
+            "allocs": list(snap.allocs()),
+        }
+        for table, objs in tables.items():
+            tidx = snap.table_index(table)
+            for o in objs:
+                assert o.create_index <= o.modify_index, (table, o.id)
+                assert o.modify_index <= tidx, (table, o.id, tidx)
+        return latest
+
+    def test_seeded_churn_keeps_indexes_monotone(self):
+        import random as _random
+
+        rng = _random.Random(20260803)
+        s = StateStore()
+        nodes, jobs, evals, allocs = [], [], [], []
+        floor = 0
+        for _ in range(160):
+            roll = rng.random()
+            if roll < 0.2 or not nodes:
+                n = mock.node()
+                s.upsert_node(None, n)
+                nodes.append(n)
+            elif roll < 0.35 or not jobs:
+                j = mock.job()
+                s.upsert_job(None, j)
+                jobs.append(j)
+            elif roll < 0.5:
+                j = rng.choice(jobs).copy()
+                j.priority = rng.randint(1, 100)
+                s.upsert_job(None, j)
+            elif roll < 0.6:
+                e = mock.evaluation()
+                e.job_id = rng.choice(jobs).id
+                s.upsert_evals(None, [e])
+                evals.append(e)
+            elif roll < 0.75:
+                j = rng.choice(jobs)
+                a = mock.alloc()
+                a.job = s.job_by_id(j.namespace, j.id)
+                a.job_id = j.id
+                a.namespace = j.namespace
+                a.node_id = rng.choice(nodes).id
+                s.upsert_allocs(None, [a])
+                allocs.append(a)
+            elif roll < 0.85 and allocs:
+                up = rng.choice(allocs).copy()
+                up.client_status = rng.choice(
+                    ["running", "complete", "failed"]
+                )
+                s.update_allocs_from_client(None, [up])
+            elif roll < 0.92 and evals:
+                e = evals.pop(rng.randrange(len(evals)))
+                dead = [a.id for a in allocs if a.eval_id == e.id]
+                allocs = [a for a in allocs if a.eval_id != e.id]
+                s.delete_evals(None, [e.id], dead)
+            elif nodes:
+                n = nodes.pop(rng.randrange(len(nodes)))
+                s.delete_node(None, n.id)
+            floor = self._assert_invariants(s, floor)
+
+    def test_auto_index_allocation_is_strictly_increasing(self):
+        s = StateStore()
+        seen = []
+        for _ in range(10):
+            s.upsert_node(None, mock.node())
+            seen.append(s.latest_index())
+        assert seen == sorted(set(seen))
+        assert seen[-1] - seen[0] == 9
+
+
+class TestSummaryReconcile:
+    """ref TestStateStore_ReconcileJobSummary: after arbitrary alloc
+    churn, the incrementally-maintained summaries must equal a from-
+    scratch rebuild — the exact repair contract behind
+    /v1/system/reconcile/summaries."""
+
+    def _counts(self, summary):
+        return {
+            tg: (v.starting, v.running, v.complete, v.failed, v.lost)
+            for tg, v in summary.summary.items()
+        }
+
+    def test_incremental_equals_rebuild_after_churn(self):
+        import random as _random
+
+        rng = _random.Random(99)
+        s = StateStore()
+        jobs = []
+        for _ in range(4):
+            j = mock.job()
+            s.upsert_job(None, j)
+            jobs.append(s.job_by_id(j.namespace, j.id))
+        allocs = []
+        for _ in range(60):
+            j = rng.choice(jobs)
+            if allocs and rng.random() < 0.5:
+                # terminal client states are absorbing: pick a live alloc
+                # (the incremental path, like the reference
+                # updateSummaryWithAlloc, never decrements complete/failed
+                # — legal traffic never transitions out of them)
+                live = [
+                    a for a in allocs
+                    if not s.alloc_by_id(a.id).terminal_status()
+                ]
+                if not live:
+                    continue
+                up = rng.choice(live).copy()
+                up.client_status = rng.choice(
+                    ["pending", "running", "complete", "failed", "lost"]
+                )
+                s.update_allocs_from_client(None, [up])
+            else:
+                a = mock.alloc()
+                a.job = j
+                a.job_id = j.id
+                a.namespace = j.namespace
+                s.upsert_allocs(None, [a])
+                allocs.append(a)
+        incremental = {
+            (j.namespace, j.id): self._counts(
+                s.job_summary_by_id(j.namespace, j.id)
+            )
+            for j in jobs
+        }
+        s.reconcile_job_summaries(None)
+        rebuilt = {
+            (j.namespace, j.id): self._counts(
+                s.job_summary_by_id(j.namespace, j.id)
+            )
+            for j in jobs
+        }
+        assert incremental == rebuilt
+
+
+class TestPersistRestore:
+    """ref fsm.go Snapshot/Restore: a snapshot round-trip must preserve
+    every table and every index — restore-then-persist is a fixpoint."""
+
+    def test_round_trip_preserves_tables_and_indexes(self):
+        from nomad_tpu.structs.model import DrainStrategy
+
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1000, n)
+        a = mock.alloc()
+        s.upsert_job(1001, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        s.upsert_allocs(1002, [a])
+        e = mock.evaluation()
+        s.upsert_evals(1003, [e])
+        s.update_node_drain(
+            1004, n.id, True, strategy=DrainStrategy(deadline=1)
+        )
+
+        blob = s.persist()
+        fresh = StateStore()
+        fresh.restore(blob)
+        assert fresh.latest_index() == s.latest_index()
+        assert fresh.snapshot()._gen.table_indexes == (
+            s.snapshot()._gen.table_indexes
+        )
+        got = fresh.node_by_id(n.id)
+        assert got.drain is True and got.create_index == 1000
+        assert got.modify_index == 1004
+        assert fresh.alloc_by_id(a.id).create_index == 1002
+        assert fresh.eval_by_id(e.id).create_index == 1003
+        # fixpoint: persisting the restored store changes nothing
+        assert fresh.persist() == blob
