@@ -24,7 +24,14 @@ from .framework import (  # noqa: F401
 )
 
 # importing the checker modules registers them
-from . import growth, imports, jax_hygiene, lockgraph, raft_hygiene  # noqa: F401,E402
+from . import (  # noqa: F401,E402
+    growth,
+    imports,
+    jax_hygiene,
+    lockgraph,
+    raft_hygiene,
+    span_hygiene,
+)
 
 
 def repo_root() -> str:

@@ -1,0 +1,201 @@
+"""Span-hygiene checkers for the trace plane (nomad_tpu/trace).
+
+The trace plane's value rests on two invariants the tree must keep:
+
+- **every manually-started span is closed on all exits** — an unclosed
+  span is a leaked entry in the store's open buffer AND a hole in the
+  tree (its children become orphans);
+- **a span body must not wrap a lock-held blocking call** — a span
+  context adds nothing there (lockgraph already flags the call), and
+  spans normalizing such blocks makes the lock-scope smell look
+  sanctioned.
+
+Rules (scoped to the trace plane's reachable surface: ``core/``,
+``tpu/``, ``rpc/``):
+
+- ``span-unclosed`` — a call to ``start_span``/``start_root`` whose
+  result is not a ``with`` item and not ``.end()``-ed inside a
+  ``finally`` block of the same function. The tracer-owned eval root
+  (``eval_root``/``finish_eval``) is lifecycle-managed across calls and
+  exempt by design.
+- ``span-lock-blocking`` — a blocking call (the lockgraph seed set +
+  wait/join/sleep) inside a ``with tracer.span(...)`` /
+  ``tracer.root(...)`` body while a lexically-enclosing ``with`` holds
+  a lock (an item whose name contains ``lock`` or ``cond``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, Project, dotted, register
+
+_SCOPES = ("nomad_tpu/core/", "nomad_tpu/tpu/", "nomad_tpu/rpc/")
+
+#: manual-span constructors whose result the caller must close
+_MANUAL_STARTS = {"start_span", "start_root"}
+#: contextmanager span constructors (the sanctioned shape)
+_SPAN_CMS = {"span", "root"}
+
+#: blocking tails (lockgraph's seed set + the generic primitives)
+_BLOCKING_TAILS = {
+    "block_until_ready", "snapshot_min_index", "raft_apply",
+    "recv", "accept", "wait", "join", "sleep", "sendall",
+}
+
+
+def _in_scope(relpath: str) -> bool:
+    return any(relpath.startswith(p) for p in _SCOPES)
+
+
+def _call_tail(node: ast.Call) -> str:
+    return dotted(node.func).rsplit(".", 1)[-1]
+
+
+def _is_span_cm(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _call_tail(node) in _SPAN_CMS and (
+        "trace" in dotted(node.func) or dotted(node.func).startswith("tracer")
+    )
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    """A with-item that looks like a lock acquisition."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = dotted(node).lower()
+    return "lock" in name or "cond" in name
+
+
+@register(
+    "span-unclosed",
+    "manually-started span not closed on all exits (use a `with` span, "
+    "record_span, or end() in a finally)",
+)
+def check_span_unclosed(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if not _in_scope(mod.relpath):
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # with-items are closed by construction
+            with_items = set()
+            finally_ended = set()  # names .end()-ed inside a finally
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        with_items.add(id(item.context_expr))
+                elif isinstance(node, ast.Try):
+                    for final_stmt in node.finalbody:
+                        for call in ast.walk(final_stmt):
+                            if (
+                                isinstance(call, ast.Call)
+                                and _call_tail(call) == "end"
+                            ):
+                                recv = dotted(call.func).rsplit(".", 1)[0]
+                                finally_ended.add(recv)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _call_tail(node) not in _MANUAL_STARTS:
+                    continue
+                if id(node) in with_items:
+                    continue
+                # assigned to a name that is end()-ed in a finally?
+                parent_assign = None
+                for stmt in ast.walk(fn):
+                    if isinstance(stmt, ast.Assign) and stmt.value is node:
+                        parent_assign = stmt
+                        break
+                if parent_assign is not None:
+                    targets = {dotted(t) for t in parent_assign.targets}
+                    if targets & finally_ended:
+                        continue
+                findings.append(
+                    Finding(
+                        "span-unclosed", mod.relpath, node.lineno,
+                        f"{_call_tail(node)}() result is not closed on "
+                        "all exits: use `with tracer.span(...)`, "
+                        "record_span(), or end() in a finally",
+                    )
+                )
+    return findings
+
+
+@register(
+    "span-lock-blocking",
+    "span body wraps a lock-held blocking call (lockgraph's "
+    "lock-held-blocking-call made span-visible)",
+)
+def check_span_lock_blocking(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if not _in_scope(mod.relpath):
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            _walk_spans(fn.body, lock_held=False, in_span=False,
+                        mod=mod, findings=findings)
+    return findings
+
+
+def _walk_spans(stmts, lock_held: bool, in_span: bool, mod, findings):
+    for stmt in stmts:
+        held = lock_held
+        spanned = in_span
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                expr = item.context_expr
+                if _is_span_cm(expr):
+                    spanned = True
+                elif _is_lockish(expr):
+                    held = True
+            _walk_spans(stmt.body, held, spanned, mod, findings)
+            continue
+        if spanned and held:
+            # simple statements are scanned whole; compound statements
+            # contribute their HEADER expressions (if/while tests, for
+            # iterators) — bodies are reached by the recursion below, so
+            # each call is scanned exactly once
+            if not hasattr(stmt, "body"):
+                scan_roots = [stmt]
+            else:
+                scan_roots = [
+                    expr
+                    for expr in (
+                        getattr(stmt, "test", None),
+                        getattr(stmt, "iter", None),
+                    )
+                    if expr is not None
+                ]
+            for root in scan_roots:
+                for node in ast.walk(root):
+                    if (
+                        isinstance(node, ast.Call)
+                        and _call_tail(node) in _BLOCKING_TAILS
+                    ):
+                        findings.append(
+                            Finding(
+                                "span-lock-blocking", mod.relpath,
+                                node.lineno,
+                                f"blocking call {dotted(node.func)}() "
+                                "inside a span body while a lock is "
+                                "held — fix the lock scope, don't "
+                                "trace over it",
+                            )
+                        )
+        # recurse into nested blocks (if/for/try/while bodies)
+        for field_name in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(stmt, field_name, None)
+            if not sub:
+                continue
+            if field_name == "handlers":
+                for handler in sub:
+                    _walk_spans(handler.body, held, spanned, mod, findings)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                continue  # already recursed above
+            else:
+                _walk_spans(sub, held, spanned, mod, findings)
+    return findings

@@ -222,6 +222,22 @@ class ApiClient:
     def metrics(self):
         return self.get("/v1/metrics")[0]
 
+    # -- trace plane (OBSERVABILITY.md) ---------------------------------
+    def traces(self, limit: int = 50, slowest: bool = False,
+               errors: bool = False) -> dict:
+        params = {"limit": limit}
+        if slowest:
+            params["slowest"] = "true"
+        if errors:
+            params["errors"] = "true"
+        return self.get("/v1/trace", **params)[0]
+
+    def trace(self, trace_id: str) -> dict:
+        return self.get(f"/v1/trace/{_q(trace_id)}")[0]
+
+    def trace_critical_path(self, tail: float = 0.99) -> dict:
+        return self.get("/v1/trace/critical-path", tail=tail)[0]
+
     def validate_job(self, job_dict: dict) -> dict:
         return self.put("/v1/validate/job", body={"Job": job_dict})[0]
 

@@ -463,12 +463,18 @@ class HTTPServer:
 
     @route("PUT", r"/v1/jobs", acl="ns:submit-job")
     def register_job(self, m, query, body):
+        from ..trace import tracer
+
         if not isinstance(body, dict) or "Job" not in body:
             raise ValueError("request must contain a Job")
         job = Job.from_dict(body["Job"])
         self._apply_request_ns(query, job)
         self._check_ns(query, job.namespace, "submit-job")
-        eval_id = self.server.job_register(job)
+        # mint the trace at HTTP submit: the created eval adopts this
+        # context (Server._adopt_eval_trace), so the retained tree runs
+        # submit → broker → worker → device → plan → fsm → mirror
+        with tracer.root("job.submit", tags={"job": job.id}):
+            eval_id = self.server.job_register(job)
         return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
 
     @route("GET", r"/v1/job/(?P<job_id>[^/]+)", acl="ns:read-job")
@@ -1196,12 +1202,47 @@ class HTTPServer:
     def operator_autopilot_health(self, m, query, body):
         return self.server.autopilot_health(), None
 
+    # -- trace plane (OBSERVABILITY.md): per-eval span trees + the
+    # critical-path attribution of eval.e2e. critical-path registers
+    # BEFORE the <trace_id> route — matching is first-registered-wins --
+    @route("GET", r"/v1/trace/critical-path", acl="agent:read")
+    def trace_critical_path(self, m, query, body):
+        from ..trace import attribute, tracer
+
+        tail = float(query.get("tail", "0.99"))
+        return attribute(tracer.store.records(), tail_pct=tail), None
+
+    @route("GET", r"/v1/trace", acl="agent:read")
+    def trace_list(self, m, query, body):
+        from ..trace import tracer
+
+        limit = min(int(query.get("limit", "50")), 500)
+        return {
+            "traces": tracer.store.list(
+                limit=limit,
+                slowest=query.get("slowest") in ("1", "true"),
+                errors=query.get("errors") in ("1", "true"),
+            ),
+            "stats": tracer.stats(),
+        }, None
+
+    @route("GET", r"/v1/trace/(?P<trace_id>[^/]+)", acl="agent:read")
+    def trace_get(self, m, query, body):
+        from ..trace import orphan_count, tracer
+
+        record = tracer.store.get(m["trace_id"])
+        if record is None:
+            raise KeyError(f"trace not found: {m['trace_id']}")
+        record["orphans"] = orphan_count(record)
+        return record, None
+
     @route("GET", r"/v1/metrics", acl="agent:read")
     def metrics(self, m, query, body):
         from ..tpu import batch_sched
         from ..tpu import drain as drain_mod
 
         from .. import metrics as metrics_mod
+        from ..trace import tracer as _tracer
 
         # job-summary gauges (ref leader.go:602 publishJobSummaryMetrics)
         summaries = {}
@@ -1242,6 +1283,8 @@ class HTTPServer:
                 if getattr(self.server, "columnar_mirror", None) is not None
                 else {}
             ),
+            # trace plane retention/sampling state (nomad_tpu/trace)
+            "trace": _tracer.stats(),
         }
         if query.get("format") == "prometheus":
             # text exposition (the reference's prometheus telemetry sink,

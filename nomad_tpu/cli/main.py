@@ -1147,6 +1147,89 @@ def cmd_event_stream(args):
             return 0
 
 
+def cmd_trace_list(args):
+    """List retained traces (OBSERVABILITY.md): newest first, or the
+    slowest-N / error keeps with -slowest / -errors."""
+    client = _client(args)
+    out = client.traces(
+        limit=args.limit, slowest=args.slowest, errors=args.errors
+    )
+    stats = out.get("stats", {})
+    print(
+        f"retained={stats.get('retained', 0)} "
+        f"open={stats.get('open', 0)} "
+        f"finished={stats.get('finished', 0)} "
+        f"sample_rate={stats.get('sample_rate', 1.0)}"
+    )
+    rows = out.get("traces", [])
+    if not rows:
+        print("No retained traces")
+        return 0
+    print(f"{'Trace ID':<34} {'Root':<12} {'Duration':>10} {'Spans':>6}  Err")
+    for r in rows:
+        dur = r.get("duration_ms")
+        print(
+            f"{r['trace_id']:<34} {str(r.get('root')):<12} "
+            f"{dur if dur is not None else '-':>10} "
+            f"{r.get('spans', 0):>6}  {'x' if r.get('error') else ''}"
+        )
+    return 0
+
+
+def cmd_trace_get(args):
+    """One trace's span tree, indented by parent (or raw JSON)."""
+    from ..trace.critical_path import build_tree
+
+    client = _client(args)
+    record = client.trace(args.trace_id)
+    if args.json:
+        print(json.dumps(record, indent=2))
+        return 0
+    print(
+        f"trace {record['trace_id']}  duration="
+        f"{record.get('duration_ms')}ms  spans={len(record['spans'])}  "
+        f"orphans={record.get('orphans', 0)}"
+    )
+    roots, children = build_tree(record)
+    t_base = min(
+        (s.get("start") or 0.0 for s in record["spans"]), default=0.0
+    )
+
+    def show(span, depth):
+        rel = ((span.get("start") or 0.0) - t_base) * 1e3
+        flags = ",".join(span.get("flags") or [])
+        err = span.get("error")
+        line = (
+            f"{'  ' * depth}{span['name']:<{max(28 - 2 * depth, 8)}} "
+            f"+{rel:9.2f}ms {span.get('duration_ms', 0):>10.2f}ms"
+        )
+        if flags:
+            line += f"  [{flags}]"
+        if err:
+            line += f"  ERROR: {err}"
+        print(line)
+        for child in children.get(span["span_id"], ()):
+            show(child, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s.get("start") or 0.0):
+        show(root, 0)
+    return 0
+
+
+def cmd_trace_critical_path(args):
+    """Aggregate critical-path attribution over the retained traces —
+    the per-stage blame table for the eval.e2e tail."""
+    from ..trace.critical_path import format_report
+
+    client = _client(args)
+    report = client.trace_critical_path(tail=args.tail)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    print(format_report(report))
+    return 0
+
+
 def cmd_status(args):
     """Generic prefix dispatch (ref command/status.go): search all
     contexts and show the best match."""
@@ -1475,6 +1558,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="auto-reconnect from the last index when the stream closes",
     )
     evs.set_defaults(fn=cmd_event_stream)
+
+    tr = sub.add_parser("trace", help="eval span trees + critical path")
+    trsub = tr.add_subparsers(dest="subcommand")
+    trl = trsub.add_parser("list", help="retained traces")
+    trl.add_argument("-limit", type=int, default=25)
+    trl.add_argument(
+        "-slowest", action="store_true", help="the slowest-N tail keep"
+    )
+    trl.add_argument(
+        "-errors", action="store_true", help="the error/fault keep"
+    )
+    trl.set_defaults(fn=cmd_trace_list)
+    trg = trsub.add_parser("get", help="one trace's span tree")
+    trg.add_argument("trace_id")
+    trg.add_argument("-json", action="store_true")
+    trg.set_defaults(fn=cmd_trace_get)
+    trc = trsub.add_parser(
+        "critical-path",
+        help="per-stage attribution of the eval.e2e tail",
+    )
+    trc.add_argument(
+        "-tail", type=float, default=0.99,
+        help="tail quantile to attribute (default 0.99)",
+    )
+    trc.add_argument("-json", action="store_true")
+    trc.set_defaults(fn=cmd_trace_critical_path)
 
     mon = sub.add_parser("monitor", help="stream agent logs")
     mon.add_argument("-log-level", "--log-level", dest="log_level")

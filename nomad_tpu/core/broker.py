@@ -18,8 +18,8 @@ import threading
 import time
 from typing import Optional
 
-from .. import metrics
 from ..structs.model import Evaluation, generate_uuid
+from ..trace import tracer
 
 logger = logging.getLogger("nomad_tpu.eval_broker")
 
@@ -183,11 +183,11 @@ class EvalBroker:
         self._requeue: dict[str, Evaluation] = {}
         # eval id -> wait timer
         self._time_wait: dict[str, _TimerHandle] = {}
-        # eval id -> first-enqueue monotonic time; the eval.e2e latency
-        # tap (enqueue -> ack) the churn-soak scorekeeper samples. Popped
-        # on ack, cleared on flush — lives exactly as long as the eval is
-        # the broker's responsibility
-        self._enqueue_t: dict[str, float] = {}
+        # the eval.e2e enqueue→ack tap lives in the trace plane now: the
+        # root span opened at first enqueue (tracer.eval_root) is closed
+        # at ack (tracer.finish_eval), which emits the eval.e2e timer
+        # with the trace id as exemplar — one source of truth for the
+        # soak scorekeeper AND the span tree
 
     # ------------------------------------------------------------------
     def set_enabled(self, enabled: bool):
@@ -224,7 +224,14 @@ class EvalBroker:
                 self._requeue[token] = ev
             return
         self._evals[ev.id] = 0
-        self._enqueue_t[ev.id] = time.monotonic()
+        tracer.eval_root(
+            ev.id,
+            tags={
+                "job": ev.job_id,
+                "type": ev.type,
+                "triggered_by": ev.triggered_by,
+            },
+        )
 
         if ev.wait_until:
             now = time.time_ns()
@@ -313,6 +320,9 @@ class EvalBroker:
         ev = self._ready[best_queue].pop()
         token = generate_uuid()
         self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        # ready-queue wait becomes a span on first delivery (the stage
+        # between submit and a worker picking the eval up)
+        tracer.eval_dequeued(ev.id)
 
         self._unack[ev.id] = (
             ev, token, _WHEEL.arm(self.nack_timeout, self._nack_timeout, (ev.id, token))
@@ -399,9 +409,11 @@ class EvalBroker:
             del self._unack[eval_id]
             self._evals.pop(eval_id, None)
             self._paused.discard(eval_id)
-            t0 = self._enqueue_t.pop(eval_id, None)
-            if t0 is not None:
-                metrics.sample("eval.e2e", time.monotonic() - t0)
+            # detach the root HERE, before a requeued copy of this eval
+            # re-enqueues below — its fresh lifecycle must mint a fresh
+            # root, not inherit (and then lose) this one. The finish —
+            # retention bookkeeping — runs after the lock is released
+            finished_root = tracer.detach_eval(eval_id)
 
             key = (ev.namespace, ev.job_id)
             self._job_evals.pop(key, None)
@@ -416,6 +428,11 @@ class EvalBroker:
             if requeued is not None:
                 self._process_enqueue(requeued, "")
             self._cond.notify_all()
+        # close the detached root OUTSIDE the broker lock: finishing a
+        # trace does retention bookkeeping (ring/heap maintenance) that
+        # has no business inside the scheduler's central serialization
+        # point
+        tracer.finish_root(finished_root)
 
     def nack(self, eval_id: str, token: str, from_timer: bool = False):
         """ref eval_broker.go:595-642. ``from_timer`` marks the nack-timeout
@@ -436,6 +453,13 @@ class EvalBroker:
             del self._unack[eval_id]
 
             dequeues = self._evals.get(eval_id, 0)
+            # marker on the eval's trace: the retry is visible in the
+            # tree (a severed worker shows as nack → re-dequeue, one
+            # connected trace, not two)
+            tracer.eval_event(
+                ev.id, "eval.nack",
+                tags={"from_timer": from_timer, "dequeues": dequeues},
+            )
             if dequeues >= self.delivery_limit:
                 self._enqueue_locked(ev, FAILED_QUEUE)
             else:
@@ -464,6 +488,10 @@ class EvalBroker:
                 timer.cancel()
             for timer in self._time_wait.values():
                 timer.cancel()
+            for eval_id in self._evals:
+                # leadership revoked: this process stops observing these
+                # evals; abandon their open roots instead of leaking them
+                tracer.discard_eval(eval_id)
             self._evals.clear()
             self._job_evals.clear()
             self._blocked.clear()
@@ -472,7 +500,6 @@ class EvalBroker:
             self._requeue.clear()
             self._paused.clear()
             self._time_wait.clear()
-            self._enqueue_t.clear()
             self._cond.notify_all()
 
     def stats(self) -> dict:

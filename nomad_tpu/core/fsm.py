@@ -18,6 +18,7 @@ the invariant blocking queries and SnapshotMinIndex rely on.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, Optional
 
 from ..state.store import StateStore
@@ -427,6 +428,20 @@ class FSM:
         return index
 
     def _apply_plan_results(self, index: int, payload: dict):
+        from ..trace import tracer
+
+        # raft-entry trace annotation (leader-minted): spans THIS
+        # replica's apply and links the committed index to the eval's
+        # trace so the ColumnarMirror's patch spans attach later. Popped
+        # before use — it never reaches state-store objects. Followers,
+        # whose store never opened the leader's trace, skip recording
+        # entirely (their spans would only be dropped on arrival)
+        trace_ctx = tracer.ctx_from_annotation(payload.get("trace"))
+        if trace_ctx is not None and not tracer.store.knows(
+            trace_ctx.trace_id
+        ):
+            trace_ctx = None
+        t0 = time.monotonic()
         plan = Plan.from_dict(payload["plan"])
         if payload.get("normalized"):
             result = self._denormalize_plan_result(payload["result"])
@@ -435,10 +450,21 @@ class FSM:
         preemption_evals = [
             Evaluation.from_dict(d) for d in payload.get("preemption_evals", [])
         ]
+        if trace_ctx is not None:
+            # linked BEFORE the upsert publishes the plan frame: a
+            # mirror sync on another thread can consume the frame
+            # immediately, and its ctxs_for_index lookup must not race
+            # an unlinked index (the mirror.patch hop would be lost)
+            tracer.link_index(index, trace_ctx)
         self.state.upsert_plan_results(
             index, plan, result, preemption_evals=preemption_evals
         )
         self._handle_upserted_evals(preemption_evals)
+        if trace_ctx is not None:
+            tracer.record_span(
+                "fsm.apply_plan", trace_ctx, t0, time.monotonic(),
+                tags={"index": index},
+            )
         return index
 
     def _denormalize_plan_result(self, doc: dict) -> PlanResult:

@@ -20,6 +20,7 @@ from typing import Optional
 from .. import metrics
 from ..state.store import StateSnapshot, StateStore
 from ..testing import faults as _faults
+from ..trace import tracer
 from ..structs.funcs import allocs_fit
 from ..structs.model import (
     NODE_SCHED_INELIGIBLE,
@@ -39,6 +40,18 @@ class PendingPlan:
         self.result: Optional[PlanResult] = None
         self.error: Optional[Exception] = None
         self.enqueued_at = time.monotonic()
+        # the submitting eval's trace context, resolved once at enqueue:
+        # the applier's queue-wait/verify/commit spans attach to it from
+        # the applier thread without another registry lookup. The
+        # CURRENT span (the worker's plan.submit, active on the
+        # enqueuing thread) wins over the eval root so the applier
+        # stages nest INSIDE plan.submit — critical-path attribution
+        # then splits submit into queue-wait/verify/commit instead of
+        # double-counting two parallel branches of the same wall time;
+        # direct callers (Planner.apply, tests) fall back to the root
+        self.trace_ctx = tracer.current() or tracer.ctx_for_eval(
+            plan.eval_id
+        )
         self._done = threading.Event()
 
     def respond(self, result: Optional[PlanResult], error: Optional[Exception]):
@@ -393,7 +406,10 @@ class Planner:
         noops = []
         for i, p in enumerate(live):
             try:
-                with metrics.measure("plan.evaluate"):
+                with tracer.span(
+                    "plan.evaluate", parent=p.trace_ctx,
+                    metric="plan.evaluate",
+                ):
                     result = evaluate_plan(snap, p.plan)
             except Exception as e:
                 p.respond(None, e)
@@ -415,7 +431,7 @@ class Planner:
                 return entries, None, live[i + 1:], noops
         return entries, snap, [], noops
 
-    def _commit_resolving(self, commit):
+    def _commit_resolving(self, commit, trace_ctxs=()):
         """Run a consensus commit, resolving indeterminate timeouts.
 
         A raft apply that times out has ALREADY stored its entry in the
@@ -435,12 +451,28 @@ class Planner:
             index = getattr(e, "raft_index", None)
             if index is None or self.barrier_fn is None:
                 raise
+            tb0 = time.monotonic()
             try:
                 self.barrier_fn(e)
             except Exception:
                 metrics.incr("plan.commit_timeout_unresolved")
+                tb1 = time.monotonic()
+                for ctx in trace_ctxs:
+                    # the indeterminacy resolution is a real stage of the
+                    # eval's lifecycle: FAILED barrier visible in the tree
+                    tracer.record_span(
+                        "plan.commit_barrier", ctx, tb0, tb1,
+                        tags={"resolved": False, "index": index},
+                        error="barrier failed; entry outcome unknown",
+                    )
                 raise e
             metrics.incr("plan.commit_timeout_resolved")
+            tb1 = time.monotonic()
+            for ctx in trace_ctxs:
+                tracer.record_span(
+                    "plan.commit_barrier", ctx, tb0, tb1,
+                    tags={"resolved": True, "index": index},
+                )
             return index
 
     def _respond_refreshed(self, noops, index: Optional[int] = None):
@@ -491,7 +523,10 @@ class Planner:
             for p in batch:
                 # time spent waiting for the applier: the stage that names
                 # the saturation point when workers outrun the commit
-                metrics.sample("plan.queue_wait", now - p.enqueued_at)
+                tracer.record_span(
+                    "plan.queue_wait", p.trace_ctx, p.enqueued_at, now,
+                    metric="plan.queue_wait",
+                )
                 if self.token_check_fn is not None and not self.token_check_fn(
                     p.plan
                 ):
@@ -631,6 +666,8 @@ class Planner:
         siblings (``noops``) are answered here too, carrying the commit's
         REAL index as their refresh point — the optimistic index they were
         verified at exists only inside the applier's scratch overlay."""
+        tc0 = time.monotonic()
+        ctxs = [p.trace_ctx for p, _ in entries if p.trace_ctx is not None]
         try:
             # chaos seam: a rule here fails/partitions the leader at the
             # worst moment — results verified, consensus not yet reached
@@ -651,16 +688,26 @@ class Planner:
             if self.commit_batch_fn is not None:
                 with metrics.measure("plan.raft_apply"):
                     index = self._commit_resolving(
-                        lambda: self.commit_batch_fn(items)
+                        lambda: self.commit_batch_fn(items),
+                        trace_ctxs=ctxs,
                     )
             elif self.commit_fn is not None:
                 with metrics.measure("plan.raft_apply"):
                     index = 0
-                    for plan, result, pevals in items:
+                    for (pending, _), (plan, result, pevals) in zip(
+                        entries, items
+                    ):
+                        # per-plan commits: a barrier resolution belongs
+                        # to THIS plan's trace only, not the whole batch
                         index = self._commit_resolving(
                             lambda p=plan, r=result, pe=pevals: self.commit_fn(
                                 p, r, pe
-                            )
+                            ),
+                            trace_ctxs=(
+                                (pending.trace_ctx,)
+                                if pending.trace_ctx is not None
+                                else ()
+                            ),
                         )
             else:
                 index = 0
@@ -673,12 +720,17 @@ class Planner:
                             [self.state.eval_by_id(e.id) for e in pevals]
                         )
             box["index"] = index
+            tc1 = time.monotonic()
             for pending, result in entries:
                 result.alloc_index = index
                 if result.refresh_index:
                     # partial commits carry a refresh point: clamp the
                     # synthetic optimistic index to the real committed one
                     result.refresh_index = min(result.refresh_index, index)
+                tracer.record_span(
+                    "plan.commit", pending.trace_ctx, tc0, tc1,
+                    tags={"batch": len(entries), "index": index},
+                )
                 pending.respond(result, None)
             self._respond_refreshed(noops, index)
         except _faults.SimulatedCrash:
@@ -699,7 +751,12 @@ class Planner:
             floor = getattr(e, "raft_index", 0)
             if floor:
                 box["floor"] = max(box.get("floor", 0), floor)
+            tc1 = time.monotonic()
             for pending, _ in entries:
+                tracer.record_span(
+                    "plan.commit", pending.trace_ctx, tc0, tc1,
+                    tags={"batch": len(entries)}, error=repr(e),
+                )
                 pending.respond(None, e)
             for pending, _ in noops:
                 pending.respond(None, e)

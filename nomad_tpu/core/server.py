@@ -68,6 +68,15 @@ class Server:
 
     def __init__(self, config: Optional[dict] = None):
         self.config = config or {}
+        # trace{} stanza (OBSERVABILITY.md): enabled, sample_rate,
+        # retain, slow_keep, error_keep. The tracer is process-wide
+        # (metrics-registry idiom); only keys present are applied, so
+        # multiple in-process servers don't fight over defaults
+        trace_cfg = self.config.get("trace")
+        if trace_cfg:
+            from ..trace import tracer as _tracer
+
+            _tracer.configure(**trace_cfg)
         self.state = StateStore()
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.get("nack_timeout", 60.0),
@@ -736,11 +745,19 @@ class Server:
             ],
             "refresh_index": result.refresh_index,
         }
+        from ..trace import tracer as _tracer
+
         return {
             "plan": slim_plan.to_dict(),
             "result": result_doc,
             "normalized": True,
             "preemption_evals": [e.to_dict() for e in preemption_evals],
+            # raft-entry trace annotation: the FSM pops it to span its
+            # apply (leader AND followers) and to link the committed
+            # index to the eval's trace for the mirror's patch spans.
+            # It never enters state-store objects, so traced and
+            # untraced runs commit byte-identical STATE
+            "trace": _tracer.annotation_for_eval(plan.eval_id),
         }
 
     # ------------------------------------------------------------------
@@ -1404,6 +1421,16 @@ class Server:
             core_job_eval(CORE_JOB_FORCE_GC, self.state.latest_index())
         )
 
+    @staticmethod
+    def _adopt_eval_trace(ev: Evaluation):
+        """Link the eval about to be created to the caller's trace
+        context (HTTP/CLI submit span, RPC server span): the broker's
+        root span — opened later on the raft apply thread — parents
+        under it, so submit→device→ack is ONE tree."""
+        from ..trace import tracer as _tracer
+
+        _tracer.adopt_eval(ev.id)
+
     # ------------------------------------------------------------------
     # Job endpoints (ref nomad/job_endpoint.go:80 Register)
     # ------------------------------------------------------------------
@@ -1434,6 +1461,7 @@ class Server:
             create_time=now_ns(),
             modify_time=now_ns(),
         )
+        self._adopt_eval_trace(ev)
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return ev.id
 
@@ -1561,6 +1589,7 @@ class Server:
             create_time=now_ns(),
             modify_time=now_ns(),
         )
+        self._adopt_eval_trace(ev)
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
         return {"DispatchedJobID": child.id, "EvalID": ev.id}
 
@@ -1588,6 +1617,7 @@ class Server:
             create_time=now_ns(),
             modify_time=now_ns(),
         )
+        self._adopt_eval_trace(ev)
         if force_reschedule:
             failed = {
                 a.id: {"force_reschedule": True}

@@ -16,6 +16,7 @@ from typing import Optional
 
 from ..scheduler.scheduler import new_scheduler
 from ..testing import faults as _faults
+from ..trace import tracer
 from ..structs.model import (
     EVAL_STATUS_FAILED,
     Evaluation,
@@ -109,21 +110,33 @@ class Worker:
         (ref worker.go:142-276). ``snapshot``/``collector`` are supplied by
         the batch-drain path (one shared snapshot, fused kernel)."""
         try:
-            # inside the try so an "error"-action rule nacks like any
-            # processing failure; a "crash" rule raises SimulatedCrash
-            # (BaseException) straight past the handler, like a real death
-            _faults.fault_point("worker.post_dequeue")
-            if snapshot is None:
-                snapshot = self._snapshot_with_lease(ev, token)
-                # fresh lease for the scheduling pass itself
-                try:
-                    self.server.eval_broker.outstanding_reset(ev.id, token)
-                except BrokerError:
-                    pass
-            self._eval_token = token
-            self._eval = ev
-            self._snapshot_index = snapshot.latest_index()
-            self.invoke_scheduler(snapshot, ev, collector=collector)
+            # the worker's slice of the eval's span tree: dequeue → ack
+            # on THIS worker (a nack + re-dequeue elsewhere adds another
+            # worker.process span to the same trace)
+            with tracer.span(
+                "worker.process",
+                parent=tracer.ctx_for_eval(ev.id),
+                tags={"eval_type": ev.type},
+            ):
+                # inside the try so an "error"-action rule nacks like any
+                # processing failure; a "crash" rule raises SimulatedCrash
+                # (BaseException) straight past the handler, like a real
+                # death
+                _faults.fault_point("worker.post_dequeue")
+                if snapshot is None:
+                    with tracer.span("eval.snapshot_wait"):
+                        snapshot = self._snapshot_with_lease(ev, token)
+                    # fresh lease for the scheduling pass itself
+                    try:
+                        self.server.eval_broker.outstanding_reset(
+                            ev.id, token
+                        )
+                    except BrokerError:
+                        pass
+                self._eval_token = token
+                self._eval = ev
+                self._snapshot_index = snapshot.latest_index()
+                self.invoke_scheduler(snapshot, ev, collector=collector)
         except Exception:
             logger.exception("eval processing failed; nacking %s", ev.id)
             try:
@@ -171,7 +184,11 @@ class Worker:
             sched.drain_collector = collector
         from .. import metrics
 
-        with metrics.measure(f"worker.invoke_scheduler.{sched_name}"):
+        with tracer.span(
+            "eval.evaluate",
+            tags={"scheduler": sched_name},
+            metric=f"worker.invoke_scheduler.{sched_name}",
+        ):
             sched.process(ev)
         metrics.incr(f"worker.evals_processed.{ev.type}")
 
@@ -181,12 +198,10 @@ class Worker:
     def submit_plan(self, plan: Plan):
         """Attach the eval token, route through the plan queue, and hand back
         a fresh snapshot when the applier asks for a refresh."""
-        from .. import metrics
-
         _faults.fault_point("worker.pre_submit")
         plan.eval_token = self._eval_token
         plan.snapshot_index = self.server.state.latest_index()
-        with metrics.measure("plan.submit"):
+        with tracer.span("plan.submit", metric="plan.submit"):
             result, error = self.server.plan_submit(plan)
         if error is not None:
             raise error
@@ -195,9 +210,10 @@ class Worker:
 
         new_state = None
         if result.refresh_index:
-            new_state = self.server.state.snapshot_min_index(
-                result.refresh_index, timeout=RAFT_SYNC_LIMIT
-            )
+            with tracer.span("plan.refresh_wait"):
+                new_state = self.server.state.snapshot_min_index(
+                    result.refresh_index, timeout=RAFT_SYNC_LIMIT
+                )
         return result, new_state
 
     def update_eval(self, ev: Evaluation):

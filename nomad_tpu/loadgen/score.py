@@ -9,8 +9,9 @@ Sampled per tick:
 - **RSS** (/proc/self/statm): the ceiling + the post-ramp growth slope —
   the signal that catches unbounded-growth classes like the r5
   ``_bad_http_addrs`` leak;
-- **eval latency**: the ``eval.e2e`` timer (enqueue→ack, core/broker.py
-  tap) p99, a timeline because the timer window slides;
+- **eval latency**: the ``eval.e2e`` timer p99 (sourced from the trace
+  plane's root span, enqueue→ack — nomad_tpu/trace; the broker's old
+  side-table tap is gone), a timeline because the timer window slides;
 - **event-stream subscriber lag**: probe subscribers riding the real
   ``/v1/event/stream`` HTTP surface; lag = broker latest index − the
   probe's last delivered index;
@@ -312,6 +313,22 @@ class Scorekeeper:
             "mirror": mirror.stats() if mirror is not None else None,
             "final_state": samples[-1] if samples else {},
         }
+        # per-stage attribution of the eval.e2e tail from RETAINED TRACES
+        # (nomad_tpu/trace critical-path): the artifact carries the blame
+        # table itself instead of hand-assembled stage splits
+        try:
+            from ..trace import attribute, tracer
+
+            cp = attribute(tracer.store.records())
+            report["critical_path"] = {
+                "traces": cp["traces"],
+                "bottleneck": cp["bottleneck"],
+                "verdict": cp["verdict"],
+                "tail_stages": (cp.get("tail") or {}).get("stages", {}),
+            }
+            report["trace_stats"] = tracer.stats()
+        except Exception:
+            report["critical_path"] = None
         report["slo"] = grade(report, scenario.slos)
         return report
 
@@ -385,6 +402,7 @@ def summary_line(report: dict) -> str:
         f"rss_slope_mb_min={report['rss_tail_slope_mb_per_min']}",
         f"eval_p99_max_ms={report['eval_e2e_p99_ms_max']}",
         f"sub_lag_max={report['subscriber_lag_max']}",
+        f"trace_bottleneck={(report.get('critical_path') or {}).get('bottleneck')}",
         f"slo={slo['passed']}/{slo['passed'] + slo['failed']}",
         f"score={slo['score']}",
         f"digest={report['stream_digest'][:12]}",

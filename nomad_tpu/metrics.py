@@ -14,8 +14,12 @@ _LOCK = threading.Lock()
 _COUNTERS: dict[str, float] = {}
 _TIMERS: dict[str, list[float]] = {}
 _HISTS: dict[str, dict[int, int]] = {}
+# nta: ignore[unbounded-cache] WHY: keyed by metric name (code-bounded);
+# each entry is a bounded deque of the last few exemplar links
+_EXEMPLARS: dict[str, list] = {}
 
 TIMER_WINDOW = 512  # samples retained per timer
+EXEMPLARS_PER_METRIC = 4  # most-recent trace links kept per timer
 
 
 def incr(name: str, value: float = 1.0):
@@ -23,22 +27,66 @@ def incr(name: str, value: float = 1.0):
         _COUNTERS[name] = _COUNTERS.get(name, 0.0) + value
 
 
+def _bucket_floor(value) -> int:
+    """Base-2 bucket lower bound: 0, 1, 2, 4, 8, ... — at most ~64
+    buckets per histogram regardless of the observed value range."""
+    iv = int(value)
+    if iv <= 0:
+        return 0
+    return 1 << (iv.bit_length() - 1)
+
+
 def observe(name: str, value):
-    """Exact-value histogram: counts per observed integer value (e.g. the
-    plan.apply_batch_size distribution). Values are small discrete sizes,
-    so no bucketing scheme is needed."""
+    """Bounded base-2 bucketed histogram (e.g. the plan.apply_batch_size
+    distribution): counts per power-of-two bucket, keyed by the bucket's
+    lower bound. The earlier exact-integer-value counting was unbounded
+    cardinality under soak (one dict key per distinct observed value —
+    the `unbounded-cache` checker's own blind spot); base-2 buckets cap
+    every histogram at ~64 keys while keeping the /v1/metrics output
+    shape ({name: {int: count}}) unchanged."""
     with _LOCK:
         hist = _HISTS.setdefault(name, {})
-        key = int(value)
+        key = _bucket_floor(value)
         hist[key] = hist.get(key, 0) + 1
 
 
-def sample(name: str, seconds: float):
+def sample(name: str, seconds: float, exemplar: str = None):
+    """Record one timer sample; ``exemplar`` links the sample to a
+    retained trace id (hot-path histograms carry these so /v1/metrics
+    p99s are one hop from the span trees that produced them)."""
     with _LOCK:
         bucket = _TIMERS.setdefault(name, [])
         bucket.append(seconds)
         if len(bucket) > TIMER_WINDOW:
             del bucket[: len(bucket) - TIMER_WINDOW]
+        if exemplar:
+            ex = _EXEMPLARS.setdefault(name, [])
+            ex.append(
+                {"trace_id": exemplar, "value_ms": round(seconds * 1e3, 3)}
+            )
+            if len(ex) > EXEMPLARS_PER_METRIC:
+                del ex[: len(ex) - EXEMPLARS_PER_METRIC]
+
+
+def percentile(name: str, q: float):
+    """Approximate percentile ``q`` in [0, 1] for a timer (exact over
+    the retained window, in seconds) or a bucketed histogram (the
+    bucket's upper bound). Returns None for an unknown name."""
+    with _LOCK:
+        samples = list(_TIMERS.get(name, ()))
+        hist = dict(_HISTS.get(name, ()))
+    if samples:
+        ordered = sorted(samples)
+        return ordered[min(len(ordered) - 1, int(len(ordered) * q))]
+    if hist:
+        total = sum(hist.values())
+        target = min(total - 1, int(total * q))
+        seen = 0
+        for key in sorted(hist):
+            seen += hist[key]
+            if seen > target:
+                return key if key == 0 else 2 * key - 1
+    return None
 
 
 @contextmanager
@@ -53,11 +101,12 @@ def measure(name: str):
 
 def snapshot() -> dict:
     """{counters, timers: {name: {count, mean_ms, p99_ms, max_ms}},
-    hists: {name: {value: count}}}"""
+    hists: {name: {bucket_floor: count}}, exemplars: {name: [...]}}"""
     with _LOCK:
         counters = dict(_COUNTERS)
         timers = {k: list(v) for k, v in _TIMERS.items()}
         hists = {k: dict(v) for k, v in _HISTS.items()}
+        exemplars = {k: list(v) for k, v in _EXEMPLARS.items() if v}
     out_timers = {}
     for name, samples in timers.items():
         if not samples:
@@ -70,7 +119,12 @@ def snapshot() -> dict:
             "p99_ms": round(p99 * 1e3, 3),
             "max_ms": round(ordered[-1] * 1e3, 3),
         }
-    return {"counters": counters, "timers": out_timers, "hists": hists}
+    return {
+        "counters": counters,
+        "timers": out_timers,
+        "hists": hists,
+        "exemplars": exemplars,
+    }
 
 
 def reset():
@@ -79,6 +133,7 @@ def reset():
         _COUNTERS.clear()
         _TIMERS.clear()
         _HISTS.clear()
+        _EXEMPLARS.clear()
 
 
 # ---------------------------------------------------------------------------

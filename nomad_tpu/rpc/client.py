@@ -217,6 +217,28 @@ class ConnPool:
         Failures after the request was flushed — including a timeout,
         where the handler may still be running — are never retried:
         re-sending would duplicate a non-idempotent write."""
+        from ..trace import tracer
+
+        ctx = tracer.current()
+        if (
+            ctx is not None
+            and ctx.sampled
+            and isinstance(payload, dict)
+            and "_trace" not in payload
+        ):
+            # trace-context propagation: the handler side re-activates
+            # this so server-side spans parent under the caller's span.
+            # Copied, never mutated in place — the caller may retry the
+            # same payload object through another pool
+            payload = {**payload, "_trace": ctx.to_dict()}
+        with tracer.span(f"rpc.{method}", tags={"addr": addr}):
+            return self._call_inner(
+                addr, method, payload, timeout, retry_leader, retry_stale
+            )
+
+    def _call_inner(
+        self, addr, method, payload, timeout, retry_leader, retry_stale
+    ):
         from .mux import StreamClosed, StreamError
 
         duplicate = self._inject(addr, method)

@@ -269,7 +269,21 @@ class RpcServer:
         handler = self.handlers.get(method)
         if handler is None:
             raise KeyError(f"unknown rpc method: {method}")
-        return handler(payload)
+        trace_doc = (
+            payload.pop("_trace", None) if isinstance(payload, dict) else None
+        )
+        if trace_doc is None:
+            return handler(payload)
+        # wire-propagated trace context: everything the handler does —
+        # including eval creation (Server._adopt_eval_trace) — parents
+        # under the remote caller's span, so a job submitted over RPC is
+        # one tree from the client socket to the device and back
+        from ..trace import tracer
+
+        ctx = tracer.ctx_from_annotation(trace_doc)
+        with tracer.activate(ctx):
+            with tracer.span(f"rpc.server.{method}"):
+                return handler(payload)
 
     def _dispatch_raft(self, method: str, payload):
         handler = self.raft_handlers.get(method)

@@ -306,7 +306,15 @@ class TPUBatchScheduler(GenericScheduler):
             return super()._compute_placements(destructive, place)
 
         _count_kernel()
-        self._kernel_placements(place, nodes, by_dc, groups)
+        # the solo-kernel stage of the eval's span tree (the fused drain
+        # path gets its device-aware spans from drain.py instead); also
+        # the headline bench's traced-arm work in the trace_overhead A/B
+        from ..trace import tracer
+
+        with tracer.span(
+            "eval.plan_kernel", tags={"allocs": len(place)}
+        ):
+            self._kernel_placements(place, nodes, by_dc, groups)
 
     # ------------------------------------------------------------------
     def _assemble_groups(

@@ -94,6 +94,11 @@ class _Parked:
     def __init__(self, prep: DrainPrep):
         self.prep = prep
         self.event = threading.Event()
+        #: the eval's drain.park span context: the batch-shared build/
+        #: dispatch spans nest UNDER it so park self-time in the
+        #: critical path is the pure rendezvous wait, not a double-count
+        #: of the fused build it temporally contains
+        self.trace_ctx = None
         #: this eval's placement slice — a DEVICE array handed back at
         #: dispatch time; the consumer's np.asarray is the sync point, so
         #: host-side materialization overlaps device compute
@@ -113,17 +118,35 @@ class _LazySlice:
     optional ``on_sync`` callback fires after the first successful sync —
     the collector threads one (shared, once-only) callback through a
     batch's slices to timestamp device completion without a dedicated
-    watcher thread."""
+    watcher thread. With ``trace_ctx``, the first sync also records a
+    per-eval ``drain.materialize`` span (host-side materialization time,
+    distinct from on-device compute — this slice's wait is exactly the
+    part not hidden by the double-buffer overlap)."""
 
-    __slots__ = ("arr", "sl", "on_sync")
+    __slots__ = ("arr", "sl", "on_sync", "trace_ctx")
 
-    def __init__(self, arr, sl, on_sync=None):
+    def __init__(self, arr, sl, on_sync=None, trace_ctx=None):
         self.arr = arr
         self.sl = sl
         self.on_sync = on_sync
+        self.trace_ctx = trace_ctx
 
     def __array__(self, dtype=None, copy=None):
+        ctx = self.trace_ctx
+        t0 = time.monotonic() if ctx is not None else 0.0
         out = np.asarray(self.arr)[self.sl]
+        if ctx is not None:
+            self.trace_ctx = None  # first sync only; later reads are hot
+            from ..trace import tracer
+
+            # the consumer's own active span (eval.evaluate on the
+            # scheduler thread) wins over the stored root ctx so the
+            # materialization nests INSIDE the stage that waited for it
+            # instead of overlapping it as a root sibling
+            tracer.record_span(
+                "drain.materialize", tracer.current() or ctx, t0,
+                time.monotonic(), metric="drain.materialize",
+            )
         cb = self.on_sync
         if cb is not None:
             self.on_sync = None
@@ -226,13 +249,28 @@ class KernelBatchCollector:
     def submit(self, prep: DrainPrep) -> tuple[np.ndarray, np.ndarray]:
         """Park this eval's inputs; returns (placements slice, usage base
         including all earlier evals' grants)."""
+        from ..trace import tracer
+
         park = _Parked(prep)
+        # opened BEFORE parking: the last-arriving thread runs the fused
+        # build inside _run_batch below, and the build/dispatch spans it
+        # records need this context as their parent. Closed in the
+        # finally — the rendezvous wait (submit → dispatch wake), with
+        # the batch-shared stages nested inside it
+        park_span = tracer.start_span("drain.park")
+        park.trace_ctx = park_span.ctx() or tracer.ctx_for_eval(
+            prep.eval_id
+        )
         with self._lock:
             self._consumed.add(prep.eval_id)
             self._parked.append(park)
             batch = self._take_batch_locked()
-        self._run_batch(batch)
-        if not park.event.wait(self.timeout):
+        try:
+            self._run_batch(batch)
+            arrived = park.event.wait(self.timeout)
+        finally:
+            park_span.end()
+        if not arrived:
             raise RuntimeError("drain kernel batch timed out")
         if park.error is not None:
             raise park.error
@@ -276,8 +314,23 @@ class KernelBatchCollector:
     def _run(self, parked: list[_Parked]):
         import jax.numpy as jnp
 
-        from .kernel import BatchArgs, BatchState, plan_batch
+        from ..trace import tracer
+        from .kernel import (
+            BatchArgs,
+            BatchState,
+            compile_cache_size,
+            plan_batch,
+        )
 
+        #: per-eval trace contexts: the fused batch's stages (build,
+        #: dispatch) are SHARED wall time, recorded into every
+        #: participating eval's tree under its drain.park span (so park
+        #: self-time stays the pure rendezvous wait); device compute —
+        #: which outlives the park — attaches to the eval root
+        trace_ctxs = [p.trace_ctx for p in parked]
+        root_ctxs = [
+            tracer.ctx_for_eval(p.prep.eval_id) for p in parked
+        ]
         t0 = time.monotonic()
         shared = self.shared
         n_real = len(shared.nodes)
@@ -414,6 +467,7 @@ class KernelBatchCollector:
             offset=np.zeros(E, dtype=np.int32),
         )
         t_build = time.monotonic()
+        cache_before = compile_cache_size()
         _, placements = plan_batch(args, init, n_real)
 
         # per-eval usage bases computed ON DEVICE in the same dispatch
@@ -437,6 +491,37 @@ class KernelBatchCollector:
         # thread per batch)
         from .. import metrics
 
+        t_disp = time.monotonic()
+        cache_after = compile_cache_size()
+        recompiled = (
+            cache_before >= 0 and cache_after > cache_before
+        )
+        # device-aware span set, per participating eval: host build →
+        # async dispatch → on-device compute (stamped at the existing
+        # materialization sync points — no added syncs on the hot path).
+        # A dispatch that grew the jit cache paid an XLA trace+compile in
+        # its window: flagged, with the padded shapes in the tags, so the
+        # 51200-vs-50176 off-bucket class is visible per trace instead of
+        # inferred from bench outlier splits (shapes already round
+        # through the one _bucket policy; the flag catches the misses)
+        dispatch_tags = {
+            "batch_evals": len(parked),
+            "padded": f"E{E}xG{G}xA{A}xN{N}xV{V}",
+            "mirror": shared.mirror is not None,
+        }
+        if recompiled:
+            dispatch_tags["jit_cache_delta"] = cache_after - cache_before
+        for ctx in trace_ctxs:
+            tracer.record_span(
+                "drain.build", ctx, t0, t_build,
+                tags={"batch_evals": len(parked)},
+            )
+            tracer.record_span(
+                "drain.kernel_dispatch", ctx, t_build, t_disp,
+                tags=dispatch_tags,
+                flags=("recompile",) if recompiled else (),
+            )
+
         fired = []
         fire_lock = threading.Lock()
         t_dispatch = t_build
@@ -446,14 +531,21 @@ class KernelBatchCollector:
                 if fired:
                     return
                 fired.append(True)
-            dt = time.monotonic() - t_dispatch
+            now = time.monotonic()
+            dt = now - t_dispatch
             LAST_DRAIN_STATS["kernel_s"] = dt
             metrics.sample("drain.batch_kernel", dt)
+            for ctx in root_ctxs:
+                tracer.record_span(
+                    "drain.device_compute", ctx, t_disp, now,
+                    tags={"batch_evals": len(root_ctxs)},
+                )
 
         for e, (park, a_start, a_len) in enumerate(slices):
             park.placements = _LazySlice(
                 placements, slice(a_start, a_start + a_len),
                 on_sync=record_kernel,
+                trace_ctx=tracer.ctx_for_eval(park.prep.eval_id),
             )
             park.used0 = _LazySlice(bases, e, on_sync=record_kernel)
 

@@ -312,6 +312,23 @@ def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
     return _plan_batch_jit(args, init, n_real)
 
 
+def compile_cache_size() -> int:
+    """Total compiled-program cache entries across the jitted planners —
+    the recompile detector shared by bench.py outlier splits and the
+    trace plane's flagged-span hook (a drain dispatch whose delta is
+    nonzero paid an XLA trace+compile inside its window: the
+    51200-vs-50176 off-bucket class, made visible). -1 when the internals
+    move (detector degrades, never breaks dispatch)."""
+    try:
+        return (
+            _plan_batch_jit._cache_size()
+            + _plan_batch_runs_jit._cache_size()
+            + _plan_batch_windowed_jit._cache_size()
+        )
+    except Exception:
+        return -1
+
+
 # ---------------------------------------------------------------------------
 # Rotation-parallel windowed planner
 # ---------------------------------------------------------------------------

@@ -339,7 +339,29 @@ class ColumnarMirror:
                         rebuilt = True
                         break
                     try:
+                        # plan frames carry the raft index the FSM linked
+                        # to the committing evals' traces: the mirror's
+                        # O(delta) patch becomes the last hop of each
+                        # eval's span tree (submit → ... → mirror patch).
+                        # enabled-gated: the per-frame lookup must cost
+                        # nothing with tracing off (this is the drain
+                        # hot path the overhead budget guards)
+                        from ..trace import tracer
+
+                        trace_ctxs = (
+                            tracer.ctxs_for_index(index)
+                            if tracer.enabled
+                            else ()
+                        )
+                        tp0 = time.monotonic() if trace_ctxs else 0.0
                         self._apply_frame(snapshot, index, events)
+                        if trace_ctxs:
+                            tp1 = time.monotonic()
+                            for ctx in trace_ctxs:
+                                tracer.record_span(
+                                    "mirror.patch", ctx, tp0, tp1,
+                                    tags={"index": index},
+                                )
                     except _Structural:
                         self._rebuild(snapshot, target, "node_axis")
                         rebuilt = True

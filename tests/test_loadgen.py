@@ -482,8 +482,10 @@ class TestLeakRegressions:
 
     def test_eval_e2e_tap_samples_on_ack(self):
         from nomad_tpu.core.broker import EvalBroker
+        from nomad_tpu.trace import tracer
 
         metrics.reset()
+        tracer.reset()
         b = EvalBroker()
         b.set_enabled(True)
         ev = mock.evaluation()
@@ -493,7 +495,11 @@ class TestLeakRegressions:
         b.ack(ev.id, token)
         snap = metrics.snapshot()
         assert snap["timers"].get("eval.e2e", {}).get("count", 0) == 1
-        assert not b._enqueue_t, "tap state must not outlive the eval"
+        # the tap is the trace root now: released at ack, not leaked
+        assert tracer.ctx_for_eval(ev.id) is None, (
+            "root span state must not outlive the eval"
+        )
+        tracer.reset()
 
 
 class TestDriverCancellation:

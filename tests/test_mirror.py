@@ -369,7 +369,10 @@ class TestSatellites:
             metrics.observe("plan.apply_batch_size", 3)
             metrics.observe("plan.apply_batch_size", 16)
             hists = metrics.snapshot()["hists"]
-            assert hists["plan.apply_batch_size"] == {3: 2, 16: 1}
+            # base-2 bucketed: 3 lands in the [2,3] bucket keyed by its
+            # floor; 16 is its own power-of-two bucket
+            assert hists["plan.apply_batch_size"] == {2: 2, 16: 1}
+            assert metrics.percentile("plan.apply_batch_size", 0.5) == 3
         finally:
             metrics.reset()
 
