@@ -641,7 +641,8 @@ def bench_config3(n_allocs=10000, n_nodes=1000):
     }
 
 
-def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
+def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2,
+                profile=False):
     """Evals/sec through the REAL server path: jobs registered against a
     running server with default_scheduler=tpu-batch and batch_drain workers,
     evals fused into multi-eval kernel batches by the broker drain
@@ -690,6 +691,7 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
         while not stop_sampler.wait(0.05):
             depth_samples.append(server.planner.queue.depth())
 
+    profiler = None
     try:
         for node in build_nodes(n_nodes):
             server.node_register(node)
@@ -711,7 +713,17 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
             tg.tasks[0].resources.networks = []
             jobs.append(job)
 
-        threading.Thread(target=sampler, daemon=True).start()
+        threading.Thread(
+            target=sampler, daemon=True, name="bench-depth-sampler"
+        ).start()
+        # profiled arm of the A/B: the sampling wall-clock profiler
+        # (nomad_tpu/debug) rides the SAME timed window it perturbs, so
+        # the overhead measurement and the blocked-site attribution come
+        # from one run
+        if profile:
+            from nomad_tpu.debug.profiler import SamplingProfiler
+
+            profiler = SamplingProfiler(hz=100).start()
         t0 = time.monotonic()
         eval_ids = [server.job_register(j) for j in jobs]
         pending = set(eval_ids)
@@ -723,6 +735,8 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
                     pending.discard(eid)
             time.sleep(0.02)
         elapsed = time.monotonic() - t0
+        profile_report = profiler.stop() if profiler is not None else None
+        profiler = None  # stopped; the finally must not re-join it
         stop_sampler.set()
         placed = sum(
             len(server.state.allocs_by_job(j.namespace, j.id)) for j in jobs
@@ -774,9 +788,29 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2):
             # TRACES (nomad_tpu/trace): the artifact carries the verdict
             # the stage timers above only let a reader infer
             "critical_path": _drain_critical_path(),
+            # sampling-profiler verdict for the profiled A/B arm: the
+            # lock/wait table (folded stacks dropped from the artifact —
+            # they're the bundle's job) + the headline number
+            "profile": (
+                {
+                    "samples": profile_report["samples"],
+                    "hz_actual": profile_report["hz_actual"],
+                    "threads": profile_report["threads"],
+                    "applier_block_frac": profile_report[
+                        "applier_block_frac"
+                    ],
+                    "blocked_sites": profile_report["blocked_sites"][:10],
+                }
+                if profile_report is not None
+                else None
+            ),
         }
     finally:
         stop_sampler.set()
+        # an exception before the happy-path stop must not leave a
+        # 100Hz sampler perturbing every later bench section
+        if profiler is not None:
+            profiler.stop()
         server.stop()
 
 
@@ -910,6 +944,67 @@ def bench_config5(n_nodes=10000):
     }
 
 
+#: pinned continuous-profiling overhead budget for the 4-worker drain
+#: A/B: the ~100Hz wall-clock sampler (nomad_tpu/debug/profiler.py) must
+#: cost ≤ this on the path it watches, or it is not an always-on tool
+PROFILE_OVERHEAD_BUDGET_PCT = 3.0
+
+
+def bench_profile_ab(base_run=None, n_jobs=200, n_nodes=500, workers=4):
+    """Profiled vs unprofiled 4-worker drain (same config as the
+    worker-scaling curve's top tier; ``base_run`` reuses that curve's
+    4-worker result as the first unprofiled sample). Best-of per arm —
+    the same chip-load-noise guard every drain section uses. The
+    profiled arm's blocked-site table is the knee diagnosis WITHOUT the
+    trace plane: the applier path must top the worker-class wait table
+    (ROADMAP item 2 reproduced from whole-process sampling alone)."""
+    base_runs = [base_run] if base_run is not None else []
+    prof_runs = []
+    prof_runs.append(
+        bench_drain(n_jobs=n_jobs, n_nodes=n_nodes, workers=workers,
+                    profile=True)
+    )
+    base_runs.append(
+        bench_drain(n_jobs=n_jobs, n_nodes=n_nodes, workers=workers)
+    )
+    prof_runs.append(
+        bench_drain(n_jobs=n_jobs, n_nodes=n_nodes, workers=workers,
+                    profile=True)
+    )
+    if len(base_runs) < 2:
+        # symmetric arms: best-of-2 profiled vs best-of-1 unprofiled
+        # would bias overhead_pct low under chip-load noise
+        base_runs.append(
+            bench_drain(n_jobs=n_jobs, n_nodes=n_nodes, workers=workers)
+        )
+    base_best = min(r["wall_s"] for r in base_runs)
+    prof_best = min(prof_runs, key=lambda r: r["wall_s"])
+    overhead = (
+        (prof_best["wall_s"] - base_best) / base_best * 100.0
+        if base_best
+        else 0.0
+    )
+    prof = prof_best["profile"] or {}
+    worker_sites = [
+        r for r in prof.get("blocked_sites", []) if r["class"] == "worker"
+    ]
+    return {
+        "workers": workers,
+        "jobs": n_jobs,
+        "nodes": n_nodes,
+        "base_wall_s": [round(r["wall_s"], 3) for r in base_runs],
+        "profiled_wall_s": [round(r["wall_s"], 3) for r in prof_runs],
+        "overhead_pct": round(overhead, 2),
+        "budget_pct": PROFILE_OVERHEAD_BUDGET_PCT,
+        "within_budget": overhead <= PROFILE_OVERHEAD_BUDGET_PCT,
+        "profile": prof,
+        "applier_block_frac": prof.get("applier_block_frac"),
+        "top_worker_blocked_site": (
+            worker_sites[0]["site"] if worker_sites else None
+        ),
+    }
+
+
 #: pinned trace-overhead budget for the headline A/B (acceptance: traced
 #: vs untraced on the SAME box — never compare to BENCH_r* numbers; the
 #: tier-1 gate in tests/test_trace.py enforces the same pin at small
@@ -1014,6 +1109,11 @@ def main():
             bench_drain(n_jobs=200, n_nodes=500, workers=w)
             for w in (1, 2, 4)
         ]
+        # continuous-profiling A/B on the 4-worker drain (the top
+        # worker-scaling tier doubles as the first unprofiled arm)
+        detail["profile_ab"] = bench_profile_ab(
+            base_run=detail["worker_scaling"][-1]
+        )
     e2e = headline["end_to_end_s"]
     parities = [headline["parity_exact_full"], headline["parity_oracle"]]
     detail["parity"] = round(min(parities), 5)
@@ -1096,6 +1196,12 @@ def main():
         parts.append(f"soak_slo_score={soak['slo_score']}")
         to = detail["trace_overhead"]
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
+        pab = detail["profile_ab"]
+        parts.append(f"profile_overhead_pct={pab['overhead_pct']}")
+        parts.append(f"applier_block_frac={pab['applier_block_frac']}")
+        parts.append(
+            f"profile_block_site={pab['top_worker_blocked_site']}"
+        )
         # retained by the LAST drain section (ws[-1] = the 4-worker run):
         # its critical path is the worker-scaling verdict from traces
         ws_cp = (ws[-1].get("critical_path") or {}) if ws else {}

@@ -31,6 +31,7 @@ from . import (  # noqa: F401,E402
     lockgraph,
     raft_hygiene,
     span_hygiene,
+    threads,
 )
 
 

@@ -73,6 +73,20 @@ def _is_container_ctor(node: ast.AST) -> bool:
         # literal {} / [] — non-empty literals are config tables, not caches
         return not getattr(node, "keys", None) and not getattr(node, "elts", None)
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id == "deque":
+            # deque with a REAL maxlen is bounded by construction — the
+            # ring idiom this checker must not cry wolf on. An explicit
+            # maxlen=None is a bare unbounded deque and still flags.
+            def _bound(arg):
+                return not (
+                    isinstance(arg, ast.Constant) and arg.value is None
+                )
+
+            for kw in node.keywords:
+                if kw.arg == "maxlen":
+                    return not _bound(kw.value)
+            if len(node.args) == 2:  # deque(iterable, maxlen)
+                return not _bound(node.args[1])
         return node.func.id in _CONTAINER_CALLS
     return False
 

@@ -1,0 +1,84 @@
+"""Thread-naming checker: every ``threading.Thread``/``threading.Timer``
+spawn must carry a descriptive ``name=``.
+
+The debug plane's sampling profiler (``nomad_tpu/debug/profiler.py``)
+classifies threads by NAME — "worker", "plan-applier", "raft", ... —
+so the flame graph and the blocked-site table can say "the workers
+spend 60% of wall blocked on the applier" instead of "Thread-47 waits a
+lot". An unnamed spawn lands in the ``other`` bucket and silently
+erodes every attribution built on the census (flight-recorder thread
+classes, ``applier_block_frac``, watchdog stall rules).
+
+Rule ``thread-unnamed`` flags any ``Thread(...)``/``Timer(...)`` call
+resolved to the ``threading`` module (``threading.Thread``, an aliased
+``_threading.Thread``, or a ``from threading import Thread`` name)
+without a ``name=`` keyword. ``**kwargs`` spreads are trusted to carry
+one (the call site can't be proven either way). Subclass constructors
+that set their own name internally are the expected suppression class —
+``# nta: ignore[thread-unnamed]`` with a WHY.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, Project, dotted, register
+
+_SPAWN_ATTRS = {"Thread", "Timer"}
+
+
+def _threading_aliases(mod) -> tuple[set[str], set[str]]:
+    """(module aliases for ``threading``, bare names bound to
+    Thread/Timer via ``from threading import ...``)."""
+    mod_aliases: set[str] = set()
+    bare: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "threading":
+                    mod_aliases.add(alias.asname or "threading")
+        elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in _SPAWN_ATTRS:
+                    bare.add(alias.asname or alias.name)
+    return mod_aliases, bare
+
+
+@register(
+    "thread-unnamed",
+    "threading.Thread/Timer spawned without a descriptive name= (the "
+    "debug profiler classifies threads by name)",
+)
+def check_thread_names(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in project.modules:
+        mod_aliases, bare = _threading_aliases(mod)
+        if not mod_aliases and not bare:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            kind = None
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SPAWN_ATTRS
+                and dotted(func.value) in mod_aliases
+            ):
+                kind = func.attr
+            elif isinstance(func, ast.Name) and func.id in bare:
+                kind = func.id
+            if kind is None:
+                continue
+            keywords = {kw.arg for kw in node.keywords}
+            if "name" in keywords or None in keywords:
+                continue  # named, or **kwargs (can't prove; trust it)
+            findings.append(
+                Finding(
+                    "thread-unnamed", mod.relpath, node.lineno,
+                    f"threading.{kind} spawned without name= — the "
+                    "profiler/flight-recorder classify threads by name; "
+                    "give it a descriptive one",
+                )
+            )
+    return findings

@@ -68,7 +68,7 @@ class ApiClient:
         return ExecWsSession(ws)
 
     def _request(self, method: str, path: str, params=None, body=None,
-                 headers=None):
+                 headers=None, raw=False):
         url = self.address + path
         params = dict(params or {})
         # the client's namespace rides every request unless overridden
@@ -86,9 +86,14 @@ class ApiClient:
             req.add_header("X-Nomad-Token", self.token)
         try:
             with urllib.request.urlopen(req, timeout=330) as resp:
-                payload = json.loads(resp.read() or b"null")
+                content = resp.read()
                 index = resp.headers.get("X-Nomad-Index")
-                return payload, int(index) if index else None
+                index = int(index) if index else None
+                if raw:
+                    # binary surfaces (the debug-bundle tarball): bytes
+                    # as served, no JSON decode
+                    return content, index
+                return json.loads(content or b"null"), index
         except urllib.error.HTTPError as e:
             try:
                 message = json.loads(e.read()).get("error", str(e))
@@ -237,6 +242,40 @@ class ApiClient:
 
     def trace_critical_path(self, tail: float = 0.99) -> dict:
         return self.get("/v1/trace/critical-path", tail=tail)[0]
+
+    # -- debug plane (OBSERVABILITY.md: profiler / bundles) --------------
+    def debug_pprof(self, profile: str = "", seconds: float = None,
+                    hz: float = None) -> dict:
+        """``/debug/pprof/<profile>`` (enable_debug-gated): the default
+        empty profile returns the one-shot thread-stacks+gc dump;
+        ``profile="profile"`` with ``seconds=N`` runs the sampling
+        wall-clock profiler and returns its folded-stack report."""
+        params = {}
+        if seconds is not None:
+            params["seconds"] = seconds
+        if hz is not None:
+            params["hz"] = hz
+        return self.get(f"/debug/pprof/{profile}", **params)[0]
+
+    def debug_bundle_json(self, seconds: float = 1.0) -> dict:
+        """The bundle's manifest + parsed contents inline (?format=json)."""
+        return self.get(
+            "/v1/debug/bundle", seconds=seconds, format="json"
+        )[0]
+
+    def debug_bundle(self, seconds: float = 1.0,
+                     output: Optional[str] = None) -> bytes:
+        """Capture a debug bundle tarball from the agent (the `operator
+        debug` wire call); returns the gzip bytes and writes them to
+        ``output`` when given."""
+        data, _ = self._request(
+            "GET", "/v1/debug/bundle", params={"seconds": seconds},
+            raw=True,
+        )
+        if output:
+            with open(output, "wb") as f:
+                f.write(data)
+        return data
 
     def validate_job(self, job_dict: dict) -> dict:
         return self.put("/v1/validate/job", body={"Job": job_dict})[0]

@@ -397,7 +397,9 @@ class HTTPServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="http-serve"
+        )
         self._thread.start()
         if self.server is not None and hasattr(self.server, "advertise_http"):
             # publish our HTTP address for cross-region forwarding
@@ -1093,30 +1095,62 @@ class HTTPServer:
             raise KeyError("this agent runs no client")
         return {"Reclaimed": reclaimed}, None
 
+    def _check_debug_enabled(self):
+        if not self.server.config.get("enable_debug"):
+            raise PermissionError("debug endpoints are disabled (enable_debug)")
+
     @route("GET", r"/debug/pprof/(?P<profile>[a-z]*)", acl="agent:read")
     def debug_pprof(self, m, query, body):
         """Runtime introspection (the Go pprof handlers' role,
-        http.go:218-222): thread stacks + gc stats, gated on
-        enable_debug exactly like the reference."""
-        if not self.server.config.get("enable_debug"):
-            raise PermissionError("debug endpoints are disabled (enable_debug)")
-        import gc as gc_mod
-        import sys
-        import threading as threading_mod
-        import traceback
+        http.go:218-222), gated on enable_debug exactly like the
+        reference. ``/debug/pprof/`` (and any non-``profile`` name)
+        keeps the original one-shot thread-stacks+gc shape;
+        ``/debug/pprof/profile?seconds=N`` runs the debug plane's
+        sampling wall-clock profiler (Go CPU-profile parity) and
+        returns its folded-stack report."""
+        self._check_debug_enabled()
+        from ..debug import profiler as dbg_profiler
 
-        names = {t.ident: t.name for t in threading_mod.enumerate()}
-        stacks = {}
-        for ident, frame in sys._current_frames().items():
-            stacks[names.get(ident, str(ident))] = traceback.format_stack(frame)
-        return {
-            "threads": stacks,
-            "thread_count": len(stacks),
-            "gc": {
-                "counts": gc_mod.get_count(),
-                "stats": gc_mod.get_stats(),
-            },
-        }, None
+        if m["profile"] == "profile":
+            seconds = min(max(float(query.get("seconds", "1")), 0.05), 30.0)
+            hz = min(max(float(query.get("hz", "100")), 1.0), 1000.0)
+            return dbg_profiler.profile(seconds, hz=hz), None
+        return dbg_profiler.thread_dump(), None
+
+    @route("GET", r"/v1/debug/bundle", acl="agent:read")
+    def debug_bundle(self, m, query, body):
+        """`nomad operator debug` over HTTP: capture a full debug
+        bundle (profiles, flight-recorder dump, slowest traces,
+        metrics, redacted config, findings) and stream it back as a
+        gzip tarball (default) or inline JSON (?format=json). Gated on
+        enable_debug like the pprof routes."""
+        self._check_debug_enabled()
+        import json as json_mod
+        import os
+        import tempfile
+
+        from ..debug import bundle as bundle_mod
+
+        seconds = min(max(float(query.get("seconds", "1")), 0.0), 30.0)
+        with tempfile.TemporaryDirectory(prefix="nomad-tpu-debug-") as tmp:
+            dest = os.path.join(tmp, "bundle")
+            manifest = bundle_mod.capture_bundle(
+                self.server, dest, profile_seconds=seconds, reason="http"
+            )
+            if query.get("format") == "json":
+                files = {}
+                for fn in manifest["files"]:
+                    with open(os.path.join(dest, fn), encoding="utf-8") as f:
+                        raw = f.read()
+                    files[fn] = (
+                        json_mod.loads(raw) if fn.endswith(".json") else raw
+                    )
+                return {"manifest": manifest, "files": files}, None
+            tar_path = os.path.join(tmp, "bundle.tar.gz")
+            bundle_mod.make_tarball(dest, tar_path)
+            with open(tar_path, "rb") as f:
+                data = f.read()
+        return RawResponse("application/gzip", data), None
 
     @route("PUT", r"/v1/validate/job", acl="ns:submit-job")
     def validate_job(self, m, query, body):
@@ -1285,6 +1319,18 @@ class HTTPServer:
             ),
             # trace plane retention/sampling state (nomad_tpu/trace)
             "trace": _tracer.stats(),
+        }
+        # debug plane health (nomad_tpu/debug): flight-recorder depth +
+        # watchdog trip counts — the operator's "is the tape running"
+        recorder = getattr(self.server, "flight_recorder", None)
+        watchdog = getattr(self.server, "watchdog", None)
+        payload["debug"] = {
+            "flight_recorded": (
+                recorder.depth() if recorder is not None else 0
+            ),
+            "watchdog_trips": (
+                watchdog.trip_count if watchdog is not None else 0
+            ),
         }
         if query.get("format") == "prometheus":
             # text exposition (the reference's prometheus telemetry sink,

@@ -605,7 +605,9 @@ def cmd_alloc_exec(args):
         except (OSError, ValueError):
             pass
 
-    t = threading.Thread(target=stdin_pump, daemon=True)
+    t = threading.Thread(
+        target=stdin_pump, daemon=True, name="cli-exec-stdin-pump"
+    )
     t.start()
     try:
         while True:
@@ -996,6 +998,42 @@ def cmd_operator_autopilot_set(args):
         overrides["max_trailing_logs"] = int(args.max_trailing_logs)
     client.autopilot_set_configuration(overrides)
     print("Configuration updated!")
+    return 0
+
+
+def cmd_operator_debug(args):
+    """Capture a debug bundle from the running agent (ref `nomad
+    operator debug`): profiles, flight-recorder dump, slowest traces,
+    metrics, redacted config — one tarball for the support ticket.
+    Requires enable_debug on the agent."""
+    client = _client(args)
+    output = args.output or time.strftime(
+        "nomad-tpu-debug-%Y%m%d-%H%M%S.tar.gz"
+    )
+    data = client.debug_bundle(seconds=args.seconds, output=output)
+    # print the findings headline from the bundle itself, so the
+    # operator sees the verdict without unpacking anything
+    try:
+        import io
+        import tarfile
+
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            member = next(
+                mem for mem in tar.getmembers()
+                if mem.name.endswith("findings.json")
+            )
+            summary = json.loads(tar.extractfile(member).read())
+        frac = summary.get("applier_block_frac")
+        if frac is not None:
+            print(f"applier_block_frac = {frac}")
+        for row in (summary.get("top_blocked_sites") or [])[:3]:
+            print(
+                f"blocked {row['class']:<9} {row['site']:<40} "
+                f"share={row['share']}"
+            )
+    except Exception:
+        pass  # the bundle itself is the deliverable
+    print(f"Debug bundle written to {output}")
     return 0
 
 
@@ -1507,6 +1545,18 @@ def build_parser() -> argparse.ArgumentParser:
     orr = opraftsub.add_parser("remove-peer")
     orr.add_argument("peer_id")
     orr.set_defaults(fn=cmd_operator_raft_remove)
+    odbg = opsub.add_parser(
+        "debug", help="capture a debug bundle from the agent"
+    )
+    odbg.add_argument(
+        "-seconds", type=float, default=2.0,
+        help="sampling-profiler duration inside the bundle (default 2s)",
+    )
+    odbg.add_argument(
+        "-output", default=None,
+        help="tarball path (default nomad-tpu-debug-<timestamp>.tar.gz)",
+    )
+    odbg.set_defaults(fn=cmd_operator_debug)
     okg = opsub.add_parser("keygen", help="generate a gossip encryption key")
     okg.set_defaults(fn=cmd_operator_keygen)
     okr = opsub.add_parser("keyring", help="manage the gossip keyring")

@@ -106,7 +106,9 @@ class CheckRunner:
         if not checks:
             return
         self._checks = checks
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="client-check-watcher"
+        )
         self._thread.start()
 
     def stop(self):

@@ -90,7 +90,10 @@ class TaskRunner:
         self.state.events = list(self._events)
 
     def start(self):
-        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"client-task-runner-{self.task.name}",
+        )
         self._thread.start()
 
     def run(self):
@@ -513,7 +516,10 @@ class AllocRunner:
             # tasks have been running for min_healthy_time, or unhealthy
             # on failure / healthy_deadline expiry. Started only after the
             # runner map is fully populated (it iterates task_runners).
-            t = threading.Thread(target=self._watch_health, daemon=True)
+            t = threading.Thread(
+                target=self._watch_health, daemon=True,
+                name="client-health-watcher",
+            )
             t.start()
         if missing_driver:
             self.task_state_updated()
@@ -814,7 +820,10 @@ class Client:
             self._update_loop,
             self._fingerprint_loop,
         ):
-            t = threading.Thread(target=target, daemon=True)
+            t = threading.Thread(
+                target=target, daemon=True,
+                name=f"client-{target.__name__.strip('_').replace('_', '-')}",
+            )
             t.start()
             self._threads.append(t)
         # external device plugins stream fingerprint changes (chip health

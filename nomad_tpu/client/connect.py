@@ -60,7 +60,10 @@ class _Listener:
         self._name = name
         self._tls = tls_context
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"connect-accept-{name}",
+        )
         self._thread.start()
 
     def stop(self):
@@ -77,7 +80,8 @@ class _Listener:
             except OSError:
                 return
             threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
+                target=self._handle, args=(conn,), daemon=True,
+                name="connect-proxy-conn",
             ).start()
 
     def _handle(self, conn: socket.socket):
@@ -99,7 +103,10 @@ class _Listener:
         if target is None:
             conn.close()
             return
-        threading.Thread(target=_pump, args=(conn, target), daemon=True).start()
+        threading.Thread(
+            target=_pump, args=(conn, target), daemon=True,
+            name="connect-proxy-pump",
+        ).start()
         _pump(target, conn)
 
 

@@ -205,7 +205,10 @@ class MockDriver(Driver):
                 self._timers.pop(key, None)
                 handle.finish(exit_code)
 
+            # nta: ignore[thread-unnamed] WHY: Timer() takes no name
+            # kwarg; named on the next line before start()
             t = threading.Timer(run_for, _finish)
+            t.name = "driver-mock-finish-timer"
             t.daemon = True
             self._timers[key] = t
             t.start()
@@ -297,7 +300,10 @@ class MockDriver(Driver):
             self._timers.pop(key, None)
             handle.finish(exit_code)
 
+        # nta: ignore[thread-unnamed] WHY: Timer() takes no name kwarg;
+        # named on the next line before start()
         t = threading.Timer(remaining, _finish)
+        t.name = "driver-mock-finish-timer"
         t.daemon = True
         self._timers[key] = t
         t.start()
@@ -390,7 +396,9 @@ class RawExecDriver(Driver):
                 t.join(timeout=5.0)
             handle.finish(code)
 
-        threading.Thread(target=waiter, daemon=True).start()
+        threading.Thread(
+            target=waiter, daemon=True, name="driver-exec-waiter"
+        ).start()
         return handle
 
     def start_task(self, task: Task, task_dir: str) -> TaskHandle:
@@ -521,7 +529,9 @@ class RawExecDriver(Driver):
             if not handle._done.is_set():
                 handle.finish(0)
 
-        threading.Thread(target=poller, daemon=True).start()
+        threading.Thread(
+            target=poller, daemon=True, name="driver-pid-poller"
+        ).start()
         return handle
 
 

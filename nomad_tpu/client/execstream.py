@@ -130,10 +130,12 @@ class ExecProcess:
 
         pumps = [
             threading.Thread(
-                target=pump, args=(self.proc.stdout, "stdout"), daemon=True
+                target=pump, args=(self.proc.stdout, "stdout"), daemon=True,
+                name="exec-stdout-pump",
             ),
             threading.Thread(
-                target=pump, args=(self.proc.stderr, "stderr"), daemon=True
+                target=pump, args=(self.proc.stderr, "stderr"), daemon=True,
+                name="exec-stderr-pump",
             ),
         ]
         for t in pumps:
@@ -147,7 +149,9 @@ class ExecProcess:
                 done.set()
                 cv.notify()
 
-        threading.Thread(target=waiter, daemon=True).start()
+        threading.Thread(
+            target=waiter, daemon=True, name="exec-proc-waiter"
+        ).start()
         while True:
             with cv:
                 while not frames and not done.is_set():

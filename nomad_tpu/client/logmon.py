@@ -100,6 +100,6 @@ def start_copier(fd, writer: RotatingWriter) -> threading.Thread:
                 pass
             writer.close()
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, daemon=True, name="logmon-fifo-pump")
     t.start()
     return t

@@ -22,6 +22,13 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     # event_broker { enabled = true  event_buffer_size = 4096
     #                subscriber_buffer = 1024 }
     "event_broker": {},
+    # operator debug plane (nomad_tpu/debug; OBSERVABILITY.md):
+    # debug { flight_recorder = true   # false: no sampling thread
+    #         flight_interval = 1.0  flight_retain = 512
+    #         bundle_dir = "/var/lib/nomad-tpu/debug"
+    #         watchdog { bundle_keep = 8   # newest auto-bundles kept
+    #                    plan_queue_wait_p99 { threshold_ms = 2000 } } }
+    "debug": {},
 }
 
 
@@ -93,6 +100,13 @@ def server_config_from_agent(config: dict) -> dict:
     # HTTP provider when an address is configured (core/vault.py)
     if config.get("vault"):
         out["vault"] = dict(config["vault"])
+    # debug plane: the pprof/bundle HTTP gate rides the top-level
+    # enable_debug key (ref config.go EnableDebug); the debug{} stanza
+    # tunes the flight recorder / watchdog / bundle capture
+    if config.get("enable_debug"):
+        out["enable_debug"] = True
+    if config.get("debug"):
+        out["debug"] = dict(config["debug"])
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",

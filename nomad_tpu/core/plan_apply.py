@@ -371,7 +371,9 @@ class Planner:
     def start(self):
         self.queue.set_enabled(True)
         self._stop.clear()
-        self._thread = threading.Thread(target=self._apply_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._apply_loop, daemon=True, name="plan-applier"
+        )
         self._thread.start()
 
     def stop(self):
@@ -637,6 +639,7 @@ class Planner:
                 target=self._async_commit_batch,
                 args=(entries, noops, box),
                 daemon=True,
+                name="plan-commit",
             )
             t.start()
             outstanding = (t, box)

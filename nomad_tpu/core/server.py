@@ -125,6 +125,32 @@ class Server:
             from ..tpu.mirror import ColumnarMirror
 
             self.columnar_mirror = ColumnarMirror(self.state, self.event_broker)
+        # operator debug plane (nomad_tpu/debug; OBSERVABILITY.md): the
+        # flight recorder is the whole-process tape the watchdog rules
+        # and debug bundles read. Constructed always (cheap: one deque),
+        # its sampling thread starts with the server unless the debug{}
+        # stanza disables it. Bundles auto-capture on watchdog trips
+        # only when a bundle_dir is configured — a default agent never
+        # surprises the operator with disk writes.
+        dbg_cfg = dict(self.config.get("debug") or {})
+        from ..debug import FlightRecorder, Watchdog
+
+        self.flight_recorder = FlightRecorder(
+            self,
+            interval=float(dbg_cfg.get("flight_interval", 1.0)),
+            retain=int(dbg_cfg.get("flight_retain", 512)),
+        )
+        self.watchdog = None
+        wd_cfg = dbg_cfg.get("watchdog", {})
+        if wd_cfg is not False:
+            self.watchdog = Watchdog(
+                self,
+                self.flight_recorder,
+                config=wd_cfg if isinstance(wd_cfg, dict) else {},
+                bundle_dir=str(dbg_cfg.get("bundle_dir") or ""),
+            )
+            self.flight_recorder.observer = self.watchdog.on_sample
+        self._flight_enabled = bool(dbg_cfg.get("flight_recorder", True))
         self.planner = Planner(self.state)
         # max independently-verified plans folded into ONE raft entry
         # (server stanza `plan_apply_batch`; the observed fold sizes are
@@ -765,6 +791,8 @@ class Server:
     # ------------------------------------------------------------------
     def start(self, num_workers: int = 2, wait_for_leader: Optional[float] = None):
         self._running = True
+        if self._flight_enabled:
+            self.flight_recorder.start()
         if self.config.get("prewarm_kernels"):
             # compile the planner shape ladder in the background so the
             # first real eval doesn't eat the cold-compile latency
@@ -801,7 +829,9 @@ class Server:
                         time.sleep(delay)
                         delay = min(delay * 2, 10.0)
 
-                threading.Thread(target=_join, daemon=True).start()
+                threading.Thread(
+                    target=_join, daemon=True, name="gossip-retry-join"
+                ).start()
         drain_n = int(self.config.get("batch_drain", 0))
         for i in range(num_workers):
             if drain_n > 1:
@@ -825,6 +855,11 @@ class Server:
 
     def stop(self):
         self._running = False
+        self.flight_recorder.stop()
+        if self.watchdog is not None:
+            # a bundle capture racing teardown reads dying subsystems;
+            # bounded wait, capture errors are already swallowed
+            self.watchdog.wait_idle(timeout=5.0)
         self._hb_expire_q.put(None)  # unpark the expiry drainer, if any
         if self.gossip is not None:
             try:
@@ -883,15 +918,25 @@ class Server:
         with self._leader_cond:
             self._leader = True
             self._leader_cond.notify_all()
-        self._reaper = threading.Thread(target=self._reap_failed_evals, daemon=True)
+        self._reaper = threading.Thread(
+            target=self._reap_failed_evals, daemon=True,
+            name="eval-failed-reaper",
+        )
         self._reaper.start()
         threading.Thread(
-            target=self._reap_dup_blocked_evals, daemon=True
+            target=self._reap_dup_blocked_evals, daemon=True,
+            name="blocked-dup-reaper",
         ).start()
-        self._gc_scheduler = threading.Thread(target=self._schedule_core_gc, daemon=True)
+        self._gc_scheduler = threading.Thread(
+            target=self._schedule_core_gc, daemon=True,
+            name="core-gc-scheduler",
+        )
         self._gc_scheduler.start()
         if self._acl_replication_target():
-            t = threading.Thread(target=self._acl_replication_loop, daemon=True)
+            t = threading.Thread(
+                target=self._acl_replication_loop, daemon=True,
+                name="acl-replication",
+            )
             t.start()
         self._reconcile_gossip_members()
         logger.info("server %s: leadership established", self.raft.node_id)

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Optional
 
+import itertools
+
 from ..scheduler.scheduler import new_scheduler
 from ..testing import faults as _faults
 from ..trace import tracer
@@ -29,6 +31,10 @@ logger = logging.getLogger("nomad_tpu.worker")
 
 DEQUEUE_TIMEOUT = 0.5
 RAFT_SYNC_LIMIT = 5.0
+
+#: process-wide worker thread numbering — the name is the debug
+#: profiler's classification key ("worker" class)
+_WORKER_SEQ = itertools.count()
 
 
 
@@ -53,7 +59,10 @@ class Worker:
     # ------------------------------------------------------------------
     def start(self):
         self._stop.clear()
-        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"sched-worker-{next(_WORKER_SEQ)}",
+        )
         self._thread.start()
 
     def stop(self):
@@ -337,7 +346,11 @@ class BatchDrainWorker(Worker):
                         ev.id,
                     )
 
-            t = threading.Thread(target=run_one, daemon=True)
+            # "drain-eval" classifies as worker-class for the profiler:
+            # these lanes do the actual plan.submit waiting
+            t = threading.Thread(
+                target=run_one, daemon=True, name=f"drain-eval-{ev.id[:8]}"
+            )
             threads.append(t)
             t.start()
         return threads

@@ -149,7 +149,10 @@ class ImageCoordinator:
             refs.discard(container)
             if refs or not self.cleanup:
                 return
+            # nta: ignore[thread-unnamed] WHY: Timer() takes no name
+            # kwarg; named on the next line before start()
             timer = threading.Timer(self.remove_delay, self._remove, (image,))
+            timer.name = "docker-image-remove-timer"
             timer.daemon = True
             self._timers[image] = timer
         timer.start()
@@ -719,7 +722,9 @@ class DockerDriver(Driver):
             if not handle._done.is_set():
                 handle.finish(code)
 
-        threading.Thread(target=waiter, daemon=True).start()
+        threading.Thread(
+            target=waiter, daemon=True, name="docker-exec-waiter"
+        ).start()
 
     # ------------------------------------------------------------------
     def stop_task(self, handle: TaskHandle, timeout: float = 5.0,

@@ -128,7 +128,10 @@ class Gossip:
     # ------------------------------------------------------------------
     def start(self):
         for target in (self._listen_loop, self._probe_loop):
-            t = threading.Thread(target=target, daemon=True)
+            t = threading.Thread(
+                target=target, daemon=True,
+                name=f"swim-{target.__name__.strip('_').replace('_', '-')}",
+            )
             t.start()
             self._threads.append(t)
 

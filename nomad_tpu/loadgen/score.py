@@ -23,26 +23,18 @@ Sampled per tick:
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 
+# ONE process sampler: the scorekeeper reads the debug plane's flight
+# recorder instead of running a private RSS/queue sampler (rss_mb is
+# re-exported — it moved to nomad_tpu/debug/flight.py with the rest of
+# the sampling)
+from ..debug.flight import FlightRecorder, rss_mb, rss_slope  # noqa: F401
 from ..testing.invariants import (
     IncrementalInvariantChecker,
     check_cluster_invariants,
 )
-
-_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
-
-
-def rss_mb() -> float:
-    try:
-        with open("/proc/self/statm") as f:
-            return int(f.read().split()[1]) * _PAGE / 1e6
-    except OSError:  # non-linux fallback
-        import resource
-
-        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
 class _StreamProbe:
@@ -116,10 +108,23 @@ class Scorekeeper:
         probes: int = 2,
         max_fit_nodes: int = 512,
         seed: int = 0,
+        recorder: FlightRecorder | None = None,
     ):
         self.server = server
         self.http_address = http_address
         self.interval = interval
+        # process sampling delegates to the flight recorder (the debug
+        # plane's ring): the server's own recorder when it has one, so
+        # watchdog rules see the storm's samples too; a private passive
+        # ring otherwise. The scorekeeper tick drives record() and keeps
+        # the returned sample — one sampler, one reader, and the
+        # SOAK_rNN.json field names unchanged (sample_process emits the
+        # same keys the private sampler did).
+        self.recorder = (
+            recorder
+            or getattr(server, "flight_recorder", None)
+            or FlightRecorder(server, interval=interval)
+        )
         self.invariants_every = max(1, invariants_every)
         self.samples: list[dict] = []
         self.checker = IncrementalInvariantChecker(
@@ -149,6 +154,14 @@ class Scorekeeper:
     # ------------------------------------------------------------------
     def start(self):
         self._t0 = time.monotonic()
+        # exactly ONE driver for the shared ring: while the scorekeeper
+        # ticks record() at the storm cadence, the server recorder's own
+        # thread must not also sample — a mixed cadence halves the
+        # wall-time the watchdog's consecutive/window rules think they
+        # cover (restored on stop())
+        self._recorder_was_running = self.recorder.running
+        if self._recorder_was_running:
+            self.recorder.stop()
         for p in self._probes:
             p.start()
         self._thread.start()
@@ -165,6 +178,8 @@ class Scorekeeper:
             self._closed = True
         for p in self._probes:
             p.stop()
+        if getattr(self, "_recorder_was_running", False):
+            self.recorder.start()
 
     # ------------------------------------------------------------------
     def _run(self):
@@ -181,53 +196,18 @@ class Scorekeeper:
                 )
 
     def _sample(self, ticks: int):
-        from .. import metrics
-
         t = round(time.monotonic() - self._t0, 2)
-        snap_metrics = metrics.snapshot()
-        timers = snap_metrics["timers"]
-        gen = self.server.state._gen
-        broker = self.server.event_broker
-        broker_stats = broker.stats() if broker is not None else {}
-        head = broker_stats.get("latest_index", 0)
-        sample = {
-            "t": t,
-            "rss_mb": round(rss_mb(), 1),
-            "index": self.server.state.latest_index(),
-            "allocs": len(gen.allocs),
-            "evals": len(gen.evals),
-            "jobs": len(gen.jobs),
-            "nodes": len(gen.nodes),
-            "deployments": len(gen.deployments),
-            "eval_e2e_p99_ms": timers.get("eval.e2e", {}).get("p99_ms", 0.0),
-            "eval_e2e_mean_ms": timers.get("eval.e2e", {}).get("mean_ms", 0.0),
-            "plan_queue_wait_p99_ms": timers.get("plan.queue_wait", {}).get(
-                "p99_ms", 0.0
-            ),
-            "plan_submit_p99_ms": timers.get("plan.submit", {}).get(
-                "p99_ms", 0.0
-            ),
-            "plan_queue_depth": (
-                self.server.planner.queue.depth()
-                if getattr(self.server, "planner", None) is not None
-                else 0
-            ),
-            "broker_ready": self.server.eval_broker.stats().get(
-                "total_ready", 0
-            ) if getattr(self.server, "eval_broker", None) else 0,
-            "subscribers": broker_stats.get("subscribers", 0),
-            "slow_consumers_closed": broker_stats.get(
-                "slow_consumers_closed", 0
-            ),
-            "probe_lag": [
-                max(0, head - p.last_index) for p in self._probes
-            ],
-        }
-        mirror = getattr(self.server, "columnar_mirror", None)
-        if mirror is not None:
-            ms = mirror.stats()
-            sample["mirror_hits"] = ms.get("hits", 0)
-            sample["mirror_rebuilds"] = ms.get("rebuilds", 0)
+        # one sampler for the whole process: the flight recorder takes
+        # the snapshot (into its ring, where the watchdog sees it) and
+        # this tick keeps the same dict for the soak report — the
+        # field names (rss_mb, plan_queue_wait_p99_ms, broker_ready,
+        # mirror_hits, ...) are sample_process's contract
+        sample = dict(self.recorder.record())
+        head = sample.get("event_latest_index", 0)
+        sample["t"] = t  # the storm timeline, not the recorder's epoch
+        sample["probe_lag"] = [
+            max(0, head - p.last_index) for p in self._probes
+        ]
         sweep = ticks % self.invariants_every == 0
         with self._checker_lock:
             if self._closed:
@@ -274,20 +254,10 @@ class Scorekeeper:
         # post-ramp growth slope: least-squares fit over the last 60% of
         # samples, so a one-tick RSS transient on either endpoint can't
         # flip the bounded-growth SLO (endpoint deltas are hostage to
-        # single-sample noise)
-        slope = 0.0
-        tail = samples[int(len(samples) * 0.4):]
-        if len(tail) >= 2 and tail[-1]["t"] > tail[0]["t"]:
-            ts = [s["t"] / 60.0 for s in tail]
-            ys = [s["rss_mb"] for s in tail]
-            n = len(tail)
-            t_mean = sum(ts) / n
-            y_mean = sum(ys) / n
-            var = sum((t - t_mean) ** 2 for t in ts)
-            cov = sum(
-                (t - t_mean) * (y - y_mean) for t, y in zip(ts, ys)
-            )
-            slope = cov / max(var, 1e-9)
+        # single-sample noise). THE shared fit (debug/flight.py) — the
+        # watchdog's rss_slope rule grades the identical math, so the
+        # soak verdict and the watchdog can never disagree
+        slope = rss_slope(samples[int(len(samples) * 0.4):])
         mirror = getattr(self.server, "columnar_mirror", None)
         report = {
             "scenario": scenario.name,
@@ -311,6 +281,13 @@ class Scorekeeper:
                 "violation_log": self.violation_log,
             },
             "mirror": mirror.stats() if mirror is not None else None,
+            # watchdog verdicts over the same flight-recorder samples
+            # this report is built from (nomad_tpu/debug/watchdog.py)
+            "watchdog": (
+                self.server.watchdog.stats()
+                if getattr(self.server, "watchdog", None) is not None
+                else None
+            ),
             "final_state": samples[-1] if samples else {},
         }
         # per-stage attribution of the eval.e2e tail from RETAINED TRACES
@@ -403,6 +380,7 @@ def summary_line(report: dict) -> str:
         f"eval_p99_max_ms={report['eval_e2e_p99_ms_max']}",
         f"sub_lag_max={report['subscriber_lag_max']}",
         f"trace_bottleneck={(report.get('critical_path') or {}).get('bottleneck')}",
+        f"watchdog_trips={(report.get('watchdog') or {}).get('trips', 0)}",
         f"slo={slo['passed']}/{slo['passed'] + slo['failed']}",
         f"score={slo['score']}",
         f"digest={report['stream_digest'][:12]}",

@@ -59,7 +59,9 @@ class _Conn:
         self._lock = threading.Lock()
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._closed = False
-        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(
+            target=self._read_loop, daemon=True, name="plugin-rpc-reader"
+        ).start()
 
     def _read_loop(self):
         while True:
@@ -273,7 +275,9 @@ class ExternalDriver(Driver):
                     handle._done.set()
                     return
 
-        threading.Thread(target=poller, daemon=True).start()
+        threading.Thread(
+            target=poller, daemon=True, name="plugin-task-poller"
+        ).start()
         return handle
 
     # -- Driver interface -----------------------------------------------
@@ -437,7 +441,9 @@ class ExternalDevicePlugin:
                 elif self._generation is None:
                     self._generation = gen
 
-        self._watch_thread = threading.Thread(target=loop, daemon=True)
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="plugin-device-watcher"
+        )
         self._watch_thread.start()
 
     def shutdown(self):

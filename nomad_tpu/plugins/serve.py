@@ -264,7 +264,8 @@ def _serve(service, socket_path: str, ready_event=None):
             except (ConnectionClosed, OSError):
                 return
             t = threading.Thread(
-                target=dispatch, args=(seq, method, payload), daemon=True
+                target=dispatch, args=(seq, method, payload), daemon=True,
+                name="plugin-serve-dispatch",
             )
             t.start()
     finally:

@@ -389,7 +389,10 @@ class Raft:
                         done.set()
 
         threads = [
-            threading.Thread(target=ask, args=(pid, addr), daemon=True)
+            threading.Thread(
+                target=ask, args=(pid, addr), daemon=True,
+                name=f"raft-vote-{pid}",
+            )
             for pid, addr in peers.items()
         ]
         for t in threads:
@@ -777,6 +780,7 @@ class Raft:
                 target=self._replicate_loop,
                 args=(pid, addr, epoch, cond),
                 daemon=True,
+                name=f"raft-repl-{pid}",
             )
             self._replicators[pid] = t
             t.start()

@@ -139,7 +139,8 @@ def register_endpoints(server, rpc) -> None:
                 dst.close()
 
         up = _threading.Thread(
-            target=pump, args=(stream, client_stream), daemon=True
+            target=pump, args=(stream, client_stream), daemon=True,
+            name="rpc-stream-bridge",
         )
         up.start()
         pump(client_stream, stream)

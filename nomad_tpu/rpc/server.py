@@ -112,7 +112,8 @@ class RpcServer:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="rpc-conn",
             )
             t.start()
 

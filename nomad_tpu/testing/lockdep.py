@@ -41,6 +41,7 @@ import _thread
 import os
 import sys
 import threading
+import time
 from typing import Optional
 
 #: raw (unwrappable) lock guarding the global edge/violation tables;
@@ -51,6 +52,13 @@ _graph_lock = _thread.allocate_lock()
 _edges: dict = {}
 #: human-readable inversion reports, in observation order
 _violations: list = []
+#: site -> [blocked-acquire count, total seconds waited] — per-site
+#: contention accounting for the debug plane's lock-wait table (the
+#: profiler's blocked-site sampling cross-validated by exact timing).
+#: Only acquires that actually BLOCK are counted: the wrappers try a
+#: non-blocking acquire first, so the uncontended fast path costs one
+#: extra C call and no clock reads.
+_contention: dict = {}
 
 _tls = threading.local()
 
@@ -133,6 +141,16 @@ def _note_acquire(wrapper):
     held.append([wrapper, 1])
 
 
+def _note_contention(site: str, waited: float):
+    with _graph_lock:
+        entry = _contention.get(site)
+        if entry is None:
+            _contention[site] = [1, waited]
+        else:
+            entry[0] += 1
+            entry[1] += waited
+
+
 def _note_release(wrapper, full: bool = False):
     held = _held()
     for i in range(len(held) - 1, -1, -1):
@@ -156,7 +174,21 @@ class _LockdepLock:
         self._site = site
 
     def acquire(self, blocking=True, timeout=-1):
-        ok = self._inner.acquire(blocking, timeout)
+        if not blocking:
+            # forward verbatim: the raw lock's ValueError for a
+            # non-blocking call with a timeout must survive wrapping —
+            # the witness must not hide argument misuse tests exist to
+            # catch
+            ok = self._inner.acquire(blocking, timeout)
+        else:
+            # contention accounting: uncontended acquires take the
+            # non-blocking fast path (no clock reads); only a REAL
+            # block pays two monotonic() calls and a table update
+            ok = self._inner.acquire(False)
+            if not ok:
+                t0 = time.monotonic()
+                ok = self._inner.acquire(True, timeout)
+                _note_contention(self._site, time.monotonic() - t0)
         if ok:
             _note_acquire(self)
         return ok
@@ -192,7 +224,13 @@ class _LockdepRLock(_LockdepLock):
         return self._inner._release_save()
 
     def _acquire_restore(self, state):
+        # Condition.wait's re-acquire after notify: the classic convoy
+        # site — timed like any blocked acquire
+        t0 = time.monotonic()
         self._inner._acquire_restore(state)
+        waited = time.monotonic() - t0
+        if waited > 1e-4:
+            _note_contention(self._site, waited)
         _note_acquire(self)
 
     def _is_owned(self):
@@ -233,10 +271,23 @@ def installed() -> bool:
 
 
 def reset():
-    """Drop recorded edges and violations (tests isolate scenarios)."""
+    """Drop recorded edges, violations, and contention (tests isolate
+    scenarios)."""
     with _graph_lock:
         _edges.clear()
         del _violations[:]
+        _contention.clear()
+
+
+def contention() -> dict:
+    """Snapshot of per-site blocked-wait totals:
+    ``site -> {count, wait_s}`` — the lock-wait table the debug bundle
+    and the watchdog's lock_contention rule consume."""
+    with _graph_lock:
+        return {
+            site: {"count": c, "wait_s": round(w, 6)}
+            for site, (c, w) in _contention.items()
+        }
 
 
 def edges() -> dict:

@@ -1,0 +1,19 @@
+#!/usr/bin/env sh
+# Capture an operator debug bundle from a running agent (nomad_tpu/debug;
+# OBSERVABILITY.md "The operator debug plane"). The agent must run with
+# enable_debug = true.
+#
+#   scripts/debug.sh                                  # -> nomad-tpu-debug-<ts>.tar.gz
+#   scripts/debug.sh -seconds 5                       # longer profiler window
+#   scripts/debug.sh -output /tmp/dbg.tar.gz
+#   NOMAD_TPU_ADDR=http://10.0.0.5:4646 scripts/debug.sh
+#
+# The bundle holds: sampling-profiler report + folded flamegraph stacks,
+# the flight-recorder ring (pre-incident tape), thread stacks, slowest-N
+# traces, metrics, REDACTED config, and the findings summary
+# (applier_block_frac, top blocked sites, watchdog trips).
+set -eu
+
+cd "$(dirname "$0")/.."
+
+exec python -m nomad_tpu operator debug "$@"

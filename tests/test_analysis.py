@@ -571,6 +571,106 @@ class TestUnboundedCache:
             {"nomad_tpu/core/x.py": src}, "unbounded-cache"
         )
 
+    def test_deque_maxlen_bounded_by_construction(self):
+        """deque(maxlen=N) is a ring — append-only growth on it must
+        not flag (the flight recorder's idiom); a bare deque() still
+        does."""
+        bounded = (
+            "from collections import deque\n"
+            "class Ring:\n"
+            "    def __init__(self):\n"
+            "        self._ring = deque(maxlen=8)\n"
+            "    def push(self, x):\n"
+            "        self._ring.append(x)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": bounded}, "unbounded-cache"
+        )
+        positional = bounded.replace("deque(maxlen=8)", "deque((), 8)")
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": positional}, "unbounded-cache"
+        )
+        unbounded = bounded.replace("deque(maxlen=8)", "deque()")
+        assert findings_for(
+            {"nomad_tpu/core/x.py": unbounded}, "unbounded-cache"
+        )
+
+
+# ----------------------------------------------------------------------
+# thread-unnamed checker (the debug profiler's classification contract)
+# ----------------------------------------------------------------------
+
+
+class TestThreadNames:
+    def test_unnamed_thread_and_timer_flagged(self):
+        src = (
+            "import threading\n"
+            "def go(fn):\n"
+            "    threading.Thread(target=fn, daemon=True).start()\n"
+            "    threading.Timer(5.0, fn).start()\n"
+        )
+        found = findings_for({"nomad_tpu/core/x.py": src}, "thread-unnamed")
+        assert len(found) == 2, found
+        assert {f.line for f in found} == {3, 4}
+
+    def test_named_spawn_clean(self):
+        src = (
+            "import threading\n"
+            "def go(fn):\n"
+            "    threading.Thread(\n"
+            "        target=fn, daemon=True, name='worker-x'\n"
+            "    ).start()\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "thread-unnamed"
+        )
+
+    def test_aliased_and_from_imports_resolved(self):
+        src = (
+            "import threading as _threading\n"
+            "from threading import Thread\n"
+            "def go(fn):\n"
+            "    _threading.Thread(target=fn).start()\n"
+            "    Thread(target=fn).start()\n"
+        )
+        found = findings_for({"nomad_tpu/core/x.py": src}, "thread-unnamed")
+        assert {f.line for f in found} == {4, 5}
+
+    def test_kwargs_spread_and_unrelated_thread_trusted(self):
+        src = (
+            "import threading\n"
+            "class other:\n"
+            "    Thread = staticmethod(print)\n"
+            "def go(fn, **kw):\n"
+            "    threading.Thread(target=fn, **kw).start()\n"
+            "    other.Thread(fn)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "thread-unnamed"
+        )
+
+    def test_why_suppression_clears(self):
+        src = (
+            "import threading\n"
+            "def go(fn):\n"
+            "    # nta: ignore[thread-unnamed] WHY: named on next line\n"
+            "    t = threading.Timer(5.0, fn)\n"
+            "    t.name = 'fixture-timer'\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "thread-unnamed"
+        )
+
+    def test_tree_has_no_unnamed_spawns(self):
+        """The audit satellite: the real tree is clean — every spawn
+        names its thread (or carries a WHY'd ignore)."""
+        project = Project.load(ROOT)
+        found = [
+            f for f in run(project, ["thread-unnamed"])
+            if f.rule == "thread-unnamed"
+        ]
+        assert found == [], [f.format() for f in found]
+
 
 class TestFramework:
     SRC = "def f(self, snap):\n    self.x_index = snap.latest_index() + 1{}\n"

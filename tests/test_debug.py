@@ -1,0 +1,733 @@
+"""Debug plane tests (nomad_tpu/debug/): sampling profiler attribution,
+lock-contention accounting, flight recorder, watchdog rules + auto
+bundle capture, bundle content/redaction, and the HTTP/CLI round-trips.
+
+The deterministic attribution tests drive the profiler with synthetic
+threads (a spinning hot function; a convoy parked on a PendingPlan
+future) so the assertions are about the attribution machinery, not
+about scheduler load on the test box.
+"""
+
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu import metrics
+from nomad_tpu.debug import (
+    FlightRecorder,
+    SamplingProfiler,
+    Watchdog,
+    capture_bundle,
+    classify_thread,
+    make_tarball,
+    redact_config,
+    render_folded,
+    thread_dump,
+)
+from nomad_tpu.debug.bundle import BUNDLE_FILES
+from nomad_tpu.debug.flight import rss_slope, sample_process
+from nomad_tpu.testing import lockdep
+
+
+def make_server(**extra):
+    from nomad_tpu.core.server import Server
+    from nomad_tpu.raft import InmemTransport, RaftConfig
+
+    cfg = {
+        "seed": 7,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft-dbg",
+            "voters": {"s0": "raft-dbg"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.05,
+                election_timeout_min=0.1,
+                election_timeout_max=0.2,
+            ),
+        },
+    }
+    cfg.update(extra)
+    return Server(cfg)
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+
+class TestProfiler:
+    def test_hot_function_attributed_above_threshold(self):
+        """A synthetic hot function on a worker-named thread must own
+        the overwhelming majority of that thread's samples."""
+        stop = threading.Event()
+
+        def spin_hot():
+            x = 0
+            while not stop.is_set():
+                for i in range(500):
+                    x += i * i
+            return x
+
+        t = threading.Thread(
+            target=spin_hot, daemon=True, name="worker-hot-synthetic"
+        )
+        t.start()
+        try:
+            prof = SamplingProfiler(hz=200).start()
+            time.sleep(0.5)
+            report = prof.stop()
+        finally:
+            stop.set()
+            t.join(timeout=2.0)
+
+        worker_samples = report["threads"].get("worker", 0)
+        assert worker_samples >= 20, report["threads"]
+        hot = sum(
+            count
+            for stack, count in report["folded"].items()
+            if "worker-hot-synthetic" in stack and "spin_hot" in stack
+        )
+        # deterministic: the thread does nothing else — ≥90% of its
+        # samples must land in spin_hot
+        assert hot / worker_samples >= 0.9, (hot, worker_samples)
+        assert report["hz_actual"] > 20
+        # folded rendering round-trips the stacks
+        folded = render_folded(report)
+        assert "spin_hot" in folded
+
+    def test_applier_convoy_names_plan_apply_wait(self):
+        """Worker-class threads parked on PendingPlan.wait (the applier
+        future every real worker blocks on, core/plan_apply.py) must
+        dominate the worker-class blocked-site table and drive
+        applier_block_frac — the ROADMAP item 2 knee signature,
+        reproduced without the trace plane."""
+        from nomad_tpu.core.plan_apply import PendingPlan
+
+        pending = PendingPlan(SimpleNamespace(eval_id="dbg-eval"))
+        threads = [
+            threading.Thread(
+                target=lambda: pending.wait(timeout=3.0),
+                daemon=True,
+                name=f"sched-worker-dbg-{i}",
+            )
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        prof = SamplingProfiler(hz=200).start()
+        time.sleep(0.4)
+        report = prof.stop()
+        pending.respond(None, RuntimeError("test done"))
+        for t in threads:
+            t.join(timeout=2.0)
+
+        assert report["applier_block_frac"] >= 0.9, report[
+            "applier_block_frac"
+        ]
+        worker_rows = [
+            r for r in report["blocked_sites"] if r["class"] == "worker"
+        ]
+        assert worker_rows, report["blocked_sites"]
+        assert worker_rows[0]["site"].endswith("core/plan_apply.py:wait"), (
+            worker_rows[0]
+        )
+
+    def test_thread_classification_contract(self):
+        assert classify_thread("sched-worker-3") == "worker"
+        assert classify_thread("drain-eval-abcd1234") == "worker"
+        assert classify_thread("plan-applier") == "applier"
+        assert classify_thread("plan-commit") == "applier"
+        assert classify_thread("raft-repl-s1") == "raft"
+        assert classify_thread("debug-flight-recorder") == "debug"
+        assert classify_thread("eval-failed-reaper") == "leader"
+        assert classify_thread("Thread-17") == "other"
+
+    def test_thread_dump_keeps_legacy_pprof_shape(self):
+        dump = thread_dump()
+        assert set(dump) == {"threads", "thread_count", "gc"}
+        assert dump["thread_count"] == len(dump["threads"])
+        me = threading.current_thread().name
+        assert me in dump["threads"]
+        assert isinstance(dump["threads"][me], list)
+
+    def test_thread_dump_keeps_duplicate_names_distinct(self):
+        """Shared static names (rpc-conn, connect-proxy-pump, ...) must
+        not clobber each other's stacks in the dump."""
+        stop = threading.Event()
+        threads = [
+            threading.Thread(
+                target=stop.wait, daemon=True, name="dump-dup-name"
+            )
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            dump = thread_dump()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=2.0)
+        dups = [n for n in dump["threads"] if n.startswith("dump-dup-name")]
+        assert len(dups) == 3, dups
+
+
+# ---------------------------------------------------------------------------
+# lockdep contention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(
+    not lockdep.installed(), reason="lockdep disabled (NOMAD_TPU_LOCKDEP=0)"
+)
+class TestLockContention:
+    def test_two_thread_convoy_attributed_to_site(self):
+        """A provoked convoy — one thread holds, one blocks — must show
+        up in the contention table at the lock's allocation site with
+        the actual blocked duration, and be the top site by wait delta
+        inside this window."""
+        before = {
+            site: entry["wait_s"]
+            for site, entry in lockdep.contention().items()
+        }
+        lock = threading.Lock()  # wrapped by lockdep; site = this line
+        entered = threading.Event()
+
+        def holder():
+            with lock:
+                entered.set()
+                time.sleep(0.35)
+
+        def blocker():
+            with lock:
+                pass
+
+        th = threading.Thread(
+            target=holder, daemon=True, name="convoy-holder"
+        )
+        tb = threading.Thread(
+            target=blocker, daemon=True, name="convoy-blocker"
+        )
+        th.start()
+        assert entered.wait(2.0)
+        tb.start()
+        th.join(timeout=2.0)
+        tb.join(timeout=2.0)
+
+        deltas = {
+            site: entry["wait_s"] - before.get(site, 0.0)
+            for site, entry in lockdep.contention().items()
+        }
+        convoy = {
+            site: d for site, d in deltas.items() if "test_debug" in site
+        }
+        assert convoy, deltas
+        site, waited = max(convoy.items(), key=lambda e: e[1])
+        assert waited >= 0.25, (site, waited)
+        # the provoked convoy is the top contended site in this window
+        assert waited == max(deltas.values()), deltas
+
+    def test_uncontended_acquire_not_counted(self):
+        before = {
+            site: entry["count"]
+            for site, entry in lockdep.contention().items()
+        }
+        lock = threading.Lock()
+        for _ in range(50):
+            with lock:
+                pass
+        after = lockdep.contention()
+        grown = {
+            site
+            for site, entry in after.items()
+            if "test_debug" in site
+            and entry["count"] > before.get(site, 0)
+        }
+        assert not grown, grown
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_passive_record_fields_and_ring_bound(self):
+        server = make_server()
+        recorder = FlightRecorder(server, interval=0.05, retain=8)
+        for _ in range(12):
+            recorder.record()
+        samples = recorder.samples()
+        assert len(samples) == 8  # deque(maxlen=retain)
+        sample = samples[-1]
+        for key in (
+            "t", "rss_mb", "index", "allocs", "evals", "jobs", "nodes",
+            "deployments", "eval_e2e_p99_ms", "eval_e2e_mean_ms",
+            "plan_queue_wait_p99_ms", "plan_submit_p99_ms",
+            "plan_queue_depth", "broker_ready", "broker_unacked",
+            "evals_processed", "subscribers", "slow_consumers_closed",
+            "threads", "thread_classes",
+        ):
+            assert key in sample, key
+        assert sample["rss_mb"] > 0
+        dump = recorder.dump()
+        assert dump["recorded"] == 8
+        assert dump["retain"] == 8
+        assert dump["samples"] == samples
+
+    def test_server_starts_and_stops_recorder(self):
+        server = make_server(debug={"flight_interval": 0.05})
+        server.start(num_workers=1, wait_for_leader=5.0)
+        try:
+            deadline = time.monotonic() + 5
+            while (
+                time.monotonic() < deadline
+                and len(server.flight_recorder.samples()) < 2
+            ):
+                time.sleep(0.05)
+            assert len(server.flight_recorder.samples()) >= 2
+        finally:
+            server.stop()
+        assert server.flight_recorder._thread is None
+
+    def test_scorekeeper_delegates_to_flight_recorder(self):
+        """The soak Scorekeeper's process sampling is the recorder's
+        (one sampler, one reader) and its sample keys — the
+        SOAK_rNN.json field-name contract — are unchanged."""
+        from nomad_tpu.loadgen.score import Scorekeeper
+
+        server = make_server()
+        sk = Scorekeeper(server, interval=0.05, probes=0)
+        assert sk.recorder is server.flight_recorder
+        before = len(server.flight_recorder.samples())
+        sk._t0 = time.monotonic()
+        sk._sample(1)
+        assert len(server.flight_recorder.samples()) == before + 1
+        sample = sk.samples[0]
+        for key in (
+            "t", "rss_mb", "index", "allocs", "evals", "jobs", "nodes",
+            "deployments", "eval_e2e_p99_ms", "eval_e2e_mean_ms",
+            "plan_queue_wait_p99_ms", "plan_submit_p99_ms",
+            "plan_queue_depth", "broker_ready", "subscribers",
+            "slow_consumers_closed", "probe_lag",
+        ):
+            assert key in sample, key
+
+    def test_rss_slope_least_squares(self):
+        flat = [{"t": i * 10.0, "rss_mb": 100.0} for i in range(10)]
+        assert rss_slope(flat) == 0.0
+        growing = [
+            {"t": i * 60.0, "rss_mb": 100.0 + 50.0 * i} for i in range(10)
+        ]
+        assert abs(rss_slope(growing) - 50.0) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+class _FakeRecorder:
+    def __init__(self, samples):
+        self._samples = samples
+
+    def samples(self, last=None):
+        return self._samples[-last:] if last else list(self._samples)
+
+
+class TestWatchdog:
+    def _watchdog(self, samples, server=None, **kw):
+        return Watchdog(
+            server or SimpleNamespace(config={}),
+            _FakeRecorder(samples),
+            **kw,
+        )
+
+    def test_plan_queue_wait_rule_needs_consecutive_breaches(self):
+        samples = [
+            {
+                "t": float(i),
+                "plan_queue_wait_p99_ms": 9000.0,
+                "plan_queue_depth": 3,
+            }
+            for i in range(3)
+        ]
+        wd = self._watchdog(
+            samples,
+            config={"plan_queue_wait_p99": {
+                "threshold_ms": 2000.0, "consecutive": 3,
+            }},
+        )
+        wd.on_sample(samples[-1])
+        assert wd.trip_count == 1
+        assert wd.trip_log[0]["rule"] == "plan_queue_wait_p99"
+        # one breached sample among healthy ones: no trip
+        healthy = [
+            {
+                "t": float(i),
+                "plan_queue_wait_p99_ms": v,
+                "plan_queue_depth": 3,
+            }
+            for i, v in enumerate((10.0, 9000.0, 10.0))
+        ]
+        wd2 = self._watchdog(healthy)
+        wd2.on_sample(healthy[-1])
+        assert wd2.trip_count == 0
+
+    def test_plan_queue_wait_rule_ignores_stale_idle_p99(self):
+        """The timer window never decays while idle: a frozen breach
+        p99 with no queued plans and a flat evals-processed counter is
+        history, not an incident — no trip, no bundle every cooldown."""
+        stale = [
+            {
+                "t": float(i),
+                "plan_queue_wait_p99_ms": 9000.0,
+                "plan_queue_depth": 0,
+                "evals_processed": 100,
+            }
+            for i in range(4)
+        ]
+        wd = self._watchdog(stale)
+        wd.on_sample(stale[-1])
+        assert wd.trip_count == 0
+        # same breach with evals completing across the window: live
+        live = [
+            {**s, "evals_processed": 100 + i} for i, s in enumerate(stale)
+        ]
+        wd2 = self._watchdog(live)
+        wd2.on_sample(live[-1])
+        assert wd2.trip_count == 1
+
+    def test_cooldown_suppresses_repeat_trips(self):
+        samples = [
+            {
+                "t": float(i),
+                "plan_queue_wait_p99_ms": 9000.0,
+                "plan_queue_depth": 2,
+            }
+            for i in range(6)
+        ]
+        wd = self._watchdog(samples, cooldown_s=3600.0)
+        for s in samples[3:]:
+            wd.on_sample(s)
+        assert wd.trip_count == 1
+
+    def test_bundle_dirs_pruned_to_keep(self, tmp_path):
+        """On-disk retention: only the newest bundle_keep watchdog-*
+        dirs survive; operator-captured dirs in the same parent are
+        never reaped."""
+        wd = self._watchdog(
+            [], bundle_dir=str(tmp_path), config={"bundle_keep": 2}
+        )
+        for i in range(5):
+            d = tmp_path / f"watchdog-{i:03d}-rss_slope"
+            d.mkdir()
+            # prune orders by mtime, not name — pin distinct times
+            os.utime(d, (1000.0 + i, 1000.0 + i))
+        (tmp_path / "operator-bundle").mkdir()
+        wd._prune_bundles()
+        left = sorted(p.name for p in tmp_path.iterdir())
+        assert left == [
+            "operator-bundle", "watchdog-003-rss_slope",
+            "watchdog-004-rss_slope",
+        ], left
+
+    def test_stalled_worker_rule(self):
+        stalled = [
+            {
+                "t": float(i),
+                "broker_ready": 5,
+                "broker_unacked": 0,
+                "evals_processed": 100,
+            }
+            for i in range(8)
+        ]
+        wd = self._watchdog(stalled)
+        wd.on_sample(stalled[-1])
+        assert wd.trip_count == 1
+        assert wd.trip_log[0]["rule"] == "stalled_worker"
+        # progress (evals_processed advancing) means no stall
+        moving = [
+            {**s, "evals_processed": 100 + i} for i, s in enumerate(stalled)
+        ]
+        wd2 = self._watchdog(moving)
+        wd2.on_sample(moving[-1])
+        assert wd2.trip_count == 0
+
+    def test_rss_slope_rule(self):
+        leaking = [
+            {"t": i * 10.0, "rss_mb": 100.0 + 200.0 * i} for i in range(12)
+        ]
+        wd = self._watchdog(
+            leaking,
+            config={"rss_slope": {
+                "threshold_mb_per_min": 500.0, "window": 12,
+                "min_span_s": 30.0,
+            }},
+        )
+        wd.on_sample(leaking[-1])
+        assert wd.trip_count == 1
+        assert wd.trip_log[0]["rule"] == "rss_slope"
+
+    def test_trip_captures_complete_bundle(self, tmp_path):
+        """A trip on a REAL server with a bundle_dir captures a complete
+        bundle (every BUNDLE_FILES member present, valid JSON)."""
+        server = make_server(
+            debug={
+                "flight_interval": 0.05,
+                "bundle_dir": str(tmp_path),
+                "watchdog": {
+                    "plan_queue_wait_p99": {
+                        "threshold_ms": 1.0, "consecutive": 2,
+                    },
+                    "profile_seconds": 0.1,
+                },
+            }
+        )
+        server.start(num_workers=1, wait_for_leader=5.0)
+        try:
+            for _ in range(8):
+                metrics.sample("plan.queue_wait", 5.0)
+            deadline = time.monotonic() + 10
+            while (
+                time.monotonic() < deadline
+                and not server.watchdog.stats()["bundles"]
+            ):
+                # keep the plan plane "live" for the activity gate: the
+                # rule must see evals completing across its window
+                metrics.incr("worker.evals_processed.service")
+                time.sleep(0.05)
+            assert server.watchdog.wait_idle(10.0)
+            stats = server.watchdog.stats()
+        finally:
+            server.stop()
+        assert stats["trips"] >= 1
+        assert stats["bundles"], stats
+        bundle_dir = stats["bundles"][0]
+        present = set(os.listdir(bundle_dir))
+        assert present == set(BUNDLE_FILES), present
+        manifest = json.loads(
+            (tmp_path / os.path.basename(bundle_dir) / "manifest.json")
+            .read_text()
+        )
+        assert manifest["reason"].startswith("watchdog:")
+        assert manifest["errors"] == {}, manifest["errors"]
+        # the trip rode the metrics surface too
+        assert metrics.snapshot()["counters"].get(
+            "debug.watchdog_trips", 0
+        ) >= 1
+
+
+# ---------------------------------------------------------------------------
+# bundle content + redaction
+# ---------------------------------------------------------------------------
+
+
+class TestBundle:
+    SECRETS = ("gossip-ENCRYPT-secret", "hvs.VAULT-SECRET-TOKEN",
+               "acl-bootstrap-SECRET")
+
+    def test_redact_config_scrubs_sensitive_keys(self):
+        cfg = {
+            "region": "global",
+            "encrypt": self.SECRETS[0],
+            "vault": {"enabled": True, "token": self.SECRETS[1]},
+            "acl": {"enabled": True, "bootstrap_secret": self.SECRETS[2]},
+            "raft": {"transport": object()},
+            "plan_apply_batch": 16,
+        }
+        red = redact_config(cfg)
+        assert red["encrypt"] == "<redacted>"
+        assert red["vault"]["token"] == "<redacted>"
+        assert red["acl"]["bootstrap_secret"] == "<redacted>"
+        assert red["raft"]["transport"] == "<object>"
+        assert red["plan_apply_batch"] == 16  # non-sensitive survives
+        assert red["region"] == "global"
+
+    def test_bundle_complete_and_secret_free(self, tmp_path):
+        server = make_server(
+            encrypt=self.SECRETS[0],
+            vault={"enabled": False, "token": self.SECRETS[1]},
+        )
+        dest = tmp_path / "bundle"
+        manifest = capture_bundle(
+            server, str(dest), profile_seconds=0.1, reason="test"
+        )
+        assert manifest["errors"] == {}, manifest["errors"]
+        assert set(os.listdir(dest)) == set(BUNDLE_FILES)
+        for fn in BUNDLE_FILES:
+            raw = (dest / fn).read_text()
+            for secret in self.SECRETS:
+                assert secret not in raw, (fn, secret)
+            if fn.endswith(".json"):
+                json.loads(raw)  # every .json member parses
+        config = json.loads((dest / "config.json").read_text())
+        assert config["encrypt"] == "<redacted>"
+        # tarball form round-trips
+        tar_path = str(tmp_path / "bundle.tar.gz")
+        make_tarball(str(dest), tar_path)
+        with tarfile.open(tar_path) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert set(BUNDLE_FILES) <= names
+
+
+# ---------------------------------------------------------------------------
+# HTTP + CLI round-trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def debug_agent():
+    from nomad_tpu.api.http import HTTPServer
+    from nomad_tpu.api.client import ApiClient
+
+    server = make_server(
+        enable_debug=True, debug={"flight_interval": 0.1}
+    )
+    server.start(num_workers=1, wait_for_leader=5.0)
+    http = HTTPServer(server, port=0)
+    http.start()
+    client = ApiClient(address=http.address)
+    try:
+        yield server, http, client
+    finally:
+        http.stop()
+        server.stop()
+
+
+class TestHttpSurface:
+    def test_pprof_legacy_shape_unbroken(self, debug_agent):
+        _, _, client = debug_agent
+        out = client.debug_pprof()
+        assert set(out) == {"threads", "thread_count", "gc"}
+        assert out["thread_count"] >= 1
+        assert "counts" in out["gc"] and "stats" in out["gc"]
+        # worker threads visible under their profiler-contract names
+        assert any("sched-worker" in name for name in out["threads"])
+
+    def test_pprof_profile_seconds_round_trip(self, debug_agent):
+        _, _, client = debug_agent
+        t0 = time.monotonic()
+        report = client.debug_pprof("profile", seconds=0.3)
+        assert time.monotonic() - t0 >= 0.3
+        assert report["samples"] > 0
+        assert "folded" in report and "blocked_sites" in report
+        assert "applier_block_frac" in report
+        assert report["ticks"] >= 10
+
+    def test_bundle_endpoint_tarball_and_json(self, debug_agent, tmp_path):
+        _, _, client = debug_agent
+        out = tmp_path / "bundle.tar.gz"
+        data = client.debug_bundle(seconds=0.1, output=str(out))
+        assert out.read_bytes() == data
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert set(BUNDLE_FILES) <= names
+        inline = client.debug_bundle_json(seconds=0.1)
+        assert set(inline["manifest"]["files"]) == set(BUNDLE_FILES)
+        assert inline["files"]["manifest.json"]["reason"] == "http"
+        assert "applier_block_frac" in inline["files"]["findings.json"]
+
+    def test_debug_routes_gated_without_enable_debug(self):
+        from nomad_tpu.api.http import HTTPServer
+        from nomad_tpu.api.client import ApiClient, APIError
+
+        server = make_server()  # no enable_debug
+        server.start(num_workers=0, wait_for_leader=5.0)
+        http = HTTPServer(server, port=0)
+        http.start()
+        try:
+            client = ApiClient(address=http.address)
+            for call in (
+                lambda: client.debug_pprof(),
+                lambda: client.debug_pprof("profile", seconds=0.1),
+                lambda: client.debug_bundle(seconds=0.1),
+                lambda: client.debug_bundle_json(seconds=0.1),
+            ):
+                with pytest.raises(APIError) as err:
+                    call()
+                assert err.value.status == 403
+        finally:
+            http.stop()
+            server.stop()
+
+    def test_operator_debug_cli(self, debug_agent, tmp_path, capsys):
+        from nomad_tpu.cli.main import main
+
+        _, http, _ = debug_agent
+        out = tmp_path / "cli-bundle.tar.gz"
+        code = main([
+            "-address", http.address, "operator", "debug",
+            "-seconds", "0.1", "-output", str(out),
+        ])
+        assert code == 0
+        printed = capsys.readouterr().out
+        assert "Debug bundle written to" in printed
+        with tarfile.open(str(out)) as tar:
+            names = {os.path.basename(m.name) for m in tar.getmembers()}
+        assert set(BUNDLE_FILES) <= names
+
+    def test_metrics_carries_debug_plane_health(self, debug_agent):
+        _, _, client = debug_agent
+        payload = client.metrics()
+        assert "debug" in payload
+        assert "flight_recorded" in payload["debug"]
+        assert "watchdog_trips" in payload["debug"]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 pin: watchdog auto-captures during the soak smoke storm
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.soak
+class TestWatchdogStorm:
+    def test_watchdog_trips_and_captures_during_smoke_storm(self, tmp_path):
+        """A short smoke storm with an always-breaching rss_slope rule:
+        the watchdog must trip mid-storm, auto-capture a complete
+        bundle, and the trips must land in the scored report and
+        SOAK_SUMMARY line."""
+        from nomad_tpu.loadgen import get_scenario
+        from nomad_tpu.loadgen.runner import run_scenario
+        from nomad_tpu.loadgen.score import summary_line
+
+        scenario = get_scenario("smoke", nodes=16, churn_s=4.0)
+        scenario.server_config = {
+            **scenario.server_config,
+            "debug": {
+                "flight_interval": 0.25,
+                "bundle_dir": str(tmp_path),
+                "watchdog": {
+                    # guaranteed breach once the window spans ≥1s: any
+                    # slope beats the sentinel threshold
+                    "rss_slope": {
+                        "threshold_mb_per_min": -1e9,
+                        "window": 6,
+                        "min_span_s": 1.0,
+                    },
+                    "profile_seconds": 0.2,
+                    "cooldown_s": 3600.0,
+                },
+            },
+        }
+        report = run_scenario(scenario, 20260804, driver_workers=4)
+        watchdog = report["watchdog"]
+        assert watchdog is not None
+        assert watchdog["trips"] >= 1, watchdog
+        assert watchdog["bundles"], watchdog
+        bundle_dir = watchdog["bundles"][0]
+        assert set(os.listdir(bundle_dir)) == set(BUNDLE_FILES)
+        line = summary_line(report)
+        assert "watchdog_trips=" in line
+        assert f"watchdog_trips={watchdog['trips']}" in line
+        # the storm itself stayed healthy
+        assert report["invariants"]["violations"] == 0
