@@ -1064,6 +1064,194 @@ def bench_trace_overhead(samples=3):
     }
 
 
+#: the sharded headline config: 10× the single-chip north star, spread
+#: over the node axis of an 8-device mesh (ROADMAP item 1)
+SHARDED_NODES = int(os.environ.get("BENCH_SHARDED_NODES", "100000"))
+SHARDED_ALLOCS = int(os.environ.get("BENCH_SHARDED_ALLOCS", "500000"))
+SHARDED_DEVICES = int(os.environ.get("BENCH_SHARDED_DEVICES", "8"))
+SHARDED_SAMPLES = int(os.environ.get("BENCH_SHARDED_SAMPLES", "3"))
+
+
+def bench_sharded():
+    """The mesh-sharded headline: plan SHARDED_ALLOCS pending allocations
+    against a SHARDED_NODES-node cluster end-to-end through the real
+    tpu-batch scheduler, with the planner's node axis sharded across
+    SHARDED_DEVICES devices (tpu/shard.py; GSPMD inserts the cross-shard
+    argmax/spread collectives). Methodology mirrors the single-chip
+    headline: untimed warmup per arm, best-of-N timed samples with
+    per-sample recompile deltas (must be 0 — the warmup compiled the
+    sharded layouts), and the UNSHARDED run of the identical eval as the
+    oracle — placements must be bit-identical (parity 1.0), because
+    sharding is a layout choice, never a semantics change. A
+    traced-vs-untraced A/B pins the trace plane's budget on the sharded
+    path too (shard-tagged dispatch spans ride the same hooks)."""
+    import gc
+
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.tpu import batch_sched, shard
+    from nomad_tpu.trace import tracer
+
+    mesh = shard.configure(SHARDED_DEVICES)
+    if mesh is None:
+        import jax
+
+        return {
+            "skipped": True,
+            "reason": (
+                f"need {SHARDED_DEVICES} devices, have {len(jax.devices())}"
+                " (CPU boxes: XLA_FLAGS=--xla_force_host_platform_"
+                "device_count=8, see scripts/multichip.sh)"
+            ),
+        }
+    try:
+        state = StateStore()
+        state.upsert_nodes(1, build_nodes(SHARDED_NODES))
+        job = build_job(SHARDED_ALLOCS, spread=True)
+        state.upsert_job(2, job)
+
+        # unsharded oracle arm first: warm, then one timed pass (the
+        # single-chip column of the PERF.md table)
+        shard.configure(enabled=False)
+        run_once(state, job)  # warmup: compiles the unsharded shapes
+        gc.collect()
+        unsharded_s, placed_unsharded = run_once(state, job)
+        unsharded_mode = batch_sched.LAST_KERNEL_STATS.get("mode")
+
+        # sharded arm: warm (compiles the mesh layouts), then best-of-N
+        shard.configure(SHARDED_DEVICES)
+        warm_t, _ = run_once(state, job)
+        samples, details = [], []
+        best, placed_sharded = None, None
+        for _ in range(SHARDED_SAMPLES):
+            gc.collect()
+            cache0 = _kernel_cache_size()
+            t, placed = run_once(state, job)
+            cache1 = _kernel_cache_size()
+            samples.append(round(t, 4))
+            details.append({
+                "total_s": round(t, 4),
+                "kernel_s": round(
+                    batch_sched.LAST_KERNEL_STATS.get("kernel_s", 0.0), 4
+                ),
+                "recompiles": (
+                    cache1 - cache0 if cache0 >= 0 and cache1 >= 0 else None
+                ),
+            })
+            if best is None or t < best:
+                best, placed_sharded = t, placed
+        stats = dict(batch_sched.LAST_KERNEL_STATS)
+
+        # fast-pair agreement (informational): the production programs
+        # are two different XLA compilations, and fusion-level 1-ulp
+        # score noise can legally flip exact ties between near-identical
+        # nodes at this scale — semantic quality is pinned by the
+        # ≥99% host-oracle budget, not by this number
+        fast_parity = parity(placed_unsharded, placed_sharded)
+
+        # THE parity pin: both arms through the deterministic compile
+        # flavor (kernel.DET_COMPILER_OPTIONS — optimization level 0,
+        # every float materialized once), where sharded placements are
+        # bit-identical to unsharded by construction; any mismatch here
+        # is a real GSPMD semantics regression. The checked sample runs
+        # against the SAME cluster at a reduced alloc count — the
+        # unfused flavor trades speed for bit-stability, so the sample
+        # size is the knob (it still crosses every shard)
+        parity_allocs = int(os.environ.get(
+            "BENCH_SHARDED_PARITY_ALLOCS",
+            str(min(SHARDED_ALLOCS, 50000)),
+        ))
+        parity_job = build_job(parity_allocs, spread=True)
+        state.upsert_job(4, parity_job)
+        from nomad_tpu.tpu.kernel import deterministic_scope
+
+        parity_mode = "deterministic (kernel.DET_COMPILER_OPTIONS)"
+        try:
+            with deterministic_scope():
+                shard.configure(enabled=False)
+                det_plain_s, det_plain = run_once(state, parity_job)
+                shard.configure(SHARDED_DEVICES)
+                det_shard_s, det_shard = run_once(state, parity_job)
+        except Exception as e:  # backend without the det flavor: degrade,
+            # and say so — a fast-pair number must never masquerade as
+            # the bit-identity pin
+            parity_mode = f"fast pair (deterministic flavor failed: {e})"
+            det_plain_s = det_shard_s = 0.0
+            det_plain, det_shard = placed_unsharded, placed_sharded
+        finally:
+            # re-arm the mesh — the trace A/B below must measure the
+            # SHARDED path even when the det unsharded arm raised before
+            # the mesh was reconfigured
+            shard.configure(SHARDED_DEVICES)
+        det_parity = parity(det_plain, det_shard)
+
+        # trace A/B on the sharded path (same interleaved-arms + median
+        # methodology as bench_trace_overhead, so thermal/cache drift
+        # hits both arms; budget pinned like the headline)
+        tracer.reset()
+        traced, untraced = [], []
+        ab_samples = int(os.environ.get("BENCH_SHARDED_TRACE_SAMPLES", "2"))
+        try:
+            for _ in range(ab_samples):
+                gc.collect()
+                tracer.enabled = True
+                with tracer.root("bench.sharded"):
+                    t, _ = run_once(state, job)
+                traced.append(t)
+                gc.collect()
+                tracer.enabled = False
+                t, _ = run_once(state, job)
+                untraced.append(t)
+        finally:
+            tracer.enabled = True
+            tracer.reset()
+        t_med = sorted(traced)[len(traced) // 2]
+        u_med = sorted(untraced)[len(untraced) // 2]
+        trace_overhead = (t_med - u_med) / u_med * 100.0 if u_med else 0.0
+
+        recompiles = (
+            None
+            if any(d["recompiles"] is None for d in details)
+            else sum(d["recompiles"] for d in details)
+        )
+        ordered = sorted(samples)
+        return {
+            "nodes": SHARDED_NODES,
+            "allocs": SHARDED_ALLOCS,
+            "devices": shard.mesh_size(mesh),
+            "end_to_end_s": round(best, 4),
+            "samples_s": samples,
+            "samples_detail": details,
+            "median_s": round(ordered[len(ordered) // 2], 4),
+            "compile_s": round(warm_t, 4),
+            "unsharded_s": round(unsharded_s, 4),
+            "speedup_vs_unsharded": (
+                round(unsharded_s / best, 3) if best else None
+            ),
+            "mode": stats.get("mode"),
+            "shards": stats.get("shards"),
+            "placed": len(placed_sharded),
+            "parity_vs_unsharded": round(det_parity, 6),
+            "parity_checked": len(det_plain),
+            "parity_mode": parity_mode,
+            "parity_det_plain_s": round(det_plain_s, 4),
+            "parity_det_shard_s": round(det_shard_s, 4),
+            "parity_fast_pair": round(fast_parity, 6),
+            "parity_fast_pair_checked": len(placed_unsharded),
+            "recompiles": recompiles,
+            "unsharded_mode": unsharded_mode,
+            "trace_overhead_pct": round(trace_overhead, 2),
+            "trace_budget_pct": TRACE_OVERHEAD_BUDGET_PCT,
+            "trace_within_budget": (
+                trace_overhead <= TRACE_OVERHEAD_BUDGET_PCT
+            ),
+            "skipped": False,
+        }
+    finally:
+        # later sections measure the single-chip paths; never leak the
+        # mesh into them
+        shard.configure(enabled=False)
+
+
 def bench_soak_smoke(seed=20260803):
     """The tier-1 smoke storm from the churn-soak load plane
     (nomad_tpu/loadgen), run as a bench section so the soak's headline
@@ -1093,9 +1281,16 @@ def bench_soak_smoke(seed=20260803):
 
 
 def main():
+    # the single-chip headline stays single-chip by construction, even
+    # under NOMAD_TPU_SHARD=1 — the sharded section measures the mesh
+    from nomad_tpu.tpu import shard as _shard
+
+    _shard.configure(enabled=False)
     headline = bench_headline()
     detail = dict(headline)
     if os.environ.get("BENCH_FAST") != "1":
+        if os.environ.get("BENCH_SHARDED", "1") != "0":
+            detail["sharded"] = bench_sharded()
         detail["config2"] = bench_config2()
         detail["config3"] = bench_config3()
         detail["config5"] = bench_config5()
@@ -1156,6 +1351,21 @@ def main():
     from nomad_tpu.analysis import count_new_findings
 
     parts.append(f"analysis_findings={count_new_findings()}")
+    if "sharded" in detail:
+        sh = detail["sharded"]
+        if sh.get("skipped"):
+            parts += [
+                "sharded_s=skipped", "sharded_parity=skipped",
+                "sharded_devices=0",
+            ]
+        else:
+            parts += [
+                f"sharded_s={sh['end_to_end_s']}",
+                f"sharded_parity={sh['parity_vs_unsharded']}",
+                f"sharded_devices={sh['devices']}",
+                f"sharded_recompiles={sh['recompiles']}",
+                f"sharded_speedup={sh['speedup_vs_unsharded']}",
+            ]
     if "config2" in detail:
         parts.append(f"cfg2={detail['config2'].get('evals_per_s')}evals/s")
         parts.append(f"cfg3={detail['config3'].get('end_to_end_s')}s")

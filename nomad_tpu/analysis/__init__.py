@@ -30,6 +30,7 @@ from . import (  # noqa: F401,E402
     jax_hygiene,
     lockgraph,
     raft_hygiene,
+    shard_hygiene,
     span_hygiene,
     threads,
 )

@@ -1,0 +1,169 @@
+"""Shard-spec hygiene for the device mesh (nomad_tpu/tpu/shard.py).
+
+The sharded planner's zero-recompile and bit-parity contracts rest on
+one discipline: in a code path where a device mesh is active, every
+array placement and every jit must state its sharding. A bare
+``jax.device_put(x)`` next to sharded inputs hands XLA a layout choice
+the warmup never compiled (a silent recompile plus a possible gather on
+the hot path), and a ``jax.jit`` without ``out_shardings`` may return a
+replicated buffer where the caller's next dispatch expects the
+partitioned one (the exact class the mirror's scatter refresh pins with
+an explicit out sharding).
+
+Rule ``shard-spec-drift`` (scoped to ``nomad_tpu/tpu/``): inside a
+function that references a mesh (a ``mesh``-named parameter/local, or a
+call to ``active_mesh``/``configure``), flag
+
+- ``device_put`` calls carrying no sharding (single argument, no
+  ``device=``/``sharding=`` keyword), and
+- ``jax.jit`` calls carrying neither ``out_shardings`` nor
+  ``in_shardings``,
+
+EXCEPT in statically-unsharded regions — the body of
+``if <mesh> is None:`` and the else of ``if <mesh> is not None:`` —
+where the single-chip defaults are exactly right. Deliberate
+exceptions take a ``# nta: ignore[shard-spec-drift]`` with a WHY.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, Project, dotted, register
+
+_SCOPE = "nomad_tpu/tpu/"
+
+#: calls that make a function a "sharded code path" even without a
+#: mesh-named binding
+_MESH_CALLS = {"active_mesh", "configure"}
+
+
+def _mentions_mesh(node: ast.AST) -> bool:
+    """The expression names a mesh: ``mesh``, ``self.mesh``,
+    ``span_mesh``, ``all_mesh``, ..."""
+    name = dotted(node)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    return tail == "mesh" or tail.endswith("_mesh")
+
+
+def _mesh_gate(test: ast.AST):
+    """Classify an if-test over a mesh: returns 'is_none' / 'not_none' /
+    None (not a mesh gate)."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None
+    op = test.ops[0]
+    left, right = test.left, test.comparators[0]
+    none_side = (
+        right if isinstance(right, ast.Constant) and right.value is None
+        else left if isinstance(left, ast.Constant) and left.value is None
+        else None
+    )
+    mesh_side = right if none_side is left else left
+    if none_side is None or not _mentions_mesh(mesh_side):
+        return None
+    if isinstance(op, ast.Is):
+        return "is_none"
+    if isinstance(op, ast.IsNot):
+        return "not_none"
+    return None
+
+
+def _function_references_mesh(fn) -> bool:
+    for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+        if arg.arg == "mesh" or arg.arg.endswith("_mesh"):
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and (
+            node.id == "mesh" or node.id.endswith("_mesh")
+        ):
+            return True
+        if isinstance(node, ast.Attribute) and _mentions_mesh(node):
+            return True
+        if isinstance(node, ast.Call):
+            tail = dotted(node.func).rsplit(".", 1)[-1]
+            if tail in _MESH_CALLS and "shard" in dotted(node.func):
+                return True
+    return False
+
+
+def _unsharded_lines(fn) -> set[int]:
+    """Line numbers inside statically-unsharded regions (mesh-is-None
+    branches), where bare placements are the correct single-chip path."""
+    lines: set[int] = set()
+
+    def mark(stmts):
+        for s in stmts:
+            for node in ast.walk(s):
+                ln = getattr(node, "lineno", None)
+                if ln is not None:
+                    lines.add(ln)
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        gate = _mesh_gate(node.test)
+        if gate == "is_none":
+            mark(node.body)
+        elif gate == "not_none":
+            mark(node.orelse)
+    return lines
+
+
+@register(
+    "shard-spec-drift",
+    "device_put/jax.jit in a mesh-active tpu/ code path without an "
+    "explicit sharding/out_shardings (silent recompile + layout drift)",
+)
+def check_shard_spec_drift(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if not mod.relpath.startswith(_SCOPE):
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _function_references_mesh(fn):
+                continue
+            exempt = _unsharded_lines(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if node.lineno in exempt:
+                    continue
+                name = dotted(node.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "device_put":
+                    has_spec = len(node.args) >= 2 or any(
+                        kw.arg in ("device", "sharding")
+                        for kw in node.keywords
+                    )
+                    if not has_spec:
+                        findings.append(
+                            Finding(
+                                "shard-spec-drift", mod.relpath,
+                                node.lineno,
+                                f"{name}() without a sharding in a "
+                                "mesh-active path: pass the "
+                                "NamedSharding (or shard.put) so the "
+                                "layout matches what warmup compiled",
+                            )
+                        )
+                elif tail == "jit" and name.startswith("jax"):
+                    has_spec = any(
+                        kw.arg in ("out_shardings", "in_shardings")
+                        for kw in node.keywords
+                    )
+                    if not has_spec:
+                        findings.append(
+                            Finding(
+                                "shard-spec-drift", mod.relpath,
+                                node.lineno,
+                                f"{name}() without out_shardings in a "
+                                "mesh-active path: pin the output "
+                                "partitioning or GSPMD may hand back a "
+                                "replicated buffer",
+                            )
+                        )
+    return findings

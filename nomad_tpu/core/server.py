@@ -793,12 +793,22 @@ class Server:
         self._running = True
         if self._flight_enabled:
             self.flight_recorder.start()
+        if self.config.get("shard_devices"):
+            # mesh-shard the planner node axis across the configured
+            # device count (tpu/shard.py; env NOMAD_TPU_SHARD covers the
+            # no-config path) — built before prewarm so the warmed
+            # programs carry the sharded layouts
+            from ..tpu import shard as _shard
+
+            _shard.configure(int(self.config["shard_devices"]))
         if self.config.get("prewarm_kernels"):
             # compile the planner shape ladder in the background so the
             # first real eval doesn't eat the cold-compile latency
             # (tpu/warmup.py; persists via the on-disk compilation cache).
             # With batch_drain + an expected cluster size, the fused
-            # drain-batch shapes prewarm too.
+            # drain-batch shapes prewarm too — mesh-sharded when a mesh
+            # is active, so the sharded headline never recompiles.
+            from ..tpu import shard as _shard
             from ..tpu.warmup import prewarm_async
 
             drain_shape = None
@@ -806,7 +816,9 @@ class Server:
             nodes_hint = int(self.config.get("prewarm_drain_nodes", 0))
             if drain_cfg > 1 and nodes_hint > 0:
                 drain_shape = (nodes_hint, drain_cfg)
-            self._prewarm_thread = prewarm_async(drain=drain_shape)
+            self._prewarm_thread = prewarm_async(
+                drain=drain_shape, mesh=_shard.active_mesh()
+            )
         self.raft.start()
         if self.gossip is not None:
             self.gossip.start()
@@ -832,6 +844,20 @@ class Server:
                 threading.Thread(
                     target=_join, daemon=True, name="gossip-retry-join"
                 ).start()
+        self.start_workers(num_workers)
+        if wait_for_leader is None:
+            # single-voter servers are their own leader; block briefly so
+            # callers can write immediately (dev-mode ergonomics)
+            wait_for_leader = 5.0 if len(self.raft.voters) == 1 else 0.0
+        if wait_for_leader:
+            self.wait_for_leader(wait_for_leader)
+
+    def start_workers(self, num_workers: int):
+        """Spawn scheduler workers (split from start() so a harness can
+        bring the server up with zero workers, load the broker, and only
+        then open the drain — the deterministic way to exercise fused
+        multi-eval batches: with workers racing registration, whether two
+        evals are ever simultaneously ready is a scheduling accident)."""
         drain_n = int(self.config.get("batch_drain", 0))
         for i in range(num_workers):
             if drain_n > 1:
@@ -846,12 +872,6 @@ class Server:
                 w = Worker(self, seed=self.config.get("seed"))
             self.workers.append(w)
             w.start()
-        if wait_for_leader is None:
-            # single-voter servers are their own leader; block briefly so
-            # callers can write immediately (dev-mode ergonomics)
-            wait_for_leader = 5.0 if len(self.raft.voters) == 1 else 0.0
-        if wait_for_leader:
-            self.wait_for_leader(wait_for_leader)
 
     def stop(self):
         self._running = False

@@ -12,6 +12,33 @@ the scalar oracle as fallback for paths the kernel does not cover.
 import os as _os
 
 
+def _ensure_xla_determinism():
+    """Pin ``--xla_allow_excess_precision=false`` (unless the operator
+    set it themselves) BEFORE the XLA backend parses its flags.
+
+    With excess precision allowed, XLA may rematerialize a fused float
+    expression differently per compilation — the sharded and unsharded
+    planner programs then disagree on ``score`` by 1 ulp, and in this
+    tie-heavy kernel (hundreds of identical nodes tie exactly) a 1-ulp
+    flip changes tie membership and cascades into diverging fill runs
+    (observed at 8K nodes × 40K allocs: parity fell to 0.63 while every
+    kernel INPUT was byte-identical). The mesh parity contract —
+    sharded placements bit-identical to unsharded — requires bitwise
+    value stability across compilations, so excess precision is off for
+    the whole planner tier."""
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_allow_excess_precision" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            flags + " --xla_allow_excess_precision=false"
+        ).strip()
+
+
+# at package import: tpu modules are imported before any planner compile
+# (batch_sched rides the scheduler factory map), which precedes backend
+# initialization on every dispatch path
+_ensure_xla_determinism()
+
+
 def enable_compile_cache(path: str | None = None) -> str:
     """Point JAX's persistent compilation cache at a repo-local directory so
     a fresh process skips recompiling the planner shapes it has seen before

@@ -308,12 +308,17 @@ class TPUBatchScheduler(GenericScheduler):
         _count_kernel()
         # the solo-kernel stage of the eval's span tree (the fused drain
         # path gets its device-aware spans from drain.py instead); also
-        # the headline bench's traced-arm work in the trace_overhead A/B
+        # the headline bench's traced-arm work in the trace_overhead A/B.
+        # Sharded dispatches tag their topology so a trace reader can
+        # tell a mesh run from a single-chip one span-locally.
         from ..trace import tracer
+        from . import shard as _shard
 
-        with tracer.span(
-            "eval.plan_kernel", tags={"allocs": len(place)}
-        ):
+        span_tags = {"allocs": len(place)}
+        span_mesh = _shard.active_mesh(len(nodes))
+        if span_mesh is not None:
+            span_tags.update(_shard.shard_tags(span_mesh))
+        with tracer.span("eval.plan_kernel", tags=span_tags):
             self._kernel_placements(place, nodes, by_dc, groups)
 
     # ------------------------------------------------------------------
@@ -506,8 +511,12 @@ class TPUBatchScheduler(GenericScheduler):
                     dev_counts[g_index[name]] = sum(d.count for _, d in asks)
             g_demand = np.concatenate([g_demand, dev_counts[:, None]], axis=1)
 
-        # pad node axis
-        N = _bucket(n_real)
+        # pad node axis (mesh-sharded when a device mesh is active and the
+        # cluster is big enough to amortize collectives: tpu/shard.py)
+        from . import shard as _shard
+
+        mesh = _shard.active_mesh(n_real)
+        N = _shard.node_bucket(n_real, mesh)
         capacity = _pad_to(capacity_real, N).astype(np.int32)
         usable = _pad_to(cluster.usable, N, fill=1.0).astype(np.float32)
         used0 = _pad_to(used0_real, N, fill=2**30).astype(np.int32)
@@ -671,30 +680,38 @@ class TPUBatchScheduler(GenericScheduler):
             t_columnar = time.monotonic()
             try:
                 rargs = RunArgs(
-                    capacity=jnp.asarray(capacity[perm]),
-                    usable=jnp.asarray(usable[perm]),
-                    feasible=jnp.asarray(feasible[0][perm]),
-                    affinity=jnp.asarray(affinity[0][perm]),
-                    affinity_present=jnp.asarray(affinity_present[0][perm]),
-                    group_count=jnp.asarray(np.int32(group_count[0])),
-                    node_value=jnp.asarray(node_value[0][perm]),
-                    spread_desired=jnp.asarray(spread_desired[0]),
-                    spread_implicit=jnp.asarray(np.float32(spread_implicit[0])),
-                    spread_weight_frac=jnp.asarray(np.float32(spread_weight_frac[0])),
-                    spread_even=jnp.asarray(bool(spread_even[0])),
-                    spread_active=jnp.asarray(bool(spread_active[0])),
-                    perm=jnp.asarray(perm),
-                    demand=jnp.asarray(demands[0]),
-                    n_allocs=jnp.asarray(np.int32(a_real)),
+                    capacity=capacity[perm],
+                    usable=usable[perm],
+                    feasible=feasible[0][perm],
+                    affinity=affinity[0][perm],
+                    affinity_present=affinity_present[0][perm],
+                    group_count=np.int32(group_count[0]),
+                    node_value=node_value[0][perm],
+                    spread_desired=spread_desired[0],
+                    spread_implicit=np.float32(spread_implicit[0]),
+                    spread_weight_frac=np.float32(spread_weight_frac[0]),
+                    spread_even=np.bool_(spread_even[0]),
+                    spread_active=np.bool_(spread_active[0]),
+                    perm=perm,
+                    demand=demands[0],
+                    n_allocs=np.int32(a_real),
                 )
+                rinit = (
+                    used0[perm],
+                    collisions0[0][perm],
+                    counts0[0],
+                    present0[0],
+                )
+                if mesh is not None:
+                    aspec, ispec = _shard.run_specs()
+                    rargs = _shard.put(rargs, aspec, mesh)
+                    rinit = _shard.put(rinit, ispec, mesh)
+                else:
+                    rargs = RunArgs(*[jnp.asarray(a) for a in rargs])
+                    rinit = tuple(jnp.asarray(x) for x in rinit)
                 placements = plan_batch_runs(
                     rargs,
-                    (
-                        jnp.asarray(used0[perm]),
-                        jnp.asarray(collisions0[0][perm]),
-                        jnp.asarray(counts0[0]),
-                        jnp.asarray(present0[0]),
-                    ),
+                    rinit,
                     A,
                     bool(spread_even[0]),
                 )
@@ -707,6 +724,7 @@ class TPUBatchScheduler(GenericScheduler):
                 n_padded_nodes=N,
                 n_padded_allocs=A,
                 mode="runs",
+                shards=_shard.mesh_size(mesh),
             )
             _count_mode("runs")
             # dispatch is async: _materialize builds templates/ids while the
@@ -736,19 +754,29 @@ class TPUBatchScheduler(GenericScheduler):
             t_columnar = time.monotonic()
             try:
                 wargs = WindowArgs(
-                    capacity=jnp.asarray(capacity),
-                    usable=jnp.asarray(usable),
-                    feasible=jnp.asarray(feasible[0]),
-                    perm=jnp.asarray(perm),
-                    demand=jnp.asarray(demands[0]),
-                    group_count=jnp.asarray(np.int32(group_count[0])),
-                    limit=jnp.asarray(np.int32(limits[0])),
-                    n_allocs=jnp.asarray(np.int32(a_real)),
+                    capacity=capacity,
+                    usable=usable,
+                    feasible=feasible[0],
+                    perm=perm,
+                    demand=demands[0],
+                    group_count=np.int32(group_count[0]),
+                    limit=np.int32(limits[0]),
+                    n_allocs=np.int32(a_real),
                 )
+                wused0, wcoll0 = used0, collisions0[0]
+                if mesh is not None:
+                    aspec, (uspec, cspec) = _shard.window_specs()
+                    wargs = _shard.put(wargs, aspec, mesh)
+                    wused0 = _shard.put(wused0, uspec, mesh)
+                    wcoll0 = _shard.put(wcoll0, cspec, mesh)
+                else:
+                    wargs = WindowArgs(*[jnp.asarray(a) for a in wargs])
+                    wused0 = jnp.asarray(wused0)
+                    wcoll0 = jnp.asarray(wcoll0)
                 placements = plan_batch_windowed(
                     wargs,
-                    jnp.asarray(used0),
-                    jnp.asarray(collisions0[0]),
+                    wused0,
+                    wcoll0,
                     n_real,
                     A,
                 )
@@ -761,6 +789,7 @@ class TPUBatchScheduler(GenericScheduler):
                 n_padded_nodes=N,
                 n_padded_allocs=A,
                 mode="windowed",
+                shards=_shard.mesh_size(mesh),
             )
             _count_mode("windowed")
             try:
@@ -776,33 +805,40 @@ class TPUBatchScheduler(GenericScheduler):
         t_columnar = time.monotonic()
         try:
             args = BatchArgs(
-                capacity=jnp.asarray(capacity),
-                usable=jnp.asarray(usable),
-                feasible=jnp.asarray(feasible),
-                affinity=jnp.asarray(affinity),
-                affinity_present=jnp.asarray(affinity_present),
-                group_count=jnp.asarray(group_count),
-                group_eval=jnp.zeros(G, dtype=np.int32),
-                node_value=jnp.asarray(node_value),
-                spread_desired=jnp.asarray(spread_desired),
-                spread_implicit=jnp.asarray(spread_implicit),
-                spread_weight_frac=jnp.asarray(spread_weight_frac),
-                spread_even=jnp.asarray(spread_even),
-                spread_active=jnp.asarray(spread_active),
-                perm=jnp.asarray(perm[None, :]),
-                ring=jnp.asarray(np.array([n_real], dtype=np.int32)),
-                demands=jnp.asarray(demands),
-                groups=jnp.asarray(group_ids),
-                limits=jnp.asarray(limits),
-                valid=jnp.asarray(valid),
+                capacity=capacity,
+                usable=usable,
+                feasible=feasible,
+                affinity=affinity,
+                affinity_present=affinity_present,
+                group_count=group_count,
+                group_eval=np.zeros(G, dtype=np.int32),
+                node_value=node_value,
+                spread_desired=spread_desired,
+                spread_implicit=spread_implicit,
+                spread_weight_frac=spread_weight_frac,
+                spread_even=spread_even,
+                spread_active=spread_active,
+                perm=perm[None, :],
+                ring=np.array([n_real], dtype=np.int32),
+                demands=demands,
+                groups=group_ids,
+                limits=limits,
+                valid=valid,
             )
             init = BatchState(
-                used=jnp.asarray(used0),
-                collisions=jnp.asarray(collisions0),
-                spread_counts=jnp.asarray(counts0),
-                spread_present=jnp.asarray(present0),
-                offset=jnp.zeros(1, dtype=np.int32),
+                used=used0,
+                collisions=collisions0,
+                spread_counts=counts0,
+                spread_present=present0,
+                offset=np.zeros(1, dtype=np.int32),
             )
+            if mesh is not None:
+                aspec, sspec = _shard.batch_specs()
+                args = _shard.put(args, aspec, mesh)
+                init = _shard.put(init, sspec, mesh)
+            else:
+                args = BatchArgs(*[jnp.asarray(a) for a in args])
+                init = BatchState(*[jnp.asarray(s) for s in init])
             _, placements = plan_batch(args, init, n_real)
         except Exception as e:
             return degrade_to_exact(f"dispatch: {e}")
@@ -813,6 +849,7 @@ class TPUBatchScheduler(GenericScheduler):
             n_padded_nodes=N,
             n_padded_allocs=A,
             mode="exact-scan",
+            shards=_shard.mesh_size(mesh),
         )
         _count_mode("exact-scan")
         try:

@@ -331,10 +331,16 @@ class KernelBatchCollector:
         root_ctxs = [
             tracer.ctx_for_eval(p.prep.eval_id) for p in parked
         ]
+        from . import shard as _shard
+
         t0 = time.monotonic()
         shared = self.shared
         n_real = len(shared.nodes)
-        N = _bucket(n_real)
+        # mesh-sharded node axis (tpu/shard.py): gated by cluster size so
+        # toy drains never pay a collective; N rounds to a mesh multiple
+        # so every shard holds equal rows
+        mesh = _shard.active_mesh(n_real)
+        N = _shard.node_bucket(n_real, mesh)
         # padding floors keyed to the configured drain size: partial batches
         # reuse the full batch's compiled shape (shape churn was costing a
         # fresh XLA compile per batch)
@@ -365,7 +371,7 @@ class KernelBatchCollector:
         # batch's host arrays.
         cap_in = usable_in = used_in = None
         if shared.mirror is not None:
-            ds = shared.mirror.device_state(N, shared.gen)
+            ds = shared.mirror.device_state(N, shared.gen, mesh=mesh)
             if ds is not None:
                 cap_in, usable_in, used_in = ds
         if used_in is None:
@@ -439,33 +445,45 @@ class KernelBatchCollector:
             a_off += a_len
 
         args = BatchArgs(
-            capacity=jnp.asarray(cap_in),
-            usable=jnp.asarray(usable_in),
-            feasible=jnp.asarray(feasible),
-            affinity=jnp.asarray(affinity),
-            affinity_present=jnp.asarray(affinity_present),
-            group_count=jnp.asarray(group_count),
-            group_eval=jnp.asarray(group_eval),
-            node_value=jnp.asarray(node_value),
-            spread_desired=jnp.asarray(spread_desired),
-            spread_implicit=jnp.asarray(spread_implicit),
-            spread_weight_frac=jnp.asarray(spread_weight_frac),
-            spread_even=jnp.asarray(spread_even),
-            spread_active=jnp.asarray(spread_active),
-            perm=jnp.asarray(perm),
-            ring=jnp.asarray(ring),
-            demands=jnp.asarray(demands),
-            groups=jnp.asarray(groups),
-            limits=jnp.asarray(limits),
-            valid=jnp.asarray(valid),
+            capacity=cap_in,
+            usable=usable_in,
+            feasible=feasible,
+            affinity=affinity,
+            affinity_present=affinity_present,
+            group_count=group_count,
+            group_eval=group_eval,
+            node_value=node_value,
+            spread_desired=spread_desired,
+            spread_implicit=spread_implicit,
+            spread_weight_frac=spread_weight_frac,
+            spread_even=spread_even,
+            spread_active=spread_active,
+            perm=perm,
+            ring=ring,
+            demands=demands,
+            groups=groups,
+            limits=limits,
+            valid=valid,
         )
         init = BatchState(
-            used=jnp.asarray(used_in),
-            collisions=jnp.asarray(collisions0),
-            spread_counts=jnp.asarray(counts0),
-            spread_present=jnp.asarray(present0),
+            used=used_in,
+            collisions=collisions0,
+            spread_counts=counts0,
+            spread_present=present0,
             offset=np.zeros(E, dtype=np.int32),
         )
+        if mesh is not None:
+            # place every input with its PartitionSpec (shard.put): the
+            # mirror's planes are already sharded (device_put is then a
+            # no-op ref), host planes upload partitioned, and the small
+            # tables replicate explicitly — one layout source with the
+            # warmup prewarm, so a fused batch never pays a recompile
+            aspec, sspec = _shard.batch_specs()
+            args = _shard.put(args, aspec, mesh)
+            init = _shard.put(init, sspec, mesh)
+        else:
+            args = BatchArgs(*[jnp.asarray(a) for a in args])
+            init = BatchState(*[jnp.asarray(s) for s in init])
         t_build = time.monotonic()
         cache_before = compile_cache_size()
         _, placements = plan_batch(args, init, n_real)
@@ -476,13 +494,23 @@ class KernelBatchCollector:
         # assembly, overlap this batch's device compute; each consumer's
         # np.asarray is its sync point)
         eval_of = group_eval[groups]
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            eval_of_d = jax.device_put(eval_of, rep)
+            n_real_d = jax.device_put(np.int32(n_real), rep)
+        else:
+            eval_of_d = jnp.asarray(eval_of)
+            n_real_d = jnp.int32(n_real)
         bases = _used_bases_fn()(
             init.used,
             placements,
             args.demands,
-            jnp.asarray(eval_of),
+            eval_of_d,
             E,
-            jnp.int32(n_real),
+            n_real_d,
         )
         # dispatch→first-consumer-sync wall clock (an UPPER BOUND on
         # device time: the first consumer's host-side template/id prep
@@ -509,6 +537,11 @@ class KernelBatchCollector:
             "padded": f"E{E}xG{G}xA{A}xN{N}xV{V}",
             "mirror": shared.mirror is not None,
         }
+        if mesh is not None:
+            # shard topology on the dispatch span: an operator reading a
+            # trace can tell a sharded dispatch (and its mesh width) from
+            # a single-chip one without cross-referencing config
+            dispatch_tags.update(_shard.shard_tags(mesh))
         if recompiled:
             dispatch_tags["jit_cache_delta"] = cache_after - cache_before
         for ctx in trace_ctxs:
@@ -559,5 +592,6 @@ class KernelBatchCollector:
             build_s=t_build - t0,
             mirror=shared.mirror is not None,
             padded=(E, G, A, N, V),
+            shards=_shard.mesh_size(mesh),
         )
         metrics.sample("drain.batch_build", t_build - t0)

@@ -83,11 +83,76 @@ class BatchState(NamedTuple):
     offset: jax.Array  # i32[E] ring cursor per eval
 
 
+#: log2(10) and its Veltkamp split: _HI carries the top 12 mantissa bits
+#: (so products with 12-bit x-halves are exact in float32), _LO the rest
+_LOG2_10 = 3.3219280948873623
+_LOG2_10_HI = 3.322265625  # log2(10) rounded to 12 mantissa bits
+_LOG2_10_LO = _LOG2_10 - _LOG2_10_HI
+
+
+def _pow10(x):
+    """Bit-stable 10^x in float32: 2^(x·log2 10) with the exponent split
+    into an integer part (applied by exact exponent-field bit assembly)
+    and a fractional part evaluated by a FIXED Horner polynomial
+    (Cephes exp2f coefficients on [-0.5, 0.5]).
+
+    Why not ``jnp.power``: XLA lowers transcendentals differently per
+    compilation context (a fusion cluster that vectorizes gets the
+    packet polynomial, one that doesn't gets scalar libm), and those
+    approximations differ in final ulps. The sharded and unsharded
+    planner programs are DIFFERENT compilations of the same math, so a
+    transcendental in the score path makes "sharded placements are
+    bit-identical to unsharded" unenforceable — observed as parity 0.63
+    at 8K nodes × 40K allocs when thousands of near-identical nodes sit
+    within 1 ulp of each other. Everything here is +,·,comparisons and
+    integer/bit ops — all correctly rounded or exact under IEEE-754, so
+    every compilation (any sharding, any fusion, any vector width)
+    produces the same bits. Requires --xla_allow_excess_precision=false
+    (tpu/__init__) so FMA contraction cannot reassociate the Horner
+    chain differently per program."""
+    # range reduction y = x·log2(10) in double-float: a single rounded
+    # product loses ~|y|·eps which lands straight in the fractional part
+    # (observed 4e-6 relative vs pow's 6e-8). Veltkamp-split the product
+    # instead — 12-bit halves multiply EXACTLY in float32 — and carry
+    # the low word into f. Every op below is IEEE-exact/correctly
+    # rounded, so the reduction is bit-stable like the rest.
+    x = jnp.clip(x, -45.2, 45.2)  # 10^±45 spans all float32 normals
+    c = jnp.float32(4097.0) * x  # 2^12 + 1: Veltkamp split constant
+    x_hi = c - (c - x)  # top ~12 mantissa bits of x
+    x_lo = x - x_hi  # exact residual
+    y_hi = x_hi * jnp.float32(_LOG2_10_HI)  # 12b × 12b: exact product
+    y_lo = x_hi * jnp.float32(_LOG2_10_LO) + x_lo * jnp.float32(_LOG2_10)
+    n = jnp.round(y_hi + y_lo)
+    # y_hi - n is exact (Sterbenz: same binade once |y_hi - n| ≤ 0.5)
+    f = (y_hi - n) + y_lo
+    # 2^f on [-0.5, 0.5]: Cephes exp2f minimax polynomial
+    p = jnp.float32(1.535336188319500e-4)
+    p = p * f + jnp.float32(1.339887440266574e-3)
+    p = p * f + jnp.float32(9.618437357674640e-3)
+    p = p * f + jnp.float32(5.550332471162809e-2)
+    p = p * f + jnp.float32(2.402264791363012e-1)
+    p = p * f + jnp.float32(6.931472028550421e-1)
+    p = p * f + jnp.float32(1.0)
+    # 2^n via exponent-field assembly (exact); n is clamped into the
+    # normal range and the residual scale applied in two exact steps so
+    # deep underflow flushes to 0 instead of wrapping the bit field
+    n_i = n.astype(jnp.int32)
+    n1 = jnp.clip(n_i, -126, 127)
+    n2 = jnp.clip(n_i - n1, -126, 127)
+    def two_pow(e):
+        return jax.lax.bitcast_convert_type(
+            ((e + 127) << 23).astype(jnp.int32), jnp.float32
+        )
+    return p * two_pow(n1) * two_pow(n2)
+
+
 def _binpack(free_cpu, free_mem):
     """Normalized ScoreFit: clip(20 − 10^fcpu − 10^fmem, [0,18]) / 18
     (ref funcs.go:154-191, rank.go:13). Single definition — the run/sweep
-    planners' closed-form trajectories must match the step formula exactly."""
-    total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
+    planners' closed-form trajectories must match the step formula
+    exactly, and ``_pow10`` keeps the only transcendental in the score
+    path bit-stable across compilations (the mesh parity contract)."""
+    total = _pow10(free_cpu) + _pow10(free_mem)
     return jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
 
 
@@ -309,7 +374,113 @@ def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
     trips (jax debug-nans raises at dispatch) — the scheduler degrades to
     the exact-np host oracle when this raises."""
     _faults.fault_point("tpu.kernel")
+    if deterministic_mode():
+        return _det_call(_plan_batch_jit, "plan_batch", args, init, n_real)
     return _plan_batch_jit(args, init, n_real)
+
+
+# ---------------------------------------------------------------------------
+# deterministic compile flavor (the mesh bit-parity contract)
+# ---------------------------------------------------------------------------
+#
+# The sharded==unsharded placement-equality contract compares two DIFFERENT
+# XLA compilations of the same jaxpr. XLA's fusion pass rematerializes
+# float subexpressions per consumer with context-dependent codegen, so the
+# two programs (and even two differently-fused unsharded programs) can
+# disagree on ``score`` by 1 ulp at a handful of lanes — and in a kernel
+# whose tie-breaks hinge on exact score equality among hundreds of
+# identical nodes, one flipped lane cascades into diverging fill runs
+# (observed: parity 0.63 at 8K nodes × 40K allocs with byte-identical
+# kernel inputs; neither --xla_cpu_enable_fast_math=false,
+# --xla_allow_excess_precision=false, nor lax.optimization_barrier closes
+# it — the remat happens inside the fusion pass). With fusion disabled,
+# every HLO op is materialized exactly once and both compilations produce
+# identical bits (verified at the failing scale).
+#
+# Production dispatch keeps the FUSED fast programs — placement quality
+# there is pinned by the ≥99% host-oracle parity budget, which 1-ulp
+# score noise cannot dent. The deterministic flavor exists for contracts
+# that assert bitwise equality: the multichip parity suite, the scored
+# multichip bench, and bench.py's sharded-vs-unsharded oracle check all
+# dispatch through it (env NOMAD_TPU_DETERMINISTIC=1).
+
+#: compiler options for the deterministic flavor: backend optimization
+#: level 0 skips the fusion/remat machinery, so every float is
+#: materialized exactly once and both compilations produce the same bits
+#: (verified at the failing scale). Chosen over xla_disable_hlo_passes
+#: ("fusion") because per-compile env_option_overrides only accept
+#: SINGULAR proto fields, and that one is repeated.
+DET_COMPILER_OPTIONS = {"xla_backend_optimization_level": 0}
+
+# nta: ignore[unbounded-cache] WHY: keyed by (planner, static args, input
+# aval+sharding signature) — the same bucketed shape ladder that bounds
+# the jit caches bounds this one
+_DET_EXECUTABLES: dict = {}
+
+
+def deterministic_mode() -> bool:
+    """Whether planner dispatch routes through the fusion-free
+    deterministic executables (env NOMAD_TPU_DETERMINISTIC=1)."""
+    import os
+
+    return os.environ.get("NOMAD_TPU_DETERMINISTIC", "0") == "1"
+
+
+def deterministic_scope():
+    """Context manager: enable deterministic dispatch for the body and
+    restore the operator's prior flag verbatim on exit (a bare pop would
+    flip a NOMAD_TPU_DETERMINISTIC=1 bench run back to the fast flavor
+    mid-artifact). The ONE definition of the env dance — bench.py's
+    sharded parity pin and the multichip scored bench both enter here."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def scope():
+        prior = os.environ.get("NOMAD_TPU_DETERMINISTIC")
+        os.environ["NOMAD_TPU_DETERMINISTIC"] = "1"
+        try:
+            yield
+        finally:
+            if prior is None:
+                os.environ.pop("NOMAD_TPU_DETERMINISTIC", None)
+            else:
+                os.environ["NOMAD_TPU_DETERMINISTIC"] = prior
+
+    return scope()
+
+
+def _det_call(jitfn, name, *call_args):
+    """Dispatch ``jitfn(*call_args)`` through an AOT executable compiled
+    with :data:`DET_COMPILER_OPTIONS`, cached per input signature —
+    shapes, dtypes AND shardings, so a sharded call never reuses an
+    unsharded executable. Python ints/bools in ``call_args`` are the
+    jits' static arguments: they select the lowering and are NOT passed
+    to the compiled executable."""
+
+    def leaf_key(x):
+        sharding = getattr(x, "sharding", None)
+        shape = getattr(x, "shape", ())
+        dtype = getattr(x, "dtype", type(x).__name__)
+        return (tuple(shape), str(dtype), repr(sharding))
+
+    statics = tuple(a for a in call_args if isinstance(a, (int, bool)))
+    dynamic = tuple(a for a in call_args if not isinstance(a, (int, bool)))
+    key = (
+        name,
+        statics,
+        tuple(
+            leaf_key(x)
+            for x in jax.tree_util.tree_leaves(dynamic)
+        ),
+    )
+    exe = _DET_EXECUTABLES.get(key)
+    if exe is None:
+        exe = jitfn.lower(*call_args).compile(
+            compiler_options=DET_COMPILER_OPTIONS
+        )
+        _DET_EXECUTABLES[key] = exe
+    return exe(*dynamic)
 
 
 def compile_cache_size() -> int:
@@ -317,14 +488,12 @@ def compile_cache_size() -> int:
     the recompile detector shared by bench.py outlier splits and the
     trace plane's flagged-span hook (a drain dispatch whose delta is
     nonzero paid an XLA trace+compile inside its window: the
-    51200-vs-50176 off-bucket class, made visible). -1 when the internals
-    move (detector degrades, never breaks dispatch)."""
+    51200-vs-50176 off-bucket class, made visible). Sharded programs
+    live in the SAME caches (a sharded input layout is just another
+    entry), so the detector covers mesh dispatches for free. -1 when
+    the internals move (detector degrades, never breaks dispatch)."""
     try:
-        return (
-            _plan_batch_jit._cache_size()
-            + _plan_batch_runs_jit._cache_size()
-            + _plan_batch_windowed_jit._cache_size()
-        )
+        return sum(fn._cache_size() for fn in PLANNER_JITS.values())
     except Exception:
         return -1
 
@@ -433,6 +602,11 @@ def plan_batch_runs(
     """Place ``n_allocs`` identical asks under full-ring (limit=∞) selection;
     returns node index per alloc slot (length ``a_pad``, -1 = unplaced)."""
     _faults.fault_point("tpu.kernel")
+    if deterministic_mode():
+        return _det_call(
+            _plan_batch_runs_jit, "plan_batch_runs", args, init, a_pad,
+            even_mode,
+        )
     return _plan_batch_runs_jit(args, init, a_pad, even_mode)
 
 
@@ -652,6 +826,11 @@ def plan_batch_windowed(
     """Place ``n_allocs`` identical asks; returns node index per alloc slot
     (length ``a_pad``, -1 = unplaced)."""
     _faults.fault_point("tpu.kernel")
+    if deterministic_mode():
+        return _det_call(
+            _plan_batch_windowed_jit, "plan_batch_windowed", args, used0,
+            collisions0, n_real, a_pad,
+        )
     return _plan_batch_windowed_jit(args, used0, collisions0, n_real, a_pad)
 
 
@@ -753,3 +932,13 @@ def _plan_batch_windowed_jit(
     )
     *_, placements, _ = jax.lax.while_loop(cond, body, init)
     return placements
+
+
+#: the jitted planners, by mode name — the one enumeration shared by the
+#: recompile detector above, the warmup prewarm ladder (single-chip AND
+#: mesh-sharded layouts), and the multichip bench's per-planner timings
+PLANNER_JITS = {
+    "exact": _plan_batch_jit,
+    "runs": _plan_batch_runs_jit,
+    "windowed": _plan_batch_windowed_jit,
+}

@@ -158,11 +158,17 @@ class DeviceState:
     #: compile a fresh scatter program per batch
     _ROW_BUCKETS = (8, 64, 512, 4096)
 
-    def __init__(self, epoch: int, n_pad: int, capacity, usable, used):
+    def __init__(self, epoch: int, n_pad: int, capacity, usable, used,
+                 mesh=None):
         import jax
 
         self.epoch = epoch
         self.n_pad = n_pad
+        #: the device mesh these planes are row-sharded over (None =
+        #: single-chip); a kernel batch must only consume a DeviceState
+        #: whose mesh matches its own, or GSPMD resharding (a silent
+        #: cross-device copy + a fresh compiled layout) rides the hot path
+        self.mesh = mesh
         n = capacity.shape[0]
         cap = np.zeros((n_pad, R_COLS), dtype=np.int32)
         cap[:n] = np.clip(capacity, 0, 2**31 - 1)
@@ -170,9 +176,19 @@ class DeviceState:
         usa[:n] = usable
         use = np.full((n_pad, R_COLS), 2**30, dtype=np.int32)
         use[:n] = np.clip(used, 0, 2**30)
-        self.capacity = jax.device_put(cap)
-        self.usable = jax.device_put(usa)
-        self.used = jax.device_put(use)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from . import shard as _shard
+
+            rows = NamedSharding(mesh, P(_shard.AXIS, None))
+            self.capacity = jax.device_put(cap, rows)
+            self.usable = jax.device_put(usa, rows)
+            self.used = jax.device_put(use, rows)
+        else:
+            self.capacity = jax.device_put(cap)
+            self.usable = jax.device_put(usa)
+            self.used = jax.device_put(use)
         self.pending: set[int] = set()
 
     @staticmethod
@@ -194,9 +210,19 @@ class DeviceState:
         padded = np.zeros(b, dtype=np.int32)
         padded[: len(rows)] = rows  # pad lanes repeat row 0: same-value set, idempotent
         vals = np.clip(used_host[padded], 0, 2**30).astype(np.int32)
-        self.used = _scatter_rows(
-            self.used, jax.device_put(padded), jax.device_put(vals)
-        )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # dirty rows/values replicate EXPLICITLY: an uncommitted
+            # host array next to the sharded plane would hand XLA a
+            # layout choice the prewarmed scatter never compiled
+            rep = NamedSharding(self.mesh, P())
+            padded_d = jax.device_put(padded, rep)
+            vals_d = jax.device_put(vals, rep)
+        else:
+            padded_d = jax.device_put(padded)
+            vals_d = jax.device_put(vals)
+        self.used = _scatter_fn(self.mesh)(self.used, padded_d, vals_d)
 
     def arrays(self):
         """(capacity, usable, used) device refs — immutable snapshots: a
@@ -205,15 +231,36 @@ class DeviceState:
         return self.capacity, self.usable, self.used
 
 
-_scatter_rows = None
+# nta: ignore[unbounded-cache] WHY: keyed by mesh identity — one entry
+# per configured mesh (at most two in practice: None + the process mesh)
+_SCATTER_FNS: dict = {}
 
 
-def _init_scatter_fns():
-    global _scatter_rows
-    if _scatter_rows is None:
+def _scatter_fn(mesh):
+    """The jitted dirty-row scatter for ``mesh`` (None = single-chip).
+    The sharded variant pins ``out_shardings`` to the row-sharded spec so
+    the refreshed ``used`` buffer stays partitioned exactly like the one
+    it replaces — GSPMD would otherwise be free to gather the output and
+    hand the next kernel batch a replicated plane (one silent recompile
+    plus an O(N) transfer per drain batch)."""
+    key = id(mesh) if mesh is not None else None
+    fn = _SCATTER_FNS.get(key)
+    if fn is None:
         import jax
 
-        _scatter_rows = jax.jit(lambda used, rows, vals: used.at[rows].set(vals))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from . import shard as _shard
+
+            fn = jax.jit(
+                lambda used, rows, vals: used.at[rows].set(vals),
+                out_shardings=NamedSharding(mesh, P(_shard.AXIS, None)),
+            )
+        else:
+            fn = jax.jit(lambda used, rows, vals: used.at[rows].set(vals))
+        _SCATTER_FNS[key] = fn
+    return fn
 
 
 class _Structural(Exception):
@@ -567,21 +614,23 @@ class ColumnarMirror:
     # ------------------------------------------------------------------
     # device-resident kernel state
     # ------------------------------------------------------------------
-    def device_state(self, n_pad: int, gen) -> Optional[tuple]:
+    def device_state(self, n_pad: int, gen, mesh=None) -> Optional[tuple]:
         """Device refs (capacity, usable, used) for the node plane padded
         to ``n_pad``, valid for state generation ``gen``; None when the
         mirror has moved past that generation (caller falls back to a host
-        transfer of its own snapshot arrays)."""
+        transfer of its own snapshot arrays). With ``mesh``, the planes
+        are row-sharded over it (the caller's fused batch dispatches
+        sharded, so its state plane must already live partitioned); a
+        cached state for a different mesh is rebuilt, never reshared."""
         with self._lock:
             cluster = self._cluster
             if cluster is None or cluster._synced_gen is not gen:
                 return None
-            _init_scatter_fns()
             ds = self._device.get(n_pad)
-            if ds is None or ds.epoch != self._epoch:
+            if ds is None or ds.epoch != self._epoch or ds.mesh is not mesh:
                 ds = DeviceState(
                     self._epoch, n_pad, cluster.capacity,
-                    cluster.usable, cluster.mirror_used,
+                    cluster.usable, cluster.mirror_used, mesh=mesh,
                 )
                 self._device[n_pad] = ds
             else:

@@ -1,0 +1,527 @@
+"""Scored multichip bench: MULTICHIP graduates from dry-run to timings.
+
+The driver's dryrun (``__graft_entry__.dryrun_multichip``) proves the
+sharded program compiles and matches the unsharded placements once, at
+toy scale, and its artifact carried only ``ok``/``rc`` plus a stderr
+tail drowned in XLA CPU-AOT machine-feature warnings. This module is
+the graduated harness:
+
+- :func:`bench_multichip` runs ALL THREE planners (exact scan, runs,
+  windowed) unsharded AND mesh-sharded at an env-scalable size
+  (``MULTICHIP_NODES`` / ``MULTICHIP_ALLOCS`` / ``MULTICHIP_DEVICES``),
+  timing each arm after an untimed warm pass, pinning sharded ==
+  unsharded placements value-for-value, and counting recompiles in the
+  timed window (must be 0 after warmup);
+- :func:`write_artifact` emits ``MULTICHIP_rNN.json`` (next free round
+  number) with the timings, parity counts and a **noise-filtered,
+  capped** stderr tail — the known XLA CPU-AOT loader warnings are
+  dropped so the field carries signal (the r05 artifact's tail was
+  ~95% machine-feature spam);
+- ``python -m nomad_tpu.tpu.multichip`` is the CLI
+  (scripts/multichip.sh wraps it with the 8-virtual-device CPU env).
+
+The synthetic cluster builders here are THE definition the sharded
+tests (tests/test_multichip.py) import, so bench and test clusters can
+never drift.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import re
+import time
+
+import numpy as np
+
+#: default bench scale — big enough that the node axis crosses every
+#: shard (8 shards × 256 rows) yet friendly to a single-core CPU mesh
+#: (collectives on virtual devices serialize; a few minutes end-to-end);
+#: MULTICHIP_NODES/ALLOCS scale it up, and the real headline scale rides
+#: bench.py's sharded section on real devices instead
+DEFAULT_NODES = int(os.environ.get("MULTICHIP_NODES", "2048"))
+DEFAULT_ALLOCS = int(os.environ.get("MULTICHIP_ALLOCS", "512"))
+DEFAULT_DEVICES = int(os.environ.get("MULTICHIP_DEVICES", "8"))
+
+#: stderr lines matching any of these are known environment noise, not
+#: signal: XLA's CPU AOT loader warning (per cache entry!) that the
+#: compile machine's feature flags differ from the host's, plus absl's
+#: pre-init log banner. Kept specific — an unknown error line must
+#: never be filtered into silence.
+NOISE_PATTERNS = (
+    r"cpu_aot_loader",
+    r"Loading XLA:CPU AOT result",
+    r"machine features?: \[",
+    r"This could lead to execution errors such as SIGILL",
+    r"WARNING: All log messages before absl::InitializeLog",
+    r"external/org_tensorflow",
+)
+
+#: hard cap on the artifact's tail field (chars, post-filter)
+TAIL_CAP = 2000
+
+_NOISE_RE = re.compile("|".join(NOISE_PATTERNS))
+
+
+def filter_noise_tail(text: str, cap: int = TAIL_CAP) -> str:
+    """Drop known-noise stderr lines and cap the result to its LAST
+    ``cap`` characters (the tail end is where a real failure prints)."""
+    kept = [ln for ln in text.splitlines() if ln and not _NOISE_RE.search(ln)]
+    out = "\n".join(kept)
+    if len(out) > cap:
+        out = out[-cap:]
+        # never start mid-line after the cut
+        nl = out.find("\n")
+        if 0 <= nl < len(out) - 1:
+            out = out[nl + 1:]
+    return out
+
+
+@contextlib.contextmanager
+def capture_stderr_fd():
+    """Capture fd-2 writes (XLA logs from C++ bypass sys.stderr) into a
+    temp file; yields a callable returning what was captured so far."""
+    import tempfile
+
+    saved = os.dup(2)
+    tmp = tempfile.TemporaryFile(mode="w+b")
+    os.dup2(tmp.fileno(), 2)
+    try:
+        def read() -> str:
+            os.fsync(2)
+            tmp.seek(0)
+            return tmp.read().decode("utf-8", "replace")
+
+        yield read
+    finally:
+        os.dup2(saved, 2)
+        os.close(saved)
+        tmp.close()
+
+
+# ---------------------------------------------------------------------------
+# synthetic cluster + per-planner args (shared with tests/test_multichip.py)
+# ---------------------------------------------------------------------------
+
+
+def build_cluster(n_nodes: int, n_allocs: int, n_values: int = 4, seed: int = 0):
+    """Heterogeneous capacities, ~10% infeasible nodes, spread classes —
+    the seeded synthetic cluster every sharded test and bench arm plans
+    against."""
+    rng = np.random.default_rng(seed)
+    capacity = np.stack(
+        [
+            rng.choice([4000, 8000, 16000, 32000], n_nodes),
+            rng.choice([8192, 16384, 32768], n_nodes),
+            # nta: ignore[shape-literal-unbucketed] WHY: resource VALUES
+            # (disk MB / bandwidth), not tensor dims — the array shape is
+            # (n_nodes,), which the callers bucket via shard.node_bucket
+            np.full(n_nodes, 100 * 1024),
+            np.full(n_nodes, 1000),
+        ],
+        axis=1,
+    ).astype(np.int32)
+    # nta: ignore[shape-literal-unbucketed] WHY: reserved-resource VALUES
+    # per row, not a padded dimension
+    reserved = np.tile(np.array([100, 256, 4096, 0], dtype=np.int32), (n_nodes, 1))
+    usable = (capacity[:, :2] - reserved[:, :2]).astype(np.float32)
+    feasible = rng.random(n_nodes) > 0.1
+    node_value = (np.arange(n_nodes) % n_values).astype(np.int32)
+    perm = rng.permutation(n_nodes).astype(np.int32)
+    demand = np.array([100, 128, 10, 5], dtype=np.int32)
+    return dict(
+        capacity=capacity,
+        reserved=reserved,
+        usable=usable,
+        feasible=feasible,
+        node_value=node_value,
+        perm=perm,
+        demand=demand,
+        n_allocs=n_allocs,
+        n_values=n_values,
+    )
+
+
+def pad_cluster(c: dict, n_pad: int) -> dict:
+    """Pad the node axis to ``n_pad`` rows (mesh-divisible sizes come
+    from ``shard.node_bucket``): pad rows are infeasible, carry zero
+    capacity and a poisoned ``reserved`` (2**30, the batch_sched pad
+    convention) so no planner can ever place on one, and extend the
+    rotation ring's tail ids. ``n_real`` records the true node count —
+    the exact scan's ring size and the windowed planner's static bound
+    keep using it, so the padding is invisible to the semantics (the
+    contract the uneven-last-shard property test pins)."""
+    n = c["capacity"].shape[0]
+    if n_pad < n:
+        raise ValueError(f"n_pad {n_pad} < real node count {n}")
+    out = dict(c)
+    out["n_real"] = n
+    if n_pad == n:
+        return out
+    k = n_pad - n
+    out["capacity"] = np.concatenate(
+        [c["capacity"], np.zeros((k, c["capacity"].shape[1]), np.int32)]
+    )
+    out["reserved"] = np.concatenate(
+        [c["reserved"], np.full((k, c["reserved"].shape[1]), 2**30, np.int32)]
+    )
+    out["usable"] = np.concatenate([c["usable"], np.ones((k, 2), np.float32)])
+    out["feasible"] = np.concatenate([c["feasible"], np.zeros(k, bool)])
+    out["node_value"] = np.concatenate(
+        [c["node_value"], np.full(k, -1, np.int32)]
+    )
+    out["perm"] = np.concatenate(
+        [c["perm"], np.arange(n, n_pad, dtype=np.int32)]
+    )
+    return out
+
+
+def exact_problem(c, spread: bool = True):
+    """(BatchArgs, BatchState) for the exact sequential-scan planner."""
+    from .kernel import BatchArgs, BatchState
+
+    n_nodes = c["capacity"].shape[0]
+    n_real = c.get("n_real", n_nodes)
+    n_allocs = c["n_allocs"]
+    V = c["n_values"]
+    args = BatchArgs(
+        capacity=c["capacity"],
+        usable=c["usable"],
+        feasible=c["feasible"][None, :],
+        affinity=np.zeros((1, n_nodes), dtype=np.float32),
+        affinity_present=np.zeros((1, n_nodes), dtype=bool),
+        group_count=np.full(1, n_allocs, dtype=np.int32),
+        group_eval=np.zeros(1, dtype=np.int32),
+        node_value=c["node_value"][None, :],
+        spread_desired=np.full(
+            (1, V), float(n_allocs) / V if spread else -1.0, dtype=np.float32
+        ),
+        spread_implicit=np.full(1, -1.0, dtype=np.float32),
+        spread_weight_frac=np.ones(1, dtype=np.float32),
+        spread_even=np.zeros(1, dtype=bool),
+        spread_active=np.full(1, spread, dtype=bool),
+        perm=c["perm"][None, :],
+        ring=np.array([n_real], dtype=np.int32),
+        demands=np.tile(c["demand"], (n_allocs, 1)),
+        groups=np.zeros(n_allocs, dtype=np.int32),
+        limits=np.full(n_allocs, n_nodes, dtype=np.int32),
+        valid=np.ones(n_allocs, dtype=bool),
+    )
+    init = BatchState(
+        used=c["reserved"].copy(),
+        collisions=np.zeros((1, n_nodes), dtype=np.int32),
+        spread_counts=np.zeros((1, V), dtype=np.int32),
+        spread_present=np.zeros((1, V), dtype=bool),
+        offset=np.zeros(1, dtype=np.int32),
+    )
+    return args, init
+
+
+def runs_problem(c, affinity: bool = True, spread: bool = True):
+    """(RunArgs, init tuple) for the run-based full-ring planner, in
+    rotation order."""
+    from .kernel import RunArgs
+
+    n_nodes = c["capacity"].shape[0]
+    V = c["n_values"]
+    perm = c["perm"]
+    aff = (
+        np.where(np.arange(n_nodes) % 5 == 0, 0.5, 0.0).astype(np.float32)
+        if affinity
+        else np.zeros(n_nodes, dtype=np.float32)
+    )
+    args = RunArgs(
+        capacity=c["capacity"][perm],
+        usable=c["usable"][perm],
+        feasible=c["feasible"][perm],
+        affinity=aff[perm],
+        affinity_present=(aff > 0)[perm],
+        group_count=np.int32(c["n_allocs"]),
+        node_value=c["node_value"][perm],
+        spread_desired=np.full(
+            V, float(c["n_allocs"]) / V if spread else -1.0, dtype=np.float32
+        ),
+        spread_implicit=np.float32(-1.0),
+        spread_weight_frac=np.float32(1.0),
+        spread_even=np.bool_(False),
+        spread_active=np.bool_(spread),
+        perm=perm,
+        demand=c["demand"],
+        n_allocs=np.int32(c["n_allocs"]),
+    )
+    init = (
+        c["reserved"][perm].copy(),
+        np.zeros(n_nodes, dtype=np.int32),
+        np.zeros(V, dtype=np.int32),
+        np.zeros(V, dtype=bool),
+    )
+    return args, init
+
+
+def window_problem(c, limit: int = 10):
+    """(WindowArgs, used0, collisions0) for the windowed planner."""
+    from .kernel import WindowArgs
+
+    n_nodes = c["capacity"].shape[0]
+    args = WindowArgs(
+        capacity=c["capacity"],
+        usable=c["usable"],
+        feasible=c["feasible"],
+        perm=c["perm"],
+        demand=c["demand"],
+        group_count=np.int32(c["n_allocs"]),
+        limit=np.int32(limit),
+        n_allocs=np.int32(c["n_allocs"]),
+    )
+    return args, c["reserved"].copy(), np.zeros(n_nodes, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# the scored bench
+# ---------------------------------------------------------------------------
+
+
+def _time_best(fn, samples: int = 2) -> float:
+    best = None
+    for _ in range(samples):
+        t0 = time.monotonic()
+        fn()
+        dt = time.monotonic() - t0
+        best = dt if best is None or dt < best else best
+    return best
+
+
+def bench_multichip(
+    n_devices: int = DEFAULT_DEVICES,
+    n_nodes: int = DEFAULT_NODES,
+    n_allocs: int = DEFAULT_ALLOCS,
+    seed: int = 0,
+    samples: int = 2,
+) -> dict:
+    """Run all three planners unsharded and mesh-sharded; returns the
+    scored report (no I/O — :func:`write_artifact` persists it)."""
+    import jax.numpy as jnp
+
+    from . import shard
+    from .kernel import (
+        compile_cache_size,
+        plan_batch,
+        plan_batch_runs,
+        plan_batch_windowed,
+    )
+
+    mesh = shard.configure(n_devices)
+    if mesh is None:
+        return {
+            "n_devices": n_devices,
+            "nodes": n_nodes,
+            "allocs": n_allocs,
+            "ok": False,
+            "skipped": True,
+            "reason": f"need {n_devices} devices",
+        }
+
+    # pad to the mesh-divisible node bucket so ANY env scale shards
+    # (uneven real counts leave the padding on the last shard)
+    c = pad_cluster(
+        build_cluster(n_nodes, n_allocs, seed=seed),
+        shard.node_bucket(n_nodes, mesh),
+    )
+    A = n_allocs
+    planners: dict[str, dict] = {}
+
+    def score(name, run_plain, run_sharded):
+        # production arms: warm (compiles, or loads from the persistent
+        # cache), then timed best-of-N with the recompile pin
+        want = np.asarray(run_plain())
+        got_warm = np.asarray(run_sharded())
+        t_plain = _time_best(lambda: np.asarray(run_plain()), samples)
+        cache0 = compile_cache_size()
+        t_shard = _time_best(lambda: np.asarray(run_sharded()), samples)
+        cache1 = compile_cache_size()
+        got = np.asarray(run_sharded())
+        placed = int((want >= 0).sum())
+        # fast-pair agreement (informational): two different fused
+        # compilations may legally disagree on sub-ulp score ties
+        fast_agree = int((want == got).sum())
+        # THE parity pin rides the deterministic compile flavor
+        # (kernel.DET_COMPILER_OPTIONS): bit-identical by construction,
+        # so any mismatch is a real GSPMD semantics regression
+        from .kernel import deterministic_scope
+
+        parity_mode = "deterministic"
+        try:
+            with deterministic_scope():
+                det_want = np.asarray(run_plain())
+                det_got = np.asarray(run_sharded())
+        except Exception as e:  # backend without the det flavor:
+            # degrade to the fast pair, visibly
+            parity_mode = f"fast pair (det flavor failed: {e})"
+            det_want, det_got = want, got
+        matched = int((det_want == det_got).sum())
+        planners[name] = {
+            "unsharded_s": round(t_plain, 4),
+            "sharded_s": round(t_shard, 4),
+            "speedup": round(t_plain / t_shard, 3) if t_shard else None,
+            "placed": placed,
+            "parity": round(matched / max(len(det_want), 1), 6),
+            "parity_checked": int(len(det_want)),
+            "parity_mode": parity_mode,
+            "fast_pair_agreement": round(
+                fast_agree / max(len(want), 1), 6
+            ),
+            "recompiles": (
+                cache1 - cache0 if cache0 >= 0 and cache1 >= 0 else None
+            ),
+            "warm_equal": bool(np.array_equal(want, got_warm)),
+        }
+
+    n_real = c.get("n_real", n_nodes)
+
+    # exact sequential scan
+    bargs, binit = exact_problem(c)
+    baspec, bsspec = shard.batch_specs()
+    b_plain_args = tuple(jnp.asarray(a) for a in bargs)
+    b_plain_init = tuple(jnp.asarray(s) for s in binit)
+    b_shard_args = shard.put(bargs, baspec, mesh)
+    b_shard_init = shard.put(binit, bsspec, mesh)
+    score(
+        "exact",
+        lambda: plan_batch(
+            type(bargs)(*b_plain_args), type(binit)(*b_plain_init), n_real
+        )[1],
+        lambda: plan_batch(b_shard_args, b_shard_init, n_real)[1],
+    )
+
+    # run-based full-ring planner (the spread/affinity headline path)
+    rargs, rinit = runs_problem(c)
+    raspec, rispec = shard.run_specs()
+    r_plain_args = type(rargs)(*[jnp.asarray(a) for a in rargs])
+    r_plain_init = tuple(jnp.asarray(x) for x in rinit)
+    r_shard_args = shard.put(rargs, raspec, mesh)
+    r_shard_init = shard.put(rinit, rispec, mesh)
+    score(
+        "runs",
+        lambda: plan_batch_runs(r_plain_args, r_plain_init, A, False),
+        lambda: plan_batch_runs(r_shard_args, r_shard_init, A, False),
+    )
+
+    # rotation-parallel windowed planner
+    wargs, wused0, wcoll0 = window_problem(c)
+    waspec, (wuspec, wcspec) = shard.window_specs()
+    w_plain = (
+        type(wargs)(*[jnp.asarray(a) for a in wargs]),
+        jnp.asarray(wused0),
+        jnp.asarray(wcoll0),
+    )
+    w_shard = (
+        shard.put(wargs, waspec, mesh),
+        shard.put(wused0, wuspec, mesh),
+        shard.put(wcoll0, wcspec, mesh),
+    )
+    score(
+        "windowed",
+        lambda: plan_batch_windowed(w_plain[0], w_plain[1], w_plain[2],
+                                    n_real, A),
+        lambda: plan_batch_windowed(w_shard[0], w_shard[1], w_shard[2],
+                                    n_real, A),
+    )
+
+    # the contract: deterministic-pair parity 1.0 with real placements.
+    # fast_pair_agreement/warm_equal stay informational — two fused
+    # compilations may legally disagree on sub-ulp score ties.
+    ok = all(
+        p["parity"] == 1.0 and p["placed"] > 0 for p in planners.values()
+    )
+    return {
+        "n_devices": n_devices,
+        "nodes": n_nodes,
+        "allocs": n_allocs,
+        "seed": seed,
+        "samples": samples,
+        "planners": planners,
+        "ok": ok,
+        "skipped": False,
+    }
+
+
+def next_artifact_path(root: str = None) -> str:
+    """The next free ``MULTICHIP_rNN.json`` round slot under ``root``."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    taken = []
+    for p in glob.glob(os.path.join(root, "MULTICHIP_r*.json")):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", p)
+        if m:
+            taken.append(int(m.group(1)))
+    nn = max(taken, default=0) + 1
+    return os.path.join(root, f"MULTICHIP_r{nn:02d}.json")
+
+
+def write_artifact(report: dict, tail: str = "", path: str = None) -> str:
+    """Persist the scored report with a noise-filtered, capped tail."""
+    path = path or next_artifact_path()
+    report = dict(report)
+    report["tail"] = filter_noise_tail(tail)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def summary_line(report: dict) -> str:
+    """One greppable line (the artifact's headline must survive a
+    truncated log tail — same contract as BENCH_SUMMARY)."""
+    if report.get("skipped"):
+        return f"MULTICHIP_SUMMARY skipped=1 reason={report.get('reason')}"
+    parts = [
+        f"devices={report['n_devices']}",
+        f"nodes={report['nodes']}",
+        f"allocs={report['allocs']}",
+        f"ok={int(report['ok'])}",
+    ]
+    for name, p in report.get("planners", {}).items():
+        parts.append(
+            f"{name}={p['sharded_s']}s/x{p['speedup']}"
+            f"/parity{p['parity']}/rc{p['recompiles']}"
+        )
+    return "MULTICHIP_SUMMARY " + " ".join(parts)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="scored multichip bench (writes MULTICHIP_rNN.json)"
+    )
+    ap.add_argument("--devices", type=int, default=DEFAULT_DEVICES)
+    ap.add_argument("--nodes", type=int, default=DEFAULT_NODES)
+    ap.add_argument("--allocs", type=int, default=DEFAULT_ALLOCS)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="artifact path override")
+    ap.add_argument(
+        "--no-artifact", action="store_true",
+        help="print the report, write nothing",
+    )
+    args = ap.parse_args(argv)
+
+    with capture_stderr_fd() as read_tail:
+        report = bench_multichip(
+            n_devices=args.devices, n_nodes=args.nodes,
+            n_allocs=args.allocs, seed=args.seed,
+        )
+        tail = read_tail()
+    if not args.no_artifact:
+        path = write_artifact(report, tail=tail, path=args.out)
+        print(f"wrote {path}")
+    else:
+        print(json.dumps(report, indent=1))
+    print(summary_line(report))
+    return 0 if report.get("ok") or report.get("skipped") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,214 @@
+"""Device-mesh sharding of the planner node axis.
+
+The node axis is the framework's scale axis (clusters grow in nodes, not
+resource columns), so it is the ONE axis partitioned across the device
+mesh: every planner's capacity/usable/used planes split by node rows,
+the per-group feasibility/affinity/value planes split by node columns,
+and the small per-group / per-alloc tables replicate. GSPMD then keeps
+feasibility + rank compute local to each shard and inserts the
+collectives for the cross-shard reductions (the argmax over candidate
+scores, the spread/propertyset count updates, the fit all-reduce) —
+the kernels themselves are unchanged, and `tests/test_multichip.py`
+pins sharded == unsharded placements value-for-value.
+
+Mechanics (the SNIPPETS compile-helper pattern, adapted):
+
+- one :class:`~jax.sharding.Mesh` over ``('nodes',)``, built lazily from
+  ``NOMAD_TPU_SHARD_DEVICES`` (default: every visible device) and gated
+  by ``NOMAD_TPU_SHARD`` — sharding is strictly opt-in, a single-chip
+  box never pays a collective;
+- per-planner :class:`~jax.sharding.PartitionSpec` trees
+  (:func:`batch_specs` / :func:`run_specs` / :func:`window_specs`) —
+  the single source the runtime paths, the warmup prewarm and the
+  multichip bench all place arrays through, so the compiled input
+  layouts can never drift between them (a layout mismatch is a silent
+  recompile, the exact class the zero-recompile pin guards);
+- :func:`put` — ``jax.device_put`` of a planner arg tree with its
+  matching ``NamedSharding`` tree (scalars and small tables placed
+  replicated EXPLICITLY: an uncommitted host array next to sharded
+  inputs would let XLA pick a layout warmup never compiled);
+- :func:`node_bucket` — the one padding policy for the node axis under
+  a mesh: ``batch_sched._bucket`` rounded up to a multiple of the mesh
+  size, so every shard holds the same row count and the last shard
+  carries the padding rows.
+
+Everything degrades to the unsharded path when no mesh is active: the
+helpers return their inputs untouched and the planners run exactly the
+single-chip programs the BASELINE numbers were taken on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger("nomad_tpu.tpu.shard")
+
+#: the mesh axis every node-dimension plane is partitioned over
+AXIS = "nodes"
+
+#: clusters below this many real nodes never shard even when a mesh is
+#: configured — per-shard work would be smaller than the collective
+#: latency it buys (the same shape of gate as SMALL_EVAL_ORACLE_MAX)
+MIN_NODES = int(os.environ.get("NOMAD_TPU_SHARD_MIN_NODES", "4096"))
+
+_lock = threading.Lock()
+_state = {"configured": False, "mesh": None}
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_SHARD", "0") == "1"
+
+
+def configure(n_devices: Optional[int] = None, enabled: bool = True):
+    """Build (or tear down, with ``enabled=False``) the process mesh.
+    Returns the active mesh or None. Safe to call repeatedly; bench and
+    tests call it explicitly, the server path calls it from config."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    with _lock:
+        _state["configured"] = True
+        if not enabled:
+            _state["mesh"] = None
+            return None
+        devices = jax.devices()
+        want = n_devices or int(
+            os.environ.get("NOMAD_TPU_SHARD_DEVICES", str(len(devices)))
+        )
+        if want < 2 or len(devices) < want:
+            logger.warning(
+                "shard: %d devices requested, %d visible; staying unsharded",
+                want, len(devices),
+            )
+            _state["mesh"] = None
+            return None
+        _state["mesh"] = Mesh(np.array(devices[:want]), (AXIS,))
+        return _state["mesh"]
+
+
+def active_mesh(n_nodes: Optional[int] = None):
+    """The process mesh, or None when sharding is off (or ``n_nodes`` is
+    given and below the MIN_NODES gate). First call resolves the env
+    gate so library code never needs an explicit configure()."""
+    with _lock:
+        configured = _state["configured"]
+        mesh = _state["mesh"]
+    if not configured:
+        mesh = configure(enabled=_env_enabled())
+    if mesh is None:
+        return None
+    if n_nodes is not None and n_nodes < MIN_NODES:
+        return None
+    return mesh
+
+
+def mesh_size(mesh) -> int:
+    """Devices in ``mesh`` (1 for None — the unsharded degenerate).
+    Takes the mesh EXPLICITLY: callers that were gated off (small
+    cluster, sharding disabled) pass None and must get 1, never a
+    re-resolved global mesh."""
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def shard_tags(mesh) -> dict:
+    """Trace-span tags describing the dispatch's shard topology."""
+    return {"shards": int(mesh.devices.size), "mesh_axis": AXIS}
+
+
+def node_bucket(n: int, mesh) -> int:
+    """Padded node-axis size under ``mesh`` (None → unsharded): the ONE
+    bucketing policy (batch_sched._bucket) rounded up to a mesh-size
+    multiple so shards are equal-sized (the last shard absorbs the
+    padding rows)."""
+    from .batch_sched import _bucket
+
+    b = _bucket(n)
+    k = mesh_size(mesh)
+    if k > 1 and b % k:
+        b = ((b // k) + 1) * k
+    return b
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec trees, one per planner (the single placement source)
+# ---------------------------------------------------------------------------
+
+
+def batch_specs():
+    """(BatchArgs, BatchState) PartitionSpec trees for the exact-scan
+    multi-eval planner: node rows/cols sharded, group/alloc tables
+    replicated (they are O(evals), not O(cluster))."""
+    from jax.sharding import PartitionSpec as P
+
+    from .kernel import BatchArgs, BatchState
+
+    rows, cols, rep = P(AXIS, None), P(None, AXIS), P()
+    args = BatchArgs(
+        capacity=rows, usable=rows, feasible=cols, affinity=cols,
+        affinity_present=cols, group_count=rep, group_eval=rep,
+        node_value=cols, spread_desired=rep, spread_implicit=rep,
+        spread_weight_frac=rep, spread_even=rep, spread_active=rep,
+        perm=cols, ring=rep, demands=rep, groups=rep, limits=rep,
+        valid=rep,
+    )
+    state = BatchState(
+        used=rows, collisions=cols, spread_counts=rep,
+        spread_present=rep, offset=rep,
+    )
+    return args, state
+
+
+def run_specs():
+    """(RunArgs, init-tuple) PartitionSpec trees for the run-based
+    full-ring planner (the spread/affinity headline path)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .kernel import RunArgs
+
+    rows, node, rep = P(AXIS, None), P(AXIS), P()
+    args = RunArgs(
+        capacity=rows, usable=rows, feasible=node, affinity=node,
+        affinity_present=node, group_count=rep, node_value=node,
+        spread_desired=rep, spread_implicit=rep, spread_weight_frac=rep,
+        spread_even=rep, spread_active=rep, perm=node, demand=rep,
+        n_allocs=rep,
+    )
+    init = (rows, node, rep, rep)
+    return args, init
+
+
+def window_specs():
+    """(WindowArgs, (used0, collisions0)) PartitionSpec trees for the
+    rotation-parallel windowed planner."""
+    from jax.sharding import PartitionSpec as P
+
+    from .kernel import WindowArgs
+
+    rows, node, rep = P(AXIS, None), P(AXIS), P()
+    args = WindowArgs(
+        capacity=rows, usable=rows, feasible=node, perm=node,
+        demand=rep, group_count=rep, limit=rep, n_allocs=rep,
+    )
+    return args, (rows, node)
+
+
+def put(tree, spec_tree, mesh):
+    """``device_put`` a planner arg tree with its PartitionSpec tree.
+    Every leaf — including the replicated scalars — is placed with an
+    explicit NamedSharding so the committed layouts match what the
+    warmup prewarm compiled (the zero-recompile contract).
+
+    ``spec_tree`` mirrors ``tree``'s structure with PartitionSpec leaves
+    (a PartitionSpec is itself a tuple, but ``tree``'s structure wins in
+    tree_map, so each spec rides through whole at its leaf position)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def _put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_put, tree, spec_tree)

@@ -20,15 +20,18 @@ import threading
 from .batch_sched import _bucket
 
 
-def bucket_shape(n_nodes: int, n_allocs: int) -> tuple[int, int]:
+def bucket_shape(n_nodes: int, n_allocs: int, mesh=None) -> tuple[int, int]:
     """The exact padded shape production hits for a real (nodes, allocs)
-    pair — computed through the ONE bucketing policy (batch_sched._bucket)
-    so the prewarm ladder can never drift from the scheduler again. (The
+    pair — computed through the ONE bucketing policy (batch_sched._bucket;
+    shard.node_bucket for the node axis when a mesh is given) so the
+    prewarm ladder can never drift from the scheduler again. (The
     previous hand-written ladder listed 51200 for the 50K-alloc headline
     while the scheduler pads 50K to 50176: the prewarmed program was never
     the one the headline ran, so the first real eval at that shape still
     compiled.)"""
-    return _bucket(n_nodes), _bucket(n_allocs)
+    from .shard import node_bucket
+
+    return node_bucket(n_nodes, mesh), _bucket(n_allocs)
 
 
 #: default ladder: dev/CI clusters and the 10K-node / 50K-alloc headline,
@@ -40,10 +43,17 @@ DEFAULT_SHAPES = tuple(bucket_shape(n, a) for n, a in DEFAULT_SIZES)
 DEFAULT_V = 4
 
 
-def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
+def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V, mesh=None) -> int:
     """Compile the planners for each (node_bucket, alloc_bucket) shape;
     returns the number of programs compiled. Failures are swallowed — a
-    prewarm must never take the agent down."""
+    prewarm must never take the agent down.
+
+    With ``mesh``, the example args are placed through the SAME
+    PartitionSpec trees the runtime paths use (shard.put), so the AOT
+    programs carry the mesh-sharded input layouts — the sharded headline
+    then hits warm programs instead of paying a GSPMD trace+compile on
+    its first real eval. Node buckets in ``shapes`` must already round
+    through ``bucket_shape(..., mesh=mesh)``."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -58,9 +68,33 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
         _plan_batch_runs_jit as plan_batch_runs,
         _plan_batch_windowed_jit as plan_batch_windowed,
     )
+    from . import shard as _shard
 
+    all_mesh = mesh
     compiled = 0
+    # Per-shape gate mirroring the runtime's MIN_NODES threshold (which
+    # tests the REAL node count, not the padded bucket). A padded shape
+    # only tells us the bucket, and real counts in (prev_bucket, n_pad]
+    # all land in it — when that window straddles MIN_NODES, BOTH
+    # flavors can reach this shape at runtime, so both are prewarmed
+    # (e.g. 3500 real nodes bucket to 4096 = MIN_NODES: runtime
+    # dispatches the UNSHARDED 4096 program, and a sharded-only prewarm
+    # would leave the first real eval paying the cold compile).
+    expanded = []
     for n_pad, a_pad in shapes:
+        if all_mesh is None or n_pad < _shard.MIN_NODES:
+            expanded.append((n_pad, a_pad, None))
+            continue
+        # the sharded flavor re-rounds the bucket to a mesh multiple —
+        # idempotent for power-of-two meshes, and for mesh widths that
+        # don't divide the bucket (e.g. 6) it lands on the exact padded
+        # size runtime dispatch computes (node_bucket is idempotent on
+        # bucket values, so shapes prepared without a mesh can't drift)
+        expanded.append((_shard.node_bucket(n_pad, all_mesh), a_pad, all_mesh))
+        prev_bucket = n_pad - 1024 if n_pad > 1024 else n_pad // 2
+        if prev_bucket < _shard.MIN_NODES:
+            expanded.append((n_pad, a_pad, None))
+    for n_pad, a_pad, mesh in expanded:
         try:
             capacity = jnp.ones((n_pad, 4), dtype=jnp.int32)
             usable = jnp.ones((n_pad, 2), dtype=jnp.float32)
@@ -96,6 +130,10 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
                 jnp.zeros(V, dtype=jnp.int32),
                 jnp.zeros(V, dtype=bool),
             )
+            if mesh is not None:
+                raspec, rispec = _shard.run_specs()
+                rargs = _shard.put(rargs, raspec, mesh)
+                rinit = _shard.put(rinit, rispec, mesh)
             plan_batch_runs.lower(rargs, rinit, a_pad, False).compile()
             compiled += 1
 
@@ -109,8 +147,14 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
                 limit=jnp.int32(2),
                 n_allocs=jnp.int32(1),
             )
+            wused0, wcoll0 = used0, coll0
+            if mesh is not None:
+                waspec, (wuspec, wcspec) = _shard.window_specs()
+                wargs = _shard.put(wargs, waspec, mesh)
+                wused0 = _shard.put(wused0, wuspec, mesh)
+                wcoll0 = _shard.put(wcoll0, wcspec, mesh)
             plan_batch_windowed.lower(
-                wargs, used0, coll0, n_pad, a_pad
+                wargs, wused0, wcoll0, n_pad, a_pad
             ).compile()
             compiled += 1
 
@@ -142,6 +186,10 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
                 spread_present=jnp.zeros((1, V), dtype=bool),
                 offset=jnp.zeros(1, dtype=jnp.int32),
             )
+            if mesh is not None:
+                baspec, bsspec = _shard.batch_specs()
+                bargs = _shard.put(bargs, baspec, mesh)
+                binit = _shard.put(binit, bsspec, mesh)
             plan_batch.lower(bargs, binit, n_pad).compile()
             compiled += 1
         except Exception:
@@ -149,19 +197,24 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V) -> int:
     return compiled
 
 
-def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8) -> int:
+def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
+                  mesh=None) -> int:
     """Compile the FUSED drain-batch shapes for a (cluster size, drain
     size) pair: the multi-eval ``plan_batch`` program plus the per-eval
     usage-base program the collector dispatches alongside it
-    (drain.py:_run computes exactly these paddings). Returns programs
-    compiled; failures are swallowed like ``prewarm``."""
+    (drain.py:_run computes exactly these paddings — including the
+    mesh-sharded node bucket and input layouts when ``mesh`` is given).
+    Returns programs compiled; failures are swallowed like ``prewarm``."""
     import numpy as np
     import jax.numpy as jnp
 
     from .drain import _used_bases_fn
     from .kernel import BatchArgs, BatchState, _plan_batch_jit
+    from . import shard as _shard
 
-    N = _bucket(n_nodes)
+    if mesh is not None and n_nodes < _shard.MIN_NODES:
+        mesh = None  # runtime gate: small clusters dispatch unsharded
+    N = _shard.node_bucket(n_nodes, mesh)
     E = _bucket(batch)
     G = _bucket(batch)
     A = _bucket(batch * 4)
@@ -196,15 +249,30 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8) -> int:
             spread_present=jnp.zeros((G, V), dtype=bool),
             offset=jnp.zeros(E, dtype=jnp.int32),
         )
+        if mesh is not None:
+            aspec, sspec = _shard.batch_specs()
+            args = _shard.put(args, aspec, mesh)
+            init = _shard.put(init, sspec, mesh)
         _plan_batch_jit.lower(args, init, n_nodes).compile()
         compiled += 1
+        placements_w = jnp.full(A, -1, dtype=jnp.int32)
+        eval_of_w = jnp.zeros(A, dtype=jnp.int32)
+        n_real_w = jnp.int32(n_nodes)
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            placements_w = jax.device_put(placements_w, rep)
+            eval_of_w = jax.device_put(eval_of_w, rep)
+            n_real_w = jax.device_put(np.int32(n_nodes), rep)
         _used_bases_fn().lower(
             init.used,
-            jnp.full(A, -1, dtype=jnp.int32),
+            placements_w,
             args.demands,
-            jnp.zeros(A, dtype=jnp.int32),
+            eval_of_w,
             E,
-            jnp.int32(n_nodes),
+            n_real_w,
         ).compile()
         compiled += 1
     except Exception:
@@ -212,14 +280,16 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8) -> int:
     return compiled
 
 
-def prewarm_async(shapes=DEFAULT_SHAPES, drain: tuple = None) -> threading.Thread:
+def prewarm_async(shapes=DEFAULT_SHAPES, drain: tuple = None,
+                  mesh=None) -> threading.Thread:
     """Fire-and-forget prewarm; returns the daemon thread. ``drain``
-    optionally adds the fused (n_nodes, batch) drain shapes."""
+    optionally adds the fused (n_nodes, batch) drain shapes; ``mesh``
+    compiles every shape with the mesh-sharded layouts instead."""
 
     def run():
-        prewarm(shapes)
+        prewarm(shapes, mesh=mesh)
         if drain is not None:
-            prewarm_drain(*drain)
+            prewarm_drain(*drain, mesh=mesh)
 
     t = threading.Thread(target=run, name="tpu-prewarm", daemon=True)
     t.start()

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Sharded multichip suite on a CPU-virtualized 8-device mesh: the scored
+# bench (writes MULTICHIP_rNN.json + prints MULTICHIP_SUMMARY) followed
+# by the sharded test file. Scale knobs:
+#   MULTICHIP_DEVICES (default 8)  mesh width
+#   MULTICHIP_NODES   (default 2048)  node axis
+#   MULTICHIP_ALLOCS  (default 512)  placements
+# Real-TPU boxes: drop the XLA_FLAGS/JAX_PLATFORMS overrides and the
+# same code paths drive the hardware mesh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DEVICES="${MULTICHIP_DEVICES:-8}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=${DEVICES}}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the persistent compile cache stores CPU-AOT entries whose machine
+# feature flags may not match this host (cpu_aot_loader SIGILL warning)
+export NOMAD_TPU_COMPILE_CACHE="${NOMAD_TPU_COMPILE_CACHE:-off}"
+
+python -m nomad_tpu.tpu.multichip "$@"
+
+echo "--- sharded test suite ---"
+python -m pytest tests/test_multichip.py -q -p no:cacheprovider

@@ -10,9 +10,13 @@ import sys
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_allow_excess_precision" not in flags:
+    # bitwise value stability across compilations: the sharded and
+    # unsharded planner programs must agree on every float (the mesh
+    # parity contract; see nomad_tpu/tpu/__init__._ensure_xla_determinism)
+    flags = (flags + " --xla_allow_excess_precision=false").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # Tests compile tiny CPU programs quickly; sharing the persistent cache with
 # TPU-process runs risks loading XLA:CPU AOT entries whose machine-feature

@@ -672,6 +672,131 @@ class TestThreadNames:
         assert found == [], [f.format() for f in found]
 
 
+class TestShardSpecDrift:
+    """shard-spec-drift: device_put/jax.jit in mesh-active tpu/ code
+    paths must state their sharding (tpu/shard.py discipline)."""
+
+    def test_bare_device_put_in_mesh_function_flagged(self):
+        src = (
+            "import jax\n"
+            "def push(x, mesh):\n"
+            "    return jax.device_put(x)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+        assert len(found) == 1 and found[0].line == 3
+
+    def test_device_put_with_sharding_clean(self):
+        src = (
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "def push(x, mesh):\n"
+            "    return jax.device_put(x, NamedSharding(mesh, P('nodes')))\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+
+    def test_unsharded_branch_exempt(self):
+        """The else of `if mesh is not None` (and the body of
+        `if mesh is None`) are the single-chip paths — bare placements
+        there are exactly right."""
+        src = (
+            "import jax\n"
+            "def push(x, mesh):\n"
+            "    if mesh is not None:\n"
+            "        return jax.device_put(x, mesh_sharding(mesh))\n"
+            "    else:\n"
+            "        return jax.device_put(x)\n"
+            "def pull(x, mesh):\n"
+            "    if mesh is None:\n"
+            "        return jax.device_put(x)\n"
+            "    return jax.device_put(x, mesh_sharding(mesh))\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+
+    def test_self_mesh_attribute_gates_too(self):
+        src = (
+            "import jax\n"
+            "class S:\n"
+            "    def refresh(self, x):\n"
+            "        if self.mesh is not None:\n"
+            "            return jax.device_put(x)\n"
+            "        return jax.device_put(x)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+        # line 5 (mesh-active) flagged; line 6 (fallthrough after the
+        # gate) is NOT statically unsharded and is flagged too — the
+        # checker only exempts explicit None-branches
+        assert {f.line for f in found} == {5, 6}
+
+    def test_jit_without_out_shardings_flagged(self):
+        src = (
+            "import jax\n"
+            "def make(mesh):\n"
+            "    return jax.jit(lambda u, r, v: u.at[r].set(v))\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+        assert len(found) == 1 and found[0].line == 3
+
+    def test_jit_with_out_shardings_clean(self):
+        src = (
+            "import jax\n"
+            "def make(mesh, spec):\n"
+            "    return jax.jit(lambda u: u, out_shardings=spec)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+
+    def test_meshless_function_and_foreign_scope_ignored(self):
+        src = (
+            "import jax\n"
+            "def plain(x):\n"
+            "    return jax.device_put(x)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+        src2 = (
+            "import jax\n"
+            "def push(x, mesh):\n"
+            "    return jax.device_put(x)\n"
+        )
+        # outside nomad_tpu/tpu/: out of scope by design
+        assert not findings_for(
+            {"nomad_tpu/core/fix.py": src2}, "shard-spec-drift"
+        )
+
+    def test_why_suppression_clears(self):
+        src = (
+            "import jax\n"
+            "def push(x, mesh):\n"
+            "    # nta: ignore[shard-spec-drift] WHY: fixture exception\n"
+            "    return jax.device_put(x)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+
+    def test_tree_is_clean(self):
+        """The sharded planner satellite: the real tpu/ tree states its
+        shardings everywhere a mesh is active (or carries a WHY)."""
+        project = Project.load(ROOT)
+        found = [
+            f for f in run(project, ["shard-spec-drift"])
+            if f.rule == "shard-spec-drift"
+        ]
+        assert found == [], [f.format() for f in found]
+
+
 class TestFramework:
     SRC = "def f(self, snap):\n    self.x_index = snap.latest_index() + 1{}\n"
 

@@ -513,3 +513,70 @@ class TestSyncLockScope:
         assert not syncer.is_alive()
         assert out.get("view") is None
         assert h.mirror._sub is None, "closed mirror resurrected a subscription"
+
+
+class TestDeviceStateSharded:
+    """Mesh-sharded DeviceState (ISSUE 10): the mirror's device planes
+    row-shard over the mesh and the dirty-row scatter refresh must keep
+    the refreshed ``used`` buffer partitioned exactly like the one it
+    replaces (the jitted scatter pins ``out_shardings`` — a replicated
+    output would hand the next fused batch a layout the warmup never
+    compiled, plus an O(N) gather per drain batch)."""
+
+    def _mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < 8:
+            pytest.skip("need 8 virtual devices")
+        return Mesh(np.array(devices[:8]), ("nodes",))
+
+    def test_sharded_planes_and_scatter_refresh(self):
+        from nomad_tpu.tpu.mirror import DeviceState
+        from nomad_tpu.tpu.shard import AXIS
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(0)
+        n, n_pad = 1000, 1024
+        capacity = rng.integers(1000, 64000, (n, 4)).astype(np.int64)
+        usable = rng.random((n, 2)).astype(np.float32) * 1000 + 1
+        used = rng.integers(0, 900, (n, 4)).astype(np.int64)
+
+        plain = DeviceState(1, n_pad, capacity, usable, used)
+        ds = DeviceState(1, n_pad, capacity, usable, used, mesh=mesh)
+        spec = ds.used.sharding.spec
+        assert spec and spec[0] == AXIS, spec
+        assert ds.capacity.sharding.spec[0] == AXIS
+
+        # dirty-row refresh: same values as the unsharded state, and the
+        # new buffer keeps the row sharding
+        used_host = used.copy()
+        used_host[7] += 5
+        used_host[999] += 3
+        for d in (plain, ds):
+            d.pending.update({7, 999})
+            d.refresh(used_host)
+        got = np.asarray(ds.used)
+        want = np.asarray(plain.used)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(
+            got[:n], np.clip(used_host, 0, 2**30).astype(np.int32)
+        )
+        assert ds.used.sharding.spec[0] == AXIS, (
+            "scatter refresh dropped the row sharding"
+        )
+
+    def test_mirror_rebuilds_device_state_on_mesh_change(self):
+        from nomad_tpu.tpu.mirror import DeviceState
+
+        mesh = self._mesh()
+        n, n_pad = 64, 64
+        capacity = np.ones((n, 4), dtype=np.int64)
+        usable = np.ones((n, 2), dtype=np.float32)
+        used = np.zeros((n, 4), dtype=np.int64)
+        # the mirror's device_state cache keys by (n_pad, epoch, mesh):
+        # a cached unsharded state must never serve a sharded caller
+        ds_plain = DeviceState(1, n_pad, capacity, usable, used)
+        ds_mesh = DeviceState(1, n_pad, capacity, usable, used, mesh=mesh)
+        assert ds_plain.mesh is None and ds_mesh.mesh is mesh

@@ -4,9 +4,26 @@ partitioned with NamedSharding(P("nodes")) and every planner must produce
 EXACTLY the placements of its unsharded run (GSPMD inserts the cross-shard
 argmax/gather collectives; semantics may not drift).
 
-This is the multi-chip contract the driver's dryrun validates at compile
-level; these tests pin value-level equality so a sharding regression in any
-planner fails the suite (VERDICT r2 next-round #1)."""
+The cluster/problem builders live in nomad_tpu.tpu.multichip (the scored
+bench drives the same definitions, so bench and test clusters can never
+drift), and the sharding specs come from nomad_tpu.tpu.shard — the ONE
+placement source the runtime paths use.
+
+Beyond the per-planner equality pins, this file carries:
+
+- the seeded cross-shard property suite: uneven node counts whose real
+  rows end mid-shard, spread classes interleaved across every shard, and
+  multiple seeds — placements, spread counts and propertyset behavior
+  must be bit-identical sharded vs unsharded;
+- the forced-host fallback leg: with the device tier faulted, a sharded
+  scheduler eval must degrade to the SAME exact-np host placements the
+  unsharded one degrades to (sharding is a layout choice even when the
+  mesh is on fire);
+- MULTICHIP artifact hygiene: the noise filter that keeps XLA CPU-AOT
+  machine-feature spam out of the artifact tail, and the capped writer.
+"""
+
+import json
 
 import numpy as np
 import pytest
@@ -15,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nomad_tpu.tpu import multichip, shard
 from nomad_tpu.tpu.kernel import (
     BatchArgs,
     BatchState,
@@ -23,6 +41,13 @@ from nomad_tpu.tpu.kernel import (
     plan_batch,
     plan_batch_runs,
     plan_batch_windowed,
+)
+from nomad_tpu.tpu.multichip import (
+    build_cluster,
+    exact_problem,
+    pad_cluster,
+    runs_problem,
+    window_problem,
 )
 
 N_DEV = 8
@@ -36,96 +61,26 @@ def mesh():
     return Mesh(np.array(devices[:N_DEV]), ("nodes",))
 
 
-def build_cluster(n_nodes, n_allocs, n_values=4, seed=0):
-    """Heterogeneous capacities, ~10% infeasible nodes, spread classes."""
-    rng = np.random.default_rng(seed)
-    capacity = np.stack(
-        [
-            rng.choice([4000, 8000, 16000, 32000], n_nodes),
-            rng.choice([8192, 16384, 32768], n_nodes),
-            np.full(n_nodes, 100 * 1024),
-            np.full(n_nodes, 1000),
-        ],
-        axis=1,
-    ).astype(np.int32)
-    reserved = np.tile(np.array([100, 256, 4096, 0], dtype=np.int32), (n_nodes, 1))
-    usable = (capacity[:, :2] - reserved[:, :2]).astype(np.float32)
-    feasible = rng.random(n_nodes) > 0.1
-    node_value = (np.arange(n_nodes) % n_values).astype(np.int32)
-    perm = rng.permutation(n_nodes).astype(np.int32)
-    demand = np.array([100, 128, 10, 5], dtype=np.int32)
-    return dict(
-        capacity=capacity,
-        reserved=reserved,
-        usable=usable,
-        feasible=feasible,
-        node_value=node_value,
-        perm=perm,
-        demand=demand,
-        n_allocs=n_allocs,
-        n_values=n_values,
+def _put_exact(args, init, mesh):
+    aspec, sspec = shard.batch_specs()
+    return (
+        shard.put(BatchArgs(*[jnp.asarray(a) for a in args]), aspec, mesh),
+        shard.put(BatchState(*[jnp.asarray(s) for s in init]), sspec, mesh),
     )
 
 
-def exact_args(c, spread=True):
-    n_nodes = c["capacity"].shape[0]
-    n_allocs = c["n_allocs"]
-    V = c["n_values"]
-    args = BatchArgs(
-        capacity=c["capacity"],
-        usable=c["usable"],
-        feasible=c["feasible"][None, :],
-        affinity=np.zeros((1, n_nodes), dtype=np.float32),
-        affinity_present=np.zeros((1, n_nodes), dtype=bool),
-        group_count=np.full(1, n_allocs, dtype=np.int32),
-        group_eval=np.zeros(1, dtype=np.int32),
-        node_value=c["node_value"][None, :],
-        spread_desired=np.full(
-            (1, V), float(n_allocs) / V if spread else -1.0, dtype=np.float32
-        ),
-        spread_implicit=np.full(1, -1.0, dtype=np.float32),
-        spread_weight_frac=np.ones(1, dtype=np.float32),
-        spread_even=np.zeros(1, dtype=bool),
-        spread_active=np.full(1, spread, dtype=bool),
-        perm=c["perm"][None, :],
-        ring=np.array([n_nodes], dtype=np.int32),
-        demands=np.tile(c["demand"], (n_allocs, 1)),
-        groups=np.zeros(n_allocs, dtype=np.int32),
-        limits=np.full(n_allocs, n_nodes, dtype=np.int32),
-        valid=np.ones(n_allocs, dtype=bool),
+def _put_runs(args, init, mesh):
+    aspec, ispec = shard.run_specs()
+    return (
+        shard.put(RunArgs(*[jnp.asarray(a) for a in args]), aspec, mesh),
+        shard.put(tuple(jnp.asarray(x) for x in init), ispec, mesh),
     )
-    init = BatchState(
-        used=c["reserved"].copy(),
-        collisions=np.zeros((1, n_nodes), dtype=np.int32),
-        spread_counts=np.zeros((1, V), dtype=np.int32),
-        spread_present=np.zeros((1, V), dtype=bool),
-        offset=np.zeros(1, dtype=np.int32),
-    )
-    return args, init
-
-
-def exact_shardings(mesh):
-    rows = NamedSharding(mesh, P("nodes", None))
-    cols = NamedSharding(mesh, P(None, "nodes"))
-    rep = NamedSharding(mesh, P())
-    args = BatchArgs(
-        capacity=rows, usable=rows, feasible=cols, affinity=cols,
-        affinity_present=cols, group_count=rep, group_eval=rep,
-        node_value=cols, spread_desired=rep, spread_implicit=rep,
-        spread_weight_frac=rep, spread_even=rep, spread_active=rep,
-        perm=cols, ring=rep, demands=rep, groups=rep, limits=rep, valid=rep,
-    )
-    state = BatchState(
-        used=rows, collisions=cols, spread_counts=rep,
-        spread_present=rep, offset=rep,
-    )
-    return args, state
 
 
 def test_exact_scan_sharded_equals_unsharded(mesh):
     """Exact sequential-scan kernel at 1K nodes: node axis over 8 devices."""
     c = build_cluster(1024, 96)
-    args, init = exact_args(c)
+    args, init = exact_problem(c)
     n_real = 1024
 
     _, want = plan_batch(
@@ -135,9 +90,7 @@ def test_exact_scan_sharded_equals_unsharded(mesh):
     )
     want = np.asarray(want)
 
-    arg_sh, st_sh = exact_shardings(mesh)
-    d_args = jax.device_put(BatchArgs(*[jnp.asarray(a) for a in args]), arg_sh)
-    d_init = jax.device_put(BatchState(*[jnp.asarray(s) for s in init]), st_sh)
+    d_args, d_init = _put_exact(args, init, mesh)
     _, got = plan_batch(d_args, d_init, n_real)
     got = np.asarray(got)
 
@@ -145,45 +98,10 @@ def test_exact_scan_sharded_equals_unsharded(mesh):
     np.testing.assert_array_equal(want, got)
 
 
-def _run_args(c, affinity=True, spread=True):
-    n_nodes = c["capacity"].shape[0]
-    V = c["n_values"]
-    perm = c["perm"]
-    aff = np.where(
-        np.arange(n_nodes) % 5 == 0, 0.5, 0.0
-    ).astype(np.float32) if affinity else np.zeros(n_nodes, dtype=np.float32)
-    rargs = RunArgs(
-        capacity=c["capacity"][perm],
-        usable=c["usable"][perm],
-        feasible=c["feasible"][perm],
-        affinity=aff[perm],
-        affinity_present=(aff > 0)[perm],
-        group_count=np.int32(c["n_allocs"]),
-        node_value=c["node_value"][perm],
-        spread_desired=np.full(
-            V, float(c["n_allocs"]) / V if spread else -1.0, dtype=np.float32
-        ),
-        spread_implicit=np.float32(-1.0),
-        spread_weight_frac=np.float32(1.0),
-        spread_even=False,
-        spread_active=spread,
-        perm=perm,
-        demand=c["demand"],
-        n_allocs=np.int32(c["n_allocs"]),
-    )
-    init = (
-        c["reserved"][perm],
-        np.zeros(n_nodes, dtype=np.int32),
-        np.zeros(V, dtype=np.int32),
-        np.zeros(V, dtype=bool),
-    )
-    return rargs, init
-
-
 def test_runs_planner_sharded_equals_unsharded(mesh):
     """Run-based full-ring planner under NamedSharding(P('nodes'))."""
     c = build_cluster(1024, 512, seed=3)
-    rargs, init = _run_args(c)
+    rargs, init = runs_problem(c)
     a_pad = 512
 
     want = np.asarray(
@@ -195,23 +113,7 @@ def test_runs_planner_sharded_equals_unsharded(mesh):
         )
     )
 
-    node = NamedSharding(mesh, P("nodes"))
-    rows = NamedSharding(mesh, P("nodes", None))
-    rep = NamedSharding(mesh, P())
-    arg_sh = RunArgs(
-        capacity=rows, usable=rows, feasible=node, affinity=node,
-        affinity_present=node, group_count=rep, node_value=node,
-        spread_desired=rep, spread_implicit=rep, spread_weight_frac=rep,
-        spread_even=rep, spread_active=rep, perm=node, demand=rep,
-        n_allocs=rep,
-    )
-    d_args = jax.device_put(RunArgs(*[jnp.asarray(a) for a in rargs]), arg_sh)
-    d_init = (
-        jax.device_put(jnp.asarray(init[0]), rows),
-        jax.device_put(jnp.asarray(init[1]), node),
-        jax.device_put(jnp.asarray(init[2]), rep),
-        jax.device_put(jnp.asarray(init[3]), rep),
-    )
+    d_args, d_init = _put_runs(rargs, init, mesh)
     got = np.asarray(plan_batch_runs(d_args, d_init, a_pad, False))
 
     assert (want >= 0).sum() > 0
@@ -222,18 +124,7 @@ def test_windowed_planner_sharded_equals_unsharded(mesh):
     """Rotation-parallel windowed planner under NamedSharding(P('nodes'))."""
     c = build_cluster(1024, 512, seed=5)
     n_real, a_pad = 1024, 512
-    wargs = WindowArgs(
-        capacity=c["capacity"],
-        usable=c["usable"],
-        feasible=c["feasible"],
-        perm=c["perm"],
-        demand=c["demand"],
-        group_count=np.int32(c["n_allocs"]),
-        limit=np.int32(10),  # log2(1024)
-        n_allocs=np.int32(c["n_allocs"]),
-    )
-    used0 = c["reserved"].copy()
-    coll0 = np.zeros(n_real, dtype=np.int32)
+    wargs, used0, coll0 = window_problem(c, limit=10)  # log2(1024)
 
     want = np.asarray(
         plan_batch_windowed(
@@ -245,19 +136,13 @@ def test_windowed_planner_sharded_equals_unsharded(mesh):
         )
     )
 
-    node = NamedSharding(mesh, P("nodes"))
-    rows = NamedSharding(mesh, P("nodes", None))
-    rep = NamedSharding(mesh, P())
-    arg_sh = WindowArgs(
-        capacity=rows, usable=rows, feasible=node, perm=node,
-        demand=rep, group_count=rep, limit=rep, n_allocs=rep,
-    )
-    d_args = jax.device_put(WindowArgs(*[jnp.asarray(a) for a in wargs]), arg_sh)
+    aspec, (uspec, cspec) = shard.window_specs()
+    d_args = shard.put(WindowArgs(*[jnp.asarray(a) for a in wargs]), aspec, mesh)
     got = np.asarray(
         plan_batch_windowed(
             d_args,
-            jax.device_put(jnp.asarray(used0), rows),
-            jax.device_put(jnp.asarray(coll0), node),
+            shard.put(jnp.asarray(used0), uspec, mesh),
+            shard.put(jnp.asarray(coll0), cspec, mesh),
             n_real,
             a_pad,
         )
@@ -271,7 +156,7 @@ def test_exact_scan_sharded_multi_group(mesh):
     """Two groups with different demands sharing the usage plane, sharded."""
     n_nodes, n_allocs = 512, 64
     c = build_cluster(n_nodes, n_allocs, seed=9)
-    args, init = exact_args(c, spread=False)
+    args, init = exact_problem(c, spread=False)
     # second group: double demand, no spread
     args = args._replace(
         feasible=np.concatenate([args.feasible, args.feasible]),
@@ -307,10 +192,276 @@ def test_exact_scan_sharded_multi_group(mesh):
     )
     want = np.asarray(want)
 
-    arg_sh, st_sh = exact_shardings(mesh)
-    d_args = jax.device_put(BatchArgs(*[jnp.asarray(a) for a in args]), arg_sh)
-    d_init = jax.device_put(BatchState(*[jnp.asarray(s) for s in init]), st_sh)
+    d_args, d_init = _put_exact(args, init, mesh)
     _, got = plan_batch(d_args, d_init, n_nodes)
 
     assert (want >= 0).sum() == n_allocs
     np.testing.assert_array_equal(want, np.asarray(got))
+
+
+# ---------------------------------------------------------------------------
+# cross-shard property suite (ISSUE 10 satellite): uneven last shard,
+# spread/propertyset across every boundary, seeded
+# ---------------------------------------------------------------------------
+
+
+class TestCrossShardProperty:
+    #: real node count whose rows end MID-shard after bucketing: 2059
+    #: buckets to 3072 = 8×384, so shards 0–4 are fully real, shard 5 is
+    #: part-real part-padding, shards 6–7 are pure padding
+    N_UNEVEN = 2059
+
+    @pytest.mark.parametrize("seed", [11, 29, 47])
+    def test_runs_spread_counts_cross_boundaries(self, mesh, seed):
+        """The spread/propertyset reductions couple EVERY shard (classes
+        interleave `node % V`), the last shard is uneven, and the run
+        planner's fill/sweep mechanics must resolve identically."""
+        n_allocs = 384
+        c = pad_cluster(
+            build_cluster(self.N_UNEVEN, n_allocs, seed=seed),
+            shard.node_bucket(self.N_UNEVEN, mesh),
+        )
+        rargs, init = runs_problem(c)
+
+        want = np.asarray(
+            plan_batch_runs(
+                RunArgs(*[jnp.asarray(a) for a in rargs]),
+                tuple(jnp.asarray(x) for x in init),
+                n_allocs,
+                False,
+            )
+        )
+        d_args, d_init = _put_runs(rargs, init, mesh)
+        got = np.asarray(plan_batch_runs(d_args, d_init, n_allocs, False))
+
+        assert (want >= 0).sum() == n_allocs
+        np.testing.assert_array_equal(want, got)
+
+        # the placements must actually CROSS shards: with 4 spread
+        # classes interleaved over node ids, every one of the 5+ real
+        # shards receives placements (a single-shard solution would
+        # mean the boost reductions never left one device)
+        rows_per_shard = c["capacity"].shape[0] // N_DEV
+        placed_nodes = want[want >= 0]
+        touched = {int(n) // rows_per_shard for n in placed_nodes}
+        assert len(touched) >= 5, (
+            f"placements stayed on shards {touched}; the property needs "
+            "cross-boundary spread pressure"
+        )
+
+    @pytest.mark.parametrize("seed", [13, 31])
+    def test_exact_scan_uneven_last_shard(self, mesh, seed):
+        n_allocs = 96
+        c = pad_cluster(
+            build_cluster(self.N_UNEVEN, n_allocs, seed=seed),
+            shard.node_bucket(self.N_UNEVEN, mesh),
+        )
+        args, init = exact_problem(c)
+
+        _, want = plan_batch(
+            BatchArgs(*[jnp.asarray(a) for a in args]),
+            BatchState(*[jnp.asarray(s) for s in init]),
+            self.N_UNEVEN,
+        )
+        want = np.asarray(want)
+        d_args, d_init = _put_exact(args, init, mesh)
+        _, got = plan_batch(d_args, d_init, self.N_UNEVEN)
+
+        assert (want >= 0).sum() == n_allocs
+        np.testing.assert_array_equal(want, np.asarray(got))
+
+    def test_deterministic_flavor_bit_parity(self, mesh, monkeypatch):
+        """The deterministic compile flavor (NOMAD_TPU_DETERMINISTIC=1 →
+        kernel.DET_COMPILER_OPTIONS) is what the scored multichip bench
+        and bench.py's sharded parity pin dispatch through: with fusion
+        remat out of the picture, sharded placements are bit-identical
+        to unsharded BY CONSTRUCTION — this pins the machinery at a
+        boundary-crossing scale (the fused production pair is pinned by
+        the tests above; at much larger scales fused pairs may legally
+        disagree on sub-ulp score ties, which is exactly why this
+        flavor exists)."""
+        monkeypatch.setenv("NOMAD_TPU_DETERMINISTIC", "1")
+        n_allocs = 256
+        c = pad_cluster(
+            build_cluster(self.N_UNEVEN, n_allocs, seed=23),
+            shard.node_bucket(self.N_UNEVEN, mesh),
+        )
+        rargs, init = runs_problem(c)
+        want = np.asarray(
+            plan_batch_runs(
+                RunArgs(*[jnp.asarray(a) for a in rargs]),
+                tuple(jnp.asarray(x) for x in init),
+                n_allocs,
+                False,
+            )
+        )
+        d_args, d_init = _put_runs(rargs, init, mesh)
+        got = np.asarray(plan_batch_runs(d_args, d_init, n_allocs, False))
+        assert (want >= 0).sum() == n_allocs
+        np.testing.assert_array_equal(want, got)
+
+    def test_forced_host_fallback_matches_oracle(self, mesh, monkeypatch):
+        """The fallback leg: with the device tier faulted, a SHARDED
+        scheduler eval must degrade to exact-np and produce the same
+        placements the unsharded degraded eval produces — the mesh must
+        be invisible to the host path."""
+        from nomad_tpu import mock
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import compute_class
+        from nomad_tpu.structs.model import Evaluation, generate_uuid
+        from nomad_tpu.testing import faults
+        from nomad_tpu.tpu import batch_sched
+        from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+
+        # shard small clusters too (the mock cluster is 520 nodes)
+        monkeypatch.setattr(shard, "MIN_NODES", 256)
+
+        import random
+
+        def build_state():
+            state = StateStore()
+            rng = random.Random(5)
+            nodes = []
+            for i in range(520):
+                n = mock.node()
+                n.id = f"node-{i:04d}"
+                n.node_resources.cpu.cpu_shares = rng.choice([8000, 16000])
+                n.node_resources.memory.memory_mb = rng.choice([16384, 32768])
+                n.node_resources.networks = []
+                n.reserved_resources.networks.reserved_host_ports = ""
+                compute_class(n)
+                nodes.append(n)
+            state.upsert_nodes(1, nodes)
+            job = mock.job()
+            job.id = "job-fallback"  # deterministic alloc names across arms
+            tg = job.task_groups[0]
+            tg.count = 64
+            tg.tasks[0].resources.networks = []
+            state.upsert_job(2, job)
+            return state, job
+
+        class Planner:
+            def __init__(self):
+                self.plans = []
+
+            def submit_plan(self, plan):
+                from nomad_tpu.structs.model import PlanResult
+
+                self.plans.append(plan)
+                return PlanResult(
+                    node_update=plan.node_update,
+                    node_allocation=plan.node_allocation,
+                    node_preemptions=plan.node_preemptions,
+                    alloc_index=1,
+                ), None
+
+            def update_eval(self, ev):
+                pass
+
+            def create_eval(self, ev):
+                pass
+
+        def run(sharded: bool) -> dict:
+            plane = faults.install(faults.FaultPlane(seed=3))
+            plane.rule("point", "error", method="tpu.kernel", count=100)
+            try:
+                shard.configure(N_DEV, enabled=sharded)
+                state, job = build_state()
+                planner = Planner()
+                sched = TPUBatchScheduler(
+                    state.snapshot(), planner, rng=random.Random(17)
+                )
+                ev = Evaluation(
+                    id=generate_uuid(), namespace=job.namespace,
+                    priority=job.priority, type=job.type,
+                    triggered_by="job-register", job_id=job.id,
+                    status="pending",
+                )
+                sched.process(ev)
+                assert batch_sched.LAST_KERNEL_STATS.get("mode") in (
+                    "exact-np-degraded",
+                ), batch_sched.LAST_KERNEL_STATS.get("mode")
+                return {
+                    a.name: a.node_id
+                    for allocs in planner.plans[0].node_allocation.values()
+                    for a in allocs
+                }
+            finally:
+                faults.uninstall()
+                shard.configure(enabled=False)
+
+        placed_sharded = run(sharded=True)
+        placed_plain = run(sharded=False)
+        assert placed_sharded, "fallback placed nothing"
+        assert placed_sharded == placed_plain
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP artifact hygiene (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestArtifactHygiene:
+    NOISE = (
+        "E0731 00:12:00.683562 16739 cpu_aot_loader.cc:210] Loading "
+        "XLA:CPU AOT result. Target machine feature +prefer-no-gather "
+        "is not supported on the host machine."
+    )
+    SIGNAL = "RuntimeError: sharded placements diverge at 3/512 positions"
+
+    def test_noise_lines_filtered_signal_kept(self):
+        text = "\n".join([self.NOISE, self.SIGNAL, self.NOISE, "", "ok line"])
+        out = multichip.filter_noise_tail(text)
+        assert "cpu_aot_loader" not in out
+        assert "SIGILL" not in out
+        assert self.SIGNAL in out
+        assert "ok line" in out
+
+    def test_unknown_error_lines_never_dropped(self):
+        """The filter is specific by design: a novel XLA error must
+        survive it verbatim."""
+        novel = "F0801 12:00:00.1 pjrt_client.cc:99] device mesh lost"
+        out = multichip.filter_noise_tail(novel)
+        assert out == novel
+
+    def test_tail_capped_at_line_boundary(self):
+        text = "\n".join(f"line-{i:06d} " + "x" * 40 for i in range(200))
+        out = multichip.filter_noise_tail(text, cap=500)
+        assert len(out) <= 500
+        assert out.startswith("line-"), out[:20]  # no mid-line start
+        assert out.endswith("line-000199 " + "x" * 40)
+
+    def test_artifact_writer_filters_and_caps(self, tmp_path):
+        path = str(tmp_path / "MULTICHIP_r99.json")
+        report = {"n_devices": 8, "ok": True, "skipped": False}
+        tail_in = "\n".join([self.NOISE] * 50 + [self.SIGNAL])
+        out_path = multichip.write_artifact(report, tail=tail_in, path=path)
+        with open(out_path) as f:
+            data = json.load(f)
+        assert data["ok"] is True
+        assert "cpu_aot_loader" not in data["tail"]
+        assert self.SIGNAL in data["tail"]
+        assert len(data["tail"]) <= multichip.TAIL_CAP
+
+    def test_next_artifact_path_advances_round(self, tmp_path):
+        (tmp_path / "MULTICHIP_r05.json").write_text("{}")
+        (tmp_path / "MULTICHIP_r11.json").write_text("{}")
+        assert multichip.next_artifact_path(str(tmp_path)).endswith(
+            "MULTICHIP_r12.json"
+        )
+
+    def test_summary_line_carries_timings(self):
+        report = {
+            "n_devices": 8, "nodes": 1024, "allocs": 256, "ok": True,
+            "skipped": False,
+            "planners": {
+                "runs": {
+                    "sharded_s": 0.5, "speedup": 1.9, "parity": 1.0,
+                    "recompiles": 0,
+                },
+            },
+        }
+        line = multichip.summary_line(report)
+        assert line.startswith("MULTICHIP_SUMMARY ")
+        assert "runs=0.5s/x1.9/parity1.0/rc0" in line
+        assert "ok=1" in line

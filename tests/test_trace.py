@@ -329,7 +329,16 @@ class TestDrainDeviceTrace:
         storm yields connected traces spanning broker, worker, device
         dispatch/compute/materialize, plan verify, raft apply, and FSM —
         including across an injected sever/retry — and the critical-path
-        analyzer attributes stages from retained traces alone."""
+        analyzer attributes stages from retained traces alone.
+
+        The server comes up with ZERO workers and the drain opens
+        (start_workers) only after every eval is in the ready queue:
+        whether two evals are ever simultaneously ready is otherwise a
+        scheduling accident — on a loaded 1-core box the workers kept
+        winning the race one eval at a time, every dequeue_batch came
+        back singleton, and the single-eval path's small-eval oracle
+        gate meant NO eval ever rode the fused device path (the exact
+        flake this test shipped with)."""
         plane = faults.install(faults.FaultPlane(seed=11))
         # one injected worker failure mid-storm: nack → retry must stay
         # inside its eval's tree
@@ -337,7 +346,7 @@ class TestDrainDeviceTrace:
             "point", "error", method="worker.post_dequeue", count=1,
             after=2,
         )
-        server = make_server(num_workers=4, extra={
+        server = make_server(num_workers=0, extra={
             "batch_drain": 4,
             "default_scheduler": "tpu-batch",
             "plan_apply_batch": 4,
@@ -353,6 +362,12 @@ class TestDrainDeviceTrace:
                 server.job_register(simple_job(f"j-drain-{j}", count=8))
                 for j in range(8)
             ]
+            wait_until(
+                lambda: server.eval_broker.stats()["total_ready"]
+                >= len(eval_ids),
+                msg="all evals ready before the drain opens",
+            )
+            server.start_workers(4)
             wait_evals_terminal(server, eval_ids, timeout=120.0)
             time.sleep(0.5)
             records = [
