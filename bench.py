@@ -1280,6 +1280,21 @@ def bench_soak_smoke(seed=20260803):
     }
 
 
+def bench_fanout():
+    """Event plane at production fan-out (loadgen/fanout.py): ramp
+    FANOUT_SUBS (default 10K) concurrent /v1/event/stream watchers
+    against a live server, run the smoke storm, score delivery. The
+    headline numbers ride BENCH_SUMMARY as fanout_*; silent gaps are
+    pinned 0 (a drop without a marker is the one unforgivable failure).
+    The subscriber fleet runs as a subprocess — the per-process fd
+    ceiling can't hold both sides of 10K connections."""
+    from nomad_tpu.loadgen.fanout import run_fanout_from_env
+
+    report = run_fanout_from_env(seed=20260804)
+    report.pop("driver", None)  # the op-level detail isn't bench signal
+    return report
+
+
 def main():
     # the single-chip headline stays single-chip by construction, even
     # under NOMAD_TPU_SHARD=1 — the sharded section measures the mesh
@@ -1297,6 +1312,8 @@ def main():
         detail["trace_overhead"] = bench_trace_overhead()
         detail["drain"] = bench_drain()
         detail["soak_smoke"] = bench_soak_smoke()
+        if os.environ.get("BENCH_FANOUT", "1") != "0":
+            detail["fanout"] = bench_fanout()
         # worker-scaling curve over the same real-server drain path (the
         # 1-core bench box bounds speedup; the curve + queue depth shows
         # WHERE the control plane saturates)
@@ -1404,6 +1421,16 @@ def main():
         )
         parts.append(f"soak_rss_peak_mb={soak['rss_peak_mb']}")
         parts.append(f"soak_slo_score={soak['slo_score']}")
+        if "fanout" in detail:
+            fo = detail["fanout"]
+            parts.append(f"fanout_subs={fo['fanout_connected']}")
+            parts.append(f"fanout_pub_eps={fo['fanout_pub_eps']}")
+            parts.append(f"fanout_lag_p99_ms={fo['fanout_lag_p99_ms']}")
+            parts.append(f"fanout_gaps={fo['fanout_gaps']}")
+            parts.append(
+                f"fanout_silent_gaps={fo['fanout_silent_gaps']}"
+            )
+            parts.append(f"fanout_slo_score={fo['slo']['score']}")
         to = detail["trace_overhead"]
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
         pab = detail["profile_ab"]

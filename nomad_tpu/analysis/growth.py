@@ -348,3 +348,151 @@ def check_unbounded_cache(project: Project) -> list[Finding]:
                 findings.extend(_check_class(mod, node))
         findings.extend(_check_module_globals(mod))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# subscriber-eviction: the event plane's stronger contract
+# ---------------------------------------------------------------------------
+
+#: the broker plane: containers here hold PER-SUBSCRIBER state (queues,
+#: filters, pending frames, adopted sockets) whose cardinality is set by
+#: external watchers — traffic, not code
+_BROKER_PREFIX = "nomad_tpu/events/"
+
+#: method names that ARE eviction paths (the slow-consumer close family)
+_EVICT_NAME_RE = re.compile(
+    r"(close|evict|unsubscribe|drop|reap|teardown|shutdown|reset)", re.I
+)
+
+
+def _fn_calls_and_guards(fn: ast.AST, names: set) -> tuple[set, set]:
+    """(self-methods called, tracked containers len()-guarded inside a
+    comparison) within ``fn``. Only SELF-methods count toward eviction
+    reachability — ``sock.close()`` or ``f.close()`` must not launder a
+    grow site — and only a ``len(self.X)`` that feeds a comparison is a
+    cap check (``log(len(self.X))`` is observability, not a bound)."""
+    called: set[str] = set()
+    guarded: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+            ):
+                called.add(func.attr)
+        elif isinstance(node, ast.Compare):
+            for expr in [node.left, *node.comparators]:
+                if (
+                    isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Name)
+                    and expr.func.id == "len"
+                    and expr.args
+                ):
+                    attr = _attr_of_self(expr.args[0])
+                    if attr in names:
+                        guarded.add(attr)
+    return called, guarded
+
+
+@register(
+    "subscriber-eviction",
+    "broker-owned per-subscriber state grown at an append site with no "
+    "reachable eviction: every grow site in nomad_tpu/events/ must "
+    "shrink the container, be cap-guarded (len() comparison), or call "
+    "an eviction path (close/evict/unsubscribe/drop)",
+)
+def check_subscriber_eviction(project: Project) -> list[Finding]:
+    """The event plane holds per-subscriber state (queues, filters,
+    pending frames, adopted sockets) in broker-owned containers whose
+    cardinality external watchers control. ``unbounded-cache`` accepts a
+    shrink ANYWHERE in the class; at production fan-out that is not
+    enough — a grow site whose flow can't reach the slow-consumer close
+    is a queue that fills while the eviction path idles elsewhere. So
+    inside ``nomad_tpu/events/`` every grow site must itself (a) shrink
+    the container, (b) guard on ``len(container)`` (explicit cap — the
+    overflow return feeds the caller's close), or (c) call an
+    eviction-named path (close/evict/unsubscribe/drop/…), directly or
+    one self-method hop away. Deliberate exceptions carry
+    ``# nta: ignore[subscriber-eviction]`` with a WHY."""
+    findings: list[Finding] = []
+    for mod in project.modules:
+        if not mod.relpath.startswith(_BROKER_PREFIX):
+            continue
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            created: dict[str, int] = {}
+            for stmt in cls.body:
+                if not (
+                    isinstance(stmt, ast.FunctionDef)
+                    and stmt.name == "__init__"
+                ):
+                    continue
+                for node in ast.walk(stmt):
+                    tgt = val = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        tgt, val = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                        tgt, val = node.target, node.value
+                    if tgt is not None:
+                        name = _attr_of_self(tgt)
+                        if name is not None and _is_container_ctor(val):
+                            created[name] = node.lineno
+            if not created:
+                continue
+            names = set(created)
+            # per-method accesses: shrink locality is the whole point
+            methods = [
+                stmt
+                for stmt in ast.walk(cls)
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            shrinks_by_method: dict[str, set] = {}
+            for fn in methods:
+                acc: dict[str, list[_Access]] = {}
+                _scan_function(fn, names, True, acc)
+                shrinks_by_method[fn.name] = {
+                    n
+                    for n, a in acc.items()
+                    if any(x.kind == "shrink" for x in a)
+                }
+            for fn in methods:
+                if fn.name == "__init__":
+                    continue
+                acc: dict[str, list[_Access]] = {}
+                _scan_function(fn, names, True, acc)
+                grows = {
+                    n: [x for x in a if x.kind == "grow"]
+                    for n, a in acc.items()
+                }
+                called, guarded = _fn_calls_and_guards(fn, names)
+                for name, sites in grows.items():
+                    if not sites:
+                        continue
+                    ok = (
+                        name in shrinks_by_method.get(fn.name, ())
+                        or name in guarded
+                        or _EVICT_NAME_RE.search(fn.name) is not None
+                        or any(
+                            name in shrinks_by_method.get(m, ())
+                            or _EVICT_NAME_RE.search(m)
+                            for m in called
+                        )
+                    )
+                    if ok:
+                        continue
+                    for site in sites:
+                        findings.append(
+                            Finding(
+                                "subscriber-eviction",
+                                mod.relpath,
+                                site.line,
+                                f"{cls.name}.{name} grows in {fn.name} "
+                                "with no reachable eviction: shrink it "
+                                "here, cap it with a len() guard, or "
+                                "route through a close/evict path",
+                            )
+                        )
+    return findings

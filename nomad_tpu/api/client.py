@@ -407,16 +407,25 @@ class ApiClient:
         index: int = 0,
         namespace: Optional[str] = None,
         heartbeat: Optional[float] = None,
+        snapshot: Optional[bool] = None,
     ) -> "EventStream":
         """Subscribe to /v1/event/stream (ref api/event.go EventStream):
         returns an iterator of frame dicts. ``topics`` is a list of
         "Topic" / "Topic:key" specs (default: all topics); ``index=N``
         resumes after raft index N (pass the last index you received).
-        Heartbeat frames are filtered out; lost-gap and error frames are
-        yielded so callers see drops explicitly."""
+        ``snapshot`` forces snapshot-on-subscribe on/off (None defers to
+        the server's configured default): with it on, a cold subscribe —
+        or a resume that fell past the ring's retention — starts with
+        {"Snapshot": ...} state batches stamped at raft index N, then a
+        {"SnapshotDone": ...} marker, then deltas from N, instead of a
+        lost-gap bail. Heartbeat frames are filtered out; snapshot,
+        lost-gap and error frames are yielded so callers see the sync
+        contract explicitly."""
         params: list = [("topic", t) for t in (topics or [])]
         if index:
             params.append(("index", str(index)))
+        if snapshot is not None:
+            params.append(("snapshot", "true" if snapshot else "false"))
         # unlike every other endpoint the server-side default here is the
         # wildcard, so "default" must travel explicitly — omitting it
         # would silently widen the stream to every namespace
@@ -447,11 +456,17 @@ class ApiClient:
 
 class EventStream:
     """Iterator over /v1/event/stream frames: yields dicts shaped
-    {"Index": N, "Events": [...]}, {"LostGap": True, "Index": N}, or
-    {"Error": msg, "ResumeIndex": N}; heartbeat frames are skipped.
-    Tracks ``last_index`` so a severed consumer can reconnect with
+    {"Index": N, "Events": [...]}, {"Snapshot": True, "Index": N,
+    "Events": [...]}, {"SnapshotDone": True, "Index": N},
+    {"LostGap": True, "Index": N}, or {"Error": msg, "ResumeIndex": N};
+    heartbeat frames are skipped. Tracks ``last_index`` so a severed
+    consumer can reconnect with
     ``client.event_stream(index=stream.last_index)`` for exactly-once
-    resumption."""
+    resumption. Lost-gap and snapshot frames ADVANCE ``last_index`` to
+    their carried index: the gap marker's floor is the only index a
+    reconnect can make progress from — resuming from the stale local
+    index would replay the same gap forever — and a snapshot covers
+    state through its stamp by construction."""
 
     def __init__(self, resp):
         self._resp = resp
@@ -482,7 +497,15 @@ class EventStream:
                 continue
             if not frame:
                 continue  # heartbeat
-            if frame.get("Index") and frame.get("Events"):
+            if frame.get("Index") and (
+                (frame.get("Events") and not frame.get("Snapshot"))
+                or frame.get("LostGap")
+                or frame.get("SnapshotDone")
+            ):
+                # snapshot BATCHES don't advance the resume point — only
+                # the SnapshotDone marker does: a consumer severed
+                # mid-snapshot must re-sync, not resume past state it
+                # never received
                 self.last_index = max(self.last_index, int(frame["Index"]))
             return frame
 

@@ -106,6 +106,19 @@ class HTTPServer:
         self.port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        #: shared fan-out pump for chunked event streams (events/mux.py);
+        #: created on the first stream, stopped with the server. The lock
+        #: guards the lazy init: two first-ever streams racing (exactly
+        #: the fan-out ramp pattern) must not each build a mux — the
+        #: loser's pump thread and adopted sockets would escape stop()
+        self._stream_mux = None
+        self._stream_mux_lock = threading.Lock()
+        #: sockets handed to the stream mux: the per-request teardown
+        #: (shutdown_request) must leave them alone — the mux owns their
+        #: lifecycle now. Weak so a mux-closed socket drops out by itself.
+        import weakref
+
+        self._detached_socks = weakref.WeakSet()
 
     def start(self):
         from ..util import LogBuffer
@@ -395,7 +408,22 @@ class HTTPServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class _Httpd(ThreadingHTTPServer):
+            # production fan-out ramps thousands of stream dials in
+            # bursts; the default listen backlog of 5 sheds them
+            request_queue_size = 512
+
+            def shutdown_request(self, request):
+                # an event-stream socket adopted by the mux outlives its
+                # request: the handler thread returns but the connection
+                # keeps streaming. One-shot — after the skip the mux is
+                # the only owner.
+                if request in api._detached_socks:
+                    api._detached_socks.discard(request)
+                    return
+                super().shutdown_request(request)
+
+        self._httpd = _Httpd((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="http-serve"
@@ -406,6 +434,8 @@ class HTTPServer:
             self.server.advertise_http(self.address)
 
     def stop(self):
+        if self._stream_mux is not None:
+            self._stream_mux.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -1765,15 +1795,27 @@ class HTTPServer:
     # the same frames over a websocket upgrade. Frames:
     #   {"Index": N, "Events": [...]}    — one raft apply's events
     #   {}                               — heartbeat (idle keep-alive)
+    #   {"Snapshot": true, "Index": N, "Events": [...]}
+    #                                    — snapshot-on-subscribe batch:
+    #                                      state objects at raft index N
+    #   {"SnapshotDone": true, "Index": N}
+    #                                    — snapshot complete; deltas with
+    #                                      index > N follow
     #   {"LostGap": true, "Index": N}    — ring overwrote events ≤ N
+    #                                      (only when snapshots are off)
     #   {"Error": msg, "ResumeIndex": N} — closed (slow consumer /
     #                                      restore / shutdown); reconnect
     #                                      with index=N
+    # Every frame's JSON is encoded exactly once in the broker and shared
+    # across subscribers; this layer only moves bytes. Chunked streams are
+    # served by the shared StreamMux pump (events/mux.py) — the handler
+    # thread detaches the socket and returns; websockets keep a thread
+    # (they need a reader for pings) but ride the same wire path.
     # --------------------------------------------------------------------
     EVENT_STREAM_HEARTBEAT = 10.0
 
     def _serve_event_stream(self, handler, parsed, query):
-        from ..events import ALL_TOPICS, required_capability
+        from ..events import ALL_TOPICS, BrokerLimitError, required_capability
 
         broker = getattr(self.server, "event_broker", None)
         if broker is None:
@@ -1847,64 +1889,66 @@ class HTTPServer:
                             403, {"error": "Permission denied"}, None
                         )
                         return
-        sub = broker.subscribe(
-            topics,
-            from_index=from_index,
-            acl=acl_obj,
-            namespace=namespace,
-        )
+        # snapshot-on-subscribe: explicit ?snapshot= wins; otherwise the
+        # broker's configured default (event_broker{snapshot_on_subscribe},
+        # on unless disabled). The broker only actually snapshots when it
+        # helps — a cold subscribe or a resume past the ring's retention;
+        # an in-retention resume stays a plain replay either way.
+        snap_q = (query.get("snapshot") or "").strip().lower()
+        if snap_q:
+            want_snapshot = snap_q in ("1", "true", "yes")
+        else:
+            want_snapshot = broker.snapshot_on_subscribe
         try:
-            if "websocket" in handler.headers.get("Upgrade", "").lower():
-                self._event_stream_ws(handler, sub, heartbeat)
-            else:
-                self._event_stream_chunked(handler, sub, heartbeat)
-        finally:
-            sub.close()
-
-    @staticmethod
-    def _event_frames(sub, heartbeat):
-        """Shared frame loop: yields JSON-able frame dicts until the
-        subscription closes (the final Error frame is yielded too)."""
-        from ..events import SubscriptionClosedError
-
-        while True:
+            sub = broker.subscribe(
+                topics,
+                from_index=from_index,
+                acl=acl_obj,
+                namespace=namespace,
+                snapshot=want_snapshot,
+            )
+        except BrokerLimitError as e:
+            handler._respond(503, {"error": str(e)}, None)
+            return
+        if "websocket" in handler.headers.get("Upgrade", "").lower():
             try:
-                frame = sub.next(timeout=heartbeat)
-            except SubscriptionClosedError as e:
-                yield {"Error": e.reason, "ResumeIndex": e.resume_index}
-                return
-            if frame is None:
-                yield {}  # heartbeat: keeps the connection visibly live
-                continue
-            index, events = frame
-            if events is None:
-                yield {"LostGap": True, "Index": index}
-            else:
-                yield {
-                    "Index": index,
-                    "Events": [e.to_dict() for e in events],
-                }
-
-    def _event_stream_chunked(self, handler, sub, heartbeat):
-        wfile = handler.wfile
-        handler.send_response(200)
-        handler.send_header("Content-Type", "application/json")
-        handler.send_header("Transfer-Encoding", "chunked")
-        handler.send_header(
-            "X-Nomad-Index", str(self.server.state.latest_index())
-        )
-        handler.end_headers()
+                self._event_stream_ws(handler, sub, heartbeat)
+            finally:
+                sub.close()
+            return
+        # chunked tier: write the headers here, then hand the socket to
+        # the shared mux and return — ownership (socket AND subscription)
+        # transfers; the per-request teardown skips the detached socket
         try:
-            for doc in self._event_frames(sub, heartbeat):
-                data = json.dumps(doc).encode() + b"\n"
-                wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                wfile.flush()
-                if "Error" in doc:
-                    break
-            wfile.write(b"0\r\n\r\n")
+            wfile = handler.wfile
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.send_header(
+                "X-Nomad-Index", str(self.server.state.latest_index())
+            )
+            handler.end_headers()
             wfile.flush()
-        except OSError:
-            pass  # client went away; the subscription closes in the caller
+            self._detached_socks.add(handler.connection)
+            self._event_mux().serve(handler.connection, sub, heartbeat)
+        except Exception:
+            self._detached_socks.discard(handler.connection)
+            sub.close()
+            raise
+
+    def _event_mux(self):
+        """The shared chunked-stream pump, created on first use with the
+        broker's frame_batch knob."""
+        with self._stream_mux_lock:
+            mux = self._stream_mux
+            if mux is None:
+                from ..events.mux import StreamMux
+
+                broker = getattr(self.server, "event_broker", None)
+                mux = self._stream_mux = StreamMux(
+                    frame_batch=getattr(broker, "frame_batch", 64)
+                )
+        return mux
 
     def _event_stream_ws(self, handler, sub, heartbeat):
         import threading as threading_mod
@@ -1929,9 +1973,16 @@ class HTTPServer:
             target=reader, daemon=True, name="event-stream-ws-reader"
         ).start()
         try:
-            for doc in self._event_frames(sub, heartbeat):
-                ws_mod.send_message(sock, json.dumps(doc))
-                if "Error" in doc:
+            while True:
+                # encode-once wire lines straight from the broker; one ws
+                # message per NDJSON line, batched per wake
+                lines, done = sub.next_wires(timeout=heartbeat)
+                if not lines and not done:
+                    ws_mod.send_message(sock, b"{}")  # heartbeat
+                    continue
+                for line in lines:
+                    ws_mod.send_message(sock, line)
+                if done:
                     break
         except OSError:
             pass

@@ -20,7 +20,14 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     "gossip": {},
     # telemetry-style stanza for the cluster event stream (events/):
     # event_broker { enabled = true  event_buffer_size = 4096
-    #                subscriber_buffer = 1024 }
+    #                subscriber_buffer = 1024
+    #                snapshot_on_subscribe = true  # cold subscribers get
+    #                    # a state snapshot stamped at raft index N, then
+    #                    # deltas from N (and lost-gap resumes become
+    #                    # snapshot+deltas instead of a gap bail)
+    #                max_subscribers = 0   # admission cap, 0 = unlimited
+    #                frame_batch = 64 }    # frames batched per socket
+    #                                      # write on the stream mux
     "event_broker": {},
     # operator debug plane (nomad_tpu/debug; OBSERVABILITY.md):
     # debug { flight_recorder = true   # false: no sampling thread

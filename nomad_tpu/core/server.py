@@ -108,6 +108,17 @@ class Server:
             self.event_broker = EventBroker(
                 size=int(eb_cfg.get("event_buffer_size", 4096)),
                 subscriber_buffer=int(eb_cfg.get("subscriber_buffer", 1024)),
+                # snapshot-on-subscribe reads the store's COW generations
+                # (state/store.py snapshot_events): cold watchers start
+                # from a consistent snapshot at index N instead of full
+                # blocking queries, and lost-gap resumes become
+                # snapshot+deltas
+                state=self.state,
+                snapshot_on_subscribe=bool(
+                    eb_cfg.get("snapshot_on_subscribe", True)
+                ),
+                max_subscribers=int(eb_cfg.get("max_subscribers", 0)),
+                frame_batch=int(eb_cfg.get("frame_batch", 64)),
             )
         self.fsm = FSM(
             state=self.state,

@@ -174,6 +174,15 @@ def capture_bundle(
         watchdog = getattr(server, "watchdog", None)
         if watchdog is not None:
             out["watchdog"] = watchdog.stats()
+        broker = getattr(server, "event_broker", None)
+        if broker is not None:
+            # fan-out overload diagnosis without a live shell: who is
+            # behind (per-subscriber lag top-N with queue depth and
+            # topics) and what the ring looked like when the rule tripped
+            out["event_broker"] = {
+                "stats": broker.stats(),
+                "subscriber_lag": broker.lag_stats(top=10),
+            }
         try:
             from ..trace import attribute, tracer
 

@@ -20,7 +20,12 @@ Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
   window (the ``_bad_http_addrs`` leak class, caught while leaking);
 - ``lock_contention`` — lock-wait seconds accumulating faster than
   ``threshold`` per wall second across the window (lockdep installs
-  only; a convoy collapse, not a single slow acquire).
+  only; a convoy collapse, not a single slow acquire);
+- ``subscriber_lag`` — max event-stream subscriber lag (broker head
+  index minus the subscriber's last drained index) above threshold for
+  N consecutive samples while subscribers exist: fan-out overload
+  becomes a debug bundle — whose findings carry the per-subscriber lag
+  top-N and broker ring stats — not a pager.
 
 Trips are always recorded + counted (``debug.watchdog_trips``); the
 bundle write additionally needs a configured ``bundle_dir`` so a
@@ -49,6 +54,7 @@ DEFAULT_RULES = {
     },
     "lock_contention": {"threshold_frac": 0.5, "window": 30,
                         "min_span_s": 5.0},
+    "subscriber_lag": {"threshold": 10_000, "consecutive": 5},
 }
 
 MAX_TRIP_LOG = 64
@@ -150,6 +156,26 @@ class Watchdog:
             return {
                 "broker_ready": sample.get("broker_ready"),
                 "flat_for_samples": len(tail),
+            }
+        return None
+
+    def _rule_subscriber_lag(self, sample, window, p):
+        tail = window[-int(p["consecutive"]):]
+        if len(tail) < int(p["consecutive"]):
+            return None
+        # the lag tap reads live subscribers only, so a breach can't
+        # outlive its cause: a drained (or closed) consumer resets the
+        # streak by construction — no idle-decay gate needed
+        if all(
+            s.get("subscribers", 0) > 0
+            and s.get("subscriber_lag_max", 0) > p["threshold"]
+            for s in tail
+        ):
+            return {
+                "lag_max": sample.get("subscriber_lag_max"),
+                "lag_p99": sample.get("subscriber_lag_p99"),
+                "threshold": p["threshold"],
+                "subscribers": sample.get("subscribers"),
             }
         return None
 

@@ -20,6 +20,24 @@ each subscriber drains its own bounded queue:
   own older index observes the gap explicitly (ref event_broker.go's
   ErrSubscriberClosed path).
 
+Production fan-out (ROADMAP item 3) shaped the delivery core:
+
+- **encode-once frames** — each published ``(index, events)`` batch
+  becomes one immutable :class:`Frame` whose per-event JSON, full-frame
+  wire line, and per-filter-signature visibility decision are each
+  computed once and shared by every matching subscriber. Per-subscriber
+  publish work is a dict probe + a deque append; no subscriber ever
+  re-serializes an event (``encode_event`` is THE serializer and tests
+  pin its call count against the publish count).
+- **snapshot-on-subscribe** — a cold subscriber (``from_index=0``) or a
+  reconnecting one whose resume index fell past the ring's retention can
+  start from a compact, topic-filtered, ACL-filtered state snapshot
+  stamped at raft index N (the store's COW generation — an O(1) pointer
+  read under the broker lock, extraction afterwards against the
+  immutable generation) and then ride deltas from N. Cold watchers never
+  fall back to full blocking queries; a lost-gap bail becomes
+  snapshot+deltas.
+
 The ring's contents are deliberately NOT snapshotted: after a restore
 the broker resets to the restored state index and live subscribers are
 closed with that index (re-derivable state, same as the reference's
@@ -28,6 +46,7 @@ in-memory event buffer).
 
 from __future__ import annotations
 
+import json
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -55,6 +74,18 @@ ALL_TOPICS = (
 #: topics whose events are cluster-scoped (no namespace): gated by the
 #: node:read coarse capability rather than a namespace capability
 NODE_TOPICS = (TOPIC_NODE, TOPIC_NODE_EVENT)
+
+#: topics with standing state objects a snapshot can carry; NodeEvent
+#: and PlanResult are ephemeral — their only history is the ring
+SNAPSHOT_TOPICS = (
+    TOPIC_JOB,
+    TOPIC_EVAL,
+    TOPIC_ALLOC,
+    TOPIC_DEPLOYMENT,
+    TOPIC_NODE,
+)
+
+EPHEMERAL_TOPICS = (TOPIC_NODE_EVENT, TOPIC_PLAN_RESULT)
 
 
 def required_capability(topic: str) -> str:
@@ -105,6 +136,107 @@ class Event:
         }
 
 
+def encode_event(event: Event) -> bytes:
+    """THE event serializer. Every byte of event JSON that reaches any
+    subscriber — chunked HTTP, websocket, snapshot frames — is produced
+    here and cached on the event, so each published event is encoded
+    exactly once no matter how many subscribers match it (tests pin that
+    by swapping in a counting wrapper for this module attribute)."""
+    return json.dumps(event.to_dict(), separators=(",", ":")).encode()
+
+
+def event_wire(event: Event) -> bytes:
+    """The event's cached wire encoding (encode-once: the first caller
+    pays ``encode_event``; everyone after shares the bytes)."""
+    wire = event.__dict__.get("_wire")
+    if wire is None:
+        wire = encode_event(event)
+        event._wire = wire
+    return wire
+
+
+class Frame:
+    """One published ``(raft index, events)`` batch plus its encodings.
+
+    Immutable after construction and shared by the ring and by every
+    matching subscriber's queue. Three things are computed once and then
+    shared across the whole fan-out:
+
+    - the per-event JSON (``event_wire``),
+    - the full-frame NDJSON wire line (``wire``),
+    - the per-filter-signature visibility decision (``visible_for`` —
+      subscribers with the same topics/namespace/ACL identity share one
+      match computation per frame).
+    """
+
+    __slots__ = ("index", "events", "_wire", "_visible")
+
+    def __init__(self, index: int, events: Iterable[Event]):
+        self.index = index
+        self.events = tuple(events)
+        self._wire: Optional[bytes] = None
+        #: filter signature -> tuple of visible event positions.
+        # nta: ignore[unbounded-cache] WHY: keyed by live-subscriber
+        # filter signatures (shared across the fleet) and the whole
+        # frame dies with the bounded ring's eviction — a per-frame
+        # memo, not a long-lived cache.
+        self._visible: dict = {}
+
+    def wire(self) -> bytes:
+        """The full-frame NDJSON line, built once then shared."""
+        wire = self._wire
+        if wire is None:
+            wire = b"".join(
+                (
+                    b'{"Index":%d,"Events":[' % self.index,
+                    b",".join(event_wire(e) for e in self.events),
+                    b"]}\n",
+                )
+            )
+            self._wire = wire
+        return wire
+
+    def wire_for(self, pos: tuple) -> bytes:
+        """Wire line for a partially-visible subscriber: reuses the
+        per-event encodings; the full-visibility fast path shares the
+        one full-frame line."""
+        if len(pos) == len(self.events):
+            return self.wire()
+        return b"".join(
+            (
+                b'{"Index":%d,"Events":[' % self.index,
+                b",".join(event_wire(self.events[i]) for i in pos),
+                b"]}\n",
+            )
+        )
+
+    def visible_for(
+        self, sub: "Subscription", ephemeral_only: bool = False
+    ) -> tuple:
+        """Positions of the events this subscriber may see — memoized per
+        filter signature, so 10K identical watchers pay one match pass.
+        ``ephemeral_only`` restricts to EPHEMERAL_TOPICS events (the
+        snapshot dedupe floor must not swallow what no snapshot can
+        carry). Benign if two publishers race: both compute identical
+        tuples."""
+        key = (sub._sig, ephemeral_only)
+        pos = self._visible.get(key)
+        if pos is None:
+            pos = tuple(
+                i
+                for i, e in enumerate(self.events)
+                if (
+                    not ephemeral_only or e.topic in EPHEMERAL_TOPICS
+                )
+                and sub.matches(e)
+            )
+            # nta: ignore[subscriber-eviction] WHY: per-frame memo — the
+            # ring's eviction IS the eviction path; entries never outlive
+            # the frame (see _visible's WHY above).
+            self._visible[key] = pos
+        return pos
+
+
 class SubscriptionClosedError(Exception):
     """Raised from Subscription.next once the broker has closed the
     subscription. ``resume_index`` is the highest index already evicted
@@ -119,11 +251,28 @@ class SubscriptionClosedError(Exception):
         self.resume_index = resume_index
 
 
+class BrokerLimitError(Exception):
+    """subscribe() refused: the broker is at ``max_subscribers``."""
+
+
+#: queue entry kinds (entries are (kind, a, b) triples)
+_EV = "ev"  # (frame, visible positions)
+_GAP = "gap"  # (through_index, None)
+_SNAP = "snap"  # (stamp index, tuple of snapshot Events)
+_SNAP_END = "snapend"  # (stamp index, None)
+
+#: snapshot Events per _SNAP queue entry / wire line (one multi-MB frame
+#: would stall the socket batcher; ~256 keeps lines around chunk size)
+SNAPSHOT_BATCH = 256
+
+
 class Subscription:
     """One consumer's bounded queue over the broker's fan-out (ref
-    stream/subscription.go). Frames are ``(index, [Event, ...])``; a
-    lost-gap frame is ``(index, None)`` meaning events up to ``index``
-    were overwritten before this subscriber could read them."""
+    stream/subscription.go). The queue holds shared :class:`Frame`
+    references (plus gap / snapshot markers), never per-subscriber event
+    copies. Consumers drain through ``next`` (typed frames, the in-proc
+    consumers), ``next_wires`` (blocking wire lines, the websocket tier)
+    or ``take_wire`` (non-blocking batched wire, the stream mux)."""
 
     def __init__(
         self,
@@ -138,11 +287,35 @@ class Subscription:
         self.acl = acl
         self.namespace = namespace
         self.max_queued = max_queued
+        #: filter signature: subscribers sharing (topics, namespace, ACL
+        #: identity) share one per-frame visibility computation. The ACL
+        #: OBJECT rides the tuple (identity hash), not id(acl): a memo
+        #: key must keep the token alive — a recycled address after the
+        #: token's GC would serve the dead token's visibility decisions
+        #: to whoever allocates there next (cross-tenant leak).
+        self._sig = (
+            tuple(
+                sorted((t, tuple(sorted(k))) for t, k in topics.items())
+            ),
+            namespace,
+            acl,
+        )
+        #: frames at or below this index are covered by the snapshot this
+        #: subscription started from (the dedupe floor: a publish racing
+        #: the subscribe must not deliver what the snapshot already has)
+        self.min_index = 0
+        #: highest index this consumer has fully drained (the broker's
+        #: per-subscriber lag tap: lag = broker head - delivered_index)
+        self.delivered_index = 0
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
         self._close_reason = ""
         self._resume_index = 0
+        #: mux wake hook (events/mux.py): called after an append when a
+        #: shared pump serves this subscription instead of a parked
+        #: thread; must be cheap and must not raise
+        self._on_ready = None
 
     # -- filtering ------------------------------------------------------
     def _topic_keys(self, topic: str) -> Optional[set[str]]:
@@ -168,27 +341,82 @@ class Subscription:
             return False
         return event_visible(self.acl, event)
 
-    # -- delivery (broker side, under the broker lock) ------------------
-    def _offer(self, index: int, events: list[Event]) -> bool:
-        """Enqueue one frame; False means this subscriber is too slow and
-        must be closed (no-slow-consumer backpressure)."""
-        wanted = [e for e in events if self.matches(e)]
-        if not wanted:
+    # -- delivery (broker side) ----------------------------------------
+    def _offer(self, frame: Frame) -> bool:
+        """Enqueue one shared frame; False means this subscriber is too
+        slow and must be closed (no-slow-consumer backpressure). Frames
+        at or below the snapshot floor deliver only their EPHEMERAL
+        events: the state topics are already covered by the snapshot,
+        but NodeEvent/PlanResult history exists nowhere else — dropping
+        the whole frame would be exactly the silent gap the plane
+        forbids."""
+        if frame.index <= self.min_index:
+            pos = frame.visible_for(self, ephemeral_only=True)
+        else:
+            pos = frame.visible_for(self)
+        if not pos:
             return True
         with self._cond:
             if self._closed:
                 return True
             if len(self._queue) >= self.max_queued:
                 return False
-            self._queue.append((index, wanted))
+            self._queue.append((_EV, frame, pos))
             self._cond.notify_all()
+        on_ready = self._on_ready
+        if on_ready is not None:
+            on_ready()
         return True
 
     def _offer_gap(self, through_index: int):
         with self._cond:
-            if not self._closed:
-                self._queue.append((through_index, None))
-                self._cond.notify_all()
+            if self._closed:
+                return
+            # a gap marker is never dropped for queue pressure: dropping
+            # it is exactly the silent gap the marker exists to prevent
+            # (one marker per subscribe/trim event, not per publish)
+            # nta: ignore[subscriber-eviction] WHY: un-capped on purpose —
+            # see the comment above; the queue itself is drained by
+            # next/take_wire and bounded by _offer's cap.
+            self._queue.append((_GAP, through_index, None))
+            self._cond.notify_all()
+        on_ready = self._on_ready
+        if on_ready is not None:
+            on_ready()
+
+    def _prepend_snapshot(self, index: int, events: list):
+        """Install snapshot entries at the FRONT of the queue: live
+        frames may already have queued behind the subscribe (they carry
+        index > ``min_index`` by construction), and the consumer must see
+        snapshot, then deltas. Exempt from ``max_queued`` — the snapshot
+        is the price of admission, bounded by store size, and delivered
+        first."""
+        entries: list = [
+            (_SNAP, index, tuple(events[start:start + SNAPSHOT_BATCH]))
+            for start in range(0, len(events), SNAPSHOT_BATCH)
+        ]
+        entries.append((_SNAP_END, index, None))
+        with self._cond:
+            if self._closed:
+                return
+            # a snapshot bigger than the configured buffer must not eat
+            # the whole live-delta budget: widen this subscription's cap
+            # to snapshot + the configured headroom, or the first live
+            # publish during the snapshot drain would slow-close it and
+            # a reconnect would just re-snapshot — a livelock on any
+            # store larger than one queue
+            self.max_queued += len(entries)
+            # appendleft reverses, so walk the delivery order backwards:
+            # the consumer sees batch 0..N in extraction order, marker last
+            for entry in reversed(entries):
+                # nta: ignore[subscriber-eviction] WHY: one snapshot per
+                # subscribe, delivered first and bounded by store size;
+                # steady-state growth is _offer's capped path.
+                self._queue.appendleft(entry)
+            self._cond.notify_all()
+        on_ready = self._on_ready
+        if on_ready is not None:
+            on_ready()
 
     def _close(self, reason: str, resume_index: int):
         with self._cond:
@@ -198,24 +426,111 @@ class Subscription:
             self._close_reason = reason
             self._resume_index = resume_index
             self._cond.notify_all()
+        on_ready = self._on_ready
+        if on_ready is not None:
+            on_ready()  # the mux must flush the final Error frame
 
     # -- consumer side --------------------------------------------------
     def next(self, timeout: Optional[float] = None):
         """Next frame ``(index, [Event, ...])`` (or ``(index, None)`` for
         a lost gap), ``None`` on timeout, SubscriptionClosedError once the
-        broker closed this subscription and its queue is drained."""
-        with self._cond:
-            self._cond.wait_for(
-                lambda: self._queue or self._closed, timeout
-            )
-            if self._queue:
-                return self._queue.popleft()
-            if self._closed:
-                raise SubscriptionClosedError(
-                    self._close_reason or "subscription closed",
-                    self._resume_index,
+        broker closed this subscription and its queue is drained.
+        Snapshot batches surface as ordinary ``(index, [Event, ...])``
+        frames stamped at the snapshot index."""
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._queue or self._closed, timeout
                 )
-            return None
+                if self._queue:
+                    kind, a, b = self._queue.popleft()
+                elif self._closed:
+                    raise SubscriptionClosedError(
+                        self._close_reason or "subscription closed",
+                        self._resume_index,
+                    )
+                else:
+                    return None
+            if kind == _EV:
+                if a.index > self.delivered_index:
+                    self.delivered_index = a.index
+                return (a.index, [a.events[i] for i in b])
+            if kind == _GAP:
+                if a > self.delivered_index:
+                    self.delivered_index = a
+                return (a, None)
+            if kind == _SNAP:
+                return (a, list(b))
+            # _SNAP_END: zero-width marker for the wire tiers; in-proc
+            # consumers skip it (don't re-wait the full timeout)
+            if a > self.delivered_index:
+                self.delivered_index = a
+            timeout = 0
+
+    def _entry_wire(self, entry) -> bytes:
+        kind, a, b = entry
+        if kind == _EV:
+            if a.index > self.delivered_index:
+                self.delivered_index = a.index
+            return a.wire_for(b)
+        if kind == _GAP:
+            if a > self.delivered_index:
+                self.delivered_index = a
+            return b'{"LostGap":true,"Index":%d}\n' % a
+        if kind == _SNAP:
+            return b"".join(
+                (
+                    b'{"Snapshot":true,"Index":%d,"Events":[' % a,
+                    b",".join(event_wire(e) for e in b),
+                    b"]}\n",
+                )
+            )
+        if a > self.delivered_index:
+            self.delivered_index = a
+        return b'{"SnapshotDone":true,"Index":%d}\n' % a
+
+    def _error_wire(self) -> bytes:
+        return b'{"Error":%s,"ResumeIndex":%d}\n' % (
+            json.dumps(self._close_reason or "subscription closed").encode(),
+            self._resume_index,
+        )
+
+    def take_wire(self, max_entries: int = 64) -> tuple[bytes, bool]:
+        """Non-blocking batched wire drain (the stream mux path): up to
+        ``max_entries`` queued entries as one NDJSON payload. Returns
+        ``(payload, done)``; ``done=True`` means the subscription is
+        closed AND fully drained — the payload then already carries the
+        final Error frame."""
+        with self._cond:
+            n = min(len(self._queue), max_entries)
+            entries = [self._queue.popleft() for _ in range(n)]
+            done = self._closed and not self._queue
+        chunks = [self._entry_wire(e) for e in entries]
+        if done:
+            chunks.append(self._error_wire())
+        return b"".join(chunks), done
+
+    def next_wires(
+        self, timeout: Optional[float] = None, max_entries: int = 64
+    ) -> tuple[list, bool]:
+        """Blocking wire drain (the websocket tier / inline chunked
+        fallback): waits up to ``timeout`` for the first entry, then
+        drains up to ``max_entries``. Returns ``(lines, done)``;
+        ``([], False)`` on timeout means a heartbeat is due, ``done=True``
+        means closed-and-drained with the Error frame as the last line."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._queue or self._closed, timeout)
+            n = min(len(self._queue), max_entries)
+            entries = [self._queue.popleft() for _ in range(n)]
+            done = self._closed and not self._queue
+        lines = [self._entry_wire(e) for e in entries]
+        if done:
+            lines.append(self._error_wire())
+        return lines, done
+
+    def queued(self) -> int:
+        with self._cond:
+            return len(self._queue)
 
     def close(self):
         """Consumer-initiated unsubscribe."""
@@ -232,13 +547,30 @@ class EventBroker:
     """Bounded ring of published frames + subscriber fan-out (ref
     stream/event_broker.go EventBroker)."""
 
-    def __init__(self, size: int = 4096, subscriber_buffer: int = 1024):
+    def __init__(
+        self,
+        size: int = 4096,
+        subscriber_buffer: int = 1024,
+        state=None,
+        snapshot_on_subscribe: bool = True,
+        max_subscribers: int = 0,
+        frame_batch: int = 64,
+    ):
         #: max EVENTS retained across all frames (oldest dropped first)
         self.size = max(1, int(size))
         self.subscriber_buffer = max(1, int(subscriber_buffer))
+        #: the state store whose COW generations stamp snapshots; None
+        #: disables snapshot-on-subscribe (bare brokers in tests)
+        self._state = state
+        self.snapshot_on_subscribe = bool(snapshot_on_subscribe)
+        #: admission cap: subscribe() raises BrokerLimitError beyond it
+        #: (0 = unlimited)
+        self.max_subscribers = int(max_subscribers or 0)
+        #: queue entries batched per socket write by the wire tiers
+        self.frame_batch = max(1, int(frame_batch))
         self._lock = threading.Lock()
-        #: ring of (index, [Event, ...]) frames, index-ascending
-        self._frames: deque = deque()
+        #: ring of Frame objects, index-ascending
+        self._frames: deque[Frame] = deque()
         self._n_events = 0
         self._latest_index = 0
         #: highest index ever evicted from the ring (lost-gap watermark)
@@ -246,23 +578,38 @@ class EventBroker:
         self._subs: list[Subscription] = []
         self._published = 0
         self._closed_slow = 0
+        self._snapshots_served = 0
+        #: one generation's worth of extracted snapshot events, keyed by
+        #: (stamp index, topic key): a ramp of N identical cold watchers
+        #: extracts once and shares the Event objects AND their cached
+        #: encodings; a new stamp index clears it (see _snapshot_events)
+        self._snap_cache: dict = {}
 
     # -- publish (FSM apply path) ---------------------------------------
     def publish(self, index: int, events: list[Event]):
         if not events:
             return
+        frame = Frame(index, events)
         with self._lock:
             self._latest_index = max(self._latest_index, index)
-            self._frames.append((index, list(events)))
-            self._n_events += len(events)
-            self._published += len(events)
+            self._frames.append(frame)
+            self._n_events += len(frame.events)
+            self._published += len(frame.events)
             while self._n_events > self.size and len(self._frames) > 1:
-                old_index, old_events = self._frames.popleft()
-                self._n_events -= len(old_events)
-                self._dropped_through = max(self._dropped_through, old_index)
+                old = self._frames.popleft()
+                self._n_events -= len(old.events)
+                self._dropped_through = max(
+                    self._dropped_through, old.index
+                )
+            if self._snap_cache:
+                # any publish supersedes every cached snapshot stamp —
+                # dropping the cache here keeps a ramp of cold watchers
+                # cheap (hits between writes) without pinning a full
+                # serialized copy of the store for the process lifetime
+                self._snap_cache.clear()
             subs = list(self._subs)
         for sub in subs:
-            if not sub._offer(index, events):
+            if not sub._offer(frame):
                 self._close_slow(sub)
 
     def _resume_floor_locked(self) -> int:
@@ -291,6 +638,7 @@ class EventBroker:
         acl=None,
         namespace: str = "*",
         max_queued: Optional[int] = None,
+        snapshot: bool = False,
     ) -> Subscription:
         """Register a subscriber. ``topics`` maps topic → keys ("*" for
         all); ``from_index=N`` replays retained events with index > N
@@ -300,7 +648,13 @@ class EventBroker:
         ``from_index=0`` is a FRESH subscribe — "whatever is retained,
         then live" — and makes no completeness claim, so it never emits a
         gap frame (every fresh subscriber on a long-lived cluster would
-        otherwise start with one)."""
+        otherwise start with one).
+
+        ``snapshot=True`` (requires a broker constructed with a state
+        store) upgrades both cold starts and lost-gap resumes to the
+        mirror's sync contract: a state snapshot stamped at raft index N,
+        then deltas from N. A resume still within retention ignores the
+        flag — plain replay is strictly cheaper and complete."""
         norm: dict[str, set[str]] = {}
         for topic, keys in (topics or {TOPIC_ALL: ("*",)}).items():
             keyset = {k for k in keys} or {"*"}
@@ -312,12 +666,59 @@ class EventBroker:
             namespace=namespace,
             max_queued=max_queued or self.subscriber_buffer,
         )
+        snap = None
         with self._lock:
-            replay = [
-                (index, events)
-                for index, events in self._frames
-                if index > from_index
-            ]
+            if (
+                self.max_subscribers
+                and len(self._subs) >= self.max_subscribers
+            ):
+                raise BrokerLimitError(
+                    "event broker subscriber limit reached "
+                    f"({self.max_subscribers})"
+                )
+            if (
+                snapshot
+                and self._state is not None
+                and any(
+                    t == TOPIC_ALL or t in SNAPSHOT_TOPICS for t in norm
+                )
+                and (
+                    from_index == 0
+                    or self._dropped_through > from_index
+                )
+            ):
+                # (a subscription to ONLY ephemeral topics — NodeEvent /
+                # PlanResult — keeps the classic contract: the snapshot
+                # carries nothing for them, and jumping from_index to the
+                # store head would silently discard their retained ring
+                # history, which is their only history)
+                # O(1) under the lock: the store's COW generation IS the
+                # snapshot; the (possibly large) per-topic extraction
+                # happens after the lock drops, against this immutable
+                # generation. A STATE-topic event the snapshot already
+                # covers (index <= N) is suppressed by the min_index
+                # floor; an EPHEMERAL event rides through it (_offer's
+                # ephemeral_only path — no snapshot can carry it), so
+                # the ring replay below still runs from the caller's
+                # resume point when the subscription spans ephemeral
+                # topics. Anything past N is either in the ring or
+                # published after this sub registered — never a gap.
+                snap = self._state.snapshot()
+                sub.min_index = snap.latest_index()
+                if not any(
+                    t == TOPIC_ALL or t in EPHEMERAL_TOPICS
+                    for t in norm
+                ):
+                    from_index = sub.min_index
+            # lag baseline: a subscriber owes delivery only from its
+            # start point (resume index, snapshot stamp, or whatever the
+            # ring still retains for a fresh subscribe)
+            sub.delivered_index = (
+                sub.min_index
+                if snap is not None
+                else (from_index or self._dropped_through)
+            )
+            replay = [f for f in self._frames if f.index > from_index]
             # cap the replay to the NEWEST frames that fit the queue with
             # headroom for live publishes — an uncapped replay would close
             # the subscription mid-replay on any cluster retaining more
@@ -326,7 +727,7 @@ class EventBroker:
             cap = max(1, sub.max_queued - 1)
             trimmed_through = 0
             if len(replay) > cap:
-                trimmed_through = replay[-cap - 1][0]
+                trimmed_through = replay[-cap - 1].index
                 replay = replay[-cap:]
             if from_index and (
                 self._dropped_through > from_index or trimmed_through
@@ -334,14 +735,57 @@ class EventBroker:
                 # an explicit resume lost part of its range (ring eviction
                 # and/or replay trim): say so, never silently skip. A
                 # fresh subscribe (from_index=0) makes no completeness
-                # claim, so trims there stay silent.
+                # claim, so trims there stay silent. With a snapshot this
+                # marker still fires for a subscription spanning
+                # ephemeral topics whose resume fell past retention: the
+                # snapshot healed the state topics, but the evicted
+                # NodeEvent/PlanResult history is genuinely gone —
+                # silence here would be a silent gap. (A snapshot scoped
+                # to state topics only never reaches this branch:
+                # from_index was moved to the stamp above.)
                 sub._offer_gap(
                     max(self._dropped_through, trimmed_through)
                 )
-            for index, events in replay:
-                sub._offer(index, events)
+            for f in replay:
+                sub._offer(f)
+            # nta: ignore[subscriber-eviction] WHY: admission is cap-gated
+            # (max_subscribers, above); eviction runs on the delivery path
+            # (_close_slow on overflow) and on consumer close
+            # (unsubscribe), not at the registration site.
             self._subs.append(sub)
+        if snap is not None:
+            events = self._snapshot_events(snap, norm)
+            if sub.acl is None and namespace in ("*", "") and norm.get(
+                TOPIC_ALL
+            ) == {"*"}:
+                visible = events  # the common watcher: everything
+            else:
+                visible = [e for e in events if sub.matches(e)]
+            sub._prepend_snapshot(snap.latest_index(), visible)
+            with self._lock:
+                self._snapshots_served += 1
         return sub
+
+    def _snapshot_events(self, snap, topics: dict) -> list:
+        """Topic-filtered snapshot Event list for generation ``snap``,
+        cached per (stamp index, topic key): ramping N cold watchers
+        against a quiet broker extracts once and shares both the Event
+        objects and their cached encodings."""
+        wanted = frozenset(topics)
+        key = (snap.latest_index(), wanted)
+        with self._lock:
+            events = self._snap_cache.get(key)
+        if events is not None:
+            return events
+        events = snap.snapshot_events(
+            None if TOPIC_ALL in wanted else wanted
+        )
+        with self._lock:
+            if any(k[0] != key[0] for k in self._snap_cache):
+                self._snap_cache.clear()  # older generation: stale
+            if len(self._snap_cache) < 8:  # distinct topic filters
+                self._snap_cache[key] = events
+        return events
 
     def unsubscribe(self, sub: Subscription):
         with self._lock:
@@ -353,7 +797,7 @@ class EventBroker:
         """Oldest raft index still retained (resume floor)."""
         with self._lock:
             if self._frames:
-                return self._frames[0][0]
+                return self._frames[0].index
             return self._latest_index
 
     def latest_index(self) -> int:
@@ -364,14 +808,52 @@ class EventBroker:
         with self._lock:
             return {
                 "events_buffered": self._n_events,
+                "frames_buffered": len(self._frames),
                 "events_published": self._published,
                 "subscribers": len(self._subs),
                 "slow_consumers_closed": self._closed_slow,
+                "snapshots_served": self._snapshots_served,
                 "oldest_index": (
-                    self._frames[0][0] if self._frames else self._latest_index
+                    self._frames[0].index
+                    if self._frames
+                    else self._latest_index
                 ),
                 "latest_index": self._latest_index,
             }
+
+    def lag_stats(self, top: int = 0) -> dict:
+        """Delivery lag per live subscriber: broker head index minus the
+        subscriber's last drained index. O(subscribers) plain attribute
+        reads — cheap enough for the flight recorder's 1Hz sample even
+        at production fan-out. ``top`` > 0 adds the worst-N subscribers
+        with queue depth and topics (the watchdog bundle's finding)."""
+        with self._lock:
+            head = self._latest_index
+            subs = list(self._subs)
+        lags = sorted(
+            (max(0, head - s.delivered_index) for s in subs), reverse=True
+        )
+        out = {
+            "subscribers": len(lags),
+            "max": lags[0] if lags else 0,
+            "p99": lags[min(len(lags) - 1, len(lags) // 100)] if lags else 0,
+        }
+        if top:
+            ranked = sorted(
+                subs,
+                key=lambda s: head - s.delivered_index,
+                reverse=True,
+            )
+            out["top"] = [
+                {
+                    "lag": max(0, head - s.delivered_index),
+                    "queued": s.queued(),
+                    "topics": sorted(s.topics),
+                    "namespace": s.namespace,
+                }
+                for s in ranked[:top]
+            ]
+        return out
 
     def acl_changed(self):
         """ACL token/policy writes applied: close every token-backed
@@ -398,6 +880,7 @@ class EventBroker:
             self._n_events = 0
             self._latest_index = index
             self._dropped_through = index
+            self._snap_cache.clear()
             subs, self._subs = self._subs, []
         for sub in subs:
             sub._close("event buffer reset (snapshot restore)", index)

@@ -19,6 +19,12 @@ def main(argv=None) -> int:
         description="churn-soak load plane over the real server surface",
     )
     parser.add_argument("--scenario", default="smoke")
+    parser.add_argument(
+        "--fanout", action="store_true",
+        help="run the event-plane fan-out bench instead of a storm "
+        "scenario (env knobs FANOUT_SUBS / FANOUT_TOPICS / STORM_S; "
+        "see scripts/fanout.sh)",
+    )
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument(
         "--duration", type=float, default=None,
@@ -56,6 +62,17 @@ def main(argv=None) -> int:
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+
+    if args.fanout:
+        from .fanout import run_fanout_from_env
+        from .fanout import summary_line as fanout_summary
+
+        report = run_fanout_from_env(
+            args.seed, out=args.out, driver_workers=args.driver_workers
+        )
+        print(json.dumps(report["slo"], indent=1))
+        print(fanout_summary(report))
+        return 0 if report["slo"]["failed"] == 0 else 1
 
     scenario = get_scenario(args.scenario)
     if args.duration is not None:

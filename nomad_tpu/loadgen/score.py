@@ -48,6 +48,7 @@ class _StreamProbe:
         self.last_index = 0
         self.frames = 0
         self.gaps = 0
+        self.snapshots = 0
         self.reconnects = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -78,6 +79,17 @@ class _StreamProbe:
                         self.last_index = max(
                             self.last_index, frame.get("Index", 0)
                         )
+                        continue
+                    if frame.get("Snapshot") or frame.get("SnapshotDone"):
+                        # snapshot-on-subscribe sync: state at index N,
+                        # deltas follow — a re-sync, not a gap. Only the
+                        # Done marker moves the resume point (a sever
+                        # mid-snapshot must re-sync on reconnect).
+                        if frame.get("SnapshotDone"):
+                            self.snapshots += 1
+                            self.last_index = max(
+                                self.last_index, frame.get("Index", 0)
+                            )
                         continue
                     if frame.get("Error"):
                         break
@@ -275,6 +287,7 @@ class Scorekeeper:
             "eval_e2e_p99_ms_max": max(p99_series, default=0.0),
             "subscriber_lag_max": max(lag_series, default=0),
             "subscriber_gaps": sum(p.gaps for p in self._probes),
+            "subscriber_snapshots": sum(p.snapshots for p in self._probes),
             "subscriber_frames": sum(p.frames for p in self._probes),
             "invariants": {
                 **self.checker.stats(),
@@ -326,20 +339,40 @@ def grade(report: dict, slos: dict) -> dict:
     - ``max_op_failure_rate`` (real failures / fired, shed+expected excluded)
     - ``max_shed_rate``
 
+    Fan-out bench reports (loadgen/fanout.py) grade through the same
+    table with their own keys:
+
+    - ``max_fanout_lag_p99_ms`` — p99 publish→delivery latency
+    - ``max_fanout_silent_gaps`` (always 0: a drop without a marker is
+      the one unforgivable failure)
+    - ``max_fanout_gaps`` — explicit lost-gap markers observed
+    - ``max_fanout_slow_closes`` — slow-consumer closes
+
     Returns {checks: {name: {target, actual, pass}}, passed, failed,
     score} where score is the passed fraction (0..1).
     """
-    driver = report["driver"]
-    fired = max(driver["fired"], 1)
+    driver = report.get("driver") or {}
+    fired = max(driver.get("fired", 0), 1)
     actuals = {
-        "max_invariant_violations": report["invariants"]["violations"],
-        "max_rss_tail_slope_mb_per_min": report["rss_tail_slope_mb_per_min"],
-        "max_rss_peak_mb": report["rss_peak_mb"],
-        "max_eval_e2e_p99_ms": report["eval_e2e_p99_ms_max"],
-        "max_subscriber_lag": report["subscriber_lag_max"],
-        "max_op_failure_rate": driver["failed"] / fired,
-        "max_shed_rate": driver["shed"] / fired,
+        "max_op_failure_rate": driver.get("failed", 0) / fired,
+        "max_shed_rate": driver.get("shed", 0) / fired,
     }
+    if "invariants" in report:
+        actuals["max_invariant_violations"] = report["invariants"][
+            "violations"
+        ]
+    for slo_key, report_key in (
+        ("max_rss_tail_slope_mb_per_min", "rss_tail_slope_mb_per_min"),
+        ("max_rss_peak_mb", "rss_peak_mb"),
+        ("max_eval_e2e_p99_ms", "eval_e2e_p99_ms_max"),
+        ("max_subscriber_lag", "subscriber_lag_max"),
+        ("max_fanout_lag_p99_ms", "fanout_lag_p99_ms"),
+        ("max_fanout_silent_gaps", "fanout_silent_gaps"),
+        ("max_fanout_gaps", "fanout_gaps"),
+        ("max_fanout_slow_closes", "fanout_slow_closes"),
+    ):
+        if report_key in report:
+            actuals[slo_key] = report[report_key]
     checks = {}
     for name, target in sorted(slos.items()):
         actual = actuals.get(name)

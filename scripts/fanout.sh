@@ -1,0 +1,34 @@
+#!/usr/bin/env sh
+# Event-plane fan-out bench entry point (nomad_tpu/loadgen/fanout.py;
+# README "Cluster event stream" + PERF.md fan-out section). Ramps
+# FANOUT_SUBS concurrent /v1/event/stream watchers against a live
+# server, runs the smoke storm, and scores delivery (publish eps,
+# subscriber lag p50/p99 ms, explicit + silent gaps, per-subscriber
+# server memory); exit 0 = every SLO passed (silent gaps are pinned 0).
+#
+#   scripts/fanout.sh                          # 10K subs -> FANOUT_r01.json
+#   FANOUT_SUBS=1000 scripts/fanout.sh         # scaled down
+#   FANOUT_TOPICS=Job,Alloc scripts/fanout.sh  # topic-filtered watchers
+#   STORM_S=60 scripts/fanout.sh               # longer churn window
+#
+# Scale knobs (env): FANOUT_SUBS, FANOUT_TOPICS, STORM_S,
+# FANOUT_LAG_SLO_MS. Numbers are only comparable A/B on the same box
+# (see PERF.md).
+set -eu
+
+cd "$(dirname "$0")/.."
+
+out=""
+for arg in "$@"; do
+  case "$arg" in
+    --out|--out=*) out="explicit" ;;
+  esac
+done
+if [ -z "$out" ]; then
+  n=1
+  while [ -e "$(printf 'FANOUT_r%02d.json' "$n")" ]; do n=$((n + 1)); done
+  set -- --out "$(printf 'FANOUT_r%02d.json' "$n")" "$@"
+fi
+
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m nomad_tpu.loadgen --fanout "$@"

@@ -558,6 +558,139 @@ class TestUnboundedCache:
             {"nomad_tpu/scheduler/x.py": src}, "unbounded-cache"
         )
 
+
+class TestSubscriberEviction:
+    """The event plane's stronger growth contract (growth.py
+    subscriber-eviction): inside nomad_tpu/events/, every grow site of a
+    broker-owned container must itself shrink it, cap it with a len()
+    guard, or route through a close/evict path — a shrink elsewhere in
+    the class is not enough."""
+
+    def test_grow_without_reachable_eviction_flagged(self):
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._subs = []\n"
+            "    def register(self, sub):\n"
+            "        pass\n"
+            "    def attach(self, sub):\n"
+            "        self._subs.append(sub)\n"
+            "    def remove(self, sub):\n"
+            "        self._subs.remove(sub)\n"
+        )
+        fs = findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+        assert len(fs) == 1 and "_subs" in fs[0].message
+        # ...even though unbounded-cache is satisfied by remove()
+        assert not findings_for(
+            {"nomad_tpu/events/x.py": src}, "unbounded-cache"
+        )
+
+    def test_len_cap_guard_clears(self):
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._q = []\n"
+            "    def offer(self, x):\n"
+            "        if len(self._q) >= 10:\n"
+            "            return False\n"
+            "        self._q.append(x)\n"
+            "        return True\n"
+            "    def drain(self):\n"
+            "        return self._q.pop()\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+
+    def test_evict_call_in_grow_method_clears(self):
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._subs = []\n"
+            "    def publish(self, sub):\n"
+            "        self._subs.append(sub)\n"
+            "        self._close_slow(sub)\n"
+            "    def _close_slow(self, sub):\n"
+            "        self._subs.remove(sub)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+
+    def test_one_hop_shrinking_callee_clears(self):
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._subs = []\n"
+            "    def attach(self, sub):\n"
+            "        self._subs.append(sub)\n"
+            "        self._reap()\n"
+            "    def _reap(self):\n"
+            "        self._subs.pop()\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+
+    def test_foreign_close_does_not_launder_grow_site(self):
+        # sock.close()/f.close() is not an eviction path for self._subs
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._subs = []\n"
+            "    def attach(self, sub, sock):\n"
+            "        self._subs.append(sub)\n"
+            "        sock.close()\n"
+            "    def remove(self, sub):\n"
+            "        self._subs.remove(sub)\n"
+        )
+        fs = findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+        assert len(fs) == 1 and "_subs" in fs[0].message
+
+    def test_len_outside_comparison_is_not_a_cap(self):
+        # log(len(self._q)) is observability, not a bound
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._q = []\n"
+            "    def offer(self, x):\n"
+            "        print(len(self._q))\n"
+            "        self._q.append(x)\n"
+            "    def drain(self):\n"
+            "        return self._q.pop()\n"
+        )
+        fs = findings_for(
+            {"nomad_tpu/events/x.py": src}, "subscriber-eviction"
+        )
+        assert len(fs) == 1 and "_q" in fs[0].message
+
+    def test_outside_events_plane_out_of_scope(self):
+        src = (
+            "class Broker:\n"
+            "    def __init__(self):\n"
+            "        self._subs = []\n"
+            "    def attach(self, sub):\n"
+            "        self._subs.append(sub)\n"
+            "    def remove(self, sub):\n"
+            "        self._subs.remove(sub)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "subscriber-eviction"
+        )
+
+    def test_live_broker_tree_clean_or_whyd(self):
+        # the satellite contract: the real events/ plane passes the rule
+        # with at most WHY'd ignores (framework suppressions)
+        from nomad_tpu.analysis import analyze
+
+        new, baselined = analyze(ROOT, ["subscriber-eviction"])
+        assert [f.format() for f in new] == []
+        assert baselined == []
+
     def test_why_suppression_clears(self):
         src = (
             "class S:\n"

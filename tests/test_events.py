@@ -193,6 +193,121 @@ class TestEventBrokerUnit:
         assert events is None, "explicit resume sees the trim as a gap"
         assert idx > 5
 
+    def test_ring_wraparound_at_exact_boundary(self):
+        # ref event_buffer_test.go: fill the ring to EXACTLY its size,
+        # then one more — the oldest frame (and only it) is evicted and
+        # the watermark lands on its index, not one off
+        b = EventBroker(size=5)
+        for i in range(1, 6):
+            b.publish(i, [ev(i)])
+        assert b.oldest_index() == 1
+        assert b.stats()["events_buffered"] == 5
+        assert b._dropped_through == 0
+        b.publish(6, [ev(6)])
+        assert b.oldest_index() == 2
+        assert b.stats()["events_buffered"] == 5
+        assert b._dropped_through == 1
+        # resume exactly at the watermark: complete replay, no gap frame
+        sub = b.subscribe(from_index=1)
+        seen = []
+        while True:
+            frame = sub.next(timeout=0.1)
+            if frame is None:
+                break
+            idx, events = frame
+            assert events is not None, "boundary resume must not gap"
+            seen.append(idx)
+        assert seen == [2, 3, 4, 5, 6]
+        # one more publish moves the watermark to 2; an explicit resume
+        # one index BELOW it is a real gap — at the exact boundary, not
+        # one off
+        b.publish(7, [ev(7)])
+        assert b._dropped_through == 2
+        at_floor = b.subscribe(from_index=2)
+        idx, events = at_floor.next(timeout=0.1)
+        assert events is not None and idx == 3, "boundary resume gapped"
+        below_floor = b.subscribe(from_index=1)
+        idx, events = below_floor.next(timeout=0.1)
+        assert events is None, "stale resume must surface the gap"
+        assert idx == 2
+
+    def test_subscriber_close_under_publish_race(self):
+        # ref subscription_test.go close-during-delivery: subscribers
+        # closing (and churning) while a publisher floods must neither
+        # deadlock nor leak registrations nor deliver to closed queues
+        b = EventBroker(size=1000, subscriber_buffer=8)
+        stop = threading.Event()
+        errors = []
+
+        def publisher():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    b.publish(i, [ev(i)])
+                except Exception as e:  # pragma: no cover - the assert
+                    errors.append(e)
+
+        def churner(cid):
+            try:
+                for _ in range(50):
+                    sub = b.subscribe()
+                    sub.next(timeout=0.001)
+                    sub.close()
+            except SubscriptionClosedError:
+                pass
+            except Exception as e:  # pragma: no cover - the assert
+                errors.append(e)
+
+        threads = [
+            threading.Thread(
+                target=publisher, name="race-pub", daemon=True
+            )
+        ] + [
+            threading.Thread(
+                target=churner, args=(c,), name=f"race-sub-{c}",
+                daemon=True,
+            )
+            for c in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive(), "deadlocked under close/publish race"
+        assert not errors, errors
+        assert b.stats()["subscribers"] == 0, "closed subs left registered"
+
+    def test_per_event_acl_filtering_across_namespaces(self):
+        # broker-level slice of the event_endpoint ACL contract: one
+        # subscription spanning namespaces only sees events its token's
+        # capabilities cover, re-checked per event at delivery
+        class FakeACL:
+            management = False
+
+            def allow_node_read(self):
+                return False
+
+            def allow_namespace_operation(self, ns, cap):
+                return ns == "default" and cap == "read-job"
+
+        b = EventBroker(size=100)
+        sub = b.subscribe(acl=FakeACL(), namespace="*")
+        b.publish(1, [ev(1, key="mine", ns="default")])
+        b.publish(2, [ev(2, key="theirs", ns="ops")])
+        b.publish(3, [ev(3, topic="Node", type="NodeRegistration",
+                         key="n1", ns="")])
+        b.publish(4, [ev(4, key="mine-too", ns="default")])
+        seen = []
+        while True:
+            frame = sub.next(timeout=0.1)
+            if frame is None:
+                break
+            seen.extend(e.key for e in frame[1])
+        assert seen == ["mine", "mine-too"], seen
+
     def test_reset_closes_subscribers_at_restored_index(self):
         b = EventBroker(size=100)
         sub = b.subscribe()
@@ -326,8 +441,12 @@ class TestEventStreamE2E:
                 assert e["Index"] == f["Index"]
 
     def test_resume_from_index_after_disconnect_no_dupes_no_loss(self):
+        # snapshot=False: this test pins the raw ring's replay/resume
+        # contract (a cold subscribe with snapshots on starts from a
+        # state snapshot instead of replaying retained frames — that
+        # path has its own tests in test_fanout.py)
         job = self._drive_all_topics()
-        stream = self.client.event_stream(heartbeat=0.2)
+        stream = self.client.event_stream(heartbeat=0.2, snapshot=False)
         first = []
         for frame in stream:
             if frame.get("Events"):
@@ -397,7 +516,10 @@ class TestEventStreamE2E:
         assert e.value.status == 400
 
     def test_lost_gap_frame_when_ring_overwrote(self):
-        # tiny ring: writes while disconnected overrun retention
+        # tiny ring: writes while disconnected overrun retention. With
+        # snapshots off the resume sees the explicit lost-gap marker;
+        # with them on (the default) the same resume upgrades to
+        # snapshot-at-N + deltas — never a silent skip either way.
         self.server.event_broker.size = 4
         job = self._drive_all_topics()
         for i in range(12):
@@ -407,12 +529,87 @@ class TestEventStreamE2E:
                     {"subsystem": "t", "message": str(i), "timestamp": i}
                 ]}},
             )
-        stream = self.client.event_stream(index=1, heartbeat=0.2)
+        stream = self.client.event_stream(
+            index=1, heartbeat=0.2, snapshot=False
+        )
         frame = next(iter(stream))
         stream.close()
         assert frame.get("LostGap") is True
         assert frame.get("Index", 0) > 1
+        # the carried floor is the resume point (the client tracks it:
+        # resuming from the stale index would replay the gap forever)
+        assert stream.last_index == frame["Index"]
         assert job is not None
+
+    def test_gap_resume_upgrades_to_snapshot_plus_deltas(self):
+        # the mirror's sync contract generalized into the stream: a
+        # resume past the ring's retention starts from a state snapshot
+        # stamped at raft index N instead of a lost-gap bail
+        self.server.event_broker.size = 4
+        self._drive_all_topics()
+        for i in range(12):
+            self.server._apply(
+                fsm_mod.NODE_EVENTS_UPSERT,
+                {"events": {"n-x": [
+                    {"subsystem": "t", "message": str(i), "timestamp": i}
+                ]}},
+            )
+        stream = self.client.event_stream(index=1, heartbeat=0.2)
+        frames = []
+        for frame in stream:
+            frames.append(frame)
+            if frame.get("SnapshotDone"):
+                break
+        # the snapshot leads; no gap bail BEFORE it (the marker for the
+        # genuinely-lost ephemeral history rides after the sync — the
+        # wildcard subscription spans NodeEvent, whose evicted ring
+        # history no snapshot can heal, so it IS declared, later)
+        assert not any(f.get("LostGap") for f in frames)
+        done = frames[-1]
+        stamp = done["Index"]
+        assert stamp >= self.server.event_broker.oldest_index() - 1
+        assert stream.last_index == stamp
+        # snapshot batches carry the live state documents, stamped <= N
+        snap_events = [
+            e
+            for f in frames
+            if f.get("Snapshot")
+            for e in f["Events"]
+        ]
+        assert snap_events, "snapshot carried no state"
+        assert all(e["Index"] <= stamp for e in snap_events)
+        assert all(e["Type"].endswith("Snapshot") for e in snap_events)
+        # deltas ride from N: new writes arrive as ordinary frames
+        self.server._apply(
+            fsm_mod.NODE_EVENTS_UPSERT,
+            {"events": {"n-y": [
+                {"subsystem": "t", "message": "after", "timestamp": 99}
+            ]}},
+        )
+        delta = None
+        saw_gap = False
+        saw_replay = False
+        for frame in stream:
+            if frame.get("LostGap"):
+                # the evicted ephemeral (NodeEvent) history is declared,
+                # not silently skipped — the snapshot can't carry it
+                saw_gap = True
+                continue
+            if frame.get("Events") and not frame.get("Snapshot"):
+                if frame["Index"] <= stamp:
+                    # still-retained ephemeral ring history replays
+                    # through the snapshot's dedupe floor
+                    saw_replay = True
+                    assert {
+                        e["Topic"] for e in frame["Events"]
+                    } <= {"NodeEvent", "PlanResult"}, frame
+                    continue
+                delta = frame
+                break
+        stream.close()
+        assert saw_gap, "lost ephemeral history must be declared"
+        assert saw_replay, "retained ephemeral history must replay"
+        assert delta is not None and delta["Index"] > stamp
 
     def test_websocket_tier_serves_same_frames(self):
         ws = WsClient(

@@ -1,0 +1,402 @@
+"""Event plane at production fan-out (events/broker.py encode-once
+frames + snapshot-on-subscribe, events/mux.py, loadgen/fanout.py):
+
+- encode-once pinned by a counting encoder: each published event is
+  JSON-encoded exactly once regardless of subscriber count;
+- snapshot-on-subscribe returns state byte-identical to a store query at
+  the stamped raft index, ACL- and topic-filtered;
+- the scaled-down fan-out smoke: 200 real HTTP stream connections under
+  the smoke storm with zero silent gaps and zero slow-consumer closes;
+- the client reconnect regression: a lost-gap frame moves the resume
+  point to its carried floor (resuming from the stale local index would
+  replay the same gap forever).
+"""
+
+import json
+import time
+
+import pytest
+
+import nomad_tpu.events.broker as broker_mod
+import nomad_tpu.mock as mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http import HTTPServer
+from nomad_tpu.core.server import Server
+from nomad_tpu.events import EventBroker
+from nomad_tpu.raft import InmemTransport, RaftConfig
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_server(extra=None):
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": "raft0",
+            "voters": {"s0": "raft0"},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    cfg.update(extra or {})
+    s = Server(cfg)
+    s.start(num_workers=1, wait_for_leader=5.0)
+    return s
+
+
+def ev(index, topic="Job", type="JobRegistered", key="j1", ns="default"):
+    from nomad_tpu.events import Event
+
+    return Event(topic=topic, type=type, key=key, index=index, namespace=ns)
+
+
+class TestEncodeOnce:
+    def test_encode_once_across_200_subscribers(self, monkeypatch):
+        """The acceptance pin: encode count == publish count, no matter
+        how many subscribers drain the wire path."""
+        calls = {"n": 0}
+        orig = broker_mod.encode_event
+
+        def counting(event):
+            calls["n"] += 1
+            return orig(event)
+
+        monkeypatch.setattr(broker_mod, "encode_event", counting)
+        b = EventBroker(size=100000, subscriber_buffer=4096)
+        subs = [b.subscribe() for _ in range(200)]
+        published = 0
+        for i in range(1, 21):
+            b.publish(i, [ev(i), ev(i, key=f"job-{i}")])
+            published += 2
+        payloads = []
+        for sub in subs:
+            total = b""
+            while True:
+                payload, done = sub.take_wire(max_entries=1024)
+                if not payload:
+                    break
+                total += payload
+            payloads.append(total)
+        # every subscriber saw every event, byte-identical
+        assert all(p == payloads[0] for p in payloads)
+        assert payloads[0].count(b'"Topic"') == published
+        assert calls["n"] == published
+
+    def test_partial_visibility_reuses_event_encodings(self, monkeypatch):
+        calls = {"n": 0}
+        orig = broker_mod.encode_event
+
+        def counting(event):
+            calls["n"] += 1
+            return orig(event)
+
+        monkeypatch.setattr(broker_mod, "encode_event", counting)
+        b = EventBroker(size=1000)
+        whole = b.subscribe()
+        only_j1 = b.subscribe({"Job": {"j1"}})
+        b.publish(1, [ev(1, key="j1"), ev(1, key="j2")])
+        full, _ = whole.take_wire()
+        partial, _ = only_j1.take_wire()
+        assert full.count(b'"Key"') == 2
+        assert partial.count(b'"Key"') == 1
+        assert b'"j1"' in partial and b'"j2"' not in partial
+        # the filtered frame reassembles from the SAME two encodings
+        assert calls["n"] == 2
+
+
+class TestSnapshotOnSubscribe:
+    def setup_method(self):
+        self.server = make_server()
+        self.http = HTTPServer(self.server, port=0)
+        self.http.start()
+        self.client = ApiClient(address=self.http.address)
+
+    def teardown_method(self):
+        self.http.stop()
+        self.server.stop()
+
+    def _drive_and_settle(self):
+        node = mock.node()
+        self.server.node_register(node)
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.networks = []
+        self.client.register_job(job.to_dict())
+        wait_until(
+            lambda: self.server.state.allocs_by_job("default", job.id),
+            msg="allocs placed",
+        )
+        # settle: snapshot-vs-store comparison needs a stable index
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            idx = self.server.state.latest_index()
+            time.sleep(0.4)
+            if self.server.state.latest_index() == idx:
+                return
+        raise AssertionError("state never settled")
+
+    def _collect_snapshot(self, **kwargs):
+        stream = self.client.event_stream(heartbeat=0.2, **kwargs)
+        events, stamp = [], None
+        for frame in stream:
+            if frame.get("Snapshot"):
+                events.extend(frame["Events"])
+            elif frame.get("SnapshotDone"):
+                stamp = frame["Index"]
+                break
+            elif frame.get("Events"):
+                break  # deltas before SnapshotDone would be a bug
+        stream.close()
+        assert stamp is not None, "no SnapshotDone marker"
+        return events, stamp
+
+    def test_snapshot_byte_identical_to_store_at_index(self):
+        self._drive_and_settle()
+        events, stamp = self._collect_snapshot()
+        snap = self.server.state.snapshot()
+        assert snap.latest_index() == stamp, (
+            "state moved; the comparison below would be vacuous"
+        )
+        by_topic_key = {
+            (e["Topic"], e["Key"]): e for e in events
+        }
+        expected = []
+        for n in snap.nodes():
+            expected.append(("Node", n.id, n.to_dict(), n.modify_index))
+        for j in snap.jobs():
+            expected.append(("Job", j.id, j.to_dict(), j.modify_index))
+        for e_ in snap.evals():
+            expected.append(("Eval", e_.id, e_.to_dict(), e_.modify_index))
+        for a in snap.allocs():
+            expected.append(("Alloc", a.id, a.to_dict(), a.modify_index))
+        for d in snap.deployments():
+            expected.append(
+                ("Deployment", d.id, d.to_dict(), d.modify_index)
+            )
+        assert len(by_topic_key) == len(expected) > 0
+        for topic, key, doc, modify_index in expected:
+            got = by_topic_key[(topic, key)]
+            # byte-identical: the snapshot payload IS the store document
+            assert json.dumps(got["Payload"], sort_keys=True) == json.dumps(
+                doc, sort_keys=True
+            ), (topic, key)
+            assert got["Index"] == modify_index <= stamp
+            assert got["Type"] == f"{topic}Snapshot".replace(
+                "AllocSnapshot", "AllocationSnapshot"
+            ) or got["Type"] in ("AllocationSnapshot",)
+
+    def test_snapshot_topic_filtered(self):
+        self._drive_and_settle()
+        events, _ = self._collect_snapshot(topics=["Job"])
+        assert events, "no Job snapshot events"
+        assert {e["Topic"] for e in events} == {"Job"}
+        assert all(e["Type"] == "JobSnapshot" for e in events)
+
+    def test_deltas_resume_exactly_after_stamp(self):
+        self._drive_and_settle()
+        stream = self.client.event_stream(heartbeat=0.2)
+        stamp = None
+        for frame in stream:
+            if frame.get("SnapshotDone"):
+                stamp = frame["Index"]
+                break
+        job = mock.job()
+        job.id = job.name = "post-snapshot-job"
+        job.task_groups[0].tasks[0].resources.networks = []
+        self.client.register_job(job.to_dict())
+        delta = None
+        deadline = time.monotonic() + 10
+        for frame in stream:
+            if frame.get("Events") and not frame.get("Snapshot"):
+                if frame["Index"] <= stamp:
+                    # replayed pre-stamp ring history rides after the
+                    # snapshot ONLY for topics no snapshot can carry
+                    assert {
+                        e["Topic"] for e in frame["Events"]
+                    } <= {"NodeEvent", "PlanResult"}, frame
+                    continue
+                delta = frame
+                break
+            if time.monotonic() > deadline:
+                break
+        stream.close()
+        assert stamp is not None and delta is not None
+        assert delta["Index"] > stamp
+
+    def test_ephemeral_topics_keep_ring_replay(self):
+        # NodeEvent/PlanResult have no standing state objects: a cold
+        # subscribe scoped to them must NOT jump to the store head (the
+        # snapshot would carry nothing and the retained ring history —
+        # their only history — would be silently discarded)
+        from nomad_tpu.core import fsm as fsm_mod
+
+        node = mock.node()
+        self.server.node_register(node)
+        for i in range(3):
+            self.server._apply(
+                fsm_mod.NODE_EVENTS_UPSERT,
+                {"events": {node.id: [
+                    {"subsystem": "t", "message": str(i), "timestamp": i}
+                ]}},
+            )
+        stream = self.client.event_stream(
+            topics=["NodeEvent"], heartbeat=0.2
+        )
+        frame = next(iter(stream))
+        stream.close()
+        assert not frame.get("Snapshot") and not frame.get("SnapshotDone")
+        assert frame.get("Events"), "retained NodeEvent history replayed"
+        assert frame["Events"][0]["Topic"] == "NodeEvent"
+
+    def test_snapshot_disabled_keeps_plain_replay(self):
+        self._drive_and_settle()
+        stream = self.client.event_stream(heartbeat=0.2, snapshot=False)
+        frame = next(iter(stream))
+        stream.close()
+        assert not frame.get("Snapshot") and not frame.get("SnapshotDone")
+
+
+class TestSnapshotACL:
+    def setup_method(self):
+        self.server = make_server(extra={"acl": {"enabled": True}})
+        self.http = HTTPServer(self.server, port=0)
+        self.http.start()
+        anon = ApiClient(address=self.http.address)
+        boot = anon.put("/v1/acl/bootstrap")[0]
+        self.mgmt = ApiClient(
+            address=self.http.address, token=boot["SecretID"]
+        )
+        self.mgmt.put(
+            "/v1/acl/policy/readonly",
+            body={"Rules": 'namespace "default" { policy = "read" }'},
+        )
+        tok = self.mgmt.put(
+            "/v1/acl/token",
+            body={"Name": "ro", "Type": "client", "Policies": ["readonly"]},
+        )[0]
+        self.ro = ApiClient(address=self.http.address, token=tok["SecretID"])
+
+    def teardown_method(self):
+        self.http.stop()
+        self.server.stop()
+
+    def test_snapshot_is_acl_filtered_per_event(self):
+        secret = mock.job()
+        secret.id = secret.name = "secret-job"
+        secret.namespace = "ops"
+        secret.task_groups[0].tasks[0].resources.networks = []
+        self.server.job_register(secret)
+        visible = mock.job()
+        visible.id = visible.name = "visible-job"
+        visible.task_groups[0].tasks[0].resources.networks = []
+        self.server.job_register(visible)
+        stream = self.ro.event_stream(
+            topics=["Job"], namespace="*", heartbeat=0.2
+        )
+        keys = set()
+        for frame in stream:
+            if frame.get("Snapshot"):
+                keys.update(e["Key"] for e in frame["Events"])
+            elif frame.get("SnapshotDone"):
+                break
+        stream.close()
+        assert "visible-job" in keys
+        assert "secret-job" not in keys, (
+            "snapshot leaked another namespace past the token"
+        )
+
+
+class TestClientGapFloorRegression:
+    """ApiClient.event_stream reconnect after a lost gap: resume from the
+    frame's carried floor, not the stale local index (which would replay
+    the same gap forever)."""
+
+    def setup_method(self):
+        self.server = make_server()
+        self.http = HTTPServer(self.server, port=0)
+        self.http.start()
+        self.client = ApiClient(address=self.http.address)
+
+    def teardown_method(self):
+        self.http.stop()
+        self.server.stop()
+
+    def test_reconnect_resumes_from_gap_floor(self):
+        from nomad_tpu.core import fsm as fsm_mod
+
+        self.server.event_broker.size = 4
+        node = mock.node()
+        self.server.node_register(node)
+        for i in range(16):
+            self.server._apply(
+                fsm_mod.NODE_EVENTS_UPSERT,
+                {"events": {node.id: [
+                    {"subsystem": "t", "message": str(i), "timestamp": i}
+                ]}},
+            )
+        stream = self.client.event_stream(
+            index=1, heartbeat=0.2, snapshot=False
+        )
+        frame = next(iter(stream))
+        stream.close()
+        assert frame.get("LostGap") is True
+        floor = frame["Index"]
+        assert floor > 1
+        assert stream.last_index == floor, (
+            "gap frame must move the resume point to its floor"
+        )
+        resumed = self.client.event_stream(
+            index=stream.last_index, heartbeat=0.2, snapshot=False
+        )
+        frame2 = next(iter(resumed))
+        resumed.close()
+        assert frame2.get("LostGap") is None, (
+            "resume from the floor replayed the gap again"
+        )
+        assert frame2.get("Events")
+        assert frame2["Index"] == floor + 1
+
+
+class TestFanoutSmoke200:
+    """The tier-1 scaled-down fan-out smoke: 200 real HTTP stream
+    connections riding the smoke storm in-process. Zero silent gaps,
+    zero slow-consumer closes, one snapshot per subscriber."""
+
+    def test_fanout_smoke(self):
+        from nomad_tpu.loadgen.fanout import run_fanout
+
+        report = run_fanout(
+            subs=200,
+            storm_s=6.0,
+            seed=7,
+            in_proc=True,
+            nodes=24,
+            settle_s=20.0,
+            heartbeat=5.0,
+            driver_workers=4,
+        )
+        assert report["fanout_connected"] == 200
+        assert report["fanout_silent_gaps"] == 0, report
+        assert report["fanout_dupes"] == 0, report
+        assert report["fanout_slow_closes"] == 0, report
+        assert report["fanout_gaps"] == 0, report
+        assert report["stream_errors"] == 0, report
+        # one snapshot-on-subscribe per cold watcher
+        assert report["snapshots_served"] >= 200
+        assert report["events_published"] > 0
+        assert report["frames_delivered"] > 0
+        # every marker-free conn was actually checked against the oracle
+        assert report["gap_checked_conns"] == 200
+        assert report["slo"]["failed"] == 0, report["slo"]
