@@ -1295,6 +1295,40 @@ def bench_fanout():
     return report
 
 
+def bench_federation_smoke(seed=20260805):
+    """The tier-1 federated storm (loadgen/federation.py smoke profile):
+    2 regions x 1 server, a short mixed storm with cross-region submits
+    through the forwarding plane and one full WAN partition + heal. The
+    contract numbers ride BENCH_SUMMARY as fed_*: invariant violations
+    (per-region + cross-region oracle) pinned 0, worst partition heal
+    time, and the forwarding error rate OUTSIDE declared chaos windows
+    (failures inside a severed-link window are chaos-by-design)."""
+    from nomad_tpu.loadgen.federation import federation_smoke, run_federation
+
+    report = run_federation(federation_smoke(), seed=seed)
+    return {
+        "regions": len(report["region_names"]),
+        "servers": report["servers_total"],
+        "seed": seed,
+        "ops_fired": report["driver"]["fired"],
+        "ops_failed": report["driver"]["failed"],
+        "fed_invariant_violations": report["fed_invariant_violations"],
+        "fed_lost_placements": report["fed_lost_placements"],
+        "fed_double_placements": report["fed_double_placements"],
+        "fed_heal_s": report["fed_heal_s"],
+        "fed_fwd_attempted": report["fed_fwd_attempted"],
+        "fed_fwd_err_rate": report["fed_fwd_err_rate"],
+        "fed_replication_lag_p99_s": report["fed_replication_lag_p99_s"],
+        "oracle_submits": report["oracle_checked_submits"],
+        "quiesced": report["quiesced"],
+        "slo_score": report["slo"]["score"],
+        "stream_digests": {
+            r: report["regions"][r]["stream_digest"][:12]
+            for r in report["region_names"]
+        },
+    }
+
+
 def main():
     # the single-chip headline stays single-chip by construction, even
     # under NOMAD_TPU_SHARD=1 — the sharded section measures the mesh
@@ -1314,6 +1348,8 @@ def main():
         detail["soak_smoke"] = bench_soak_smoke()
         if os.environ.get("BENCH_FANOUT", "1") != "0":
             detail["fanout"] = bench_fanout()
+        if os.environ.get("BENCH_FEDERATION", "1") != "0":
+            detail["federation_smoke"] = bench_federation_smoke()
         # worker-scaling curve over the same real-server drain path (the
         # 1-core bench box bounds speedup; the curve + queue depth shows
         # WHERE the control plane saturates)
@@ -1431,6 +1467,15 @@ def main():
                 f"fanout_silent_gaps={fo['fanout_silent_gaps']}"
             )
             parts.append(f"fanout_slo_score={fo['slo']['score']}")
+        if "federation_smoke" in detail:
+            fed = detail["federation_smoke"]
+            parts.append(
+                "fed_invariant_violations="
+                f"{fed['fed_invariant_violations']}"
+            )
+            parts.append(f"fed_heal_s={fed['fed_heal_s']}")
+            parts.append(f"fed_fwd_err_rate={fed['fed_fwd_err_rate']}")
+            parts.append(f"fed_slo_score={fed['slo_score']}")
         to = detail["trace_overhead"]
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
         pab = detail["profile_ab"]

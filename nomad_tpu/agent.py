@@ -176,8 +176,14 @@ class ServerAgent:
     ):
         from .raft.log import FileLogStore, SnapshotStore, StableStore
 
-        voters = voters or {self.name: self.address}
+        # None = single-voter default; an EXPLICIT empty dict means "join
+        # via gossip discovery" (the server starts voter-less and waits
+        # for the region leader's CONFIG entry — it never self-elects)
+        voters = {self.name: self.address} if voters is None else voters
+        # merge ON TOP of any user-supplied raft stanza so timing knobs
+        # (heartbeat_interval / election_timeout_*) survive the wiring
         raft_cfg: dict = {
+            **self.config.get("raft", {}),
             "node_id": self.name,
             "address": self.address,
             "voters": voters,
@@ -203,15 +209,21 @@ class ServerAgent:
         # the HTTP agent's client-fs forwarding pool must dial client RPC
         # listeners with the same mTLS identity
         self.server.tls_client_context = self.tls_client_context
-        # raft rides the RPC listener, so raft addr == rpc addr
+        # raft rides the RPC listener, so raft addr == rpc addr; the
+        # live voter map keeps not_leader hints dialable after restarts
+        # and membership changes outgrow the boot-time seed
         self.rpc.server_rpc_addrs = dict(voters)
+        self.rpc.voters_snapshot = self.server.raft.voters_snapshot
         self._register_endpoints(self.server, self.rpc)
         self.rpc.start()
         self.server.start(num_workers=num_workers, wait_for_leader=wait_for_leader)
 
-    def stop(self):
+    def stop(self, hard: bool = False):
+        """``hard=True`` simulates a crash: the server skips its gossip
+        leave broadcast (peers must detect the death), but the listener
+        and transport still close — a dead process holds no sockets."""
         if self.server is not None:
-            self.server.stop()
+            self.server.stop(hard=hard)
         self._transport.close()
         self.rpc.stop()
 

@@ -8,15 +8,65 @@ canonical dict encoding is the wire format.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from .. import metrics
 from ..jobspec.hcl import parse_duration
 from ..raft import NotLeaderError
 from ..structs.model import Allocation, Job
+from ..testing import faults as _faults
+
+#: total wall budget a cross-region (or leader) forward may spend
+#: retrying through an election/partition before surfacing the error
+FORWARD_RETRY_DEADLINE_S = float(
+    os.environ.get("NOMAD_TPU_FWD_DEADLINE_S", "5.0")
+)
+#: error substrings that mean "the target cluster is mid-transition"
+#: (election in flight, stale routing) rather than "the request is bad" —
+#: the retryable class. Every entry is an EXPLICIT handler refusal: the
+#: remote answered without executing, so re-sending cannot double-apply
+#: a non-idempotent write (dispatch mints a new child job per call).
+#: "timed out" is deliberately absent — a hop that answers "my inner
+#: forward timed out" has an indeterminate outcome beyond it.
+_TRANSIENT_FORWARD_ERRORS = (
+    "not the leader",
+    # the inner leader-forward loop's terminal wrapper: with ambiguous
+    # outcomes surfaced separately as "forward outcome unknown", this
+    # message only ever wraps explicit refusals, so another peer may
+    # safely re-fire the request
+    "leader forward failed after",
+    "forwarding loop",
+    "no route to it",
+    "no path to region",
+    "region link",
+)
+
+
+def _transient_forward_error(message: str) -> bool:
+    msg = str(message)
+    return any(s in msg for s in _TRANSIENT_FORWARD_ERRORS)
+
+
+def _pre_send_failure(e: Exception) -> bool:
+    """True when the transport error provably happened BEFORE the request
+    was sent (dial refused / unreachable), so a retry cannot double-apply.
+    Ambiguous failures — timeouts, resets mid-exchange — return False and
+    must surface: the remote may have executed the write."""
+    import urllib.error
+
+    if isinstance(e, ConnectionRefusedError):
+        return True
+    if isinstance(e, urllib.error.URLError) and not isinstance(
+        e, urllib.error.HTTPError
+    ):
+        return isinstance(e.reason, ConnectionRefusedError)
+    return False
 
 _ROUTES: list[tuple[str, re.Pattern, str, object]] = []
 
@@ -185,6 +235,15 @@ class HTTPServer:
                             except PermissionError as e:
                                 self._respond(403, {"error": str(e)}, None)
                                 return
+                            except NotLeaderError as e:
+                                # ws dials can't be proxied here; surface
+                                # a retryable error, not a false 403
+                                self._respond(
+                                    500,
+                                    {"error": f"not the leader ({e})"},
+                                    None,
+                                )
+                                return
                             if not _acl_allows(
                                 acl_obj, "ns:alloc-exec", query
                             ):
@@ -256,6 +315,15 @@ class HTTPServer:
                             except PermissionError as e:
                                 self._respond(403, {"error": str(e)}, None)
                                 return
+                            except NotLeaderError as e:
+                                # a token miss on a follower is not
+                                # authoritative (its table may lag a
+                                # restart or replication round): the
+                                # leader re-resolves and serves
+                                self._forward_leader(
+                                    method, e, parsed, query, body
+                                )
+                                return
                             if not _acl_allows(acl_obj, acl_spec, query):
                                 self._respond(
                                     403, {"error": "Permission denied"}, None
@@ -268,9 +336,30 @@ class HTTPServer:
                             "X-Nomad-Token", ""
                         )
                         try:
-                            result, index = getattr(api, name)(
-                                _DecodedMatch(match), query, body
-                            )
+                            trace_hdr = self.headers.get("X-Nomad-Trace")
+                            if trace_hdr:
+                                # forwarded-request propagation: the
+                                # proxying hop's span context rides the
+                                # header so this handler's spans join the
+                                # submitter's tree (cross-region critical
+                                # paths are one retained trace)
+                                from ..trace import tracer
+
+                                ctx = None
+                                try:
+                                    ctx = tracer.ctx_from_annotation(
+                                        json.loads(trace_hdr)
+                                    )
+                                except Exception:
+                                    pass
+                                with tracer.activate(ctx):
+                                    result, index = getattr(api, name)(
+                                        _DecodedMatch(match), query, body
+                                    )
+                            else:
+                                result, index = getattr(api, name)(
+                                    _DecodedMatch(match), query, body
+                                )
                             if isinstance(result, RawResponse):
                                 data = result.body
                                 self.send_response(200)
@@ -320,71 +409,231 @@ class HTTPServer:
                         None,
                     )
                     return
-                leader_id = getattr(err, "leader_id", None) or getattr(
-                    api.server.raft, "leader_id", None
-                )
-                leader_rpc = getattr(err, "leader_addr", None) or (
-                    api.server.raft.leader_address()
-                )
-                target = api.server.resolve_server_http_addr(
-                    leader_id, leader_rpc
-                )
-                if not target:
-                    self._respond(
-                        500,
-                        {"error": f"not the leader and no route to it ({err})"},
-                        None,
-                    )
-                    return
                 from .client import APIError, ApiClient
 
-                proxy = ApiClient(
-                    address=target,
-                    token=self.headers.get("X-Nomad-Token") or "",
-                )
                 path = parsed.path + (
                     "?" + parsed.query if parsed.query else ""
                 )
+                # retry-with-backoff through the election: the leader
+                # hint is only trusted on the first attempt (it may name
+                # the peer that just died); later attempts re-resolve
+                # from live raft state, so the re-elected leader is found
+                # as soon as a quorum knows it. Writes on this surface
+                # are idempotent upserts, so a retry after a flushed-but-
+                # failed hop cannot double-apply.
+                deadline = time.monotonic() + FORWARD_RETRY_DEADLINE_S
+                backoff = 0.05
+                attempt = 0
+                last_err = str(err)
+                while True:
+                    if attempt == 0:
+                        leader_id = getattr(err, "leader_id", None) or getattr(
+                            api.server.raft, "leader_id", None
+                        )
+                        leader_rpc = getattr(err, "leader_addr", None) or (
+                            api.server.raft.leader_address()
+                        )
+                    else:
+                        leader_id = getattr(api.server.raft, "leader_id", None)
+                        leader_rpc = api.server.raft.leader_address()
+                    target = (
+                        api.server.resolve_server_http_addr(
+                            leader_id, leader_rpc
+                        )
+                        if leader_rpc or leader_id
+                        else None
+                    )
+                    if target:
+                        proxy = ApiClient(
+                            address=target,
+                            token=self.headers.get("X-Nomad-Token") or "",
+                        )
+                        try:
+                            payload, index = proxy._request(
+                                method, path, body=body,
+                                headers=self._forward_headers(ttl - 1),
+                            )
+                            self._respond(200, payload, index)
+                            return
+                        except APIError as e:
+                            if not _transient_forward_error(str(e)):
+                                self._respond(e.status, {"error": str(e)}, None)
+                                return
+                            last_err = str(e)
+                        except Exception as e:
+                            # a stale address (peer restarted onto a new
+                            # HTTP port) must not wedge forwarding forever
+                            # — quarantine it so the next resolution
+                            # consults the live sources
+                            api.server.forget_server_http_addr(
+                                leader_rpc, target
+                            )
+                            if not _pre_send_failure(e):
+                                # ambiguous transport failure: the hop may
+                                # have executed the write — surfacing is
+                                # the only double-apply-safe answer
+                                self._respond(
+                                    500,
+                                    {
+                                        "error": "leader forward outcome "
+                                        f"unknown: {e}"
+                                    },
+                                    None,
+                                )
+                                return
+                            last_err = f"{type(e).__name__}: {e}"
+                    else:
+                        last_err = f"no route to leader ({err})"
+                    attempt += 1
+                    if time.monotonic() + backoff > deadline:
+                        break
+                    metrics.incr("http.leader_forward.retry")
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 0.5)
+                metrics.incr("http.leader_forward.failed")
+                self._respond(
+                    500,
+                    {
+                        "error": "leader forward failed after "
+                        f"{attempt + 1} attempts: {last_err}"
+                    },
+                    None,
+                )
+
+            def _forward_headers(self, ttl: int) -> dict:
+                """Headers every proxy hop carries: the loop-bounding TTL
+                plus the active trace context (when sampled), so the
+                remote handler's spans — job.submit included — parent
+                under this hop and the cross-region critical path is ONE
+                retained tree."""
+                headers = {"X-Nomad-Forward-TTL": str(ttl)}
                 try:
-                    payload, index = proxy._request(
-                        method, path, body=body,
-                        headers={"X-Nomad-Forward-TTL": str(ttl - 1)},
-                    )
-                    self._respond(200, payload, index)
-                except APIError as e:
-                    self._respond(e.status, {"error": str(e)}, None)
-                except Exception as e:
-                    # a stale address (peer restarted onto a new HTTP
-                    # port) must not wedge forwarding forever — quarantine
-                    # it so the next resolution consults the live sources
-                    api.server.forget_server_http_addr(leader_rpc, target)
-                    self._respond(
-                        500, {"error": f"leader forward failed: {e}"}, None
-                    )
+                    from ..trace import tracer
+
+                    ctx = tracer.current()
+                    if ctx is not None and ctx.sampled:
+                        headers["X-Nomad-Trace"] = json.dumps(ctx.to_dict())
+                except Exception:
+                    pass
+                return headers
 
             def _forward_region(self, method, region, parsed, query, body):
+                """Proxy the request to a server in ``region`` (ref
+                rpc.go forward() + region tables), retrying with backoff
+                through remote elections and stale routing: losing the
+                remote leader mid-call must not surface a transient
+                error to the submitter. Each attempt re-reads the gossip
+                forwarding table and rotates peers; only the recognized
+                transient error class retries (writes on this surface
+                are idempotent upserts, so a retried hop cannot
+                double-apply). The inter-region fault seam
+                (testing/faults.py region scope) gates every attempt —
+                a partitioned link fails here exactly like a dead WAN."""
                 from .client import APIError, ApiClient
 
-                peers = api.server.region_http_servers(region)
-                if not peers:
-                    self._respond(
-                        500, {"error": f"no path to region {region!r}"}, None
-                    )
-                    return
-                proxy = ApiClient(
-                    address=peers[0],
-                    token=self.headers.get("X-Nomad-Token") or "",
-                )
+                self_region = getattr(api.server, "region", "global")
                 path = parsed.path + ("?" + parsed.query if parsed.query else "")
+                span_cm = None
                 try:
-                    payload, index = proxy._request(method, path, body=body)
-                    self._respond(200, payload, index)
-                except APIError as e:
-                    self._respond(e.status, {"error": str(e)}, None)
-                except Exception as e:
-                    self._respond(
-                        500, {"error": f"region forward failed: {e}"}, None
+                    from ..trace import tracer
+
+                    # the forward hop is the trace ROOT when the request
+                    # arrived untraced (the cross-region submit surface),
+                    # a child span when a context is already active
+                    opener = (
+                        tracer.span if tracer.current() is not None
+                        else tracer.root
                     )
+                    span_cm = opener(
+                        "http.region_forward",
+                        tags={"src": self_region, "dst": region},
+                    )
+                    span_cm.__enter__()
+                except Exception:
+                    span_cm = None
+                try:
+                    self._forward_region_inner(
+                        method, region, self_region, path, body,
+                        ApiClient, APIError,
+                    )
+                finally:
+                    if span_cm is not None:
+                        span_cm.__exit__(None, None, None)
+
+            def _forward_region_inner(
+                self, method, region, self_region, path, body,
+                ApiClient, APIError,
+            ):
+                deadline = time.monotonic() + FORWARD_RETRY_DEADLINE_S
+                backoff = 0.05
+                attempt = 0
+                last_err = f"no path to region {region!r}"
+                while True:
+                    severed = _faults.region_link(
+                        self_region, region, "http.forward"
+                    ) in ("drop", "sever")
+                    if severed:
+                        last_err = (
+                            f"region link {self_region}->{region} severed"
+                        )
+                        metrics.incr("http.region_forward.severed")
+                    else:
+                        peers = api.server.region_http_servers(region)
+                        if peers:
+                            proxy = ApiClient(
+                                address=peers[attempt % len(peers)],
+                                token=self.headers.get("X-Nomad-Token") or "",
+                            )
+                            try:
+                                payload, index = proxy._request(
+                                    method, path, body=body,
+                                    headers=self._forward_headers(2),
+                                )
+                                metrics.incr("http.region_forward.ok")
+                                self._respond(200, payload, index)
+                                return
+                            except APIError as e:
+                                if not _transient_forward_error(str(e)):
+                                    self._respond(
+                                        e.status, {"error": str(e)}, None
+                                    )
+                                    return
+                                last_err = str(e)
+                            except Exception as e:
+                                if not _pre_send_failure(e):
+                                    # ambiguous transport failure: the
+                                    # remote may have executed the write
+                                    # (dispatch mints a child per call) —
+                                    # only a provably-unsent request is
+                                    # safe to re-fire
+                                    self._respond(
+                                        500,
+                                        {
+                                            "error": "region forward to "
+                                            f"{region!r} outcome "
+                                            f"unknown: {e}"
+                                        },
+                                        None,
+                                    )
+                                    return
+                                last_err = f"{type(e).__name__}: {e}"
+                        else:
+                            last_err = f"no path to region {region!r}"
+                    attempt += 1
+                    if time.monotonic() + backoff > deadline:
+                        break
+                    metrics.incr("http.region_forward.retry")
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 0.5)
+                metrics.incr("http.region_forward.failed")
+                self._respond(
+                    500,
+                    {
+                        "error": f"region forward to {region!r} failed "
+                        f"after {attempt + 1} attempts: {last_err}"
+                    },
+                    None,
+                )
 
             def _respond(self, code, payload, index):
                 data = json.dumps(payload).encode()
@@ -504,8 +753,14 @@ class HTTPServer:
         self._check_ns(query, job.namespace, "submit-job")
         # mint the trace at HTTP submit: the created eval adopts this
         # context (Server._adopt_eval_trace), so the retained tree runs
-        # submit → broker → worker → device → plan → fsm → mirror
-        with tracer.root("job.submit", tags={"job": job.id}):
+        # submit → broker → worker → device → plan → fsm → mirror. A
+        # request forwarded from another region arrives with an active
+        # context (X-Nomad-Trace) — then job.submit is a child span and
+        # the cross-region hop stays one tree
+        opener = (
+            tracer.span if tracer.current() is not None else tracer.root
+        )
+        with opener("job.submit", tags={"job": job.id}):
             eval_id = self.server.job_register(job)
         return {"EvalID": eval_id, "JobModifyIndex": self.server.state.latest_index()}, None
 
@@ -1874,6 +2129,12 @@ class HTTPServer:
                 acl_obj = self.server.resolve_token(secret)
             except PermissionError as e:
                 handler._respond(403, {"error": str(e)}, None)
+                return
+            except NotLeaderError as e:
+                # streams aren't proxied; retryable error, not a false 403
+                handler._respond(
+                    500, {"error": f"not the leader ({e})"}, None
+                )
                 return
             # subscribe-time gate per requested topic; each delivered
             # event is re-filtered against ITS namespace. The wildcard

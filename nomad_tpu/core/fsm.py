@@ -142,8 +142,14 @@ class FSM:
             # ignoreUnknownTypeFlag entries); log and skip.
             logger.error("fsm: unknown message type %r at index %d", msg_type, index)
             return None
-        if self.time_table is not None:
-            # witness index→time for GC age thresholds (fsm.go:258)
+        if self.time_table is not None and msg_type != NOOP:
+            # witness index→time for GC age thresholds (fsm.go:258).
+            # Noops are excluded to match the reference, where LogNoop
+            # entries never reach fsm.Apply at all — every election
+            # appends a term-start noop (the leadership barrier rides
+            # its apply), and witnessing it would stamp "now" before any
+            # real write (on a fresh cluster that poisons backdated
+            # test witnesses; the next real apply witnesses anyway)
             self.time_table.witness(index)
         pre = None
         if self.event_broker is not None and msg_type in (

@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 from .. import metrics
+from ..testing import faults as _faults
 from ..raft import InmemTransport, NotLeaderError, Raft, RaftConfig
 from ..raft.log import InmemLogStore, SnapshotStore, StableStore
 from ..state.store import StateStore
@@ -215,7 +216,17 @@ class Server:
         #: this server's region; regions are independent raft domains
         #: federated over gossip (ref regions_endpoint.go, serf.go WAN)
         self.region = self.config.get("region", "global")
+        #: ACL-replication health, fed by replicate_acl_once and read by
+        #: the flight recorder (debug/flight.py) so the per-region
+        #: acl_replication_lag watchdog rule can see replication stall
+        #: while it is happening. Keys: configured, authoritative_region,
+        #: rounds, failures, last_success_wall, started_wall, last_error.
+        self.acl_replication_status: dict = {"configured": False}
         self.raft = self._setup_raft()
+        #: members with a grace-delayed voter-removal recheck in flight
+        #: (one per member; see _remove_dead_server_after_grace)
+        self._dead_server_pending: set = set()
+        self._dead_server_lock = threading.Lock()
         self.gossip = self._setup_gossip()
         from .vault import VaultClient
 
@@ -236,11 +247,22 @@ class Server:
         else:
             voters = rc.get("voters", {node_id: address})
         single = len(voters) == 1
+        # timing knobs (``raft`` stanza): the dev defaults are tuned for
+        # an idle box — multi-server clusters under real load (and the
+        # federated chaos topology, which runs many servers in one
+        # process) need election timeouts with GIL-stall headroom, or
+        # followers fire elections against a perfectly healthy leader
         raft_config = rc.get("config") or RaftConfig(
             # single-voter dev servers elect in ~10ms (raftInmem dev mode)
-            heartbeat_interval=0.02 if single else 0.05,
-            election_timeout_min=0.01 if single else 0.15,
-            election_timeout_max=0.03 if single else 0.30,
+            heartbeat_interval=rc.get(
+                "heartbeat_interval", 0.02 if single else 0.05
+            ),
+            election_timeout_min=rc.get(
+                "election_timeout_min", 0.01 if single else 0.15
+            ),
+            election_timeout_max=rc.get(
+                "election_timeout_max", 0.03 if single else 0.30
+            ),
             snapshot_threshold=rc.get("snapshot_threshold", 8192),
         )
         return Raft(
@@ -321,9 +343,17 @@ class Server:
                     "cleanup_dead_servers", True
                 ):
                     return
-                if member.name in self.raft.voters:
-                    logger.info("gossip: removing server %s from raft", member.name)
+                if member.name not in self.raft.voters:
+                    return
+                if event == "leave":
+                    # a leave is the member's own statement — no stale-
+                    # record race to absorb, remove immediately
+                    logger.info(
+                        "gossip: removing server %s from raft", member.name
+                    )
                     self.raft.remove_voter(member.name)
+                else:
+                    self._remove_dead_server_after_grace(member.name)
         except NotLeaderError:
             pass
         except Exception:
@@ -338,6 +368,14 @@ class Server:
         "last_contact_threshold_s": 0.2,
         "max_trailing_logs": 250,
         "server_stabilization_time_s": 10.0,
+        #: seconds a dead/reaped member must STAY dead before its voter
+        #: record is removed (ref autopilot.go pruneDeadServers running
+        #: on an interval, never instantly on the serf event). The grace
+        #: absorbs stale death records: after a WAN partition heals, the
+        #: far side's DEAD record for a live local server can arrive
+        #: moments before that server's refutation — instant removal
+        #: then splits the voter map and starts an election war.
+        "dead_server_grace_s": 3.0,
     }
 
     def autopilot_config(self) -> dict:
@@ -647,13 +685,63 @@ class Server:
             with_status = self.gossip.members.get(voter)
             if with_status is not None and with_status.status == "suspect":
                 continue  # possibly flapping; the dead event will decide
+            # same grace as the dead event: a leadership change right
+            # after a partition heal sees the far side's stale DEAD
+            # records before the refutations arrive — removing on that
+            # snapshot splits the voter map
+            self._remove_dead_server_after_grace(voter)
+
+    def _remove_dead_server_after_grace(self, name: str):
+        """Schedule a voter removal that only fires if ``name`` is STILL
+        not alive after ``autopilot.dead_server_grace_s`` (one pending
+        recheck per member). Ref autopilot.go pruneDeadServers: cleanup
+        is periodic, never instant on a serf event, exactly so a stale
+        death record can be refuted before it costs a voter."""
+        grace = float(
+            self.autopilot_config().get("dead_server_grace_s", 3.0)
+        )
+        with self._dead_server_lock:
+            if name in self._dead_server_pending:
+                return
+            self._dead_server_pending.add(name)
+
+        def recheck():
+            with self._dead_server_lock:
+                self._dead_server_pending.discard(name)
+            if not self._running or not self._leader:
+                return
+            member = (
+                self.gossip.members.get(name)
+                if self.gossip is not None
+                else None
+            )
+            if member is not None and member.status == "alive":
+                return  # refuted within the grace — a live server keeps its seat
+            if name not in self.raft.voters:
+                return
             try:
                 logger.info(
-                    "gossip reconcile: removing non-member voter %s", voter
+                    "gossip: removing dead server %s from raft", name
                 )
-                self.raft.remove_voter(voter)
+                self.raft.remove_voter(name)
+            except NotLeaderError:
+                pass
             except Exception:
-                logger.exception("gossip reconcile removal failed")
+                logger.exception("dead-server removal failed")
+
+        def recheck_async():
+            # remove_voter blocks on the CONFIG commit (up to its 5s
+            # timeout when quorum is strained) — never on the shared
+            # timer wheel's thread, where it would stall every broker
+            # nack/heartbeat timer behind it
+            threading.Thread(
+                target=recheck, daemon=True, name=f"dead-server-rm-{name}"
+            ).start()
+
+        if grace <= 0:
+            recheck_async()
+        else:
+            shared_timer_wheel().arm(grace, recheck_async, ())
 
     def _apply(self, msg_type: str, payload: dict):
         """Propose a write through consensus (ref nomad/rpc.go raftApply).
@@ -884,7 +972,12 @@ class Server:
             self.workers.append(w)
             w.start()
 
-    def stop(self):
+    def stop(self, hard: bool = False):
+        """``hard=True`` is a simulated crash (the chaos harness's
+        leader kill): no gossip leave broadcast, so peers discover the
+        death through the SWIM failure detector exactly as they would a
+        kill -9 — intentional departures stay distinguishable from
+        failures (serf leave vs. failed)."""
         self._running = False
         self.flight_recorder.stop()
         if self.watchdog is not None:
@@ -893,10 +986,11 @@ class Server:
             self.watchdog.wait_idle(timeout=5.0)
         self._hb_expire_q.put(None)  # unpark the expiry drainer, if any
         if self.gossip is not None:
-            try:
-                self.gossip.leave()
-            except Exception:
-                pass
+            if not hard:
+                try:
+                    self.gossip.leave()
+                except Exception:
+                    pass
             self.gossip.stop()
         for w in self.workers:
             w.stop()
@@ -928,9 +1022,38 @@ class Server:
         else:
             self._revoke_leadership()
 
+    def _leadership_barrier(self) -> bool:
+        """True once the FSM provably covers every entry committed by
+        prior leaders. Rides the term-start noop raft already appended
+        at election — commit of a current-term entry proves (by Log
+        Matching) every prior committed entry is in this log, and its
+        APPLY means the FSM replayed them all — so the barrier proposes
+        nothing and adds no load; it just waits out the apply loop.
+        Aborts only when leadership moves (the follower transition
+        callback cleans up); it never gives up while still leader, which
+        would leave a raft leader whose server never enables its
+        planner — every write then fails not_leader forever."""
+        target = self.raft.term_start_index
+        while self._running and self.raft.is_leader():
+            if self.raft.last_applied >= target:
+                return True
+            time.sleep(0.002)
+        return False
+
     def _establish_leadership(self):
         """ref leader.go:180 establishLeadership"""
         if not self._running:
+            return
+        # barrier FIRST (ref leader.go: s.raft.Barrier()): commit + apply
+        # a current-term noop so the FSM covers every entry committed by
+        # prior leaders before ANY leader subsystem reads state. Without
+        # it, _restore_evals re-enqueues evals whose ack is still in the
+        # un-applied log suffix and the planner verifies plans against
+        # snapshots missing the old leader's committed placements — the
+        # "alloc placed twice after failover" class the federated storm
+        # surfaced. Runs on the raft-lead-* callback thread, so blocking
+        # here stalls no raft progress.
+        if not self._leadership_barrier():
             return
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
@@ -1127,6 +1250,17 @@ class Server:
             return cached
         token = self.state.acl_token_by_secret(secret)
         if token is None:
+            if not self.raft.is_leader():
+                # a follower's table may simply LAG — a freshly restarted
+                # server serves HTTP before its FSM catches up to the
+                # commit index, and a replica region's follower may not
+                # have replicated a new global token yet. Only the
+                # leader's miss is authoritative (ref acl.go: resolution
+                # falls through to the authoritative source on a local
+                # miss); the RPC/HTTP layers forward on this error.
+                raise NotLeaderError(
+                    self.raft.leader_address(), self.raft.leader_id
+                )
             raise PermissionError("ACL token not found")
         if token.type == ACL_TOKEN_TYPE_MANAGEMENT:
             acl = ACL_MANAGEMENT
@@ -1161,9 +1295,26 @@ class Server:
         while self._leader and self._running:
             try:
                 self.replicate_acl_once()
-            except Exception:
+            except Exception as e:
+                st = self.acl_replication_status
+                st["failures"] = st.get("failures", 0) + 1
+                st["last_error"] = f"{type(e).__name__}: {e}"
                 logger.exception("acl replication round failed")
             time.sleep(interval)
+
+    def acl_replication_lag_s(self) -> Optional[float]:
+        """Seconds since the last successful replication round (None
+        when this server doesn't replicate — authoritative regions and
+        ACL-less clusters). A server that has NEVER succeeded reports
+        lag since its first attempt, so a region that came up
+        partitioned is visibly behind from the start."""
+        st = self.acl_replication_status
+        if not st.get("configured"):
+            return None
+        anchor = st.get("last_success_wall") or st.get("started_wall")
+        if anchor is None:
+            return None
+        return max(0.0, time.time() - anchor)
 
     def replicate_acl_once(self) -> dict:
         """One replication round; returns {policies_upserted, policies_
@@ -1178,8 +1329,27 @@ class Server:
         auth = self._acl_replication_target()
         if auth is None:
             return stats
+        st = self.acl_replication_status
+        st["configured"] = True
+        st["authoritative_region"] = auth
+        st.setdefault("started_wall", time.time())
+        st.setdefault("rounds", 0)
+        st.setdefault("failures", 0)
+        # inter-region fault seam: a partitioned WAN stalls replication
+        # here exactly like an unreachable authoritative region — the
+        # stall is counted so the acl_replication_lag watchdog sees it
+        if _faults.region_link(self.region, auth, "acl.replication") in (
+            "drop", "sever",
+        ):
+            st["failures"] += 1
+            st["last_error"] = (
+                f"region link {self.region}->{auth} severed"
+            )
+            return stats
         peers = self.region_http_servers(auth)
         if not peers:
+            st["failures"] += 1
+            st["last_error"] = f"no path to authoritative region {auth!r}"
             return stats
         from ..api.client import ApiClient
         from ..structs.model import AclPolicy, AclToken
@@ -1250,6 +1420,9 @@ class Server:
         if stale_tokens:
             self.acl_delete_tokens(stale_tokens)
             stats["tokens_deleted"] = len(stale_tokens)
+        st["rounds"] += 1
+        st["last_success_wall"] = time.time()
+        st.pop("last_error", None)
         return stats
 
     def acl_bootstrap(self):

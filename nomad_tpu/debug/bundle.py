@@ -194,6 +194,42 @@ def capture_bundle(
             }
         except Exception:
             out["critical_path"] = None
+        # federation diagnosis (the acl_replication_lag trip's payload):
+        # which region this is, who it can reach, how replication and
+        # cross-region forwarding are doing, and local raft health
+        region = getattr(server, "region", None)
+        if region is not None:
+            try:
+                from .. import metrics as _metrics
+
+                counters = _metrics.snapshot()["counters"]
+                fed = {
+                    "region": region,
+                    "known_regions": server.regions(),
+                    "replication": dict(
+                        getattr(server, "acl_replication_status", {}) or {}
+                    ),
+                    "raft": {
+                        "leader_id": getattr(server.raft, "leader_id", None),
+                        "is_leader": server.is_leader(),
+                        "voters": sorted(server.raft.voters),
+                    },
+                    "forwarding": {
+                        k: v
+                        for k, v in counters.items()
+                        if k.startswith(
+                            ("http.region_forward", "http.leader_forward",
+                             "rpc.not_leader_retry")
+                        )
+                    },
+                }
+                lag_fn = getattr(server, "acl_replication_lag_s", None)
+                lag = lag_fn() if lag_fn is not None else None
+                if lag is not None:
+                    fed["replication"]["lag_s"] = round(lag, 3)
+                out["federation"] = fed
+            except Exception:
+                out["federation"] = None
         return out
 
     _write_json(dest, "findings.json", section("findings", findings) or {})

@@ -123,6 +123,24 @@ def sample_process(server) -> dict:
         sample["trace_retained"] = ts.get("retained", 0)
     except Exception:
         pass
+    # federation signals: which region this process serves, cross-region
+    # forwarding counters, and — on replicating (non-authoritative ACL)
+    # servers only — how far behind the authoritative region this one is.
+    # The keys appear ONLY where the feature is configured, so watchdog
+    # rules keyed on them stay silent on single-region clusters.
+    region = getattr(server, "region", None)
+    if region is not None:
+        sample["region"] = region
+    sample["region_forward_failed"] = counters.get(
+        "http.region_forward.failed", 0
+    )
+    lag_fn = getattr(server, "acl_replication_lag_s", None)
+    lag = lag_fn() if lag_fn is not None else None
+    if lag is not None:
+        sample["acl_replication_lag_s"] = round(lag, 3)
+        st = server.acl_replication_status
+        sample["acl_replication_rounds"] = st.get("rounds", 0)
+        sample["acl_replication_failures"] = st.get("failures", 0)
     if lockdep.installed():
         sample["lock_wait_s"] = round(
             sum(e["wait_s"] for e in lockdep.contention().values()), 4

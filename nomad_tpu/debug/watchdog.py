@@ -25,7 +25,14 @@ Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
   index minus the subscriber's last drained index) above threshold for
   N consecutive samples while subscribers exist: fan-out overload
   becomes a debug bundle — whose findings carry the per-subscriber lag
-  top-N and broker ring stats — not a pager.
+  top-N and broker ring stats — not a pager;
+- ``acl_replication_lag`` — seconds since this (non-authoritative,
+  replicating) region last successfully mirrored the authoritative
+  region's ACL state, above threshold for N consecutive samples: a
+  severed WAN or dead authoritative leader becomes a bundle whose
+  findings carry the per-region replication/forwarding stats. The rule
+  keys off ``acl_replication_lag_s``, which only replicating servers
+  emit — single-region clusters never see it.
 
 Trips are always recorded + counted (``debug.watchdog_trips``); the
 bundle write additionally needs a configured ``bundle_dir`` so a
@@ -55,6 +62,7 @@ DEFAULT_RULES = {
     "lock_contention": {"threshold_frac": 0.5, "window": 30,
                         "min_span_s": 5.0},
     "subscriber_lag": {"threshold": 10_000, "consecutive": 5},
+    "acl_replication_lag": {"threshold_s": 30.0, "consecutive": 3},
 }
 
 MAX_TRIP_LOG = 64
@@ -176,6 +184,26 @@ class Watchdog:
                 "lag_p99": sample.get("subscriber_lag_p99"),
                 "threshold": p["threshold"],
                 "subscribers": sample.get("subscribers"),
+            }
+        return None
+
+    def _rule_acl_replication_lag(self, sample, window, p):
+        tail = window[-int(p["consecutive"]):]
+        if len(tail) < int(p["consecutive"]):
+            return None
+        # the key exists only on replicating servers, so the rule is
+        # structurally silent everywhere else; a successful round resets
+        # the lag (and the streak) by construction
+        if all(
+            s.get("acl_replication_lag_s") is not None
+            and s["acl_replication_lag_s"] > p["threshold_s"]
+            for s in tail
+        ):
+            return {
+                "lag_s": sample.get("acl_replication_lag_s"),
+                "threshold_s": p["threshold_s"],
+                "failures": sample.get("acl_replication_failures"),
+                "region": sample.get("region"),
             }
         return None
 

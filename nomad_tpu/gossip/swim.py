@@ -33,6 +33,8 @@ from typing import Callable, Optional
 
 import msgpack
 
+from ..testing import faults as _faults
+
 logger = logging.getLogger("nomad_tpu.gossip")
 
 ALIVE = "alive"
@@ -211,6 +213,16 @@ class Gossip:
             return [m for m in self.members.values() if m.status == ALIVE]
 
     # ------------------------------------------------------------------
+    def _region_of_addr(self, addr: tuple[str, int]) -> Optional[str]:
+        """Region tag of the member at ``addr`` (None when unknown) —
+        the fault plane's WAN rules are keyed by region, not address."""
+        addr = (addr[0], int(addr[1]))
+        with self._lock:
+            for m in self.members.values():
+                if m.addr == addr:
+                    return m.tags.get("region", "global")
+        return None
+
     def _view(self) -> list[dict]:
         with self._lock:
             return self._view_locked()
@@ -219,6 +231,20 @@ class Gossip:
         return [m.to_wire() for m in self.members.values()]
 
     def _send(self, addr: tuple[str, int], msg: dict):
+        # inter-region fault seam (testing/faults.py region scope): a
+        # region partition drops the WAN datagrams here, so cross-region
+        # members go suspect -> dead through the normal SWIM detector —
+        # exactly the observable shape of a real network partition.
+        # Addresses whose member (and therefore region) is unknown are
+        # never dropped: a first join must be able to reach its seed.
+        if _faults.ACTIVE is not None:
+            dst_region = self._region_of_addr(addr)
+            if dst_region is not None:
+                act = _faults.ACTIVE.on_region(
+                    self._me.tags.get("region", "global"), dst_region, "gossip"
+                )
+                if act in ("drop", "sever"):
+                    return
         msg["from"] = self.name
         data = msgpack.packb(msg, use_bin_type=True)
         if self.keyring is not None:
@@ -331,10 +357,16 @@ class Gossip:
                 except Exception:
                     continue
                 if incoming.name == self.name:
-                    # refutation: someone thinks we're suspect/dead — bump
-                    # incarnation so our alive record dominates
+                    # refutation: someone holds a non-alive record of us —
+                    # bump incarnation so our alive record dominates. LEFT
+                    # must refute too (ref serf aliveNode): a restarted
+                    # process rejoins at incarnation 0 while the cluster
+                    # holds its own leave tombstone at N+1 — without the
+                    # bump the rejoiner is permanently invisible, which
+                    # under a rolling region restart splits the voter map
+                    # and erases the region from every forwarding table
                     if (
-                        incoming.status in (SUSPECT, DEAD)
+                        incoming.status in (SUSPECT, DEAD, LEFT)
                         and incoming.incarnation >= self._me.incarnation
                         and self._me.status != LEFT
                     ):

@@ -25,6 +25,13 @@ def main(argv=None) -> int:
         "scenario (env knobs FANOUT_SUBS / FANOUT_TOPICS / STORM_S; "
         "see scripts/fanout.sh)",
     )
+    parser.add_argument(
+        "--federation", action="store_true",
+        help="run the multi-region federated storm (partition, "
+        "failover, rolling restart as scored chaos phases; env knobs "
+        "FED_PROFILE / FED_REGIONS / FED_SERVERS / FED_NODES / "
+        "FED_CHURN_S / FED_CROSS_P; see scripts/federation.sh)",
+    )
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument(
         "--duration", type=float, default=None,
@@ -72,6 +79,17 @@ def main(argv=None) -> int:
         )
         print(json.dumps(report["slo"], indent=1))
         print(fanout_summary(report))
+        return 0 if report["slo"]["failed"] == 0 else 1
+
+    if args.federation:
+        from .federation import run_federation_from_env
+        from .federation import summary_line as fed_summary
+
+        report = run_federation_from_env(
+            args.seed, out=args.out, time_scale=args.time_scale
+        )
+        print(json.dumps(report["slo"], indent=1))
+        print(fed_summary(report))
         return 0 if report["slo"]["failed"] == 0 else 1
 
     scenario = get_scenario(args.scenario)

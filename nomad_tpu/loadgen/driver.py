@@ -23,7 +23,15 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from .grammar import OpStream, World, build_job, build_node, job_id_for, node_id_for
+from .grammar import (
+    JOB_PREFIX,
+    OpStream,
+    World,
+    build_job,
+    build_node,
+    job_id_for,
+    node_id_for,
+)
 
 logger = logging.getLogger("nomad_tpu.loadgen.driver")
 
@@ -103,10 +111,18 @@ class StormDriver:
         time_scale: float = 1.0,
         datacenters: tuple = ("dc1", "dc2"),
         node_resources: dict | None = None,
+        token: str = "",
+        job_prefix: str = JOB_PREFIX,
     ):
         self.stream = stream
         self.rpc_servers = list(rpc_servers)
         self.http_address = http_address
+        #: ACL secret the HTTP ops carry (federated storms run with ACLs
+        #: enabled so replication has something to replicate)
+        self.token = token
+        #: job-id namespace; federated storms scope it per region so the
+        #: cross-region oracle can tell the regions' jobs apart
+        self.job_prefix = job_prefix
         self.workers = workers
         self.max_backlog = max_backlog
         self.time_scale = time_scale
@@ -209,7 +225,7 @@ class StormDriver:
         setup_err = ""
         try:
             proxy = ServerProxy(self.rpc_servers, max_retries=3)
-            http = ApiClient(address=self.http_address)
+            http = ApiClient(address=self.http_address, token=self.token)
         except Exception as e:  # noqa: BLE001
             setup_err = f"worker setup failed: {type(e).__name__}: {e}"
             logger.error(setup_err)
@@ -292,7 +308,9 @@ class StormDriver:
                 node_id_for(a["node"]), False, mark_eligible=True
             )
         elif kind in ("job.submit", "job.dispatch_register"):
-            proxy.job_register(build_job(a, self.datacenters))
+            proxy.job_register(
+                build_job(a, self.datacenters, self.job_prefix)
+            )
         elif kind in ("job.scale", "job.update"):
             # post-apply snapshot: for scale, count is already the op's
             # target; for update, version is already the op's nonce
@@ -307,23 +325,30 @@ class StormDriver:
                 "memory_mb": payload["memory_mb"],
                 "version": payload["version"],
             }
-            proxy.job_register(build_job(args, self.datacenters))
+            proxy.job_register(
+                build_job(args, self.datacenters, self.job_prefix)
+            )
         elif kind == "job.stop":
             proxy.job_deregister(
-                "default", job_id_for(a["slot"], payload["category"]),
+                "default",
+                job_id_for(a["slot"], payload["category"], self.job_prefix),
                 purge=a.get("purge", False),
             )
         elif kind == "job.dispatch":
             for wave in range(a.get("fanout", 1)):
                 http.job_dispatch(
-                    job_id_for(a["slot"], "dsp"), meta={"wave": str(wave)}
+                    job_id_for(a["slot"], "dsp", self.job_prefix),
+                    meta={"wave": str(wave)},
                 )
         elif kind == "job.evaluate":
             if payload is None:
                 raise KeyError(f"job not found: slot {a['slot']}")
             http.put(
-                f"/v1/job/{job_id_for(payload['slot'], payload['category'])}"
-                "/evaluate"
+                "/v1/job/"
+                + job_id_for(
+                    payload["slot"], payload["category"], self.job_prefix
+                )
+                + "/evaluate"
             )
         elif kind == "system.gc":
             http.system_gc()

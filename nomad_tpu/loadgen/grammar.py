@@ -387,8 +387,12 @@ JOB_PREFIX = "ldg"
 NODE_PREFIX = "ldgnode"
 
 
-def job_id_for(slot: int, category: str) -> str:
-    return f"{JOB_PREFIX}-{category}-{slot:05d}"
+def job_id_for(slot: int, category: str, prefix: str = JOB_PREFIX) -> str:
+    """``prefix`` scopes the id space: federated storms run one grammar
+    per region against separate raft domains, and the cross-region
+    oracle (job present in exactly its home region) is only meaningful
+    when region A's slot 3 and region B's slot 3 are different jobs."""
+    return f"{prefix}-{category}-{slot:05d}"
 
 
 def node_id_for(slot: int) -> str:
@@ -422,7 +426,8 @@ def build_node(slot: int, datacenters: tuple = ("dc1", "dc2"), resources: Option
     return node
 
 
-def build_job(args: dict, datacenters: tuple = ("dc1", "dc2")):
+def build_job(args: dict, datacenters: tuple = ("dc1", "dc2"),
+              prefix: str = JOB_PREFIX):
     """Job object for submit/update args. Everything that varies is drawn
     at compile time and carried in ``args`` — rebuilding from the same
     args yields an equivalent job (ids, counts, resources, version
@@ -432,7 +437,7 @@ def build_job(args: dict, datacenters: tuple = ("dc1", "dc2")):
 
     category = args["category"]
     job = mock.batch_job() if args.get("type") == "batch" else mock.job()
-    job.id = job_id_for(args["slot"], category)
+    job.id = job_id_for(args["slot"], category, prefix)
     job.name = job.id
     job.datacenters = list(datacenters)
     tg = job.task_groups[0]

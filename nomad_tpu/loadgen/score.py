@@ -348,6 +348,19 @@ def grade(report: dict, slos: dict) -> dict:
     - ``max_fanout_gaps`` — explicit lost-gap markers observed
     - ``max_fanout_slow_closes`` — slow-consumer closes
 
+    Federated storm reports (loadgen/federation.py) likewise:
+
+    - ``max_fed_invariant_violations`` — per-region + cross-region
+      (always 0)
+    - ``max_fed_lost_placements`` / ``max_fed_double_placements`` —
+      oracle-checked cross-region submits that vanished or landed in
+      two raft domains (always 0)
+    - ``max_fed_heal_s`` — worst partition heal time
+    - ``max_fed_fwd_err_rate`` — cross-region forwarding failures
+      outside declared chaos windows / forwards attempted
+    - ``max_fed_replication_lag_p99_s`` — ACL replication convergence
+      lag p99
+
     Returns {checks: {name: {target, actual, pass}}, passed, failed,
     score} where score is the passed fraction (0..1).
     """
@@ -370,6 +383,12 @@ def grade(report: dict, slos: dict) -> dict:
         ("max_fanout_silent_gaps", "fanout_silent_gaps"),
         ("max_fanout_gaps", "fanout_gaps"),
         ("max_fanout_slow_closes", "fanout_slow_closes"),
+        ("max_fed_invariant_violations", "fed_invariant_violations"),
+        ("max_fed_lost_placements", "fed_lost_placements"),
+        ("max_fed_double_placements", "fed_double_placements"),
+        ("max_fed_heal_s", "fed_heal_s"),
+        ("max_fed_fwd_err_rate", "fed_fwd_err_rate"),
+        ("max_fed_replication_lag_p99_s", "fed_replication_lag_p99_s"),
     ):
         if report_key in report:
             actuals[slo_key] = report[report_key]

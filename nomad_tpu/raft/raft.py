@@ -120,6 +120,7 @@ class Raft:
         self.leader_id: Optional[str] = None
         self.commit_index = 0
         self.last_applied = 0
+        self.term_start_index = 0
         self.last_snapshot_index = 0
         self.last_snapshot_term = 0
         self._last_contact = time.monotonic()
@@ -433,6 +434,11 @@ class Raft:
             # commit a noop to establish leadership over prior-term entries
             noop = LogEntry(index=last_index + 1, term=term, etype=NOOP, data=None)
             self.log.store_entries([noop])
+            #: index of this term's noop: once APPLIED, the FSM provably
+            #: covers every entry committed by prior leaders (the
+            #: server-level establishment barrier rides it instead of
+            #: proposing a second entry)
+            self.term_start_index = noop.index
         self._start_replicators(epoch)
         self._maybe_advance_commit()
         if self.on_leadership is not None:

@@ -48,6 +48,13 @@ class ConnPool:
     CIRCUIT_THRESHOLD = 3
     #: seconds a tripped address fails fast before a probe dial is allowed
     CIRCUIT_COOLDOWN = 5.0
+    #: total attempts a call may spend chasing a moving leader: a
+    #: not_leader WITH a hint hops to the hinted address; one WITHOUT a
+    #: hint means an election is in flight — back off and re-ask the same
+    #: server, which answers with the new leader once a quorum knows it
+    LEADER_RETRIES = 6
+    LEADER_BACKOFF_BASE = 0.02
+    LEADER_BACKOFF_MAX = 0.25
 
     def __init__(self, timeout: float = 10.0, tls_context=None, name: str = "",
                  circuit_threshold: Optional[int] = None,
@@ -210,10 +217,16 @@ class ConnPool:
         retry_leader: bool = True,
         retry_stale: bool = True,
     ):
-        """One RPC. On a not_leader error with a leader hint, retries once
-        against the leader (follower→leader forwarding). A dead cached
-        session retries once on a fresh one — but ONLY when the open
-        failed to send, so the server cannot have executed the call.
+        """One RPC. On a not_leader error the call chases the leader for
+        up to ``LEADER_RETRIES`` attempts with exponential backoff: a
+        hinted error hops straight to the hinted address; a hint-less one
+        (election in flight — the old leader just died) backs off and
+        re-asks, so losing the remote leader mid-call converges on the
+        re-elected leader instead of surfacing a transient error to the
+        submitter. Retrying not_leader is always safe: it is an explicit
+        handler answer, so the write was refused, not applied. A dead
+        cached session retries once on a fresh one — but ONLY when the
+        open failed to send, so the server cannot have executed the call.
         Failures after the request was flushed — including a timeout,
         where the handler may still be running — are never retried:
         re-sending would duplicate a non-idempotent write."""
@@ -239,6 +252,48 @@ class ConnPool:
     def _call_inner(
         self, addr, method, payload, timeout, retry_leader, retry_stale
     ):
+        attempts = self.LEADER_RETRIES if retry_leader else 1
+        origin = addr
+        last_err = None
+        for attempt in range(attempts):
+            if attempt:
+                # backoff before the next hop: a hint that points at a
+                # just-severed peer (or a hint-less mid-election answer)
+                # otherwise hot-loops through the circuit breaker
+                time.sleep(
+                    min(
+                        self.LEADER_BACKOFF_BASE * (2 ** (attempt - 1)),
+                        self.LEADER_BACKOFF_MAX,
+                    )
+                )
+            try:
+                return self._call_once(addr, method, payload, timeout,
+                                       retry_stale)
+            except RpcError as err:
+                if attempts == 1:
+                    raise
+                if err.code == "not_leader":
+                    last_err = err
+                    metrics.incr("rpc.not_leader_retry")
+                    if err.leader_rpc_addr:
+                        addr = err.leader_rpc_addr
+                    # no hint: election in flight — re-ask the same
+                    # address, which answers with the new leader once a
+                    # quorum knows it
+                    continue
+                if addr != origin and err.code in ("connect", "circuit_open"):
+                    # the HINTED leader is unreachable — likely the very
+                    # server whose death caused the election. Both codes
+                    # are raised strictly before the request is sent, so
+                    # falling back to the origin cannot double-apply
+                    last_err = err
+                    metrics.incr("rpc.leader_hop_unreachable")
+                    addr = origin
+                    continue
+                raise
+        raise last_err
+
+    def _call_once(self, addr, method, payload, timeout, retry_stale):
         from .mux import StreamClosed, StreamError
 
         duplicate = self._inject(addr, method)
@@ -259,21 +314,7 @@ class ConnPool:
             return result
         except StreamError as e:
             stream.close()
-            err = self._rpc_error(e.error)
-            if (
-                err.code == "not_leader"
-                and retry_leader
-                and err.leader_rpc_addr
-            ):
-                # brief backoff before the leader hop: a hint that points
-                # at a just-severed peer otherwise hot-loops through the
-                # circuit breaker
-                time.sleep(0.02)
-                return self.call(
-                    err.leader_rpc_addr, method, payload,
-                    timeout=timeout, retry_leader=False,
-                )
-            raise err
+            raise self._rpc_error(e.error)
         except TimeoutError:
             stream.close()
             raise RpcError("timeout", f"{addr}: {method}: timed out")

@@ -46,6 +46,13 @@ class RpcServer:
         # maps raft node_id -> rpc "host:port" (fed by config/gossip) so
         # NotLeaderError responses can carry a dialable leader address
         self.server_rpc_addrs: dict[str, str] = {}
+        #: live raft voter map accessor (set by ServerAgent.start). The
+        #: boot-time server_rpc_addrs seed goes stale — a restarted
+        #: joiner boots with an EMPTY map, and hint-less not_leader
+        #: answers strand clients on the follower they asked — so hints
+        #: fall back to the replicated voter map, which on TCP agents
+        #: holds dialable addresses (raft rides the RPC listener).
+        self.voters_snapshot = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind_addr, port))
@@ -53,6 +60,15 @@ class RpcServer:
         self.address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
         self._running = False
         self._threads: list[threading.Thread] = []
+        #: accepted connections still being served; stop() closes them.
+        #: Without this a stopped server keeps ANSWERING on connections
+        #: accepted before the stop — the mux read loop never checks
+        #: _running — so a restarted server (same port, new object)
+        #: coexists with a zombie twin that serves clients' CACHED
+        #: sessions from its frozen pre-stop raft view. A real process
+        #: death closes every socket; a simulated restart must too.
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def register_stream(self, method: str, handler: Callable):
         """Register a streaming method (ref structs/streaming_rpc.go): the
@@ -103,6 +119,21 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
+        # hang up every in-flight connection: their reader loops unblock
+        # with EOF and exit, and clients' cached sessions fail their NEXT
+        # open-before-send, which is the one retry ConnPool allows
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept_loop(self):
         while self._running:
@@ -126,6 +157,13 @@ class RpcServer:
                 conn.settimeout(10.0)
                 conn = self.tls_context.wrap_socket(conn, server_side=True)
                 conn.settimeout(None)
+            # registered AFTER the tls wrap (the wrapped object owns the
+            # fd) and re-checked against _running so a conn accepted
+            # during stop() can't slip past the hang-up sweep
+            with self._conns_lock:
+                self._conns.add(conn)
+            if not self._running:
+                return
             proto = conn.recv(1)
             if not proto:
                 return
@@ -147,6 +185,8 @@ class RpcServer:
         except (ConnectionClosed, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -172,9 +212,6 @@ class RpcServer:
                 result = dispatch(method, payload)
                 write_frame(conn, [seq, None, result])
             except NotLeaderError as e:
-                leader_rpc = None
-                if e.leader_id and e.leader_id in self.server_rpc_addrs:
-                    leader_rpc = self.server_rpc_addrs[e.leader_id]
                 write_frame(
                     conn,
                     [
@@ -182,7 +219,7 @@ class RpcServer:
                         {
                             "code": "not_leader",
                             "message": str(e),
-                            "leader_rpc_addr": leader_rpc,
+                            "leader_rpc_addr": self._leader_rpc_addr(e),
                         },
                         None,
                     ],
@@ -250,15 +287,32 @@ class RpcServer:
             except StreamClosed:
                 pass
 
+    def _leader_rpc_addr(self, e) -> "Optional[str]":
+        """Dialable address for a not_leader hint: the boot-time map
+        first, then the live raft voter map (a restarted joiner's boot
+        map is empty; the voter map is replicated state). Addresses
+        that do not parse as host:port — inmem transports' ``raft-*``
+        pseudo-addresses — are withheld: a wrong hint is worse than a
+        hint-less answer, which the client retries in place."""
+        if e.leader_id and e.leader_id in self.server_rpc_addrs:
+            return self.server_rpc_addrs[e.leader_id]
+        addr = None
+        if e.leader_id and self.voters_snapshot is not None:
+            try:
+                addr = self.voters_snapshot().get(e.leader_id)
+            except Exception:
+                addr = None
+        addr = addr or e.leader_addr
+        if addr and ":" in addr and addr.rsplit(":", 1)[1].isdigit():
+            return addr
+        return None
+
     def _error_obj(self, e: Exception) -> dict:
         if isinstance(e, NotLeaderError):
-            leader_rpc = None
-            if e.leader_id and e.leader_id in self.server_rpc_addrs:
-                leader_rpc = self.server_rpc_addrs[e.leader_id]
             return {
                 "code": "not_leader",
                 "message": str(e),
-                "leader_rpc_addr": leader_rpc,
+                "leader_rpc_addr": self._leader_rpc_addr(e),
             }
         if isinstance(e, KeyError):
             return {"code": "not_found", "message": str(e)}

@@ -13,6 +13,19 @@ seams call the module-level gates at well-known points:
   and ``worker.pre_submit`` (kill a scheduler worker mid-eval),
   ``plan.raft_apply`` (fail/partition the leader mid plan-commit batch),
   ``tpu.kernel`` (device error / NaN at kernel dispatch).
+- ``on_region(src_region, dst_region, channel)`` — every INTER-REGION
+  link: gossip datagrams (gossip/swim.py), HTTP region forwarding
+  (api/http.py) and ACL replication (core/server.py). ``src``/``dst``
+  patterns match *region names*, ``method`` matches the channel
+  (``gossip`` | ``http.forward`` | ``acl.replication``), so a full
+  region partition is ONE declarative rule — not N per-connection
+  severs keyed to intra-region transport addresses.
+
+Region-scale helpers: :meth:`FaultPlane.partition_regions` installs the
+(symmetric or asymmetric) sever rules for a region pair and returns
+them; :meth:`FaultPlane.expire_rules` heals by retiring rules in place
+(the rule list order — and therefore the seeded decision sequence of
+every other rule — is untouched, keeping replay deterministic).
 
 Every decision is drawn from one seeded ``random.Random`` under a lock,
 so a deterministic call sequence yields a deterministic fault schedule.
@@ -96,6 +109,34 @@ class FaultPlane:
                 r.trips for r in self.rules if scope is None or r.scope == scope
             )
 
+    def partition_regions(
+        self,
+        a: str,
+        b: str,
+        symmetric: bool = True,
+        channel: str = "*",
+        **kw,
+    ) -> list[FaultRule]:
+        """Sever every inter-region channel from region ``a`` to region
+        ``b`` (and the reverse when ``symmetric``): gossip goes dark, HTTP
+        forwards fail, ACL replication stalls — one declarative rule per
+        direction. Heal with :meth:`expire_rules` on the returned list."""
+        rules = [self.rule("region", "sever", src=a, dst=b, method=channel, **kw)]
+        if symmetric:
+            rules.append(
+                self.rule("region", "sever", src=b, dst=a, method=channel, **kw)
+            )
+        return rules
+
+    def expire_rules(self, rules: list[FaultRule]):
+        """Retire rules in place (heal): each stops tripping by capping
+        ``count`` at its current trip total. Removal would re-index the
+        ordered rule list and perturb the seeded decision sequence of
+        every later rule — expiry keeps replays byte-stable."""
+        with self._lock:
+            for r in rules:
+                r.count = r.trips
+
     # -- decision core -------------------------------------------------
     def _decide(
         self, scope: str, src: str, dst: str, method: str,
@@ -163,6 +204,18 @@ class FaultPlane:
             return None
         return self._fire(rule, point)
 
+    def on_region(
+        self, src_region: str, dst_region: str, channel: str
+    ) -> Optional[str]:
+        """Inter-region link gate. Same-region traffic never matches —
+        region rules model the WAN, not the local fabric."""
+        if src_region == dst_region:
+            return None
+        rule = self._decide("region", src_region, dst_region, channel)
+        if rule is None:
+            return None
+        return self._fire(rule, f"region {src_region}->{dst_region} {channel}")
+
 
 #: the installed plane; production seams read this once per fault point
 ACTIVE: Optional[FaultPlane] = None
@@ -196,3 +249,14 @@ def fault_point(point: str):
     p = ACTIVE
     if p is not None:
         p.on_point(point)
+
+
+def region_link(src_region: str, dst_region: str, channel: str) -> Optional[str]:
+    """Inter-region link gate for production seams: returns the action
+    the seam must apply itself ("drop"/"sever" — both mean the traffic
+    does not cross the WAN), or None. May also sleep (delay) or raise
+    like any other seam."""
+    p = ACTIVE
+    if p is None:
+        return None
+    return p.on_region(src_region or "global", dst_region or "global", channel)

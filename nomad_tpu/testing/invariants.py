@@ -98,6 +98,98 @@ def assert_cluster_invariants(state):
     )
 
 
+def check_federation_invariants(
+    region_states: dict,
+    oracle: Optional[list] = None,
+    acl_authoritative: Optional[str] = None,
+) -> list[str]:
+    """The cross-region oracle for federated chaos runs, called after
+    every region quiesced and partitions healed.
+
+    ``region_states`` maps region name → StateReader (a live store or a
+    snapshot; any server of the region — state is raft-replicated).
+    Checks, on top of a per-region :func:`check_cluster_invariants`
+    sweep (violations prefixed ``[region]``):
+
+    - **job-home uniqueness** (no lost or double-committed placements
+      across regions): for every ``oracle`` entry
+      ``{"namespace", "job_id", "region"}`` — one per cross-region
+      forwarded submit whose op was acknowledged — the job exists in its
+      TARGET region and in no other. A forward that was acked but landed
+      nowhere is a lost submit; one that landed in two raft domains is a
+      double commit (the federation analog of "alloc placed twice").
+      Entries carrying ``may_complete`` (batch jobs, which force-GC may
+      legitimately reap once dead) are exempt from the lost-check only —
+      double-commit always applies, since GC removes but never adds;
+    - **ACL convergence**: with ``acl_authoritative`` set, every other
+      region's policy table (name → rules) and global-token accessor set
+      equals the authoritative region's — replication converged, with
+      no stale extras left behind.
+    """
+    violations: list[str] = []
+    for region, state in sorted(region_states.items()):
+        for v in check_cluster_invariants(state):
+            violations.append(f"[{region}] {v}")
+
+    for entry in oracle or ():
+        ns = entry.get("namespace", "default")
+        job_id = entry["job_id"]
+        home = entry["region"]
+        present = sorted(
+            region
+            for region, state in region_states.items()
+            if state.job_by_id(ns, job_id) is not None
+        )
+        if home not in present and not entry.get("may_complete"):
+            violations.append(
+                f"lost cross-region submit: job {ns}/{job_id} acked for "
+                f"region {home!r} but absent there (present in {present})"
+            )
+        extras = [r for r in present if r != home]
+        if extras:
+            violations.append(
+                f"double-committed cross-region submit: job {ns}/{job_id} "
+                f"homed in {home!r} also present in {extras}"
+            )
+
+    if acl_authoritative is not None and acl_authoritative in region_states:
+        auth_state = region_states[acl_authoritative]
+        auth_policies = {
+            p.name: p.rules for p in auth_state.acl_policies()
+        }
+        auth_globals = {
+            t.accessor_id for t in auth_state.acl_tokens() if t.global_token
+        }
+        for region, state in sorted(region_states.items()):
+            if region == acl_authoritative:
+                continue
+            policies = {p.name: p.rules for p in state.acl_policies()}
+            if policies != auth_policies:
+                missing = sorted(set(auth_policies) - set(policies))
+                extra = sorted(set(policies) - set(auth_policies))
+                drifted = sorted(
+                    n
+                    for n in set(policies) & set(auth_policies)
+                    if policies[n] != auth_policies[n]
+                )
+                violations.append(
+                    f"[{region}] acl policies diverged from "
+                    f"{acl_authoritative!r}: missing={missing} "
+                    f"extra={extra} drifted={drifted}"
+                )
+            globals_ = {
+                t.accessor_id for t in state.acl_tokens() if t.global_token
+            }
+            if globals_ != auth_globals:
+                violations.append(
+                    f"[{region}] global acl tokens diverged from "
+                    f"{acl_authoritative!r}: missing="
+                    f"{sorted(auth_globals - globals_)} "
+                    f"extra={sorted(globals_ - auth_globals)}"
+                )
+    return violations
+
+
 class IncrementalInvariantChecker:
     """The same invariants, cheap enough to run *mid-storm*.
 

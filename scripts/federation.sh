@@ -1,0 +1,39 @@
+#!/usr/bin/env sh
+# Federated storm entry point (nomad_tpu/loadgen/federation.py; README
+# "Federated storm plane" + OBSERVABILITY.md federation section). Runs
+# the full multi-region chaos storm by default — region partition +
+# heal, leader failover mid-storm, asymmetric partial sever, rolling
+# region restart — and writes the scored FED_rNN.json artifact; exit 0
+# = every SLO passed (0 invariant violations, 0 lost/double-committed
+# cross-region placements, bounded heal time / forwarding error rate /
+# replication lag p99).
+#
+#   scripts/federation.sh                       # full storm -> FED_r01.json
+#   FED_PROFILE=smoke scripts/federation.sh     # the tier-1 2-region smoke
+#   FED_SERVERS=3 FED_CHURN_S=180 scripts/federation.sh   # longer storm
+#   scripts/federation.sh --seed 7              # different storm, same SLOs
+#
+# Scale knobs (env): FED_PROFILE (smoke|storm), FED_REGIONS (2..3),
+# FED_SERVERS (per region), FED_NODES (per region), FED_JOB_SLOTS,
+# FED_CHURN_S, FED_CHURN_RATE, FED_CROSS_P (cross-region submit
+# fraction), FED_QUIESCE_S, FED_RESTART_REGION.
+# Determinism: the same --seed compiles byte-identical per-region op
+# streams (stream_digest per region in the artifact).
+set -eu
+
+cd "$(dirname "$0")/.."
+
+out=""
+for arg in "$@"; do
+  case "$arg" in
+    --out|--out=*) out="explicit" ;;
+  esac
+done
+if [ -z "$out" ]; then
+  n=1
+  while [ -e "$(printf 'FED_r%02d.json' "$n")" ]; do n=$((n + 1)); done
+  set -- --out "$(printf 'FED_r%02d.json' "$n")" "$@"
+fi
+
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m nomad_tpu.loadgen --federation "$@"

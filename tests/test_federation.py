@@ -1,0 +1,944 @@
+"""Oracle for the federated storm plane (nomad_tpu/loadgen/federation.py
++ the region-scoped fault seams, forwarding retry semantics, and the
+acl_replication_lag watchdog rule).
+
+Ports the reference's region-forwarding (regions_endpoint.go, rpc.go
+forward()) and ACL-replication (leader.go replicateACLPolicies/Tokens)
+test slices against the NEW plane: cross-region submits must land in
+exactly their home raft domain, replication must converge with bounded
+lag after a WAN partition heals, and losing the remote leader mid-call
+must be retried — not surfaced — to the submitter. The tier-1 smoke is
+a full 2-region storm with a seeded partition + heal, scored by
+check_federation_invariants.
+"""
+
+import json
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.debug.bundle import capture_bundle
+from nomad_tpu.debug.flight import sample_process
+from nomad_tpu.debug.watchdog import Watchdog
+from nomad_tpu.loadgen.federation import (
+    FederatedCluster,
+    FederationConfig,
+    federation_smoke,
+    region_scenario,
+    route_cross_region,
+    run_federation,
+    summary_line,
+)
+from nomad_tpu.loadgen.grammar import compile_stream
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs.model import AclPolicy, AclToken
+from nomad_tpu.testing import faults
+from nomad_tpu.testing.invariants import check_federation_invariants
+
+pytestmark = pytest.mark.chaos
+
+
+def wait_until(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# region-scoped fault rules (testing/faults.py "region" scope)
+# ---------------------------------------------------------------------------
+
+
+class TestRegionFaultRules:
+    def test_partition_is_one_declarative_rule_per_direction(self):
+        """A full region partition is partition_regions(a, b) — not N
+        per-connection severs: every inter-region channel between the
+        pair is severed by the two returned rules."""
+        plane = faults.FaultPlane(seed=3)
+        rules = plane.partition_regions("east", "west")
+        assert len(rules) == 2
+        for channel in ("gossip", "http.forward", "acl.replication"):
+            assert plane.on_region("east", "west", channel) == "sever"
+            assert plane.on_region("west", "east", channel) == "sever"
+        # an uninvolved region pair is untouched
+        assert plane.on_region("east", "north", "gossip") is None
+
+    def test_same_region_traffic_never_matches(self):
+        """Region rules model the WAN: a glob that would match anything
+        still never severs the local fabric."""
+        plane = faults.FaultPlane(seed=3)
+        plane.rule("region", "sever", src="*", dst="*")
+        assert plane.on_region("east", "east", "gossip") is None
+        assert plane.on_region("east", "west", "gossip") == "sever"
+
+    def test_asymmetric_sever_blocks_one_direction(self):
+        plane = faults.FaultPlane(seed=3)
+        rules = plane.partition_regions("east", "west", symmetric=False)
+        assert len(rules) == 1
+        assert plane.on_region("east", "west", "http.forward") == "sever"
+        assert plane.on_region("west", "east", "http.forward") is None
+
+    def test_expire_rules_heals_without_reindexing(self):
+        """Heal retires rules in place: they stop tripping, but the
+        ordered rule list (and therefore the seeded decision sequence of
+        every later rule) is untouched — replay stays byte-stable."""
+        plane = faults.FaultPlane(seed=3)
+        rules = plane.partition_regions("east", "west")
+        before = list(plane.rules)
+        assert plane.on_region("east", "west", "gossip") == "sever"
+        plane.expire_rules(rules)
+        assert plane.on_region("east", "west", "gossip") is None
+        assert plane.on_region("west", "east", "gossip") is None
+        assert plane.rules == before  # same objects, same order
+
+    def test_region_link_gate_is_noop_without_plane(self):
+        faults.uninstall()
+        assert faults.region_link("east", "west", "gossip") is None
+
+
+# ---------------------------------------------------------------------------
+# the cross-region invariant oracle (testing/invariants.py)
+# ---------------------------------------------------------------------------
+
+
+def _store_with_job(job_id: str, index: int = 10) -> StateStore:
+    s = StateStore()
+    job = mock.job()
+    job.id = job_id
+    job.name = job_id
+    s.upsert_job(index, job)
+    return s
+
+
+class TestFederationInvariants:
+    def test_clean_federation_passes(self):
+        states = {"east": _store_with_job("a"), "west": _store_with_job("b")}
+        oracle = [
+            {"namespace": "default", "job_id": "a", "region": "east"},
+            {"namespace": "default", "job_id": "b", "region": "west"},
+        ]
+        assert check_federation_invariants(states, oracle=oracle) == []
+
+    def test_lost_submit_detected(self):
+        """An acked cross-region submit whose job exists in NO region is
+        a lost placement — the federation analog of a dropped write."""
+        states = {"east": StateStore(), "west": StateStore()}
+        oracle = [{"namespace": "default", "job_id": "gone", "region": "west"}]
+        violations = check_federation_invariants(states, oracle=oracle)
+        assert len(violations) == 1
+        assert "lost cross-region submit" in violations[0]
+
+    def test_double_commit_detected(self):
+        """One submit landing in two raft domains is the federation
+        analog of an alloc placed twice."""
+        states = {
+            "east": _store_with_job("dup"),
+            "west": _store_with_job("dup"),
+        }
+        oracle = [{"namespace": "default", "job_id": "dup", "region": "west"}]
+        violations = check_federation_invariants(states, oracle=oracle)
+        assert len(violations) == 1
+        assert "double-committed cross-region submit" in violations[0]
+        assert "east" in violations[0]
+
+    def test_acl_divergence_detected_and_convergence_passes(self):
+        auth = StateStore()
+        west = StateStore()
+        auth.upsert_acl_policies(
+            5, [AclPolicy(name="p1", description="", rules="x")]
+        )
+        violations = check_federation_invariants(
+            {"global": auth, "west": west}, acl_authoritative="global"
+        )
+        assert any(
+            "acl policies diverged" in v and "[west]" in v for v in violations
+        )
+        west.upsert_acl_policies(
+            5, [AclPolicy(name="p1", description="", rules="x")]
+        )
+        assert (
+            check_federation_invariants(
+                {"global": auth, "west": west}, acl_authoritative="global"
+            )
+            == []
+        )
+
+    def test_global_token_divergence_detected(self):
+        auth = StateStore()
+        west = StateStore()
+        auth.upsert_acl_tokens(
+            5,
+            [AclToken(name="t", type="management", global_token=True)],
+        )
+        violations = check_federation_invariants(
+            {"global": auth, "west": west}, acl_authoritative="global"
+        )
+        assert any("global acl tokens diverged" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# ACL replication under a severed WAN (InmemTransport 2-region slice)
+# ---------------------------------------------------------------------------
+
+
+def _make_region_server(name, region, transport, seeds=None, acl=None):
+    from nomad_tpu.core.server import Server
+    from nomad_tpu.raft import RaftConfig
+
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "region": region,
+        "bootstrap": True,
+        "gossip": {"bind": ("127.0.0.1", 0), "join": seeds or []},
+        "acl": acl or {},
+        "raft": {
+            "node_id": name,
+            "address": f"raft-{name}",
+            "transport": transport,
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    s = Server(cfg)
+    s.start(num_workers=0, wait_for_leader=5.0)
+    return s
+
+
+class TestReplicationLagUnderPartition:
+    def test_severed_wan_accrues_lag_then_heals(self, tmp_path):
+        """The replication-lag pipeline end-to-end: a severed
+        region link stalls replicate_acl_once (counted, lag accruing in
+        acl_replication_lag_s and the flight sample), the watchdog's
+        acl_replication_lag rule sees exactly those samples, and after
+        heal the replica converges — check_federation_invariants clean."""
+        from nomad_tpu.api.http import HTTPServer
+        from nomad_tpu.raft import InmemTransport
+
+        faults.uninstall()
+        transport = InmemTransport()
+        auth = _make_region_server(
+            "fedauth-1", "global", transport, acl={"enabled": True}
+        )
+        http_auth = HTTPServer(auth, port=0)
+        http_auth.start()
+        west = None
+        plane = None
+        try:
+            boot = auth.acl_bootstrap()
+            west = _make_region_server(
+                "fedwest-1",
+                "west",
+                transport,
+                seeds=[list(auth.gossip.addr)],
+                acl={
+                    "enabled": True,
+                    "authoritative_region": "global",
+                    "replication_token": boot.secret_id,
+                    "replication_interval": 0.1,
+                },
+            )
+            wait_until(
+                lambda: west.state.acl_token_by_accessor(boot.accessor_id)
+                is not None,
+                msg="bootstrap token replicated",
+            )
+            rounds0 = west.acl_replication_status["rounds"]
+            assert rounds0 > 0
+            # healthy lag is small and the flight sample carries it
+            assert west.acl_replication_lag_s() < 5.0
+            sample = sample_process(west)
+            assert sample["region"] == "west"
+            assert "acl_replication_lag_s" in sample
+            assert "acl_replication_failures" in sample
+            # the authoritative region does not replicate: no lag key
+            assert auth.acl_replication_lag_s() is None
+            assert "acl_replication_lag_s" not in sample_process(auth)
+
+            # -- sever the WAN: replication stalls, visibly ------------
+            plane = faults.install(faults.FaultPlane(seed=11))
+            rules = plane.partition_regions(
+                "west", "global", channel="acl.replication"
+            )
+            failures0 = west.acl_replication_status.get("failures", 0)
+            auth.acl_upsert_policies(
+                [AclPolicy(name="wartime", description="", rules="# sev")]
+            )
+            wait_until(
+                lambda: west.acl_replication_status.get("failures", 0)
+                > failures0,
+                msg="replication rounds failing while severed",
+            )
+            assert west.state.acl_policy_by_name("wartime") is None
+            assert "severed" in west.acl_replication_status["last_error"]
+            # lag anchors at the last pre-sever success and accrues
+            wait_until(
+                lambda: west.acl_replication_lag_s() > 0.2,
+                msg="replication lag accruing",
+            )
+
+            # the auto-capture payload names the stalled region: the
+            # bundle's findings carry per-region replication state
+            manifest = capture_bundle(
+                west, str(tmp_path / "fed-bundle"), profile_seconds=0.05
+            )
+            findings = json.loads(
+                (tmp_path / "fed-bundle" / "findings.json").read_text()
+            )
+            fed = findings["federation"]
+            assert fed["region"] == "west"
+            assert fed["replication"]["failures"] > 0
+            assert fed["replication"]["lag_s"] > 0
+            assert "raft" in fed and "forwarding" in fed
+            assert manifest["reason"] == "manual"
+
+            # -- heal: convergence with bounded lag --------------------
+            plane.expire_rules(rules)
+            wait_until(
+                lambda: west.state.acl_policy_by_name("wartime") is not None,
+                msg="policy replicated after heal",
+            )
+            wait_until(
+                lambda: west.acl_replication_lag_s() < 1.0,
+                msg="lag reset by a successful round",
+            )
+            assert (
+                check_federation_invariants(
+                    {"global": auth.state, "west": west.state},
+                    acl_authoritative="global",
+                )
+                == []
+            )
+        finally:
+            if plane is not None:
+                faults.uninstall()
+            http_auth.stop()
+            if west is not None:
+                west.stop()
+            auth.stop()
+
+
+# ---------------------------------------------------------------------------
+# acl_replication_lag watchdog rule (debug/watchdog.py)
+# ---------------------------------------------------------------------------
+
+
+class _FakeRecorder:
+    def __init__(self, samples):
+        self._samples = samples
+
+    def samples(self, last=None):
+        return self._samples[-last:] if last else list(self._samples)
+
+
+class TestAclReplicationLagWatchdog:
+    def _watchdog(self, samples, **kw):
+        from types import SimpleNamespace
+
+        return Watchdog(
+            SimpleNamespace(config={}), _FakeRecorder(samples), **kw
+        )
+
+    def test_consecutive_breaches_trip(self):
+        samples = [
+            {
+                "t": float(i),
+                "region": "west",
+                "acl_replication_lag_s": 120.0,
+                "acl_replication_failures": 4,
+            }
+            for i in range(3)
+        ]
+        wd = self._watchdog(samples)
+        wd.on_sample(samples[-1])
+        assert wd.trip_count == 1
+        trip = wd.trip_log[0]
+        assert trip["rule"] == "acl_replication_lag"
+        assert trip["detail"]["region"] == "west"
+        assert trip["detail"]["lag_s"] == 120.0
+
+    def test_single_breach_does_not_trip(self):
+        """One bad sample among healthy ones — a successful round reset
+        the lag mid-window — is not an incident."""
+        samples = [
+            {"t": float(i), "acl_replication_lag_s": v}
+            for i, v in enumerate((120.0, 0.4, 120.0))
+        ]
+        wd = self._watchdog(samples)
+        wd.on_sample(samples[-1])
+        assert wd.trip_count == 0
+
+    def test_rule_structurally_silent_off_replicas(self):
+        """Single-region clusters never emit the key, so the rule can
+        never fire there — no config needed to keep it quiet."""
+        samples = [{"t": float(i), "rss_mb": 50.0} for i in range(5)]
+        wd = self._watchdog(samples)
+        wd.on_sample(samples[-1])
+        assert wd.trip_count == 0
+
+    def test_threshold_overridable_via_config(self):
+        samples = [
+            {"t": float(i), "acl_replication_lag_s": 5.0} for i in range(3)
+        ]
+        wd = self._watchdog(
+            samples,
+            config={"acl_replication_lag": {
+                "threshold_s": 2.0, "consecutive": 3,
+            }},
+        )
+        wd.on_sample(samples[-1])
+        assert wd.trip_count == 1
+
+
+# ---------------------------------------------------------------------------
+# forwarding retry semantics: leader dies mid-forward
+# ---------------------------------------------------------------------------
+
+
+class TestForwardingRetrySemantics:
+    def test_cross_region_submit_survives_remote_leader_kill(self):
+        """The satellite regression: a cross-region submit whose target
+        region loses its leader at the exact moment of the forward must
+        converge on the re-elected leader — the submitter sees success,
+        not a transient not-leader error. The kill is a seeded fault
+        rule on the east->west http.forward link (count=1), so it fires
+        exactly when the forwarding hop first consults the WAN."""
+        faults.uninstall()
+        cfg = FederationConfig(
+            regions=2,
+            servers_per_region=3,
+            nodes_per_region=4,
+            n_workers=1,
+        )
+        cluster = FederatedCluster(cfg, seed=42)
+        plane = None
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            # the failover needs a quorum that survives the kill: wait
+            # for all three west servers to join the voter set
+            wait_until(
+                lambda: len(
+                    cluster.leader_of("west").agent.server.raft.voters
+                )
+                == 3,
+                msg="west voters joined",
+            )
+
+            plane = faults.install(faults.FaultPlane(seed=7))
+            killed = []
+
+            def kill_west_leader():
+                leader = cluster.leader_of("west")
+                if leader is not None:
+                    killed.append(leader.name)
+                    cluster.kill(leader)
+
+            plane.rule(
+                "region", "callback", src="east", dst="west",
+                method="http.forward", count=1, callback=kill_west_leader,
+            )
+
+            job = mock.job()
+            job.id = "fed-failover-submit"
+            job.name = job.id
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].resources.networks = []
+            client = ApiClient(
+                address=cluster.http_address("east"),
+                token=cluster.mgmt_token,
+            )
+            result, _ = client.put(
+                "/v1/jobs", body={"Job": job.to_dict()}, region="west"
+            )
+            # the kill actually fired mid-forward, and the submit still
+            # came back acknowledged by the re-elected west leader
+            assert killed, "fault rule never fired"
+            assert result["EvalID"]
+            new_leader = cluster.leader_of("west")
+            assert new_leader is not None
+            assert new_leader.name != killed[0]
+            # exactly one home: west has the job, east does not
+            assert (
+                cluster.anchor("west").agent.server.state.job_by_id(
+                    "default", job.id
+                )
+                is not None
+            )
+            assert (
+                cluster.anchor("east").agent.server.state.job_by_id(
+                    "default", job.id
+                )
+                is None
+            )
+        finally:
+            if plane is not None:
+                faults.uninstall()
+            cluster.stop()
+
+    def test_severed_link_fails_loudly_after_deadline(self, monkeypatch):
+        """A partition that outlives the retry budget surfaces a
+        deadline error naming the severed link — bounded, not hung."""
+        from nomad_tpu.api import http as http_mod
+        from nomad_tpu.api.client import APIError
+
+        monkeypatch.setattr(http_mod, "FORWARD_RETRY_DEADLINE_S", 1.0)
+        faults.uninstall()
+        cfg = federation_smoke()
+        cluster = FederatedCluster(cfg, seed=42)
+        plane = None
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            plane = faults.install(faults.FaultPlane(seed=7))
+            plane.partition_regions("east", "west", channel="http.forward")
+            client = ApiClient(
+                address=cluster.http_address("east"),
+                token=cluster.mgmt_token,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(APIError) as err:
+                client.get("/v1/regions", region="west")
+            elapsed = time.monotonic() - t0
+            assert "severed" in str(err.value)
+            assert elapsed < 10.0  # bounded by FORWARD_RETRY_DEADLINE_S
+        finally:
+            if plane is not None:
+                faults.uninstall()
+            cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-region stream determinism (the replay contract, no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+class TestFederationDeterminism:
+    def _routed(self, region, cfg, seed):
+        others = [r for r in cfg.region_names() if r != region]
+        return route_cross_region(
+            compile_stream(region_scenario(region, cfg), seed),
+            region, others, seed, cfg.cross_region_p,
+        )
+
+    def test_same_seed_same_per_region_digest(self):
+        cfg = federation_smoke()
+        for region in cfg.region_names():
+            assert (
+                self._routed(region, cfg, 5).digest()
+                == self._routed(region, cfg, 5).digest()
+            )
+
+    def test_regions_and_seeds_diverge(self):
+        cfg = federation_smoke()
+        east5 = self._routed("east", cfg, 5)
+        assert east5.digest() != self._routed("west", cfg, 5).digest()
+        assert east5.digest() != self._routed("east", cfg, 6).digest()
+
+    def test_routing_tags_only_submits_and_is_inside_digest(self):
+        cfg = federation_smoke()
+        stream = self._routed("east", cfg, 5)
+        tagged = [op for op in stream.ops if "via_region" in op.args]
+        assert tagged, "cross_region_p=0.3 routed nothing"
+        assert all(op.kind == "job.submit" for op in tagged)
+        assert all(op.args["via_region"] == "west" for op in tagged)
+        # routing is part of the digest: a different routing seed would
+        # change it, so replay replays the SAME cross-region pattern
+        base = compile_stream(region_scenario("east", cfg), 5)
+        assert stream.digest() != base.digest()
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 federated smoke storm
+# ---------------------------------------------------------------------------
+
+
+class TestFederationSmokeStorm:
+    def test_two_region_smoke_partition_heals_clean(self, tmp_path):
+        """The acceptance gate scaled to tier-1: a 2-region storm with
+        cross-region submits and one full partition + heal. Zero
+        invariant violations (per-region and cross-region), zero
+        lost/double-committed oracle submits, a measured heal, and the
+        artifact + FED_SUMMARY contracts."""
+        out = tmp_path / "FED_smoke.json"
+        report = run_federation(
+            federation_smoke(), seed=20260804, out=str(out)
+        )
+        assert report["fed_invariant_violations"] == 0, (
+            report["final_violations"],
+            {r: report["regions"][r]["mid_storm_violations"]
+             for r in report["region_names"]},
+        )
+        assert report["fed_lost_placements"] == 0
+        assert report["fed_double_placements"] == 0
+        assert report["quiesced"]
+        assert report["oracle_checked_submits"] > 0
+        assert report["fed_fwd_attempted"] > 0
+        # the partition demonstrably healed (9999.0 = never healed)
+        kinds = [e["kind"] for e in report["chaos"]]
+        assert "partition" in kinds and "heal" in kinds
+        assert report["fed_heal_s"] < 9999.0
+        # replication probes ran and converged
+        assert report["fed_replication_probes"] > 0
+        # every region carries its own digest + samples in the artifact
+        for region in report["region_names"]:
+            per = report["regions"][region]
+            assert len(per["stream_digest"]) == 64
+            assert per["samples"], f"no flight samples for {region}"
+        line = summary_line(report)
+        assert line.startswith("FED_SUMMARY ")
+        assert "invariant_violations=0" in line
+        assert "lost=0" in line and "double=0" in line
+        # the artifact on disk is strict JSON with the same verdict
+        data = json.loads(out.read_text())
+        assert data["scenario"] == "federation"
+        assert data["fed_invariant_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rolling-restart recovery: the failure classes the full storm surfaced
+# ---------------------------------------------------------------------------
+
+
+class TestStoppedServerHangsUpConnections:
+    def test_restarted_port_serves_new_server_to_cached_sessions(self):
+        """The zombie-twin regression: RpcServer.stop() must hang up
+        connections it already ACCEPTED, not just the listener. A mux
+        session's reader loop never re-checks _running, so without the
+        hang-up a stopped server keeps answering its clients' cached
+        sessions from a frozen raft view while the restarted server —
+        same port, new object — serves only fresh dials: in the
+        federated storm every driver worker was pinned to the dead
+        twin's stale not_leader answers for the rest of the run."""
+        from nomad_tpu.rpc import ConnPool, RpcServer
+
+        old = RpcServer("127.0.0.1", 0)
+        old.register("Test.WhoAmI", lambda payload: {"gen": "old"})
+        old.start()
+        port = int(old.address.rsplit(":", 1)[1])
+        pool = ConnPool()
+        try:
+            assert (
+                pool.call(old.address, "Test.WhoAmI", {})["gen"] == "old"
+            )
+            old.stop()
+            new = RpcServer("127.0.0.1", port)
+            new.register("Test.WhoAmI", lambda payload: {"gen": "new"})
+            new.start()
+            try:
+                # the SAME pool (cached session to the old object) must
+                # reach the new server: the old conn is hung up, so the
+                # dead-session open-retry dials the new listener
+                assert (
+                    pool.call(new.address, "Test.WhoAmI", {})["gen"]
+                    == "new"
+                )
+            finally:
+                new.stop()
+        finally:
+            pool.close()
+
+
+class TestLeadershipBarrier:
+    def test_new_leader_fsm_covers_prior_commits_at_establishment(self):
+        """establishLeadership's barrier contract (ref leader.go
+        s.raft.Barrier()): when the server-level leader flag goes up,
+        the new leader's FSM must already cover everything the OLD
+        leader committed — otherwise the planner verifies plans (and
+        _restore_evals re-enqueues evals) against stale state, the
+        'alloc placed twice after failover' class."""
+        cfg = FederationConfig(
+            regions=1, servers_per_region=3, nodes_per_region=4,
+            n_workers=1,
+        )
+        cluster = FederatedCluster(cfg, seed=42)
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            wait_until(
+                lambda: len(
+                    cluster.leader_of("east").agent.server.raft.voters
+                )
+                == 3,
+                msg="east voters joined",
+            )
+            leader = cluster.leader_of("east")
+            job = mock.job()
+            job.id = job.name = "barrier-probe"
+            job.task_groups[0].tasks[0].resources.networks = []
+            leader.agent.server.job_register(job)
+            committed = leader.agent.server.raft.commit_index
+            cluster.kill(leader)
+            assert cluster.wait_region_leader("east")
+
+            def established():
+                fs = cluster.leader_of("east")
+                return fs is not None and fs.agent.server._leader
+
+            wait_until(established, msg="new leader established")
+            srv = cluster.leader_of("east").agent.server
+            # the barrier floor: everything the old leader committed is
+            # applied before any leader subsystem runs
+            assert srv.raft.last_applied >= committed
+            assert srv.state.job_by_id("default", "barrier-probe") is not None
+        finally:
+            cluster.stop()
+
+
+class TestDeadServerGrace:
+    def test_stale_dead_record_for_live_member_keeps_voter(self):
+        """The heal-time race: a DEAD record for a member that is in
+        fact alive (the far side's stale verdict arriving just before
+        the refutation) must NOT cost the member its voter seat — the
+        grace recheck sees it alive and keeps it. Instant removal here
+        split the voter map after every partition heal."""
+        cfg = FederationConfig(
+            regions=1, servers_per_region=3, nodes_per_region=4,
+            n_workers=1,
+        )
+        cluster = FederatedCluster(cfg, seed=42)
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            wait_until(
+                lambda: len(
+                    cluster.leader_of("east").agent.server.raft.voters
+                )
+                == 3,
+                msg="east voters joined",
+            )
+            leader = cluster.leader_of("east")
+            srv = leader.agent.server
+            srv.set_autopilot_config({"dead_server_grace_s": 0.4})
+            victim = next(
+                s for s in cluster.live_servers("east")
+                if s.name != leader.name
+            )
+            member = srv.gossip.members[victim.name]
+            assert member.status == "alive"
+            srv._gossip_event("dead", member)
+            # still a voter immediately (no instant removal)...
+            assert victim.name in srv.raft.voters
+            # ...and still a voter after the grace recheck fired,
+            # because the member is demonstrably alive
+            time.sleep(1.2)
+            assert victim.name in srv.raft.voters
+        finally:
+            cluster.stop()
+
+    def test_genuinely_dead_member_removed_after_grace(self):
+        cfg = FederationConfig(
+            regions=1, servers_per_region=3, nodes_per_region=4,
+            n_workers=1,
+        )
+        cluster = FederatedCluster(cfg, seed=42)
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            wait_until(
+                lambda: len(
+                    cluster.leader_of("east").agent.server.raft.voters
+                )
+                == 3,
+                msg="east voters joined",
+            )
+            leader = cluster.leader_of("east")
+            leader.agent.server.set_autopilot_config(
+                {"dead_server_grace_s": 0.4}
+            )
+            victim = next(
+                s for s in cluster.live_servers("east")
+                if s.name != leader.name
+            )
+            cluster.kill(victim)  # crash: no leave broadcast
+            # SWIM detects the death, the grace recheck confirms it, and
+            # the voter record goes away — dead servers still get pruned
+            wait_until(
+                lambda: victim.name
+                not in cluster.leader_of("east").agent.server.raft.voters,
+                timeout=30.0,
+                msg="dead voter pruned",
+            )
+        finally:
+            cluster.stop()
+
+
+class TestFollowerTokenResolution:
+    def test_follower_miss_defers_to_leader_and_leader_is_authoritative(self):
+        """A token miss on a follower is NOT authoritative — a freshly
+        restarted server serves HTTP before its FSM catches up, and a
+        replica's table may lag a replication round. The follower
+        raises NotLeaderError (the forwarding layers retry at the
+        leader); only the leader's miss 403s. End-to-end: a write to
+        the follower's HTTP surface whose local table is stale must
+        succeed via the leader, not bounce 403."""
+        from nomad_tpu.raft import NotLeaderError
+
+        cfg = FederationConfig(
+            regions=1, servers_per_region=2, nodes_per_region=4,
+            n_workers=1,
+        )
+        cluster = FederatedCluster(cfg, seed=42)
+        try:
+            cluster.start()
+            cluster.wait_ready()
+            wait_until(
+                lambda: len(
+                    cluster.leader_of("east").agent.server.raft.voters
+                )
+                == 2,
+                msg="east voters joined",
+            )
+            leader = cluster.leader_of("east")
+            follower = next(
+                s for s in cluster.live_servers("east")
+                if s.name != leader.name
+            )
+            with pytest.raises(NotLeaderError):
+                follower.agent.server.resolve_token("no-such-secret")
+            with pytest.raises(PermissionError):
+                leader.agent.server.resolve_token("no-such-secret")
+
+            # simulate the catch-up window: the follower's table misses
+            # a token the leader knows
+            fsrv = follower.agent.server
+            real = fsrv.state.acl_token_by_secret
+            fsrv.state.acl_token_by_secret = lambda secret: None
+            fsrv._acl_cache.clear()
+            try:
+                job = mock.job()
+                job.id = job.name = "follower-auth-submit"
+                job.task_groups[0].tasks[0].resources.networks = []
+                client = ApiClient(
+                    address=follower.http.address,
+                    token=cluster.mgmt_token,
+                )
+                result, _ = client.put(
+                    "/v1/jobs", body={"Job": job.to_dict()}
+                )
+                assert result["EvalID"]
+            finally:
+                fsrv.state.acl_token_by_secret = real
+            assert (
+                leader.agent.server.state.job_by_id(
+                    "default", "follower-auth-submit"
+                )
+                is not None
+            )
+        finally:
+            cluster.stop()
+
+
+class TestChaosExecutorWindows:
+    class _StubCluster:
+        def rejoin_gossip(self, a, b):
+            pass
+
+        def probe_forward(self, a, b):
+            return True
+
+    def _executor(self, chaos):
+        from nomad_tpu.loadgen.federation import (
+            ChaosExecutor,
+            FederationConfig,
+        )
+
+        cfg = FederationConfig(chaos=chaos)
+        plane = faults.FaultPlane(seed=3)
+        ex = ChaosExecutor(self._StubCluster(), plane, cfg, churn_start=0.0)
+        ex._t0 = time.monotonic()
+        return ex, plane
+
+    def test_equal_offset_events_sort_without_comparing_args(self):
+        # tuple-fallthrough sorting would TypeError comparing the args
+        # dicts of two same-kind events at the same offset
+        ex, _ = self._executor(
+            [
+                (0.4, "leader_kill", {"region": "west"}),
+                (0.4, "leader_kill", {"region": "north"}),
+            ]
+        )
+        assert len(ex.events) == 2
+
+    def test_overlapping_severs_all_heal_with_own_windows(self):
+        """Two links severed before one heal: BOTH sets of rules must
+        retire at the heal (an overwrite leaked the first pair's sever
+        past quiesce) and each pair's window keeps its own open time."""
+        ex, plane = self._executor([])
+        ex._do_partition({"a": "east", "b": "west"})
+        time.sleep(0.05)
+        ex._do_partial_sever({"a": "east", "b": "north"})
+        assert plane.on_region("east", "west", "http.forward") == "sever"
+        assert plane.on_region("east", "north", "http.forward") == "sever"
+        ex._do_heal({})
+        assert plane.on_region("east", "west", "http.forward") is None
+        assert plane.on_region("east", "north", "http.forward") is None
+        assert {tuple(sorted(p)) for _, _, p in ex.windows} == {
+            ("east", "west"),
+            ("east", "north"),
+        }
+        t_open = {
+            tuple(sorted(p)): t0 for t0, _, p in ex.windows
+        }
+        assert t_open[("east", "west")] < t_open[("east", "north")]
+
+    def test_resevering_same_link_keeps_original_open_time(self):
+        ex, plane = self._executor([])
+        ex._do_partition({"a": "east", "b": "west"})
+        time.sleep(0.05)
+        ex._do_partial_sever({"a": "east", "b": "west"})
+        # superseded rules retired, replacement active
+        assert plane.on_region("east", "west", "http.forward") == "sever"
+        ex._do_heal({})
+        assert plane.on_region("east", "west", "http.forward") is None
+        assert len(ex.windows) == 1
+        t_open, t_close, _ = ex.windows[0]
+        # the window spans from the FIRST sever (the link was dark the
+        # whole time), not from the re-sever
+        assert t_close - t_open >= 0.05
+
+
+class TestForwardRetrySafety:
+    def test_only_explicit_refusals_and_presend_failures_retry(self):
+        """The forward loops may re-fire a request ONLY when the prior
+        attempt provably did not execute: an explicit handler refusal
+        (not_leader / no-path / severed-link) or a dial that never
+        connected. Ambiguous failures — timeouts, resets, an inner hop
+        reporting an unknown outcome — must surface, or a retried
+        dispatch mints a second child job."""
+        import urllib.error
+
+        from nomad_tpu.api.http import (
+            _pre_send_failure,
+            _transient_forward_error,
+        )
+
+        assert _transient_forward_error("node is not the leader (...)")
+        assert _transient_forward_error("no path to region 'west'")
+        assert _transient_forward_error("region link east->west severed")
+        assert _transient_forward_error(
+            "500: leader forward failed after 3 attempts: no route"
+        )
+        # ambiguous outcomes are NOT transient
+        assert not _transient_forward_error("request timed out")
+        assert not _transient_forward_error(
+            "leader forward outcome unknown: timeout"
+        )
+        assert not _transient_forward_error(
+            "region forward to 'west' outcome unknown: reset"
+        )
+
+        refused = urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+        assert _pre_send_failure(refused)
+        assert _pre_send_failure(ConnectionRefusedError(111, "refused"))
+        assert not _pre_send_failure(urllib.error.URLError(TimeoutError()))
+        assert not _pre_send_failure(TimeoutError())
+        assert not _pre_send_failure(ConnectionResetError())

@@ -248,7 +248,11 @@ class TestNodeGCPort:
         try:
             node = self._down_node(s)
             run_gc(s, CORE_JOB_NODE_GC)
-            deadline = time.monotonic() + 5
+            # generous margin: the GC eval needs a scheduler worker
+            # slot, which the full tier-1 suite can starve well past
+            # the idle-box norm — the assertion is THAT the node is
+            # reaped, not how fast
+            deadline = time.monotonic() + 20
             while time.monotonic() < deadline and s.state.node_by_id(node.id):
                 time.sleep(0.02)
             assert s.state.node_by_id(node.id) is None
