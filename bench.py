@@ -642,7 +642,7 @@ def bench_config3(n_allocs=10000, n_nodes=1000):
 
 
 def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2,
-                profile=False):
+                profile=False, pipeline=None):
     """Evals/sec through the REAL server path: jobs registered against a
     running server with default_scheduler=tpu-batch and batch_drain workers,
     evals fused into multi-eval kernel batches by the broker drain
@@ -670,6 +670,10 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2,
         # fold whole drain waves into one consensus round (the knob the
         # plan.apply_batch_size histogram in /v1/metrics is tuned against)
         "plan_apply_batch": drain,
+        # applier pipeline + broker ready-queue sharding (the applier
+        # ladder passes {"max_inflight", "ready_shards"} here; None =
+        # server defaults, i.e. pipelined applier, unsharded broker)
+        **({"plan_pipeline": pipeline} if pipeline else {}),
         "raft": {
             "node_id": "s0",
             "address": "raft0",
@@ -685,11 +689,13 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2,
     server = Server(cfg)
     server.start(num_workers=workers, wait_for_leader=5.0)
     depth_samples: list[int] = []
+    overlay_samples: list[int] = []
     stop_sampler = threading.Event()
 
     def sampler():
         while not stop_sampler.wait(0.05):
             depth_samples.append(server.planner.queue.depth())
+            overlay_samples.append(server.planner.overlay_depth())
 
     profiler = None
     try:
@@ -774,6 +780,9 @@ def bench_drain(n_jobs=500, n_nodes=1000, drain=32, workers=2,
             "plan_queue_depth_mean": round(
                 sum(depth_samples) / max(len(depth_samples), 1), 2
             ),
+            # how deep the applier's commit pipeline actually ran
+            # (verified-but-uncommitted batches; core/plan_apply.py)
+            "overlay_depth_max": max(overlay_samples, default=0),
             "stages": stages,
             # incremental columnar mirror accounting (tpu/mirror.py): how
             # many drain batches were served by O(delta) patches vs full
@@ -1001,6 +1010,64 @@ def bench_profile_ab(base_run=None, n_jobs=200, n_nodes=500, workers=4):
         "applier_block_frac": prof.get("applier_block_frac"),
         "top_worker_blocked_site": (
             worker_sites[0]["site"] if worker_sites else None
+        ),
+    }
+
+
+#: the applier ladder's worker tiers (ROADMAP item 1 acceptance shape)
+APPLIER_TIERS = (1, 2, 4, 8)
+
+
+def bench_applier():
+    """The applier-knee section (ROADMAP item 1): worker-scaling ladder
+    over the drain config with the FULL pipeline on — overlapped commits
+    (max_inflight=2), device dense verify, and 8-way sharded broker
+    ready-queues — reporting evals/s, plan.queue_wait p99 and (top tier)
+    applier_block_frac per tier. ``cpu_count`` rides the artifact: on a
+    1-core box the ladder measures contention removal, not parallel
+    speedup (PERF.md caveat), so absolute targets are only meaningful on
+    a multi-core box."""
+    pipeline = {"max_inflight": 2, "ready_shards": 8}
+    tiers = []
+    for w in APPLIER_TIERS:
+        run = bench_drain(
+            n_jobs=200, n_nodes=500, workers=w,
+            profile=(w == APPLIER_TIERS[-1]), pipeline=pipeline,
+        )
+        stages = run.get("stages") or {}
+        queue_wait = stages.get("plan.queue_wait", {})
+        prof = run.get("profile") or {}
+        tiers.append({
+            "workers": w,
+            "evals_per_s": run.get("evals_per_s"),
+            "wall_s": run.get("wall_s"),
+            "plan_queue_wait_p99_ms": queue_wait.get("p99_ms", 0.0),
+            "plan_queue_depth_max": run.get("plan_queue_depth_max"),
+            "overlay_depth_max": run.get("overlay_depth_max"),
+            "applier_block_frac": prof.get("applier_block_frac"),
+            "trace_bottleneck": (run.get("critical_path") or {}).get(
+                "bottleneck"
+            ),
+        })
+    top = tiers[-1]
+    return {
+        # the 1-core-box caveat, recorded IN the artifact (not just docs)
+        "cpu_count": os.cpu_count(),
+        "pipeline": pipeline,
+        "tiers": tiers,
+        "applier_evals_s": top["evals_per_s"],
+        "applier_queue_wait_p99_ms": top["plan_queue_wait_p99_ms"],
+        "applier_block_frac": top["applier_block_frac"],
+        "applier_bottleneck": top["trace_bottleneck"],
+        # ONE formatter for the per-tier summary token, derived from
+        # APPLIER_TIERS — BENCH_SUMMARY and scripts/applier.sh both
+        # print this verbatim so the label can never drift from the
+        # ladder actually run
+        "applier_workers_line": (
+            "applier_workers="
+            + "/".join(str(t.get("evals_per_s")) for t in tiers)
+            + "evals/s@"
+            + ",".join(str(w) for w in APPLIER_TIERS)
         ),
     }
 
@@ -1362,6 +1429,10 @@ def main():
         detail["profile_ab"] = bench_profile_ab(
             base_run=detail["worker_scaling"][-1]
         )
+        # the applier-knee ladder (ROADMAP item 1): 1/2/4/8 workers with
+        # the pipelined applier + sharded ready-queues
+        if os.environ.get("BENCH_APPLIER", "1") != "0":
+            detail["applier"] = bench_applier()
     e2e = headline["end_to_end_s"]
     parities = [headline["parity_exact_full"], headline["parity_oracle"]]
     detail["parity"] = round(min(parities), 5)
@@ -1480,10 +1551,24 @@ def main():
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
         pab = detail["profile_ab"]
         parts.append(f"profile_overhead_pct={pab['overhead_pct']}")
-        parts.append(f"applier_block_frac={pab['applier_block_frac']}")
+        if "applier" not in detail:
+            # the applier ladder's 8-worker tier owns this key when it
+            # ran (one key, one source — no ambiguous duplicates)
+            parts.append(f"applier_block_frac={pab['applier_block_frac']}")
         parts.append(
             f"profile_block_site={pab['top_worker_blocked_site']}"
         )
+        if "applier" in detail:
+            ap = detail["applier"]
+            parts.append(f"applier_evals_s={ap['applier_evals_s']}")
+            parts.append(
+                "applier_queue_wait_p99_ms="
+                f"{ap['applier_queue_wait_p99_ms']}"
+            )
+            parts.append(f"applier_block_frac={ap['applier_block_frac']}")
+            parts.append(f"applier_bottleneck={ap['applier_bottleneck']}")
+            parts.append(f"applier_cores={ap['cpu_count']}")
+            parts.append(ap["applier_workers_line"])
         # retained by the LAST drain section (ws[-1] = the 4-worker run):
         # its critical path is the worker-scaling verdict from traces
         ws_cp = (ws[-1].get("critical_path") or {}) if ws else {}

@@ -17,7 +17,18 @@ store legitimately do index arithmetic; consumers must not):
 - ``raft-index-cross-store`` — a comparison whose two sides read
   ``latest_index()``/``table_index()`` from *different* receivers:
   indexes from two stores (or a store and a scratch overlay) are not on
-  the same axis.
+  the same axis;
+- ``overlay-unresolved`` — a module reads the plan applier's optimistic
+  in-flight overlay (``X.overlay.<read>`` / an ``overlay``-named
+  receiver) without any handling of the ``commit_timeout_unresolved``
+  outcome. The overlay's epochs are *uncommitted raft entries*: a
+  consumer that credits them but never accounts for an entry whose
+  outcome stays UNKNOWN (ApplyTimeout + failed barrier → the entry may
+  still land) re-opens the PR 6 over-commit class under pipelining.
+  Handling evidence accepted (module granularity): the
+  ``commit_timeout_unresolved`` marker (metric name / identifier), a
+  read of the error's ``raft_index`` floor, or a call to the overlay's
+  ``rollback``.
 """
 
 from __future__ import annotations
@@ -121,6 +132,85 @@ def check_index_arith(project: Project) -> list[Finding]:
                                 "never reach it",
                             )
                         )
+    return findings
+
+
+#: receiver-chain segments that name the applier's in-flight overlay
+_OVERLAY_NAMES = {"overlay", "in_flight_overlay", "_overlay"}
+
+#: overlay attribute reads that consume uncommitted-entry state (depth
+#: alone is observability — sampling how deep the pipeline runs never
+#: credits an uncommitted entry's capacity)
+_OVERLAY_READS = {
+    "deltas", "placed_vec", "replay_onto", "prune", "push", "_epochs",
+}
+
+#: evidence the module handles the unresolved-outcome contract
+_UNRESOLVED_MARKER = "commit_timeout_unresolved"
+
+
+def _overlay_read(node: ast.AST) -> Optional[str]:
+    """``<...>.overlay.<read>`` attribute access, else None."""
+    if not isinstance(node, ast.Attribute) or node.attr not in _OVERLAY_READS:
+        return None
+    recv = node.value
+    if isinstance(recv, ast.Name) and recv.id in _OVERLAY_NAMES:
+        return f"{recv.id}.{node.attr}"
+    if isinstance(recv, ast.Attribute) and recv.attr in _OVERLAY_NAMES:
+        return f"{dotted(recv)}.{node.attr}"
+    return None
+
+
+def _module_handles_unresolved(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _UNRESOLVED_MARKER in node.value
+        ):
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.id if isinstance(node, ast.Name) else node.attr
+            if name == _UNRESOLVED_MARKER or name == "raft_index":
+                return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "rollback"
+        ):
+            return True
+    return False
+
+
+@register(
+    "overlay-unresolved",
+    "module reads the in-flight overlay but never handles the "
+    "commit_timeout_unresolved outcome (the pipelined over-commit class)",
+)
+def check_overlay_unresolved(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if any(mod.relpath.startswith(p) for p in _EXEMPT_PREFIXES):
+            continue
+        reads = []
+        for node in ast.walk(mod.tree):
+            desc = _overlay_read(node)
+            if desc is not None:
+                reads.append((node.lineno, desc))
+        if not reads:
+            continue
+        if _module_handles_unresolved(mod.tree):
+            continue
+        for lineno, desc in reads:
+            findings.append(
+                Finding(
+                    "overlay-unresolved", mod.relpath, lineno,
+                    f"{desc} read without handling the "
+                    f"commit_timeout_unresolved outcome (rollback + "
+                    f"raft_index floor); an unknown-outcome entry may "
+                    "still land",
+                )
+            )
     return findings
 
 

@@ -34,8 +34,19 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     #         flight_interval = 1.0  flight_retain = 512
     #         bundle_dir = "/var/lib/nomad-tpu/debug"
     #         watchdog { bundle_keep = 8   # newest auto-bundles kept
-    #                    plan_queue_wait_p99 { threshold_ms = 2000 } } }
+    #                    plan_queue_wait_p99 { threshold_ms = 500 } } }
     "debug": {},
+    # plan applier pipeline (core/plan_apply.py; OBSERVABILITY.md):
+    # plan_pipeline { max_inflight = 2       # concurrent uncommitted
+    #                                        # raft entries (1 = classic
+    #                                        # join-before-dispatch)
+    #                 device_verify = true   # dense verify on the mirror's
+    #                                        # device-resident planes
+    #                 device_verify_min = 256  # placements below this take
+    #                                          # the host paths outright
+    #                 ready_shards = 1 }     # eval-broker ready-queue
+    #                                        # shards (by job hash)
+    "plan_pipeline": {},
 }
 
 
@@ -114,6 +125,8 @@ def server_config_from_agent(config: dict) -> dict:
         out["enable_debug"] = True
     if config.get("debug"):
         out["debug"] = dict(config["debug"])
+    if config.get("plan_pipeline"):
+        out["plan_pipeline"] = dict(config["plan_pipeline"])
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",

@@ -150,13 +150,63 @@ class _PendingHeap:
         return len(self._heap)
 
 
+class _Shard:
+    """One ready-queue shard: a per-job-hash slice of the broker's whole
+    state machine under its OWN lock. Because routing is by (namespace,
+    job) hash, EVERYTHING keyed to a job — the in-flight eval, the
+    blocked heap behind it, the unack records, nack timers, pause set and
+    requeue-on-ack slot — lives together in one shard, so per-job
+    ordering and the token/nack semantics are shard-local invariants
+    exactly as they were broker-global before."""
+
+    __slots__ = (
+        "lock", "evals", "job_evals", "blocked", "ready", "unack",
+        "paused", "requeue", "time_wait",
+    )
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # eval id -> dequeue attempt count (dedup + delivery limit)
+        self.evals: dict[str, int] = {}
+        # per-job serialization: (ns, job) -> in-flight eval id
+        self.job_evals: dict[tuple[str, str], str] = {}
+        # (ns, job) -> heap of evals blocked behind the in-flight one
+        self.blocked: dict[tuple[str, str], _PendingHeap] = {}
+        # scheduler type -> ready heap
+        self.ready: dict[str, _PendingHeap] = {}
+        # eval id -> (eval, token, nack timer)
+        self.unack: dict[str, tuple[Evaluation, str, _TimerHandle]] = {}
+        # evals whose nack timer is paused (plan in flight); checked by
+        # the timer path under the lock since cancel() can't stop a fired
+        # timer
+        self.paused: set[str] = set()
+        # token -> eval to requeue on ack
+        self.requeue: dict[str, Evaluation] = {}
+        # eval id -> wait timer
+        self.time_wait: dict[str, _TimerHandle] = {}
+
+
 class EvalBroker:
+    """Sharded by job hash (``ready_shards``; ROADMAP item 1c): N workers
+    dequeuing through one lock+condvar convoyed on the broker itself once
+    the applier stopped being the bottleneck — the profiler charged
+    worker idle directly to the dequeue lock. Each shard owns its slice
+    of the state machine under its own lock; dequeue scans shard peeks
+    (one short lock hold apiece, rotated start per caller so workers
+    don't herd) and pops the best-priority candidate. Cross-shard
+    priority is best-effort under contention (the peek and the pop are
+    separate acquisitions); per-job ordering, token guards, nack/requeue
+    and delivery-limit semantics are exact — they are shard-local.
+    ``ready_shards=1`` (the default) degenerates to the classic single
+    critical section."""
+
     def __init__(
         self,
         nack_timeout: float = DEFAULT_NACK_TIMEOUT,
         delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
         initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
         subsequent_nack_delay: float = DEFAULT_SUBSEQUENT_NACK_DELAY,
+        ready_shards: int = 1,
     ):
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
@@ -164,25 +214,20 @@ class EvalBroker:
         self.subsequent_nack_delay = subsequent_nack_delay
 
         self.enabled = False
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        # evals: eval id -> dequeue attempt count (dedup + delivery limit)
-        self._evals: dict[str, int] = {}
-        # per-job serialization: (ns, job) -> in-flight eval id
-        self._job_evals: dict[tuple[str, str], str] = {}
-        # (ns, job) -> heap of evals blocked behind the in-flight one
-        self._blocked: dict[tuple[str, str], _PendingHeap] = {}
-        # scheduler type -> ready heap
-        self._ready: dict[str, _PendingHeap] = {}
-        # eval id -> (eval, token, nack timer)
-        self._unack: dict[str, tuple[Evaluation, str, _TimerHandle]] = {}
-        # evals whose nack timer is paused (plan in flight); checked by the
-        # timer path under the lock since cancel() can't stop a fired timer
-        self._paused: set[str] = set()
-        # token -> eval to requeue on ack
-        self._requeue: dict[str, Evaluation] = {}
-        # eval id -> wait timer
-        self._time_wait: dict[str, _TimerHandle] = {}
+        self._shards = [_Shard() for _ in range(max(1, int(ready_shards)))]
+        # eval id -> owning shard (ack/nack/outstanding know only the id);
+        # tiny critical section, written at first enqueue, dropped at ack
+        self._route: dict[str, _Shard] = {}
+        self._route_lock = threading.Lock()
+        # the sleep side of dequeue: a generation-counted condvar OUTSIDE
+        # the shard locks (lock order: shard.lock -> _wake, never the
+        # reverse — waiters hold no shard lock). The generation closes
+        # the classic lost-wakeup window between an empty scan and the
+        # wait.
+        self._wake = threading.Condition()
+        self._wake_seq = 0
+        # rotated scan start so concurrent dequeuers spread over shards
+        self._rotor = itertools.count()
         # the eval.e2e enqueue→ack tap lives in the trace plane now: the
         # root span opened at first enqueue (tracer.eval_root) is closed
         # at ack (tracer.finish_eval), which emits the eval.e2e timer
@@ -190,40 +235,62 @@ class EvalBroker:
         # soak scorekeeper AND the span tree
 
     # ------------------------------------------------------------------
+    def _shard_for(self, ev: Evaluation) -> _Shard:
+        return self._shards[
+            hash((ev.namespace, ev.job_id)) % len(self._shards)
+        ]
+
+    def _shard_of(self, eval_id: str) -> Optional[_Shard]:
+        with self._route_lock:
+            return self._route.get(eval_id)
+
+    def _notify(self):
+        with self._wake:
+            self._wake_seq += 1
+            self._wake.notify_all()
+
+    # ------------------------------------------------------------------
     def set_enabled(self, enabled: bool):
-        with self._lock:
-            prev = self.enabled
-            self.enabled = enabled
+        prev = self.enabled
+        self.enabled = enabled
         if prev and not enabled:
             self.flush()
+        if enabled:
+            self._notify()
 
     # ------------------------------------------------------------------
     def enqueue(self, ev: Evaluation):
-        with self._lock:
-            self._process_enqueue(ev, "")
+        shard = self._shard_for(ev)
+        with shard.lock:
+            self._process_enqueue(shard, ev, "")
 
     def enqueue_all(self, evals: dict | list):
         """Enqueue many evals; accepts {eval: token} or a list."""
-        with self._lock:
-            if isinstance(evals, dict):
-                for ev, token in evals.items():
-                    self._process_enqueue(ev, token)
-            else:
-                for ev in evals:
-                    self._process_enqueue(ev, "")
+        if isinstance(evals, dict):
+            for ev, token in evals.items():
+                shard = self._shard_for(ev)
+                with shard.lock:
+                    self._process_enqueue(shard, ev, token)
+        else:
+            for ev in evals:
+                shard = self._shard_for(ev)
+                with shard.lock:
+                    self._process_enqueue(shard, ev, "")
 
-    def _process_enqueue(self, ev: Evaluation, token: str):
-        """ref eval_broker.go:212-254"""
+    def _process_enqueue(self, shard: _Shard, ev: Evaluation, token: str):
+        """ref eval_broker.go:212-254; caller holds shard.lock."""
         if not self.enabled:
             return
-        if ev.id in self._evals:
+        if ev.id in shard.evals:
             if token == "":
                 return
-            unack = self._unack.get(ev.id)
+            unack = shard.unack.get(ev.id)
             if unack is not None and unack[1] == token:
-                self._requeue[token] = ev
+                shard.requeue[token] = ev
             return
-        self._evals[ev.id] = 0
+        shard.evals[ev.id] = 0
+        with self._route_lock:
+            self._route[ev.id] = shard
         tracer.eval_root(
             ev.id,
             tags={
@@ -237,32 +304,46 @@ class EvalBroker:
             now = time.time_ns()
             delay = max((ev.wait_until - now) / 1e9, 0.0)
             if delay > 0:
-                self._time_wait[ev.id] = _WHEEL.arm(
+                shard.time_wait[ev.id] = _WHEEL.arm(
                     delay, self._enqueue_waiting, (ev,)
                 )
                 return
 
-        self._enqueue_locked(ev, ev.type)
+        self._enqueue_locked(shard, ev, ev.type)
 
     def _enqueue_waiting(self, ev: Evaluation):
-        with self._lock:
-            self._time_wait.pop(ev.id, None)
-            self._enqueue_locked(ev, ev.type)
+        shard = self._shard_for(ev)
+        with shard.lock:
+            shard.time_wait.pop(ev.id, None)
+            self._enqueue_locked(shard, ev, ev.type)
 
-    def _enqueue_locked(self, ev: Evaluation, queue: str):
-        """ref eval_broker.go:277-327"""
+    def _enqueue_locked(self, shard: _Shard, ev: Evaluation, queue: str):
+        """ref eval_broker.go:277-327; caller holds shard.lock."""
         if not self.enabled:
             return
+        # (re-)register the route AND the dedup-registry entry on EVERY
+        # entry into the ready/blocked structures, not just first
+        # enqueue: a wait-timer callback that lost the flush race (timer
+        # fired, blocked on the shard lock while flush dropped all
+        # state, broker re-enabled) would otherwise insert an eval that
+        # (a) no ack/nack can resolve — wedging its (ns, job) slot — and
+        # (b) escapes dedup, so a legitimate restore-path re-enqueue
+        # pushes a SECOND ready copy and two workers race the same eval.
+        # Both writes are idempotent: the shard is a pure function of
+        # (ns, job) and setdefault preserves a live dequeue count.
+        with self._route_lock:
+            self._route[ev.id] = shard
+        shard.evals.setdefault(ev.id, 0)
         key = (ev.namespace, ev.job_id)
-        pending_eval = self._job_evals.get(key, "")
+        pending_eval = shard.job_evals.get(key, "")
         if pending_eval == "":
-            self._job_evals[key] = ev.id
+            shard.job_evals[key] = ev.id
         elif pending_eval != ev.id:
-            self._blocked.setdefault(key, _PendingHeap()).push(ev)
+            shard.blocked.setdefault(key, _PendingHeap()).push(ev)
             return
 
-        self._ready.setdefault(queue, _PendingHeap()).push(ev)
-        self._cond.notify_all()
+        shard.ready.setdefault(queue, _PendingHeap()).push(ev)
+        self._notify()
 
     # ------------------------------------------------------------------
     def dequeue(
@@ -271,17 +352,23 @@ class EvalBroker:
         """Blocking dequeue for the given scheduler types; returns
         (eval, token) or (None, "") on timeout (ref eval_broker.go:329-460)."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
-            while True:
-                ev, token = self._scan(schedulers)
-                if ev is not None:
-                    return ev, token
-                remaining = (
-                    None if deadline is None else deadline - time.monotonic()
-                )
-                if remaining is not None and remaining <= 0:
-                    return None, ""
-                self._cond.wait(remaining if remaining is not None else 1.0)
+        offset = next(self._rotor)
+        while True:
+            with self._wake:
+                seq = self._wake_seq
+            ev, token = self._scan_shards(schedulers, offset)
+            if ev is not None:
+                return ev, token
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                return None, ""
+            with self._wake:
+                if self._wake_seq == seq:
+                    self._wake.wait(
+                        remaining if remaining is not None else 1.0
+                    )
 
     def dequeue_batch(
         self, schedulers: list[str], max_evals: int, timeout: Optional[float] = None
@@ -294,21 +381,53 @@ class EvalBroker:
         if ev is None:
             return out
         out.append((ev, token))
-        with self._cond:
-            while len(out) < max_evals:
-                ev, token = self._scan(schedulers)
-                if ev is None:
-                    break
-                out.append((ev, token))
+        offset = next(self._rotor)
+        while len(out) < max_evals:
+            ev, token = self._scan_shards(schedulers, offset)
+            if ev is None:
+                break
+            out.append((ev, token))
         return out
 
-    def _scan(self, schedulers: list[str]) -> tuple[Optional[Evaluation], str]:
-        """Pick the highest-priority eval across eligible queues; must hold
-        the lock."""
+    def _scan_shards(
+        self, schedulers: list[str], offset: int
+    ) -> tuple[Optional[Evaluation], str]:
+        """One non-blocking pass: peek every shard (short per-shard lock
+        holds, rotated start), then pop from the best-priority shard. A
+        concurrent dequeuer may win the pop race — rescan until a pass
+        finds the broker empty."""
+        n = len(self._shards)
+        while True:
+            best_shard = None
+            best_prio = None
+            for i in range(n):
+                shard = self._shards[(offset + i) % n]
+                with shard.lock:
+                    for sched in schedulers:
+                        heap_ = shard.ready.get(sched)
+                        if not heap_ or not len(heap_):
+                            continue
+                        candidate = heap_.peek()
+                        if best_prio is None or candidate.priority > best_prio:
+                            best_prio = candidate.priority
+                            best_shard = shard
+            if best_shard is None:
+                return None, ""
+            with best_shard.lock:
+                ev, token = self._scan(best_shard, schedulers)
+            if ev is not None:
+                return ev, token
+            # raced: the peeked eval was taken; rescan
+
+    def _scan(
+        self, shard: _Shard, schedulers: list[str]
+    ) -> tuple[Optional[Evaluation], str]:
+        """Pick the highest-priority eval across the shard's eligible
+        queues; caller holds shard.lock."""
         best: Optional[Evaluation] = None
         best_queue = ""
         for sched in schedulers:
-            heap_ = self._ready.get(sched)
+            heap_ = shard.ready.get(sched)
             if not heap_ or not len(heap_):
                 continue
             candidate = heap_.peek()
@@ -317,14 +436,14 @@ class EvalBroker:
                 best_queue = sched
         if best is None:
             return None, ""
-        ev = self._ready[best_queue].pop()
+        ev = shard.ready[best_queue].pop()
         token = generate_uuid()
-        self._evals[ev.id] = self._evals.get(ev.id, 0) + 1
+        shard.evals[ev.id] = shard.evals.get(ev.id, 0) + 1
         # ready-queue wait becomes a span on first delivery (the stage
         # between submit and a worker picking the eval up)
         tracer.eval_dequeued(ev.id)
 
-        self._unack[ev.id] = (
+        shard.unack[ev.id] = (
             ev, token, _WHEEL.arm(self.nack_timeout, self._nack_timeout, (ev.id, token))
         )
         return ev, token
@@ -337,8 +456,11 @@ class EvalBroker:
 
     # ------------------------------------------------------------------
     def outstanding(self, eval_id: str) -> tuple[str, bool]:
-        with self._lock:
-            unack = self._unack.get(eval_id)
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            return "", False
+        with shard.lock:
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 return "", False
             return unack[1], True
@@ -347,15 +469,18 @@ class EvalBroker:
         """Restart the nack timer — the worker's lease extension while it
         is still making progress (ref eval_broker.go OutstandingReset,
         called from the worker's WaitForIndex heartbeat)."""
-        with self._lock:
-            unack = self._unack.get(eval_id)
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            raise BrokerError("evaluation is not outstanding")
+        with shard.lock:
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 raise BrokerError("evaluation is not outstanding")
             ev, utoken, timer = unack
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
             timer.cancel()
-            self._unack[eval_id] = (
+            shard.unack[eval_id] = (
                 ev, token,
                 _WHEEL.arm(self.nack_timeout, self._nack_timeout, (eval_id, token)),
             )
@@ -366,14 +491,17 @@ class EvalBroker:
         worker (its eval nacked and re-dequeued elsewhere) fails here and
         its plan never reaches the queue (ref eval_broker.go:656-672,
         plan_endpoint.go:30-35)."""
-        with self._lock:
-            unack = self._unack.get(eval_id)
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            raise BrokerError("evaluation is not outstanding")
+        with shard.lock:
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 raise BrokerError("evaluation is not outstanding")
             _, utoken, timer = unack
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
-            self._paused.add(eval_id)
+            shard.paused.add(eval_id)
             timer.cancel()
 
     def resume_nack_timeout(self, eval_id: str, token: str):
@@ -382,33 +510,41 @@ class EvalBroker:
         set removal: a stale holder's resume must not strip the CURRENT
         holder's pause (a lock-blocked timer callback would then slip past
         the paused guard and nack a live plan)."""
-        with self._lock:
-            unack = self._unack.get(eval_id)
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            raise BrokerError("evaluation is not outstanding")
+        with shard.lock:
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 raise BrokerError("evaluation is not outstanding")
             ev, utoken, _ = unack
             if utoken != token:
                 raise BrokerError("evaluation token does not match")
-            self._paused.discard(eval_id)
-            self._unack[eval_id] = (
+            shard.paused.discard(eval_id)
+            shard.unack[eval_id] = (
                 ev, token,
                 _WHEEL.arm(self.nack_timeout, self._nack_timeout, (eval_id, token)),
             )
 
     def ack(self, eval_id: str, token: str):
         """ref eval_broker.go:531-592"""
-        with self._lock:
-            requeued = self._requeue.pop(token, None)
-            unack = self._unack.get(eval_id)
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            raise BrokerError("Evaluation ID not found")
+        with shard.lock:
+            requeued = shard.requeue.pop(token, None)
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 raise BrokerError("Evaluation ID not found")
             ev, utoken, timer = unack
             if utoken != token:
                 raise BrokerError("Token does not match for Evaluation ID")
             timer.cancel()
-            del self._unack[eval_id]
-            self._evals.pop(eval_id, None)
-            self._paused.discard(eval_id)
+            del shard.unack[eval_id]
+            shard.evals.pop(eval_id, None)
+            shard.paused.discard(eval_id)
+            with self._route_lock:
+                self._route.pop(eval_id, None)
             # detach the root HERE, before a requeued copy of this eval
             # re-enqueues below — its fresh lifecycle must mint a fresh
             # root, not inherit (and then lose) this one. The finish —
@@ -416,18 +552,19 @@ class EvalBroker:
             finished_root = tracer.detach_eval(eval_id)
 
             key = (ev.namespace, ev.job_id)
-            self._job_evals.pop(key, None)
+            shard.job_evals.pop(key, None)
 
-            blocked = self._blocked.get(key)
+            blocked = shard.blocked.get(key)
             if blocked is not None and len(blocked):
                 nxt = blocked.pop()
                 if not len(blocked):
-                    del self._blocked[key]
-                self._enqueue_locked(nxt, nxt.type)
+                    del shard.blocked[key]
+                self._enqueue_locked(shard, nxt, nxt.type)
 
             if requeued is not None:
-                self._process_enqueue(requeued, "")
-            self._cond.notify_all()
+                # same (ns, job) — the requeued eval routes to THIS shard
+                self._process_enqueue(shard, requeued, "")
+        self._notify()
         # close the detached root OUTSIDE the broker lock: finishing a
         # trace does retention bookkeeping (ring/heap maintenance) that
         # has no business inside the scheduler's central serialization
@@ -439,20 +576,23 @@ class EvalBroker:
         path, which must yield to a concurrent pause: Timer.cancel() can't
         stop a callback already blocked on this lock, so the paused-set
         check (atomic under the same lock as pause) is the real guard."""
-        with self._lock:
-            if from_timer and eval_id in self._paused:
+        shard = self._shard_of(eval_id)
+        if shard is None:
+            raise BrokerError("Evaluation ID not found")
+        with shard.lock:
+            if from_timer and eval_id in shard.paused:
                 return
-            self._requeue.pop(token, None)
-            unack = self._unack.get(eval_id)
+            shard.requeue.pop(token, None)
+            unack = shard.unack.get(eval_id)
             if unack is None:
                 raise BrokerError("Evaluation ID not found")
             ev, utoken, timer = unack
             if utoken != token:
                 raise BrokerError("Token does not match for Evaluation ID")
             timer.cancel()
-            del self._unack[eval_id]
+            del shard.unack[eval_id]
 
-            dequeues = self._evals.get(eval_id, 0)
+            dequeues = shard.evals.get(eval_id, 0)
             # marker on the eval's trace: the retry is visible in the
             # tree (a severed worker shows as nack → re-dequeue, one
             # connected trace, not two)
@@ -461,16 +601,16 @@ class EvalBroker:
                 tags={"from_timer": from_timer, "dequeues": dequeues},
             )
             if dequeues >= self.delivery_limit:
-                self._enqueue_locked(ev, FAILED_QUEUE)
+                self._enqueue_locked(shard, ev, FAILED_QUEUE)
             else:
                 delay = self._nack_reenqueue_delay(dequeues)
                 if delay > 0:
-                    self._time_wait[ev.id] = _WHEEL.arm(
+                    shard.time_wait[ev.id] = _WHEEL.arm(
                         delay, self._enqueue_waiting, (ev,)
                     )
                 else:
-                    self._enqueue_locked(ev, ev.type)
-            self._cond.notify_all()
+                    self._enqueue_locked(shard, ev, ev.type)
+        self._notify()
 
     def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
         """ref eval_broker.go:644-655"""
@@ -482,32 +622,53 @@ class EvalBroker:
 
     # ------------------------------------------------------------------
     def flush(self):
-        """Cancel timers and drop all state (ref eval_broker.go:692-749)."""
-        with self._lock:
-            for _, _, timer in self._unack.values():
-                timer.cancel()
-            for timer in self._time_wait.values():
-                timer.cancel()
-            for eval_id in self._evals:
-                # leadership revoked: this process stops observing these
-                # evals; abandon their open roots instead of leaking them
-                tracer.discard_eval(eval_id)
-            self._evals.clear()
-            self._job_evals.clear()
-            self._blocked.clear()
-            self._ready.clear()
-            self._unack.clear()
-            self._requeue.clear()
-            self._paused.clear()
-            self._time_wait.clear()
-            self._cond.notify_all()
+        """Cancel timers and drop all state (ref eval_broker.go:692-749).
+        ``enabled`` is already False when this runs off set_enabled, so an
+        enqueue racing a shard's clear either observes the flag or loses
+        the shard lock to us and is cleared."""
+        for shard in self._shards:
+            with shard.lock:
+                for _, _, timer in shard.unack.values():
+                    timer.cancel()
+                for timer in shard.time_wait.values():
+                    timer.cancel()
+                for eval_id in shard.evals:
+                    # leadership revoked: this process stops observing
+                    # these evals; abandon their open roots instead of
+                    # leaking them
+                    tracer.discard_eval(eval_id)
+                with self._route_lock:
+                    for eval_id in shard.evals:
+                        self._route.pop(eval_id, None)
+                shard.evals.clear()
+                shard.job_evals.clear()
+                shard.blocked.clear()
+                shard.ready.clear()
+                shard.unack.clear()
+                shard.requeue.clear()
+                shard.paused.clear()
+                shard.time_wait.clear()
+        self._notify()
 
     def stats(self) -> dict:
-        with self._lock:
-            return {
-                "total_ready": sum(len(h) for h in self._ready.values()),
-                "total_unacked": len(self._unack),
-                "total_blocked": sum(len(h) for h in self._blocked.values()),
-                "total_waiting": len(self._time_wait),
-                "by_scheduler": {k: len(h) for k, h in self._ready.items()},
-            }
+        total_ready = 0
+        total_unacked = 0
+        total_blocked = 0
+        total_waiting = 0
+        by_scheduler: dict[str, int] = {}
+        for shard in self._shards:
+            with shard.lock:
+                total_ready += sum(len(h) for h in shard.ready.values())
+                total_unacked += len(shard.unack)
+                total_blocked += sum(len(h) for h in shard.blocked.values())
+                total_waiting += len(shard.time_wait)
+                for k, h in shard.ready.items():
+                    by_scheduler[k] = by_scheduler.get(k, 0) + len(h)
+        return {
+            "total_ready": total_ready,
+            "total_unacked": total_unacked,
+            "total_blocked": total_blocked,
+            "total_waiting": total_waiting,
+            "by_scheduler": by_scheduler,
+            "ready_shards": len(self._shards),
+        }

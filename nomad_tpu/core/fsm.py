@@ -666,7 +666,7 @@ def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
     if stored is None:
         # already deleted: whatever it contributed is gone with it
         return dict(fallback or {}, id=alloc_id, _terminal=True)
-    from ..tpu.mirror import usage_vec
+    from ..tpu.mirror import exotic_flag, usage_vec
 
     return {
         "id": stored.id,
@@ -680,6 +680,10 @@ def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
         "deployment_id": stored.deployment_id,
         "_terminal": stored.terminal_status(),
         "_usage": usage_vec(stored),
+        # ports/devices flag: lets the mirror keep per-row exotic counts
+        # so the plan applier's dense device verify knows which rows must
+        # take the exact host check (core/plan_apply.py)
+        "_exotic": exotic_flag(stored),
     }
 
 
@@ -707,6 +711,10 @@ def _alloc_event(index: int, doc: dict, event_type: str) -> "Event":
         payload["Terminal"] = bool(doc["_terminal"])
         if doc.get("_usage") is not None:
             payload["Resources"] = list(doc["_usage"])
+        # missing (GC-fallback doc) reads as True downstream — the mirror
+        # defaults unknown allocs to exotic, degrading verify not parity
+        if "_exotic" in doc:
+            payload["Exotic"] = bool(doc["_exotic"])
     return Event(
         topic=TOPIC_ALLOC,
         type=event_type,

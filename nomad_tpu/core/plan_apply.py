@@ -184,14 +184,13 @@ def _alloc_triple(alloc) -> tuple[int, int, int]:
 
 def _alloc_exotic(alloc) -> bool:
     """Whether the alloc carries ports/bandwidth or devices — dimensions the
-    dense verify doesn't model, forcing the exact per-node check."""
-    resources = alloc.allocated_resources
-    if resources.shared.networks:
-        return True
-    for tr in resources.tasks.values():
-        if tr.networks or tr.devices:
-            return True
-    return False
+    dense verify doesn't model, forcing the exact per-node check. Delegates
+    to the mirror plane's single definition (tpu/mirror.py exotic_flag) so
+    the host dense path, the device verify, and the mirror's per-row
+    exotic counts can never disagree."""
+    from ..tpu.mirror import exotic_flag
+
+    return exotic_flag(alloc)
 
 
 def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dict[str, tuple[bool, str]]:
@@ -274,33 +273,30 @@ def _dense_node_fit(snap: StateSnapshot, plan: Plan, node_ids: list[str]) -> dic
     return verdicts
 
 
-def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
-    """Determine the committable subset of a plan
-    (ref plan_apply.go:399-560)."""
+def _plan_node_ids(plan: Plan) -> list[str]:
+    return list(dict.fromkeys(
+        list(plan.node_update.keys()) + list(plan.node_allocation.keys())
+    ))
+
+
+def _assemble_result(plan: Plan, node_ids: list[str], fit_fn,
+                     refresh_index: int) -> PlanResult:
+    """Build the committable subset from per-node fit verdicts — THE
+    shared tail of the host and device verify paths (ref
+    plan_apply.go:399-560). One implementation so the two oracles can
+    never drift on assembly semantics (all_at_once, preempt-only
+    pass-through, canary correction)."""
     result = PlanResult(
         deployment=plan.deployment.copy() if plan.deployment else None,
         deployment_updates=plan.deployment_updates,
     )
-
-    node_ids = list(dict.fromkeys(
-        list(plan.node_update.keys()) + list(plan.node_allocation.keys())
-    ))
-
-    total_placements = sum(len(v) for v in plan.node_allocation.values())
-    dense = None
-    if total_placements >= DENSE_VERIFY_THRESHOLD:
-        dense = _dense_node_fit(snap, plan, node_ids)
-
     partial_commit = False
     for node_id in node_ids:
-        if dense is not None:
-            fit, reason = dense[node_id]
-        else:
-            fit, reason = evaluate_node_plan(snap, plan, node_id)
+        fit, _reason = fit_fn(node_id)
         if not fit:
             partial_commit = True
             if plan.all_at_once:
-                return PlanResult(refresh_index=snap.latest_index())
+                return PlanResult(refresh_index=refresh_index)
             continue
         if plan.node_update.get(node_id):
             result.node_update[node_id] = plan.node_update[node_id]
@@ -315,9 +311,27 @@ def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
             result.node_preemptions[node_id] = preempted
 
     if partial_commit:
-        result.refresh_index = snap.latest_index()
+        result.refresh_index = refresh_index
         _correct_deployment_canaries(result)
     return result
+
+
+def evaluate_plan(snap: StateSnapshot, plan: Plan) -> PlanResult:
+    """Determine the committable subset of a plan
+    (ref plan_apply.go:399-560)."""
+    node_ids = _plan_node_ids(plan)
+
+    total_placements = sum(len(v) for v in plan.node_allocation.values())
+    dense = None
+    if total_placements >= DENSE_VERIFY_THRESHOLD:
+        dense = _dense_node_fit(snap, plan, node_ids)
+
+    def fit_for(node_id):
+        if dense is not None:
+            return dense[node_id]
+        return evaluate_node_plan(snap, plan, node_id)
+
+    return _assemble_result(plan, node_ids, fit_for, snap.latest_index())
 
 
 def _correct_deployment_canaries(result: PlanResult):
@@ -332,8 +346,160 @@ def _correct_deployment_canaries(result: PlanResult):
         group.placed_canaries = [c for c in group.placed_canaries if c in placed]
 
 
+#: minimum placements before a plan takes the DEVICE dense verify — below
+#: this the host paths win outright (a jit dispatch costs more than the
+#: whole host check for a handful of rows); shares the spirit (and scale)
+#: of DENSE_VERIFY_THRESHOLD. Tunable via plan_pipeline{device_verify_min}.
+DEVICE_VERIFY_MIN_PLACEMENTS = 256
+
+
+def _usage_vec(alloc) -> tuple:
+    from ..tpu.mirror import usage_vec
+
+    return usage_vec(alloc) or (0, 0, 0, 0)
+
+
+class _OverlayEpoch:
+    """One verified-but-uncommitted batch's contribution to the in-flight
+    overlay: the ADD side of its used-plane deltas, the placed-alloc
+    vectors (so a later plan stopping an uncommitted alloc can cancel the
+    credited add), the adds-only results for host-snapshot replay, and —
+    once the commit thread is harvested — the entry's committed raft
+    index, which is the ONLY prune authority. Content-based pruning
+    ("the placed alloc id is in the snapshot, so the entry applied") is
+    UNSOUND: in-place updates and refresh/nack retries legitimately
+    reuse alloc ids, so an id's presence can come from an EARLIER entry
+    — dropping the epoch then loses its sibling plans' uncommitted adds
+    (observed as real over-commits in the e2e drive)."""
+
+    __slots__ = ("deltas", "placed", "replay", "index")
+
+    def __init__(self):
+        # epoch lifetime is ONE batch (≤ max_apply_batch plans): the
+        # whole object leaves the overlay at prune (entry committed and
+        # visible in the base) or rollback (entry failed/unresolved), so
+        # per-epoch growth is bounded by the batch fold cap
+        #: node_id -> accumulated (cpu, mem, disk, mbits) ADD delta
+        self.deltas: dict[str, list] = {}  # nta: ignore[unbounded-cache] WHY: bounded by one batch's placements; epoch dropped at prune/rollback
+        #: alloc_id -> (node_id, usage vec) for uncommitted placements
+        self.placed: dict[str, tuple] = {}  # nta: ignore[unbounded-cache] WHY: bounded by one batch's placements; epoch dropped at prune/rollback
+        #: [(plan, adds-only PlanResult)] — host verify replays these onto
+        #: its base snapshot (upsert_plan_results consumes only the result
+        #: maps, so a result carrying just node_allocation replays exactly
+        #: the ADD side)
+        self.replay: list = []  # nta: ignore[unbounded-cache] WHY: ≤ max_apply_batch entries; epoch dropped at prune/rollback
+        #: the entry's committed raft index, stamped at harvest; None
+        #: while the commit is still in flight (never prunable)
+        self.index: Optional[int] = None
+
+    def absorb(self, plan: Plan, result: PlanResult):
+        """Record ``result``'s placements. ONLY the add side: an
+        uncommitted batch's REMOVALS are never credited to later batches —
+        a later plan relying on capacity freed by a stop that then fails
+        to commit would over-commit the node (the PR 6 over-commit class,
+        resurrected via pipelining). Within one batch/raft entry stops DO
+        credit (the entry is atomic) — that is the stacked-snapshot /
+        batch-delta accounting in _verify_batch, not this overlay."""
+        if not result.node_allocation:
+            return
+        self.replay.append(
+            (plan, PlanResult(node_allocation=result.node_allocation))
+        )
+        for node_id, allocs in result.node_allocation.items():
+            slot = self.deltas.setdefault(node_id, [0, 0, 0, 0])
+            for a in allocs:
+                vec = _usage_vec(a)
+                for i in range(4):
+                    slot[i] += vec[i]
+                self.placed[a.id] = (node_id, vec)
+
+    def empty(self) -> bool:
+        return not self.replay
+
+
+class InFlightOverlay:
+    """Used-plane ADD deltas of every verified batch whose raft entry has
+    not yet been proven committed (ROADMAP item 1b): the applier verifies
+    new batches against base-snapshot + overlay instead of blocking the
+    loop on each ``raft.apply``.
+
+    Outcome contract (enforced tree-wide by the ``overlay-unresolved``
+    analysis rule): every consumer of this overlay must also handle the
+    ``plan.commit_timeout_unresolved`` outcome — a commit that failed
+    with its entry outcome UNKNOWN (ApplyTimeout + failed barrier) is
+    rolled back here like any failure, but its ``raft_index`` floor must
+    still gate the apply loop's snapshots: the entry may yet land, and
+    only a snapshot at-or-past it can be trusted not to miss it."""
+
+    def __init__(self):
+        self._epochs: list[_OverlayEpoch] = []
+
+    def push(self, epoch: _OverlayEpoch):
+        if not epoch.empty():
+            self._epochs.append(epoch)
+
+    def rollback(self, epoch: _OverlayEpoch) -> bool:
+        """Drop a failed (or unresolved) batch's phantom adds. For the
+        unresolved case the caller ALSO keeps the floor from the raised
+        error's ``raft_index`` — rollback alone is not outcome handling."""
+        try:
+            self._epochs.remove(epoch)
+            return True
+        except ValueError:
+            return False
+
+    def prune(self, snap: StateSnapshot) -> int:
+        """Drop epochs whose HARVESTED commit index ``snap`` provably
+        covers (their adds now live in the base). Un-harvested epochs
+        (index None) are never pruned even if the entry already applied
+        to the store — keeping one is merely conservative (double-counted
+        adds reject, never over-commit) and the window is one loop
+        iteration, while any content-based shortcut is unsound (alloc ids
+        recur across entries via in-place updates and retries)."""
+        before = len(self._epochs)
+        latest = snap.latest_index()
+        self._epochs = [
+            e for e in self._epochs
+            if e.index is None or e.index > latest
+        ]
+        return before - len(self._epochs)
+
+    def depth(self) -> int:
+        return len(self._epochs)
+
+    def deltas(self) -> dict[str, list]:
+        """Merged node_id -> (cpu, mem, disk, mbits) add deltas."""
+        out: dict[str, list] = {}
+        for epoch in self._epochs:
+            for node_id, vec in epoch.deltas.items():
+                slot = out.setdefault(node_id, [0, 0, 0, 0])
+                for i in range(4):
+                    slot[i] += vec[i]
+        return out
+
+    def placed_vec(self, alloc_id: str, node_id: str) -> Optional[tuple]:
+        """Usage vec of an uncommitted placement on ``node_id``, if any."""
+        for epoch in self._epochs:
+            rec = epoch.placed.get(alloc_id)
+            if rec is not None and rec[0] == node_id:
+                return rec[1]
+        return None
+
+    def replay_onto(self, snap: StateSnapshot, stack_fn) -> StateSnapshot:
+        """Host-path base: stack every epoch's adds-only results onto
+        ``snap`` (the same accounting the device path reads numerically)."""
+        for epoch in self._epochs:
+            for plan, adds in epoch.replay:
+                snap = stack_fn(snap, plan, adds)
+        return snap
+
+
 class Planner:
-    """The leader's single plan-apply loop (ref plan_apply.go:71-180)."""
+    """The leader's pipelined plan-apply loop (ref plan_apply.go:71-180;
+    ROADMAP item 1): verify batches against base-snapshot + in-flight
+    overlay while up to ``max_inflight`` prior batches' raft entries are
+    still committing, with the dense verify running against the
+    ColumnarMirror's device-resident planes when a mirror is wired."""
 
     def __init__(self, state: StateStore):
         self.state = state
@@ -367,6 +533,22 @@ class Planner:
         # class constant stays as the default so direct constructions and
         # old call sites keep the historical behavior
         self.max_apply_batch = self.MAX_APPLY_BATCH
+        # pipeline depth: verified batches whose commits may be in flight
+        # simultaneously (plan_pipeline{max_inflight}). 1 = the classic
+        # join-before-dispatch applier; the default overlaps verify(N+1)
+        # with commit(N) without ever joining on the hot path
+        self.max_inflight = self.MAX_INFLIGHT
+        # hook: () -> ColumnarMirror | None (server wiring); enables the
+        # device-resident dense verify for big plans
+        self.mirror_fn = None
+        # device verify enable + size gate (plan_pipeline{device_verify,
+        # device_verify_min})
+        self.device_verify = True
+        self.device_verify_min = DEVICE_VERIFY_MIN_PLACEMENTS
+        #: ADD deltas of uncommitted batches; the verify base rides
+        #: base-snapshot + this (mutated only by the apply loop; depth()
+        #: is sampled cross-thread by the flight recorder)
+        self.overlay = InFlightOverlay()
 
     def start(self):
         self.queue.set_enabled(True)
@@ -390,29 +572,270 @@ class Planner:
     #: the worker-scaling knee without a code change.
     MAX_APPLY_BATCH = 16
 
-    def _verify_batch(self, live, snap):
-        """Verify each plan against the CUMULATIVE optimistic snapshot so
-        later plans in the batch can't double-book capacity earlier ones
-        took. Returns (entries, snap, leftovers, noops): entries =
-        [(pending, result)] to commit, snap = the stacked snapshot,
-        leftovers = plans to requeue if optimistic stacking ever fails
-        mid-batch (verifying them against a snapshot missing an accepted
-        sibling would double-book), and noops = fully-rejected plans whose
-        response must wait for a REAL index (see _respond_refreshed: an
-        optimistic snapshot's latest_index is synthetic — bumped once per
-        stacked plan while a batched commit advances the real store index
-        once per BATCH — so handing it out as a refresh index makes the
-        worker wait for an index the store may reach only much later, or
-        never between writes)."""
+    #: default pipeline depth (concurrent uncommitted raft entries). Safe
+    #: by the overlay's adds-only credit discipline: concurrently-proposed
+    #: entries upsert ABSOLUTE alloc docs, so their log order never
+    #: changes final state, and a batch verified against an in-flight
+    #: sibling's adds is conservative whichever entry lands first.
+    MAX_INFLIGHT = 2
+
+    def _device_ctx(self, base_snap, live):
+        """Per-batch handles for the dense device verify, or None when it
+        can't/shouldn't run (no mirror wired, every plan under the size
+        gate, or the mirror already moved past this snapshot). The
+        context: (mirror, cluster, device arrays, gen, mesh)."""
+        if not self.device_verify or self.mirror_fn is None:
+            return None
+        if not any(
+            sum(len(v) for v in p.plan.node_allocation.values())
+            >= self.device_verify_min
+            for p in live
+        ):
+            return None
+        mirror = self.mirror_fn()
+        if mirror is None:
+            return None
+        try:
+            from ..tpu import shard as _shard
+            from ..tpu.shard import node_bucket
+
+            n_real = len(base_snap.nodes())
+            # the MIN_NODES-gated mesh, exactly as the drain batches
+            # resolve it: both consumers must agree per n_pad or the
+            # mirror's DeviceState cache thrashes full-plane rebuilds
+            mesh = _shard.active_mesh(n_real)
+            handles = mirror.verify_handles(
+                base_snap, node_bucket(n_real, mesh), mesh=mesh
+            )
+        except Exception:
+            metrics.incr("plan.verify_device_degrade.handles")
+            return None
+        if handles is None:
+            metrics.incr("plan.verify_device_degrade.stale")
+            return None
+        cluster, arrays, gen = handles
+        return (mirror, cluster, arrays, gen, mesh)
+
+    def _evaluate_plan_device(
+        self, dev_ctx, base_snap, plan, overlay_deltas, epoch, stacked_fn
+    ):
+        """Dense device verify of one plan against the mirror's
+        device-resident planes + the in-flight overlay (ROADMAP item 1a):
+        a vectorized node-axis fit check shaped exactly like the planner
+        kernel. Parity with the host oracle by construction: the device
+        only ever CONFIRMS fits — rows it cannot model (ports/devices,
+        int32-clip range, unknown allocs) and rows that fail the dense
+        check are answered by the exact host path (``stacked_fn`` hands
+        back the same stacked snapshot the host verify would use).
+        Returns a PlanResult, or None to degrade the whole plan to the
+        host path."""
+        total_placements = sum(
+            len(v) for v in plan.node_allocation.values()
+        )
+        if total_placements < self.device_verify_min:
+            return None
+
+        node_ids = _plan_node_ids(plan)
+        mirror, _cluster, (cap_dev, _usable, used_dev), gen, mesh = dev_ctx
+
+        #: per-node verdicts decided host-side (status checks and hard
+        #: failures); rows absent here ride the kernel or the exact path
+        verdicts: dict[str, tuple] = {}
+        exact_nodes: list[str] = []
+        rows: list[int] = []
+        row_nodes: list[str] = []
+        row_deltas: list = []
+        import numpy as np
+
+        clip = 2**30
+        with mirror.locked_cluster(gen) as cluster:
+            if cluster is None:
+                # a drain batch synced the mirror forward mid-batch: the
+                # device planes no longer match this snapshot
+                metrics.incr("plan.verify_device_degrade.stale")
+                return None
+            for node_id in node_ids:
+                if not plan.node_allocation.get(node_id):
+                    verdicts[node_id] = (True, "")
+                    continue
+                row = cluster.index.get(node_id)
+                if row is None:
+                    # node outside the mirror's axis (not in state):
+                    # degrade — the host path mints the exact reason
+                    metrics.incr("plan.verify_device_degrade.rows")
+                    return None
+                node = cluster.nodes[row]
+                if node.status != NODE_STATUS_READY:
+                    verdicts[node_id] = (
+                        False, "node is not ready for placements"
+                    )
+                    continue
+                if node.scheduling_eligibility == NODE_SCHED_INELIGIBLE:
+                    verdicts[node_id] = (
+                        False, "node is not eligible for draining"
+                    )
+                    continue
+                if cluster.exotic_live[row] > 0:
+                    exact_nodes.append(node_id)
+                    continue
+                # THIS plan's removals credit (stop + place commit in the
+                # same raft entry); sub vectors resolve against base-live
+                # allocs, uncommitted overlay placements, and this
+                # batch's own placements — anything else is already gone
+                # and contributes nothing (matching remove_allocs)
+                removed = {
+                    a.id
+                    for a in (
+                        plan.node_update.get(node_id, [])
+                        + plan.node_preemptions.get(node_id, [])
+                        + plan.node_allocation.get(node_id, [])
+                    )
+                }
+                delta = np.zeros(4, dtype=np.int64)
+                exotic = False
+                for a in plan.node_allocation.get(node_id, []):
+                    if a.allocated_resources is not None and _alloc_exotic(a):
+                        exotic = True
+                        break
+                    delta += np.asarray(_usage_vec(a), dtype=np.int64)
+                if exotic:
+                    exact_nodes.append(node_id)
+                    continue
+                for aid in removed:
+                    rec = cluster._alloc_rec.get(aid)
+                    if rec is not None and rec[0] == node_id:
+                        delta -= np.asarray(rec[1], dtype=np.int64)
+                        continue
+                    vec = None
+                    pr = epoch.placed.get(aid)
+                    if pr is not None and pr[0] == node_id:
+                        vec = pr[1]
+                    elif overlay_deltas is not None:
+                        vec = self.overlay.placed_vec(aid, node_id)
+                    if vec is not None:
+                        delta -= np.asarray(vec, dtype=np.int64)
+                if overlay_deltas:
+                    ov = overlay_deltas.get(node_id)
+                    if ov is not None:
+                        delta += np.asarray(ov, dtype=np.int64)
+                bv = epoch.deltas.get(node_id)
+                if bv is not None:
+                    delta += np.asarray(bv, dtype=np.int64)
+                used_row = cluster.mirror_used[row]
+                if (
+                    used_row.max() >= clip
+                    or used_row.min() < 0
+                    or np.abs(delta).max() >= clip
+                ):
+                    # outside the device planes' int32-clip range: the
+                    # clipped plane could mask a real overflow — exact
+                    exact_nodes.append(node_id)
+                    continue
+                rows.append(row)
+                row_nodes.append(node_id)
+                row_deltas.append(delta)
+
+        if rows:
+            try:
+                from ..tpu.mirror import DeviceState
+                from ..tpu import kernel as _kernel
+
+                k = len(rows)
+                b = DeviceState._row_bucket(k)
+                padded = np.zeros(b, dtype=np.int32)
+                padded[:k] = rows
+                deltas_arr = np.zeros((b, 4), dtype=np.int32)
+                deltas_arr[:k] = np.stack(row_deltas)
+                fits = np.asarray(
+                    _kernel.verify_rows(cap_dev, used_dev, padded, deltas_arr)
+                )[:k]
+            except Exception:
+                # device fault: the planner-kernel degradation contract
+                # (KernelFault class) — whole plan to the host oracle
+                metrics.incr("plan.verify_device_degrade.kernel_fault")
+                return None
+            for node_id, fit in zip(row_nodes, fits):
+                if bool(fit):
+                    verdicts[node_id] = (True, "")
+                else:
+                    # dense failure: the exact host check mints the
+                    # failing reason (and double-checks) — identical to
+                    # the host dense path's failure handling
+                    exact_nodes.append(node_id)
+
+        for node_id in exact_nodes:
+            verdicts[node_id] = evaluate_node_plan(
+                stacked_fn(), plan, node_id
+            )
+
+        # the SAME assembly as the host oracle (shared helper), with
+        # refresh indexes minted from the REAL base snapshot
+        return _assemble_result(
+            plan, node_ids, verdicts.__getitem__, base_snap.latest_index()
+        )
+
+    class _StackFailure(Exception):
+        """_optimistic_snapshot raised while building the host verify
+        base: the remaining plans can't be verified safely this round."""
+
+    def _verify_batch(self, live, base_snap, dev_ctx=None):
+        """Verify each plan against base-snapshot + in-flight overlay +
+        the CUMULATIVE results of this batch, so neither a sibling in this
+        batch nor an uncommitted in-flight batch can be double-booked.
+        Returns (entries, leftovers, noops, epoch): entries = [(pending,
+        result)] to commit in one raft entry, leftovers = plans to
+        requeue when optimistic stacking fails mid-batch (verifying them
+        against a base missing an accepted sibling would double-book),
+        noops = fully-rejected plans whose response must carry a REAL
+        index (see _respond_refreshed — a stacked snapshot's latest_index
+        is synthetic), and epoch = the batch's overlay contribution (the
+        caller pushes it when dispatching the commit)."""
         entries = []
         noops = []
+        epoch = _OverlayEpoch()
+        overlay_deltas = (
+            self.overlay.deltas() if dev_ctx is not None else None
+        )
+        stacked_box: list = [None]
+
+        def stacked_fn():
+            # lazy host verify base: base + overlay adds + accepted
+            # siblings; built once, then kept current by post-accept
+            # stacking below
+            if stacked_box[0] is None:
+                try:
+                    s = self.overlay.replay_onto(
+                        base_snap, self._optimistic_snapshot
+                    )
+                    for p2, r2 in entries:
+                        s = self._optimistic_snapshot(s, p2.plan, r2)
+                except Exception as e:
+                    raise Planner._StackFailure() from e
+                stacked_box[0] = s
+            return stacked_box[0]
+
         for i, p in enumerate(live):
             try:
                 with tracer.span(
                     "plan.evaluate", parent=p.trace_ctx,
                     metric="plan.evaluate",
                 ):
-                    result = evaluate_plan(snap, p.plan)
+                    result = None
+                    if dev_ctx is not None:
+                        with tracer.span(
+                            "plan.verify_device",
+                            metric="plan.verify_device",
+                        ):
+                            result = self._evaluate_plan_device(
+                                dev_ctx, base_snap, p.plan,
+                                overlay_deltas, epoch, stacked_fn,
+                            )
+                    if result is None:
+                        result = evaluate_plan(stacked_fn(), p.plan)
+            except Planner._StackFailure:
+                # can't build a safe verify base mid-flight: requeue this
+                # plan and the rest; the apply loop resynchronizes
+                return entries, live[i:], noops, epoch
             except Exception as e:
                 p.respond(None, e)
                 continue
@@ -420,18 +843,18 @@ class Planner:
                 noops.append((p, result))
                 continue
             entries.append((p, result))
-            try:
-                snap = self._optimistic_snapshot(snap, p.plan, result)
-            except Exception:
-                # entry i IS being committed but the stacked snap is
-                # missing its placements: hand back snap=None so the apply
-                # loop joins the outstanding commit and re-fetches a fresh
-                # post-commit snapshot before verifying anything else —
-                # reusing the partial snap would double-book entry i's
-                # capacity (the pre-batching code forced snap=None on
-                # exactly this failure)
-                return entries, None, live[i + 1:], noops
-        return entries, snap, [], noops
+            epoch.absorb(p.plan, result)
+            if stacked_box[0] is not None:
+                try:
+                    stacked_box[0] = self._optimistic_snapshot(
+                        stacked_box[0], p.plan, result
+                    )
+                except Exception:
+                    # entry i IS being committed but the stacked base is
+                    # missing its placements: requeue the rest — verifying
+                    # them against it would double-book entry i's capacity
+                    return entries, live[i + 1:], noops, epoch
+        return entries, [], noops, epoch
 
     def _commit_resolving(self, commit, trace_ctxs=()):
         """Run a consensus commit, resolving indeterminate timeouts.
@@ -491,33 +914,74 @@ class Planner:
             result.refresh_index = min(result.refresh_index, real)
             p.respond(result, None)
 
+    def _harvest(self, outstanding: list, block: bool = False):
+        """Collect finished commits off the pipeline: fold their committed
+        indexes into ``prev_index`` (returned), fold any unresolved-entry
+        floor, and roll the overlay back for batches whose commit FAILED
+        (their adds were phantoms). A commit that failed with
+        ``plan.commit_timeout_unresolved`` (ApplyTimeout + failed barrier)
+        also rolls back — but its entry may still land, so its
+        ``raft_index`` rides the returned floor and gates every later
+        snapshot. With ``block``, the OLDEST commit is joined first (the
+        pipeline-depth backpressure point)."""
+        prev_index = 0
+        floor = 0
+        if block and outstanding:
+            outstanding[0][0].join()
+        done = [o for o in outstanding if not o[0].is_alive()]
+        for t, box, epoch in done:
+            t.join()
+            outstanding.remove((t, box, epoch))
+            index = box.get("index", 0)
+            if index:
+                prev_index = max(prev_index, index)
+                # stamp the entry's real index: prune drops the epoch
+                # once a base snapshot provably covers it (the ONLY
+                # sound prune authority — see _OverlayEpoch)
+                epoch.index = index
+            else:
+                # failed (or unresolved) commit: the epoch's adds never
+                # materialized — later batches must stop verifying
+                # against them
+                if self.overlay.rollback(epoch):
+                    metrics.incr("plan.overlay_rollback")
+            floor = max(floor, box.get("floor", 0))
+        return prev_index, floor
+
     def _apply_loop(self):
-        """Overlap verify(N+1) with raft-apply(N) (ref plan_apply.go:49-180):
-        after dispatching batch N's commit asynchronously, batch N+1 is
-        verified against an OPTIMISTIC snapshot that already contains N's
-        results — so back-to-back plans can't double-book capacity while
-        the consensus round-trip is in flight. Queued plans that piled up
-        behind the head are folded into ONE raft entry (MAX_APPLY_BATCH),
-        amortizing the fsync + consensus round-trip that otherwise caps
-        the applier at ~1/commit-latency plans per second. The submitting
-        workers are answered only after their commit really lands."""
-        outstanding: Optional[tuple[threading.Thread, dict]] = None
+        """The pipelined applier (ref plan_apply.go:49-180; ROADMAP item
+        1b): queued plans fold into one raft entry (MAX_APPLY_BATCH), the
+        batch verifies against base-snapshot + the in-flight overlay
+        (adds of up to ``max_inflight`` uncommitted batches), and its
+        commit dispatches WITHOUT joining the previous one — the loop
+        never blocks on ``raft.apply`` until the pipeline is full. The
+        submitting workers are still answered only after their commit
+        really lands (_async_commit_batch). Safety: the overlay credits
+        only the ADD side of uncommitted batches (conservative whichever
+        entries land), failed commits roll their epochs back at harvest,
+        and unresolved outcomes floor every later snapshot past the
+        in-flight entry."""
+        outstanding: list = []  # [(thread, box, epoch)], dispatch order
         prev_index = 0
         # snapshots must never be taken below this index: a commit that
         # failed INDETERMINATELY (apply timeout + failed barrier) may still
         # land at its entry index — verifying any batch against state below
         # it risks double-booking the in-flight entry's capacity
         floor = 0
-        snap: Optional[StateSnapshot] = None
-        # the REAL store index the current snap is based on: an optimistic
-        # overlay bumps the snapshot's own index synthetically, which must
-        # not satisfy staleness checks against genuine raft writes (a node
-        # going down at the same numeric index would be missed)
-        snap_base_index = 0
 
         while not self._stop.is_set():
             head = self.queue.dequeue(timeout=0.2)
             if head is None:
+                if outstanding:
+                    hi, hf = self._harvest(outstanding)
+                    prev_index = max(prev_index, hi)
+                    floor = max(floor, hf)
+                if self.overlay.depth():
+                    # idle housekeeping: without this, committed epochs
+                    # (and their Plan/Allocation graphs) outlive the
+                    # burst that created them, and overlay_depth()
+                    # reports in-flight batches on a quiesced server
+                    self.overlay.prune(self.state.snapshot())
                 continue
             batch = [head] + self.queue.drain(self.max_apply_batch - 1)
             now = time.monotonic()
@@ -543,97 +1007,54 @@ class Planner:
             if not live:
                 continue
 
-            # harvest a commit that finished while we were idle
-            if outstanding is not None and not outstanding[0].is_alive():
-                prev_index = max(prev_index, outstanding[1].get("index", 0))
-                floor = max(floor, outstanding[1].get("floor", 0))
-                outstanding = None
-                snap = None
+            # harvest finished commits; block on the oldest only when the
+            # pipeline is at depth (the backpressure that bounds overlay
+            # growth and worker-visible commit latency)
+            hi, hf = self._harvest(outstanding)
+            prev_index = max(prev_index, hi)
+            floor = max(floor, hf)
+            while len(outstanding) >= max(1, self.max_inflight):
+                hi, hf = self._harvest(outstanding, block=True)
+                prev_index = max(prev_index, hi)
+                floor = max(floor, hf)
 
             batch_min = max(p.plan.snapshot_index for p in live)
             min_index = max(prev_index, batch_min, floor)
-            if snap is not None and snap_base_index < min_index:
-                snap = None
-            if snap is None:
-                # a replacement snapshot must contain the in-flight batch's
-                # placements — unrelated writes advancing the store index
-                # would otherwise satisfy min_index with a snapshot that
-                # misses them and double-books their capacity
-                if outstanding is not None:
-                    outstanding[0].join()
-                    prev_index = max(prev_index, outstanding[1].get("index", 0))
-                    floor = max(floor, outstanding[1].get("floor", 0))
-                    outstanding = None
-                    min_index = max(prev_index, batch_min, floor)
-                try:
-                    snap = self.state.snapshot_min_index(min_index, timeout=5.0)
-                    snap_base_index = snap.latest_index()
-                except Exception as e:
-                    for p in live:
-                        p.respond(None, e)
-                    continue
+            try:
+                snap = self.state.snapshot_min_index(min_index, timeout=5.0)
+            except Exception as e:
+                for p in live:
+                    p.respond(None, e)
+                continue
+            # drop overlay epochs the snapshot provably contains: their
+            # adds are in the base now (keeping one is conservative, but
+            # systematically double-counts)
+            t_ov = time.monotonic()
+            pruned = self.overlay.prune(snap)
+            tracer.record_span(
+                "plan.overlay", live[0].trace_ctx, t_ov, time.monotonic(),
+                tags={"depth": self.overlay.depth(), "pruned": pruned,
+                      "inflight": len(outstanding)},
+            )
 
-            entries, snap, leftovers, noops = self._verify_batch(live, snap)
+            dev_ctx = self._device_ctx(snap, live)
+            entries, leftovers, noops, epoch = self._verify_batch(
+                live, snap, dev_ctx
+            )
             if leftovers:
+                # stacking failed mid-batch: requeue and resynchronize —
+                # join the whole pipeline so the next round verifies
+                # against committed reality
                 self.queue.requeue(leftovers)
+                while outstanding:
+                    hi, hf = self._harvest(outstanding, block=True)
+                    prev_index = max(prev_index, hi)
+                    floor = max(floor, hf)
             if not entries:
                 self._respond_refreshed(noops)
                 continue
 
-            # one commit in flight at a time: wait out the previous one and
-            # refresh to a snapshot containing it before dispatching
-            if outstanding is not None:
-                outstanding[0].join()
-                committed = outstanding[1].get("index", 0)
-                prev_index = max(prev_index, committed)
-                floor = max(floor, outstanding[1].get("floor", 0))
-                outstanding = None
-                try:
-                    fresh = self.state.snapshot_min_index(
-                        max(
-                            prev_index,
-                            max(p.plan.snapshot_index for p, _ in entries),
-                            floor,
-                        ),
-                        timeout=5.0,
-                    )
-                except Exception as e:
-                    for p, _ in entries:
-                        p.respond(None, e)
-                    # the rejected siblings need nothing from the commit:
-                    # answer them with their (valid) no-op verdicts at the
-                    # store's real index instead of surfacing the failure
-                    self._respond_refreshed(noops)
-                    continue
-                snap_base_index = fresh.latest_index()
-                if not committed:
-                    # the previous commit FAILED: this batch was verified
-                    # against an optimistic world that never materialized —
-                    # re-verify against reality before committing. The
-                    # noops re-verify too: one may have been judged no-op
-                    # only because a phantom sibling took its capacity.
-                    entries, snap, leftovers, noops = self._verify_batch(
-                        [p for p, _ in entries] + [p for p, _ in noops],
-                        fresh,
-                    )
-                    if leftovers:
-                        self.queue.requeue(leftovers)
-                    if not entries:
-                        self._respond_refreshed(noops)
-                        continue
-                else:
-                    # re-base: the fresh snapshot holds the committed batch
-                    # for real; stack this batch's results back on top for
-                    # the next iteration's verify base
-                    snap = fresh
-                    try:
-                        for p, result in entries:
-                            snap = self._optimistic_snapshot(
-                                snap, p.plan, result
-                            )
-                    except Exception:
-                        snap = None  # fresh snapshot next round
-
+            self.overlay.push(epoch)
             box: dict = {}
             t = threading.Thread(
                 target=self._async_commit_batch,
@@ -642,10 +1063,15 @@ class Planner:
                 name="plan-commit",
             )
             t.start()
-            outstanding = (t, box)
+            outstanding.append((t, box, epoch))
 
-        if outstanding is not None:
-            outstanding[0].join(timeout=2.0)
+        for t, _box, _epoch in outstanding:
+            t.join(timeout=2.0)
+
+    def overlay_depth(self) -> int:
+        """In-flight verified-but-uncommitted batches (the flight
+        recorder's ``overlay_depth`` sample key)."""
+        return self.overlay.depth()
 
     def _optimistic_snapshot(
         self, snap: StateSnapshot, plan: Plan, result: PlanResult

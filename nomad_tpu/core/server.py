@@ -79,11 +79,16 @@ class Server:
 
             _tracer.configure(**trace_cfg)
         self.state = StateStore()
+        # plan_pipeline{} stanza (OBSERVABILITY.md): the applier pipeline
+        # depth, the device dense-verify gate, and the eval broker's
+        # ready-queue shard count all tune the ROADMAP item 1 knee
+        pp_cfg = dict(self.config.get("plan_pipeline") or {})
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.get("nack_timeout", 60.0),
             delivery_limit=self.config.get("delivery_limit", 3),
             initial_nack_delay=self.config.get("initial_nack_delay", 1.0),
             subsequent_nack_delay=self.config.get("subsequent_nack_delay", 20.0),
+            ready_shards=int(pp_cfg.get("ready_shards", 1)),
         )
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.periodic = None  # PeriodicDispatch attaches in agent wiring
@@ -171,6 +176,18 @@ class Server:
             1, int(self.config.get("plan_apply_batch",
                                    self.planner.max_apply_batch))
         )
+        # applier pipeline knobs (plan_pipeline{}): commit-overlap depth
+        # and the device-resident dense verify against the mirror planes
+        self.planner.max_inflight = max(
+            1, int(pp_cfg.get("max_inflight", self.planner.max_inflight))
+        )
+        self.planner.device_verify = bool(pp_cfg.get("device_verify", True))
+        self.planner.device_verify_min = int(
+            pp_cfg.get("device_verify_min", self.planner.device_verify_min)
+        )
+        # late-bound: the mirror is constructed above but may be closed/
+        # absent; the applier degrades to the host oracle either way
+        self.planner.mirror_fn = lambda: self.columnar_mirror
         self.planner.commit_fn = self._commit_plan
         self.planner.commit_batch_fn = self._commit_plan_batch
         self.planner.barrier_fn = self._plan_commit_barrier

@@ -206,10 +206,16 @@ class Worker:
     # ------------------------------------------------------------------
     def submit_plan(self, plan: Plan):
         """Attach the eval token, route through the plan queue, and hand back
-        a fresh snapshot when the applier asks for a refresh."""
+        a fresh snapshot when the applier asks for a refresh. SnapshotIndex
+        is the index this worker actually EVALUATED against (ref worker.go
+        SubmitPlan), not the store head: the pipelined applier floors its
+        verify snapshot at the batch's max SnapshotIndex, and chasing
+        unrelated writes that landed after the scheduler ran only adds
+        commit latency without adding safety (the applier re-verifies
+        against its own, always-newer, base anyway)."""
         _faults.fault_point("worker.pre_submit")
         plan.eval_token = self._eval_token
-        plan.snapshot_index = self.server.state.latest_index()
+        plan.snapshot_index = self._snapshot_index
         with tracer.span("plan.submit", metric="plan.submit"):
             result, error = self.server.plan_submit(plan)
         if error is not None:
@@ -223,6 +229,10 @@ class Worker:
                 new_state = self.server.state.snapshot_min_index(
                     result.refresh_index, timeout=RAFT_SYNC_LIMIT
                 )
+            # the scheduler retries against the refreshed snapshot: later
+            # submits must carry ITS index (worker.go updates its snapshot
+            # watermark on refresh)
+            self._snapshot_index = new_state.latest_index()
         return result, new_state
 
     def update_eval(self, ev: Evaluation):

@@ -87,6 +87,14 @@ def sample_process(server) -> dict:
             if getattr(server, "planner", None) is not None
             else 0
         ),
+        # verified-but-uncommitted batches in the applier's optimistic
+        # overlay (core/plan_apply.py): the debug bundle's view of how
+        # deep the commit pipeline actually runs
+        "overlay_depth": (
+            server.planner.overlay_depth()
+            if getattr(server, "planner", None) is not None
+            else 0
+        ),
         "broker_ready": eval_stats.get("total_ready", 0),
         "broker_unacked": eval_stats.get("total_unacked", 0),
         "evals_processed": sum(

@@ -12,7 +12,13 @@ artifact, not reconstructed from folklore.
 Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
 
 - ``plan_queue_wait_p99`` — the applier saturation signal (ROADMAP
-  item 2): p99 above threshold for N consecutive samples;
+  item 1): p99 above threshold for N consecutive samples. Retuned for
+  the pipelined applier: the pre-pipeline 2000ms default tolerated the
+  serialized applier's normal convoying; with overlapped commits the
+  bench target is p99 <50ms, so 500ms (10x the target) is a real
+  anomaly, not noise. Kept (not retired): the rule still fires exactly
+  when the pipeline saturates — overlay at depth, every worker parked
+  in plan.submit — which is the bundle an operator wants;
 - ``stalled_worker`` — ready evals with zero in-flight work and a flat
   evals-processed counter across N samples: the workers stopped
   consuming (the synthetic-refresh-index bug class, PR 3);
@@ -52,7 +58,7 @@ logger = logging.getLogger("nomad_tpu.debug.watchdog")
 
 #: rule name -> default parameters (override via debug.watchdog.<rule>)
 DEFAULT_RULES = {
-    "plan_queue_wait_p99": {"threshold_ms": 2000.0, "consecutive": 3},
+    "plan_queue_wait_p99": {"threshold_ms": 500.0, "consecutive": 3},
     "stalled_worker": {"consecutive": 8},
     "rss_slope": {
         "threshold_mb_per_min": 512.0,

@@ -934,9 +934,45 @@ def _plan_batch_windowed_jit(
     return placements
 
 
+# ---------------------------------------------------------------------------
+# dense plan verify (the applier's commit-time fit check, core/plan_apply.py)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _verify_rows_jit(capacity, used, rows, deltas):
+    """Node-axis fit check for a plan's touched rows against the mirror's
+    device-resident planes: scatter-add the plan's per-row usage deltas
+    into ``used`` and test every resource column against ``capacity``.
+    Shaped exactly like the planner kernel's feasibility mask (used +
+    demand <= capacity over R_COLS) and like DeviceState's dirty-row
+    scatter: ``rows``/``deltas`` are bucketed, with pad lanes repeating
+    row 0 at delta 0 (`.add` of zero is idempotent, so repeats are safe —
+    REAL rows must be pre-aggregated host-side, one lane per row).
+    Returns the per-lane fit verdict ``fits[rows]`` (pad lanes echo row
+    0's verdict; the caller reads only the real lanes)."""
+    stacked = used.at[rows].add(deltas)
+    fits = jnp.all(stacked <= capacity, axis=1)
+    return fits[rows]
+
+
+def verify_rows(capacity, used, rows, deltas):
+    """Dispatch the dense verify; the ``tpu.kernel`` fault point models
+    device errors exactly as it does for the planner kernels — the
+    applier degrades the whole plan to the host oracle when this
+    raises."""
+    _faults.fault_point("tpu.kernel")
+    return _verify_rows_jit(capacity, used, rows, deltas)
+
+
 #: the jitted planners, by mode name — the one enumeration shared by the
 #: recompile detector above, the warmup prewarm ladder (single-chip AND
-#: mesh-sharded layouts), and the multichip bench's per-planner timings
+#: mesh-sharded layouts), and the multichip bench's per-planner timings.
+#: verify_rows is deliberately NOT here: compile_cache_size() deltas are
+#: diffed across DRAIN dispatch windows on other threads, and an applier
+#: verify compile landing inside one would falsely flag the innocent
+#: drain span [recompile] (warmup.prewarm_drain compiles the verify
+#: shapes instead, so the applier hot path stays cold-compile-free)
 PLANNER_JITS = {
     "exact": _plan_batch_jit,
     "runs": _plan_batch_runs_jit,

@@ -59,6 +59,25 @@ SYNC_WAIT_S = 0.05
 VERIFY_EVERY = int(os.environ.get("NOMAD_TPU_MIRROR_VERIFY_EVERY", "64"))
 
 
+def exotic_flag(alloc) -> bool:
+    """Whether the alloc carries ports/bandwidth networks or devices —
+    dimensions the dense planes can't verify exactly. THE single
+    definition: the FSM stamps it into every Alloc event (``Exotic``),
+    the mirror counts it per node row (``exotic_live``), and the plan
+    applier's host dense path (core/plan_apply.py ``_alloc_exotic``)
+    delegates here, so device verify and host verify can never disagree
+    on which allocs force the exact per-node check."""
+    resources = alloc.allocated_resources
+    if resources is None:
+        return False
+    if resources.shared.networks:
+        return True
+    for tr in resources.tasks.values():
+        if tr.networks or tr.devices:
+            return True
+    return False
+
+
 def usage_vec(alloc) -> Optional[tuple]:
     """The (cpu, memory_mb, disk_mb, mbits) contribution of one alloc —
     exactly ``ColumnarCluster.sum_alloc_usage`` restricted to one element,
@@ -98,9 +117,13 @@ class MirrorCluster(ColumnarCluster):
         self._mirror_lock = lock
         #: reserved + Σ live-alloc contributions per row (int64, [N, R])
         self.mirror_used = self.reserved.copy()
+        #: live allocs per row carrying ports/devices (dimensions the
+        #: dense planes can't verify): the plan applier's device verify
+        #: degrades these rows to the exact host check
+        self.exotic_live = np.zeros(len(nodes), dtype=np.int32)
         #: the state generation the incremental planes currently equal
         self._synced_gen = None
-        #: alloc id → (node_id, usage vec, job_id, task_group)
+        #: alloc id → (node_id, usage vec, job_id, task_group, exotic)
         self._alloc_rec: dict[str, tuple] = {}
         #: (job_id, task_group) → {node_id: live alloc count}
         self._job_counts: dict[tuple, dict] = {}
@@ -459,7 +482,16 @@ class ColumnarMirror:
         from-scratch recompute over the same node rows."""
         cluster = self._cluster
         fresh = ColumnarCluster.initial_used(cluster, snapshot)
-        ok = np.array_equal(fresh, cluster.mirror_used)
+        fresh_exotic = np.zeros(len(cluster.nodes), dtype=np.int32)
+        for alloc in snapshot.allocs():
+            if alloc.terminal_status() or not exotic_flag(alloc):
+                continue
+            row = cluster.index.get(alloc.node_id)
+            if row is not None:
+                fresh_exotic[row] += 1
+        ok = np.array_equal(fresh, cluster.mirror_used) and np.array_equal(
+            fresh_exotic, cluster.exotic_live
+        )
         if not ok:
             logger.warning(
                 "mirror checksum mismatch at index %d (max row delta %s); "
@@ -496,7 +528,8 @@ class ColumnarMirror:
             if alloc.node_id not in cluster.index:
                 continue
             self._track(cluster, alloc.id, alloc.node_id,
-                        usage_vec(alloc), alloc.job_id, alloc.task_group)
+                        usage_vec(alloc), alloc.job_id, alloc.task_group,
+                        exotic_flag(alloc))
         self._cluster = cluster
         self._applied = snapshot.latest_index()
         self._applied_na = target
@@ -509,7 +542,8 @@ class ColumnarMirror:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _track(cluster: MirrorCluster, alloc_id, node_id, vec, job_id, tg):
+    def _track(cluster: MirrorCluster, alloc_id, node_id, vec, job_id, tg,
+               exotic: bool = True):
         row = cluster.index.get(node_id)
         if row is None:
             return
@@ -520,7 +554,9 @@ class ColumnarMirror:
             # non-terminal matching alloc regardless of resources
             vec = (0, 0, 0, 0)
         cluster.mirror_used[row] += np.asarray(vec, dtype=np.int64)
-        cluster._alloc_rec[alloc_id] = (node_id, vec, job_id, tg)
+        if exotic:
+            cluster.exotic_live[row] += 1
+        cluster._alloc_rec[alloc_id] = (node_id, vec, job_id, tg, exotic)
         jc = cluster._job_counts.setdefault((job_id, tg), {})
         jc[node_id] = jc.get(node_id, 0) + 1
 
@@ -530,7 +566,7 @@ class ColumnarMirror:
         rec = cluster._alloc_rec.pop(alloc_id, None)
         if rec is None:
             return None
-        node_id, vec, job_id, tg = rec
+        node_id, vec, job_id, tg, exotic = rec
         jc = cluster._job_counts.get((job_id, tg))
         if jc is not None:
             c = jc.get(node_id, 0) - 1
@@ -544,6 +580,8 @@ class ColumnarMirror:
         if row is None:
             return None
         cluster.mirror_used[row] -= np.asarray(vec, dtype=np.int64)
+        if exotic:
+            cluster.exotic_live[row] -= 1
         return row
 
     def _mark_dirty(self, row: int):
@@ -587,6 +625,10 @@ class ColumnarMirror:
             cluster, alloc_id, node_id,
             tuple(vec) if vec is not None else None,
             p.get("JobID", ""), p.get("TaskGroup", ""),
+            # a payload missing the flag (shouldn't happen in-process)
+            # defaults EXOTIC: the verify path then degrades that row to
+            # the exact host check instead of trusting the dense planes
+            bool(p.get("Exotic", True)),
         )
         r = cluster.index.get(node_id)
         if r is not None:
@@ -636,6 +678,49 @@ class ColumnarMirror:
             else:
                 ds.refresh(cluster.mirror_used)
             return ds.arrays()
+
+    # ------------------------------------------------------------------
+    # plan-applier dense device verify (core/plan_apply.py)
+    # ------------------------------------------------------------------
+    def verify_handles(self, snapshot, n_pad: int, mesh=None):
+        """The plan applier's device-verify view of ``snapshot``: sync the
+        mirror to exactly that generation and return ``(cluster, (capacity,
+        usable, used) device refs, gen)``, or None when the mirror can't
+        serve it (closed, or already synced PAST the snapshot by a
+        concurrent drain batch — the applier then degrades to the host
+        oracle, counted in tpu.mirror_stale / plan.verify_device_degrade).
+        ``mesh`` must match what the drain batches pass for the same
+        n_pad (the MIN_NODES-gated active mesh): the DeviceState cache is
+        keyed by n_pad, so a mesh mismatch between the two consumers
+        would rebuild the full planes on every alternation instead of
+        riding the dirty-row scatter."""
+        cluster = self.sync(snapshot)
+        if cluster is None:
+            return None
+        gen = getattr(snapshot, "_gen", snapshot)
+        arrays = self.device_state(n_pad, gen, mesh=mesh)
+        if arrays is None:
+            return None
+        return cluster, arrays, gen
+
+    def locked_cluster(self, gen):
+        """Context manager yielding the MirrorCluster while it is still
+        synced to ``gen`` (else None), with the data lock held: the
+        applier's per-plan host-side gather (rows, node objects, exotic
+        counts, alloc-rec vectors) reads a consistent plane set even if a
+        drain worker is concurrently syncing the mirror forward."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            with self._lock:
+                cluster = self._cluster
+                if cluster is None or cluster._synced_gen is not gen:
+                    yield None
+                else:
+                    yield cluster
+
+        return _ctx()
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:

@@ -277,6 +277,26 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
         compiled += 1
     except Exception:
         pass
+    # the plan applier's dense device verify (kernel.verify_rows) rides
+    # the SAME (N-padded) mirror planes: prewarm its small row-bucket
+    # shapes so the first big plan after startup doesn't pay a cold XLA
+    # compile inside the apply loop (the cold-compile class this ladder
+    # exists to kill)
+    try:
+        from .kernel import _verify_rows_jit
+        from .mirror import DeviceState
+
+        cap_w = jnp.ones((N, 4), dtype=jnp.int32)
+        used_w = jnp.zeros((N, 4), dtype=jnp.int32)
+        for b in DeviceState._ROW_BUCKETS[:2]:
+            _verify_rows_jit.lower(
+                cap_w, used_w,
+                jnp.zeros(b, dtype=jnp.int32),
+                jnp.zeros((b, 4), dtype=jnp.int32),
+            ).compile()
+            compiled += 1
+    except Exception:
+        pass
     return compiled
 
 

@@ -16,11 +16,19 @@ from __future__ import annotations
 
 from typing import Optional
 
-#: stages owned by the serialized plan applier: when one of these is the
-#: bottleneck, the verdict names the applier (ROADMAP item 2's knee)
-APPLIER_STAGES = frozenset(
-    {"plan.submit", "plan.queue_wait", "plan.commit", "plan.commit_barrier"}
-)
+#: stages owned by the plan applier's QUEUE/serialization: when one of
+#: these is the bottleneck, the verdict names the applier (ROADMAP
+#: item 1's knee). plan.commit / plan.commit_barrier moved out when the
+#: applier pipelined (PR 13): commits now overlap verification, so a
+#: commit-dominated tail is raft consensus latency (fsync/replication —
+#: the worker legitimately waits for its entry to land), not the
+#: applier convoying plans behind one loop
+APPLIER_STAGES = frozenset({"plan.submit", "plan.queue_wait"})
+
+#: consensus-round stages: a tail these own is commit latency, named as
+#: such so operators chase raft (fsync, replication, batch fold), not
+#: the applier loop
+CONSENSUS_STAGES = frozenset({"plan.commit", "plan.commit_barrier"})
 #: root-ish spans never named as a bottleneck "stage" (they ARE the e2e)
 ROOT_NAMES = frozenset({"eval.e2e", "job.submit"})
 #: stages whose wall time is COVERED ELSEWHERE in the tree and must not
@@ -163,6 +171,14 @@ def attribute(records: list[dict], tail_pct: float = 0.99) -> dict:
             f"{tail_stages[bottleneck]['share'] * 100:.0f}% of the "
             f"p{int(tail_pct * 100)} tail (plan submit/queue-wait "
             "dominate while verification stays flat)"
+        )
+    elif bottleneck in CONSENSUS_STAGES:
+        verdict = (
+            f"consensus commit latency: '{bottleneck}' owns "
+            f"{tail_stages[bottleneck]['share'] * 100:.0f}% of the "
+            f"p{int(tail_pct * 100)} tail (the pipelined applier keeps "
+            "verifying while entries commit; tune raft/fold, not the "
+            "applier)"
         )
     elif bottleneck is not None:
         verdict = (

@@ -1,0 +1,34 @@
+#!/usr/bin/env sh
+# Applier-knee ladder entry point (ROADMAP item 1; PERF.md
+# "Applier pipeline" section). Runs ONLY the applier section of
+# bench.py — the worker-scaling drain ladder (bench.APPLIER_TIERS)
+# with the pipelined applier (overlapped commits + device dense
+# verify) and 8-way sharded broker ready-queues — and prints the JSON
+# detail plus the trailing APPLIER_SUMMARY line.
+#
+#   scripts/applier.sh
+#
+# The ladder records os.cpu_count() in the artifact: on a 1-core box
+# it measures contention removal, not parallel speedup (PERF.md
+# caveat) — absolute evals/s targets only bind on a multi-core box.
+set -eu
+
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'EOF'
+import json
+
+import bench
+
+result = bench.bench_applier()
+print(json.dumps(result, indent=2))
+print(
+    "APPLIER_SUMMARY "
+    f"applier_evals_s={result['applier_evals_s']} "
+    f"applier_queue_wait_p99_ms={result['applier_queue_wait_p99_ms']} "
+    f"applier_block_frac={result['applier_block_frac']} "
+    f"applier_bottleneck={result['applier_bottleneck']} "
+    f"applier_cores={result['cpu_count']} "
+    + result["applier_workers_line"]
+)
+EOF

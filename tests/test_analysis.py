@@ -368,6 +368,55 @@ class TestRaftHygiene:
         assert len(found) == 1
         assert found[0].line == 2
 
+    # -- overlay-unresolved (the pipelined over-commit class) ----------
+    def test_overlay_read_without_unresolved_handling_flagged(self):
+        src = (
+            "def verify(self, snap, plan):\n"
+            "    extra = self.overlay.deltas()\n"
+            "    return extra\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/x.py": src}, "overlay-unresolved"
+        )
+        assert len(found) == 1
+        assert "commit_timeout_unresolved" in found[0].message
+
+    def test_overlay_read_with_marker_clean(self):
+        src = (
+            "def verify(self, snap, plan):\n"
+            "    extra = self.overlay.deltas()\n"
+            "    return extra\n"
+            "def on_commit_error(self, e, box):\n"
+            "    metrics.incr('plan.commit_timeout_unresolved')\n"
+            "    box['floor'] = getattr(e, 'raft_index', 0)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "overlay-unresolved"
+        )
+
+    def test_overlay_read_with_rollback_clean(self):
+        src = (
+            "def harvest(self, box, epoch):\n"
+            "    merged = self.overlay.deltas()\n"
+            "    if not box.get('index'):\n"
+            "        self.overlay.rollback(epoch)\n"
+            "    return merged\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "overlay-unresolved"
+        )
+
+    def test_overlay_depth_observability_not_flagged(self):
+        # sampling pipeline depth (flight recorder) consumes no
+        # uncommitted capacity — must stay clean without any handling
+        src = (
+            "def sample(self, server):\n"
+            "    return server.planner.overlay_depth()\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "overlay-unresolved"
+        )
+
 
 # ----------------------------------------------------------------------
 # import-graph checkers

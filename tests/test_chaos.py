@@ -348,10 +348,18 @@ class TestLeaderCrashMidApply:
                 "initial_nack_delay": 0.05,
                 "subsequent_nack_delay": 0.1,
             },
+            # the PR 12 raft-timing knobs, de-flaked: under the 50–100ms
+            # dev election timeouts this 3-servers-one-process test raced
+            # GIL stalls against the failure detector — the partitioned
+            # leader's term kept climbing and the post-heal re-election
+            # war occasionally outlived the eval-terminal wait (~5/25).
+            # The wider window keeps failover fast (≤0.6s) while making
+            # heartbeat loss from scheduler load, not the partition, a
+            # non-event (same ratios federation.py runs its storms with).
             raft_config=RaftConfig(
-                heartbeat_interval=0.02,
-                election_timeout_min=0.05,
-                election_timeout_max=0.10,
+                heartbeat_interval=0.06,
+                election_timeout_min=0.3,
+                election_timeout_max=0.6,
                 apply_timeout=1.0,
             ),
         )
@@ -399,7 +407,24 @@ class TestLeaderCrashMidApply:
                 ),
                 msg="replicas converge",
             )
-            wait_quiescent(new_leader)
+            # the heal can re-elect (the deposed leader rejoins with an
+            # inflated term): quiesce the CURRENT leader, not the local
+            # variable captured mid-partition
+            leader = wait_leader(servers)
+            wait_quiescent(leader)
+            # deterministic ordering for the per-server invariant sweep:
+            # the converge wait above observes the ALLOC entries, but the
+            # eval-status entries trail them in the log — a follower
+            # checked mid-apply shows the (already completed) eval as
+            # 'pending'. Wait for every replica to reach the quiesced
+            # leader's applied index before sweeping.
+            target = leader.state.latest_index()
+            wait_until(
+                lambda: all(
+                    s.state.latest_index() >= target for s in servers
+                ),
+                msg="replica logs converge to the quiesced leader",
+            )
             for s in servers:
                 assert_cluster_invariants(s.state)
         finally:

@@ -1,6 +1,9 @@
 """Plan-applier hardening: EvalToken split-brain guard, dense verify
-parity, and the overlapped verify/apply loop
-(ref plan_endpoint.go:19-52, plan_apply.go:49-180, plan_apply_pool.go)."""
+parity (host AND device-resident), the pipelined overlay apply loop, and
+the ported reference slice (snapshot-min-index wait, partial eviction,
+queue ordering)
+(ref plan_endpoint.go:19-52, plan_apply.go:49-180, plan_apply_test.go,
+plan_queue_test.go)."""
 
 import random
 import threading
@@ -247,6 +250,647 @@ class TestOverlappedApply:
             assert len(state.allocs_by_node_terminal(node.id, False)) == 1
         finally:
             planner.stop()
+
+
+def _mirror_for(state):
+    """A live ColumnarMirror over ``state`` (its own event broker; syncs
+    rebuild from snapshots since nothing publishes frames here)."""
+    from nomad_tpu.events import EventBroker
+    from nomad_tpu.tpu.mirror import ColumnarMirror
+
+    broker = EventBroker(state=state)
+    return ColumnarMirror(state, broker)
+
+
+class TestDeviceVerifyParity:
+    """The acceptance pin: device-verify == host-oracle verify over ≥100
+    seeded plans, including exotic rows, down/ineligible nodes, stops,
+    preemptions, int32-clip edges, mirror-sever rebuilds, kernel-fault
+    degradation, and a closed mirror (full degrade)."""
+
+    def _cluster(self, rng, n_nodes=24):
+        from nomad_tpu.structs.model import NetworkResource, Port
+
+        state = StateStore()
+        nodes = []
+        for i in range(n_nodes):
+            n = mock.node()
+            n.node_resources.cpu.cpu_shares = rng.choice([1000, 2000, 4000])
+            n.node_resources.memory.memory_mb = rng.choice([2048, 4096])
+            nodes.append(n)
+        state.upsert_nodes(1, nodes)
+        # preloaded allocs: plain + exotic (reserved ports)
+        idx = 2
+        preloaded = []
+        for n in nodes:
+            for _ in range(rng.randint(0, 3)):
+                a = make_alloc(
+                    n.id, cpu=rng.choice([100, 400, 900]),
+                    mem=rng.choice([64, 256]),
+                )
+                if rng.random() < 0.2:
+                    a.allocated_resources.tasks["web"].networks = [
+                        NetworkResource(
+                            device="eth0", ip="192.168.0.100", mbits=10,
+                            reserved_ports=[
+                                Port(label="http", value=rng.randint(8000, 8005))
+                            ],
+                        )
+                    ]
+                preloaded.append(a)
+        state.upsert_allocs(idx, preloaded)
+        # a few nodes down / ineligible
+        state.update_node_status(3, nodes[0].id, "down")
+        from nomad_tpu.structs.model import NODE_SCHED_INELIGIBLE
+
+        nodes[1].scheduling_eligibility = NODE_SCHED_INELIGIBLE
+        return state, nodes, preloaded
+
+    def _seeded_plan(self, rng, nodes, preloaded):
+        from nomad_tpu.structs.model import NetworkResource, Port
+
+        plan = Plan(priority=50)
+        for n in rng.sample(nodes, rng.randint(1, len(nodes))):
+            allocs = []
+            for _ in range(rng.randint(1, 4)):
+                a = make_alloc(
+                    n.id, cpu=rng.choice([50, 300, 1200]),
+                    mem=rng.choice([16, 128, 1024]),
+                )
+                if rng.random() < 0.1:
+                    a.allocated_resources.tasks["web"].networks = [
+                        NetworkResource(
+                            device="eth0", ip="192.168.0.100", mbits=5,
+                            reserved_ports=[Port(label="x", value=9000)],
+                        )
+                    ]
+                allocs.append(a)
+            plan.node_allocation[n.id] = allocs
+            if rng.random() < 0.3:
+                stops = [
+                    a for a in preloaded
+                    if a.node_id == n.id and rng.random() < 0.5
+                ]
+                if stops:
+                    plan.node_update[n.id] = stops
+            if rng.random() < 0.1:
+                preempt = [a for a in preloaded if a.node_id == n.id][:1]
+                if preempt:
+                    plan.node_preemptions[n.id] = preempt
+        if rng.random() < 0.1:
+            plan.all_at_once = True
+        return plan
+
+    @staticmethod
+    def _committed_sets(result):
+        return (
+            {k: [a.id for a in v] for k, v in result.node_allocation.items()},
+            {k: [a.id for a in v] for k, v in result.node_update.items()},
+            {k: [a.id for a in v] for k, v in result.node_preemptions.items()},
+            bool(result.refresh_index),
+        )
+
+    def _device_result(self, planner, snap, plan):
+        dev_ctx = planner._device_ctx(snap, [_FakePending(plan)])
+        if dev_ctx is None:
+            return None
+        from nomad_tpu.core.plan_apply import _OverlayEpoch
+
+        return planner._evaluate_plan_device(
+            dev_ctx, snap, plan, planner.overlay.deltas(), _OverlayEpoch(),
+            lambda: snap,
+        )
+
+    def test_device_matches_host_over_seeded_plans(self):
+        rng = random.Random(20260804)
+        state, nodes, preloaded = self._cluster(rng)
+        planner = Planner(state)
+        mirror = _mirror_for(state)
+        planner.mirror_fn = lambda: mirror
+        planner.device_verify_min = 1  # exercise the device path per plan
+        snap = state.snapshot()
+        device_checked = 0
+        for i in range(120):
+            plan = self._seeded_plan(rng, nodes, preloaded)
+            host = evaluate_plan(snap, plan)
+            dev = self._device_result(planner, snap, plan)
+            if i == 60:
+                # sever mid-stream: the next sync rebuilds and parity must
+                # survive the rebuilt planes
+                mirror.sever()
+            if dev is None:
+                continue
+            device_checked += 1
+            assert self._committed_sets(dev) == self._committed_sets(host), (
+                f"device/host divergence on seeded plan {i}"
+            )
+            assert dev.refresh_index == host.refresh_index
+        assert device_checked >= 100, (
+            f"device path exercised only {device_checked} times"
+        )
+        assert mirror.counters["rebuilds"] >= 1  # the sever really rebuilt
+        mirror.close()
+
+    def test_int32_clip_rows_degrade_to_exact(self):
+        """A row whose used plane exceeds the device int32-clip range must
+        take the exact host check — the clipped plane would under-report
+        usage and could confirm an over-commit."""
+        state = StateStore()
+        n = mock.node()
+        n.node_resources.cpu.cpu_shares = 2**31 - 1
+        n.node_resources.memory.memory_mb = 4096
+        state.upsert_node(1, n)
+        big = make_alloc(n.id, cpu=2**30 + 7, mem=1)
+        state.upsert_allocs(2, [big])
+        planner = Planner(state)
+        mirror = _mirror_for(state)
+        planner.mirror_fn = lambda: mirror
+        planner.device_verify_min = 1
+        snap = state.snapshot()
+        plan = Plan(priority=50)
+        plan.node_allocation[n.id] = [make_alloc(n.id, cpu=100, mem=1)]
+        host = evaluate_plan(snap, plan)
+        dev = self._device_result(planner, snap, plan)
+        assert dev is not None
+        assert TestDeviceVerifyParity._committed_sets(dev) == (
+            TestDeviceVerifyParity._committed_sets(host)
+        )
+        mirror.close()
+
+    def test_kernel_fault_degrades_to_host(self):
+        from nomad_tpu.testing import faults
+
+        state = StateStore()
+        nodes = [mock.node() for _ in range(3)]
+        state.upsert_nodes(1, nodes)
+        planner = Planner(state)
+        mirror = _mirror_for(state)
+        planner.mirror_fn = lambda: mirror
+        planner.device_verify_min = 1
+        snap = state.snapshot()
+        # plain placements on healthy nodes: guaranteed candidate rows,
+        # so the verify really reaches the kernel dispatch
+        plan = Plan(priority=50)
+        for n in nodes:
+            plan.node_allocation[n.id] = [make_alloc(n.id, cpu=100, mem=64)]
+        plane = faults.install(faults.FaultPlane(seed=3))
+        try:
+            plane.rule("point", "error", method="tpu.kernel")
+            dev = self._device_result(planner, snap, plan)
+            # the kernel fault gate fires inside verify_rows: whole plan
+            # degrades to the host oracle (None), never a wrong verdict
+            assert dev is None
+        finally:
+            faults.uninstall()
+            mirror.close()
+
+    def test_device_verify_through_apply_loop(self):
+        """End-to-end: the running apply loop takes the device path (min
+        placements 1) and two conflicting plans still serialize — the
+        second is rejected off the overlay/stacked accounting exactly as
+        on the host path."""
+        state = StateStore()
+        node = mock.node()
+        node.node_resources.cpu.cpu_shares = 1000
+        node.node_resources.memory.memory_mb = 4096
+        state.upsert_node(1, node)
+        planner = Planner(state)
+        mirror = _mirror_for(state)
+        planner.mirror_fn = lambda: mirror
+        planner.device_verify_min = 1
+        planner.start()
+        try:
+            def plan():
+                p = Plan(priority=50)
+                p.node_allocation[node.id] = [
+                    make_alloc(node.id, cpu=800, mem=64)
+                ]
+                return p
+
+            pa_ = planner.queue.enqueue(plan())
+            pb_ = planner.queue.enqueue(plan())
+            ra, ea = pa_.wait(timeout=10.0)
+            rb, eb = pb_.wait(timeout=10.0)
+            assert ea is None and eb is None
+            committed = [
+                r for r in (ra, rb) if r is not None and r.node_allocation
+            ]
+            assert len(committed) == 1, "device path double-booked"
+            assert len(state.allocs_by_node_terminal(node.id, False)) == 1
+        finally:
+            planner.stop()
+            mirror.close()
+
+    def test_closed_mirror_fully_degrades(self):
+        rng = random.Random(13)
+        state, nodes, preloaded = self._cluster(rng, n_nodes=4)
+        planner = Planner(state)
+        mirror = _mirror_for(state)
+        planner.mirror_fn = lambda: mirror
+        planner.device_verify_min = 1
+        mirror.close()
+        snap = state.snapshot()
+        plan = self._seeded_plan(rng, nodes, preloaded)
+        assert planner._device_ctx(snap, [_FakePending(plan)]) is None
+
+
+class _FakePending:
+    """Just enough PendingPlan surface for _device_ctx's size gate."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+
+class TestPipelinedApply:
+    """ROADMAP item 1b: verify(N+1) while commit(N) is in flight, with
+    the overlay carrying N's adds; rollback on failure; floors on
+    unresolved outcomes."""
+
+    def _node(self, state, cpu=1000):
+        node = mock.node()
+        node.node_resources.cpu.cpu_shares = cpu
+        node.node_resources.memory.memory_mb = 4096
+        state.upsert_node(1, node)
+        return node
+
+    def test_commits_overlap_in_flight(self):
+        """Two independent batches must have their consensus commits in
+        flight SIMULTANEOUSLY (the pipeline, not just verify overlap)."""
+        state = StateStore()
+        nodes = [mock.node() for _ in range(2)]
+        for i, n in enumerate(nodes):
+            state.upsert_node(i + 1, n)
+
+        in_flight = []
+        release = threading.Event()
+        both_started = threading.Event()
+        lock = threading.Lock()
+
+        def commit_batch(items):
+            with lock:
+                in_flight.append(len(items))
+                if len(in_flight) >= 2:
+                    both_started.set()
+            assert release.wait(10), "second commit never dispatched"
+            index = 0
+            for plan, result, pevals in items:
+                index = state.upsert_plan_results(None, plan, result)
+            return index
+
+        planner = Planner(state)
+        planner.commit_batch_fn = commit_batch
+        planner.max_inflight = 2
+        planner.start()
+        try:
+            def plan_for(n):
+                p = Plan(priority=50)
+                p.node_allocation[n.id] = [make_alloc(n.id, cpu=100, mem=64)]
+                return p
+
+            pa_ = planner.queue.enqueue(plan_for(nodes[0]))
+            time.sleep(0.1)  # batch A dispatched, commit parked
+            pb_ = planner.queue.enqueue(plan_for(nodes[1]))
+            assert both_started.wait(5), (
+                "commit(N+1) waited for commit(N): the applier still "
+                "serializes on raft.apply"
+            )
+            release.set()
+            ra, ea = pa_.wait(timeout=10.0)
+            rb, eb = pb_.wait(timeout=10.0)
+            assert ea is None and ra.node_allocation
+            assert eb is None and rb.node_allocation
+        finally:
+            release.set()
+            planner.stop()
+
+    def test_overlay_guards_against_inflight_double_book(self):
+        """A plan verified while a conflicting batch's commit is in
+        flight must see the overlay's adds and reject — without the
+        applier joining the commit."""
+        state = StateStore()
+        node = self._node(state, cpu=1000)
+
+        release = threading.Event()
+        started = threading.Event()
+
+        def commit_batch(items):
+            started.set()
+            assert release.wait(10)
+            index = 0
+            for plan, result, pevals in items:
+                index = state.upsert_plan_results(None, plan, result)
+            return index
+
+        planner = Planner(state)
+        planner.commit_batch_fn = commit_batch
+        planner.start()
+        try:
+            pa_ = planner.queue.enqueue(self._plan(node, cpu=800))
+            assert started.wait(5)
+            pb_ = planner.queue.enqueue(self._plan(node, cpu=800))
+            rb, eb = pb_.wait(timeout=5.0)
+            # B answered from the overlay BEFORE A's commit released
+            assert eb is None and not rb.node_allocation
+            assert rb.refresh_index
+            release.set()
+            ra, ea = pa_.wait(timeout=10.0)
+            assert ea is None and ra.node_allocation
+            assert len(state.allocs_by_node_terminal(node.id, False)) == 1
+        finally:
+            release.set()
+            planner.stop()
+
+    @staticmethod
+    def _plan(node, cpu):
+        p = Plan(priority=50)
+        p.node_allocation[node.id] = [make_alloc(node.id, cpu=cpu, mem=64)]
+        return p
+
+    def test_overlay_rolls_back_on_commit_failure(self):
+        """A failed commit's phantom adds must leave the overlay: the
+        same capacity must be grantable to the next plan."""
+        state = StateStore()
+        node = self._node(state, cpu=1000)
+
+        fail_first = {"armed": True}
+        release = threading.Event()
+        started = threading.Event()
+
+        def commit_batch(items):
+            if fail_first["armed"]:
+                fail_first["armed"] = False
+                started.set()
+                assert release.wait(10)
+                raise RuntimeError("injected commit failure")
+            index = 0
+            for plan, result, pevals in items:
+                index = state.upsert_plan_results(None, plan, result)
+            return index
+
+        planner = Planner(state)
+        planner.commit_batch_fn = commit_batch
+        planner.start()
+        try:
+            pa_ = planner.queue.enqueue(self._plan(node, cpu=800))
+            assert started.wait(5)
+            # B conflicts while A's (doomed) commit is in flight:
+            # conservatively rejected off the overlay
+            pb_ = planner.queue.enqueue(self._plan(node, cpu=800))
+            rb, eb = pb_.wait(timeout=5.0)
+            assert eb is None and rb.refresh_index
+            release.set()
+            ra, ea = pa_.wait(timeout=10.0)
+            assert ea is not None, "failed commit must surface to worker"
+            # C takes the capacity the rolled-back epoch released
+            pc_ = planner.queue.enqueue(self._plan(node, cpu=800))
+            rc, ec = pc_.wait(timeout=10.0)
+            assert ec is None and rc.node_allocation, (
+                "overlay rollback lost the failed batch's capacity"
+            )
+            assert len(state.allocs_by_node_terminal(node.id, False)) == 1
+        finally:
+            release.set()
+            planner.stop()
+
+    def test_epoch_never_pruned_on_alloc_id_reuse(self):
+        """The e2e-drive regression: plans legitimately REUSE alloc ids
+        (in-place updates, refresh/nack retries), so an id's presence in
+        a snapshot must never prune an in-flight epoch — the overlay may
+        only drop an epoch once its HARVESTED commit index is covered by
+        the base. Pre-fix, the in-flight batch below was pruned because
+        its first placed id already existed in state (the in-place
+        update), and plan C double-booked node n2."""
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        for n in (n1, n2):
+            n.node_resources.cpu.cpu_shares = 1000
+            n.node_resources.memory.memory_mb = 4096
+        state.upsert_node(1, n1)
+        state.upsert_node(2, n2)
+        old = make_alloc(n1.id, cpu=100, mem=64)
+        state.upsert_allocs(3, [old])
+
+        release = threading.Event()
+        started = threading.Event()
+        first = {"armed": True}
+
+        def commit_batch(items):
+            if first["armed"]:
+                first["armed"] = False
+                started.set()
+                assert release.wait(10)
+            index = 0
+            for plan, result, pevals in items:
+                index = state.upsert_plan_results(None, plan, result)
+            return index
+
+        planner = Planner(state)
+        planner.commit_batch_fn = commit_batch
+        # ONE batch: an in-place update of `old` (same alloc id — the
+        # id-reuse trigger, first in verify order) + a fresh 800-cpu
+        # placement on n2. Queue both before start so they fold.
+        update = make_alloc(n1.id, cpu=100, mem=64)
+        update.id = old.id
+        plan_a = Plan(priority=90)
+        plan_a.node_allocation[n1.id] = [update]
+        plan_b = Plan(priority=50)
+        plan_b.node_allocation[n2.id] = [make_alloc(n2.id, cpu=800, mem=64)]
+        planner.queue.set_enabled(True)
+        pa_ = planner.queue.enqueue(plan_a)
+        pb_ = planner.queue.enqueue(plan_b)
+        planner.start()
+        try:
+            assert started.wait(5)
+            # while the batch's entry is in flight, C contends for n2:
+            # the epoch (with B's 800-cpu add) must still be credited
+            plan_c = Plan(priority=50)
+            plan_c.node_allocation[n2.id] = [
+                make_alloc(n2.id, cpu=800, mem=64)
+            ]
+            pc_ = planner.queue.enqueue(plan_c)
+            rc, ec = pc_.wait(timeout=5.0)
+            assert ec is None and not rc.node_allocation, (
+                "epoch pruned on reused alloc id: plan C double-booked n2"
+            )
+            assert rc.refresh_index
+            release.set()
+            for p in (pa_, pb_):
+                r, e = p.wait(timeout=10.0)
+                assert e is None and r.node_allocation
+            assert len(state.allocs_by_node_terminal(n2.id, False)) == 1
+        finally:
+            release.set()
+            planner.stop()
+
+    def test_unresolved_timeout_floors_and_rolls_back(self):
+        """ApplyTimeout + failed barrier (commit_timeout_unresolved): the
+        epoch rolls back AND the floor forces every later verify past the
+        in-flight entry — when it lands late, no double-booking (the PR 6
+        over-commit class must stay dead under overlap)."""
+        from nomad_tpu.raft import ApplyTimeout
+        from nomad_tpu.structs.funcs import allocs_fit
+
+        state = StateStore()
+        node = self._node(state, cpu=1000)
+        applied = threading.Event()
+        seen = {"first": None}
+
+        def commit_batch(items):
+            if seen["first"] is None:
+                seen["first"] = items
+                entry_index = state.latest_index() + 1
+
+                def late_apply():
+                    time.sleep(0.4)
+                    for plan, result, pevals in items:
+                        state.upsert_plan_results(None, plan, result)
+                    applied.set()
+
+                threading.Thread(
+                    target=late_apply, daemon=True,
+                    name="test-late-apply",
+                ).start()
+                raise ApplyTimeout(entry_index)
+            index = 0
+            for plan, result, pevals in items:
+                index = state.upsert_plan_results(None, plan, result)
+            return index
+
+        def barrier_fn(exc):
+            raise RuntimeError("barrier failed; outcome unknown")
+
+        planner = Planner(state)
+        planner.commit_batch_fn = commit_batch
+        planner.barrier_fn = barrier_fn
+        planner.start()
+        try:
+            pa_ = planner.queue.enqueue(self._plan(node, cpu=600))
+            ra, ea = pa_.wait(timeout=10.0)
+            assert ea is not None, "unresolved outcome must fail the plan"
+            # B must wait out the floor: by then A's entry has landed and
+            # B sees its usage
+            pb_ = planner.queue.enqueue(self._plan(node, cpu=600))
+            rb, eb = pb_.wait(timeout=10.0)
+            assert eb is None and rb is not None
+            assert rb.refresh_index and not rb.node_allocation, (
+                "plan B committed against state missing the in-flight "
+                "entry — the over-commit class is back"
+            )
+            assert applied.is_set()
+            live = state.snapshot().allocs_by_node_terminal(node.id, False)
+            fit, dim, used = allocs_fit(node, live, None, True)
+            assert fit, f"over-committed: {dim}"
+        finally:
+            planner.stop()
+
+
+class TestReferencePortSlice:
+    """Ported slice of plan_apply_test.go / plan_endpoint_test.go /
+    plan_queue_test.go: snapshot-min-index wait, partial-eviction
+    results, queue ordering."""
+
+    def test_snapshot_min_index_wait(self):
+        """A plan stamped with a SnapshotIndex ahead of the store must
+        not verify until the store reaches it (ref plan_apply.go
+        snapshotMinIndex / TestPlanApply_applyPlan watchdog)."""
+        state = StateStore()
+        node = mock.node()
+        state.upsert_node(1, node)
+        planner = Planner(state)
+        planner.start()
+        try:
+            plan = Plan(priority=50)
+            plan.node_allocation[node.id] = [make_alloc(node.id, cpu=100)]
+            plan.snapshot_index = 3  # the store is at 1
+            pending = planner.queue.enqueue(plan)
+            time.sleep(0.4)
+            assert pending.result is None and pending.error is None, (
+                "applier verified below the plan's snapshot index"
+            )
+            state.upsert_node(3, mock.node())  # the awaited write lands
+            result, err = pending.wait(timeout=5.0)
+            assert err is None and result.node_allocation
+        finally:
+            planner.stop()
+
+    def test_partial_eviction_allows_placement(self):
+        """Evicting an existing alloc in the same plan frees its capacity
+        for the plan's own placement (ref plan_apply_test.go
+        TestPlanApply_EvalPlan_Partial eviction accounting)."""
+        state = StateStore()
+        node = mock.node()
+        node.node_resources.cpu.cpu_shares = 1000
+        state.upsert_node(1, node)
+        old = make_alloc(node.id, cpu=900, mem=64)
+        state.upsert_allocs(2, [old])
+
+        plan = Plan(priority=50)
+        plan.node_update[node.id] = [old]
+        plan.node_allocation[node.id] = [make_alloc(node.id, cpu=900, mem=64)]
+        result = evaluate_plan(state.snapshot(), plan)
+        assert node.id in result.node_allocation, (
+            "eviction credit not applied within the plan"
+        )
+        assert node.id in result.node_update
+        assert not result.refresh_index
+
+    def test_partial_commit_keeps_passing_nodes(self):
+        """One overfull node fails; the other commits; the result carries
+        a refresh index (ref TestPlanApply_EvalPlan_Partial)."""
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        n1.node_resources.cpu.cpu_shares = 100
+        n2.node_resources.cpu.cpu_shares = 4000
+        state.upsert_node(1, n1)
+        state.upsert_node(2, n2)
+        plan = Plan(priority=50)
+        plan.node_allocation[n1.id] = [make_alloc(n1.id, cpu=900)]
+        plan.node_allocation[n2.id] = [make_alloc(n2.id, cpu=900)]
+        result = evaluate_plan(state.snapshot(), plan)
+        assert n2.id in result.node_allocation
+        assert n1.id not in result.node_allocation
+        assert result.refresh_index
+
+    def test_all_at_once_rejects_whole_plan(self):
+        """AllAtOnce: one failing node rejects the whole plan
+        (ref TestPlanApply_EvalPlan_Partial_AllAtOnce)."""
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        n1.node_resources.cpu.cpu_shares = 100
+        state.upsert_node(1, n1)
+        state.upsert_node(2, n2)
+        plan = Plan(priority=50, all_at_once=True)
+        plan.node_allocation[n1.id] = [make_alloc(n1.id, cpu=900)]
+        plan.node_allocation[n2.id] = [make_alloc(n2.id, cpu=100)]
+        result = evaluate_plan(state.snapshot(), plan)
+        assert not result.node_allocation
+        assert result.refresh_index
+
+    def test_queue_priority_and_fifo_ordering(self):
+        """PlanQueue pops by priority, FIFO within a priority (ref
+        plan_queue_test.go TestPlanQueue_Dequeue_Priority/FIFO)."""
+        from nomad_tpu.core.plan_apply import PlanQueue
+
+        q = PlanQueue()
+        q.set_enabled(True)
+        low = Plan(priority=10)
+        mid_a = Plan(priority=50)
+        mid_b = Plan(priority=50)
+        high = Plan(priority=90)
+        q.enqueue(mid_a)
+        q.enqueue(low)
+        q.enqueue(high)
+        q.enqueue(mid_b)
+        order = [q.dequeue(timeout=0.1).plan for _ in range(4)]
+        assert order == [high, mid_a, mid_b, low]
+
+    def test_disabled_queue_fails_submissions(self):
+        from nomad_tpu.core.plan_apply import PlanQueue
+
+        q = PlanQueue()
+        pending = q.enqueue(Plan(priority=50))
+        result, err = pending.wait(timeout=0.5)
+        assert result is None and err is not None
 
 
 class TestBatchedApply:

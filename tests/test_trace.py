@@ -697,6 +697,28 @@ class TestCriticalPath:
         share = report["tail"]["stages"]["plan.queue_wait"]["share"]
         assert share > 0.5
 
+    def test_commit_tail_names_consensus_not_the_applier(self):
+        """Post-pipeline (PR 13): a plan.commit-dominated tail is raft
+        consensus latency — the applier keeps verifying while entries
+        commit — so the verdict must steer operators at raft/fold
+        tuning, not the applier loop."""
+        records = [
+            _mk_record(
+                {
+                    "eval.evaluate": 2.0,
+                    "plan.queue_wait": 3.0,
+                    "plan.evaluate": 1.5,
+                    "plan.commit": 200.0,
+                },
+                220.0,
+            )
+            for _ in range(10)
+        ]
+        report = attribute(records)
+        assert report["bottleneck"] == "plan.commit"
+        assert "consensus commit latency" in report["verdict"]
+        assert "serialized plan applier" not in report["verdict"]
+
     def test_parent_self_time_excludes_children(self):
         record = _mk_record({"child": 40.0}, 100.0)
         from nomad_tpu.trace import attribute_trace
