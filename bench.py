@@ -1131,6 +1131,66 @@ def bench_trace_overhead(samples=3):
     }
 
 
+#: the device profiler must be as close to free as the trace plane and
+#: the sampling profiler: same A/B shape, same pinned budget
+DEVPROF_OVERHEAD_BUDGET_PCT = 3.0
+
+
+def bench_devprof_overhead(samples=3):
+    """A/B the headline pass with the device profiler (debug/devprof.py)
+    enabled vs disabled — arms interleaved like the trace/profile A/Bs
+    so thermal/cache drift hits both. The enabled arm pays the dispatch
+    wrapper (shard signature + cache-delta probe + round recording);
+    compile events are excluded by warming first, exactly like
+    production steady state."""
+    import gc
+
+    from nomad_tpu.debug import devprof
+    from nomad_tpu.state import StateStore
+
+    state = StateStore()
+    state.upsert_nodes(1, build_nodes(N_NODES))
+    job = build_job(N_ALLOCS, spread=True)
+    state.upsert_job(2, job)
+    run_once(state, job)  # warm compile outside both arms
+    on: list[float] = []
+    off: list[float] = []
+    prior = devprof.enabled()
+    try:
+        for _ in range(samples):
+            gc.collect()
+            devprof.enable(True)
+            t, _ = run_once(state, job)
+            on.append(t)
+            gc.collect()
+            devprof.enable(False)
+            t, _ = run_once(state, job)
+            off.append(t)
+    finally:
+        # restore the operator's state, never force-enable: a
+        # NOMAD_TPU_DEVPROF=0 bench run must stay uninstrumented for
+        # every section after this one
+        devprof.enable(prior)
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    on_med, off_med = med(on), med(off)
+    overhead = ((on_med - off_med) / off_med * 100.0) if off_med else 0.0
+    summ = devprof.summary()
+    return {
+        "samples": samples,
+        "enabled_median_s": round(on_med, 4),
+        "disabled_median_s": round(off_med, 4),
+        "overhead_pct": round(overhead, 2),
+        "budget_pct": DEVPROF_OVERHEAD_BUDGET_PCT,
+        "within_budget": overhead <= DEVPROF_OVERHEAD_BUDGET_PCT,
+        "compile_s_total": summ["compile_s_total"],
+        "h2d_mb": summ["h2d_mb"],
+        "rounds_per_placement": summ["rounds_per_placement"],
+    }
+
+
 #: the sharded headline config: 10× the single-chip north star, spread
 #: over the node axis of an 8-device mesh (ROADMAP item 1)
 SHARDED_NODES = int(os.environ.get("BENCH_SHARDED_NODES", "100000"))
@@ -1411,6 +1471,7 @@ def main():
         detail["config3"] = bench_config3()
         detail["config5"] = bench_config5()
         detail["trace_overhead"] = bench_trace_overhead()
+        detail["devprof_overhead"] = bench_devprof_overhead()
         detail["drain"] = bench_drain()
         detail["soak_smoke"] = bench_soak_smoke()
         if os.environ.get("BENCH_FANOUT", "1") != "0":
@@ -1549,6 +1610,15 @@ def main():
             parts.append(f"fed_slo_score={fed['slo_score']}")
         to = detail["trace_overhead"]
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
+        dpo = detail["devprof_overhead"]
+        parts.append(f"devprof_overhead_pct={dpo['overhead_pct']}")
+        # whole-run device-plane totals (every section's compiles and
+        # transfers), read at print time from the live counters
+        from nomad_tpu.debug import devprof as _devprof_mod
+
+        dps = _devprof_mod.summary()
+        parts.append(f"compile_s_total={dps['compile_s_total']}")
+        parts.append(f"h2d_mb={dps['h2d_mb']}")
         pab = detail["profile_ab"]
         parts.append(f"profile_overhead_pct={pab['overhead_pct']}")
         if "applier" not in detail:

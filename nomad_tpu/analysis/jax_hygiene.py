@@ -25,7 +25,11 @@ Rules:
 - ``jit-shape-unbucketed`` — a locally-computed size (from ``len()``,
   arithmetic, or a literal) passed to a known jit entry point without
   rounding through ``_bucket`` (deliberate static args get a suppression
-  with a WHY).
+  with a WHY);
+- ``transfer-uncounted`` — a raw ``device_put`` in ``tpu/`` that does
+  not route through the counted wrapper (``devprof.device_put``): the
+  devprof h2d transfer ledger is only trustworthy if EVERY placement
+  site feeds it, and item 2's dispatch-path rewrite will mint new ones.
 """
 
 from __future__ import annotations
@@ -290,6 +294,39 @@ def check_shape_literals(project: Project) -> list[Finding]:
                             "padding will compile a different shape",
                         )
                     )
+    return findings
+
+
+#: dotted prefixes that ARE the counted transfer wrapper (or carry it):
+#: devprof.device_put counts the bytes before delegating to jax
+_COUNTED_PUT_PREFIXES = ("devprof", "_devprof", "_devprof_put", "_dp")
+
+
+@register(
+    "transfer-uncounted",
+    "raw device_put in tpu/ outside the counted devprof wrapper: the "
+    "h2d transfer ledger goes blind to this placement site",
+)
+def check_transfer_uncounted(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.iter_modules("nomad_tpu/tpu/"):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name.endswith("device_put"):
+                continue
+            prefix = name.rsplit(".", 1)[0] if "." in name else ""
+            if prefix.rsplit(".", 1)[-1] in _COUNTED_PUT_PREFIXES:
+                continue
+            findings.append(
+                Finding(
+                    "transfer-uncounted", mod.relpath, node.lineno,
+                    f"{name}() bypasses the counted wrapper "
+                    "(devprof.device_put): its bytes never reach the "
+                    "h2d ledger",
+                )
+            )
     return findings
 
 

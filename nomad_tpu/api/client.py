@@ -243,6 +243,13 @@ class ApiClient:
     def trace_critical_path(self, tail: float = 0.99) -> dict:
         return self.get("/v1/trace/critical-path", tail=tail)[0]
 
+    def device_stats(self) -> dict:
+        """The device plane's ``tpu_devprof`` payload from a live
+        server: compile ledger + HLO collective census, transfer
+        totals, collective-round counters (the ``operator device`` CLI
+        surface; OBSERVABILITY.md "The device plane")."""
+        return self.metrics().get("tpu_devprof") or {}
+
     # -- debug plane (OBSERVABILITY.md: profiler / bundles) --------------
     def debug_pprof(self, profile: str = "", seconds: float = None,
                     hz: float = None) -> dict:

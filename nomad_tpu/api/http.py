@@ -1605,6 +1605,16 @@ class HTTPServer:
             # trace plane retention/sampling state (nomad_tpu/trace)
             "trace": _tracer.stats(),
         }
+        # device plane (debug/devprof.py): compile ledger + collective
+        # census + transfer totals + round counters. jax-free reads —
+        # resolving pending round scalars is is_ready-gated, so a
+        # metrics poll can never stall behind an in-flight kernel.
+        try:
+            from ..debug import devprof as _devprof
+
+            payload["tpu_devprof"] = _devprof.snapshot()
+        except Exception:
+            payload["tpu_devprof"] = {}
         # debug plane health (nomad_tpu/debug): flight-recorder depth +
         # watchdog trip counts — the operator's "is the tape running"
         recorder = getattr(self.server, "flight_recorder", None)

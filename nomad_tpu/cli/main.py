@@ -1037,6 +1037,25 @@ def cmd_operator_debug(args):
     return 0
 
 
+def cmd_operator_device(args):
+    """Print the live server's device-plane numbers (`operator
+    device`): compile ledger top-N, collective_rounds_per_placement —
+    the ROADMAP item 2 knee as one number off a running cluster — and
+    the h2d/d2h transfer totals. Reads /v1/metrics' tpu_devprof key via
+    ApiClient.device_stats; -json dumps the raw payload."""
+    from ..debug import devprof
+
+    payload = _client(args).device_stats()
+    if not payload:
+        print("device plane dark (devprof disabled or no TPU dispatches)")
+        return 0
+    if args.as_json:
+        print(json.dumps(payload, indent=1))
+        return 0
+    print(devprof.format_report(payload, top=args.top))
+    return 0
+
+
 def cmd_operator_keygen(args):
     from ..gossip.keyring import generate_key
 
@@ -1557,6 +1576,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="tarball path (default nomad-tpu-debug-<timestamp>.tar.gz)",
     )
     odbg.set_defaults(fn=cmd_operator_debug)
+    odev = opsub.add_parser(
+        "device",
+        help="device-plane stats: compile ledger, collective rounds, "
+        "transfer totals (debug/devprof.py)",
+    )
+    odev.add_argument(
+        "-top", type=int, default=8,
+        help="compile-ledger rows to print (default 8)",
+    )
+    odev.add_argument(
+        "-json", action="store_true", dest="as_json",
+        help="dump the raw tpu_devprof payload",
+    )
+    odev.set_defaults(fn=cmd_operator_device)
     okg = opsub.add_parser("keygen", help="generate a gossip encryption key")
     okg.set_defaults(fn=cmd_operator_keygen)
     okr = opsub.add_parser("keyring", help="manage the gossip keyring")

@@ -1,7 +1,7 @@
 """Operator debug plane (the ``nomad operator debug`` + pprof-handlers
 role): continuous profiling, flight recorder, watchdog, debug bundles.
 
-Four parts, layered:
+Five parts, layered:
 
 - :mod:`.profiler` — pure-stdlib sampling wall-clock profiler
   (``sys._current_frames`` at ~100Hz, thread-name classified, folded
@@ -10,8 +10,14 @@ Four parts, layered:
   pre-incident tape) + the ONE shared process sampler;
 - :mod:`.watchdog` — cheap rules over the recorder; trips counted and
   (with a ``bundle_dir``) auto-captured;
+- :mod:`.devprof`  — the device plane: compile ledger + HLO collective
+  census, h2d/d2h transfer accounting, and the collective-round
+  counter distilled to ``collective_rounds_per_placement`` (ROADMAP
+  item 2's instrument; ``operator device`` CLI + ``tpu_devprof`` in
+  /v1/metrics);
 - :mod:`.bundle`   — the artifact: profiles + flight dump + slowest
-  traces + metrics + redacted config + findings, dir or tarball.
+  traces + metrics + redacted config + device plane + findings, dir or
+  tarball.
 
 Surfaces: ``/debug/pprof/profile?seconds=N`` and ``/v1/debug/bundle``
 (both ``enable_debug``-gated, agent:read), ``nomad-tpu operator

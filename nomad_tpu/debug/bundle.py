@@ -13,8 +13,12 @@ Contents (all JSON except the flamegraph-ready ``profile.folded``):
   the same data as flamegraph input);
 - ``traces.json``    — slowest-N + error traces from the trace store;
 - ``lockdep.json``   — contention table + violations (when installed);
+- ``device.json``    — the device plane (debug/devprof.py): compile
+  ledger with per-executable cost + HLO collective census, transfer
+  totals, per-planner round counters, last-dispatch table;
 - ``findings.json``  — the analysis layer: applier_block_frac, top
-  blocked sites, watchdog state, trace critical-path verdict.
+  blocked sites, watchdog state, trace critical-path verdict, and the
+  distilled devprof summary (collective_rounds_per_placement).
 
 Captured by the watchdog on a rule trip, by ``nomad-tpu operator
 debug`` / ``GET /v1/debug/bundle`` on demand, and by scripts/debug.sh.
@@ -44,6 +48,7 @@ BUNDLE_FILES = (
     "profile.folded",
     "traces.json",
     "lockdep.json",
+    "device.json",
     "findings.json",
 )
 
@@ -166,11 +171,24 @@ def capture_bundle(
         dest, "lockdep.json", section("lockdep", lockdep_dump) or {}
     )
 
+    def device():
+        from . import devprof
+
+        return devprof.snapshot()
+
+    _write_json(dest, "device.json", section("device", device) or {})
+
     def findings():
         out = {
             "applier_block_frac": prof.get("applier_block_frac"),
             "top_blocked_sites": prof.get("blocked_sites", [])[:10],
         }
+        try:
+            from . import devprof
+
+            out["device"] = devprof.summary()
+        except Exception:
+            out["device"] = None
         watchdog = getattr(server, "watchdog", None)
         if watchdog is not None:
             out["watchdog"] = watchdog.stats()

@@ -131,6 +131,22 @@ def sample_process(server) -> dict:
         sample["trace_retained"] = ts.get("retained", 0)
     except Exception:
         pass
+    # device plane (debug/devprof.py): compile-cache growth over the
+    # flight tail is the recompile_storm rule's signal (the
+    # 51200-vs-50176 shape-drift class re-paying compiles in steady
+    # state); transfer + collective-round totals ride along. All three
+    # reads are jax-free — compile_cache_size is sys.modules-gated, so
+    # a server that never touched the TPU tier samples a constant 0.
+    try:
+        from . import devprof
+
+        dp = devprof.totals()
+        sample["compile_cache_size"] = devprof.compile_cache_size()
+        sample["h2d_bytes"] = dp["h2d_bytes"]
+        sample["d2h_bytes"] = dp["d2h_bytes"]
+        sample["collective_rounds"] = dp["collective_rounds"]
+    except Exception:
+        pass
     # federation signals: which region this process serves, cross-region
     # forwarding counters, and — on replicating (non-authoritative ACL)
     # servers only — how far behind the authoritative region this one is.

@@ -38,7 +38,13 @@ Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
   severed WAN or dead authoritative leader becomes a bundle whose
   findings carry the per-region replication/forwarding stats. The rule
   keys off ``acl_replication_lag_s``, which only replicating servers
-  emit — single-region clusters never see it.
+  emit — single-region clusters never see it;
+- ``recompile_storm`` — planner compile-cache growth of ≥ ``growth``
+  entries across the flight tail while the server is PAST its warmup
+  (evals already processed before the window opened — the prewarm
+  ladder's legitimate boot-time compiles never trip it): the
+  51200-vs-50176 shape-drift class silently re-paying XLA compiles in
+  steady state becomes a bundle whose device section names the shapes.
 
 Trips are always recorded + counted (``debug.watchdog_trips``); the
 bundle write additionally needs a configured ``bundle_dir`` so a
@@ -69,6 +75,7 @@ DEFAULT_RULES = {
                         "min_span_s": 5.0},
     "subscriber_lag": {"threshold": 10_000, "consecutive": 5},
     "acl_replication_lag": {"threshold_s": 30.0, "consecutive": 3},
+    "recompile_storm": {"growth": 4, "window": 60, "min_span_s": 10.0},
 }
 
 MAX_TRIP_LOG = 64
@@ -210,6 +217,34 @@ class Watchdog:
                 "threshold_s": p["threshold_s"],
                 "failures": sample.get("acl_replication_failures"),
                 "region": sample.get("region"),
+            }
+        return None
+
+    def _rule_recompile_storm(self, sample, window, p):
+        tail = window[-int(p["window"]):]
+        if (
+            len(tail) < 2
+            or tail[-1]["t"] - tail[0]["t"] < p["min_span_s"]
+            or "compile_cache_size" not in tail[-1]
+            or "compile_cache_size" not in tail[0]
+        ):
+            return None
+        # warmup gate: the prewarm ladder legitimately compiles a burst
+        # of programs at boot — growth only counts once the server had
+        # ALREADY processed evals before this window opened (a storm in
+        # steady state is drift, the same signal the trace plane's
+        # [recompile]-flagged spans carry per-dispatch)
+        if tail[0].get("evals_processed", 0) <= 0:
+            return None
+        growth = (
+            tail[-1]["compile_cache_size"] - tail[0]["compile_cache_size"]
+        )
+        if growth >= p["growth"]:
+            return {
+                "cache_growth": growth,
+                "cache_size": sample.get("compile_cache_size"),
+                "threshold": p["growth"],
+                "span_s": round(tail[-1]["t"] - tail[0]["t"], 2),
             }
         return None
 

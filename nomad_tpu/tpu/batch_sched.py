@@ -93,6 +93,17 @@ def _compact_template(d: dict) -> dict:
 _MISS = object()
 
 
+def _tag_device_span(span, planner: str, mode: str):
+    """Stamp a solo eval.plan_kernel span with the dispatched mode and
+    the executable's devprof ledger stats (flops / bytes / collective
+    census totals) — the device-plane cost readable span-locally."""
+    from ..debug import devprof
+
+    span.set_tag("mode", mode)
+    for k, v in devprof.dispatch_tags(planner).items():
+        span.set_tag(k, v)
+
+
 def _pad_to(x: np.ndarray, size: int, fill=0):
     if x.shape[0] == size:
         return x
@@ -318,8 +329,10 @@ class TPUBatchScheduler(GenericScheduler):
         span_mesh = _shard.active_mesh(len(nodes))
         if span_mesh is not None:
             span_tags.update(_shard.shard_tags(span_mesh))
-        with tracer.span("eval.plan_kernel", tags=span_tags):
-            self._kernel_placements(place, nodes, by_dc, groups)
+        with tracer.span("eval.plan_kernel", tags=span_tags) as kspan:
+            self._kernel_placements(
+                place, nodes, by_dc, groups, kernel_span=kspan
+            )
 
     # ------------------------------------------------------------------
     def _assemble_groups(
@@ -444,10 +457,15 @@ class TPUBatchScheduler(GenericScheduler):
 
     # ------------------------------------------------------------------
     def _kernel_placements(
-        self, place: list, nodes: list, by_dc: dict, groups: dict
+        self, place: list, nodes: list, by_dc: dict, groups: dict,
+        kernel_span=None,
     ):
         import time
 
+        from ..trace.span import NOOP_SPAN
+
+        if kernel_span is None:
+            kernel_span = NOOP_SPAN
         t_start = time.monotonic()
         ctx = self.ctx
         n_real = len(nodes)
@@ -707,6 +725,9 @@ class TPUBatchScheduler(GenericScheduler):
                     rargs = _shard.put(rargs, aspec, mesh)
                     rinit = _shard.put(rinit, ispec, mesh)
                 else:
+                    from ..debug import devprof as _dp
+
+                    _dp.count_tree_h2d((rargs, rinit))
                     rargs = RunArgs(*[jnp.asarray(a) for a in rargs])
                     rinit = tuple(jnp.asarray(x) for x in rinit)
                 placements = plan_batch_runs(
@@ -727,6 +748,7 @@ class TPUBatchScheduler(GenericScheduler):
                 shards=_shard.mesh_size(mesh),
             )
             _count_mode("runs")
+            _tag_device_span(kernel_span, "runs", "runs")
             # dispatch is async: _materialize builds templates/ids while the
             # device runs, then blocks on the placements
             try:
@@ -770,6 +792,9 @@ class TPUBatchScheduler(GenericScheduler):
                     wused0 = _shard.put(wused0, uspec, mesh)
                     wcoll0 = _shard.put(wcoll0, cspec, mesh)
                 else:
+                    from ..debug import devprof as _dp
+
+                    _dp.count_tree_h2d((wargs, wused0, wcoll0))
                     wargs = WindowArgs(*[jnp.asarray(a) for a in wargs])
                     wused0 = jnp.asarray(wused0)
                     wcoll0 = jnp.asarray(wcoll0)
@@ -792,6 +817,7 @@ class TPUBatchScheduler(GenericScheduler):
                 shards=_shard.mesh_size(mesh),
             )
             _count_mode("windowed")
+            _tag_device_span(kernel_span, "windowed", "windowed")
             try:
                 self._materialize(
                     place, placements, nodes, by_dc, planes_list, g_index,
@@ -837,9 +863,12 @@ class TPUBatchScheduler(GenericScheduler):
                 args = _shard.put(args, aspec, mesh)
                 init = _shard.put(init, sspec, mesh)
             else:
+                from ..debug import devprof as _dp
+
+                _dp.count_tree_h2d((args, init))
                 args = BatchArgs(*[jnp.asarray(a) for a in args])
                 init = BatchState(*[jnp.asarray(s) for s in init])
-            _, placements = plan_batch(args, init, n_real)
+            _, placements = plan_batch(args, init, n_real, n_valid=a_real)
         except Exception as e:
             return degrade_to_exact(f"dispatch: {e}")
         LAST_KERNEL_STATS.update(
@@ -852,6 +881,9 @@ class TPUBatchScheduler(GenericScheduler):
             shards=_shard.mesh_size(mesh),
         )
         _count_mode("exact-scan")
+        _tag_device_span(kernel_span, "exact", "exact-scan")
+        kernel_span.set_tag("collective_rounds", A)
+        kernel_span.set_tag("placements", a_real)
         try:
             self._materialize(
                 place, placements, nodes, by_dc, planes_list, g_index,
@@ -1066,10 +1098,18 @@ class TPUBatchScheduler(GenericScheduler):
         # the device sync point: an async XLA failure (device error, NaN
         # trip) surfaces here, BEFORE any scheduler state is mutated — so
         # the degrade path can safely replan from scratch
+        was_device = hasattr(placements, "sharding")
         try:
             placements = np.asarray(placements)
         except Exception as e:
             raise KernelFault(f"device sync: {e}") from e
+        if was_device:
+            # solo-path materialization: THE d2h transfer of this eval's
+            # placements (drain slices count theirs at record_kernel;
+            # the exact-np oracle path never had a device array)
+            from ..debug import devprof
+
+            devprof.count_d2h(placements.nbytes)
         if t_dispatch is not None:
             LAST_KERNEL_STATS["kernel_s"] = time.monotonic() - t_dispatch
 

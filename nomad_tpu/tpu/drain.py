@@ -482,11 +482,20 @@ class KernelBatchCollector:
             args = _shard.put(args, aspec, mesh)
             init = _shard.put(init, sspec, mesh)
         else:
+            from ..debug import devprof as _devprof_put
+
+            # the single-chip upload path: leaves go up via jnp.asarray
+            # without passing the counted wrapper — count the tree here
+            # so the h2d ledger covers both flavors
+            _devprof_put.count_tree_h2d((args, init))
             args = BatchArgs(*[jnp.asarray(a) for a in args])
             init = BatchState(*[jnp.asarray(s) for s in init])
         t_build = time.monotonic()
         cache_before = compile_cache_size()
-        _, placements = plan_batch(args, init, n_real)
+        # n_valid: the devprof round counter charges the fused scan's
+        # rounds against the REAL placements asked for, not the padded
+        # lane count (rounds_per_placement ≈ A/A_real ≥ 1.0 today)
+        _, placements = plan_batch(args, init, n_real, n_valid=A_real)
 
         # per-eval usage bases computed ON DEVICE in the same dispatch
         # wave (double-buffering: the parked threads wake NOW, at dispatch
@@ -495,12 +504,13 @@ class KernelBatchCollector:
         # np.asarray is its sync point)
         eval_of = group_eval[groups]
         if mesh is not None:
-            import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..debug import devprof as _devprof
+
             rep = NamedSharding(mesh, P())
-            eval_of_d = jax.device_put(eval_of, rep)
-            n_real_d = jax.device_put(np.int32(n_real), rep)
+            eval_of_d = _devprof.device_put(eval_of, rep)
+            n_real_d = _devprof.device_put(np.int32(n_real), rep)
         else:
             eval_of_d = jnp.asarray(eval_of)
             n_real_d = jnp.int32(n_real)
@@ -536,12 +546,23 @@ class KernelBatchCollector:
             "batch_evals": len(parked),
             "padded": f"E{E}xG{G}xA{A}xN{N}xV{V}",
             "mirror": shared.mirror is not None,
+            # the device-plane cost of this dispatch (devprof): the
+            # exact scan runs one collective round per alloc lane, so a
+            # trace reader sees the convoy size span-locally
+            "collective_rounds": A,
+            "placements": A_real,
         }
         if mesh is not None:
             # shard topology on the dispatch span: an operator reading a
             # trace can tell a sharded dispatch (and its mesh width) from
             # a single-chip one without cross-referencing config
             dispatch_tags.update(_shard.shard_tags(mesh))
+        from ..debug import devprof as _devprof_mod
+
+        # executable cost from the compile ledger (flops / bytes /
+        # collective census totals) — empty when devprof is off or the
+        # program never recorded a compile in this process
+        dispatch_tags.update(_devprof_mod.dispatch_tags("exact"))
         if recompiled:
             dispatch_tags["jit_cache_delta"] = cache_after - cache_before
         for ctx in trace_ctxs:
@@ -568,10 +589,27 @@ class KernelBatchCollector:
             dt = now - t_dispatch
             LAST_DRAIN_STATS["kernel_s"] = dt
             metrics.sample("drain.batch_kernel", dt)
+            # the first consumer sync materializes the batch-wide
+            # placement + usage-base arrays host-side exactly once (jax
+            # caches the host copy; every _LazySlice shares it) — THE
+            # drain path's d2h transfer, counted at the moment it happens
+            from ..debug import devprof as _dp
+
+            _dp.count_d2h(
+                getattr(placements, "nbytes", 0)
+                + getattr(bases, "nbytes", 0),
+                calls=2,
+            )
+            device_tags = {"batch_evals": len(root_ctxs)}
+            device_tags.update(_dp.dispatch_tags("exact"))
+            if mesh is not None:
+                device_tags.update(_shard.shard_tags(mesh))
+                device_tags["collective_rounds"] = A
+                device_tags["placements"] = A_real
             for ctx in root_ctxs:
                 tracer.record_span(
                     "drain.device_compute", ctx, t_disp, now,
-                    tags={"batch_evals": len(root_ctxs)},
+                    tags=device_tags,
                 )
 
         for e, (park, a_start, a_len) in enumerate(slices):

@@ -25,12 +25,14 @@ Everything is static-shaped; N and A are padded by the caller.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 from . import enable_compile_cache
+from ..debug import devprof as _devprof
 from ..testing import faults as _faults
 
 # must precede every jit compile; this module is the jax entry point for
@@ -368,15 +370,35 @@ def _plan_batch_jit(args: BatchArgs, init: BatchState, n_real: int):
     return final_state, placements
 
 
-def plan_batch(args: BatchArgs, init: BatchState, n_real: int):
+def plan_batch(args: BatchArgs, init: BatchState, n_real: int,
+               n_valid: int = None):
     """Run the placement scan; returns (final_state, node index per alloc
     or -1). The ``tpu.kernel`` fault point models device errors / NaN
     trips (jax debug-nans raises at dispatch) — the scheduler degrades to
-    the exact-np host oracle when this raises."""
+    the exact-np host oracle when this raises.
+
+    ``n_valid`` (optional) is the host-known count of REAL alloc lanes:
+    the devprof round counter then records rounds-per-placement against
+    the placements actually asked for instead of the padded scan length
+    (callers that pad — drain/batch_sched — pass it; a caller whose
+    lanes are all valid can omit it)."""
     _faults.fault_point("tpu.kernel")
-    if deterministic_mode():
-        return _det_call(_plan_batch_jit, "plan_batch", args, init, n_real)
-    return _plan_batch_jit(args, init, n_real)
+    A = int(args.demands.shape[0])
+    key = (
+        f"E{args.perm.shape[0]}G{args.feasible.shape[0]}"
+        f"A{A}N{args.capacity.shape[0]}"
+    )
+    out, sharded = _dispatch(
+        "exact", _plan_batch_jit, (args, init, n_real), key
+    )
+    # the exact scan IS the sequential fill loop: one scan step per
+    # alloc lane, each step a full-ring score + argmax — under a mesh,
+    # one cross-shard collective round per lane (the ROADMAP item 2
+    # hypothesis, measured instead of asserted)
+    _devprof.count_rounds(
+        "exact", A, A if n_valid is None else int(n_valid), sharded
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -450,13 +472,12 @@ def deterministic_scope():
     return scope()
 
 
-def _det_call(jitfn, name, *call_args):
-    """Dispatch ``jitfn(*call_args)`` through an AOT executable compiled
-    with :data:`DET_COMPILER_OPTIONS`, cached per input signature —
+def _det_key(name, call_args):
+    """The deterministic-executable cache key for a call signature —
     shapes, dtypes AND shardings, so a sharded call never reuses an
-    unsharded executable. Python ints/bools in ``call_args`` are the
-    jits' static arguments: they select the lowering and are NOT passed
-    to the compiled executable."""
+    unsharded executable. Shared by ``_det_call`` and the devprof
+    compile ledger (which fetches the freshly-minted executable by the
+    same key to census it)."""
 
     def leaf_key(x):
         sharding = getattr(x, "sharding", None)
@@ -466,14 +487,23 @@ def _det_call(jitfn, name, *call_args):
 
     statics = tuple(a for a in call_args if isinstance(a, (int, bool)))
     dynamic = tuple(a for a in call_args if not isinstance(a, (int, bool)))
-    key = (
+    return (
         name,
         statics,
         tuple(
             leaf_key(x)
             for x in jax.tree_util.tree_leaves(dynamic)
         ),
-    )
+    ), dynamic
+
+
+def _det_call(jitfn, name, *call_args):
+    """Dispatch ``jitfn(*call_args)`` through an AOT executable compiled
+    with :data:`DET_COMPILER_OPTIONS`, cached per input signature (see
+    :func:`_det_key`). Python ints/bools in ``call_args`` are the jits'
+    static arguments: they select the lowering and are NOT passed to
+    the compiled executable."""
+    key, dynamic = _det_key(name, call_args)
     exe = _DET_EXECUTABLES.get(key)
     if exe is None:
         exe = jitfn.lower(*call_args).compile(
@@ -481,6 +511,62 @@ def _det_call(jitfn, name, *call_args):
         )
         _DET_EXECUTABLES[key] = exe
     return exe(*dynamic)
+
+
+def _jit_cache_size(jitfn) -> int:
+    try:
+        return jitfn._cache_size()
+    except Exception:
+        return -1  # detector degrades (no compile events), never breaks
+
+
+def _dispatch(planner: str, jitfn, call_args: tuple, shape_key: str,
+              allow_det: bool = True):
+    """One planner dispatch through the devprof compile ledger: route to
+    the deterministic or fast flavor, detect a compile via the per-fn
+    cache delta, and hand the executable to devprof for cost analysis +
+    the HLO collective census. For the fast flavor the analysis hook is
+    ``jitfn.lower(args).compile()`` — AFTER the triggering call that is
+    a C++ dispatch-cache hit returning the SAME executable, never a
+    second XLA compile. Returns ``(result, sharded)``; with devprof
+    disabled this is exactly the old two-branch dispatch.
+    ``allow_det=False`` pins the fast flavor (verify_rows: its boolean
+    verdicts are not part of the bit-parity contract, and a det AOT
+    compile inside a parity window would be pure waste)."""
+    det = allow_det and deterministic_mode()
+    if not _devprof.enabled():
+        if det:
+            return _det_call(jitfn, planner, *call_args), False
+        return jitfn(*call_args), False
+    flavor = "det" if det else "fast"
+    sharded = _devprof.tree_sharded(call_args)
+    if det:
+        # detect via THIS dispatch's own key, not the global cache
+        # length — a concurrent det dispatch of another planner growing
+        # the dict must not mint a phantom compile entry here
+        dkey = _det_key(planner, call_args)[0]
+        was_missing = dkey not in _DET_EXECUTABLES
+        t0 = time.monotonic()
+        out = _det_call(jitfn, planner, *call_args)
+        if was_missing and dkey in _DET_EXECUTABLES:
+            _devprof.record_compile(
+                planner, shape_key, sharded, flavor,
+                time.monotonic() - t0,
+                compiled=_DET_EXECUTABLES.get(dkey),
+            )
+    else:
+        before = _jit_cache_size(jitfn)
+        t0 = time.monotonic()
+        out = jitfn(*call_args)
+        after = _jit_cache_size(jitfn)
+        if before >= 0 and after > before:
+            _devprof.record_compile(
+                planner, shape_key, sharded, flavor,
+                time.monotonic() - t0,
+                compile_fn=lambda: jitfn.lower(*call_args).compile(),
+            )
+    _devprof.record_dispatch(planner, shape_key, sharded, flavor)
+    return out, sharded
 
 
 def compile_cache_size() -> int:
@@ -600,14 +686,24 @@ def plan_batch_runs(
     even_mode: bool = False,
 ):
     """Place ``n_allocs`` identical asks under full-ring (limit=∞) selection;
-    returns node index per alloc slot (length ``a_pad``, -1 = unplaced)."""
+    returns node index per alloc slot (length ``a_pad``, -1 = unplaced).
+
+    The jit additionally returns its while-loop trip count — the number
+    of sequential device rounds (each one full-ring score + reduction;
+    under a mesh, one cross-shard collective round). The wrapper feeds
+    it to the devprof round counter as a LAZY device scalar (recording
+    never syncs) and hands callers only the placements, unchanged."""
     _faults.fault_point("tpu.kernel")
-    if deterministic_mode():
-        return _det_call(
-            _plan_batch_runs_jit, "plan_batch_runs", args, init, a_pad,
-            even_mode,
+    key = f"N{args.capacity.shape[0]}A{a_pad}"
+    out, sharded = _dispatch(
+        "runs", _plan_batch_runs_jit, (args, init, a_pad, even_mode), key
+    )
+    placements, rounds = out
+    if _devprof.enabled():
+        _devprof.count_rounds(
+            "runs", rounds, int(args.n_allocs), sharded
         )
-    return _plan_batch_runs_jit(args, init, a_pad, even_mode)
+    return placements
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -655,7 +751,7 @@ def _plan_batch_runs_jit(
         return score, num
 
     def body(state):
-        used, coll, counts, present, placed, placements, _ = state
+        used, coll, counts, present, placed, placements, _, rounds = state
 
         fit = args.feasible & jnp.all(
             used + args.demand[None, :] <= args.capacity, axis=1
@@ -788,10 +884,14 @@ def _plan_batch_runs_jit(
             placed,
             placements,
         )
-        return used, coll, counts, present, placed, placements, any_avail
+        # rounds = while-loop trips: the device-loop round count the
+        # devprof collective counter reads (one cross-shard reduction
+        # set per round when sharded)
+        return (used, coll, counts, present, placed, placements,
+                any_avail, rounds + 1)
 
     def cond(state):
-        _, _, _, _, placed, _, progress = state
+        _, _, _, _, placed, _, progress, _ = state
         return (placed < args.n_allocs) & progress
 
     placements0 = jnp.full(a_pad + 1, -1, dtype=jnp.int32)
@@ -803,9 +903,10 @@ def _plan_batch_runs_jit(
         jnp.int32(0),
         placements0,
         jnp.bool_(True),
+        jnp.int32(0),
     )
-    *_, placements, _ = jax.lax.while_loop(cond, body, init_state)
-    return placements[:a_pad]
+    *_, placements, _, rounds = jax.lax.while_loop(cond, body, init_state)
+    return placements[:a_pad], rounds
 
 
 class WindowArgs(NamedTuple):
@@ -824,14 +925,23 @@ def plan_batch_windowed(
     n_real: int, a_pad: int
 ):
     """Place ``n_allocs`` identical asks; returns node index per alloc slot
-    (length ``a_pad``, -1 = unplaced)."""
+    (length ``a_pad``, -1 = unplaced). Like :func:`plan_batch_runs`, the
+    jit also returns its while-loop trip count, recorded to the devprof
+    round counter (the windowed planner already resolves one WINDOW of
+    placements per round — its rounds-per-placement is the existing
+    counter-example to the one-collective-per-placement ceiling)."""
     _faults.fault_point("tpu.kernel")
-    if deterministic_mode():
-        return _det_call(
-            _plan_batch_windowed_jit, "plan_batch_windowed", args, used0,
-            collisions0, n_real, a_pad,
+    key = f"N{args.capacity.shape[0]}A{a_pad}"
+    out, sharded = _dispatch(
+        "windowed", _plan_batch_windowed_jit,
+        (args, used0, collisions0, n_real, a_pad), key,
+    )
+    placements, rounds = out
+    if _devprof.enabled():
+        _devprof.count_rounds(
+            "windowed", rounds, int(args.n_allocs), sharded
         )
-    return _plan_batch_windowed_jit(args, used0, collisions0, n_real, a_pad)
+    return placements
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -846,11 +956,11 @@ def _plan_batch_windowed_jit(
     L = args.limit
 
     def cond(state):
-        _, _, _, placed, _, progress = state
+        _, _, _, placed, _, progress, _ = state
         return (placed < args.n_allocs) & progress
 
     def body(state):
-        used, collisions, offset, placed, placements, _ = state
+        used, collisions, offset, placed, placements, _, rounds = state
 
         fit_nodes = args.feasible & jnp.all(
             used + args.demand[None, :] <= args.capacity, axis=1
@@ -919,7 +1029,8 @@ def _plan_batch_windowed_jit(
 
         placed = placed + w_use
         progress = w_use > 0
-        return used, collisions, offset, placed, placements, progress
+        return (used, collisions, offset, placed, placements, progress,
+                rounds + 1)
 
     placements0 = jnp.full(a_pad, -1, dtype=jnp.int32)
     init = (
@@ -929,9 +1040,10 @@ def _plan_batch_windowed_jit(
         jnp.int32(0),
         placements0,
         jnp.bool_(True),
+        jnp.int32(0),
     )
-    *_, placements, _ = jax.lax.while_loop(cond, body, init)
-    return placements
+    *_, placements, _, rounds = jax.lax.while_loop(cond, body, init)
+    return placements, rounds
 
 
 # ---------------------------------------------------------------------------
@@ -960,9 +1072,17 @@ def verify_rows(capacity, used, rows, deltas):
     """Dispatch the dense verify; the ``tpu.kernel`` fault point models
     device errors exactly as it does for the planner kernels — the
     applier degrades the whole plan to the host oracle when this
-    raises."""
+    raises. Rides the devprof compile ledger like the planners (an
+    applier verify shape that escapes the warmup prewarm is a compile
+    event the ledger names), but records no rounds: the verify is one
+    scatter+compare, not a fill loop."""
     _faults.fault_point("tpu.kernel")
-    return _verify_rows_jit(capacity, used, rows, deltas)
+    key = f"N{capacity.shape[0]}R{rows.shape[0]}"
+    out, _ = _dispatch(
+        "verify_rows", _verify_rows_jit, (capacity, used, rows, deltas),
+        key, allow_det=False,
+    )
+    return out
 
 
 #: the jitted planners, by mode name — the one enumeration shared by the

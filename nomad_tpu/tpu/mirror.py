@@ -183,7 +183,7 @@ class DeviceState:
 
     def __init__(self, epoch: int, n_pad: int, capacity, usable, used,
                  mesh=None):
-        import jax
+        from ..debug import devprof as _devprof
 
         self.epoch = epoch
         self.n_pad = n_pad
@@ -205,13 +205,13 @@ class DeviceState:
             from . import shard as _shard
 
             rows = NamedSharding(mesh, P(_shard.AXIS, None))
-            self.capacity = jax.device_put(cap, rows)
-            self.usable = jax.device_put(usa, rows)
-            self.used = jax.device_put(use, rows)
+            self.capacity = _devprof.device_put(cap, rows)
+            self.usable = _devprof.device_put(usa, rows)
+            self.used = _devprof.device_put(use, rows)
         else:
-            self.capacity = jax.device_put(cap)
-            self.usable = jax.device_put(usa)
-            self.used = jax.device_put(use)
+            self.capacity = _devprof.device_put(cap)
+            self.usable = _devprof.device_put(usa)
+            self.used = _devprof.device_put(use)
         self.pending: set[int] = set()
 
     @staticmethod
@@ -225,7 +225,7 @@ class DeviceState:
         """Push pending dirty rows to the device as one scatter update."""
         if not self.pending:
             return
-        import jax
+        from ..debug import devprof as _devprof
 
         rows = np.fromiter(self.pending, dtype=np.int32, count=len(self.pending))
         self.pending.clear()
@@ -240,11 +240,11 @@ class DeviceState:
             # host array next to the sharded plane would hand XLA a
             # layout choice the prewarmed scatter never compiled
             rep = NamedSharding(self.mesh, P())
-            padded_d = jax.device_put(padded, rep)
-            vals_d = jax.device_put(vals, rep)
+            padded_d = _devprof.device_put(padded, rep)
+            vals_d = _devprof.device_put(vals, rep)
         else:
-            padded_d = jax.device_put(padded)
-            vals_d = jax.device_put(vals)
+            padded_d = _devprof.device_put(padded)
+            vals_d = _devprof.device_put(vals)
         self.used = _scatter_fn(self.mesh)(self.used, padded_d, vals_d)
 
     def arrays(self):

@@ -333,14 +333,34 @@ def bench_multichip(
     planners: dict[str, dict] = {}
 
     def score(name, run_plain, run_sharded):
+        from ..debug import devprof
+
         # production arms: warm (compiles, or loads from the persistent
         # cache), then timed best-of-N with the recompile pin
         want = np.asarray(run_plain())
         got_warm = np.asarray(run_sharded())
         t_plain = _time_best(lambda: np.asarray(run_plain()), samples)
         cache0 = compile_cache_size()
+        # per-planner comm breakdown: the devprof round counter diffed
+        # around the sharded timed arm gives this planner's collective
+        # rounds per dispatch — the number the wavefront rewrite (item
+        # 2) must push from ~placements toward placements/K
+        rounds0 = devprof.rounds_snapshot().get(name, {})
         t_shard = _time_best(lambda: np.asarray(run_sharded()), samples)
+        rounds1 = devprof.rounds_snapshot().get(name, {})
         cache1 = compile_cache_size()
+
+        def _delta(key):
+            return rounds1.get(key, 0) - rounds0.get(key, 0)
+
+        s_disp = _delta("sharded_dispatches")
+        s_rounds = _delta("sharded_rounds")
+        s_place = _delta("sharded_placements")
+        census = {}
+        for e in devprof.snapshot()["compile_ledger"]:
+            if e["planner"] == name and e["sharded"] and e["collectives"]:
+                census = e["collectives"]
+                break
         got = np.asarray(run_sharded())
         placed = int((want >= 0).sum())
         # fast-pair agreement (informational): two different fused
@@ -376,6 +396,19 @@ def bench_multichip(
                 cache1 - cache0 if cache0 >= 0 and cache1 >= 0 else None
             ),
             "warm_equal": bool(np.array_equal(want, got_warm)),
+            # device-plane comm breakdown (debug/devprof.py):
+            # mesh_comm_frac = the sharded wall clock in EXCESS of the
+            # unsharded program — comm + partitioning overhead, exact
+            # when per-shard compute is free and tight on a single-core
+            # virtual mesh where compute can't parallelize at all
+            "mesh_comm_frac": devprof.mesh_comm_frac(t_plain, t_shard),
+            "collective_rounds": (
+                round(s_rounds / s_disp) if s_disp else None
+            ),
+            "collective_rounds_per_placement": (
+                round(s_rounds / s_place, 4) if s_place else None
+            ),
+            "collective_census": census,
         }
 
     n_real = c.get("n_real", n_nodes)
@@ -435,6 +468,17 @@ def bench_multichip(
     ok = all(
         p["parity"] == 1.0 and p["placed"] > 0 for p in planners.values()
     )
+    # headline comm aggregates: overall mesh_comm_frac over the summed
+    # arm pairs, total collective rounds per full planner sweep — the
+    # MULTICHIP_SUMMARY keys ROADMAP item 2's PR will be judged against
+    from ..debug import devprof as _devprof
+
+    t_plain_total = sum(p["unsharded_s"] for p in planners.values())
+    t_shard_total = sum(p["sharded_s"] for p in planners.values())
+    comm_frac = _devprof.mesh_comm_frac(t_plain_total, t_shard_total)
+    rounds_total = sum(
+        p["collective_rounds"] or 0 for p in planners.values()
+    )
     return {
         "n_devices": n_devices,
         "nodes": n_nodes,
@@ -442,6 +486,9 @@ def bench_multichip(
         "seed": seed,
         "samples": samples,
         "planners": planners,
+        "mesh_comm_frac": comm_frac,
+        "collective_rounds": rounds_total,
+        "devprof": _devprof.summary(),
         "ok": ok,
         "skipped": False,
     }
@@ -483,11 +530,17 @@ def summary_line(report: dict) -> str:
         f"allocs={report['allocs']}",
         f"ok={int(report['ok'])}",
     ]
+    if "mesh_comm_frac" in report:
+        parts.append(f"mesh_comm_frac={report['mesh_comm_frac']}")
+        parts.append(f"collective_rounds={report['collective_rounds']}")
     for name, p in report.get("planners", {}).items():
-        parts.append(
+        line = (
             f"{name}={p['sharded_s']}s/x{p['speedup']}"
             f"/parity{p['parity']}/rc{p['recompiles']}"
         )
+        if p.get("collective_rounds_per_placement") is not None:
+            line += f"/crpp{p['collective_rounds_per_placement']}"
+        parts.append(line)
     return "MULTICHIP_SUMMARY " + " ".join(parts)
 
 

@@ -204,11 +204,18 @@ def put(tree, spec_tree, mesh):
 
     ``spec_tree`` mirrors ``tree``'s structure with PartitionSpec leaves
     (a PartitionSpec is itself a tuple, but ``tree``'s structure wins in
-    tree_map, so each spec rides through whole at its leaf position)."""
+    tree_map, so each spec rides through whole at its leaf position).
+
+    Placement routes through ``devprof.device_put`` — THE counted
+    wrapper — so every host→device byte the mesh path moves lands in
+    the transfer ledger (debug/devprof.py; the ``transfer-uncounted``
+    analysis rule keeps this exhaustive)."""
     import jax
     from jax.sharding import NamedSharding
 
+    from ..debug import devprof as _devprof
+
     def _put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _devprof.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(_put, tree, spec_tree)

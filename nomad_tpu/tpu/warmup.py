@@ -259,13 +259,14 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
         eval_of_w = jnp.zeros(A, dtype=jnp.int32)
         n_real_w = jnp.int32(n_nodes)
         if mesh is not None:
-            import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from ..debug import devprof as _devprof
+
             rep = NamedSharding(mesh, P())
-            placements_w = jax.device_put(placements_w, rep)
-            eval_of_w = jax.device_put(eval_of_w, rep)
-            n_real_w = jax.device_put(np.int32(n_nodes), rep)
+            placements_w = _devprof.device_put(placements_w, rep)
+            eval_of_w = _devprof.device_put(eval_of_w, rep)
+            n_real_w = _devprof.device_put(np.int32(n_nodes), rep)
         _used_bases_fn().lower(
             init.used,
             placements_w,

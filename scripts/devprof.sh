@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Device-plane observability walkthrough on an 8-virtual-device CPU
+# mesh (OBSERVABILITY.md "The device plane"): a sharded, traced
+# planning pass drives the devprof instruments end-to-end, then prints
+#
+#   - the compile ledger (per-executable cost + HLO collective census),
+#   - collective_rounds_per_placement (ROADMAP item 2's knee),
+#   - the critical-path verdict — on a sharded run where device
+#     dispatch dominates, it names the cross-shard collective convoy,
+#   - a trailing DEVPROF_SUMMARY line (greppable, like BENCH_SUMMARY).
+#
+# Knobs: DEVPROF_DEVICES (8), DEVPROF_NODES (2048), DEVPROF_ALLOCS
+# (4096). Real-TPU boxes: drop the XLA_FLAGS/JAX_PLATFORMS overrides.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DEVICES="${DEVPROF_DEVICES:-8}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=${DEVICES}}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export NOMAD_TPU_COMPILE_CACHE="${NOMAD_TPU_COMPILE_CACHE:-off}"
+export NOMAD_TPU_SHARD=1
+export NOMAD_TPU_SHARD_MIN_NODES="${NOMAD_TPU_SHARD_MIN_NODES:-512}"
+export BENCH_NODES="${DEVPROF_NODES:-2048}"
+export BENCH_ALLOCS="${DEVPROF_ALLOCS:-4096}"
+export DEVPROF_DEVICES_N="${DEVICES}"
+
+python - <<'EOF'
+import json
+import os
+
+import bench
+from nomad_tpu.debug import devprof
+from nomad_tpu.state import StateStore
+from nomad_tpu.tpu import batch_sched, shard
+from nomad_tpu.trace import attribute, tracer
+
+mesh = shard.configure(int(os.environ["DEVPROF_DEVICES_N"]))
+assert mesh is not None, "mesh did not come up (device count?)"
+
+state = StateStore()
+state.upsert_nodes(1, bench.build_nodes(bench.N_NODES))
+job = bench.build_job(bench.N_ALLOCS, spread=True)
+state.upsert_job(2, job)
+
+# pass 1 — the runs planner (the spread headline path): its fill runs
+# already batch placements per round, so rounds/placement lands well
+# under 1.0 — the counter REFUTES the per-placement hypothesis for this
+# planner, with data
+bench.run_once(state, job)  # warm: compiles land in the ledger
+elapsed_runs, _ = bench.run_once(state, job)
+
+# pass 2 — the exact sequential scan (the fused-drain semantics, where
+# the hypothesis lives): one collective round per alloc lane. Traced,
+# so the dispatch spans carry the shard topology + round tags and the
+# critical-path verdict can name the convoy.
+batch_sched.EXACT_ONLY = True
+try:
+    bench.run_once(state, job)  # warm the exact-scan mesh layout
+    tracer.reset()
+    # a root finished through the eval lifecycle path so the trace is
+    # RETAINED (tracer.root's lexically-scoped spans stay open-ended;
+    # retention is what attribute() reads)
+    root = tracer.start_root("devprof.sh")
+    with tracer.activate(root.ctx()):
+        elapsed, placed = bench.run_once(state, job)
+    tracer.finish_root(root)
+finally:
+    batch_sched.EXACT_ONLY = False
+
+report = attribute(tracer.store.records())
+snap = devprof.snapshot()
+summ = snap["summary"]
+
+print(devprof.format_report(snap))
+print()
+print(f"runs-planner pass: {elapsed_runs:.3f}s (rounds batch via fill runs)")
+print(f"traced exact-scan pass: {elapsed:.3f}s, {len(placed)} placements")
+print(f"critical-path verdict: {report['verdict']}")
+print(f"mesh spans: {json.dumps(report['mesh'])}")
+print(
+    "DEVPROF_SUMMARY "
+    f"devices={mesh.devices.size} "
+    f"nodes={bench.N_NODES} allocs={bench.N_ALLOCS} "
+    f"collective_rounds={summ['collective_rounds']} "
+    f"collective_rounds_per_placement={summ['collective_rounds_per_placement']} "
+    f"compile_s_total={summ['compile_s_total']} "
+    f"h2d_mb={summ['h2d_mb']} d2h_mb={summ['d2h_mb']} "
+    f"census_collective_ops={summ['census_collective_ops']} "
+    f"convoy_named={int('collective convoy' in report['verdict'])}"
+)
+EOF

@@ -423,6 +423,59 @@ class TestRaftHygiene:
 # ----------------------------------------------------------------------
 
 
+class TestTransferUncounted:
+    """transfer-uncounted: raw device_put in tpu/ must route through
+    the counted devprof wrapper or the h2d ledger goes blind."""
+
+    def test_raw_jax_device_put_flagged(self):
+        src = (
+            "import jax\n"
+            "def push(x, s):\n"
+            "    return jax.device_put(x, s)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "transfer-uncounted"
+        )
+        assert len(found) == 1 and found[0].line == 3
+
+    def test_counted_wrapper_clean(self):
+        src = (
+            "from ..debug import devprof as _devprof\n"
+            "def push(x, s):\n"
+            "    return _devprof.device_put(x, s)\n"
+            "def push2(x, s):\n"
+            "    from ..debug import devprof\n"
+            "    return devprof.device_put(x, s)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "transfer-uncounted"
+        )
+
+    def test_outside_tpu_scope_exempt(self):
+        src = (
+            "import jax\n"
+            "def push(x):\n"
+            "    return jax.device_put(x)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/fix.py": src}, "transfer-uncounted"
+        )
+
+    def test_suppression_honored(self):
+        src = (
+            "import jax\n"
+            "def push(x, s):\n"
+            "    # nta: ignore[transfer-uncounted] WHY: fixture\n"
+            "    return jax.device_put(x, s)\n"
+        )
+        project = Project.from_sources({"nomad_tpu/tpu/fix.py": src})
+        found = [
+            f for f in run(project, ["transfer-uncounted"])
+            if f.rule == "transfer-uncounted"
+        ]
+        assert not found
+
+
 class TestImports:
     def test_top_level_cycle_flagged_deferred_clean(self):
         cyc = {
