@@ -541,3 +541,76 @@ class TestSnapshotRestore:
             last = index
             for e in events:
                 assert e.index == index, (e.topic, e.type, e.index, index)
+
+
+class TestSnapshotRestoreOrdering:
+    """ref fsm_test.go TestFSM_SnapshotRestore ordering slices: Restore
+    replaces state wholesale (not a merge), the follower's event ring
+    resets to the snapshot index, and a restored FSM is a per-table
+    fixpoint of the one that produced the snapshot."""
+
+    def _populate(self, h):
+        node = _registered_node(h)
+        job = _registered_job(h)
+        ev = mock.evaluation()
+        ev.job_id = job.id
+        h.apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.node_id = node.id
+        h.apply(fsm_mod.ALLOC_UPDATE, {"allocs": [a.to_dict()]})
+        return node, job, ev, a
+
+    def test_restore_replaces_not_merges(self, h):
+        """A follower with divergent local state that installs a snapshot
+        must end up with EXACTLY the snapshot's world — objects absent
+        from the snapshot are gone, not merged in (fsm.go Restore blows
+        away the state store before loading)."""
+        node, job, ev, a = self._populate(h)
+        snap = h.fsm.snapshot()
+        # divergent follower: different objects at overlapping indexes
+        follower = Harness()
+        stray_node = _registered_node(follower)
+        stray_job = _registered_job(follower)
+        follower.fsm.restore(snap)
+        st = follower.state
+        assert st.node_by_id(stray_node.id) is None
+        assert st.job_by_id("default", stray_job.id) is None
+        assert st.node_by_id(node.id) is not None
+        assert st.alloc_by_id(a.id) is not None
+        assert st.latest_index() == h.state.latest_index()
+
+    def test_restore_resets_event_ring_to_snapshot_index(self, h):
+        self._populate(h)
+        snap = h.fsm.snapshot()
+        restored = snap["index"]
+        follower = Harness()
+        _registered_node(follower)
+        follower.fsm.restore(snap)
+        # the ring restarts at the restored index: a post-restore
+        # subscriber sees exactly the applies after the snapshot, never
+        # a stale pre-restore frame
+        sub = follower.broker.subscribe()
+        follower.fsm.apply(
+            restored + 1, fsm_mod.JOB_REGISTER, {"job": mock.job().to_dict()}
+        )
+        frame = sub.next(timeout=1.0)
+        assert frame is not None and frame[0] == restored + 1
+
+    def test_restored_fsm_is_a_persist_fixpoint(self, h):
+        self._populate(h)
+        snap = h.fsm.snapshot()
+        f2 = FSM()
+        f2.restore(snap)
+        assert f2.snapshot() == snap
+
+    def test_applies_resume_past_restored_index(self, h):
+        node, *_ = self._populate(h)
+        snap = h.fsm.snapshot()
+        f2 = FSM()
+        f2.restore(snap)
+        base = f2.state.latest_index()
+        f2.apply(base + 1, fsm_mod.NODE_DEREGISTER, {"node_id": node.id})
+        assert f2.state.latest_index() == base + 1
+        assert f2.state.node_by_id(node.id) is None

@@ -777,3 +777,276 @@ class TestPersistRestore:
         assert fresh.eval_by_id(e.id).create_index == 1003
         # fixpoint: persisting the restored store changes nothing
         assert fresh.persist() == blob
+
+
+class TestPersistRestorePerTable:
+    """ref state_store_test.go TestStateStore_Restore* family: every table
+    round-trips through persist()/restore() with its documents AND its
+    per-table index intact — restore-then-persist is a per-table fixpoint."""
+
+    def _round_trip(self, s):
+        blob = s.persist()
+        fresh = StateStore()
+        fresh.restore(blob)
+        assert fresh.persist() == blob
+        return fresh
+
+    def test_restore_node(self):
+        from nomad_tpu.structs.model import DrainStrategy
+
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(5, n)
+        s.update_node_drain(6, n.id, True, strategy=DrainStrategy(deadline=7))
+        s.update_node_eligibility(7, n.id, "ineligible")
+        fresh = self._round_trip(s)
+        got = fresh.node_by_id(n.id)
+        assert got.to_dict() == s.node_by_id(n.id).to_dict()
+        assert got.drain_strategy is not None
+        assert got.drain_strategy.deadline == 7
+        assert [e["message"] for e in got.events] == [
+            e["message"] for e in s.node_by_id(n.id).events
+        ]
+        assert fresh.table_index("nodes") == 7
+
+    def test_restore_job_and_version_history(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(10, j)
+        j2 = j.copy()
+        j2.priority = 99
+        s.upsert_job(11, j2)
+        fresh = self._round_trip(s)
+        assert fresh.job_by_id(j.namespace, j.id).version == 1
+        versions = fresh.job_versions(j.namespace, j.id)
+        assert [v.version for v in versions] == [1, 0]
+        assert (
+            fresh.job_by_id_and_version(j.namespace, j.id, 0).priority
+            == j.priority
+        )
+        assert fresh.table_index("jobs") == 11
+        assert fresh.table_index("job_version") == 11
+
+    def test_restore_job_summary(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        s.upsert_allocs(2, [a])
+        fresh = self._round_trip(s)
+        summary = fresh.job_summary_by_id(a.namespace, a.job_id)
+        assert summary.to_dict() == (
+            s.job_summary_by_id(a.namespace, a.job_id).to_dict()
+        )
+        assert summary.summary[a.task_group].starting == 1
+
+    def test_restore_evals(self):
+        s = StateStore()
+        e = mock.evaluation()
+        s.upsert_evals(4, [e])
+        fresh = self._round_trip(s)
+        assert fresh.eval_by_id(e.id).to_dict() == s.eval_by_id(e.id).to_dict()
+        assert fresh.table_index("evals") == 4
+
+    def test_restore_allocs_with_client_state(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_job(1, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        s.upsert_allocs(2, [a])
+        up = a.copy()
+        up.client_status = "running"
+        s.update_allocs_from_client(3, [up])
+        fresh = self._round_trip(s)
+        got = fresh.alloc_by_id(a.id)
+        assert got.client_status == "running"
+        assert got.create_index == 2 and got.modify_index == 3
+        assert fresh.table_index("allocs") == 3
+
+    def test_restore_deployments(self):
+        s = StateStore()
+        d = mock.deployment()
+        s.upsert_deployment(8, d)
+        fresh = self._round_trip(s)
+        assert (
+            fresh.deployment_by_id(d.id).to_dict()
+            == s.deployment_by_id(d.id).to_dict()
+        )
+        assert fresh.table_index("deployment") == 8
+
+    def test_restore_periodic_launch(self):
+        s = StateStore()
+        j = mock.periodic_job()
+        s.upsert_job(1, j)
+        s.upsert_periodic_launch(2, j.namespace, j.id, 12345)
+        fresh = self._round_trip(s)
+        launch = fresh.periodic_launch_by_id(j.namespace, j.id)
+        assert launch["launch"] == 12345
+        assert fresh.table_index("periodic_launch") == 2
+
+    def test_restore_acl_and_vault_tables(self):
+        from nomad_tpu.structs.model import AclPolicy, AclToken
+
+        s = StateStore()
+        s.upsert_acl_policies(1, [AclPolicy(name="ops", rules="x")])
+        s.upsert_acl_tokens(
+            2, [AclToken(accessor_id="acc", secret_id="sec")], bootstrap=True
+        )
+        s.upsert_vault_accessors(3, [{"accessor": "v1", "alloc_id": "a1"}])
+        fresh = self._round_trip(s)
+        assert fresh.acl_policy_by_name("ops").rules == "x"
+        assert fresh.acl_token_by_accessor("acc").secret_id == "sec"
+        assert fresh.acl_token_by_secret("sec") is not None
+        assert fresh.vault_accessors()[0]["accessor"] == "v1"
+        assert fresh.table_index("acl_bootstrap") == 2
+
+    def test_restore_operator_configs(self):
+        s = StateStore()
+        s.set_scheduler_config(1, {"preemption": {"batch": True}})
+        s.set_autopilot_config(2, {"cleanup_dead_servers": True})
+        fresh = self._round_trip(s)
+        assert fresh.scheduler_config() == {"preemption": {"batch": True}}
+        assert fresh.autopilot_config() == {"cleanup_dead_servers": True}
+
+    def test_restore_preserves_every_table_index(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        a = mock.alloc()
+        s.upsert_job(2, a.job)
+        a.job = s.job_by_id(a.namespace, a.job_id)
+        s.upsert_allocs(3, [a])
+        s.upsert_evals(4, [mock.evaluation()])
+        s.upsert_deployment(5, mock.deployment())
+        fresh = self._round_trip(s)
+        assert (
+            fresh.snapshot()._gen.table_indexes
+            == s.snapshot()._gen.table_indexes
+        )
+
+
+class TestRestoreOrdering:
+    """ref fsm_test.go TestFSM_SnapshotRestore ordering slices: restore is
+    one atomic publish — waiters wake at the restored index, snapshots
+    taken before keep serving the pre-restore world, and writes applied
+    after continue the index axis past the snapshot."""
+
+    def _populated(self, upto=20):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(upto, n)
+        return s, n
+
+    def test_restore_wakes_min_index_waiters(self):
+        src, n = self._populated(upto=50)
+        blob = src.persist()
+        dst = StateStore()
+        results = []
+
+        def waiter():
+            snap = dst.snapshot_min_index(50, timeout=2.0)
+            results.append(snap.latest_index())
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        dst.restore(blob)
+        t.join()
+        assert results == [50]
+
+    def test_restore_wakes_blocking_query(self):
+        src, n = self._populated(upto=7)
+        blob = src.persist()
+        dst = StateStore()
+        results = []
+
+        def query():
+            res, idx = dst.blocking_query(
+                lambda snap: len(list(snap.nodes())), min_index=0, timeout=2.0
+            )
+            results.append((res, idx))
+
+        t = threading.Thread(target=query)
+        t.start()
+        time.sleep(0.05)
+        dst.restore(blob)
+        t.join()
+        assert results == [(1, 7)]
+
+    def test_prior_snapshot_keeps_pre_restore_world(self):
+        s, n = self._populated()
+        before = s.snapshot()
+        other = StateStore()
+        m = mock.node()
+        other.upsert_node(99, m)
+        s.restore(other.persist())
+        assert before.node_by_id(n.id) is not None
+        assert before.node_by_id(m.id) is None
+        assert s.node_by_id(n.id) is None
+        assert s.node_by_id(m.id) is not None
+
+    def test_writes_after_restore_continue_monotone(self):
+        s, n = self._populated(upto=30)
+        fresh = StateStore()
+        fresh.restore(s.persist())
+        fresh.upsert_node(None, mock.node())
+        assert fresh.latest_index() == 31
+        fresh.upsert_node(None, mock.node())
+        assert fresh.latest_index() == 32
+
+
+class TestBlockingQueryWakeups:
+    """ref state_store_test.go blocking-query slices beyond the basic
+    write wakeup: deletes wake too (any publish does), every concurrent
+    waiter wakes on one write, and timeout serves the current world."""
+
+    def test_delete_wakes_waiters(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        results = []
+
+        def query():
+            res, idx = s.blocking_query(
+                lambda snap: snap.node_by_id(n.id) is None,
+                min_index=1,
+                timeout=2.0,
+            )
+            results.append((res, idx))
+
+        t = threading.Thread(target=query)
+        t.start()
+        time.sleep(0.05)
+        s.delete_node(2, n.id)
+        t.join()
+        assert results == [(True, 2)]
+
+    def test_one_write_wakes_every_waiter(self):
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+        results = []
+        lock = threading.Lock()
+
+        def query():
+            res, idx = s.blocking_query(
+                lambda snap: len(list(snap.nodes())), min_index=1, timeout=2.0
+            )
+            with lock:
+                results.append((res, idx))
+
+        threads = [threading.Thread(target=query) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        s.upsert_node(2, mock.node())
+        for t in threads:
+            t.join()
+        assert results == [(2, 2)] * 4
+
+    def test_timeout_serves_current_world(self):
+        s = StateStore()
+        s.upsert_node(3, mock.node())
+        res, idx = s.blocking_query(
+            lambda snap: len(list(snap.nodes())), min_index=3, timeout=0.05
+        )
+        assert (res, idx) == (1, 3)
