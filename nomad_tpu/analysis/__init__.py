@@ -29,6 +29,7 @@ from . import (  # noqa: F401,E402
     imports,
     jax_hygiene,
     lockgraph,
+    plane_mutation,
     raft_hygiene,
     shard_hygiene,
     span_hygiene,

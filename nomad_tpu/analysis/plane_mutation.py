@@ -1,0 +1,157 @@
+"""Commit-path ownership of the dense columnar planes.
+
+The committed planes (``state/planes.py``) are snapshot state: the
+``used`` / ``exotic_live`` arrays and the alloc-record / job-count
+tables are patched by the SAME write transaction that swaps the MVCC
+tables, versioned by the same raft index, and persisted through FSM
+Snapshot/Restore. Everything downstream — the mirror view, the drain
+path, the device scatter — holds read-only aliases. A write to a plane
+from outside the commit path silently desynchronizes the planes from
+the tables the next persist claims they match, which is exactly the
+skew/rebuild failure class the columnar-first refactor deleted.
+
+Rule ``plane-mutation-outside-commit``: outside ``state/planes.py`` and
+``state/store.py``, flag
+
+- assignments (plain, augmented, or subscript) whose target chain is a
+  committed-plane field — a ``planes``/``_planes`` attribute chain
+  ending in an owned field, or one of the mirror's alias names
+  (``mirror_used``, ``exotic_live``, ``_alloc_rec``, ``_job_counts``),
+  and
+- mutating method calls (``pop``/``setdefault``/``clear``/``update``/
+  ``fill``/...) on those chains.
+
+Read-only aliasing (``self.mirror_used = planes.used`` in the mirror
+view constructor) is the one legitimate exception and takes a
+``# nta: ignore[plane-mutation-outside-commit]`` with a WHY.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, Project, dotted, register
+
+#: the commit path — the only modules allowed to write plane state
+_COMMIT_PATH = ("nomad_tpu/state/planes.py", "nomad_tpu/state/store.py")
+
+#: alias names under which mirror code reaches the plane tables; a write
+#: through ANY chain ending in one of these is a plane write
+_ALIAS_TAILS = {"mirror_used", "exotic_live", "_alloc_rec", "_job_counts"}
+
+#: fields owned by CommittedPlanes — a write is only a plane write when
+#: the chain also passes through a ``planes``-named binding
+_OWNED_TAILS = {
+    "used",
+    "exotic_live",
+    "alloc_rec",
+    "job_counts",
+    "nodes",
+    "index",
+    "gen",
+    "epoch",
+    "version",
+}
+
+#: container/array methods that mutate their receiver in place
+_MUTATORS = {
+    "pop",
+    "popitem",
+    "setdefault",
+    "clear",
+    "update",
+    "append",
+    "extend",
+    "add",
+    "remove",
+    "fill",
+    "sort",
+}
+
+
+def _is_plane_chain(name: str) -> bool:
+    """``name`` is a dotted chain (from :func:`dotted`, so subscripts
+    render as ``x[]``) that resolves to committed-plane state."""
+    if not name or name == "?":
+        return False
+    parts = [p.removesuffix("[]") for p in name.split(".")]
+    tail = parts[-1]
+    if tail in _ALIAS_TAILS:
+        return True
+    through_planes = any(p in ("planes", "_planes") for p in parts[:-1])
+    return through_planes and tail in _OWNED_TAILS
+
+
+def _unwrap_target(node: ast.AST) -> ast.AST:
+    """Peel subscripts off an assignment target: ``x.used[i]`` → ``x.used``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def _flat_targets(node: ast.AST):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _flat_targets(elt)
+    elif isinstance(node, ast.Starred):
+        yield from _flat_targets(node.value)
+    else:
+        yield node
+
+
+@register(
+    "plane-mutation-outside-commit",
+    "write to a committed columnar plane outside the store commit path "
+    "(state/planes.py + state/store.py) — desyncs planes from the MVCC "
+    "tables they are persisted against",
+)
+def check_plane_mutation(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if mod.relpath in _COMMIT_PATH:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                targets = [
+                    t for raw in node.targets for t in _flat_targets(raw)
+                ]
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATORS
+                    and _is_plane_chain(dotted(fn.value))
+                ):
+                    findings.append(
+                        Finding(
+                            "plane-mutation-outside-commit",
+                            mod.relpath,
+                            node.lineno,
+                            f"{dotted(fn.value)}.{fn.attr}() mutates a "
+                            "committed plane outside the store commit "
+                            "path: route the change through an FSM "
+                            "apply so the write transaction patches it",
+                        )
+                    )
+                continue
+            else:
+                continue
+            for t in targets:
+                base = _unwrap_target(t)
+                name = dotted(base)
+                if not _is_plane_chain(name):
+                    continue
+                findings.append(
+                    Finding(
+                        "plane-mutation-outside-commit",
+                        mod.relpath,
+                        t.lineno,
+                        f"assignment to committed plane '{name}' outside "
+                        "the store commit path: planes are snapshot "
+                        "state patched only by StateStore write "
+                        "transactions (state/planes.py)",
+                    )
+                )
+    return findings

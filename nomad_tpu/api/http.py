@@ -753,7 +753,7 @@ class HTTPServer:
         self._check_ns(query, job.namespace, "submit-job")
         # mint the trace at HTTP submit: the created eval adopts this
         # context (Server._adopt_eval_trace), so the retained tree runs
-        # submit → broker → worker → device → plan → fsm → mirror. A
+        # submit → broker → worker → device → plan → fsm. A
         # request forwarded from another region arrives with an active
         # context (X-Nomad-Trace) — then job.submit is a child span and
         # the cross-region hop stays one tree
@@ -1595,8 +1595,9 @@ class HTTPServer:
             # evals rode the TPU path, by mode, and why the rest didn't
             "tpu_scheduler": batch_sched.counters_snapshot(),
             "drain": dict(drain_mod.DRAIN_COUNTERS),
-            # incremental columnar mirror (tpu/mirror.py): delta-apply hit
-            # rate vs full rebuilds, by rebuild reason
+            # committed-plane mirror view (tpu/mirror.py): sync hits and
+            # node-axis view refreshes; rebuilds are structurally 0 —
+            # the planes are patched by the store's own write commits
             "tpu_mirror": (
                 self.server.columnar_mirror.stats()
                 if getattr(self.server, "columnar_mirror", None) is not None

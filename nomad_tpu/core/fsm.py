@@ -22,6 +22,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..state.store import StateStore
+from ..testing import faults as _faults
 from ..structs.model import (
     EVAL_STATUS_BLOCKED,
     EVAL_STATUS_PENDING,
@@ -159,7 +160,13 @@ class FSM:
             # exist post-apply: capture them first so the events carry
             # the real namespace instead of a guessed 'default'
             pre = self._capture_pre_delete(msg_type, payload)
+        # chaos crash points (testing/faults.py): a seeded kill before /
+        # after the state mutation simulates a server dying mid-apply —
+        # the crash-recovery storm restores from snapshot + log replay
+        # and must find planes byte-identical to a cold rebuild
+        _faults.fault_point("fsm.apply.pre")
         resp = applier(index, payload)
+        _faults.fault_point("fsm.apply.post_state")
         if self.event_broker is not None and msg_type in (
             ACL_POLICY_UPSERT, ACL_POLICY_DELETE,
             ACL_TOKEN_UPSERT, ACL_TOKEN_DELETE,
@@ -666,7 +673,7 @@ def _alloc_doc(state, alloc_id: str, fallback: Optional[dict] = None) -> dict:
     if stored is None:
         # already deleted: whatever it contributed is gone with it
         return dict(fallback or {}, id=alloc_id, _terminal=True)
-    from ..tpu.mirror import exotic_flag, usage_vec
+    from ..state.planes import exotic_flag, usage_vec
 
     return {
         "id": stored.id,

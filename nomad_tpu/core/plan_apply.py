@@ -188,7 +188,7 @@ def _alloc_exotic(alloc) -> bool:
     to the mirror plane's single definition (tpu/mirror.py exotic_flag) so
     the host dense path, the device verify, and the mirror's per-row
     exotic counts can never disagree."""
-    from ..tpu.mirror import exotic_flag
+    from ..state.planes import exotic_flag
 
     return exotic_flag(alloc)
 
@@ -354,7 +354,7 @@ DEVICE_VERIFY_MIN_PLACEMENTS = 256
 
 
 def _usage_vec(alloc) -> tuple:
-    from ..tpu.mirror import usage_vec
+    from ..state.planes import usage_vec
 
     return usage_vec(alloc) or (0, 0, 0, 0)
 

@@ -133,15 +133,14 @@ class Server:
             time_table=self.time_table,
             event_broker=self.event_broker,
         )
-        # event-driven incremental columnar mirror (tpu/mirror.py): the
-        # TPU drain path's dense state plane, patched O(delta) from the
-        # broker's Node/Alloc/PlanResult frames instead of rebuilt per
-        # state generation. Subscribes lazily on first drain batch.
-        self.columnar_mirror = None
-        if self.event_broker is not None:
-            from ..tpu.mirror import ColumnarMirror
+        # committed-plane columnar view (tpu/mirror.py): the TPU drain
+        # path's dense state plane. The planes themselves live in the
+        # state store and are patched by the same write transaction that
+        # swaps the tables (state/planes.py), so the view needs no event
+        # subscription and is constructed unconditionally.
+        from ..tpu.mirror import ColumnarMirror
 
-            self.columnar_mirror = ColumnarMirror(self.state, self.event_broker)
+        self.columnar_mirror = ColumnarMirror(self.state)
         # operator debug plane (nomad_tpu/debug; OBSERVABILITY.md): the
         # flight recorder is the whole-process tape the watchdog rules
         # and debug bundles read. Constructed always (cheap: one deque),

@@ -123,6 +123,20 @@ def sample_process(server) -> dict:
         ms = mirror.stats()
         sample["mirror_hits"] = ms.get("hits", 0)
         sample["mirror_rebuilds"] = ms.get("rebuilds", 0)
+    # committed-plane audit: a rate-limited checksum of the dense planes
+    # against a cold rebuild of the MVCC tables (state/planes.py). Zero
+    # rows is the refactor's invariant; the plane_divergence watchdog
+    # rule trips a bundle on anything else.
+    planes = getattr(getattr(server, "state", None), "planes", None)
+    if planes is not None:
+        try:
+            verdict = planes.audit_sample(server.state.snapshot()._gen)
+        except Exception:
+            verdict = None
+        if verdict is not None:
+            sample["plane_divergence_rows"] = verdict["rows"]
+            sample["plane_divergence_recs"] = verdict["recs"]
+            sample["plane_audit_version"] = verdict["version"]
     try:
         from ..trace import tracer
 

@@ -76,6 +76,7 @@ DEFAULT_RULES = {
     "subscriber_lag": {"threshold": 10_000, "consecutive": 5},
     "acl_replication_lag": {"threshold_s": 30.0, "consecutive": 3},
     "recompile_storm": {"growth": 4, "window": 60, "min_span_s": 10.0},
+    "plane_divergence": {"threshold": 1},
 }
 
 MAX_TRIP_LOG = 64
@@ -245,6 +246,23 @@ class Watchdog:
                 "cache_size": sample.get("compile_cache_size"),
                 "threshold": p["growth"],
                 "span_s": round(tail[-1]["t"] - tail[0]["t"], 2),
+            }
+        return None
+
+    def _rule_plane_divergence(self, sample, window, p):
+        # divergence between the committed planes and a cold rebuild of
+        # the MVCC tables is impossible by construction (the same write
+        # transaction patches both) — which is exactly why it is audited:
+        # a single nonzero row means a write path bypassed the commit
+        # protocol, and that warrants a bundle immediately, no
+        # consecutive-sample streak required
+        rows = sample.get("plane_divergence_rows", 0)
+        recs = sample.get("plane_divergence_recs", 0)
+        if rows >= p["threshold"] or recs >= p["threshold"]:
+            return {
+                "rows": rows,
+                "recs": recs,
+                "planes_version": sample.get("plane_audit_version"),
             }
         return None
 

@@ -652,9 +652,11 @@ class EventBroker:
 
         ``snapshot=True`` (requires a broker constructed with a state
         store) upgrades both cold starts and lost-gap resumes to the
-        mirror's sync contract: a state snapshot stamped at raft index N,
-        then deltas from N. A resume still within retention ignores the
-        flag — plain replay is strictly cheaper and complete."""
+        snapshot-then-deltas contract: a state snapshot stamped at raft
+        index N, then deltas from N. A resume still within retention
+        ignores the flag — plain replay is strictly cheaper and
+        complete. (External watchers only: the columnar planes are
+        committed in-state and never ride this stream.)"""
         norm: dict[str, set[str]] = {}
         for topic, keys in (topics or {TOPIC_ALL: ("*",)}).items():
             keyset = {k for k in keys} or {"*"}

@@ -12,8 +12,8 @@ Three layers, deliberately separable:
   direct store writes), measuring lateness instead of slowing down when
   the cluster falls behind;
 - :mod:`.score` — a continuous scorekeeper: RSS ceiling, eval-latency
-  p99 over time, event-stream subscriber lag, mirror rebuild/hit
-  counts, plan-queue wait, and the cluster invariants checked
+  p99 over time, event-stream subscriber lag, committed-plane view
+  counters, plan-queue wait, and the cluster invariants checked
   *throughout* the storm (testing/invariants.py incremental mode), all
   folded into a scored ``SOAK_r*.json`` artifact and one
   ``SOAK_SUMMARY`` trailing line.

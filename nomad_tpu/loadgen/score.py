@@ -16,7 +16,8 @@ Sampled per tick:
   ``/v1/event/stream`` HTTP surface; lag = broker latest index − the
   probe's last delivered index;
 - **plan plane**: ``plan.queue_wait`` / ``plan.submit`` p99, queue depth;
-- **mirror**: hit/rebuild counters (tpu/mirror.py) when a mirror exists;
+- **mirror**: committed-plane view counters (tpu/mirror.py) — sync hits
+  plus a rebuild count that is structurally zero;
 - **store shape**: object counts per table (alloc/eval/job/node).
 """
 

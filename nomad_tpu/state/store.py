@@ -52,6 +52,8 @@ from ..structs.model import (
     TaskGroupSummary,
 )
 
+from .planes import CommittedPlanes
+
 JOB_TRACKED_VERSIONS = 6
 
 
@@ -448,6 +450,12 @@ class StateStore(StateReader):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._write_mutex = threading.RLock()
+        #: the dense columnar planes, patched by the SAME write transaction
+        #: that swaps the tables and stamped at every publish — see
+        #: state/planes.py for the commit protocol
+        self.planes = CommittedPlanes()
+        # commit the (empty) planes so readers are served from birth
+        self.planes.commit(self._gen, self._gen.index)
 
     # ------------------------------------------------------------------
     # snapshots + blocking queries
@@ -482,9 +490,12 @@ class StateStore(StateReader):
 
     def _publish(self, **updates):
         """Swap in a new generation (must hold no external refs to mutated
-        tables) and wake blocked queries."""
+        tables) and wake blocked queries. The committed planes are stamped
+        with the new generation in the same critical section — plane
+        freshness IS generation identity, never an event subscription."""
         with self._cond:
             self._gen = replace(self._gen, **updates)
+            self.planes.commit(self._gen, self._gen.index)
             self._cond.notify_all()
 
     @staticmethod
@@ -546,6 +557,9 @@ class StateStore(StateReader):
                 )
             node.modify_index = index
             table[node.id] = node
+        # join / re-register may change resources or attributes: the node
+        # axis (and every plane keyed to it) rebuilds at commit time
+        self.planes.invalidate_axis()
         self._publish(
             index=index, nodes=table, table_indexes=self._bump(gen, index, "nodes")
         )
@@ -569,6 +583,9 @@ class StateStore(StateReader):
             ]
             node.modify_index = index
             table[node_id] = node
+            # resources unchanged: the committed planes just swap the
+            # node object so identity reads stay current
+            self.planes.swap_node(node)
             changed = True
         # publish even when nothing matched: the raft index must land in
         # the store so min-index waiters see this entry applied
@@ -586,7 +603,8 @@ class StateStore(StateReader):
     def delete_node(self, index: int, node_id: str):
         gen = self._gen
         nodes = dict(gen.nodes)
-        nodes.pop(node_id, None)
+        if nodes.pop(node_id, None) is not None:
+            self.planes.invalidate_axis()
         self._publish(
             index=index, nodes=nodes, table_indexes=self._bump(gen, index, "nodes")
         )
@@ -664,6 +682,9 @@ class StateStore(StateReader):
         node.modify_index = index
         nodes = dict(gen.nodes)
         nodes[node_id] = node
+        # status / drain / eligibility flap: same resources — O(1) object
+        # swap in the committed planes, no dense-plane mutation
+        self.planes.swap_node(node)
         self._publish(
             index=index, nodes=nodes, table_indexes=self._bump(gen, index, "nodes")
         )
@@ -888,7 +909,8 @@ class StateStore(StateReader):
         for eid in eval_ids:
             evals.pop(eid, None)
         for aid in alloc_ids:
-            allocs.pop(aid, None)
+            if allocs.pop(aid, None) is not None:
+                self.planes.remove_alloc(aid)
         self._publish(
             index=index,
             evals=evals,
@@ -907,9 +929,10 @@ class StateStore(StateReader):
         deployments = dict(gen.deployments)
         jobs_touched: dict[tuple[str, str], str] = {}
         for a in allocs:
-            self._upsert_alloc_impl(
+            stored = self._upsert_alloc_impl(
                 gen, table, summaries, deployments, index, a.copy(), jobs_touched
             )
+            self.planes.apply_alloc(stored)
         jobs = self._set_job_statuses(
             dict(gen.jobs), table, gen.evals, index, jobs_touched
         )
@@ -975,6 +998,7 @@ class StateStore(StateReader):
         if not alloc.terminal_status():
             force = JOB_STATUS_RUNNING
         jobs_touched[(alloc.namespace, alloc.job_id)] = force
+        return alloc
 
     @_write_txn
     def update_allocs_from_client(self, index: int, allocs: list[Allocation]):
@@ -1016,6 +1040,7 @@ class StateStore(StateReader):
             self._update_summary_with_alloc(gen, summaries, index, alloc, exist)
             self._update_deployment_with_alloc(deployments, index, alloc, exist)
             table[alloc.id] = alloc
+            self.planes.apply_alloc(alloc)
             force = "" if alloc.terminal_status() else JOB_STATUS_RUNNING
             jobs_touched[(alloc.namespace, alloc.job_id)] = force
         jobs = self._set_job_statuses(
@@ -1543,9 +1568,10 @@ class StateStore(StateReader):
             # Re-attach the job pulled out of the plan payload
             if a.job is None:
                 a.job = plan.job
-            self._upsert_alloc_impl(
+            stored = self._upsert_alloc_impl(
                 gen, allocs_table, summaries, deployments, index, a, jobs_touched
             )
+            self.planes.apply_alloc(stored)
 
         for ev in preemption_evals or []:
             self._nested_upsert_eval(gen, evals_table, index, ev.copy(), jobs_touched)
@@ -1593,6 +1619,9 @@ class StateStore(StateReader):
             "acl_tokens": [t.to_dict() for t in gen.acl_tokens.values()],
             "vault_accessors": list(gen.vault_accessors.values()),
             "table_indexes": dict(gen.table_indexes),
+            # the committed dense planes ride the same snapshot: restore
+            # installs them instead of cold-rebuilding O(N + A) state
+            "planes": self.planes.persist_for(gen),
         }
 
     def restore(self, data: dict):
@@ -1662,6 +1691,9 @@ class StateStore(StateReader):
                 },
                 table_indexes=dict(data.get("table_indexes", {})),
             )
+            # stage the snapshot's planes for installation at the publish
+            # below (an old snapshot without them cold-rebuilds instead)
+            self.planes.stage_restore(data.get("planes"))
             self._publish(**{f: getattr(gen, f) for f in (
                 "index", "nodes", "jobs", "job_versions", "job_summaries",
                 "evals", "allocs", "deployments", "periodic_launch",

@@ -12,7 +12,10 @@ seams call the module-level gates at well-known points:
 - ``fault_point(name)`` — process-level points: ``worker.post_dequeue``
   and ``worker.pre_submit`` (kill a scheduler worker mid-eval),
   ``plan.raft_apply`` (fail/partition the leader mid plan-commit batch),
-  ``tpu.kernel`` (device error / NaN at kernel dispatch).
+  ``tpu.kernel`` (device error / NaN at kernel dispatch),
+  ``fsm.apply.pre`` / ``fsm.apply.post_state`` (kill -9 around an FSM
+  apply — before the applier ran, or after state mutated but before
+  events published; the committed-plane crash-recovery storm's seams).
 - ``on_region(src_region, dst_region, channel)`` — every INTER-REGION
   link: gossip datagrams (gossip/swim.py), HTTP region forwarding
   (api/http.py) and ACL replication (core/server.py). ``src``/``dst``

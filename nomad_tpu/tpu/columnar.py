@@ -96,10 +96,10 @@ def _cluster_nbytes(cluster: "ColumnarCluster") -> int:
     return total
 
 
-#: dense resource columns: cpu MHz, memory MB, disk MB, network mbits
-#: (bandwidth is the AssignNetwork dimension the kernel CAN model densely;
-#: ports stay a host post-pass, SURVEY §7)
-R_COLS = 4
+# R_COLS and the per-node row derivations live with the committed planes
+# (state/planes.py) — the single definition shared with the state store's
+# in-commit plane maintenance, so the two can never disagree on a column
+from ..state.planes import R_COLS, node_capacity_row, node_reserved_row
 
 
 class ColumnarCluster:
@@ -112,21 +112,8 @@ class ColumnarCluster:
         self.capacity = np.zeros((n, R_COLS), dtype=np.int64)
         self.reserved = np.zeros((n, R_COLS), dtype=np.int64)
         for i, node in enumerate(nodes):
-            res = node.node_resources
-            self.capacity[i] = (
-                res.cpu.cpu_shares,
-                res.memory.memory_mb,
-                res.disk.disk_mb,
-                # AvailBandwidth: device-backed links only (network.go:72)
-                sum(net.mbits for net in res.networks if net.device),
-            )
-            if node.reserved_resources is not None:
-                rr = node.reserved_resources
-                self.reserved[i, :3] = (
-                    rr.cpu.cpu_shares,
-                    rr.memory.memory_mb,
-                    rr.disk.disk_mb,
-                )
+            self.capacity[i] = node_capacity_row(node)
+            self.reserved[i] = node_reserved_row(node)
         # Scoring denominators (ScoreFit: total - reserved; funcs.go:160-165)
         self.usable = (self.capacity[:, :2] - self.reserved[:, :2]).astype(np.float32)
         # AssignNetwork enforces bandwidth PER DEVICE; the dense sum is

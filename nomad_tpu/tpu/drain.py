@@ -46,10 +46,11 @@ class SharedCluster:
     planes, and the snapshot usage.
 
     With a :class:`~..tpu.mirror.ColumnarMirror` (the server path), the
-    arrays come from the long-lived event-patched mirror — O(delta) per
-    batch, device-resident planes — and span ALL nodes (non-ready nodes
-    simply never enter a ring). Without one (tests, direct harnesses), the
-    legacy ready-node rebuild path is kept."""
+    arrays alias the store's COMMITTED planes (state/planes.py) — patched
+    by the same write transaction that swapped the tables, exact for this
+    snapshot by construction, device-resident — and span ALL nodes
+    (non-ready nodes simply never enter a ring). Without one (tests,
+    direct harnesses), the legacy ready-node rebuild path is kept."""
 
     def __init__(self, snapshot, mirror=None):
         self.gen = getattr(snapshot, "_gen", snapshot)

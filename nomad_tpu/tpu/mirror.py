@@ -1,134 +1,98 @@
-"""Event-driven incremental columnar mirror of cluster state.
+"""Committed-plane columnar view of cluster state.
 
-``ColumnarCluster.shared`` (columnar.py) rebuilds the whole dense mirror on
-any nodes-table bump, and ``initial_used``/``_live_allocs_by_node`` rescan
-the entire alloc table once per state generation — under a drain, where
-every plan commit publishes a generation, each eval pays O(total allocs) of
-host work plus a fresh host→device transfer. The :class:`ColumnarMirror`
-replaces that rebuild-on-invalidate scheme with a long-lived,
-raft-index-versioned state plane that subscribes to the in-process
-EventBroker (all topics; Node/Alloc/PlanResult frames carry the deltas) and
-applies O(delta) patches:
+The dense capacity/used planes live in the state store itself
+(:class:`nomad_tpu.state.planes.CommittedPlanes`): the FSM apply patches
+them in the SAME write transaction that swaps the MVCC tables, and
+``StateStore._publish`` stamps them with the new generation identity and
+raft index. The :class:`ColumnarMirror` here is therefore a thin adapter —
+it no longer subscribes to events, chases frames, detects skew, or
+checksums itself against rebuilds, because the planes are exact **by
+construction**: ``planes.gen is snapshot._gen`` is the entire freshness
+test. The EventBroker subscription machinery that used to keep the old
+mirror fresh (and the skew/sever/lost-gap/checksum rebuild failure class
+that came with it) is deleted; the broker now serves external watchers
+only.
 
-- node upsert/remove edits rows (capacity/reserved/usable planes, plus a
-  by-node alloc rescan for a re-appearing node);
-- alloc transitions add/subtract their ``sum_alloc_usage`` contribution to
-  the per-node ``used`` matrix, keyed by the per-alloc usage vector the FSM
-  embeds in every Alloc event;
-- same-job collision counts are maintained per (job, task group).
+What remains here:
 
-The mirror's dense planes are also kept **device-resident**
-(:class:`DeviceState`): the capacity/usable planes are ``device_put`` once
-per node-axis epoch and the ``used`` plane is patched with small
-dirty-row scatter updates into a fresh buffer (double-buffered against
-the in-flight kernels still reading the old one), so a fused drain batch
-starts from arrays already on the chip instead of re-uploading O(N)
-state per eval.
-
-Degradation contract (never silently drift): a lost-gap frame, a severed
-subscription, an index skew, a sync timeout, or a periodic checksum
-mismatch against a fresh rebuild all force a full rebuild from the target
-snapshot, counted in ``tpu.mirror_rebuild*`` metrics.
+- :class:`MirrorCluster` — a ColumnarCluster whose ``mirror_used`` /
+  ``exotic_live`` / alloc-record tables ALIAS the committed planes (zero
+  copies; a store commit is immediately visible under the shared lock);
+- :class:`DeviceState` — the device-resident planes, uploaded once per
+  node-axis epoch and patched with dirty-row scatter updates fed straight
+  from the store's in-commit track/untrack path;
+- the adapter itself: ``sync`` / ``device_state`` / ``verify_handles`` /
+  ``locked_cluster`` / ``stats`` with the same consumer contract as
+  before. ``rebuilds`` is retained in the counters and is structurally
+  zero — the acceptance gate of the refactor; node-axis changes surface
+  as ``view_refreshes`` (an O(N) host re-derivation of the static
+  planes), never as a rebuild of the usage state.
 """
 
 from __future__ import annotations
 
 import logging
-import os
 import threading
-import time
 from typing import Optional
 
 import numpy as np
 
+from ..state.planes import exotic_flag, usage_vec  # noqa: F401 — canonical
+# definitions moved into the state layer with the planes; re-exported here
+# because this module was their historical home
 from .columnar import R_COLS, ColumnarCluster
 
 logger = logging.getLogger("nomad_tpu.tpu.mirror")
 
-#: how long sync() waits for an expected event frame before declaring the
-#: publish lost and rebuilding. The FSM publishes synchronously inside the
-#: same apply that bumped the table index the sync is chasing, so the only
-#: legitimate gap is the microseconds between the store swap and the
-#: publish; kept SHORT because sync holds the mirror lock while waiting —
-#: a lost frame (derivation bug, event-less alloc GC) should cost one
-#: bounded rebuild, not a long stall of every fast-path reader
-SYNC_WAIT_S = 0.05
-
-#: every Nth incremental sync is checksummed against a from-scratch
-#: ``initial_used`` recompute; 0 disables (the property tests re-enable)
-VERIFY_EVERY = int(os.environ.get("NOMAD_TPU_MIRROR_VERIFY_EVERY", "64"))
-
-
-def exotic_flag(alloc) -> bool:
-    """Whether the alloc carries ports/bandwidth networks or devices —
-    dimensions the dense planes can't verify exactly. THE single
-    definition: the FSM stamps it into every Alloc event (``Exotic``),
-    the mirror counts it per node row (``exotic_live``), and the plan
-    applier's host dense path (core/plan_apply.py ``_alloc_exotic``)
-    delegates here, so device verify and host verify can never disagree
-    on which allocs force the exact per-node check."""
-    resources = alloc.allocated_resources
-    if resources is None:
-        return False
-    if resources.shared.networks:
-        return True
-    for tr in resources.tasks.values():
-        if tr.networks or tr.devices:
-            return True
-    return False
-
-
-def usage_vec(alloc) -> Optional[tuple]:
-    """The (cpu, memory_mb, disk_mb, mbits) contribution of one alloc —
-    exactly ``ColumnarCluster.sum_alloc_usage`` restricted to one element,
-    so mirror patches and full rebuilds can never disagree on the math."""
-    if alloc.allocated_resources is None:
-        return None
-    c = alloc.comparable_cached()
-    bw = 0
-    res = alloc.allocated_resources
-    for tr in res.tasks.values():
-        for net in tr.networks:
-            bw += net.mbits
-    for net in res.shared.networks:
-        bw += net.mbits
-    return (
-        c.flattened.cpu.cpu_shares,
-        c.flattened.memory.memory_mb,
-        c.shared.disk_mb,
-        bw,
-    )
-
 
 class MirrorCluster(ColumnarCluster):
-    """A ColumnarCluster whose usage plane and collision counts are
-    maintained incrementally by a :class:`ColumnarMirror`. Built over ALL
-    nodes in the state (not just ready ones) so per-eval eligibility is a
-    ring permutation, never a node-axis change; a node status flap costs a
-    pointer swap instead of a full rebuild.
+    """A ColumnarCluster whose usage plane and collision counts alias the
+    store's committed planes. Built over ALL nodes in the state (not just
+    ready ones) so per-eval eligibility is a ring permutation, never a
+    node-axis change; a node status flap is an object swap the store
+    already performed in the shared ``nodes`` list.
 
-    The fast paths serve only the exact generation the mirror last synced
-    to; any other generation falls back to the base class's scan-the-table
-    implementations, so a stale reader can never observe a half-applied
-    patch set."""
+    The fast paths serve only the exact generation the planes are
+    committed at; any other generation falls back to the base class's
+    scan-the-table implementations, so a stale reader can never observe a
+    half-applied write transaction."""
 
-    def __init__(self, nodes, lock: threading.RLock):
-        super().__init__(nodes)
-        self._mirror_lock = lock
+    def __init__(self, planes):
+        super().__init__(planes.nodes)
+        self._planes = planes
+        self._epoch = planes.epoch
+        self._mirror_lock = planes.lock
+        # alias, don't copy: the store's write transactions patch these
+        # in-commit, and this view sees the result the moment the planes
+        # are restamped
+        self.index = planes.index
+        # nta: ignore[plane-mutation-outside-commit] WHY: read-only
+        # aliasing, not mutation — the next four bind the committed
+        # arrays/tables into this view so fast paths index them with
+        # zero copies; nothing here ever writes through the alias
         #: reserved + Σ live-alloc contributions per row (int64, [N, R])
-        self.mirror_used = self.reserved.copy()
+        self.mirror_used = planes.used
         #: live allocs per row carrying ports/devices (dimensions the
         #: dense planes can't verify): the plan applier's device verify
         #: degrades these rows to the exact host check
-        self.exotic_live = np.zeros(len(nodes), dtype=np.int32)
-        #: the state generation the incremental planes currently equal
-        self._synced_gen = None
+        # nta: ignore[plane-mutation-outside-commit] WHY: read-only alias
+        self.exotic_live = planes.exotic_live
         #: alloc id → (node_id, usage vec, job_id, task_group, exotic)
-        self._alloc_rec: dict[str, tuple] = {}
+        # nta: ignore[plane-mutation-outside-commit] WHY: read-only alias
+        self._alloc_rec = planes.alloc_rec
         #: (job_id, task_group) → {node_id: live alloc count}
-        self._job_counts: dict[tuple, dict] = {}
+        # nta: ignore[plane-mutation-outside-commit] WHY: read-only alias
+        self._job_counts = planes.job_counts
 
-    # -- incremental fast paths -----------------------------------------
+    @property
+    def _synced_gen(self):
+        """The generation this view is exact for: the planes' committed
+        generation while the node axis it was derived over is current,
+        else None (the adapter builds a fresh view on the next sync)."""
+        p = self._planes
+        return p.gen if p.epoch == self._epoch else None
+
+    # -- committed-plane fast paths -------------------------------------
     def initial_used(self, state, plan=None) -> np.ndarray:
         gen = getattr(state, "_gen", state)
         with self._mirror_lock:
@@ -148,7 +112,7 @@ class MirrorCluster(ColumnarCluster):
                 return used
         # stale generation: the O(total allocs) rescan runs OUTSIDE the
         # lock — a reader one generation behind must not serialize the
-        # other worker's sync/device refresh behind a full table scan
+        # store's write transactions behind a full table scan
         return super().initial_used(state, plan)
 
     def collision_counts(self, state, job_id: str, tg_name: str) -> np.ndarray:
@@ -212,6 +176,9 @@ class DeviceState:
             self.capacity = _devprof.device_put(cap)
             self.usable = _devprof.device_put(usa)
             self.used = _devprof.device_put(use)
+        #: dirty rows since the last refresh — registered as a sink with
+        #: the committed planes, so the store's in-commit track/untrack
+        #: feeds it directly
         self.pending: set[int] = set()
 
     @staticmethod
@@ -286,372 +253,71 @@ def _scatter_fn(mesh):
     return fn
 
 
-class _Structural(Exception):
-    """A node joined or left: the node axis (and every plane keyed to it)
-    must be rebuilt from the target snapshot."""
-
-
 class ColumnarMirror:
-    """The long-lived, event-patched columnar state plane for one server."""
+    """The committed-plane columnar view for one server: an adapter over
+    ``state.planes`` that builds the MirrorCluster view per node-axis
+    epoch and owns the device-resident plane cache."""
 
-    def __init__(self, state, broker, verify_every: int = VERIFY_EVERY):
-        # ``state`` is accepted for construction-site symmetry but never
-        # consulted: every read comes from the snapshot each sync() is
-        # given — the mirror must reflect that exact generation, never
-        # the live store
-        self._broker = broker
-        self._lock = threading.RLock()
-        #: serializes sync() callers; the ONLY lock held across the
-        #: bounded frame wait, so data-plane readers (device_state,
-        #: MirrorCluster fast paths, stats) never stall behind it. Order:
-        #: _sync_lock before _lock, never the reverse.
-        self._sync_lock = threading.Lock()
+    def __init__(self, state, broker=None, verify_every: int = 0):
+        # ``broker`` and ``verify_every`` are accepted for construction-
+        # site compatibility and ignored: plane freshness comes from the
+        # store commit path, not an event subscription, and the checksum
+        # self-verify the old mirror needed is now the watchdog's
+        # plane_divergence audit (state/planes.py audit_sample)
+        self._state = state
+        self._planes = state.planes
+        self._lock = self._planes.lock
         self._closed = False
-        self._sub: Optional["Subscription"] = None
         self._cluster: Optional[MirrorCluster] = None
-        #: highest frame index consumed (any topic)
-        self._applied = 0
-        #: highest frame index that touched the node/alloc planes
-        self._applied_na = 0
-        #: bumped whenever the node axis changes (device planes re-upload)
-        self._epoch = 0
         self._device: dict[int, DeviceState] = {}
-        self.verify_every = verify_every
-        self._syncs = 0
         self.counters = {
             "hits": 0,
-            "rebuilds": 0,
+            "rebuilds": 0,  # structurally zero — kept as the gate metric
             "stale": 0,
-            "events_applied": 0,
+            "view_refreshes": 0,
             "rebuild_reasons": {},
         }
 
     # ------------------------------------------------------------------
-    def sync(self, snapshot) -> Optional[MirrorCluster]:
-        """Bring the mirror to exactly ``snapshot``'s node/alloc state and
-        return the shared MirrorCluster, or None when this snapshot is
-        older than what the mirror already applied (the caller then builds
-        a one-off legacy cluster instead — the mirror never runs
-        backwards)."""
-        from .. import metrics
-        from ..events.broker import SubscriptionClosedError
-
-        target = max(
-            snapshot.table_index("nodes"), snapshot.table_index("allocs")
-        )
-        # _sync_lock serializes sync callers and is the only lock held
-        # across the bounded frame wait; _lock (which the fast-path
-        # readers contend on) is taken per-mutation. The analyzer's
-        # lock-held-blocking-call finding on the old single-lock sync —
-        # every device_state/stats reader stalled behind a 50ms wait for
-        # a frame that may never come — is what this split burned down.
-        with self._sync_lock:
-            with self._lock:
-                if self._closed:
-                    return None
-                if self._cluster is not None and self._applied_na > target:
-                    self.counters["stale"] += 1
-                    metrics.incr("tpu.mirror_stale")
-                    return None
-                if self._cluster is None or self._sub is None:
-                    self._rebuild(snapshot, target, "init")
-                    return self._finish(snapshot, rebuilt=True)
-                sub = self._sub
-                # invalidate the fast path BEFORE patching: _lock is now
-                # released between frame applications, so a reader at the
-                # previous generation must fall back to the scan path
-                # rather than observe a half-applied patch set (_finish
-                # republishes the generation once the planes are whole)
-                self._cluster._synced_gen = None
-            rebuilt = False
-            deadline = time.monotonic() + SYNC_WAIT_S
-            t0 = time.monotonic()
-            while True:
-                with self._lock:
-                    if self._closed:
-                        return None
-                    if self._applied >= target:
-                        break
-                try:
-                    # the wait: no data lock held (sync callers are
-                    # already serialized by _sync_lock, so frames can't
-                    # be consumed out of order)
-                    frame = self._next_frame(sub, deadline)  # nta: ignore[lock-held-blocking-call] — _sync_lock exists to be held here; readers use _lock
-                except SubscriptionClosedError:
-                    with self._lock:
-                        if self._closed:
-                            return None
-                        self._rebuild(snapshot, target, "severed")
-                    rebuilt = True
-                    break
-                with self._lock:
-                    # close() may have run while we waited with _lock
-                    # released: a rebuild here would mint a fresh broker
-                    # subscription nothing will ever close
-                    if self._closed:
-                        return None
-                    if frame is None:
-                        self._rebuild(snapshot, target, "timeout")
-                        rebuilt = True
-                        break
-                    index, events = frame
-                    if events is None:  # explicit lost-gap marker
-                        self._rebuild(snapshot, target, "gap")
-                        rebuilt = True
-                        break
-                    if index > target:
-                        # the write at ``target`` published nothing we
-                        # saw: resync from scratch (the rebuild's fresh
-                        # subscription re-covers this frame's range — its
-                        # content ≤ snapshot is in the rebuild, anything
-                        # newer replays from the ring)
-                        self._rebuild(snapshot, target, "skew")
-                        rebuilt = True
-                        break
-                    try:
-                        # plan frames carry the raft index the FSM linked
-                        # to the committing evals' traces: the mirror's
-                        # O(delta) patch becomes the last hop of each
-                        # eval's span tree (submit → ... → mirror patch).
-                        # enabled-gated: the per-frame lookup must cost
-                        # nothing with tracing off (this is the drain
-                        # hot path the overhead budget guards)
-                        from ..trace import tracer
-
-                        trace_ctxs = (
-                            tracer.ctxs_for_index(index)
-                            if tracer.enabled
-                            else ()
-                        )
-                        tp0 = time.monotonic() if trace_ctxs else 0.0
-                        self._apply_frame(snapshot, index, events)
-                        if trace_ctxs:
-                            tp1 = time.monotonic()
-                            for ctx in trace_ctxs:
-                                tracer.record_span(
-                                    "mirror.patch", ctx, tp0, tp1,
-                                    tags={"index": index},
-                                )
-                    except _Structural:
-                        self._rebuild(snapshot, target, "node_axis")
-                        rebuilt = True
-                        break
-            if not rebuilt:
-                metrics.sample("mirror.apply_delta", time.monotonic() - t0)
-            with self._lock:
-                if self._closed:
-                    return None
-                return self._finish(snapshot, rebuilt=rebuilt)
-
-    def _next_frame(self, sub, deadline: float):
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            return None
-        return sub.next(timeout=remaining)
-
-    # ------------------------------------------------------------------
-    def _finish(self, snapshot, rebuilt: bool) -> MirrorCluster:
-        from .. import metrics
-
+    def _ensure_cluster(self) -> MirrorCluster:
+        """The MirrorCluster view for the planes' current node axis,
+        re-derived (static planes only: capacity/usable/single_nic — the
+        usage state is aliased, never copied) when the axis epoch moved.
+        Caller holds the plane lock."""
         cluster = self._cluster
-        self._syncs += 1
-        if (
-            not rebuilt
-            and self.verify_every
-            and self._syncs % self.verify_every == 0
-            and not self._verify(snapshot)
-        ):
-            metrics.incr("tpu.mirror_checksum_mismatch")
-            self._rebuild(
-                snapshot,
-                max(snapshot.table_index("nodes"), snapshot.table_index("allocs")),
-                "checksum",
-            )
-            cluster = self._cluster
-            rebuilt = True
-        if rebuilt:
-            self.counters["rebuilds"] += 1
-        else:
-            self.counters["hits"] += 1
-            metrics.incr("tpu.mirror_hit")
-        cluster._synced_gen = getattr(snapshot, "_gen", snapshot)
+        if cluster is None or cluster._epoch != self._planes.epoch:
+            from .. import metrics
+
+            cluster = MirrorCluster(self._planes)
+            self._cluster = cluster
+            # retire device planes for the dead axis; their pending-row
+            # sinks die with them
+            for ds in self._device.values():
+                self._planes.unregister_sink(ds.pending)
+            self._device.clear()
+            self.counters["view_refreshes"] += 1
+            metrics.incr("tpu.mirror_view_refresh")
         return cluster
 
-    def _verify(self, snapshot) -> bool:
-        """Checksum the incrementally-maintained ``used`` plane against the
-        from-scratch recompute over the same node rows."""
-        cluster = self._cluster
-        fresh = ColumnarCluster.initial_used(cluster, snapshot)
-        fresh_exotic = np.zeros(len(cluster.nodes), dtype=np.int32)
-        for alloc in snapshot.allocs():
-            if alloc.terminal_status() or not exotic_flag(alloc):
-                continue
-            row = cluster.index.get(alloc.node_id)
-            if row is not None:
-                fresh_exotic[row] += 1
-        ok = np.array_equal(fresh, cluster.mirror_used) and np.array_equal(
-            fresh_exotic, cluster.exotic_live
-        )
-        if not ok:
-            logger.warning(
-                "mirror checksum mismatch at index %d (max row delta %s); "
-                "rebuilding",
-                self._applied,
-                np.abs(fresh - cluster.mirror_used).max(),
-            )
-        return ok
-
-    # ------------------------------------------------------------------
-    def _rebuild(self, snapshot, target: int, reason: str):
-        """Full O(N + A) rebuild from ``snapshot`` + fresh subscription.
-        The old subscription (if any) is dropped, so frames the rebuild
-        already covers are never replayed into the new plane."""
+    def sync(self, snapshot) -> Optional[MirrorCluster]:
+        """The MirrorCluster view of exactly ``snapshot``, or None when
+        the committed planes are at a different generation (a write
+        landed between the caller's snapshot and this sync — the caller
+        builds a one-off legacy cluster instead; counted stale)."""
         from .. import metrics
-        from ..events.broker import TOPIC_ALL
 
-        t0 = time.monotonic()
-        if self._sub is not None:
-            try:
-                self._sub.close()
-            except Exception:
-                pass
-        # subscribe BEFORE reading the snapshot tables: frames for writes
-        # after ``snapshot`` queue up and are applied by later syncs;
-        # frames at or before the snapshot index are filtered below
-        self._sub = self._broker.subscribe(
-            topics={TOPIC_ALL: ("*",)}, from_index=snapshot.latest_index()
-        )
-        cluster = MirrorCluster(list(snapshot.nodes()), self._lock)
-        for alloc in snapshot.allocs():
-            if alloc.terminal_status():
-                continue
-            if alloc.node_id not in cluster.index:
-                continue
-            self._track(cluster, alloc.id, alloc.node_id,
-                        usage_vec(alloc), alloc.job_id, alloc.task_group,
-                        exotic_flag(alloc))
-        self._cluster = cluster
-        self._applied = snapshot.latest_index()
-        self._applied_na = target
-        self._epoch += 1
-        self._device.clear()
-        metrics.incr(f"tpu.mirror_rebuild.{reason}")
-        metrics.sample("mirror.rebuild", time.monotonic() - t0)
-        reasons = self.counters["rebuild_reasons"]
-        reasons[reason] = reasons.get(reason, 0) + 1
-
-    # ------------------------------------------------------------------
-    @staticmethod
-    def _track(cluster: MirrorCluster, alloc_id, node_id, vec, job_id, tg,
-               exotic: bool = True):
-        row = cluster.index.get(node_id)
-        if row is None:
-            return
-        if vec is None:
-            # allocated_resources=None contributes nothing to ``used``
-            # (sum_alloc_usage skips it) but MUST still count for same-job
-            # collisions — the base collision_counts counts every
-            # non-terminal matching alloc regardless of resources
-            vec = (0, 0, 0, 0)
-        cluster.mirror_used[row] += np.asarray(vec, dtype=np.int64)
-        if exotic:
-            cluster.exotic_live[row] += 1
-        cluster._alloc_rec[alloc_id] = (node_id, vec, job_id, tg, exotic)
-        jc = cluster._job_counts.setdefault((job_id, tg), {})
-        jc[node_id] = jc.get(node_id, 0) + 1
-
-    def _untrack(self, alloc_id: str) -> Optional[int]:
-        """Remove one alloc's contribution; returns the dirty row or None."""
-        cluster = self._cluster
-        rec = cluster._alloc_rec.pop(alloc_id, None)
-        if rec is None:
-            return None
-        node_id, vec, job_id, tg, exotic = rec
-        jc = cluster._job_counts.get((job_id, tg))
-        if jc is not None:
-            c = jc.get(node_id, 0) - 1
-            if c > 0:
-                jc[node_id] = c
-            else:
-                jc.pop(node_id, None)
-                if not jc:
-                    cluster._job_counts.pop((job_id, tg), None)
-        row = cluster.index.get(node_id)
-        if row is None:
-            return None
-        cluster.mirror_used[row] -= np.asarray(vec, dtype=np.int64)
-        if exotic:
-            cluster.exotic_live[row] -= 1
-        return row
-
-    def _mark_dirty(self, row: int):
-        for ds in self._device.values():
-            ds.pending.add(int(row))
-
-    # ------------------------------------------------------------------
-    def _apply_frame(self, snapshot, index: int, events: list):
-        from ..events import TOPIC_ALLOC, TOPIC_NODE, TOPIC_NODE_EVENT
-
-        mutated = False
-        for e in events:
-            if e.topic == TOPIC_ALLOC:
-                self._apply_alloc(e)
-                mutated = True
-            elif e.topic == TOPIC_NODE:
-                self._apply_node(snapshot, e)
-                mutated = True
-            elif e.topic == TOPIC_NODE_EVENT:
-                mutated = True  # nodes-table bump; resources unchanged
-        self._applied = index
-        if mutated:
-            self._applied_na = index
-        self.counters["events_applied"] += len(events)
-
-    def _apply_alloc(self, e):
-        p = e.payload
-        alloc_id = p.get("ID", "")
-        row = self._untrack(alloc_id)
-        if row is not None:
-            self._mark_dirty(row)
-        if p.get("Terminal") or "Terminal" not in p:
-            # terminal, or an event lacking the mirror fields entirely
-            # (a fallback doc for an already-deleted alloc): nothing live
-            # to track
-            return
-        vec = p.get("Resources")
-        cluster = self._cluster
-        node_id = p.get("NodeID", "")
-        self._track(
-            cluster, alloc_id, node_id,
-            tuple(vec) if vec is not None else None,
-            p.get("JobID", ""), p.get("TaskGroup", ""),
-            # a payload missing the flag (shouldn't happen in-process)
-            # defaults EXOTIC: the verify path then degrades that row to
-            # the exact host check instead of trusting the dense planes
-            bool(p.get("Exotic", True)),
-        )
-        r = cluster.index.get(node_id)
-        if r is not None:
-            self._mark_dirty(r)
-
-    def _apply_node(self, snapshot, e):
-        cluster = self._cluster
-        node_id = e.key
-        if e.type in ("NodeRegistration", "NodeDeregistration"):
-            # node joined, left, or RE-registered (attributes/resources
-            # may have changed, invalidating every node-axis plane): the
-            # axis rebuilds from the target snapshot. Membership changes
-            # are rare next to the status/alloc churn the O(delta) paths
-            # below absorb.
-            raise _Structural(node_id)
-        # status / drain / eligibility flaps: same resources, same
-        # attributes — swap the object so identity reads stay current, and
-        # leave every dense plane untouched (the O(1) win over the old
-        # rebuild-on-any-nodes-bump cache)
-        node = snapshot.node_by_id(node_id)
-        row = cluster.index.get(node_id)
-        if node is not None and row is not None:
-            cluster.nodes[row] = node
+        gen = getattr(snapshot, "_gen", snapshot)
+        with self._lock:
+            if self._closed:
+                return None
+            if self._planes.gen is not gen:
+                self.counters["stale"] += 1
+                metrics.incr("tpu.mirror_stale")
+                return None
+            cluster = self._ensure_cluster()
+            self.counters["hits"] += 1
+            metrics.incr("tpu.mirror_hit")
+            return cluster
 
     # ------------------------------------------------------------------
     # device-resident kernel state
@@ -659,41 +325,50 @@ class ColumnarMirror:
     def device_state(self, n_pad: int, gen, mesh=None) -> Optional[tuple]:
         """Device refs (capacity, usable, used) for the node plane padded
         to ``n_pad``, valid for state generation ``gen``; None when the
-        mirror has moved past that generation (caller falls back to a host
-        transfer of its own snapshot arrays). With ``mesh``, the planes
-        are row-sharded over it (the caller's fused batch dispatches
-        sharded, so its state plane must already live partitioned); a
-        cached state for a different mesh is rebuilt, never reshared."""
+        committed planes are at a different generation (caller falls back
+        to a host transfer of its own snapshot arrays). With ``mesh``,
+        the planes are row-sharded over it (the caller's fused batch
+        dispatches sharded, so its state plane must already live
+        partitioned); a cached state for a different mesh is rebuilt,
+        never reshared."""
         with self._lock:
-            cluster = self._cluster
-            if cluster is None or cluster._synced_gen is not gen:
+            planes = self._planes
+            if self._closed or planes.gen is not gen:
                 return None
+            cluster = self._ensure_cluster()
             ds = self._device.get(n_pad)
-            if ds is None or ds.epoch != self._epoch or ds.mesh is not mesh:
+            if ds is not None and (
+                ds.epoch != planes.epoch or ds.mesh is not mesh
+            ):
+                planes.unregister_sink(ds.pending)
+                ds = None
+            if ds is None:
                 ds = DeviceState(
-                    self._epoch, n_pad, cluster.capacity,
-                    cluster.usable, cluster.mirror_used, mesh=mesh,
+                    planes.epoch, n_pad, cluster.capacity,
+                    cluster.usable, planes.used, mesh=mesh,
                 )
                 self._device[n_pad] = ds
+                # from here on the store's in-commit track/untrack marks
+                # dirty rows straight into this DeviceState
+                planes.register_sink(ds.pending)
             else:
-                ds.refresh(cluster.mirror_used)
+                ds.refresh(planes.used)
             return ds.arrays()
 
     # ------------------------------------------------------------------
     # plan-applier dense device verify (core/plan_apply.py)
     # ------------------------------------------------------------------
     def verify_handles(self, snapshot, n_pad: int, mesh=None):
-        """The plan applier's device-verify view of ``snapshot``: sync the
-        mirror to exactly that generation and return ``(cluster, (capacity,
-        usable, used) device refs, gen)``, or None when the mirror can't
-        serve it (closed, or already synced PAST the snapshot by a
-        concurrent drain batch — the applier then degrades to the host
-        oracle, counted in tpu.mirror_stale / plan.verify_device_degrade).
-        ``mesh`` must match what the drain batches pass for the same
-        n_pad (the MIN_NODES-gated active mesh): the DeviceState cache is
-        keyed by n_pad, so a mesh mismatch between the two consumers
-        would rebuild the full planes on every alternation instead of
-        riding the dirty-row scatter."""
+        """The plan applier's device-verify view of ``snapshot``: the
+        committed-plane cluster and ``(capacity, usable, used)`` device
+        refs at exactly that generation, or None when the planes have
+        already committed PAST the snapshot (the applier then degrades to
+        the host oracle, counted in tpu.mirror_stale /
+        plan.verify_device_degrade). ``mesh`` must match what the drain
+        batches pass for the same n_pad (the MIN_NODES-gated active
+        mesh): the DeviceState cache is keyed by n_pad, so a mesh
+        mismatch between the two consumers would rebuild the full planes
+        on every alternation instead of riding the dirty-row scatter."""
         cluster = self.sync(snapshot)
         if cluster is None:
             return None
@@ -704,18 +379,23 @@ class ColumnarMirror:
         return cluster, arrays, gen
 
     def locked_cluster(self, gen):
-        """Context manager yielding the MirrorCluster while it is still
-        synced to ``gen`` (else None), with the data lock held: the
-        applier's per-plan host-side gather (rows, node objects, exotic
-        counts, alloc-rec vectors) reads a consistent plane set even if a
-        drain worker is concurrently syncing the mirror forward."""
+        """Context manager yielding the MirrorCluster while the planes
+        are still committed at ``gen`` (else None), with the plane lock
+        held: the applier's per-plan host-side gather (rows, node
+        objects, exotic counts, alloc-rec vectors) reads a consistent
+        plane set even if a write transaction is concurrently patching
+        the store forward."""
         import contextlib
 
         @contextlib.contextmanager
         def _ctx():
             with self._lock:
                 cluster = self._cluster
-                if cluster is None or cluster._synced_gen is not gen:
+                if (
+                    self._closed
+                    or cluster is None
+                    or cluster._synced_gen is not gen
+                ):
                     yield None
                 else:
                     yield cluster
@@ -725,33 +405,19 @@ class ColumnarMirror:
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
+            planes = self._planes
             out = dict(self.counters)
             out["rebuild_reasons"] = dict(self.counters["rebuild_reasons"])
-            out["applied_index"] = self._applied
-            out["nodes"] = (
-                len(self._cluster.nodes) if self._cluster is not None else 0
-            )
-            out["tracked_allocs"] = (
-                len(self._cluster._alloc_rec)
-                if self._cluster is not None
-                else 0
-            )
+            out["applied_index"] = planes.version
+            out["epoch"] = planes.epoch
+            out["nodes"] = len(planes.nodes)
+            out["tracked_allocs"] = len(planes.alloc_rec)
             return out
 
     def close(self):
         with self._lock:
             self._closed = True
-            if self._sub is not None:
-                try:
-                    self._sub.close()
-                except Exception:
-                    pass
-                self._sub = None
-
-    # -- test hook ------------------------------------------------------
-    def sever(self):
-        """Cut the mirror's subscription (chaos harness): the next sync
-        observes SubscriptionClosedError and must rebuild."""
-        with self._lock:
-            if self._sub is not None:
-                self._broker._close_slow(self._sub)
+            for ds in self._device.values():
+                self._planes.unregister_sink(ds.pending)
+            self._device.clear()
+            self._cluster = None

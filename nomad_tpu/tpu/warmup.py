@@ -279,7 +279,7 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
     except Exception:
         pass
     # the plan applier's dense device verify (kernel.verify_rows) rides
-    # the SAME (N-padded) mirror planes: prewarm its small row-bucket
+    # the SAME (N-padded) committed planes: prewarm its small row-bucket
     # shapes so the first big plan after startup doesn't pay a cold XLA
     # compile inside the apply loop (the cold-compile class this ladder
     # exists to kill)

@@ -1032,6 +1032,93 @@ class TestShardSpecDrift:
         assert found == [], [f.format() for f in found]
 
 
+class TestPlaneMutation:
+    """plane-mutation-outside-commit: the committed columnar planes are
+    snapshot state owned by StateStore write transactions; any write
+    reaching them from outside state/planes.py + state/store.py is the
+    skew failure class the columnar-first refactor deleted."""
+
+    def test_subscript_write_through_planes_chain_flagged(self):
+        src = (
+            "def stop(self, state, row, vec):\n"
+            "    state.planes.used[row] -= vec\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/core/fix.py": src}, "plane-mutation-outside-commit"
+        )
+        assert len(found) == 1 and found[0].line == 2
+
+    def test_mutating_call_on_alias_flagged(self):
+        src = (
+            "def untrack(self, alloc_id):\n"
+            "    self._alloc_rec.pop(alloc_id, None)\n"
+            "    self._job_counts.clear()\n"
+            "    self.mirror_used.fill(0)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "plane-mutation-outside-commit"
+        )
+        assert {f.line for f in found} == {2, 3, 4}
+
+    def test_rebinding_owned_field_flagged(self):
+        src = (
+            "def reset(self, planes):\n"
+            "    planes.gen = None\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/events/fix.py": src}, "plane-mutation-outside-commit"
+        )
+        assert len(found) == 1 and found[0].line == 2
+
+    def test_commit_path_and_reads_clean(self):
+        # the commit path itself is exempt — it IS the owner
+        owner = (
+            "def _untrack(self, alloc_id):\n"
+            "    self.alloc_rec.pop(alloc_id)\n"
+            "    self.planes.used[0] += 1\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/state/planes.py": owner},
+            "plane-mutation-outside-commit",
+        )
+        assert not findings_for(
+            {"nomad_tpu/state/store.py": owner},
+            "plane-mutation-outside-commit",
+        )
+        # reads through the alias never flag; nor do unrelated fields
+        reads = (
+            "def scan(self, cluster, row):\n"
+            "    used = cluster.mirror_used[row].copy()\n"
+            "    rec = cluster._alloc_rec.get('a')\n"
+            "    self.used = {}\n"
+            "    return used, rec\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/fix.py": reads}, "plane-mutation-outside-commit"
+        )
+
+    def test_why_suppression_clears(self):
+        src = (
+            "def view(self, planes):\n"
+            "    # nta: ignore[plane-mutation-outside-commit] WHY: alias\n"
+            "    self.mirror_used = planes.used\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "plane-mutation-outside-commit"
+        )
+
+    def test_tree_is_clean(self):
+        """The robustness tentpole's ownership claim holds over the real
+        tree: nothing outside the store commit path writes a plane (the
+        mirror's read-only aliases carry WHY'd suppressions)."""
+        project = Project.load(ROOT)
+        found = [
+            f for f in run(project, ["plane-mutation-outside-commit"])
+            if f.rule == "plane-mutation-outside-commit"
+        ]
+        assert found == [], [f.format() for f in found]
+
+
 class TestFramework:
     SRC = "def f(self, snap):\n    self.x_index = snap.latest_index() + 1{}\n"
 

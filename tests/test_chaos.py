@@ -758,27 +758,239 @@ class TestEventStreamSever:
             server.stop()
 
 
-class TestMirrorSeverMidDrain:
-    """The columnar mirror's subscription is cut between fused drain
-    batches; the invariant: the rebuild fallback produces EXACTLY the
-    placements an unsevered (or mirror-less) run produces — degradation is
-    a performance event, never a placement event."""
+class TestPlanesCrashRecovery:
+    """The crash-recovery storm behind the committed-planes refactor: the
+    dense capacity/used planes are snapshot state patched by the same
+    write transaction as the MVCC tables, so a seeded kill mid-FSM-apply,
+    a snapshot install onto a lagging follower, and a restart-restore
+    under churn must all land planes byte-identical to a cold rebuild at
+    the same raft index — and the drain path must ride the committed
+    planes with ZERO rebuild events in steady state."""
+
+    # -- shared helpers -------------------------------------------------
+
+    @staticmethod
+    def _assert_planes_identity(state):
+        """The byte-identity oracle: persisted planes == cold rebuild at
+        the same raft index. Returns the full persist blob."""
+        from nomad_tpu.state.planes import CommittedPlanes
+
+        blob = state.persist()
+        assert blob["planes"] == CommittedPlanes.build_blob(state._gen), (
+            "committed planes diverged from a cold rebuild at index"
+            f" {state.latest_index()}"
+        )
+        return blob
+
+    @staticmethod
+    def _churn_alloc(job, node_id, name, rng):
+        from nomad_tpu.structs.model import (
+            ALLOC_CLIENT_STATUS_RUNNING,
+            ALLOC_DESIRED_STATUS_RUN,
+        )
+
+        tg = job.task_groups[0]
+        task = tg.tasks[0]
+        a = Allocation(
+            id=generate_uuid(),
+            namespace=job.namespace,
+            job_id=job.id,
+            task_group=tg.name,
+            name=name,
+            node_id=node_id,
+            desired_status=ALLOC_DESIRED_STATUS_RUN,
+            client_status=ALLOC_CLIENT_STATUS_RUNNING,
+            allocated_resources=AllocatedResources(
+                tasks={
+                    task.name: AllocatedTaskResources(
+                        cpu=AllocatedCpuResources(
+                            cpu_shares=rng.choice([50, 100])
+                        ),
+                        memory=AllocatedMemoryResources(
+                            memory_mb=rng.choice([32, 64])
+                        ),
+                    )
+                },
+                shared=AllocatedSharedResources(disk_mb=rng.choice([0, 10])),
+            ),
+        )
+        a.job = job
+        return a
+
+    def _churn_world(self, seed, steps=26):
+        """Drive a fresh FSM through the PR 6 churn grammar, recording
+        every (index, msg_type, payload) raft entry so a crashed world can
+        be deterministically replayed. Returns (log, reference state)."""
+        import copy
+
+        from nomad_tpu.core import fsm as fsm_mod
+        from nomad_tpu.core.fsm import FSM
+        from nomad_tpu.structs.model import PlanResult
+
+        rng = random.Random(seed)
+        state = StateStore()
+        fsm = FSM(state=state, event_broker=None)
+        log = []
+        idx = 0
+
+        def apply(msg_type, payload):
+            nonlocal idx
+            idx += 1
+            log.append((idx, msg_type, payload))
+            # deepcopy: the logged payload must stay pristine for replay
+            fsm.apply(idx, msg_type, copy.deepcopy(payload))
+
+        jobs = []
+        for _ in range(2):
+            j = mock.job()
+            apply(fsm_mod.JOB_REGISTER, {"job": j.to_dict()})
+            jobs.append(state.job_by_id(j.namespace, j.id))
+        for _ in range(4):
+            apply(fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()})
+
+        live = []
+        for step in range(steps):
+            nodes = list(state.nodes())
+            op = rng.random()
+            if op < 0.45 and nodes:
+                job = rng.choice(jobs)
+                alloc = self._churn_alloc(
+                    job, rng.choice(nodes).id, f"c[{step}]", rng
+                )
+                plan = Plan(eval_id=generate_uuid(), job=job)
+                plan.node_allocation.setdefault(alloc.node_id, []).append(
+                    alloc
+                )
+                result = PlanResult(node_allocation=plan.node_allocation)
+                apply(
+                    fsm_mod.APPLY_PLAN_RESULTS,
+                    {"plan": plan.to_dict(), "result": result.to_dict()},
+                )
+                live.append(alloc)
+            elif op < 0.70 and live:
+                a = live.pop(rng.randrange(len(live)))
+                c = a.copy()
+                c.client_status = rng.choice(["complete", "failed"])
+                apply(
+                    fsm_mod.ALLOC_CLIENT_UPDATE, {"allocs": [c.to_dict()]}
+                )
+            elif op < 0.80:
+                apply(
+                    fsm_mod.NODE_REGISTER, {"node": mock.node().to_dict()}
+                )
+            elif op < 0.90 and len(nodes) > 2:
+                victim = rng.choice(nodes)
+                apply(fsm_mod.NODE_DEREGISTER, {"node_id": victim.id})
+                live = [a for a in live if a.node_id != victim.id]
+            elif nodes:
+                apply(
+                    fsm_mod.NODE_STATUS_UPDATE,
+                    {
+                        "node_id": rng.choice(nodes).id,
+                        "status": rng.choice(["down", "ready"]),
+                    },
+                )
+        return log, state
+
+    # -- scenario 1: seeded kill -9 at FSM-apply crash points -----------
+
+    def test_seeded_crash_points_restore_byte_identical(self):
+        """Kill the process (SimulatedCrash) at a seeded raft entry, at
+        BOTH crash points — before the applier ran (entry lost) and after
+        state mutated but before events published (entry half-visible).
+        Restart = restore the last snapshot + replay the log tail. Either
+        way the survivor's planes must be byte-identical to the cold
+        rebuild AND to a never-crashed reference world."""
+        import copy
+
+        from nomad_tpu.core.fsm import FSM
+
+        for seed in (11, 12, 13):
+            log, ref_state = self._churn_world(seed)
+            ref_blob = self._assert_planes_identity(ref_state)
+            for point in ("fsm.apply.pre", "fsm.apply.post_state"):
+                # str seeds hash stably (sha512), unlike tuple hashes
+                crash_after = random.Random(f"{seed}:{point}").randrange(
+                    len(log) // 2, len(log) - 1
+                )
+                state = StateStore()
+                fsm = FSM(state=state, event_broker=None)
+                plane = faults.FaultPlane(seed=seed)
+                plane.rule(
+                    "point", "crash", method=point, after=crash_after, count=1
+                )
+                faults.install(plane)
+                snapshot, crashed = None, False
+                try:
+                    for pos, (idx, t, p) in enumerate(log):
+                        try:
+                            fsm.apply(idx, t, copy.deepcopy(p))
+                        except faults.SimulatedCrash:
+                            crashed = True
+                            break
+                        if pos % 7 == 6:
+                            snapshot = fsm.snapshot()
+                finally:
+                    faults.uninstall()
+                assert crashed, (seed, point, crash_after)
+
+                # restart-restore: a fresh store installs the last durable
+                # snapshot, then the raft tail replays over it
+                state2 = StateStore()
+                fsm2 = FSM(state=state2, event_broker=None)
+                if snapshot is not None:
+                    fsm2.restore(copy.deepcopy(snapshot))
+                    self._assert_planes_identity(state2)
+                for idx, t, p in log:
+                    if idx > state2.latest_index():
+                        fsm2.apply(idx, t, copy.deepcopy(p))
+                blob = self._assert_planes_identity(state2)
+                assert blob == ref_blob, (
+                    f"crash at {point} entry {crash_after} (seed {seed}) "
+                    "did not converge to the reference world"
+                )
+
+    # -- scenario 2: snapshot install onto a lagging follower -----------
+
+    def test_snapshot_install_onto_lagging_follower(self):
+        """A follower that applied only a prefix of the log receives the
+        leader's snapshot (the raft InstallSnapshot path): the staged
+        planes must come up byte-identical to both the leader's and a
+        cold rebuild — no post-restore reconciliation pass exists."""
+        import copy
+
+        from nomad_tpu.core.fsm import FSM
+
+        log, leader = self._churn_world(21, steps=30)
+        leader_blob = self._assert_planes_identity(leader)
+
+        follower = StateStore()
+        f_fsm = FSM(state=follower, event_broker=None)
+        for idx, t, p in log[: len(log) // 3]:
+            f_fsm.apply(idx, t, copy.deepcopy(p))
+        assert follower.latest_index() < leader.latest_index()
+        self._assert_planes_identity(follower)  # lagging but exact
+
+        f_fsm.restore(copy.deepcopy(leader_blob))
+        assert follower.latest_index() == leader.latest_index()
+        blob = self._assert_planes_identity(follower)
+        assert blob == leader_blob, "snapshot install diverged from leader"
+
+    # -- scenario 3: drain storm, zero rebuilds in steady state ---------
 
     def _fsm_world(self, node_docs, job_docs):
         """A deterministic scheduler world whose plan applications flow
-        through a real FSM + event broker, so the columnar mirror sees the
-        same Alloc/PlanResult frames a server's drain path would."""
+        through a real FSM, so the drain path rides the same committed
+        planes a server would."""
         from nomad_tpu.core import fsm as fsm_mod
         from nomad_tpu.core.fsm import FSM
-        from nomad_tpu.events import EventBroker
         from nomad_tpu.scheduler import Harness
         from nomad_tpu.structs.model import PlanResult
         from nomad_tpu.tpu.mirror import ColumnarMirror
 
-        broker = EventBroker()
         state = StateStore()
-        fsm = FSM(state=state, event_broker=broker)
-        mirror = ColumnarMirror(state, broker, verify_every=0)
+        fsm = FSM(state=state, event_broker=None)
+        mirror = ColumnarMirror(state)
 
         class FsmHarness(Harness):
             """Harness whose plan/eval writes go through FSM.apply, so
@@ -874,7 +1086,12 @@ class TestMirrorSeverMidDrain:
             if not a.terminal_status()
         }
 
-    def test_sever_mid_drain_preserves_placement_parity(self):
+    def test_drain_storm_steady_state_zero_rebuilds(self):
+        """Two fused drain waves with a client update landing between
+        them, A/B'd against a mirror-less run: placements must be
+        identical, every wave must ride the committed planes, and the
+        rebuild counter — the metric the refactor structurally zeroes —
+        must read exactly 0."""
         rng = random.Random(4242)
         node_docs = []
         for _ in range(8):
@@ -892,16 +1109,15 @@ class TestMirrorSeverMidDrain:
             job_docs.append(j.to_dict())
 
         results = {}
-        for severed in (False, True):
+        for with_mirror in (False, True):
             h, fsm, mirror = self._fsm_world(node_docs, job_docs)
             jobs = sorted(h.state.jobs(), key=lambda j: j.id)
-            used_mirror = self._run_wave(h, mirror, jobs[:2], seed=5)
-            assert used_mirror, "first wave must ride the mirror"
-            if severed:
-                mirror.sever()  # chaos: subscription cut mid-drain
-            # a write lands while (possibly) severed: stop one wave-1
-            # alloc through the FSM, in BOTH worlds — the severed mirror
-            # must notice it can't have seen the frame and rebuild
+            wave_mirror = mirror if with_mirror else None
+            used_mirror = self._run_wave(h, wave_mirror, jobs[:2], seed=5)
+            assert used_mirror == with_mirror
+            # a write lands between waves: stop one wave-1 alloc through
+            # the FSM, in BOTH worlds — the commit patches the planes, so
+            # wave 2 sees it with no subscription and no rebuild
             victim = sorted(
                 h.state.allocs_by_job(jobs[0].namespace, jobs[0].id),
                 key=lambda a: a.name,
@@ -915,19 +1131,19 @@ class TestMirrorSeverMidDrain:
                 ALLOC_CLIENT_UPDATE,
                 {"allocs": [stopped.to_dict()]},
             )
-            used_mirror2 = self._run_wave(h, mirror, jobs[2:], seed=5)
-            assert used_mirror2, (
-                "second wave must still be mirror-backed (rebuild path)"
-            )
-            if severed:
-                assert (
-                    mirror.counters["rebuild_reasons"].get("severed", 0) >= 1
-                ), mirror.counters
-            results[severed] = self._placements(h, jobs)
+            used_mirror2 = self._run_wave(h, wave_mirror, jobs[2:], seed=5)
+            assert used_mirror2 == with_mirror
+            if with_mirror:
+                stats = mirror.stats()
+                assert stats["rebuilds"] == 0, stats
+                assert stats["hits"] >= 2, stats
+                assert mirror.counters["rebuild_reasons"] == {}
+            results[with_mirror] = self._placements(h, jobs)
             # 4 jobs × 3 allocs, minus the one stopped mid-scenario
-            assert len(results[severed]) == 11
+            assert len(results[with_mirror]) == 11
             assert_cluster_invariants(h.state)
+            self._assert_planes_identity(h.state)
 
         assert results[False] == results[True], (
-            "severed-mirror rebuild changed placements"
+            "committed-plane drain changed placements vs the cold path"
         )

@@ -279,6 +279,11 @@ class TestFlightRecorder:
         ):
             assert key in sample, key
         assert sample["rss_mb"] > 0
+        # the committed-plane audit rides every sample (rate-limited to
+        # one cold rebuild per interval): exact by construction → 0 rows
+        assert sample["plane_divergence_rows"] == 0
+        assert sample["plane_divergence_recs"] == 0
+        assert sample["plane_audit_version"] == server.state.latest_index()
         dump = recorder.dump()
         assert dump["recorded"] == 8
         assert dump["retain"] == 8
@@ -420,6 +425,24 @@ class TestWatchdog:
         for s in samples[3:]:
             wd.on_sample(s)
         assert wd.trip_count == 1
+
+    def test_plane_divergence_trips_immediately(self):
+        """A nonzero plane-audit row count means a write path bypassed
+        the store's commit protocol — one sample is enough to bundle,
+        no consecutive-breach streak."""
+        clean = [{"t": 0.0, "plane_divergence_rows": 0,
+                  "plane_divergence_recs": 0}]
+        wd = self._watchdog(clean)
+        wd.on_sample(clean[-1])
+        assert wd.trip_count == 0
+        bad = [{"t": 1.0, "plane_divergence_rows": 2,
+                "plane_divergence_recs": 0, "plane_audit_version": 17}]
+        wd2 = self._watchdog(bad)
+        wd2.on_sample(bad[-1])
+        assert wd2.trip_count == 1
+        assert wd2.trip_log[0]["rule"] == "plane_divergence"
+        assert wd2.trip_log[0]["detail"]["rows"] == 2
+        assert wd2.trip_log[0]["detail"]["planes_version"] == 17
 
     def test_bundle_dirs_pruned_to_keep(self, tmp_path):
         """On-disk retention: only the newest bundle_keep watchdog-*
