@@ -11,8 +11,10 @@ partitioned one (the exact class the mirror's scatter refresh pins with
 an explicit out sharding).
 
 Rule ``shard-spec-drift`` (scoped to ``nomad_tpu/tpu/``): inside a
-function that references a mesh (a ``mesh``-named parameter/local, or a
-call to ``active_mesh``/``configure``), flag
+function that references a mesh (a ``mesh``-named parameter/local, a
+call to ``active_mesh``/``configure``, or a spec-tree fetch —
+``batch_specs``/``run_specs``/``window_specs``/``wavefront_specs``),
+flag
 
 - ``device_put`` calls carrying no sharding (single argument, no
   ``device=``/``sharding=`` keyword), and
@@ -36,6 +38,14 @@ _SCOPE = "nomad_tpu/tpu/"
 #: calls that make a function a "sharded code path" even without a
 #: mesh-named binding
 _MESH_CALLS = {"active_mesh", "configure"}
+
+#: spec-tree constructors (shard.py): a function fetching a
+#: PartitionSpec tree is preparing sharded placements, so it is
+#: mesh-active even when the mesh object itself never appears by name
+#: (e.g. the specs are fetched for a put() further down the call chain)
+_SPEC_CALLS = {
+    "batch_specs", "run_specs", "window_specs", "wavefront_specs",
+}
 
 
 def _mentions_mesh(node: ast.AST) -> bool:
@@ -82,8 +92,11 @@ def _function_references_mesh(fn) -> bool:
         if isinstance(node, ast.Attribute) and _mentions_mesh(node):
             return True
         if isinstance(node, ast.Call):
-            tail = dotted(node.func).rsplit(".", 1)[-1]
-            if tail in _MESH_CALLS and "shard" in dotted(node.func):
+            name = dotted(node.func)
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _MESH_CALLS and "shard" in name:
+                return True
+            if tail in _SPEC_CALLS and "shard" in name:
                 return True
     return False
 

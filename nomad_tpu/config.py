@@ -47,6 +47,16 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     #                 ready_shards = 1 }     # eval-broker ready-queue
     #                                        # shards (by job hash)
     "plan_pipeline": {},
+    # wavefront placement plane (tpu/wavefront.py; OBSERVABILITY.md):
+    # wavefront { enabled = true       # route the exact-scan dispatch
+    #                                  # through conflict-free batched
+    #                                  # commits (parity-exact)
+    #             max_round = 32       # placements attempted per device
+    #                                  # round (window width W)
+    #             contention_top_m = 1 }  # candidate nodes per lane fed
+    #                                     # to the conflict binning (1 =
+    #                                     # winner-only, already exact)
+    "wavefront": {},
 }
 
 
@@ -127,6 +137,8 @@ def server_config_from_agent(config: dict) -> dict:
         out["debug"] = dict(config["debug"])
     if config.get("plan_pipeline"):
         out["plan_pipeline"] = dict(config["plan_pipeline"])
+    if config.get("wavefront"):
+        out["wavefront"] = dict(config["wavefront"])
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",

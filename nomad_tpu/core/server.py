@@ -916,6 +916,19 @@ class Server:
             from ..tpu import shard as _shard
 
             _shard.configure(int(self.config["shard_devices"]))
+        if self.config.get("wavefront"):
+            # wavefront placement plane (tpu/wavefront.py): route the
+            # exact-scan dispatch through conflict-free batched commits.
+            # Applied before prewarm so the warmed ladder includes the
+            # wavefront programs when the stanza enables them.
+            from ..tpu import wavefront as _wavefront
+
+            wf = dict(self.config["wavefront"])
+            _wavefront.configure(
+                enabled=wf.get("enabled", True),
+                max_round=wf.get("max_round"),
+                contention_top_m=wf.get("contention_top_m"),
+            )
         if self.config.get("prewarm_kernels"):
             # compile the planner shape ladder in the background so the
             # first real eval doesn't eat the cold-compile latency

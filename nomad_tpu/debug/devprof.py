@@ -406,9 +406,13 @@ def device_put(x, sharding=None):
 def count_rounds(planner: str, rounds, placements: int, sharded: bool):
     """One planner dispatch's device-loop rounds against the placements
     it resolved. ``rounds`` may be a host int (the exact scan's
-    statically-known step count) or the device scalar the runs/windowed
-    kernels return — device scalars park in a bounded pending queue and
-    fold into the totals once ready, so recording never syncs."""
+    statically-known step count) or the device scalar the runs/windowed/
+    wavefront kernels return — device scalars park in a bounded pending
+    queue and fold into the totals once ready, so recording never syncs.
+    This counter is how the ROADMAP item 2 fix is scored: the exact scan
+    records rounds == lanes (collective_rounds_per_placement = 1.0), the
+    wavefront planner records its measured commit rounds (≪ 1 per
+    placement on contention-free batches)."""
     if not _ENABLED:
         return
     if isinstance(rounds, (int, np.integer)):

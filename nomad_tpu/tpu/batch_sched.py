@@ -681,6 +681,7 @@ class TPUBatchScheduler(GenericScheduler):
         import jax.numpy as jnp
 
         from .kernel import BatchArgs, BatchState, plan_batch
+        from . import wavefront as _wavefront
 
         # Run-based fast path: one group with affinity/spread (limit=∞,
         # full-ring selection) → resolve fill runs and sweep tie-runs one
@@ -868,21 +869,36 @@ class TPUBatchScheduler(GenericScheduler):
                 _dp.count_tree_h2d((args, init))
                 args = BatchArgs(*[jnp.asarray(a) for a in args])
                 init = BatchState(*[jnp.asarray(s) for s in init])
-            _, placements = plan_batch(args, init, n_real, n_valid=a_real)
+            wf_rounds = None
+            if _wavefront.enabled():
+                _, placements, wf_rounds = _wavefront.plan_batch_wavefront(
+                    args, init, n_real, n_valid=a_real,
+                    n_shards=_shard.mesh_size(mesh),
+                )
+            else:
+                _, placements = plan_batch(args, init, n_real, n_valid=a_real)
         except Exception as e:
             return degrade_to_exact(f"dispatch: {e}")
+        mode = "wavefront" if wf_rounds is not None else "exact-scan"
         LAST_KERNEL_STATS.update(
             columnar_s=t_columnar - t_start,
             n_nodes=n_real,
             n_allocs=len(place),
             n_padded_nodes=N,
             n_padded_allocs=A,
-            mode="exact-scan",
+            mode=mode,
             shards=_shard.mesh_size(mesh),
         )
-        _count_mode("exact-scan")
-        _tag_device_span(kernel_span, "exact", "exact-scan")
-        kernel_span.set_tag("collective_rounds", A)
+        _count_mode(mode)
+        _tag_device_span(
+            kernel_span, "wavefront" if wf_rounds is not None else "exact",
+            mode,
+        )
+        if wf_rounds is None:
+            # the sequential scan's round count is its lane count, known
+            # statically; the wavefront's is a device scalar, measured
+            # after the materialize sync below
+            kernel_span.set_tag("collective_rounds", A)
         kernel_span.set_tag("placements", a_real)
         try:
             self._materialize(
@@ -892,6 +908,15 @@ class TPUBatchScheduler(GenericScheduler):
             )
         except KernelFault as e:
             return degrade_to_exact(str(e))
+        if wf_rounds is not None:
+            # _materialize synced the program, so reading the round
+            # count is free now — the span carries the MEASURED rounds
+            # (this is what flips the critical-path convoy verdict off
+            # on wavefront runs)
+            try:
+                kernel_span.set_tag("collective_rounds", int(wf_rounds))
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     def _failed_group_metric(

@@ -333,6 +333,7 @@ class KernelBatchCollector:
             tracer.ctx_for_eval(p.prep.eval_id) for p in parked
         ]
         from . import shard as _shard
+        from . import wavefront as _wavefront
 
         t0 = time.monotonic()
         shared = self.shared
@@ -496,7 +497,14 @@ class KernelBatchCollector:
         # n_valid: the devprof round counter charges the fused scan's
         # rounds against the REAL placements asked for, not the padded
         # lane count (rounds_per_placement ≈ A/A_real ≥ 1.0 today)
-        _, placements = plan_batch(args, init, n_real, n_valid=A_real)
+        wf_rounds = None
+        if _wavefront.enabled():
+            _, placements, wf_rounds = _wavefront.plan_batch_wavefront(
+                args, init, n_real, n_valid=A_real,
+                n_shards=_shard.mesh_size(mesh),
+            )
+        else:
+            _, placements = plan_batch(args, init, n_real, n_valid=A_real)
 
         # per-eval usage bases computed ON DEVICE in the same dispatch
         # wave (double-buffering: the parked threads wake NOW, at dispatch
@@ -547,12 +555,19 @@ class KernelBatchCollector:
             "batch_evals": len(parked),
             "padded": f"E{E}xG{G}xA{A}xN{N}xV{V}",
             "mirror": shared.mirror is not None,
+        }
+        if wf_rounds is None:
             # the device-plane cost of this dispatch (devprof): the
             # exact scan runs one collective round per alloc lane, so a
-            # trace reader sees the convoy size span-locally
-            "collective_rounds": A,
-            "placements": A_real,
-        }
+            # trace reader sees the convoy size span-locally. The
+            # wavefront's round count is a device scalar unknown at
+            # dispatch time — it lands MEASURED on the device_compute
+            # span at the first consumer sync instead, so the mesh
+            # rounds-per-placement stats are never biased by a guess.
+            dispatch_tags["collective_rounds"] = A
+            dispatch_tags["placements"] = A_real
+        else:
+            dispatch_tags["planner"] = "wavefront"
         if mesh is not None:
             # shard topology on the dispatch span: an operator reading a
             # trace can tell a sharded dispatch (and its mesh width) from
@@ -563,7 +578,9 @@ class KernelBatchCollector:
         # executable cost from the compile ledger (flops / bytes /
         # collective census totals) — empty when devprof is off or the
         # program never recorded a compile in this process
-        dispatch_tags.update(_devprof_mod.dispatch_tags("exact"))
+        dispatch_tags.update(_devprof_mod.dispatch_tags(
+            "wavefront" if wf_rounds is not None else "exact"
+        ))
         if recompiled:
             dispatch_tags["jit_cache_delta"] = cache_after - cache_before
         for ctx in trace_ctxs:
@@ -602,10 +619,22 @@ class KernelBatchCollector:
                 calls=2,
             )
             device_tags = {"batch_evals": len(root_ctxs)}
-            device_tags.update(_dp.dispatch_tags("exact"))
+            device_tags.update(_dp.dispatch_tags(
+                "wavefront" if wf_rounds is not None else "exact"
+            ))
             if mesh is not None:
                 device_tags.update(_shard.shard_tags(mesh))
-                device_tags["collective_rounds"] = A
+                if wf_rounds is not None:
+                    # the program has executed by this sync, so the
+                    # device round scalar is free to read — the span
+                    # carries MEASURED rounds and the critical-path
+                    # convoy verdict sees rpp ≪ 1 on wavefront runs
+                    try:
+                        device_tags["collective_rounds"] = int(wf_rounds)
+                    except Exception:
+                        device_tags["collective_rounds"] = A
+                else:
+                    device_tags["collective_rounds"] = A
                 device_tags["placements"] = A_real
             for ctx in root_ctxs:
                 tracer.record_span(

@@ -579,6 +579,11 @@ def compile_cache_size() -> int:
     entry), so the detector covers mesh dispatches for free. -1 when
     the internals move (detector degrades, never breaks dispatch)."""
     try:
+        # the wavefront planner (tpu/wavefront.py) registers itself into
+        # PLANNER_JITS on import; pull it in lazily so this census stays
+        # complete without a kernel->wavefront top-level import cycle
+        from . import wavefront  # noqa: F401
+
         return sum(fn._cache_size() for fn in PLANNER_JITS.values())
     except Exception:
         return -1
@@ -1098,3 +1103,9 @@ PLANNER_JITS = {
     "runs": _plan_batch_runs_jit,
     "windowed": _plan_batch_windowed_jit,
 }
+
+# the wavefront planner lives in its own module (tpu/wavefront.py) and
+# registers itself into PLANNER_JITS at import; every dispatcher imports
+# it before calling plan_batch_wavefront, and compile_cache_size() pulls
+# it in lazily, so the enumeration is complete wherever it is consumed
+# without a kernel->wavefront top-level import cycle

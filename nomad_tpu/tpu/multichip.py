@@ -219,6 +219,87 @@ def exact_problem(c, spread: bool = True):
     return args, init
 
 
+def wavefront_problem(c, n_groups: int = 32, spread: bool = True,
+                      overlap: int = 16):
+    """(BatchArgs, BatchState) for the wavefront planner's scored
+    section: a multi-tenant batch of ``n_groups`` independent groups in
+    interleaved submission order, each feasible on a mostly-disjoint
+    slice of the cluster (every ``overlap``-th node is shared with the
+    next group, so real conflicts exist without dominating), full-ring
+    limits, per-group demands and spread. This is the drain-shaped
+    workload the wavefront decomposition targets: the sequential scan
+    pays one cross-shard collective round per placement here even though
+    consecutive allocs cannot interact, while the wavefront commits ~W
+    conflict-free placements per round. The SAME (args, init) drive the
+    sequential oracle, so parity is pinned on this exact problem.
+
+    NOTE the single-group ``exact_problem`` is the wavefront's designed
+    worst case — every alloc shares one feasible set, so exactness
+    forces one commit per round. That regime belongs to the runs
+    planner's fill/sweep trajectories; the wavefront's win condition is
+    multi-tenant independence, which is why this builder exists."""
+    from .kernel import BatchArgs, BatchState
+
+    n_nodes = c["capacity"].shape[0]
+    n_real = c.get("n_real", n_nodes)
+    n_allocs = c["n_allocs"]
+    V = c["n_values"]
+    G = int(n_groups)
+    ids = np.arange(n_nodes)
+    gid = np.arange(G)
+    # contiguous 8-node blocks round-robin across groups; every
+    # ``overlap``-th node is additionally feasible for the NEXT group
+    slice_of = (ids // 8) % G
+    base = slice_of[None, :] == gid[:, None]
+    if overlap:
+        shared = ids % max(int(overlap), 1) == 0
+        base = base | (
+            shared[None, :] & (((slice_of + 1) % G)[None, :] == gid[:, None])
+        )
+    feasible = base & c["feasible"][None, :]
+    groups = (np.arange(n_allocs) % G).astype(np.int32)
+    group_count = np.bincount(groups, minlength=G).astype(np.int32)
+    # per-group demand tiers (1x/2x/3x the base ask)
+    scale = (1 + groups % 3).astype(np.int32)
+    demands = c["demand"][None, :] * scale[:, None]
+    args = BatchArgs(
+        capacity=c["capacity"],
+        usable=c["usable"],
+        feasible=feasible,
+        affinity=np.zeros((G, n_nodes), dtype=np.float32),
+        affinity_present=np.zeros((G, n_nodes), dtype=bool),
+        group_count=np.maximum(group_count, 1),
+        group_eval=np.zeros(G, dtype=np.int32),
+        node_value=np.tile(c["node_value"], (G, 1)),
+        spread_desired=np.tile(
+            np.full(
+                (1, V),
+                float(max(int(group_count.max()), 1)) / V if spread else -1.0,
+                dtype=np.float32,
+            ),
+            (G, 1),
+        ),
+        spread_implicit=np.full(G, -1.0, dtype=np.float32),
+        spread_weight_frac=np.ones(G, dtype=np.float32),
+        spread_even=np.zeros(G, dtype=bool),
+        spread_active=np.full(G, spread, dtype=bool),
+        perm=c["perm"][None, :],
+        ring=np.array([n_real], dtype=np.int32),
+        demands=demands.astype(np.int32),
+        groups=groups,
+        limits=np.full(n_allocs, n_nodes, dtype=np.int32),
+        valid=np.ones(n_allocs, dtype=bool),
+    )
+    init = BatchState(
+        used=c["reserved"].copy(),
+        collisions=np.zeros((G, n_nodes), dtype=np.int32),
+        spread_counts=np.zeros((G, V), dtype=np.int32),
+        spread_present=np.zeros((G, V), dtype=bool),
+        offset=np.zeros(1, dtype=np.int32),
+    )
+    return args, init
+
+
 def runs_problem(c, affinity: bool = True, spread: bool = True):
     """(RunArgs, init tuple) for the run-based full-ring planner, in
     rotation order."""
@@ -462,6 +543,47 @@ def bench_multichip(
                                     n_real, A),
     )
 
+    # wavefront conflict-free batched commits (tpu/wavefront.py): the
+    # sequential fill loop stays THE oracle — run_plain is plan_batch on
+    # the SAME (args, init), so score()'s deterministic parity pin
+    # proves the wavefront reproduces the sequential placements
+    # bit-for-bit while its crpp column shows the mesh cost dropping
+    # from one collective round per placement toward per-ROUND.
+    # MULTICHIP_WAVEFRONT=0 skips the section.
+    if os.environ.get("MULTICHIP_WAVEFRONT", "1") not in ("0", ""):
+        from . import wavefront as _wavefront
+
+        fargs, finit = wavefront_problem(c)
+        faspec, fsspec = shard.wavefront_specs()
+        f_plain_args = type(fargs)(*[jnp.asarray(a) for a in fargs])
+        f_plain_init = type(finit)(*[jnp.asarray(s) for s in finit])
+        f_shard_args = shard.put(fargs, faspec, mesh)
+        f_shard_init = shard.put(finit, fsspec, mesh)
+        n_shards = shard.mesh_size(mesh)
+        score(
+            "wavefront",
+            lambda: plan_batch(f_plain_args, f_plain_init, n_real)[1],
+            lambda: _wavefront.plan_batch_wavefront(
+                f_shard_args, f_shard_init, n_real, n_shards=n_shards
+            )[1],
+        )
+        # the honest tentpole measure on a single-core virtual mesh
+        # (where sharded-vs-unsharded can never win on wall clock):
+        # sharded sequential vs sharded wavefront on the SAME args —
+        # the dispatch/collective count is all that differs
+        t_seq_sharded = _time_best(
+            lambda: np.asarray(
+                plan_batch(f_shard_args, f_shard_init, n_real)[1]
+            ),
+            samples,
+        )
+        wf = planners["wavefront"]
+        wf["sequential_sharded_s"] = round(t_seq_sharded, 4)
+        wf["wavefront_speedup"] = (
+            round(t_seq_sharded / wf["sharded_s"], 3)
+            if wf["sharded_s"] else None
+        )
+
     # the contract: deterministic-pair parity 1.0 with real placements.
     # fast_pair_agreement/warm_equal stay informational — two fused
     # compilations may legally disagree on sub-ulp score ties.
@@ -540,6 +662,8 @@ def summary_line(report: dict) -> str:
         )
         if p.get("collective_rounds_per_placement") is not None:
             line += f"/crpp{p['collective_rounds_per_placement']}"
+        if p.get("wavefront_speedup") is not None:
+            line += f"/wfx{p['wavefront_speedup']}"
         parts.append(line)
     return "MULTICHIP_SUMMARY " + " ".join(parts)
 

@@ -162,6 +162,19 @@ def batch_specs():
     return args, state
 
 
+def wavefront_specs():
+    """(BatchArgs, BatchState) PartitionSpec trees for the wavefront
+    planner. The wavefront is an alternative DRIVE over the exact-scan
+    batch — same planes, same carry — so its layout IS ``batch_specs()``;
+    re-exported under the planner's own name so dispatch sites, the
+    warmup ladder and the multichip bench reference the planner they
+    compile (and a future wavefront-only plane has one place to land).
+    The tournament reduction depends on this layout: the contiguous
+    node-row split is what makes the ``[S, N/S]`` local stage
+    communication-free."""
+    return batch_specs()
+
+
 def run_specs():
     """(RunArgs, init-tuple) PartitionSpec trees for the run-based
     full-ring planner (the spread/affinity headline path)."""

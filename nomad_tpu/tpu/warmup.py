@@ -69,6 +69,7 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V, mesh=None) -> int:
         _plan_batch_windowed_jit as plan_batch_windowed,
     )
     from . import shard as _shard
+    from . import wavefront as _wavefront
 
     all_mesh = mesh
     compiled = 0
@@ -192,6 +193,20 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V, mesh=None) -> int:
                 binit = _shard.put(binit, bsspec, mesh)
             plan_batch.lower(bargs, binit, n_pad).compile()
             compiled += 1
+
+            # the wavefront drive shares the exact scan's planes (and
+            # wavefront_specs() IS batch_specs()), so its ladder entry
+            # reuses the example trees just placed; statics come from
+            # the module's window_for/shards_for single sources so the
+            # compiled key can never drift from runtime dispatch
+            if _wavefront.enabled():
+                _wavefront._plan_batch_wavefront_jit.lower(
+                    bargs, binit, n_pad,
+                    _wavefront.window_for(a_pad),
+                    _wavefront.contention_top_m(),
+                    _wavefront.shards_for(n_pad, _shard.mesh_size(mesh)),
+                ).compile()
+                compiled += 1
         except Exception:
             continue
     return compiled
@@ -211,6 +226,7 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
     from .drain import _used_bases_fn
     from .kernel import BatchArgs, BatchState, _plan_batch_jit
     from . import shard as _shard
+    from . import wavefront as _wavefront
 
     if mesh is not None and n_nodes < _shard.MIN_NODES:
         mesh = None  # runtime gate: small clusters dispatch unsharded
@@ -255,6 +271,14 @@ def prewarm_drain(n_nodes: int, batch: int, v_values: int = 8,
             init = _shard.put(init, sspec, mesh)
         _plan_batch_jit.lower(args, init, n_nodes).compile()
         compiled += 1
+        if _wavefront.enabled():
+            _wavefront._plan_batch_wavefront_jit.lower(
+                args, init, n_nodes,
+                _wavefront.window_for(A),
+                _wavefront.contention_top_m(),
+                _wavefront.shards_for(N, _shard.mesh_size(mesh)),
+            ).compile()
+            compiled += 1
         placements_w = jnp.full(A, -1, dtype=jnp.int32)
         eval_of_w = jnp.zeros(A, dtype=jnp.int32)
         n_real_w = jnp.int32(n_nodes)

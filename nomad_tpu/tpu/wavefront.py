@@ -1,0 +1,454 @@
+"""Wavefront placement plane: conflict-free batched commits + tournament
+argmax, so the mesh finally pays.
+
+The exact scan (`kernel._plan_batch_jit`) is the sequential fill loop: one
+scan step per alloc lane, each step a full-ring score + argmax — under a
+mesh, one cross-shard collective round PER PLACEMENT (PR 14 measured
+``collective_rounds_per_placement`` = 1.0 for the exact planner, and
+MULTICHIP_r07 shows the consequence: mesh_comm_frac 0.93-0.95, sharded
+speedup 0.055-0.35). The Go ``BinPackIterator`` (scheduler/rank.go) never
+needed to batch because it ran on one core; the independence it leaves on
+the table is that allocs whose feasible node sets don't contend cannot
+affect each other's selection — they can all take their argmax winner in
+ONE round.
+
+This module exploits that independence without giving up the oracle:
+
+**Predict-then-verify commit-prefix.** Each device round scores a window
+of W pending lanes *as if* each were next (a vmap of the exact step's
+selection against the round-start state), then commits the longest prefix
+of lanes that is conflict-free and defers the rest to the next round.
+"Conflict-free" is derived from the exact step's data flow, so parity with
+the sequential scan holds BY CONSTRUCTION, not by tuning:
+
+- cross-lane coupling through state flows only through the winner's
+  ``used`` row, ``collisions[g, winner]``, and ``spread_counts[g, ·]`` —
+  all invisible to a later lane j unless the winner is feasible for j's
+  group (a lane only ever reads scores/fit of its own feasible set, and
+  collisions/spread are per-group, with same-group subsumed by the shared
+  feasible set). Binning by shared top-M candidate nodes (``top_m`` > 1)
+  is strictly more conservative than the winner alone.
+- the only other coupling is the per-eval ring cursor: a lane that
+  consumes ring positions (``consumed % ring != 0``) conflicts with every
+  later lane of the same eval.
+
+Lanes past the first conflicted lane wait; the committed prefix is
+therefore exactly what the sequential scan would have produced, and
+``tests/test_wavefront.py`` pins wavefront == sequential bit-identically
+under the deterministic compile flavor (any divergence is a real
+semantics bug).
+
+**Hierarchical tournament reduction.** Every reduction in the selection
+(the feasibility counts, the rotation prefix-sums, the score max, the
+first-strict-max tie-break) is expressed as a per-shard local stage over
+the ``[S, N/S]`` view of the node axis followed by an S-wide finish, with
+S the mesh size baked in as a static arg. Under the ``shard.py``
+PartitionSpec trees the node axis splits contiguously, so the local stage
+is communication-free and only the tiny ``[S]`` finish crosses shards —
+the full cross-mesh argmax collective becomes a log-width tournament.
+Integer sums/cumsums and float max are order-insensitive, so the
+tournament is bit-identical to the flat reduction (the parity contract
+survives).
+
+**Double-buffered commit writeback.** The placements-array scatter of
+round r is deferred into round r+1 (carried as a pending index/value
+window, exactly the two-slot discipline of ``mirror.py``'s DeviceState):
+selection never reads the placements array, so the scatter of the
+current round overlaps the next round's per-shard re-scoring instead of
+serializing against it.
+
+The planner registers in ``kernel.PLANNER_JITS`` (compile ledger +
+recompile detection for free), takes its PartitionSpecs from
+``shard.wavefront_specs()``, prewarm shapes from ``warmup.py``, and is
+dispatched from ``batch_sched.py``/``drain.py`` behind the
+``wavefront{enabled,max_round,contention_top_m}`` config stanza (env:
+``NOMAD_TPU_WAVEFRONT``, ``NOMAD_TPU_WAVEFRONT_MAX_ROUND``,
+``NOMAD_TPU_WAVEFRONT_TOP_M``). Rounds are recorded to the devprof
+collective counter as a lazy device scalar — ``rounds_snapshot()`` shows
+``collective_rounds_per_placement`` dropping from 1.0 to ~W^-1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..debug import devprof as _devprof
+from ..testing import faults as _faults
+from . import kernel as _kernel
+from .kernel import MAX_SKIP, NEG_INF, BatchArgs, BatchState, _scores
+
+# ---------------------------------------------------------------------------
+# config stanza (mirrors shard.py's module state: explicit configure() wins,
+# env is the library-code default, disabled until someone opts in)
+# ---------------------------------------------------------------------------
+
+DEFAULT_MAX_ROUND = 32
+DEFAULT_TOP_M = 1
+
+_lock = threading.Lock()
+_state = {"enabled": None, "max_round": None, "top_m": None}
+
+
+def configure(enabled=None, max_round=None, contention_top_m=None):
+    """Set the wavefront knobs from config (server passthrough) or tests.
+    ``None`` leaves a knob on its env/default resolution."""
+    with _lock:
+        if enabled is not None:
+            _state["enabled"] = bool(enabled)
+        if max_round is not None:
+            _state["max_round"] = max(1, int(max_round))
+        if contention_top_m is not None:
+            _state["top_m"] = max(1, int(contention_top_m))
+
+
+def reset():
+    """Back to env/default resolution (test isolation)."""
+    with _lock:
+        _state.update({"enabled": None, "max_round": None, "top_m": None})
+
+
+def enabled() -> bool:
+    """Whether batch_sched/drain route the exact-scan path through the
+    wavefront planner (config stanza, env ``NOMAD_TPU_WAVEFRONT=1``)."""
+    with _lock:
+        v = _state["enabled"]
+    if v is not None:
+        return v
+    return os.environ.get("NOMAD_TPU_WAVEFRONT", "0") == "1"
+
+
+def max_round() -> int:
+    """Window width W: the max placements attempted per device round."""
+    with _lock:
+        v = _state["max_round"]
+    if v is not None:
+        return v
+    return max(1, int(os.environ.get(
+        "NOMAD_TPU_WAVEFRONT_MAX_ROUND", str(DEFAULT_MAX_ROUND))))
+
+
+def contention_top_m() -> int:
+    """Candidate nodes per lane fed to the contention binning. M=1 bins
+    by the argmax winner alone (already exact — see the module
+    docstring); M>1 is strictly more conservative, trading wavefront
+    width for earlier conflict detection when scores are volatile."""
+    with _lock:
+        v = _state["top_m"]
+    if v is not None:
+        return v
+    return max(1, int(os.environ.get(
+        "NOMAD_TPU_WAVEFRONT_TOP_M", str(DEFAULT_TOP_M))))
+
+
+def window_for(a_pad: int) -> int:
+    """The static window width for an ``a_pad``-lane batch — single
+    source for dispatch AND the warmup prewarm ladder, so the compiled
+    static args can never drift between them."""
+    return max(1, min(max_round(), int(a_pad)))
+
+
+def shards_for(n_pad: int, n_shards: int) -> int:
+    """The static tournament width: the mesh size when it divides the
+    padded node axis (node_bucket guarantees it for mesh-built planes),
+    else 1 (flat reductions — still exact, just no local stage)."""
+    s = max(1, int(n_shards))
+    return s if n_pad % s == 0 else 1
+
+
+# ---------------------------------------------------------------------------
+# tournament reductions: per-shard local stage over the [S, N/S] view,
+# then an S-wide finish. Bit-identical to the flat reduction (int sums /
+# cumsums and float max are order-insensitive), so the parity contract
+# is untouched; under the mesh the local stage is communication-free.
+# ---------------------------------------------------------------------------
+
+
+def _tsum(x, s: int):
+    if s <= 1:
+        return jnp.sum(x)
+    return jnp.sum(jnp.sum(x.reshape(s, -1), axis=1))
+
+
+def _tmax(x, s: int):
+    if s <= 1:
+        return jnp.max(x)
+    return jnp.max(jnp.max(x.reshape(s, -1), axis=1))
+
+
+def _tmin(x, s: int):
+    if s <= 1:
+        return jnp.min(x)
+    return jnp.min(jnp.min(x.reshape(s, -1), axis=1))
+
+
+def _tcumsum(x, s: int):
+    """Hierarchical inclusive prefix-sum: local scans per shard, then an
+    exclusive scan of the S shard totals rebases each block."""
+    if s <= 1:
+        return jnp.cumsum(x)
+    loc = jnp.cumsum(x.reshape(s, -1), axis=1)
+    base = jnp.cumsum(loc[:, -1]) - loc[:, -1]
+    return (loc + base[:, None]).reshape(x.shape)
+
+
+def _rot_incl_t(x, offset, total, positions, s: int):
+    """``kernel._rot_incl`` with the cumsum staged as a tournament —
+    same two-segment rotation trick, same integer results."""
+    xi = x.astype(jnp.int32)
+    xc = _tcumsum(xi, s)
+    xex = xc - xi
+    x_off = xex[offset]
+    return jnp.where(positions >= offset, xc - x_off, total - x_off + xc)
+
+
+# ---------------------------------------------------------------------------
+# the as-if selection: one lane of _step's selection against the
+# round-start state, reductions staged as tournaments
+# ---------------------------------------------------------------------------
+
+_BIG = 2**30
+
+
+def _select(args: BatchArgs, state: BatchState, s: int, m: int,
+            demand, g, limit, valid):
+    """What ``kernel._step`` would select for this alloc against
+    ``state`` — scores, limit-iterator deferral, replay, first-strict-max
+    tie-break, ring-consumption accounting — without mutating anything.
+    Returns (best_node, place, advances, consumed, top_nodes[m])."""
+    n_pad = args.capacity.shape[0]
+    positions = jnp.arange(n_pad)
+    e = args.group_eval[g]
+    ring_size = args.ring[e]
+    perm = args.perm[e]
+    in_ring = positions < ring_size
+
+    fit_nodes = args.feasible[g] & jnp.all(
+        state.used + demand[None, :] <= args.capacity, axis=1
+    )
+    final = _scores(args, state, g, demand)
+
+    fit_p = fit_nodes[perm] & in_ring
+    score_p = final[perm]
+    offset = state.offset[e]
+
+    nonpos = fit_p & (score_p <= 0.0)
+    nonpos_total = _tsum(nonpos.astype(jnp.int32), s)
+    nonpos_incl = _rot_incl_t(nonpos, offset, nonpos_total, positions, s)
+    skipped = nonpos & (nonpos_incl <= MAX_SKIP)
+
+    kept = fit_p & ~skipped
+    kept_total = _tsum(kept.astype(jnp.int32), s)
+    ret_incl = _rot_incl_t(kept, offset, kept_total, positions, s)
+    returned = kept & (ret_incl <= limit)
+    n_returned = _tsum(returned.astype(jnp.int32), s)
+
+    need = jnp.maximum(limit - n_returned, 0)
+    skip_total = _tsum(skipped.astype(jnp.int32), s)
+    skip_incl = _rot_incl_t(skipped, offset, skip_total, positions, s)
+    replay = skipped & (skip_incl <= need)
+    candidates = returned | replay
+
+    rot_rank = jnp.where(
+        positions >= offset, positions - offset, ring_size - offset + positions
+    )
+
+    found = _tmax(candidates.astype(jnp.int32), s) > 0
+    max_score = _tmax(jnp.where(candidates, score_p, NEG_INF), s)
+    tie = candidates & (score_p == max_score)
+    visit_order = rot_rank + jnp.where(replay, n_pad, 0)
+    # first-strict-max as a two-stage tournament: the minimal visit rank
+    # among ties, then the (unique) position holding it — identical to
+    # _step's argmin because visit_order is injective on the ring
+    best_visit = _tmin(jnp.where(tie, visit_order, _BIG), s)
+    best_p = _tmin(
+        jnp.where(tie & (visit_order == best_visit), positions, _BIG), s
+    )
+    best_node = perm[jnp.minimum(best_p, n_pad - 1)]
+
+    last_ret_rank = _tmax(jnp.where(returned, rot_rank, -1), s)
+    consumed = jnp.where(n_returned >= limit, last_ret_rank + 1, ring_size)
+
+    place = found & valid
+    best_node = jnp.where(place, best_node, -1)
+    # the cursor moves iff the lane is valid and consumption is not a
+    # full-ring (or zero) wrap — the ONLY way an unplaced lane couples
+    # to a later one
+    advances = valid & (consumed % jnp.maximum(ring_size, 1) != 0)
+
+    if m > 1:
+        # extra candidate nodes for conservative binning: the next-best
+        # scored candidates after the winner (top_k is a tournament
+        # already under GSPMD); slot 0 always carries the winner
+        sc = jnp.where(candidates, score_p, NEG_INF)
+        _, idxs = jax.lax.top_k(sc, m)
+        extra_ok = candidates[idxs]
+        extra_nodes = jnp.where(extra_ok, perm[idxs], -1)
+        top_nodes = jnp.concatenate([best_node[None], extra_nodes[: m - 1]])
+    else:
+        top_nodes = best_node[None]
+    top_nodes = jnp.where(place, top_nodes, -1)
+
+    return best_node, place, advances, consumed, top_nodes
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _plan_batch_wavefront_jit(args: BatchArgs, init: BatchState,
+                              n_real: int, window: int, top_m: int,
+                              n_shards: int):
+    """Wavefront drive over the exact-scan batch: per device round, score
+    a ``window`` of pending lanes as-if-next (vmap of the sequential
+    selection), commit the longest conflict-free prefix, defer the rest.
+    Returns (final_state, placements[a_pad], rounds)."""
+    a_pad = args.demands.shape[0]
+    w = window
+    E = args.ring.shape[0]
+    lane_arange = jnp.arange(w)
+
+    select = jax.vmap(
+        functools.partial(_select, args), in_axes=(None, None, None, 0, 0, 0, 0)
+    )
+
+    # lanes past the last valid one never mutate state and default to -1
+    # in the placements array, so the drive stops at the valid frontier
+    # instead of paying rounds for padding
+    stop = jnp.max(jnp.where(args.valid, jnp.arange(a_pad) + 1, 0))
+
+    def body(carry):
+        state, placements, pend_idx, pend_val, i, rounds = carry
+        # flush round r-1's commits (double buffer): selection below
+        # never reads `placements`, so this scatter overlaps the
+        # re-scoring instead of serializing in front of it
+        placements = placements.at[pend_idx].set(pend_val)
+
+        lanes = i + lane_arange
+        lane_in = lanes < a_pad
+        li = jnp.minimum(lanes, a_pad - 1)
+        demand_w = args.demands[li]
+        g_w = args.groups[li]
+        limit_w = args.limits[li]
+        valid_w = args.valid[li] & lane_in
+
+        best, place, advances, consumed, topn = select(
+            state, n_shards, top_m, demand_w, g_w, limit_w, valid_w
+        )
+
+        # conflict matrix: earlier lane i invalidates later lane j iff
+        # one of i's candidate nodes is feasible for j's group (i's
+        # placement would move scores/fit/collisions j can see) or i
+        # advances j's eval ring cursor
+        e_w = args.group_eval[g_w]
+        feas_w = args.feasible[g_w]  # [w, N]
+        topn_safe = jnp.maximum(topn, 0)  # [w, m]
+        hits = jnp.take(feas_w, topn_safe.reshape(-1), axis=1).reshape(
+            w, w, top_m
+        )  # hits[j, i, m] = feasible[g_j, topn[i, m]]
+        node_conf = jnp.any(hits & (topn >= 0)[None, :, :], axis=2)
+        cursor_conf = advances[None, :] & (e_w[:, None] == e_w[None, :])
+        pair_conf = node_conf | cursor_conf
+        earlier = lane_arange[None, :] < lane_arange[:, None]
+        blocked = jnp.any(pair_conf & earlier, axis=1)
+        # commit the prefix before the first blocked lane; lane 0 has no
+        # earlier lanes so the wavefront always advances (termination)
+        first_block = jnp.min(jnp.where(blocked, lane_arange, w))
+        count = jnp.maximum(first_block, 1)
+        commit = lane_arange < count
+
+        # state updates for the committed, placed lanes. All scatters
+        # dump masked lanes onto index 0 with a zero delta (add/max are
+        # duplicate-safe) or onto a dedicated dump slot (set).
+        placed_c = place & commit
+        adv_c = advances & commit
+        win = jnp.maximum(best, 0)
+        row = jnp.where(placed_c, win, 0)
+        used = state.used.at[row].add(
+            jnp.where(placed_c[:, None], demand_w, 0)
+        )
+        gg = jnp.where(placed_c, g_w, 0)
+        collisions = state.collisions.at[gg, row].add(
+            placed_c.astype(jnp.int32)
+        )
+        v_w = args.node_value[g_w, win]
+        do_spread = placed_c & args.spread_active[g_w] & (v_w >= 0)
+        sv = jnp.where(do_spread, v_w, 0)
+        sg = jnp.where(do_spread, g_w, 0)
+        spread_counts = state.spread_counts.at[sg, sv].add(
+            do_spread.astype(jnp.int32)
+        )
+        spread_present = state.spread_present.at[sg, sv].max(do_spread)
+        # at most one committed lane advances any eval's cursor (the
+        # cursor conflict rule), so a set-scatter with an E dump slot is
+        # collision-free
+        new_off = (state.offset[e_w] + consumed) % jnp.maximum(
+            args.ring[e_w], 1
+        )
+        off_ext = jnp.concatenate(
+            [state.offset, jnp.zeros((1,), state.offset.dtype)]
+        )
+        ei = jnp.where(adv_c, e_w, E)
+        offset = off_ext.at[ei].set(jnp.where(adv_c, new_off, 0))[:E]
+
+        # stash this round's placements for next round's flush
+        new_pend_idx = jnp.where(commit & lane_in, lanes, a_pad)
+        new_pend_val = jnp.where(commit, best, -1)
+
+        new_state = BatchState(
+            used, collisions, spread_counts, spread_present, offset
+        )
+        return (new_state, placements, new_pend_idx, new_pend_val,
+                i + count, rounds + 1)
+
+    def cond(carry):
+        return carry[4] < stop
+
+    placements0 = jnp.full(a_pad + 1, -1, dtype=jnp.int32)
+    pend_idx0 = jnp.full(w, a_pad, dtype=jnp.int32)
+    pend_val0 = jnp.full(w, -1, dtype=jnp.int32)
+    state, placements, pend_idx, pend_val, _, rounds = jax.lax.while_loop(
+        cond, body,
+        (init, placements0, pend_idx0, pend_val0, jnp.int32(0),
+         jnp.int32(0)),
+    )
+    placements = placements.at[pend_idx].set(pend_val)
+    return state, placements[:a_pad], rounds
+
+
+def plan_batch_wavefront(args: BatchArgs, init: BatchState, n_real: int,
+                         n_valid: int = None, n_shards: int = 1):
+    """Run the wavefront drive; returns (final_state, node index per
+    alloc or -1, rounds). Drop-in for :func:`kernel.plan_batch` on the
+    exact-scan batch — same args, same state, same placements under the
+    deterministic flavor — plus the device-round count the devprof
+    collective counter reads (a LAZY device scalar: recording never
+    syncs). The ``tpu.kernel`` fault point degrades callers to the
+    exact-np host oracle exactly as the sequential scan does."""
+    _faults.fault_point("tpu.kernel")
+    A = int(args.demands.shape[0])
+    n_pad = int(args.capacity.shape[0])
+    w = window_for(A)
+    m = contention_top_m()
+    s = shards_for(n_pad, n_shards)
+    key = (
+        f"E{args.perm.shape[0]}G{args.feasible.shape[0]}"
+        f"A{A}N{n_pad}W{w}M{m}S{s}"
+    )
+    out, sharded = _kernel._dispatch(
+        "wavefront", _plan_batch_wavefront_jit,
+        (args, init, n_real, w, m, s), key,
+    )
+    final_state, placements, rounds = out
+    _devprof.count_rounds(
+        "wavefront", rounds, A if n_valid is None else int(n_valid), sharded
+    )
+    return final_state, placements, rounds
+
+
+# one enumeration: compile ledger, recompile detector, warmup ladder and
+# the multichip bench all iterate PLANNER_JITS; registration rides this
+# module's import (every dispatcher imports it first, and
+# kernel.compile_cache_size pulls it in lazily — no top-level cycle)
+_kernel.PLANNER_JITS["wavefront"] = _plan_batch_wavefront_jit

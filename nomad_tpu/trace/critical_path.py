@@ -130,7 +130,7 @@ def _mesh_dispatch_stats(records: list[dict]) -> dict:
     stamps. ``rounds_per_placement`` is None when no sharded span
     carried the counter (e.g. every sharded dispatch rode the runs
     planner, whose rounds resolve in devprof, not span tags)."""
-    spans = rounds = placements = shards = 0
+    spans = rounds = placements = shards = wavefront = 0
     for r in records:
         for s in r.get("spans") or ():
             tags = s.get("tags") or {}
@@ -144,11 +144,20 @@ def _mesh_dispatch_stats(records: list[dict]) -> dict:
             shards = max(shards, width)
             rounds += int(tags.get("collective_rounds") or 0)
             placements += int(tags.get("placements") or 0)
+            # wavefront dispatches stamp MEASURED rounds (a device
+            # scalar read at the materialize sync) instead of the
+            # one-per-lane static count — their presence is what turns
+            # the convoy verdict into the amortized reading below
+            if "wavefront" in (
+                str(tags.get("planner") or ""), str(tags.get("mode") or "")
+            ):
+                wavefront += 1
     return {
         "sharded_spans": spans,
         "shards": shards,
         "rounds": rounds,
         "placements": placements,
+        "wavefront_spans": wavefront,
         "rounds_per_placement": (
             round(rounds / placements, 4) if placements else None
         ),
@@ -253,6 +262,23 @@ def attribute(records: list[dict], tail_pct: float = 0.99) -> dict:
             f"{mesh['shards']}-way mesh — the sequential fill loop pays "
             "one cross-mesh reduction per placement; batch conflict-free "
             "placements into wavefronts (ROADMAP item 2)"
+        )
+    elif (
+        device_dominant
+        and mesh["sharded_spans"] > 0
+        and mesh.get("wavefront_spans", 0) > 0
+        and rpp is not None
+    ):
+        # the negative of the convoy: wavefront dispatches present and
+        # the MEASURED rounds-per-placement sits under the convoy
+        # threshold — the mesh cost is amortized, look elsewhere
+        verdict = (
+            "device dispatch dominates but the wavefront planner "
+            f"amortizes the mesh: {rpp} collective rounds per placement "
+            f"over a {mesh['shards']}-way mesh "
+            f"({mesh['wavefront_spans']} wavefront dispatch spans) — "
+            "not a convoy; per-shard compute or host "
+            "build/materialize is the next knee"
         )
     elif bottleneck in APPLIER_STAGES:
         verdict = (

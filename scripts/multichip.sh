@@ -16,6 +16,8 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 # the persistent compile cache stores CPU-AOT entries whose machine
 # feature flags may not match this host (cpu_aot_loader SIGILL warning)
 export NOMAD_TPU_COMPILE_CACHE="${NOMAD_TPU_COMPILE_CACHE:-off}"
+# wavefront scored section (tpu/wavefront.py): on by default; =0 skips
+export MULTICHIP_WAVEFRONT="${MULTICHIP_WAVEFRONT:-1}"
 
 python -m nomad_tpu.tpu.multichip "$@"
 

@@ -1021,6 +1021,34 @@ class TestShardSpecDrift:
             {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
         )
 
+    def test_spec_fetch_makes_function_mesh_active(self):
+        """Fetching a PartitionSpec tree (batch_specs/wavefront_specs/
+        ...) is preparing sharded placements — a bare device_put next to
+        it is the same layout drift even when no mesh is named."""
+        src = (
+            "import jax\n"
+            "from nomad_tpu.tpu import shard\n"
+            "def stage(args):\n"
+            "    aspec, sspec = shard.wavefront_specs()\n"
+            "    return jax.device_put(args)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+        assert len(found) == 1 and found[0].line == 5
+
+    def test_spec_fetch_with_stated_sharding_clean(self):
+        src = (
+            "import jax\n"
+            "from nomad_tpu.tpu import shard\n"
+            "def stage(args, mesh):\n"
+            "    aspec, sspec = shard.batch_specs()\n"
+            "    return shard.put(args, aspec, mesh)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/tpu/fix.py": src}, "shard-spec-drift"
+        )
+
     def test_tree_is_clean(self):
         """The sharded planner satellite: the real tpu/ tree states its
         shardings everywhere a mesh is active (or carries a WHY)."""

@@ -500,6 +500,53 @@ class TestConvoyVerdict:
         assert report["mesh"]["rounds_per_placement"] == 0.125
         assert "collective convoy" not in report["verdict"]
 
+    def test_wavefront_run_does_not_fire_convoy(self):
+        """THE negative for the wavefront plane: a real wavefront run
+        emits a dispatch span tagged planner=wavefront (no static round
+        count) plus a device_compute span carrying the MEASURED rounds —
+        the verdict must not name a convoy, and instead names the
+        amortization so a trace reader sees the mesh is paid for."""
+        rec = _record([
+            _span("eval.e2e", "r", None, 0.0, 1000.0),
+            _span(
+                "drain.kernel_dispatch", "k", "r", 0.0, 450.0,
+                tags={"shards": 8, "planner": "wavefront"},
+            ),
+            _span(
+                "drain.device_compute", "d", "r", 450.0, 450.0,
+                tags={
+                    "shards": 8,
+                    "collective_rounds": 40,
+                    "placements": 512,
+                },
+            ),
+        ])
+        report = attribute([rec])
+        assert report["mesh"]["wavefront_spans"] == 1
+        assert report["mesh"]["rounds_per_placement"] < 0.5
+        assert "collective convoy" not in report["verdict"]
+        assert "wavefront" in report["verdict"]
+
+    def test_batched_sched_wavefront_span_counts(self):
+        """batch_sched's solo-kernel path tags mode=wavefront on the
+        same span that carries the measured rounds (set after the
+        materialize sync) — one span, still recognized."""
+        rec = _record([
+            _span("eval.e2e", "r", None, 0.0, 1000.0),
+            _span(
+                "eval.plan_kernel", "k", "r", 0.0, 900.0,
+                tags={
+                    "shards": 8,
+                    "mode": "wavefront",
+                    "collective_rounds": 38,
+                    "placements": 512,
+                },
+            ),
+        ])
+        report = attribute([rec])
+        assert report["mesh"]["wavefront_spans"] == 1
+        assert "collective convoy" not in report["verdict"]
+
     def test_applier_verdict_untouched_by_mesh_spans(self):
         """A queue-wait-dominated tail keeps the serialized-applier
         verdict even when sharded dispatch spans exist elsewhere."""

@@ -655,6 +655,38 @@ class TestDockerContainerConfig:
         assert argv.index("--entrypoint") < img
         assert argv[img + 1 :] == ["-c", "echo", "hi"]
 
+    def test_namespace_network_keys_spec_start_task_roundtrip(
+        self, fake_docker, tmp_path
+    ):
+        """Every networking/namespace key travels the FULL path: the
+        task_config_spec() gate (unknown keys fail start_task loudly,
+        so a key absent from the spec could never reach argv) and then
+        the container argv the fake CLI records."""
+        script, state = fake_docker
+        driver = DockerDriver(binary=script)
+        task = make_task(config={
+            "image": "redis:3.2",
+            "network_mode": "mynet",
+            "ipv4_address": "172.18.0.10",
+            "ipv6_address": "2001:db8::10",
+            "pid_mode": "host",
+            "ipc_mode": "host",
+            "uts_mode": "host",
+            "userns_mode": "host",
+        })
+        task_dir = tmp_path / "taskdir"
+        task_dir.mkdir()
+        handle = driver.start_task(task, str(task_dir))
+        run_args = (state / f"{handle._container}.run").read_text()
+        assert "--network mynet" in run_args
+        assert "--ip 172.18.0.10" in run_args
+        assert "--ip6 2001:db8::10" in run_args
+        assert "--pid host" in run_args
+        assert "--ipc host" in run_args
+        assert "--uts host" in run_args
+        assert "--userns host" in run_args
+        driver.stop_task(handle, timeout=1)
+
     def test_config_error_surfaces_through_start_task(self, fake_docker, tmp_path):
         """A bad stanza fails start_task loudly (→ driver-failure task
         event), never launching a container."""

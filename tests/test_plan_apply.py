@@ -607,6 +607,45 @@ class TestPipelinedApply:
         p.node_allocation[node.id] = [make_alloc(node.id, cpu=cpu, mem=64)]
         return p
 
+    def test_stack_failure_mid_batch_commits_prefix_requeues_rest(self):
+        """Regression for the partial-snapshot hole: when post-accept
+        stacking raises at entry i, _verify_batch must return EXACTLY
+        the verified prefix (entry i included — it was accepted before
+        the stack broke) as entries, hand every later plan back as a
+        leftover for requeue, and must NOT have verified or responded to
+        any leftover — verifying them against the partial stacked
+        snapshot would double-book entry i's capacity."""
+        from nomad_tpu.core.plan_apply import PendingPlan
+
+        state = StateStore()
+        node = self._node(state, cpu=1000)
+        planner = Planner(state)  # never started: direct _verify_batch
+
+        live = [PendingPlan(self._plan(node, cpu=100)) for _ in range(4)]
+        base = state.snapshot()
+
+        real = planner._optimistic_snapshot
+        calls = {"n": 0}
+
+        def flaky(snap, plan, result):
+            # calls 1..2 stack entries 0..1; call 3 (stacking entry 2
+            # into the live base) explodes mid-batch
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("columnar stack exploded")
+            return real(snap, plan, result)
+
+        planner._optimistic_snapshot = flaky
+        entries, leftovers, noops, epoch = planner._verify_batch(live, base)
+
+        assert [p for p, _ in entries] == live[:3]
+        assert all(r.node_allocation for _, r in entries)
+        assert leftovers == live[3:]
+        assert noops == []
+        for p in leftovers:
+            assert p.result is None and p.error is None
+            assert not p._done.is_set(), "leftover was responded to"
+
     def test_overlay_rolls_back_on_commit_failure(self):
         """A failed commit's phantom adds must leave the overlay: the
         same capacity must be grantable to the next plan."""

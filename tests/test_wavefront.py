@@ -1,0 +1,499 @@
+"""The wavefront placement plane (nomad_tpu/tpu/wavefront.py): parity,
+contention binning, degradation and accounting.
+
+The contract under test is exactness-by-construction: the wavefront
+planner commits a PREFIX of each predicted window — cut at the first
+lane whose candidate nodes or ring cursor could couple it to an earlier
+lane — so its placements AND final state are bit-identical to the
+sequential fill loop (kernel.plan_batch), which stays THE oracle. Every
+test here therefore compares against plan_batch on the SAME (args,
+init), unsharded and across the 8-device virtual mesh with an uneven
+node axis, under the deterministic compile flavor where bit-equality is
+guaranteed rather than merely expected.
+
+The suite also pins the operational edges: the sole-shared-node
+contention case must serialize (never share a wavefront), a faulted
+kernel must degrade to the exact-np host path, disabling the plane must
+reproduce the old exact-scan dispatch, and the devprof round accounting
+must show commit rounds ≪ placements on multi-tenant shapes (the number
+the MULTICHIP crpp criterion reads).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nomad_tpu.tpu import shard, wavefront
+from nomad_tpu.tpu.kernel import (
+    BatchArgs,
+    BatchState,
+    deterministic_scope,
+    plan_batch,
+)
+from nomad_tpu.tpu.multichip import (
+    build_cluster,
+    exact_problem,
+    pad_cluster,
+    wavefront_problem,
+)
+from nomad_tpu.tpu.wavefront import plan_batch_wavefront
+
+N_DEV = 8
+
+#: real node count whose rows end MID-shard after bucketing (the
+#: test_multichip.py property-suite constant): 2059 buckets to 3072
+N_UNEVEN = 2059
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    if len(devices) < N_DEV:
+        pytest.skip(f"need {N_DEV} virtual devices, have {len(devices)}")
+    return Mesh(np.array(devices[:N_DEV]), ("nodes",))
+
+
+@pytest.fixture(autouse=True)
+def _wavefront_reset():
+    yield
+    wavefront.reset()
+
+
+def _jx(args, init):
+    return (
+        BatchArgs(*[jnp.asarray(a) for a in args]),
+        BatchState(*[jnp.asarray(s) for s in init]),
+    )
+
+
+def _assert_state_equal(want, got):
+    for name, w, g in zip(BatchState._fields, want, got):
+        np.testing.assert_array_equal(
+            np.asarray(w), np.asarray(g), err_msg=f"state.{name} diverged"
+        )
+
+
+# ---------------------------------------------------------------------------
+# parity: the sequential fill loop is THE oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_unsharded_parity_multi_group(seed):
+    """Placements AND final state bit-equal to plan_batch on the
+    multi-tenant problem, with real commit batching (rounds < allocs)."""
+    n_nodes, n_allocs = 1024, 256
+    c = build_cluster(n_nodes, n_allocs, seed=seed)
+    args, init = wavefront_problem(c)
+    jargs, jinit = _jx(args, init)
+
+    s_want, want = plan_batch(jargs, jinit, n_nodes)
+    f_state, got, rounds = plan_batch_wavefront(jargs, jinit, n_nodes)
+
+    want, got = np.asarray(want), np.asarray(got)
+    assert (want >= 0).sum() == n_allocs
+    np.testing.assert_array_equal(want, got)
+    _assert_state_equal(s_want, f_state)
+    assert int(rounds) < n_allocs, (
+        f"no commit batching: {int(rounds)} rounds for {n_allocs} lanes"
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_unsharded_parity_single_group_serializes(seed):
+    """The designed worst case: one group means every pair of lanes
+    shares the feasible set, so exactness forces one commit per round —
+    parity holds AND the round count equals the lane count."""
+    n_nodes, n_allocs = 512, 64
+    c = build_cluster(n_nodes, n_allocs, seed=seed)
+    args, init = exact_problem(c)
+    jargs, jinit = _jx(args, init)
+
+    _, want = plan_batch(jargs, jinit, n_nodes)
+    _, got, rounds = plan_batch_wavefront(jargs, jinit, n_nodes)
+
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    assert int(rounds) == n_allocs
+
+
+@pytest.mark.parametrize("seed", [11, 29, 47])
+def test_sharded_parity_uneven_axis_deterministic(mesh, seed, monkeypatch):
+    """The acceptance pin: sharded wavefront == UNSHARDED sequential,
+    bit-for-bit, across an uneven node axis (real rows end mid-shard)
+    under the deterministic compile flavor."""
+    monkeypatch.setenv("NOMAD_TPU_DETERMINISTIC", "1")
+    n_allocs = 256
+    c = pad_cluster(
+        build_cluster(N_UNEVEN, n_allocs, seed=seed),
+        shard.node_bucket(N_UNEVEN, mesh),
+    )
+    args, init = wavefront_problem(c)
+    jargs, jinit = _jx(args, init)
+
+    _, want = plan_batch(jargs, jinit, N_UNEVEN)
+    want = np.asarray(want)
+
+    aspec, sspec = shard.wavefront_specs()
+    d_args = shard.put(args, aspec, mesh)
+    d_init = shard.put(init, sspec, mesh)
+    _, got, rounds = plan_batch_wavefront(
+        d_args, d_init, N_UNEVEN, n_shards=shard.mesh_size(mesh)
+    )
+
+    assert (want >= 0).sum() == n_allocs
+    np.testing.assert_array_equal(want, np.asarray(got))
+    assert int(rounds) < n_allocs
+
+
+# ---------------------------------------------------------------------------
+# contention binning: shared feasibility must serialize
+# ---------------------------------------------------------------------------
+
+
+def test_sole_shared_node_never_shares_a_wavefront():
+    """Two allocs in different groups whose ONLY feasible node is the
+    same node: the conflict matrix must split them into two rounds (the
+    second lane's selection depends on the first's usage write), and the
+    sequential outcome — second lane unplaced once the node fills — must
+    reproduce exactly."""
+    n_nodes, V = 64, 4
+    c = build_cluster(n_nodes, 2, seed=1)
+    args, init = wavefront_problem(c, n_groups=2, overlap=0)
+    sole = np.zeros((2, n_nodes), dtype=bool)
+    sole[:, 5] = True  # both groups: node 5 only
+    # demand sized so the node holds exactly one of the two allocs
+    cap5 = np.asarray(c["capacity"])[5] - np.asarray(c["reserved"])[5]
+    demands = np.tile((cap5 * 0.6).astype(np.int32), (2, 1))
+    args = args._replace(
+        feasible=sole,
+        demands=demands,
+        spread_active=np.zeros(2, dtype=bool),
+        spread_desired=np.full((2, V), -1.0, dtype=np.float32),
+    )
+    jargs, jinit = _jx(args, init)
+
+    _, want = plan_batch(jargs, jinit, n_nodes)
+    _, got, rounds = plan_batch_wavefront(jargs, jinit, n_nodes)
+
+    want, got = np.asarray(want), np.asarray(got)
+    np.testing.assert_array_equal(want, got)
+    assert want[0] == 5 and want[1] == -1, want
+    assert int(rounds) == 2, (
+        f"sole-shared-node lanes committed in {int(rounds)} round(s)"
+    )
+
+
+def test_disjoint_feasibility_commits_in_one_round():
+    """The inverse control: fully disjoint feasible sets (no overlap,
+    one alloc per group, no cursor coupling) commit in a single round
+    per window."""
+    n_nodes, n_allocs = 512, 16
+    c = build_cluster(n_nodes, n_allocs, seed=2)
+    args, init = wavefront_problem(c, n_groups=16, overlap=0)
+    jargs, jinit = _jx(args, init)
+
+    _, want = plan_batch(jargs, jinit, n_nodes)
+    _, got, rounds = plan_batch_wavefront(jargs, jinit, n_nodes)
+
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    assert int(rounds) == 1, int(rounds)
+
+
+# ---------------------------------------------------------------------------
+# operational edges: fault degrade, disable, accounting
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_fault_degrades_to_exact_np(monkeypatch):
+    """With the device tier faulted and the wavefront ENABLED, a
+    scheduler eval must degrade to the exact-np host path — the
+    wavefront honors the same tpu.kernel fault point as the sequential
+    dispatch, so the fallback ladder is unchanged."""
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import compute_class
+    from nomad_tpu.structs.model import Evaluation, PlanResult, generate_uuid
+    from nomad_tpu.testing import faults
+    from nomad_tpu.tpu import batch_sched
+    from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+
+    wavefront.configure(enabled=True)
+    state = StateStore()
+    rng = random.Random(5)
+    nodes = []
+    for i in range(96):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.node_resources.cpu.cpu_shares = rng.choice([8000, 16000])
+        n.node_resources.memory.memory_mb = rng.choice([16384, 32768])
+        n.node_resources.networks = []
+        n.reserved_resources.networks.reserved_host_ports = ""
+        compute_class(n)
+        nodes.append(n)
+    state.upsert_nodes(1, nodes)
+    job = mock.job()
+    job.id = "job-wavefront-fault"
+    tg = job.task_groups[0]
+    tg.count = 16
+    tg.tasks[0].resources.networks = []
+    state.upsert_job(2, job)
+
+    class Planner:
+        def __init__(self):
+            self.plans = []
+
+        def submit_plan(self, plan):
+            self.plans.append(plan)
+            return PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                node_preemptions=plan.node_preemptions,
+                alloc_index=1,
+            ), None
+
+        def update_eval(self, ev):
+            pass
+
+        def create_eval(self, ev):
+            pass
+
+    plane = faults.install(faults.FaultPlane(seed=3))
+    plane.rule("point", "error", method="tpu.kernel", count=100)
+    try:
+        planner = Planner()
+        sched = TPUBatchScheduler(
+            state.snapshot(), planner, rng=random.Random(17)
+        )
+        ev = Evaluation(
+            id=generate_uuid(), namespace=job.namespace,
+            priority=job.priority, type=job.type,
+            triggered_by="job-register", job_id=job.id,
+            status="pending",
+        )
+        sched.process(ev)
+    finally:
+        faults.uninstall()
+    assert batch_sched.LAST_KERNEL_STATS.get("mode") == "exact-np-degraded"
+    placed = {
+        a.name: a.node_id
+        for allocs in planner.plans[0].node_allocation.values()
+        for a in allocs
+    }
+    assert placed, "degraded eval placed nothing"
+
+
+def test_disabled_equals_sequential_dispatch():
+    """wavefront.enabled() False must leave the old exact-scan dispatch
+    byte-for-byte in charge: same mode string, same placements."""
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import compute_class
+    from nomad_tpu.structs.model import (
+        Evaluation,
+        PlanResult,
+        Spread,
+        SpreadTarget,
+        generate_uuid,
+    )
+    from nomad_tpu.tpu import batch_sched
+    from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+
+    def build_state():
+        state = StateStore()
+        rng = random.Random(9)
+        nodes = []
+        for i in range(96):
+            n = mock.node()
+            n.id = f"node-{i:04d}"
+            n.datacenter = f"dc{i % 4 + 1}"
+            n.node_resources.cpu.cpu_shares = rng.choice([8000, 16000])
+            n.node_resources.memory.memory_mb = rng.choice([16384, 32768])
+            n.node_resources.networks = []
+            n.reserved_resources.networks.reserved_host_ports = ""
+            compute_class(n)
+            nodes.append(n)
+        state.upsert_nodes(1, nodes)
+        job = mock.job()
+        job.id = "job-wavefront-ab"
+        job.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        tg = job.task_groups[0]
+        tg.count = 16
+        tg.tasks[0].resources.networks = []
+        # a spread with a small count routes past the runs/windowed fast
+        # paths to the exact-scan dispatch — the path the wavefront gates
+        job.spreads = [
+            Spread(
+                attribute="${node.datacenter}",
+                weight=100,
+                spread_target=[
+                    SpreadTarget(value=f"dc{i}", percent=25)
+                    for i in (1, 2, 3, 4)
+                ],
+            )
+        ]
+        state.upsert_job(2, job)
+        return state, job
+
+    class Planner:
+        def __init__(self):
+            self.plans = []
+
+        def submit_plan(self, plan):
+            self.plans.append(plan)
+            return PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                node_preemptions=plan.node_preemptions,
+                alloc_index=1,
+            ), None
+
+        def update_eval(self, ev):
+            pass
+
+        def create_eval(self, ev):
+            pass
+
+    def run(enable: bool):
+        wavefront.configure(enabled=enable)
+        state, job = build_state()
+        planner = Planner()
+        sched = TPUBatchScheduler(
+            state.snapshot(), planner, rng=random.Random(17)
+        )
+        ev = Evaluation(
+            id=generate_uuid(), namespace=job.namespace,
+            priority=job.priority, type=job.type,
+            triggered_by="job-register", job_id=job.id,
+            status="pending",
+        )
+        sched.process(ev)
+        mode = batch_sched.LAST_KERNEL_STATS.get("mode")
+        placed = {
+            a.name: a.node_id
+            for allocs in planner.plans[0].node_allocation.values()
+            for a in allocs
+        }
+        return mode, placed
+
+    mode_off, placed_off = run(enable=False)
+    mode_on, placed_on = run(enable=True)
+    assert mode_off == "exact-scan", mode_off
+    assert mode_on == "wavefront", mode_on
+    assert placed_off == placed_on
+
+
+def test_devprof_round_accounting():
+    """count_rounds('wavefront', ...) must surface measured commit
+    rounds ≪ placements on the multi-tenant shape — the crpp column the
+    MULTICHIP acceptance reads."""
+    from nomad_tpu.debug import devprof
+
+    n_nodes, n_allocs = 1024, 256
+    c = build_cluster(n_nodes, n_allocs, seed=4)
+    args, init = wavefront_problem(c)
+    jargs, jinit = _jx(args, init)
+
+    before = devprof.rounds_snapshot().get("wavefront", {})
+    _, placements, _ = plan_batch_wavefront(jargs, jinit, n_nodes)
+    np.asarray(placements)  # sync so lazy round scalars resolve
+    after = devprof.rounds_snapshot().get("wavefront", {})
+
+    d_rounds = after.get("rounds", 0) - before.get("rounds", 0)
+    d_place = after.get("placements", 0) - before.get("placements", 0)
+    assert d_place == n_allocs
+    assert 0 < d_rounds < 0.2 * d_place, (
+        f"crpp {d_rounds}/{d_place} not under the 0.2 acceptance line"
+    )
+
+
+def test_config_knobs_resolve():
+    """configure() beats env; reset() restores env/default resolution;
+    window/shard derivations stay static-safe."""
+    assert wavefront.enabled() is False  # default off
+    wavefront.configure(enabled=True, max_round=8, contention_top_m=2)
+    assert wavefront.enabled() is True
+    assert wavefront.max_round() == 8
+    assert wavefront.contention_top_m() == 2
+    assert wavefront.window_for(4) == 4  # clamped to the lane count
+    assert wavefront.window_for(512) == 8
+    assert wavefront.shards_for(3072, 8) == 8
+    assert wavefront.shards_for(3070, 8) == 1  # non-divisible → flat
+    wavefront.reset()
+    assert wavefront.enabled() is False
+    assert wavefront.max_round() == wavefront.DEFAULT_MAX_ROUND
+
+
+def test_contention_top_m_parity():
+    """M>1 widens the conflict binning (more conservative) — parity and
+    full placement must be unaffected."""
+    n_nodes, n_allocs = 512, 128
+    c = build_cluster(n_nodes, n_allocs, seed=6)
+    args, init = wavefront_problem(c)
+    jargs, jinit = _jx(args, init)
+
+    _, want = plan_batch(jargs, jinit, n_nodes)
+    wavefront.configure(contention_top_m=3)
+    _, got, rounds_m3 = plan_batch_wavefront(jargs, jinit, n_nodes)
+    wavefront.reset()
+    _, got_m1, rounds_m1 = plan_batch_wavefront(jargs, jinit, n_nodes)
+
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got_m1))
+    assert int(rounds_m3) >= int(rounds_m1)
+
+
+def test_sharded_deterministic_scope_matches_fast(mesh):
+    """deterministic_scope() routes the wavefront through the det AOT
+    executables — same placements as the fast flavor on the same args
+    (the bench parity machinery end to end)."""
+    n_allocs = 128
+    c = pad_cluster(
+        build_cluster(N_UNEVEN, n_allocs, seed=23),
+        shard.node_bucket(N_UNEVEN, mesh),
+    )
+    args, init = wavefront_problem(c)
+    aspec, sspec = shard.wavefront_specs()
+    d_args = shard.put(args, aspec, mesh)
+    d_init = shard.put(init, sspec, mesh)
+    s = shard.mesh_size(mesh)
+
+    _, fast, _ = plan_batch_wavefront(d_args, d_init, N_UNEVEN, n_shards=s)
+    fast = np.asarray(fast)
+    with deterministic_scope():
+        _, det, _ = plan_batch_wavefront(
+            d_args, d_init, N_UNEVEN, n_shards=s
+        )
+    np.testing.assert_array_equal(fast, np.asarray(det))
+
+
+def test_prewarm_ladder_covers_wavefront_zero_recompiles():
+    """The warmup ladder must compile the wavefront program when the
+    plane is enabled (one extra executable per rung), and a warmed
+    dispatch must add nothing to the planner compile cache — the rc0
+    column of the MULTICHIP acceptance."""
+    from nomad_tpu.tpu import warmup
+    from nomad_tpu.tpu.kernel import compile_cache_size
+
+    n_nodes, batch = 512, 16
+    base = warmup.prewarm_drain(n_nodes, batch)
+    wavefront.configure(enabled=True)
+    assert warmup.prewarm_drain(n_nodes, batch) == base + 1
+
+    # steady state: a warm call pins the trace; same-shaped fresh args
+    # must reuse it (0 recompiles), so timed loops never pay XLA.
+    n_allocs = 256
+    args, init = wavefront_problem(build_cluster(1024, n_allocs, seed=9))
+    jargs, jinit = _jx(args, init)
+    _, warm, _ = plan_batch_wavefront(jargs, jinit, 1024)
+    np.asarray(warm)
+    before = compile_cache_size()
+    args2, init2 = wavefront_problem(build_cluster(1024, n_allocs, seed=10))
+    jargs2, jinit2 = _jx(args2, init2)
+    _, again, _ = plan_batch_wavefront(jargs2, jinit2, 1024)
+    np.asarray(again)
+    assert compile_cache_size() - before == 0
