@@ -1595,6 +1595,35 @@ def bench_federation_smoke(seed=20260805):
     }
 
 
+def bench_overload(seed=20260807):
+    """The overload storm (loadgen/overload.py): capacity stage, a burst
+    at OVERLOAD_BURST_X times that offered rate, then a recovery probe —
+    grading the overload control plane past saturation. The contract
+    numbers ride BENCH_SUMMARY as overload_*: goodput at burst must hold
+    against the capacity stage (the brownout + shedding dividend), every
+    op is accounted (zero real failures), and recovery completes inside
+    the SLO window."""
+    from nomad_tpu.loadgen.overload import run_overload_from_env
+
+    report = run_overload_from_env(seed=seed)
+    return {
+        "seed": seed,
+        "overload_goodput_cap_eps": report["overload_goodput_cap_eps"],
+        "overload_goodput_eps": report["overload_goodput_eps"],
+        "overload_goodput_drop": report["overload_goodput_drop"],
+        "overload_shed_frac": report["overload_shed_frac"],
+        "overload_dl_exceeded": report["overload_dl_exceeded"],
+        "overload_recovery_s": report["overload_recovery_s"],
+        "overload_admitted_p99_ms": report["overload_admitted_p99_ms"],
+        "overload_failed": report["overload_failed"],
+        "overload_unaccounted": report["overload_unaccounted"],
+        "brownout_max_level": report["brownout_max_level"],
+        "invariant_violations": report["invariants"]["violations"],
+        "quiesced": report["quiesced"],
+        "slo_score": report["slo"]["score"],
+    }
+
+
 def main():
     # the single-chip headline stays single-chip by construction, even
     # under NOMAD_TPU_SHARD=1 — the sharded section measures the mesh
@@ -1617,6 +1646,8 @@ def main():
             detail["fanout"] = bench_fanout()
         if os.environ.get("BENCH_FEDERATION", "1") != "0":
             detail["federation_smoke"] = bench_federation_smoke()
+        if os.environ.get("BENCH_OVERLOAD", "1") != "0":
+            detail["overload"] = bench_overload()
         # worker-scaling curve over the same real-server drain path (the
         # 1-core bench box bounds speedup; the curve + queue depth shows
         # WHERE the control plane saturates)
@@ -1753,6 +1784,21 @@ def main():
             parts.append(f"fed_heal_s={fed['fed_heal_s']}")
             parts.append(f"fed_fwd_err_rate={fed['fed_fwd_err_rate']}")
             parts.append(f"fed_slo_score={fed['slo_score']}")
+        if "overload" in detail:
+            ovl = detail["overload"]
+            parts.append(
+                f"overload_goodput_eps={ovl['overload_goodput_eps']}"
+            )
+            parts.append(
+                f"overload_shed_frac={ovl['overload_shed_frac']}"
+            )
+            parts.append(
+                f"overload_dl_exceeded={ovl['overload_dl_exceeded']}"
+            )
+            parts.append(
+                f"overload_recovery_s={ovl['overload_recovery_s']}"
+            )
+            parts.append(f"overload_slo_score={ovl['slo_score']}")
         to = detail["trace_overhead"]
         parts.append(f"trace_overhead_pct={to['overhead_pct']}")
         dpo = detail["devprof_overhead"]

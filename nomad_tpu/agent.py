@@ -215,6 +215,22 @@ class ServerAgent:
         self.rpc.server_rpc_addrs = dict(voters)
         self.rpc.voters_snapshot = self.server.raft.voters_snapshot
         self._register_endpoints(self.server, self.rpc)
+        if self.server.overload is not None:
+            ov = self.server.overload
+
+            def _admission_check(method, payload, _ov=ov):
+                # priority-aware shedding at the RPC edge: job-carrying
+                # payloads classify on the job's own priority, everything
+                # else rides the service default. Heartbeats and node
+                # registration are exempted by RpcServer.ADMISSION_EXEMPT.
+                pri = None
+                if isinstance(payload, dict):
+                    job = payload.get("job")
+                    if isinstance(job, dict):
+                        pri = job.get("priority")
+                _ov.admit_request(pri)
+
+            self.rpc.admission_check = _admission_check
         self.rpc.start()
         self.server.start(num_workers=num_workers, wait_for_leader=wait_for_leader)
 

@@ -31,6 +31,7 @@ from . import (  # noqa: F401,E402
     lockgraph,
     plane_mutation,
     raft_hygiene,
+    retry_budget,
     shard_hygiene,
     span_hygiene,
     threads,

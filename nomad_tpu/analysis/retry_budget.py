@@ -1,0 +1,128 @@
+"""Retry-amplification hygiene checker.
+
+The overload plane (core/overload.py) exists because retries multiply:
+one user request that fans through a leader-chase ladder, a rotation
+ladder, and an HTTP forward loop can hit a struggling cluster dozens of
+times — each layer individually "bounded", the product a storm. The
+process-wide ``RetryBudget`` is the damper: every retry loop consults it
+before sleeping and re-firing, so past saturation retries stop instead
+of compounding. That contract only holds if every NEW retry loop also
+consults it — which is exactly the kind of invariant a reviewer misses
+and a grep can keep.
+
+Rule:
+
+- ``retry-without-budget`` — a ``for``/``while`` loop that both catches
+  an exception (``try`` in the loop body) and backs off with
+  ``time.sleep(...)`` — the sleep-and-retry shape — inside a function
+  with no budget/deadline evidence. Evidence (function granularity): any
+  identifier, attribute, or string containing ``budget`` or ``deadline``
+  (``retry_budget().try_acquire()``, ``deadline_remaining_s(...)``, a
+  ``_deadline`` read, ...). Periodic tickers that pace on
+  ``Event.wait()`` are deliberately out of scope — they re-run on a
+  cadence, they don't amplify per-request.
+
+Suppress deliberate exceptions with ``# nta: ignore[retry-without-budget]``
+plus a WHY — e.g. a boot-time ramp that retries a fixed small number of
+times before any user traffic exists.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, Project, dotted, register
+
+#: the module that IMPLEMENTS the budget/deadline plane: its internals
+#: legitimately sleep in refill/accounting paths
+_EXEMPT = ("nomad_tpu/core/overload.py",)
+
+_EVIDENCE_SUBSTRINGS = ("budget", "deadline")
+
+
+def _is_sleep_call(node: ast.AST) -> bool:
+    """``time.sleep(...)`` (or any ``<mod>.sleep(...)``) — the backoff
+    shape. ``Event.wait()`` pacing is out of scope (periodic tickers)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    return isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+
+
+def _has_evidence(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = node.id if isinstance(node, ast.Name) else node.attr
+            low = name.lower()
+            if any(s in low for s in _EVIDENCE_SUBSTRINGS):
+                return True
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            low = node.value.lower()
+            if any(s in low for s in _EVIDENCE_SUBSTRINGS):
+                return True
+    return False
+
+
+def _retryish(loop: ast.AST) -> bool:
+    """Loop body contains BOTH an exception catch and a backoff sleep —
+    the sleep-and-retry ladder shape."""
+    has_try = has_sleep = False
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Try):
+            has_try = True
+        elif _is_sleep_call(node):
+            has_sleep = True
+        if has_try and has_sleep:
+            return True
+    return False
+
+
+@register(
+    "retry-without-budget",
+    "sleep-and-retry loop that never consults the process retry budget "
+    "or a deadline (the retry-amplification class)",
+)
+def check_retry_budget(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.modules:
+        if mod.relpath in _EXEMPT:
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            loops = [
+                n
+                for n in ast.walk(fn)
+                if isinstance(n, (ast.For, ast.While)) and _retryish(n)
+            ]
+            if not loops:
+                continue
+            if _has_evidence(fn):
+                continue
+            # report the INNERMOST matching loop(s) only: an outer loop
+            # that merely contains a flagged retry ladder is not itself
+            # a second ladder
+            inner = [
+                lp
+                for lp in loops
+                if not any(
+                    lp2 is not lp and lp2 in ast.walk(lp) for lp2 in loops
+                )
+            ]
+            for lp in inner:
+                kind = "for" if isinstance(lp, ast.For) else "while"
+                findings.append(
+                    Finding(
+                        "retry-without-budget", mod.relpath, lp.lineno,
+                        f"{kind}-loop in {fn.name}() sleeps and retries "
+                        "without consulting retry_budget() or a "
+                        "deadline; past saturation this amplifies load "
+                        "instead of shedding it",
+                    )
+                )
+    return findings
+
+
+__all__ = ["check_retry_budget", "dotted"]

@@ -17,6 +17,15 @@ from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
 from .. import metrics
+from ..core.overload import (
+    DeadlineExceeded,
+    ErrOverloaded,
+    current_deadline,
+    deadline_remaining_s,
+    deadline_scope,
+    mint_deadline,
+    retry_budget,
+)
 from ..jobspec.hcl import parse_duration
 from ..raft import NotLeaderError
 from ..structs.model import Allocation, Job
@@ -67,6 +76,25 @@ def _pre_send_failure(e: Exception) -> bool:
     ):
         return isinstance(e.reason, ConnectionRefusedError)
     return False
+
+def _request_priority(body):
+    """Eval priority the submitted work will run at, when the body carries
+    one (job register/dispatch payloads), else None — the admission
+    controller's priority-aware shedding classifies on it (system >
+    service > batch, core/overload.py)."""
+    if isinstance(body, dict):
+        job = body.get("Job")
+        if isinstance(job, dict):
+            # the wire format is snake_case (Job.to_dict); "Priority" is
+            # accepted too for reference-API-shaped clients
+            pri = job.get("priority", job.get("Priority"))
+            if pri is not None:
+                try:
+                    return int(pri)
+                except (TypeError, ValueError):
+                    pass
+    return None
+
 
 _ROUTES: list[tuple[str, re.Pattern, str, object]] = []
 
@@ -169,6 +197,38 @@ class HTTPServer:
         import weakref
 
         self._detached_socks = weakref.WeakSet()
+
+    def _mint_request_deadline(self, headers, query) -> int:
+        """Mint the request's wall-clock deadline (unix ns; 0 = none).
+
+        Precedence: an explicit ``X-Nomad-Deadline: <seconds>`` header
+        always wins (honored even without an overload stanza — it is an
+        explicit per-request opt-in). With the overload plane configured,
+        ``?wait=<dur>`` doubles as the deadline (a blocking caller gone
+        after its wait is work nobody collects), then the stanza's
+        ``default_deadline_s``. Without the stanza those two mint nothing
+        — the A/B contract keeps pre-overload behavior byte-identical."""
+        hdr = headers.get("X-Nomad-Deadline")
+        if hdr:
+            try:
+                ttl = float(hdr)
+                if ttl > 0:
+                    return mint_deadline(ttl)
+            except ValueError:
+                pass
+        ov = getattr(self.server, "overload", None) if self.server else None
+        if ov is None:
+            return 0
+        if query.get("wait"):
+            try:
+                ttl = parse_duration(query["wait"]) / 1e9
+                if ttl > 0:
+                    return mint_deadline(ttl)
+            except (ValueError, TypeError):
+                pass
+        if ov.default_deadline_s > 0:
+            return mint_deadline(ov.default_deadline_s)
+        return 0
 
     def start(self):
         from ..util import LogBuffer
@@ -335,31 +395,64 @@ class HTTPServer:
                         query["__secret__"] = self.headers.get(
                             "X-Nomad-Token", ""
                         )
+                        # bounded accept (the overload plane): mutating
+                        # requests pass priority-aware admission BEFORE
+                        # any handler work — reject-early with 429 +
+                        # Retry-After keeps queues short instead of
+                        # metastable. Reads stay open (they are how
+                        # operators see an overloaded cluster).
+                        if (
+                            method != "GET"
+                            and server is not None
+                            and getattr(server, "overload", None) is not None
+                        ):
+                            try:
+                                server.overload.admit_request(
+                                    _request_priority(body)
+                                )
+                            except ErrOverloaded as e:
+                                self._respond_overloaded(e)
+                                return
                         try:
+                            dl_ns = api._mint_request_deadline(
+                                self.headers, query
+                            )
+                            if dl_ns and time.time_ns() >= dl_ns:
+                                raise DeadlineExceeded(
+                                    "request deadline exceeded before "
+                                    "dispatch",
+                                    where="http",
+                                )
                             trace_hdr = self.headers.get("X-Nomad-Trace")
-                            if trace_hdr:
-                                # forwarded-request propagation: the
-                                # proxying hop's span context rides the
-                                # header so this handler's spans join the
-                                # submitter's tree (cross-region critical
-                                # paths are one retained trace)
-                                from ..trace import tracer
+                            # the deadline scope makes the deadline
+                            # visible to everything downstream of the
+                            # handler — eval creation stamps it, and
+                            # ConnPool forwards it on any remote hop
+                            with deadline_scope(dl_ns):
+                                if trace_hdr:
+                                    # forwarded-request propagation: the
+                                    # proxying hop's span context rides
+                                    # the header so this handler's spans
+                                    # join the submitter's tree (cross-
+                                    # region critical paths are one
+                                    # retained trace)
+                                    from ..trace import tracer
 
-                                ctx = None
-                                try:
-                                    ctx = tracer.ctx_from_annotation(
-                                        json.loads(trace_hdr)
-                                    )
-                                except Exception:
-                                    pass
-                                with tracer.activate(ctx):
+                                    ctx = None
+                                    try:
+                                        ctx = tracer.ctx_from_annotation(
+                                            json.loads(trace_hdr)
+                                        )
+                                    except Exception:
+                                        pass
+                                    with tracer.activate(ctx):
+                                        result, index = getattr(api, name)(
+                                            _DecodedMatch(match), query, body
+                                        )
+                                else:
                                     result, index = getattr(api, name)(
                                         _DecodedMatch(match), query, body
                                     )
-                            else:
-                                result, index = getattr(api, name)(
-                                    _DecodedMatch(match), query, body
-                                )
                             if isinstance(result, RawResponse):
                                 data = result.body
                                 self.send_response(200)
@@ -369,6 +462,23 @@ class HTTPServer:
                                 self.wfile.write(data)
                                 return
                             self._respond(200, result, index)
+                        except ErrOverloaded as e:
+                            # an in-process handler (or the RPC tier under
+                            # it) shed the work mid-flight
+                            self._respond_overloaded(e)
+                        except DeadlineExceeded as e:
+                            # loud terminal outcome, never a silent drop:
+                            # 504 carries the refusing stage in the body
+                            self._respond(
+                                504,
+                                {
+                                    "error": str(e),
+                                    "code": "deadline_exceeded",
+                                    "where": getattr(e, "where", "")
+                                    or "http",
+                                },
+                                None,
+                            )
                         except KeyError as e:
                             self._respond(404, {"error": str(e)}, None)
                         except PermissionError as e:
@@ -487,6 +597,12 @@ class HTTPServer:
                     attempt += 1
                     if time.monotonic() + backoff > deadline:
                         break
+                    # forward retries ride the process-wide retry budget
+                    # (core/overload.py) with the rpc ladders: when the
+                    # bucket is dry, fail fast instead of amplifying
+                    if not retry_budget().try_acquire():
+                        metrics.incr("http.leader_forward.budget_exhausted")
+                        break
                     metrics.incr("http.leader_forward.retry")
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 0.5)
@@ -515,6 +631,14 @@ class HTTPServer:
                         headers["X-Nomad-Trace"] = json.dumps(ctx.to_dict())
                 except Exception:
                     pass
+                # deadline propagation across proxy hops: carry the
+                # REMAINING budget (the header's unit is seconds-from-now)
+                # so the remote hop re-mints the same absolute deadline
+                dl = current_deadline()
+                if dl:
+                    rem = deadline_remaining_s(dl)
+                    if rem is not None and rem > 0:
+                        headers["X-Nomad-Deadline"] = f"{rem:.3f}"
                 return headers
 
             def _forward_region(self, method, region, parsed, query, body):
@@ -622,6 +746,11 @@ class HTTPServer:
                     attempt += 1
                     if time.monotonic() + backoff > deadline:
                         break
+                    # same shared retry budget as the leader-forward loop
+                    # and the rpc client ladders: bounded amplification
+                    if not retry_budget().try_acquire():
+                        metrics.incr("http.region_forward.budget_exhausted")
+                        break
                     metrics.incr("http.region_forward.retry")
                     time.sleep(backoff)
                     backoff = min(backoff * 2, 0.5)
@@ -634,6 +763,27 @@ class HTTPServer:
                     },
                     None,
                 )
+
+            def _respond_overloaded(self, e):
+                """429 + Retry-After: the shed-work contract. The body
+                carries the machine-readable code and the same hint so
+                non-header-aware clients can pace themselves too."""
+                retry_after = float(getattr(e, "retry_after", 1.0) or 1.0)
+                data = json.dumps(
+                    {
+                        "error": str(e),
+                        "code": "overloaded",
+                        "retry_after": retry_after,
+                    }
+                ).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Retry-After", str(max(1, int(retry_after)))
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def _respond(self, code, payload, index):
                 data = json.dumps(payload).encode()
@@ -1605,6 +1755,14 @@ class HTTPServer:
             ),
             # trace plane retention/sampling state (nomad_tpu/trace)
             "trace": _tracer.stats(),
+            # overload control plane (core/overload.py): load signal,
+            # admitted/shed by class, deadline_exceeded ledger by stage,
+            # brownout level — {} when the stanza is off
+            "overload": (
+                self.server.overload.stats()
+                if getattr(self.server, "overload", None) is not None
+                else {}
+            ),
         }
         # device plane (debug/devprof.py): compile ledger + collective
         # census + transfer totals + round counters. jax-free reads —

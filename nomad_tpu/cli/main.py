@@ -1132,7 +1132,9 @@ def cmd_event_stream(args):
     client = _client(args)
     index = args.index or 0
     delay = 1.0
-    while True:
+    # WHY: one interactive stream, reconnect paced at human timescale —
+    # a budget here would only mute the operator's terminal mid-incident
+    while True:  # nta: ignore[retry-without-budget]
         try:
             stream = client.event_stream(
                 topics=args.topic or None,

@@ -982,7 +982,10 @@ class Client:
     def _watch_allocations(self):
         """Long-poll the server for alloc changes (ref client.go:1861)."""
         index = 0
-        while not self._stop.is_set():
+        # WHY: the node's single alloc-watch long-poll — one in-flight
+        # query per node by construction, paced by the blocking-query
+        # wait; severing it on budget would blind the node to its work
+        while not self._stop.is_set():  # nta: ignore[retry-without-budget]
             try:
                 allocs, new_index = self.server.get_client_allocs(
                     self.node.id, min_index=index, timeout=0.5

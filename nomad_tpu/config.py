@@ -57,6 +57,29 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     #                                     # to the conflict binning (1 =
     #                                     # winner-only, already exact)
     "wavefront": {},
+    # overload control plane (core/overload.py; OBSERVABILITY.md):
+    # overload { enabled = true        # stanza present+enabled wires the
+    #                                  # plane; absent = byte-identical
+    #                                  # pre-overload behavior
+    #            depth_limit = 4096    # broker ready+unacked depth that
+    #                                  # reads as load 1.0
+    #            queue_wait_budget_ms = 500  # plan.queue_wait p99 that
+    #                                        # reads as load 1.0
+    #            shed_batch = 0.8      # load at which batch work sheds
+    #            shed_service = 0.95   # ... service work (system + node
+    #                                  # heartbeats are never shed)
+    #            retry_after_s = 1.0   # client hint on 429/ErrOverloaded
+    #            retry_budget = 256    # process-wide retry token bucket
+    #            retry_refill_per_s = 64.0
+    #            default_deadline_s = 0  # per-request deadline minted for
+    #                                    # write endpoints without an
+    #                                    # explicit X-Nomad-Deadline
+    #                                    # (0 = none)
+    #            brownout { enabled = true
+    #                       enter = 0.9   exit = 0.6  # load thresholds
+    #                       enter_streak = 3          # consecutive samples
+    #                       exit_streak = 5 } }       # before a step
+    "overload": {},
 }
 
 
@@ -139,6 +162,8 @@ def server_config_from_agent(config: dict) -> dict:
         out["plan_pipeline"] = dict(config["plan_pipeline"])
     if config.get("wavefront"):
         out["wavefront"] = dict(config["wavefront"])
+    if config.get("overload"):
+        out["overload"] = dict(config["overload"])
     for key in (
         "heartbeat_ttl",
         "eval_gc_interval",

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import metrics
 from ..structs.model import Evaluation, generate_uuid
 from ..trace import tracer
 
@@ -228,6 +229,11 @@ class EvalBroker:
         self._wake_seq = 0
         # rotated scan start so concurrent dequeuers spread over shards
         self._rotor = itertools.count()
+        # hook: (ev) -> None; the leader marks an eval whose deadline
+        # passed before delivery as terminally failed
+        # (``deadline_exceeded``) — refused work is always accounted,
+        # never silently dropped (core/overload.py)
+        self.on_deadline_exceeded = None
         # the eval.e2e enqueue→ack tap lives in the trace plane now: the
         # root span opened at first enqueue (tracer.eval_root) is closed
         # at ack (tracer.finish_eval), which emits the eval.e2e timer
@@ -265,17 +271,21 @@ class EvalBroker:
             self._process_enqueue(shard, ev, "")
 
     def enqueue_all(self, evals: dict | list):
-        """Enqueue many evals; accepts {eval: token} or a list."""
+        """Enqueue many evals; accepts {eval: token}, a list of evals,
+        or a list of (eval, token) pairs. The pair form is the usable
+        spelling of the reference's token'd EnqueueAll (eval_broker.go's
+        map[*Evaluation]string) — Evaluation is an unhashable dataclass
+        here, so it can't key a dict."""
         if isinstance(evals, dict):
-            for ev, token in evals.items():
-                shard = self._shard_for(ev)
-                with shard.lock:
-                    self._process_enqueue(shard, ev, token)
+            items = list(evals.items())
         else:
-            for ev in evals:
-                shard = self._shard_for(ev)
-                with shard.lock:
-                    self._process_enqueue(shard, ev, "")
+            items = [
+                ev if isinstance(ev, tuple) else (ev, "") for ev in evals
+            ]
+        for ev, token in items:
+            shard = self._shard_for(ev)
+            with shard.lock:
+                self._process_enqueue(shard, ev, token)
 
     def _process_enqueue(self, shard: _Shard, ev: Evaluation, token: str):
         """ref eval_broker.go:212-254; caller holds shard.lock."""
@@ -413,40 +423,97 @@ class EvalBroker:
                             best_shard = shard
             if best_shard is None:
                 return None, ""
+            expired: list = []
             with best_shard.lock:
-                ev, token = self._scan(best_shard, schedulers)
+                ev, token = self._scan(best_shard, schedulers, expired)
+            # report refused-expired evals OUTSIDE the shard lock: the
+            # terminal callback (leader wiring) does a raft apply, and
+            # trace finishing does retention bookkeeping — neither
+            # belongs inside the broker's central serialization point
+            for dead_ev, finished_root in expired:
+                tracer.finish_root(finished_root)
+                metrics.incr("overload.deadline_exceeded.broker")
+                logger.warning(
+                    "refusing to dequeue eval %s: deadline exceeded "
+                    "(job %s, %.3fs past)",
+                    dead_ev.id[:8], dead_ev.job_id,
+                    (time.time_ns() - dead_ev.deadline) / 1e9,
+                )
+                if self.on_deadline_exceeded is not None:
+                    try:
+                        self.on_deadline_exceeded(dead_ev)
+                    except Exception:
+                        logger.exception(
+                            "deadline-exceeded callback failed for %s",
+                            dead_ev.id[:8],
+                        )
             if ev is not None:
                 return ev, token
             # raced: the peeked eval was taken; rescan
 
     def _scan(
-        self, shard: _Shard, schedulers: list[str]
+        self, shard: _Shard, schedulers: list[str], expired: list = None
     ) -> tuple[Optional[Evaluation], str]:
         """Pick the highest-priority eval across the shard's eligible
-        queues; caller holds shard.lock."""
-        best: Optional[Evaluation] = None
-        best_queue = ""
-        for sched in schedulers:
-            heap_ = shard.ready.get(sched)
-            if not heap_ or not len(heap_):
-                continue
-            candidate = heap_.peek()
-            if best is None or candidate.priority > best.priority:
-                best = candidate
-                best_queue = sched
-        if best is None:
-            return None, ""
-        ev = shard.ready[best_queue].pop()
-        token = generate_uuid()
-        shard.evals[ev.id] = shard.evals.get(ev.id, 0) + 1
-        # ready-queue wait becomes a span on first delivery (the stage
-        # between submit and a worker picking the eval up)
-        tracer.eval_dequeued(ev.id)
+        queues; caller holds shard.lock. Evals whose deadline already
+        passed are REFUSED at the pop (the overload plane's first
+        enforcement point, core/overload.py): their broker state is
+        resolved terminally here — exactly the cleanup ``ack`` performs —
+        and they ride ``expired`` out to the caller, which reports them
+        (trace finish + metric + terminal callback) outside the lock.
+        Paying a worker/applier/device round for work nobody is waiting
+        on anymore would only deepen the overload that expired it."""
+        while True:
+            best: Optional[Evaluation] = None
+            best_queue = ""
+            for sched in schedulers:
+                heap_ = shard.ready.get(sched)
+                if not heap_ or not len(heap_):
+                    continue
+                candidate = heap_.peek()
+                if best is None or candidate.priority > best.priority:
+                    best = candidate
+                    best_queue = sched
+            if best is None:
+                return None, ""
+            ev = shard.ready[best_queue].pop()
 
-        shard.unack[ev.id] = (
-            ev, token, _WHEEL.arm(self.nack_timeout, self._nack_timeout, (ev.id, token))
-        )
-        return ev, token
+            if ev.deadline and time.time_ns() >= ev.deadline:
+                tracer.eval_event(
+                    ev.id, "eval.deadline_exceeded",
+                    tags={"where": "broker"},
+                )
+                # terminal resolution of the broker's state for this
+                # eval: the ack cleanup, minus unack (it was never
+                # delivered)
+                shard.evals.pop(ev.id, None)
+                with self._route_lock:
+                    self._route.pop(ev.id, None)
+                finished_root = tracer.detach_eval(ev.id)
+                key = (ev.namespace, ev.job_id)
+                if shard.job_evals.get(key) == ev.id:
+                    shard.job_evals.pop(key, None)
+                    blocked = shard.blocked.get(key)
+                    if blocked is not None and len(blocked):
+                        nxt = blocked.pop()
+                        if not len(blocked):
+                            del shard.blocked[key]
+                        self._enqueue_locked(shard, nxt, nxt.type)
+                if expired is not None:
+                    expired.append((ev, finished_root))
+                continue  # rescan: the next-best eval may still be live
+
+            token = generate_uuid()
+            shard.evals[ev.id] = shard.evals.get(ev.id, 0) + 1
+            # ready-queue wait becomes a span on first delivery (the stage
+            # between submit and a worker picking the eval up)
+            tracer.eval_dequeued(ev.id)
+
+            shard.unack[ev.id] = (
+                ev, token,
+                _WHEEL.arm(self.nack_timeout, self._nack_timeout, (ev.id, token)),
+            )
+            return ev, token
 
     def _nack_timeout(self, eval_id: str, token: str):
         try:

@@ -20,6 +20,7 @@ from typing import Optional
 from .. import metrics
 from ..state.store import StateSnapshot, StateStore
 from ..testing import faults as _faults
+from .overload import DeadlineExceeded
 from ..trace import tracer
 from ..structs.funcs import allocs_fit
 from ..structs.model import (
@@ -1001,6 +1002,22 @@ class Planner:
                     p.respond(
                         None,
                         RuntimeError("plan rejected: eval token no longer live"),
+                    )
+                elif p.plan.deadline and time.time_ns() >= p.plan.deadline:
+                    # the overload plane's applier gate (core/overload.py):
+                    # the eval's deadline passed while its plan queued —
+                    # verifying and paying a consensus round for work
+                    # nobody is waiting on would deepen the backlog that
+                    # expired it. The worker turns this into a terminal
+                    # deadline_exceeded eval outcome.
+                    metrics.incr("overload.deadline_exceeded.applier")
+                    p.respond(
+                        None,
+                        DeadlineExceeded(
+                            "plan rejected: deadline exceeded before "
+                            "verify/commit",
+                            where="applier",
+                        ),
                     )
                 else:
                     live.append(p)

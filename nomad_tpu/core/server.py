@@ -52,6 +52,7 @@ from .blocked_evals import BlockedEvals
 from .broker import EvalBroker, shared_timer_wheel
 from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
 from .drainer import NodeDrainer
+from .overload import OverloadController, current_deadline
 from .periodic import PeriodicDispatch, derive_dispatch_job
 from .fsm import FSM
 from .plan_apply import Planner
@@ -167,6 +168,39 @@ class Server:
             )
             self.flight_recorder.observer = self.watchdog.on_sample
         self._flight_enabled = bool(dbg_cfg.get("flight_recorder", True))
+        # overload control plane (core/overload.py; OBSERVABILITY.md "The
+        # overload plane"): constructed ONLY when the overload{} stanza
+        # is present — no stanza means no admission, no brownout, no
+        # default deadline: byte-identical pre-overload behavior (the
+        # A/B contract pinned by tests/test_overload.py)
+        self.overload: Optional[OverloadController] = None
+        ov_cfg = dict(self.config.get("overload") or {})
+        if ov_cfg and ov_cfg.get("enabled", True):
+            self.overload = OverloadController(
+                ov_cfg,
+                load_fn=self._overload_load,
+                brownout_actions=self._brownout_actions(),
+            )
+            # the broker refuses expired evals at dequeue; this callback
+            # turns each refusal into a terminal failed-eval update so
+            # the submitter sees a loud outcome, never a vanished eval
+            self.eval_broker.on_deadline_exceeded = (
+                lambda ev: self.eval_deadline_exceeded(ev, "broker")
+            )
+            # drive the brownout ladder at the flight recorder's cadence,
+            # chained in FRONT of the watchdog observer so both see every
+            # sample (brownout transitions are deterministic per run)
+            prev_observer = self.flight_recorder.observer
+
+            def _overload_observer(sample, _prev=prev_observer):
+                try:
+                    self.overload.on_sample()
+                except Exception:
+                    logger.exception("overload on_sample failed")
+                if _prev is not None:
+                    _prev(sample)
+
+            self.flight_recorder.observer = _overload_observer
         self.planner = Planner(self.state)
         # max independently-verified plans folded into ONE raft entry
         # (server stanza `plan_apply_batch`; the observed fold sizes are
@@ -1001,6 +1035,99 @@ class Server:
             self.workers.append(w)
             w.start()
 
+    # ------------------------------------------------------------------
+    # overload plane (core/overload.py)
+    # ------------------------------------------------------------------
+    def _overload_load(self) -> float:
+        """Cheap cached load signal in [0, ~∞): max of broker backlog
+        against its depth limit and the plan queue-wait p99 against its
+        budget. Deliberately two in-process taps — the admission check
+        sits on every mutating request and must never itself become the
+        bottleneck (AdmissionController caches the value for 0.5s)."""
+        cfg = self.config.get("overload") or {}
+        depth_limit = float(cfg.get("depth_limit", 4096))
+        qw_budget_s = float(cfg.get("queue_wait_budget_ms", 500.0)) / 1e3
+        st = self.eval_broker.stats()
+        depth = st["total_ready"] + st["total_unacked"]
+        load = depth / max(1.0, depth_limit)
+        p99 = metrics.percentile("plan.queue_wait", 0.99)
+        if p99:
+            load = max(load, float(p99) / max(1e-9, qw_budget_s))
+        return load
+
+    def _brownout_actions(self) -> list:
+        """The brownout ladder, in degradation order (ISSUE round 18):
+        wavefront→exact-scan dispatch, trace sampling→0, devprof census
+        off, snapshot-on-subscribe off. Every degrade captures the prior
+        value so restore puts the PROCESS-WIDE knob back exactly — a
+        brownout that outlives the storm would leak into the next test's
+        baseline."""
+        from ..debug import devprof as _devprof
+        from ..tpu import wavefront as _wavefront
+        from ..trace import tracer as _tracer
+
+        prior: dict = {}
+
+        def wf_degrade():
+            prior["wavefront"] = _wavefront.enabled()
+            _wavefront.configure(enabled=False)
+
+        def wf_restore():
+            _wavefront.configure(enabled=prior.pop("wavefront", True))
+
+        def trace_degrade():
+            prior["sample_rate"] = _tracer.sample_rate
+            _tracer.sample_rate = 0.0
+
+        def trace_restore():
+            _tracer.sample_rate = prior.pop("sample_rate", 1.0)
+
+        def devprof_degrade():
+            prior["devprof"] = _devprof.enable(False)
+
+        def devprof_restore():
+            _devprof.enable(prior.pop("devprof", True))
+
+        def snap_degrade():
+            eb = self.event_broker
+            if eb is not None:
+                prior["snapshot_on_subscribe"] = eb.snapshot_on_subscribe
+                eb.snapshot_on_subscribe = False
+
+        def snap_restore():
+            eb = self.event_broker
+            if eb is not None:
+                eb.snapshot_on_subscribe = prior.pop(
+                    "snapshot_on_subscribe", True
+                )
+
+        return [
+            ("wavefront", wf_degrade, wf_restore),
+            ("trace_sampling", trace_degrade, trace_restore),
+            ("devprof_census", devprof_degrade, devprof_restore),
+            ("snapshot_on_subscribe", snap_degrade, snap_restore),
+        ]
+
+    def eval_deadline_exceeded(self, ev: Evaluation, where: str):
+        """Terminal deadline_exceeded outcome for ``ev``: one raft-applied
+        failed-eval update carrying the refusing stage, plus the overload
+        ledger. Called by the broker's refuse-at-dequeue callback and the
+        worker's refuse-to-evaluate path (core/worker.py) — the refusing
+        stage increments its own ``overload.deadline_exceeded.<stage>``
+        metric at the refusal point, so this never double-counts."""
+        if self.overload is not None:
+            self.overload.note_deadline_exceeded(where)
+        updated = ev.copy()
+        updated.status = "failed"
+        updated.status_description = f"deadline_exceeded ({where})"
+        updated.modify_time = now_ns()
+        try:
+            self._apply(fsm_mod.EVAL_UPDATE, {"evals": [updated.to_dict()]})
+        except NotLeaderError:
+            # leadership moved mid-refusal: the new leader's broker will
+            # refuse the same expired eval and apply the update itself
+            pass
+
     def stop(self, hard: bool = False):
         """``hard=True`` is a simulated crash (the chaos harness's
         leader kill): no gossip leave broadcast, so peers discover the
@@ -1009,6 +1136,11 @@ class Server:
         failures (serf leave vs. failed)."""
         self._running = False
         self.flight_recorder.stop()
+        if self.overload is not None:
+            # restore every browned-out PROCESS-WIDE knob (wavefront,
+            # trace sampling, devprof, snapshot-on-subscribe) so a storm
+            # that ended mid-brownout can't leak into the next run
+            self.overload.stop()
         if self.watchdog is not None:
             # a bundle capture racing teardown reads dying subsystems;
             # bounded wait, capture errors are already swallowed
@@ -1321,7 +1453,10 @@ class Server:
         interval = float(
             self.config.get("acl", {}).get("replication_interval", 1.0)
         )
-        while self._leader and self._running:
+        # WHY: one replication round per interval per follower region —
+        # fixed cadence, not per-request; budget-severing would stall
+        # ACL convergence (staleness already surfaced as replication lag)
+        while self._leader and self._running:  # nta: ignore[retry-without-budget]
             try:
                 self.replicate_acl_once()
             except Exception as e:
@@ -1727,6 +1862,18 @@ class Server:
         if stored.is_periodic() or stored.is_parameterized():
             return ""
 
+        # direct-RPC submissions never pass the HTTP mint; when the
+        # overload stanza sets default_deadline_s, stamp it here so the
+        # whole pipeline stays bounded regardless of entry surface
+        deadline_ns = current_deadline()
+        if (
+            not deadline_ns
+            and self.overload is not None
+            and self.overload.default_deadline_s > 0
+        ):
+            from .overload import mint_deadline
+
+            deadline_ns = mint_deadline(self.overload.default_deadline_s)
         ev = Evaluation(
             id=generate_uuid(),
             namespace=job.namespace,
@@ -1738,6 +1885,11 @@ class Server:
             status=EVAL_STATUS_PENDING,
             create_time=now_ns(),
             modify_time=now_ns(),
+            # deadline propagation (core/overload.py): the HTTP/RPC edge
+            # activated the caller's deadline scope; the eval carries it
+            # so broker/worker/applier/drain can refuse expired work.
+            # Server-initiated follow-ups deliberately do NOT inherit it.
+            deadline=deadline_ns,
         )
         self._adopt_eval_trace(ev)
         self._apply(fsm_mod.EVAL_UPDATE, {"evals": [ev.to_dict()]})

@@ -26,6 +26,7 @@ from ..structs.model import (
     PlanResult,
 )
 from .broker import FAILED_QUEUE, BrokerError
+from .overload import DeadlineExceeded
 
 logger = logging.getLogger("nomad_tpu.worker")
 
@@ -114,10 +115,43 @@ class Worker:
                 except BrokerError:
                     pass
 
+    def _fail_deadline_exceeded(self, ev: Evaluation, token: str, where: str):
+        """Terminal resolution of expired work (core/overload.py): mark
+        the eval failed ``deadline_exceeded`` and ACK it — nacking would
+        requeue work nobody is waiting on anymore, and the broker would
+        only refuse it again at the next dequeue."""
+        logger.warning(
+            "eval %s deadline exceeded at %s; failing terminal",
+            ev.id[:8], where,
+        )
+        if where == "worker":
+            # the applier/drain stages count their own refusal metric at
+            # the refusal point; the worker-stage refusal is counted here
+            from .. import metrics
+
+            metrics.incr("overload.deadline_exceeded.worker")
+        try:
+            self.server.eval_deadline_exceeded(ev, where)
+        except Exception:
+            logger.exception(
+                "deadline-exceeded update failed for %s", ev.id[:8]
+            )
+        try:
+            self.server.eval_broker.ack(ev.id, token)
+        except BrokerError:
+            pass
+
     def process_eval(self, ev: Evaluation, token: str, snapshot=None, collector=None):
         """Dequeue → snapshot ≥ wait index → invoke scheduler → ack/nack
         (ref worker.go:142-276). ``snapshot``/``collector`` are supplied by
         the batch-drain path (one shared snapshot, fused kernel)."""
+        if ev.deadline and time.time_ns() >= ev.deadline:
+            # refuse BEFORE the snapshot wait and the scheduler invoke:
+            # the deadline passed between broker delivery and here
+            if collector is not None:
+                collector.leave(ev.id)
+            self._fail_deadline_exceeded(ev, token, "worker")
+            return
         try:
             # the worker's slice of the eval's span tree: dequeue → ack
             # on THIS worker (a nack + re-dequeue elsewhere adds another
@@ -146,6 +180,14 @@ class Worker:
                 self._eval = ev
                 self._snapshot_index = snapshot.latest_index()
                 self.invoke_scheduler(snapshot, ev, collector=collector)
+        except DeadlineExceeded as e:
+            # a downstream stage (applier verify/commit, drain dispatch)
+            # refused the work past its deadline: terminal, not a nack —
+            # retrying expired work only deepens the overload
+            self._fail_deadline_exceeded(
+                ev, token, getattr(e, "where", "") or "worker"
+            )
+            return
         except Exception:
             logger.exception("eval processing failed; nacking %s", ev.id)
             try:
@@ -311,6 +353,18 @@ class BatchDrainWorker(Worker):
     def process_batch(self, batch: list) -> list:
         """Spawn one thread per drained eval; returns the threads for the
         run loop to join."""
+        live = []
+        for ev, token in batch:
+            if ev.deadline and time.time_ns() >= ev.deadline:
+                # expired between broker delivery and the batch forming:
+                # refuse before the shared snapshot wait and the fused
+                # kernel ever see it
+                self._fail_deadline_exceeded(ev, token, "worker")
+            else:
+                live.append((ev, token))
+        batch = live
+        if not batch:
+            return []
         if len(batch) == 1:
             self.process_eval(*batch[0])
             return []

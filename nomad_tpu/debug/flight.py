@@ -145,6 +145,23 @@ def sample_process(server) -> dict:
         sample["trace_retained"] = ts.get("retained", 0)
     except Exception:
         pass
+    # overload plane (core/overload.py): keys appear ONLY when the
+    # overload{} stanza constructed a controller, so the watchdog's
+    # overload rule stays silent on unconfigured servers
+    ov = getattr(server, "overload", None)
+    if ov is not None:
+        try:
+            adm = ov.admission
+            sample["overload_load"] = round(adm.load(), 4)
+            sample["overload_admitted_total"] = adm.admitted
+            sample["overload_shed_total"] = adm.shed_total()
+            sample["overload_dl_exceeded_total"] = (
+                ov.deadline_exceeded_total()
+            )
+            bo = ov.brownout
+            sample["brownout_level"] = bo.level if bo is not None else 0
+        except Exception:
+            pass
     # device plane (debug/devprof.py): compile-cache growth over the
     # flight tail is the recompile_storm rule's signal (the
     # 51200-vs-50176 shape-drift class re-paying compiles in steady

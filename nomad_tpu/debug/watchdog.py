@@ -44,7 +44,12 @@ Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
   (evals already processed before the window opened — the prewarm
   ladder's legitimate boot-time compiles never trip it): the
   51200-vs-50176 shape-drift class silently re-paying XLA compiles in
-  steady state becomes a bundle whose device section names the shapes.
+  steady state becomes a bundle whose device section names the shapes;
+- ``overload`` — sustained admission shedding above ``shed_per_s``
+  across the window, or any brownout level above ``brownout_level``:
+  the bundle captures the admission/brownout/retry-budget state while
+  the storm is still in progress. Keys exist only on servers with an
+  ``overload{}`` stanza, so unconfigured agents never trip it.
 
 Trips are always recorded + counted (``debug.watchdog_trips``); the
 bundle write additionally needs a configured ``bundle_dir`` so a
@@ -77,6 +82,7 @@ DEFAULT_RULES = {
     "acl_replication_lag": {"threshold_s": 30.0, "consecutive": 3},
     "recompile_storm": {"growth": 4, "window": 60, "min_span_s": 10.0},
     "plane_divergence": {"threshold": 1},
+    "overload": {"shed_per_s": 50.0, "consecutive": 5, "brownout_level": 0},
 }
 
 MAX_TRIP_LOG = 64
@@ -263,6 +269,44 @@ class Watchdog:
                 "rows": rows,
                 "recs": recs,
                 "planes_version": sample.get("plane_audit_version"),
+            }
+        return None
+
+    def _rule_overload(self, sample, window, p):
+        # sustained shedding — or any brownout past the configured floor
+        # — is an incident whose evidence (admission state, brownout
+        # level, retry-budget depth) is exactly what vanishes once the
+        # storm passes; the bundle captures it while it is happening.
+        # Keys exist only when the overload{} stanza built a controller,
+        # so unconfigured servers never evaluate past the gate.
+        tail = window[-int(p["consecutive"]):]
+        if (
+            len(tail) < int(p["consecutive"])
+            or "overload_shed_total" not in tail[-1]
+            or "overload_shed_total" not in tail[0]
+        ):
+            return None
+        level = sample.get("brownout_level", 0)
+        if level > int(p["brownout_level"]):
+            return {
+                "brownout_level": level,
+                "overload_load": sample.get("overload_load"),
+                "shed_total": sample.get("overload_shed_total"),
+                "dl_exceeded_total": sample.get("overload_dl_exceeded_total"),
+            }
+        span = tail[-1]["t"] - tail[0]["t"]
+        if span <= 0:
+            return None
+        shed_rate = (
+            tail[-1]["overload_shed_total"] - tail[0]["overload_shed_total"]
+        ) / span
+        if shed_rate > float(p["shed_per_s"]):
+            return {
+                "shed_per_s": round(shed_rate, 1),
+                "threshold_per_s": p["shed_per_s"],
+                "overload_load": sample.get("overload_load"),
+                "shed_total": sample.get("overload_shed_total"),
+                "dl_exceeded_total": sample.get("overload_dl_exceeded_total"),
             }
         return None
 

@@ -26,6 +26,13 @@ def main(argv=None) -> int:
         "see scripts/fanout.sh)",
     )
     parser.add_argument(
+        "--overload", action="store_true",
+        help="run the scored overload storm (capacity / burst / recovery "
+        "stages past saturation; env knobs OVERLOAD_CAP_RATE / "
+        "OVERLOAD_BURST_X / OVERLOAD_BURST_S / OVERLOAD_DEPTH_LIMIT / "
+        "OVERLOAD_DEADLINE_S; see scripts/overload.sh)",
+    )
+    parser.add_argument(
         "--federation", action="store_true",
         help="run the multi-region federated storm (partition, "
         "failover, rolling restart as scored chaos phases; env knobs "
@@ -79,6 +86,17 @@ def main(argv=None) -> int:
         )
         print(json.dumps(report["slo"], indent=1))
         print(fanout_summary(report))
+        return 0 if report["slo"]["failed"] == 0 else 1
+
+    if args.overload:
+        from .overload import run_overload_from_env
+        from .overload import summary_line as overload_summary
+
+        report = run_overload_from_env(
+            args.seed, out=args.out, driver_workers=args.driver_workers
+        )
+        print(json.dumps(report["slo"], indent=1))
+        print(overload_summary(report))
         return 0 if report["slo"]["failed"] == 0 else 1
 
     if args.federation:

@@ -56,6 +56,12 @@ class OpResult:
     ok: bool
     expected_miss: bool = False
     shed: bool = False
+    #: server refused the op at admission (429/ErrOverloaded): recorded
+    #: loss by design, not a failure — the overload plane's contract
+    server_shed: bool = False
+    #: server refused the op terminal deadline_exceeded: also recorded
+    #: loss, the other accounted outcome past saturation
+    dl_exceeded: bool = False
     error: str = ""
 
     @property
@@ -72,6 +78,8 @@ class DriverReport:
     failed: int = 0
     expected_miss: int = 0
     shed: int = 0
+    server_shed: int = 0
+    dl_exceeded: int = 0
     by_kind: dict = field(default_factory=dict)
     lateness_p99_s: float = 0.0
     lateness_max_s: float = 0.0
@@ -85,6 +93,8 @@ class DriverReport:
             "failed": self.failed,
             "expected_miss": self.expected_miss,
             "shed": self.shed,
+            "server_shed": self.server_shed,
+            "dl_exceeded": self.dl_exceeded,
             "by_kind": self.by_kind,
             "lateness_p99_s": round(self.lateness_p99_s, 4),
             "lateness_max_s": round(self.lateness_max_s, 4),
@@ -113,6 +123,7 @@ class StormDriver:
         node_resources: dict | None = None,
         token: str = "",
         job_prefix: str = JOB_PREFIX,
+        deadline_s: float = 0.0,
     ):
         self.stream = stream
         self.rpc_servers = list(rpc_servers)
@@ -123,6 +134,11 @@ class StormDriver:
         #: job-id namespace; federated storms scope it per region so the
         #: cross-region oracle can tell the regions' jobs apart
         self.job_prefix = job_prefix
+        #: per-op deadline TTL (seconds; 0 = none): each fired op runs
+        #: under a deadline scope, so the RPC client injects ``_deadline``
+        #: and the whole server pipeline can refuse the work once expired
+        #: — the end-to-end propagation path, not a test shortcut
+        self.deadline_s = float(deadline_s)
         self.workers = workers
         self.max_backlog = max_backlog
         self.time_scale = time_scale
@@ -237,15 +253,37 @@ class StormDriver:
                 op, payload = item
                 began = time.monotonic() - t_start
                 ok, expected, err = True, False, ""
+                srv_shed = dl_exc = False
                 try:
                     if proxy is None:
                         raise RuntimeError(setup_err)
-                    self._fire(op, payload, proxy, http)
+                    if self.deadline_s > 0:
+                        from ..core.overload import (
+                            deadline_scope,
+                            mint_deadline,
+                        )
+
+                        with deadline_scope(
+                            mint_deadline(self.deadline_s)
+                        ):
+                            self._fire(op, payload, proxy, http)
+                    else:
+                        self._fire(op, payload, proxy, http)
                 except Exception as e:  # noqa: BLE001 — failures are data
                     ok = False
                     err = f"{type(e).__name__}: {e}"
+                    # the overload plane's two ACCOUNTED refusals are not
+                    # failures: both are the server's loud, by-design
+                    # answer past saturation ("deadline exceeded" is the
+                    # exception text; "deadline_exceeded" the wire code)
+                    low = err.lower()
+                    srv_shed = "overloaded" in low
+                    dl_exc = (
+                        "deadline_exceeded" in low
+                        or "deadline exceeded" in low
+                    )
                     expected = any(s in str(e) for s in _EXPECTED_SUBSTRINGS)
-                    if not expected:
+                    if not (expected or srv_shed or dl_exc):
                         logger.debug("op %s failed: %s", op.kind, err)
                 self._record(
                     OpResult(
@@ -253,6 +291,7 @@ class StormDriver:
                         t_sched=op.t * self.time_scale,
                         t_start=began, t_done=time.monotonic() - t_start,
                         ok=ok, expected_miss=expected,
+                        server_shed=srv_shed, dl_exceeded=dl_exc,
                         error=err if not ok else "",
                     )
                 )
@@ -369,7 +408,11 @@ class StormDriver:
         for r in results:
             rep.fired += 1
             bk = rep.by_kind.setdefault(
-                r.kind, {"ok": 0, "failed": 0, "expected_miss": 0, "shed": 0}
+                r.kind,
+                {
+                    "ok": 0, "failed": 0, "expected_miss": 0, "shed": 0,
+                    "server_shed": 0, "dl_exceeded": 0,
+                },
             )
             if r.shed:
                 rep.shed += 1
@@ -379,6 +422,12 @@ class StormDriver:
             if r.ok:
                 rep.ok += 1
                 bk["ok"] += 1
+            elif r.server_shed:
+                rep.server_shed += 1
+                bk["server_shed"] += 1
+            elif r.dl_exceeded:
+                rep.dl_exceeded += 1
+                bk["dl_exceeded"] += 1
             elif r.expected_miss:
                 rep.expected_miss += 1
                 bk["expected_miss"] += 1

@@ -238,7 +238,7 @@ class Phase:
                 p.get("count_range_by_category", {}).get(cat)
                 or p.get("count_range", (1, 4))
             )
-            return {
+            args = {
                 "slot": free[rng.randrange(len(free))],
                 "category": cat,
                 "type": "batch" if cat == "bat" else "service",
@@ -247,6 +247,13 @@ class Phase:
                 "memory_mb": rng.choice(p.get("memory_choices", (32, 64, 128))),
                 "version": 0,
             }
+            # overload storms shed by priority class; the key is only
+            # drawn when the param exists so pre-existing scenarios keep
+            # their stream digests byte-identical
+            pri = p.get("priority_by_category", {}).get(cat)
+            if pri is not None:
+                args["priority"] = int(pri)
+            return args
         if kind == "job.scale":
             live = world.live_jobs()
             live = [s for s in live if s.category != "dsp"]
@@ -442,6 +449,8 @@ def build_job(args: dict, datacenters: tuple = ("dc1", "dc2"),
     job.datacenters = list(datacenters)
     tg = job.task_groups[0]
     tg.count = args.get("count", 1)
+    if args.get("priority") is not None:
+        job.priority = int(args["priority"])
     task = tg.tasks[0]
     task.driver = "mock_driver"
     task.resources.cpu = args.get("cpu", 100)

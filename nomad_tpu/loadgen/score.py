@@ -362,6 +362,22 @@ def grade(report: dict, slos: dict) -> dict:
     - ``max_fed_replication_lag_p99_s`` — ACL replication convergence
       lag p99
 
+    Overload storm reports (loadgen/overload.py) likewise:
+
+    - ``max_overload_goodput_drop`` — fractional goodput LOSS past
+      saturation vs the capacity stage (0 when the burst stage completes
+      at least as much work per second — the brownout/shedding dividend)
+    - ``max_overload_unaccounted`` — ops missing from the
+      ok+shed+server_shed+deadline_exceeded+expected+failed ledger
+      (always 0: every op gets a loud outcome)
+    - ``max_overload_failed`` — REAL op failures (shed and
+      deadline-exceeded excluded; always 0)
+    - ``max_overload_recovery_s`` — seconds from burst end until load is
+      back under the brownout exit threshold at level 0
+    - ``max_overload_admitted_p99_ms`` — p99 round-trip of ADMITTED ops
+      during the burst (admitted work keeps its latency budget; shed
+      work fails fast and is excluded)
+
     Returns {checks: {name: {target, actual, pass}}, passed, failed,
     score} where score is the passed fraction (0..1).
     """
@@ -390,6 +406,11 @@ def grade(report: dict, slos: dict) -> dict:
         ("max_fed_heal_s", "fed_heal_s"),
         ("max_fed_fwd_err_rate", "fed_fwd_err_rate"),
         ("max_fed_replication_lag_p99_s", "fed_replication_lag_p99_s"),
+        ("max_overload_goodput_drop", "overload_goodput_drop"),
+        ("max_overload_unaccounted", "overload_unaccounted"),
+        ("max_overload_failed", "overload_failed"),
+        ("max_overload_recovery_s", "overload_recovery_s"),
+        ("max_overload_admitted_p99_ms", "overload_admitted_p99_ms"),
     ):
         if report_key in report:
             actuals[slo_key] = report[report_key]

@@ -468,7 +468,10 @@ class Raft:
 
     def _replicate_loop(self, peer_id: str, addr: str, epoch: int, cond):
         backoff = 0.01
-        while True:
+        # WHY: raft replication IS the recovery path — one loop per peer,
+        # capped backoff; budget-severing it turns overload into
+        # unavailability, the opposite of shedding
+        while True:  # nta: ignore[retry-without-budget]
             with self._lock:
                 if (
                     self.role != LEADER

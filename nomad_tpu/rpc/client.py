@@ -30,11 +30,15 @@ from ..testing import faults as _faults
 
 
 class RpcError(Exception):
-    def __init__(self, code: str, message: str, leader_rpc_addr: Optional[str] = None):
+    def __init__(self, code: str, message: str, leader_rpc_addr: Optional[str] = None,
+                 retry_after: Optional[float] = None):
         super().__init__(f"{code}: {message}")
         self.code = code
         self.message = message
         self.leader_rpc_addr = leader_rpc_addr
+        #: server-supplied pacing hint, set on code == "overloaded": how
+        #: long the caller should wait before resubmitting shed work
+        self.retry_after = retry_after
 
 
 class ConnPool:
@@ -206,6 +210,7 @@ class ConnPool:
             err.get("code", "error"),
             err.get("message", ""),
             err.get("leader_rpc_addr"),
+            retry_after=err.get("retry_after"),
         )
 
     def call(
@@ -231,6 +236,7 @@ class ConnPool:
         where the handler may still be running — are never retried:
         re-sending would duplicate a non-idempotent write."""
         from ..trace import tracer
+        from ..core import overload as _overload
 
         ctx = tracer.current()
         if (
@@ -244,6 +250,16 @@ class ConnPool:
             # Copied, never mutated in place — the caller may retry the
             # same payload object through another pool
             payload = {**payload, "_trace": ctx.to_dict()}
+        deadline_ns = _overload.current_deadline()
+        if (
+            deadline_ns
+            and isinstance(payload, dict)
+            and "_deadline" not in payload
+        ):
+            # deadline propagation (the _trace pattern, core/overload.py):
+            # the handler side re-activates it so the server — and any
+            # eval/plan minted there — inherits the caller's deadline
+            payload = {**payload, "_deadline": deadline_ns}
         with tracer.span(f"rpc.{method}", tags={"addr": addr}):
             return self._call_inner(
                 addr, method, payload, timeout, retry_leader, retry_stale
@@ -252,11 +268,21 @@ class ConnPool:
     def _call_inner(
         self, addr, method, payload, timeout, retry_leader, retry_stale
     ):
+        from ..core.overload import retry_budget
+
         attempts = self.LEADER_RETRIES if retry_leader else 1
         origin = addr
         last_err = None
         for attempt in range(attempts):
             if attempt:
+                # every RETRY (not the first attempt) spends a token from
+                # the process-wide retry budget: when many ladders chase
+                # a dead leader at once, the budget — not the product of
+                # their individual limits — bounds total retry volume
+                # (core/overload.py, the metastable-retry-storm guard)
+                if not retry_budget().try_acquire():
+                    metrics.incr("rpc.retry_budget_exhausted")
+                    raise last_err
                 # backoff before the next hop: a hint that points at a
                 # just-severed peer (or a hint-less mid-election answer)
                 # otherwise hot-loops through the circuit breaker
@@ -386,6 +412,8 @@ class ServerProxy:
     RETRY_BACKOFF_MAX = 1.0
 
     def _call(self, method: str, payload, timeout: Optional[float] = None):
+        from ..core.overload import retry_budget
+
         last_err = None
         for attempt in range(self.max_retries):
             with self._lock:
@@ -403,6 +431,12 @@ class ServerProxy:
                         self._current += 1
                     last_err = e
                     if attempt + 1 < self.max_retries:
+                        # a rotation retry rides the process-wide retry
+                        # budget too (core/overload.py): fail fast with
+                        # the last error once the bucket is dry
+                        if not retry_budget().try_acquire():
+                            metrics.incr("rpc.retry_budget_exhausted")
+                            raise last_err
                         time.sleep(
                             min(
                                 self.RETRY_BACKOFF_BASE * (2 ** attempt),

@@ -18,6 +18,7 @@ import ssl
 import threading
 from typing import Callable, Optional
 
+from ..core.overload import DeadlineExceeded, ErrOverloaded
 from ..raft import NotLeaderError
 from .codec import (
     RPC_NOMAD,
@@ -33,6 +34,13 @@ logger = logging.getLogger("nomad_tpu.rpc")
 
 
 class RpcServer:
+    #: methods never subject to admission control: shedding heartbeats or
+    #: registrations under overload starves node TTLs and converts a load
+    #: spike into a false mass-node-down event (the heartbeat-starvation
+    #: satellite, tests/test_overload.py). Raft traffic rides a separate
+    #: dispatch and is likewise never shed.
+    ADMISSION_EXEMPT = frozenset({"Node.UpdateStatus", "Node.Register"})
+
     def __init__(
         self, bind_addr: str = "127.0.0.1", port: int = 0, tls_context=None
     ):
@@ -53,6 +61,11 @@ class RpcServer:
         #: fall back to the replicated voter map, which on TCP agents
         #: holds dialable addresses (raft rides the RPC listener).
         self.voters_snapshot = None
+        #: optional admission hook (set by ServerAgent when the overload
+        #: stanza is configured): ``admission_check(method, payload)``
+        #: raises ErrOverloaded to shed the call before any handler work.
+        #: ADMISSION_EXEMPT methods bypass it unconditionally.
+        self.admission_check: Optional[Callable] = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind_addr, port))
@@ -224,6 +237,28 @@ class RpcServer:
                         None,
                     ],
                 )
+            except ErrOverloaded as e:
+                write_frame(
+                    conn,
+                    [
+                        seq,
+                        {
+                            "code": "overloaded",
+                            "message": str(e),
+                            "retry_after": getattr(e, "retry_after", 1.0),
+                        },
+                        None,
+                    ],
+                )
+            except DeadlineExceeded as e:
+                write_frame(
+                    conn,
+                    [
+                        seq,
+                        {"code": "deadline_exceeded", "message": str(e)},
+                        None,
+                    ],
+                )
             except KeyError as e:
                 write_frame(
                     conn, [seq, {"code": "not_found", "message": str(e)}, None]
@@ -279,7 +314,9 @@ class RpcServer:
             pass
         except Exception as e:
             if not isinstance(
-                e, (NotLeaderError, KeyError, ValueError)
+                e,
+                (NotLeaderError, KeyError, ValueError,
+                 ErrOverloaded, DeadlineExceeded),
             ):
                 logger.exception("rpc handler error for %s", method)
             try:
@@ -314,6 +351,14 @@ class RpcServer:
                 "message": str(e),
                 "leader_rpc_addr": self._leader_rpc_addr(e),
             }
+        if isinstance(e, ErrOverloaded):
+            return {
+                "code": "overloaded",
+                "message": str(e),
+                "retry_after": getattr(e, "retry_after", 1.0),
+            }
+        if isinstance(e, DeadlineExceeded):
+            return {"code": "deadline_exceeded", "message": str(e)}
         if isinstance(e, KeyError):
             return {"code": "not_found", "message": str(e)}
         if isinstance(e, ValueError):
@@ -324,21 +369,43 @@ class RpcServer:
         handler = self.handlers.get(method)
         if handler is None:
             raise KeyError(f"unknown rpc method: {method}")
-        trace_doc = (
-            payload.pop("_trace", None) if isinstance(payload, dict) else None
-        )
-        if trace_doc is None:
+        trace_doc = None
+        deadline_ns = 0
+        if isinstance(payload, dict):
+            trace_doc = payload.pop("_trace", None)
+            deadline_ns = payload.pop("_deadline", 0) or 0
+        if deadline_ns:
+            from ..core.overload import deadline_expired
+
+            # refuse-before-work: a call whose deadline already passed in
+            # flight gets a terminal deadline_exceeded here instead of
+            # consuming handler/broker/raft time nobody is waiting on
+            if deadline_expired(deadline_ns):
+                raise DeadlineExceeded(
+                    f"{method}: deadline exceeded before dispatch",
+                    where="rpc",
+                )
+        if (
+            self.admission_check is not None
+            and method not in self.ADMISSION_EXEMPT
+        ):
+            self.admission_check(method, payload)
+        if trace_doc is None and not deadline_ns:
             return handler(payload)
-        # wire-propagated trace context: everything the handler does —
-        # including eval creation (Server._adopt_eval_trace) — parents
-        # under the remote caller's span, so a job submitted over RPC is
-        # one tree from the client socket to the device and back
+        from ..core.overload import deadline_scope
         from ..trace import tracer
 
-        ctx = tracer.ctx_from_annotation(trace_doc)
-        with tracer.activate(ctx):
-            with tracer.span(f"rpc.server.{method}"):
+        with deadline_scope(deadline_ns):
+            if trace_doc is None:
                 return handler(payload)
+            # wire-propagated trace context: everything the handler does —
+            # including eval creation (Server._adopt_eval_trace) — parents
+            # under the remote caller's span, so a job submitted over RPC
+            # is one tree from the client socket to the device and back
+            ctx = tracer.ctx_from_annotation(trace_doc)
+            with tracer.activate(ctx):
+                with tracer.span(f"rpc.server.{method}"):
+                    return handler(payload)
 
     def _dispatch_raft(self, method: str, payload):
         handler = self.raft_handlers.get(method)

@@ -1254,6 +1254,15 @@ class Evaluation(Base):
     queued_allocations: dict[str, int] = field(default_factory=dict)
     leader_ack_token: str = ""
     snapshot_index: int = 0
+    #: wall-clock unix-ns deadline minted at the submitting edge
+    #: (core/overload.py); 0 = none. The broker refuses to dequeue, the
+    #: worker refuses to evaluate, and the applier refuses to commit an
+    #: eval whose deadline passed — terminal ``deadline_exceeded``, never
+    #: a silent drop. Server-initiated follow-up evals (rolling, blocked,
+    #: failed-follow-up) deliberately do NOT inherit it: the client's
+    #: deadline bounds the client's request, not the reconciliation work
+    #: it eventually triggers.
+    deadline: int = 0
     create_index: int = 0
     modify_index: int = 0
     create_time: int = 0
@@ -1277,6 +1286,7 @@ class Evaluation(Base):
             eval_id=self.id,
             priority=self.priority,
             job=job,
+            deadline=self.deadline,
         )
         if job is not None:
             p.all_at_once = job.all_at_once
@@ -1491,6 +1501,9 @@ class Plan(Base):
     deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
     node_preemptions: dict[str, list[Allocation]] = field(default_factory=dict)
     snapshot_index: int = 0
+    #: the submitting eval's deadline (unix ns, 0 = none) — the plan
+    #: applier refuses to verify/commit past it (core/overload.py)
+    deadline: int = 0
 
     def append_stopped_alloc(self, alloc: Allocation, desc: str, client_status: str):
         """Mark an alloc for stopping in this plan (ref Plan.AppendStoppedAlloc)."""

@@ -453,6 +453,7 @@ class TPUBatchScheduler(GenericScheduler):
             perm_eligible=perm_eligible,
             collisions0=collisions0,
             by_dc=by_dc,
+            deadline=self.eval.deadline,
         )
 
     # ------------------------------------------------------------------

@@ -89,6 +89,10 @@ class DrainPrep:
     perm_eligible: np.ndarray  # i32[n_elig] shuffled eligible node indices
     collisions0: np.ndarray  # i32[Gi, n_real] same-job alloc counts
     by_dc: dict[str, int]
+    #: the eval's wall-clock deadline (unix ns, 0 = none): the collector
+    #: refuses to spend a device round on an already-expired lane
+    #: (core/overload.py — the drain plane's min-deadline gate)
+    deadline: int = 0
 
 
 class _Parked:
@@ -294,6 +298,31 @@ class KernelBatchCollector:
     def _run_batch(self, parked: Optional[list]):
         if not parked:
             return
+        # the batch min-deadline gate (core/overload.py): lanes whose
+        # deadline passed while they rendezvoused are refused BEFORE the
+        # fused build and the device round — their threads wake with
+        # DeadlineExceeded (the worker turns that into a terminal
+        # deadline_exceeded eval outcome), and if every lane expired the
+        # batch pays no device dispatch at all
+        now = time.time_ns()
+        expired = [
+            p for p in parked if p.prep.deadline and now >= p.prep.deadline
+        ]
+        if expired:
+            from .. import metrics
+            from ..core.overload import DeadlineExceeded
+
+            metrics.incr("overload.deadline_exceeded.drain", len(expired))
+            for p in expired:
+                p.error = DeadlineExceeded(
+                    "drain lane refused: deadline exceeded before device "
+                    "dispatch",
+                    where="drain",
+                )
+                p.event.set()
+            parked = [p for p in parked if p.error is None]
+            if not parked:
+                return
         # deterministic sequencing regardless of thread arrival order:
         # highest priority first, then submission order (the broker's
         # dequeue ordering), so capacity threads through the fused scan the

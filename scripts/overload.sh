@@ -1,0 +1,38 @@
+#!/usr/bin/env sh
+# Overload storm entry point (nomad_tpu/loadgen/overload.py; README
+# "Overload control plane" + OBSERVABILITY.md "The overload plane").
+# Drives one live server PAST saturation — a capacity stage, a burst at
+# OVERLOAD_BURST_X times that rate, then a recovery probe — and scores
+# the overload control plane: goodput must hold past the knee, every op
+# must be accounted (ok / shed / deadline_exceeded, zero real failures),
+# admitted work must keep its latency budget, and recovery must complete
+# inside the SLO window; exit 0 = every SLO passed.
+#
+#   scripts/overload.sh                          # -> OVERLOAD_r01.json
+#   OVERLOAD_BURST_X=5 scripts/overload.sh       # harder burst
+#   OVERLOAD_DEPTH_LIMIT=64 scripts/overload.sh  # earlier knee
+#   OVERLOAD_DEADLINE_S=4 scripts/overload.sh    # tighter deadlines
+#
+# Scale knobs (env): OVERLOAD_NODES, OVERLOAD_CAP_RATE, OVERLOAD_CAP_S,
+# OVERLOAD_BURST_X, OVERLOAD_BURST_S, OVERLOAD_DEPTH_LIMIT,
+# OVERLOAD_DEADLINE_S, OVERLOAD_RECOVERY_SLO_S,
+# OVERLOAD_GOODPUT_DROP_SLO, OVERLOAD_ADMITTED_P99_SLO_MS. Numbers are
+# only comparable A/B on the same box (see PERF.md).
+set -eu
+
+cd "$(dirname "$0")/.."
+
+out=""
+for arg in "$@"; do
+  case "$arg" in
+    --out|--out=*) out="explicit" ;;
+  esac
+done
+if [ -z "$out" ]; then
+  n=1
+  while [ -e "$(printf 'OVERLOAD_r%02d.json' "$n")" ]; do n=$((n + 1)); done
+  set -- --out "$(printf 'OVERLOAD_r%02d.json' "$n")" "$@"
+fi
+
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m nomad_tpu.loadgen --overload "$@"

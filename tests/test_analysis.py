@@ -418,6 +418,109 @@ class TestRaftHygiene:
         )
 
 
+class TestRetryBudget:
+    """retry-without-budget: the sleep-and-retry ladder shape must
+    consult the process retry budget (or a deadline) or it amplifies
+    load past saturation (core/overload.py RetryBudget)."""
+
+    def test_sleep_retry_loop_flagged(self):
+        src = (
+            "import time\n"
+            "def call(self):\n"
+            "    for attempt in range(5):\n"
+            "        try:\n"
+            "            return self._rpc()\n"
+            "        except Exception:\n"
+            "            time.sleep(0.1 * attempt)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/rpc/x.py": src}, "retry-without-budget"
+        )
+        assert len(found) == 1
+        assert "retry_budget" in found[0].message
+
+    def test_budget_consult_clean(self):
+        src = (
+            "import time\n"
+            "from ..core.overload import retry_budget\n"
+            "def call(self):\n"
+            "    for attempt in range(5):\n"
+            "        try:\n"
+            "            return self._rpc()\n"
+            "        except Exception:\n"
+            "            if not retry_budget().try_acquire():\n"
+            "                raise\n"
+            "            time.sleep(0.1 * attempt)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/rpc/x.py": src}, "retry-without-budget"
+        )
+
+    def test_deadline_consult_clean(self):
+        src = (
+            "import time\n"
+            "def call(self, deadline_ns):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return self._rpc()\n"
+            "        except Exception:\n"
+            "            if deadline_expired(deadline_ns):\n"
+            "                raise\n"
+            "            time.sleep(0.1)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/rpc/x.py": src}, "retry-without-budget"
+        )
+
+    def test_periodic_ticker_not_flagged(self):
+        # Event.wait pacing is a cadence, not a per-request ladder
+        src = (
+            "def run(self):\n"
+            "    while not self._stop.wait(1.0):\n"
+            "        try:\n"
+            "            self._tick()\n"
+            "        except Exception:\n"
+            "            pass\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/x.py": src}, "retry-without-budget"
+        )
+
+    def test_innermost_loop_only(self):
+        # the outer while merely CONTAINS the ladder; one finding, at
+        # the inner for-loop
+        src = (
+            "import time\n"
+            "def pump(self):\n"
+            "    while self._running:\n"
+            "        for attempt in range(3):\n"
+            "            try:\n"
+            "                self._send()\n"
+            "                break\n"
+            "            except Exception:\n"
+            "                time.sleep(0.5)\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/rpc/x.py": src}, "retry-without-budget"
+        )
+        assert len(found) == 1
+        assert found[0].line == 4
+
+    def test_overload_module_exempt(self):
+        src = (
+            "import time\n"
+            "def refill(self):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            self._refill()\n"
+            "        except Exception:\n"
+            "            time.sleep(0.1)\n"
+        )
+        assert not findings_for(
+            {"nomad_tpu/core/overload.py": src}, "retry-without-budget"
+        )
+
+
 # ----------------------------------------------------------------------
 # import-graph checkers
 # ----------------------------------------------------------------------

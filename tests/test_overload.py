@@ -1,0 +1,572 @@
+"""Overload control plane pins (core/overload.py; ISSUE round 18).
+
+Four layers, one contract each:
+
+- deadline primitives + propagation: the HTTP/RPC edge mints a
+  wall-clock deadline, the scope carries it thread-locally, the eval
+  carries it through the pipeline, and every stage refuses expired work
+  LOUDLY (terminal ``deadline_exceeded (stage)``, never a silent drop).
+- admission control: priority-aware shedding (system > service > batch)
+  at the edge, with heartbeats exempt so an overload burst cannot
+  cascade into mass node-down.
+- retry budget: one process-wide token bucket bounds total retry volume
+  across every client ladder — under a severed cluster, attempts stay
+  within first-tries + budget, not the product of per-ladder limits.
+- brownout: a deterministic degradation ladder over process-wide knobs,
+  fully restored on recovery; with no ``overload{}`` stanza NOTHING is
+  constructed and no knob is ever touched (the A/B contract).
+"""
+
+import time
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu import metrics
+from nomad_tpu.core.overload import (
+    AdmissionController,
+    BrownoutController,
+    DeadlineExceeded,
+    ErrOverloaded,
+    OverloadController,
+    RetryBudget,
+    classify_priority,
+    configure_retry_budget,
+    current_deadline,
+    deadline_expired,
+    deadline_remaining_s,
+    deadline_scope,
+    mint_deadline,
+    reset_retry_budget,
+    retry_budget,
+)
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.model import now_ns
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+_SERVER_SEQ = [0]
+
+
+def make_server(num_workers=1, extra=None):
+    _SERVER_SEQ[0] += 1
+    tag = f"ovl{_SERVER_SEQ[0]}"
+    cfg = {
+        "seed": 42,
+        "heartbeat_ttl": 600.0,
+        "raft": {
+            "node_id": "s0",
+            "address": tag,
+            "voters": {"s0": tag},
+            "transport": InmemTransport(),
+            "config": RaftConfig(
+                heartbeat_interval=0.02,
+                election_timeout_min=0.05,
+                election_timeout_max=0.10,
+            ),
+        },
+    }
+    cfg.update(extra or {})
+    s = Server(cfg)
+    s.start(num_workers=num_workers, wait_for_leader=5.0)
+    return s
+
+
+OVERLOAD_STANZA = {
+    "depth_limit": 64,
+    "queue_wait_budget_ms": 500,
+    "default_deadline_s": 0.0,
+    "load_cache_s": 0.0,
+}
+
+
+# ---------------------------------------------------------------------------
+# deadline primitives
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlinePrimitives:
+    def test_mint_expired_remaining(self):
+        dl = mint_deadline(60.0)
+        assert not deadline_expired(dl)
+        rem = deadline_remaining_s(dl)
+        assert 59.0 < rem <= 60.0
+        assert deadline_expired(mint_deadline(-1.0))
+        # 0 is the no-deadline sentinel, never expired
+        assert not deadline_expired(0)
+        assert deadline_remaining_s(0) is None
+
+    def test_scope_is_thread_local_and_reentrant(self):
+        assert current_deadline() == 0
+        outer = mint_deadline(60.0)
+        inner = mint_deadline(5.0)
+        with deadline_scope(outer):
+            assert current_deadline() == outer
+            # an inner scope with no deadline inherits the outer one
+            with deadline_scope(0):
+                assert current_deadline() == outer
+            # a real inner deadline overrides, then restores
+            with deadline_scope(inner):
+                assert current_deadline() == inner
+            assert current_deadline() == outer
+        assert current_deadline() == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_classify_priority_bands(self):
+        assert classify_priority(95) == "system"
+        assert classify_priority(90) == "system"
+        assert classify_priority(89) == "service"
+        assert classify_priority(50) == "service"
+        assert classify_priority(49) == "batch"
+        assert classify_priority(0) == "batch"
+
+    def _ctrl(self, load_box):
+        return AdmissionController(
+            lambda: load_box[0],
+            shed_batch=0.8,
+            shed_service=0.95,
+            retry_after_s=2.5,
+            cache_s=0.0,
+        )
+
+    def test_priority_aware_shedding_order(self):
+        load = [0.5]
+        ac = self._ctrl(load)
+        for cls in ("batch", "service", "system"):
+            ac.admit(cls)  # calm: everyone gets in
+        assert ac.admitted == 3 and ac.shed_total() == 0
+
+        load[0] = 0.85  # past the batch knee only
+        with pytest.raises(ErrOverloaded) as ei:
+            ac.admit("batch")
+        assert ei.value.retry_after == 2.5
+        assert "shedding batch work" in str(ei.value)
+        ac.admit("service")
+        ac.admit("system")
+
+        load[0] = 0.97  # past the service knee; system still never shed
+        with pytest.raises(ErrOverloaded):
+            ac.admit("batch")
+        with pytest.raises(ErrOverloaded):
+            ac.admit("service")
+        ac.admit("system")
+
+        assert ac.shed == {"batch": 2, "service": 1, "system": 0}
+        assert ac.shed_total() == 3
+        assert ac.admitted == 6
+
+    def test_broken_load_signal_fails_open(self):
+        def boom():
+            raise RuntimeError("signal down")
+
+        ac = AdmissionController(boom, cache_s=0.0)
+        # a dead signal must read as calm — shedding on a broken sensor
+        # would turn a metrics bug into an outage
+        assert ac.load() == 0.0
+        ac.admit("batch")
+        assert ac.shed_total() == 0
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_budget():
+    yield
+    reset_retry_budget()
+
+
+class TestRetryBudget:
+    def test_tokens_spend_and_exhaust(self):
+        b = RetryBudget(capacity=3, refill_per_s=0.0)
+        assert all(b.try_acquire() for _ in range(3))
+        assert not b.try_acquire()
+        assert not b.try_acquire()
+        assert b.spent == 3
+        assert b.exhausted == 2
+        assert b.remaining() == 0.0
+
+    def test_refill_restores_tokens(self):
+        b = RetryBudget(capacity=2, refill_per_s=1000.0)
+        assert b.try_acquire(2)
+        assert not b.try_acquire()
+        time.sleep(0.01)
+        assert b.try_acquire()
+
+    def test_process_singleton_configure_and_reset(self, fresh_budget):
+        configure_retry_budget(5, 0.0)
+        b = retry_budget()
+        assert b.capacity == 5
+        assert retry_budget() is b
+        reset_retry_budget()
+        assert retry_budget().capacity == 256  # lazy default is back
+
+    def test_severed_cluster_attempts_bounded_by_budget(self, fresh_budget):
+        """The retry-amplification pin: ladders chasing a severed
+        cluster make first-tries + budget total attempts, NOT the
+        product of their per-ladder retry limits."""
+        from nomad_tpu.rpc import RpcError
+        from nomad_tpu.rpc.client import ServerProxy
+
+        configure_retry_budget(4, 0.0)
+        attempts = [0]
+
+        class DeadPool:
+            def call(self, addr, method, payload, timeout=None):
+                attempts[0] += 1
+                raise RpcError("connect", f"{addr}: connection refused")
+
+        calls = 0
+        for _ in range(3):
+            proxy = ServerProxy(
+                ["10.0.0.1:4647", "10.0.0.2:4647"],
+                pool=DeadPool(),
+                max_retries=10,
+            )
+            with pytest.raises(RpcError):
+                proxy._call("Job.Register", {})
+            calls += 1
+        # without the budget: 3 calls x 10 retries = 30 attempts. With
+        # it: one free first try per call + at most 4 budgeted retries.
+        assert attempts[0] <= calls + 4, attempts[0]
+        assert retry_budget().exhausted >= 1
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+
+def _flag_ladder(n=3):
+    state = {i: "on" for i in range(n)}
+    actions = []
+    for i in range(n):
+        actions.append(
+            (
+                f"knob{i}",
+                (lambda i=i: state.__setitem__(i, "off")),
+                (lambda i=i: state.__setitem__(i, "on")),
+            )
+        )
+    return state, actions
+
+
+class TestBrownout:
+    def test_ladder_is_a_pure_function_of_the_sample_sequence(self):
+        state, actions = _flag_ladder(3)
+        bo = BrownoutController(
+            actions, enter=0.9, exit=0.6, enter_streak=2, exit_streak=2
+        )
+        # one hot sample is not a streak
+        assert bo.on_sample(1.0) == 0
+        assert bo.on_sample(1.0) == 1
+        assert state == {0: "off", 1: "on", 2: "on"}
+        # a mid-band sample breaks BOTH streaks: no flapping ratchet
+        assert bo.on_sample(1.0) == 1
+        assert bo.on_sample(0.75) == 1
+        assert bo.on_sample(1.0) == 1
+        assert bo.on_sample(1.0) == 2
+        assert bo.on_sample(1.0) == 2
+        assert bo.on_sample(1.0) == 3
+        # at max_level, further heat holds
+        assert bo.on_sample(1.0) == 3
+        assert state == {0: "off", 1: "off", 2: "off"}
+        assert bo.peak_level == 3
+
+        # cool-down walks back one level per exit streak, in reverse
+        assert bo.on_sample(0.1) == 3
+        assert bo.on_sample(0.1) == 2
+        assert state[2] == "on" and state[0] == "off"
+        for _ in range(4):
+            bo.on_sample(0.1)
+        assert bo.level == 0
+        assert state == {0: "on", 1: "on", 2: "on"}
+        assert bo.peak_level == 3  # the high-water mark survives recovery
+
+    def test_restore_all_unwinds_everything(self):
+        state, actions = _flag_ladder(2)
+        bo = BrownoutController(
+            actions, enter=0.5, exit=0.1, enter_streak=1, exit_streak=1
+        )
+        bo.on_sample(1.0)
+        bo.on_sample(1.0)
+        assert bo.level == 2
+        bo.restore_all()
+        assert bo.level == 0
+        assert state == {0: "on", 1: "on"}
+
+    def test_a_failing_action_does_not_wedge_the_ladder(self):
+        hits = []
+
+        def boom():
+            raise RuntimeError("knob stuck")
+
+        actions = [
+            ("bad", boom, boom),
+            ("good", lambda: hits.append("degrade"),
+             lambda: hits.append("restore")),
+        ]
+        bo = BrownoutController(
+            actions, enter=0.5, exit=0.1, enter_streak=1, exit_streak=1
+        )
+        bo.on_sample(1.0)
+        bo.on_sample(1.0)
+        assert bo.level == 2
+        assert hits == ["degrade"]
+        bo.restore_all()
+        assert hits == ["degrade", "restore"]
+
+
+# ---------------------------------------------------------------------------
+# the per-server umbrella
+# ---------------------------------------------------------------------------
+
+
+class TestOverloadController:
+    def test_deadline_exceeded_ledger(self):
+        ov = OverloadController({}, load_fn=lambda: 0.0)
+        ov.note_deadline_exceeded("broker")
+        ov.note_deadline_exceeded("broker")
+        ov.note_deadline_exceeded("worker")
+        assert ov.deadline_exceeded == {"broker": 2, "worker": 1}
+        assert ov.deadline_exceeded_total() == 3
+        assert ov.stats()["deadline_exceeded"]["broker"] == 2
+
+    def test_admit_request_classifies_default_priority_as_service(self):
+        ov = OverloadController(
+            {"shed_batch": 0.0, "shed_service": 2.0, "load_cache_s": 0.0},
+            load_fn=lambda: 1.0,
+        )
+        # load 1.0 >= shed_batch 0.0: batch refused, service admitted
+        with pytest.raises(ErrOverloaded):
+            ov.admit_request(priority=10)
+        ov.admit_request(priority=None)  # job default (50) => service
+        ov.admit_request(priority=95)
+
+
+# ---------------------------------------------------------------------------
+# the RPC edge: refuse-before-work + heartbeat exemption
+# ---------------------------------------------------------------------------
+
+
+class TestRpcEdge:
+    def _rpc(self):
+        from nomad_tpu.rpc.server import RpcServer
+
+        rs = RpcServer(port=0)
+        try:
+            rs._sock.close()  # dispatch-only tests never accept()
+        except OSError:
+            pass
+        rs.register("Job.Register", lambda payload: {"ok": True})
+        rs.register("Node.UpdateStatus", lambda payload: {"ok": True})
+        rs.register("Node.Register", lambda payload: {"ok": True})
+        return rs
+
+    def test_expired_deadline_refused_before_dispatch(self):
+        rs = self._rpc()
+        with pytest.raises(DeadlineExceeded) as ei:
+            rs._dispatch("Job.Register", {"_deadline": now_ns() - 1})
+        assert ei.value.where == "rpc"
+        # a live deadline dispatches, activated as the handler's scope
+        seen = []
+        rs.register(
+            "Job.Register", lambda payload: seen.append(current_deadline())
+        )
+        dl = mint_deadline(30.0)
+        rs._dispatch("Job.Register", {"_deadline": dl})
+        assert seen == [dl]
+
+    def test_heartbeats_exempt_from_admission(self):
+        rs = self._rpc()
+
+        def always_shed(method, payload):
+            raise ErrOverloaded("storm", retry_after=1.0)
+
+        rs.admission_check = always_shed
+        # the starvation fix: a shedding edge still accepts node
+        # liveness traffic — otherwise a load spike becomes a false
+        # mass-node-down event
+        assert rs.ADMISSION_EXEMPT >= {"Node.UpdateStatus", "Node.Register"}
+        assert rs._dispatch("Node.UpdateStatus", {}) == {"ok": True}
+        assert rs._dispatch("Node.Register", {}) == {"ok": True}
+        with pytest.raises(ErrOverloaded):
+            rs._dispatch("Job.Register", {})
+
+
+# ---------------------------------------------------------------------------
+# the HTTP edge: deadline minting precedence
+# ---------------------------------------------------------------------------
+
+
+class TestHttpMint:
+    def _api(self, overload_cfg=None):
+        from types import SimpleNamespace
+
+        from nomad_tpu.api.http import HTTPServer
+
+        ov = None
+        if overload_cfg is not None:
+            ov = OverloadController(overload_cfg, load_fn=lambda: 0.0)
+        return HTTPServer(SimpleNamespace(overload=ov), port=0)
+
+    def test_header_wins_even_without_stanza(self):
+        api = self._api(None)
+        dl = api._mint_request_deadline({"X-Nomad-Deadline": "5"}, {})
+        assert 0 < dl <= now_ns() + int(5.1e9)
+
+    def test_no_stanza_mints_nothing_from_wait(self):
+        # the A/B contract: without overload{}, ?wait= stays a pure
+        # blocking-query timeout and no default applies
+        api = self._api(None)
+        assert api._mint_request_deadline({}, {"wait": "10s"}) == 0
+        assert api._mint_request_deadline({}, {}) == 0
+
+    def test_stanza_precedence_wait_then_default(self):
+        api = self._api({"default_deadline_s": 30.0})
+        dl = api._mint_request_deadline({}, {"wait": "2s"})
+        assert 0 < dl <= now_ns() + int(2.1e9)
+        dl = api._mint_request_deadline({}, {})
+        assert now_ns() + int(29e9) < dl <= now_ns() + int(30.1e9)
+        # the explicit header still beats both
+        dl = api._mint_request_deadline(
+            {"X-Nomad-Deadline": "1"}, {"wait": "10s"}
+        )
+        assert dl <= now_ns() + int(1.1e9)
+
+    def test_request_priority_reads_wire_casing(self):
+        # the wire format is snake_case (Job.to_dict) — a system job's
+        # priority must classify as system, not default to service
+        from nomad_tpu.api.http import _request_priority
+
+        assert _request_priority({"Job": {"priority": 95}}) == 95
+        assert _request_priority({"Job": {"Priority": 40}}) == 40
+        assert _request_priority({"Job": {}}) is None
+        assert _request_priority({"Job": mock.job().to_dict()}) == 50
+        assert _request_priority(None) is None
+
+
+# ---------------------------------------------------------------------------
+# full pipeline: expired work refused terminally, A/B off == untouched
+# ---------------------------------------------------------------------------
+
+
+class TestServerPipeline:
+    def test_expired_eval_refused_before_scheduler_or_device(self):
+        """The acceptance pin: an eval submitted past its deadline is
+        failed terminal ``deadline_exceeded (broker)`` — it never
+        reaches the scheduler (no allocs, no plan) and never pays a
+        device dispatch."""
+        s = make_server(num_workers=1, extra={"overload": dict(OVERLOAD_STANZA)})
+        try:
+            before = metrics.snapshot()["counters"]
+            job = mock.job()
+            with deadline_scope(now_ns() - 1_000_000_000):
+                eval_id = s.job_register(job)
+            assert s.state.eval_by_id(eval_id).deadline > 0
+
+            wait_until(
+                lambda: s.state.eval_by_id(eval_id).status == "failed",
+                msg="expired eval failed terminal",
+            )
+            ev = s.state.eval_by_id(eval_id)
+            assert ev.status_description == "deadline_exceeded (broker)"
+            # never reached the scheduler: no allocations were created
+            assert s.state.allocs_by_job(job.namespace, job.id) == []
+            after = metrics.snapshot()["counters"]
+            assert after.get(
+                "overload.deadline_exceeded.broker", 0
+            ) > before.get("overload.deadline_exceeded.broker", 0)
+            assert s.overload.deadline_exceeded.get("broker", 0) >= 1
+        finally:
+            s.stop()
+            reset_retry_budget()
+
+    def test_default_deadline_stamped_on_direct_submissions(self):
+        stanza = dict(OVERLOAD_STANZA, default_deadline_s=60.0)
+        s = make_server(num_workers=0, extra={"overload": stanza})
+        try:
+            t0 = now_ns()
+            eval_id = s.job_register(mock.job())
+            dl = s.state.eval_by_id(eval_id).deadline
+            assert t0 < dl <= t0 + int(61e9)
+        finally:
+            s.stop()
+            reset_retry_budget()
+
+    def test_no_stanza_is_byte_identical_off(self):
+        """The A/B contract: without overload{} the controller is never
+        constructed, evals carry no deadline, and no process-wide knob
+        is so much as read-modified."""
+        from nomad_tpu.debug import devprof
+        from nomad_tpu.tpu import wavefront
+        from nomad_tpu.trace import tracer
+
+        knobs_before = (
+            wavefront.enabled(), tracer.sample_rate, devprof.enabled()
+        )
+        s = make_server(num_workers=0)
+        try:
+            assert s.overload is None
+            eval_id = s.job_register(mock.job())
+            assert s.state.eval_by_id(eval_id).deadline == 0
+        finally:
+            s.stop()
+        knobs_after = (
+            wavefront.enabled(), tracer.sample_rate, devprof.enabled()
+        )
+        assert knobs_after == knobs_before
+
+    def test_brownout_degrades_real_knobs_and_stop_restores(self):
+        """The server's ladder really flips the process-wide knobs —
+        wavefront dispatch, trace sampling, devprof census,
+        snapshot-on-subscribe — and ``stop()`` puts every one back."""
+        from nomad_tpu.debug import devprof
+        from nomad_tpu.tpu import wavefront
+        from nomad_tpu.trace import tracer
+
+        stanza = dict(
+            OVERLOAD_STANZA,
+            brownout={"enter": 0.9, "exit": 0.6,
+                      "enter_streak": 1, "exit_streak": 1},
+        )
+        baseline = (
+            wavefront.enabled(), tracer.sample_rate, devprof.enabled()
+        )
+        s = make_server(num_workers=0, extra={"overload": stanza})
+        try:
+            bo = s.overload.brownout
+            assert bo.max_level == 4
+            for _ in range(bo.max_level):
+                s.overload.on_sample(1.0)
+            assert bo.level == 4
+            assert wavefront.enabled() is False
+            assert tracer.sample_rate == 0.0
+            assert devprof.enabled() is False
+            if s.event_broker is not None:
+                assert s.event_broker.snapshot_on_subscribe is False
+        finally:
+            s.stop()
+            reset_retry_budget()
+        assert (
+            wavefront.enabled(), tracer.sample_rate, devprof.enabled()
+        ) == baseline
+        assert s.overload.brownout.level == 0
+        assert s.overload.brownout.peak_level == 4

@@ -363,3 +363,189 @@ class TestDelayedEvalsPort:
         assert b.dequeue(SERVICE, 1.0)[0].id == e2.id
         assert b.dequeue(SERVICE, 2.0)[0].id == e1.id
         assert b.stats()["total_waiting"] == 0
+
+
+class TestSerializePendingPort:
+    def test_duplicate_job_serializes_behind_in_flight(self):
+        # ref TestEvalBroker_Serialize_DuplicateJobID
+        # (eval_broker_test.go:386): only ONE eval per (ns, job) is ever
+        # ready/outstanding; the rest pend in the per-job blocked heap
+        # and release one at a time on ack, priority-then-FIFO.
+        b = make_broker()
+        b.set_enabled(True)
+        e1, e2, e3 = (mock.evaluation() for _ in range(3))
+        e2.job_id = e1.job_id
+        e3.job_id = e1.job_id
+        e2.priority, e3.priority = 30, 10
+        for e in (e1, e2, e3):
+            b.enqueue(e)
+        stats = b.stats()
+        assert stats["total_ready"] == 1
+        assert stats["total_blocked"] == 2
+
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == e1.id
+        # the pending heap does NOT release while e1 is outstanding
+        assert b.stats()["total_ready"] == 0
+        b.ack(e1.id, token)
+
+        # release is priority-ordered: e2 (30) before e3 (10)
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == e2.id
+        assert b.stats()["total_blocked"] == 1
+        b.ack(e2.id, token)
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == e3.id
+        b.ack(e3.id, token)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_blocked"] == 0
+
+    def test_namespaces_do_not_serialize_against_each_other(self):
+        # ref TestEvalBroker_Serialize_Namespaced_DuplicateJobID
+        # (eval_broker_test.go:503): same job id, different namespace —
+        # independent slots, both immediately ready.
+        b = make_broker()
+        b.set_enabled(True)
+        e1, e2 = mock.evaluation(), mock.evaluation()
+        e2.job_id = e1.job_id
+        e2.namespace = "other"
+        b.enqueue(e1)
+        b.enqueue(e2)
+        stats = b.stats()
+        assert stats["total_ready"] == 2
+        assert stats["total_blocked"] == 0
+
+
+class TestRequeuePort:
+    def test_requeue_released_on_ack(self):
+        # ref TestEvalBroker_Requeue_Ack (eval_broker_test.go:1544): the
+        # scheduler reblocks ITS OWN eval by re-enqueueing it with its
+        # dequeue token; the copy parks in the requeue slot and becomes
+        # ready only when the outstanding one is acked.
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        out, token = b.dequeue(SERVICE, 1.0)
+
+        b.enqueue_all([(ev.copy(), token)])
+        # still parked: nothing ready while the original is outstanding
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 1
+
+        b.ack(out.id, token)
+        wait_until(
+            lambda: b.stats()["total_ready"] == 1, msg="requeue released"
+        )
+        out2, token2 = b.dequeue(SERVICE, 1.0)
+        assert out2.id == ev.id
+        assert token2 != token
+        b.ack(out2.id, token2)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+
+    def test_requeue_dropped_on_nack(self):
+        # ref TestEvalBroker_Requeue_Nack (eval_broker_test.go:1588): a
+        # nack drops the requeue slot — only the nack-delay re-enqueue
+        # of the original survives (no double delivery).
+        b = make_broker()
+        b.set_enabled(True)
+        ev = mock.evaluation()
+        b.enqueue(ev)
+        out, token = b.dequeue(SERVICE, 1.0)
+
+        b.enqueue_all([(ev.copy(), token)])
+        b.nack(out.id, token)
+
+        wait_until(
+            lambda: b.stats()["total_ready"] == 1, msg="nack requeued"
+        )
+        out2, token2 = b.dequeue(SERVICE, 1.0)
+        assert out2.id == ev.id
+        b.ack(out2.id, token2)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+
+
+class TestRefuseExpiredPort:
+    """Broker-side guard rail for the overload plane's refuse-expired
+    dequeue semantics (core/broker.py _scan): work whose deadline passed
+    is resolved terminally at the pop — reported via
+    on_deadline_exceeded, never delivered, never silently dropped."""
+
+    def test_expired_eval_refused_and_reported(self):
+        b = make_broker()
+        b.set_enabled(True)
+        seen = []
+        b.on_deadline_exceeded = lambda ev: seen.append(ev.id)
+        ev = mock.evaluation()
+        ev.deadline = now_ns() - 1_000_000_000  # expired a second ago
+        b.enqueue(ev)
+        assert b.stats()["total_ready"] == 1
+
+        out, _ = b.dequeue(SERVICE, timeout=0.05)
+        assert out is None
+        assert seen == [ev.id]
+        # terminal cleanup: no ready/unacked/blocked residue, and the
+        # dedup registry forgot the id (a re-submit would be accepted)
+        stats = b.stats()
+        assert stats["total_ready"] == 0
+        assert stats["total_unacked"] == 0
+        assert stats["total_blocked"] == 0
+        assert not b.outstanding(ev.id)[1]
+
+    def test_expired_skipped_live_delivered_same_scan(self):
+        # an expired high-priority eval ahead of a live one must not
+        # stall the queue: the scan refuses it and keeps going
+        b = make_broker()
+        b.set_enabled(True)
+        seen = []
+        b.on_deadline_exceeded = lambda ev: seen.append(ev.id)
+        dead = mock.evaluation()
+        dead.priority = 90
+        dead.deadline = now_ns() - 1
+        live = mock.evaluation()
+        live.priority = 50
+        b.enqueue(dead)
+        b.enqueue(live)
+
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == live.id
+        assert seen == [dead.id]
+        b.ack(live.id, token)
+
+    def test_expired_in_flight_promotes_blocked_successor(self):
+        # refusing the per-job in-flight eval must free the (ns, job)
+        # slot so the pending successor releases — same contract as ack
+        b = make_broker()
+        b.set_enabled(True)
+        seen = []
+        b.on_deadline_exceeded = lambda ev: seen.append(ev.id)
+        dead = mock.evaluation()
+        dead.deadline = now_ns() - 1
+        succ = mock.evaluation()
+        succ.job_id = dead.job_id
+        b.enqueue(dead)
+        b.enqueue(succ)
+        assert b.stats()["total_blocked"] == 1
+
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == succ.id
+        assert seen == [dead.id]
+        assert b.stats()["total_blocked"] == 0
+        b.ack(succ.id, token)
+
+    def test_future_deadline_is_delivered(self):
+        b = make_broker()
+        b.set_enabled(True)
+        b.on_deadline_exceeded = lambda ev: pytest.fail("live eval refused")
+        ev = mock.evaluation()
+        ev.deadline = now_ns() + 60_000_000_000
+        b.enqueue(ev)
+        out, token = b.dequeue(SERVICE, 1.0)
+        assert out.id == ev.id
+        b.ack(ev.id, token)

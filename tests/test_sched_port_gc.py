@@ -48,9 +48,14 @@ def make_server():
         },
     }
     s = Server(cfg)
-    s.start(num_workers=0, wait_for_leader=5.0)
-    # indexes at or below 5000 are "old enough" for every GC threshold
+    # indexes at or below 5000 are "old enough" for every GC threshold.
+    # Plant the backdated witness BEFORE start(): the leader's GC cron
+    # witnesses latest_index at "now" as soon as it spins up, and a
+    # same-wall-clock entry landing first makes the table silently drop
+    # this backdate (TimeTable.witness granularity check) — planted
+    # first, the cron's boot witness is dropped instead (index <= 5000).
     s.time_table.witness(5000, when=time.time() - 10 * 24 * 3600)
+    s.start(num_workers=0, wait_for_leader=5.0)
     return s
 
 
