@@ -22,6 +22,11 @@ Rules:
 - ``shape-literal-unbucketed`` — an integer literal ≥ 1024 used directly
   as a dimension in an array constructor or ``.lower()`` call in
   ``tpu/`` without rounding through ``_bucket``/``bucket_shape``;
+- ``tile-shape-unbucketed`` — inside tile/paged code in ``tpu/``, an
+  integer literal ≥ 64 used as an array/``.lower()`` dimension without
+  rounding through ``tile_rows`` (the paged planner's tile-bucket
+  policy): a literal bypasses the power-of-two + mesh-multiple
+  rounding, so the compiled tile program misses the production bucket;
 - ``jit-shape-unbucketed`` — a locally-computed size (from ``len()``,
   arithmetic, or a literal) passed to a known jit entry point without
   rounding through ``_bucket`` (deliberate static args get a suppression
@@ -40,7 +45,8 @@ from typing import Iterable, Optional
 from .framework import Finding, ModuleInfo, Project, dotted, register
 
 #: names that mark an expression as rounded through the padding policy
-_BUCKET_FNS = {"_bucket", "bucket_shape", "_row_bucket"}
+#: (tile_rows is the paged planner's tile-bucket policy, tpu/paging.py)
+_BUCKET_FNS = {"_bucket", "bucket_shape", "_row_bucket", "tile_rows"}
 
 _ARRAY_CTORS = {"zeros", "ones", "full", "empty", "tile", "arange"}
 
@@ -294,6 +300,54 @@ def check_shape_literals(project: Project) -> list[Finding]:
                             "padding will compile a different shape",
                         )
                     )
+    return findings
+
+
+#: tile dims below this are lane/column constants, not tile shapes
+TILE_LITERAL_MIN = 64
+
+
+@register(
+    "tile-shape-unbucketed",
+    "integer literal used as a tile dimension in tile/paged code "
+    "without rounding through tile_rows (the paged planner's "
+    "tile-bucket policy)",
+)
+def check_tile_shapes(project: Project) -> list[Finding]:
+    findings = []
+    for mod in project.iter_modules("nomad_tpu/tpu/"):
+        parents = _parent_map(mod.tree)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "tile" not in fn.name and "paged" not in fn.name:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = dotted(node.func).rsplit(".", 1)[-1]
+                if tail not in _ARRAY_CTORS and tail != "lower":
+                    continue
+                for arg in node.args:
+                    for lit in ast.walk(arg):
+                        if not (
+                            isinstance(lit, ast.Constant)
+                            and isinstance(lit.value, int)
+                            and lit.value >= TILE_LITERAL_MIN
+                        ):
+                            continue
+                        if _under_bucket(lit, parents):
+                            continue
+                        findings.append(
+                            Finding(
+                                "tile-shape-unbucketed", mod.relpath,
+                                lit.lineno,
+                                f"literal tile dim {lit.value} in "
+                                f"{tail}() does not round through "
+                                "tile_rows; the compiled tile program "
+                                "misses the production tile bucket",
+                            )
+                        )
     return findings
 
 

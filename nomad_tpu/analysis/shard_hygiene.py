@@ -45,6 +45,7 @@ _MESH_CALLS = {"active_mesh", "configure"}
 #: (e.g. the specs are fetched for a put() further down the call chain)
 _SPEC_CALLS = {
     "batch_specs", "run_specs", "window_specs", "wavefront_specs",
+    "paged_specs",
 }
 
 

@@ -859,13 +859,38 @@ class HTTPServer:
 
     # ------------------------------------------------------------------
     def _blocking(self, query, run):
-        """Shared blocking-query plumbing (?index=N&wait=D)."""
+        """Shared blocking-query plumbing (?index=N&wait=D).
+
+        Deadline-aware park: a minted request deadline shorter than
+        ``?wait=`` clamps the park so the query un-parks AT the deadline
+        with a terminal ``deadline_exceeded (blocking_query)`` instead of
+        holding the connection past it. Without an active deadline the
+        park is exactly the pre-overload ``?wait=`` behavior."""
         min_index = int(query.get("index", 0))
         if min_index:
             wait = parse_duration(query.get("wait", "5m")) / 1e9
+            dl = current_deadline()
+            clamped = False
+            if dl:
+                rem = deadline_remaining_s(dl)
+                if rem is not None and rem < wait:
+                    wait = max(rem, 0.0)
+                    clamped = True
             result, index = self.server.state.blocking_query(
                 run, min_index=min_index, timeout=wait
             )
+            if clamped and index <= min_index:
+                # the park was cut short by the deadline, not woken by
+                # data: loud terminal outcome, attributed to this stage
+                metrics.incr("overload.deadline_exceeded.blocking_query")
+                ov = getattr(self.server, "overload", None)
+                if ov is not None:
+                    ov.note_deadline_exceeded("blocking_query")
+                raise DeadlineExceeded(
+                    "deadline expired while blocked on index "
+                    f"{min_index}",
+                    where="blocking_query",
+                )
             return result, index
         snap = self.server.state.snapshot()
         return run(snap), snap.latest_index()

@@ -57,6 +57,19 @@ DEFAULT_AGENT_CONFIG: dict[str, Any] = {
     #                                     # to the conflict binning (1 =
     #                                     # winner-only, already exact)
     "wavefront": {},
+    # paged node axis (tpu/paging.py; OBSERVABILITY.md): stream the
+    # planner's dense node planes through device memory in tiles when
+    # the cluster exceeds the resident budget
+    # paging { enabled = true             # route over-budget windowed
+    #                                     # dispatch through the pager
+    #          device_node_budget_mb = 256  # device-resident node-plane
+    #                                       # byte budget (floored at
+    #                                       # two tiles for the double
+    #                                       # buffer)
+    #          tile_nodes = 65536 }      # node rows per tile (rounded
+    #                                    # to a power of two + mesh
+    #                                    # multiple by tile_rows())
+    "paging": {},
     # overload control plane (core/overload.py; OBSERVABILITY.md):
     # overload { enabled = true        # stanza present+enabled wires the
     #                                  # plane; absent = byte-identical
@@ -162,6 +175,8 @@ def server_config_from_agent(config: dict) -> dict:
         out["plan_pipeline"] = dict(config["plan_pipeline"])
     if config.get("wavefront"):
         out["wavefront"] = dict(config["wavefront"])
+    if config.get("paging"):
+        out["paging"] = dict(config["paging"])
     if config.get("overload"):
         out["overload"] = dict(config["overload"])
     for key in (

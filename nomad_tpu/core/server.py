@@ -963,6 +963,20 @@ class Server:
                 max_round=wf.get("max_round"),
                 contention_top_m=wf.get("contention_top_m"),
             )
+        if self.config.get("paging"):
+            # paged node axis (tpu/paging.py): stream over-budget node
+            # planes through device memory in tiles. Applied before
+            # prewarm so the warmed ladder includes the tile shapes,
+            # and before first commit so the committed planes stamp
+            # dirtiness at the configured tile granularity.
+            from ..tpu import paging as _paging
+
+            pg = dict(self.config["paging"])
+            _paging.configure(
+                enabled=pg.get("enabled", True),
+                device_node_budget_mb=pg.get("device_node_budget_mb"),
+                tile_nodes=pg.get("tile_nodes"),
+            )
         if self.config.get("prewarm_kernels"):
             # compile the planner shape ladder in the background so the
             # first real eval doesn't eat the cold-compile latency

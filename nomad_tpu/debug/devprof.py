@@ -108,6 +108,14 @@ _TRANSFERS = {
     "h2d_bytes": 0, "h2d_calls": 0, "d2h_bytes": 0, "d2h_calls": 0,
 }
 
+#: paged-planner tile stream accounting (tpu/paging.py's TileCache):
+#: uploads = tiles sent h2d, reuploads = tiles sent AGAIN (dirty dynamic
+#: refresh or re-admission after eviction — the h2d_thrash signal)
+_PAGED = {
+    "tile_uploads": 0, "tile_upload_bytes": 0,
+    "tile_reuploads": 0, "tile_reupload_bytes": 0,
+}
+
 #: round counts whose device scalar hasn't been read yet: resolved
 #: lazily and NON-blockingly (is_ready-gated) so a /v1/metrics poll can
 #: never stall behind an in-flight kernel
@@ -147,6 +155,8 @@ def reset():
         _PENDING.clear()
         for k in _TRANSFERS:
             _TRANSFERS[k] = 0
+        for k in _PAGED:
+            _PAGED[k] = 0
         _COMPILES["count"] = 0
         _COMPILES["seconds"] = 0.0
 
@@ -385,6 +395,29 @@ def count_tree_h2d(tree):
     count_h2d(total, calls=calls)
 
 
+def count_tile_upload(nbytes: int, reupload: bool = False):
+    """One paged tile crossing host→device (tpu/paging.py's TileCache —
+    which already routes the bytes through :func:`count_h2d` /
+    ``shard.put``; this ledger adds the TILE-granular view the
+    ``h2d_thrash`` watchdog rule divides by committed placements).
+    ``reupload`` marks a tile sent again: a dirty dynamic-plane refresh
+    or a re-admission after budget eviction."""
+    if not _ENABLED or nbytes <= 0:
+        return
+    with _lock:
+        _PAGED["tile_uploads"] += 1
+        _PAGED["tile_upload_bytes"] += int(nbytes)
+        if reupload:
+            _PAGED["tile_reuploads"] += 1
+            _PAGED["tile_reupload_bytes"] += int(nbytes)
+
+
+def paged_totals() -> dict:
+    """The paged tile-stream counters (flight-sample / bench view)."""
+    with _lock:
+        return dict(_PAGED)
+
+
 def device_put(x, sharding=None):
     """THE counted ``jax.device_put``: every placement site in ``tpu/``
     routes here (directly or via ``shard.put``) so the h2d ledger stays
@@ -507,6 +540,7 @@ def totals() -> dict:
         placements = sum(e["placements"] for e in _ROUNDS.values())
         return {
             **_TRANSFERS,
+            **{f"paged_{k}": v for k, v in _PAGED.items()},
             "compiles": _COMPILES["count"],
             "compile_s": round(_COMPILES["seconds"], 4),
             "rounds": rounds,
@@ -566,6 +600,14 @@ def summary() -> dict:
                 round(s_rounds / s_placements, 4) if s_placements else None
             ),
             "census_collective_ops": collective_ops,
+            "paged_tile_uploads": _PAGED["tile_uploads"],
+            "paged_tile_reuploads": _PAGED["tile_reuploads"],
+            "paged_tile_upload_mb": round(
+                _PAGED["tile_upload_bytes"] / 1e6, 3
+            ),
+            "paged_tile_reupload_mb": round(
+                _PAGED["tile_reupload_bytes"] / 1e6, 3
+            ),
         }
 
 

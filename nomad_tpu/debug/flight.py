@@ -176,6 +176,16 @@ def sample_process(server) -> dict:
         sample["h2d_bytes"] = dp["h2d_bytes"]
         sample["d2h_bytes"] = dp["d2h_bytes"]
         sample["collective_rounds"] = dp["collective_rounds"]
+        # paged node axis (tpu/paging.py): tile-granular H2D traffic
+        # plus resolved placements — the h2d_thrash rule's numerator
+        # and denominator ride the same sample so their deltas line up
+        sample["placements_total"] = dp["placements"]
+        sample["paged_tile_uploads"] = dp["paged_tile_uploads"]
+        sample["paged_tile_reuploads"] = dp["paged_tile_reuploads"]
+        sample["paged_tile_upload_bytes"] = dp["paged_tile_upload_bytes"]
+        sample["paged_tile_reupload_bytes"] = dp[
+            "paged_tile_reupload_bytes"
+        ]
     except Exception:
         pass
     # federation signals: which region this process serves, cross-region

@@ -45,6 +45,12 @@ Rules (thresholds config-overridable via the ``debug.watchdog`` stanza):
   ladder's legitimate boot-time compiles never trip it): the
   51200-vs-50176 shape-drift class silently re-paying XLA compiles in
   steady state becomes a bundle whose device section names the shapes;
+- ``h2d_thrash`` — paged-planner tile RE-upload bytes per committed
+  placement sustained above ``bytes_per_placement`` across the window
+  (plus an absolute ``min_reupload_mb`` floor): the device node budget
+  is too tight for the working set and tiles are being evicted and
+  re-streamed wholesale instead of staying resident. Keys ride the
+  devprof transfer ledger, so servers that never page stay at 0;
 - ``overload`` — sustained admission shedding above ``shed_per_s``
   across the window, or any brownout level above ``brownout_level``:
   the bundle captures the admission/brownout/retry-budget state while
@@ -81,6 +87,12 @@ DEFAULT_RULES = {
     "subscriber_lag": {"threshold": 10_000, "consecutive": 5},
     "acl_replication_lag": {"threshold_s": 30.0, "consecutive": 3},
     "recompile_storm": {"growth": 4, "window": 60, "min_span_s": 10.0},
+    "h2d_thrash": {
+        "bytes_per_placement": 1_000_000.0,
+        "min_reupload_mb": 16.0,
+        "window": 60,
+        "min_span_s": 10.0,
+    },
     "plane_divergence": {"threshold": 1},
     "overload": {"shed_per_s": 50.0, "consecutive": 5, "brownout_level": 0},
 }
@@ -251,6 +263,44 @@ class Watchdog:
                 "cache_growth": growth,
                 "cache_size": sample.get("compile_cache_size"),
                 "threshold": p["growth"],
+                "span_s": round(tail[-1]["t"] - tail[0]["t"], 2),
+            }
+        return None
+
+    def _rule_h2d_thrash(self, sample, window, p):
+        # paged node axis (tpu/paging.py): a healthy pager re-uploads a
+        # tile's small dynamic planes when a commit dirtied it — thrash
+        # is when the device budget is so tight relative to the working
+        # set that tiles keep getting EVICTED and re-admitted wholesale,
+        # and the signature is re-upload bytes growing far faster than
+        # committed placements. The absolute-bytes floor keeps an idle
+        # server (zero placements, one dirty refresh) from tripping.
+        tail = window[-int(p["window"]):]
+        if (
+            len(tail) < 2
+            or tail[-1]["t"] - tail[0]["t"] < p["min_span_s"]
+            or "paged_tile_reupload_bytes" not in tail[-1]
+            or "paged_tile_reupload_bytes" not in tail[0]
+        ):
+            return None
+        re_bytes = (
+            tail[-1]["paged_tile_reupload_bytes"]
+            - tail[0]["paged_tile_reupload_bytes"]
+        )
+        if re_bytes < float(p["min_reupload_mb"]) * 1e6:
+            return None
+        placed = (
+            tail[-1].get("placements_total", 0)
+            - tail[0].get("placements_total", 0)
+        )
+        per = re_bytes / max(placed, 1)
+        if per > float(p["bytes_per_placement"]):
+            return {
+                "reupload_bytes": re_bytes,
+                "placements": placed,
+                "bytes_per_placement": round(per, 1),
+                "threshold": p["bytes_per_placement"],
+                "reuploads_total": sample.get("paged_tile_reuploads"),
                 "span_s": round(tail[-1]["t"] - tail[0]["t"], 2),
             }
         return None

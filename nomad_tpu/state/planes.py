@@ -45,6 +45,15 @@ import numpy as np
 #: ``tpu/columnar.py`` re-exports it.
 R_COLS = 4
 
+#: node rows per dirty-versioning tile — the granularity at which the
+#: paged planner (tpu/paging.py) re-uploads committed state, so the
+#: write path stamps at the same granularity the H2D stream pages at.
+#: Module-level (not imported from tpu/) so state/ stays jax-free;
+#: ``paging.configure`` pushes its resolved ``tile_rows`` here and each
+#: plane instance latches the value at axis-rebuild time (stamps stay
+#: self-consistent within an epoch even if the knob moves).
+TILE_ROWS = 65536
+
 
 def node_capacity_row(node) -> tuple:
     """One node's dense capacity row. Single definition shared by the
@@ -148,6 +157,14 @@ class CommittedPlanes:
         self.epoch = 0
         #: raft index the planes were last committed at
         self.version = 0
+        #: per-tile raft-index stamps (tile t covers node rows
+        #: [t·tile_rows, (t+1)·tile_rows)); committed by the same write
+        #: transaction as ``version``, so "which tiles changed since
+        #: index V" is one vectorized compare for the pager
+        self.tile_version = np.zeros(0, dtype=np.int64)
+        #: tile granularity latched at the last axis rebuild
+        self.tile_rows = TILE_ROWS
+        self._dirty_tiles: set[int] = set()
         #: the Generation these planes exactly equal; None while a write
         #: transaction is mid-patch (readers fall back to scan paths)
         self.gen = None
@@ -241,6 +258,7 @@ class CommittedPlanes:
     def _mark_dirty(self, row: int) -> None:
         for sink in self._sinks:
             sink.add(int(row))
+        self._dirty_tiles.add(int(row) // self.tile_rows)
 
     # -- commit (runs inside StateStore._publish) -----------------------
     def commit(self, gen, index: int) -> None:
@@ -254,6 +272,19 @@ class CommittedPlanes:
                     self._rebuild_axis(gen)
             elif self._axis_dirty:
                 self._rebuild_axis(gen)
+            n_tiles = max(1, -(-len(self.nodes) // self.tile_rows))
+            if len(self.tile_version) != n_tiles:
+                # fresh axis (rebuild/install reset the stamps): every
+                # tile is new at this index
+                self.tile_version = np.full(n_tiles, int(index),
+                                            dtype=np.int64)
+            elif self._dirty_tiles:
+                rows = np.fromiter(
+                    (t for t in self._dirty_tiles if t < n_tiles),
+                    dtype=np.int64,
+                )
+                self.tile_version[rows] = int(index)
+            self._dirty_tiles.clear()
             self.gen = gen
             self.version = index
 
@@ -274,9 +305,32 @@ class CommittedPlanes:
                 self._track(alloc)
         self.epoch += 1
         self._axis_dirty = False
+        # fresh axis: relatch the tile granularity and drop the stamps
+        # (commit() restamps every tile of the new axis at its index)
+        self.tile_rows = max(1, int(TILE_ROWS))
+        self.tile_version = np.zeros(0, dtype=np.int64)
+        self._dirty_tiles = set()
         # device sinks belong to the previous axis; their DeviceStates are
         # discarded by the adapter's epoch check
         self._sinks = []
+
+    # -- tile dirty-version readers (the pager's re-upload gate) --------
+    def dirty_tiles_since(self, version: int) -> list:
+        """Tile indices whose rows changed after raft ``version`` — the
+        set a device-resident pager must re-upload to reach the current
+        commit. A caller holding stamps from a different ``epoch`` must
+        discard them and treat every tile as dirty (the axis itself
+        moved); compare :attr:`epoch` before trusting this."""
+        with self.lock:
+            if len(self.tile_version) == 0:
+                return []
+            return np.nonzero(self.tile_version > int(version))[0].tolist()
+
+    def tile_stamps(self) -> tuple:
+        """``(epoch, tile_rows, tile_version copy)`` under the lock —
+        one consistent read for observability and the pager."""
+        with self.lock:
+            return self.epoch, self.tile_rows, self.tile_version.copy()
 
     # -- device sink registry (adapter holds self.lock) -----------------
     def register_sink(self, sink: set) -> None:
@@ -396,6 +450,9 @@ class CommittedPlanes:
         }
         self.epoch += 1
         self._axis_dirty = False
+        self.tile_rows = max(1, int(TILE_ROWS))
+        self.tile_version = np.zeros(0, dtype=np.int64)
+        self._dirty_tiles = set()
         self._sinks = []
         return True
 

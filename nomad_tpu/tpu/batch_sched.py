@@ -774,6 +774,49 @@ class TPUBatchScheduler(GenericScheduler):
         )
         if use_windowed:
             from .kernel import WindowArgs, plan_batch_windowed
+            from . import paging as _paging
+
+            # Paged route: the node planes exceed the device-resident
+            # budget — stream them through in tiles instead of pinning
+            # the full axis. Placements are bit-identical to the flat
+            # dispatch (pinned by test_paging's A/B); stanza off or
+            # budget-fitting shapes never enter here, so the flat path
+            # below stays byte-identical to pre-paging behavior.
+            if _paging.should_page(N, capacity.shape[1]):
+                t_columnar = time.monotonic()
+                try:
+                    placements, _rounds, pstats = _paging.plan_batch_paged(
+                        capacity, usable, feasible[0], perm, demands[0],
+                        int(group_count[0]), int(limits[0]), a_real,
+                        used0, collisions0[0], n_real, A, mesh=mesh,
+                    )
+                except Exception as e:
+                    return degrade_to_exact(f"dispatch: {e}")
+                LAST_KERNEL_STATS.update(
+                    columnar_s=t_columnar - t_start,
+                    n_nodes=n_real,
+                    n_allocs=a_real,
+                    n_padded_nodes=pstats["n_pad"],
+                    n_padded_allocs=A,
+                    mode="paged",
+                    shards=_shard.mesh_size(mesh),
+                    paged_tiles=pstats["tiles"],
+                    paged_tile_nodes=pstats["tile_nodes"],
+                    paged_reuploads=pstats["reuploads"],
+                    paged_budget_bytes=pstats["limit_bytes"],
+                )
+                _count_mode("paged")
+                _tag_device_span(kernel_span, "paged", "paged")
+                try:
+                    self._materialize(
+                        place, placements, nodes, by_dc, planes_list,
+                        g_index, gid_real, used0, capacity, g_demand,
+                        t_dispatch=t_columnar,
+                        dev_entries=dev_entries, groups=groups,
+                    )
+                except KernelFault as e:
+                    return degrade_to_exact(str(e))
+                return
 
             t_columnar = time.monotonic()
             try:

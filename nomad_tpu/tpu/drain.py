@@ -413,6 +413,15 @@ class KernelBatchCollector:
             used0 = np.full((N, R_COLS), 2**30, dtype=np.int32)
             used0[:n_real] = shared.used0
             cap_in, usable_in, used_in = capacity, usable, used0
+            # over the paging budget the mirror REFUSES a resident plane
+            # by design; this batch pays a transient upload instead, and
+            # the counter keeps the devprof h2d bytes explainable
+            from . import paging as _paging
+
+            if _paging.should_page(N, R_COLS):
+                from .. import metrics
+
+                metrics.incr("tpu.drain_paged_fallback")
 
         feasible = np.zeros((G, N), dtype=bool)
         affinity = np.zeros((G, N), dtype=np.float32)

@@ -579,10 +579,11 @@ def compile_cache_size() -> int:
     entry), so the detector covers mesh dispatches for free. -1 when
     the internals move (detector degrades, never breaks dispatch)."""
     try:
-        # the wavefront planner (tpu/wavefront.py) registers itself into
-        # PLANNER_JITS on import; pull it in lazily so this census stays
-        # complete without a kernel->wavefront top-level import cycle
-        from . import wavefront  # noqa: F401
+        # the wavefront and paged planners (tpu/wavefront.py,
+        # tpu/paging.py) register themselves into PLANNER_JITS on
+        # import; pull them in lazily so this census stays complete
+        # without a kernel->satellite top-level import cycle
+        from . import paging, wavefront  # noqa: F401
 
         return sum(fn._cache_size() for fn in PLANNER_JITS.values())
     except Exception:

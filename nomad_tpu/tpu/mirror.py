@@ -275,6 +275,7 @@ class ColumnarMirror:
             "rebuilds": 0,  # structurally zero — kept as the gate metric
             "stale": 0,
             "view_refreshes": 0,
+            "over_budget": 0,
             "rebuild_reasons": {},
         }
 
@@ -331,6 +332,19 @@ class ColumnarMirror:
         dispatches sharded, so its state plane must already live
         partitioned); a cached state for a different mesh is rebuilt,
         never reshared."""
+        # Budget gate: when the paging stanza says a full n_pad-row
+        # resident mirror would blow the device budget, refuse to build
+        # one — the caller degrades to its host-plane path (counted) and
+        # the over-budget axis is the paged dispatch's job.
+        from . import paging as _paging
+
+        if _paging.should_page(n_pad, R_COLS):
+            from .. import metrics
+
+            with self._lock:
+                self.counters["over_budget"] += 1
+            metrics.incr("tpu.mirror_over_budget")
+            return None
         with self._lock:
             planes = self._planes
             if self._closed or planes.gen is not gen:

@@ -209,6 +209,20 @@ def window_specs():
     return args, (rows, node)
 
 
+def paged_specs():
+    """(static-tile, dynamic-tile) PartitionSpec trees for the paged
+    planner's tile stream (tpu/paging.py). A tile is a contiguous
+    node-row slab, so the layout is the windowed planner's restricted to
+    one tile: static planes (capacity rows, usable rows, feasible lane,
+    node-id lane) and dynamic planes (used rows, collisions lane) all
+    split over the node axis — ``paging.tile_rows`` rounds the tile to a
+    mesh multiple so shards stay equal-sized."""
+    from jax.sharding import PartitionSpec as P
+
+    rows, node = P(AXIS, None), P(AXIS)
+    return (rows, rows, node, node), (rows, node)
+
+
 def put(tree, spec_tree, mesh):
     """``device_put`` a planner arg tree with its PartitionSpec tree.
     Every leaf — including the replicated scalars — is placed with an

@@ -209,6 +209,44 @@ def prewarm(shapes=DEFAULT_SHAPES, v_values: int = DEFAULT_V, mesh=None) -> int:
                 compiled += 1
         except Exception:
             continue
+    # the paged planner's tile sweeps compile per TILE shape, not per
+    # cluster shape — one (count, window) pair covers every node axis
+    # the pager streams, so the ladder entry is a single fixed shape
+    # from the tile_rows() single source (scalars ride as dynamic 0-d
+    # i32 args exactly as plan_batch_paged dispatches them)
+    from . import paging as _paging
+
+    if _paging.enabled():
+        try:
+            tn = _paging.tile_rows(all_mesh)
+            cap_t = jnp.ones((tn, 4), dtype=jnp.int32)
+            usable_t = jnp.ones((tn, 2), dtype=jnp.float32)
+            feas_t = jnp.ones(tn, dtype=bool)
+            used_t = jnp.zeros((tn, 4), dtype=jnp.int32)
+            coll_t = jnp.zeros(tn, dtype=jnp.int32)
+            nodes_t = jnp.arange(tn, dtype=jnp.int32)
+            if all_mesh is not None:
+                sspec, dspec = _shard.paged_specs()
+                cap_t, usable_t, feas_t, nodes_t = _shard.put(
+                    (cap_t, usable_t, feas_t, nodes_t), sspec, all_mesh
+                )
+                used_t, coll_t = _shard.put(
+                    (used_t, coll_t), dspec, all_mesh
+                )
+            demand_t = np.ones(4, dtype=np.int32)
+            s = np.int32(0)
+            _paging._tile_count_jit.lower(
+                cap_t, feas_t, used_t, demand_t, s, s, np.int32(tn)
+            ).compile()
+            compiled += 1
+            _paging._tile_window_jit.lower(
+                cap_t, usable_t, feas_t, used_t, coll_t, nodes_t,
+                demand_t, np.int32(1), np.int32(2), s, s, np.int32(tn),
+                s, s, np.int32(1), np.int32(1),
+            ).compile()
+            compiled += 1
+        except Exception:
+            pass
     return compiled
 
 

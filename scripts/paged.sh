@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Paged-planner suite (tpu/paging.py; README "Paged node axis" +
+# PERF.md round 19): the scored bench section — a PAGED_NODES-node
+# axis whose dense planes DO NOT fit the enforced device budget,
+# streamed through in tiles — followed by the paging test file (parity
+# pins, TileCache accounting, dispatch routing A/B). Scale knobs:
+#   BENCH_PAGED_NODES        (default 1000000)  node axis
+#   BENCH_PAGED_ALLOCS       (default 100000)   placements
+#   BENCH_PAGED_TILE_NODES   (default 65536)    tile height
+#   BENCH_PAGED_BUDGET_MB    (default 8)        enforced device budget
+#   BENCH_PAGED_PARITY_NODES (default 8192)     host-oracle subsample
+# The artifact records the budget-vs-plane arithmetic itself:
+# budget_holds_full must read false, parity_vs_oracle must read 1.0,
+# recompiles must read 0. Numbers are only comparable A/B on the same
+# box (see PERF.md).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export NOMAD_TPU_COMPILE_CACHE="${NOMAD_TPU_COMPILE_CACHE:-off}"
+
+python - "$@" <<'EOF'
+import json
+import sys
+
+import bench
+
+out = bench.bench_paged()
+print(json.dumps({"paged": out}, indent=1))
+print(
+    "PAGED_SUMMARY "
+    f"paged_nodes={out['nodes']} "
+    f"paged_s={out['paged_s']} "
+    f"paged_parity={out['parity_vs_oracle']} "
+    f"paged_tile_reuploads={out['tile_reuploads']} "
+    f"paged_recompiles={out['recompiles']} "
+    f"paged_budget_holds_full={out['budget_holds_full']}"
+)
+ok = (
+    not out["budget_holds_full"]
+    and out["parity_vs_oracle"] == 1.0
+    and out["recompiles"] == 0
+    and out["placed"] > 0
+)
+sys.exit(0 if ok else 1)
+EOF
+
+echo "--- paged test suite ---"
+python -m pytest tests/test_paging.py -q -p no:cacheprovider

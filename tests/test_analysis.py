@@ -297,6 +297,41 @@ class TestJaxHygiene:
         assert len(found) == 1
         assert "51200" in found[0].message
 
+    def test_tile_shape_unbucketed(self):
+        # the 51200-vs-50176 class at tile granularity: a paged-tile
+        # example array with a literal row count compiles a program the
+        # production tile bucket (tile_rows: power-of-two + mesh
+        # multiple) will never hit
+        src = (
+            "import numpy as np\n"
+            "from .paging import tile_rows\n"
+            "def warm_tiles_bad():\n"
+            "    return np.zeros((65536, 4))\n"
+            "def warm_tiles_good():\n"
+            "    tn = tile_rows()\n"
+            "    return np.zeros((tn, 4))\n"
+            "def warm_tiles_wrapped():\n"
+            "    return np.zeros((tile_rows(65536), 4))\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/w.py": src}, "tile-shape-unbucketed"
+        )
+        assert len(found) == 1
+        assert "65536" in found[0].message
+
+    def test_tile_shape_scoped_to_tile_code(self):
+        # the 64-row threshold only applies inside tile/paged functions;
+        # cluster-scale code keeps the generic 1024 rule
+        src = (
+            "import numpy as np\n"
+            "def plain_helper():\n"
+            "    return np.zeros((512, 4))\n"
+        )
+        found = findings_for(
+            {"nomad_tpu/tpu/w.py": src}, "tile-shape-unbucketed"
+        )
+        assert found == []
+
     def test_jit_shape_unbucketed(self):
         src = (
             "import jax\n"

@@ -444,6 +444,47 @@ class TestWatchdog:
         assert wd2.trip_log[0]["detail"]["rows"] == 2
         assert wd2.trip_log[0]["detail"]["planes_version"] == 17
 
+    def test_h2d_thrash_rule(self):
+        """Paged-planner thrash: tile re-upload bytes far outpacing
+        committed placements means the device budget is churning tiles
+        without buying decisions — bundle it."""
+
+        def window(re_bytes, placed):
+            return [
+                {"t": 0.0, "paged_tile_reupload_bytes": 0,
+                 "placements_total": 0},
+                {"t": 15.0, "paged_tile_reupload_bytes": re_bytes,
+                 "placements_total": placed,
+                 "paged_tile_reuploads": 40},
+            ]
+
+        thrash = window(50_000_000, 10)
+        wd = self._watchdog(thrash)
+        wd.on_sample(thrash[-1])
+        assert wd.trip_count == 1
+        assert wd.trip_log[0]["rule"] == "h2d_thrash"
+        assert wd.trip_log[0]["detail"]["reupload_bytes"] == 50_000_000
+        assert wd.trip_log[0]["detail"]["placements"] == 10
+
+        # same traffic amortized over real placement volume: healthy
+        busy = window(50_000_000, 1_000_000)
+        wd2 = self._watchdog(busy)
+        wd2.on_sample(busy[-1])
+        assert wd2.trip_count == 0
+
+        # trickle below the absolute floor never trips, whatever the
+        # ratio says (idle servers re-stamp tiles occasionally)
+        trickle = window(1_000_000, 0)
+        wd3 = self._watchdog(trickle)
+        wd3.on_sample(trickle[-1])
+        assert wd3.trip_count == 0
+
+        # servers without the pager (no paged_* sample keys): inert
+        plain = [{"t": 0.0}, {"t": 15.0}]
+        wd4 = self._watchdog(plain)
+        wd4.on_sample(plain[-1])
+        assert wd4.trip_count == 0
+
     def test_bundle_dirs_pruned_to_keep(self, tmp_path):
         """On-disk retention: only the newest bundle_keep watchdog-*
         dirs survive; operator-captured dirs in the same parent are

@@ -463,6 +463,86 @@ class TestHttpMint:
         assert _request_priority(None) is None
 
 
+class TestBlockingQueryDeadline:
+    """The deadline-aware park (api/http.py ``_blocking``): a minted
+    deadline shorter than ``?wait=`` clamps the park and a timeout at
+    the clamp is a LOUD terminal 504, not a silent empty 200 after the
+    caller already gave up."""
+
+    def _api(self, state):
+        from types import SimpleNamespace
+
+        from nomad_tpu.api.http import HTTPServer
+
+        ov = OverloadController(
+            dict(OVERLOAD_STANZA), load_fn=lambda: 0.0
+        )
+        return HTTPServer(
+            SimpleNamespace(state=state, overload=ov), port=0
+        )
+
+    def test_deadline_clamps_park_and_raises(self):
+        from nomad_tpu.state import StateStore
+
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+        api = self._api(s)
+        before = metrics.snapshot()["counters"].get(
+            "overload.deadline_exceeded.blocking_query", 0
+        )
+        t0 = time.monotonic()
+        with deadline_scope(mint_deadline(0.1)):
+            with pytest.raises(DeadlineExceeded) as e:
+                api._blocking(
+                    {"index": "1", "wait": "30s"},
+                    lambda snap: len(list(snap.nodes())),
+                )
+        # un-parked at the ~0.1s deadline, nowhere near the 30s wait
+        assert time.monotonic() - t0 < 5.0
+        assert e.value.where == "blocking_query"
+        after = metrics.snapshot()["counters"]
+        assert (
+            after["overload.deadline_exceeded.blocking_query"] == before + 1
+        )
+        assert api.server.overload.deadline_exceeded.get(
+            "blocking_query"
+        ) == 1
+
+    def test_data_before_deadline_returns_normally(self):
+        import threading
+
+        from nomad_tpu.state import StateStore
+
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+        api = self._api(s)
+        t = threading.Timer(0.05, lambda: s.upsert_node(2, mock.node()))
+        t.start()
+        try:
+            with deadline_scope(mint_deadline(10.0)):
+                res, idx = api._blocking(
+                    {"index": "1", "wait": "30s"},
+                    lambda snap: len(list(snap.nodes())),
+                )
+        finally:
+            t.join()
+        assert (res, idx) == (2, 2)
+
+    def test_no_deadline_is_plain_wait_timeout(self):
+        # the A/B contract: without an active deadline a park that
+        # times out returns the snapshot as it always did — no raise
+        from nomad_tpu.state import StateStore
+
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+        api = self._api(s)
+        res, idx = api._blocking(
+            {"index": "1", "wait": "50ms"},
+            lambda snap: len(list(snap.nodes())),
+        )
+        assert (res, idx) == (1, 1)
+
+
 # ---------------------------------------------------------------------------
 # full pipeline: expired work refused terminally, A/B off == untouched
 # ---------------------------------------------------------------------------

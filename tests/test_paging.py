@@ -1,0 +1,486 @@
+"""Paged node axis (nomad_tpu/tpu/paging.py): the tiled windowed
+planner must be BIT-IDENTICAL to the flat windowed scan it decomposes
+— same placements, same round count — with the pure-numpy windowed
+oracle pinning both from the host side. The suite also pins the
+operational surface: the tile bucketing policy, the budget gate, the
+TileCache's floor/LRU/dirty-reupload accounting, the per-tile raft
+stamps the committed planes carry, the devprof tile ledger, and the
+dispatch routing (paged engages only over budget; paging off leaves
+the flat path byte-identical — THE A/B contract)."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nomad_tpu.state import planes as state_planes
+from nomad_tpu.tpu import kernel, paging
+from nomad_tpu.tpu.kernel import WindowArgs, deterministic_scope
+from nomad_tpu.tpu.paging import TileCache, plan_batch_paged, plan_windowed_np
+
+
+@pytest.fixture(autouse=True)
+def _paging_reset():
+    tile_rows_before = state_planes.TILE_ROWS
+    yield
+    paging.reset()
+    state_planes.TILE_ROWS = tile_rows_before
+
+
+# ---------------------------------------------------------------------------
+# problem generator + the three implementations under comparison
+# ---------------------------------------------------------------------------
+
+
+def build_case(seed, n, a, limit, c=4):
+    rng = np.random.default_rng(seed)
+    capacity = rng.integers(8, 64, size=(n, c)).astype(np.int32)
+    usable = np.maximum(capacity[:, :2].astype(np.float32), 1.0)
+    feasible = rng.random(n) < 0.9
+    demand = rng.integers(1, 4, size=c).astype(np.int32)
+    used0 = rng.integers(0, 4, size=(n, c)).astype(np.int32)
+    collisions0 = rng.integers(0, 2, size=n).astype(np.int32)
+    perm = rng.permutation(n).astype(np.int32)
+    group_count = int(rng.integers(1, 8))
+    return dict(
+        capacity=capacity, usable=usable, feasible=feasible, perm=perm,
+        demand=demand, group_count=group_count, limit=int(limit),
+        n_allocs=int(a), used0=used0, collisions0=collisions0,
+        n_real=int(n), a_pad=int(a),
+    )
+
+
+def run_flat(case):
+    """The flat windowed jit — THE decomposition reference."""
+    args = WindowArgs(
+        capacity=jnp.asarray(case["capacity"]),
+        usable=jnp.asarray(case["usable"]),
+        feasible=jnp.asarray(case["feasible"]),
+        perm=jnp.asarray(case["perm"], jnp.int32),
+        demand=jnp.asarray(case["demand"]),
+        group_count=jnp.int32(case["group_count"]),
+        limit=jnp.int32(case["limit"]),
+        n_allocs=jnp.int32(case["n_allocs"]),
+    )
+    out, _ = kernel._dispatch(
+        "windowed", kernel._plan_batch_windowed_jit,
+        (args, jnp.asarray(case["used0"]),
+         jnp.asarray(case["collisions0"]),
+         case["n_real"], case["a_pad"]),
+        f"N{case['n_real']}A{case['a_pad']}",
+    )
+    placements, rounds = out
+    return np.asarray(placements), int(rounds)
+
+
+def run_paged(case):
+    placements, rounds, stats = plan_batch_paged(
+        case["capacity"], case["usable"], case["feasible"], case["perm"],
+        case["demand"], case["group_count"], case["limit"],
+        case["n_allocs"], case["used0"], case["collisions0"],
+        case["n_real"], case["a_pad"],
+    )
+    return placements, rounds, stats
+
+
+def run_oracle(case):
+    return plan_windowed_np(
+        case["capacity"], case["usable"], case["feasible"], case["perm"],
+        case["demand"], case["group_count"], case["limit"],
+        case["n_allocs"], case["used0"], case["collisions0"],
+        case["n_real"], case["a_pad"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# the tile bucketing policy + the budget gate
+# ---------------------------------------------------------------------------
+
+
+class TestPolicy:
+    def test_tile_rows_rounds_to_power_of_two(self):
+        paging.configure(tile_nodes=100)
+        assert paging.tile_rows() == 128
+        paging.configure(tile_nodes=64)
+        assert paging.tile_rows() == 64
+        paging.configure(tile_nodes=1)  # floored at MIN_TILE_NODES
+        assert paging.tile_rows() == paging.MIN_TILE_NODES
+
+    def test_configure_pushes_tile_rows_to_planes(self):
+        paging.configure(tile_nodes=128)
+        assert state_planes.TILE_ROWS == 128
+
+    def test_should_page_requires_enabled_and_over_budget(self):
+        paging.reset()
+        assert not paging.should_page(10**7)  # disabled by default
+        paging.configure(enabled=True, device_node_budget_mb=1)
+        # 1MB budget: ~20K nodes fit, a million do not
+        assert not paging.should_page(1024)
+        assert paging.should_page(10**6)
+        paging.configure(enabled=False)
+        assert not paging.should_page(10**6)
+
+    def test_plane_bytes_scale_with_columns(self):
+        assert paging.plane_bytes(1000, 4) > paging.plane_bytes(1000, 3)
+
+
+# ---------------------------------------------------------------------------
+# TileCache: budget floor, LRU eviction, dirty re-upload accounting
+# ---------------------------------------------------------------------------
+
+
+def _tile_builders(tn=8, c=4):
+    def build_static(t):
+        return (
+            np.full((tn, c), t, np.int32),
+            np.ones((tn, 2), np.float32),
+            np.ones(tn, bool),
+            np.arange(t * tn, (t + 1) * tn, dtype=np.int32),
+        )
+
+    def build_dynamic(t):
+        return (np.zeros((tn, c), np.int32), np.zeros(tn, np.int32))
+
+    return build_static, build_dynamic
+
+
+class TestTileCache:
+    def test_budget_floored_at_two_tiles(self):
+        cache = TileCache(1, *_tile_builders())
+        cache.ensure(0)
+        st = cache.stats()
+        assert st["budget_raised"]
+        assert st["limit_bytes"] == 2 * st["tile_bytes"]
+
+    def test_lru_eviction_and_revisit_counts_as_reupload(self):
+        bs, bd = _tile_builders()
+        tile_bytes = sum(
+            np.asarray(x).nbytes for x in (*bs(0), *bd(0))
+        )
+        cache = TileCache(2 * tile_bytes, bs, bd)
+        cache.ensure(0)
+        cache.ensure(1)
+        assert cache.evictions == 0
+        cache.ensure(2)  # evicts tile 0 (LRU)
+        assert cache.evictions == 1
+        assert cache.reuploads == 0
+        cache.ensure(0)  # back in: a budget-driven re-stream
+        assert cache.reuploads == 1
+        assert cache.reupload_bytes == tile_bytes
+
+    def test_dirty_reuploads_only_dynamic_planes(self):
+        bs, bd = _tile_builders()
+        dyn_bytes = sum(np.asarray(x).nbytes for x in bd(0))
+        cache = TileCache(1 << 20, bs, bd)
+        cache.ensure(0)
+        before = cache.upload_bytes
+        cache.mark_dirty([0])
+        cache.ensure(0)
+        assert cache.reuploads == 1
+        assert cache.upload_bytes - before == dyn_bytes
+        cache.ensure(0)  # clean again: a hit, no traffic
+        assert cache.hits == 1
+        assert cache.upload_bytes - before == dyn_bytes
+
+
+# ---------------------------------------------------------------------------
+# parity: paged == flat == numpy oracle, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_paged_matches_flat_and_oracle_multi_tile(seed):
+    """Multi-tile, multi-round, ring-offset-wrapping shapes: the paged
+    decomposition must reproduce the flat windowed scan's placements and
+    round count exactly, and the numpy oracle must agree with both."""
+    paging.configure(enabled=True, tile_nodes=64)
+    case = build_case(seed, n=320, a=160, limit=4)
+    flat_p, flat_r = run_flat(case)
+    paged_p, paged_r, stats = run_paged(case)
+    oracle_p, oracle_r = run_oracle(case)
+    assert stats["tiles"] == 5
+    np.testing.assert_array_equal(flat_p, paged_p)
+    np.testing.assert_array_equal(flat_p, oracle_p)
+    assert flat_r == paged_r == oracle_r
+    assert (paged_p >= 0).sum() == case["n_allocs"]
+
+
+def test_paged_matches_flat_irregular_tail_tile(seed=11):
+    """A node count that leaves the last tile mostly padding."""
+    paging.configure(enabled=True, tile_nodes=64)
+    case = build_case(seed, n=797, a=96, limit=6)
+    flat_p, flat_r = run_flat(case)
+    paged_p, paged_r, stats = run_paged(case)
+    assert stats["tiles"] == 13
+    np.testing.assert_array_equal(flat_p, paged_p)
+    assert flat_r == paged_r
+
+
+def test_paged_matches_flat_single_tile():
+    paging.configure(enabled=True, tile_nodes=64)
+    case = build_case(5, n=48, a=24, limit=3)
+    flat_p, flat_r = run_flat(case)
+    paged_p, paged_r, stats = run_paged(case)
+    assert stats["tiles"] == 1
+    np.testing.assert_array_equal(flat_p, paged_p)
+    assert flat_r == paged_r
+
+
+def test_paged_matches_flat_deterministic_flavor():
+    """Under the deterministic compile flavor (the flavor the sharded
+    parity pins run in) the decomposition must still be bit-identical."""
+    paging.configure(enabled=True, tile_nodes=64)
+    case = build_case(7, n=320, a=160, limit=4)
+    with deterministic_scope():
+        flat_p, flat_r = run_flat(case)
+        paged_p, paged_r, _ = run_paged(case)
+    np.testing.assert_array_equal(flat_p, paged_p)
+    assert flat_r == paged_r
+
+
+def test_paged_zero_feasible_places_nothing():
+    paging.configure(enabled=True, tile_nodes=64)
+    case = build_case(3, n=200, a=50, limit=4)
+    case["feasible"][:] = False
+    paged_p, paged_r, _ = run_paged(case)
+    oracle_p, oracle_r = run_oracle(case)
+    assert (paged_p == -1).all()
+    np.testing.assert_array_equal(paged_p, oracle_p)
+    assert paged_r == oracle_r == 1
+
+
+def test_paged_dispatch_is_recompile_free_across_tiles():
+    """Every tile of a shape shares ONE compiled program per sweep: a
+    second paged run on a different problem of the same tile shape must
+    not grow the compile cache."""
+    paging.configure(enabled=True, tile_nodes=64)
+    run_paged(build_case(21, n=320, a=64, limit=4))
+    before = kernel.compile_cache_size()
+    run_paged(build_case(22, n=448, a=64, limit=4))
+    assert kernel.compile_cache_size() == before
+
+
+def test_devprof_counts_tile_traffic():
+    """The devprof transfer ledger grows its paged counters during a
+    multi-round paged run: uploads for first residency, re-uploads for
+    the dirty dynamic planes committed placements touch."""
+    from nomad_tpu.debug import devprof
+
+    paging.configure(enabled=True, tile_nodes=64)
+    devprof.enable(True)
+    devprof.reset()
+    _, rounds, stats = run_paged(build_case(9, n=320, a=160, limit=4))
+    totals = devprof.totals()
+    # the ledger's tile_uploads is TOTAL tile traffic: first admissions
+    # plus dirty/evicted re-streams (the thrash rule's numerator)
+    assert rounds > 1
+    assert stats["uploads"] > 0 and stats["reuploads"] > 0
+    assert (
+        totals["paged_tile_uploads"]
+        == stats["uploads"] + stats["reuploads"]
+    )
+    assert totals["paged_tile_upload_bytes"] == stats["upload_bytes"] > 0
+    assert totals["paged_tile_reuploads"] == stats["reuploads"]
+    assert (
+        totals["paged_tile_reupload_bytes"] == stats["reupload_bytes"] > 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# committed planes: tile-granular raft stamps
+# ---------------------------------------------------------------------------
+
+
+def _mini_store(n_nodes=10):
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+
+    state = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        nodes.append(n)
+    state.upsert_nodes(1, nodes)
+    return state, nodes
+
+
+def _alloc_on(node_id):
+    from nomad_tpu import mock
+
+    a = mock.alloc()
+    a.node_id = node_id
+    a.desired_status = "run"
+    a.client_status = "pending"
+    return a
+
+
+class TestPlaneTileStamps:
+    def test_commit_stamps_dirty_tiles_only(self):
+        state_planes.TILE_ROWS = 4
+        state, nodes = _mini_store(10)  # 3 tiles of 4 rows
+        planes = state.planes
+        epoch0, tile_rows, stamps = planes.tile_stamps()
+        assert tile_rows == 4
+        assert list(stamps) == [1, 1, 1]  # fresh axis: full restamp
+
+        row = planes.index[nodes[5].id]
+        state.upsert_allocs(7, [_alloc_on(nodes[5].id)])
+        epoch1, _, stamps = planes.tile_stamps()
+        assert epoch1 == epoch0  # no axis change
+        want = [1, 1, 1]
+        want[row // 4] = 7
+        assert list(stamps) == want
+        assert planes.dirty_tiles_since(1) == [row // 4]
+        assert planes.dirty_tiles_since(7) == []
+
+    def test_axis_rebuild_restamps_every_tile(self):
+        from nomad_tpu import mock
+
+        state_planes.TILE_ROWS = 4
+        state, nodes = _mini_store(10)
+        state.upsert_allocs(3, [_alloc_on(nodes[0].id)])
+        extra = mock.node()
+        extra.id = "node-extra"
+        state.upsert_node(9, extra)  # axis change: full rebuild
+        epoch, tile_rows, stamps = state.planes.tile_stamps()
+        assert len(stamps) == 3  # 11 nodes / 4 rows
+        assert (stamps == 9).all()
+        assert state.planes.dirty_tiles_since(8) == [0, 1, 2]
+
+    def test_dirty_tiles_cleared_after_commit(self):
+        state_planes.TILE_ROWS = 4
+        state, nodes = _mini_store(8)
+        state.upsert_allocs(5, [_alloc_on(nodes[0].id)])
+        assert state.planes._dirty_tiles == set()
+
+
+# ---------------------------------------------------------------------------
+# dispatch routing: the A/B contract
+# ---------------------------------------------------------------------------
+
+
+def _sched_problem(seed=9):
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+    from nomad_tpu.structs import compute_class
+
+    state = StateStore()
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(96):
+        n = mock.node()
+        n.id = f"node-{i:04d}"
+        n.node_resources.cpu.cpu_shares = rng.choice([8000, 16000])
+        n.node_resources.memory.memory_mb = rng.choice([16384, 32768])
+        n.node_resources.networks = []
+        n.reserved_resources.networks.reserved_host_ports = ""
+        compute_class(n)
+        nodes.append(n)
+    state.upsert_nodes(1, nodes)
+    job = mock.job()
+    job.id = "job-paged-route"
+    tg = job.task_groups[0]
+    tg.count = 16
+    tg.tasks[0].resources.networks = []
+    state.upsert_job(2, job)
+    return state, job
+
+
+class _Planner:
+    def __init__(self):
+        self.plans = []
+
+    def submit_plan(self, plan):
+        from nomad_tpu.structs.model import PlanResult
+
+        self.plans.append(plan)
+        return PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            node_preemptions=plan.node_preemptions,
+            alloc_index=1,
+        ), None
+
+    def update_eval(self, ev):
+        pass
+
+    def create_eval(self, ev):
+        pass
+
+
+def _run_eval(seed=9):
+    from nomad_tpu.structs.model import Evaluation, generate_uuid
+    from nomad_tpu.tpu import batch_sched
+    from nomad_tpu.tpu.batch_sched import TPUBatchScheduler
+
+    state, job = _sched_problem(seed)
+    planner = _Planner()
+    sched = TPUBatchScheduler(
+        state.snapshot(), planner, rng=random.Random(17)
+    )
+    ev = Evaluation(
+        id=generate_uuid(), namespace=job.namespace,
+        priority=job.priority, type=job.type,
+        triggered_by="job-register", job_id=job.id,
+        status="pending",
+    )
+    batch_sched.LAST_KERNEL_STATS.clear()
+    sched.process(ev)
+    mode = batch_sched.LAST_KERNEL_STATS.get("mode")
+    stats = dict(batch_sched.LAST_KERNEL_STATS)
+    placed = {
+        a.name: a.node_id
+        for allocs in planner.plans[0].node_allocation.values()
+        for a in allocs
+    }
+    return mode, placed, stats
+
+
+class TestDispatchRouting:
+    def test_over_budget_routes_paged_with_identical_placements(
+        self, monkeypatch
+    ):
+        """With paging ON and the budget too small for the node planes,
+        the eval routes through the pager — and places the SAME allocs
+        on the SAME nodes as the flat windowed dispatch."""
+        paging.reset()
+        mode_off, placed_off, _ = _run_eval()
+        assert mode_off == "windowed"
+
+        paging.configure(enabled=True, tile_nodes=64)
+        monkeypatch.setattr(paging, "budget_mb", lambda: 0)
+        mode_on, placed_on, stats = _run_eval()
+        assert mode_on == "paged"
+        assert stats["paged_tiles"] >= 2
+        assert placed_on == placed_off
+
+    def test_enabled_but_budget_fitting_stays_flat(self):
+        """The A/B pin: shapes that fit the budget never enter the pager
+        — the flat windowed path runs exactly as before the stanza
+        existed."""
+        paging.configure(enabled=True, device_node_budget_mb=4096)
+        mode, placed, stats = _run_eval()
+        assert mode == "windowed"
+        assert "paged_tiles" not in stats
+        assert placed
+
+    def test_paged_kernel_fault_degrades_to_exact_np(self, monkeypatch):
+        """The pager honors the tpu.kernel fault point: a faulted device
+        tier degrades the eval to the exact-np host oracle, the same
+        ladder as every other dispatch mode."""
+        from nomad_tpu.testing import faults
+        from nomad_tpu.tpu import batch_sched
+
+        paging.configure(enabled=True, tile_nodes=64)
+        monkeypatch.setattr(paging, "budget_mb", lambda: 0)
+        plane = faults.install(faults.FaultPlane(seed=3))
+        plane.rule("point", "error", method="tpu.kernel", count=100)
+        try:
+            mode, placed, _ = _run_eval()
+        finally:
+            faults.uninstall()
+        assert mode == "exact-np-degraded"
+        assert placed, "degraded eval placed nothing"

@@ -10,7 +10,13 @@ import random
 
 from nomad_tpu import mock
 from nomad_tpu.scheduler.context import EvalContext
-from nomad_tpu.scheduler.feasible import StaticIterator
+from nomad_tpu.scheduler.feasible import (
+    ConstraintChecker,
+    DistinctHostsIterator,
+    DriverChecker,
+    StaticIterator,
+    new_random_iterator,
+)
 from nomad_tpu.scheduler.rank import (
     BinPackIterator,
     FeasibleRankIterator,
@@ -23,7 +29,10 @@ from nomad_tpu.scheduler.rank import (
 )
 from nomad_tpu.scheduler.testing import Harness
 from nomad_tpu.structs.model import (
+    CONSTRAINT_DISTINCT_HOSTS,
     Affinity,
+    Constraint,
+    DriverInfo,
     AllocatedCpuResources,
     AllocatedMemoryResources,
     AllocatedResources,
@@ -126,6 +135,129 @@ class TestFeasibleRankIteratorPort:
         static = StaticIterator(ctx, nodes)
         feasible = FeasibleRankIterator(ctx, static)
         assert len(collect_ranked(feasible)) == 10
+
+
+class TestFeasibilityIteratorPort:
+    """Source-iterator + checker slice from the reference feasibility
+    corpus (scheduler/feasible_test.go — cited per test): the rank
+    pipeline above consumes exactly these iterators, so their
+    serve/reset/filter contracts are pinned next to it."""
+
+    def test_static_iterator_serves_all_then_resets(self):
+        # ref TestStaticIterator_Reset (feasible_test.go:40)
+        h, ctx = make_ctx()
+        nodes = [mock.node() for _ in range(3)]
+        static = StaticIterator(ctx, nodes)
+        for round_no in range(3):
+            out = []
+            while True:
+                n = static.next()
+                if n is None:
+                    break
+                out.append(n)
+            assert len(out) == len(nodes), round_no
+            assert {n.id for n in out} == {n.id for n in nodes}
+            static.reset()
+
+    def test_static_iterator_set_nodes(self):
+        # ref TestStaticIterator_SetNodes (feasible_test.go:60)
+        h, ctx = make_ctx()
+        static = StaticIterator(ctx, [mock.node() for _ in range(3)])
+        replacement = [mock.node()]
+        static.set_nodes(replacement)
+        assert static.next() is replacement[0]
+        assert static.next() is None
+
+    def test_random_iterator_is_a_permutation(self):
+        # ref TestRandomIterator (feasible_test.go:76): randomized order,
+        # but every node served exactly once
+        h, ctx = make_ctx()
+        nodes = [mock.node() for _ in range(10)]
+        ids = {n.id for n in nodes}
+        rand = new_random_iterator(ctx, nodes[:])
+        out = []
+        while True:
+            n = rand.next()
+            if n is None:
+                break
+            out.append(n)
+        assert len(out) == 10
+        assert {n.id for n in out} == ids
+
+    def test_driver_checker_info_and_attribute_forms(self):
+        # ref TestDriverChecker_HealthChecks + TestDriverChecker_Compatibility
+        # (feasible_test.go:170): fingerprinted DriverInfo wins; legacy
+        # driver.<name> attributes accept only truthy forms
+        h, ctx = make_ctx()
+        healthy = mock.node()
+        undetected = mock.node()
+        undetected.drivers["exec"] = DriverInfo(detected=False, healthy=False)
+        unhealthy = mock.node()
+        unhealthy.drivers["exec"] = DriverInfo(detected=True, healthy=False)
+        legacy_true = mock.node()
+        del legacy_true.drivers["exec"]
+        legacy_true.attributes["driver.exec"] = "true"
+        legacy_false = mock.node()
+        del legacy_false.drivers["exec"]
+        legacy_false.attributes["driver.exec"] = "0"
+
+        checker = DriverChecker(ctx, {"exec"})
+        assert checker.feasible(healthy)
+        assert not checker.feasible(undetected)
+        assert not checker.feasible(unhealthy)
+        assert checker.feasible(legacy_true)
+        assert not checker.feasible(legacy_false)
+
+    def test_constraint_checker_operands(self):
+        # ref TestConstraintChecker (feasible_test.go:290): equality on a
+        # node target, regexp + version on attributes, is_set
+        h, ctx = make_ctx()
+        n = mock.node()
+        n.attributes["kernel.version"] = "4.9.32"
+
+        def ok(*constraints):
+            checker = ConstraintChecker(ctx, list(constraints))
+            return checker.feasible(n)
+
+        assert ok(Constraint("${node.datacenter}", "dc1", "="))
+        assert not ok(Constraint("${node.datacenter}", "dc2", "="))
+        assert ok(Constraint("${attr.kernel.name}", "^lin.*$", "regexp"))
+        assert not ok(Constraint("${attr.kernel.name}", "^win.*$", "regexp"))
+        assert ok(Constraint("${attr.kernel.version}", ">= 4.6", "version"))
+        assert not ok(Constraint("${attr.kernel.version}", "> 5.0", "version"))
+        assert ok(Constraint("${attr.kernel.name}", "", "is_set"))
+        assert not ok(Constraint("${attr.no.such.attr}", "", "is_set"))
+        # a failed constraint is attributed in the filter metrics
+        assert any(
+            "dc2" in reason for reason in ctx.metrics.constraint_filtered
+        )
+
+    def test_distinct_hosts_filters_proposed_collisions(self):
+        # ref TestDistinctHostsIterator_JobDistinctHosts
+        # (feasible_test.go:450): a job-level distinct_hosts constraint
+        # rejects nodes already carrying a proposed alloc of the job
+        h, ctx = make_ctx()
+        n1, n2 = mock.node(), mock.node()
+        job = mock.job()
+        job.constraints = [Constraint(operand=CONSTRAINT_DISTINCT_HOSTS)]
+        tg = job.task_groups[0]
+        ctx.plan.node_allocation[n1.id] = [
+            Allocation(
+                id=generate_uuid(), job_id=job.id, task_group=tg.name
+            )
+        ]
+
+        static = StaticIterator(ctx, [n1, n2])
+        distinct = DistinctHostsIterator(ctx, static)
+        distinct.set_job(job)
+        distinct.set_task_group(tg)
+        out = []
+        while True:
+            n = distinct.next()
+            if n is None:
+                break
+            out.append(n)
+        assert [n.id for n in out] == [n2.id]
 
 
 class TestBinPackIteratorPort:
