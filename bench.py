@@ -1821,9 +1821,14 @@ def main():
     # in the perf trajectory next to the numbers the analyzer protects
     # (the shape-bucket rules exist because of a bench regression; see
     # ANALYSIS.md)
-    from nomad_tpu.analysis import count_new_findings
+    from nomad_tpu.analysis import count_new_findings, count_race_findings
 
     parts.append(f"analysis_findings={count_new_findings()}")
+    # the race plane's burn-down gauge: new + baselined findings from
+    # the three race rules (racegraph.py) — drops as races get fixed,
+    # never silently (a WHY'd ignore removes it from the count only
+    # with a committed justification next to the write site)
+    parts.append(f"race_findings={count_race_findings()}")
     if "sharded" in detail:
         sh = detail["sharded"]
         if sh.get("skipped"):

@@ -30,6 +30,7 @@ from . import (  # noqa: F401,E402
     jax_hygiene,
     lockgraph,
     plane_mutation,
+    racegraph,
     raft_hygiene,
     retry_budget,
     shard_hygiene,
@@ -62,5 +63,27 @@ def count_new_findings(root: str = None) -> int:
     try:
         new, _ = analyze(root)
         return len(new)
+    except Exception:
+        return -1  # analyzer itself broke: surface as a sentinel
+
+
+#: the race plane's rules (analysis/racegraph.py) — the slice of the
+#: catalog whose finding count BENCH_SUMMARY tracks separately
+RACE_RULES = (
+    "unsynchronized-shared-write",
+    "inconsistent-lockset",
+    "unguarded-flag-check",
+)
+
+
+def count_race_findings(root: str = None) -> int:
+    """Total race-plane findings, new AND baselined — bench.py surfaces
+    this as ``race_findings=`` so the burn-down trajectory (fix or WHY
+    each one away) is visible next to the perf numbers. Unlike
+    :func:`count_new_findings` this counts the baseline too: a baselined
+    race is debt being tracked, not debt paid."""
+    try:
+        new, known = analyze(root, list(RACE_RULES))
+        return len(new) + len(known)
     except Exception:
         return -1  # analyzer itself broke: surface as a sentinel

@@ -1,0 +1,630 @@
+"""Static shared-state data-race analyzer (the racegraph).
+
+The lockgraph (:mod:`.lockgraph`) answers "can these locks deadlock";
+this pass answers the complementary question the repo's costliest bugs
+actually asked: "is this attribute written by one thread while another
+thread reads it, and does a lock protect both sides?" — the zombie
+frozen-raft-view replies, the sharded-broker flush-race re-enqueue and
+the mirror close()-racing-sync were all unsynchronized cross-thread
+state, found only by storm archaeology.
+
+The model extends the lockgraph's lock universe and call-edge
+resolution into a **shared-state map**, following Eraser's lockset
+discipline (Savage et al., SOSP '97):
+
+1. **thread classes** — seeded from every named ``threading.Thread`` /
+   ``threading.Timer`` spawn (the thread-naming lint guarantees spawns
+   are named, so the static name IS the thread-class id) plus
+   timer-wheel ``arm(delay, fn, args)`` callbacks, and propagated
+   through the lockgraph's resolved call edges. Public entry points
+   (methods whose name doesn't start with ``_``, plus dunders) get the
+   synthetic ``caller`` class: API/test threads call them directly.
+2. **entry locksets** — for every function, the set of locks provably
+   held at EVERY resolved call site (a greatest-fixpoint intersection),
+   so a private helper only ever invoked under ``with self._lock:`` is
+   not misflagged. Public functions start at the empty set — anyone may
+   call them bare.
+3. **per-attribute access sites** — every ``self.X`` read, write and
+   ``if self.X:`` check, with the lockset held at the site (the
+   lockgraph ``with lock:`` body walk) plus the entry lockset.
+
+An attribute is **shared** when its access sites span ≥ 2 thread
+classes including at least one spawned thread, with at least one write
+outside ``__init__`` (initialization before publication is Eraser's
+virgin state and never flagged).
+
+Rules:
+
+- ``unsynchronized-shared-write`` — a shared attribute is written under
+  an EMPTY lockset in one thread class while another class reads or
+  writes it;
+- ``inconsistent-lockset`` — two write sites guard the same shared
+  attribute with disjoint (non-empty) locksets: each write is "locked",
+  but no single lock protects the attribute — the classic Eraser
+  finding;
+- ``unguarded-flag-check`` — a shared boolean whose writes are
+  consistently guarded by a lock is tested in an ``if`` outside that
+  lock: check-then-act, the exact zombie-conn shape. ``while self._run``
+  daemon-loop polls are deliberately exempt (benign staleness by
+  design); the rule fires on decisions, not on loop continuation.
+
+Findings are keyed per (class, attribute, rule) with stable messages so
+the baseline survives unrelated edits. The runtime witness
+(:mod:`nomad_tpu.testing.racedep`) cross-validates: every race it
+observes under tier-1 must be derivable from this map
+(``test_runtime_races_consistent_with_static_graph``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .framework import Finding, Project, register
+from .lockgraph import Model, build_model, _short
+from .threads import _threading_aliases
+
+#: the synthetic thread class for direct entry (API handlers, tests,
+#: whatever thread owns the object and calls its public surface)
+CALLER = "caller"
+
+#: the shared timer wheel's callback thread (core/broker._TimerWheel)
+WHEEL = "eval-broker-timers"
+
+#: per-request threads ThreadingHTTPServer spawns for ``do_*`` handlers
+HTTP = "http-handler"
+
+#: constructor-ish methods whose writes are Eraser's virgin state:
+#: initialization before the instance is published to other threads
+_INIT_METHODS = frozenset({"__init__", "__new__", "__post_init__"})
+
+
+@dataclass
+class Access:
+    """One ``self.X`` access site."""
+
+    func: str  # FuncInfo qualname
+    method: str  # enclosing top-level method name
+    line: int
+    kind: str  # "read" | "write" | "check"
+    locks: frozenset  # lock ids held AT the site (entry locks added later)
+    bool_write: bool = False  # write of a True/False constant
+    in_init: bool = False
+
+
+@dataclass
+class SharedAttr:
+    """The computed shared-state map entry for one (class, attr)."""
+
+    class_qual: str
+    attr: str
+    relpath: str
+    accesses: list = field(default_factory=list)
+    thread_classes: frozenset = frozenset()
+
+
+def _spawn_name(call: ast.Call, fallback: str) -> str:
+    """Static thread-class id out of the ``name=`` kwarg: constant
+    strings verbatim, f-strings reduced to their constant skeleton
+    (``f"ldg-worker-{i}"`` → ``ldg-worker``)."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return v.value
+        if isinstance(v, ast.JoinedStr):
+            parts = [
+                s.value
+                for s in v.values
+                if isinstance(s, ast.Constant) and isinstance(s.value, str)
+            ]
+            name = "".join(parts).strip("-_ ")
+            if name:
+                return name
+    return fallback
+
+
+class RaceModel:
+    """Shared-state map over the lockgraph model."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.model: Model = build_model(project)
+        #: (thread class, target qualname, relpath, line)
+        self.spawns: list = []
+        self._find_spawns()
+        #: qualname → frozenset of thread-class names that may run it
+        self.tclasses: dict = self._thread_classes()
+        #: qualname → frozenset of lock ids held at EVERY call site
+        self.entry: dict = self._entry_locks()
+        #: (class qualname, attr) → [Access]
+        self.accesses: dict = {}
+        for syms in self.model.symbols.values():
+            self._collect_module(syms)
+        #: (class qualname, attr) → SharedAttr — the shared-state map
+        self.shared: dict = self._shared_state()
+
+    # -- thread-class seeding -------------------------------------------
+    def _find_spawns(self):
+        for modname, syms in self.model.symbols.items():
+            mod = syms.mod
+            mod_aliases, bare = _threading_aliases(mod)
+            for node in mod.tree.body:
+                self._walk_spawn(node, syms, None, None, mod_aliases, bare)
+
+    def _walk_spawn(self, node, syms, ci, funcqual, mod_aliases, bare):
+        if isinstance(node, ast.ClassDef):
+            nci = syms.classes.get(node.name) if ci is None else None
+            for child in node.body:
+                self._walk_spawn(child, syms, nci, None, mod_aliases, bare)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if funcqual is None:
+                base = ci.qualname if ci is not None else _short(
+                    syms.mod.modname
+                )
+                q = f"{base}.{node.name}"
+            else:
+                q = f"{funcqual}.<{node.name}>"
+            for child in node.body:
+                self._walk_spawn(child, syms, ci, q, mod_aliases, bare)
+            return
+        if isinstance(node, ast.Call):
+            self._maybe_spawn(node, syms, ci, funcqual, mod_aliases, bare)
+        for child in ast.iter_child_nodes(node):
+            self._walk_spawn(child, syms, ci, funcqual, mod_aliases, bare)
+
+    def _maybe_spawn(self, call, syms, ci, funcqual, mod_aliases, bare):
+        fn = call.func
+        kind = None
+        if isinstance(fn, ast.Attribute) and fn.attr in ("Thread", "Timer"):
+            if isinstance(fn.value, ast.Name) and fn.value.id in mod_aliases:
+                kind = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in bare:
+            kind = fn.id
+        target = None
+        if kind is not None:
+            if kind == "Thread":
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            else:  # Timer(interval, function)
+                for kw in call.keywords:
+                    if kw.arg == "function":
+                        target = kw.value
+                if target is None and len(call.args) >= 2:
+                    target = call.args[1]
+        elif (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "arm"
+            and len(call.args) == 3
+        ):
+            # the shared timer wheel: arm(delay, fn, args) — callbacks
+            # run on the wheel's own thread
+            kind = "arm"
+            target = call.args[1]
+        if kind is None or target is None:
+            return
+        qual = self._resolve_target(target, syms, ci, funcqual)
+        if qual is None:
+            return
+        tclass = (
+            WHEEL
+            if kind == "arm"
+            else _spawn_name(call, qual.rsplit(".", 1)[-1].strip("<>"))
+        )
+        self.spawns.append(
+            (tclass, qual, syms.mod.relpath, call.lineno)
+        )
+
+    def _resolve_target(self, target, syms, ci, funcqual) -> Optional[str]:
+        if isinstance(target, ast.Attribute):
+            return self.model._callee_ref(syms, ci, target.value, target.attr)
+        if isinstance(target, ast.Name):
+            if funcqual is not None:
+                nested = f"{funcqual}.<{target.id}>"
+                if nested in self.model.funcs:
+                    return nested
+            if ci is not None:
+                hit = self.model._find_method(ci, target.id)
+                if hit is not None:
+                    return hit
+            return self.model._name_ref(syms, ci, target.id)
+        return None
+
+    def _thread_classes(self) -> dict:
+        tc: dict = {q: set() for q in self.model.funcs}
+        for tclass, qual, _, _ in self.spawns:
+            tc.setdefault(qual, set()).add(tclass)
+        for q in self.model.funcs:
+            tail = q.rsplit(".", 1)[-1]
+            if not tail.startswith("_") or (
+                tail.startswith("__") and tail.endswith("__")
+            ):
+                tc[q].add(CALLER)
+            if tail.startswith("do_") and tail[3:].isupper():
+                # ThreadingHTTPServer runs each do_VERB in a per-request
+                # thread the Thread-spawn scan can't see — seed the API
+                # surface with its own class so server state shared with
+                # handlers registers as shared
+                tc[q].add(HTTP)
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.model.funcs.items():
+                mine = tc.get(q)
+                if not mine:
+                    continue
+                for _, callee, _ in fi.calls:
+                    if callee is None or callee == q:
+                        continue
+                    dst = tc.setdefault(callee, set())
+                    if not mine <= dst:
+                        dst |= mine
+                        changed = True
+        return {q: frozenset(s) for q, s in tc.items()}
+
+    def _entry_locks(self) -> dict:
+        """Greatest fixpoint: locks provably held at every resolved call
+        site of each function. ``None`` = no call site seen yet (⊤)."""
+        spawn_targets = {qual for _, qual, _, _ in self.spawns}
+        entry: dict = {}
+        for q in self.model.funcs:
+            tail = q.rsplit(".", 1)[-1]
+            public = not tail.startswith("_") or (
+                tail.startswith("__") and tail.endswith("__")
+            )
+            entry[q] = frozenset() if public or q in spawn_targets else None
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.model.funcs.items():
+                eq = entry.get(q)
+                if eq is None:
+                    continue
+                for held, callee, _ in fi.calls:
+                    if callee is None or callee == q:
+                        continue
+                    ctx = eq | frozenset(held)
+                    cur = entry.get(callee)
+                    new = ctx if cur is None else cur & ctx
+                    if new != cur:
+                        entry[callee] = new
+                        changed = True
+        return {q: (s if s is not None else frozenset()) for q, s in entry.items()}
+
+    # -- access collection ----------------------------------------------
+    def _collect_module(self, syms):
+        for node in syms.mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = syms.classes.get(node.name)
+            if ci is None:
+                continue
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{ci.qualname}.{meth.name}"
+                    for stmt in meth.body:
+                        self._walk_stmt(
+                            syms, ci, q, meth.name, stmt, frozenset()
+                        )
+
+    def _add(self, ci, fq, mname, line, attr, kind, locks, bool_write=False):
+        if self.model._class_lock(ci, attr) is not None:
+            return  # the lock itself is not racy state
+        self.accesses.setdefault((ci.qualname, attr), []).append(
+            Access(
+                func=fq,
+                method=mname,
+                line=line,
+                kind=kind,
+                locks=locks,
+                bool_write=bool_write,
+                in_init=mname in _INIT_METHODS,
+            )
+        )
+
+    def _reads(self, syms, ci, fq, mname, expr, held, kind="read"):
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                self._add(ci, fq, mname, node.lineno, node.attr, kind, held)
+
+    def _writes(self, syms, ci, fq, mname, tgt, value, held):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._writes(syms, ci, fq, mname, elt, None, held)
+            return
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            bool_write = isinstance(value, ast.Constant) and isinstance(
+                value.value, bool
+            )
+            self._add(
+                ci, fq, mname, tgt.lineno, tgt.attr, "write", held,
+                bool_write=bool_write,
+            )
+
+    def _walk_stmt(self, syms, ci, fq, mname, stmt, held):
+        model = self.model
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                lid = model._lock_of(syms, ci, item.context_expr)
+                if lid is not None:
+                    new_held = new_held | {lid}
+                else:
+                    self._reads(syms, ci, fq, mname, item.context_expr, held)
+            for s in stmt.body:
+                self._walk_stmt(syms, ci, fq, mname, s, new_held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs when invoked (thread target, callback) —
+            # never under the lexically enclosing lockset
+            nested = f"{fq}.<{stmt.name}>"
+            for s in stmt.body:
+                self._walk_stmt(syms, ci, nested, mname, s, frozenset())
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            # if-tests are check-then-act candidates; while-tests are
+            # daemon-loop polls — benign staleness, plain reads
+            kind = "check" if isinstance(stmt, ast.If) else "read"
+            self._reads(syms, ci, fq, mname, stmt.test, held, kind)
+            for s in stmt.body + stmt.orelse:
+                self._walk_stmt(syms, ci, fq, mname, s, held)
+            return
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._writes(syms, ci, fq, mname, tgt, stmt.value, held)
+                if isinstance(tgt, ast.Subscript):
+                    # ``self.d[k] = v`` mutates the container: a read of
+                    # the binding (container-content races are the
+                    # container's problem, not the binding's)
+                    self._reads(syms, ci, fq, mname, tgt, held)
+            self._reads(syms, ci, fq, mname, stmt.value, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            # += is a read-modify-write of the binding
+            self._writes(syms, ci, fq, mname, stmt.target, None, held)
+            if isinstance(stmt.target, ast.Subscript):
+                self._reads(syms, ci, fq, mname, stmt.target, held)
+            self._reads(syms, ci, fq, mname, stmt.value, held)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._writes(syms, ci, fq, mname, stmt.target, stmt.value, held)
+                self._reads(syms, ci, fq, mname, stmt.value, held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._reads(syms, ci, fq, mname, child, held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(syms, ci, fq, mname, child, held)
+            elif isinstance(child, ast.excepthandler):
+                for s in child.body:
+                    self._walk_stmt(syms, ci, fq, mname, s, held)
+
+    # -- the shared-state map -------------------------------------------
+    def effective(self, a: Access) -> frozenset:
+        """Site lockset plus locks provably held on entry."""
+        return a.locks | self.entry.get(a.func, frozenset())
+
+    def _shared_state(self) -> dict:
+        shared: dict = {}
+        for (cq, attr), accs in self.accesses.items():
+            if not any(a.kind == "write" and not a.in_init for a in accs):
+                continue
+            classes = set()
+            for a in accs:
+                classes |= self.tclasses.get(a.func, frozenset())
+            spawned = {c for c in classes if c != CALLER}
+            if len(classes) < 2 or not spawned:
+                continue
+            ci = self.model.classes.get(cq)
+            shared[(cq, attr)] = SharedAttr(
+                class_qual=cq,
+                attr=attr,
+                relpath=ci.relpath if ci is not None else "",
+                accesses=accs,
+                thread_classes=frozenset(classes),
+            )
+        return shared
+
+
+def build_race_model(project: Project) -> RaceModel:
+    model = getattr(project, "_race_model", None)
+    if model is None:
+        model = project._race_model = RaceModel(project)
+    return model
+
+
+def _sup(project: Project, relpath: str, rule: str, line: int) -> bool:
+    """True when ``rule`` is suppressed at this access site. Checked at
+    the ACCESS level (not just the finding's reported line) so an
+    inline ``# nta: ignore[...]`` on one write removes that write as
+    evidence everywhere — e.g. a pre-spawn publication site stops
+    feeding rule 1 without hiding genuinely racy sites of the same
+    attribute elsewhere."""
+    mi = project.by_path.get(relpath)
+    return mi is not None and mi.suppressed(rule, line)
+
+
+def _methods(accs) -> str:
+    return ", ".join(sorted({a.method for a in accs}))
+
+
+def _classes(rm: RaceModel, accs) -> str:
+    out: set = set()
+    for a in accs:
+        out |= rm.tclasses.get(a.func, frozenset())
+    return "/".join(sorted(out)) or "?"
+
+
+@register(
+    "unsynchronized-shared-write",
+    "an attribute shared across thread classes is written under an "
+    "empty lockset while another thread class reads or writes it",
+)
+def check_unsynchronized_shared_write(project: Project) -> list[Finding]:
+    rm = build_race_model(project)
+    findings = []
+    for (cq, attr), sa in sorted(rm.shared.items()):
+        writes = [
+            a for a in sa.accesses if a.kind == "write" and not a.in_init
+        ]
+        unlocked = [
+            w
+            for w in writes
+            if not rm.effective(w)
+            and not _sup(
+                project, sa.relpath, "unsynchronized-shared-write", w.line
+            )
+        ]
+        if not unlocked:
+            continue
+        # demand a second access SITE (a different method) such that the
+        # pair spans ≥2 thread classes with a spawned one — a lone
+        # method reachable from two classes is too weak (it flags every
+        # public helper a worker loop happens to share with tests)
+        w_methods = {w.method for w in unlocked}
+        other = [
+            a
+            for a in sa.accesses
+            if not a.in_init and a.method not in w_methods
+        ]
+        evidence = [
+            (w, a)
+            for w in unlocked
+            for a in other
+            if len(
+                rm.tclasses.get(w.func, frozenset())
+                | rm.tclasses.get(a.func, frozenset())
+            ) >= 2
+            and (
+                rm.tclasses.get(w.func, frozenset())
+                | rm.tclasses.get(a.func, frozenset())
+            ) - {CALLER}
+        ]
+        if not evidence:
+            continue
+        seen_ids: set = set()
+        other = []
+        for _, a in evidence:
+            if id(a) not in seen_ids:
+                seen_ids.add(id(a))
+                other.append(a)
+        other.sort(key=lambda a: (a.method, a.line))
+        findings.append(
+            Finding(
+                "unsynchronized-shared-write",
+                sa.relpath,
+                min(w.line for w in unlocked),
+                f"{cq}.{attr} written without a lock in "
+                f"{_methods(unlocked)} [{_classes(rm, unlocked)}] while "
+                f"accessed from {_methods(other)} "
+                f"[{_classes(rm, other)}] — take one lock on both sides",
+            )
+        )
+    return findings
+
+
+@register(
+    "inconsistent-lockset",
+    "two write sites guard the same shared attribute with disjoint "
+    "locksets — every write is locked, but no single lock protects the "
+    "attribute (the classic Eraser finding)",
+)
+def check_inconsistent_lockset(project: Project) -> list[Finding]:
+    rm = build_race_model(project)
+    findings = []
+    for (cq, attr), sa in sorted(rm.shared.items()):
+        locked = [
+            (a, rm.effective(a))
+            for a in sa.accesses
+            if a.kind == "write"
+            and not a.in_init
+            and rm.effective(a)
+            and not _sup(
+                project, sa.relpath, "inconsistent-lockset", a.line
+            )
+        ]
+        if len(locked) < 2:
+            continue
+        common = frozenset.intersection(*[ls for _, ls in locked])
+        if common:
+            continue
+        # name one concretely disjoint pair for the message
+        (a1, l1) = locked[0]
+        pair = next(
+            ((a2, l2) for a2, l2 in locked[1:] if not (l1 & l2)), None
+        )
+        if pair is None:
+            # pairwise-overlapping but no common lock: still no single
+            # protector; report against the first two
+            pair = locked[1]
+        (a2, l2) = pair
+        findings.append(
+            Finding(
+                "inconsistent-lockset",
+                sa.relpath,
+                min(a1.line, a2.line),
+                f"{cq}.{attr} written under {{{', '.join(sorted(l1))}}} "
+                f"in {a1.method} but under {{{', '.join(sorted(l2))}}} "
+                f"in {a2.method} — no common lock protects it",
+            )
+        )
+    return findings
+
+
+@register(
+    "unguarded-flag-check",
+    "a shared boolean written under a consistent lock is tested in an "
+    "``if`` outside that lock — check-then-act (the zombie-conn shape)",
+)
+def check_unguarded_flag_check(project: Project) -> list[Finding]:
+    rm = build_race_model(project)
+    findings = []
+    for (cq, attr), sa in sorted(rm.shared.items()):
+        writes = [
+            a for a in sa.accesses if a.kind == "write" and not a.in_init
+        ]
+        if not writes or not all(w.bool_write for w in writes):
+            continue
+        locksets = [rm.effective(w) for w in writes]
+        guard = frozenset.intersection(*locksets) if locksets else frozenset()
+        if not guard:
+            continue  # unlocked writes are rule 1's territory
+        bare = [
+            a
+            for a in sa.accesses
+            if a.kind == "check"
+            and not (rm.effective(a) & guard)
+            and not _sup(
+                project, sa.relpath, "unguarded-flag-check", a.line
+            )
+        ]
+        if not bare:
+            continue
+        findings.append(
+            Finding(
+                "unguarded-flag-check",
+                sa.relpath,
+                min(a.line for a in bare),
+                f"{cq}.{attr} is guarded by "
+                f"{{{', '.join(sorted(guard))}}} at every write but "
+                f"checked without it in {_methods(bare)} — check-then-act "
+                f"races the flag flip; test it under the lock",
+            )
+        )
+    return findings

@@ -2305,6 +2305,35 @@ class HTTPServer:
         # a non-positive heartbeat would turn the frame loop into a
         # client-controlled busy-spin on a server thread
         heartbeat = max(heartbeat, 0.1)
+        # brownout shed class for this stream: batch hangs up first,
+        # service next, system never (core/overload.py ladder). Explicit
+        # ?admission_class= wins; a numeric ?priority= maps through the
+        # same bands as eval shedding; default is service. Without an
+        # overload{} stanza nothing ever sheds — the knob is inert.
+        from ..core.overload import CLASSES as _ADM_CLASSES
+        from ..core.overload import CLASS_SERVICE, classify_priority
+
+        adm_class = (query.get("admission_class") or "").strip().lower()
+        if adm_class and adm_class not in _ADM_CLASSES:
+            handler._respond(
+                400,
+                {"error": f"unknown admission_class {adm_class!r}"},
+                None,
+            )
+            return
+        if not adm_class:
+            if query.get("priority"):
+                try:
+                    adm_class = classify_priority(int(query["priority"]))
+                except ValueError:
+                    handler._respond(
+                        400,
+                        {"error": "priority must be an integer"},
+                        None,
+                    )
+                    return
+            else:
+                adm_class = CLASS_SERVICE
         # the stream spans all namespaces the token can read unless the
         # caller narrows it; the subscribe-time gate below must evaluate
         # against the SAME scope the subscription will cover, so the
@@ -2385,7 +2414,12 @@ class HTTPServer:
             handler.end_headers()
             wfile.flush()
             self._detached_socks.add(handler.connection)
-            self._event_mux().serve(handler.connection, sub, heartbeat)
+            self._event_mux().serve(
+                handler.connection,
+                sub,
+                heartbeat,
+                admission_class=adm_class,
+            )
         except Exception:
             self._detached_socks.discard(handler.connection)
             sub.close()
@@ -2403,6 +2437,14 @@ class HTTPServer:
                 mux = self._stream_mux = StreamMux(
                     frame_batch=getattr(broker, "frame_batch", 64)
                 )
+                # hand the mux's shed switch to the server's brownout
+                # ladder; registration replays any already-degraded
+                # stream levels so a mux created mid-brownout sheds too
+                srv = self.server
+                if srv is not None and hasattr(
+                    srv, "add_stream_shed_hook"
+                ):
+                    srv.add_stream_shed_hook(mux.set_class_shed)
         return mux
 
     def _event_stream_ws(self, handler, sub, heartbeat):

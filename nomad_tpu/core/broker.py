@@ -215,6 +215,11 @@ class EvalBroker:
         self.subsequent_nack_delay = subsequent_nack_delay
 
         self.enabled = False
+        #: serializes enabled-state transitions: two concurrent
+        #: set_enabled calls must agree on who saw the enable->disable
+        #: edge (the flush trigger), or a toggle can double-flush or
+        #: skip the flush entirely
+        self._enabled_lock = threading.Lock()
         self._shards = [_Shard() for _ in range(max(1, int(ready_shards)))]
         # eval id -> owning shard (ack/nack/outstanding know only the id);
         # tiny critical section, written at first enqueue, dropped at ack
@@ -257,8 +262,9 @@ class EvalBroker:
 
     # ------------------------------------------------------------------
     def set_enabled(self, enabled: bool):
-        prev = self.enabled
-        self.enabled = enabled
+        with self._enabled_lock:
+            prev = self.enabled
+            self.enabled = enabled
         if prev and not enabled:
             self.flush()
         if enabled:

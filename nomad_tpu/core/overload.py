@@ -127,6 +127,7 @@ class ErrOverloaded(Exception):
 CLASS_BATCH = "batch"
 CLASS_SERVICE = "service"
 CLASS_SYSTEM = "system"
+CLASSES = (CLASS_BATCH, CLASS_SERVICE, CLASS_SYSTEM)
 
 
 def classify_priority(priority: int) -> str:
@@ -203,30 +204,40 @@ class AdmissionController:
         """Raise ``ErrOverloaded`` when ``cls`` should be shed now."""
         limit = self.threshold(cls)
         if limit is None:
-            self.admitted += 1
+            with self._lock:
+                # counter increments share the load-cache lock: admit()
+                # runs on every handler thread at once while stats() and
+                # the flight recorder read the totals (lost increments
+                # here silently understate shed rates — racedep-witnessed)
+                self.admitted += 1
             return
         load = self.load()
         if load >= limit:
-            self.shed[cls] += 1
+            with self._lock:
+                self.shed[cls] += 1
             metrics.incr(f"overload.shed.{cls}")
             raise ErrOverloaded(
                 f"server overloaded (load={load:.2f}); "
                 f"shedding {cls} work",
                 retry_after=self.retry_after_s,
             )
-        self.admitted += 1
+        with self._lock:
+            self.admitted += 1
 
     def shed_total(self) -> int:
-        return sum(self.shed.values())
+        with self._lock:
+            return sum(self.shed.values())
 
     def stats(self) -> dict:
-        return {
-            "load": self.load(),
-            "admitted": self.admitted,
-            "shed": dict(self.shed),
-            "shed_batch_at": self.shed_batch,
-            "shed_service_at": self.shed_service,
-        }
+        load = self.load()
+        with self._lock:
+            return {
+                "load": load,
+                "admitted": self.admitted,
+                "shed": dict(self.shed),
+                "shed_batch_at": self.shed_batch,
+                "shed_service_at": self.shed_service,
+            }
 
 
 # ---------------------------------------------------------------------------

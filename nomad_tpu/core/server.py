@@ -33,6 +33,9 @@ from ..structs.model import (
     EVAL_TRIGGER_JOB_REGISTER,
     EVAL_TRIGGER_NODE_UPDATE,
     EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+    JOB_MAX_PRIORITY,
+    JOB_MIN_PRIORITY,
+    JOB_TYPE_BATCH,
     JOB_TYPE_CORE,
     JOB_TYPE_SERVICE,
     JOB_TYPE_SYSTEM,
@@ -52,6 +55,7 @@ from .blocked_evals import BlockedEvals
 from .broker import EvalBroker, shared_timer_wheel
 from .deployment_watcher import DeploymentsWatcher, install_deployment_endpoints
 from .drainer import NodeDrainer
+from . import overload as overload_mod
 from .overload import OverloadController, current_deadline
 from .periodic import PeriodicDispatch, derive_dispatch_job
 from .fsm import FSM
@@ -174,6 +178,16 @@ class Server:
         # default deadline: byte-identical pre-overload behavior (the
         # A/B contract pinned by tests/test_overload.py)
         self.overload: Optional[OverloadController] = None
+        # stream-shed hooks: the HTTP layer's StreamMux registers its
+        # set_class_shed here (the core server doesn't own the HTTP
+        # plane — the CLI wires them, so this is a callback seam). With
+        # no overload plane the ladder never reaches the stream rungs
+        # and registered hooks are never invoked.
+        # nta: ignore[unbounded-cache] WHY: one registration per stream
+        # mux, and a server wires at most one HTTP layer — growth is
+        # O(process wiring), not O(traffic); hooks live for the server.
+        self._stream_shed_hooks: list = []
+        self._stream_shed_on: set = set()
         ov_cfg = dict(self.config.get("overload") or {})
         if ov_cfg and ov_cfg.get("enabled", True):
             self.overload = OverloadController(
@@ -1115,12 +1129,58 @@ class Server:
                     "snapshot_on_subscribe", True
                 )
 
+        def shed_batch_degrade():
+            self._shed_stream_class(overload_mod.CLASS_BATCH, True)
+
+        def shed_batch_restore():
+            self._shed_stream_class(overload_mod.CLASS_BATCH, False)
+
+        def shed_service_degrade():
+            self._shed_stream_class(overload_mod.CLASS_SERVICE, True)
+
+        def shed_service_restore():
+            self._shed_stream_class(overload_mod.CLASS_SERVICE, False)
+
         return [
             ("wavefront", wf_degrade, wf_restore),
             ("trace_sampling", trace_degrade, trace_restore),
             ("devprof_census", devprof_degrade, devprof_restore),
             ("snapshot_on_subscribe", snap_degrade, snap_restore),
+            # stream shedding rungs, most-sheddable class first; there is
+            # deliberately NO rung for system streams — deployment
+            # watchers and operator consoles ride out any brownout
+            ("stream_shed_batch", shed_batch_degrade, shed_batch_restore),
+            (
+                "stream_shed_service",
+                shed_service_degrade,
+                shed_service_restore,
+            ),
         ]
+
+    def add_stream_shed_hook(self, fn) -> None:
+        """Register ``fn(admission_class, shed)`` to receive stream-shed
+        transitions from the brownout ladder. A mux created while a
+        stream rung is already degraded gets the current state replayed
+        at registration, so mid-brownout adoptions shed too."""
+        self._stream_shed_hooks.append(fn)
+        for cls in sorted(self._stream_shed_on):
+            try:
+                fn(cls, True)
+            except Exception:
+                logger.exception("stream shed hook failed (%s)", cls)
+
+    def _shed_stream_class(self, admission_class: str, shed: bool) -> None:
+        if shed:
+            self._stream_shed_on.add(admission_class)
+        else:
+            self._stream_shed_on.discard(admission_class)
+        for fn in list(self._stream_shed_hooks):
+            try:
+                fn(admission_class, shed)
+            except Exception:
+                logger.exception(
+                    "stream shed hook failed (%s)", admission_class
+                )
 
     def eval_deadline_exceeded(self, ev: Evaluation, where: str):
         """Terminal deadline_exceeded outcome for ``ev``: one raft-applied
@@ -2092,6 +2152,29 @@ class Server:
             raise ValueError("job requires at least one task group")
         if job.type == JOB_TYPE_CORE:
             raise ValueError("job type cannot be core")
+        if not (JOB_MIN_PRIORITY <= job.priority <= JOB_MAX_PRIORITY):
+            # priority drives eval ordering AND overload admission
+            # classes; out-of-band values would make a user job outrank
+            # core GC or dodge shedding (ref structs.go Job.Validate)
+            raise ValueError(
+                f"job priority must be between {JOB_MIN_PRIORITY} "
+                f"and {JOB_MAX_PRIORITY}, got {job.priority}"
+            )
+        if job.periodic is not None and job.periodic.enabled:
+            if job.type != JOB_TYPE_BATCH:
+                # the dispatcher stamps child copies per tick; a periodic
+                # service would accrete immortal children (ref structs.go:
+                # periodic is batch-only)
+                raise ValueError(
+                    "periodic can only be used with batch jobs, got "
+                    f"type {job.type!r}"
+                )
+            if job.parameterized_job is not None:
+                # both are job factories; composing them is ambiguous
+                # (does the cron tick dispatch, or template a dispatch?)
+                raise ValueError(
+                    "a periodic job cannot also be parameterized"
+                )
         if job.is_periodic():
             # reject bad cron specs at admission: the dispatcher would
             # otherwise silently never launch (ref structs.go

@@ -90,18 +90,16 @@ _DTYPE_BYTES = {
 
 _lock = threading.Lock()
 
-#: (planner, shape_key, sharded, flavor) -> ledger entry
-# nta: ignore[unbounded-cache] WHY: keyed by the planners' bucketed
-# shape ladder — the same vocabulary that bounds the jit caches
+#: (planner, shape_key, sharded, flavor) -> ledger entry; keyed by the
+#: planners' bucketed shape ladder — the same vocabulary that bounds
+#: the jit caches (the analyzer sees the reset() eviction path, so no
+#: suppression is needed)
 _LEDGER: dict = {}
 
-#: per-planner dispatch/round accounting
-# nta: ignore[unbounded-cache] WHY: keyed by planner name — the
-# code-fixed PLANNER_JITS vocabulary
+#: per-planner dispatch/round accounting (planner-name keyed)
 _ROUNDS: dict = {}
 
 #: most recent dispatch signature per planner (span-tag lookup)
-# nta: ignore[unbounded-cache] WHY: one slot per planner name
 _LAST: dict = {}
 
 _TRANSFERS = {

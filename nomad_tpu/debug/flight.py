@@ -152,9 +152,12 @@ def sample_process(server) -> dict:
     if ov is not None:
         try:
             adm = ov.admission
-            sample["overload_load"] = round(adm.load(), 4)
-            sample["overload_admitted_total"] = adm.admitted
-            sample["overload_shed_total"] = adm.shed_total()
+            adm_stats = adm.stats()  # counters read under adm's lock
+            sample["overload_load"] = round(adm_stats["load"], 4)
+            sample["overload_admitted_total"] = adm_stats["admitted"]
+            sample["overload_shed_total"] = sum(
+                adm_stats["shed"].values()
+            )
             sample["overload_dl_exceeded_total"] = (
                 ov.deadline_exceeded_total()
             )
@@ -288,7 +291,8 @@ class FlightRecorder:
             try:
                 self.record()
             except Exception:  # one bad tick is data loss; a dead
-                self.errors += 1  # recorder is a blind incident
+                with self._lock:  # recorder is a blind incident; dump()
+                    self.errors += 1  # reads the count live
                 logger.exception("flight-recorder tick failed")
 
     # ------------------------------------------------------------------
@@ -313,11 +317,13 @@ class FlightRecorder:
     def dump(self) -> dict:
         """The bundle's ``flight.json`` payload: config + full ring."""
         samples = self.samples()
+        with self._lock:  # _run increments errors under the same lock
+            errors = self.errors
         return {
             "interval_s": self.interval,
             "retain": self.retain,
             "recorded": len(samples),
-            "errors": self.errors,
+            "errors": errors,
             "span_s": (
                 round(samples[-1]["t"] - samples[0]["t"], 2)
                 if len(samples) >= 2

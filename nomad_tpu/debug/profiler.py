@@ -167,9 +167,15 @@ class SamplingProfiler:
             except Exception:
                 # a sampler tick must never kill the sampler (frames can
                 # disappear mid-walk); one lost tick is one lost sample
+                # nta: ignore[unsynchronized-shared-write] WHY: report()
+                # is join-ordered after stop() (class docstring) — the
+                # "caller" reader cannot run concurrently with the
+                # sampler thread
                 self._dropped += 1
 
     def _tick(self, me: int):
+        # nta: ignore[unsynchronized-shared-write] WHY: report() is
+        # join-ordered after stop() — no concurrent reader
         self._ticks += 1
         names = {t.ident: t.name for t in threading.enumerate()}
         for ident, frame in sys._current_frames().items():
@@ -200,6 +206,8 @@ class SamplingProfiler:
                 for fn, func in stack
                 for suffix, name_ in _APPLIER_WAIT
             ):
+                # nta: ignore[unsynchronized-shared-write] WHY: report()
+                # is join-ordered after stop() — no concurrent reader
                 self._applier_blocked += 1
             folded = f"{cls}:{fold_name(name)};" + ";".join(
                 f"{_short(fn)}:{func}" for fn, func in reversed(stack)
@@ -209,6 +217,8 @@ class SamplingProfiler:
             elif len(self._folded) < self.max_stacks:
                 self._folded[folded] = 1
             else:
+                # nta: ignore[unsynchronized-shared-write] WHY: report()
+                # is join-ordered after stop() — no concurrent reader
                 self._dropped += 1
 
     # ------------------------------------------------------------------

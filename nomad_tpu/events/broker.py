@@ -418,6 +418,17 @@ class Subscription:
         if on_ready is not None:
             on_ready()
 
+    def shed(self, reason: str):
+        """Server-initiated resumable close (the brownout stream-shed
+        path, events/mux.py): the final Error frame advertises THIS
+        subscriber's own delivered index, so a reconnect with
+        ``?index=<that>`` resumes exactly after the last frame it
+        drained — strictly tighter than the slow-consumer close's
+        ring-floor resume (the shed client isn't behind)."""
+        with self._cond:
+            resume = self.delivered_index
+        self._close(reason, resume)
+
     def _close(self, reason: str, resume_index: int):
         with self._cond:
             if self._closed:
@@ -444,6 +455,7 @@ class Subscription:
                 )
                 if self._queue:
                     kind, a, b = self._queue.popleft()
+                    self._advance_locked(((kind, a, b),))
                 elif self._closed:
                     raise SubscriptionClosedError(
                         self._close_reason or "subscription closed",
@@ -452,30 +464,38 @@ class Subscription:
                 else:
                     return None
             if kind == _EV:
-                if a.index > self.delivered_index:
-                    self.delivered_index = a.index
                 return (a.index, [a.events[i] for i in b])
             if kind == _GAP:
-                if a > self.delivered_index:
-                    self.delivered_index = a
                 return (a, None)
             if kind == _SNAP:
                 return (a, list(b))
             # _SNAP_END: zero-width marker for the wire tiers; in-proc
             # consumers skip it (don't re-wait the full timeout)
-            if a > self.delivered_index:
-                self.delivered_index = a
             timeout = 0
 
+    def _advance_locked(self, entries):
+        """Advance the lag tap for drained ``entries`` — caller holds
+        ``self._cond``. The advance used to ride the wire-encode path
+        OUTSIDE the lock, so ``lag_stats`` (another thread) could read a
+        torn view of a subscriber's progress; the racegraph/racedep plane
+        pinned the write under the queue's own lock."""
+        for kind, a, _ in entries:
+            if kind == _EV:
+                idx = a.index
+            elif kind in (_GAP, _SNAP_END):
+                idx = a
+            else:
+                continue
+            if idx > self.delivered_index:
+                self.delivered_index = idx
+
     def _entry_wire(self, entry) -> bytes:
+        """Pure wire encoder — no state updates (encoding happens outside
+        ``_cond``; see ``_advance_locked``)."""
         kind, a, b = entry
         if kind == _EV:
-            if a.index > self.delivered_index:
-                self.delivered_index = a.index
             return a.wire_for(b)
         if kind == _GAP:
-            if a > self.delivered_index:
-                self.delivered_index = a
             return b'{"LostGap":true,"Index":%d}\n' % a
         if kind == _SNAP:
             return b"".join(
@@ -485,8 +505,6 @@ class Subscription:
                     b"]}\n",
                 )
             )
-        if a > self.delivered_index:
-            self.delivered_index = a
         return b'{"SnapshotDone":true,"Index":%d}\n' % a
 
     def _error_wire(self) -> bytes:
@@ -505,6 +523,7 @@ class Subscription:
             n = min(len(self._queue), max_entries)
             entries = [self._queue.popleft() for _ in range(n)]
             done = self._closed and not self._queue
+            self._advance_locked(entries)
         chunks = [self._entry_wire(e) for e in entries]
         if done:
             chunks.append(self._error_wire())
@@ -523,6 +542,7 @@ class Subscription:
             n = min(len(self._queue), max_entries)
             entries = [self._queue.popleft() for _ in range(n)]
             done = self._closed and not self._queue
+            self._advance_locked(entries)
         lines = [self._entry_wire(e) for e in entries]
         if done:
             lines.append(self._error_wire())
@@ -750,10 +770,10 @@ class EventBroker:
                 )
             for f in replay:
                 sub._offer(f)
-            # nta: ignore[subscriber-eviction] WHY: admission is cap-gated
-            # (max_subscribers, above); eviction runs on the delivery path
-            # (_close_slow on overflow) and on consumer close
-            # (unsubscribe), not at the registration site.
+            # admission is cap-gated (max_subscribers, above); eviction
+            # runs on the delivery path (_close_slow on overflow) and on
+            # consumer close (unsubscribe) — both visible to the
+            # subscriber-eviction rule, so no suppression is needed here
             self._subs.append(sub)
         if snap is not None:
             events = self._snapshot_events(snap, norm)

@@ -59,6 +59,7 @@ class _Conn:
         "fd",
         "sub",
         "heartbeat",
+        "admission_class",
         "out",
         "last_tx",
         "closing",
@@ -66,11 +67,17 @@ class _Conn:
         "want_write",
     )
 
-    def __init__(self, sock, sub, heartbeat: float):
+    def __init__(
+        self, sock, sub, heartbeat: float, admission_class: str = "service"
+    ):
         self.sock = sock
         self.fd = sock.fileno()
         self.sub = sub
         self.heartbeat = heartbeat
+        #: overload shedding class (core/overload.py CLASS_*): the
+        #: brownout ladder hangs up batch streams first, service next,
+        #: system never
+        self.admission_class = admission_class
         self.out = bytearray()
         self.last_tx = time.monotonic()
         #: the terminal chunk is queued; drop once the buffer drains
@@ -113,17 +120,37 @@ class StreamMux:
         self._thread: threading.Thread | None = None
         self.served = 0
         self.dropped = 0
+        #: admission classes currently being shed (brownout); guarded by
+        #: _lock, read per adopted conn (never snapshotted across a
+        #: loop — a restore racing an adoption must win)
+        self._shed_classes: set = set()
+        #: newly-shed classes awaiting a disconnect sweep (pump-drained)
+        self._shed_req: deque = deque()
+        #: streams hung up by the shed policy, per class (under _lock)
+        # nta: ignore[unbounded-cache] WHY: keyed by admission class —
+        # at most the three fixed CLASS_* values, not per-subscriber.
+        self.shed_streams: dict = {}
 
     # ------------------------------------------------------------------
-    def serve(self, sock, sub, heartbeat: float = 10.0):
+    def serve(
+        self,
+        sock,
+        sub,
+        heartbeat: float = 10.0,
+        admission_class: str = "service",
+    ):
         """Adopt ``sock`` (response headers already written and flushed)
         and pump ``sub``'s frames to it until either side closes. Returns
-        immediately; the caller must not touch the socket again."""
+        immediately; the caller must not touch the socket again.
+        ``admission_class`` places the stream in the brownout shed order
+        (batch first, service next, system never)."""
         sock.setblocking(False)
         # honor the client's requested cadence (the HTTP layer already
         # floors it at 0.1s); the pump's wait adapts below, so a fast
         # heartbeat costs extra wakeups only while such a conn exists
-        conn = _Conn(sock, sub, max(0.1, float(heartbeat)))
+        conn = _Conn(
+            sock, sub, max(0.1, float(heartbeat)), admission_class
+        )
         with self._lock:
             if self._stop.is_set():
                 # a stream that raced the shutdown: adopting it would
@@ -183,7 +210,8 @@ class StreamMux:
                 self._wake.clear()
             try:
                 now = time.monotonic()
-                self._admit()
+                self._admit(now)
+                self._shed_pass()
                 self._poll(now)
                 self._drain_dirty(now)
                 self._heartbeats(now)
@@ -191,7 +219,7 @@ class StreamMux:
                 logger.exception("stream mux tick failed")  # silent stall
         self._teardown()
 
-    def _admit(self):
+    def _admit(self, now: float):
         while self._adds:
             conn = self._adds.popleft()
             self._conns[conn.fd] = conn
@@ -199,6 +227,79 @@ class StreamMux:
                 self._sel.register(conn.sock, selectors.EVENT_READ, conn)
             except (ValueError, OSError):
                 self._drop(conn, "register")
+                continue
+            # the shed check reads the live set per conn, NOT a snapshot
+            # taken at loop entry: a conn appended while this loop runs
+            # (serve() is any-thread) may postdate a restore — judging
+            # it by a pre-restore snapshot would hang up a legitimately
+            # re-admitted stream
+            with self._lock:
+                shed_now = conn.admission_class in self._shed_classes
+            if shed_now:
+                # adopted mid-brownout: hang up with the resumable close
+                # frame rather than silently serving a class the ladder
+                # already disconnected — the client sees the same Error
+                # frame either way and retries after the storm
+                self._shed_conn(conn)
+            # service unconditionally at admission: a _drain_dirty pass
+            # that ran between serve()'s parking of this conn and this
+            # admit pops the conn's dirty entry but skips the (not yet
+            # admitted) conn — and a publish that raced into that
+            # dirty=True window appended no second entry, so its frames
+            # would wait for the NEXT publish to re-notify. An empty
+            # queue makes this a no-op take_wire.
+            self._service(conn, now)
+
+    # ------------------------------------------------------------------
+    # brownout stream shedding (core/overload.py ladder actions)
+    # ------------------------------------------------------------------
+    def set_class_shed(self, admission_class: str, shed: bool):
+        """Brownout hook (any thread): ``shed=True`` hangs up every live
+        stream of ``admission_class`` with the resumable close frame and
+        keeps shedding new adoptions of that class until ``shed=False``.
+        Restore only stops FUTURE shedding — a hung-up client reconnects
+        on its own (the Error frame carries its resume index)."""
+        with self._lock:
+            if shed:
+                self._shed_classes.add(admission_class)
+            else:
+                self._shed_classes.discard(admission_class)
+        if shed:
+            # the disconnect sweep runs on the pump thread (selector and
+            # _conns are pump-owned); a mux with no pump has no conns
+            # nta: ignore[subscriber-eviction] WHY: a hand-off queue the
+            # pump drains to empty every tick (_shed_pass popleft);
+            # bounded by brownout transitions, not subscriber count.
+            self._shed_req.append(admission_class)
+            self._wake.set()
+
+    def _shed_pass(self):
+        while self._shed_req:
+            cls = self._shed_req.popleft()
+            for conn in list(self._conns.values()):
+                if conn.admission_class == cls and not conn.closing:
+                    self._shed_conn(conn)
+
+    def _shed_conn(self, conn: _Conn):
+        """Pump-thread only: resumable-close ``conn``'s subscription.
+        The close wakes the dirty path (sub._on_ready → _notify), the
+        next service drains the final Error frame + last chunk, and the
+        flush drops the connection — the normal teardown, just
+        server-initiated."""
+        from .. import metrics
+
+        with self._lock:
+            # nta: ignore[subscriber-eviction] WHY: a per-class counter
+            # map with at most three keys (the fixed admission classes),
+            # not a per-subscriber registry.
+            self.shed_streams[conn.admission_class] = (
+                self.shed_streams.get(conn.admission_class, 0) + 1
+            )
+        metrics.incr(f"overload.shed.stream_{conn.admission_class}")
+        conn.sub.shed(
+            "subscription closed: stream shed by brownout "
+            f"({conn.admission_class})"
+        )
 
     def _poll(self, now: float):
         """Selector pass: client hangups (readable with EOF/error) and
@@ -298,7 +399,10 @@ class StreamMux:
         if self._conns.get(conn.fd) is not conn:
             return  # already dropped (or the fd was reused by a new conn)
         self._conns.pop(conn.fd, None)
-        self.dropped += 1
+        with self._lock:
+            # the adoption lock also guards the counters: stats() reads
+            # them from arbitrary threads while the pump drops conns
+            self.dropped += 1
         conn.sub._on_ready = None
         try:
             self._sel.unregister(conn.sock)
@@ -316,18 +420,21 @@ class StreamMux:
             logger.exception("stream mux: subscription close failed (%s)", why)
 
     def _teardown(self):
-        self._admit()
+        self._admit(time.monotonic())
         for conn in list(self._conns.values()):
             self._drop(conn, "shutdown")
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:
-        return {
-            "connections": len(self._conns),
-            "served": self.served,
-            "dropped": self.dropped,
-            "pending_adds": len(self._adds),
-        }
+        with self._lock:
+            return {
+                "connections": len(self._conns),
+                "served": self.served,
+                "dropped": self.dropped,
+                "pending_adds": len(self._adds),
+                "shed_classes": sorted(self._shed_classes),
+                "shed_streams": dict(self.shed_streams),
+            }
 
     def stop(self):
         with self._lock:

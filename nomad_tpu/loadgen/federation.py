@@ -420,6 +420,10 @@ class FederatedCluster:
                     gossip_seeds.append(list(fs.agent.server.gossip.addr))
                 if region == self.auth_region and i == 0:
                     boot = fs.agent.server.acl_bootstrap()
+                    # nta: ignore[unsynchronized-shared-write] WHY: set
+                    # during cluster start, before the chaos executor
+                    # (the only cross-thread reader) is spawned —
+                    # pre-spawn publication
                     self.mgmt_token = boot.secret_id
 
     def wait_ready(self, timeout: float = 30.0):
@@ -708,6 +712,8 @@ class ChaosExecutor:
         )
 
     def start(self, t0: float):
+        # nta: ignore[unsynchronized-shared-write] WHY: written before
+        # the thread spawn on the next line — pre-spawn publication
         self._t0 = t0
         self._thread.start()
 
@@ -895,6 +901,8 @@ class FederationScorekeeper:
         )
 
     def start(self, t0: float):
+        # nta: ignore[unsynchronized-shared-write] WHY: written before
+        # the thread spawn on the next line — pre-spawn publication
         self._t0 = t0
         self._thread.start()
 

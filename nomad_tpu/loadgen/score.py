@@ -166,6 +166,9 @@ class Scorekeeper:
 
     # ------------------------------------------------------------------
     def start(self):
+        # nta: ignore[unsynchronized-shared-write] WHY: written before
+        # the scorekeeper thread spawns below — Thread.start() is the
+        # happens-before edge (pre-spawn publication)
         self._t0 = time.monotonic()
         # exactly ONE driver for the shared ring: while the scorekeeper
         # ticks record() at the storm cadence, the server recorder's own

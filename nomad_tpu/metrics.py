@@ -14,8 +14,9 @@ _LOCK = threading.Lock()
 _COUNTERS: dict[str, float] = {}
 _TIMERS: dict[str, list[float]] = {}
 _HISTS: dict[str, dict[int, int]] = {}
-# nta: ignore[unbounded-cache] WHY: keyed by metric name (code-bounded);
-# each entry is a bounded deque of the last few exemplar links
+# keyed by metric name (code-bounded); each entry is a bounded deque of
+# the last few exemplar links — reset() clears it, which the
+# unbounded-cache rule sees, so no suppression is needed
 _EXEMPLARS: dict[str, list] = {}
 
 TIMER_WINDOW = 512  # samples retained per timer

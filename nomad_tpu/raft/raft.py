@@ -160,20 +160,23 @@ class Raft:
 
     # ------------------------------------------------------------------
     def _restore_on_boot(self):
+        # The per-line ignores below share one WHY: this runs during
+        # construction, before start() spawns any raft thread —
+        # pre-spawn publication (Thread.start() is the h-b edge).
         snap = self.snapshots.latest()
         if snap is not None:
             self.fsm.restore(snap.data)
-            self.last_snapshot_index = snap.last_index
-            self.last_snapshot_term = snap.last_term
-            self.commit_index = snap.last_index
-            self.last_applied = snap.last_index
+            self.last_snapshot_index = snap.last_index  # nta: ignore[unsynchronized-shared-write]
+            self.last_snapshot_term = snap.last_term  # nta: ignore[unsynchronized-shared-write]
+            self.commit_index = snap.last_index  # nta: ignore[unsynchronized-shared-write]
+            self.last_applied = snap.last_index  # nta: ignore[unsynchronized-shared-write]
             if snap.voters:
-                self.voters = dict(snap.voters)
+                self.voters = dict(snap.voters)  # nta: ignore[unsynchronized-shared-write]
         # adopt the newest CONFIG entry in the log, if any
         for i in range(self.log.first_index(), self.log.last_index() + 1):
             e = self.log.get(i)
             if e is not None and e.etype == CONFIG:
-                self.voters = dict(e.data["voters"])
+                self.voters = dict(e.data["voters"])  # nta: ignore[unsynchronized-shared-write]
 
     def start(self):
         t = threading.Thread(target=self._run, daemon=True, name=f"raft-{self.node_id}")

@@ -290,6 +290,17 @@ def contention() -> dict:
         }
 
 
+def held_sites() -> tuple:
+    """Allocation sites of the locks THIS thread currently holds,
+    innermost last — the lockset the racedep witness (racedep.py)
+    intersects per shared-attribute access. Thread-local read: no
+    lock, O(held depth), safe on any access path."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return ()
+    return tuple(entry[0]._site for entry in held)
+
+
 def edges() -> dict:
     """Snapshot of observed (site_a, site_b) -> witness."""
     with _graph_lock:

@@ -1,0 +1,322 @@
+"""Runtime data-race witness: Eraser locksets over watched attributes.
+
+The static pass (``nomad_tpu/analysis/racegraph.py``) derives which
+shared attributes CAN race; this witness watches what threads ACTUALLY
+do to a curated set of those attributes under tier-1 and applies the
+Eraser lockset discipline (Savage et al., SOSP '97) per
+``(instance, attribute)``:
+
+- **virgin → exclusive** — the first accessing thread owns the value;
+  no lockset refinement (initialization-before-publication is legal);
+- **exclusive → shared** — a second thread touches it: the candidate
+  lockset ``C(v)`` starts as the locks that thread holds *right now*
+  (read from lockdep's per-thread held stack — the two witnesses share
+  one ground truth, keyed by lock allocation site);
+- **shared** — every access refines ``C(v) ∩= held``; when the
+  attribute has been written in the shared state and ``C(v)`` goes
+  empty, that is a race: no single lock protected every access.
+
+Mechanics: :func:`install` patches each watched class's
+``__setattr__`` (write witnessing on every assignment to a watched
+attribute) and installs a property over each *declared hot read*
+attribute (read witnessing at, e.g., a stats()/dump() site). Watched
+classes must use instance ``__dict__`` storage — ``__slots__`` classes
+(e.g. the mux's ``_Conn``) are not instrumentable this way and are
+excluded by construction.
+
+Scope decisions (documented, deliberate):
+
+- races are RECORDED, never raised from the access path (raising inside
+  arbitrary attribute writes can corrupt the code under test); the
+  tier-1 conftest asserts ``races() == []`` after every test,
+  mirroring the lockdep guard;
+- both sides of a race are captured: the previous write's
+  thread/location line (kept per attribute at every write — one frame
+  walk, cheap) and the detecting access's full stack;
+- one report per ``(class, attribute)`` — after the first race the
+  record is parked so a hot racy counter cannot flood the report or
+  tax the run;
+- write-only watching (no read property) is for attributes whose
+  unlocked reads are *deliberate* benign staleness (e.g. the broker's
+  ``lag_stats`` sampling ``delivered_index``): the witness then checks
+  that writes stay under a consistent lockset without indicting the
+  sanctioned dirty reads.
+
+Enable AFTER the watched modules import (the classes must exist) and
+ideally with lockdep installed first — without lockdep every held
+lockset reads empty and any second-thread write looks like a race.
+``tests/conftest.py`` wires both; opt out with ``NOMAD_TPU_RACEDEP=0``.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+import traceback
+
+from . import lockdep
+
+#: raw lock guarding the shared-state transitions and the race report
+#: list (never held across anything blocking)
+_state_lock = _thread.allocate_lock()
+
+#: human-readable race reports, in observation order
+_races: list = []
+#: (class_qual, attr) already reported — dedupe + parking
+_reported: set = set()
+
+#: Eraser states (virgin is "no record yet")
+_EXCLUSIVE = 0
+_SHARED_READ = 1
+_SHARED_MOD = 2
+
+_installed = False
+#: cls -> (orig __setattr__, {attr: orig class attr or _MISSING}) for
+#: uninstall
+_patched: dict = {}
+
+_MISSING = object()
+
+#: the instance-state slot name (stored via object.__setattr__, so the
+#: patched __setattr__ never recurses through it)
+_STATE = "_racedep_state"
+
+
+def _class_qual(cls) -> str:
+    mod = cls.__module__ or ""
+    if mod.startswith("nomad_tpu."):
+        mod = mod[len("nomad_tpu.") :]
+    return f"{mod}.{cls.__qualname__}"
+
+
+def _where() -> str:
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename.replace(os.sep, "/").rsplit("/", 1)[-1]
+    return (
+        f"{threading.current_thread().name} at "
+        f"{fn}:{f.f_lineno} ({f.f_code.co_name})"
+    )
+
+
+def _stack() -> str:
+    out = []
+    for line in traceback.format_stack(sys._getframe(2)):
+        if __file__ in line:
+            continue
+        out.append(line.rstrip())
+    return "\n".join(out[-12:])
+
+
+def _note(obj, cls_qual: str, attr: str, is_write: bool):
+    """One witnessed access. Fast paths (virgin, exclusive-owner) touch
+    only the per-instance record; shared-state refinement and race
+    recording serialize on ``_state_lock``."""
+    state = obj.__dict__.get(_STATE)
+    if state is None:
+        state = {}
+        object.__setattr__(obj, _STATE, state)
+    ident = _thread.get_ident()
+    rec = state.get(attr)
+    if rec is None:
+        # virgin → exclusive: first accessor owns it, no refinement
+        state[attr] = [
+            _EXCLUSIVE,
+            ident,
+            None,
+            _where() if is_write else None,
+        ]
+        return
+    if rec[0] == _EXCLUSIVE and rec[1] == ident:
+        if is_write:
+            rec[3] = _where()
+        return
+    if (cls_qual, attr) in _reported:
+        return  # parked: one report per (class, attr)
+    held = frozenset(lockdep.held_sites())
+    with _state_lock:
+        if rec[0] == _EXCLUSIVE:
+            # second thread: C(v) starts as what it holds right now
+            rec[0] = _SHARED_MOD if is_write else _SHARED_READ
+            rec[2] = held
+        else:
+            rec[2] = rec[2] & held
+            if is_write:
+                rec[0] = _SHARED_MOD
+        racy = rec[0] == _SHARED_MOD and not rec[2]
+        if racy and (cls_qual, attr) not in _reported:
+            _reported.add((cls_qual, attr))
+            prev = rec[3] or "<no prior write witnessed>"
+            _races.append(
+                f"data race on {cls_qual}.{attr}: lockset empty at "
+                f"{_where()} (previous write: {prev})\n"
+                f"  access stack:\n{_stack()}"
+            )
+        if is_write:
+            rec[3] = _where()
+
+
+def _make_setattr(cls, watched: frozenset):
+    orig = cls.__setattr__
+
+    def __setattr__(self, name, value):
+        if name in watched:
+            _note(self, _class_qual(cls), name, True)
+        orig(self, name, value)
+
+    __setattr__._racedep = True
+    return __setattr__
+
+
+def _make_read_property(cls, attr: str):
+    """Data descriptor witnessing reads of ``attr``; storage stays in
+    the instance ``__dict__`` (the property outranks it for lookups,
+    but writes go through the patched ``__setattr__`` → ``fset``)."""
+    qual = _class_qual(cls)
+
+    def fget(self):
+        try:
+            value = self.__dict__[attr]
+        except KeyError:
+            raise AttributeError(attr) from None
+        _note(self, qual, attr, False)
+        return value
+
+    def fset(self, value):
+        # the write was already noted by the patched __setattr__ (every
+        # ``obj.attr = v`` routes through it before reaching fset)
+        self.__dict__[attr] = value
+
+    return property(fget, fset)
+
+
+def watch_class(cls, write_attrs, read_attrs=()):
+    """Instrument ``cls``: witness writes to ``write_attrs`` (plus
+    ``read_attrs`` — every read attr is write-witnessed too) and reads
+    of ``read_attrs``. Idempotent per class; used by :func:`install`
+    for the default watchlist and directly by provocation tests."""
+    if cls in _patched:
+        return
+    if getattr(cls, "__slots__", None) is not None:
+        raise TypeError(
+            f"{cls.__qualname__} uses __slots__ — racedep needs "
+            "instance __dict__ storage"
+        )
+    watched = frozenset(write_attrs) | frozenset(read_attrs)
+    saved: dict = {}
+    for attr in read_attrs:
+        saved[attr] = cls.__dict__.get(attr, _MISSING)
+        setattr(cls, attr, _make_read_property(cls, attr))
+    orig_setattr = cls.__dict__.get("__setattr__", _MISSING)
+    cls.__setattr__ = _make_setattr(cls, watched)
+    _patched[cls] = (orig_setattr, saved)
+
+
+def unwatch_class(cls):
+    """Remove instrumentation from one class (test cleanup for ad-hoc
+    :func:`watch_class` targets). No-op when the class isn't watched."""
+    if cls in _patched:
+        _unwatch_class(cls)
+
+
+def _unwatch_class(cls):
+    orig_setattr, saved = _patched.pop(cls)
+    if orig_setattr is _MISSING:
+        del cls.__setattr__
+    else:
+        cls.__setattr__ = orig_setattr
+    for attr, orig in saved.items():
+        if orig is _MISSING:
+            delattr(cls, attr)
+        else:
+            setattr(cls, attr, orig)
+
+
+def _default_watchlist():
+    """The curated tier-1 set: attributes the racegraph proved shared
+    across thread classes, fixed in this plane, and cheap to witness.
+    Imported lazily so racedep itself stays import-light."""
+    from ..core.broker import EvalBroker
+    from ..core.overload import AdmissionController
+    from ..debug.flight import FlightRecorder
+    from ..events.broker import Subscription
+    from ..events.mux import StreamMux
+
+    return [
+        # admit() counters: handler threads write, stats()/flight read
+        (AdmissionController, ("admitted",), ("admitted",)),
+        # pump-thread counters vs stats() readers
+        (StreamMux, ("dropped", "served"), ("dropped",)),
+        # write-only: lag_stats() reads are sanctioned benign staleness
+        (Subscription, ("delivered_index", "_closed"), ()),
+        # write-only: enabled reads are deliberate dirty checks; the
+        # set_enabled transition itself must stay under _enabled_lock
+        (EvalBroker, ("enabled",), ()),
+        # sampler-thread error count vs dump()
+        (FlightRecorder, ("errors",), ("errors",)),
+    ]
+
+
+def install():
+    """Instrument the default watchlist. Instances created before
+    install still witness (state rides the instance lazily); attributes
+    set before install simply start their Eraser life at the next
+    access."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    for cls, w, r in _default_watchlist():
+        watch_class(cls, w, r)
+
+
+def uninstall():
+    global _installed
+    if not _installed and not _patched:
+        return
+    _installed = False
+    for cls in list(_patched):
+        _unwatch_class(cls)
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset():
+    """Drop recorded races and reporting state (test isolation). The
+    per-instance Eraser records live on the instances and die with
+    them."""
+    with _state_lock:
+        del _races[:]
+        _reported.clear()
+
+
+def races() -> list:
+    with _state_lock:
+        return list(_races)
+
+
+def race_count() -> int:
+    return len(_races)
+
+
+def race_keys() -> list:
+    """The ``(class_qual, attr)`` identity keys of every recorded race
+    — what the static cross-validation joins on."""
+    with _state_lock:
+        return sorted(_reported)
+
+
+def check():
+    """Raise AssertionError when any race has been observed."""
+    r = races()
+    if r:
+        raise AssertionError(
+            "racedep observed data races:\n" + "\n\n".join(r)
+        )

@@ -4,7 +4,31 @@
 # exit 1 = new findings (printed as JSON); exit 2 = analyzer error.
 # Extra args pass through, e.g.:
 #   scripts/analyze.sh --rules lock-order-cycle nomad_tpu/tpu/
+#
+# --changed (must be first) limits findings to files touched in the
+# working tree / index vs HEAD — the pre-commit loop: analyze only what
+# you are about to ship. The whole tree is still PARSED (call graphs
+# and lock orders cross file boundaries); only the findings are
+# filtered, so a cross-file finding anchored in an untouched file still
+# needs the full run (CI does both).
 set -eu
 
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--changed" ]; then
+    shift
+    changed=$(
+        {
+            git diff --name-only HEAD -- 'nomad_tpu/*.py' 'nomad_tpu/**/*.py'
+            git diff --name-only --cached -- 'nomad_tpu/*.py' 'nomad_tpu/**/*.py'
+        } | sort -u
+    )
+    if [ -z "$changed" ]; then
+        echo "analyze.sh --changed: no modified nomad_tpu .py files" >&2
+        exit 0
+    fi
+    # shellcheck disable=SC2086
+    exec python -m nomad_tpu.analysis --format json "$@" $changed
+fi
+
 exec python -m nomad_tpu.analysis --format json "$@"

@@ -43,6 +43,16 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Runtime data-race witness (nomad_tpu/testing/racedep.py): Eraser
+# locksets over a curated set of shared attributes, keyed to lockdep's
+# per-thread held stacks — installed AFTER lockdep (it reads lockdep's
+# held sites) and after the watched modules import. Disable with
+# NOMAD_TPU_RACEDEP=0 to bisect witness overhead.
+from nomad_tpu.testing import racedep  # noqa: E402
+
+if os.environ.get("NOMAD_TPU_RACEDEP", "1") != "0":
+    racedep.install()
+
 
 @pytest.fixture(autouse=True)
 def _lockdep_guard():
@@ -54,3 +64,14 @@ def _lockdep_guard():
     yield
     now = lockdep.violations()
     assert len(now) == before, "\n".join(now[before:])
+
+
+@pytest.fixture(autouse=True)
+def _racedep_guard():
+    """Fail the test during which a data race was first witnessed —
+    same contract as the lockdep guard: tier-1 passes only with zero
+    observed races on the watched attributes."""
+    before = racedep.race_count()
+    yield
+    now = racedep.races()
+    assert len(now) == before, "\n\n".join(now[before:])

@@ -1,0 +1,170 @@
+"""Job endpoint validation port (ref nomad/job_endpoint_test.go
+TestJobEndpoint_Register_* validation slices + structs_test.go
+TestJob_Validate).
+
+Admission-time rejection contract: a malformed job never reaches the
+raft log — ``_validate_job`` raises before ``_apply``, so a bad submit
+costs nothing cluster-wide and the submitter gets the precise reason.
+The cases here mirror the upstream validation set that this repo
+implements: identity/type basics, the priority band (which also feeds
+the overload admission classes — see core/overload.classify_priority),
+the periodic constraints (batch-only, cron-validated, exclusive with
+parameterized), and task-group shape.
+"""
+
+import pytest
+
+import nomad_tpu.mock as mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.raft import InmemTransport, RaftConfig
+from nomad_tpu.structs.model import (
+    JOB_MAX_PRIORITY,
+    JOB_MIN_PRIORITY,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SERVICE,
+    ParameterizedJobConfig,
+    PeriodicConfig,
+)
+
+validate = Server._validate_job
+
+
+class TestValidateBasics:
+    def test_valid_job_passes(self):
+        validate(mock.job())
+        validate(mock.batch_job())
+        validate(mock.system_job())
+        validate(mock.periodic_job())
+
+    def test_missing_id_rejected(self):
+        j = mock.job()
+        j.id = ""
+        with pytest.raises(ValueError, match="missing job ID"):
+            validate(j)
+
+    def test_no_task_groups_rejected_unless_stop(self):
+        j = mock.job()
+        j.task_groups = []
+        with pytest.raises(ValueError, match="at least one task group"):
+            validate(j)
+        # a stop-submit is a tombstone, not a spec: shape checks waived
+        j.stop = True
+        validate(j)
+
+    def test_core_type_rejected(self):
+        j = mock.job()
+        j.type = "_core"
+        with pytest.raises(ValueError, match="cannot be core"):
+            validate(j)
+
+    def test_task_group_shape(self):
+        j = mock.job()
+        j.task_groups[0].count = -1
+        with pytest.raises(ValueError, match="count must be >= 0"):
+            validate(j)
+        j = mock.job()
+        j.task_groups[0].tasks = []
+        with pytest.raises(ValueError, match="at least one task"):
+            validate(j)
+
+
+class TestValidatePriority:
+    def test_band_edges(self):
+        for p in (JOB_MIN_PRIORITY, 50, JOB_MAX_PRIORITY):
+            j = mock.job()
+            j.priority = p
+            validate(j)
+
+    @pytest.mark.parametrize("priority", [0, -1, 101, 200])
+    def test_out_of_band_rejected(self, priority):
+        j = mock.job()
+        j.priority = priority
+        with pytest.raises(ValueError, match="priority must be between"):
+            validate(j)
+
+
+class TestValidatePeriodic:
+    def test_periodic_requires_batch(self):
+        j = mock.periodic_job()
+        j.type = JOB_TYPE_SERVICE
+        with pytest.raises(ValueError, match="batch jobs"):
+            validate(j)
+
+    def test_periodic_cannot_be_parameterized(self):
+        j = mock.periodic_job()
+        j.parameterized_job = ParameterizedJobConfig()
+        with pytest.raises(ValueError, match="cannot also be parameterized"):
+            validate(j)
+
+    def test_disabled_periodic_skips_periodic_checks(self):
+        # enabled=False means "not periodic" everywhere (is_periodic());
+        # the stanza may ride along on any type without the batch bound
+        j = mock.job()
+        j.periodic = PeriodicConfig(enabled=False, spec="not a cron")
+        validate(j)
+
+    def test_bad_cron_spec_rejected(self):
+        j = mock.periodic_job()
+        j.periodic.spec = "bad cron"
+        with pytest.raises(Exception):
+            validate(j)
+
+    def test_unknown_spec_type_rejected(self):
+        j = mock.periodic_job()
+        j.periodic.spec_type = "iso8601"
+        with pytest.raises(ValueError, match="unknown periodic spec type"):
+            validate(j)
+
+
+class TestRegisterEndpoint:
+    """End-to-end: the rejection happens at the endpoint, before raft."""
+
+    def _server(self):
+        s = Server(
+            {
+                "seed": 7,
+                "raft": {
+                    "node_id": "s0",
+                    "address": "jobep0",
+                    "voters": {"s0": "jobep0"},
+                    "transport": InmemTransport(),
+                    "config": RaftConfig(
+                        heartbeat_interval=0.02,
+                        election_timeout_min=0.05,
+                        election_timeout_max=0.10,
+                    ),
+                },
+            }
+        )
+        s.start(num_workers=0, wait_for_leader=5.0)
+        return s
+
+    def test_register_rejects_before_raft_and_accepts_valid(self):
+        s = self._server()
+        try:
+            bad = mock.job()
+            bad.priority = 400
+            idx_before = s.state.latest_index()
+            with pytest.raises(ValueError, match="priority must be between"):
+                s.job_register(bad)
+            assert s.state.latest_index() == idx_before  # nothing applied
+            assert s.state.job_by_id(bad.namespace, bad.id) is None
+
+            ok = mock.job()
+            s.job_register(ok)
+            assert s.state.job_by_id(ok.namespace, ok.id) is not None
+        finally:
+            s.stop()
+
+    def test_periodic_service_rejected_at_register(self):
+        s = self._server()
+        try:
+            j = mock.job()  # type=service
+            j.periodic = PeriodicConfig(
+                enabled=True, spec_type="cron", spec="*/5 * * * *"
+            )
+            with pytest.raises(ValueError, match="batch jobs"):
+                s.job_register(j)
+            assert s.state.job_by_id(j.namespace, j.id) is None
+        finally:
+            s.stop()

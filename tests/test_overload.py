@@ -633,15 +633,18 @@ class TestServerPipeline:
         s = make_server(num_workers=0, extra={"overload": stanza})
         try:
             bo = s.overload.brownout
-            assert bo.max_level == 4
+            assert bo.max_level == 6
             for _ in range(bo.max_level):
                 s.overload.on_sample(1.0)
-            assert bo.level == 4
+            assert bo.level == 6
             assert wavefront.enabled() is False
             assert tracer.sample_rate == 0.0
             assert devprof.enabled() is False
             if s.event_broker is not None:
                 assert s.event_broker.snapshot_on_subscribe is False
+            # the stream rungs flipped the server-side shed state for
+            # batch then service; system has no rung, ever
+            assert s._stream_shed_on == {"batch", "service"}
         finally:
             s.stop()
             reset_retry_budget()
@@ -649,4 +652,160 @@ class TestServerPipeline:
             wavefront.enabled(), tracer.sample_rate, devprof.enabled()
         ) == baseline
         assert s.overload.brownout.level == 0
-        assert s.overload.brownout.peak_level == 4
+        assert s.overload.brownout.peak_level == 6
+        assert s._stream_shed_on == set()
+
+
+class TestStreamShed:
+    """Brownout stream shedding (events/mux.py + the two stream rungs):
+    batch streams are hung up with a RESUMABLE close frame first,
+    service next, system never; with no overload stanza the policy is
+    byte-identical off."""
+
+    @staticmethod
+    def _ev(index, key="j1"):
+        from nomad_tpu.events import Event
+
+        return Event(
+            topic="Job", type="JobRegistered", key=key, index=index,
+            namespace="default",
+        )
+
+    def _mux_pair(self, mux, broker, admission_class):
+        """Subscribe + adopt one end of a socketpair; returns the client
+        socket (read side) and the subscription."""
+        import socket
+
+        client, server = socket.socketpair()
+        client.settimeout(5.0)
+        sub = broker.subscribe()
+        mux.serve(server, sub, heartbeat=30.0,
+                  admission_class=admission_class)
+        return client, sub
+
+    @staticmethod
+    def _read_until_eof(client):
+        buf = b""
+        try:
+            while True:
+                data = client.recv(65536)
+                if not data:
+                    break
+                buf += data
+        except OSError:
+            pass
+        return buf
+
+    def test_batch_shed_sends_resumable_close_service_survives(self):
+        import re
+
+        from nomad_tpu.events.broker import EventBroker
+        from nomad_tpu.events.mux import StreamMux
+
+        broker = EventBroker(size=1000)
+        mux = StreamMux(sweep=0.02)
+        try:
+            batch_c, batch_sub = self._mux_pair(mux, broker, "batch")
+            svc_c, svc_sub = self._mux_pair(mux, broker, "service")
+            for i in range(1, 4):
+                broker.publish(i, [self._ev(i)])
+            wait_until(
+                lambda: batch_sub.delivered_index == 3
+                and svc_sub.delivered_index == 3,
+                msg="both streams drained to index 3",
+            )
+            before = metrics.snapshot()["counters"].get(
+                "overload.shed.stream_batch", 0)
+            mux.set_class_shed("batch", True)
+            # the batch stream ends with the Error frame advertising ITS
+            # OWN delivered index (tighter than the slow-consumer ring
+            # floor: the shed client isn't behind), then the last chunk
+            # and a server-side close
+            buf = self._read_until_eof(batch_c)
+            m = re.search(rb'"ResumeIndex":\s*(\d+)', buf)
+            assert b"stream shed by brownout (batch)" in buf
+            assert m and int(m.group(1)) == 3
+            assert buf.endswith(b"0\r\n\r\n")
+            # the service stream is untouched and still live
+            assert not svc_sub.closed
+            broker.publish(4, [self._ev(4)])
+            wait_until(lambda: svc_sub.delivered_index == 4,
+                       msg="service stream still delivering")
+            st = mux.stats()
+            assert st["shed_classes"] == ["batch"]
+            assert st["shed_streams"] == {"batch": 1}
+            assert (
+                metrics.snapshot()["counters"]
+                ["overload.shed.stream_batch"] == before + 1
+            )
+            svc_c.close()
+            batch_c.close()
+        finally:
+            mux.stop()
+
+    def test_shed_class_rejects_new_adoptions_until_restore(self):
+        from nomad_tpu.events.broker import EventBroker
+        from nomad_tpu.events.mux import StreamMux
+
+        broker = EventBroker(size=1000)
+        mux = StreamMux(sweep=0.02)
+        try:
+            mux.set_class_shed("batch", True)
+            # adopted mid-brownout: hung up with the same resumable
+            # close frame, not silently served
+            c1, sub1 = self._mux_pair(mux, broker, "batch")
+            buf = self._read_until_eof(c1)
+            assert b"stream shed by brownout (batch)" in buf
+            wait_until(lambda: sub1.closed, msg="shed-at-admit close")
+            # restore stops future shedding; a reconnect now sticks
+            mux.set_class_shed("batch", False)
+            c2, sub2 = self._mux_pair(mux, broker, "batch")
+            broker.publish(1, [self._ev(1)])
+            wait_until(lambda: sub2.delivered_index == 1,
+                       msg="post-restore batch stream delivers")
+            assert not sub2.closed
+            c1.close()
+            c2.close()
+        finally:
+            mux.stop()
+
+    def test_brownout_ladder_drives_hooks_with_replay(self):
+        """Server side: the two stream rungs call every registered hook
+        in class order, and a hook registered mid-brownout (a mux built
+        lazily on first stream) gets the degraded state replayed."""
+        stanza = dict(
+            OVERLOAD_STANZA,
+            brownout={"enter": 0.9, "exit": 0.6,
+                      "enter_streak": 1, "exit_streak": 1},
+        )
+        s = make_server(num_workers=0, extra={"overload": stanza})
+        try:
+            calls = []
+            s.add_stream_shed_hook(lambda c, on: calls.append((c, on)))
+            for _ in range(s.overload.brownout.max_level):
+                s.overload.on_sample(1.0)
+            assert calls == [("batch", True), ("service", True)]
+            # a late registrant (mux created mid-brownout) replays
+            late = []
+            s.add_stream_shed_hook(lambda c, on: late.append((c, on)))
+            assert late == [("batch", True), ("service", True)]
+            for _ in range(8):
+                s.overload.on_sample(0.0)
+            assert ("service", False) in calls and ("batch", False) in calls
+            assert s._stream_shed_on == set()
+        finally:
+            s.stop()
+            reset_retry_budget()
+
+    def test_no_stanza_streams_never_shed(self):
+        """A/B: without overload{} there is no ladder, no rung ever
+        fires, and a registered hook is never invoked."""
+        s = make_server(num_workers=0)
+        try:
+            assert s.overload is None
+            calls = []
+            s.add_stream_shed_hook(lambda c, on: calls.append((c, on)))
+            assert calls == []
+            assert s._stream_shed_on == set()
+        finally:
+            s.stop()

@@ -222,6 +222,9 @@ class TestHTTPAPI:
         job.task_groups[0].count = 0
         from nomad_tpu.structs.model import PeriodicConfig
 
+        # periodic requires a batch job (the ported job-endpoint
+        # validation rejects periodic service jobs before raft)
+        job.type = "batch"
         job.periodic = PeriodicConfig(enabled=True, spec="0 0 1 1 *")
         client.register_job(job.to_dict())
         out = client.job_periodic_force("cron-parent")
